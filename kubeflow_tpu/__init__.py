@@ -1,0 +1,19 @@
+"""kubeflow-tpu: a TPU-native ML platform.
+
+A brand-new framework with the capabilities of the Kubeflow components repo
+(reference: ODH fork of kubeflow/kubeflow), re-designed TPU-first:
+
+- ``kubeflow_tpu.api`` / ``kubeflow_tpu.controlplane``: the control plane —
+  typed resources (Notebook, Profile, TpuPodDefault, Tensorboard), an
+  object store with watches, a reconciler runtime, controllers, and the
+  TPU env-injection webhook (the NCCL-free multi-host bootstrap).
+- ``kubeflow_tpu.parallel``: device meshes, sharding rules, FSDP/TP/SP/EP
+  parallelism built on jax.sharding + shard_map.
+- ``kubeflow_tpu.models`` / ``kubeflow_tpu.ops``: model families (Llama,
+  ViT, Gemma, MLP) and TPU kernels (Pallas flash attention, ring attention).
+- ``kubeflow_tpu.train``: training loop, optimizer, checkpointing.
+- ``kubeflow_tpu.serving``: jax2tf/SavedModel and pure-JAX serving.
+- ``kubeflow_tpu.distributed``: multi-host bootstrap from injected env.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,8 @@
+"""Model families: Llama-3, ViT, Gemma, MLP (BASELINE.md configs).
+
+Models are functional JAX: `init(rng, cfg) -> params pytree` plus
+`apply(params, cfg, ...) -> logits`, with a parallel pytree of logical
+axis names for sharding (kubeflow_tpu.parallel.sharding). No module
+framework on the hot path — pytrees + pure functions keep tracing cheap
+and sharding explicit.
+"""

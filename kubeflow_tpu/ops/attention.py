@@ -1,0 +1,102 @@
+"""Attention: XLA reference path + TPU Pallas flash-attention dispatch.
+
+Design: one public `dot_product_attention` that dispatches by backend.
+- CPU / debugging: pure-XLA grouped-query attention with fp32 logits.
+- TPU: Pallas flash attention kernel (kubeflow_tpu.ops.pallas.flash_attention)
+  for long sequences; falls back to XLA for short ones (XLA's fused
+  attention is already good below ~1k tokens).
+
+The XLA path never materializes repeated KV heads: queries are reshaped to
+[batch, q_per_kv, kv_heads, ...] and contracted against the kv heads
+directly — keeps HBM traffic at the GQA level, which is the point of GQA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -2.0**30  # large-but-finite: avoids NaNs from (-inf) - (-inf)
+
+
+def _xla_attention(
+    q: jnp.ndarray,            # [b, sq, n_q, hd]
+    k: jnp.ndarray,            # [b, skv, n_kv, hd]
+    v: jnp.ndarray,            # [b, skv, n_kv, hd]
+    q_positions: jnp.ndarray,  # [b, sq]
+    kv_positions: jnp.ndarray, # [b, skv]
+    *,
+    causal: bool,
+    kv_mask: jnp.ndarray | None,  # [b, skv] bool, False = padded/invalid
+) -> jnp.ndarray:
+    b, sq, n_q, hd = q.shape
+    n_kv = k.shape[2]
+    assert n_q % n_kv == 0, (n_q, n_kv)
+    group = n_q // n_kv
+    scale = hd**-0.5
+
+    qg = q.reshape(b, sq, n_kv, group, hd)
+    # logits: [b, n_kv, group, sq, skv] in fp32
+    logits = jnp.einsum(
+        "bsngh,btnh->bngst", qg.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+
+    mask = jnp.ones((b, sq, k.shape[1]), dtype=bool)
+    if causal:
+        mask &= q_positions[:, :, None] >= kv_positions[:, None, :]
+    if kv_mask is not None:
+        mask &= kv_mask[:, None, :]
+    logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bngst,btnh->bsngh", probs, v.astype(jnp.float32))
+    return out.reshape(b, sq, n_q, hd).astype(q.dtype)
+
+
+def _flash_kernel_available() -> bool:
+    try:
+        from kubeflow_tpu.ops.pallas import flash_attention  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def dot_product_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    kv_positions: jnp.ndarray,
+    *,
+    causal: bool = True,
+    kv_mask: jnp.ndarray | None = None,
+    impl: str = "auto",
+    contiguous_positions: bool = False,
+) -> jnp.ndarray:
+    """Grouped-query attention.
+
+    impl: "auto" | "xla" | "flash". "auto" picks the Pallas flash kernel on
+    TPU for long sequences when it is safe: kernel present, no kv_mask, and
+    the caller declared positions contiguous (`contiguous_positions=True`).
+    The flash kernel masks by row/col index, so packed sequences with
+    per-segment position resets MUST take the XLA path, which masks by the
+    actual position tensors.
+    """
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        long_seq = q.shape[1] >= 1024 and q.shape[1] % 512 == 0
+        same_len = q.shape[1] == k.shape[1]
+        impl = (
+            "flash"
+            if (on_tpu and long_seq and same_len and causal
+                and kv_mask is None and contiguous_positions
+                and _flash_kernel_available())
+            else "xla"
+        )
+    if impl == "flash":
+        from kubeflow_tpu.ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    return _xla_attention(
+        q, k, v, q_positions, kv_positions, causal=causal, kv_mask=kv_mask
+    )

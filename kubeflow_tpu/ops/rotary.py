@@ -1,0 +1,33 @@
+"""Rotary position embeddings (RoPE), Llama-3 style with NTK scaling hooks."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int,
+    *,
+    theta: float = 500000.0,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Inverse frequencies for RoPE. Llama-3 uses theta=500000."""
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return (1.0 / (theta**exponents)).astype(dtype)
+
+
+def apply_rope(
+    x: jnp.ndarray,          # [batch, seq, heads, head_dim]
+    positions: jnp.ndarray,  # [batch, seq] int32
+    inv_freq: jnp.ndarray,   # [head_dim // 2]
+) -> jnp.ndarray:
+    """Rotate (pairs-split convention: first half/second half, as Llama).
+
+    fp32 sin/cos for precision; result cast back to x.dtype.
+    """
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [b, s, hd/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    cos = jnp.cos(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)

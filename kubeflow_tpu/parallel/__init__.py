@@ -1,0 +1,21 @@
+"""Parallelism layer: meshes, sharding rules, and parallel transforms.
+
+The reference control plane has no parallelism code (SURVEY.md §2b); this
+package is the TPU-native value-add: jax.sharding Mesh construction from
+slice topology, logical-axis sharding rules, and FSDP/TP/SP/EP strategies.
+"""
+
+from kubeflow_tpu.parallel.mesh import (
+    MeshSpec,
+    SliceTopology,
+    SLICE_TOPOLOGIES,
+    create_mesh,
+    mesh_from_env,
+)
+from kubeflow_tpu.parallel.sharding import (
+    ShardingRules,
+    LLAMA_RULES,
+    logical_to_spec,
+    shard_pytree_specs,
+    with_sharding_constraint,
+)

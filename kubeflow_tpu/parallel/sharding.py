@@ -1,0 +1,103 @@
+"""Logical-axis sharding rules (t5x-style) mapped onto the mesh.
+
+Every parameter/activation carries *logical* axis names ("embed", "heads",
+"mlp", "batch", ...). A `ShardingRules` table maps logical names to mesh
+axes; `logical_to_spec` resolves them into `PartitionSpec`s. Changing the
+parallelism strategy (FSDP vs TP vs both) is a rules change, not a model
+change — this is the TPU-idiomatic answer to the reference's absent
+parallelism layer (SURVEY.md §2b).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel import mesh as mesh_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """Maps logical axis names to mesh axis names (or None = replicated)."""
+
+    rules: Mapping[str, str | tuple[str, ...] | None]
+
+    def resolve(self, logical_axes: tuple[str | None, ...]) -> P:
+        out: list[Any] = []
+        for ax in logical_axes:
+            if ax is None:
+                out.append(None)
+            else:
+                if ax not in self.rules:
+                    raise KeyError(f"no sharding rule for logical axis {ax!r}")
+                out.append(self.rules[ax])
+        # Trailing Nones can be dropped but keeping them is harmless.
+        return P(*out)
+
+
+# The canonical Llama/transformer rule set. Params and activations use
+# DISTINCT logical names: a param's embed dim shards over fsdp (ZeRO-3 —
+# gathered per-layer), while an activation's embed dim stays unsharded
+# (its batch dim already carries data×fsdp); TP shards params' and
+# activations' heads/mlp/vocab dims over tensor.
+LLAMA_RULES = ShardingRules(
+    rules={
+        # --- params ---
+        "embed": mesh_lib.FSDP_AXIS,
+        "heads": mesh_lib.TENSOR_AXIS,
+        "kv_heads": mesh_lib.TENSOR_AXIS,
+        "head_dim": None,
+        "mlp": mesh_lib.TENSOR_AXIS,
+        "vocab": mesh_lib.TENSOR_AXIS,
+        "layers": None,
+        "experts": mesh_lib.TENSOR_AXIS,
+        "stage": None,
+        # --- activations ---
+        "batch": (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS),
+        "seq": None,
+        "act_embed": None,
+        "act_heads": mesh_lib.TENSOR_AXIS,
+        "act_kv_heads": mesh_lib.TENSOR_AXIS,
+        "act_mlp": mesh_lib.TENSOR_AXIS,
+        "act_vocab": mesh_lib.TENSOR_AXIS,
+    }
+)
+
+
+def logical_to_spec(rules: ShardingRules, logical: Any) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda axes: rules.resolve(axes),
+        logical,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(a is None or isinstance(a, str) for a in x),
+    )
+
+
+def shard_pytree_specs(rules: ShardingRules, logical: Any, mesh: Mesh) -> Any:
+    """Like logical_to_spec but returns NamedShardings bound to `mesh`."""
+    specs = logical_to_spec(rules, logical)
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def with_sharding_constraint(x: Any, logical_axes: tuple[str | None, ...],
+                             rules: ShardingRules = LLAMA_RULES) -> Any:
+    """Constrain an activation's sharding by logical axes (no-op outside jit
+    without a mesh context)."""
+    spec = rules.resolve(logical_axes)  # typos in logical names must raise
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except Exception as e:
+        # Only the no-mesh-context case is advisory (plain eager CPU runs);
+        # anything else (e.g. duplicate mesh axes in one spec) is a real
+        # sharding bug and must surface.
+        if "mesh" in str(e).lower():
+            return x
+        raise

@@ -1,0 +1,8 @@
+"""Training loop layer: sharded train step, optimizer, data."""
+
+from kubeflow_tpu.train.trainer import (
+    TrainState,
+    Trainer,
+    TrainConfig,
+    cross_entropy_loss,
+)

@@ -1,0 +1,24 @@
+"""Test config: hermetic 8-device CPU mesh (the fake-TPU backend).
+
+Mirrors the reference's envtest philosophy (SURVEY.md §4): test the real
+code against a simulated environment. Here: JAX CPU with 8 virtual
+devices stands in for a TPU slice so sharding/collectives are exercised
+without hardware.
+
+Note: a sitecustomize may pin jax_platforms to a TPU plugin via
+jax.config (overriding the JAX_PLATFORMS env var), so we override the
+config directly — before any backend is initialized.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

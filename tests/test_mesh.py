@@ -1,0 +1,47 @@
+"""Mesh/topology tests (control-plane ↔ compute shared source of truth)."""
+
+import jax
+import pytest
+
+from kubeflow_tpu.parallel import (
+    MeshSpec,
+    SLICE_TOPOLOGIES,
+    create_mesh,
+    mesh_from_env,
+)
+
+
+def test_virtual_device_count():
+    assert len(jax.devices()) == 8  # conftest fake-TPU backend
+
+
+def test_topology_table():
+    t = SLICE_TOPOLOGIES["v5e-16"]
+    assert t.chips == 16
+    assert t.hosts == 4  # 4 chips per host on multi-host v5e
+    assert SLICE_TOPOLOGIES["v5e-1"].hosts == 1
+    assert SLICE_TOPOLOGIES["v5e-8"].hosts == 1  # single host, 8 chips
+
+
+def test_mesh_spec_resolution():
+    assert MeshSpec().resolve(8) == {"data": 1, "fsdp": 8, "tensor": 1}
+    assert MeshSpec(data=2, fsdp=-1, tensor=2).resolve(8) == {
+        "data": 2, "fsdp": 2, "tensor": 2}
+    with pytest.raises(ValueError):
+        MeshSpec(data=3, fsdp=-1).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=2, fsdp=2, tensor=1).resolve(8)
+
+
+def test_create_mesh_axes():
+    mesh = create_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    assert mesh.axis_names == ("data", "fsdp", "tensor")
+    assert mesh.shape == {"data": 2, "fsdp": 2, "tensor": 2}
+
+
+def test_mesh_from_env(monkeypatch):
+    monkeypatch.setenv("KFTPU_MESH", "data=1,fsdp=4,tensor=2")
+    mesh = mesh_from_env()
+    assert mesh.shape == {"data": 1, "fsdp": 4, "tensor": 2}
+    monkeypatch.delenv("KFTPU_MESH")
+    assert mesh_from_env().shape == {"data": 1, "fsdp": 8, "tensor": 1}
