@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Benchmark: Llama training tokens/sec/chip on the local device(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference (kubeflow/kubeflow control plane) publishes no performance
+numbers (BASELINE.md: `published: {}`), so `vs_baseline` is normalized
+against a hardware roofline instead: vs_baseline = MFU / 0.40, i.e. 1.0
+means 40% model-FLOPs utilization of the chip's peak bf16 throughput —
+a strong single-chip training bar. >1.0 beats it.
+
+Presets are sized to the device: on a single v5e chip (16 GB HBM) a
+~460M-param Llama with fp32 master params + Adam fits with remat; on CPU
+the tiny config keeps CI fast.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# Peak bf16 FLOPs/sec per chip by TPU generation (public numbers).
+PEAK_FLOPS = {
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+    "cpu": 1e11,  # nominal; CPU runs are smoke tests, not benchmarks
+}
+
+
+def detect_generation() -> str:
+    if jax.default_backend() != "tpu":
+        return "cpu"
+    kind = jax.devices()[0].device_kind.lower()
+    for gen in ("v6e", "v5p", "v5e", "v4"):
+        if gen in kind or gen.replace("v", "v5 lite") in kind:
+            return gen
+    if "v5 lite" in kind or "v5lite" in kind:
+        return "v5e"
+    return "v5e"
+
+
+@dataclasses.dataclass
+class Preset:
+    name: str
+    batch: int
+    seq: int
+    steps: int
+    warmup: int
+    model: str  # key into llama-style config factory below
+
+
+def bench_configs():
+    from kubeflow_tpu.models import llama
+
+    # ~460M params, MXU-friendly shapes, 32k vocab: fits one v5e chip
+    # with fp32 params + adam moments + remat at batch 8 x seq 2048.
+    bench_500m = llama.LlamaConfig(
+        vocab_size=32768, hidden_size=1536, intermediate_size=6144,
+        num_layers=14, num_heads=12, num_kv_heads=4, head_dim=128,
+    )
+    return {
+        "tiny": llama.LLAMA_TINY,
+        "bench-500m": bench_500m,
+        "llama3-1b": llama.LLAMA3_1B,
+        "llama3-8b": llama.LLAMA3_8B,
+    }
+
+
+PRESETS = {
+    "tpu-v5e-1": Preset("tpu-v5e-1", batch=8, seq=2048, steps=10, warmup=2,
+                        model="bench-500m"),
+    "tiny-cpu": Preset("tiny-cpu", batch=4, seq=128, steps=5, warmup=1,
+                       model="tiny"),
+}
+
+
+def model_flops_per_token(cfg, seq: int) -> float:
+    """Approximate train FLOPs/token: 6*N for matmul params + attention."""
+    from kubeflow_tpu.models import llama
+
+    n = llama.num_params(cfg)
+    n_matmul = n - cfg.vocab_size * cfg.hidden_size  # embed lookup is free
+    attn = 12 * cfg.num_layers * cfg.num_heads * cfg.head_dim * seq
+    return 6 * n_matmul + attn
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="auto")
+    p.add_argument("--json-only", action="store_true")
+    args = p.parse_args()
+
+    preset_name = args.preset
+    if preset_name == "auto":
+        preset_name = "tpu-v5e-1" if jax.default_backend() == "tpu" else "tiny-cpu"
+    preset = PRESETS[preset_name]
+
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.parallel import MeshSpec, create_mesh
+    from kubeflow_tpu.train import Trainer, TrainConfig
+
+    cfg = bench_configs()[preset.model]
+    n_devices = len(jax.devices())
+    mesh = create_mesh(MeshSpec(data=1, fsdp=n_devices, tensor=1))
+    # Global batch must divide evenly over the data*fsdp axes.
+    batch = -(-preset.batch // n_devices) * n_devices
+
+    trainer = Trainer(
+        mesh=mesh,
+        apply_fn=lambda p_, t: llama.apply(p_, cfg, t),
+        init_fn=lambda k: llama.init(k, cfg),
+        logical_axes=llama.param_logical_axes(cfg),
+        train_config=TrainConfig(warmup_steps=10, total_steps=1000),
+    )
+    state = trainer.init(jax.random.key(0))
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (batch, preset.seq)), jnp.int32
+    )
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    for _ in range(preset.warmup):
+        state, loss = trainer.step(state, tokens, targets)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(preset.steps):
+        state, loss = trainer.step(state, tokens, targets)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    total_tokens = batch * preset.seq * preset.steps
+    tok_per_sec_per_chip = total_tokens / dt / n_devices
+
+    gen = detect_generation()
+    flops_per_tok = model_flops_per_token(cfg, preset.seq)
+    mfu = tok_per_sec_per_chip * flops_per_tok / PEAK_FLOPS[gen]
+    vs_baseline = mfu / 0.40
+
+    result = {
+        "metric": f"llama_train_tokens_per_sec_per_chip[{preset.model},{gen}]",
+        "value": round(tok_per_sec_per_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs_baseline, 4),
+    }
+    print(json.dumps(result))
+    if not args.json_only:
+        print(
+            f"# preset={preset.name} devices={n_devices} loss={float(loss):.3f} "
+            f"mfu={mfu:.3f} step_time={dt/preset.steps*1000:.1f}ms",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
