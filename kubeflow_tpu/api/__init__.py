@@ -1,0 +1,43 @@
+"""Typed resource model (reference L1: CRD type definitions).
+
+The reference defines its types as Go structs registered into a k8s
+scheme (notebook_types.go, profile_types.go, poddefault_types.go,
+tensorboard_types.go). Here resources are Python dataclasses with a
+uniform envelope (apiVersion/kind/metadata/spec/status) and dict
+round-tripping, served by the kubeflow_tpu.controlplane store.
+"""
+
+from kubeflow_tpu.api.core import (
+    Container,
+    EnvVar,
+    Event,
+    Namespace,
+    ObjectMeta,
+    OwnerReference,
+    PersistentVolumeClaim,
+    Pod,
+    PodSpec,
+    Resource,
+    RoleBinding,
+    Service,
+    ServiceAccount,
+    ServicePort,
+    StatefulSet,
+    Toleration,
+    VirtualService,
+    Volume,
+    VolumeMount,
+    resource_from_dict,
+)
+from kubeflow_tpu.api.crds import (
+    Notebook,
+    NotebookSpec,
+    NotebookStatus,
+    Profile,
+    ProfileSpec,
+    Tensorboard,
+    TensorboardSpec,
+    TpuPodDefault,
+    TpuPodDefaultSpec,
+    TpuSpec,
+)

@@ -1,0 +1,397 @@
+"""Core resource model: metadata envelope + workload types.
+
+Design: every resource is a dataclass subclassing `Resource` with
+`metadata: ObjectMeta` plus kind-specific spec/status dataclasses.
+Serialization is structural (`to_dict`/`resource_from_dict`) so the REST
+layer, the store, and tests all speak plain dicts — the same role the
+k8s API machinery plays for the reference's Go structs
+(e.g. notebook-controller/api/v1beta1/notebook_types.go:69-75).
+
+These are *our* workload types, not k8s clones: just enough surface for
+the controllers' semantics (env/volume merge, gang replicas, routing),
+with TPU fields first-class where k8s would use annotations.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import time
+import typing
+from dataclasses import dataclass, field
+from typing import Any, ClassVar
+
+API_VERSION = "kubeflow-tpu.dev/v1"
+
+
+def _now() -> float:
+    return time.time()
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    uid: str = ""
+    resource_version: int = 0
+    generation: int = 0
+    creation_timestamp: float = 0.0
+    deletion_timestamp: float | None = None
+    owner_references: list[OwnerReference] = field(default_factory=list)
+    finalizers: list[str] = field(default_factory=list)
+
+
+@dataclass
+class OwnerReference:
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = True
+
+
+# ---------------------------------------------------------------------------
+# Pod building blocks (consumed by the webhook merge engine — the analog of
+# admission-webhook/main.go:153-364's env/volume/toleration merging).
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class EnvVar:
+    name: str = ""
+    value: str = ""
+
+
+@dataclass
+class VolumeMount:
+    name: str = ""
+    mount_path: str = ""
+    sub_path: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class Volume:
+    name: str = ""
+    # Exactly one of the sources is typically set.
+    pvc_name: str = ""          # persistent claim
+    empty_dir: bool = False
+    config_map: str = ""
+    secret: str = ""
+    size_limit: str = ""        # for empty_dir (e.g. shm)
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"
+    value: str = ""
+    effect: str = ""
+
+
+@dataclass
+class ResourceRequirements:
+    requests: dict[str, str] = field(default_factory=dict)
+    limits: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Probe:
+    path: str = ""
+    port: int = 0
+    initial_delay_seconds: int = 0
+    period_seconds: int = 10
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    command: list[str] = field(default_factory=list)
+    args: list[str] = field(default_factory=list)
+    env: list[EnvVar] = field(default_factory=list)
+    ports: list[int] = field(default_factory=list)
+    volume_mounts: list[VolumeMount] = field(default_factory=list)
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    working_dir: str = ""
+    liveness_probe: Probe | None = None
+    readiness_probe: Probe | None = None
+
+
+@dataclass
+class NodeSelectorTerm:
+    key: str = ""
+    values: list[str] = field(default_factory=list)
+
+
+@dataclass
+class PodSpec:
+    containers: list[Container] = field(default_factory=list)
+    init_containers: list[Container] = field(default_factory=list)
+    volumes: list[Volume] = field(default_factory=list)
+    tolerations: list[Toleration] = field(default_factory=list)
+    service_account: str = ""
+    node_selector: dict[str, str] = field(default_factory=dict)
+    affinity_terms: list[NodeSelectorTerm] = field(default_factory=list)
+    scheduler_name: str = ""
+    fs_group: int | None = None
+    hostname: str = ""
+    subdomain: str = ""
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+# ---------------------------------------------------------------------------
+# Resource envelope + registry
+# ---------------------------------------------------------------------------
+
+_KIND_REGISTRY: dict[str, type] = {}
+
+
+@dataclass
+class Resource:
+    KIND: ClassVar[str] = ""
+    NAMESPACED: ClassVar[bool] = True
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if cls.KIND:
+            _KIND_REGISTRY[cls.KIND] = cls
+
+    @property
+    def kind(self) -> str:
+        return type(self).KIND
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.kind, self.metadata.namespace, self.metadata.name)
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["apiVersion"] = API_VERSION
+        d["kind"] = self.kind
+        return d
+
+    def clone(self):
+        return copy.deepcopy(self)
+
+
+def _build(cls, data):
+    """Recursively build a dataclass from a plain dict (tolerant: unknown
+    keys are ignored; missing keys take defaults)."""
+    if data is None:
+        return None
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name not in data:
+            continue
+        v = data[f.name]
+        t = hints.get(f.name, Any)
+        kwargs[f.name] = _coerce(t, v)
+    return cls(**kwargs)
+
+
+def _coerce(t, v):
+    origin = typing.get_origin(t)
+    if origin in (typing.Union, getattr(__import__("types"), "UnionType", None)):
+        args = [a for a in typing.get_args(t) if a is not type(None)]
+        if v is None:
+            return None
+        return _coerce(args[0], v)
+    if dataclasses.is_dataclass(t) and isinstance(v, dict):
+        return _build(t, v)
+    if origin is list and isinstance(v, list):
+        (elem,) = typing.get_args(t)
+        return [_coerce(elem, x) for x in v]
+    if origin is dict and isinstance(v, dict):
+        return dict(v)
+    return v
+
+
+def resource_from_dict(data: dict[str, Any]) -> Resource:
+    kind = data.get("kind", "")
+    cls = _KIND_REGISTRY.get(kind)
+    if cls is None:
+        raise ValueError(f"unknown kind {kind!r}")
+    payload = {k: v for k, v in data.items() if k not in ("apiVersion", "kind")}
+    return _build(cls, payload)
+
+
+def registered_kinds() -> dict[str, type]:
+    return dict(_KIND_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Workload resources the controllers own (reference L2 outputs)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Pod(Resource):
+    KIND: ClassVar[str] = "Pod"
+    spec: PodSpec = field(default_factory=PodSpec)
+    # status
+    phase: str = "Pending"   # Pending/Running/Succeeded/Failed
+    ready: bool = False
+    host_ip: str = ""
+    pod_ip: str = ""
+    conditions: list[dict] = field(default_factory=list)
+
+
+@dataclass
+class StatefulSetSpec:
+    replicas: int = 1
+    service_name: str = ""
+    selector: dict[str, str] = field(default_factory=dict)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    # Gang semantics: all-or-nothing pod creation for TPU slices
+    # (reference never needed this — single-pod notebooks; SURVEY.md §7
+    # "hard parts" (a)).
+    gang: bool = False
+
+
+@dataclass
+class StatefulSet(Resource):
+    KIND: ClassVar[str] = "StatefulSet"
+    spec: StatefulSetSpec = field(default_factory=StatefulSetSpec)
+    ready_replicas: int = 0
+
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    port: int = 0
+    target_port: int = 0
+
+
+@dataclass
+class ServiceSpec:
+    selector: dict[str, str] = field(default_factory=dict)
+    ports: list[ServicePort] = field(default_factory=list)
+    cluster_ip: str = ""
+    headless: bool = False
+
+
+@dataclass
+class Service(Resource):
+    KIND: ClassVar[str] = "Service"
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+
+
+@dataclass
+class HTTPRoute:
+    prefix: str = ""
+    rewrite: str = ""
+    destination_host: str = ""
+    destination_port: int = 0
+    headers: dict[str, str] = field(default_factory=dict)
+    timeout: str = ""
+
+
+@dataclass
+class VirtualServiceSpec:
+    gateways: list[str] = field(default_factory=list)
+    hosts: list[str] = field(default_factory=list)
+    http: list[HTTPRoute] = field(default_factory=list)
+
+
+@dataclass
+class VirtualService(Resource):
+    KIND: ClassVar[str] = "VirtualService"
+    spec: VirtualServiceSpec = field(default_factory=VirtualServiceSpec)
+
+
+@dataclass
+class DeploymentSpec:
+    replicas: int = 1
+    selector: dict[str, str] = field(default_factory=dict)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class Deployment(Resource):
+    KIND: ClassVar[str] = "Deployment"
+    spec: DeploymentSpec = field(default_factory=DeploymentSpec)
+    ready_replicas: int = 0
+    conditions: list[dict] = field(default_factory=list)
+
+
+@dataclass
+class PersistentVolumeClaim(Resource):
+    KIND: ClassVar[str] = "PersistentVolumeClaim"
+    storage: str = "5Gi"
+    access_modes: list[str] = field(default_factory=lambda: ["ReadWriteOnce"])
+    storage_class: str = ""
+    phase: str = "Bound"  # hermetic cluster binds immediately
+
+
+@dataclass
+class Event(Resource):
+    KIND: ClassVar[str] = "Event"
+    involved_kind: str = ""
+    involved_name: str = ""
+    type: str = "Normal"   # Normal | Warning
+    reason: str = ""
+    message: str = ""
+    timestamp: float = field(default_factory=_now)
+
+
+@dataclass
+class Namespace(Resource):
+    KIND: ClassVar[str] = "Namespace"
+    NAMESPACED: ClassVar[bool] = False
+    phase: str = "Active"
+
+
+@dataclass
+class ServiceAccount(Resource):
+    KIND: ClassVar[str] = "ServiceAccount"
+
+
+@dataclass
+class RoleBinding(Resource):
+    KIND: ClassVar[str] = "RoleBinding"
+    role: str = ""            # cluster role name, e.g. "kubeflow-tpu-edit"
+    subjects: list[str] = field(default_factory=list)  # user ids
+
+
+@dataclass
+class AuthorizationPolicy(Resource):
+    KIND: ClassVar[str] = "AuthorizationPolicy"
+    # principals/headers allowed; paths optionally restricted
+    allow_users: list[str] = field(default_factory=list)
+    allow_namespaces: list[str] = field(default_factory=list)
+    allow_paths: list[str] = field(default_factory=list)
+
+
+@dataclass
+class ResourceQuota(Resource):
+    KIND: ClassVar[str] = "ResourceQuota"
+    hard: dict[str, str] = field(default_factory=dict)  # incl. "tpu/chips"
+
+
+@dataclass
+class NetworkPolicy(Resource):
+    KIND: ClassVar[str] = "NetworkPolicy"
+    allow_from_namespaces: list[str] = field(default_factory=list)
+    allow_ports: list[int] = field(default_factory=list)
+
+
+@dataclass
+class ConfigMap(Resource):
+    KIND: ClassVar[str] = "ConfigMap"
+    data: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Secret(Resource):
+    KIND: ClassVar[str] = "Secret"
+    data: dict[str, str] = field(default_factory=dict)

@@ -1,0 +1,164 @@
+"""Platform CRDs: Notebook, Profile, TpuPodDefault, Tensorboard.
+
+TPU-first redesign of the reference CRDs:
+- `Notebook` (ref: notebook-controller/api/v1beta1/notebook_types.go:69-75)
+  gains a first-class `tpu` block (slice topology, generation) instead of
+  GPU vendor annotations; the reconciler derives gang replica count from
+  the topology (one pod per TPU VM host).
+- `Profile` (ref: profile-controller/api/v1/profile_types.go:63-69) quota
+  includes TPU chips.
+- `TpuPodDefault` (ref: admission-webhook/pkg/apis/settings/v1alpha1/
+  poddefault_types.go:27-78) keeps the label-selected merge semantics and
+  adds `tpu_env: bool` to opt a pod into automatic TPU_WORKER_* injection.
+- `Tensorboard` (ref: tensorboard-controller/api/v1alpha1/
+  tensorboard_types.go:57-63) keeps logspath dispatch (pvc:// | gs://).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import ClassVar
+
+from kubeflow_tpu.api.core import (
+    PodTemplateSpec,
+    Resource,
+    Toleration,
+    Volume,
+    VolumeMount,
+    EnvVar,
+)
+
+
+# ---------------------------------------------------------------------------
+# Notebook
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TpuSpec:
+    """TPU attachment for a workload. Empty topology = CPU-only pod."""
+
+    topology: str = ""          # e.g. "v5e-16" (kubeflow_tpu.parallel.mesh)
+    # Parallelism layout hint injected as KFTPU_MESH for in-pod JAX.
+    mesh: str = ""              # e.g. "data=1,fsdp=16,tensor=1"
+    reserved: bool = False      # use reserved capacity
+
+
+@dataclass
+class NotebookSpec:
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    tpu: TpuSpec = field(default_factory=TpuSpec)
+
+
+@dataclass
+class NotebookCondition:
+    type: str = ""
+    reason: str = ""
+    message: str = ""
+    last_probe_time: float = 0.0
+
+
+@dataclass
+class NotebookStatus:
+    ready_replicas: int = 0
+    container_state: str = ""   # waiting | running | terminated
+    conditions: list[NotebookCondition] = field(default_factory=list)
+
+
+@dataclass
+class Notebook(Resource):
+    KIND: ClassVar[str] = "Notebook"
+    spec: NotebookSpec = field(default_factory=NotebookSpec)
+    status: NotebookStatus = field(default_factory=NotebookStatus)
+
+
+# Annotations shared with the reference's semantics (culler / stop):
+STOP_ANNOTATION = "kubeflow-tpu.dev/stopped"           # ref culler.go:36-40
+LAST_ACTIVITY_ANNOTATION = "kubeflow-tpu.dev/last-activity"
+CULLING_DISABLED_ANNOTATION = "kubeflow-tpu.dev/culling-disabled"
+# Webhook bookkeeping (ref admission-webhook/main.go:424-426 stamps
+# poddefault.admission.kubeflow.org/poddefault-<name>=<rv>):
+PODDEFAULT_APPLIED_PREFIX = "tpupoddefault.kubeflow-tpu.dev/"
+WEBHOOK_EXCLUDE_ANNOTATION = "kubeflow-tpu.dev/webhook-exclude"
+
+
+# ---------------------------------------------------------------------------
+# Profile (multi-tenancy)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ProfileSpec:
+    owner: str = ""                       # user id (email)
+    resource_quota: dict[str, str] = field(default_factory=dict)
+    # e.g. {"cpu": "32", "memory": "128Gi", "tpu/v5e-chips": "16"}
+
+
+@dataclass
+class ProfileStatus:
+    phase: str = ""  # "" | Ready | Failed
+    message: str = ""
+
+
+@dataclass
+class Profile(Resource):
+    KIND: ClassVar[str] = "Profile"
+    NAMESPACED: ClassVar[bool] = False    # cluster-scoped, owns a namespace
+    spec: ProfileSpec = field(default_factory=ProfileSpec)
+    status: ProfileStatus = field(default_factory=ProfileStatus)
+
+
+PROFILE_FINALIZER = "profile.kubeflow-tpu.dev/cleanup"
+
+
+# ---------------------------------------------------------------------------
+# TpuPodDefault (PodDefault, TPU-first)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TpuPodDefaultSpec:
+    # label selector choosing which pods this applies to
+    selector: dict[str, str] = field(default_factory=dict)
+    desc: str = ""
+    env: list[EnvVar] = field(default_factory=list)
+    volumes: list[Volume] = field(default_factory=list)
+    volume_mounts: list[VolumeMount] = field(default_factory=list)
+    tolerations: list[Toleration] = field(default_factory=list)
+    annotations: dict[str, str] = field(default_factory=dict)
+    labels: dict[str, str] = field(default_factory=dict)
+    service_account: str = ""
+    command: list[str] = field(default_factory=list)
+    args: list[str] = field(default_factory=list)
+    # TPU-native: inject TPU_WORKER_ID/TPU_WORKER_HOSTNAMES/coordinator
+    # env derived from the pod's gang position (the NCCL-free bootstrap).
+    tpu_env: bool = False
+
+
+@dataclass
+class TpuPodDefault(Resource):
+    KIND: ClassVar[str] = "TpuPodDefault"
+    spec: TpuPodDefaultSpec = field(default_factory=TpuPodDefaultSpec)
+
+
+# ---------------------------------------------------------------------------
+# Tensorboard
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TensorboardSpec:
+    logspath: str = ""   # "pvc://name/subpath" | "gs://bucket/path"
+
+
+@dataclass
+class TensorboardStatus:
+    ready: bool = False
+    conditions: list[dict] = field(default_factory=list)
+
+
+@dataclass
+class Tensorboard(Resource):
+    KIND: ClassVar[str] = "Tensorboard"
+    spec: TensorboardSpec = field(default_factory=TensorboardSpec)
+    status: TensorboardStatus = field(default_factory=TensorboardStatus)

@@ -1,0 +1,13 @@
+"""Control plane: object store, reconciler runtime, controllers, webhook.
+
+The TPU-native re-design of the reference's L0-L3 stack:
+- store.py      — versioned object store + watch fanout (apiserver/envtest
+                  equivalent; pluggable native C++ backend)
+- runtime.py    — controller manager: workqueues, reconcile loops,
+                  owner-based requeue (controller-runtime equivalent)
+- webhook.py    — admission chain: TpuPodDefault merge + TPU env injection
+- controllers/  — notebook, profile, tensorboard reconcilers + culler
+"""
+
+from kubeflow_tpu.controlplane.store import Store, WatchEvent, Conflict, NotFound
+from kubeflow_tpu.controlplane.runtime import Controller, Manager, Result

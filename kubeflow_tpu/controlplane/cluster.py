@@ -1,0 +1,75 @@
+"""Cluster assembly: store + webhook + controllers under one Manager.
+
+The equivalent of the reference's per-controller main.go wiring
+(notebook-controller/main.go, profile-controller/main.go) plus the
+envtest environment used by its integration suites — one call builds a
+fully-working in-process control plane.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.controlplane.controllers.culler import ActivityProbe, Culler
+from kubeflow_tpu.controlplane.controllers.notebook import NotebookController
+from kubeflow_tpu.controlplane.controllers.workload import (
+    NodePool,
+    Scheduler,
+    StatefulSetController,
+)
+from kubeflow_tpu.controlplane.runtime import Manager
+from kubeflow_tpu.controlplane.store import Store
+from kubeflow_tpu.controlplane.webhook import PodDefaultWebhook
+
+
+@dataclass
+class ClusterConfig:
+    tpu_slices: dict[str, int] = field(default_factory=dict)
+    use_routing: bool = True
+    enable_culling: bool = False
+    cull_idle_time: float = 1440 * 60.0
+    cull_check_period: float = 60.0
+    activity_probe: ActivityProbe | None = None
+
+
+class Cluster:
+    """In-process control plane. Use as a context manager or call
+    start()/stop() explicitly."""
+
+    def __init__(self, config: ClusterConfig | None = None):
+        self.config = config or ClusterConfig()
+        self.store = Store()
+        self.scheduler = Scheduler(NodePool(dict(self.config.tpu_slices)))
+        self.webhook = PodDefaultWebhook(self.store)
+        self.store.register_mutating_webhook("Pod", self.webhook)
+        self.manager = Manager(self.store)
+        self.notebook_controller = NotebookController(
+            use_routing=self.config.use_routing
+        )
+        self.statefulset_controller = StatefulSetController(self.scheduler)
+        self.manager.register(self.notebook_controller)
+        self.manager.register(self.statefulset_controller)
+        self.culler = None
+        if self.config.enable_culling and self.config.activity_probe is not None:
+            self.culler = Culler(
+                self.config.activity_probe,
+                idle_time=self.config.cull_idle_time,
+                check_period=self.config.cull_check_period,
+            )
+            self.manager.register(self.culler)
+
+    def start(self) -> "Cluster":
+        self.manager.start()
+        return self
+
+    def stop(self) -> None:
+        self.manager.stop()
+
+    def wait_idle(self, timeout: float = 5.0) -> bool:
+        return self.manager.wait_idle(timeout=timeout)
+
+    def __enter__(self) -> "Cluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,9 @@
+"""Reconcilers (reference L2): notebook, workload, profile, tensorboard."""
+
+from kubeflow_tpu.controlplane.controllers.notebook import NotebookController
+from kubeflow_tpu.controlplane.controllers.workload import (
+    StatefulSetController,
+    Scheduler,
+    NodePool,
+)
+from kubeflow_tpu.controlplane.controllers.culler import Culler, ActivityProbe

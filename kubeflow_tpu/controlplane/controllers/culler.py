@@ -1,0 +1,193 @@
+"""Idle culling: kernel-activity probing → stop annotation.
+
+Semantics from the reference's culler pkg (notebook-controller/pkg/
+culler/culler.go), re-shaped for TPU economics (an idle v5e-16 slice
+burns 16 chips, so culling is a first-class cost control):
+
+- probe each running notebook's kernel/terminal activity over its
+  in-cluster URL (ref getNotebookResourceResponse :155-180); here the
+  transport is a pluggable `ActivityProbe` so tests inject activity
+  hermetically (the reference's culler tests skip HTTP too, SURVEY.md §4);
+- a notebook is active if ANY kernel is busy (ref allKernelsAreIdle
+  :223-240); long-running training cells keep the kernel busy, so a
+  3-day pretrain is never culled (SURVEY.md §7 hard part d);
+- last activity tracked in an annotation (ref
+  UpdateNotebookLastActivityAnnotation :266-300);
+- idle > idle_time ⇒ SetStopAnnotation (ref :118-141), which the
+  notebook controller turns into replicas=0. Restart = remove the
+  annotation (spawner PATCH path).
+
+Env knobs mirror the reference (culler.go:26-28): CULL_IDLE_TIME
+(minutes, default 1440), IDLENESS_CHECK_PERIOD (minutes, default 1),
+ENABLE_CULLING (default false).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Protocol
+
+from kubeflow_tpu.api.crds import (
+    CULLING_DISABLED_ANNOTATION,
+    LAST_ACTIVITY_ANNOTATION,
+    Notebook,
+    STOP_ANNOTATION,
+)
+from kubeflow_tpu.controlplane.runtime import Controller, Result
+from kubeflow_tpu.controlplane.store import Conflict, NotFound, Store
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class KernelStatus:
+    execution_state: str = "idle"   # idle | busy
+    last_activity: float = 0.0
+
+
+class ActivityProbe(Protocol):
+    """Transport for kernel/terminal activity. Production impl does HTTP
+    GET http://<nb>.<ns>.svc/notebook/<ns>/<nb>/api/kernels (ref
+    culler.go:155-180); tests inject a fake."""
+
+    def kernels(self, namespace: str, name: str) -> list[KernelStatus] | None:
+        ...
+
+
+class HTTPActivityProbe:
+    """Probes the notebook pod's Jupyter REST API (ref culler.go:155-201).
+
+    10s timeout per the reference (culler.go:19-21).
+    """
+
+    def __init__(self, cluster_domain: str = "cluster.local", timeout: float = 10.0):
+        self.cluster_domain = cluster_domain
+        self.timeout = timeout
+
+    def kernels(self, namespace: str, name: str) -> list[KernelStatus] | None:
+        import json
+        import urllib.request
+
+        url = (
+            f"http://{name}.{namespace}.svc.{self.cluster_domain}"
+            f"/notebook/{namespace}/{name}/api/kernels"
+        )
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout) as r:
+                data = json.loads(r.read())
+        except Exception:
+            return None
+        out = []
+        for k in data:
+            ts = k.get("last_activity", 0)
+            out.append(KernelStatus(k.get("execution_state", "idle"),
+                                    _parse_ts(ts)))
+        return out
+
+
+def _parse_ts(ts) -> float:
+    if isinstance(ts, (int, float)):
+        return float(ts)
+    try:
+        import datetime
+
+        return datetime.datetime.fromisoformat(
+            str(ts).replace("Z", "+00:00")
+        ).timestamp()
+    except ValueError:
+        return 0.0
+
+
+class Culler(Controller):
+    """Runs as a controller over Notebooks with periodic requeue."""
+
+    KIND = "Notebook"
+
+    def __init__(
+        self,
+        probe: ActivityProbe,
+        *,
+        enabled: bool = True,
+        idle_time: float = 1440 * 60.0,       # ref CULL_IDLE_TIME 1440m
+        check_period: float = 60.0,           # ref IDLENESS_CHECK_PERIOD 1m
+        clock=time.time,
+    ):
+        self.probe = probe
+        self.enabled = enabled
+        self.idle_time = idle_time
+        self.check_period = check_period
+        self.clock = clock
+
+    def reconcile(self, store: Store, namespace: str, name: str) -> Result:
+        try:
+            nb = store.get("Notebook", namespace, name)
+        except NotFound:
+            return Result()
+        assert isinstance(nb, Notebook)
+        ann = nb.metadata.annotations
+        if not self.enabled or STOP_ANNOTATION in ann:
+            return Result(requeue_after=self.check_period)
+        if ann.get(CULLING_DISABLED_ANNOTATION) == "true":
+            return Result(requeue_after=self.check_period)
+
+        now = self.clock()
+        if LAST_ACTIVITY_ANNOTATION not in ann:
+            # First observation: initialize the activity clock (the
+            # reference stamps the annotation at notebook creation) —
+            # never cull based on an unrecorded past.
+            self._annotate(store, namespace, name,
+                           {LAST_ACTIVITY_ANNOTATION: str(now)})
+            return Result(requeue_after=self.check_period)
+        kernels = self.probe.kernels(namespace, name)
+        last = float(ann.get(LAST_ACTIVITY_ANNOTATION, "0") or 0)
+
+        if kernels is None:
+            # Unreachable (starting/stopped): no state change (ref updates
+            # only on successful probe, culler.go:266-300).
+            return Result(requeue_after=self.check_period)
+
+        busy = any(k.execution_state == "busy" for k in kernels)
+        kernel_last = max((k.last_activity for k in kernels), default=0.0)
+        prev = last
+        if busy:
+            last = now          # ref updateTimestampFromKernelsActivity :323-355
+        else:
+            last = max(last, kernel_last)
+        if last != prev:
+            # Only write on change: an unconditional update would emit a
+            # MODIFIED watch event that re-enqueues this notebook and turns
+            # the check_period poll into a hot loop.
+            self._annotate(store, namespace, name,
+                           {LAST_ACTIVITY_ANNOTATION: str(last)})
+
+        if now - last > self.idle_time:     # ref NotebookNeedsCulling :405-420
+            self._annotate(store, namespace, name, {
+                STOP_ANNOTATION: _iso(now),  # ref SetStopAnnotation :118-141
+            })
+            store.emit_event(nb, "Normal", "Culled",
+                             f"idle for {(now - last) / 60:.0f} min")
+            log.info("culled notebook %s/%s", namespace, name)
+        return Result(requeue_after=self.check_period)
+
+    def _annotate(self, store: Store, namespace: str, name: str,
+                  annotations: dict[str, str]) -> None:
+        for _ in range(5):
+            nb = store.try_get("Notebook", namespace, name)
+            if nb is None:
+                return
+            nb.metadata.annotations.update(annotations)
+            try:
+                store.update(nb)
+                return
+            except Conflict:
+                continue
+
+
+def _iso(ts: float) -> str:
+    import datetime
+
+    return datetime.datetime.fromtimestamp(
+        ts, datetime.timezone.utc
+    ).isoformat()

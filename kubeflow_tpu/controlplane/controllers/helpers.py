@@ -1,0 +1,57 @@
+"""Create-or-update helpers with owned-field drift detection.
+
+Pattern (not code) from the reference's common/reconcilehelper/util.go:
+create if missing; if present, copy only the fields this controller owns
+and update when they drifted (CopyStatefulSetFields :107-134,
+CopyServiceFields :166-195 — which deliberately preserves clusterIP;
+we preserve runtime-assigned fields the same way).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from kubeflow_tpu.api.core import Resource
+from kubeflow_tpu.controlplane.store import NotFound, Store, set_controller_reference
+
+
+def reconcile_child(
+    store: Store,
+    owner: Resource,
+    desired: Resource,
+    copy_fields: Callable[[Resource, Resource], bool],
+) -> Resource:
+    """Ensure `desired` exists and its owned fields match.
+
+    `copy_fields(desired, current) -> changed` copies the owned fields
+    onto `current` in place and reports drift.
+    """
+    set_controller_reference(owner, desired)
+    try:
+        current = store.get(desired.kind, desired.metadata.namespace,
+                            desired.metadata.name)
+    except NotFound:
+        return store.create(desired)
+    if copy_fields(desired, current):
+        return store.update(current)
+    return current
+
+
+def copy_spec_and_labels(desired: Resource, current: Resource) -> bool:
+    """Default owned-field copier: spec + labels/annotations we set.
+    Runtime fields (status, uid, rv, clusterIP-style data) are preserved
+    because only `spec`, labels and annotations are copied."""
+    changed = False
+    if dataclasses.asdict(desired.spec) != dataclasses.asdict(current.spec):  # type: ignore[attr-defined]
+        current.spec = desired.spec  # type: ignore[attr-defined]
+        changed = True
+    for k, v in desired.metadata.labels.items():
+        if current.metadata.labels.get(k) != v:
+            current.metadata.labels[k] = v
+            changed = True
+    for k, v in desired.metadata.annotations.items():
+        if current.metadata.annotations.get(k) != v:
+            current.metadata.annotations[k] = v
+            changed = True
+    return changed

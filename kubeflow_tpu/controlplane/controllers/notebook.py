@@ -1,0 +1,261 @@
+"""Notebook controller: Notebook CR → gang StatefulSet + headless Service
++ VirtualService; status mirroring; event re-emission.
+
+TPU-first re-design of the reference's notebook-controller
+(controllers/notebook_controller.go:90-282):
+- the reference hard-codes a single-pod StatefulSet (replicas 0/1,
+  generateStatefulSet :418-481); here replicas = number of TPU VM hosts
+  in the slice topology (gang), one pod per host, each labeled with its
+  gang ordinal so the admission webhook can compute TPU_WORKER_ID /
+  TPU_WORKER_HOSTNAMES (webhook.py) — the NCCL-free multi-host bootstrap;
+- Service is headless for stable per-host DNS (the reference's ClusterIP
+  service :483-510 only needed one endpoint);
+- VirtualService prefix `/notebook/<ns>/<name>/` and NB_PREFIX env kept
+  (ref :516-610, :402-416) so notebook UIs behind a path proxy work;
+- stop annotation ⇒ replicas 0 (ref :419-422, culler contract);
+- pod warning events re-emitted onto the Notebook (ref :94-118) and pod
+  state mirrored into status (ref :300-359).
+"""
+
+from __future__ import annotations
+
+import os
+
+from kubeflow_tpu.api.core import (
+    Container,
+    EnvVar,
+    HTTPRoute,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    StatefulSet,
+    StatefulSetSpec,
+    VirtualService,
+    VirtualServiceSpec,
+)
+from kubeflow_tpu.api.crds import (
+    Notebook,
+    NotebookCondition,
+    STOP_ANNOTATION,
+)
+from kubeflow_tpu.controlplane.controllers.helpers import (
+    copy_spec_and_labels,
+    reconcile_child,
+)
+from kubeflow_tpu.controlplane.runtime import Controller, Result
+from kubeflow_tpu.controlplane.store import NotFound, Store
+from kubeflow_tpu.controlplane import webhook as wh
+from kubeflow_tpu.parallel.mesh import SLICE_TOPOLOGIES
+
+NOTEBOOK_NAME_LABEL = "notebook-name"       # ref notebook_controller.go:688-699
+DEFAULT_PORT = 8888                          # ref :51
+TPU_RESOURCE_KEY = "tpu/chips"
+TOPOLOGY_NODE_SELECTOR = "kubeflow-tpu.dev/slice-topology"
+
+
+class NotebookController(Controller):
+    KIND = "Notebook"
+    OWNS = ("StatefulSet", "Service", "VirtualService")
+
+    def __init__(self, *, use_routing: bool = True,
+                 culling_check_period: float | None = None):
+        self.use_routing = use_routing
+        # ref IDLENESS_CHECK_PERIOD (1m default) drives periodic requeue
+        self.culling_check_period = culling_check_period
+
+    def reconcile(self, store: Store, namespace: str, name: str) -> Result:
+        try:
+            nb = store.get("Notebook", namespace, name)
+        except NotFound:
+            return Result()  # children garbage-collected via owner refs
+        assert isinstance(nb, Notebook)
+
+        topo_name = nb.spec.tpu.topology
+        if topo_name and topo_name not in SLICE_TOPOLOGIES:
+            # Surface the config error to the user instead of retrying
+            # forever (the spawner UI mines warning events, ref
+            # status.py:79-95).
+            if not any(
+                e.reason == "InvalidTopology"
+                for e in store.events_for("Notebook", namespace, name)
+            ):
+                store.emit_event(
+                    nb, "Warning", "InvalidTopology",
+                    f"unknown TPU slice topology {topo_name!r}; known: "
+                    f"{sorted(SLICE_TOPOLOGIES)}",
+                )
+            return Result()
+
+        sts = self._desired_statefulset(nb)
+        reconcile_child(store, nb, sts, copy_spec_and_labels)
+        svc = self._desired_service(nb)
+        reconcile_child(store, nb, svc, copy_spec_and_labels)
+        if self.use_routing:
+            vs = self._desired_virtualservice(nb)
+            reconcile_child(store, nb, vs, copy_spec_and_labels)
+
+        self._mirror_status(store, nb)
+        self._reemit_pod_events(store, nb)
+
+        if self.culling_check_period:
+            return Result(requeue_after=self.culling_check_period)
+        return Result()
+
+    # -- desired children --------------------------------------------------
+
+    def _gang_size(self, nb: Notebook) -> int:
+        topo_name = nb.spec.tpu.topology
+        if not topo_name:
+            return 1
+        topo = SLICE_TOPOLOGIES[topo_name]
+        return topo.hosts
+
+    def _desired_statefulset(self, nb: Notebook) -> StatefulSet:
+        name, ns = nb.metadata.name, nb.metadata.namespace
+        stopped = STOP_ANNOTATION in nb.metadata.annotations  # ref :419-422
+        gang_size = self._gang_size(nb)
+        replicas = 0 if stopped else gang_size
+
+        template = nb.spec.template
+        tmpl = template.__class__(
+            metadata=template.metadata.__class__(
+                labels={
+                    **template.metadata.labels,
+                    NOTEBOOK_NAME_LABEL: name,
+                    wh.GANG_NAME_LABEL: name,
+                    wh.GANG_SIZE_LABEL: str(gang_size),
+                },
+                annotations=dict(template.metadata.annotations),
+            ),
+            spec=template.spec,
+        )
+        tmpl = _clone(tmpl)
+        topo_name = nb.spec.tpu.topology
+        if topo_name:
+            tmpl.metadata.labels[wh.TOPOLOGY_LABEL] = topo_name
+            if nb.spec.tpu.mesh:
+                tmpl.metadata.labels[wh.MESH_LABEL] = (
+                    nb.spec.tpu.mesh.replace(",", "_")
+                )
+            topo = SLICE_TOPOLOGIES[topo_name]
+            # ICI-topology-aware placement: pin to the right slice pool
+            # (generalizes the reference's only placement-aware code, the
+            # RWO-PVC affinity in tensorboard_controller.go:408-451).
+            tmpl.spec.node_selector.setdefault(TOPOLOGY_NODE_SELECTOR, topo_name)
+            for c in tmpl.spec.containers:
+                c.resources.limits.setdefault(
+                    TPU_RESOURCE_KEY, str(topo.chips_per_host)
+                )
+
+        if not tmpl.spec.containers:
+            tmpl.spec.containers.append(Container(name=name))
+        main = tmpl.spec.containers[0]
+        if not any(p == DEFAULT_PORT for p in main.ports):
+            main.ports.append(DEFAULT_PORT)
+        # NB_PREFIX env for path-proxied UIs (ref :402-416)
+        if not any(e.name == "NB_PREFIX" for e in main.env):
+            main.env.append(EnvVar("NB_PREFIX", f"/notebook/{ns}/{name}"))
+        if tmpl.spec.fs_group is None and os.environ.get("ADD_FSGROUP", "true") != "false":
+            tmpl.spec.fs_group = 100  # ref :468-479
+
+        sts = StatefulSet(
+            spec=StatefulSetSpec(
+                replicas=replicas,
+                service_name=name,
+                selector={NOTEBOOK_NAME_LABEL: name},
+                template=tmpl,
+                gang=gang_size > 1,
+            )
+        )
+        sts.metadata.name = name
+        sts.metadata.namespace = ns
+        sts.metadata.labels = {NOTEBOOK_NAME_LABEL: name}
+        return sts
+
+    def _desired_service(self, nb: Notebook) -> Service:
+        name, ns = nb.metadata.name, nb.metadata.namespace
+        svc = Service(
+            spec=ServiceSpec(
+                selector={NOTEBOOK_NAME_LABEL: name},
+                ports=[ServicePort("http", 80, DEFAULT_PORT)],
+                headless=True,   # stable per-host DNS for the gang
+            )
+        )
+        svc.metadata.name = name
+        svc.metadata.namespace = ns
+        svc.metadata.labels = {NOTEBOOK_NAME_LABEL: name}
+        return svc
+
+    def _desired_virtualservice(self, nb: Notebook) -> VirtualService:
+        name, ns = nb.metadata.name, nb.metadata.namespace
+        prefix = f"/notebook/{ns}/{name}/"   # ref :53-54, :516-610
+        vs = VirtualService(
+            spec=VirtualServiceSpec(
+                gateways=["kubeflow-gateway"],
+                hosts=["*"],
+                http=[
+                    HTTPRoute(
+                        prefix=prefix,
+                        rewrite="/",
+                        destination_host=f"{name}.{ns}.svc",
+                        destination_port=80,
+                    )
+                ],
+            )
+        )
+        vs.metadata.name = f"notebook-{ns}-{name}"
+        vs.metadata.namespace = ns
+        return vs
+
+    # -- status + events ---------------------------------------------------
+
+    def _mirror_status(self, store: Store, nb: Notebook) -> None:
+        pods = store.list(
+            "Pod", nb.metadata.namespace,
+            label_selector={NOTEBOOK_NAME_LABEL: nb.metadata.name},
+        )
+        ready = sum(1 for p in pods if p.phase == "Running" and p.ready)
+        state = ""
+        conditions = []
+        for p in sorted(pods, key=lambda p: p.metadata.name):
+            state = state or (
+                "running" if p.phase == "Running" else
+                "terminated" if p.phase in ("Succeeded", "Failed") else "waiting"
+            )
+            conditions.append(NotebookCondition(
+                type=p.phase, reason="", message="",
+            ))
+        fresh = store.try_get("Notebook", nb.metadata.namespace, nb.metadata.name)
+        if fresh is None:
+            return
+        assert isinstance(fresh, Notebook)
+        if (fresh.status.ready_replicas, fresh.status.container_state) != (
+            ready, state
+        ):
+            fresh.status.ready_replicas = ready
+            fresh.status.container_state = state
+            fresh.status.conditions = conditions
+            store.update(fresh)
+
+    def _reemit_pod_events(self, store: Store, nb: Notebook) -> None:
+        """Surface pod warnings on the Notebook (ref :94-118, predicate
+        :703-723 filters to warning/scheduling events)."""
+        ns, name = nb.metadata.namespace, nb.metadata.name
+        existing = {
+            (e.reason, e.message)
+            for e in store.events_for("Notebook", ns, name)
+        }
+        for pod in store.list("Pod", ns, label_selector={NOTEBOOK_NAME_LABEL: name}):
+            for ev in store.events_for("Pod", ns, pod.metadata.name):
+                if ev.type != "Warning":
+                    continue
+                if (ev.reason, ev.message) in existing:
+                    continue
+                store.emit_event(nb, "Warning", ev.reason, ev.message)
+                existing.add((ev.reason, ev.message))
+
+
+def _clone(obj):
+    import copy
+
+    return copy.deepcopy(obj)

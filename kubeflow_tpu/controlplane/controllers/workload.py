@@ -1,0 +1,194 @@
+"""Workload layer: StatefulSet → Pods, gang-aware TPU scheduler.
+
+The reference leans on kubelet/kube-scheduler (L0) to turn StatefulSets
+into running pods; hermetic operation needs an in-process equivalent —
+the same move envtest makes (real apiserver, no kubelet), except our
+tests DO need pods to materialize (the TPU env webhook fires on pod
+create). Two pieces:
+
+- StatefulSetController: creates/deletes pods `<name>-<i>` to match
+  spec.replicas, labels each with its gang ordinal, mirrors readiness.
+  Gang atomicity (SURVEY.md §7 hard part a): for gang STS, capacity for
+  the WHOLE slice is reserved before any pod is created — partial slices
+  never start, they fail as a unit with a Warning event that the spawner
+  UI surfaces (ref status.py:79-95 mines warning events for "why is my
+  pod pending").
+- Scheduler/NodePool: models TPU slice capacity per topology
+  (`NodePool({"v5e-16": 2})` = two v5e-16 slices). Pods with a TPU
+  node selector consume a slice host; others always fit.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from kubeflow_tpu.api.core import Pod, StatefulSet
+from kubeflow_tpu.controlplane.runtime import Controller, Result
+from kubeflow_tpu.controlplane.store import (
+    AdmissionDenied,
+    AlreadyExists,
+    NotFound,
+    Store,
+    set_controller_reference,
+)
+from kubeflow_tpu.controlplane import webhook as wh
+from kubeflow_tpu.parallel.mesh import SLICE_TOPOLOGIES
+
+
+@dataclass
+class NodePool:
+    """TPU capacity by topology name → number of whole slices."""
+
+    slices: dict[str, int] = field(default_factory=dict)
+    cpu_unlimited: bool = True
+
+    def total_hosts(self, topo_name: str) -> int:
+        topo = SLICE_TOPOLOGIES.get(topo_name)
+        if topo is None:
+            return 0
+        return self.slices.get(topo_name, 0) * topo.hosts
+
+
+class Scheduler:
+    """Tracks slice-host allocations by gang. Thread-safe."""
+
+    def __init__(self, pool: NodePool):
+        self.pool = pool
+        self._lock = threading.Lock()
+        # gang key -> (topology, hosts reserved)
+        self._reservations: dict[tuple[str, str], tuple[str, int]] = {}
+
+    def try_reserve_gang(
+        self, namespace: str, gang: str, topo_name: str, hosts: int
+    ) -> bool:
+        with self._lock:
+            key = (namespace, gang)
+            if key in self._reservations:
+                return True
+            used = sum(
+                h for (t, h) in self._reservations.values() if t == topo_name
+            )
+            if used + hosts > self.pool.total_hosts(topo_name):
+                return False
+            self._reservations[key] = (topo_name, hosts)
+            return True
+
+    def release_gang(self, namespace: str, gang: str) -> None:
+        with self._lock:
+            self._reservations.pop((namespace, gang), None)
+
+    def reserved(self, namespace: str, gang: str) -> bool:
+        with self._lock:
+            return (namespace, gang) in self._reservations
+
+
+class StatefulSetController(Controller):
+    KIND = "StatefulSet"
+    OWNS = ("Pod",)
+
+    def __init__(self, scheduler: Scheduler | None = None):
+        self.scheduler = scheduler or Scheduler(NodePool())
+
+    def reconcile(self, store: Store, namespace: str, name: str) -> Result:
+        try:
+            sts = store.get("StatefulSet", namespace, name)
+        except NotFound:
+            self.scheduler.release_gang(namespace, name)
+            return Result()
+        assert isinstance(sts, StatefulSet)
+
+        want = sts.spec.replicas
+        tmpl = sts.spec.template
+        topo_name = tmpl.metadata.labels.get(wh.TOPOLOGY_LABEL, "")
+
+        # Gang admission: reserve the whole slice first (all-or-nothing).
+        if want > 0 and topo_name:
+            if not self.scheduler.try_reserve_gang(
+                namespace, name, topo_name, want
+            ):
+                existing = {
+                    (e.reason) for e in store.events_for(
+                        "StatefulSet", namespace, name)
+                }
+                if "FailedScheduling" not in existing:
+                    store.emit_event(
+                        sts, "Warning", "FailedScheduling",
+                        f"insufficient TPU capacity for {topo_name} "
+                        f"({want} hosts required, gang is all-or-nothing)",
+                    )
+                return Result(requeue_after=0.5)
+        if want == 0 and topo_name:
+            self.scheduler.release_gang(namespace, name)
+
+        pods = {
+            p.metadata.name: p
+            for p in store.list("Pod", namespace)
+            if any(r.uid == sts.metadata.uid
+                   for r in p.metadata.owner_references)
+        }
+
+        changed = False
+        for i in range(want):
+            pod_name = f"{name}-{i}"
+            if pod_name in pods:
+                continue
+            pod = Pod(spec=tmpl.spec)
+            pod = pod.clone()
+            pod.metadata.name = pod_name
+            pod.metadata.namespace = namespace
+            pod.metadata.labels = {
+                **tmpl.metadata.labels,
+                wh.GANG_ORDINAL_LABEL: str(i),
+            }
+            pod.metadata.annotations = dict(tmpl.metadata.annotations)
+            pod.spec.hostname = pod_name
+            pod.spec.subdomain = sts.spec.service_name
+            set_controller_reference(sts, pod)
+            try:
+                store.create(pod)
+            except AlreadyExists:
+                pass
+            except AdmissionDenied as e:
+                store.emit_event(sts, "Warning", "AdmissionDenied", str(e))
+                # Don't hold the slice hostage while no pod can start;
+                # requeue so removing the conflicting TpuPodDefault
+                # eventually recovers (those changes don't enqueue us).
+                self.scheduler.release_gang(namespace, name)
+                return Result(requeue_after=2.0)
+            changed = True
+
+        for pod_name, pod in pods.items():
+            try:
+                ordinal = int(pod_name.rsplit("-", 1)[1])
+            except (IndexError, ValueError):
+                ordinal = 0
+            if ordinal >= want:
+                try:
+                    store.delete("Pod", namespace, pod_name)
+                    changed = True
+                except NotFound:
+                    pass
+
+        # Simulated kubelet: freshly created pods become Running+ready.
+        for p in store.list("Pod", namespace):
+            if not any(r.uid == sts.metadata.uid
+                       for r in p.metadata.owner_references):
+                continue
+            if p.phase == "Pending":
+                p.phase = "Running"
+                p.ready = True
+                p.pod_ip = f"10.0.{abs(hash((namespace, p.metadata.name))) % 250}.{abs(hash(p.metadata.name)) % 250}"
+                store.update(p)
+                changed = True
+
+        ready = sum(
+            1 for p in store.list("Pod", namespace)
+            if any(r.uid == sts.metadata.uid for r in p.metadata.owner_references)
+            and p.phase == "Running" and p.ready
+        )
+        fresh = store.try_get("StatefulSet", namespace, name)
+        if fresh is not None and fresh.ready_replicas != ready:
+            fresh.ready_replicas = ready
+            store.update(fresh)
+        return Result()

@@ -1,0 +1,217 @@
+"""Controller runtime: workqueues + reconcile loops (controller-runtime
+equivalent).
+
+Semantics preserved from the reference's runtime because every controller
+depends on them (SURVEY.md §5 "race detection"):
+- one in-flight reconcile per key (dedup workqueue) — the concurrency
+  model that makes reconcilers race-free;
+- watch-driven enqueue with owner mapping (a change to an owned object
+  enqueues its owner, the `Owns()` pattern of SetupWithManager,
+  notebook_controller.go:726-774);
+- rate-limited retries on error and `Result(requeue_after=...)` for
+  periodic resync (culling requeue, notebook_controller.go:279-281).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable
+
+from kubeflow_tpu.api.core import Resource
+from kubeflow_tpu.controlplane.store import Conflict, Store, WatchEvent
+
+log = logging.getLogger(__name__)
+
+Key = tuple[str, str]  # (namespace, name)
+
+
+@dataclasses.dataclass
+class Result:
+    requeue_after: float | None = None
+
+
+class Controller:
+    """Subclass and implement reconcile(store, namespace, name) -> Result."""
+
+    KIND: str = ""                 # primary kind
+    OWNS: tuple[str, ...] = ()     # owned kinds: events map back to owner
+    WATCHES: tuple[str, ...] = ()  # extra kinds: enqueue ALL primaries
+
+    def reconcile(self, store: Store, namespace: str, name: str) -> Result:
+        raise NotImplementedError
+
+
+class _WorkQueue:
+    """Dedup queue with per-key delayed re-adds (rate-limited retries)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._ready: list[Key] = []
+        self._pending: set[Key] = set()
+        self._delayed: dict[Key, float] = {}
+        self._failures: dict[Key, int] = {}
+        self._shutdown = False
+
+    def add(self, key: Key) -> None:
+        with self._cond:
+            if key not in self._pending:
+                self._pending.add(key)
+                self._ready.append(key)
+            self._cond.notify()
+
+    def add_after(self, key: Key, delay: float) -> None:
+        with self._cond:
+            due = time.monotonic() + delay
+            cur = self._delayed.get(key)
+            if cur is None or due < cur:
+                self._delayed[key] = due
+            self._cond.notify()
+
+    def add_rate_limited(self, key: Key) -> None:
+        with self._cond:
+            n = self._failures.get(key, 0)
+            self._failures[key] = n + 1
+        self.add_after(key, min(0.005 * (2**n), 8.0))
+
+    def forget(self, key: Key) -> None:
+        with self._cond:
+            self._failures.pop(key, None)
+
+    def get(self, timeout: float = 0.2) -> Key | None:
+        with self._cond:
+            deadline = time.monotonic() + timeout
+            while True:
+                now = time.monotonic()
+                for key, due in list(self._delayed.items()):
+                    if due <= now:
+                        del self._delayed[key]
+                        if key not in self._pending:
+                            self._pending.add(key)
+                            self._ready.append(key)
+                if self._ready:
+                    key = self._ready.pop(0)
+                    self._pending.discard(key)
+                    return key
+                if self._shutdown or now >= deadline:
+                    return None
+                wait = deadline - now
+                if self._delayed:
+                    wait = min(wait, max(0.0, min(self._delayed.values()) - now))
+                self._cond.wait(wait if wait > 0 else 0.001)
+
+    def shutdown(self):
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+
+class Manager:
+    """Runs controllers against a store. start()/stop(), or use
+    wait_idle() in tests for deterministic settling (envtest-style)."""
+
+    def __init__(self, store: Store):
+        self.store = store
+        self._controllers: list[tuple[Controller, _WorkQueue]] = []
+        self._threads: list[threading.Thread] = []
+        self._watch = None
+        self._stop = threading.Event()
+        self._active = 0
+        self._active_cond = threading.Condition()
+
+    def register(self, controller: Controller) -> None:
+        self._controllers.append((controller, _WorkQueue()))
+
+    def start(self) -> None:
+        self._watch = self.store.watch()
+        t = threading.Thread(target=self._dispatch_loop, daemon=True,
+                             name="mgr-dispatch")
+        t.start()
+        self._threads.append(t)
+        for ctrl, wq in self._controllers:
+            # Kick initial reconcile for pre-existing primaries.
+            for obj in self.store.list(ctrl.KIND):
+                wq.add((obj.metadata.namespace, obj.metadata.name))
+            t = threading.Thread(
+                target=self._worker_loop, args=(ctrl, wq), daemon=True,
+                name=f"ctrl-{ctrl.KIND}",
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch is not None:
+            self._watch.close()
+        for _, wq in self._controllers:
+            wq.shutdown()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    # -- event routing -----------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        for event in self._watch:
+            if self._stop.is_set():
+                return
+            self._dispatch(event)
+
+    def _dispatch(self, event: WatchEvent) -> None:
+        obj = event.resource
+        for ctrl, wq in self._controllers:
+            if obj.kind == ctrl.KIND:
+                wq.add((obj.metadata.namespace, obj.metadata.name))
+            elif obj.kind in ctrl.OWNS:
+                for ref in obj.metadata.owner_references:
+                    if ref.kind == ctrl.KIND:
+                        wq.add((obj.metadata.namespace, ref.name))
+            elif obj.kind in ctrl.WATCHES:
+                for primary in self.store.list(ctrl.KIND):
+                    wq.add((primary.metadata.namespace, primary.metadata.name))
+
+    # -- workers -----------------------------------------------------------
+
+    def _worker_loop(self, ctrl: Controller, wq: _WorkQueue) -> None:
+        while not self._stop.is_set():
+            key = wq.get(timeout=0.2)
+            if key is None:
+                continue
+            with self._active_cond:
+                self._active += 1
+            try:
+                result = ctrl.reconcile(self.store, key[0], key[1])
+            except Conflict:
+                wq.add_rate_limited(key)
+            except Exception:
+                log.exception("reconcile %s %s failed", ctrl.KIND, key)
+                wq.add_rate_limited(key)
+            else:
+                wq.forget(key)
+                if result and result.requeue_after:
+                    wq.add_after(key, result.requeue_after)
+            finally:
+                with self._active_cond:
+                    self._active -= 1
+                    self._active_cond.notify_all()
+
+    # -- test support ------------------------------------------------------
+
+    def wait_idle(self, timeout: float = 5.0, settle: float = 0.05) -> bool:
+        """Wait until all queues are empty and workers idle for `settle`s.
+        Delayed requeues (periodic resync) are ignored."""
+        deadline = time.monotonic() + timeout
+        idle_since = None
+        while time.monotonic() < deadline:
+            busy = self._active > 0 or any(
+                wq._ready or wq._pending for _, wq in self._controllers
+            )
+            if busy:
+                idle_since = None
+            elif idle_since is None:
+                idle_since = time.monotonic()
+            elif time.monotonic() - idle_since >= settle:
+                return True
+            time.sleep(0.01)
+        return False

@@ -1,0 +1,293 @@
+"""Versioned object store with watches — the apiserver equivalent.
+
+Replaces the reference's L0 (k8s API server + etcd) for hermetic,
+in-process operation, in the same spirit the reference's envtest boots a
+real apiserver for integration tests (SURVEY.md §4 tier 2). Semantics
+kept from that world because the controllers rely on them:
+
+- optimistic concurrency: update must carry the current resource_version
+  or it raises Conflict (the reference wraps updates in
+  retry.RetryOnConflict, e.g. notebook_route.go:119-131);
+- finalizers: delete marks deletion_timestamp and the object lingers
+  until controllers strip their finalizers (profile_controller.go:284-319);
+- owner references: deleting an owner cascades to owned objects
+  (SetControllerReference semantics);
+- watches: every mutation fans out a WatchEvent to subscribers — the
+  controller runtime's trigger;
+- admission chain: mutating webhooks run on create (and optionally
+  update), exactly where the reference's admission chain sits (L3).
+
+The store is intentionally synchronous + threadsafe. A native C++
+backend implementing the same contract can be slotted in via
+`kubeflow_tpu.native` (the reference has no native runtime; ours is the
+TPU-era equivalent of its Go controller binaries).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import itertools
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+from kubeflow_tpu.api.core import Event, Resource
+
+
+class StoreError(Exception):
+    pass
+
+
+class NotFound(StoreError):
+    pass
+
+
+class AlreadyExists(StoreError):
+    pass
+
+
+class Conflict(StoreError):
+    pass
+
+
+class AdmissionDenied(StoreError):
+    pass
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    type: str            # ADDED | MODIFIED | DELETED
+    resource: Resource
+
+
+Mutator = Callable[[Resource], None]     # in-place mutate or raise AdmissionDenied
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: dict[tuple[str, str, str], Resource] = {}
+        self._rv = itertools.count(1)
+        self._watchers: list[tuple[queue.Queue, tuple[str, ...] | None]] = []
+        # kind -> mutators run at create; "*" applies to every kind
+        self._mutating_webhooks: dict[str, list[Mutator]] = {}
+
+    # -- admission ---------------------------------------------------------
+
+    def register_mutating_webhook(self, kind: str, fn: Mutator) -> None:
+        self._mutating_webhooks.setdefault(kind, []).append(fn)
+
+    def _admit(self, obj: Resource) -> None:
+        for fn in self._mutating_webhooks.get("*", []):
+            fn(obj)
+        for fn in self._mutating_webhooks.get(obj.kind, []):
+            fn(obj)
+
+    # -- CRUD --------------------------------------------------------------
+
+    def create(self, obj: Resource, *, dry_run: bool = False) -> Resource:
+        with self._lock:
+            if obj.key in self._objects:
+                raise AlreadyExists(f"{obj.key} exists")
+            obj = obj.clone()
+            self._admit(obj)
+            if dry_run:
+                return obj
+            m = obj.metadata
+            m.uid = m.uid or uuid.uuid4().hex
+            m.resource_version = next(self._rv)
+            m.generation = 1
+            m.creation_timestamp = m.creation_timestamp or time.time()
+            self._objects[obj.key] = obj
+            self._notify(WatchEvent("ADDED", obj.clone()))
+            return obj.clone()
+
+    def get(self, kind: str, namespace: str, name: str) -> Resource:
+        with self._lock:
+            obj = self._objects.get((kind, namespace, name))
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name}")
+            return obj.clone()
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Resource | None:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFound:
+            return None
+
+    def update(self, obj: Resource) -> Resource:
+        with self._lock:
+            cur = self._objects.get(obj.key)
+            if cur is None:
+                raise NotFound(f"{obj.key}")
+            if obj.metadata.resource_version != cur.metadata.resource_version:
+                raise Conflict(
+                    f"{obj.key}: rv {obj.metadata.resource_version} != "
+                    f"{cur.metadata.resource_version}"
+                )
+            obj = obj.clone()
+            m = obj.metadata
+            m.uid = cur.metadata.uid
+            m.creation_timestamp = cur.metadata.creation_timestamp
+            m.resource_version = next(self._rv)
+            m.generation = cur.metadata.generation + 1
+            self._objects[obj.key] = obj
+            self._notify(WatchEvent("MODIFIED", obj.clone()))
+            # A finalizer strip on a deleting object may complete deletion.
+            if m.deletion_timestamp is not None and not m.finalizers:
+                self._finalize_delete(obj.key)
+            return obj.clone()
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            key = (kind, namespace, name)
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFound(f"{kind} {namespace}/{name}")
+            if cur.metadata.finalizers:
+                if cur.metadata.deletion_timestamp is None:
+                    cur.metadata.deletion_timestamp = time.time()
+                    cur.metadata.resource_version = next(self._rv)
+                    self._notify(WatchEvent("MODIFIED", cur.clone()))
+                return
+            self._finalize_delete(key)
+
+    def _finalize_delete(self, key) -> None:
+        obj = self._objects.pop(key, None)
+        if obj is None:
+            return
+        self._notify(WatchEvent("DELETED", obj.clone()))
+        # Cascade: delete objects owned (controller=True) by this one.
+        owned = [
+            o.key
+            for o in list(self._objects.values())
+            if any(r.uid == obj.metadata.uid for r in o.metadata.owner_references)
+        ]
+        for k, ns, n in owned:
+            try:
+                self.delete(k, ns, n)
+            except NotFound:
+                pass
+
+    # -- queries -----------------------------------------------------------
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        *,
+        label_selector: dict[str, str] | None = None,
+        field_match: Callable[[Resource], bool] | None = None,
+    ) -> list[Resource]:
+        with self._lock:
+            out = []
+            for (k, ns, _), obj in self._objects.items():
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector and not _labels_match(
+                    obj.metadata.labels, label_selector
+                ):
+                    continue
+                if field_match and not field_match(obj):
+                    continue
+                out.append(obj.clone())
+            return sorted(out, key=lambda o: (o.metadata.namespace, o.metadata.name))
+
+    # -- events ------------------------------------------------------------
+
+    def emit_event(
+        self, involved: Resource, type_: str, reason: str, message: str
+    ) -> None:
+        ev = Event(
+            involved_kind=involved.kind,
+            involved_name=involved.metadata.name,
+            type=type_,
+            reason=reason,
+            message=message,
+        )
+        ev.metadata.namespace = involved.metadata.namespace or "default"
+        ev.metadata.name = f"{involved.metadata.name}.{uuid.uuid4().hex[:8]}"
+        self.create(ev)
+
+    def events_for(self, kind: str, namespace: str, name: str) -> list[Event]:
+        return [
+            e
+            for e in self.list("Event", namespace)
+            if e.involved_kind == kind and e.involved_name == name
+        ]
+
+    # -- watches -----------------------------------------------------------
+
+    def watch(self, kinds: Iterable[str] | None = None) -> "Watch":
+        q: queue.Queue = queue.Queue()
+        kt = tuple(kinds) if kinds is not None else None
+        with self._lock:
+            self._watchers.append((q, kt))
+        return Watch(self, q)
+
+    def _unwatch(self, q: queue.Queue) -> None:
+        with self._lock:
+            self._watchers = [(w, k) for (w, k) in self._watchers if w is not q]
+
+    def _notify(self, event: WatchEvent) -> None:
+        for q, kinds in self._watchers:
+            if kinds is None or event.resource.kind in kinds:
+                q.put(event)
+
+
+class Watch:
+    """Iterator over store events; close() to stop."""
+
+    _SENTINEL = object()
+
+    def __init__(self, store: Store, q: queue.Queue):
+        self._store = store
+        self._q = q
+        self._closed = False
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is self._SENTINEL:
+                return
+            yield item
+
+    def get(self, timeout: float | None = None) -> WatchEvent | None:
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if item is self._SENTINEL:
+            return None
+        return item
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._store._unwatch(self._q)
+            self._q.put(self._SENTINEL)
+
+
+def _labels_match(labels: dict[str, str], selector: dict[str, str]) -> bool:
+    for k, want in selector.items():
+        have = labels.get(k)
+        if have is None:
+            return False
+        if want not in ("*", have) and not fnmatch.fnmatch(have, want):
+            return False
+    return True
+
+
+def set_controller_reference(owner: Resource, owned: Resource) -> None:
+    """SetControllerReference equivalent (ref reconcilehelper usage)."""
+    from kubeflow_tpu.api.core import OwnerReference
+
+    owned.metadata.owner_references = [
+        OwnerReference(kind=owner.kind, name=owner.metadata.name,
+                       uid=owner.metadata.uid, controller=True)
+    ]

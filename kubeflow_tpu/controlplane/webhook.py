@@ -1,0 +1,197 @@
+"""Admission webhook: TpuPodDefault merge engine + TPU env injection.
+
+Re-design of the reference's PodDefault mutating webhook
+(admission-webhook/main.go): on pod create, select the namespace's
+TpuPodDefaults by label selector (ref filterPodDefaults main.go:70-95),
+refuse to apply on conflict (ref safeToApplyPodDefaultsOnPod
+main.go:99-133 — conflict-refusal is load-bearing, SURVEY.md §7 hard
+part b), merge env/volumes/mounts/tolerations/labels/annotations/
+command/args (ref merge fns main.go:153-364), and stamp an applied
+annotation (ref main.go:424-426).
+
+TPU-native addition (the whole point, SURVEY.md §2b "collective
+communication backend"): pods belonging to a TPU gang get
+TPU_WORKER_ID / TPU_WORKER_HOSTNAMES / coordinator env derived from
+their gang ordinal and the slice topology, so in-pod
+`jax.distributed.initialize()` comes up over ICI with no NCCL/MPI
+rendezvous. The reference's closest mechanism is env merging
+(main.go:153-188); here topology env is computed, not configured.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from kubeflow_tpu.api.core import EnvVar, Pod, Resource
+from kubeflow_tpu.api.crds import (
+    PODDEFAULT_APPLIED_PREFIX,
+    WEBHOOK_EXCLUDE_ANNOTATION,
+    TpuPodDefault,
+)
+from kubeflow_tpu.controlplane.store import AdmissionDenied, Store, _labels_match
+from kubeflow_tpu.parallel.mesh import SLICE_TOPOLOGIES
+
+log = logging.getLogger(__name__)
+
+# Gang bookkeeping labels set by the notebook controller on pods it creates.
+GANG_NAME_LABEL = "kubeflow-tpu.dev/gang-name"
+GANG_ORDINAL_LABEL = "kubeflow-tpu.dev/gang-ordinal"
+GANG_SIZE_LABEL = "kubeflow-tpu.dev/gang-size"
+TOPOLOGY_LABEL = "kubeflow-tpu.dev/tpu-topology"
+MESH_LABEL = "kubeflow-tpu.dev/mesh"
+
+JAX_COORDINATOR_PORT = 8476
+
+
+class PodDefaultWebhook:
+    """Mutating webhook for Pods; register on the store's admission chain."""
+
+    def __init__(self, store: Store):
+        self.store = store
+
+    def __call__(self, obj: Resource) -> None:
+        if not isinstance(obj, Pod):
+            return
+        if obj.metadata.annotations.get(WEBHOOK_EXCLUDE_ANNOTATION) == "true":
+            # ref main.go:496-504 exclusion annotation
+            return
+        defaults = self._matching_defaults(obj)
+        if defaults:
+            self._check_conflicts(obj, defaults)
+            for pd in defaults:
+                self._apply(obj, pd)
+        self._inject_tpu_env(obj)
+
+    # -- selection (ref filterPodDefaults main.go:70-95) -------------------
+
+    def _matching_defaults(self, pod: Pod) -> list[TpuPodDefault]:
+        out = []
+        for pd in self.store.list("TpuPodDefault", pod.metadata.namespace):
+            if _labels_match(pod.metadata.labels, pd.spec.selector):
+                out.append(pd)
+        return sorted(out, key=lambda p: p.metadata.name)
+
+    # -- conflict detection (ref safeToApplyPodDefaultsOnPod :99-133) ------
+
+    def _check_conflicts(self, pod: Pod, defaults: list[TpuPodDefault]) -> None:
+        # Volumes are pod-level; env/mounts are checked PER CONTAINER (the
+        # reference checks safeToApplyPodDefaultsOnContainer per container —
+        # pooling across containers would false-deny multi-container pods
+        # whose containers legitimately differ).
+        volumes: dict[str, str] = {v.name: v.pvc_name for v in pod.spec.volumes}
+        per_container = [
+            (
+                {e.name: e.value for e in c.env},
+                {m.mount_path: m.name for m in c.volume_mounts},
+            )
+            for c in pod.spec.containers
+        ]
+        for pd in defaults:
+            for env, mounts in per_container:
+                for e in pd.spec.env:
+                    if e.name in env and env[e.name] != e.value:
+                        raise AdmissionDenied(
+                            f"TpuPodDefault {pd.metadata.name}: env {e.name} "
+                            f"conflicts (existing={env[e.name]!r} "
+                            f"default={e.value!r})"
+                        )
+                    env[e.name] = e.value
+                for m in pd.spec.volume_mounts:
+                    if m.mount_path in mounts and mounts[m.mount_path] != m.name:
+                        raise AdmissionDenied(
+                            f"TpuPodDefault {pd.metadata.name}: mount path "
+                            f"{m.mount_path} conflicts"
+                        )
+                    mounts[m.mount_path] = m.name
+            for v in pd.spec.volumes:
+                if v.name in volumes and volumes[v.name] != v.pvc_name:
+                    raise AdmissionDenied(
+                        f"TpuPodDefault {pd.metadata.name}: volume {v.name} "
+                        "conflicts with existing volume"
+                    )
+                volumes[v.name] = v.pvc_name
+
+    # -- merge (ref applyPodDefaultsOnPod :369-427) ------------------------
+
+    def _apply(self, pod: Pod, pd: TpuPodDefault) -> None:
+        spec = pd.spec
+        for v in spec.volumes:
+            if all(v.name != x.name for x in pod.spec.volumes):
+                pod.spec.volumes.append(v)
+        for t in spec.tolerations:
+            if all(
+                (t.key, t.value, t.effect) != (x.key, x.value, x.effect)
+                for x in pod.spec.tolerations
+            ):
+                pod.spec.tolerations.append(t)
+        if spec.service_account and not pod.spec.service_account:
+            pod.spec.service_account = spec.service_account
+        for k, v in spec.annotations.items():
+            pod.metadata.annotations.setdefault(k, v)
+        for k, v in spec.labels.items():
+            pod.metadata.labels.setdefault(k, v)
+        for c in pod.spec.containers:
+            have = {e.name for e in c.env}
+            c.env.extend(e for e in spec.env if e.name not in have)
+            have_mounts = {m.mount_path for m in c.volume_mounts}
+            c.volume_mounts.extend(
+                m for m in spec.volume_mounts if m.mount_path not in have_mounts
+            )
+            # ref setCommandAndArgs :453-468 — only when pod doesn't set them
+            if spec.command and not c.command:
+                c.command = list(spec.command)
+            if spec.args and not c.args:
+                c.args = list(spec.args)
+        pod.metadata.annotations[
+            PODDEFAULT_APPLIED_PREFIX + pd.metadata.name
+        ] = str(pd.metadata.resource_version)
+
+    # -- TPU env injection (the NCCL-free multi-host bootstrap) ------------
+
+    def _inject_tpu_env(self, pod: Pod) -> None:
+        labels = pod.metadata.labels
+        gang = labels.get(GANG_NAME_LABEL)
+        topo_name = labels.get(TOPOLOGY_LABEL)
+        if not gang or not topo_name:
+            return
+        topo = SLICE_TOPOLOGIES.get(topo_name)
+        if topo is None:
+            raise AdmissionDenied(f"unknown TPU topology {topo_name!r}")
+        size = int(labels.get(GANG_SIZE_LABEL, topo.hosts))
+        ordinal = int(labels.get(GANG_ORDINAL_LABEL, "0"))
+        ns = pod.metadata.namespace
+        # Stable per-host DNS via the gang's headless service:
+        # <gang>-<ordinal>.<gang>.<ns>.svc (StatefulSet hostname contract).
+        hostnames = ",".join(
+            f"{gang}-{i}.{gang}.{ns}.svc" for i in range(size)
+        )
+        coordinator = f"{gang}-0.{gang}.{ns}.svc:{JAX_COORDINATOR_PORT}"
+        tpu_env = {
+            "TPU_WORKER_ID": str(ordinal),
+            "TPU_WORKER_HOSTNAMES": hostnames,
+            "TPU_CHIPS_PER_HOST_BOUNDS": _chips_per_host_bounds(topo),
+            "TPU_ACCELERATOR_TYPE": topo.name,
+            "JAX_COORDINATOR_ADDRESS": coordinator,
+            "KFTPU_TOPOLOGY": topo.name,
+            "KFTPU_NUM_PROCESSES": str(size),
+        }
+        mesh = labels.get(MESH_LABEL, "")
+        if mesh:
+            tpu_env["KFTPU_MESH"] = mesh.replace("_", ",")
+        for c in pod.spec.containers:
+            have = {e.name for e in c.env}
+            for k, v in tpu_env.items():
+                if k not in have:
+                    c.env.append(EnvVar(name=k, value=v))
+
+
+def _chips_per_host_bounds(topo) -> str:
+    """libtpu's per-host chip grid, e.g. '2,2,1' for 4 chips/host."""
+    cph = topo.chips_per_host
+    if cph == 1:
+        return "1,1,1"
+    if cph == 4:
+        return "2,2,1"
+    if cph == 8:
+        return "2,4,1"
+    return f"{cph},1,1"

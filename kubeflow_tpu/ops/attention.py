@@ -94,6 +94,13 @@ def dot_product_attention(
             else "xla"
         )
     if impl == "flash":
+        if kv_mask is not None or not contiguous_positions:
+            raise ValueError(
+                "impl='flash' masks by row/col index only: it supports "
+                "neither kv_mask nor non-contiguous positions (pass "
+                "contiguous_positions=True for plain causal batches, or "
+                "use impl='xla')"
+            )
         from kubeflow_tpu.ops.pallas.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal)

@@ -185,9 +185,21 @@ def mesh_from_env(devices: Sequence[jax.Device] | None = None) -> Mesh:
             k, _, v = part.partition("=")
             k = k.strip()
             if k in (DATA_AXIS, FSDP_AXIS, TENSOR_AXIS):
-                kwargs[k] = int(v)
+                try:
+                    kwargs[k] = int(v)
+                except ValueError:
+                    raise ValueError(
+                        f"malformed KFTPU_MESH entry {part!r} "
+                        f"(full value: {raw!r})"
+                    ) from None
     spec = MeshSpec(**kwargs) if kwargs else MeshSpec()
     topo = os.environ.get("KFTPU_TOPOLOGY") or None
     if topo is not None and topo not in SLICE_TOPOLOGIES:
+        # Control plane injected a topology this library build doesn't
+        # know — proceed without topology validation but say so.
+        logging.getLogger(__name__).warning(
+            "unknown KFTPU_TOPOLOGY %r (known: %s); skipping slice "
+            "validation", topo, sorted(SLICE_TOPOLOGIES),
+        )
         topo = None
     return create_mesh(spec, devices=devices, topology=topo)

@@ -1,0 +1,139 @@
+"""Culler semantics with fake clock + fake probe (the reference's culler
+tests also never touch HTTP — SURVEY.md §4 tier 1)."""
+
+from kubeflow_tpu.api.crds import (
+    CULLING_DISABLED_ANNOTATION,
+    LAST_ACTIVITY_ANNOTATION,
+    Notebook,
+    STOP_ANNOTATION,
+)
+from kubeflow_tpu.controlplane.controllers.culler import Culler, KernelStatus
+from kubeflow_tpu.controlplane.store import Store
+
+
+class FakeProbe:
+    def __init__(self):
+        self.result = [KernelStatus("idle", 0.0)]
+
+    def kernels(self, namespace, name):
+        return self.result
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def mk(store, name="nb"):
+    nb = Notebook()
+    nb.metadata.name = name
+    nb.metadata.namespace = "u"
+    return store.create(nb)
+
+
+def setup():
+    store = Store()
+    probe = FakeProbe()
+    clock = FakeClock()
+    culler = Culler(probe, idle_time=600.0, check_period=60.0, clock=clock)
+    return store, probe, clock, culler
+
+
+def test_idle_past_threshold_culls():
+    store, probe, clock, culler = setup()
+    mk(store)
+    culler.reconcile(store, "u", "nb")       # records activity at t=1000
+    clock.t += 601
+    culler.reconcile(store, "u", "nb")
+    nb = store.get("Notebook", "u", "nb")
+    assert STOP_ANNOTATION in nb.metadata.annotations
+    assert any(e.reason == "Culled" for e in store.events_for("Notebook", "u", "nb"))
+
+
+def test_busy_kernel_never_culled():
+    """A 3-day pretrain keeps the kernel busy ⇒ no cull (SURVEY.md §7d)."""
+    store, probe, clock, culler = setup()
+    mk(store)
+    probe.result = [KernelStatus("busy", 0.0)]
+    culler.reconcile(store, "u", "nb")
+    for _ in range(10):
+        clock.t += 590
+        culler.reconcile(store, "u", "nb")
+    nb = store.get("Notebook", "u", "nb")
+    assert STOP_ANNOTATION not in nb.metadata.annotations
+
+
+def test_kernel_activity_advances_timestamp():
+    store, probe, clock, culler = setup()
+    mk(store)
+    culler.reconcile(store, "u", "nb")
+    clock.t += 500
+    probe.result = [KernelStatus("idle", clock.t - 10)]  # recent activity
+    culler.reconcile(store, "u", "nb")
+    clock.t += 500
+    culler.reconcile(store, "u", "nb")   # idle 510s < 600 ⇒ not culled
+    nb = store.get("Notebook", "u", "nb")
+    assert STOP_ANNOTATION not in nb.metadata.annotations
+    last = float(nb.metadata.annotations[LAST_ACTIVITY_ANNOTATION])
+    assert last == clock.t - 510
+
+
+def test_disabled_annotation_skips():
+    store, probe, clock, culler = setup()
+    nb = Notebook()
+    nb.metadata.name = "nb"
+    nb.metadata.namespace = "u"
+    nb.metadata.annotations[CULLING_DISABLED_ANNOTATION] = "true"
+    store.create(nb)
+    clock.t += 10000
+    culler.reconcile(store, "u", "nb")
+    assert STOP_ANNOTATION not in store.get(
+        "Notebook", "u", "nb").metadata.annotations
+
+
+def test_unreachable_probe_does_not_cull_fresh_notebook():
+    store, probe, clock, culler = setup()
+    mk(store)
+    probe.result = None
+    culler.reconcile(store, "u", "nb")
+    clock.t += 10000
+    culler.reconcile(store, "u", "nb")
+    assert STOP_ANNOTATION not in store.get(
+        "Notebook", "u", "nb").metadata.annotations
+
+
+def test_culling_end_to_end_scales_down():
+    """Integration: culler + notebook controller through the Cluster —
+    idle notebook ends at replicas 0 (the full reference loop §3.2)."""
+    import time
+
+    from kubeflow_tpu.api.core import Container, PodTemplateSpec
+    from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
+
+    probe = FakeProbe()
+    cfg = ClusterConfig(
+        enable_culling=True, activity_probe=probe,
+        cull_idle_time=0.3, cull_check_period=0.05,
+    )
+    with Cluster(cfg) as c:
+        nb = Notebook()
+        nb.metadata.name = "idle-nb"
+        nb.metadata.namespace = "u"
+        nb.spec.template = PodTemplateSpec()
+        nb.spec.template.spec.containers.append(Container(name="idle-nb"))
+        c.store.create(nb)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            sts = c.store.try_get("StatefulSet", "u", "idle-nb")
+            cur = c.store.get("Notebook", "u", "idle-nb")
+            if (sts is not None and sts.spec.replicas == 0
+                    and STOP_ANNOTATION in cur.metadata.annotations
+                    and c.store.list("Pod", "u") == []):
+                break
+            time.sleep(0.05)
+        sts = c.store.get("StatefulSet", "u", "idle-nb")
+        assert sts.spec.replicas == 0
+        assert c.store.list("Pod", "u") == []

@@ -1,0 +1,108 @@
+"""Store semantics: CRUD, optimistic concurrency, finalizers, GC, watch."""
+
+import pytest
+
+from kubeflow_tpu.api.core import Namespace, Pod, resource_from_dict
+from kubeflow_tpu.api.crds import Notebook
+from kubeflow_tpu.controlplane.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    Store,
+    set_controller_reference,
+)
+
+
+def mk_notebook(name="nb", ns="user1"):
+    nb = Notebook()
+    nb.metadata.name = name
+    nb.metadata.namespace = ns
+    return nb
+
+
+def test_create_get_roundtrip():
+    s = Store()
+    created = s.create(mk_notebook())
+    assert created.metadata.uid
+    assert created.metadata.resource_version > 0
+    got = s.get("Notebook", "user1", "nb")
+    assert got.metadata.uid == created.metadata.uid
+    with pytest.raises(AlreadyExists):
+        s.create(mk_notebook())
+    with pytest.raises(NotFound):
+        s.get("Notebook", "user1", "other")
+
+
+def test_optimistic_concurrency():
+    s = Store()
+    a = s.create(mk_notebook())
+    b = s.get("Notebook", "user1", "nb")
+    a.metadata.labels["x"] = "1"
+    s.update(a)
+    b.metadata.labels["y"] = "2"
+    with pytest.raises(Conflict):
+        s.update(b)  # stale resource_version
+
+
+def test_finalizers_defer_deletion():
+    s = Store()
+    nb = mk_notebook()
+    nb.metadata.finalizers = ["test/cleanup"]
+    s.create(nb)
+    s.delete("Notebook", "user1", "nb")
+    # still present, marked deleting
+    cur = s.get("Notebook", "user1", "nb")
+    assert cur.metadata.deletion_timestamp is not None
+    cur.metadata.finalizers = []
+    s.update(cur)
+    with pytest.raises(NotFound):
+        s.get("Notebook", "user1", "nb")
+
+
+def test_owner_gc_cascade():
+    s = Store()
+    owner = s.create(mk_notebook())
+    child = Pod()
+    child.metadata.name = "nb-0"
+    child.metadata.namespace = "user1"
+    set_controller_reference(owner, child)
+    s.create(child)
+    s.delete("Notebook", "user1", "nb")
+    with pytest.raises(NotFound):
+        s.get("Pod", "user1", "nb-0")
+
+
+def test_label_selector_and_watch():
+    s = Store()
+    w = s.watch(("Notebook",))
+    nb = mk_notebook()
+    nb.metadata.labels["team"] = "ml"
+    s.create(nb)
+    other = mk_notebook("nb2")
+    s.create(other)
+    assert len(s.list("Notebook", "user1", label_selector={"team": "ml"})) == 1
+    ev = w.get(timeout=1)
+    assert ev.type == "ADDED" and ev.resource.metadata.name == "nb"
+    ev = w.get(timeout=1)
+    assert ev.resource.metadata.name == "nb2"
+    w.close()
+
+
+def test_serialization_roundtrip():
+    nb = mk_notebook()
+    nb.spec.tpu.topology = "v5e-16"
+    nb.metadata.labels["a"] = "b"
+    d = nb.to_dict()
+    assert d["kind"] == "Notebook"
+    back = resource_from_dict(d)
+    assert isinstance(back, Notebook)
+    assert back.spec.tpu.topology == "v5e-16"
+    assert back.metadata.labels == {"a": "b"}
+
+
+def test_cluster_scoped_namespace():
+    s = Store()
+    n = Namespace()
+    n.metadata.name = "user1"
+    s.create(n)
+    assert s.get("Namespace", "", "user1").phase == "Active"

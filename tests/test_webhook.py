@@ -1,0 +1,173 @@
+"""TpuPodDefault webhook: merge semantics + conflict refusal (table-driven,
+modeled on the reference's admission-webhook/main_test.go tier)."""
+
+import pytest
+
+from kubeflow_tpu.api.core import Container, EnvVar, Pod, Toleration, Volume, VolumeMount
+from kubeflow_tpu.api.crds import (
+    PODDEFAULT_APPLIED_PREFIX,
+    WEBHOOK_EXCLUDE_ANNOTATION,
+    TpuPodDefault,
+)
+from kubeflow_tpu.controlplane.store import AdmissionDenied, Store
+from kubeflow_tpu.controlplane.webhook import PodDefaultWebhook
+from kubeflow_tpu.controlplane import webhook as wh
+
+
+def mk_store():
+    s = Store()
+    s.register_mutating_webhook("Pod", PodDefaultWebhook(s))
+    return s
+
+
+def mk_poddefault(name, ns="user1", selector=None, **spec_kwargs):
+    pd = TpuPodDefault()
+    pd.metadata.name = name
+    pd.metadata.namespace = ns
+    pd.spec.selector = selector or {"use-" + name: "true"}
+    for k, v in spec_kwargs.items():
+        setattr(pd.spec, k, v)
+    return pd
+
+
+def mk_pod(name="p1", ns="user1", labels=None):
+    pod = Pod()
+    pod.metadata.name = name
+    pod.metadata.namespace = ns
+    pod.metadata.labels = labels or {}
+    pod.spec.containers.append(Container(name="main"))
+    return pod
+
+
+def test_env_volume_merge_and_stamp():
+    s = mk_store()
+    s.create(mk_poddefault(
+        "gcs-creds",
+        env=[EnvVar("GOOGLE_APPLICATION_CREDENTIALS", "/secrets/gcp.json")],
+        volumes=[Volume(name="creds", secret="user-gcp-sa")],
+        volume_mounts=[VolumeMount(name="creds", mount_path="/secrets")],
+        tolerations=[Toleration(key="tpu", value="true", effect="NoSchedule")],
+    ))
+    pod = mk_pod(labels={"use-gcs-creds": "true"})
+    created = s.create(pod)
+    c = created.spec.containers[0]
+    assert {e.name: e.value for e in c.env}[
+        "GOOGLE_APPLICATION_CREDENTIALS"] == "/secrets/gcp.json"
+    assert created.spec.volumes[0].secret == "user-gcp-sa"
+    assert c.volume_mounts[0].mount_path == "/secrets"
+    assert created.spec.tolerations[0].key == "tpu"
+    pd = s.get("TpuPodDefault", "user1", "gcs-creds")
+    assert created.metadata.annotations[
+        PODDEFAULT_APPLIED_PREFIX + "gcs-creds"
+    ] == str(pd.metadata.resource_version)
+
+
+def test_selector_mismatch_no_apply():
+    s = mk_store()
+    s.create(mk_poddefault("x", env=[EnvVar("A", "1")]))
+    created = s.create(mk_pod())
+    assert created.spec.containers[0].env == []
+
+
+def test_env_conflict_denied():
+    """Conflict refusal is load-bearing (ref safeToApplyPodDefaultsOnPod
+    main.go:99-133)."""
+    s = mk_store()
+    s.create(mk_poddefault("a", env=[EnvVar("MODE", "fast")]))
+    pod = mk_pod(labels={"use-a": "true"})
+    pod.spec.containers[0].env.append(EnvVar("MODE", "slow"))
+    with pytest.raises(AdmissionDenied, match="MODE"):
+        s.create(pod)
+
+
+def test_cross_poddefault_conflict_denied():
+    s = mk_store()
+    sel = {"team": "ml"}
+    s.create(mk_poddefault("a", selector=sel, env=[EnvVar("MODE", "fast")]))
+    s.create(mk_poddefault("b", selector=sel, env=[EnvVar("MODE", "slow")]))
+    with pytest.raises(AdmissionDenied, match="MODE"):
+        s.create(mk_pod(labels={"team": "ml"}))
+
+
+def test_same_value_env_not_conflict():
+    s = mk_store()
+    s.create(mk_poddefault("a", env=[EnvVar("MODE", "fast")]))
+    pod = mk_pod(labels={"use-a": "true"})
+    pod.spec.containers[0].env.append(EnvVar("MODE", "fast"))
+    created = s.create(pod)
+    envs = [e for e in created.spec.containers[0].env if e.name == "MODE"]
+    assert len(envs) == 1
+
+
+def test_mount_path_conflict_denied():
+    s = mk_store()
+    s.create(mk_poddefault(
+        "a",
+        volumes=[Volume(name="v1", pvc_name="pvc1")],
+        volume_mounts=[VolumeMount(name="v1", mount_path="/data")],
+    ))
+    pod = mk_pod(labels={"use-a": "true"})
+    pod.spec.volumes.append(Volume(name="other", pvc_name="pvc2"))
+    pod.spec.containers[0].volume_mounts.append(
+        VolumeMount(name="other", mount_path="/data"))
+    with pytest.raises(AdmissionDenied, match="/data"):
+        s.create(pod)
+
+
+def test_command_only_when_unset():
+    s = mk_store()
+    s.create(mk_poddefault("a", command=["jupyter"], args=["lab"]))
+    pod = mk_pod(labels={"use-a": "true"})
+    pod.spec.containers[0].command = ["bash"]
+    created = s.create(pod)
+    assert created.spec.containers[0].command == ["bash"]   # pod wins
+    assert created.spec.containers[0].args == ["lab"]       # unset → filled
+
+
+def test_exclude_annotation():
+    s = mk_store()
+    s.create(mk_poddefault("a", env=[EnvVar("A", "1")]))
+    pod = mk_pod(labels={"use-a": "true"})
+    pod.metadata.annotations[WEBHOOK_EXCLUDE_ANNOTATION] = "true"
+    created = s.create(pod)
+    assert created.spec.containers[0].env == []
+
+
+def test_tpu_env_injection_standalone():
+    """Gang labels alone (no TpuPodDefault) trigger TPU env injection."""
+    s = mk_store()
+    pod = mk_pod(labels={
+        wh.GANG_NAME_LABEL: "train",
+        wh.GANG_ORDINAL_LABEL: "2",
+        wh.GANG_SIZE_LABEL: "4",
+        wh.TOPOLOGY_LABEL: "v5e-16",
+    })
+    created = s.create(pod)
+    env = {e.name: e.value for e in created.spec.containers[0].env}
+    assert env["TPU_WORKER_ID"] == "2"
+    assert env["KFTPU_NUM_PROCESSES"] == "4"
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5e-16"
+
+
+def test_tpu_env_unknown_topology_denied():
+    s = mk_store()
+    pod = mk_pod(labels={
+        wh.GANG_NAME_LABEL: "train",
+        wh.TOPOLOGY_LABEL: "v99-1024",
+    })
+    with pytest.raises(AdmissionDenied, match="v99-1024"):
+        s.create(pod)
+
+
+def test_user_env_not_overwritten_by_tpu_env():
+    s = mk_store()
+    pod = mk_pod(labels={
+        wh.GANG_NAME_LABEL: "train",
+        wh.GANG_ORDINAL_LABEL: "0",
+        wh.GANG_SIZE_LABEL: "2",
+        wh.TOPOLOGY_LABEL: "v5e-8",
+    })
+    pod.spec.containers[0].env.append(EnvVar("TPU_WORKER_ID", "7"))
+    created = s.create(pod)
+    env = [e for e in created.spec.containers[0].env if e.name == "TPU_WORKER_ID"]
+    assert len(env) == 1 and env[0].value == "7"
