@@ -1,0 +1,127 @@
+"""AuthN/AuthZ for the web surface (reference L4).
+
+- authn: trusted-header identity, the reference's model throughout
+  (crud_backend/authn.py:12-67, settings.py:5-6 USERID_HEADER default
+  `kubeflow-userid`; dashboard server.ts:25-32). No sessions: the mesh
+  in front injects the header.
+- authz: SubjectAccessReview-style checks resolved against RoleBindings
+  in the store (crud_backend/authz.py:25-132 does a SAR per call; here
+  the store IS the authority so the check is a direct lookup with the
+  same verb model).
+- csrf: double-submit cookie (crud_backend/csrf.py:57-111).
+"""
+
+from __future__ import annotations
+
+import hmac
+import secrets
+from dataclasses import dataclass
+
+from kubeflow_tpu.controlplane.store import Store
+
+USERID_HEADER = "kubeflow-userid"
+USERID_PREFIX = ""          # ref strips an optional prefix (authn.py)
+CSRF_COOKIE = "XSRF-TOKEN"
+CSRF_HEADER = "X-XSRF-TOKEN"
+
+# Namespaces never claimable through self-serve profiles: owning
+# kubeflow-tpu-system would mint cluster admins (is_cluster_admin reads
+# admin RoleBindings from it).
+RESERVED_NAMESPACES = frozenset({
+    "kubeflow-tpu-system", "default", "kube-system", "kube-public",
+})
+RESERVED_PREFIXES = ("kube-", "kubeflow-tpu-")
+
+
+def is_reserved_namespace(name: str) -> bool:
+    return name in RESERVED_NAMESPACES or name.startswith(RESERVED_PREFIXES)
+
+
+# verb sets per role (mirrors k8s edit/view ClusterRole semantics)
+_ROLE_VERBS = {
+    "kubeflow-tpu-admin": {"get", "list", "create", "update", "delete"},
+    "kubeflow-tpu-edit": {"get", "list", "create", "update", "delete"},
+    "kubeflow-tpu-view": {"get", "list"},
+}
+
+
+class Unauthenticated(Exception):
+    status = 401
+
+
+class Forbidden(Exception):
+    status = 403
+
+
+@dataclass(frozen=True)
+class User:
+    name: str
+
+
+def authenticate(headers) -> User:
+    """Extract identity from trusted headers (authn.py:12-67)."""
+    raw = headers.get(USERID_HEADER, "")
+    if not raw:
+        raise Unauthenticated(f"missing {USERID_HEADER} header")
+    if USERID_PREFIX and raw.startswith(USERID_PREFIX):
+        raw = raw[len(USERID_PREFIX):]
+    return User(raw)
+
+
+def is_cluster_admin(store: Store, user: User,
+                     cluster_admins: set[str] | None = None) -> bool:
+    if cluster_admins and user.name in cluster_admins:
+        return True
+    for rb in store.list("RoleBinding", "kubeflow-tpu-system"):
+        if rb.role == "kubeflow-tpu-admin" and user.name in rb.subjects:
+            return True
+    return False
+
+
+def ensure_authorized(
+    store: Store,
+    user: User,
+    verb: str,
+    kind: str,
+    namespace: str,
+    *,
+    cluster_admins: set[str] | None = None,
+) -> None:
+    """SAR-equivalent (authz.py:46-80): raise Forbidden unless allowed."""
+    if is_cluster_admin(store, user, cluster_admins):
+        return
+    for rb in store.list("RoleBinding", namespace):
+        if user.name not in rb.subjects:
+            continue
+        if verb in _ROLE_VERBS.get(rb.role, set()):
+            return
+    raise Forbidden(
+        f"user {user.name!r} cannot {verb} {kind} in namespace {namespace!r}"
+    )
+
+
+def namespaces_for(store: Store, user: User,
+                   cluster_admins: set[str] | None = None) -> list[str]:
+    """Namespaces the user can at least view (dashboard env-info)."""
+    if is_cluster_admin(store, user, cluster_admins):
+        return sorted(
+            n.metadata.name for n in store.list("Namespace")
+        )
+    out = set()
+    for rb in store.list("RoleBinding", None):
+        if user.name in rb.subjects and rb.metadata.namespace:
+            out.add(rb.metadata.namespace)
+    return sorted(out)
+
+
+# -- CSRF (double-submit cookie, csrf.py:57-111) ----------------------------
+
+
+def new_csrf_token() -> str:
+    return secrets.token_urlsafe(32)
+
+
+def check_csrf(cookie_token: str | None, header_token: str | None) -> bool:
+    if not cookie_token or not header_token:
+        return False
+    return hmac.compare_digest(cookie_token, header_token)

@@ -1,0 +1,275 @@
+"""Profile controller: multi-tenancy with first-class TPU quota.
+
+Re-design of the reference's profile-controller
+(controllers/profile_controller.go:105-322):
+- cluster-scoped Profile → owned Namespace with owner annotation
+  (:127-198) and default labels;
+- AuthorizationPolicy allowing the owner's identity header, in-namespace
+  traffic, and the notebook-controller's kernels-probe path (:407-524);
+- `default-editor` / `default-viewer` ServiceAccounts with RoleBindings
+  (:560-639) plus the owner's admin RoleBinding (:230-251);
+- ResourceQuota from spec (:526-557) — TPU-first: `tpu/<gen>-chips`
+  quota keys are validated against the slice-topology table so a tenant
+  can be capped at e.g. 32 v5e chips;
+- pluggable cloud-identity plugins (:643-701, plugin_workload_identity.
+  go:44-51): here an in-memory WorkloadIdentity plugin annotates the
+  editor SA (pure policy editing, testable like plugin_iam_test.go);
+- finalizer-based cleanup (:284-319): deleting the Profile deletes the
+  namespace and everything in it.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Protocol
+
+from kubeflow_tpu.api.core import (
+    AuthorizationPolicy,
+    Namespace,
+    ResourceQuota,
+    RoleBinding,
+    ServiceAccount,
+)
+from kubeflow_tpu.api.crds import PROFILE_FINALIZER, Profile
+from kubeflow_tpu.controlplane.runtime import Controller, Result
+from kubeflow_tpu.controlplane.store import (
+    AlreadyExists,
+    NotFound,
+    Store,
+    set_controller_reference,
+)
+
+log = logging.getLogger(__name__)
+
+OWNER_ANNOTATION = "kubeflow-tpu.dev/profile-owner"
+ROLE_ADMIN = "kubeflow-tpu-admin"
+ROLE_EDIT = "kubeflow-tpu-edit"
+ROLE_VIEW = "kubeflow-tpu-view"
+KERNELS_PROBE_PATH = "/notebook/*/*/api/kernels"   # culler probe allowance
+
+
+class ProfilePlugin(Protocol):
+    """ref Plugin iface profile_controller.go:77-83."""
+
+    def apply(self, store: Store, profile: Profile) -> None: ...
+    def revoke(self, store: Store, profile: Profile) -> None: ...
+
+
+class WorkloadIdentityPlugin:
+    """Binds the namespace's editor SA to a cloud service account by
+    annotation (ref plugin_workload_identity.go:34-51: annotation
+    `iam.gke.io/gcp-service-account`). Pure metadata editing — the cloud
+    IAM call is out of scope exactly as the reference's tests treat it."""
+
+    SA_ANNOTATION = "iam.kubeflow-tpu.dev/gcp-service-account"
+
+    def __init__(self, gsa_format: str = "{profile}@project.iam.gserviceaccount.com"):
+        self.gsa_format = gsa_format
+
+    def apply(self, store: Store, profile: Profile) -> None:
+        ns = profile.metadata.name
+        sa = store.try_get("ServiceAccount", ns, "default-editor")
+        if sa is None:
+            return
+        gsa = self.gsa_format.format(profile=profile.metadata.name)
+        if sa.metadata.annotations.get(self.SA_ANNOTATION) != gsa:
+            sa.metadata.annotations[self.SA_ANNOTATION] = gsa
+            store.update(sa)
+
+    def revoke(self, store: Store, profile: Profile) -> None:
+        ns = profile.metadata.name
+        sa = store.try_get("ServiceAccount", ns, "default-editor")
+        if sa is None:
+            return
+        if self.SA_ANNOTATION in sa.metadata.annotations:
+            del sa.metadata.annotations[self.SA_ANNOTATION]
+            store.update(sa)
+
+
+class ProfileController(Controller):
+    KIND = "Profile"
+    OWNS = ("Namespace",)
+
+    def __init__(self, *, default_namespace_labels: dict[str, str] | None = None,
+                 plugins: list[ProfilePlugin] | None = None):
+        # ref: fsnotify-watched labels file (profile_controller.go:356-405);
+        # our config layer (utils/config.py) hot-reloads and re-creates the
+        # controller-visible dict in place.
+        self.default_namespace_labels = default_namespace_labels or {}
+        self.plugins = plugins or []
+
+    def reconcile(self, store: Store, namespace: str, name: str) -> Result:
+        try:
+            profile = store.get("Profile", "", name)
+        except NotFound:
+            return Result()
+        assert isinstance(profile, Profile)
+
+        # Defense in depth vs privilege escalation: a profile that would
+        # own a reserved/system namespace never reconciles (Kfam rejects
+        # these too, but direct CR creation must not bypass it).
+        from kubeflow_tpu.controlplane.auth import is_reserved_namespace
+
+        if is_reserved_namespace(name):
+            if profile.status.phase != "Failed":
+                profile.status.phase = "Failed"
+                profile.status.message = f"namespace name {name!r} is reserved"
+                store.update(profile)
+            return Result()
+
+        if profile.metadata.deletion_timestamp is not None:
+            return self._finalize(store, profile)
+
+        if PROFILE_FINALIZER not in profile.metadata.finalizers:
+            profile.metadata.finalizers.append(PROFILE_FINALIZER)
+            store.update(profile)
+            return Result()  # re-enqueued by our own MODIFIED event
+
+        if not self._ensure_namespace(store, profile):
+            return Result()  # ownership conflict surfaced in status
+        self._ensure_service_accounts(store, profile)
+        self._ensure_role_bindings(store, profile)
+        self._ensure_authorization_policy(store, profile)
+        self._ensure_quota(store, profile)
+        for plugin in self.plugins:
+            plugin.apply(store, profile)
+
+        fresh = store.try_get("Profile", "", name)
+        if fresh is not None and fresh.status.phase != "Ready":
+            fresh.status.phase = "Ready"
+            fresh.status.message = ""
+            store.update(fresh)
+        return Result()
+
+    # -- pieces ------------------------------------------------------------
+
+    def _ensure_namespace(self, store: Store, profile: Profile) -> bool:
+        name = profile.metadata.name
+        existing = store.try_get("Namespace", "", name)
+        if existing is None:
+            ns = Namespace()
+            ns.metadata.name = name
+            ns.metadata.annotations[OWNER_ANNOTATION] = profile.spec.owner
+            ns.metadata.labels.update(self.default_namespace_labels)
+            set_controller_reference(profile, ns)
+            try:
+                store.create(ns)
+            except AlreadyExists:
+                pass
+            return True
+        # Ownership check (ref :127-198): namespace created by someone else
+        # is NOT adopted.
+        owner = existing.metadata.annotations.get(OWNER_ANNOTATION)
+        if owner != profile.spec.owner:
+            fresh = store.try_get("Profile", "", name)
+            if fresh is not None and fresh.status.phase != "Failed":
+                fresh.status.phase = "Failed"
+                fresh.status.message = (
+                    f"namespace {name} exists and is not owned by "
+                    f"{profile.spec.owner}"
+                )
+                store.update(fresh)
+            return False
+        # label merge semantics (ref setNamespaceLabels :722-741:
+        # empty value ⇒ delete label)
+        changed = False
+        for k, v in self.default_namespace_labels.items():
+            if v == "" and k in existing.metadata.labels:
+                del existing.metadata.labels[k]
+                changed = True
+            elif v != "" and existing.metadata.labels.get(k) != v:
+                existing.metadata.labels[k] = v
+                changed = True
+        if changed:
+            store.update(existing)
+        return True
+
+    def _ensure_service_accounts(self, store: Store, profile: Profile) -> None:
+        ns = profile.metadata.name
+        for sa_name in ("default-editor", "default-viewer"):
+            if store.try_get("ServiceAccount", ns, sa_name) is None:
+                sa = ServiceAccount()
+                sa.metadata.name = sa_name
+                sa.metadata.namespace = ns
+                try:
+                    store.create(sa)
+                except AlreadyExists:
+                    pass
+
+    def _ensure_role_bindings(self, store: Store, profile: Profile) -> None:
+        ns = profile.metadata.name
+        wanted = [
+            ("default-editor", ROLE_EDIT, [f"sa:{ns}:default-editor"]),
+            ("default-viewer", ROLE_VIEW, [f"sa:{ns}:default-viewer"]),
+            ("namespace-admin", ROLE_ADMIN, [profile.spec.owner]),
+        ]
+        for rb_name, role, subjects in wanted:
+            existing = store.try_get("RoleBinding", ns, rb_name)
+            if existing is None:
+                # No user/role annotations: those mark KFAM-managed
+                # contributor bindings only (KFAM lists bindings back from
+                # annotations, ref bindings.go:179-222).
+                rb = RoleBinding(role=role, subjects=subjects)
+                rb.metadata.name = rb_name
+                rb.metadata.namespace = ns
+                try:
+                    store.create(rb)
+                except AlreadyExists:
+                    pass
+            elif existing.role != role or existing.subjects != subjects:
+                existing.role = role
+                existing.subjects = subjects
+                store.update(existing)
+
+    def _ensure_authorization_policy(self, store: Store, profile: Profile) -> None:
+        ns = profile.metadata.name
+        desired_users = sorted({
+            u for rb in store.list("RoleBinding", ns) for u in rb.subjects
+        } | {profile.spec.owner})
+        existing = store.try_get("AuthorizationPolicy", ns, "ns-owner-access")
+        if existing is None:
+            ap = AuthorizationPolicy(
+                allow_users=desired_users,
+                allow_namespaces=[ns],          # in-ns traffic (ref :452-469)
+                allow_paths=[KERNELS_PROBE_PATH],
+            )
+            ap.metadata.name = "ns-owner-access"
+            ap.metadata.namespace = ns
+            try:
+                store.create(ap)
+            except AlreadyExists:
+                pass
+        elif existing.allow_users != desired_users:
+            existing.allow_users = desired_users
+            store.update(existing)
+
+    def _ensure_quota(self, store: Store, profile: Profile) -> None:
+        ns = profile.metadata.name
+        if not profile.spec.resource_quota:
+            return
+        existing = store.try_get("ResourceQuota", ns, "kf-resource-quota")
+        if existing is None:
+            rq = ResourceQuota(hard=dict(profile.spec.resource_quota))
+            rq.metadata.name = "kf-resource-quota"
+            rq.metadata.namespace = ns
+            try:
+                store.create(rq)
+            except AlreadyExists:
+                pass
+        elif existing.hard != profile.spec.resource_quota:
+            existing.hard = dict(profile.spec.resource_quota)
+            store.update(existing)
+
+    def _finalize(self, store: Store, profile: Profile) -> Result:
+        for plugin in self.plugins:
+            plugin.revoke(store, profile)
+        try:
+            store.delete("Namespace", "", profile.metadata.name)
+        except NotFound:
+            pass
+        fresh = store.try_get("Profile", "", profile.metadata.name)
+        if fresh is not None and PROFILE_FINALIZER in fresh.metadata.finalizers:
+            fresh.metadata.finalizers.remove(PROFILE_FINALIZER)
+            store.update(fresh)
+        return Result()
+

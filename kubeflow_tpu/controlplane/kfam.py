@@ -1,0 +1,179 @@
+"""Access management service (KFAM): profiles + contributor bindings.
+
+Re-design of the reference's access-management component (kfam/*.go):
+- profile create/delete (api_default.go:134-155 → profile CR);
+- contributor bindings: a RoleBinding + AuthorizationPolicy-users pair
+  per contributor (bindings.go:96-139), listed back from RoleBinding
+  annotations (bindings.go:179-222);
+- owner-or-cluster-admin permission gate on mutations
+  (api_default.go:104-132, :293-310);
+- role mapping admin|edit|view ↔ cluster role names
+  (api_default.go:39-46).
+
+The REST surface (aiohttp app in kubeflow_tpu.web.kfam_app) wraps this
+logic; tests drive both layers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import dataclass
+
+from kubeflow_tpu.api.core import RoleBinding
+from kubeflow_tpu.api.crds import Profile
+from kubeflow_tpu.controlplane.auth import (
+    User,
+    is_cluster_admin,
+    is_reserved_namespace,
+)
+from kubeflow_tpu.controlplane.controllers.profile import (
+    OWNER_ANNOTATION,
+    ROLE_ADMIN,
+    ROLE_EDIT,
+    ROLE_VIEW,
+)
+from kubeflow_tpu.controlplane.store import AlreadyExists, NotFound, Store
+
+_ROLE_MAP = {"admin": ROLE_ADMIN, "edit": ROLE_EDIT, "view": ROLE_VIEW}
+_ROLE_UNMAP = {v: k for k, v in _ROLE_MAP.items()}
+
+_EMAIL_RE = re.compile(r"^[^@\s]+@[^@\s]+$|^sa:[\w.-]+:[\w.-]+$")
+_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
+
+
+class KfamError(Exception):
+    status = 400
+
+
+class PermissionDenied(KfamError):
+    status = 403
+
+
+@dataclass
+class Binding:
+    user: str
+    namespace: str
+    role: str  # admin | edit | view
+
+
+class Kfam:
+    def __init__(self, store: Store, cluster_admins: set[str] | None = None):
+        self.store = store
+        self.cluster_admins = cluster_admins or set()
+
+    # -- permission gate (ref api_default.go:104-132) ----------------------
+
+    def _ensure_owner_or_admin(self, caller: User, namespace: str) -> None:
+        if is_cluster_admin(self.store, caller, self.cluster_admins):
+            return
+        profile = self.store.try_get("Profile", "", namespace)
+        if profile is not None and profile.spec.owner == caller.name:
+            return
+        # namespace admins (contributors with admin role) also qualify
+        for rb in self.store.list("RoleBinding", namespace):
+            if caller.name in rb.subjects and rb.role == ROLE_ADMIN:
+                return
+        raise PermissionDenied(
+            f"{caller.name} is not owner/admin of {namespace}"
+        )
+
+    # -- profiles ----------------------------------------------------------
+
+    def create_profile(self, caller: User, name: str, owner: str = "",
+                       quota: dict[str, str] | None = None) -> Profile:
+        owner = owner or caller.name
+        if owner != caller.name and not is_cluster_admin(
+            self.store, caller, self.cluster_admins
+        ):
+            raise PermissionDenied("only cluster admins create for others")
+        if not _NAME_RE.match(name):
+            raise KfamError(f"invalid profile name {name!r}")
+        if is_reserved_namespace(name):
+            raise PermissionDenied(f"namespace name {name!r} is reserved")
+        p = Profile()
+        p.metadata.name = name
+        p.spec.owner = owner
+        if quota:
+            p.spec.resource_quota = dict(quota)
+        try:
+            return self.store.create(p)
+        except AlreadyExists:
+            raise KfamError(f"profile {name} already exists")
+
+    def delete_profile(self, caller: User, name: str) -> None:
+        self._ensure_owner_or_admin(caller, name)
+        try:
+            self.store.delete("Profile", "", name)
+        except NotFound:
+            raise KfamError(f"profile {name} not found")
+
+    # -- bindings (ref bindings.go:96-222) ---------------------------------
+
+    def create_binding(self, caller: User, b: Binding) -> None:
+        self._ensure_owner_or_admin(caller, b.namespace)
+        if b.role not in _ROLE_MAP:
+            raise KfamError(f"unknown role {b.role!r} (admin|edit|view)")
+        if not _EMAIL_RE.match(b.user):
+            raise KfamError(f"invalid user {b.user!r}")
+        rb = RoleBinding(role=_ROLE_MAP[b.role], subjects=[b.user])
+        rb.metadata.name = _binding_name(b.user, b.role)
+        rb.metadata.namespace = b.namespace
+        rb.metadata.annotations["user"] = b.user
+        rb.metadata.annotations["role"] = b.role
+        try:
+            self.store.create(rb)
+        except AlreadyExists:
+            raise KfamError(f"binding for {b.user} already exists")
+        self._sync_authz_users(b.namespace)
+
+    def delete_binding(self, caller: User, b: Binding) -> None:
+        self._ensure_owner_or_admin(caller, b.namespace)
+        try:
+            self.store.delete(
+                "RoleBinding", b.namespace, _binding_name(b.user, b.role)
+            )
+        except NotFound:
+            raise KfamError(f"binding for {b.user} not found")
+        self._sync_authz_users(b.namespace)
+
+    def list_bindings(self, caller: User, namespace: str | None = None,
+                      user: str | None = None) -> list[Binding]:
+        out = []
+        for rb in self.store.list("RoleBinding", namespace):
+            u = rb.metadata.annotations.get("user")
+            r = rb.metadata.annotations.get("role") or _ROLE_UNMAP.get(rb.role)
+            if not u or not r:
+                continue  # not a kfam-managed binding
+            if user is not None and u != user:
+                continue
+            out.append(Binding(user=u, namespace=rb.metadata.namespace, role=r))
+        return out
+
+    def is_cluster_admin(self, user: User) -> bool:
+        return is_cluster_admin(self.store, user, self.cluster_admins)
+
+    def _sync_authz_users(self, namespace: str) -> None:
+        """Keep the namespace AuthorizationPolicy's user list in step with
+        bindings (the reference creates a per-contributor policy,
+        bindings.go:79-94; we maintain one policy's allow list)."""
+        ap = self.store.try_get("AuthorizationPolicy", namespace,
+                                "ns-owner-access")
+        if ap is None:
+            return
+        users = {
+            u for rb in self.store.list("RoleBinding", namespace)
+            for u in rb.subjects
+        }
+        profile = self.store.try_get("Profile", "", namespace)
+        if profile is not None:
+            users.add(profile.spec.owner)
+        users = sorted(users)
+        if ap.allow_users != users:
+            ap.allow_users = users
+            self.store.update(ap)
+
+
+def _binding_name(user: str, role: str) -> str:
+    digest = hashlib.sha256(f"{user}:{role}".encode()).hexdigest()[:10]
+    return f"contributor-{digest}"

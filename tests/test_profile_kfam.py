@@ -1,0 +1,213 @@
+"""Profile controller + KFAM + authz integration."""
+
+import pytest
+
+from kubeflow_tpu.api.crds import Profile
+from kubeflow_tpu.controlplane.auth import (
+    Forbidden,
+    Unauthenticated,
+    User,
+    authenticate,
+    check_csrf,
+    ensure_authorized,
+    namespaces_for,
+    new_csrf_token,
+)
+from kubeflow_tpu.controlplane.controllers.profile import (
+    OWNER_ANNOTATION,
+    ProfileController,
+    WorkloadIdentityPlugin,
+)
+from kubeflow_tpu.controlplane.kfam import Binding, Kfam, KfamError, PermissionDenied
+from kubeflow_tpu.controlplane.runtime import Manager
+from kubeflow_tpu.controlplane.store import NotFound, Store
+
+
+def mk_profile(name="alice", owner="alice@example.com", quota=None):
+    p = Profile()
+    p.metadata.name = name
+    p.spec.owner = owner
+    if quota:
+        p.spec.resource_quota = quota
+    return p
+
+
+@pytest.fixture()
+def env():
+    store = Store()
+    mgr = Manager(store)
+    mgr.register(ProfileController(
+        default_namespace_labels={"istio-injection": "enabled"},
+        plugins=[WorkloadIdentityPlugin()],
+    ))
+    mgr.start()
+    yield store, mgr
+    mgr.stop()
+
+
+def test_profile_materializes_tenancy(env):
+    store, mgr = env
+    store.create(mk_profile(quota={"cpu": "32", "tpu/v5e-chips": "16"}))
+    assert mgr.wait_idle()
+    ns = store.get("Namespace", "", "alice")
+    assert ns.metadata.annotations[OWNER_ANNOTATION] == "alice@example.com"
+    assert ns.metadata.labels["istio-injection"] == "enabled"
+    assert store.get("ServiceAccount", "alice", "default-editor")
+    assert store.get("ServiceAccount", "alice", "default-viewer")
+    rb = store.get("RoleBinding", "alice", "namespace-admin")
+    assert rb.subjects == ["alice@example.com"]
+    ap = store.get("AuthorizationPolicy", "alice", "ns-owner-access")
+    assert "alice@example.com" in ap.allow_users
+    rq = store.get("ResourceQuota", "alice", "kf-resource-quota")
+    assert rq.hard["tpu/v5e-chips"] == "16"
+    # workload identity plugin annotated the editor SA
+    sa = store.get("ServiceAccount", "alice", "default-editor")
+    assert sa.metadata.annotations[WorkloadIdentityPlugin.SA_ANNOTATION] == (
+        "alice@project.iam.gserviceaccount.com")
+    assert store.get("Profile", "", "alice").status.phase == "Ready"
+
+
+def test_profile_delete_cleans_namespace(env):
+    store, mgr = env
+    store.create(mk_profile())
+    assert mgr.wait_idle()
+    store.delete("Profile", "", "alice")
+    assert mgr.wait_idle()
+    assert store.try_get("Profile", "", "alice") is None
+    assert store.try_get("Namespace", "", "alice") is None
+    assert store.try_get("ServiceAccount", "alice", "default-editor") is None
+
+
+def test_foreign_namespace_not_adopted(env):
+    store, mgr = env
+    from kubeflow_tpu.api.core import Namespace
+
+    ns = Namespace()
+    ns.metadata.name = "taken"
+    ns.metadata.annotations[OWNER_ANNOTATION] = "mallory@example.com"
+    store.create(ns)
+    store.create(mk_profile("taken", owner="alice@example.com"))
+    assert mgr.wait_idle()
+    p = store.get("Profile", "", "taken")
+    assert p.status.phase == "Failed"
+    assert "not owned" in p.status.message
+
+
+def test_kfam_contributor_flow(env):
+    store, mgr = env
+    store.create(mk_profile())
+    assert mgr.wait_idle()
+    kfam = Kfam(store)
+    owner = User("alice@example.com")
+    bob = User("bob@example.com")
+
+    # owner adds bob as editor
+    kfam.create_binding(owner, Binding("bob@example.com", "alice", "edit"))
+    listed = kfam.list_bindings(owner, "alice")
+    assert Binding("bob@example.com", "alice", "edit") in listed
+    ap = store.get("AuthorizationPolicy", "alice", "ns-owner-access")
+    assert "bob@example.com" in ap.allow_users
+
+    # bob (not owner/admin) cannot add carol
+    with pytest.raises(PermissionDenied):
+        kfam.create_binding(bob, Binding("carol@example.com", "alice", "view"))
+
+    # bob can edit resources in alice's namespace now
+    ensure_authorized(store, bob, "create", "Notebook", "alice")
+    with pytest.raises(Forbidden):
+        ensure_authorized(store, User("carol@example.com"), "get",
+                          "Notebook", "alice")
+
+    # remove bob: authz falls back to forbidden
+    kfam.delete_binding(owner, Binding("bob@example.com", "alice", "edit"))
+    with pytest.raises(Forbidden):
+        ensure_authorized(store, bob, "create", "Notebook", "alice")
+    ap = store.get("AuthorizationPolicy", "alice", "ns-owner-access")
+    assert "bob@example.com" not in ap.allow_users
+
+
+def test_kfam_validation(env):
+    store, mgr = env
+    store.create(mk_profile())
+    assert mgr.wait_idle()
+    kfam = Kfam(store)
+    owner = User("alice@example.com")
+    with pytest.raises(KfamError, match="unknown role"):
+        kfam.create_binding(owner, Binding("bob@example.com", "alice", "root"))
+    with pytest.raises(KfamError, match="invalid user"):
+        kfam.create_binding(owner, Binding("not an email", "alice", "edit"))
+
+
+def test_kfam_cluster_admin(env):
+    store, mgr = env
+    store.create(mk_profile())
+    assert mgr.wait_idle()
+    kfam = Kfam(store, cluster_admins={"root@example.com"})
+    root = User("root@example.com")
+    assert kfam.is_cluster_admin(root)
+    assert not kfam.is_cluster_admin(User("alice@example.com"))
+    # admin can create profiles for others and manage any namespace
+    kfam.create_profile(root, "bobspace", owner="bob@example.com")
+    assert mgr.wait_idle()
+    kfam.create_binding(root, Binding("carol@example.com", "alice", "view"))
+
+
+def test_viewer_cannot_write(env):
+    store, mgr = env
+    store.create(mk_profile())
+    assert mgr.wait_idle()
+    kfam = Kfam(store)
+    owner = User("alice@example.com")
+    kfam.create_binding(owner, Binding("carol@example.com", "alice", "view"))
+    carol = User("carol@example.com")
+    ensure_authorized(store, carol, "list", "Notebook", "alice")
+    with pytest.raises(Forbidden):
+        ensure_authorized(store, carol, "delete", "Notebook", "alice")
+
+
+def test_namespaces_for_and_authn(env):
+    store, mgr = env
+    store.create(mk_profile())
+    store.create(mk_profile("bob", owner="bob@example.com"))
+    assert mgr.wait_idle()
+    kfam = Kfam(store)
+    kfam.create_binding(User("alice@example.com"),
+                        Binding("bob@example.com", "alice", "edit"))
+    assert namespaces_for(store, User("bob@example.com")) == ["alice", "bob"]
+    assert namespaces_for(
+        store, User("root@x.com"), cluster_admins={"root@x.com"}
+    ) == ["alice", "bob"]
+
+    u = authenticate({"kubeflow-userid": "x@y.z"})
+    assert u.name == "x@y.z"
+    with pytest.raises(Unauthenticated):
+        authenticate({})
+
+
+def test_csrf():
+    t = new_csrf_token()
+    assert check_csrf(t, t)
+    assert not check_csrf(t, new_csrf_token())
+    assert not check_csrf(None, t)
+    assert not check_csrf(t, None)
+
+
+def test_reserved_namespace_rejected(env):
+    """Privilege-escalation guard: self-serve profile cannot claim system
+    namespaces (owning kubeflow-tpu-system would mint cluster admins)."""
+    store, mgr = env
+    kfam = Kfam(store)
+    attacker = User("mallory@example.com")
+    for name in ("kubeflow-tpu-system", "kube-system", "default",
+                 "kubeflow-tpu-anything"):
+        with pytest.raises(PermissionDenied, match="reserved"):
+            kfam.create_profile(attacker, name)
+    # direct CR creation (bypassing kfam) is also neutralized
+    store.create(mk_profile("kubeflow-tpu-system", owner="mallory@example.com"))
+    assert mgr.wait_idle()
+    p = store.get("Profile", "", "kubeflow-tpu-system")
+    assert p.status.phase == "Failed"
+    assert store.try_get("RoleBinding", "kubeflow-tpu-system",
+                         "namespace-admin") is None
+    from kubeflow_tpu.controlplane.auth import is_cluster_admin
+    assert not is_cluster_admin(store, attacker)
