@@ -12,7 +12,13 @@ from dataclasses import dataclass, field
 
 from kubeflow_tpu.controlplane.controllers.culler import ActivityProbe, Culler
 from kubeflow_tpu.controlplane.controllers.notebook import NotebookController
+from kubeflow_tpu.controlplane.controllers.profile import (
+    ProfileController,
+    WorkloadIdentityPlugin,
+)
+from kubeflow_tpu.controlplane.controllers.tensorboard import TensorboardController
 from kubeflow_tpu.controlplane.controllers.workload import (
+    DeploymentController,
     NodePool,
     Scheduler,
     StatefulSetController,
@@ -30,6 +36,9 @@ class ClusterConfig:
     cull_idle_time: float = 1440 * 60.0
     cull_check_period: float = 60.0
     activity_probe: ActivityProbe | None = None
+    default_namespace_labels: dict[str, str] = field(default_factory=dict)
+    enable_workload_identity: bool = False
+    cluster_admins: set[str] = field(default_factory=set)
 
 
 class Cluster:
@@ -47,8 +56,20 @@ class Cluster:
             use_routing=self.config.use_routing
         )
         self.statefulset_controller = StatefulSetController(self.scheduler)
+        self.profile_controller = ProfileController(
+            default_namespace_labels=self.config.default_namespace_labels,
+            plugins=([WorkloadIdentityPlugin()]
+                     if self.config.enable_workload_identity else []),
+        )
+        self.tensorboard_controller = TensorboardController(
+            use_routing=self.config.use_routing
+        )
+        self.deployment_controller = DeploymentController()
         self.manager.register(self.notebook_controller)
         self.manager.register(self.statefulset_controller)
+        self.manager.register(self.profile_controller)
+        self.manager.register(self.tensorboard_controller)
+        self.manager.register(self.deployment_controller)
         self.culler = None
         if self.config.enable_culling and self.config.activity_probe is not None:
             self.culler = Culler(
@@ -57,6 +78,19 @@ class Cluster:
                 check_period=self.config.cull_check_period,
             )
             self.manager.register(self.culler)
+
+    @property
+    def cluster_admins(self) -> set[str]:
+        return set(self.config.cluster_admins)
+
+    def create_web_app(self, **kwargs):
+        """The platform web app wired to this cluster (admins included) —
+        use this instead of calling create_platform_app by hand so
+        ClusterConfig.cluster_admins actually takes effect."""
+        from kubeflow_tpu.web.platform import create_platform_app
+
+        kwargs.setdefault("cluster_admins", self.cluster_admins)
+        return create_platform_app(self.store, **kwargs)
 
     def start(self) -> "Cluster":
         self.manager.start()

@@ -2,8 +2,14 @@
 
 from kubeflow_tpu.controlplane.controllers.notebook import NotebookController
 from kubeflow_tpu.controlplane.controllers.workload import (
+    DeploymentController,
     StatefulSetController,
     Scheduler,
     NodePool,
 )
 from kubeflow_tpu.controlplane.controllers.culler import Culler, ActivityProbe
+from kubeflow_tpu.controlplane.controllers.profile import (
+    ProfileController,
+    WorkloadIdentityPlugin,
+)
+from kubeflow_tpu.controlplane.controllers.tensorboard import TensorboardController

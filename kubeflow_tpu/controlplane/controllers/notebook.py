@@ -56,6 +56,7 @@ TOPOLOGY_NODE_SELECTOR = "kubeflow-tpu.dev/slice-topology"
 class NotebookController(Controller):
     KIND = "Notebook"
     OWNS = ("StatefulSet", "Service", "VirtualService")
+    WATCHES = ("Event",)   # re-emit pod/STS warnings onto the CR (ref :94-118)
 
     def __init__(self, *, use_routing: bool = True,
                  culling_check_period: float | None = None):
@@ -245,14 +246,19 @@ class NotebookController(Controller):
             (e.reason, e.message)
             for e in store.events_for("Notebook", ns, name)
         }
-        for pod in store.list("Pod", ns, label_selector={NOTEBOOK_NAME_LABEL: name}):
-            for ev in store.events_for("Pod", ns, pod.metadata.name):
-                if ev.type != "Warning":
-                    continue
-                if (ev.reason, ev.message) in existing:
-                    continue
-                store.emit_event(nb, "Warning", ev.reason, ev.message)
-                existing.add((ev.reason, ev.message))
+        sources = [
+            ev
+            for pod in store.list("Pod", ns,
+                                  label_selector={NOTEBOOK_NAME_LABEL: name})
+            for ev in store.events_for("Pod", ns, pod.metadata.name)
+        ] + store.events_for("StatefulSet", ns, name)
+        for ev in sources:
+            if ev.type != "Warning":
+                continue
+            if (ev.reason, ev.message) in existing:
+                continue
+            store.emit_event(nb, "Warning", ev.reason, ev.message)
+            existing.add((ev.reason, ev.message))
 
 
 def _clone(obj):

@@ -1,0 +1,190 @@
+"""Tensorboard controller: Tensorboard CR → Deployment + Service + route.
+
+Re-design of the reference's tensorboard-controller
+(controllers/tensorboard_controller.go:67-149):
+- logspath dispatch (generateDeployment :159-284):
+    pvc://<name>/<subpath>  → mount that PVC at /logs (ref :170-223)
+    gs://bucket/path        → mount the user-gcp-sa secret + pass the
+                              GCS path straight to tensorboard (ref
+                              :224-239) — the TPU-first default, since
+                              TPU training writes Orbax/TensorBoard
+                              events to GCS
+    anything else           → legacy tb-volume PVC (ref :240+)
+- image from TENSORBOARD_IMAGE env (ref :164); port 6006 (ref :273);
+- VirtualService prefix /tensorboard/<ns>/<name>/ (ref :306-358);
+- RWO-PVC co-scheduling via node affinity with the pod already mounting
+  the PVC, gated by RWO_PVC_SCHEDULING (ref :408-451, :456-466) — the
+  reference's only placement-aware code, kept because it generalizes to
+  ICI-topology placement;
+- Deployment conditions mirrored into CR status (ref :113-146).
+"""
+
+from __future__ import annotations
+
+import os
+
+from kubeflow_tpu.api.core import (
+    Container,
+    Deployment,
+    DeploymentSpec,
+    EnvVar,
+    HTTPRoute,
+    NodeSelectorTerm,
+    PodTemplateSpec,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    VirtualService,
+    VirtualServiceSpec,
+    Volume,
+    VolumeMount,
+)
+from kubeflow_tpu.api.crds import Tensorboard
+from kubeflow_tpu.controlplane.controllers.helpers import (
+    copy_spec_and_labels,
+    reconcile_child,
+)
+from kubeflow_tpu.controlplane.runtime import Controller, Result
+from kubeflow_tpu.controlplane.store import NotFound, Store
+
+DEFAULT_IMAGE = "tensorflow/tensorflow:2.16.1"   # env-overridable (ref :164)
+TB_PORT = 6006
+TB_NAME_LABEL = "tensorboard-name"
+
+
+class TensorboardController(Controller):
+    KIND = "Tensorboard"
+    OWNS = ("Deployment", "Service", "VirtualService")
+
+    def __init__(self, *, use_routing: bool = True,
+                 rwo_pvc_scheduling: bool | None = None):
+        self.use_routing = use_routing
+        if rwo_pvc_scheduling is None:
+            rwo_pvc_scheduling = (
+                os.environ.get("RWO_PVC_SCHEDULING", "false") == "true"
+            )
+        self.rwo_pvc_scheduling = rwo_pvc_scheduling
+
+    def reconcile(self, store: Store, namespace: str, name: str) -> Result:
+        try:
+            tb = store.get("Tensorboard", namespace, name)
+        except NotFound:
+            return Result()
+        assert isinstance(tb, Tensorboard)
+
+        dep = self._desired_deployment(store, tb)
+        reconcile_child(store, tb, dep, copy_spec_and_labels)
+        svc = self._desired_service(tb)
+        reconcile_child(store, tb, svc, copy_spec_and_labels)
+        if self.use_routing:
+            vs = self._desired_virtualservice(tb)
+            reconcile_child(store, tb, vs, copy_spec_and_labels)
+
+        cur_dep = store.try_get("Deployment", namespace, name)
+        ready = bool(cur_dep and cur_dep.ready_replicas >= 1)
+        fresh = store.try_get("Tensorboard", namespace, name)
+        if fresh is not None and fresh.status.ready != ready:
+            fresh.status.ready = ready
+            fresh.status.conditions = list(cur_dep.conditions) if cur_dep else []
+            store.update(fresh)
+        return Result()
+
+    def _desired_deployment(self, store: Store, tb: Tensorboard) -> Deployment:
+        name, ns = tb.metadata.name, tb.metadata.namespace
+        logspath = tb.spec.logspath
+        volumes: list[Volume] = []
+        mounts: list[VolumeMount] = []
+        affinity: list[NodeSelectorTerm] = []
+        logdir = logspath
+
+        if logspath.startswith("pvc://"):
+            rest = logspath[len("pvc://"):]
+            pvc_name, _, sub_path = rest.partition("/")
+            volumes.append(Volume(name="tb-logs", pvc_name=pvc_name))
+            mounts.append(VolumeMount(name="tb-logs", mount_path="/logs",
+                                      sub_path=sub_path))
+            logdir = "/logs"
+            if self.rwo_pvc_scheduling:
+                affinity = self._rwo_affinity(store, ns, pvc_name)
+        elif logspath.startswith("gs://"):
+            # GCS-native (the TPU-first default): creds via secret mount
+            volumes.append(Volume(name="gcp-creds", secret="user-gcp-sa"))
+            mounts.append(VolumeMount(name="gcp-creds", mount_path="/secret/gcp"))
+        else:
+            volumes.append(Volume(name="tb-volume", pvc_name="tb-volume"))
+            mounts.append(VolumeMount(name="tb-volume", mount_path="/logs",
+                                      sub_path=logspath.lstrip("/")))
+            logdir = "/logs"
+
+        container = Container(
+            name=name,
+            image=os.environ.get("TENSORBOARD_IMAGE", DEFAULT_IMAGE),
+            command=["/usr/local/bin/tensorboard"],
+            args=[f"--logdir={logdir}", f"--port={TB_PORT}",
+                  "--bind_all"],
+            ports=[TB_PORT],
+            volume_mounts=mounts,
+        )
+        if logspath.startswith("gs://"):
+            container.env.append(EnvVar(
+                "GOOGLE_APPLICATION_CREDENTIALS",
+                "/secret/gcp/user-gcp-sa.json",
+            ))
+
+        dep = Deployment(
+            spec=DeploymentSpec(
+                replicas=1,
+                selector={TB_NAME_LABEL: name},
+                template=PodTemplateSpec(),
+            )
+        )
+        dep.spec.template.metadata.labels = {TB_NAME_LABEL: name}
+        dep.spec.template.spec.containers = [container]
+        dep.spec.template.spec.volumes = volumes
+        dep.spec.template.spec.affinity_terms = affinity
+        dep.metadata.name = name
+        dep.metadata.namespace = ns
+        dep.metadata.labels = {TB_NAME_LABEL: name}
+        return dep
+
+    def _rwo_affinity(self, store: Store, namespace: str,
+                      pvc_name: str) -> list[NodeSelectorTerm]:
+        """Schedule next to the pod already mounting the RWO PVC
+        (ref generateNodeAffinity :408-451: field-selector pod listing
+        by claim)."""
+        for pod in store.list("Pod", namespace):
+            if any(v.pvc_name == pvc_name for v in pod.spec.volumes):
+                if pod.host_ip:
+                    return [NodeSelectorTerm(key="kubernetes.io/hostname",
+                                             values=[pod.host_ip])]
+        return []
+
+    def _desired_service(self, tb: Tensorboard) -> Service:
+        name, ns = tb.metadata.name, tb.metadata.namespace
+        svc = Service(
+            spec=ServiceSpec(
+                selector={TB_NAME_LABEL: name},
+                ports=[ServicePort("http", 80, TB_PORT)],
+            )
+        )
+        svc.metadata.name = name
+        svc.metadata.namespace = ns
+        return svc
+
+    def _desired_virtualservice(self, tb: Tensorboard) -> VirtualService:
+        name, ns = tb.metadata.name, tb.metadata.namespace
+        vs = VirtualService(
+            spec=VirtualServiceSpec(
+                gateways=["kubeflow-gateway"],
+                hosts=["*"],
+                http=[HTTPRoute(
+                    prefix=f"/tensorboard/{ns}/{name}/",
+                    rewrite="/",
+                    destination_host=f"{name}.{ns}.svc",
+                    destination_port=80,
+                )],
+            )
+        )
+        vs.metadata.name = f"tensorboard-{ns}-{name}"
+        vs.metadata.namespace = ns
+        return vs

@@ -179,6 +179,7 @@ class StatefulSetController(Controller):
                 p.phase = "Running"
                 p.ready = True
                 p.pod_ip = f"10.0.{abs(hash((namespace, p.metadata.name))) % 250}.{abs(hash(p.metadata.name)) % 250}"
+                p.host_ip = f"node-{abs(hash(p.metadata.name)) % 8}"
                 store.update(p)
                 changed = True
 
@@ -192,3 +193,101 @@ class StatefulSetController(Controller):
             fresh.ready_replicas = ready
             store.update(fresh)
         return Result()
+
+
+class DeploymentController(Controller):
+    """Deployment → pods (unordered, no gang). Serves the tensorboard
+    controller's Deployments the way the STS controller serves notebooks."""
+
+    KIND = "Deployment"
+    OWNS = ("Pod",)
+
+    def reconcile(self, store: Store, namespace: str, name: str) -> Result:
+        from kubeflow_tpu.api.core import Deployment
+
+        try:
+            dep = store.get("Deployment", namespace, name)
+        except NotFound:
+            return Result()
+        assert isinstance(dep, Deployment)
+        want = dep.spec.replicas
+        tmpl = dep.spec.template
+        tmpl_hash = _template_hash(tmpl)
+
+        owned = [
+            p for p in store.list("Pod", namespace)
+            if any(r.uid == dep.metadata.uid for r in p.metadata.owner_references)
+        ]
+        # Rolling replacement: pods from an older template are retired so
+        # a spec change (e.g. a Tensorboard's new --logdir) actually lands.
+        stale = [
+            p for p in owned
+            if p.metadata.annotations.get(TEMPLATE_HASH_ANNOTATION) != tmpl_hash
+        ]
+        for pod in stale:
+            try:
+                store.delete("Pod", namespace, pod.metadata.name)
+            except NotFound:
+                pass
+        owned = [p for p in owned if p not in stale]
+
+        for i in range(want - len(owned)):
+            pod = Pod(spec=tmpl.spec).clone()
+            pod.metadata.name = f"{name}-{uuid_suffix()}"
+            pod.metadata.namespace = namespace
+            pod.metadata.labels = dict(tmpl.metadata.labels)
+            pod.metadata.annotations = {
+                **tmpl.metadata.annotations,
+                TEMPLATE_HASH_ANNOTATION: tmpl_hash,
+            }
+            set_controller_reference(dep, pod)
+            try:
+                store.create(pod)
+            except AdmissionDenied as e:
+                store.emit_event(dep, "Warning", "AdmissionDenied", str(e))
+                return Result(requeue_after=2.0)
+            except AlreadyExists:
+                pass
+        for pod in owned[want:]:
+            try:
+                store.delete("Pod", namespace, pod.metadata.name)
+            except NotFound:
+                pass
+
+        ready = 0
+        for p in store.list("Pod", namespace):
+            if not any(r.uid == dep.metadata.uid
+                       for r in p.metadata.owner_references):
+                continue
+            if p.phase == "Pending":
+                p.phase = "Running"
+                p.ready = True
+                p.host_ip = f"node-{abs(hash(p.metadata.name)) % 8}"
+                store.update(p)
+            if p.phase == "Running":
+                ready += 1
+        fresh = store.try_get("Deployment", namespace, name)
+        if fresh is not None and fresh.ready_replicas != ready:
+            fresh.ready_replicas = ready
+            fresh.conditions = [{"type": "Available",
+                                 "status": str(ready >= want)}]
+            store.update(fresh)
+        return Result()
+
+
+TEMPLATE_HASH_ANNOTATION = "kubeflow-tpu.dev/template-hash"
+
+
+def _template_hash(tmpl) -> str:
+    import dataclasses
+    import hashlib
+    import json
+
+    blob = json.dumps(dataclasses.asdict(tmpl), sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def uuid_suffix() -> str:
+    import uuid as _uuid
+
+    return _uuid.uuid4().hex[:6]

@@ -168,7 +168,11 @@ class Manager:
                     if ref.kind == ctrl.KIND:
                         wq.add((obj.metadata.namespace, ref.name))
             elif obj.kind in ctrl.WATCHES:
-                for primary in self.store.list(ctrl.KIND):
+                # Scope the fan-out to the event object's namespace — a
+                # cluster-wide enqueue per watched object would make every
+                # Event O(all primaries).
+                ns = obj.metadata.namespace or None
+                for primary in self.store.list(ctrl.KIND, ns):
                     wq.add((primary.metadata.namespace, primary.metadata.name))
 
     # -- workers -----------------------------------------------------------

@@ -166,6 +166,13 @@ class Store:
             for o in list(self._objects.values())
             if any(r.uid == obj.metadata.uid for r in o.metadata.owner_references)
         ]
+        # Deleting a Namespace deletes everything namespaced inside it.
+        if obj.kind == "Namespace":
+            owned += [
+                o.key
+                for o in list(self._objects.values())
+                if o.metadata.namespace == obj.metadata.name
+            ]
         for k, ns, n in owned:
             try:
                 self.delete(k, ns, n)

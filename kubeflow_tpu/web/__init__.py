@@ -1,0 +1,6 @@
+"""Web/API surface (reference L5): spawner, volumes, tensorboards CRUD
+apps + central dashboard BFF + KFAM REST — aiohttp apps sharing one
+authn/authz/CSRF middleware stack (the reference's crud_backend common
+layer re-done for asyncio)."""
+
+from kubeflow_tpu.web.platform import create_platform_app

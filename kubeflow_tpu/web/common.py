@@ -1,0 +1,130 @@
+"""Shared web layer: authn middleware, authz helpers, CSRF, errors.
+
+The asyncio re-design of the reference's crud_backend package
+(crud-web-apps/common/backend/kubeflow/kubeflow/crud_backend/):
+- header authn middleware (authn.py:12-67);
+- per-request SAR-style authz via controlplane.auth (authz.py:113-132
+  decorator → here a helper called by handlers);
+- CSRF double-submit cookie for non-GET (csrf.py:57-111);
+- uniform JSON errors + success envelopes, healthz/readyz probes
+  (probes.py).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any
+
+from aiohttp import web
+
+from kubeflow_tpu.controlplane import auth
+from kubeflow_tpu.controlplane.store import (
+    AdmissionDenied,
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    Store,
+)
+
+log = logging.getLogger(__name__)
+
+AUTH_EXEMPT = {"/healthz", "/readyz", "/metrics"}
+
+
+def json_success(payload: dict[str, Any] | None = None, status: int = 200):
+    body = {"success": True, "status": status}
+    if payload:
+        body.update(payload)
+    return web.json_response(body, status=status)
+
+
+def json_error(message: str, status: int):
+    return web.json_response(
+        {"success": False, "status": status, "log": message}, status=status
+    )
+
+
+@web.middleware
+async def error_middleware(request: web.Request, handler):
+    try:
+        return await handler(request)
+    except web.HTTPException:
+        raise
+    except auth.Unauthenticated as e:
+        return json_error(str(e), 401)
+    except auth.Forbidden as e:
+        return json_error(str(e), 403)
+    except NotFound as e:
+        return json_error(str(e), 404)
+    except (AlreadyExists, Conflict) as e:
+        return json_error(str(e), 409)
+    except AdmissionDenied as e:
+        return json_error(str(e), 422)
+    except (KeyError, ValueError, json.JSONDecodeError) as e:
+        return json_error(f"bad request: {e}", 400)
+    except Exception:
+        log.exception("unhandled error for %s", request.path)
+        return json_error("internal error", 500)
+
+
+@web.middleware
+async def authn_middleware(request: web.Request, handler):
+    if request.path in AUTH_EXEMPT:
+        return await handler(request)
+    request["user"] = auth.authenticate(request.headers)
+    return await handler(request)
+
+
+@web.middleware
+async def csrf_middleware(request: web.Request, handler):
+    # Parent-app middlewares wrap subapp requests too; service APIs
+    # (mesh-internal, no browser) opt out by prefix.
+    for prefix in request.app.get("csrf_exempt_prefixes", ()):
+        if request.path.startswith(prefix):
+            return await handler(request)
+    if request.method in ("GET", "HEAD", "OPTIONS"):
+        resp = await handler(request)
+        # hand the SPA a token to echo back (double-submit)
+        if auth.CSRF_COOKIE not in request.cookies:
+            try:
+                resp.set_cookie(auth.CSRF_COOKIE, auth.new_csrf_token(),
+                                httponly=False, samesite="Strict")
+            except AttributeError:
+                pass
+        return resp
+    if request.path in AUTH_EXEMPT:
+        return await handler(request)
+    cookie = request.cookies.get(auth.CSRF_COOKIE)
+    header = request.headers.get(auth.CSRF_HEADER)
+    if not auth.check_csrf(cookie, header):
+        return json_error("CSRF token missing or mismatched", 403)
+    return await handler(request)
+
+
+def add_probes(app: web.Application) -> None:
+    async def ok(_request):
+        return web.json_response({"status": "ok"})
+
+    app.router.add_get("/healthz", ok)
+    app.router.add_get("/readyz", ok)
+
+
+def base_app(store: Store, *, csrf: bool = True) -> web.Application:
+    middlewares = [error_middleware, authn_middleware]
+    if csrf:
+        middlewares.append(csrf_middleware)
+    app = web.Application(middlewares=middlewares)
+    app["store"] = store
+    add_probes(app)
+    return app
+
+
+def ensure_authorized(request: web.Request, verb: str, kind: str,
+                      namespace: str) -> auth.User:
+    user: auth.User = request["user"]
+    store: Store = request.app["store"]
+    admins = request.app.get("cluster_admins") or set()
+    auth.ensure_authorized(store, user, verb, kind, namespace,
+                           cluster_admins=admins)
+    return user

@@ -1,0 +1,141 @@
+"""Central dashboard BFF (ref components/centraldashboard).
+
+Aggregation endpoints the Polymer SPA calls (app/api.ts:29-102,
+api_workgroup.ts:255-391), re-done over the in-process store + Kfam:
+- /api/workgroup/env-info   — identity, namespaces, clusterAdmin flag,
+  platform metadata (getProfileAwareEnv :134-158);
+- /api/workgroup/exists     — has the user a profile? (registration flow)
+- /api/workgroup/create     — self-serve profile creation
+- /api/namespaces, /api/activities/{ns} (events), /api/dashboard-links,
+  /api/metrics/{type} (TPU utilization summary replaces Stackdriver).
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from kubeflow_tpu.controlplane import auth
+from kubeflow_tpu.controlplane.kfam import Kfam, KfamError
+from kubeflow_tpu.controlplane.store import Store
+from kubeflow_tpu.web.common import base_app, json_error, json_success
+
+DEFAULT_LINKS = {
+    "menuLinks": [
+        {"link": "/jupyter/", "text": "Notebooks"},
+        {"link": "/tensorboards/", "text": "TensorBoards"},
+        {"link": "/volumes/", "text": "Volumes"},
+    ],
+    "externalLinks": [],
+    "quickLinks": [
+        {"desc": "Create a new Notebook server", "link": "/jupyter/new"},
+        {"desc": "View TPU slice usage", "link": "/metrics"},
+    ],
+    "documentationItems": [],
+}
+
+
+def create_dashboard_app(store: Store, *, cluster_admins: set[str] | None = None,
+                         links: dict | None = None,
+                         csrf: bool = True) -> web.Application:
+    app = base_app(store, csrf=csrf)
+    app["kfam"] = Kfam(store, cluster_admins)
+    app["cluster_admins"] = cluster_admins or set()
+    app["links"] = links or DEFAULT_LINKS
+
+    app.router.add_get("/api/workgroup/env-info", env_info)
+    app.router.add_get("/api/workgroup/exists", workgroup_exists)
+    app.router.add_post("/api/workgroup/create", workgroup_create)
+    app.router.add_get("/api/namespaces", list_namespaces)
+    app.router.add_get("/api/activities/{ns}", activities)
+    app.router.add_get("/api/dashboard-links", dashboard_links)
+    app.router.add_get("/api/metrics/{type}", metrics)
+    return app
+
+
+async def env_info(request: web.Request):
+    store: Store = request.app["store"]
+    kfam: Kfam = request.app["kfam"]
+    user: auth.User = request["user"]
+    namespaces = auth.namespaces_for(store, user, request.app["cluster_admins"])
+    profiles = [p.metadata.name for p in store.list("Profile")
+                if p.spec.owner == user.name]
+    return json_success({
+        "user": user.name,
+        "platform": {
+            "kind": "kubeflow-tpu",
+            "provider": "tpu",
+            "namespaces": len(store.list("Namespace")),
+        },
+        "namespaces": namespaces,
+        "ownedNamespaces": profiles,
+        "isClusterAdmin": kfam.is_cluster_admin(user),
+    })
+
+
+async def workgroup_exists(request: web.Request):
+    store: Store = request.app["store"]
+    user: auth.User = request["user"]
+    owned = [p for p in store.list("Profile") if p.spec.owner == user.name]
+    return json_success({"hasWorkgroup": bool(owned),
+                         "user": user.name})
+
+
+async def workgroup_create(request: web.Request):
+    kfam: Kfam = request.app["kfam"]
+    user: auth.User = request["user"]
+    body = await request.json() if request.can_read_body else {}
+    name = body.get("namespace") or user.name.split("@")[0]
+    try:
+        kfam.create_profile(user, name)
+    except KfamError as e:
+        return json_error(str(e), e.status)
+    return json_success({"namespace": name}, status=201)
+
+
+async def list_namespaces(request: web.Request):
+    store: Store = request.app["store"]
+    user: auth.User = request["user"]
+    return json_success({
+        "namespaces": auth.namespaces_for(
+            store, user, request.app["cluster_admins"])
+    })
+
+
+async def activities(request: web.Request):
+    ns = request.match_info["ns"]
+    from kubeflow_tpu.web.common import ensure_authorized
+
+    ensure_authorized(request, "list", "Event", ns)
+    store: Store = request.app["store"]
+    events = sorted(store.list("Event", ns), key=lambda e: -e.timestamp)[:50]
+    return json_success({
+        "activities": [
+            {"kind": e.involved_kind, "name": e.involved_name,
+             "type": e.type, "reason": e.reason, "message": e.message,
+             "time": e.timestamp}
+            for e in events
+        ]
+    })
+
+
+async def dashboard_links(request: web.Request):
+    return json_success({"links": request.app["links"]})
+
+
+async def metrics(request: web.Request):
+    """TPU-native replacement for the Stackdriver charts
+    (stackdriver_metrics_service.ts): summarize slice allocation from
+    live pods."""
+    store: Store = request.app["store"]
+    from kubeflow_tpu.controlplane import webhook as wh
+
+    by_topo: dict[str, int] = {}
+    for pod in store.list("Pod"):
+        topo = pod.metadata.labels.get(wh.TOPOLOGY_LABEL)
+        if topo and pod.phase == "Running":
+            by_topo[topo] = by_topo.get(topo, 0) + 1
+    return json_success({
+        "type": request.match_info["type"],
+        "tpuHostsInUse": by_topo,
+        "notebooks": len(store.list("Notebook")),
+    })

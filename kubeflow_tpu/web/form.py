@@ -1,0 +1,204 @@
+"""Spawner form engine: admin config with value/readOnly semantics.
+
+Re-design of the reference JWA's form layer
+(jupyter/backend/apps/common/form.py:16-60 + spawner_ui_config.yaml):
+- every form section has {value, readOnly}: readOnly pins the admin
+  value; otherwise the user's value wins, falling back to the default;
+- the GPU vendor picker (utils.py:56-85) becomes a TPU slice picker:
+  the config lists allowed slice topologies (validated against the
+  topology table) and a default parallelism mesh per topology;
+- notebook construction fills a template Notebook CR the way
+  post.py:27-36 calls form.set_notebook_* setters.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from kubeflow_tpu.api.core import (
+    Container,
+    PodTemplateSpec,
+    Toleration,
+    Volume,
+    VolumeMount,
+)
+from kubeflow_tpu.api.crds import Notebook
+from kubeflow_tpu.parallel.mesh import SLICE_TOPOLOGIES
+
+
+class FormError(ValueError):
+    pass
+
+
+DEFAULT_SPAWNER_CONFIG: dict[str, Any] = {
+    "image": {
+        "value": "kubeflow-tpu/jupyter-jax:latest",
+        "options": [
+            "kubeflow-tpu/jupyter-jax:latest",       # jax[tpu] + pallas
+            "kubeflow-tpu/jupyter-jax-full:latest",  # + flax/optax/orbax etc.
+            "kubeflow-tpu/codeserver-jax:latest",
+            "kubeflow-tpu/rstudio:latest",
+        ],
+        "readOnly": False,
+    },
+    "cpu": {"value": "0.5", "limitFactor": 1.2, "readOnly": False},
+    "memory": {"value": "1.0Gi", "limitFactor": 1.2, "readOnly": False},
+    # TPU slice picker (replaces the reference's `gpus` vendor block)
+    "tpu": {
+        "value": {"topology": "", "mesh": ""},
+        "options": ["", "v5e-1", "v5e-8", "v5e-16", "v5e-32"],
+        "readOnly": False,
+    },
+    "workspaceVolume": {
+        "value": {"name": "{notebook-name}-workspace", "size": "5Gi",
+                  "mountPath": "/home/jovyan"},
+        "readOnly": False,
+    },
+    "dataVolumes": {"value": [], "readOnly": False},
+    "tolerations": {"value": [], "readOnly": False},
+    "shm": {"value": True, "readOnly": False},
+    "configurations": {"value": [], "readOnly": False},  # TpuPodDefault names
+}
+
+
+def get_form_value(body: dict, config: dict, field_name: str,
+                   body_field: str | None = None) -> Any:
+    """ref form.py:16-60: readOnly pins config; else user value or default."""
+    section = config.get(field_name, {})
+    if section.get("readOnly"):
+        return section.get("value")
+    return body.get(body_field or field_name, section.get("value"))
+
+
+@dataclass
+class NotebookForm:
+    name: str
+    namespace: str
+    image: str
+    cpu: str
+    memory: str
+    tpu_topology: str
+    tpu_mesh: str
+    workspace: dict | None
+    data_volumes: list[dict] = field(default_factory=list)
+    tolerations: list[dict] = field(default_factory=list)
+    shm: bool = True
+    configurations: list[str] = field(default_factory=list)
+
+
+def parse_form(body: dict, config: dict[str, Any] | None = None) -> NotebookForm:
+    config = config or DEFAULT_SPAWNER_CONFIG
+    name = body.get("name", "")
+    namespace = body.get("namespace", "")
+    if not name or not namespace:
+        raise FormError("name and namespace are required")
+
+    image = get_form_value(body, config, "image")
+    options = config.get("image", {}).get("options", [])
+    if options and image not in options and config["image"].get("readOnly"):
+        raise FormError(f"image {image!r} not in allowed options")
+
+    tpu = get_form_value(body, config, "tpu") or {}
+    topo = tpu.get("topology", "")
+    if topo and topo not in SLICE_TOPOLOGIES:
+        raise FormError(
+            f"unknown TPU topology {topo!r}; allowed: "
+            f"{config.get('tpu', {}).get('options')}"
+        )
+    allowed = config.get("tpu", {}).get("options")
+    if topo and allowed and topo not in allowed:
+        raise FormError(f"TPU topology {topo!r} not allowed by admin config")
+
+    ws = get_form_value(body, config, "workspaceVolume", "workspace")
+    if ws:
+        ws = dict(ws)
+        ws["name"] = ws.get("name", "").replace("{notebook-name}", name) or (
+            f"{name}-workspace"
+        )
+
+    return NotebookForm(
+        name=name,
+        namespace=namespace,
+        image=image,
+        cpu=str(get_form_value(body, config, "cpu")),
+        memory=str(get_form_value(body, config, "memory")),
+        tpu_topology=topo,
+        tpu_mesh=tpu.get("mesh", ""),
+        workspace=ws,
+        data_volumes=get_form_value(body, config, "dataVolumes", "datavols") or [],
+        tolerations=get_form_value(body, config, "tolerations") or [],
+        shm=bool(get_form_value(body, config, "shm")),
+        configurations=get_form_value(body, config, "configurations") or [],
+    )
+
+
+def build_notebook(form: NotebookForm, config: dict[str, Any] | None = None) -> Notebook:
+    """Template → Notebook CR (ref notebook_template.yaml + setters)."""
+    config = config or DEFAULT_SPAWNER_CONFIG
+    nb = Notebook()
+    nb.metadata.name = form.name
+    nb.metadata.namespace = form.namespace
+    nb.spec.tpu.topology = form.tpu_topology
+    nb.spec.tpu.mesh = form.tpu_mesh
+
+    limit_factor = float(config.get("cpu", {}).get("limitFactor", 1.2))
+    container = Container(name=form.name, image=form.image)
+    container.resources.requests = {"cpu": form.cpu, "memory": form.memory}
+    container.resources.limits = {
+        "cpu": f"{float(form.cpu) * limit_factor:g}",
+        "memory": form.memory,
+    }
+
+    tmpl = PodTemplateSpec()
+    tmpl.spec.containers.append(container)
+
+    if form.workspace:
+        tmpl.spec.volumes.append(
+            Volume(name=form.workspace["name"],
+                   pvc_name=form.workspace["name"])
+        )
+        container.volume_mounts.append(VolumeMount(
+            name=form.workspace["name"],
+            mount_path=form.workspace.get("mountPath", "/home/jovyan"),
+        ))
+    for dv in form.data_volumes:
+        vol_name = dv.get("name") or dv.get("pvc")
+        tmpl.spec.volumes.append(Volume(name=vol_name, pvc_name=vol_name))
+        container.volume_mounts.append(VolumeMount(
+            name=vol_name, mount_path=dv.get("mountPath", f"/data/{vol_name}"),
+        ))
+    if form.shm:
+        tmpl.spec.volumes.append(Volume(name="dshm", empty_dir=True,
+                                        size_limit="2Gi"))
+        container.volume_mounts.append(
+            VolumeMount(name="dshm", mount_path="/dev/shm"))
+    for t in form.tolerations:
+        tmpl.spec.tolerations.append(Toleration(
+            key=t.get("key", ""), value=t.get("value", ""),
+            effect=t.get("effect", ""),
+        ))
+    nb.spec.template = tmpl
+    return nb
+
+
+# -- status derivation (ref apps/common/status.py:9-99) ---------------------
+
+
+def notebook_status(nb: Notebook, events: list) -> dict[str, str]:
+    from kubeflow_tpu.api.crds import STOP_ANNOTATION
+
+    if STOP_ANNOTATION in nb.metadata.annotations:
+        if nb.status.ready_replicas == 0:
+            return {"phase": "stopped", "message": "Notebook is stopped."}
+        return {"phase": "terminating", "message": "Stopping the notebook."}
+    if nb.status.ready_replicas > 0 and nb.status.container_state == "running":
+        return {"phase": "ready", "message": "Running."}
+    # ref find_error_event :79-95 — newest warning explains the wait
+    warnings = sorted(
+        (e for e in events if e.type == "Warning"),
+        key=lambda e: e.timestamp, reverse=True,
+    )
+    if warnings:
+        return {"phase": "warning", "message": warnings[0].message}
+    return {"phase": "waiting", "message": "Starting the notebook."}

@@ -1,0 +1,152 @@
+"""Jupyter web app backend (JWA): notebook spawner/manager REST API.
+
+Re-design of crud-web-apps/jupyter/backend:
+- POST creates workspace/data PVCs then the Notebook CR, validating the
+  CR with a dry-run create FIRST so users get errors before any PVC is
+  made (ref post.py:48-54);
+- GET list summarizes status from CR + warning events (status.py);
+- PATCH stopped:true/false toggles the stop annotation (the culler
+  restart path);
+- config endpoint serves the admin spawner config (utils.py:22-53),
+  TPU slice picker included;
+- poddefaults endpoint lists selectable TpuPodDefaults (ref JWA lists
+  PodDefaults for the configurations picker).
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from kubeflow_tpu.api.core import PersistentVolumeClaim
+from kubeflow_tpu.api.crds import Notebook, STOP_ANNOTATION
+from kubeflow_tpu.controlplane.store import AlreadyExists, Store
+from kubeflow_tpu.web import form as form_lib
+from kubeflow_tpu.web.common import base_app, ensure_authorized, json_success
+
+
+def create_jupyter_app(store: Store, *, spawner_config=None,
+                       csrf: bool = True) -> web.Application:
+    app = base_app(store, csrf=csrf)
+    app["spawner_config"] = spawner_config or form_lib.DEFAULT_SPAWNER_CONFIG
+
+    app.router.add_get("/api/config", get_config)
+    app.router.add_get("/api/namespaces/{ns}/notebooks", list_notebooks)
+    app.router.add_post("/api/namespaces/{ns}/notebooks", post_notebook)
+    app.router.add_get("/api/namespaces/{ns}/notebooks/{name}", get_notebook)
+    app.router.add_delete("/api/namespaces/{ns}/notebooks/{name}", delete_notebook)
+    app.router.add_patch("/api/namespaces/{ns}/notebooks/{name}", patch_notebook)
+    app.router.add_get("/api/namespaces/{ns}/poddefaults", list_poddefaults)
+    return app
+
+
+async def get_config(request: web.Request):
+    return json_success({"config": request.app["spawner_config"]})
+
+
+def _summarize(store: Store, nb: Notebook) -> dict:
+    events = store.events_for(
+        "Notebook", nb.metadata.namespace, nb.metadata.name
+    )
+    status = form_lib.notebook_status(nb, events)
+    return {
+        "name": nb.metadata.name,
+        "namespace": nb.metadata.namespace,
+        "image": (nb.spec.template.spec.containers[0].image
+                  if nb.spec.template.spec.containers else ""),
+        "tpu": {"topology": nb.spec.tpu.topology, "mesh": nb.spec.tpu.mesh},
+        "status": status,
+        "readyReplicas": nb.status.ready_replicas,
+        "serverUrl": f"/notebook/{nb.metadata.namespace}/{nb.metadata.name}/",
+    }
+
+
+async def list_notebooks(request: web.Request):
+    ns = request.match_info["ns"]
+    ensure_authorized(request, "list", "Notebook", ns)
+    store: Store = request.app["store"]
+    return json_success({
+        "notebooks": [_summarize(store, nb) for nb in store.list("Notebook", ns)]
+    })
+
+
+async def get_notebook(request: web.Request):
+    ns, name = request.match_info["ns"], request.match_info["name"]
+    ensure_authorized(request, "get", "Notebook", ns)
+    store: Store = request.app["store"]
+    nb = store.get("Notebook", ns, name)
+    return json_success({"notebook": _summarize(store, nb)})
+
+
+async def post_notebook(request: web.Request):
+    ns = request.match_info["ns"]
+    ensure_authorized(request, "create", "Notebook", ns)
+    store: Store = request.app["store"]
+    body = await request.json()
+    body["namespace"] = ns
+    form = form_lib.parse_form(body, request.app["spawner_config"])
+    nb = form_lib.build_notebook(form, request.app["spawner_config"])
+
+    # Selected configurations: adopt each TpuPodDefault's selector labels
+    # on the pod template so the admission webhook matches it (the JWA
+    # copies PodDefault matchLabels the same way).
+    for conf in form.configurations:
+        pd = store.get("TpuPodDefault", ns, conf)
+        nb.spec.template.metadata.labels.update(pd.spec.selector)
+
+    # dry-run validate the CR before creating PVCs (ref post.py:48-54)
+    store.create(nb, dry_run=True)
+
+    for vol in nb.spec.template.spec.volumes:
+        if not vol.pvc_name:
+            continue
+        if store.try_get("PersistentVolumeClaim", ns, vol.pvc_name) is None:
+            pvc = PersistentVolumeClaim()
+            pvc.metadata.name = vol.pvc_name
+            pvc.metadata.namespace = ns
+            if form.workspace and vol.pvc_name == form.workspace["name"]:
+                pvc.storage = form.workspace.get("size", "5Gi")
+            try:
+                store.create(pvc)
+            except AlreadyExists:
+                pass
+    store.create(nb)
+    return json_success({"name": form.name}, status=201)
+
+
+async def delete_notebook(request: web.Request):
+    ns, name = request.match_info["ns"], request.match_info["name"]
+    ensure_authorized(request, "delete", "Notebook", ns)
+    request.app["store"].delete("Notebook", ns, name)
+    return json_success()
+
+
+async def patch_notebook(request: web.Request):
+    ns, name = request.match_info["ns"], request.match_info["name"]
+    ensure_authorized(request, "update", "Notebook", ns)
+    store: Store = request.app["store"]
+    body = await request.json()
+    nb = store.get("Notebook", ns, name)
+    if "stopped" in body:
+        if body["stopped"]:
+            import datetime
+
+            nb.metadata.annotations[STOP_ANNOTATION] = (
+                datetime.datetime.now(datetime.timezone.utc).isoformat()
+            )
+        else:
+            nb.metadata.annotations.pop(STOP_ANNOTATION, None)
+    store.update(nb)
+    return json_success()
+
+
+async def list_poddefaults(request: web.Request):
+    ns = request.match_info["ns"]
+    ensure_authorized(request, "list", "TpuPodDefault", ns)
+    store: Store = request.app["store"]
+    return json_success({
+        "poddefaults": [
+            {"name": pd.metadata.name, "desc": pd.spec.desc,
+             "selector": pd.spec.selector}
+            for pd in store.list("TpuPodDefault", ns)
+        ]
+    })

@@ -1,0 +1,107 @@
+"""KFAM REST service (ref access-management kfam/routers.go:30-101)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from kubeflow_tpu.controlplane.kfam import Binding, Kfam, KfamError
+from kubeflow_tpu.controlplane.store import Store
+from kubeflow_tpu.web.common import base_app, json_error, json_success
+
+
+def create_kfam_app(store: Store, *, cluster_admins: set[str] | None = None,
+                    csrf: bool = False) -> web.Application:
+    # The reference KFAM sits behind the mesh and uses no CSRF (it is a
+    # service API, not a browser app) — kept configurable.
+    app = base_app(store, csrf=csrf)
+    app["kfam"] = Kfam(store, cluster_admins)
+
+    app.router.add_get("/v1/bindings", get_bindings)
+    app.router.add_post("/v1/bindings", post_binding)
+    app.router.add_delete("/v1/bindings", delete_binding)
+    app.router.add_post("/v1/profiles", post_profile)
+    app.router.add_delete("/v1/profiles/{name}", delete_profile)
+    app.router.add_get("/v1/role/clusteradmin", get_clusteradmin)
+    return app
+
+
+@web.middleware
+async def _kfam_errors(request, handler):
+    try:
+        return await handler(request)
+    except KfamError as e:
+        return json_error(str(e), e.status)
+
+
+def _binding_from(body: dict) -> Binding:
+    # accept both flat and reference-style nested payloads
+    if "roleRef" in body:   # reference Binding shape (bindings.go)
+        user = body.get("user", {}).get("name", "")
+        ns = body.get("referredNamespace", "")
+        role = body.get("roleRef", {}).get("name", "")
+    else:
+        user, ns, role = body.get("user", ""), body.get("namespace", ""), body.get("role", "")
+    return Binding(user=user, namespace=ns, role=role)
+
+
+async def get_bindings(request: web.Request):
+    kfam: Kfam = request.app["kfam"]
+    bindings = kfam.list_bindings(
+        request["user"],
+        namespace=request.query.get("namespace") or None,
+        user=request.query.get("user") or None,
+    )
+    return json_success({
+        "bindings": [
+            {"user": b.user, "namespace": b.namespace, "role": b.role}
+            for b in bindings
+        ]
+    })
+
+
+async def post_binding(request: web.Request):
+    kfam: Kfam = request.app["kfam"]
+    try:
+        kfam.create_binding(request["user"], _binding_from(await request.json()))
+    except KfamError as e:
+        return json_error(str(e), e.status)
+    return json_success(status=201)
+
+
+async def delete_binding(request: web.Request):
+    kfam: Kfam = request.app["kfam"]
+    try:
+        kfam.delete_binding(request["user"], _binding_from(await request.json()))
+    except KfamError as e:
+        return json_error(str(e), e.status)
+    return json_success()
+
+
+async def post_profile(request: web.Request):
+    kfam: Kfam = request.app["kfam"]
+    body = await request.json()
+    try:
+        kfam.create_profile(
+            request["user"], body["name"], owner=body.get("owner", ""),
+            quota=body.get("quota"),
+        )
+    except KfamError as e:
+        return json_error(str(e), e.status)
+    return json_success(status=201)
+
+
+async def delete_profile(request: web.Request):
+    kfam: Kfam = request.app["kfam"]
+    try:
+        kfam.delete_profile(request["user"], request.match_info["name"])
+    except KfamError as e:
+        return json_error(str(e), e.status)
+    return json_success()
+
+
+async def get_clusteradmin(request: web.Request):
+    kfam: Kfam = request.app["kfam"]
+    from kubeflow_tpu.controlplane.auth import User
+
+    user = request.query.get("user") or request["user"].name
+    return json_success({"isClusterAdmin": kfam.is_cluster_admin(User(user))})

@@ -1,0 +1,61 @@
+"""Platform app: all web services mounted under one aiohttp server.
+
+The reference deploys the dashboard + three CRUD apps + KFAM as separate
+pods behind Istio path routing (VirtualServices at /jupyter, /volumes,
+/tensorboards, /kfam, /). One process serving the same paths preserves
+the URL contract while staying hermetic; each subapp can also be served
+alone (their create_* factories are independent).
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from kubeflow_tpu.controlplane.store import Store
+from kubeflow_tpu.web.dashboard_app import create_dashboard_app
+from kubeflow_tpu.web.jupyter_app import create_jupyter_app
+from kubeflow_tpu.web.kfam_app import create_kfam_app
+from kubeflow_tpu.web.tensorboards_app import create_tensorboards_app
+from kubeflow_tpu.web.volumes_app import create_volumes_app
+
+
+def create_platform_app(
+    store: Store,
+    *,
+    cluster_admins: set[str] | None = None,
+    spawner_config=None,
+    csrf: bool = True,
+) -> web.Application:
+    root = create_dashboard_app(store, cluster_admins=cluster_admins, csrf=csrf)
+    root["csrf_exempt_prefixes"] = ("/kfam/",)
+    root.add_subapp("/jupyter/", create_jupyter_app(
+        store, spawner_config=spawner_config, csrf=csrf))
+    root.add_subapp("/volumes/", create_volumes_app(store, csrf=csrf))
+    root.add_subapp("/tensorboards/", create_tensorboards_app(store, csrf=csrf))
+    root.add_subapp("/kfam/", create_kfam_app(
+        store, cluster_admins=cluster_admins, csrf=False))
+    return root
+
+
+def main() -> None:  # pragma: no cover - manual entry point
+    import argparse
+
+    from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=8082)
+    p.add_argument("--tpu-slices", default="v5e-16=1,v5e-1=4")
+    args = p.parse_args()
+
+    slices = {}
+    for part in args.tpu_slices.split(","):
+        k, _, v = part.partition("=")
+        if k:
+            slices[k] = int(v or 1)
+    cluster = Cluster(ClusterConfig(tpu_slices=slices)).start()
+    app = cluster.create_web_app()
+    web.run_app(app, port=args.port)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

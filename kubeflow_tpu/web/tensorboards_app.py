@@ -1,0 +1,56 @@
+"""Tensorboards web app (TWA): Tensorboard CR CRUD
+(ref crud-web-apps/tensorboards/backend)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from kubeflow_tpu.api.crds import Tensorboard
+from kubeflow_tpu.controlplane.store import Store
+from kubeflow_tpu.web.common import base_app, ensure_authorized, json_success
+
+
+def create_tensorboards_app(store: Store, *, csrf: bool = True) -> web.Application:
+    app = base_app(store, csrf=csrf)
+    app.router.add_get("/api/namespaces/{ns}/tensorboards", list_tbs)
+    app.router.add_post("/api/namespaces/{ns}/tensorboards", post_tb)
+    app.router.add_delete("/api/namespaces/{ns}/tensorboards/{name}", delete_tb)
+    return app
+
+
+async def list_tbs(request: web.Request):
+    ns = request.match_info["ns"]
+    ensure_authorized(request, "list", "Tensorboard", ns)
+    store: Store = request.app["store"]
+    return json_success({
+        "tensorboards": [
+            {
+                "name": t.metadata.name,
+                "logspath": t.spec.logspath,
+                "ready": t.status.ready,
+                "url": f"/tensorboard/{ns}/{t.metadata.name}/",
+            }
+            for t in store.list("Tensorboard", ns)
+        ]
+    })
+
+
+async def post_tb(request: web.Request):
+    ns = request.match_info["ns"]
+    ensure_authorized(request, "create", "Tensorboard", ns)
+    body = await request.json()
+    if not body.get("name") or not body.get("logspath"):
+        raise ValueError("name and logspath are required")
+    tb = Tensorboard()
+    tb.metadata.name = body["name"]
+    tb.metadata.namespace = ns
+    tb.spec.logspath = body["logspath"]
+    request.app["store"].create(tb)
+    return json_success({"name": tb.metadata.name}, status=201)
+
+
+async def delete_tb(request: web.Request):
+    ns, name = request.match_info["ns"], request.match_info["name"]
+    ensure_authorized(request, "delete", "Tensorboard", ns)
+    request.app["store"].delete("Tensorboard", ns, name)
+    return json_success()

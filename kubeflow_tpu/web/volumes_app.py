@@ -1,0 +1,76 @@
+"""Volumes web app (VWA): PVC CRUD (ref crud-web-apps/volumes/backend)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from kubeflow_tpu.api.core import PersistentVolumeClaim
+from kubeflow_tpu.controlplane.store import Store
+from kubeflow_tpu.web.common import base_app, ensure_authorized, json_success
+
+
+def create_volumes_app(store: Store, *, csrf: bool = True) -> web.Application:
+    app = base_app(store, csrf=csrf)
+    app.router.add_get("/api/namespaces/{ns}/pvcs", list_pvcs)
+    app.router.add_post("/api/namespaces/{ns}/pvcs", post_pvc)
+    app.router.add_delete("/api/namespaces/{ns}/pvcs/{name}", delete_pvc)
+    return app
+
+
+def _used_by(store: Store, ns: str, pvc_name: str) -> list[str]:
+    """Notebooks mounting this PVC (VWA shows 'used by' to block deletes)."""
+    out = []
+    for nb in store.list("Notebook", ns):
+        if any(v.pvc_name == pvc_name for v in nb.spec.template.spec.volumes):
+            out.append(nb.metadata.name)
+    return out
+
+
+async def list_pvcs(request: web.Request):
+    ns = request.match_info["ns"]
+    ensure_authorized(request, "list", "PersistentVolumeClaim", ns)
+    store: Store = request.app["store"]
+    return json_success({
+        "pvcs": [
+            {
+                "name": p.metadata.name,
+                "size": p.storage,
+                "accessModes": p.access_modes,
+                "storageClass": p.storage_class,
+                "phase": p.phase,
+                "usedBy": _used_by(store, ns, p.metadata.name),
+            }
+            for p in store.list("PersistentVolumeClaim", ns)
+        ]
+    })
+
+
+async def post_pvc(request: web.Request):
+    ns = request.match_info["ns"]
+    ensure_authorized(request, "create", "PersistentVolumeClaim", ns)
+    body = await request.json()
+    pvc = PersistentVolumeClaim()
+    pvc.metadata.name = body["name"]
+    pvc.metadata.namespace = ns
+    pvc.storage = body.get("size", "5Gi")
+    if body.get("mode"):
+        pvc.access_modes = [body["mode"]]
+    if body.get("class"):
+        pvc.storage_class = body["class"]
+    request.app["store"].create(pvc)
+    return json_success({"name": pvc.metadata.name}, status=201)
+
+
+async def delete_pvc(request: web.Request):
+    ns, name = request.match_info["ns"], request.match_info["name"]
+    ensure_authorized(request, "delete", "PersistentVolumeClaim", ns)
+    store: Store = request.app["store"]
+    users = _used_by(store, ns, name)
+    if users:
+        from kubeflow_tpu.web.common import json_error
+
+        return json_error(
+            f"PVC {name} is mounted by notebooks: {', '.join(users)}", 409
+        )
+    store.delete("PersistentVolumeClaim", ns, name)
+    return json_success()

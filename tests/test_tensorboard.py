@@ -1,0 +1,89 @@
+"""Tensorboard controller: logspath dispatch, routing, status."""
+
+import pytest
+
+from kubeflow_tpu.api.crds import Tensorboard
+from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
+
+
+def mk_tb(name="tb1", ns="user1", logspath="gs://bucket/runs"):
+    tb = Tensorboard()
+    tb.metadata.name = name
+    tb.metadata.namespace = ns
+    tb.spec.logspath = logspath
+    return tb
+
+
+@pytest.fixture()
+def cluster():
+    with Cluster(ClusterConfig()) as c:
+        yield c
+
+
+def test_gcs_logspath(cluster):
+    cluster.store.create(mk_tb())
+    assert cluster.wait_idle()
+    dep = cluster.store.get("Deployment", "user1", "tb1")
+    c = dep.spec.template.spec.containers[0]
+    assert "--logdir=gs://bucket/runs" in c.args
+    assert any(v.secret == "user-gcp-sa" for v in dep.spec.template.spec.volumes)
+    env = {e.name: e.value for e in c.env}
+    assert env["GOOGLE_APPLICATION_CREDENTIALS"].startswith("/secret/gcp")
+    vs = cluster.store.get("VirtualService", "user1", "tensorboard-user1-tb1")
+    assert vs.spec.http[0].prefix == "/tensorboard/user1/tb1/"
+    # deployment controller ran a pod; status mirrors readiness
+    tb = cluster.store.get("Tensorboard", "user1", "tb1")
+    assert tb.status.ready
+
+
+def test_pvc_logspath(cluster):
+    cluster.store.create(mk_tb("tb2", logspath="pvc://training-out/run5"))
+    assert cluster.wait_idle()
+    dep = cluster.store.get("Deployment", "user1", "tb2")
+    c = dep.spec.template.spec.containers[0]
+    assert "--logdir=/logs" in c.args
+    vol = dep.spec.template.spec.volumes[0]
+    assert vol.pvc_name == "training-out"
+    assert c.volume_mounts[0].sub_path == "run5"
+
+
+def test_legacy_logspath(cluster):
+    cluster.store.create(mk_tb("tb3", logspath="/some/path"))
+    assert cluster.wait_idle()
+    dep = cluster.store.get("Deployment", "user1", "tb3")
+    assert dep.spec.template.spec.volumes[0].pvc_name == "tb-volume"
+    assert dep.spec.template.spec.containers[0].volume_mounts[0].sub_path == "some/path"
+
+
+def test_delete_cascades(cluster):
+    cluster.store.create(mk_tb())
+    assert cluster.wait_idle()
+    cluster.store.delete("Tensorboard", "user1", "tb1")
+    assert cluster.wait_idle()
+    assert cluster.store.try_get("Deployment", "user1", "tb1") is None
+    assert cluster.store.try_get("Service", "user1", "tb1") is None
+
+
+def test_spec_change_replaces_pod(cluster):
+    """Template drift rolls pods: changing logspath lands on a new pod."""
+    import time
+
+    cluster.store.create(mk_tb("tbr", logspath="gs://bucket/v1"))
+    assert cluster.wait_idle()
+    old_pods = [p.metadata.name for p in cluster.store.list("Pod", "user1")
+                if p.metadata.labels.get("tensorboard-name") == "tbr"]
+    tb = cluster.store.get("Tensorboard", "user1", "tbr")
+    tb.spec.logspath = "gs://bucket/v2"
+    cluster.store.update(tb)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        assert cluster.wait_idle()
+        pods = [p for p in cluster.store.list("Pod", "user1")
+                if p.metadata.labels.get("tensorboard-name") == "tbr"]
+        if (pods and all(p.metadata.name not in old_pods for p in pods)
+                and pods[0].phase == "Running"):
+            break
+        time.sleep(0.05)
+    assert pods and pods[0].metadata.name not in old_pods
+    args = pods[0].spec.containers[0].args
+    assert "--logdir=gs://bucket/v2" in args

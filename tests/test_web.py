@@ -1,0 +1,203 @@
+"""Web surface tests: full HTTP round-trips against the platform app
+backed by a live cluster (the reference's KinD smoke tier, hermetic)."""
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubeflow_tpu.controlplane import auth
+from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
+from kubeflow_tpu.web.platform import create_platform_app
+
+pytest_plugins = ("aiohttp.pytest_plugin",)
+
+ALICE = {"kubeflow-userid": "alice@example.com"}
+BOB = {"kubeflow-userid": "bob@example.com"}
+ROOT = {"kubeflow-userid": "root@example.com"}
+
+
+@pytest.fixture()
+async def env(loop):
+    cluster = Cluster(ClusterConfig(
+        tpu_slices={"v5e-16": 1, "v5e-1": 4},
+        cluster_admins={"root@example.com"},
+    )).start()
+    app = cluster.create_web_app(csrf=False)  # admins flow from ClusterConfig
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    yield cluster, client
+    await client.close()
+    cluster.stop()
+
+
+async def _mk_profile(client, cluster, name="alice", headers=ALICE):
+    r = await client.post("/kfam/v1/profiles", json={"name": name},
+                          headers=headers)
+    assert r.status == 201, await r.text()
+    assert cluster.wait_idle()
+
+
+async def test_unauthenticated_rejected(env):
+    cluster, client = env
+    r = await client.get("/api/namespaces")
+    assert r.status == 401
+
+
+async def test_workgroup_flow(env):
+    cluster, client = env
+    r = await client.get("/api/workgroup/exists", headers=ALICE)
+    assert (await r.json())["hasWorkgroup"] is False
+    r = await client.post("/api/workgroup/create",
+                          json={"namespace": "alice"}, headers=ALICE)
+    assert r.status == 201
+    assert cluster.wait_idle()
+    r = await client.get("/api/workgroup/env-info", headers=ALICE)
+    info = await r.json()
+    assert info["namespaces"] == ["alice"]
+    assert info["ownedNamespaces"] == ["alice"]
+    assert info["isClusterAdmin"] is False
+    r = await client.get("/api/workgroup/env-info", headers=ROOT)
+    assert (await r.json())["isClusterAdmin"] is True
+
+
+async def test_notebook_lifecycle_over_http(env):
+    cluster, client = env
+    await _mk_profile(client, cluster)
+
+    # spawn a TPU notebook
+    r = await client.post(
+        "/jupyter/api/namespaces/alice/notebooks",
+        json={"name": "train", "tpu": {"topology": "v5e-16",
+                                       "mesh": "data=1,fsdp=16,tensor=1"}},
+        headers=ALICE,
+    )
+    assert r.status == 201, await r.text()
+    assert cluster.wait_idle()
+
+    # workspace PVC was created
+    r = await client.get("/volumes/api/namespaces/alice/pvcs", headers=ALICE)
+    pvcs = (await r.json())["pvcs"]
+    assert any(p["name"] == "train-workspace" for p in pvcs)
+    assert any("train" in p["usedBy"] for p in pvcs)
+
+    # list: running status with TPU info
+    r = await client.get("/jupyter/api/namespaces/alice/notebooks",
+                         headers=ALICE)
+    nbs = (await r.json())["notebooks"]
+    assert nbs[0]["tpu"]["topology"] == "v5e-16"
+    assert nbs[0]["status"]["phase"] == "ready"
+
+    # bob can't see alice's namespace
+    r = await client.get("/jupyter/api/namespaces/alice/notebooks",
+                         headers=BOB)
+    assert r.status == 403
+
+    # stop → stopped phase; start → ready again
+    r = await client.patch("/jupyter/api/namespaces/alice/notebooks/train",
+                           json={"stopped": True}, headers=ALICE)
+    assert r.status == 200
+    assert cluster.wait_idle()
+    r = await client.get("/jupyter/api/namespaces/alice/notebooks/train",
+                         headers=ALICE)
+    assert (await r.json())["notebook"]["status"]["phase"] == "stopped"
+
+    # delete
+    r = await client.delete("/jupyter/api/namespaces/alice/notebooks/train",
+                            headers=ALICE)
+    assert r.status == 200
+    assert cluster.wait_idle()
+    assert cluster.store.try_get("Notebook", "alice", "train") is None
+
+
+async def test_notebook_bad_topology_rejected(env):
+    cluster, client = env
+    await _mk_profile(client, cluster)
+    r = await client.post(
+        "/jupyter/api/namespaces/alice/notebooks",
+        json={"name": "x", "tpu": {"topology": "v99-7"}},
+        headers=ALICE,
+    )
+    assert r.status == 400
+    assert "v99-7" in (await r.json())["log"]
+
+
+async def test_capacity_starvation_surfaces_in_status(env):
+    cluster, client = env
+    await _mk_profile(client, cluster)
+    for name in ("one", "two"):
+        r = await client.post(
+            "/jupyter/api/namespaces/alice/notebooks",
+            json={"name": name, "tpu": {"topology": "v5e-16"}},
+            headers=ALICE,
+        )
+        assert r.status == 201
+        assert cluster.wait_idle()
+    r = await client.get("/jupyter/api/namespaces/alice/notebooks/two",
+                         headers=ALICE)
+    status = (await r.json())["notebook"]["status"]
+    assert status["phase"] == "warning"
+    assert "insufficient TPU capacity" in status["message"]
+    # activities feed shows the warning too
+    r = await client.get("/api/activities/alice", headers=ALICE)
+    acts = (await r.json())["activities"]
+    assert any(a["reason"] == "FailedScheduling" for a in acts)
+
+
+async def test_contributor_via_kfam_http(env):
+    cluster, client = env
+    await _mk_profile(client, cluster)
+    r = await client.post(
+        "/kfam/v1/bindings",
+        json={"user": "bob@example.com", "namespace": "alice", "role": "edit"},
+        headers=ALICE,
+    )
+    assert r.status == 201, await r.text()
+    r = await client.get("/jupyter/api/namespaces/alice/notebooks", headers=BOB)
+    assert r.status == 200
+    r = await client.get("/kfam/v1/bindings?namespace=alice", headers=ALICE)
+    assert (await r.json())["bindings"] == [
+        {"user": "bob@example.com", "namespace": "alice", "role": "edit"}]
+
+
+async def test_tensorboard_over_http(env):
+    cluster, client = env
+    await _mk_profile(client, cluster)
+    r = await client.post(
+        "/tensorboards/api/namespaces/alice/tensorboards",
+        json={"name": "tb", "logspath": "gs://bucket/runs"},
+        headers=ALICE,
+    )
+    assert r.status == 201
+    assert cluster.wait_idle()
+    r = await client.get("/tensorboards/api/namespaces/alice/tensorboards",
+                         headers=ALICE)
+    tbs = (await r.json())["tensorboards"]
+    assert tbs[0]["ready"] is True
+    assert tbs[0]["url"] == "/tensorboard/alice/tb/"
+
+
+async def test_dashboard_links_and_metrics(env):
+    cluster, client = env
+    await _mk_profile(client, cluster)
+    r = await client.get("/api/dashboard-links", headers=ALICE)
+    links = (await r.json())["links"]
+    assert any(l["link"] == "/jupyter/" for l in links["menuLinks"])
+    r = await client.post(
+        "/jupyter/api/namespaces/alice/notebooks",
+        json={"name": "t", "tpu": {"topology": "v5e-16"}}, headers=ALICE)
+    assert cluster.wait_idle()
+    r = await client.get("/api/metrics/tpu", headers=ALICE)
+    m = await r.json()
+    assert m["tpuHostsInUse"] == {"v5e-16": 4}
+
+
+async def test_pvc_delete_blocked_when_mounted(env):
+    cluster, client = env
+    await _mk_profile(client, cluster)
+    r = await client.post(
+        "/jupyter/api/namespaces/alice/notebooks",
+        json={"name": "nb"}, headers=ALICE)
+    assert cluster.wait_idle()
+    r = await client.delete("/volumes/api/namespaces/alice/pvcs/nb-workspace",
+                            headers=ALICE)
+    assert r.status == 409
+    assert "mounted by" in (await r.json())["log"]
