@@ -19,3 +19,9 @@ from kubeflow_tpu.parallel.sharding import (
     shard_pytree_specs,
     with_sharding_constraint,
 )
+from kubeflow_tpu.parallel.ring import (
+    ring_attention,
+    ring_attention_sharded,
+    ulysses_attention,
+    ulysses_attention_sharded,
+)

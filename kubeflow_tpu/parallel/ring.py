@@ -1,0 +1,224 @@
+"""Sequence/context parallelism: ring attention + Ulysses head-scatter.
+
+Long-context attention over a sequence-sharded batch, the two TPU-idiomatic
+layouts (SURVEY.md §5 "long-context"):
+
+- **Ring attention** (`ring_attention`, `ring_attention_sharded`): each
+  device keeps its Q shard resident and streams K/V shards around the ICI
+  ring with `jax.lax.ppermute`, accumulating blockwise online-softmax
+  partial results. O(s/N) activation memory per device, neighbor-only
+  collectives (rides ICI links, never DCN). Explicit collectives via
+  `shard_map` — this is deliberately NOT left to XLA: GSPMD would
+  all-gather the full K/V.
+
+- **Ulysses** (`ulysses_attention`): all-to-all swaps the sequence shard
+  for a head shard, runs *full* local attention per head group, and swaps
+  back. Cheaper when heads >= ring size and sequence fits after the swap;
+  two all-to-alls instead of N-1 permutes.
+
+Reference parity: the reference has no attention code of any kind
+(SURVEY.md §2b row "SP/CP, ring attention"); this subsystem is green-field
+TPU design.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubeflow_tpu.ops.attention import NEG_INF
+from kubeflow_tpu.parallel import mesh as mesh_lib
+
+
+def _block_attend(q, k, v, mask):
+    """One blockwise-attention accumulation step (grouped-query, fp32).
+
+    q: [b, sq, n_kv, g, hd]   (queries pre-grouped per kv head)
+    k, v: [b, sk, n_kv, hd]
+    mask: [b, sq, sk] bool (True = attend)
+    Returns unnormalized (o, m, l) for online-softmax merging:
+      o: [b, sq, n_kv, g, hd], m/l: [b, sq, n_kv, g]
+    """
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bsngh,btnh->bngst", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)                       # [b, n_kv, g, sq]
+    p = jnp.exp(logits - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bngst,btnh->bngsh", p, v.astype(jnp.float32))
+    # rearrange to [b, sq, n_kv, g, ...] so seq leads like q/k/v
+    perm = (0, 3, 1, 2)
+    return (
+        jnp.transpose(o, (0, 3, 1, 2, 4)),
+        jnp.transpose(m, perm),
+        jnp.transpose(l, perm),
+    )
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [b, s_local, n_q, hd]
+    k: jnp.ndarray,  # [b, s_local, n_kv, hd]
+    v: jnp.ndarray,  # [b, s_local, n_kv, hd]
+    *,
+    axis_name: str,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Ring attention over sequence shards. Call inside `shard_map`.
+
+    The global sequence is the concatenation of per-device shards in
+    axis-index order. K/V rotate one hop per step (N-1 ppermutes for an
+    N-device ring) while each block's contribution merges into an
+    online-softmax accumulator — numerically identical to full softmax
+    attention over the gathered sequence.
+
+    Causal masking is by *global* position, derived from the axis index of
+    the device each K/V block originated on; fully-future blocks still
+    execute (static schedule — no data-dependent control flow under jit)
+    but contribute zero weight.
+    """
+    size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, s, n_q, hd = q.shape
+    n_kv = k.shape[2]
+    assert n_q % n_kv == 0, (n_q, n_kv)
+    g = n_q // n_kv
+    qg = q.reshape(b, s, n_kv, g, hd)
+
+    local_pos = jnp.arange(s, dtype=jnp.int32)
+    q_pos = my_idx * s + local_pos                      # [s] global positions
+
+    perm = [(i, (i + 1) % size) for i in range(size)]   # rotate k/v upward
+
+    # Static unrolled ring (size is a compile-time constant under shard_map):
+    # exactly size-1 ppermute hops — the last block needs no onward rotation.
+    o = jnp.zeros((b, s, n_kv, g, hd), jnp.float32)
+    m = jnp.full((b, s, n_kv, g), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, s, n_kv, g), jnp.float32)
+    k_blk, v_blk = k, v
+    for i in range(size):
+        # Block i arrived after i hops: it originated on device my_idx - i.
+        src = (my_idx - i) % size
+        kv_pos = src * s + local_pos
+        if causal:
+            mask = q_pos[:, None] >= kv_pos[None, :]
+        else:
+            mask = jnp.ones((s, s), dtype=bool)
+        mask = jnp.broadcast_to(mask, (b, s, s))
+        o_i, m_i, l_i = _block_attend(qg, k_blk, v_blk, mask)
+        m_new = jnp.maximum(m, m_i)
+        a = jnp.exp(m - m_new)
+        a_i = jnp.exp(m_i - m_new)
+        o = o * a[..., None] + o_i * a_i[..., None]
+        l = l * a + l_i * a_i
+        m = m_new
+        if i + 1 < size:
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+    # Causal guarantees every row attends at least to itself, so l > 0.
+    out = o / l[..., None]
+    return out.reshape(b, s, n_q, hd).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jnp.ndarray,  # [b, s_global, n_q, hd]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    seq_axis: str = mesh_lib.FSDP_AXIS,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """shard_map wrapper: sequence dim sharded over `seq_axis`, the rest
+    replicated across it. Context parallelism conventionally reuses the
+    fsdp device axis as the sequence axis (mesh.py axis convention)."""
+    n = mesh.shape[seq_axis]
+    if q.shape[1] % n != 0:
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by "
+            f"{seq_axis}={n}"
+        )
+    spec = P(None, seq_axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,  # [b, s_local, n_q, hd]
+    k: jnp.ndarray,  # [b, s_local, n_kv, hd]
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Ulysses sequence parallelism. Call inside `shard_map`.
+
+    all-to-all #1: [b, s/N, n, hd] -> [b, s, n/N, hd] (gather sequence,
+    scatter heads); full attention on the now-complete sequence for the
+    local head group; all-to-all #2 swaps back. Requires n_q and n_kv
+    divisible by the axis size.
+    """
+    size = jax.lax.psum(1, axis_name)
+    n_q, n_kv = q.shape[2], k.shape[2]
+    if n_q % size or n_kv % size:
+        raise ValueError(
+            f"ulysses needs heads divisible by axis size: "
+            f"n_q={n_q} n_kv={n_kv} size={size}"
+        )
+
+    # split_axis=2 (heads), concat_axis=1 (sequence): tiled=True keeps the
+    # array rank stable.
+    def scatter_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def gather_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    b, s, nh, hd = qh.shape
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    g = nh // kh.shape[2]
+    qg = qh.reshape(b, s, kh.shape[2], g, hd)
+    mask = (
+        pos[:, :, None] >= pos[:, None, :]
+        if causal
+        else jnp.ones((b, s, s), dtype=bool)
+    )
+    o, m, l = _block_attend(qg, kh, vh, mask)
+    out = (o / l[..., None]).reshape(b, s, nh, hd).astype(q.dtype)
+    return gather_heads(out)
+
+
+def ulysses_attention_sharded(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    seq_axis: str = mesh_lib.FSDP_AXIS,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """shard_map wrapper for `ulysses_attention` (see ring_attention_sharded)."""
+    spec = P(None, seq_axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(ulysses_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
