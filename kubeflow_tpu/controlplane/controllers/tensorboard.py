@@ -82,10 +82,12 @@ class TensorboardController(Controller):
 
         cur_dep = store.try_get("Deployment", namespace, name)
         ready = bool(cur_dep and cur_dep.ready_replicas >= 1)
+        conditions = list(cur_dep.conditions) if cur_dep else []
         fresh = store.try_get("Tensorboard", namespace, name)
-        if fresh is not None and fresh.status.ready != ready:
+        if fresh is not None and (fresh.status.ready != ready
+                                  or fresh.status.conditions != conditions):
             fresh.status.ready = ready
-            fresh.status.conditions = list(cur_dep.conditions) if cur_dep else []
+            fresh.status.conditions = conditions
             store.update(fresh)
         return Result()
 

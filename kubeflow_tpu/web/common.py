@@ -19,6 +19,7 @@ from typing import Any
 from aiohttp import web
 
 from kubeflow_tpu.controlplane import auth
+from kubeflow_tpu.controlplane.kfam import KfamError
 from kubeflow_tpu.controlplane.store import (
     AdmissionDenied,
     AlreadyExists,
@@ -55,6 +56,8 @@ async def error_middleware(request: web.Request, handler):
         return json_error(str(e), 401)
     except auth.Forbidden as e:
         return json_error(str(e), 403)
+    except KfamError as e:
+        return json_error(str(e), e.status)
     except NotFound as e:
         return json_error(str(e), 404)
     except (AlreadyExists, Conflict) as e:
@@ -110,12 +113,14 @@ def add_probes(app: web.Application) -> None:
     app.router.add_get("/readyz", ok)
 
 
-def base_app(store: Store, *, csrf: bool = True) -> web.Application:
+def base_app(store: Store, *, csrf: bool = True,
+             cluster_admins: set[str] | None = None) -> web.Application:
     middlewares = [error_middleware, authn_middleware]
     if csrf:
         middlewares.append(csrf_middleware)
     app = web.Application(middlewares=middlewares)
     app["store"] = store
+    app["cluster_admins"] = cluster_admins or set()
     add_probes(app)
     return app
 

@@ -15,9 +15,9 @@ from __future__ import annotations
 from aiohttp import web
 
 from kubeflow_tpu.controlplane import auth
-from kubeflow_tpu.controlplane.kfam import Kfam, KfamError
+from kubeflow_tpu.controlplane.kfam import Kfam
 from kubeflow_tpu.controlplane.store import Store
-from kubeflow_tpu.web.common import base_app, json_error, json_success
+from kubeflow_tpu.web.common import base_app, json_success
 
 DEFAULT_LINKS = {
     "menuLinks": [
@@ -85,10 +85,7 @@ async def workgroup_create(request: web.Request):
     user: auth.User = request["user"]
     body = await request.json() if request.can_read_body else {}
     name = body.get("namespace") or user.name.split("@")[0]
-    try:
-        kfam.create_profile(user, name)
-    except KfamError as e:
-        return json_error(str(e), e.status)
+    kfam.create_profile(user, name)
     return json_success({"namespace": name}, status=201)
 
 
@@ -125,17 +122,33 @@ async def dashboard_links(request: web.Request):
 async def metrics(request: web.Request):
     """TPU-native replacement for the Stackdriver charts
     (stackdriver_metrics_service.ts): summarize slice allocation from
-    live pods."""
+    live pods. Scoped to the namespaces the caller can see — cluster
+    admins get the cluster-wide view, everyone else their own tenants
+    (the sibling endpoints all gate per-namespace; metrics must not be
+    the one cross-tenant leak)."""
     store: Store = request.app["store"]
+    user: auth.User = request["user"]
     from kubeflow_tpu.controlplane import webhook as wh
 
+    admins = request.app["cluster_admins"]
+    if user.name in admins:
+        visible = None  # all namespaces
+    else:
+        visible = set(auth.namespaces_for(store, user, admins))
+
     by_topo: dict[str, int] = {}
+    notebooks = 0
     for pod in store.list("Pod"):
+        if visible is not None and pod.metadata.namespace not in visible:
+            continue
         topo = pod.metadata.labels.get(wh.TOPOLOGY_LABEL)
         if topo and pod.phase == "Running":
             by_topo[topo] = by_topo.get(topo, 0) + 1
+    for nb in store.list("Notebook"):
+        if visible is None or nb.metadata.namespace in visible:
+            notebooks += 1
     return json_success({
         "type": request.match_info["type"],
         "tpuHostsInUse": by_topo,
-        "notebooks": len(store.list("Notebook")),
+        "notebooks": notebooks,
     })

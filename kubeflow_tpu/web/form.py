@@ -31,6 +31,36 @@ class FormError(ValueError):
     pass
 
 
+def parse_cpu(quantity: str) -> float:
+    """k8s CPU quantity → cores: '500m' → 0.5, '2' → 2.0."""
+    q = str(quantity).strip()
+    try:
+        if q.endswith("m"):
+            return float(q[:-1]) / 1000.0
+        return float(q)
+    except ValueError:
+        raise FormError(f"invalid CPU quantity {quantity!r}") from None
+
+
+def format_cpu(cores: float) -> str:
+    if cores < 1:
+        return f"{int(round(cores * 1000))}m"
+    return f"{cores:g}"
+
+
+def scale_memory(quantity: str, factor: float) -> str:
+    """Scale a k8s memory quantity's numeric part, keeping its unit."""
+    q = str(quantity).strip()
+    i = len(q)
+    while i > 0 and not (q[i - 1].isdigit() or q[i - 1] == "."):
+        i -= 1
+    num, unit = q[:i], q[i:]
+    try:
+        return f"{float(num) * factor:g}{unit}"
+    except ValueError:
+        raise FormError(f"invalid memory quantity {quantity!r}") from None
+
+
 DEFAULT_SPAWNER_CONFIG: dict[str, Any] = {
     "image": {
         "value": "kubeflow-tpu/jupyter-jax:latest",
@@ -96,7 +126,9 @@ def parse_form(body: dict, config: dict[str, Any] | None = None) -> NotebookForm
 
     image = get_form_value(body, config, "image")
     options = config.get("image", {}).get("options", [])
-    if options and image not in options and config["image"].get("readOnly"):
+    # readOnly pins the admin value (trusted by construction); otherwise the
+    # value is user-supplied and MUST be on the allowlist.
+    if options and image not in options and not config["image"].get("readOnly"):
         raise FormError(f"image {image!r} not in allowed options")
 
     tpu = get_form_value(body, config, "tpu") or {}
@@ -142,12 +174,13 @@ def build_notebook(form: NotebookForm, config: dict[str, Any] | None = None) -> 
     nb.spec.tpu.topology = form.tpu_topology
     nb.spec.tpu.mesh = form.tpu_mesh
 
-    limit_factor = float(config.get("cpu", {}).get("limitFactor", 1.2))
+    cpu_factor = float(config.get("cpu", {}).get("limitFactor", 1.2))
+    mem_factor = float(config.get("memory", {}).get("limitFactor", 1.2))
     container = Container(name=form.name, image=form.image)
     container.resources.requests = {"cpu": form.cpu, "memory": form.memory}
     container.resources.limits = {
-        "cpu": f"{float(form.cpu) * limit_factor:g}",
-        "memory": form.memory,
+        "cpu": format_cpu(parse_cpu(form.cpu) * cpu_factor),
+        "memory": scale_memory(form.memory, mem_factor),
     }
 
     tmpl = PodTemplateSpec()

@@ -25,8 +25,9 @@ from kubeflow_tpu.web.common import base_app, ensure_authorized, json_success
 
 
 def create_jupyter_app(store: Store, *, spawner_config=None,
+                       cluster_admins: set[str] | None = None,
                        csrf: bool = True) -> web.Application:
-    app = base_app(store, csrf=csrf)
+    app = base_app(store, csrf=csrf, cluster_admins=cluster_admins)
     app["spawner_config"] = spawner_config or form_lib.DEFAULT_SPAWNER_CONFIG
 
     app.router.add_get("/api/config", get_config)

@@ -1,19 +1,23 @@
-"""KFAM REST service (ref access-management kfam/routers.go:30-101)."""
+"""KFAM REST service (ref access-management kfam/routers.go:30-101).
+
+KfamError → HTTP status conversion happens in the shared
+`common.error_middleware`; handlers raise and stay flat.
+"""
 
 from __future__ import annotations
 
 from aiohttp import web
 
-from kubeflow_tpu.controlplane.kfam import Binding, Kfam, KfamError
+from kubeflow_tpu.controlplane.kfam import Binding, Kfam
 from kubeflow_tpu.controlplane.store import Store
-from kubeflow_tpu.web.common import base_app, json_error, json_success
+from kubeflow_tpu.web.common import base_app, json_success
 
 
 def create_kfam_app(store: Store, *, cluster_admins: set[str] | None = None,
                     csrf: bool = False) -> web.Application:
     # The reference KFAM sits behind the mesh and uses no CSRF (it is a
     # service API, not a browser app) — kept configurable.
-    app = base_app(store, csrf=csrf)
+    app = base_app(store, csrf=csrf, cluster_admins=cluster_admins)
     app["kfam"] = Kfam(store, cluster_admins)
 
     app.router.add_get("/v1/bindings", get_bindings)
@@ -23,14 +27,6 @@ def create_kfam_app(store: Store, *, cluster_admins: set[str] | None = None,
     app.router.add_delete("/v1/profiles/{name}", delete_profile)
     app.router.add_get("/v1/role/clusteradmin", get_clusteradmin)
     return app
-
-
-@web.middleware
-async def _kfam_errors(request, handler):
-    try:
-        return await handler(request)
-    except KfamError as e:
-        return json_error(str(e), e.status)
 
 
 def _binding_from(body: dict) -> Binding:
@@ -61,41 +57,29 @@ async def get_bindings(request: web.Request):
 
 async def post_binding(request: web.Request):
     kfam: Kfam = request.app["kfam"]
-    try:
-        kfam.create_binding(request["user"], _binding_from(await request.json()))
-    except KfamError as e:
-        return json_error(str(e), e.status)
+    kfam.create_binding(request["user"], _binding_from(await request.json()))
     return json_success(status=201)
 
 
 async def delete_binding(request: web.Request):
     kfam: Kfam = request.app["kfam"]
-    try:
-        kfam.delete_binding(request["user"], _binding_from(await request.json()))
-    except KfamError as e:
-        return json_error(str(e), e.status)
+    kfam.delete_binding(request["user"], _binding_from(await request.json()))
     return json_success()
 
 
 async def post_profile(request: web.Request):
     kfam: Kfam = request.app["kfam"]
     body = await request.json()
-    try:
-        kfam.create_profile(
-            request["user"], body["name"], owner=body.get("owner", ""),
-            quota=body.get("quota"),
-        )
-    except KfamError as e:
-        return json_error(str(e), e.status)
+    kfam.create_profile(
+        request["user"], body["name"], owner=body.get("owner", ""),
+        quota=body.get("quota"),
+    )
     return json_success(status=201)
 
 
 async def delete_profile(request: web.Request):
     kfam: Kfam = request.app["kfam"]
-    try:
-        kfam.delete_profile(request["user"], request.match_info["name"])
-    except KfamError as e:
-        return json_error(str(e), e.status)
+    kfam.delete_profile(request["user"], request.match_info["name"])
     return json_success()
 
 

@@ -29,9 +29,12 @@ def create_platform_app(
     root = create_dashboard_app(store, cluster_admins=cluster_admins, csrf=csrf)
     root["csrf_exempt_prefixes"] = ("/kfam/",)
     root.add_subapp("/jupyter/", create_jupyter_app(
-        store, spawner_config=spawner_config, csrf=csrf))
-    root.add_subapp("/volumes/", create_volumes_app(store, csrf=csrf))
-    root.add_subapp("/tensorboards/", create_tensorboards_app(store, csrf=csrf))
+        store, spawner_config=spawner_config, cluster_admins=cluster_admins,
+        csrf=csrf))
+    root.add_subapp("/volumes/", create_volumes_app(
+        store, cluster_admins=cluster_admins, csrf=csrf))
+    root.add_subapp("/tensorboards/", create_tensorboards_app(
+        store, cluster_admins=cluster_admins, csrf=csrf))
     root.add_subapp("/kfam/", create_kfam_app(
         store, cluster_admins=cluster_admins, csrf=False))
     return root

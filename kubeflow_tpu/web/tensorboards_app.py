@@ -10,8 +10,10 @@ from kubeflow_tpu.controlplane.store import Store
 from kubeflow_tpu.web.common import base_app, ensure_authorized, json_success
 
 
-def create_tensorboards_app(store: Store, *, csrf: bool = True) -> web.Application:
-    app = base_app(store, csrf=csrf)
+def create_tensorboards_app(store: Store, *,
+                            cluster_admins: set[str] | None = None,
+                            csrf: bool = True) -> web.Application:
+    app = base_app(store, csrf=csrf, cluster_admins=cluster_admins)
     app.router.add_get("/api/namespaces/{ns}/tensorboards", list_tbs)
     app.router.add_post("/api/namespaces/{ns}/tensorboards", post_tb)
     app.router.add_delete("/api/namespaces/{ns}/tensorboards/{name}", delete_tb)

@@ -9,8 +9,9 @@ from kubeflow_tpu.controlplane.store import Store
 from kubeflow_tpu.web.common import base_app, ensure_authorized, json_success
 
 
-def create_volumes_app(store: Store, *, csrf: bool = True) -> web.Application:
-    app = base_app(store, csrf=csrf)
+def create_volumes_app(store: Store, *, cluster_admins: set[str] | None = None,
+                       csrf: bool = True) -> web.Application:
+    app = base_app(store, csrf=csrf, cluster_admins=cluster_admins)
     app.router.add_get("/api/namespaces/{ns}/pvcs", list_pvcs)
     app.router.add_post("/api/namespaces/{ns}/pvcs", post_pvc)
     app.router.add_delete("/api/namespaces/{ns}/pvcs/{name}", delete_pvc)
@@ -18,11 +19,18 @@ def create_volumes_app(store: Store, *, csrf: bool = True) -> web.Application:
 
 
 def _used_by(store: Store, ns: str, pvc_name: str) -> list[str]:
-    """Notebooks mounting this PVC (VWA shows 'used by' to block deletes)."""
+    """Workloads mounting this PVC (VWA shows 'used by' to block deletes):
+    Notebooks via pod-template volumes, Tensorboards via pvc:// logspath."""
     out = []
     for nb in store.list("Notebook", ns):
         if any(v.pvc_name == pvc_name for v in nb.spec.template.spec.volumes):
             out.append(nb.metadata.name)
+    for tb in store.list("Tensorboard", ns):
+        logspath = tb.spec.logspath
+        if logspath.startswith("pvc://"):
+            mounted = logspath[len("pvc://"):].partition("/")[0]
+            if mounted == pvc_name:
+                out.append(f"tensorboard/{tb.metadata.name}")
     return out
 
 
@@ -70,7 +78,7 @@ async def delete_pvc(request: web.Request):
         from kubeflow_tpu.web.common import json_error
 
         return json_error(
-            f"PVC {name} is mounted by notebooks: {', '.join(users)}", 409
+            f"PVC {name} is in use by: {', '.join(users)}", 409
         )
     store.delete("PersistentVolumeClaim", ns, name)
     return json_success()

@@ -200,4 +200,80 @@ async def test_pvc_delete_blocked_when_mounted(env):
     r = await client.delete("/volumes/api/namespaces/alice/pvcs/nb-workspace",
                             headers=ALICE)
     assert r.status == 409
-    assert "mounted by" in (await r.json())["log"]
+    assert "in use by" in (await r.json())["log"]
+
+
+async def test_user_image_must_be_on_allowlist(env):
+    cluster, client = env
+    await _mk_profile(client, cluster)
+    r = await client.post(
+        "/jupyter/api/namespaces/alice/notebooks",
+        json={"name": "bad", "image": "evil/backdoor:latest"},
+        headers=ALICE,
+    )
+    assert r.status == 400
+    assert "not in allowed options" in (await r.json())["log"]
+
+
+async def test_millicpu_quantity_accepted(env):
+    cluster, client = env
+    await _mk_profile(client, cluster)
+    r = await client.post(
+        "/jupyter/api/namespaces/alice/notebooks",
+        json={"name": "mc", "cpu": "500m", "memory": "1Gi"},
+        headers=ALICE,
+    )
+    assert r.status == 201, await r.text()
+    nb = cluster.store.get("Notebook", "alice", "mc")
+    res = nb.spec.template.spec.containers[0].resources
+    assert res.requests["cpu"] == "500m"
+    assert res.limits["cpu"] == "600m"      # 0.5 * limitFactor 1.2
+    assert res.limits["memory"] == "1.2Gi"  # limitFactor applies to memory
+
+
+async def test_metrics_scoped_to_visible_namespaces(env):
+    cluster, client = env
+    await _mk_profile(client, cluster)
+    r = await client.post(
+        "/jupyter/api/namespaces/alice/notebooks",
+        json={"name": "t", "tpu": {"topology": "v5e-16"}}, headers=ALICE)
+    assert cluster.wait_idle()
+    # bob has no bindings: sees nothing
+    r = await client.get("/api/metrics/tpu", headers=BOB)
+    m = await r.json()
+    assert m["tpuHostsInUse"] == {}
+    assert m["notebooks"] == 0
+    # cluster admin sees everything
+    r = await client.get("/api/metrics/tpu", headers=ROOT)
+    m = await r.json()
+    assert m["tpuHostsInUse"] == {"v5e-16": 4}
+
+
+async def test_pvc_delete_blocked_by_tensorboard(env):
+    cluster, client = env
+    await _mk_profile(client, cluster)
+    r = await client.post("/volumes/api/namespaces/alice/pvcs",
+                          json={"name": "runs"}, headers=ALICE)
+    assert r.status == 201
+    r = await client.post(
+        "/tensorboards/api/namespaces/alice/tensorboards",
+        json={"name": "tb", "logspath": "pvc://runs/exp1"},
+        headers=ALICE,
+    )
+    assert r.status == 201
+    assert cluster.wait_idle()
+    r = await client.delete("/volumes/api/namespaces/alice/pvcs/runs",
+                            headers=ALICE)
+    assert r.status == 409
+    assert "tensorboard/tb" in (await r.json())["log"]
+
+
+async def test_subapps_honor_cluster_admin(env):
+    cluster, client = env
+    await _mk_profile(client, cluster)
+    # root never got a binding in alice's namespace, but is a cluster admin
+    r = await client.get("/jupyter/api/namespaces/alice/notebooks",
+                         headers=ROOT)
+    assert r.status == 200
+    r = await client.get("/volumes/api/namespaces/alice/pvcs", headers=ROOT)
+    assert r.status == 200
