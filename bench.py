@@ -133,12 +133,16 @@ def main() -> int:
 
     for _ in range(preset.warmup):
         state, loss = trainer.step(state, tokens, targets)
-    jax.block_until_ready(loss)
+    # Sync via device-to-host transfer: on some PJRT plugins (the axon
+    # tunnel) block_until_ready returns before the enqueued chain has
+    # executed, which once inflated this bench ~2000x. float() cannot
+    # lie — the value physically leaves the device.
+    float(loss)
 
     t0 = time.perf_counter()
     for _ in range(preset.steps):
         state, loss = trainer.step(state, tokens, targets)
-    jax.block_until_ready(loss)
+    float(loss)
     dt = time.perf_counter() - t0
 
     total_tokens = batch * preset.seq * preset.steps

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the hot ops (flash attention first).
+
+Kernels are written against the TPU memory hierarchy (HBM → VMEM → MXU)
+and tested on CPU in interpreter mode, mirroring how the control plane is
+tested against the fake-TPU backend.
+"""
+
+from kubeflow_tpu.ops.pallas.flash_attention import flash_attention

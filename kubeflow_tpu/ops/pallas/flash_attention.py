@@ -1,0 +1,382 @@
+"""Flash attention as Pallas TPU kernels (fwd + custom-VJP bwd).
+
+Blockwise attention that never materializes the [s, s] score matrix:
+Q blocks stay VMEM-resident while K/V blocks stream through, merging
+into an online-softmax accumulator — O(block_q * block_k) VMEM instead
+of O(s^2) HBM, with every matmul landing on the MXU in fp32 accumulation.
+
+Backward is the standard two-kernel formulation (saved row logsumexp +
+recomputed probabilities):
+  - dq kernel:   grid over Q blocks, streaming K/V blocks;
+  - dk/dv kernel: grid over K blocks, streaming Q/dO blocks.
+GQA is handled by index-mapping each query head onto its KV head inside
+the BlockSpecs (KV never repeats in HBM); dk/dv come out at query-head
+resolution and are group-summed outside the kernel.
+
+Causal masking is by absolute row/col block index — packed sequences with
+position resets must use the XLA path (see ops.attention dispatcher).
+
+Reference parity: the reference has no attention/compute code at all
+(SURVEY.md §2b); this is the TPU-native hot-op layer BASELINE.json's
+tokens/sec/chip metric exercises.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -2.0**30
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block(s: int, block: int) -> int:
+    b = min(block, s)
+    while s % b:
+        b //= 2
+    return max(b, 1)
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
+                *, scale, causal, block_q, block_k, nk):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)          # [bq, hd]
+        k = k_ref[0, 0].astype(jnp.float32)          # [bk, hd]
+        v = v_ref[0, 0].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                     # [bq, bk]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+            mask = (qi * block_q + rows) >= (ki * block_k + cols)
+            logits = jnp.where(mask, logits, NEG_INF)
+
+        m_prev = m_scr[:, 0]                          # [bq]
+        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=1))
+        p = jnp.exp(logits - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
+        acc[:] = acc[:] * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    if causal:
+        # Skip compute for blocks strictly above the diagonal.
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc[:] / safe_l[:, None]).astype(o_ref.dtype)
+        lse = m_scr[:, 0] + jnp.log(safe_l)
+        # lane-replicated rows: TPU blocks need the trailing dims tiled
+        # (8, 128), so per-row scalars are stored [s, 128] like the
+        # in-tree kernel's l/m residuals.
+        lse_ref[0, 0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[2:])
+
+
+def _fwd(q4, k4, v4, *, causal, block_q, block_k, interpret):
+    """q4: [b, nq, s, hd]; k4/v4: [b, nkv, s, hd] → (o4, lse[b, nq, s])."""
+    b, nq, s, hd = q4.shape
+    nkv = k4.shape[1]
+    g = nq // nkv
+    scale = hd**-0.5
+    block_q = _pick_block(s, block_q)
+    block_k = _pick_block(s, block_k)
+    nqb, nkb = s // block_q, s // block_k
+
+    grid = (b * nq, nqb, nkb)
+    q_spec = pl.BlockSpec(
+        (1, 1, block_q, hd),
+        lambda bh, qi, ki: (bh // nq, bh % nq, qi, 0),
+    )
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, hd),
+        lambda bh, qi, ki: (bh // nq, (bh % nq) // g, ki, 0),
+    )
+    o_spec = pl.BlockSpec(
+        (1, 1, block_q, hd),
+        lambda bh, qi, ki: (bh // nq, bh % nq, qi, 0),
+    )
+    lse_spec = pl.BlockSpec(
+        (1, 1, block_q, 128),
+        lambda bh, qi, ki: (bh // nq, bh % nq, qi, 0),
+    )
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, nk=nkb,
+    )
+    o4, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=[o_spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(q4.shape, q4.dtype),
+            jax.ShapeDtypeStruct((b, nq, s, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, hd), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q4, k4, v4)
+    return o4, lse
+
+
+# --------------------------------------------------------------- backward
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, scale, causal, block_q, block_k, nk):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, 0]                     # [bq]
+        delta = delta_ref[0, 0][:, 0]                 # [bq]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+            mask = (qi * block_q + rows) >= (ki * block_k + cols)
+            logits = jnp.where(mask, logits, NEG_INF)
+        p = jnp.exp(logits - lse[:, None])            # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        dq_acc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc,
+                *, scale, causal, block_q, block_k, nq_blocks):
+    ki, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)           # [bq, hd]
+        k = k_ref[0, 0].astype(jnp.float32)           # [bk, hd]
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, 0]
+        delta = delta_ref[0, 0][:, 0]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                     # [bq, bk]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+            mask = (qi * block_q + rows) >= (ki * block_k + cols)
+            logits = jnp.where(mask, logits, NEG_INF)
+        p = jnp.exp(logits - lse[:, None])
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])                # [bq, bk]
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    if causal:
+        # Q blocks strictly above the diagonal see none of this K block.
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == nq_blocks - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(causal, block_q, block_k, interpret, res, do4):
+    q4, k4, v4, o4, lse = res
+    b, nq, s, hd = q4.shape
+    nkv = k4.shape[1]
+    g = nq // nkv
+    scale = hd**-0.5
+    block_q = _pick_block(s, block_q)
+    block_k = _pick_block(s, block_k)
+    nqb, nkb = s // block_q, s // block_k
+
+    delta = jnp.sum(do4.astype(jnp.float32) * o4.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
+
+    q_spec = pl.BlockSpec(
+        (1, 1, block_q, hd), lambda bh, qi, ki: (bh // nq, bh % nq, qi, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, block_k, hd),
+        lambda bh, qi, ki: (bh // nq, (bh % nq) // g, ki, 0))
+    row_spec = pl.BlockSpec(
+        (1, 1, block_q, 128),
+        lambda bh, qi, ki: (bh // nq, bh % nq, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nk=nkb),
+        grid=(b * nq, nqb, nkb),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q4.shape, q4.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
+        interpret=interpret,
+    )(q4, k4, v4, do4, lse, delta)
+
+    # dk/dv at query-head resolution; kv-head index maps stream the same
+    # K/V block to every query head in the group.
+    q_spec2 = pl.BlockSpec(
+        (1, 1, block_q, hd), lambda bh, ki, qi: (bh // nq, bh % nq, qi, 0))
+    kv_spec2 = pl.BlockSpec(
+        (1, 1, block_k, hd),
+        lambda bh, ki, qi: (bh // nq, (bh % nq) // g, ki, 0))
+    row_spec2 = pl.BlockSpec(
+        (1, 1, block_q, 128),
+        lambda bh, ki, qi: (bh // nq, bh % nq, qi, 0))
+    dkv_out_spec = pl.BlockSpec(
+        (1, 1, block_k, hd), lambda bh, ki, qi: (bh // nq, bh % nq, ki, 0))
+
+    dk_full, dv_full = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, nq_blocks=nqb),
+        grid=(b * nq, nkb, nqb),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=[dkv_out_spec, dkv_out_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, nq, s, hd), k4.dtype),
+            jax.ShapeDtypeStruct((b, nq, s, hd), v4.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, hd), jnp.float32),
+            pltpu.VMEM((block_k, hd), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q4, k4, v4, do4, lse, delta)
+
+    # Group-sum query-head gradients onto their KV head.
+    dk = dk_full.reshape(b, nkv, g, s, hd).sum(axis=2).astype(k4.dtype)
+    dv = dv_full.reshape(b, nkv, g, s, hd).sum(axis=2).astype(v4.dtype)
+    return dq, dk, dv
+
+
+# -------------------------------------------------------------- public API
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q4, k4, v4, causal, block_q, block_k, interpret):
+    o4, _ = _fwd(q4, k4, v4, causal=causal, block_q=block_q,
+                 block_k=block_k, interpret=interpret)
+    return o4
+
+
+def _flash_fwd(q4, k4, v4, causal, block_q, block_k, interpret):
+    o4, lse = _fwd(q4, k4, v4, causal=causal, block_q=block_q,
+                   block_k=block_k, interpret=interpret)
+    return o4, (q4, k4, v4, o4, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, do4):
+    return _bwd(causal, block_q, block_k, interpret, res, do4)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,  # [b, s, n_q, hd]
+    k: jnp.ndarray,  # [b, s, n_kv, hd]
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Flash attention with GQA, differentiable (custom VJP).
+
+    Layout contract matches ops.attention.dot_product_attention:
+    [batch, seq, heads, head_dim] in/out. `interpret=None` auto-selects
+    interpreter mode off-TPU so the same code path is testable on the
+    hermetic CPU backend.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    b, s, n_q, hd = q.shape
+    n_kv = k.shape[2]
+    if n_q % n_kv:
+        raise ValueError(f"n_q={n_q} not a multiple of n_kv={n_kv}")
+    if k.shape[1] != s:
+        raise ValueError("flash kernel requires equal q/kv sequence lengths")
+    q4 = jnp.transpose(q, (0, 2, 1, 3))
+    k4 = jnp.transpose(k, (0, 2, 1, 3))
+    v4 = jnp.transpose(v, (0, 2, 1, 3))
+    o4 = _flash(q4, k4, v4, causal, block_q, block_k, interpret)
+    return jnp.transpose(o4, (0, 2, 1, 3))

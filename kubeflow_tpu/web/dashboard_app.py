@@ -37,9 +37,8 @@ DEFAULT_LINKS = {
 def create_dashboard_app(store: Store, *, cluster_admins: set[str] | None = None,
                          links: dict | None = None,
                          csrf: bool = True) -> web.Application:
-    app = base_app(store, csrf=csrf)
+    app = base_app(store, csrf=csrf, cluster_admins=cluster_admins)
     app["kfam"] = Kfam(store, cluster_admins)
-    app["cluster_admins"] = cluster_admins or set()
     app["links"] = links or DEFAULT_LINKS
 
     app.router.add_get("/api/workgroup/env-info", env_info)
@@ -131,7 +130,7 @@ async def metrics(request: web.Request):
     from kubeflow_tpu.controlplane import webhook as wh
 
     admins = request.app["cluster_admins"]
-    if user.name in admins:
+    if auth.is_cluster_admin(store, user, admins):
         visible = None  # all namespaces
     else:
         visible = set(auth.namespaces_for(store, user, admins))

@@ -42,10 +42,18 @@ def parse_cpu(quantity: str) -> float:
         raise FormError(f"invalid CPU quantity {quantity!r}") from None
 
 
+def _fmt_quantity_number(x: float) -> str:
+    """Plain decimal (never scientific notation — k8s quantities forbid
+    exponents combined with binary suffixes)."""
+    if x == int(x):
+        return str(int(x))
+    return f"{x:.3f}".rstrip("0").rstrip(".")
+
+
 def format_cpu(cores: float) -> str:
     if cores < 1:
         return f"{int(round(cores * 1000))}m"
-    return f"{cores:g}"
+    return _fmt_quantity_number(cores)
 
 
 def scale_memory(quantity: str, factor: float) -> str:
@@ -56,7 +64,7 @@ def scale_memory(quantity: str, factor: float) -> str:
         i -= 1
     num, unit = q[:i], q[i:]
     try:
-        return f"{float(num) * factor:g}{unit}"
+        return f"{_fmt_quantity_number(float(num) * factor)}{unit}"
     except ValueError:
         raise FormError(f"invalid memory quantity {quantity!r}") from None
 
