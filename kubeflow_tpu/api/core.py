@@ -354,6 +354,9 @@ class Namespace(Resource):
 @dataclass
 class ServiceAccount(Resource):
     KIND: ClassVar[str] = "ServiceAccount"
+    # Populated asynchronously by the platform (the reference waits on this
+    # before unlocking notebook start, odh notebook_controller.go:94-122).
+    image_pull_secrets: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -376,6 +379,19 @@ class AuthorizationPolicy(Resource):
 class ResourceQuota(Resource):
     KIND: ClassVar[str] = "ResourceQuota"
     hard: dict[str, str] = field(default_factory=dict)  # incl. "tpu/chips"
+
+
+@dataclass
+class Route(Resource):
+    """Edge ingress route (OpenShift Route equivalent; on GKE this maps to
+    a gateway HTTPRoute). Exposes a Service at a cluster-external host."""
+
+    KIND: ClassVar[str] = "Route"
+    host: str = ""              # assigned by the platform when empty
+    to_service: str = ""
+    target_port: str = ""       # named service port
+    tls_termination: str = ""   # "" | "edge" | "reencrypt"
+    redirect_insecure: bool = True
 
 
 @dataclass

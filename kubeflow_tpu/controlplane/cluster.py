@@ -11,6 +11,11 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from kubeflow_tpu.controlplane.controllers.culler import ActivityProbe, Culler
+from kubeflow_tpu.controlplane.controllers.gateway import (
+    GatewayNotebookController,
+    NotebookGatewayWebhook,
+    ServiceAccountPullSecretWebhook,
+)
 from kubeflow_tpu.controlplane.controllers.notebook import NotebookController
 from kubeflow_tpu.controlplane.controllers.profile import (
     ProfileController,
@@ -39,6 +44,10 @@ class ClusterConfig:
     default_namespace_labels: dict[str, str] = field(default_factory=dict)
     enable_workload_identity: bool = False
     cluster_admins: set[str] = field(default_factory=set)
+    # Gateway layer (the odh-notebook-controller equivalent): auth-proxy
+    # sidecar injection, Routes, NetworkPolicies, reconciliation lock.
+    enable_gateway: bool = False
+    gateway_domain: str = "apps.example.com"
 
 
 class Cluster:
@@ -70,6 +79,18 @@ class Cluster:
         self.manager.register(self.profile_controller)
         self.manager.register(self.tensorboard_controller)
         self.manager.register(self.deployment_controller)
+        self.gateway_controller = None
+        self.gateway_webhook = None
+        if self.config.enable_gateway:
+            self.gateway_webhook = NotebookGatewayWebhook(self.store)
+            self.store.register_mutating_webhook("Notebook", self.gateway_webhook)
+            self.store.register_mutating_webhook(
+                "ServiceAccount", ServiceAccountPullSecretWebhook(self.store)
+            )
+            self.gateway_controller = GatewayNotebookController(
+                gateway_domain=self.config.gateway_domain
+            )
+            self.manager.register(self.gateway_controller)
         self.culler = None
         if self.config.enable_culling and self.config.activity_probe is not None:
             self.culler = Culler(

@@ -1,0 +1,426 @@
+"""Gateway layer: auth-proxy injection, edge Routes, NetworkPolicies,
+reconciliation lock — the second operator over the same Notebook CR.
+
+Re-design (capability parity, new mechanism) of the reference's
+odh-notebook-controller:
+- a Notebook-level mutating webhook (ref notebook_webhook.go:226-265)
+  that, at create, (a) injects a reconciliation lock — the culler's stop
+  annotation reused as a startup gate so the pod cannot start before its
+  pull secret / auth material exists (ref InjectReconciliationLock
+  notebook_webhook.go:55-64), (b) injects an auth-proxy sidecar when
+  annotated (ref InjectOAuthProxy :68-223: SAR-gated proxy on :8443,
+  100m/64Mi requests=limits, health probes, cookie+TLS secret volumes,
+  dedicated ServiceAccount), and (c) injects cluster-wide egress-proxy
+  env + trusted CA bundle (ref InjectProxyConfig :299-398);
+- a second controller on the Notebook kind reconciling the objects the
+  sidecar needs — ServiceAccount, tls Service, cookie Secret, Route,
+  NetworkPolicies, trusted-CA ConfigMap (ref notebook_oauth.go:74-262,
+  notebook_route.go:34-146, notebook_network.go:42-221) — and removing
+  the lock once the ServiceAccount's pull secret is visible, with
+  bounded retry (ref RemoveReconciliationLock notebook_controller.go:94-122).
+
+TPU-native framing: on GKE there is no OpenShift OAuth server; the same
+capability is a SAR-gated identity-aware proxy sidecar in front of the
+notebook (IAP-style), and `Route` maps to a gateway HTTPRoute. The gate
+matters MORE on TPU slices than it did upstream: a gang pod that starts
+before its neighbors' auth material exists wedges the whole slice's
+`jax.distributed.initialize` barrier, so the lock holds replicas at 0
+until the control plane is ready for the entire gang.
+"""
+
+from __future__ import annotations
+
+import secrets as pysecrets
+
+from kubeflow_tpu.api.core import (
+    ConfigMap,
+    Container,
+    EnvVar,
+    NetworkPolicy,
+    Pod,
+    Probe,
+    Resource,
+    ResourceRequirements,
+    Route,
+    Secret,
+    Service,
+    ServiceAccount,
+    ServicePort,
+    ServiceSpec,
+    Volume,
+    VolumeMount,
+)
+from kubeflow_tpu.api.crds import Notebook, STOP_ANNOTATION
+from kubeflow_tpu.controlplane.controllers.helpers import reconcile_child
+from kubeflow_tpu.controlplane.controllers.notebook import DEFAULT_PORT
+from kubeflow_tpu.controlplane.runtime import Controller, Result
+from kubeflow_tpu.controlplane.store import NotFound, Store, set_controller_reference
+
+# Annotations (ref odh-notebook-controller const block):
+INJECT_AUTH_PROXY_ANNOTATION = "kubeflow-tpu.dev/inject-auth-proxy"
+LOGOUT_URL_ANNOTATION = "kubeflow-tpu.dev/logout-url"
+# Lock value distinguishes "stopped by the gateway's startup gate" from a
+# user/culler stop (ref AnnotationValueReconciliationLock).
+LOCK_VALUE = "gateway-lock"
+
+AUTH_PROXY_CONTAINER = "auth-proxy"
+AUTH_PROXY_PORT = 8443                     # ref notebook_network.go:36
+AUTH_PROXY_PORT_NAME = "auth-proxy"
+AUTH_SERVICE_PORT = 443                    # ref notebook_oauth.go:36
+DEFAULT_PROXY_IMAGE = "kubeflow-tpu/auth-proxy:latest"
+
+# Cluster-wide egress proxy config lives in this ConfigMap (the reference
+# reads the OpenShift cluster Proxy resource, notebook_webhook.go:267-297).
+SYSTEM_NAMESPACE = "kubeflow-tpu-system"
+CLUSTER_PROXY_CONFIGMAP = "cluster-proxy-config"
+TRUSTED_CA_CONFIGMAP = "trusted-ca-bundle"
+
+# Bounded wait for the pull secret before force-unlocking (the reference
+# retries 3x with backoff then removes the lock regardless,
+# notebook_controller.go:94-122).
+LOCK_MAX_RETRIES = 3
+LOCK_RETRY_ANNOTATION = "kubeflow-tpu.dev/gateway-lock-retries"
+
+
+def auth_enabled(nb: Notebook) -> bool:
+    return nb.metadata.annotations.get(
+        INJECT_AUTH_PROXY_ANNOTATION, ""
+    ).lower() in ("1", "true")
+
+
+def locked(nb: Notebook) -> bool:
+    return nb.metadata.annotations.get(STOP_ANNOTATION) == LOCK_VALUE
+
+
+class NotebookGatewayWebhook:
+    """Mutating webhook on Notebook create (register on the store).
+
+    The reference mounts this at /mutate-notebook-v1 and handles
+    create+update; our store runs mutators at create, which covers both
+    injections that matter (the lock is create-only upstream too,
+    notebook_webhook.go:234-240).
+    """
+
+    def __init__(self, store: Store, *, proxy_image: str = DEFAULT_PROXY_IMAGE,
+                 enable_lock: bool = True):
+        self.store = store
+        self.proxy_image = proxy_image
+        self.enable_lock = enable_lock
+
+    def __call__(self, obj: Resource) -> None:
+        if not isinstance(obj, Notebook):
+            return
+        if self.enable_lock and STOP_ANNOTATION not in obj.metadata.annotations:
+            obj.metadata.annotations[STOP_ANNOTATION] = LOCK_VALUE
+        if auth_enabled(obj):
+            inject_auth_proxy(obj, self.proxy_image)
+        proxy_env = cluster_proxy_env(self.store)
+        if proxy_env:
+            inject_proxy_config(obj, proxy_env)
+
+
+def inject_auth_proxy(nb: Notebook, image: str) -> None:
+    """Add (or replace) the SAR-gated identity proxy sidecar.
+
+    Mirrors ref InjectOAuthProxy (notebook_webhook.go:68-223): the proxy
+    terminates TLS on :8443, checks a SubjectAccessReview on the Notebook
+    resource itself, then forwards to the Jupyter port on localhost.
+    """
+    name, ns = nb.metadata.name, nb.metadata.namespace
+    args = [
+        "--provider=kubernetes-sar",
+        f"--https-address=:{AUTH_PROXY_PORT}",
+        f"--service-account={name}",
+        "--cookie-secret-file=/etc/auth/config/cookie_secret",
+        "--cookie-expire=24h0m0s",
+        "--tls-cert=/etc/tls/private/tls.crt",
+        "--tls-key=/etc/tls/private/tls.key",
+        f"--upstream=http://localhost:{DEFAULT_PORT}",
+        "--email-domain=*",
+        "--skip-provider-button",
+        (
+            '--sar={"verb":"get","resource":"notebooks",'
+            f'"resourceName":"{name}","namespace":"{ns}"}}'
+        ),
+    ]
+    logout = nb.metadata.annotations.get(LOGOUT_URL_ANNOTATION, "")
+    if logout:
+        args.append(f"--logout-url={logout}")
+    sidecar = Container(
+        name=AUTH_PROXY_CONTAINER,
+        image=image,
+        args=args,
+        ports=[AUTH_PROXY_PORT],
+        env=[EnvVar("NAMESPACE", ns)],
+        volume_mounts=[
+            VolumeMount(name="auth-config", mount_path="/etc/auth/config"),
+            VolumeMount(name="tls-certificates", mount_path="/etc/tls/private"),
+        ],
+        resources=ResourceRequirements(
+            requests={"cpu": "100m", "memory": "64Mi"},   # ref :131-140
+            limits={"cpu": "100m", "memory": "64Mi"},
+        ),
+        liveness_probe=Probe(path="/auth/healthz", port=AUTH_PROXY_PORT,
+                             initial_delay_seconds=30, period_seconds=5),
+        readiness_probe=Probe(path="/auth/healthz", port=AUTH_PROXY_PORT,
+                              initial_delay_seconds=5, period_seconds=5),
+    )
+    spec = nb.spec.template.spec
+    for i, c in enumerate(spec.containers):
+        if c.name == AUTH_PROXY_CONTAINER:
+            spec.containers[i] = sidecar
+            break
+    else:
+        spec.containers.append(sidecar)
+    _upsert_volume(spec.volumes, Volume(name="auth-config",
+                                        secret=f"{name}-auth-config"))
+    _upsert_volume(spec.volumes, Volume(name="tls-certificates",
+                                        secret=f"{name}-tls"))
+    # Dedicated ServiceAccount, never `default` (ref :221-222).
+    spec.service_account = name
+
+
+def _upsert_volume(volumes: list[Volume], vol: Volume) -> None:
+    for i, v in enumerate(volumes):
+        if v.name == vol.name:
+            volumes[i] = vol
+            return
+    volumes.append(vol)
+
+
+def cluster_proxy_env(store: Store) -> dict[str, str]:
+    """Egress-proxy env from the cluster config (ref ClusterWideProxyIsEnabled
+    + InjectProxyConfig, notebook_webhook.go:267-398)."""
+    cm = store.try_get("ConfigMap", SYSTEM_NAMESPACE, CLUSTER_PROXY_CONFIGMAP)
+    if cm is None:
+        return {}
+    assert isinstance(cm, ConfigMap)
+    out = {}
+    for key, env in (("http_proxy", "HTTP_PROXY"), ("https_proxy", "HTTPS_PROXY"),
+                     ("no_proxy", "NO_PROXY")):
+        if cm.data.get(key):
+            out[env] = cm.data[key]
+    return out
+
+
+def inject_proxy_config(nb: Notebook, proxy_env: dict[str, str]) -> None:
+    spec = nb.spec.template.spec
+    for c in spec.containers:
+        if c.name == AUTH_PROXY_CONTAINER:
+            continue
+        have = {e.name for e in c.env}
+        for k, v in proxy_env.items():
+            if k not in have:
+                c.env.append(EnvVar(k, v))
+        # Trusted CA bundle for TLS through the egress proxy (ref
+        # InjectProxyConfig mounts the odh-trusted-ca-bundle ConfigMap).
+        if not any(m.name == "trusted-ca" for m in c.volume_mounts):
+            c.volume_mounts.append(VolumeMount(
+                name="trusted-ca",
+                mount_path="/etc/pki/tls/certs/ca-bundle.crt",
+                sub_path="ca-bundle.crt", read_only=True,
+            ))
+    _upsert_volume(spec.volumes, Volume(name="trusted-ca",
+                                        config_map=TRUSTED_CA_CONFIGMAP))
+
+
+class GatewayNotebookController(Controller):
+    """Second reconciler on the Notebook kind (the ODH pattern: two
+    operators, one CR — ref odh notebook_controller.go:126-198)."""
+
+    KIND = "Notebook"
+    OWNS = ("ServiceAccount", "Service", "Secret", "Route", "NetworkPolicy",
+            "ConfigMap")
+
+    def __init__(self, *, gateway_domain: str = "apps.example.com"):
+        self.gateway_domain = gateway_domain
+
+    def reconcile(self, store: Store, namespace: str, name: str) -> Result:
+        try:
+            nb = store.get("Notebook", namespace, name)
+        except NotFound:
+            return Result()
+        assert isinstance(nb, Notebook)
+
+        self._reconcile_trusted_ca(store, nb)
+        self._reconcile_network_policies(store, nb)
+        if auth_enabled(nb):
+            self._reconcile_service_account(store, nb)
+            self._reconcile_tls_service(store, nb)
+            self._reconcile_auth_secret(store, nb)
+            self._reconcile_route(store, nb, target=AUTH_PROXY_PORT_NAME,
+                                  tls="reencrypt")
+        else:
+            self._reconcile_route(store, nb, target="http", tls="edge")
+
+        if locked(nb):
+            return self._remove_lock(store, nb)
+        return Result()
+
+    # -- children ----------------------------------------------------------
+
+    def _reconcile_trusted_ca(self, store: Store, nb: Notebook) -> None:
+        """Mirror the system CA bundle into the notebook namespace (ref
+        createProxyConfigMap, odh notebook_controller.go:200-260)."""
+        src = store.try_get("ConfigMap", SYSTEM_NAMESPACE, TRUSTED_CA_CONFIGMAP)
+        if src is None:
+            return
+        assert isinstance(src, ConfigMap)
+        cm = ConfigMap(data=dict(src.data))
+        cm.metadata.name = TRUSTED_CA_CONFIGMAP
+        cm.metadata.namespace = nb.metadata.namespace
+        existing = store.try_get("ConfigMap", cm.metadata.namespace, cm.metadata.name)
+        if existing is None:
+            store.create(cm)
+        elif existing.data != cm.data:
+            existing.data = cm.data
+            store.update(existing)
+
+    def _reconcile_network_policies(self, store: Store, nb: Notebook) -> None:
+        """Ingress rules (ref notebook_network.go:130-208): the notebook
+        port only from the gateway namespace; the auth port from anywhere
+        (the proxy is the auth boundary)."""
+        name, ns = nb.metadata.name, nb.metadata.namespace
+        np = NetworkPolicy(
+            allow_from_namespaces=[SYSTEM_NAMESPACE],
+            allow_ports=[DEFAULT_PORT],
+        )
+        np.metadata.name = f"{name}-ctrl-np"
+        np.metadata.namespace = ns
+        reconcile_child(store, nb, np, _copy_netpol)
+        if auth_enabled(nb):
+            np2 = NetworkPolicy(allow_ports=[AUTH_PROXY_PORT])
+            np2.metadata.name = f"{name}-auth-np"
+            np2.metadata.namespace = ns
+            reconcile_child(store, nb, np2, _copy_netpol)
+
+    def _reconcile_service_account(self, store: Store, nb: Notebook) -> None:
+        sa = ServiceAccount()
+        sa.metadata.name = nb.metadata.name
+        sa.metadata.namespace = nb.metadata.namespace
+        # Route-redirect annotation (ref notebook_oauth.go:46-62).
+        sa.metadata.annotations = {
+            "kubeflow-tpu.dev/redirect-route": nb.metadata.name,
+        }
+        set_controller_reference(nb, sa)
+        if store.try_get("ServiceAccount", sa.metadata.namespace,
+                         sa.metadata.name) is None:
+            store.create(sa)
+
+    def _reconcile_tls_service(self, store: Store, nb: Notebook) -> None:
+        name, ns = nb.metadata.name, nb.metadata.namespace
+        svc = Service(spec=ServiceSpec(
+            selector={"notebook-name": name},
+            ports=[ServicePort(AUTH_PROXY_PORT_NAME, AUTH_SERVICE_PORT,
+                               AUTH_PROXY_PORT)],
+        ))
+        svc.metadata.name = f"{name}-tls"
+        svc.metadata.namespace = ns
+        from kubeflow_tpu.controlplane.controllers.helpers import (
+            copy_spec_and_labels,
+        )
+        reconcile_child(store, nb, svc, copy_spec_and_labels)
+
+    def _reconcile_auth_secret(self, store: Store, nb: Notebook) -> None:
+        """Cookie secret, generated once (ref NewNotebookOAuthSecret
+        notebook_oauth.go:187-209 — random 32B seed)."""
+        name, ns = nb.metadata.name, nb.metadata.namespace
+        if store.try_get("Secret", ns, f"{name}-auth-config") is not None:
+            return
+        sec = Secret(data={"cookie_secret": pysecrets.token_urlsafe(32)})
+        sec.metadata.name = f"{name}-auth-config"
+        sec.metadata.namespace = ns
+        set_controller_reference(nb, sec)
+        store.create(sec)
+
+    def _reconcile_route(self, store: Store, nb: Notebook, *, target: str,
+                         tls: str) -> None:
+        name, ns = nb.metadata.name, nb.metadata.namespace
+        route = Route(
+            host=f"{name}-{ns}.{self.gateway_domain}",
+            to_service=f"{name}-tls" if target == AUTH_PROXY_PORT_NAME else name,
+            target_port=target,
+            tls_termination=tls,
+        )
+        route.metadata.name = name
+        route.metadata.namespace = ns
+        reconcile_child(store, nb, route, _copy_route)
+
+    # -- lock removal (ref RemoveReconciliationLock :94-122) ---------------
+
+    def _remove_lock(self, store: Store, nb: Notebook) -> Result:
+        """Unlock once the pull secret is visible on the ServiceAccount;
+        after LOCK_MAX_RETRIES bounded retries, unlock anyway (the
+        reference swallows the wait error and removes the lock)."""
+        sa_name = (nb.metadata.name if auth_enabled(nb)
+                   else nb.spec.template.spec.service_account)
+        ready = True
+        if sa_name:
+            sa = store.try_get("ServiceAccount", nb.metadata.namespace, sa_name)
+            ready = sa is not None and bool(sa.image_pull_secrets)
+        fresh = store.try_get("Notebook", nb.metadata.namespace,
+                              nb.metadata.name)
+        if fresh is None or not locked(fresh):
+            return Result()
+        assert isinstance(fresh, Notebook)
+        if not ready:
+            try:
+                retries = int(
+                    fresh.metadata.annotations.get(LOCK_RETRY_ANNOTATION, "0")
+                )
+            except ValueError:
+                retries = LOCK_MAX_RETRIES  # garbled counter: stop waiting
+            if retries < LOCK_MAX_RETRIES:
+                fresh.metadata.annotations[LOCK_RETRY_ANNOTATION] = str(
+                    retries + 1
+                )
+                store.update(fresh)
+                return Result(requeue_after=0.05 * (retries + 1))
+        del fresh.metadata.annotations[STOP_ANNOTATION]
+        fresh.metadata.annotations.pop(LOCK_RETRY_ANNOTATION, None)
+        store.update(fresh)
+        return Result()
+
+
+class ServiceAccountPullSecretWebhook:
+    """Models the platform's async pull-secret provisioning (on OpenShift a
+    dockercfg secret appears on every new ServiceAccount; the lock-removal
+    wait above is what makes that asynchrony safe)."""
+
+    def __init__(self, store: Store):
+        self.store = store
+
+    def __call__(self, obj: Resource) -> None:
+        if isinstance(obj, ServiceAccount) and not obj.image_pull_secrets:
+            obj.image_pull_secrets.append(
+                f"{obj.metadata.name}-dockercfg"
+            )
+
+
+def _copy_netpol(desired: NetworkPolicy, current: NetworkPolicy) -> bool:
+    changed = (
+        current.allow_from_namespaces != desired.allow_from_namespaces
+        or current.allow_ports != desired.allow_ports
+    )
+    if changed:
+        current.allow_from_namespaces = list(desired.allow_from_namespaces)
+        current.allow_ports = list(desired.allow_ports)
+    return changed
+
+
+def _copy_route(desired: Route, current: Route) -> bool:
+    # Host is platform-assigned once set; compare/copy everything else
+    # (ref CompareNotebookRoutes blanks Host, notebook_route.go:65-73).
+    changed = (
+        current.to_service != desired.to_service
+        or current.target_port != desired.target_port
+        or current.tls_termination != desired.tls_termination
+    )
+    if changed:
+        current.to_service = desired.to_service
+        current.target_port = desired.target_port
+        current.tls_termination = desired.tls_termination
+    if not current.host:
+        current.host = desired.host
+        changed = True
+    return changed

@@ -30,7 +30,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -2.0**30
+from kubeflow_tpu.ops.attention import NEG_INF
+
+
+def _apply_causal_mask(logits, qi, ki, block_q, block_k):
+    rows = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    mask = (qi * block_q + rows) >= (ki * block_k + cols)
+    return jnp.where(mask, logits, NEG_INF)
 
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
@@ -69,10 +76,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
             preferred_element_type=jnp.float32,
         ) * scale                                     # [bq, bk]
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-            mask = (qi * block_q + rows) >= (ki * block_k + cols)
-            logits = jnp.where(mask, logits, NEG_INF)
+            logits = _apply_causal_mask(logits, qi, ki, block_q, block_k)
 
         m_prev = m_scr[:, 0]                          # [bq]
         m_new = jnp.maximum(m_prev, jnp.max(logits, axis=1))
@@ -179,10 +183,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-            mask = (qi * block_q + rows) >= (ki * block_k + cols)
-            logits = jnp.where(mask, logits, NEG_INF)
+            logits = _apply_causal_mask(logits, qi, ki, block_q, block_k)
         p = jnp.exp(logits - lse[:, None])            # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -225,10 +226,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         ) * scale                                     # [bq, bk]
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-            mask = (qi * block_q + rows) >= (ki * block_k + cols)
-            logits = jnp.where(mask, logits, NEG_INF)
+            logits = _apply_causal_mask(logits, qi, ki, block_q, block_k)
         p = jnp.exp(logits - lse[:, None])
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),

@@ -47,7 +47,12 @@ def _fmt_quantity_number(x: float) -> str:
     exponents combined with binary suffixes)."""
     if x == int(x):
         return str(int(x))
-    return f"{x:.3f}".rstrip("0").rstrip(".")
+    out = f"{x:.3f}".rstrip("0").rstrip(".")
+    if float(out or 0) == 0.0 and x != 0.0:
+        # Don't collapse a tiny nonzero quantity to "0": widen precision
+        # until the magnitude survives.
+        out = f"{x:.12f}".rstrip("0").rstrip(".")
+    return out
 
 
 def format_cpu(cores: float) -> str:

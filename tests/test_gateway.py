@@ -1,0 +1,171 @@
+"""Gateway layer (odh-notebook-controller equivalent): auth-proxy
+injection, Routes, NetworkPolicies, reconciliation lock.
+
+Mirrors the reference's envtest suite shape (odh-notebook-controller/
+controllers/notebook_controller_test.go:40-719: reconcile-when-modified,
+recreate-when-deleted, lock-removal patterns)."""
+
+import pytest
+
+from kubeflow_tpu.api.core import ConfigMap, Container, PodTemplateSpec
+from kubeflow_tpu.api.crds import Notebook, STOP_ANNOTATION
+from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
+from kubeflow_tpu.controlplane.controllers import gateway as gw
+
+
+def mk_notebook(name="nb1", ns="user1", auth=False, topology=""):
+    nb = Notebook()
+    nb.metadata.name = name
+    nb.metadata.namespace = ns
+    if auth:
+        nb.metadata.annotations[gw.INJECT_AUTH_PROXY_ANNOTATION] = "true"
+    nb.spec.template = PodTemplateSpec()
+    nb.spec.template.spec.containers.append(
+        Container(name=name, image="kubeflow-tpu/jupyter-jax:latest")
+    )
+    nb.spec.tpu.topology = topology
+    return nb
+
+
+@pytest.fixture()
+def cluster():
+    cfg = ClusterConfig(tpu_slices={"v5e-16": 1, "v5e-1": 4},
+                        enable_gateway=True)
+    with Cluster(cfg) as c:
+        yield c
+
+
+def test_lock_injected_then_removed(cluster):
+    """Create → lock holds STS at 0; gateway unlocks → pods start
+    (ref InjectReconciliationLock + RemoveReconciliationLock)."""
+    cluster.store.create(mk_notebook())
+    assert cluster.wait_idle()
+    nb = cluster.store.get("Notebook", "user1", "nb1")
+    assert STOP_ANNOTATION not in nb.metadata.annotations
+    sts = cluster.store.get("StatefulSet", "user1", "nb1")
+    assert sts.spec.replicas == 1
+    pod = cluster.store.get("Pod", "user1", "nb1-0")
+    assert pod.phase == "Running"
+
+
+def test_auth_proxy_sidecar_injected(cluster):
+    cluster.store.create(mk_notebook("secure", auth=True))
+    assert cluster.wait_idle()
+    nb = cluster.store.get("Notebook", "user1", "secure")
+    names = [c.name for c in nb.spec.template.spec.containers]
+    assert names == ["secure", gw.AUTH_PROXY_CONTAINER]
+    sidecar = nb.spec.template.spec.containers[1]
+    assert sidecar.ports == [gw.AUTH_PROXY_PORT]
+    assert sidecar.resources.requests == {"cpu": "100m", "memory": "64Mi"}
+    assert sidecar.resources.limits == sidecar.resources.requests
+    assert sidecar.liveness_probe.initial_delay_seconds == 30
+    assert sidecar.readiness_probe.initial_delay_seconds == 5
+    assert any("--sar=" in a and '"resourceName":"secure"' in a
+               for a in sidecar.args)
+    vols = {v.name: v for v in nb.spec.template.spec.volumes}
+    assert vols["auth-config"].secret == "secure-auth-config"
+    assert vols["tls-certificates"].secret == "secure-tls"
+    # dedicated SA, never default (ref notebook_webhook.go:221-222)
+    assert nb.spec.template.spec.service_account == "secure"
+
+
+def test_auth_children_reconciled(cluster):
+    cluster.store.create(mk_notebook("secure", auth=True))
+    assert cluster.wait_idle()
+    sa = cluster.store.get("ServiceAccount", "user1", "secure")
+    assert sa.image_pull_secrets  # platform stamped the pull secret
+    svc = cluster.store.get("Service", "user1", "secure-tls")
+    assert svc.spec.ports[0].port == gw.AUTH_SERVICE_PORT
+    assert svc.spec.ports[0].target_port == gw.AUTH_PROXY_PORT
+    sec = cluster.store.get("Secret", "user1", "secure-auth-config")
+    assert sec.data["cookie_secret"]
+    route = cluster.store.get("Route", "user1", "secure")
+    assert route.to_service == "secure-tls"
+    assert route.tls_termination == "reencrypt"
+    # cookie secret is generated once, stable across reconciles
+    nb = cluster.store.get("Notebook", "user1", "secure")
+    nb.metadata.labels["touch"] = "1"
+    cluster.store.update(nb)
+    assert cluster.wait_idle()
+    assert cluster.store.get(
+        "Secret", "user1", "secure-auth-config"
+    ).data["cookie_secret"] == sec.data["cookie_secret"]
+
+
+def test_plain_route_without_auth(cluster):
+    cluster.store.create(mk_notebook())
+    assert cluster.wait_idle()
+    route = cluster.store.get("Route", "user1", "nb1")
+    assert route.to_service == "nb1"
+    assert route.target_port == "http"
+    assert route.tls_termination == "edge"
+    assert route.host == "nb1-user1.apps.example.com"
+
+
+def test_network_policies(cluster):
+    cluster.store.create(mk_notebook("secure", auth=True))
+    assert cluster.wait_idle()
+    np = cluster.store.get("NetworkPolicy", "user1", "secure-ctrl-np")
+    assert np.allow_ports == [8888]
+    assert np.allow_from_namespaces == [gw.SYSTEM_NAMESPACE]
+    np2 = cluster.store.get("NetworkPolicy", "user1", "secure-auth-np")
+    assert np2.allow_ports == [gw.AUTH_PROXY_PORT]
+    assert np2.allow_from_namespaces == []  # any
+
+
+def test_route_recreated_when_deleted(cluster):
+    """Delete-owned-object → reconcile recreates (ref odh
+    notebook_controller_test.go recreate-when-deleted specs)."""
+    cluster.store.create(mk_notebook())
+    assert cluster.wait_idle()
+    cluster.store.delete("Route", "user1", "nb1")
+    assert cluster.wait_idle()
+    assert cluster.store.get("Route", "user1", "nb1")
+
+
+def test_route_drift_reverted_host_kept(cluster):
+    cluster.store.create(mk_notebook())
+    assert cluster.wait_idle()
+    route = cluster.store.get("Route", "user1", "nb1")
+    route.host = "custom.host.example"     # platform-assigned: preserved
+    route.target_port = "wrong"            # owned field: reverted
+    cluster.store.update(route)
+    assert cluster.wait_idle()
+    route = cluster.store.get("Route", "user1", "nb1")
+    assert route.host == "custom.host.example"
+    assert route.target_port == "http"
+
+
+def test_cluster_proxy_env_injection(cluster):
+    cm = ConfigMap(data={"http_proxy": "http://proxy:3128",
+                         "https_proxy": "http://proxy:3128",
+                         "no_proxy": ".svc,.cluster.local"})
+    cm.metadata.name = gw.CLUSTER_PROXY_CONFIGMAP
+    cm.metadata.namespace = gw.SYSTEM_NAMESPACE
+    cluster.store.create(cm)
+    ca = ConfigMap(data={"ca-bundle.crt": "FAKE-CA"})
+    ca.metadata.name = gw.TRUSTED_CA_CONFIGMAP
+    ca.metadata.namespace = gw.SYSTEM_NAMESPACE
+    cluster.store.create(ca)
+
+    cluster.store.create(mk_notebook("proxied"))
+    assert cluster.wait_idle()
+    nb = cluster.store.get("Notebook", "user1", "proxied")
+    env = {e.name: e.value for e in nb.spec.template.spec.containers[0].env}
+    assert env["HTTP_PROXY"] == "http://proxy:3128"
+    assert env["NO_PROXY"] == ".svc,.cluster.local"
+    # trusted CA mirrored into the user namespace
+    mirrored = cluster.store.get("ConfigMap", "user1", gw.TRUSTED_CA_CONFIGMAP)
+    assert mirrored.data["ca-bundle.crt"] == "FAKE-CA"
+
+
+def test_gang_notebook_gated_by_lock(cluster):
+    """TPU twist: the lock gates the WHOLE gang — no partial slice starts
+    before the control plane unlocks."""
+    cluster.store.create(mk_notebook("big", topology="v5e-16"))
+    assert cluster.wait_idle()
+    sts = cluster.store.get("StatefulSet", "user1", "big")
+    assert sts.spec.replicas == 4
+    pods = cluster.store.list("Pod", "user1",
+                              label_selector={"notebook-name": "big"})
+    assert len(pods) == 4
