@@ -28,6 +28,7 @@ from kubeflow_tpu.controlplane.controllers.workload import (
     Scheduler,
     StatefulSetController,
 )
+from kubeflow_tpu.controlplane.metrics import ControlPlaneMetrics
 from kubeflow_tpu.controlplane.runtime import Manager
 from kubeflow_tpu.controlplane.store import Store
 from kubeflow_tpu.controlplane.webhook import PodDefaultWebhook
@@ -60,9 +61,10 @@ class Cluster:
         self.scheduler = Scheduler(NodePool(dict(self.config.tpu_slices)))
         self.webhook = PodDefaultWebhook(self.store)
         self.store.register_mutating_webhook("Pod", self.webhook)
-        self.manager = Manager(self.store)
+        self.metrics = ControlPlaneMetrics(self.store)
+        self.manager = Manager(self.store, metrics=self.metrics)
         self.notebook_controller = NotebookController(
-            use_routing=self.config.use_routing
+            use_routing=self.config.use_routing, metrics=self.metrics
         )
         self.statefulset_controller = StatefulSetController(self.scheduler)
         self.profile_controller = ProfileController(
@@ -97,6 +99,7 @@ class Cluster:
                 self.config.activity_probe,
                 idle_time=self.config.cull_idle_time,
                 check_period=self.config.cull_check_period,
+                metrics=self.metrics,
             )
             self.manager.register(self.culler)
 
@@ -111,6 +114,7 @@ class Cluster:
         from kubeflow_tpu.web.platform import create_platform_app
 
         kwargs.setdefault("cluster_admins", self.cluster_admins)
+        kwargs.setdefault("metrics", self.metrics)
         return create_platform_app(self.store, **kwargs)
 
     def start(self) -> "Cluster":

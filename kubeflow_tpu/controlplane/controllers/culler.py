@@ -113,12 +113,14 @@ class Culler(Controller):
         idle_time: float = 1440 * 60.0,       # ref CULL_IDLE_TIME 1440m
         check_period: float = 60.0,           # ref IDLENESS_CHECK_PERIOD 1m
         clock=time.time,
+        metrics=None,
     ):
         self.probe = probe
         self.enabled = enabled
         self.idle_time = idle_time
         self.check_period = check_period
         self.clock = clock
+        self.metrics = metrics
 
     def reconcile(self, store: Store, namespace: str, name: str) -> Result:
         try:
@@ -168,6 +170,8 @@ class Culler(Controller):
             })
             store.emit_event(nb, "Normal", "Culled",
                              f"idle for {(now - last) / 60:.0f} min")
+            if self.metrics is not None:
+                self.metrics.notebook_culled.inc(namespace=namespace)
             log.info("culled notebook %s/%s", namespace, name)
         return Result(requeue_after=self.check_period)
 

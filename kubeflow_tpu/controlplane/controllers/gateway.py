@@ -31,6 +31,7 @@ until the control plane is ready for the entire gang.
 from __future__ import annotations
 
 import secrets as pysecrets
+import time
 
 from kubeflow_tpu.api.core import (
     ConfigMap,
@@ -51,7 +52,10 @@ from kubeflow_tpu.api.core import (
     VolumeMount,
 )
 from kubeflow_tpu.api.crds import Notebook, STOP_ANNOTATION
-from kubeflow_tpu.controlplane.controllers.helpers import reconcile_child
+from kubeflow_tpu.controlplane.controllers.helpers import (
+    copy_spec_and_labels,
+    reconcile_child,
+)
 from kubeflow_tpu.controlplane.controllers.notebook import DEFAULT_PORT
 from kubeflow_tpu.controlplane.runtime import Controller, Result
 from kubeflow_tpu.controlplane.store import NotFound, Store, set_controller_reference
@@ -76,10 +80,11 @@ CLUSTER_PROXY_CONFIGMAP = "cluster-proxy-config"
 TRUSTED_CA_CONFIGMAP = "trusted-ca-bundle"
 
 # Bounded wait for the pull secret before force-unlocking (the reference
-# retries 3x with backoff then removes the lock regardless,
-# notebook_controller.go:94-122).
-LOCK_MAX_RETRIES = 3
-LOCK_RETRY_ANNOTATION = "kubeflow-tpu.dev/gateway-lock-retries"
+# retries 1s+5s+25s with backoff then removes the lock regardless,
+# notebook_controller.go:94-122). Wall-clock budget, not a retry count:
+# watch events re-enqueue reconciles faster than any requeue delay, so a
+# counter would burn its retries in milliseconds.
+LOCK_WAIT_BUDGET = 31.0
 
 
 def auth_enabled(nb: Notebook) -> bool:
@@ -229,11 +234,20 @@ class GatewayNotebookController(Controller):
     operators, one CR — ref odh notebook_controller.go:126-198)."""
 
     KIND = "Notebook"
-    OWNS = ("ServiceAccount", "Service", "Secret", "Route", "NetworkPolicy",
-            "ConfigMap")
+    OWNS = ("ServiceAccount", "Service", "Secret", "Route", "NetworkPolicy")
+    # The mirrored trusted-ca ConfigMap is namespace-shared (not owned by
+    # any one notebook, so no owner ref / no GC); watching the kind keeps
+    # delete→recreate working for it.
+    WATCHES = ("ConfigMap",)
 
-    def __init__(self, *, gateway_domain: str = "apps.example.com"):
+    def __init__(self, *, gateway_domain: str = "apps.example.com",
+                 lock_wait_budget: float = LOCK_WAIT_BUDGET,
+                 clock=None):
         self.gateway_domain = gateway_domain
+        self.lock_wait_budget = lock_wait_budget
+        self.clock = clock or time.monotonic
+        # (ns, name) -> monotonic deadline for pull-secret visibility.
+        self._lock_deadlines: dict[tuple[str, str], float] = {}
 
     def reconcile(self, store: Store, namespace: str, name: str) -> Result:
         try:
@@ -316,9 +330,6 @@ class GatewayNotebookController(Controller):
         ))
         svc.metadata.name = f"{name}-tls"
         svc.metadata.namespace = ns
-        from kubeflow_tpu.controlplane.controllers.helpers import (
-            copy_spec_and_labels,
-        )
         reconcile_child(store, nb, svc, copy_spec_and_labels)
 
     def _reconcile_auth_secret(self, store: Store, nb: Notebook) -> None:
@@ -350,8 +361,12 @@ class GatewayNotebookController(Controller):
 
     def _remove_lock(self, store: Store, nb: Notebook) -> Result:
         """Unlock once the pull secret is visible on the ServiceAccount;
-        after LOCK_MAX_RETRIES bounded retries, unlock anyway (the
-        reference swallows the wait error and removes the lock)."""
+        after a wall-clock budget, unlock anyway (the reference swallows
+        the wait error and removes the lock). The budget lives in
+        controller memory, not an annotation: writing a retry counter to
+        the CR would emit a MODIFIED event that re-enqueues immediately
+        and defeats the backoff."""
+        key = (nb.metadata.namespace, nb.metadata.name)
         sa_name = (nb.metadata.name if auth_enabled(nb)
                    else nb.spec.template.spec.service_account)
         ready = True
@@ -361,24 +376,18 @@ class GatewayNotebookController(Controller):
         fresh = store.try_get("Notebook", nb.metadata.namespace,
                               nb.metadata.name)
         if fresh is None or not locked(fresh):
+            self._lock_deadlines.pop(key, None)
             return Result()
         assert isinstance(fresh, Notebook)
         if not ready:
-            try:
-                retries = int(
-                    fresh.metadata.annotations.get(LOCK_RETRY_ANNOTATION, "0")
-                )
-            except ValueError:
-                retries = LOCK_MAX_RETRIES  # garbled counter: stop waiting
-            if retries < LOCK_MAX_RETRIES:
-                fresh.metadata.annotations[LOCK_RETRY_ANNOTATION] = str(
-                    retries + 1
-                )
-                store.update(fresh)
-                return Result(requeue_after=0.05 * (retries + 1))
+            now = self.clock()
+            deadline = self._lock_deadlines.setdefault(
+                key, now + self.lock_wait_budget)
+            if now < deadline:
+                return Result(requeue_after=min(1.0, deadline - now))
         del fresh.metadata.annotations[STOP_ANNOTATION]
-        fresh.metadata.annotations.pop(LOCK_RETRY_ANNOTATION, None)
         store.update(fresh)
+        self._lock_deadlines.pop(key, None)
         return Result()
 
 

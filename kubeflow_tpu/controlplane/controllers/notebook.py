@@ -59,10 +59,12 @@ class NotebookController(Controller):
     WATCHES = ("Event",)   # re-emit pod/STS warnings onto the CR (ref :94-118)
 
     def __init__(self, *, use_routing: bool = True,
-                 culling_check_period: float | None = None):
+                 culling_check_period: float | None = None,
+                 metrics=None):
         self.use_routing = use_routing
         # ref IDLENESS_CHECK_PERIOD (1m default) drives periodic requeue
         self.culling_check_period = culling_check_period
+        self.metrics = metrics
 
     def reconcile(self, store: Store, namespace: str, name: str) -> Result:
         try:
@@ -88,7 +90,11 @@ class NotebookController(Controller):
             return Result()
 
         sts = self._desired_statefulset(nb)
+        is_new = store.try_get("StatefulSet", namespace, name) is None
         reconcile_child(store, nb, sts, copy_spec_and_labels)
+        if is_new and self.metrics is not None:
+            # ref pkg/metrics/metrics.go created counter
+            self.metrics.notebook_created.inc(namespace=namespace)
         svc = self._desired_service(nb)
         reconcile_child(store, nb, svc, copy_spec_and_labels)
         if self.use_routing:

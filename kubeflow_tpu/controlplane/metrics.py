@@ -1,0 +1,174 @@
+"""Prometheus-style metrics: registry, counters/gauges, live collectors.
+
+Capability parity with the reference's three metric surfaces:
+- notebook metrics collector that scrapes live state at collect time
+  (ref notebook-controller/pkg/metrics/metrics.go:22-99 — a custom
+  Collect() lists StatefulSets with the notebook-name label instead of
+  maintaining a gauge imperatively), plus created/culled counters;
+- profile reconcile counters with component/kind/severity labels
+  (ref profile-controller/controllers/monitoring.go:19-77);
+- KFAM request counters + a /metrics route
+  (ref access-management/kfam/monitoring.go, routers.go:82-86).
+
+No prometheus_client dependency: exposition is the stable text format,
+rendered directly. Collectors are callables run at scrape time, so the
+"running notebooks" gauge can never drift from the store's truth.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+from kubeflow_tpu.controlplane.store import Store
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{str(v).replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
+        for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+class Counter:
+    """Monotonic counter with optional labels."""
+
+    def __init__(self, name: str, help: str, registry: "Registry | None" = None):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values: dict[tuple[tuple[str, str], ...], float] = {}
+        if registry is not None:
+            registry.register(self)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def samples(self) -> Iterable[tuple[dict[str, str], float]]:
+        with self._lock:
+            return [(dict(k), v) for k, v in self._values.items()]
+
+    TYPE = "counter"
+
+
+class Gauge(Counter):
+    TYPE = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = value
+
+
+class Registry:
+    """Holds metrics and scrape-time collectors; renders exposition text."""
+
+    def __init__(self):
+        self._metrics: list[Counter] = []
+        self._collectors: list[Callable[[], None]] = []
+        self._lock = threading.Lock()
+
+    def register(self, metric: Counter) -> None:
+        with self._lock:
+            self._metrics.append(metric)
+
+    def register_collector(self, fn: Callable[[], None]) -> None:
+        """`fn` refreshes gauges from live state; runs on every render
+        (the reference's custom Collect→scrape pattern)."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def render(self) -> str:
+        with self._lock:
+            collectors = list(self._collectors)
+            metrics = list(self._metrics)
+        for fn in collectors:
+            fn()
+        lines: list[str] = []
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.TYPE}")
+            # No samples yet → emit nothing (a synthetic unlabeled 0 would
+            # create a timeseries that goes stale once labeled samples
+            # appear; prometheus_client behaves the same way).
+            samples = sorted(m.samples(), key=lambda s: sorted(s[0].items()))
+            for labels, v in samples:
+                num = int(v) if float(v).is_integer() else v
+                lines.append(f"{m.name}{_fmt_labels(labels)} {num}")
+        return "\n".join(lines) + "\n"
+
+
+class ControlPlaneMetrics:
+    """The platform's metric set, wired into controllers at assembly.
+
+    Names keep the reference's vocabulary (notebook_create_total,
+    notebook_cull_total, running gauge scraped live; reconcile counters
+    labeled kind/severity).
+    """
+
+    def __init__(self, store: Store, registry: Registry | None = None):
+        self.registry = registry or Registry()
+        self.store = store
+        self.notebooks_running = Gauge(
+            "notebook_running", "Current running notebooks per namespace "
+            "(scraped live from StatefulSets, ref metrics.go:74-99)",
+            self.registry)
+        self.tpu_hosts_running = Gauge(
+            "tpu_hosts_running", "Current TPU-slice host pods per namespace",
+            self.registry)
+        self.notebook_created = Counter(
+            "notebook_create_total", "Notebook StatefulSets created",
+            self.registry)
+        self.notebook_culled = Counter(
+            "notebook_cull_total", "Notebooks culled for idleness",
+            self.registry)
+        self.reconcile_total = Counter(
+            "reconcile_total", "Reconcile outcomes by controller kind "
+            "(ref monitoring.go:62-77)", self.registry)
+        self.request_total = Counter(
+            "request_total", "HTTP requests by service/method/code "
+            "(ref kfam/monitoring.go)", self.registry)
+        self.registry.register_collector(self._scrape)
+
+    def _scrape(self) -> None:
+        """Live scrape (never drifts): running notebooks = STS with the
+        notebook-name label and ready replicas; TPU hosts = their pods."""
+        running: dict[str, int] = {}
+        hosts: dict[str, int] = {}
+        for sts in self.store.list("StatefulSet"):
+            if "notebook-name" not in sts.metadata.labels:
+                continue
+            ns = sts.metadata.namespace
+            if sts.ready_replicas > 0:
+                running[ns] = running.get(ns, 0) + 1
+                if sts.spec.gang:
+                    hosts[ns] = hosts.get(ns, 0) + sts.ready_replicas
+        # Reset namespaces that emptied out, then set current values.
+        for labels, _ in self.notebooks_running.samples():
+            self.notebooks_running.set(
+                float(running.get(labels.get("namespace", ""), 0)), **labels)
+        for ns, n in running.items():
+            self.notebooks_running.set(float(n), namespace=ns)
+        for labels, _ in self.tpu_hosts_running.samples():
+            self.tpu_hosts_running.set(
+                float(hosts.get(labels.get("namespace", ""), 0)), **labels)
+        for ns, n in hosts.items():
+            self.tpu_hosts_running.set(float(n), namespace=ns)
+
+    # -- hooks for controllers --------------------------------------------
+
+    def record_reconcile(self, kind: str, ok: bool) -> None:
+        self.reconcile_total.inc(kind=kind,
+                                 severity="info" if ok else "error")
+
+    def record_request(self, service: str, method: str, code: int) -> None:
+        self.request_total.inc(service=service, method=method,
+                               code=str(code))

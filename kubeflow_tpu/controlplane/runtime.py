@@ -112,8 +112,9 @@ class Manager:
     """Runs controllers against a store. start()/stop(), or use
     wait_idle() in tests for deterministic settling (envtest-style)."""
 
-    def __init__(self, store: Store):
+    def __init__(self, store: Store, metrics=None):
         self.store = store
+        self.metrics = metrics   # ControlPlaneMetrics | None
         self._controllers: list[tuple[Controller, _WorkQueue]] = []
         self._threads: list[threading.Thread] = []
         self._watch = None
@@ -190,8 +191,13 @@ class Manager:
                 wq.add_rate_limited(key)
             except Exception:
                 log.exception("reconcile %s %s failed", ctrl.KIND, key)
+                # ref monitoring.go:74 IncRequestErrorCounter (severity label)
+                if self.metrics is not None:
+                    self.metrics.record_reconcile(type(ctrl).__name__, False)
                 wq.add_rate_limited(key)
             else:
+                if self.metrics is not None:
+                    self.metrics.record_reconcile(type(ctrl).__name__, True)
                 wq.forget(key)
                 if result and result.requeue_after:
                     wq.add_after(key, result.requeue_after)

@@ -25,9 +25,22 @@ def create_platform_app(
     cluster_admins: set[str] | None = None,
     spawner_config=None,
     csrf: bool = True,
+    metrics=None,
 ) -> web.Application:
     root = create_dashboard_app(store, cluster_admins=cluster_admins, csrf=csrf)
     root["csrf_exempt_prefixes"] = ("/kfam/",)
+    if metrics is not None:
+        # /metrics + request counters (ref kfam routers.go:82-86 exposes
+        # prometheus on the same mux as the API). Outermost middleware so
+        # it also counts authn/CSRF rejections and handler crashes.
+        root["platform_metrics"] = metrics
+        root.middlewares.insert(0, _request_counter_middleware)
+
+        async def render_metrics(_request):
+            return web.Response(text=metrics.registry.render(),
+                                content_type="text/plain")
+
+        root.router.add_get("/metrics", render_metrics)
     root.add_subapp("/jupyter/", create_jupyter_app(
         store, spawner_config=spawner_config, cluster_admins=cluster_admins,
         csrf=csrf))
@@ -38,6 +51,33 @@ def create_platform_app(
     root.add_subapp("/kfam/", create_kfam_app(
         store, cluster_admins=cluster_admins, csrf=False))
     return root
+
+
+# Bounded label set: unknown first segments (scanners, typos) bucket to
+# "other" so request_total cardinality can't grow without limit.
+_KNOWN_SERVICES = frozenset(
+    {"api", "jupyter", "volumes", "tensorboards", "kfam", "metrics",
+     "healthz", "readyz", "dashboard"})
+
+
+@web.middleware
+async def _request_counter_middleware(request: web.Request, handler):
+    metrics = request.config_dict.get("platform_metrics")
+    segment = request.path.split("/")[1] or "dashboard"
+    service = segment if segment in _KNOWN_SERVICES else "other"
+    try:
+        resp = await handler(request)
+    except web.HTTPException as exc:
+        if metrics is not None:
+            metrics.record_request(service, request.method, exc.status)
+        raise
+    except Exception:
+        if metrics is not None:
+            metrics.record_request(service, request.method, 500)
+        raise
+    if metrics is not None:
+        metrics.record_request(service, request.method, resp.status)
+    return resp
 
 
 def main() -> None:  # pragma: no cover - manual entry point

@@ -159,6 +159,46 @@ def test_cluster_proxy_env_injection(cluster):
     assert mirrored.data["ca-bundle.crt"] == "FAKE-CA"
 
 
+def test_trusted_ca_recreated_when_deleted(cluster):
+    ca = ConfigMap(data={"ca-bundle.crt": "FAKE-CA"})
+    ca.metadata.name = gw.TRUSTED_CA_CONFIGMAP
+    ca.metadata.namespace = gw.SYSTEM_NAMESPACE
+    cluster.store.create(ca)
+    cluster.store.create(mk_notebook())
+    assert cluster.wait_idle()
+    assert cluster.store.get("ConfigMap", "user1", gw.TRUSTED_CA_CONFIGMAP)
+    cluster.store.delete("ConfigMap", "user1", gw.TRUSTED_CA_CONFIGMAP)
+    assert cluster.wait_idle()
+    # WATCHES=("ConfigMap",) re-enqueues the notebook: mirror comes back
+    assert cluster.store.get("ConfigMap", "user1", gw.TRUSTED_CA_CONFIGMAP)
+
+
+def test_lock_wait_budget_expires_then_force_unlocks():
+    """Without the pull-secret webhook, the gate waits out its budget then
+    unlocks anyway (ref swallows the wait error and removes the lock)."""
+    from kubeflow_tpu.controlplane.controllers.gateway import (
+        GatewayNotebookController,
+        NotebookGatewayWebhook,
+    )
+    from kubeflow_tpu.controlplane.store import Store
+
+    store = Store()
+    store.register_mutating_webhook("Notebook", NotebookGatewayWebhook(store))
+    t = [0.0]
+    ctrl = GatewayNotebookController(lock_wait_budget=10.0, clock=lambda: t[0])
+    nb = mk_notebook("slow", auth=True)
+    store.create(nb)
+    res = ctrl.reconcile(store, "user1", "slow")
+    # SA exists but has no pull secret (no platform webhook): still locked
+    assert res.requeue_after is not None
+    assert STOP_ANNOTATION in store.get(
+        "Notebook", "user1", "slow").metadata.annotations
+    t[0] = 11.0
+    ctrl.reconcile(store, "user1", "slow")
+    assert STOP_ANNOTATION not in store.get(
+        "Notebook", "user1", "slow").metadata.annotations
+
+
 def test_gang_notebook_gated_by_lock(cluster):
     """TPU twist: the lock gates the WHOLE gang — no partial slice starts
     before the control plane unlocks."""

@@ -1,0 +1,120 @@
+"""Metrics subsystem: live-scrape collectors, counters, exposition text,
+/metrics route (ref pkg/metrics/metrics.go, monitoring.go, kfam
+monitoring + routers.go:82-86)."""
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubeflow_tpu.api.core import Container, PodTemplateSpec
+from kubeflow_tpu.api.crds import Notebook, STOP_ANNOTATION
+from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
+from kubeflow_tpu.controlplane.controllers.culler import Culler, KernelStatus
+from kubeflow_tpu.controlplane.metrics import (
+    ControlPlaneMetrics,
+    Counter,
+    Gauge,
+    Registry,
+)
+from kubeflow_tpu.controlplane.store import Store
+
+pytest_plugins = ("aiohttp.pytest_plugin",)
+
+
+def mk_notebook(name="nb1", ns="user1", topology=""):
+    nb = Notebook()
+    nb.metadata.name = name
+    nb.metadata.namespace = ns
+    nb.spec.template = PodTemplateSpec()
+    nb.spec.template.spec.containers.append(
+        Container(name=name, image="kubeflow-tpu/jupyter-jax:latest"))
+    nb.spec.tpu.topology = topology
+    return nb
+
+
+def test_counter_and_render_format():
+    reg = Registry()
+    c = Counter("requests_total", "Requests", reg)
+    c.inc(code="200", method="GET")
+    c.inc(code="200", method="GET")
+    c.inc(code="404", method="GET")
+    g = Gauge("temperature", "Temp", reg)
+    g.set(3.5)
+    text = reg.render()
+    assert "# TYPE requests_total counter" in text
+    assert 'requests_total{code="200",method="GET"} 2' in text
+    assert 'requests_total{code="404",method="GET"} 1' in text
+    assert "# TYPE temperature gauge" in text
+    assert "temperature 3.5" in text
+
+
+def test_running_gauge_scrapes_live_state():
+    with Cluster(ClusterConfig(tpu_slices={"v5e-16": 1})) as cluster:
+        cluster.store.create(mk_notebook("a"))
+        cluster.store.create(mk_notebook("big", topology="v5e-16"))
+        assert cluster.wait_idle()
+        text = cluster.metrics.registry.render()
+        assert 'notebook_running{namespace="user1"} 2' in text
+        assert 'tpu_hosts_running{namespace="user1"} 4' in text
+        assert 'notebook_create_total{namespace="user1"} 2' in text
+
+        # Stop one: the gauge follows the live state on next render
+        # (ref metrics.go Collect→scrape, never drifts).
+        nb = cluster.store.get("Notebook", "user1", "big")
+        nb.metadata.annotations[STOP_ANNOTATION] = "now"
+        cluster.store.update(nb)
+        assert cluster.wait_idle()
+        text = cluster.metrics.registry.render()
+        assert 'notebook_running{namespace="user1"} 1' in text
+        assert 'tpu_hosts_running{namespace="user1"} 0' in text
+        # created is a counter: unchanged by the stop
+        assert 'notebook_create_total{namespace="user1"} 2' in text
+
+
+def test_reconcile_counters():
+    with Cluster(ClusterConfig()) as cluster:
+        cluster.store.create(mk_notebook())
+        assert cluster.wait_idle()
+        assert cluster.metrics.reconcile_total.value(
+            kind="NotebookController", severity="info") > 0
+        assert cluster.metrics.reconcile_total.value(
+            kind="NotebookController", severity="error") == 0
+
+
+def test_culled_counter():
+    store = Store()
+    metrics = ControlPlaneMetrics(store)
+
+    class Probe:
+        def kernels(self, namespace, name):
+            return [KernelStatus("idle", 0.0)]
+
+    t = [1000.0]
+    culler = Culler(Probe(), idle_time=600.0, check_period=60.0,
+                    clock=lambda: t[0], metrics=metrics)
+    store.create(mk_notebook("nb", ns="u"))
+    culler.reconcile(store, "u", "nb")
+    t[0] += 601
+    culler.reconcile(store, "u", "nb")
+    assert metrics.notebook_culled.value(namespace="u") == 1
+
+
+@pytest.fixture()
+async def env(loop):
+    cluster = Cluster(ClusterConfig(tpu_slices={"v5e-1": 4})).start()
+    app = cluster.create_web_app(csrf=False)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    yield cluster, client
+    await client.close()
+    cluster.stop()
+
+
+async def test_metrics_route_and_request_counter(env):
+    cluster, client = env
+    headers = {"kubeflow-userid": "alice@example.com"}
+    await client.get("/api/namespaces", headers=headers)
+    r = await client.get("/metrics")
+    assert r.status == 200
+    text = await r.text()
+    assert "# TYPE request_total counter" in text
+    assert 'service="api"' in text
