@@ -6,3 +6,4 @@ from kubeflow_tpu.train.trainer import (
     TrainConfig,
     cross_entropy_loss,
 )
+from kubeflow_tpu.train.checkpoint import CheckpointConfig, Checkpointer
