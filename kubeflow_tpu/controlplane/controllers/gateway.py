@@ -246,13 +246,33 @@ class GatewayNotebookController(Controller):
         self.gateway_domain = gateway_domain
         self.lock_wait_budget = lock_wait_budget
         self.clock = clock or time.monotonic
-        # (ns, name) -> monotonic deadline for pull-secret visibility.
-        self._lock_deadlines: dict[tuple[str, str], float] = {}
+        # (ns, name) -> (uid, monotonic deadline) for pull-secret
+        # visibility. The uid pins the deadline to one incarnation of the
+        # notebook: delete+recreate may coalesce into a single reconcile
+        # in the dedup workqueue, so the NotFound cleanup can be skipped
+        # entirely — a uid mismatch must start a fresh wait.
+        self._lock_deadlines: dict[tuple[str, str], tuple[str, float]] = {}
+
+    def watch_fanout_namespace(self, obj):
+        """The source trusted-CA bundle lives in the system namespace but
+        is mirrored into every notebook namespace — its updates must
+        re-enqueue notebooks cluster-wide. Everything else (mirrors,
+        unrelated system ConfigMaps) stays namespace-scoped to avoid
+        O(all-notebooks) fan-out per event."""
+        ns = obj.metadata.namespace or None
+        if (ns == SYSTEM_NAMESPACE
+                and obj.metadata.name == TRUSTED_CA_CONFIGMAP):
+            return None
+        return ns
 
     def reconcile(self, store: Store, namespace: str, name: str) -> Result:
         try:
             nb = store.get("Notebook", namespace, name)
         except NotFound:
+            # Drop any pending lock-wait deadline: a recreated same-name
+            # notebook must start a fresh pull-secret wait, not inherit
+            # an expired one and unlock immediately.
+            self._lock_deadlines.pop((namespace, name), None)
             return Result()
         assert isinstance(nb, Notebook)
 
@@ -381,8 +401,12 @@ class GatewayNotebookController(Controller):
         assert isinstance(fresh, Notebook)
         if not ready:
             now = self.clock()
-            deadline = self._lock_deadlines.setdefault(
-                key, now + self.lock_wait_budget)
+            uid = fresh.metadata.uid
+            entry = self._lock_deadlines.get(key)
+            if entry is None or entry[0] != uid:
+                entry = (uid, now + self.lock_wait_budget)
+                self._lock_deadlines[key] = entry
+            deadline = entry[1]
             if now < deadline:
                 return Result(requeue_after=min(1.0, deadline - now))
         del fresh.metadata.annotations[STOP_ANNOTATION]

@@ -23,11 +23,19 @@ from typing import Callable, Iterable
 from kubeflow_tpu.controlplane.store import Store
 
 
+def _escape_label_value(v: str) -> str:
+    # Prometheus exposition format: backslash, double-quote and newline
+    # must be escaped inside label values.
+    return (
+        v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
     inner = ",".join(
-        f'{k}="{str(v).replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
+        f'{k}="{_escape_label_value(str(v))}"'
         for k, v in sorted(labels.items())
     )
     return "{" + inner + "}"
@@ -165,9 +173,13 @@ class ControlPlaneMetrics:
 
     # -- hooks for controllers --------------------------------------------
 
-    def record_reconcile(self, kind: str, ok: bool) -> None:
-        self.reconcile_total.inc(kind=kind,
-                                 severity="info" if ok else "error")
+    def record_reconcile(self, kind: str, ok: bool, *,
+                         severity: str | None = None) -> None:
+        """severity overrides the ok→info/error mapping (e.g. "conflict"
+        for optimistic-concurrency retries, which are neither)."""
+        self.reconcile_total.inc(
+            kind=kind,
+            severity=severity or ("info" if ok else "error"))
 
     def record_request(self, service: str, method: str, code: int) -> None:
         self.request_total.inc(service=service, method=method,

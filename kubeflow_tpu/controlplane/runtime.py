@@ -38,10 +38,17 @@ class Controller:
 
     KIND: str = ""                 # primary kind
     OWNS: tuple[str, ...] = ()     # owned kinds: events map back to owner
-    WATCHES: tuple[str, ...] = ()  # extra kinds: enqueue ALL primaries
+    WATCHES: tuple[str, ...] = ()  # extra kinds: enqueue primaries in scope
 
     def reconcile(self, store: Store, namespace: str, name: str) -> Result:
         raise NotImplementedError
+
+    def watch_fanout_namespace(self, obj: Resource) -> str | None:
+        """Which namespace's primaries a WATCHES event re-enqueues.
+        Default: the event object's own namespace (keeps fan-out O(ns),
+        not O(cluster)). Return None for a cluster-wide fan-out — e.g. a
+        system-namespace source object mirrored into every namespace."""
+        return obj.metadata.namespace or None
 
 
 class _WorkQueue:
@@ -169,10 +176,7 @@ class Manager:
                     if ref.kind == ctrl.KIND:
                         wq.add((obj.metadata.namespace, ref.name))
             elif obj.kind in ctrl.WATCHES:
-                # Scope the fan-out to the event object's namespace — a
-                # cluster-wide enqueue per watched object would make every
-                # Event O(all primaries).
-                ns = obj.metadata.namespace or None
+                ns = ctrl.watch_fanout_namespace(obj)
                 for primary in self.store.list(ctrl.KIND, ns):
                     wq.add((primary.metadata.namespace, primary.metadata.name))
 
@@ -188,6 +192,11 @@ class Manager:
             try:
                 result = ctrl.reconcile(self.store, key[0], key[1])
             except Conflict:
+                # A conflict retry is neither success nor failure, but a
+                # sustained storm must be visible on reconcile_total.
+                if self.metrics is not None:
+                    self.metrics.record_reconcile(
+                        type(ctrl).__name__, False, severity="conflict")
                 wq.add_rate_limited(key)
             except Exception:
                 log.exception("reconcile %s %s failed", ctrl.KIND, key)

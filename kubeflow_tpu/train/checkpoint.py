@@ -21,7 +21,6 @@ import dataclasses
 from typing import Any, Mapping
 
 import jax
-import numpy as np
 import orbax.checkpoint as ocp
 
 from kubeflow_tpu.train.trainer import Trainer, TrainState
@@ -91,16 +90,14 @@ class Checkpointer:
 
     def abstract_state(self) -> dict[str, Any]:
         """ShapeDtypeStructs + NamedShardings describing the state tree."""
-        t = self.trainer
-        shapes = jax.eval_shape(
-            t._init, jax.ShapeDtypeStruct((2,), np.uint32)
-        )
-        shardings = t.state_shardings
-
         def abstr(leaf, sh):
             return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=sh)
 
-        return jax.tree.map(abstr, _to_tree(shapes), _to_tree(shardings))
+        return jax.tree.map(
+            abstr,
+            _to_tree(self.trainer.state_shapes),
+            _to_tree(self.trainer.state_shardings),
+        )
 
     def restore(self, step: int | None = None) -> TrainState:
         if step is None:

@@ -124,6 +124,12 @@ class Trainer:
         self.state_shardings = TrainState(
             self.param_shardings, self.opt_shardings, NamedSharding(mesh, P())
         )
+        # Abstract state tree (ShapeDtypeStructs), the public handle for
+        # checkpoint restore targets — keeps callers off _init.
+        self.state_shapes = TrainState(
+            params_shapes, opt_shapes,
+            jax.ShapeDtypeStruct((), jnp.int32),
+        )
         self.batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), None))
 
         self._jit_init = jax.jit(self._init, out_shardings=self.state_shardings)

@@ -209,3 +209,81 @@ def test_gang_notebook_gated_by_lock(cluster):
     pods = cluster.store.list("Pod", "user1",
                               label_selector={"notebook-name": "big"})
     assert len(pods) == 4
+
+
+def test_ca_rotation_in_system_namespace_propagates(cluster):
+    """Updating the SOURCE bundle (system namespace) must refresh every
+    user-namespace mirror — cluster-wide fan-out, not namespace-scoped."""
+    ca = ConfigMap(data={"ca-bundle.crt": "CA-V1"})
+    ca.metadata.name = gw.TRUSTED_CA_CONFIGMAP
+    ca.metadata.namespace = gw.SYSTEM_NAMESPACE
+    cluster.store.create(ca)
+    cluster.store.create(mk_notebook())
+    assert cluster.wait_idle()
+    assert cluster.store.get(
+        "ConfigMap", "user1", gw.TRUSTED_CA_CONFIGMAP
+    ).data["ca-bundle.crt"] == "CA-V1"
+
+    src = cluster.store.get("ConfigMap", gw.SYSTEM_NAMESPACE,
+                            gw.TRUSTED_CA_CONFIGMAP)
+    src.data = {"ca-bundle.crt": "CA-V2-ROTATED"}
+    cluster.store.update(src)
+    assert cluster.wait_idle()
+    assert cluster.store.get(
+        "ConfigMap", "user1", gw.TRUSTED_CA_CONFIGMAP
+    ).data["ca-bundle.crt"] == "CA-V2-ROTATED"
+
+
+def test_recreated_notebook_gets_fresh_lock_wait():
+    """Delete + recreate same-name notebook: the new one must not inherit
+    the old (expired) lock-wait deadline and unlock instantly."""
+    from kubeflow_tpu.controlplane.controllers.gateway import (
+        GatewayNotebookController,
+        NotebookGatewayWebhook,
+    )
+    from kubeflow_tpu.controlplane.store import Store
+
+    store = Store()
+    store.register_mutating_webhook("Notebook", NotebookGatewayWebhook(store))
+    t = [0.0]
+    ctrl = GatewayNotebookController(lock_wait_budget=10.0, clock=lambda: t[0])
+    store.create(mk_notebook("nb", auth=True))
+    ctrl.reconcile(store, "user1", "nb")          # starts the wait at t=0
+    t[0] = 50.0                                    # way past the budget
+    store.delete("Notebook", "user1", "nb")
+    ctrl.reconcile(store, "user1", "nb")          # delete-event reconcile
+
+    store.create(mk_notebook("nb", auth=True))    # recreated, re-locked
+    res = ctrl.reconcile(store, "user1", "nb")
+    # Fresh wait: still locked, requeued — NOT force-unlocked.
+    assert res.requeue_after is not None
+    assert STOP_ANNOTATION in store.get(
+        "Notebook", "user1", "nb").metadata.annotations
+    t[0] = 61.0                                    # budget elapses again
+    ctrl.reconcile(store, "user1", "nb")
+    assert STOP_ANNOTATION not in store.get(
+        "Notebook", "user1", "nb").metadata.annotations
+
+
+def test_coalesced_delete_recreate_still_fresh_wait():
+    """Delete+recreate that coalesces into ONE reconcile (dedup workqueue)
+    must still start a fresh lock wait — the deadline is uid-pinned."""
+    from kubeflow_tpu.controlplane.controllers.gateway import (
+        GatewayNotebookController,
+        NotebookGatewayWebhook,
+    )
+    from kubeflow_tpu.controlplane.store import Store
+
+    store = Store()
+    store.register_mutating_webhook("Notebook", NotebookGatewayWebhook(store))
+    t = [0.0]
+    ctrl = GatewayNotebookController(lock_wait_budget=10.0, clock=lambda: t[0])
+    store.create(mk_notebook("nb", auth=True))
+    ctrl.reconcile(store, "user1", "nb")          # deadline pinned to uid A
+    t[0] = 50.0
+    store.delete("Notebook", "user1", "nb")
+    store.create(mk_notebook("nb", auth=True))    # uid B; no reconcile between
+    res = ctrl.reconcile(store, "user1", "nb")    # the single coalesced run
+    assert res.requeue_after is not None
+    assert STOP_ANNOTATION in store.get(
+        "Notebook", "user1", "nb").metadata.annotations
