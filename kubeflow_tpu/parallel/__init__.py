@@ -25,3 +25,17 @@ from kubeflow_tpu.parallel.ring import (
     ulysses_attention,
     ulysses_attention_sharded,
 )
+# NOTE: the bare `pipeline` schedule fn is NOT re-exported — it would
+# shadow the `kubeflow_tpu.parallel.pipeline` submodule name.
+from kubeflow_tpu.parallel.pipeline import (
+    pipeline_sharded,
+    stack_stage_params,
+)
+from kubeflow_tpu.parallel.moe import (
+    MoEConfig,
+    init_moe,
+    moe_logical_axes,
+    moe_mlp,
+    moe_mlp_expert_parallel,
+    moe_mlp_sharded,
+)

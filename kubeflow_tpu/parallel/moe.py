@@ -1,0 +1,238 @@
+"""Mixture-of-Experts with expert parallelism over ICI.
+
+The reference has no MoE/parallelism code (SURVEY.md §2b row "Expert
+parallelism (EP/MoE)": "pjit expert axis + ragged all-to-all over ICI").
+This module supplies both TPU execution styles:
+
+- `moe_mlp` — the GSPMD path: capacity-based top-k dispatch expressed as
+  dense einsums. Under pjit with the experts dim sharded (logical axis
+  "experts" → tensor), XLA partitions the expert computation and inserts
+  the collectives itself. Zero hand-written communication; best when the
+  expert dim is sharded over the same axis as the rest of the layer.
+
+- `moe_mlp_expert_parallel` / `moe_mlp_sharded` — the explicit-EP path:
+  `shard_map` over an expert axis; tokens are dispatched to the devices
+  owning their experts with `jax.lax.all_to_all` (the TPU equivalent of
+  the ragged a2a), computed, and returned. Deliberately explicit because
+  GSPMD cannot infer the token→expert shuffle without materializing the
+  full dispatch tensor on every device.
+
+Routing is standard top-k softmax gating with per-expert capacity
+(drop-overflow) and the Switch-style load-balancing auxiliary loss.
+Everything is static-shaped: capacity is a compile-time constant, drops
+are masked writes — no dynamic shapes under jit (XLA requirement).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubeflow_tpu.parallel import mesh as mesh_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    embed_dim: int = 512
+    mlp_dim: int = 1024          # per-expert hidden dim (SwiGLU)
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+    def capacity(self, n_tokens: int) -> int:
+        """Per-expert token slots; static given a static token count."""
+        cap = int(self.capacity_factor * n_tokens * self.top_k
+                  / self.num_experts)
+        return max(cap, self.top_k)
+
+
+def init_moe(rng: jax.Array, cfg: MoEConfig) -> dict[str, jnp.ndarray]:
+    kr, kg, ku, kd = jax.random.split(rng, 4)
+    d, m, e = cfg.embed_dim, cfg.mlp_dim, cfg.num_experts
+    s = d ** -0.5
+    return {
+        "router": (jax.random.normal(kr, (d, e)) * s).astype(cfg.dtype),
+        "w_gate": (jax.random.normal(kg, (e, d, m)) * s).astype(cfg.dtype),
+        "w_up": (jax.random.normal(ku, (e, d, m)) * s).astype(cfg.dtype),
+        "w_down": (jax.random.normal(kd, (e, m, d)) * (m ** -0.5)).astype(cfg.dtype),
+    }
+
+
+def moe_logical_axes() -> dict[str, tuple[str | None, ...]]:
+    """Logical axes for sharding.py rules ("experts" → tensor by default)."""
+    return {
+        "router": ("embed", None),
+        "w_gate": ("experts", "embed", None),
+        "w_up": ("experts", "embed", None),
+        "w_down": ("experts", None, "embed"),
+    }
+
+
+def _route(router_logits: jnp.ndarray, cfg: MoEConfig, capacity: int):
+    """Top-k routing with capacity. logits: [T, E] (fp32 recommended).
+
+    Returns:
+      dispatch: [T, E, C] one-hot bool — token t occupies slot c of expert e
+      combine:  [T, E, C] float — dispatch weighted by router probability
+      aux:      scalar load-balancing loss (Switch Transformer eq. 4-6)
+    """
+    T, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, cfg.top_k)   # [T, k]
+
+    # Slot assignment: for the flattened (k, T) priority order, each
+    # expert's tokens take consecutive slots. Rank-0 choices across all
+    # tokens outrank rank-1 choices (Switch convention) so a token's
+    # primary expert is dropped last.
+    expert_onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # [T,k,E]
+    prio = expert_onehot.transpose(1, 0, 2).reshape(cfg.top_k * T, E)
+    pos_in_expert = jnp.cumsum(prio, axis=0) - prio               # [kT, E]
+    pos = pos_in_expert.reshape(cfg.top_k, T, E).transpose(1, 0, 2)
+    slot = jnp.sum(pos * expert_onehot, axis=-1)                  # [T, k]
+    keep = slot < capacity
+
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    disp = jnp.zeros((T, E, capacity), bool)
+    t_idx = jnp.arange(T)[:, None].repeat(cfg.top_k, 1)
+    safe_slot = jnp.where(keep, slot, 0)
+    combine = combine.at[
+        t_idx.ravel(), gate_idx.ravel(), safe_slot.ravel()
+    ].add(jnp.where(keep, gate_vals, 0.0).ravel())
+    disp = disp.at[
+        t_idx.ravel(), gate_idx.ravel(), safe_slot.ravel()
+    ].max(keep.ravel())
+
+    # Load-balance aux: E * sum_e( fraction_routed_e * mean_prob_e ).
+    frac = jnp.mean(
+        jnp.sum(expert_onehot, axis=1).astype(jnp.float32), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_prob)
+    return disp, combine, aux
+
+
+def _expert_ffn(params, x_ecd: jnp.ndarray) -> jnp.ndarray:
+    """Per-expert SwiGLU. x: [E, C, d] → [E, C, d]; E is a batched einsum
+    dim so every expert's matmuls hit the MXU in one fused call."""
+    gate = jnp.einsum("ecd,edm->ecm", x_ecd, params["w_gate"])
+    up = jnp.einsum("ecd,edm->ecm", x_ecd, params["w_up"])
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up
+    return jnp.einsum("ecm,emd->ecd", act, params["w_down"])
+
+
+def moe_mlp(
+    params: dict[str, jnp.ndarray],
+    x: jnp.ndarray,            # [b, s, d]
+    cfg: MoEConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """GSPMD MoE layer: (output [b,s,d], aux loss). Shard params' experts
+    dim via moe_logical_axes(); XLA inserts the collectives."""
+    b, s, d = x.shape
+    xt = x.reshape(b * s, d)
+    capacity = cfg.capacity(b * s)
+    logits = xt.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    disp, combine, aux = _route(logits, cfg, capacity)
+    # [T,E,C] x [T,d] → [E,C,d]: the dispatch einsum
+    xe = jnp.einsum("tec,td->ecd", disp.astype(x.dtype), xt)
+    ye = _expert_ffn(params, xe)
+    y = jnp.einsum("tec,ecd->td", combine.astype(ye.dtype), ye)
+    return y.reshape(b, s, d).astype(x.dtype), aux
+
+
+def moe_mlp_expert_parallel(
+    params: dict[str, jnp.ndarray],   # experts dim LOCAL (E/N per device)
+    x: jnp.ndarray,                   # [b_local, s, d] tokens LOCAL
+    cfg: MoEConfig,
+    *,
+    axis_name: str,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Explicit expert parallelism. Call inside shard_map.
+
+    Each device routes its local tokens against ALL experts (router
+    weights replicated), builds capacity-bounded dispatch buffers, then a
+    single `all_to_all` moves each expert-group's slots to the device
+    owning those experts — the ragged all-to-all of SURVEY §2b, made
+    rectangular by the capacity bound so shapes stay static. A second
+    all_to_all returns expert outputs to the tokens' home devices.
+    """
+    n = jax.lax.psum(1, axis_name)
+    b, s, d = x.shape
+    T = b * s
+    e_local = params["w_gate"].shape[0]
+    E = e_local * n
+    xt = x.reshape(T, d)
+    capacity = cfg.capacity(T)
+
+    logits = xt.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    disp, combine, aux = _route(logits, cfg, capacity)
+
+    # Local dispatch buffers for every (global) expert: [E, C, d].
+    xe = jnp.einsum("tec,td->ecd", disp.astype(x.dtype), xt)
+    # a2a #1: split expert dim into N groups, concat along slots →
+    # [E/N, N*C, d]: this device now holds ITS experts' slots from all
+    # devices.
+    xe = jax.lax.all_to_all(
+        xe, axis_name, split_axis=0, concat_axis=1, tiled=True
+    )
+    ye = _expert_ffn(params, xe)
+    # a2a #2 (inverse): [E/N, N*C, d] → [E, C, d] back on token owners.
+    ye = jax.lax.all_to_all(
+        ye, axis_name, split_axis=1, concat_axis=0, tiled=True
+    )
+    y = jnp.einsum("tec,ecd->td", combine.astype(ye.dtype), ye)
+    # Aux is a per-device statistic over local tokens; average globally so
+    # the EP loss matches the single-device computation in expectation.
+    aux = jax.lax.pmean(aux, axis_name)
+    return y.reshape(b, s, d).astype(x.dtype), aux
+
+
+def moe_mlp_sharded(
+    params: dict[str, jnp.ndarray],
+    x: jnp.ndarray,               # [b, s, d] global
+    cfg: MoEConfig,
+    mesh: Mesh,
+    *,
+    expert_axis: str = mesh_lib.TENSOR_AXIS,
+    batch_axes: tuple[str, ...] = (
+        mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS, mesh_lib.TENSOR_AXIS,
+    ),
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """shard_map wrapper: batch sharded over `batch_axes`, experts over
+    `expert_axis` (EP reuses the tensor device axis per mesh.py).
+
+    The expert axis is deliberately also a batch axis (the classic EP
+    layout): tokens and experts shard along the same devices, so the
+    all-to-alls move only the dispatched slots — no token replication.
+    """
+    n = mesh.shape[expert_axis]
+    if cfg.num_experts % n:
+        raise ValueError(
+            f"num_experts={cfg.num_experts} not divisible by "
+            f"{expert_axis}={n}"
+        )
+    n_batch = math.prod(mesh.shape[a] for a in batch_axes)
+    if x.shape[0] % max(1, n_batch):
+        raise ValueError(f"batch {x.shape[0]} not divisible by {batch_axes}")
+    param_specs = {
+        "router": P(),
+        "w_gate": P(expert_axis),
+        "w_up": P(expert_axis),
+        "w_down": P(expert_axis),
+    }
+    x_spec = P(batch_axes, None, None)
+    fn = jax.shard_map(
+        functools.partial(
+            moe_mlp_expert_parallel, cfg=cfg, axis_name=expert_axis
+        ),
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=(x_spec, P()),
+        check_vma=False,
+    )
+    return fn(params, x)

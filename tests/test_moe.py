@@ -1,0 +1,98 @@
+"""MoE routing + expert parallelism on the fake-TPU 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.parallel import MeshSpec, create_mesh
+from kubeflow_tpu.parallel import moe as moe_lib
+from kubeflow_tpu.parallel.moe import MoEConfig, init_moe
+
+
+CFG = MoEConfig(num_experts=8, top_k=2, embed_dim=32, mlp_dim=64,
+                capacity_factor=8.0)  # generous: no drops → exact routing
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_moe(jax.random.key(0), CFG)
+
+
+def _x(b=8, s=16, d=32, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).normal(size=(b, s, d)), jnp.float32
+    )
+
+
+def naive_moe(params, x, cfg):
+    """Reference: every token sees its top-k experts at full precision."""
+    b, s, d = x.shape
+    xt = x.reshape(-1, d)
+    probs = jax.nn.softmax(xt @ params["router"], axis=-1)
+    vals, idx = jax.lax.top_k(probs, cfg.top_k)
+    # All experts on all tokens: [E, T, d]
+    gate = jnp.einsum("td,edm->etm", xt, params["w_gate"])
+    up = jnp.einsum("td,edm->etm", xt, params["w_up"])
+    act = jax.nn.silu(gate) * up
+    ye = jnp.einsum("etm,emd->etd", act, params["w_down"])
+    sel = ye[idx.T, jnp.arange(xt.shape[0])[None, :]]  # [k, T, d]
+    out = jnp.sum(vals.T[..., None] * sel, axis=0)
+    return out.reshape(b, s, d)
+
+
+def test_dense_matches_naive(params):
+    x = _x()
+    y, aux = moe_lib.moe_mlp(params, x, CFG)
+    y_ref = naive_moe(params, x, CFG)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-4, atol=1e-4)
+    assert float(aux) > 0.0
+
+
+def test_capacity_drops_tokens(params):
+    """With a tight capacity some second-choice tokens are dropped — the
+    output diverges from the full computation but stays finite."""
+    tight = MoEConfig(num_experts=8, top_k=2, embed_dim=32, mlp_dim=64,
+                      capacity_factor=0.25)
+    x = _x()
+    y, aux = moe_lib.moe_mlp(params, x, tight)
+    assert np.all(np.isfinite(np.asarray(y)))
+    y_ref = naive_moe(params, x, tight)
+    assert not np.allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+
+
+def test_expert_parallel_matches_dense(params):
+    """EP over the 2-wide tensor axis (tokens+experts co-sharded) must
+    reproduce the dense GSPMD path when nothing is dropped."""
+    mesh = create_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    x = _x()
+    y_dense, _ = moe_lib.moe_mlp(params, x, CFG)
+    y_ep, aux = moe_lib.moe_mlp_sharded(params, x, CFG, mesh)
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_dense),
+                               rtol=1e-4, atol=1e-4)
+    assert float(aux) > 0.0
+
+
+def test_expert_parallel_grads_flow(params):
+    """EP path must be differentiable end-to-end (training usability)."""
+    mesh = create_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    x = _x(b=8, s=4)
+
+    def loss(p):
+        y, aux = moe_lib.moe_mlp_sharded(p, x, CFG, mesh)
+        return jnp.sum(y**2) + 0.01 * aux
+
+    grads = jax.grad(loss)(params)
+    for k, g in grads.items():
+        assert np.all(np.isfinite(np.asarray(g))), k
+    # Router must receive gradient through the combine weights.
+    assert float(jnp.max(jnp.abs(grads["router"]))) > 0.0
+
+
+def test_divisibility_errors(params):
+    mesh = create_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    bad = MoEConfig(num_experts=5, top_k=2, embed_dim=32, mlp_dim=64)
+    with pytest.raises(ValueError, match="not divisible"):
+        moe_lib.moe_mlp_sharded(init_moe(jax.random.key(1), bad), _x(),
+                                bad, mesh)
