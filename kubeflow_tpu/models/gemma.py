@@ -1,0 +1,161 @@
+"""Gemma family, TPU-first (BASELINE config "Gemma-2B jax2tf serving").
+
+Same stacked-layers/`lax.scan` + logical-axes design as models/llama.py;
+the Gemma-specific differences are kept explicit:
+  - tied embeddings ALWAYS, with sqrt(hidden) embedding scaling;
+  - GeGLU MLP (gelu gate, not silu);
+  - multi-query attention (num_kv_heads=1 for 2B), head_dim 256;
+  - rope theta 10000, norm eps 1e-6.
+
+Reference parity: the reference serves models via the (removed)
+TF-Serving path (`/root/reference/docs_dev/tf_serving.md:1-60`); this is
+the model that kubeflow_tpu.serving exports the TPU-native way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.ops.attention import dot_product_attention
+from kubeflow_tpu.ops.norms import rms_norm
+from kubeflow_tpu.ops.rotary import apply_rope, rope_frequencies
+from kubeflow_tpu.parallel.sharding import with_sharding_constraint as wsc
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmaConfig:
+    vocab_size: int = 256128
+    hidden_size: int = 2048
+    intermediate_size: int = 16384
+    num_layers: int = 18
+    num_heads: int = 8
+    num_kv_heads: int = 1
+    head_dim: int = 256
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+
+GEMMA_2B = GemmaConfig()
+GEMMA_TINY = GemmaConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=512, num_layers=2,
+    num_heads=4, num_kv_heads=1, head_dim=32, dtype=jnp.float32, remat=False,
+)
+
+CONFIGS = {"gemma-2b": GEMMA_2B, "tiny": GEMMA_TINY}
+
+
+def param_logical_axes(cfg: GemmaConfig) -> Params:
+    return {
+        "embed": ("vocab", "embed"),
+        "blocks": {
+            "attn_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", "embed"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+    }
+
+
+def init(rng: jax.Array, cfg: GemmaConfig) -> Params:
+    keys = iter(jax.random.split(rng, 16))
+    pd = cfg.param_dtype
+
+    def dense(key, shape, fan_in):
+        return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(pd)
+
+    L, D = cfg.num_layers, cfg.hidden_size
+    return {
+        "embed": dense(next(keys), (cfg.vocab_size, D), D),
+        "blocks": {
+            "attn_norm": jnp.zeros((L, D), pd),
+            "wq": dense(next(keys), (L, D, cfg.q_dim), D),
+            "wk": dense(next(keys), (L, D, cfg.kv_dim), D),
+            "wv": dense(next(keys), (L, D, cfg.kv_dim), D),
+            "wo": dense(next(keys), (L, cfg.q_dim, D), cfg.q_dim),
+            "mlp_norm": jnp.zeros((L, D), pd),
+            "w_gate": dense(next(keys), (L, D, cfg.intermediate_size), D),
+            "w_up": dense(next(keys), (L, D, cfg.intermediate_size), D),
+            "w_down": dense(next(keys), (L, cfg.intermediate_size, D),
+                            cfg.intermediate_size),
+        },
+        "final_norm": jnp.zeros((D,), pd),
+    }
+
+
+def _block(cfg: GemmaConfig, x, p, positions, inv_freq, kv_mask,
+           contiguous_positions=False):
+    b, s, D = x.shape
+    h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+    q = (h @ p["wq"].astype(cfg.dtype)).reshape(b, s, cfg.num_heads, cfg.head_dim)
+    k = (h @ p["wk"].astype(cfg.dtype)).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    v = (h @ p["wv"].astype(cfg.dtype)).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    q = wsc(q, ("batch", "seq", "act_heads", None))
+    attn = dot_product_attention(q, k, v, positions, positions,
+                                 causal=True, kv_mask=kv_mask,
+                                 contiguous_positions=contiguous_positions)
+    x = x + attn.reshape(b, s, cfg.q_dim) @ p["wo"].astype(cfg.dtype)
+    x = wsc(x, ("batch", "seq", "act_embed"))
+
+    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    # GeGLU: gelu(gate) * up — the Gemma MLP.
+    gate = jax.nn.gelu(h @ p["w_gate"].astype(cfg.dtype), approximate=True)
+    up = h @ p["w_up"].astype(cfg.dtype)
+    ff = wsc(gate * up, ("batch", "seq", "act_mlp"))
+    x = x + ff @ p["w_down"].astype(cfg.dtype)
+    return wsc(x, ("batch", "seq", "act_embed"))
+
+
+def apply(
+    params: Params,
+    cfg: GemmaConfig,
+    tokens: jnp.ndarray,                 # [b, s] int32
+    positions: jnp.ndarray | None = None,
+    kv_mask: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Forward → logits [b, s, vocab] fp32. Tied head (embed.T)."""
+    b, s = tokens.shape
+    contiguous = positions is None
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    inv_freq = rope_frequencies(cfg.head_dim, theta=cfg.rope_theta)
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.dtype)  # Gemma scaling
+    x = wsc(x, ("batch", "seq", "act_embed"))
+
+    block_fn = lambda x, lp: (
+        _block(cfg, x, lp, positions, inv_freq, kv_mask,
+               contiguous_positions=contiguous), None)
+    if cfg.remat:
+        block_fn = jax.checkpoint(block_fn)
+    x, _ = jax.lax.scan(block_fn, x, params["blocks"])
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    return wsc(logits, ("batch", "seq", "act_vocab"))

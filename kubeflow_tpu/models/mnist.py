@@ -1,0 +1,146 @@
+"""MNIST MLP — the CPU smoke config (BASELINE "MNIST MLP (JAX-CPU) smoke").
+
+The reference's jupyter-scipy image exists to run exactly this kind of
+small CPU workload in a notebook pod
+(`/root/reference/components/example-notebook-servers/README.md:13-42`);
+this module is the framework-native equivalent the smoke test launches.
+
+Data: reads an `.npz` (keys: x_train/y_train/x_test/y_test) from
+`KFTPU_MNIST_PATH` if set; otherwise generates a deterministic synthetic
+digit-blob dataset (zero-egress environments have no downloader).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    input_dim: int = 784
+    hidden_dims: tuple[int, ...] = (512, 512)
+    num_classes: int = 10
+
+
+MNIST_MLP = MLPConfig()
+
+
+def param_logical_axes(cfg: MLPConfig) -> Params:
+    layers = []
+    for _ in cfg.hidden_dims:
+        layers.append({"w": ("embed", "mlp"), "b": ("mlp",)})
+    return {
+        "layers": layers,
+        "out_w": ("embed", "vocab"),
+        "out_b": ("vocab",),
+    }
+
+
+def init(rng: jax.Array, cfg: MLPConfig = MNIST_MLP) -> Params:
+    dims = (cfg.input_dim, *cfg.hidden_dims)
+    keys = jax.random.split(rng, len(dims))
+    layers = []
+    for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        layers.append({
+            "w": jax.random.normal(keys[i], (d_in, d_out)) * (d_in ** -0.5),
+            "b": jnp.zeros((d_out,)),
+        })
+    return {
+        "layers": layers,
+        "out_w": jax.random.normal(keys[-1], (dims[-1], cfg.num_classes))
+        * (dims[-1] ** -0.5),
+        "out_b": jnp.zeros((cfg.num_classes,)),
+    }
+
+
+def apply(params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """[b, 784] → logits [b, 10]."""
+    h = x
+    for layer in params["layers"]:
+        h = jax.nn.relu(h @ layer["w"] + layer["b"])
+    return h @ params["out_w"] + params["out_b"]
+
+
+def loss_and_accuracy(params: Params, x, y) -> tuple[jnp.ndarray, jnp.ndarray]:
+    logits = apply(params, x)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, y[:, None], axis=-1)[:, 0]
+    loss = jnp.mean(logz - gold)
+    acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+    return loss, acc
+
+
+def load_dataset(n_train: int = 4096, n_test: int = 512, seed: int = 0):
+    """(x_train, y_train, x_test, y_test) float32 [N,784] / int32 [N]."""
+    path = os.environ.get("KFTPU_MNIST_PATH", "")
+    if path and os.path.exists(path):
+        d = np.load(path)
+        return (
+            d["x_train"].reshape(len(d["x_train"]), -1).astype(np.float32) / 255.0,
+            d["y_train"].astype(np.int32),
+            d["x_test"].reshape(len(d["x_test"]), -1).astype(np.float32) / 255.0,
+            d["y_test"].astype(np.int32),
+        )
+    # Synthetic stand-in: 10 gaussian class prototypes + noise. Linearly
+    # separable enough that a learning bug shows as low accuracy.
+    rng = np.random.default_rng(seed)
+    protos = rng.normal(size=(10, 784)).astype(np.float32)
+
+    def gen(n):
+        y = rng.integers(0, 10, n).astype(np.int32)
+        x = protos[y] + rng.normal(scale=2.0, size=(n, 784)).astype(np.float32)
+        return x, y
+
+    x_tr, y_tr = gen(n_train)
+    x_te, y_te = gen(n_test)
+    return x_tr, y_tr, x_te, y_te
+
+
+def batches(x: np.ndarray, y: np.ndarray, batch_size: int,
+            seed: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    if batch_size > len(x):
+        raise ValueError(
+            f"batch_size {batch_size} exceeds dataset size {len(x)}")
+    idx = np.random.default_rng(seed).permutation(len(x))
+    for i in range(0, len(x) - batch_size + 1, batch_size):
+        j = idx[i:i + batch_size]
+        yield x[j], y[j]
+
+
+def train_smoke(steps: int = 100, batch_size: int = 128,
+                lr: float = 0.1) -> dict[str, float]:
+    """The end-to-end CPU smoke: SGD for `steps`, returns metrics."""
+    x_tr, y_tr, x_te, y_te = load_dataset()
+    params = init(jax.random.key(0))
+
+    @jax.jit
+    def step(params, x, y):
+        (loss, _), grads = jax.value_and_grad(
+            loss_and_accuracy, has_aux=True)(params, x, y)
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return params, loss
+
+    n_done = 0
+    epoch = 0
+    while n_done < steps:
+        for xb, yb in batches(x_tr, y_tr, batch_size, seed=epoch):
+            params, loss = step(params, jnp.asarray(xb), jnp.asarray(yb))
+            n_done += 1
+            if n_done >= steps:
+                break
+        epoch += 1
+    test_loss, test_acc = loss_and_accuracy(
+        params, jnp.asarray(x_te), jnp.asarray(y_te))
+    return {
+        "final_train_loss": float(loss),
+        "test_loss": float(test_loss),
+        "test_accuracy": float(test_acc),
+    }

@@ -1,0 +1,195 @@
+"""Vision Transformer, TPU-first (BASELINE config "ViT-B/16 fine-tune").
+
+Patchify is a single reshape + dense (a 16x16-stride conv is exactly a
+[P*P*C, D] matmul on non-overlapping patches — one big MXU-friendly GEMM
+instead of a conv XLA must re-window). Blocks are stacked on a leading
+layers axis and scanned, like models/llama.py. Pre-LN, learned position
+embeddings, mean-pool head (configurable CLS token).
+
+Logical axes reuse the LLAMA_RULES vocabulary ("embed"→fsdp,
+"heads"/"mlp"→tensor, classifier "vocab"→tensor), so the same
+ShardingRules drive FSDP/TP fine-tuning with zero model changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.ops.attention import dot_product_attention
+from kubeflow_tpu.ops.norms import layer_norm
+from kubeflow_tpu.parallel.sharding import with_sharding_constraint as wsc
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    num_classes: int = 1000
+    norm_eps: float = 1e-6
+    use_cls_token: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        assert self.hidden_size % self.num_heads == 0
+        return self.hidden_size // self.num_heads
+
+    @property
+    def num_patches(self) -> int:
+        assert self.image_size % self.patch_size == 0
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def seq_len(self) -> int:
+        return self.num_patches + (1 if self.use_cls_token else 0)
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.num_channels
+
+
+VIT_B16 = ViTConfig()
+VIT_TINY = ViTConfig(
+    image_size=32, patch_size=8, hidden_size=64, intermediate_size=128,
+    num_layers=2, num_heads=4, num_classes=10, dtype=jnp.float32, remat=False,
+)
+
+CONFIGS = {"vit-b16": VIT_B16, "tiny": VIT_TINY}
+
+
+def param_logical_axes(cfg: ViTConfig) -> Params:
+    axes: Params = {
+        "patch_embed": (None, "embed"),
+        "patch_bias": ("embed",),
+        "pos_embed": (None, "embed"),
+        "blocks": {
+            "ln1_w": ("layers", "embed"), "ln1_b": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "heads"),
+            "wv": ("layers", "embed", "heads"),
+            "bq": ("layers", "heads"), "bk": ("layers", "heads"),
+            "bv": ("layers", "heads"),
+            "wo": ("layers", "heads", "embed"), "bo": ("layers", "embed"),
+            "ln2_w": ("layers", "embed"), "ln2_b": ("layers", "embed"),
+            "w1": ("layers", "embed", "mlp"), "b1": ("layers", "mlp"),
+            "w2": ("layers", "mlp", "embed"), "b2": ("layers", "embed"),
+        },
+        "final_ln_w": ("embed",), "final_ln_b": ("embed",),
+        "head_w": ("embed", "vocab"), "head_b": ("vocab",),
+    }
+    if cfg.use_cls_token:
+        axes["cls_token"] = (None, "embed")
+    return axes
+
+
+def init(rng: jax.Array, cfg: ViTConfig) -> Params:
+    keys = iter(jax.random.split(rng, 24))
+    pd = cfg.param_dtype
+
+    def dense(key, shape, fan_in):
+        return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(pd)
+
+    L, D, M = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    params: Params = {
+        "patch_embed": dense(next(keys), (cfg.patch_dim, D), cfg.patch_dim),
+        "patch_bias": jnp.zeros((D,), pd),
+        "pos_embed": (jax.random.normal(next(keys), (cfg.seq_len, D))
+                      * 0.02).astype(pd),
+        "blocks": {
+            "ln1_w": jnp.ones((L, D), pd), "ln1_b": jnp.zeros((L, D), pd),
+            "wq": dense(next(keys), (L, D, D), D),
+            "wk": dense(next(keys), (L, D, D), D),
+            "wv": dense(next(keys), (L, D, D), D),
+            "bq": jnp.zeros((L, D), pd), "bk": jnp.zeros((L, D), pd),
+            "bv": jnp.zeros((L, D), pd),
+            "wo": dense(next(keys), (L, D, D), D),
+            "bo": jnp.zeros((L, D), pd),
+            "ln2_w": jnp.ones((L, D), pd), "ln2_b": jnp.zeros((L, D), pd),
+            "w1": dense(next(keys), (L, D, M), D),
+            "b1": jnp.zeros((L, M), pd),
+            "w2": dense(next(keys), (L, M, D), M),
+            "b2": jnp.zeros((L, D), pd),
+        },
+        "final_ln_w": jnp.ones((D,), pd),
+        "final_ln_b": jnp.zeros((D,), pd),
+        # Zero-init head: standard fine-tune recipe (fresh classes).
+        "head_w": jnp.zeros((D, cfg.num_classes), pd),
+        "head_b": jnp.zeros((cfg.num_classes,), pd),
+    }
+    if cfg.use_cls_token:
+        params["cls_token"] = (jax.random.normal(next(keys), (1, D))
+                               * 0.02).astype(pd)
+    return params
+
+
+def patchify(cfg: ViTConfig, images: jnp.ndarray) -> jnp.ndarray:
+    """[b, H, W, C] → [b, n_patches, P*P*C] by pure reshape/transpose."""
+    b, H, W, C = images.shape
+    P = cfg.patch_size
+    gh, gw = H // P, W // P
+    x = images.reshape(b, gh, P, gw, P, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)            # [b, gh, gw, P, P, C]
+    return x.reshape(b, gh * gw, P * P * C)
+
+
+def _block(cfg: ViTConfig, x, p):
+    b, s, D = x.shape
+    nh, hd = cfg.num_heads, cfg.head_dim
+    dt = cfg.dtype
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+
+    h = layer_norm(x, p["ln1_w"], p["ln1_b"], cfg.norm_eps)
+    q = (h @ p["wq"].astype(dt) + p["bq"].astype(dt)).reshape(b, s, nh, hd)
+    k = (h @ p["wk"].astype(dt) + p["bk"].astype(dt)).reshape(b, s, nh, hd)
+    v = (h @ p["wv"].astype(dt) + p["bv"].astype(dt)).reshape(b, s, nh, hd)
+    q = wsc(q, ("batch", "seq", "act_heads", None))
+    attn = dot_product_attention(q, k, v, pos, pos, causal=False)
+    attn = attn.reshape(b, s, D)
+    x = x + attn @ p["wo"].astype(dt) + p["bo"].astype(dt)
+    x = wsc(x, ("batch", "seq", "act_embed"))
+
+    h = layer_norm(x, p["ln2_w"], p["ln2_b"], cfg.norm_eps)
+    h = jax.nn.gelu(h @ p["w1"].astype(dt) + p["b1"].astype(dt))
+    h = wsc(h, ("batch", "seq", "act_mlp"))
+    x = x + h @ p["w2"].astype(dt) + p["b2"].astype(dt)
+    return wsc(x, ("batch", "seq", "act_embed"))
+
+
+def apply(params: Params, cfg: ViTConfig, images: jnp.ndarray) -> jnp.ndarray:
+    """[b, H, W, C] float images → logits [b, num_classes] fp32."""
+    x = patchify(cfg, images).astype(cfg.dtype)
+    x = x @ params["patch_embed"].astype(cfg.dtype) \
+        + params["patch_bias"].astype(cfg.dtype)
+    if cfg.use_cls_token:
+        cls = jnp.broadcast_to(
+            params["cls_token"].astype(cfg.dtype),
+            (x.shape[0], 1, cfg.hidden_size))
+        x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(cfg.dtype)
+    x = wsc(x, ("batch", "seq", "act_embed"))
+
+    block_fn = lambda x, lp: (_block(cfg, x, lp), None)
+    if cfg.remat:
+        block_fn = jax.checkpoint(block_fn)
+    x, _ = jax.lax.scan(block_fn, x, params["blocks"])
+
+    x = layer_norm(x, params["final_ln_w"], params["final_ln_b"], cfg.norm_eps)
+    pooled = x[:, 0] if cfg.use_cls_token else jnp.mean(x, axis=1)
+    logits = (pooled.astype(jnp.float32)
+              @ params["head_w"].astype(jnp.float32)
+              + params["head_b"].astype(jnp.float32))
+    return logits

@@ -81,7 +81,12 @@ def _route(router_logits: jnp.ndarray, cfg: MoEConfig, capacity: int):
     Returns:
       dispatch: [T, E, C] one-hot bool — token t occupies slot c of expert e
       combine:  [T, E, C] float — dispatch weighted by router probability
-      aux:      scalar load-balancing loss (Switch Transformer eq. 4-6)
+      frac:     [E] fraction of routing choices per expert
+      mean_prob:[E] mean router probability per expert
+    (aux loss = E * sum(frac * mean_prob), Switch Transformer eq. 4-6 —
+    returned as factors so sharded callers can average them over token
+    shards BEFORE the product, keeping the loss identical to the
+    single-device computation.)
     """
     T, E = router_logits.shape
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
@@ -109,12 +114,14 @@ def _route(router_logits: jnp.ndarray, cfg: MoEConfig, capacity: int):
         t_idx.ravel(), gate_idx.ravel(), safe_slot.ravel()
     ].max(keep.ravel())
 
-    # Load-balance aux: E * sum_e( fraction_routed_e * mean_prob_e ).
     frac = jnp.mean(
         jnp.sum(expert_onehot, axis=1).astype(jnp.float32), axis=0)
     mean_prob = jnp.mean(probs, axis=0)
-    aux = E * jnp.sum(frac * mean_prob)
-    return disp, combine, aux
+    return disp, combine, frac, mean_prob
+
+
+def _aux_loss(frac: jnp.ndarray, mean_prob: jnp.ndarray) -> jnp.ndarray:
+    return frac.shape[0] * jnp.sum(frac * mean_prob)
 
 
 def _expert_ffn(params, x_ecd: jnp.ndarray) -> jnp.ndarray:
@@ -137,12 +144,12 @@ def moe_mlp(
     xt = x.reshape(b * s, d)
     capacity = cfg.capacity(b * s)
     logits = xt.astype(jnp.float32) @ params["router"].astype(jnp.float32)
-    disp, combine, aux = _route(logits, cfg, capacity)
+    disp, combine, frac, mean_prob = _route(logits, cfg, capacity)
     # [T,E,C] x [T,d] → [E,C,d]: the dispatch einsum
     xe = jnp.einsum("tec,td->ecd", disp.astype(x.dtype), xt)
     ye = _expert_ffn(params, xe)
     y = jnp.einsum("tec,ecd->td", combine.astype(ye.dtype), ye)
-    return y.reshape(b, s, d).astype(x.dtype), aux
+    return y.reshape(b, s, d).astype(x.dtype), _aux_loss(frac, mean_prob)
 
 
 def moe_mlp_expert_parallel(
@@ -151,8 +158,15 @@ def moe_mlp_expert_parallel(
     cfg: MoEConfig,
     *,
     axis_name: str,
+    token_axes: tuple[str, ...] = (),
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Explicit expert parallelism. Call inside shard_map.
+
+    `token_axes`: every mesh axis the token batch is sharded over
+    (including `axis_name` when experts and tokens co-shard). The
+    load-balance statistics are averaged over these axes *before* the
+    frac·prob product, so the aux loss and its router gradient are
+    bit-comparable to the unsharded `moe_mlp`.
 
     Each device routes its local tokens against ALL experts (router
     weights replicated), builds capacity-bounded dispatch buffers, then a
@@ -170,7 +184,7 @@ def moe_mlp_expert_parallel(
     capacity = cfg.capacity(T)
 
     logits = xt.astype(jnp.float32) @ params["router"].astype(jnp.float32)
-    disp, combine, aux = _route(logits, cfg, capacity)
+    disp, combine, frac, mean_prob = _route(logits, cfg, capacity)
 
     # Local dispatch buffers for every (global) expert: [E, C, d].
     xe = jnp.einsum("tec,td->ecd", disp.astype(x.dtype), xt)
@@ -186,10 +200,12 @@ def moe_mlp_expert_parallel(
         ye, axis_name, split_axis=1, concat_axis=0, tiled=True
     )
     y = jnp.einsum("tec,ecd->td", combine.astype(ye.dtype), ye)
-    # Aux is a per-device statistic over local tokens; average globally so
-    # the EP loss matches the single-device computation in expectation.
-    aux = jax.lax.pmean(aux, axis_name)
-    return y.reshape(b, s, d).astype(x.dtype), aux
+    # Average the statistics over every token-sharding axis FIRST, then
+    # take the product — identical to the global single-device loss.
+    for ax in (token_axes or (axis_name,)):
+        frac = jax.lax.pmean(frac, ax)
+        mean_prob = jax.lax.pmean(mean_prob, ax)
+    return y.reshape(b, s, d).astype(x.dtype), _aux_loss(frac, mean_prob)
 
 
 def moe_mlp_sharded(
@@ -228,7 +244,8 @@ def moe_mlp_sharded(
     x_spec = P(batch_axes, None, None)
     fn = jax.shard_map(
         functools.partial(
-            moe_mlp_expert_parallel, cfg=cfg, axis_name=expert_axis
+            moe_mlp_expert_parallel, cfg=cfg, axis_name=expert_axis,
+            token_axes=tuple(batch_axes),
         ),
         mesh=mesh,
         in_specs=(param_specs, x_spec),

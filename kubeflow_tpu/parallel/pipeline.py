@@ -22,7 +22,6 @@ that stage's device.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable
 
 import jax

@@ -1,0 +1,20 @@
+"""Serving layer: KV-cache inference engine, REST server, model export.
+
+Reference parity: the reference's serving story is the removed TF-Serving
+component (`/root/reference/docs_dev/tf_serving.md:1-60`, tested by
+`/root/reference/testing/test_tf_serving.py`) fronted by the same
+Service/VirtualService machinery as notebooks. The TPU-native redesign
+(SURVEY.md §2b "Model serving"): a pure-JAX engine with a static-shape
+KV cache (bucketed prefill, `lax.scan` decode — XLA-friendly, no dynamic
+shapes), an aiohttp REST server the gateway can route to, and
+ahead-of-time export via `jax.export` (StableHLO) with jax2tf/SavedModel
+available when TensorFlow is present.
+"""
+
+from kubeflow_tpu.serving.engine import (
+    DecodeState,
+    EngineConfig,
+    InferenceEngine,
+    GEMMA_FAMILY,
+    LLAMA_FAMILY,
+)

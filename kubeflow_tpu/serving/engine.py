@@ -1,0 +1,226 @@
+"""Pure-JAX inference engine: static-shape KV cache, scan decode.
+
+TPU constraints drive the design (pallas guide / XLA semantics):
+- The KV cache is a fixed [L, b, max_len, n_kv, hd] buffer; prefill and
+  decode write into it with `dynamic_update_slice`. No dynamic shapes —
+  one compile per (batch, max_len) bucket, reused across requests.
+- Decode is a single `lax.scan` over token steps: one trace, one
+  compile, no per-token Python dispatch.
+- Attention over the cache masks invalid slots by position (kv_mask), so
+  the same `dot_product_attention` op serves train and serve.
+
+Llama and Gemma share a block param schema (wq/wk/wv/wo, w_gate/w_up/
+w_down, attn_norm/mlp_norm, final_norm, embed); a `Family` adapter
+captures the differences (gate activation, embedding scale, tied head),
+so one engine serves both families.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.ops.attention import dot_product_attention
+from kubeflow_tpu.ops.norms import rms_norm
+from kubeflow_tpu.ops.rotary import apply_rope, rope_frequencies
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class Family:
+    """Model-family adapter for the shared llama/gemma block schema."""
+
+    name: str
+    gate_act: Callable[[jnp.ndarray], jnp.ndarray]
+    scale_embed: bool          # multiply embeddings by sqrt(hidden)
+
+
+LLAMA_FAMILY = Family("llama", jax.nn.silu, scale_embed=False)
+GEMMA_FAMILY = Family(
+    "gemma", lambda x: jax.nn.gelu(x, approximate=True), scale_embed=True
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    max_len: int = 1024        # cache bucket; one compile per value
+    temperature: float = 0.0   # 0 = greedy
+    # When set, sequences that emit EOS keep emitting EOS for the rest of
+    # the (fixed-length) scan, so callers can trim on first EOS.
+    eos_token: int | None = None
+
+
+class DecodeState:
+    """KV cache + cursor, a pytree (jit-carryable)."""
+
+    def __init__(self, k, v, length):
+        self.k = k              # [L, b, max_len, n_kv, hd]
+        self.v = v
+        self.length = length    # [] int32 — filled slots
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.length), None
+
+    @classmethod
+    def tree_unflatten(cls, _, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    DecodeState, DecodeState.tree_flatten, DecodeState.tree_unflatten
+)
+
+
+class InferenceEngine:
+    """Batched greedy/temperature generation for a llama-family model.
+
+    `cfg` is the model's LlamaConfig/GemmaConfig (shared field names).
+    Jitted entry points are cached per (batch, prompt_len, max_new).
+    """
+
+    def __init__(self, params: Params, cfg, family: Family,
+                 engine_config: EngineConfig = EngineConfig()):
+        self.params = params
+        self.cfg = cfg
+        self.family = family
+        self.ec = engine_config
+        self._generate_jit = jax.jit(
+            self._generate, static_argnames=("max_new",)
+        )
+
+    # -- model internals ---------------------------------------------------
+
+    def _embed(self, tokens):
+        cfg = self.cfg
+        x = self.params["embed"].astype(cfg.dtype)[tokens]
+        if self.family.scale_embed:
+            x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.dtype)
+        return x
+
+    def _head(self, x):
+        params, cfg = self.params, self.cfg
+        tied = "lm_head" not in params
+        head = params["embed"].T if tied else params["lm_head"]
+        return x.astype(jnp.float32) @ head.astype(jnp.float32)
+
+    def _forward_cached(self, tokens, state: DecodeState):
+        """Run [b, s] tokens starting at state.length; returns
+        (last-position logits [b, vocab], updated state)."""
+        cfg, fam, params = self.cfg, self.family, self.params
+        b, s = tokens.shape
+        start = state.length
+        positions = start + jnp.arange(s, dtype=jnp.int32)[None, :]
+        positions = jnp.broadcast_to(positions, (b, s))
+        inv_freq = rope_frequencies(cfg.head_dim, theta=cfg.rope_theta)
+        kv_positions = jnp.broadcast_to(
+            jnp.arange(self.ec.max_len, dtype=jnp.int32)[None, :],
+            (b, self.ec.max_len))
+        kv_valid = kv_positions < (start + s)
+
+        x = self._embed(tokens)
+
+        def layer(x, scanned):
+            p, k_cache, v_cache = scanned
+            h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+            q = (h @ p["wq"].astype(cfg.dtype)).reshape(
+                b, s, cfg.num_heads, cfg.head_dim)
+            k = (h @ p["wk"].astype(cfg.dtype)).reshape(
+                b, s, cfg.num_kv_heads, cfg.head_dim)
+            v = (h @ p["wv"].astype(cfg.dtype)).reshape(
+                b, s, cfg.num_kv_heads, cfg.head_dim)
+            q = apply_rope(q, positions, inv_freq)
+            k = apply_rope(k, positions, inv_freq)
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
+            attn = dot_product_attention(
+                q, k_cache, v_cache, positions, kv_positions,
+                causal=True, kv_mask=kv_valid)
+            x = x + attn.reshape(b, s, cfg.q_dim) @ p["wo"].astype(cfg.dtype)
+
+            h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+            gate = fam.gate_act(h @ p["w_gate"].astype(cfg.dtype))
+            ff = gate * (h @ p["w_up"].astype(cfg.dtype))
+            x = x + ff @ p["w_down"].astype(cfg.dtype)
+            return x, (k_cache, v_cache)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            layer, x, (params["blocks"], state.k, state.v))
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        logits = self._head(x[:, -1])
+        return logits, DecodeState(k_new, v_new, start + s)
+
+    # -- public API --------------------------------------------------------
+
+    def init_state(self, batch: int) -> DecodeState:
+        cfg = self.cfg
+        shape = (cfg.num_layers, batch, self.ec.max_len,
+                 cfg.num_kv_heads, cfg.head_dim)
+        return DecodeState(
+            jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype),
+            jnp.zeros((), jnp.int32))
+
+    def _sample(self, logits, rng):
+        if self.ec.temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            rng, logits / self.ec.temperature, axis=-1).astype(jnp.int32)
+
+    def _generate(self, prompt, state, rng, *, max_new: int):
+        eos = self.ec.eos_token
+        rng, sub = jax.random.split(rng)  # use-once key discipline
+        logits, state = self._forward_cached(prompt, state)
+        first = self._sample(logits, sub)
+        done0 = (first == eos) if eos is not None else jnp.zeros(
+            first.shape, bool)
+
+        def step(carry, _):
+            state, tok, rng, done = carry
+            rng, sub = jax.random.split(rng)
+            logits, state = self._forward_cached(tok[:, None], state)
+            nxt = self._sample(logits, sub)
+            if eos is not None:
+                # Sequences past EOS emit EOS forever (static shapes —
+                # the scan always runs max_new steps; callers trim).
+                nxt = jnp.where(done, jnp.asarray(eos, nxt.dtype), nxt)
+                done = done | (nxt == eos)
+            return (state, nxt, rng, done), nxt
+
+        (state, _, _, _), rest = jax.lax.scan(
+            step, (state, first, rng, done0), None, length=max_new - 1)
+        toks = jnp.concatenate(
+            [first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1)
+        return toks, state
+
+    def generate(
+        self,
+        prompt_tokens: jnp.ndarray,   # [b, s] int32
+        *,
+        max_new: int = 32,
+        rng: jax.Array | None = None,
+    ) -> jnp.ndarray:
+        """Generate `max_new` tokens after the prompt. Returns [b, max_new]
+        (post-hoc EOS trimming is the caller's job — shapes stay static)."""
+        b, s = prompt_tokens.shape
+        if s + max_new > self.ec.max_len:
+            raise ValueError(
+                f"prompt {s} + max_new {max_new} exceeds cache bucket "
+                f"{self.ec.max_len}")
+        if rng is None:
+            if self.ec.temperature > 0.0:
+                # Fresh entropy per request — a constant default key would
+                # make every "sampled" completion identical.
+                rng = jax.random.key(
+                    int.from_bytes(os.urandom(4), "little"))
+            else:
+                rng = jax.random.key(0)  # greedy: key is never consumed
+        state = self.init_state(b)
+        toks, _ = self._generate_jit(
+            prompt_tokens, state, rng, max_new=max_new)
+        return toks

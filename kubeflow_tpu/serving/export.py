@@ -1,0 +1,66 @@
+"""Ahead-of-time model export for serving.
+
+TPU-native first: `jax.export` serializes the jitted forward to portable
+StableHLO bytes (versioned, reloadable with jax.export.deserialize — the
+artifact a serving pod loads without retracing Python). The reference's
+SavedModel path (`/root/reference/docs_dev/tf_serving.md`) is kept as an
+optional jax2tf export, gated on TensorFlow being installed (it is not
+part of this image's baked dependency set).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable
+
+import jax
+
+
+def export_stablehlo(
+    fn: Callable[..., Any],
+    example_args: tuple,
+    path: str,
+) -> int:
+    """Serialize `jit(fn)` for `example_args` shapes to `path`.
+
+    Returns the artifact size in bytes. Reload with `load_stablehlo`.
+    """
+    exported = jax.export.export(jax.jit(fn))(*example_args)
+    blob = exported.serialize()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(blob)
+    return len(blob)
+
+
+def load_stablehlo(path: str):
+    """Deserialize an exported artifact; `.call(*args)` runs it."""
+    with open(path, "rb") as f:
+        return jax.export.deserialize(f.read())
+
+
+def export_saved_model(
+    fn: Callable[..., Any],
+    example_args: tuple,
+    path: str,
+) -> None:
+    """jax2tf → TF SavedModel (the reference's serving format). Raises a
+    clear error when TensorFlow is absent instead of failing mid-trace."""
+    try:
+        import tensorflow as tf  # noqa: F401
+        from jax.experimental import jax2tf
+    except ImportError as e:
+        raise RuntimeError(
+            "SavedModel export needs tensorflow; this image does not ship "
+            "it. Use export_stablehlo (jax-native) instead."
+        ) from e
+    module = tf.Module()
+    tf_fn = jax2tf.convert(fn, with_gradient=False)
+    module.f = tf.function(
+        tf_fn,
+        autograph=False,
+        input_signature=[
+            tf.TensorSpec(a.shape, tf.as_dtype(a.dtype)) for a in example_args
+        ],
+    )
+    tf.saved_model.save(module, path)

@@ -1,0 +1,139 @@
+"""Model-serving REST server (the TF-Serving-proxy replacement).
+
+The reference exposed model inference as an HTTP service behind the same
+Service/VirtualService machinery as notebooks
+(`/root/reference/docs_dev/tf_serving.md:1-60`; prediction smoke test in
+`/root/reference/testing/test_tf_serving.py:40-57`). TPU-native version:
+an aiohttp app wrapping `InferenceEngine`, serving
+  POST /v1/models/{name}:generate   {"tokens": [[...]], "max_new": N}
+  POST /v1/models/{name}:generate   {"text": "...", ...} (byte tokenizer)
+  GET  /v1/models                    model card listing
+  GET  /healthz /readyz              gateway probes
+
+Text in/out uses a dependency-free byte-level tokenizer (offset by
+`BYTE_OFFSET` to keep specials 0..byte_offset-1 free) so the server
+round-trips strings without downloaded vocabularies; real deployments
+pass token IDs from their own tokenizer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+from aiohttp import web
+
+from kubeflow_tpu.serving.engine import InferenceEngine
+
+BYTE_OFFSET = 3  # 0=pad, 1=bos, 2=eos
+BOS, EOS = 1, 2
+
+
+def byte_encode(text: str) -> list[int]:
+    return [BOS] + [b + BYTE_OFFSET for b in text.encode("utf-8")]
+
+
+def byte_decode(tokens: list[int]) -> str:
+    # Ids outside the byte range (specials below, vocab tail above — the
+    # model's vocab is larger than 256+offset) are dropped, not crashed on.
+    raw = bytes(t - BYTE_OFFSET for t in tokens
+                if BYTE_OFFSET <= t < BYTE_OFFSET + 256)
+    return raw.decode("utf-8", errors="replace")
+
+
+def create_serving_app(engines: dict[str, InferenceEngine]) -> web.Application:
+    app = web.Application()
+    app["engines"] = engines
+    # One inference at a time per process: the device is the bottleneck,
+    # and interleaved generate calls would just thrash compile caches.
+    app["gpu_lock"] = asyncio.Lock()
+    app.router.add_get("/healthz", _ok)
+    app.router.add_get("/readyz", _ok)
+    app.router.add_get("/v1/models", list_models)
+    app.router.add_post("/v1/models/{name}:generate", generate)
+    return app
+
+
+async def _ok(request: web.Request):
+    return web.json_response({"status": "ok"})
+
+
+async def list_models(request: web.Request):
+    out = []
+    for name, eng in request.app["engines"].items():
+        out.append({
+            "name": name,
+            "family": eng.family.name,
+            "max_len": eng.ec.max_len,
+            "vocab_size": eng.cfg.vocab_size,
+            "hidden_size": eng.cfg.hidden_size,
+            "num_layers": eng.cfg.num_layers,
+        })
+    return web.json_response({"models": out})
+
+
+async def generate(request: web.Request):
+    name = request.match_info["name"]
+    engine = request.app["engines"].get(name)
+    if engine is None:
+        return web.json_response(
+            {"error": f"no model {name!r}"}, status=404)
+    try:
+        body: dict[str, Any] = await request.json()
+    except Exception:
+        return web.json_response({"error": "invalid JSON"}, status=400)
+
+    text_mode = "text" in body
+    if text_mode:
+        if not isinstance(body["text"], str):
+            return web.json_response({"error": "'text' must be a string"},
+                                     status=400)
+        token_lists = [byte_encode(body["text"])]
+    elif "tokens" in body:
+        token_lists = body["tokens"]
+        if (not isinstance(token_lists, list) or not token_lists
+                or not all(
+                    isinstance(t, list) and t
+                    and all(isinstance(x, int) and not isinstance(x, bool)
+                            for x in t)
+                    for t in token_lists)):
+            return web.json_response(
+                {"error": "tokens must be a non-empty list of non-empty "
+                          "integer token-id lists"}, status=400)
+    else:
+        return web.json_response(
+            {"error": "body needs 'text' or 'tokens'"}, status=400)
+
+    max_new = body.get("max_new", 16)
+    if not isinstance(max_new, int) or isinstance(max_new, bool) \
+            or max_new < 1:
+        return web.json_response(
+            {"error": "max_new must be a positive integer"}, status=400)
+    lens = {len(t) for t in token_lists}
+    if len(lens) != 1:
+        return web.json_response(
+            {"error": "all prompts in a batch must share a length "
+                      "(static shapes); pad client-side"}, status=400)
+    prompt_len = lens.pop()
+    if prompt_len + max_new > engine.ec.max_len:
+        return web.json_response(
+            {"error": f"prompt {prompt_len} + max_new {max_new} exceeds "
+                      f"model max_len {engine.ec.max_len}"}, status=400)
+    vocab = engine.cfg.vocab_size
+    arr = np.asarray(token_lists, dtype=np.int32)
+    if arr.min() < 0 or arr.max() >= vocab:
+        return web.json_response(
+            {"error": f"token ids must be in [0, {vocab})"}, status=400)
+
+    async with request.app["gpu_lock"]:
+        toks = await asyncio.get_event_loop().run_in_executor(
+            None,
+            lambda: np.asarray(
+                engine.generate(jnp.asarray(arr), max_new=max_new)),
+        )
+    resp: dict[str, Any] = {"tokens": toks.tolist()}
+    if text_mode:
+        resp["text"] = byte_decode(toks[0].tolist())
+    return web.json_response(resp)

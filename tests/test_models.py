@@ -1,0 +1,139 @@
+"""ViT / Gemma / MNIST model families on the fake-TPU backend."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models import gemma, mnist, vit
+from kubeflow_tpu.parallel import MeshSpec, create_mesh
+from kubeflow_tpu.train import Trainer, TrainConfig
+
+
+def test_gemma_forward_shapes_and_tied_head():
+    cfg = gemma.GEMMA_TINY
+    params = gemma.init(jax.random.key(0), cfg)
+    assert "lm_head" not in params  # always tied
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 12)),
+        jnp.int32)
+    logits = gemma.apply(params, cfg, toks)
+    assert logits.shape == (2, 12, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_gemma_causality():
+    """Changing a future token must not affect earlier logits."""
+    cfg = gemma.GEMMA_TINY
+    params = gemma.init(jax.random.key(0), cfg)
+    rng = np.random.default_rng(1)
+    t1 = rng.integers(0, cfg.vocab_size, (1, 8)).astype(np.int32)
+    t2 = t1.copy()
+    t2[0, -1] = (t2[0, -1] + 7) % cfg.vocab_size
+    l1 = gemma.apply(params, cfg, jnp.asarray(t1))
+    l2 = gemma.apply(params, cfg, jnp.asarray(t2))
+    np.testing.assert_allclose(np.asarray(l1[:, :-1]), np.asarray(l2[:, :-1]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gemma_trains_sharded():
+    """Gemma composes with the FSDP/TP Trainer unchanged."""
+    cfg = gemma.GEMMA_TINY
+    mesh = create_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    trainer = Trainer(
+        mesh=mesh,
+        apply_fn=lambda p, t: gemma.apply(p, cfg, t),
+        init_fn=lambda k: gemma.init(k, cfg),
+        logical_axes=gemma.param_logical_axes(cfg),
+        train_config=TrainConfig(warmup_steps=1, total_steps=10),
+    )
+    state = trainer.init(jax.random.key(0))
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 16)),
+        jnp.int32)
+    losses = []
+    for _ in range(3):
+        state, loss = trainer.step(state, toks, jnp.roll(toks, -1, 1))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_vit_forward_and_patchify():
+    cfg = vit.VIT_TINY
+    params = vit.init(jax.random.key(0), cfg)
+    imgs = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 32, 32, 3)), jnp.float32)
+    logits = vit.apply(params, cfg, imgs)
+    assert logits.shape == (2, 10)
+    # Zero-init head ⇒ zero logits at init (fine-tune convention).
+    np.testing.assert_allclose(np.asarray(logits), 0.0, atol=1e-6)
+    # Patchify is a pure rearrangement: pixel sums preserved.
+    patches = vit.patchify(cfg, imgs)
+    assert patches.shape == (2, cfg.num_patches, cfg.patch_dim)
+    np.testing.assert_allclose(
+        float(jnp.sum(patches)), float(jnp.sum(imgs)), rtol=1e-5)
+
+
+def test_vit_finetune_learns():
+    """Few steps of full fine-tune separate two synthetic classes."""
+    cfg = vit.VIT_TINY
+    params = vit.init(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    # Class 0: bright top half; class 1: bright bottom half.
+    n = 32
+    y = rng.integers(0, 2, n).astype(np.int32)
+    x = rng.normal(scale=0.1, size=(n, 32, 32, 3)).astype(np.float32)
+    x[y == 0, :16] += 1.0
+    x[y == 1, 16:] += 1.0
+    xb, yb = jnp.asarray(x), jnp.asarray(y)
+
+    import optax
+    opt = optax.adam(3e-3)
+    ost = opt.init(params)
+
+    @jax.jit
+    def step(params, ost):
+        def loss_fn(p):
+            logits = vit.apply(p, cfg, xb)
+            onehot = jax.nn.one_hot(yb, cfg.num_classes)
+            return -jnp.mean(
+                jnp.sum(onehot * jax.nn.log_softmax(logits), -1))
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        u, ost = opt.update(g, ost)
+        return optax.apply_updates(params, u), ost, loss
+
+    losses = []
+    for _ in range(30):
+        params, ost, loss = step(params, ost)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses
+    acc = float(jnp.mean(
+        (jnp.argmax(vit.apply(params, cfg, xb), -1) == yb)))
+    assert acc >= 0.9, acc
+
+
+def test_vit_trainer_sharded_smoke():
+    """ViT under the sharded Trainer: one FSDP/TP step compiles + runs.
+    (Trainer's loss is next-token CE over [b,s,vocab]; ViT emits [b,c] —
+    wrap apply to add a seq dim so the same Trainer drives both.)"""
+    cfg = vit.VIT_TINY
+    mesh = create_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    trainer = Trainer(
+        mesh=mesh,
+        apply_fn=lambda p, imgs: vit.apply(p, cfg, imgs)[:, None, :],
+        init_fn=lambda k: vit.init(k, cfg),
+        logical_axes=vit.param_logical_axes(cfg),
+        train_config=TrainConfig(warmup_steps=1, total_steps=10),
+    )
+    state = trainer.init(jax.random.key(0))
+    imgs = jnp.asarray(
+        np.random.default_rng(0).normal(size=(8, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(np.random.default_rng(1).integers(0, 10, (8, 1)), jnp.int32)
+    state, loss = trainer.step(state, imgs, y, jnp.ones((8, 1), jnp.float32))
+    assert np.isfinite(float(loss))
+
+
+def test_mnist_smoke_learns():
+    metrics = mnist.train_smoke(steps=60)
+    assert metrics["test_accuracy"] > 0.8, metrics
+    assert metrics["final_train_loss"] < 1.0, metrics

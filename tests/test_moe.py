@@ -64,14 +64,30 @@ def test_capacity_drops_tokens(params):
 
 def test_expert_parallel_matches_dense(params):
     """EP over the 2-wide tensor axis (tokens+experts co-sharded) must
-    reproduce the dense GSPMD path when nothing is dropped."""
+    reproduce the dense GSPMD path when nothing is dropped — output AND
+    load-balance aux loss (stats averaged before the frac·prob product)."""
     mesh = create_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
     x = _x()
-    y_dense, _ = moe_lib.moe_mlp(params, x, CFG)
+    y_dense, aux_dense = moe_lib.moe_mlp(params, x, CFG)
     y_ep, aux = moe_lib.moe_mlp_sharded(params, x, CFG, mesh)
     np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_dense),
                                rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(float(aux), float(aux_dense), rtol=1e-5)
     assert float(aux) > 0.0
+
+
+def test_expert_parallel_aux_grad_matches_dense(params):
+    """Router gradient of the aux loss must match the dense path (the
+    shard-local objective bug regression)."""
+    mesh = create_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    x = _x()
+
+    g_dense = jax.grad(
+        lambda p: moe_lib.moe_mlp(p, x, CFG)[1])(params)["router"]
+    g_ep = jax.grad(
+        lambda p: moe_lib.moe_mlp_sharded(p, x, CFG, mesh)[1])(params)["router"]
+    np.testing.assert_allclose(np.asarray(g_ep), np.asarray(g_dense),
+                               rtol=1e-4, atol=1e-6)
 
 
 def test_expert_parallel_grads_flow(params):
