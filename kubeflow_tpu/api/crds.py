@@ -88,10 +88,20 @@ WEBHOOK_EXCLUDE_ANNOTATION = "kubeflow-tpu.dev/webhook-exclude"
 
 
 @dataclass
+class ProfilePluginSpec:
+    """Per-profile cloud-identity plugin (ref GetPluginSpec,
+    profile_controller.go:643-675: plugins are part of the Profile CR)."""
+
+    kind: str = ""                        # "WorkloadIdentity" | "IamForServiceAccount"
+    options: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
 class ProfileSpec:
     owner: str = ""                       # user id (email)
     resource_quota: dict[str, str] = field(default_factory=dict)
     # e.g. {"cpu": "32", "memory": "128Gi", "tpu/v5e-chips": "16"}
+    plugins: list[ProfilePluginSpec] = field(default_factory=list)
 
 
 @dataclass
@@ -162,3 +172,91 @@ class Tensorboard(Resource):
     KIND: ClassVar[str] = "Tensorboard"
     spec: TensorboardSpec = field(default_factory=TensorboardSpec)
     status: TensorboardStatus = field(default_factory=TensorboardStatus)
+
+
+# ---------------------------------------------------------------------------
+# HPO: Experiment / Trial (Katib StudyJob equivalent — the reference only
+# smoke-tests Katib from outside, testing/katib_studyjob_test.py; the CRD
+# itself lives in the separate katib repo, so this is a green-field design)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ParameterSpec:
+    """One search dimension. type: double | int | categorical."""
+
+    name: str = ""
+    type: str = "double"
+    min: float = 0.0
+    max: float = 0.0
+    log: bool = False                      # double only
+    values: list[str] = field(default_factory=list)  # categorical only
+
+
+@dataclass
+class ObjectiveSpec:
+    metric: str = "loss"
+    goal: str = "minimize"                 # minimize | maximize
+
+
+@dataclass
+class ExperimentSpec:
+    objective: ObjectiveSpec = field(default_factory=ObjectiveSpec)
+    algorithm: str = "random"              # random | grid
+    seed: int = 0
+    parameters: list[ParameterSpec] = field(default_factory=list)
+    max_trials: int = 10
+    parallel_trials: int = 2
+    # Pod template for each trial; hyperparameters are injected as
+    # KFTPU_HP_<NAME> env vars and TPU env rides the normal webhook path.
+    trial_template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    tpu: TpuSpec = field(default_factory=TpuSpec)
+
+
+@dataclass
+class ExperimentStatus:
+    phase: str = ""       # "" | Running | Succeeded | Failed
+    trials_created: int = 0
+    trials_succeeded: int = 0
+    trials_failed: int = 0
+    best_trial: str = ""
+    best_value: float | None = None
+    best_assignment: dict[str, str] = field(default_factory=dict)
+    message: str = ""
+
+
+@dataclass
+class Experiment(Resource):
+    KIND: ClassVar[str] = "Experiment"
+    spec: ExperimentSpec = field(default_factory=ExperimentSpec)
+    status: ExperimentStatus = field(default_factory=ExperimentStatus)
+
+
+@dataclass
+class TrialSpec:
+    experiment: str = ""
+    assignment: dict[str, str] = field(default_factory=dict)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    tpu: TpuSpec = field(default_factory=TpuSpec)
+    objective_metric: str = "loss"
+
+
+@dataclass
+class TrialStatus:
+    phase: str = ""       # "" | Running | Succeeded | Failed
+    value: float | None = None
+    message: str = ""
+
+
+@dataclass
+class Trial(Resource):
+    KIND: ClassVar[str] = "Trial"
+    spec: TrialSpec = field(default_factory=TrialSpec)
+    status: TrialStatus = field(default_factory=TrialStatus)
+
+
+# Trial pods report their objective via this annotation (written by the
+# in-pod metric reporter; the trial controller mirrors it into status).
+TRIAL_METRIC_ANNOTATION = "kubeflow-tpu.dev/metric-value"
+TRIAL_LABEL = "trial-name"
+EXPERIMENT_LABEL = "experiment-name"

@@ -11,6 +11,11 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from kubeflow_tpu.controlplane.controllers.culler import ActivityProbe, Culler
+from kubeflow_tpu.controlplane.controllers.hpo import (
+    ExperimentController,
+    TrialController,
+    TrialExecutor,
+)
 from kubeflow_tpu.controlplane.controllers.gateway import (
     GatewayNotebookController,
     NotebookGatewayWebhook,
@@ -49,6 +54,9 @@ class ClusterConfig:
     # sidecar injection, Routes, NetworkPolicies, reconciliation lock.
     enable_gateway: bool = False
     gateway_domain: str = "apps.example.com"
+    # Hermetic HPO: when set, trial pods "run" this objective in-process
+    # (the envtest-style fake kubelet for trials). None in production.
+    trial_executor: TrialExecutor | None = None
 
 
 class Cluster:
@@ -76,6 +84,11 @@ class Cluster:
             use_routing=self.config.use_routing
         )
         self.deployment_controller = DeploymentController()
+        self.experiment_controller = ExperimentController()
+        self.trial_controller = TrialController(
+            executor=self.config.trial_executor)
+        self.manager.register(self.experiment_controller)
+        self.manager.register(self.trial_controller)
         self.manager.register(self.notebook_controller)
         self.manager.register(self.statefulset_controller)
         self.manager.register(self.profile_controller)

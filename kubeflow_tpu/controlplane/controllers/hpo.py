@@ -1,0 +1,241 @@
+"""HPO controllers: Experiment → Trials → Pods.
+
+Katib-equivalent control loop, restated in this framework's reconcile
+kernel (the reference only smoke-tests Katib from outside,
+`/root/reference/testing/katib_studyjob_test.py`):
+
+- ExperimentController keeps `parallel_trials` Trials in flight until
+  `max_trials` are created, then aggregates the best result. Suggestion
+  state is deterministic: the suggester is keyed by (uid, seed) and
+  replayed from the count of existing trials, so controller restarts
+  don't double-suggest.
+- TrialController renders the trial pod (hyperparameters as
+  KFTPU_HP_<NAME> env), lets the normal TpuPodDefault webhook inject TPU
+  topology env (the BASELINE "HPO sweep w/ env injection" path), and
+  mirrors the pod's reported metric annotation into Trial.status.
+
+Hermetic execution: `TrialExecutor` is the fake-kubelet for trial pods —
+it "runs" the objective in-process when registered (tests, local mode).
+Production leaves it None; a metric-reporter sidecar writes the
+annotation instead.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+from kubeflow_tpu.api.core import EnvVar, Pod
+from kubeflow_tpu.api.crds import (
+    EXPERIMENT_LABEL,
+    Experiment,
+    ParameterSpec,
+    TRIAL_LABEL,
+    TRIAL_METRIC_ANNOTATION,
+    Trial,
+)
+from kubeflow_tpu.controlplane.runtime import Controller, Result
+from kubeflow_tpu.controlplane.store import (
+    AdmissionDenied,
+    AlreadyExists,
+    NotFound,
+    Store,
+    set_controller_reference,
+)
+from kubeflow_tpu.hpo import search as search_lib
+
+log = logging.getLogger(__name__)
+
+# In-process objective for hermetic trials: (assignment) -> metric.
+TrialExecutor = Callable[[dict[str, str]], float]
+
+
+def _space_from_spec(params: list[ParameterSpec]) -> search_lib.SearchSpace:
+    out: list[search_lib.Parameter] = []
+    for p in params:
+        if p.type == "double":
+            out.append(search_lib.Double(p.name, p.min, p.max, log=p.log))
+        elif p.type == "int":
+            out.append(search_lib.Integer(p.name, int(p.min), int(p.max)))
+        elif p.type == "categorical":
+            out.append(search_lib.Categorical(p.name, tuple(p.values)))
+        else:
+            raise ValueError(f"unknown parameter type {p.type!r}")
+    return search_lib.SearchSpace(tuple(out))
+
+
+class ExperimentController(Controller):
+    KIND = "Experiment"
+    OWNS = ("Trial",)
+
+    def reconcile(self, store: Store, namespace: str, name: str) -> Result:
+        try:
+            exp = store.get("Experiment", namespace, name)
+        except NotFound:
+            return Result()
+        assert isinstance(exp, Experiment)
+        spec = exp.spec
+
+        trials = [
+            t for t in store.list("Trial", namespace)
+            if t.spec.experiment == name
+        ]
+        running = [t for t in trials if t.status.phase in ("", "Running")]
+        done = [t for t in trials if t.status.phase in ("Succeeded", "Failed")]
+
+        # Spawn up to the parallelism budget. The suggester is recreated
+        # deterministically and fast-forwarded past prior suggestions.
+        to_create = min(
+            spec.parallel_trials - len(running),
+            spec.max_trials - len(trials),
+        )
+        if to_create > 0:
+            try:
+                space = _space_from_spec(spec.parameters)
+                suggester = search_lib.make_suggester(
+                    spec.algorithm, space,
+                    **({"seed": spec.seed}
+                       if spec.algorithm == "random" else {}))
+            except ValueError as e:
+                exp.status.phase = "Failed"
+                exp.status.message = str(e)
+                store.update(exp)
+                return Result()
+            suggester.suggest(len(trials))           # replay
+            batch = suggester.suggest(to_create)
+            for a in batch:
+                idx = len(trials)
+                trial = Trial()
+                trial.metadata.name = f"{name}-{idx}"
+                trial.metadata.namespace = namespace
+                trial.metadata.labels = {EXPERIMENT_LABEL: name}
+                trial.spec.experiment = name
+                trial.spec.assignment = {k: str(v) for k, v in a.items()}
+                trial.spec.template = spec.trial_template
+                trial.spec.tpu = spec.tpu
+                trial.spec.objective_metric = spec.objective.metric
+                set_controller_reference(exp, trial)
+                try:
+                    store.create(trial)
+                    trials.append(trial)
+                except AlreadyExists:
+                    pass
+
+        # Aggregate status. (Grid exhaustion below max_trials is closed
+        # out by the `finished` condition: no running, all trials done.)
+        succeeded = [t for t in done if t.status.phase == "Succeeded"]
+        best = None
+        for t in succeeded:
+            if t.status.value is None:
+                continue
+            if best is None or search_lib.better(
+                spec.objective.goal, t.status.value, best.status.value
+            ):
+                best = t
+        exp.status.trials_created = len(trials)
+        exp.status.trials_succeeded = len(succeeded)
+        exp.status.trials_failed = len(done) - len(succeeded)
+        if best is not None:
+            exp.status.best_trial = best.metadata.name
+            exp.status.best_value = best.status.value
+            exp.status.best_assignment = dict(best.spec.assignment)
+        finished = (len(done) >= spec.max_trials
+                    or (not running and len(trials) == len(done)
+                        and len(trials) > 0 and spec.algorithm == "grid"
+                        and len(trials) < spec.max_trials))
+        if finished:
+            exp.status.phase = (
+                "Succeeded" if succeeded else "Failed")
+        elif trials:
+            exp.status.phase = "Running"
+        store.update(exp)
+        return Result()
+
+
+class TrialController(Controller):
+    KIND = "Trial"
+    OWNS = ("Pod",)
+
+    def __init__(self, executor: TrialExecutor | None = None):
+        self.executor = executor
+
+    def reconcile(self, store: Store, namespace: str, name: str) -> Result:
+        try:
+            trial = store.get("Trial", namespace, name)
+        except NotFound:
+            return Result()
+        assert isinstance(trial, Trial)
+        if trial.status.phase in ("Succeeded", "Failed"):
+            return Result()
+
+        pod_name = f"{name}-run"
+        pod = store.try_get("Pod", namespace, pod_name)
+        if pod is None:
+            pod = Pod(spec=trial.spec.template.spec).clone()
+            pod.metadata.name = pod_name
+            pod.metadata.namespace = namespace
+            pod.metadata.labels = {
+                **trial.spec.template.metadata.labels,
+                TRIAL_LABEL: name,
+                EXPERIMENT_LABEL: trial.spec.experiment,
+            }
+            pod.metadata.annotations = dict(
+                trial.spec.template.metadata.annotations)
+            # Hyperparameters as env for the training script; the pod
+            # webhook additionally injects TPU topology env.
+            for c in pod.spec.containers:
+                c.env.append(EnvVar("KFTPU_TRIAL_NAME", name))
+                for k, v in sorted(trial.spec.assignment.items()):
+                    c.env.append(EnvVar(f"KFTPU_HP_{k.upper()}", v))
+            set_controller_reference(trial, pod)
+            try:
+                store.create(pod)
+            except AlreadyExists:
+                pass
+            except AdmissionDenied as e:
+                trial.status.phase = "Failed"
+                trial.status.message = f"pod admission denied: {e}"
+                store.update(trial)
+                return Result()
+            # Re-fetch: admission webhooks mutated the stored copy; writing
+            # through the stale local one would Conflict and re-run the
+            # executor on retry.
+            pod = store.get("Pod", namespace, pod_name)
+            trial.status.phase = "Running"
+            trial = store.update(trial)  # keep rv fresh for the mirror below
+
+        # Hermetic executor: run the objective now and complete the pod.
+        if self.executor is not None and pod.phase not in (
+            "Succeeded", "Failed"
+        ):
+            try:
+                value = float(self.executor(dict(trial.spec.assignment)))
+                pod.phase = "Succeeded"
+                pod.metadata.annotations[TRIAL_METRIC_ANNOTATION] = str(value)
+            except Exception as e:  # noqa: BLE001 — user objective
+                pod.phase = "Failed"
+                pod.metadata.annotations.pop(TRIAL_METRIC_ANNOTATION, None)
+                log.warning("trial %s objective failed: %s", name, e)
+            store.update(pod)
+
+        # Mirror pod completion into trial status.
+        if pod.phase == "Succeeded":
+            raw = pod.metadata.annotations.get(TRIAL_METRIC_ANNOTATION)
+            if raw is None:
+                trial.status.phase = "Failed"
+                trial.status.message = (
+                    "pod succeeded without reporting "
+                    f"{TRIAL_METRIC_ANNOTATION}")
+            else:
+                try:
+                    trial.status.value = float(raw)
+                    trial.status.phase = "Succeeded"
+                except ValueError:
+                    trial.status.phase = "Failed"
+                    trial.status.message = f"unparseable metric {raw!r}"
+            store.update(trial)
+        elif pod.phase == "Failed":
+            trial.status.phase = "Failed"
+            trial.status.message = "trial pod failed"
+            store.update(trial)
+        return Result()

@@ -66,6 +66,14 @@ class WorkloadIdentityPlugin:
     def __init__(self, gsa_format: str = "{profile}@project.iam.gserviceaccount.com"):
         self.gsa_format = gsa_format
 
+    def with_options(self, options: dict[str, str]) -> "WorkloadIdentityPlugin":
+        """Per-profile configuration (ref GetPluginSpec unmarshalling the
+        CR's plugin spec into the plugin struct)."""
+        if not options:
+            return self
+        return WorkloadIdentityPlugin(
+            gsa_format=options.get("gsaFormat", self.gsa_format))
+
     def apply(self, store: Store, profile: Profile) -> None:
         ns = profile.metadata.name
         sa = store.try_get("ServiceAccount", ns, "default-editor")
@@ -86,17 +94,171 @@ class WorkloadIdentityPlugin:
             store.update(sa)
 
 
+class IamForServiceAccountPlugin:
+    """AWS-IRSA-equivalent: edits a role trust policy (in-memory JSON,
+    exactly the scope the reference tests — plugin_iam.go:134-248 /
+    plugin_iam_test.go operate on policy documents without AWS calls) and
+    annotates the editor SA with the role ARN
+    (ref annotation `eks.amazonaws.com/role-arn`, plugin_iam.go:24)."""
+
+    SA_ANNOTATION = "iam.kubeflow-tpu.dev/role-arn"
+
+    def __init__(self, *, role_arn_format: str =
+                 "arn:aws:iam::0:role/{profile}",
+                 oidc_provider: str = "oidc.example.com/id/CLUSTER",
+                 policies: dict[str, dict] | None = None):
+        self.role_arn_format = role_arn_format
+        self.oidc_provider = oidc_provider
+        # role arn -> trust policy document (the fake IAM backend).
+        self.policies: dict[str, dict] = policies if policies is not None else {}
+
+    def with_options(self, options: dict[str, str]) -> "IamForServiceAccountPlugin":
+        """Per-profile configuration; the policy store is SHARED with the
+        registry instance so apply/revoke see the same IAM state."""
+        if not options:
+            return self
+        return IamForServiceAccountPlugin(
+            role_arn_format=options.get("roleArnFormat",
+                                        self.role_arn_format),
+            oidc_provider=options.get("oidcProvider", self.oidc_provider),
+            policies=self.policies,
+        )
+
+    def _subject(self, profile: Profile) -> str:
+        return (f"system:serviceaccount:{profile.metadata.name}:"
+                f"default-editor")
+
+    def apply(self, store: Store, profile: Profile) -> None:
+        arn = self.role_arn_format.format(profile=profile.metadata.name)
+        policy = self.policies.setdefault(
+            arn, {"Version": "2012-10-17", "Statement": []})
+        add_irsa_statement(policy, self.oidc_provider,
+                           self._subject(profile))
+        sa = store.try_get("ServiceAccount", profile.metadata.name,
+                           "default-editor")
+        if sa is not None and sa.metadata.annotations.get(
+            self.SA_ANNOTATION
+        ) != arn:
+            sa.metadata.annotations[self.SA_ANNOTATION] = arn
+            store.update(sa)
+
+    def revoke(self, store: Store, profile: Profile) -> None:
+        arn = self.role_arn_format.format(profile=profile.metadata.name)
+        policy = self.policies.get(arn)
+        if policy is not None:
+            remove_irsa_statement(policy, self.oidc_provider,
+                                  self._subject(profile))
+        sa = store.try_get("ServiceAccount", profile.metadata.name,
+                           "default-editor")
+        if sa is not None and self.SA_ANNOTATION in sa.metadata.annotations:
+            del sa.metadata.annotations[self.SA_ANNOTATION]
+            store.update(sa)
+
+
+def _irsa_condition_key(oidc_provider: str) -> str:
+    return f"{oidc_provider}:sub"
+
+
+def add_irsa_statement(policy: dict, oidc_provider: str,
+                       subject: str) -> None:
+    """Idempotently grant `subject` AssumeRoleWithWebIdentity via the
+    OIDC provider. Mirrors the reference's trust-policy editing semantics
+    (plugin_iam.go:134-248): one statement per provider, subjects
+    accumulate in the StringEquals condition (string or list form)."""
+    stmts = policy.setdefault("Statement", [])
+    key = _irsa_condition_key(oidc_provider)
+    for s in stmts:
+        cond = s.get("Condition", {}).get("StringEquals", {})
+        if key in cond:
+            subs = cond[key]
+            if isinstance(subs, str):
+                if subs == subject:
+                    return
+                cond[key] = [subs, subject]
+            elif subject not in subs:
+                subs.append(subject)
+            return
+    stmts.append({
+        "Effect": "Allow",
+        "Principal": {"Federated": oidc_provider},
+        "Action": "sts:AssumeRoleWithWebIdentity",
+        "Condition": {"StringEquals": {key: subject}},
+    })
+
+
+def remove_irsa_statement(policy: dict, oidc_provider: str,
+                          subject: str) -> None:
+    """Remove `subject`; drops the whole statement when it was the last
+    subject (ref plugin_iam.go deletion path)."""
+    stmts = policy.get("Statement", [])
+    key = _irsa_condition_key(oidc_provider)
+    for s in list(stmts):
+        cond = s.get("Condition", {}).get("StringEquals", {})
+        if key not in cond:
+            continue
+        subs = cond[key]
+        if isinstance(subs, str):
+            if subs == subject:
+                stmts.remove(s)
+        else:
+            if subject in subs:
+                subs.remove(subject)
+            if len(subs) == 1:
+                cond[key] = subs[0]
+            elif not subs:
+                stmts.remove(s)
+        return
+
+
+PLUGIN_KINDS: dict[str, type] = {
+    "WorkloadIdentity": WorkloadIdentityPlugin,
+    "IamForServiceAccount": IamForServiceAccountPlugin,
+}
+
+
+def resolve_profile_plugins(
+    profile: Profile,
+    registry: dict[str, "ProfilePlugin"],
+) -> list["ProfilePlugin"]:
+    """Per-profile plugin resolution (ref GetPluginSpec
+    profile_controller.go:643-675): the Profile CR names its plugins;
+    instances come from the controller's registry so state (fake IAM
+    policies, formats) is shared across profiles."""
+    out = []
+    for ps in profile.spec.plugins:
+        plugin = registry.get(ps.kind)
+        if plugin is None:
+            raise ValueError(
+                f"profile {profile.metadata.name}: unknown plugin kind "
+                f"{ps.kind!r} (have {sorted(registry)})")
+        if ps.options:
+            configure = getattr(plugin, "with_options", None)
+            if configure is None:
+                raise ValueError(
+                    f"profile {profile.metadata.name}: plugin {ps.kind!r} "
+                    "does not accept options")
+            plugin = configure(dict(ps.options))
+        out.append(plugin)
+    return out
+
+
 class ProfileController(Controller):
     KIND = "Profile"
     OWNS = ("Namespace",)
 
     def __init__(self, *, default_namespace_labels: dict[str, str] | None = None,
-                 plugins: list[ProfilePlugin] | None = None):
+                 plugins: list[ProfilePlugin] | None = None,
+                 plugin_registry: dict[str, ProfilePlugin] | None = None):
         # ref: fsnotify-watched labels file (profile_controller.go:356-405);
         # our config layer (utils/config.py) hot-reloads and re-creates the
         # controller-visible dict in place.
         self.default_namespace_labels = default_namespace_labels or {}
-        self.plugins = plugins or []
+        self.plugins = plugins or []          # applied to every profile
+        # kind -> instance, consulted for Profile.spec.plugins entries
+        # (ref GetPluginSpec). Default registry has both cloud plugins.
+        self.plugin_registry = (
+            plugin_registry if plugin_registry is not None
+            else {k: cls() for k, cls in PLUGIN_KINDS.items()})
 
     def reconcile(self, store: Store, namespace: str, name: str) -> Result:
         try:
@@ -131,7 +293,17 @@ class ProfileController(Controller):
         self._ensure_role_bindings(store, profile)
         self._ensure_authorization_policy(store, profile)
         self._ensure_quota(store, profile)
-        for plugin in self.plugins:
+        try:
+            per_profile = resolve_profile_plugins(
+                profile, self.plugin_registry)
+        except ValueError as e:
+            fresh = store.try_get("Profile", "", name)
+            if fresh is not None and fresh.status.message != str(e):
+                fresh.status.phase = "Failed"
+                fresh.status.message = str(e)
+                store.update(fresh)
+            return Result()
+        for plugin in [*self.plugins, *per_profile]:
             plugin.apply(store, profile)
 
         fresh = store.try_get("Profile", "", name)
@@ -261,7 +433,12 @@ class ProfileController(Controller):
             store.update(existing)
 
     def _finalize(self, store: Store, profile: Profile) -> Result:
-        for plugin in self.plugins:
+        try:
+            per_profile = resolve_profile_plugins(
+                profile, self.plugin_registry)
+        except ValueError:
+            per_profile = []  # unknown kinds have nothing to revoke
+        for plugin in [*self.plugins, *per_profile]:
             plugin.revoke(store, profile)
         try:
             store.delete("Namespace", "", profile.metadata.name)

@@ -1,0 +1,18 @@
+"""HPO layer: search spaces, suggesters, local sweeps, Experiment CRs.
+
+BASELINE config "Katib HPO sweep w/ PodDefault TPU-env injection": the
+controllers live in kubeflow_tpu.controlplane.controllers.hpo; this
+package is the algorithm core plus the notebook-local entry point.
+"""
+
+from kubeflow_tpu.hpo.search import (
+    Categorical,
+    Double,
+    GridSuggester,
+    Integer,
+    RandomSuggester,
+    SearchSpace,
+    better,
+    make_suggester,
+)
+from kubeflow_tpu.hpo.local import SweepResult, TrialResult, run_sweep

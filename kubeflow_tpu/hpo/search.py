@@ -1,0 +1,154 @@
+"""HPO search spaces and suggestion algorithms (Katib-equivalent core).
+
+The reference ships only a Katib smoke test
+(`/root/reference/testing/katib_studyjob_test.py`) — the StudyJob CRD it
+exercises lives in the separate katib repo. This module supplies the
+algorithm layer for the TPU-native Experiment/Trial controllers and for
+in-notebook local sweeps: deterministic, seeded suggesters (random,
+grid) over typed parameter domains.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+
+Assignment = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class Double:
+    name: str
+    min: float
+    max: float
+    log: bool = False    # sample in log space (learning rates)
+
+    def validate(self) -> None:
+        if not (self.max > self.min):
+            raise ValueError(f"{self.name}: max must exceed min")
+        if self.log and self.min <= 0:
+            raise ValueError(f"{self.name}: log scale needs min > 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class Integer:
+    name: str
+    min: int
+    max: int             # inclusive
+
+    def validate(self) -> None:
+        if not (self.max >= self.min):
+            raise ValueError(f"{self.name}: max must be >= min")
+
+
+@dataclasses.dataclass(frozen=True)
+class Categorical:
+    name: str
+    values: tuple[Any, ...]
+
+    def validate(self) -> None:
+        if not self.values:
+            raise ValueError(f"{self.name}: needs at least one value")
+
+
+Parameter = Double | Integer | Categorical
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchSpace:
+    parameters: tuple[Parameter, ...]
+
+    def __post_init__(self):
+        names = [p.name for p in self.parameters]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate parameter names in {names}")
+        for p in self.parameters:
+            p.validate()
+
+
+class RandomSuggester:
+    """Independent uniform (log-uniform for Double(log=True)) sampling."""
+
+    def __init__(self, space: SearchSpace, seed: int = 0):
+        self.space = space
+        self._rng = np.random.default_rng(seed)
+
+    def suggest(self, n: int) -> list[Assignment]:
+        out = []
+        for _ in range(n):
+            a: Assignment = {}
+            for p in self.space.parameters:
+                if isinstance(p, Double):
+                    if p.log:
+                        a[p.name] = float(np.exp(self._rng.uniform(
+                            math.log(p.min), math.log(p.max))))
+                    else:
+                        a[p.name] = float(self._rng.uniform(p.min, p.max))
+                elif isinstance(p, Integer):
+                    a[p.name] = int(self._rng.integers(p.min, p.max + 1))
+                else:
+                    a[p.name] = p.values[
+                        int(self._rng.integers(len(p.values)))]
+            out.append(a)
+        return out
+
+
+class GridSuggester:
+    """Cartesian grid; Doubles get `grid_points` samples (log-aware).
+    Exhausts after the full grid — suggest() then returns []."""
+
+    def __init__(self, space: SearchSpace, grid_points: int = 5):
+        self.space = space
+        axes: list[list[Any]] = []
+        for p in space.parameters:
+            if isinstance(p, Double):
+                if p.log:
+                    pts = np.exp(np.linspace(math.log(p.min),
+                                             math.log(p.max), grid_points))
+                else:
+                    pts = np.linspace(p.min, p.max, grid_points)
+                axes.append([float(x) for x in pts])
+            elif isinstance(p, Integer):
+                span = p.max - p.min + 1
+                if span <= grid_points:
+                    axes.append(list(range(p.min, p.max + 1)))
+                else:
+                    axes.append(sorted({
+                        int(round(x)) for x in
+                        np.linspace(p.min, p.max, grid_points)}))
+            else:
+                axes.append(list(p.values))
+        self._grid = itertools.product(*axes)
+        self._names = [p.name for p in space.parameters]
+
+    def suggest(self, n: int) -> list[Assignment]:
+        out = []
+        for combo in itertools.islice(self._grid, n):
+            out.append(dict(zip(self._names, combo)))
+        return out
+
+
+SUGGESTERS = {"random": RandomSuggester, "grid": GridSuggester}
+
+
+def make_suggester(algorithm: str, space: SearchSpace, **kwargs):
+    try:
+        cls = SUGGESTERS[algorithm]
+    except KeyError:
+        raise ValueError(
+            f"unknown algorithm {algorithm!r}; have {sorted(SUGGESTERS)}"
+        ) from None
+    return cls(space, **kwargs)
+
+
+def better(goal: str, a: float, b: float) -> bool:
+    """Is metric `a` better than `b` under goal 'minimize'/'maximize'?"""
+    if goal == "minimize":
+        return a < b
+    if goal == "maximize":
+        return a > b
+    raise ValueError(f"goal must be minimize|maximize, got {goal!r}")

@@ -122,7 +122,11 @@ async def generate(request: web.Request):
             {"error": f"prompt {prompt_len} + max_new {max_new} exceeds "
                       f"model max_len {engine.ec.max_len}"}, status=400)
     vocab = engine.cfg.vocab_size
-    arr = np.asarray(token_lists, dtype=np.int32)
+    try:
+        arr = np.asarray(token_lists, dtype=np.int32)
+    except OverflowError:
+        return web.json_response(
+            {"error": f"token ids must be in [0, {vocab})"}, status=400)
     if arr.min() < 0 or arr.max() >= vocab:
         return web.json_response(
             {"error": f"token ids must be in [0, {vocab})"}, status=400)

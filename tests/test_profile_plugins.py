@@ -1,0 +1,129 @@
+"""Cloud-identity profile plugins: IRSA trust-policy editing + per-profile
+plugin resolution (ref plugin_iam_test.go / plugin_workload_identity_test.go
+— pure in-memory policy JSON, no cloud calls)."""
+
+import pytest
+
+from kubeflow_tpu.api.crds import Profile, ProfilePluginSpec
+from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
+from kubeflow_tpu.controlplane.controllers.profile import (
+    IamForServiceAccountPlugin,
+    WorkloadIdentityPlugin,
+    add_irsa_statement,
+    remove_irsa_statement,
+)
+
+OIDC = "oidc.example.com/id/TEST"
+
+
+def test_add_statement_idempotent():
+    policy = {"Version": "2012-10-17", "Statement": []}
+    add_irsa_statement(policy, OIDC, "system:serviceaccount:a:default-editor")
+    add_irsa_statement(policy, OIDC, "system:serviceaccount:a:default-editor")
+    assert len(policy["Statement"]) == 1
+    s = policy["Statement"][0]
+    assert s["Action"] == "sts:AssumeRoleWithWebIdentity"
+    assert s["Principal"]["Federated"] == OIDC
+    assert s["Condition"]["StringEquals"][f"{OIDC}:sub"] == (
+        "system:serviceaccount:a:default-editor")
+
+
+def test_statement_accumulates_subjects_then_removes():
+    policy = {"Statement": []}
+    add_irsa_statement(policy, OIDC, "sub-a")
+    add_irsa_statement(policy, OIDC, "sub-b")
+    add_irsa_statement(policy, OIDC, "sub-c")
+    assert len(policy["Statement"]) == 1
+    subs = policy["Statement"][0]["Condition"]["StringEquals"][f"{OIDC}:sub"]
+    assert subs == ["sub-a", "sub-b", "sub-c"]
+
+    remove_irsa_statement(policy, OIDC, "sub-b")
+    subs = policy["Statement"][0]["Condition"]["StringEquals"][f"{OIDC}:sub"]
+    assert subs == ["sub-a", "sub-c"]
+    remove_irsa_statement(policy, OIDC, "sub-a")
+    # back to string form with one subject left (ref round-trip semantics)
+    assert policy["Statement"][0]["Condition"]["StringEquals"][
+        f"{OIDC}:sub"] == "sub-c"
+    remove_irsa_statement(policy, OIDC, "sub-c")
+    assert policy["Statement"] == []
+
+
+def test_remove_is_noop_for_unknown_subject_or_provider():
+    policy = {"Statement": []}
+    add_irsa_statement(policy, OIDC, "sub-a")
+    remove_irsa_statement(policy, OIDC, "nope")
+    remove_irsa_statement(policy, "other-provider", "sub-a")
+    assert len(policy["Statement"]) == 1
+
+
+def test_foreign_statements_untouched():
+    foreign = {"Effect": "Allow", "Action": "s3:GetObject"}
+    policy = {"Statement": [foreign]}
+    add_irsa_statement(policy, OIDC, "sub-a")
+    assert foreign in policy["Statement"] and len(policy["Statement"]) == 2
+    remove_irsa_statement(policy, OIDC, "sub-a")
+    assert policy["Statement"] == [foreign]
+
+
+def _profile(name, plugins=()):
+    p = Profile()
+    p.metadata.name = name
+    p.spec.owner = f"{name}@example.com"
+    p.spec.plugins = [ProfilePluginSpec(kind=k) for k in plugins]
+    return p
+
+
+def test_per_profile_plugins_apply_and_revoke():
+    irsa = IamForServiceAccountPlugin(oidc_provider=OIDC)
+    with Cluster(ClusterConfig()) as c:
+        c.profile_controller.plugin_registry = {
+            "WorkloadIdentity": WorkloadIdentityPlugin(),
+            "IamForServiceAccount": irsa,
+        }
+        c.store.create(_profile("alice", plugins=("IamForServiceAccount",)))
+        c.store.create(_profile("bob", plugins=("IamForServiceAccount",
+                                                "WorkloadIdentity")))
+        assert c.wait_idle(timeout=10)
+
+        sa_a = c.store.get("ServiceAccount", "alice", "default-editor")
+        arn_a = sa_a.metadata.annotations[IamForServiceAccountPlugin.SA_ANNOTATION]
+        assert arn_a == "arn:aws:iam::0:role/alice"
+        assert arn_a in irsa.policies
+        assert irsa.policies[arn_a]["Statement"][0]["Condition"][
+            "StringEquals"][f"{OIDC}:sub"] == (
+            "system:serviceaccount:alice:default-editor")
+
+        sa_b = c.store.get("ServiceAccount", "bob", "default-editor")
+        assert WorkloadIdentityPlugin.SA_ANNOTATION in sa_b.metadata.annotations
+
+        # Delete alice: finalizer revokes — policy emptied.
+        c.store.delete("Profile", "", "alice")
+        assert c.wait_idle(timeout=10)
+        assert irsa.policies[arn_a]["Statement"] == []
+
+
+def test_unknown_plugin_kind_fails_profile():
+    with Cluster(ClusterConfig()) as c:
+        c.store.create(_profile("eve", plugins=("NopeIdentity",)))
+        assert c.wait_idle(timeout=10)
+        prof = c.store.get("Profile", "", "eve")
+        assert prof.status.phase == "Failed"
+        assert "unknown plugin kind" in prof.status.message
+
+
+def test_plugin_options_configure_per_profile():
+    """ProfilePluginSpec.options reaches the plugin (ref GetPluginSpec)."""
+    irsa = IamForServiceAccountPlugin(oidc_provider=OIDC)
+    with Cluster(ClusterConfig()) as c:
+        c.profile_controller.plugin_registry = {"IamForServiceAccount": irsa}
+        p = _profile("carol")
+        p.spec.plugins = [ProfilePluginSpec(
+            kind="IamForServiceAccount",
+            options={"roleArnFormat": "arn:aws:iam::42:role/kf-{profile}"})]
+        c.store.create(p)
+        assert c.wait_idle(timeout=10)
+        sa = c.store.get("ServiceAccount", "carol", "default-editor")
+        arn = sa.metadata.annotations[IamForServiceAccountPlugin.SA_ANNOTATION]
+        assert arn == "arn:aws:iam::42:role/kf-carol"
+        # shared fake-IAM backend saw the configured ARN
+        assert arn in irsa.policies

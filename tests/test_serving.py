@@ -181,3 +181,14 @@ def test_byte_decode_drops_out_of_range_ids():
     # vocab-tail ids (>= 256+offset) and specials must not crash decode
     assert server_lib.byte_decode(
         [1, 300, ord("h") + 3, ord("i") + 3, 2, 500]) == "hi"
+
+
+async def test_out_of_int32_token_ids_are_400(llama_engine):
+    engine, _, _ = llama_engine
+    app = server_lib.create_serving_app({"m": engine})
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    r = await client.post("/v1/models/m:generate",
+                          json={"tokens": [[2**40]], "max_new": 1})
+    assert r.status == 400
+    await client.close()
