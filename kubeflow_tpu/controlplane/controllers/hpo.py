@@ -97,9 +97,12 @@ class ExperimentController(Controller):
                     **({"seed": spec.seed}
                        if spec.algorithm == "random" else {}))
             except ValueError as e:
-                exp.status.phase = "Failed"
-                exp.status.message = str(e)
-                store.update(exp)
+                if (exp.status.phase, exp.status.message) != (
+                    "Failed", str(e)
+                ):  # update-on-change only: see livelock note below
+                    exp.status.phase = "Failed"
+                    exp.status.message = str(e)
+                    store.update(exp)
                 return Result()
             suggester.suggest(len(trials))           # replay
             batch = suggester.suggest(to_create)
@@ -132,6 +135,8 @@ class ExperimentController(Controller):
                 spec.objective.goal, t.status.value, best.status.value
             ):
                 best = t
+        import dataclasses as _dc
+        old_status = _dc.asdict(exp.status)
         exp.status.trials_created = len(trials)
         exp.status.trials_succeeded = len(succeeded)
         exp.status.trials_failed = len(done) - len(succeeded)
@@ -148,7 +153,10 @@ class ExperimentController(Controller):
                 "Succeeded" if succeeded else "Failed")
         elif trials:
             exp.status.phase = "Running"
-        store.update(exp)
+        # Update only on change: an unconditional write would emit
+        # MODIFIED, re-enqueue this controller, and livelock.
+        if _dc.asdict(exp.status) != old_status:
+            store.update(exp)
         return Result()
 
 

@@ -433,11 +433,20 @@ class ProfileController(Controller):
             store.update(existing)
 
     def _finalize(self, store: Store, profile: Profile) -> Result:
-        try:
-            per_profile = resolve_profile_plugins(
-                profile, self.plugin_registry)
-        except ValueError:
-            per_profile = []  # unknown kinds have nothing to revoke
+        # Revoke every kind that is still resolvable — one unknown kind
+        # must not leak the others' external state (IAM trust policies
+        # are not cleaned up by the namespace cascade).
+        per_profile = []
+        for ps in profile.spec.plugins:
+            known = Profile()
+            known.metadata.name = profile.metadata.name
+            known.spec.owner = profile.spec.owner
+            known.spec.plugins = [ps]
+            try:
+                per_profile.extend(
+                    resolve_profile_plugins(known, self.plugin_registry))
+            except ValueError:
+                continue  # unknown kind: nothing we can revoke
         for plugin in [*self.plugins, *per_profile]:
             plugin.revoke(store, profile)
         try:

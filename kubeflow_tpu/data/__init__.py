@@ -1,0 +1,9 @@
+"""Data layer: native token-shard loader with a pure-Python fallback."""
+
+from kubeflow_tpu.data.loader import (
+    PyTokenLoader,
+    TokenShardLoader,
+    native_available,
+    open_loader,
+    write_shard,
+)

@@ -1,0 +1,238 @@
+"""Token-shard data loading: ctypes bindings over the native loader.
+
+`native/dataloader.cpp` owns the hot path (mmap shards, prefetch thread
+pool); this module is the thin Python face plus a bit-identical pure-
+Python fallback (`PyTokenLoader`) used when no C++ toolchain exists. The
+shuffle is a shared deterministic LCG Fisher-Yates, so the two
+implementations produce the SAME batch stream for the same
+(seed, epoch, host) — swapping loaders never changes training data order
+(parity is tested in tests/test_dataloader.py).
+
+Shard format "KTSH": magic u32 | version u32 | n_tokens u64 | int32[].
+Multi-host: (host, n_hosts) stripes the shuffled window order the way
+TPU_WORKER_ID stripes the gang — each host sees a disjoint window set.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+from typing import Iterator, Sequence
+
+import numpy as np
+
+MAGIC = 0x4853544B  # "KTSH"
+VERSION = 1
+_HEADER = struct.Struct("<IIQ")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libktdata.so")
+
+_lib: ctypes.CDLL | None = None
+_build_failed = False
+
+
+def write_shard(path: str, tokens: np.ndarray) -> None:
+    """Write an int32 token array as a KTSH shard."""
+    arr = np.ascontiguousarray(tokens, dtype=np.int32)
+    with open(path, "wb") as f:
+        f.write(_HEADER.pack(MAGIC, VERSION, arr.size))
+        f.write(arr.tobytes())
+
+
+def ensure_built() -> bool:
+    """Build libktdata.so if missing; returns availability."""
+    global _build_failed
+    if os.path.exists(_LIB_PATH):
+        return True
+    if _build_failed:
+        return False
+    src = os.path.join(_NATIVE_DIR, "dataloader.cpp")
+    if not os.path.exists(src):
+        _build_failed = True
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR, "libktdata.so"],
+            check=True, capture_output=True, timeout=120,
+        )
+        return os.path.exists(_LIB_PATH)
+    except Exception:
+        _build_failed = True
+        return False
+
+
+def _load_lib() -> ctypes.CDLL | None:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not ensure_built():
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.kt_loader_open.restype = ctypes.c_void_p
+    lib.kt_loader_open.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int,
+    ]
+    lib.kt_loader_next.restype = ctypes.c_int
+    lib.kt_loader_next.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_int32)]
+    lib.kt_loader_n_windows.restype = ctypes.c_uint64
+    lib.kt_loader_n_windows.argtypes = [ctypes.c_void_p]
+    lib.kt_loader_close.argtypes = [ctypes.c_void_p]
+    lib.kt_last_error.restype = ctypes.c_char_p
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _load_lib() is not None
+
+
+class TokenShardLoader:
+    """Native loader handle. Iterate with next_batch() -> [b, seq+1] i32."""
+
+    def __init__(self, paths: Sequence[str], *, batch: int, seq: int,
+                 seed: int = 0, host: int = 0, n_hosts: int = 1,
+                 prefetch: int = 4, threads: int = 2):
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError(
+                "native loader unavailable (no toolchain?); use "
+                "PyTokenLoader or open_loader()")
+        self._lib = lib
+        self.batch, self.seq = batch, seq
+        c_paths = (ctypes.c_char_p * len(paths))(
+            *[p.encode() for p in paths])
+        self._h = lib.kt_loader_open(
+            c_paths, len(paths), batch, seq, seed, host, n_hosts,
+            prefetch, threads)
+        if not self._h:
+            raise ValueError(
+                f"kt_loader_open: {lib.kt_last_error().decode()}")
+
+    @property
+    def n_windows(self) -> int:
+        return int(self._lib.kt_loader_n_windows(self._h))
+
+    def next_batch(self) -> np.ndarray:
+        # Fresh buffer per call: the C side memcpys straight into it —
+        # exactly one copy from the prefetched batch to Python.
+        out = np.empty((self.batch, self.seq + 1), np.int32)
+        rc = self._lib.kt_loader_next(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc != 0:
+            raise RuntimeError("loader closed")
+        return out
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.kt_loader_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            yield self.next_batch()
+
+
+# -- pure-Python fallback (bit-identical order) -----------------------------
+
+
+def _lcg_shuffle(n: int, seed: int, epoch: int) -> np.ndarray:
+    """Fisher-Yates driven by the SAME LCG as the C++ loader."""
+    perm = np.arange(n, dtype=np.uint64)
+    mask = (1 << 64) - 1
+    state = (seed ^ ((epoch * 0x9E3779B97F4A7C15) & mask)) & mask
+    for i in range(n, 1, -1):
+        state = (state * 6364136223846793005 + 1442695040888963407) & mask
+        j = (state >> 33) % i
+        perm[i - 1], perm[j] = perm[j], perm[i - 1]
+    return perm
+
+
+class PyTokenLoader:
+    """Same semantics as TokenShardLoader, no native dependency."""
+
+    def __init__(self, paths: Sequence[str], *, batch: int, seq: int,
+                 seed: int = 0, host: int = 0, n_hosts: int = 1,
+                 **_ignored):
+        if not paths or batch < 1 or seq < 1 or not (0 <= host < n_hosts):
+            raise ValueError("invalid arguments")
+        self.batch, self.seq = batch, seq
+        self.seed, self.host, self.n_hosts = seed, host, n_hosts
+        self._shards: list[np.ndarray] = []
+        self._window_base: list[int] = []
+        total = 0
+        for p in paths:
+            with open(p, "rb") as f:
+                magic, version, n_tokens = _HEADER.unpack(
+                    f.read(_HEADER.size))
+                if magic != MAGIC or version != VERSION:
+                    raise ValueError(f"bad shard {p}")
+                toks = np.fromfile(f, dtype=np.int32, count=n_tokens)
+                if toks.size != n_tokens:
+                    raise ValueError(f"truncated shard {p}")
+            self._shards.append(toks)
+            self._window_base.append(total)
+            total += max(0, (n_tokens - 1) // seq)
+        self._total_windows = total
+        self.n_windows = total // n_hosts
+        self._batches_per_epoch = self.n_windows // batch
+        if self._batches_per_epoch == 0:
+            raise ValueError("not enough windows for one batch")
+        self._ticket = 0
+        self._cached_epoch = -1
+        self._order: np.ndarray | None = None
+
+    def _window(self, global_w: int) -> np.ndarray:
+        si = 0
+        while (si + 1 < len(self._window_base)
+               and self._window_base[si + 1] <= global_w):
+            si += 1
+        local = global_w - self._window_base[si]
+        start = local * self.seq
+        return self._shards[si][start:start + self.seq + 1]
+
+    def next_batch(self) -> np.ndarray:
+        epoch = self._ticket // self._batches_per_epoch
+        b = self._ticket % self._batches_per_epoch
+        self._ticket += 1
+        if epoch != self._cached_epoch:
+            perm = _lcg_shuffle(self._total_windows, self.seed, epoch)
+            self._order = perm[self.host::self.n_hosts]
+            self._cached_epoch = epoch
+        out = np.empty((self.batch, self.seq + 1), np.int32)
+        for i in range(self.batch):
+            out[i] = self._window(int(self._order[b * self.batch + i]))
+        return out
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            yield self.next_batch()
+
+
+def open_loader(paths: Sequence[str], **kwargs):
+    """Native when available, Python otherwise — same batch stream."""
+    if native_available():
+        return TokenShardLoader(paths, **kwargs)
+    return PyTokenLoader(paths, **kwargs)

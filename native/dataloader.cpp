@@ -1,0 +1,292 @@
+// kubeflow-tpu native data loader: mmap'd token shards, prefetch threads.
+//
+// The reference has no native code anywhere (SURVEY.md §2a: "no C++, Rust,
+// or CUDA in the reference"); its data path is container images pulling
+// datasets inside notebook pods. A TPU training framework lives or dies on
+// host-side input throughput — the device steps in microseconds and the
+// Python GIL cannot fill a v5e host's batch pipe. This loader keeps the
+// hot path native:
+//   - shards are mmap'd (zero-copy reads, page cache shared across procs);
+//   - a thread pool assembles fixed-shape [batch, seq+1] int32 windows
+//     into a bounded ring of buffers (prefetch overlaps host->device);
+//   - window order is a deterministic per-epoch Fisher-Yates driven by an
+//     LCG, bit-identical to the Python fallback in
+//     kubeflow_tpu/data/loader.py — swap implementations, same batches.
+//
+// Shard format ("KTSH"): magic u32 | version u32 | n_tokens u64 | i32[].
+// C ABI (ctypes-consumed, no pybind11 per environment constraints):
+//   kt_loader_open(paths, n_paths, batch, seq, seed, host, n_hosts,
+//                  prefetch, threads) -> handle (0 on error)
+//   kt_loader_next(handle, out) -> 0 ok / -1 bad handle
+//   kt_loader_n_windows(handle) -> total windows visible to this host
+//   kt_loader_close(handle)
+//   kt_last_error() -> const char* (thread-local message)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+constexpr uint32_t kMagic = 0x4853544b;  // "KTSH" little-endian
+constexpr uint32_t kVersion = 1;
+
+struct Header {
+  uint32_t magic;
+  uint32_t version;
+  uint64_t n_tokens;
+};
+
+struct Shard {
+  const int32_t* tokens = nullptr;  // into the mmap
+  uint64_t n_tokens = 0;
+  void* map = nullptr;
+  size_t map_len = 0;
+};
+
+// Deterministic 64-bit LCG (same constants in the Python fallback).
+struct Lcg {
+  uint64_t state;
+  explicit Lcg(uint64_t seed) : state(seed) {}
+  uint64_t next() {
+    state = state * 6364136223846793005ULL + 1442695040888963407ULL;
+    return state >> 33;
+  }
+};
+
+class Loader {
+ public:
+  Loader(std::vector<Shard> shards, int batch, int seq, uint64_t seed,
+         int host, int n_hosts, int prefetch, int threads)
+      : shards_(std::move(shards)),
+        batch_(batch),
+        seq_(seq),
+        seed_(seed),
+        host_(host),
+        n_hosts_(n_hosts),
+        prefetch_(prefetch < 1 ? 1 : prefetch) {
+    // Windows never cross shard boundaries; global index = shard-major.
+    uint64_t cum = 0;
+    for (auto& s : shards_) {
+      uint64_t w = s.n_tokens > (uint64_t)seq_ ? (s.n_tokens - 1) / seq_ : 0;
+      window_base_.push_back(cum);
+      windows_per_shard_.push_back(w);
+      cum += w;
+    }
+    total_windows_ = cum;
+    // Host partition: windows at positions host, host+n_hosts, ... of the
+    // shuffled order. Per-host batch count floors so hosts stay in step.
+    host_windows_ = total_windows_ / n_hosts_;
+    batches_per_epoch_ = host_windows_ / batch_;
+    if (batches_per_epoch_ > 0)  // else open() rejects; no workers to race
+      for (int i = 0; i < (threads < 1 ? 1 : threads); ++i)
+        workers_.emplace_back([this] { WorkerLoop(); });
+  }
+
+  uint64_t batches_per_epoch() const { return batches_per_epoch_; }
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_not_full_.notify_all();
+    cv_not_empty_.notify_all();
+    for (auto& t : workers_) t.join();
+    for (auto& s : shards_)
+      if (s.map) munmap(s.map, s.map_len);
+  }
+
+  uint64_t total_windows() const { return host_windows_; }
+
+  int Next(int32_t* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_not_empty_.wait(lk, [this] { return !ready_.empty() || stop_; });
+    if (stop_ && ready_.empty()) return -1;
+    std::vector<int32_t> buf = std::move(ready_.front());
+    ready_.pop_front();
+    lk.unlock();
+    cv_not_full_.notify_one();
+    std::memcpy(out, buf.data(), buf.size() * sizeof(int32_t));
+    return 0;
+  }
+
+ private:
+  void CopyWindow(uint64_t global_w, int32_t* dst) const {
+    // Locate the shard (linear scan: shard counts are small).
+    size_t si = 0;
+    while (si + 1 < window_base_.size() &&
+           window_base_[si + 1] <= global_w)
+      ++si;
+    uint64_t local = global_w - window_base_[si];
+    const int32_t* src = shards_[si].tokens + local * (uint64_t)seq_;
+    std::memcpy(dst, src, (seq_ + 1) * sizeof(int32_t));
+  }
+
+  // One epoch's shuffled order, restricted to this host's slots.
+  std::vector<uint64_t> EpochOrder(uint64_t epoch) const {
+    std::vector<uint64_t> perm(total_windows_);
+    for (uint64_t i = 0; i < total_windows_; ++i) perm[i] = i;
+    Lcg rng(seed_ ^ (epoch * 0x9E3779B97F4A7C15ULL));
+    for (uint64_t i = total_windows_; i > 1; --i) {
+      uint64_t j = rng.next() % i;
+      std::swap(perm[i - 1], perm[j]);
+    }
+    std::vector<uint64_t> mine;
+    mine.reserve(host_windows_);
+    for (uint64_t i = (uint64_t)host_; i < total_windows_;
+         i += (uint64_t)n_hosts_)
+      mine.push_back(perm[i]);
+    return mine;
+  }
+
+  void WorkerLoop() {
+    while (true) {
+      uint64_t ticket;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (stop_) return;
+        ticket = next_ticket_++;
+      }
+      uint64_t epoch = ticket / batches_per_epoch_;
+      uint64_t b = ticket % batches_per_epoch_;
+      // Epoch order memoized per worker would still recompute across
+      // epochs; cache the latest per-thread (sequential access pattern).
+      thread_local uint64_t cached_epoch = UINT64_MAX;
+      thread_local std::vector<uint64_t> order;
+      if (cached_epoch != epoch) {
+        order = EpochOrder(epoch);
+        cached_epoch = epoch;
+      }
+      std::vector<int32_t> buf((size_t)batch_ * (seq_ + 1));
+      for (int i = 0; i < batch_; ++i)
+        CopyWindow(order[b * batch_ + i], buf.data() + (size_t)i * (seq_ + 1));
+      std::unique_lock<std::mutex> lk(mu_);
+      // Emit strictly in ticket order into a bounded queue. Each worker
+      // holds exactly one dense ticket, so the next_emit_ holder always
+      // becomes runnable once the consumer drains a slot: no deadlock.
+      cv_not_full_.wait(lk, [this, ticket] {
+        return ((int)ready_.size() < prefetch_ && next_emit_ == ticket)
+               || stop_;
+      });
+      if (stop_) return;
+      ready_.push_back(std::move(buf));
+      ++next_emit_;
+      lk.unlock();
+      cv_not_empty_.notify_all();
+      cv_not_full_.notify_all();
+    }
+  }
+
+  std::vector<Shard> shards_;
+  std::vector<uint64_t> window_base_, windows_per_shard_;
+  uint64_t total_windows_ = 0, host_windows_ = 0, batches_per_epoch_ = 0;
+  int batch_, seq_;
+  uint64_t seed_;
+  int host_, n_hosts_, prefetch_;
+  std::vector<std::thread> workers_;
+  std::mutex mu_;
+  std::condition_variable cv_not_empty_, cv_not_full_;
+  std::deque<std::vector<int32_t>> ready_;
+  uint64_t next_ticket_ = 0, next_emit_ = 0;
+  bool stop_ = false;
+};
+
+bool MapShard(const char* path, Shard* out) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) {
+    g_last_error = std::string("open failed: ") + path;
+    return false;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(Header)) {
+    g_last_error = std::string("stat failed or too small: ") + path;
+    close(fd);
+    return false;
+  }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (map == MAP_FAILED) {
+    g_last_error = std::string("mmap failed: ") + path;
+    return false;
+  }
+  const Header* h = static_cast<const Header*>(map);
+  if (h->magic != kMagic || h->version != kVersion) {
+    g_last_error = std::string("bad magic/version: ") + path;
+    munmap(map, st.st_size);
+    return false;
+  }
+  if (sizeof(Header) + h->n_tokens * sizeof(int32_t) > (uint64_t)st.st_size) {
+    g_last_error = std::string("truncated shard: ") + path;
+    munmap(map, st.st_size);
+    return false;
+  }
+  out->map = map;
+  out->map_len = st.st_size;
+  out->n_tokens = h->n_tokens;
+  out->tokens = reinterpret_cast<const int32_t*>(
+      static_cast<const char*>(map) + sizeof(Header));
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kt_loader_open(const char** paths, int n_paths, int batch, int seq,
+                     uint64_t seed, int host, int n_hosts, int prefetch,
+                     int threads) {
+  if (n_paths < 1 || batch < 1 || seq < 1 || n_hosts < 1 || host < 0 ||
+      host >= n_hosts) {
+    g_last_error = "invalid arguments";
+    return nullptr;
+  }
+  std::vector<Shard> shards(n_paths);
+  for (int i = 0; i < n_paths; ++i) {
+    if (!MapShard(paths[i], &shards[i])) {
+      for (int j = 0; j < i; ++j) munmap(shards[j].map, shards[j].map_len);
+      return nullptr;
+    }
+  }
+  auto* loader = new Loader(std::move(shards), batch, seq, seed, host,
+                            n_hosts, prefetch, threads);
+  if (loader->batches_per_epoch() == 0) {
+    g_last_error = "not enough windows for one batch";
+    delete loader;
+    return nullptr;
+  }
+  return loader;
+}
+
+int kt_loader_next(void* handle, int32_t* out) {
+  if (!handle) {
+    g_last_error = "null handle";
+    return -1;
+  }
+  return static_cast<Loader*>(handle)->Next(out);
+}
+
+uint64_t kt_loader_n_windows(void* handle) {
+  if (!handle) return 0;
+  return static_cast<Loader*>(handle)->total_windows();
+}
+
+void kt_loader_close(void* handle) {
+  delete static_cast<Loader*>(handle);
+}
+
+const char* kt_last_error() { return g_last_error.c_str(); }
+
+}  // extern "C"

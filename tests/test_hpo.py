@@ -184,3 +184,34 @@ def test_experiment_invalid_parameters_fail_cleanly():
         exp = c.store.get("Experiment", "user1", "exp")
         assert exp.status.phase == "Failed"
         assert "unknown parameter type" in exp.status.message
+
+
+def test_no_reconcile_livelock_after_completion():
+    """A finished Experiment must stop writing itself (self-triggering
+    MODIFIED events would peg a worker forever)."""
+    import time
+    cfg = ClusterConfig(trial_executor=lambda a: 1.0)
+    with Cluster(cfg) as c:
+        c.store.create(_experiment(max_trials=2, parallel=2))
+        assert c.wait_idle(timeout=20)
+        exp = c.store.get("Experiment", "user1", "exp")
+        assert exp.status.phase == "Succeeded"
+        rv0 = exp.metadata.resource_version
+        time.sleep(1.0)
+        rv1 = c.store.get("Experiment", "user1",
+                          "exp").metadata.resource_version
+        assert rv1 == rv0, "experiment still being rewritten while settled"
+
+
+def test_no_livelock_on_failed_validation():
+    import time
+    cfg = ClusterConfig(trial_executor=lambda a: 0.0)
+    with Cluster(cfg) as c:
+        exp = _experiment()
+        exp.spec.parameters = [ParameterSpec(name="x", type="nope")]
+        c.store.create(exp)
+        assert c.wait_idle(timeout=10)
+        rv0 = c.store.get("Experiment", "user1", "exp").metadata.resource_version
+        time.sleep(1.0)
+        rv1 = c.store.get("Experiment", "user1", "exp").metadata.resource_version
+        assert rv1 == rv0

@@ -127,3 +127,25 @@ def test_plugin_options_configure_per_profile():
         assert arn == "arn:aws:iam::42:role/kf-carol"
         # shared fake-IAM backend saw the configured ARN
         assert arn in irsa.policies
+
+
+def test_finalize_revokes_known_plugins_despite_unknown_kind():
+    irsa = IamForServiceAccountPlugin(oidc_provider=OIDC)
+    with Cluster(ClusterConfig()) as c:
+        c.profile_controller.plugin_registry = {"IamForServiceAccount": irsa}
+        p = _profile("dave", plugins=("IamForServiceAccount",))
+        c.store.create(p)
+        assert c.wait_idle(timeout=10)
+        arn = "arn:aws:iam::0:role/dave"
+        assert irsa.policies[arn]["Statement"]
+        # Registry loses a kind the profile later references.
+        fresh = c.store.get("Profile", "", "dave")
+        from kubeflow_tpu.api.crds import ProfilePluginSpec as PPS
+        fresh.spec.plugins = [PPS(kind="GoneIdentity"),
+                              PPS(kind="IamForServiceAccount")]
+        c.store.update(fresh)
+        c.wait_idle(timeout=10)
+        c.store.delete("Profile", "", "dave")
+        assert c.wait_idle(timeout=10)
+        # IRSA still revoked even though GoneIdentity is unresolvable.
+        assert irsa.policies[arn]["Statement"] == []
