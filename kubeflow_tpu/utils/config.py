@@ -1,0 +1,113 @@
+"""Hot-reloaded config files (the reference's fsnotify mechanism).
+
+The reference watches mounted ConfigMaps and reloads without restart:
+profile default namespace labels via fsnotify with symlink-aware re-add
+(profile_controller.go:356-405, 743-758), JWA spawner config re-read per
+request (jupyter utils.py:22-53). Kubernetes swaps an entire symlinked
+directory on ConfigMap update, so inotify on the file itself goes stale —
+the reference re-adds its watch; we poll the resolved real path + mtime
+(hermetic, no OS-specific watch API) and invoke callbacks on change.
+
+`WatchedConfig.data` is replaced atomically (readers grab the attribute);
+callbacks run on the poller thread.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Any, Callable
+
+log = logging.getLogger(__name__)
+
+
+def _parse(path: str, raw: str) -> Any:
+    if path.endswith((".yaml", ".yml")):
+        import yaml
+
+        return yaml.safe_load(raw)
+    return json.loads(raw)
+
+
+class WatchedConfig:
+    """Polls `path` and reloads on content change.
+
+    Usage:
+        cfg = WatchedConfig(path, default={})
+        cfg.on_change(lambda data: manager.enqueue_all("Profile"))
+        cfg.start()
+        ... cfg.data ...
+        cfg.stop()
+    """
+
+    def __init__(self, path: str, *, default: Any = None,
+                 poll_interval: float = 0.2):
+        self.path = path
+        self.poll_interval = poll_interval
+        self.data: Any = default
+        self._callbacks: list[Callable[[Any], None]] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_sig: tuple | None = None
+        self._load(initial=True)
+
+    def on_change(self, cb: Callable[[Any], None]) -> None:
+        self._callbacks.append(cb)
+
+    def _signature(self) -> tuple | None:
+        try:
+            real = os.path.realpath(self.path)  # symlink-swap aware
+            st = os.stat(real)
+            return (real, st.st_mtime_ns, st.st_size)
+        except OSError:
+            return None
+
+    def _load(self, initial: bool = False) -> None:
+        sig = self._signature()
+        if sig == self._last_sig:
+            return
+        self._last_sig = sig
+        if sig is None:
+            if not initial:
+                log.warning("watched config %s disappeared; keeping last "
+                            "value", self.path)
+            return
+        try:
+            with open(sig[0]) as f:
+                data = _parse(self.path, f.read())
+        except Exception as e:  # noqa: BLE001 — keep serving old config
+            log.warning("watched config %s unreadable (%s); keeping last "
+                        "value", self.path, e)
+            return
+        self.data = data
+        if not initial:
+            for cb in self._callbacks:
+                try:
+                    cb(data)
+                except Exception:  # noqa: BLE001
+                    log.exception("config change callback failed")
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            self._load()
+
+    def start(self) -> "WatchedConfig":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def __enter__(self) -> "WatchedConfig":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

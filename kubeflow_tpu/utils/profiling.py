@@ -1,0 +1,98 @@
+"""Profiling: XLA profiler traces, first-compile latency, step timing.
+
+The reference has no tracing/profiling at all (SURVEY.md §5 "Tracing /
+profiling — absent"); its observability is metrics + logs. The TPU
+replacement is the XLA profiler (TensorBoard profile plugin reads the
+trace directory) plus the platform's north-star latency metric
+(BASELINE.md): **pod-to-first-XLA-compile seconds** — how long a user
+waits between pod start and a first compiled step.
+
+Pod start time comes from `KFTPU_POD_START_TIME` (epoch seconds,
+injected by the TPU webhook alongside the topology env); fallback is
+process start.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Callable
+
+import jax
+
+_PROCESS_START = time.time()
+POD_START_ENV = "KFTPU_POD_START_TIME"
+
+
+def pod_start_time() -> float:
+    raw = os.environ.get(POD_START_ENV, "")
+    try:
+        return float(raw)
+    except ValueError:
+        return _PROCESS_START
+
+
+def time_to_first_compile(
+    fn: Callable[..., Any], *args: Any, **kwargs: Any
+) -> tuple[float, Any]:
+    """Run `jit(fn)(*args)` once and return (seconds since pod start at
+    completion of the first compile+execute, result). The BASELINE
+    "pod-to-first-XLA-compile" measurement."""
+    out = jax.jit(fn)(*args, **kwargs)
+    jax.block_until_ready(out)
+    return time.time() - pod_start_time(), out
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """XLA profiler trace → `logdir` (open with TensorBoard's profile
+    plugin). Wraps steps of interest:
+
+        with profiling.trace("/tmp/profile"):
+            state, loss = trainer.step(state, batch, targets)
+    """
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Blocking step timer with percentile summary.
+
+    `with timer.step(): ...` — the exit blocks on `ready` (pass the
+    step's output) so async dispatch doesn't fake a fast step.
+    """
+
+    def __init__(self):
+        self.durations: list[float] = []
+
+    @contextlib.contextmanager
+    def step(self, ready: Any = None):
+        t0 = time.perf_counter()
+        yield
+        if ready is not None:
+            jax.block_until_ready(ready)
+        self.durations.append(time.perf_counter() - t0)
+
+    def record(self, seconds: float) -> None:
+        self.durations.append(seconds)
+
+    def summary(self) -> dict[str, float]:
+        if not self.durations:
+            return {}
+        xs = sorted(self.durations)
+
+        def pct(p: float) -> float:
+            return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+        return {
+            "count": len(xs),
+            "mean_s": sum(xs) / len(xs),
+            "p50_s": pct(0.50),
+            "p90_s": pct(0.90),
+            "p99_s": pct(0.99),
+            "max_s": xs[-1],
+        }

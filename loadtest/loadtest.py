@@ -1,0 +1,102 @@
+"""Control-plane load test: N notebooks, reconcile fan-out latency.
+
+Hermetic re-design of the reference's loadtest
+(`/root/reference/components/notebook-controller/loadtest/
+start_notebooks.py:1-60`, default 3 CRs via kubectl): spawns N Notebook
+CRs against the in-process cluster and measures time until every
+StatefulSet has ready pods, plus webhook/controller throughput. Run:
+
+    python loadtest/loadtest.py --notebooks 200 --tpu 0
+    python loadtest/loadtest.py --notebooks 50 --tpu 8   # gang scheduling
+
+Prints one JSON line per phase (machine-readable like bench.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from kubeflow_tpu.api.core import Container, PodTemplateSpec
+from kubeflow_tpu.api.crds import Notebook
+from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
+
+
+def mk_notebook(i: int, ns: str, topology: str = "") -> Notebook:
+    nb = Notebook()
+    nb.metadata.name = f"load-{i}"
+    nb.metadata.namespace = ns
+    nb.spec.template = PodTemplateSpec()
+    nb.spec.template.spec.containers.append(
+        Container(name=f"load-{i}", image="kubeflow-tpu/jupyter-jax:latest"))
+    nb.spec.tpu.topology = topology
+    return nb
+
+
+def wait_all_ready(cluster: Cluster, ns: str, n: int,
+                   timeout: float) -> float | None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ready = sum(
+            1 for sts in cluster.store.list("StatefulSet", ns)
+            if sts.ready_replicas >= max(1, sts.spec.replicas))
+        if ready >= n:
+            return time.monotonic()
+        time.sleep(0.02)
+    return None
+
+
+def run(n_notebooks: int, tpu_slices: int, timeout: float) -> int:
+    topo = "v5e-16" if tpu_slices else ""
+    cfg = ClusterConfig(tpu_slices={"v5e-16": tpu_slices})
+    with Cluster(cfg) as cluster:
+        t0 = time.monotonic()
+        for i in range(n_notebooks):
+            cluster.store.create(mk_notebook(i, "load", topo))
+        t_created = time.monotonic()
+        done = wait_all_ready(cluster, "load", min(
+            n_notebooks, tpu_slices or n_notebooks), timeout)
+        if done is None and not tpu_slices:
+            print(json.dumps({"error": "timeout waiting for readiness"}))
+            return 1
+        stats = {
+            "metric": "notebook_reconcile_fanout",
+            "notebooks": n_notebooks,
+            "create_s": round(t_created - t0, 4),
+            "all_ready_s": round((done or time.monotonic()) - t0, 4),
+            "notebooks_per_sec": round(
+                n_notebooks / ((done or time.monotonic()) - t0), 1),
+        }
+        if tpu_slices:
+            # Gang capacity: only `tpu_slices` gangs fit; the rest must be
+            # pending with a FailedScheduling warning, never partial.
+            scheduled = sum(
+                1 for sts in cluster.store.list("StatefulSet", "load")
+                if sts.ready_replicas == sts.spec.replicas
+                and sts.spec.replicas > 0)
+            partial = sum(
+                1 for sts in cluster.store.list("StatefulSet", "load")
+                if 0 < sts.ready_replicas < sts.spec.replicas)
+            stats.update(gangs_scheduled=scheduled, partial_gangs=partial)
+            if partial:
+                print(json.dumps({"error": "partial gang detected",
+                                  **stats}))
+                return 1
+        print(json.dumps(stats))
+        return 0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--notebooks", type=int, default=50)
+    p.add_argument("--tpu", type=int, default=0,
+                   help="number of v5e-16 slices in the pool (0 = CPU pods)")
+    p.add_argument("--timeout", type=float, default=120.0)
+    a = p.parse_args()
+    return run(a.notebooks, a.tpu, a.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

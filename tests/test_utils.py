@@ -1,0 +1,122 @@
+"""Profiling utilities + hot-reloaded config (fsnotify-equivalent)."""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.api.crds import Profile
+from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
+from kubeflow_tpu.utils import StepTimer, WatchedConfig, time_to_first_compile
+from kubeflow_tpu.utils import profiling
+
+
+def test_time_to_first_compile():
+    secs, out = time_to_first_compile(
+        lambda x: jnp.sum(x * 2.0), jnp.ones((8, 8)))
+    assert secs > 0
+    assert float(out) == 128.0
+
+
+def test_pod_start_env_overrides(monkeypatch):
+    monkeypatch.setenv(profiling.POD_START_ENV, str(time.time() - 100.0))
+    secs, _ = time_to_first_compile(lambda x: x + 1, jnp.zeros(()))
+    assert secs >= 100.0
+    monkeypatch.setenv(profiling.POD_START_ENV, "not-a-number")
+    secs, _ = time_to_first_compile(lambda x: x + 2, jnp.zeros(()))
+    assert secs < 100.0  # falls back to process start
+
+
+def test_step_timer_summary():
+    t = StepTimer()
+    for d in (0.01, 0.02, 0.03):
+        t.record(d)
+    x = jnp.ones((4,))
+    with t.step(ready=x * 2):
+        _ = x * 2
+    s = t.summary()
+    assert s["count"] == 4
+    assert s["p50_s"] <= s["p99_s"] <= s["max_s"]
+
+
+def test_trace_writes_profile(tmp_path):
+    logdir = str(tmp_path / "prof")
+    with profiling.trace(logdir):
+        jax.block_until_ready(jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))))
+    found = []
+    for root, _, files in os.walk(logdir):
+        found.extend(files)
+    assert found, "no trace files written"
+
+
+def test_watched_config_reload_and_symlink_swap(tmp_path):
+    real1 = tmp_path / "v1.json"
+    real1.write_text(json.dumps({"a": "1"}))
+    link = tmp_path / "config.json"
+    link.symlink_to(real1)
+
+    changes = []
+    cfg = WatchedConfig(str(link), poll_interval=0.05)
+    cfg.on_change(lambda d: changes.append(d))
+    assert cfg.data == {"a": "1"}
+    with cfg:
+        # in-place content change
+        real1.write_text(json.dumps({"a": "2"}))
+        deadline = time.time() + 5
+        while not changes and time.time() < deadline:
+            time.sleep(0.02)
+        assert changes and changes[-1] == {"a": "2"}
+
+        # k8s-style symlink swap to a new file
+        real2 = tmp_path / "v2.json"
+        real2.write_text(json.dumps({"a": "3"}))
+        tmp_link = tmp_path / "new_link"
+        tmp_link.symlink_to(real2)
+        os.replace(tmp_link, link)
+        deadline = time.time() + 5
+        while (not changes or changes[-1] != {"a": "3"}) \
+                and time.time() < deadline:
+            time.sleep(0.02)
+        assert changes[-1] == {"a": "3"}
+
+
+def test_watched_config_bad_content_keeps_last(tmp_path):
+    p = tmp_path / "c.json"
+    p.write_text(json.dumps({"ok": True}))
+    cfg = WatchedConfig(str(p), poll_interval=0.05)
+    with cfg:
+        p.write_text("{not json")
+        time.sleep(0.3)
+        assert cfg.data == {"ok": True}
+
+
+def test_label_config_change_reconciles_profiles(tmp_path):
+    """End-to-end fsnotify parity: editing the labels file relabels every
+    profile namespace (ref profile_controller.go:356-405 full
+    re-reconcile; empty value deletes the label :722-741)."""
+    labels = tmp_path / "labels.json"
+    labels.write_text(json.dumps({"team": "ml", "zone": "a"}))
+    cfg = ClusterConfig(namespace_labels_path=str(labels))
+    with Cluster(cfg) as c:
+        c.labels_config.poll_interval = 0.05
+        p = Profile()
+        p.metadata.name = "carol"
+        p.spec.owner = "carol@example.com"
+        c.store.create(p)
+        assert c.wait_idle(timeout=10)
+        ns = c.store.get("Namespace", "", "carol")
+        assert ns.metadata.labels["team"] == "ml"
+        assert ns.metadata.labels["zone"] == "a"
+
+        labels.write_text(json.dumps({"team": "infra", "zone": ""}))
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            ns = c.store.get("Namespace", "", "carol")
+            if (ns.metadata.labels.get("team") == "infra"
+                    and "zone" not in ns.metadata.labels):
+                break
+            time.sleep(0.05)
+        assert ns.metadata.labels["team"] == "infra"
+        assert "zone" not in ns.metadata.labels
