@@ -62,15 +62,26 @@ def _yaml(obj: Any, indent: int = 0) -> str:
             if isinstance(v, (dict, list)) and v:
                 lines.append(f"{pad}{k}:")
                 lines.append(_yaml(v, indent + 1))
+            elif isinstance(v, dict):
+                # Empty mapping must stay a mapping ({}), not a quoted
+                # string — GHA rejects `pull_request: "{}"` as an event.
+                lines.append(f"{pad}{k}: {{}}")
+            elif isinstance(v, list):
+                lines.append(f"{pad}{k}: []")
             else:
                 lines.append(f"{pad}{k}: {_scalar(v)}")
         return "\n".join(lines)
     if isinstance(obj, list):
         lines = []
         for v in obj:
-            if isinstance(v, dict):
+            if isinstance(v, (dict, list)) and not v:
+                lines.append(f"{pad}- {'{}' if isinstance(v, dict) else '[]'}")
+            elif isinstance(v, dict):
                 body = _yaml(v, indent + 1).lstrip()
                 lines.append(f"{pad}- {body}")
+            elif isinstance(v, list):
+                lines.append(f"{pad}-")
+                lines.append(_yaml(v, indent + 1))
             else:
                 lines.append(f"{pad}- {_scalar(v)}")
         return "\n".join(lines)

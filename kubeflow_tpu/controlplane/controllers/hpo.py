@@ -166,6 +166,11 @@ class TrialController(Controller):
 
     def __init__(self, executor: TrialExecutor | None = None):
         self.executor = executor
+        # Pod uid → (phase, metric-or-None): executor outcomes recorded
+        # BEFORE the store write, so a Conflict on update + workqueue
+        # retry replays the recorded result instead of re-running the
+        # objective (which may be slow or side-effecting).
+        self._executed: dict[str, tuple[str, str | None]] = {}
 
     def reconcile(self, store: Store, namespace: str, name: str) -> Result:
         try:
@@ -216,15 +221,30 @@ class TrialController(Controller):
         if self.executor is not None and pod.phase not in (
             "Succeeded", "Failed"
         ):
-            try:
-                value = float(self.executor(dict(trial.spec.assignment)))
-                pod.phase = "Succeeded"
-                pod.metadata.annotations[TRIAL_METRIC_ANNOTATION] = str(value)
-            except Exception as e:  # noqa: BLE001 — user objective
-                pod.phase = "Failed"
+            outcome = self._executed.get(pod.metadata.uid)
+            if outcome is None:
+                try:
+                    value = float(self.executor(dict(trial.spec.assignment)))
+                    outcome = ("Succeeded", str(value))
+                except Exception as e:  # noqa: BLE001 — user objective
+                    outcome = ("Failed", None)
+                    log.warning("trial %s objective failed: %s", name, e)
+                self._executed[pod.metadata.uid] = outcome
+                # The pop below misses pods that turn terminal through
+                # another writer (or trials deleted mid-retry), so bound
+                # the memo by evicting oldest entries — by then their
+                # Conflict retry has long since resolved.
+                while len(self._executed) > 256:
+                    self._executed.pop(next(iter(self._executed)))
+            pod.phase, metric = outcome
+            if metric is None:
                 pod.metadata.annotations.pop(TRIAL_METRIC_ANNOTATION, None)
-                log.warning("trial %s objective failed: %s", name, e)
+            else:
+                pod.metadata.annotations[TRIAL_METRIC_ANNOTATION] = metric
             store.update(pod)
+            # Durably recorded on the pod now; drop the memo so the map
+            # stays bounded over a long-lived controller.
+            self._executed.pop(pod.metadata.uid, None)
 
         # Mirror pod completion into trial status.
         if pod.phase == "Succeeded":

@@ -24,6 +24,7 @@ import os
 from kubeflow_tpu.api.core import (
     Container,
     EnvVar,
+    Event,
     HTTPRoute,
     Service,
     ServicePort,
@@ -222,6 +223,19 @@ class NotebookController(Controller):
             label_selector={NOTEBOOK_NAME_LABEL: nb.metadata.name},
         )
         ready = sum(1 for p in pods if p.phase == "Running" and p.ready)
+        # One namespace-wide event scan per reconcile (not per pod):
+        # _mirror_status runs on every pod/STS watch event, so per-pod
+        # events_for calls would be O(pods x events) on the hot path.
+        # Keep the LATEST warning per object by timestamp — store.list
+        # orders events by name (random uuid suffix), not recency.
+        warnings_by_obj: dict[tuple[str, str], Event] = {}
+        for e in store.list("Event", nb.metadata.namespace):
+            if e.type != "Warning":
+                continue
+            key = (e.involved_kind, e.involved_name)
+            prev = warnings_by_obj.get(key)
+            if prev is None or e.timestamp >= prev.timestamp:
+                warnings_by_obj[key] = e
         state = ""
         conditions = []
         for p in sorted(pods, key=lambda p: p.metadata.name):
@@ -229,16 +243,41 @@ class NotebookController(Controller):
                 "running" if p.phase == "Running" else
                 "terminated" if p.phase in ("Succeeded", "Failed") else "waiting"
             )
+            # Mirror WHY a pod is stuck, not just its phase — the spawner
+            # UI's "why is my pod pending" depends on it (ref
+            # notebook_controller.go:300-359 mirrors container
+            # state/reason; here the explanation lives in the pod's
+            # Warning events, e.g. FailedScheduling from the gang
+            # scheduler).
+            reason = message = ""
+            if p.phase not in ("Running", "Succeeded"):
+                last = warnings_by_obj.get(("Pod", p.metadata.name))
+                if last is not None:
+                    reason, message = last.reason, last.message
             conditions.append(NotebookCondition(
-                type=p.phase, reason="", message="",
+                type=p.phase, reason=reason, message=message,
             ))
+        if not pods:
+            # Gang scheduling failures create no pods at all; the warning
+            # sits on the StatefulSet. Surface it so status explains the
+            # empty gang instead of showing nothing.
+            last = warnings_by_obj.get(("StatefulSet", nb.metadata.name))
+            if last is not None:
+                state = "waiting"
+                conditions.append(NotebookCondition(
+                    type="Pending", reason=last.reason, message=last.message,
+                ))
         fresh = store.try_get("Notebook", nb.metadata.namespace, nb.metadata.name)
         if fresh is None:
             return
         assert isinstance(fresh, Notebook)
-        if (fresh.status.ready_replicas, fresh.status.container_state) != (
-            ready, state
-        ):
+
+        def _key(cs):
+            return [(c.type, c.reason, c.message) for c in cs]
+
+        if (fresh.status.ready_replicas, fresh.status.container_state,
+                _key(fresh.status.conditions)) != (ready, state,
+                                                   _key(conditions)):
             fresh.status.ready_replicas = ready
             fresh.status.container_state = state
             fresh.status.conditions = conditions

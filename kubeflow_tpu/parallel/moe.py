@@ -168,6 +168,18 @@ def moe_mlp_expert_parallel(
     frac·prob product, so the aux loss and its router gradient are
     bit-comparable to the unsharded `moe_mlp`.
 
+    Capacity semantics (intended, GShard/Switch-style): capacity is
+    derived from the LOCAL token count — each device grants every expert
+    `capacity_factor * T_local * k / E` slots for its own tokens. Under
+    tight capacity this drops per token-shard, not per global batch, so
+    the same global batch can route differently on different mesh shapes
+    and differs from `moe_mlp`'s global ranking. This is deliberate:
+    exact global-drop parity would need a cross-device token ranking
+    (a sort collective) before dispatch, defeating the point of EP. The
+    per-shard semantics make each device's math identical to `moe_mlp`
+    run on its local token block — tested that way in
+    tests/test_moe.py::test_ep_tight_capacity_matches_per_shard_dense.
+
     Each device routes its local tokens against ALL experts (router
     weights replicated), builds capacity-bounded dispatch buffers, then a
     single `all_to_all` moves each expert-group's slots to the device

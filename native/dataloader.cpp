@@ -118,7 +118,11 @@ class Loader {
     std::vector<int32_t> buf = std::move(ready_.front());
     ready_.pop_front();
     lk.unlock();
-    cv_not_full_.notify_one();
+    // notify_all, not notify_one: several workers can wait on cv_not_full_
+    // with distinct tickets, and only the next_emit_ holder's predicate is
+    // true. notify_one may wake a non-holder, which re-sleeps and consumes
+    // the wakeup — the holder would then never run (lost-wakeup deadlock).
+    cv_not_full_.notify_all();
     std::memcpy(out, buf.data(), buf.size() * sizeof(int32_t));
     return 0;
   }
@@ -228,7 +232,10 @@ bool MapShard(const char* path, Shard* out) {
     munmap(map, st.st_size);
     return false;
   }
-  if (sizeof(Header) + h->n_tokens * sizeof(int32_t) > (uint64_t)st.st_size) {
+  // Divide instead of multiply: n_tokens near 2^62 would wrap the product
+  // past the file size and slip through, then read far out of the mmap.
+  if (h->n_tokens >
+      ((uint64_t)st.st_size - sizeof(Header)) / sizeof(int32_t)) {
     g_last_error = std::string("truncated shard: ") + path;
     munmap(map, st.st_size);
     return false;

@@ -112,3 +112,23 @@ def test_divisibility_errors(params):
     with pytest.raises(ValueError, match="not divisible"):
         moe_lib.moe_mlp_sharded(init_moe(jax.random.key(1), bad), _x(),
                                 bad, mesh)
+
+
+def test_ep_tight_capacity_matches_per_shard_dense(params):
+    """Documented EP capacity semantics (moe.py): capacity binds per
+    token-shard, so each device's output equals the dense path run on its
+    local token block — and (unlike the no-drop regime) differs from the
+    global-ranking dense path on the full batch."""
+    tight = MoEConfig(num_experts=8, top_k=2, embed_dim=32, mlp_dim=64,
+                      capacity_factor=0.5)
+    mesh = create_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    x = _x()
+    y_ep, _ = moe_lib.moe_mlp_sharded(params, x, tight, mesh)
+    per_shard = jnp.concatenate(
+        [moe_lib.moe_mlp(params, blk, tight)[0]
+         for blk in jnp.split(x, 8, axis=0)]
+    )
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(per_shard),
+                               rtol=1e-4, atol=1e-4)
+    y_dense, _ = moe_lib.moe_mlp(params, x, tight)
+    assert not np.allclose(np.asarray(y_ep), np.asarray(y_dense), atol=1e-5)

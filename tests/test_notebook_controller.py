@@ -164,3 +164,25 @@ def test_drift_correction(cluster):
         import time
         time.sleep(0.05)
     assert cluster.store.get("StatefulSet", "user1", "nb1").spec.replicas == 1
+
+
+def test_status_conditions_carry_failure_reason(cluster):
+    """Status mirrors WHY a notebook is stuck (ref mirrors container
+    state/reason, notebook_controller.go:300-359): a gang that cannot
+    schedule yields a Pending condition with the FailedScheduling
+    reason/message, and a healthy notebook carries clean conditions."""
+    cluster.store.create(mk_notebook("a", topology="v5e-16"))
+    assert cluster.wait_idle()
+    nb_a = cluster.store.get("Notebook", "user1", "a")
+    assert all(c.reason == "" for c in nb_a.status.conditions)
+    assert nb_a.status.container_state == "running"
+
+    cluster.store.create(mk_notebook("blocked", topology="v5e-16"))
+    assert cluster.wait_idle()
+    nb_b = cluster.store.get("Notebook", "user1", "blocked")
+    assert nb_b.status.container_state == "waiting"
+    reasons = {(c.type, c.reason) for c in nb_b.status.conditions}
+    assert ("Pending", "FailedScheduling") in reasons
+    msg = next(c.message for c in nb_b.status.conditions
+               if c.reason == "FailedScheduling")
+    assert "capacity" in msg
