@@ -41,6 +41,7 @@ TOPOLOGY_LABEL = "kubeflow-tpu.dev/tpu-topology"
 MESH_LABEL = "kubeflow-tpu.dev/mesh"
 
 JAX_COORDINATOR_PORT = 8476
+POD_START_TIME_ENV = "KFTPU_POD_START_TIME"
 
 
 class PodDefaultWebhook:
@@ -61,6 +62,18 @@ class PodDefaultWebhook:
             for pd in defaults:
                 self._apply(obj, pd)
         self._inject_tpu_env(obj)
+        self._inject_pod_start_time(obj)
+
+    def _inject_pod_start_time(self, pod: Pod) -> None:
+        """Stamp admission time so utils/profiling can report
+        pod-to-first-XLA-compile (the BASELINE north-star latency) from
+        the actual pod start instead of falling back to process start."""
+        import time as _time
+
+        stamp = str(_time.time())
+        for c in pod.spec.containers:
+            if all(e.name != POD_START_TIME_ENV for e in c.env):
+                c.env.append(EnvVar(name=POD_START_TIME_ENV, value=stamp))
 
     # -- selection (ref filterPodDefaults main.go:70-95) -------------------
 

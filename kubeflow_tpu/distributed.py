@@ -1,0 +1,108 @@
+"""Multi-host bootstrap: consume the webhook's topology env.
+
+The control plane's half of the collective backend already exists — the
+admission webhook computes and injects `TPU_WORKER_ID`,
+`TPU_WORKER_HOSTNAMES`, `JAX_COORDINATOR_ADDRESS` and
+`KFTPU_NUM_PROCESSES` onto every gang pod
+(controlplane/webhook.py:_inject_tpu_env). This module is the in-pod
+half: it turns that env into a live `jax.distributed` process group —
+the NCCL/MPI-rendezvous replacement SURVEY.md §5 names ("Distributed
+communication backend": `jax.distributed.initialize(coordinator_address,
+num_processes=len(TPU_WORKER_HOSTNAMES), process_id=TPU_WORKER_ID)`).
+The reference's closest mechanism is env merging in its PodDefault
+webhook (admission-webhook/main.go:153-188); it has no consumer because
+it has no compute layer. Ours does: call `initialize_from_env()` first
+thing in a training entrypoint (the jupyter-jax-tpu image does this on
+kernel start), then `parallel.mesh_from_env()` for the sharding layout.
+
+Collectives then ride ICI within a slice and DCN across slices — both
+owned by XLA; nothing here opens a socket besides the coordinator
+handshake.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+log = logging.getLogger(__name__)
+
+COORDINATOR_ENV = "JAX_COORDINATOR_ADDRESS"
+NUM_PROCESSES_ENV = "KFTPU_NUM_PROCESSES"
+PROCESS_ID_ENV = "TPU_WORKER_ID"
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def initialize_from_env(timeout_secs: int | None = None) -> bool:
+    """Form the global process group from webhook-injected env.
+
+    Returns True when `jax.distributed.initialize` ran (multi-process
+    gang), False when the env describes a single process (or is absent)
+    and no initialization is needed — single-pod notebooks fall through
+    to plain local JAX. Safe to call more than once; subsequent calls
+    are no-ops.
+
+    Raises ValueError on half-injected env (coordinator without process
+    count, non-integer worker id) — a misconfigured gang should fail
+    loudly at startup, not hang N-1 workers in the coordinator
+    handshake.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator = os.environ.get(COORDINATOR_ENV, "")
+    raw_num = os.environ.get(NUM_PROCESSES_ENV, "")
+    raw_id = os.environ.get(PROCESS_ID_ENV, "")
+    if not coordinator and not raw_num:
+        return False
+    if not coordinator or not raw_num:
+        raise ValueError(
+            f"half-injected gang env: {COORDINATOR_ENV}={coordinator!r} "
+            f"{NUM_PROCESSES_ENV}={raw_num!r} — the TPU webhook injects "
+            "both or neither"
+        )
+    try:
+        num_processes = int(raw_num)
+        process_id = int(raw_id or "0")
+    except ValueError as e:
+        raise ValueError(f"non-integer gang env: {e}") from e
+    if num_processes <= 1:
+        return False
+    kwargs = {}
+    if timeout_secs is not None:
+        kwargs["initialization_timeout"] = timeout_secs
+    log.info(
+        "jax.distributed.initialize coordinator=%s process=%d/%d",
+        coordinator, process_id, num_processes,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+    _initialized = True
+    return True
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def global_mesh_from_env(devices=None):
+    """initialize_from_env() + parallel.mesh_from_env() in one call —
+    the two-line prologue of every gang training script."""
+    initialize_from_env()
+    from kubeflow_tpu.parallel.mesh import mesh_from_env
+
+    return mesh_from_env(devices)

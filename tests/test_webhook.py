@@ -66,7 +66,11 @@ def test_selector_mismatch_no_apply():
     s = mk_store()
     s.create(mk_poddefault("x", env=[EnvVar("A", "1")]))
     created = s.create(mk_pod())
-    assert created.spec.containers[0].env == []
+    # only the unconditional pod-start stamp, nothing from the mismatched
+    # TpuPodDefault
+    assert [e.name for e in created.spec.containers[0].env] == [
+        wh.POD_START_TIME_ENV
+    ]
 
 
 def test_env_conflict_denied():
@@ -171,3 +175,17 @@ def test_user_env_not_overwritten_by_tpu_env():
     created = s.create(pod)
     env = [e for e in created.spec.containers[0].env if e.name == "TPU_WORKER_ID"]
     assert len(env) == 1 and env[0].value == "7"
+
+
+def test_pod_start_time_injected_for_all_pods():
+    """Every admitted pod gets KFTPU_POD_START_TIME (epoch seconds) so
+    utils/profiling's pod-to-first-compile metric measures from actual
+    pod admission, not process start."""
+    import time
+
+    s = mk_store()
+    before = time.time()
+    created = s.create(mk_pod())
+    env = {e.name: e.value for e in created.spec.containers[0].env}
+    stamp = float(env[wh.POD_START_TIME_ENV])
+    assert before - 1 <= stamp <= time.time() + 1
