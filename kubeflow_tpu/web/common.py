@@ -30,7 +30,11 @@ from kubeflow_tpu.controlplane.store import (
 
 log = logging.getLogger(__name__)
 
-AUTH_EXEMPT = {"/healthz", "/readyz", "/metrics"}
+AUTH_EXEMPT = {"/healthz", "/readyz", "/metrics", "/"}
+# The SPA shell and its assets load before identity is known — the auth
+# proxy injects the userid header on API calls; the shell itself is
+# public (same as the reference serving the dashboard bundle).
+AUTH_EXEMPT_PREFIXES = ("/static/",)
 
 
 def json_success(payload: dict[str, Any] | None = None, status: int = 200):
@@ -73,9 +77,22 @@ async def error_middleware(request: web.Request, handler):
 
 @web.middleware
 async def authn_middleware(request: web.Request, handler):
-    if request.path in AUTH_EXEMPT:
+    if request.path in AUTH_EXEMPT or request.path.startswith(
+        AUTH_EXEMPT_PREFIXES
+    ):
         return await handler(request)
-    request["user"] = auth.authenticate(request.headers)
+    try:
+        request["user"] = auth.authenticate(request.headers)
+    except auth.Unauthenticated:
+        # DEV fallback (ref getBasicEnvironment, api_workgroup.ts:147-158:
+        # no identity headers ⇒ a fixed local identity). Only active when
+        # the operator opts in (create_platform_app(dev_user=...)) —
+        # production deployments sit behind an auth proxy that always
+        # injects the header.
+        dev = request.config_dict.get("dev_user")
+        if not dev:
+            raise
+        request["user"] = auth.User(dev)
     return await handler(request)
 
 

@@ -1,0 +1,72 @@
+// API client: thin fetch wrapper speaking the backends' JSON envelope
+// ({success, status, ...} — web/common.py json_success/json_error) with
+// CSRF double-submit echo (cookie XSRF-TOKEN → header X-XSRF-TOKEN,
+// ref crud_backend/csrf.py semantics).
+
+const CSRF_COOKIE = 'XSRF-TOKEN';
+const CSRF_HEADER = 'X-XSRF-TOKEN';
+
+function csrfToken() {
+  for (const part of document.cookie.split(';')) {
+    const [k, ...v] = part.trim().split('=');
+    if (k === CSRF_COOKIE) return decodeURIComponent(v.join('='));
+  }
+  return '';
+}
+
+export class ApiError extends Error {
+  constructor(message, status) {
+    super(message);
+    this.status = status;
+  }
+}
+
+async function call(method, path, body) {
+  const headers = { Accept: 'application/json' };
+  if (method !== 'GET') headers[CSRF_HEADER] = csrfToken();
+  if (body !== undefined) headers['Content-Type'] = 'application/json';
+  const resp = await fetch(path, {
+    method,
+    headers,
+    body: body === undefined ? undefined : JSON.stringify(body),
+    credentials: 'same-origin',
+  });
+  let data = {};
+  try {
+    data = await resp.json();
+  } catch {
+    /* non-JSON error body */
+  }
+  if (!resp.ok || data.success === false) {
+    throw new ApiError(data.log || `${resp.status} ${resp.statusText}`, resp.status);
+  }
+  return data;
+}
+
+export const api = {
+  get: (path) => call('GET', path),
+  post: (path, body) => call('POST', path, body ?? {}),
+  patch: (path, body) => call('PATCH', path, body),
+  del: (path, body) => call('DELETE', path, body),
+};
+
+// Route map — every path the SPA touches, in one place (the HTTP test
+// asserts each exists on the server so the frontend can't drift).
+export const routes = {
+  envInfo: '/api/workgroup/env-info',
+  workgroupExists: '/api/workgroup/exists',
+  workgroupCreate: '/api/workgroup/create',
+  namespaces: '/api/namespaces',
+  activities: (ns) => `/api/activities/${ns}`,
+  dashboardLinks: '/api/dashboard-links',
+  metrics: (type) => `/api/metrics/${type}`,
+  spawnerConfig: '/jupyter/api/config',
+  notebooks: (ns) => `/jupyter/api/namespaces/${ns}/notebooks`,
+  notebook: (ns, name) => `/jupyter/api/namespaces/${ns}/notebooks/${name}`,
+  poddefaults: (ns) => `/jupyter/api/namespaces/${ns}/poddefaults`,
+  pvcs: (ns) => `/volumes/api/namespaces/${ns}/pvcs`,
+  pvc: (ns, name) => `/volumes/api/namespaces/${ns}/pvcs/${name}`,
+  tensorboards: (ns) => `/tensorboards/api/namespaces/${ns}/tensorboards`,
+  tensorboard: (ns, name) => `/tensorboards/api/namespaces/${ns}/tensorboards/${name}`,
+  kfamBindings: '/kfam/v1/bindings',
+};

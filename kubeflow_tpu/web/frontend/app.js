@@ -1,0 +1,176 @@
+// SPA shell + hash router (ref centraldashboard main-page.js /
+// dashboard-view.js / manage-users-view.js and the CRUD apps' Angular
+// pages, re-done frameworkless). Views render into #outlet; the
+// namespace selector is global state shared by every view, like the
+// reference's namespace-selector element.
+
+import { api, routes, ApiError } from '/static/api.js';
+import { homeView } from '/static/views_home.js';
+import { notebooksView, notebookFormView } from '/static/views_notebooks.js';
+import { volumesView } from '/static/views_volumes.js';
+import { tensorboardsView } from '/static/views_tensorboards.js';
+import { contributorsView } from '/static/views_contributors.js';
+
+export const state = {
+  user: '',
+  isClusterAdmin: false,
+  namespaces: [],
+  namespace: localStorage.getItem('kftpu.ns') || '',
+};
+
+const outlet = document.getElementById('outlet');
+const nsSelect = document.getElementById('ns-select');
+
+// -- helpers shared by views ----------------------------------------
+
+export function h(tag, attrs = {}, ...children) {
+  const el = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs)) {
+    if (k === 'class') el.className = v;
+    else if (k.startsWith('on') && typeof v === 'function') {
+      el.addEventListener(k.slice(2), v);
+    } else if (v !== undefined && v !== null) el.setAttribute(k, v);
+  }
+  for (const c of children.flat()) {
+    if (c === null || c === undefined) continue;
+    el.append(c instanceof Node ? c : document.createTextNode(String(c)));
+  }
+  return el;
+}
+
+let toastTimer;
+export function toast(message, isError = false) {
+  const el = document.getElementById('toast');
+  el.textContent = message;
+  el.className = `toast${isError ? ' err' : ''}`;
+  clearTimeout(toastTimer);
+  toastTimer = setTimeout(() => el.classList.add('hidden'), 4500);
+}
+
+export function reportError(err) {
+  toast(err instanceof ApiError ? err.message : String(err), true);
+}
+
+export function ago(epochSecs) {
+  const d = Date.now() / 1000 - epochSecs;
+  if (d < 60) return `${Math.max(1, Math.round(d))}s ago`;
+  if (d < 3600) return `${Math.round(d / 60)}m ago`;
+  if (d < 86400) return `${Math.round(d / 3600)}h ago`;
+  return `${Math.round(d / 86400)}d ago`;
+}
+
+// -- router ---------------------------------------------------------
+
+const views = {
+  home: homeView,
+  jupyter: notebooksView,
+  'jupyter/new': notebookFormView,
+  volumes: volumesView,
+  tensorboards: tensorboardsView,
+  contributors: contributorsView,
+};
+
+function currentRoute() {
+  const hash = location.hash.replace(/^#\//, '');
+  return hash === '' ? 'home' : hash;
+}
+
+export async function render() {
+  const route = currentRoute();
+  const view = views[route] || views.home;
+  for (const a of document.querySelectorAll('.nav-list a')) {
+    a.classList.toggle(
+      'active',
+      a.dataset.route === (route.startsWith('jupyter') ? 'jupyter' : route),
+    );
+  }
+  outlet.replaceChildren(h('div', { class: 'card' }, 'Loading…'));
+  try {
+    const node = await view({ state, outlet });
+    outlet.replaceChildren(node);
+  } catch (err) {
+    outlet.replaceChildren(
+      h('div', { class: 'card' }, h('h2', {}, 'Error'), String(err.message || err)),
+    );
+  }
+}
+
+// -- registration (workgroup_exists → create, ref registration-page.js)
+
+async function ensureWorkgroup() {
+  const info = await api.get(routes.workgroupExists);
+  if (info.hasWorkgroup || state.namespaces.length) return;
+  const suggested = (state.user || 'user').split('@')[0].replace(/[^a-z0-9-]/g, '-');
+  const input = h('input', { value: suggested, 'aria-label': 'Namespace name' });
+  const btn = h('button', { class: 'primary' }, 'Create workspace');
+  const card = h(
+    'div',
+    { class: 'card register' },
+    h('h2', {}, `Welcome, ${state.user}`),
+    h('p', { class: 'sub' }, 'You have no workspace yet. Create your personal namespace to start spawning TPU notebooks.'),
+    input,
+    btn,
+  );
+  btn.addEventListener('click', async () => {
+    btn.disabled = true;
+    try {
+      await api.post(routes.workgroupCreate, { namespace: input.value.trim() });
+      toast(`Workspace ${input.value.trim()} created`);
+      await bootstrap();
+    } catch (err) {
+      reportError(err);
+      btn.disabled = false;
+    }
+  });
+  outlet.replaceChildren(card);
+  throw Object.assign(new Error('registration required'), { handled: true });
+}
+
+// -- bootstrap (ref dashboard env bootstrap, SURVEY §3.4) -----------
+
+async function bootstrap() {
+  const env = await api.get(routes.envInfo);
+  state.user = env.user;
+  state.isClusterAdmin = !!env.isClusterAdmin;
+  state.namespaces = env.namespaces || [];
+  document.getElementById('user-chip').textContent = state.user;
+  document
+    .getElementById('cluster-admin-badge')
+    .classList.toggle('hidden', !state.isClusterAdmin);
+
+  if (!state.namespaces.includes(state.namespace)) {
+    state.namespace = state.namespaces[0] || '';
+  }
+  nsSelect.replaceChildren(
+    ...state.namespaces.map((ns) =>
+      h('option', { value: ns, ...(ns === state.namespace ? { selected: '' } : {}) }, ns),
+    ),
+  );
+
+  try {
+    await ensureWorkgroup();
+  } catch (err) {
+    if (err.handled) return; // registration card is showing
+    throw err;
+  }
+  await render();
+}
+
+nsSelect.addEventListener('change', () => {
+  state.namespace = nsSelect.value;
+  localStorage.setItem('kftpu.ns', state.namespace);
+  render();
+});
+window.addEventListener('hashchange', render);
+
+bootstrap().catch((err) => {
+  outlet.replaceChildren(
+    h(
+      'div',
+      { class: 'card' },
+      h('h2', {}, 'Cannot reach the platform API'),
+      h('p', {}, String(err.message || err)),
+      h('p', { class: 'sub' }, 'Check that you are signed in (the auth proxy must inject the kubeflow-userid header).'),
+    ),
+  );
+});

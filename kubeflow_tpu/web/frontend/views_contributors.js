@@ -1,0 +1,116 @@
+// Manage Contributors view (ref manage-users-view.js + KFAM): list the
+// namespace's role bindings, add/remove contributors. Talks to the
+// KFAM service routes directly (/kfam/v1/bindings), same as the
+// reference dashboard proxies to KFAM.
+
+import { api, routes } from '/static/api.js';
+import { h, state, toast, reportError, render } from '/static/app.js';
+
+export async function contributorsView() {
+  const ns = state.namespace;
+  if (!ns) return h('div', { class: 'card empty' }, 'No namespace selected.');
+  let bindings = [];
+  let readError = null;
+  try {
+    const data = await api.get(`${routes.kfamBindings}?namespace=${encodeURIComponent(ns)}`);
+    bindings = data.bindings || [];
+  } catch (err) {
+    readError = err;
+  }
+
+  if (readError) {
+    return h(
+      'div',
+      { class: 'card' },
+      h('h2', {}, 'Manage Contributors'),
+      h('p', { class: 'sub' }, `You need owner or admin rights on ${ns} to manage contributors.`),
+      h('p', {}, String(readError.message)),
+    );
+  }
+
+  const rows = bindings.map((b) =>
+    h(
+      'tr',
+      {},
+      h('td', {}, b.user),
+      h('td', {}, b.role),
+      h(
+        'td',
+        {},
+        h(
+          'button',
+          {
+            class: 'small danger',
+            onclick: async () => {
+              try {
+                await api.del(routes.kfamBindings, { user: b.user, namespace: ns, role: b.role });
+                toast(`Removed ${b.user}`);
+                render();
+              } catch (err) {
+                reportError(err);
+              }
+            },
+          },
+          'Remove',
+        ),
+      ),
+    ),
+  );
+
+  const userInput = h('input', { placeholder: 'teammate@example.com' });
+  const roleSelect = h(
+    'select',
+    {},
+    h('option', { value: 'edit', selected: '' }, 'edit'),
+    h('option', { value: 'view' }, 'view'),
+    h('option', { value: 'admin' }, 'admin'),
+  );
+  const addBtn = h('button', { class: 'primary' }, 'Add contributor');
+  addBtn.addEventListener('click', async () => {
+    addBtn.disabled = true;
+    try {
+      await api.post(routes.kfamBindings, {
+        user: userInput.value.trim(),
+        namespace: ns,
+        role: roleSelect.value,
+      });
+      toast(`Added ${userInput.value.trim()}`);
+      render();
+    } catch (err) {
+      reportError(err);
+      addBtn.disabled = false;
+    }
+  });
+
+  return h(
+    'div',
+    {},
+    h(
+      'div',
+      { class: 'card' },
+      h('div', { class: 'toolbar' }, h('h2', {}, `Contributors to ${ns}`)),
+      rows.length
+        ? h(
+            'table',
+            { class: 'grid' },
+            h('thead', {}, h('tr', {}, h('th', {}, 'User'), h('th', {}, 'Role'), h('th', {}, ''))),
+            h('tbody', {}, rows),
+          )
+        : h('div', { class: 'empty' }, 'No contributors besides the owner.'),
+    ),
+    h(
+      'div',
+      { class: 'card' },
+      h('h3', {}, 'Add contributor'),
+      h(
+        'div',
+        { class: 'form-grid' },
+        h('label', {}, 'User'),
+        userInput,
+        h('label', {}, 'Role'),
+        roleSelect,
+        h('div', { class: 'span2' }, addBtn),
+      ),
+    ),
+  );
+}

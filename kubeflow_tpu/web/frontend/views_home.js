@@ -1,0 +1,62 @@
+// Home view (ref dashboard-view.js): usage tiles from /api/metrics,
+// quick links from /api/dashboard-links, recent activity feed from
+// /api/activities/{ns}.
+
+import { api, routes } from '/static/api.js';
+import { h, ago } from '/static/app.js';
+
+export async function homeView({ state }) {
+  const ns = state.namespace;
+  const [metrics, links, activities] = await Promise.all([
+    api.get(routes.metrics('summary')),
+    api.get(routes.dashboardLinks),
+    ns ? api.get(routes.activities(ns)) : Promise.resolve({ activities: [] }),
+  ]);
+
+  const tpuTiles = Object.entries(metrics.tpuHostsInUse || {}).map(([topo, hosts]) =>
+    h('div', { class: 'tile' }, h('div', { class: 'n' }, hosts), h('div', { class: 't' }, `${topo} hosts in use`)),
+  );
+
+  const feed = (activities.activities || []).slice(0, 15).map((a) =>
+    h(
+      'tr',
+      { class: `activity${a.type === 'Warning' ? ' warn' : ''}` },
+      h('td', { class: 'when' }, ago(a.time)),
+      h('td', { class: 'reason' }, a.reason),
+      h('td', {}, `${a.kind}/${a.name}`),
+      h('td', {}, a.message),
+    ),
+  );
+
+  return h(
+    'div',
+    {},
+    h(
+      'div',
+      { class: 'tile-row' },
+      h('div', { class: 'tile' }, h('div', { class: 'n' }, metrics.notebooks ?? 0), h('div', { class: 't' }, 'notebooks')),
+      h('div', { class: 'tile' }, h('div', { class: 'n' }, state.namespaces.length), h('div', { class: 't' }, 'namespaces you can access')),
+      tpuTiles.length ? tpuTiles : h('div', { class: 'tile' }, h('div', { class: 'n' }, 0), h('div', { class: 't' }, 'TPU hosts in use')),
+    ),
+    h(
+      'div',
+      { class: 'card' },
+      h('h3', {}, 'Quick shortcuts'),
+      h(
+        'div',
+        { class: 'quick-links' },
+        ((links.links || {}).quickLinks || []).map((l) =>
+          h('a', { href: l.link.startsWith('/jupyter/new') ? '#/jupyter/new' : l.link }, l.desc),
+        ),
+      ),
+    ),
+    h(
+      'div',
+      { class: 'card' },
+      h('h3', {}, `Recent activity in ${ns || '(no namespace)'}`),
+      feed.length
+        ? h('table', { class: 'grid' }, h('tbody', {}, feed))
+        : h('div', { class: 'empty' }, 'No recent events.'),
+    ),
+  );
+}

@@ -1,0 +1,244 @@
+// Notebooks list + spawner form (ref crud-web-apps/jupyter/frontend
+// pages/index and pages/form). The form is driven ENTIRELY by the
+// admin spawner config from GET /jupyter/api/config: readOnly sections
+// render pinned (disabled) controls, options populate pickers — the
+// same value/readOnly contract the backend enforces (web/form.py).
+
+import { api, routes } from '/static/api.js';
+import { h, state, toast, reportError, render } from '/static/app.js';
+
+const PHASE_DOT = {
+  ready: 'ready',
+  waiting: 'waiting',
+  warning: 'warning',
+  stopped: 'stopped',
+  terminating: 'terminating',
+};
+
+export async function notebooksView() {
+  const ns = state.namespace;
+  if (!ns) return h('div', { class: 'card empty' }, 'No namespace selected.');
+  const data = await api.get(routes.notebooks(ns));
+
+  const rows = (data.notebooks || []).map((nb) => {
+    const stopped = nb.status.phase === 'stopped';
+    const stopBtn = h(
+      'button',
+      {
+        class: 'small',
+        onclick: async () => {
+          try {
+            await api.patch(routes.notebook(ns, nb.name), { stopped: !stopped });
+            toast(stopped ? `Starting ${nb.name}` : `Stopping ${nb.name}`);
+            render();
+          } catch (err) {
+            reportError(err);
+          }
+        },
+      },
+      stopped ? 'Start' : 'Stop',
+    );
+    const delBtn = h(
+      'button',
+      {
+        class: 'small danger',
+        onclick: async () => {
+          if (!confirm(`Delete notebook ${nb.name}? Its workspace PVC is kept.`)) return;
+          try {
+            await api.del(routes.notebook(ns, nb.name));
+            toast(`Deleted ${nb.name}`);
+            render();
+          } catch (err) {
+            reportError(err);
+          }
+        },
+      },
+      'Delete',
+    );
+    return h(
+      'tr',
+      {},
+      h(
+        'td',
+        {},
+        h(
+          'span',
+          { class: 'status', title: nb.status.message },
+          h('span', { class: `dot ${PHASE_DOT[nb.status.phase] || 'waiting'}` }),
+          nb.status.phase,
+        ),
+      ),
+      h('td', {}, nb.status.phase === 'ready'
+        ? h('a', { href: nb.serverUrl, target: '_blank', rel: 'noopener' }, nb.name)
+        : nb.name),
+      h('td', {}, nb.image.split('/').pop()),
+      h('td', {}, nb.tpu.topology || '—'),
+      h('td', {}, String(nb.readyReplicas)),
+      h('td', { title: nb.status.message }, nb.status.message),
+      h('td', {}, stopBtn, ' ', delBtn),
+    );
+  });
+
+  return h(
+    'div',
+    { class: 'card' },
+    h(
+      'div',
+      { class: 'toolbar' },
+      h('h2', {}, `Notebooks in ${ns}`),
+      h('button', { class: 'primary', onclick: () => (location.hash = '#/jupyter/new') }, '+ New Notebook'),
+    ),
+    rows.length
+      ? h(
+          'table',
+          { class: 'grid' },
+          h(
+            'thead',
+            {},
+            h('tr', {}, h('th', {}, 'Status'), h('th', {}, 'Name'), h('th', {}, 'Image'), h('th', {}, 'TPU'), h('th', {}, 'Ready'), h('th', {}, 'Info'), h('th', {}, '')),
+          ),
+          h('tbody', {}, rows),
+        )
+      : h('div', { class: 'empty' }, 'No notebooks yet — spawn one with “New Notebook”.'),
+  );
+}
+
+// -- spawner form ---------------------------------------------------
+
+function section(config, key) {
+  return config[key] || { value: '', readOnly: false };
+}
+
+function pinned(sec) {
+  return sec.readOnly ? { disabled: '' } : {};
+}
+
+function roPill(sec) {
+  return sec.readOnly ? h('span', { class: 'readonly-pill' }, 'admin-pinned') : null;
+}
+
+export async function notebookFormView() {
+  const ns = state.namespace;
+  if (!ns) return h('div', { class: 'card empty' }, 'No namespace selected.');
+  const [{ config }, pdResp] = await Promise.all([
+    api.get(routes.spawnerConfig),
+    api.get(routes.poddefaults(ns)),
+  ]);
+  const poddefaults = pdResp.poddefaults || [];
+
+  const img = section(config, 'image');
+  const cpu = section(config, 'cpu');
+  const mem = section(config, 'memory');
+  const tpu = section(config, 'tpu');
+  const ws = section(config, 'workspaceVolume');
+  const shm = section(config, 'shm');
+  const confs = section(config, 'configurations');
+
+  const nameInput = h('input', { placeholder: 'my-notebook', 'aria-label': 'Name' });
+  const imageSelect = h(
+    'select',
+    { 'aria-label': 'Image', ...pinned(img) },
+    (img.options || [img.value]).map((o) =>
+      h('option', { value: o, ...(o === img.value ? { selected: '' } : {}) }, o),
+    ),
+  );
+  const cpuInput = h('input', { value: cpu.value, ...(cpu.readOnly ? { readonly: '' } : {}) });
+  const memInput = h('input', { value: mem.value, ...(mem.readOnly ? { readonly: '' } : {}) });
+
+  const topoSelect = h(
+    'select',
+    { 'aria-label': 'TPU slice', ...pinned(tpu) },
+    (tpu.options || ['']).map((o) =>
+      h('option', { value: o, ...(o === (tpu.value || {}).topology ? { selected: '' } : {}) }, o === '' ? 'none (CPU only)' : o),
+    ),
+  );
+  const meshInput = h('input', {
+    placeholder: 'data=1,fsdp=16,tensor=1 (optional)',
+    value: (tpu.value || {}).mesh || '',
+    ...(tpu.readOnly ? { readonly: '' } : {}),
+  });
+
+  const wsName = h('input', {
+    value: (ws.value || {}).name || '{notebook-name}-workspace',
+    ...(ws.readOnly ? { readonly: '' } : {}),
+  });
+  const wsSize = h('input', {
+    value: (ws.value || {}).size || '5Gi',
+    ...(ws.readOnly ? { readonly: '' } : {}),
+  });
+  const shmCheck = h('input', {
+    type: 'checkbox',
+    ...(shm.value ? { checked: '' } : {}),
+    ...pinned(shm),
+  });
+
+  const pdChecks = poddefaults.map((pd) => {
+    const selected = (confs.value || []).includes(pd.name);
+    const cb = h('input', {
+      type: 'checkbox',
+      value: pd.name,
+      ...(selected ? { checked: '' } : {}),
+      ...pinned(confs),
+    });
+    return h('label', { class: 'check-row' }, cb, `${pd.name} — ${pd.desc || 'no description'}`);
+  });
+
+  const submit = h('button', { class: 'primary' }, 'Launch');
+  submit.addEventListener('click', async () => {
+    submit.disabled = true;
+    try {
+      const body = {
+        name: nameInput.value.trim(),
+        image: imageSelect.value,
+        cpu: cpuInput.value,
+        memory: memInput.value,
+        tpu: { topology: topoSelect.value, mesh: meshInput.value.trim() },
+        workspace: { name: wsName.value, size: wsSize.value },
+        shm: shmCheck.checked,
+        configurations: pdChecks
+          .map((row) => row.querySelector('input'))
+          .filter((cb) => cb.checked)
+          .map((cb) => cb.value),
+      };
+      await api.post(routes.notebooks(ns), body);
+      toast(`Notebook ${body.name} created`);
+      location.hash = '#/jupyter';
+    } catch (err) {
+      reportError(err);
+      submit.disabled = false;
+    }
+  });
+
+  return h(
+    'div',
+    { class: 'card' },
+    h('h2', {}, 'New Notebook'),
+    h('p', { class: 'sub' }, `Namespace ${ns} — fields the admin pinned are read-only.`),
+    h(
+      'div',
+      { class: 'form-grid' },
+      h('label', {}, 'Name'),
+      nameInput,
+      h('label', {}, 'Image', roPill(img)),
+      imageSelect,
+      h('label', {}, 'CPU'),
+      cpuInput,
+      h('label', {}, 'Memory'),
+      memInput,
+      h('label', {}, 'TPU slice', roPill(tpu)),
+      topoSelect,
+      h('label', {}, 'Device mesh'),
+      meshInput,
+      h('div', { class: 'field-note' }, 'Mesh axes (data/fsdp/tensor) must multiply to the slice chip count; leave empty for pure FSDP.'),
+      h('label', {}, 'Workspace volume', roPill(ws)),
+      h('div', {}, wsName, h('div', { class: 'field-note' }, '{notebook-name} expands to the server name.')),
+      h('label', {}, 'Workspace size'),
+      wsSize,
+      h('label', {}, 'Shared memory'),
+      h('label', { class: 'check-row' }, shmCheck, 'mount /dev/shm'),
+      h('label', { class: 'span2' }, 'Configurations (TpuPodDefaults)'),
+      pdChecks.length ? h('div', { class: 'span2' }, pdChecks) : h('div', { class: 'field-note span2' }, 'None available in this namespace.'),
+      h('div', { class: 'span2' }, submit, ' ', h('button', { onclick: () => (location.hash = '#/jupyter') }, 'Cancel')),
+    ),
+  );
+}

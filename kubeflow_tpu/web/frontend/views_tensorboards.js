@@ -1,0 +1,102 @@
+// TensorBoards view (ref crud-web-apps/tensorboards frontend): list +
+// create with logspath (pvc:// or gs://) + delete.
+
+import { api, routes } from '/static/api.js';
+import { h, state, toast, reportError, render } from '/static/app.js';
+
+export async function tensorboardsView() {
+  const ns = state.namespace;
+  if (!ns) return h('div', { class: 'card empty' }, 'No namespace selected.');
+  const data = await api.get(routes.tensorboards(ns));
+
+  const rows = (data.tensorboards || []).map((t) =>
+    h(
+      'tr',
+      {},
+      h(
+        'td',
+        {},
+        h(
+          'span',
+          { class: 'status' },
+          h('span', { class: `dot ${t.ready ? 'ready' : 'waiting'}` }),
+          t.ready ? 'ready' : 'starting',
+        ),
+      ),
+      h('td', {}, t.ready ? h('a', { href: t.url, target: '_blank', rel: 'noopener' }, t.name) : t.name),
+      h('td', {}, t.logspath),
+      h(
+        'td',
+        {},
+        h(
+          'button',
+          {
+            class: 'small danger',
+            onclick: async () => {
+              if (!confirm(`Delete tensorboard ${t.name}?`)) return;
+              try {
+                await api.del(routes.tensorboard(ns, t.name));
+                toast(`Deleted ${t.name}`);
+                render();
+              } catch (err) {
+                reportError(err);
+              }
+            },
+          },
+          'Delete',
+        ),
+      ),
+    ),
+  );
+
+  const nameInput = h('input', { placeholder: 'my-tensorboard' });
+  const logsInput = h('input', { placeholder: 'pvc://my-volume/logs or gs://bucket/runs' });
+  const createBtn = h('button', { class: 'primary' }, 'Create');
+  createBtn.addEventListener('click', async () => {
+    createBtn.disabled = true;
+    try {
+      await api.post(routes.tensorboards(ns), {
+        name: nameInput.value.trim(),
+        logspath: logsInput.value.trim(),
+      });
+      toast(`TensorBoard ${nameInput.value.trim()} created`);
+      render();
+    } catch (err) {
+      reportError(err);
+      createBtn.disabled = false;
+    }
+  });
+
+  return h(
+    'div',
+    {},
+    h(
+      'div',
+      { class: 'card' },
+      h('div', { class: 'toolbar' }, h('h2', {}, `TensorBoards in ${ns}`)),
+      rows.length
+        ? h(
+            'table',
+            { class: 'grid' },
+            h('thead', {}, h('tr', {}, h('th', {}, 'Status'), h('th', {}, 'Name'), h('th', {}, 'Logs path'), h('th', {}, ''))),
+            h('tbody', {}, rows),
+          )
+        : h('div', { class: 'empty' }, 'No tensorboards.'),
+    ),
+    h(
+      'div',
+      { class: 'card' },
+      h('h3', {}, 'New TensorBoard'),
+      h(
+        'div',
+        { class: 'form-grid' },
+        h('label', {}, 'Name'),
+        nameInput,
+        h('label', {}, 'Logs path'),
+        logsInput,
+        h('div', { class: 'field-note' }, 'pvc://volume/subpath mounts a volume; gs:// reads straight from object storage.'),
+        h('div', { class: 'span2' }, createBtn),
+      ),
+    ),
+  );
+}

@@ -1,0 +1,90 @@
+// Volumes view (ref crud-web-apps/volumes frontend): PVC list with
+// used-by protection surfaced, inline create form.
+
+import { api, routes } from '/static/api.js';
+import { h, state, toast, reportError, render } from '/static/app.js';
+
+export async function volumesView() {
+  const ns = state.namespace;
+  if (!ns) return h('div', { class: 'card empty' }, 'No namespace selected.');
+  const data = await api.get(routes.pvcs(ns));
+
+  const rows = (data.pvcs || []).map((p) => {
+    const used = (p.usedBy || []).length > 0;
+    const delBtn = h(
+      'button',
+      {
+        class: 'small danger',
+        ...(used ? { disabled: '', title: `in use by ${p.usedBy.join(', ')}` } : {}),
+        onclick: async () => {
+          if (!confirm(`Delete volume ${p.name}?`)) return;
+          try {
+            await api.del(routes.pvc(ns, p.name));
+            toast(`Deleted ${p.name}`);
+            render();
+          } catch (err) {
+            reportError(err);
+          }
+        },
+      },
+      'Delete',
+    );
+    return h(
+      'tr',
+      {},
+      h('td', {}, p.name),
+      h('td', {}, p.size),
+      h('td', {}, (p.accessModes || []).join(', ')),
+      h('td', {}, p.phase),
+      h('td', {}, used ? p.usedBy.join(', ') : '—'),
+      h('td', {}, delBtn),
+    );
+  });
+
+  const nameInput = h('input', { placeholder: 'my-volume' });
+  const sizeInput = h('input', { value: '5Gi' });
+  const createBtn = h('button', { class: 'primary' }, 'Create');
+  createBtn.addEventListener('click', async () => {
+    createBtn.disabled = true;
+    try {
+      await api.post(routes.pvcs(ns), { name: nameInput.value.trim(), size: sizeInput.value });
+      toast(`Volume ${nameInput.value.trim()} created`);
+      render();
+    } catch (err) {
+      reportError(err);
+      createBtn.disabled = false;
+    }
+  });
+
+  return h(
+    'div',
+    {},
+    h(
+      'div',
+      { class: 'card' },
+      h('div', { class: 'toolbar' }, h('h2', {}, `Volumes in ${ns}`)),
+      rows.length
+        ? h(
+            'table',
+            { class: 'grid' },
+            h('thead', {}, h('tr', {}, h('th', {}, 'Name'), h('th', {}, 'Size'), h('th', {}, 'Access'), h('th', {}, 'Phase'), h('th', {}, 'Used by'), h('th', {}, ''))),
+            h('tbody', {}, rows),
+          )
+        : h('div', { class: 'empty' }, 'No volumes.'),
+    ),
+    h(
+      'div',
+      { class: 'card' },
+      h('h3', {}, 'New volume'),
+      h(
+        'div',
+        { class: 'form-grid' },
+        h('label', {}, 'Name'),
+        nameInput,
+        h('label', {}, 'Size'),
+        sizeInput,
+        h('div', { class: 'span2' }, createBtn),
+      ),
+    ),
+  );
+}

@@ -9,6 +9,8 @@ alone (their create_* factories are independent).
 
 from __future__ import annotations
 
+import os
+
 from aiohttp import web
 
 from kubeflow_tpu.controlplane.store import Store
@@ -26,8 +28,11 @@ def create_platform_app(
     spawner_config=None,
     csrf: bool = True,
     metrics=None,
+    dev_user: str | None = None,
 ) -> web.Application:
     root = create_dashboard_app(store, cluster_admins=cluster_admins, csrf=csrf)
+    if dev_user:
+        root["dev_user"] = dev_user
     root["csrf_exempt_prefixes"] = ("/kfam/",)
     if metrics is not None:
         # /metrics + request counters (ref kfam routers.go:82-86 exposes
@@ -50,7 +55,26 @@ def create_platform_app(
         store, cluster_admins=cluster_admins, csrf=csrf))
     root.add_subapp("/kfam/", create_kfam_app(
         store, cluster_admins=cluster_admins, csrf=False))
+    add_frontend(root)
     return root
+
+
+FRONTEND_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "frontend")
+
+
+def add_frontend(app: web.Application) -> None:
+    """Serve the SPA (ref centraldashboard/public): index.html at /,
+    hashed-routed so every view lives under the one document; modules
+    and styles under /static/. Assets are committed files, no build
+    step — the frameworkless answer to the reference's Polymer/Angular
+    bundles."""
+
+    async def index(_request: web.Request):
+        return web.FileResponse(os.path.join(FRONTEND_DIR, "index.html"))
+
+    app.router.add_get("/", index)
+    app.router.add_static("/static/", FRONTEND_DIR)
 
 
 # Bounded label set: unknown first segments (scanners, typos) bucket to
@@ -88,6 +112,9 @@ def main() -> None:  # pragma: no cover - manual entry point
     p = argparse.ArgumentParser()
     p.add_argument("--port", type=int, default=8082)
     p.add_argument("--tpu-slices", default="v5e-16=1,v5e-1=4")
+    p.add_argument("--dev-user", default="",
+                   help="identity to assume when no auth header is present "
+                        "(local development without an auth proxy)")
     args = p.parse_args()
 
     slices = {}
@@ -95,8 +122,11 @@ def main() -> None:  # pragma: no cover - manual entry point
         k, _, v = part.partition("=")
         if k:
             slices[k] = int(v or 1)
-    cluster = Cluster(ClusterConfig(tpu_slices=slices)).start()
-    app = cluster.create_web_app()
+    cluster = Cluster(ClusterConfig(
+        tpu_slices=slices,
+        cluster_admins={args.dev_user} if args.dev_user else set(),
+    )).start()
+    app = cluster.create_web_app(dev_user=args.dev_user or None)
     web.run_app(app, port=args.port)
 
 
