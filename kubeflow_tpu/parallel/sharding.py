@@ -87,11 +87,42 @@ def shard_pytree_specs(rules: ShardingRules, logical: Any, mesh: Mesh) -> Any:
     )
 
 
+def _filter_spec_to_mesh(spec: P) -> P:
+    """Drop mesh axes the current context can't constrain.
+
+    Model code names logical axes unconditionally; which physical axes
+    exist — and which are already manual because we're inside a
+    shard_map (e.g. the PP stage axis) — depends on the caller's mesh.
+    Axes missing from the mesh or not Auto are unconstrainable there by
+    definition, so dropping them is the correct meaning of the
+    constraint, not a silent loss (typos are still caught earlier by
+    rules.resolve on the LOGICAL name)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if not getattr(mesh, "axis_names", ()):
+        return spec  # no mesh context; with_sharding_constraint will no-op
+    auto = {
+        name
+        for name, t in zip(mesh.axis_names, mesh.axis_types)
+        if t == jax.sharding.AxisType.Auto
+    }
+
+    def filt(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in auto)
+            return kept if kept else None
+        return entry if entry in auto else None
+
+    return P(*(filt(e) for e in spec))
+
+
 def with_sharding_constraint(x: Any, logical_axes: tuple[str | None, ...],
                              rules: ShardingRules = LLAMA_RULES) -> Any:
     """Constrain an activation's sharding by logical axes (no-op outside jit
     without a mesh context)."""
     spec = rules.resolve(logical_axes)  # typos in logical names must raise
+    spec = _filter_spec_to_mesh(spec)
     try:
         return jax.lax.with_sharding_constraint(x, spec)
     except Exception as e:

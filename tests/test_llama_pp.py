@@ -1,0 +1,113 @@
+"""PP-Llama: flagship blocks as pipeline stages (VERDICT r1 item 5).
+
+Numerics vs the plain full-depth forward, and training: a few SGD steps
+on the 8-device mesh with the loss decreasing and matching the non-PP
+loss on identical data.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama, llama_pp
+
+
+CFG = llama.LLAMA_TINY  # 2 layers
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    devs = np.array(jax.devices()[:2])
+    return jax.sharding.Mesh(devs, ("stage",))
+
+
+def _data(b=4, s=16, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = jnp.asarray(rng.integers(0, CFG.vocab_size, (b, s)), jnp.int32)
+    return toks, jnp.roll(toks, -1, axis=1)
+
+
+def test_split_merge_roundtrip():
+    params = llama.init(jax.random.key(0), CFG)
+    staged = llama_pp.split_stages(params, CFG, 2)
+    for leaf in jax.tree.leaves(staged):
+        assert leaf.shape[0] == 2 and leaf.shape[1] == 1
+    merged = llama_pp.merge_stages(staged)
+    for a, b in zip(jax.tree.leaves(merged),
+                    jax.tree.leaves(params["blocks"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_indivisible_layers_rejected():
+    params = llama.init(jax.random.key(0), CFG)
+    with pytest.raises(ValueError, match="not divisible"):
+        llama_pp.split_stages(params, CFG, 3)
+
+
+def test_pp_logits_match_dense(mesh4):
+    params = llama.init(jax.random.key(0), CFG)
+    toks, _ = _data()
+    ref = llama.apply(params, CFG, toks)
+    out = llama_pp.apply_pipelined(params, CFG, toks, mesh4,
+                                   num_microbatches=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pp_loss_matches_dense_and_trains(mesh4):
+    params = llama.init(jax.random.key(1), CFG)
+    toks, tgts = _data(seed=1)
+
+    def dense_loss(p):
+        logits = llama.apply(p, CFG, toks)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return jnp.mean(-jnp.take_along_axis(logp, tgts[..., None], axis=-1))
+
+    pp_loss = llama_pp.loss_pipelined(params, CFG, toks, tgts, mesh4,
+                                      num_microbatches=2)
+    np.testing.assert_allclose(float(pp_loss), float(dense_loss(params)),
+                               rtol=1e-4)
+
+    step = llama_pp.make_train_step(CFG, mesh4, learning_rate=5e-2,
+                                    num_microbatches=2)
+    momentum = jax.tree.map(jnp.zeros_like, params)
+    losses = []
+    for _ in range(6):
+        params, momentum, loss = step(params, momentum, toks, tgts)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+def test_pp_grads_match_dense(mesh4):
+    """Gradients THROUGH the pipeline (scan + ppermute VJPs) must equal
+    the dense path's — per-stage grads live on their stage but the
+    values agree."""
+    params = llama.init(jax.random.key(2), CFG)
+    toks, tgts = _data(seed=2)
+
+    def dense_loss(p):
+        logits = llama.apply(p, CFG, toks)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return jnp.mean(-jnp.take_along_axis(logp, tgts[..., None], axis=-1))
+
+    g_dense = jax.grad(dense_loss)(params)
+    g_pp = jax.grad(
+        lambda p: llama_pp.loss_pipelined(p, CFG, toks, tgts, mesh4,
+                                          num_microbatches=2)
+    )(params)
+    dense_leaves = {
+        jax.tree_util.keystr(k): v
+        for k, v in jax.tree_util.tree_leaves_with_path(g_dense)
+    }
+    pp_leaves = {
+        jax.tree_util.keystr(k): v
+        for k, v in jax.tree_util.tree_leaves_with_path(g_pp)
+    }
+    assert dense_leaves.keys() == pp_leaves.keys()
+    for key in dense_leaves:
+        np.testing.assert_allclose(
+            np.asarray(pp_leaves[key]), np.asarray(dense_leaves[key]),
+            rtol=5e-3, atol=5e-4, err_msg=key,
+        )
