@@ -1,18 +1,35 @@
 #!/usr/bin/env python
-"""Benchmark: Llama training tokens/sec/chip on the local device(s).
+"""Benchmarks across BASELINE.md's target configs on the local device(s).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints ONE JSON line (driver contract). The headline metric keeps the
+round-1/2 shape so results stay comparable across rounds:
+
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "extra_metrics": [{...}, {...}, ...]}
+
+`extra_metrics` carries the rest of the BASELINE sweep, one dict per
+metric with the same keys:
+  - llama train tokens/s/chip on a ~1.1B-param bf16 Llama-3-shape model
+    (the closest single-chip proxy to BASELINE.md's 8B-FSDP north star:
+    same block shapes at 2048 hidden, bf16 params + Adam state sized to
+    one 16 GB v5e chip via a 32k bench vocab + tied head),
+  - train tokens/s/chip at seq 4096, with a hard assert that the
+    attention dispatch took the Pallas flash kernel (ops/attention.py
+    trace-time impl counters) — not a silent XLA fallback,
+  - serving decode tokens/s on serving/engine.py (KV-cache scan decode),
+  - pod-to-first-XLA-compile seconds (BASELINE.md north-star latency),
+    measured from KFTPU_POD_START_TIME (webhook-injected; process start
+    when absent) to the first compiled+executed training step.
 
 The reference (kubeflow/kubeflow control plane) publishes no performance
-numbers (BASELINE.md: `published: {}`), so `vs_baseline` is normalized
-against a hardware roofline instead: vs_baseline = MFU / 0.40, i.e. 1.0
-means 40% model-FLOPs utilization of the chip's peak bf16 throughput —
-a strong single-chip training bar. >1.0 beats it.
-
-Presets are sized to the device: on a single v5e chip (16 GB HBM) a
-~460M-param Llama with fp32 master params + Adam fits with remat; on CPU
-the tiny config keeps CI fast.
+numbers (BASELINE.md: `published: {}`), so `vs_baseline` normalizes
+against hardware rooflines instead:
+  - training: MFU / 0.40 (1.0 = 40% of peak bf16 FLOPs — a strong
+    single-chip training bar; >1.0 beats it),
+  - decode: MBU / 0.40 (model-bandwidth utilization vs peak HBM GB/s;
+    decode is bandwidth-bound, so MBU is the roofline that matters),
+  - first-compile: 120s budget / measured (>1.0 = faster than a 2-minute
+    pod-to-first-step budget).
 """
 
 from __future__ import annotations
@@ -28,7 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-# Peak bf16 FLOPs/sec per chip by TPU generation (public numbers).
+# Peak bf16 FLOPs/sec and HBM GB/s per chip by TPU generation (public).
 PEAK_FLOPS = {
     "v5e": 197e12,
     "v5p": 459e12,
@@ -36,6 +53,14 @@ PEAK_FLOPS = {
     "v6e": 918e12,
     "cpu": 1e11,  # nominal; CPU runs are smoke tests, not benchmarks
 }
+PEAK_HBM_GBS = {
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v4": 1228e9,
+    "v6e": 1640e9,
+    "cpu": 50e9,
+}
+FIRST_COMPILE_BUDGET_S = 120.0
 
 
 def detect_generation() -> str:
@@ -57,7 +82,7 @@ class Preset:
     seq: int
     steps: int
     warmup: int
-    model: str  # key into llama-style config factory below
+    model: str  # key into bench_configs()
 
 
 def bench_configs():
@@ -69,17 +94,37 @@ def bench_configs():
         vocab_size=32768, hidden_size=1536, intermediate_size=6144,
         num_layers=14, num_heads=12, num_kv_heads=4, head_dim=128,
     )
+    # ~1.08B params: Llama-3-1B block shapes (hidden 2048, 16 layers,
+    # GQA 16q/8kv) with bf16 master params. 32k bench vocab + tied head
+    # keep params (2.2 GB) + bf16 Adam moments (4.3 GB) + fp32 logits
+    # inside one 16 GB v5e chip; the block compute — where the 8B
+    # north star's FLOPs live — is unchanged from llama.LLAMA3_1B.
+    bench_1b = llama.LlamaConfig(
+        vocab_size=32768, hidden_size=2048, intermediate_size=8192,
+        num_layers=16, num_heads=16, num_kv_heads=8, head_dim=128,
+        param_dtype=jnp.bfloat16, tie_embeddings=True,
+    )
+    # bf16 serving weights for the decode bench (decode reads every
+    # param every step — fp32 storage would halve effective MBU).
+    bench_500m_serve = dataclasses.replace(
+        bench_500m, param_dtype=jnp.bfloat16)
     return {
         "tiny": llama.LLAMA_TINY,
         "bench-500m": bench_500m,
+        "bench-500m-serve": bench_500m_serve,
+        "bench-1b-bf16": bench_1b,
         "llama3-1b": llama.LLAMA3_1B,
         "llama3-8b": llama.LLAMA3_8B,
     }
 
 
-PRESETS = {
+TRAIN_PRESETS = {
     "tpu-v5e-1": Preset("tpu-v5e-1", batch=8, seq=2048, steps=10, warmup=2,
                         model="bench-500m"),
+    "tpu-1b-bf16": Preset("tpu-1b-bf16", batch=2, seq=2048, steps=10,
+                          warmup=2, model="bench-1b-bf16"),
+    "tpu-flash-4k": Preset("tpu-flash-4k", batch=2, seq=4096, steps=10,
+                           warmup=2, model="bench-500m"),
     "tiny-cpu": Preset("tiny-cpu", batch=4, seq=128, steps=5, warmup=1,
                        model="tiny"),
 }
@@ -90,25 +135,38 @@ def model_flops_per_token(cfg, seq: int) -> float:
     from kubeflow_tpu.models import llama
 
     n = llama.num_params(cfg)
-    n_matmul = n - cfg.vocab_size * cfg.hidden_size  # embed lookup is free
+    # The embedding lookup is free; a tied table is also the head matmul,
+    # so only the untied case subtracts it from the matmul param count.
+    n_matmul = n if cfg.tie_embeddings else n - cfg.vocab_size * cfg.hidden_size
     attn = 12 * cfg.num_layers * cfg.num_heads * cfg.head_dim * seq
     return 6 * n_matmul + attn
 
 
-def main() -> int:
-    p = argparse.ArgumentParser()
-    p.add_argument("--preset", default="auto")
-    p.add_argument("--json-only", action="store_true")
-    args = p.parse_args()
-
-    preset_name = args.preset
-    if preset_name == "auto":
-        preset_name = "tpu-v5e-1" if jax.default_backend() == "tpu" else "tiny-cpu"
-    preset = PRESETS[preset_name]
-
+def param_bytes(cfg) -> int:
     from kubeflow_tpu.models import llama
+
+    itemsize = jnp.dtype(cfg.param_dtype).itemsize
+    return llama.num_params(cfg) * itemsize
+
+
+_first_compile_s: float | None = None
+
+
+def _record_first_compile(elapsed_since_pod_start: float) -> None:
+    global _first_compile_s
+    if _first_compile_s is None:
+        _first_compile_s = elapsed_since_pod_start
+
+
+def bench_train(preset: Preset, *, assert_flash: bool = False,
+                verbose: bool = True) -> dict:
+    """One training bench -> metric dict. Also records pod-to-first-compile
+    the first time any train bench finishes its first step."""
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.ops import attention
     from kubeflow_tpu.parallel import MeshSpec, create_mesh
     from kubeflow_tpu.train import Trainer, TrainConfig
+    from kubeflow_tpu.utils import profiling
 
     cfg = bench_configs()[preset.model]
     n_devices = len(jax.devices())
@@ -131,19 +189,30 @@ def main() -> int:
     )
     targets = jnp.roll(tokens, -1, axis=1)
 
-    for _ in range(preset.warmup):
+    attention.reset_impl_counts()
+    for i in range(preset.warmup):
         state, loss = trainer.step(state, tokens, targets)
-    # Sync via device-to-host transfer: on some PJRT plugins (the axon
-    # tunnel) block_until_ready returns before the enqueued chain has
-    # executed, which once inflated this bench ~2000x. float() cannot
-    # lie — the value physically leaves the device.
+        if i == 0:
+            # Sync via device-to-host transfer: on some PJRT plugins (the
+            # axon tunnel) block_until_ready returns before the enqueued
+            # chain has executed, which once inflated this bench ~2000x.
+            # float() cannot lie — the value physically leaves the device.
+            float(loss)
+            _record_first_compile(time.time() - profiling.pod_start_time())
     float(loss)
+    counts = attention.impl_counts()
+    if assert_flash and counts["flash"] == 0:
+        raise AssertionError(
+            f"preset {preset.name} (seq={preset.seq}) did not route through "
+            f"the Pallas flash kernel: impl counts {counts}"
+        )
 
     t0 = time.perf_counter()
     for _ in range(preset.steps):
         state, loss = trainer.step(state, tokens, targets)
     float(loss)
     dt = time.perf_counter() - t0
+    del state, trainer  # free HBM before the next bench
 
     total_tokens = batch * preset.seq * preset.steps
     tok_per_sec_per_chip = total_tokens / dt / n_devices
@@ -151,21 +220,157 @@ def main() -> int:
     gen = detect_generation()
     flops_per_tok = model_flops_per_token(cfg, preset.seq)
     mfu = tok_per_sec_per_chip * flops_per_tok / PEAK_FLOPS[gen]
-    vs_baseline = mfu / 0.40
 
-    result = {
-        "metric": f"llama_train_tokens_per_sec_per_chip[{preset.model},{gen}]",
-        "value": round(tok_per_sec_per_chip, 2),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(vs_baseline, 4),
-    }
-    print(json.dumps(result))
-    if not args.json_only:
+    if verbose:
         print(
-            f"# preset={preset.name} devices={n_devices} loss={float(loss):.3f} "
-            f"mfu={mfu:.3f} step_time={dt/preset.steps*1000:.1f}ms",
+            f"# preset={preset.name} devices={n_devices} "
+            f"loss={float(loss):.3f} mfu={mfu:.3f} "
+            f"step_time={dt/preset.steps*1000:.1f}ms attn_impl={counts}",
             file=sys.stderr,
         )
+    tag = "flash4k" if assert_flash else preset.model
+    return {
+        "metric": f"llama_train_tokens_per_sec_per_chip[{tag},{gen}]",
+        "value": round(tok_per_sec_per_chip, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }
+
+
+def bench_decode(model: str, *, batch: int, prompt_len: int,
+                 max_new: int, max_len: int, verbose: bool = True) -> dict:
+    """Serving decode throughput on the KV-cache scan engine."""
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.serving import engine as engine_lib
+
+    cfg = bench_configs()[model]
+    # jit the init: eager per-op dispatch is pathological over remote
+    # PJRT transports (each op is a round-trip).
+    params = jax.jit(lambda k: llama.init(k, cfg))(jax.random.key(0))
+    jax.block_until_ready(params)
+    eng = engine_lib.InferenceEngine(
+        params, cfg, engine_lib.LLAMA_FAMILY,
+        engine_lib.EngineConfig(max_len=max_len),
+    )
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (batch, prompt_len)), jnp.int32)
+
+    # Isolate decode from prefill: time generate at max_new=1 (prefill +
+    # one sampled token, zero scan steps) and at max_new; the difference
+    # is pure decode-scan time for max_new - 1 tokens. Timing one full
+    # generate would attribute the prompt's prefill FLOPs to "decode"
+    # and understate tokens/s as prompts grow.
+    for mn in (1, max_new):  # compile + warmup both entry points
+        np.asarray(eng.generate(prompt, max_new=mn))
+    t0 = time.perf_counter()
+    out = eng.generate(prompt, max_new=1)
+    np.asarray(out)  # device-to-host sync (see bench_train note)
+    t_prefill = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = eng.generate(prompt, max_new=max_new)
+    np.asarray(out)
+    t_full = time.perf_counter() - t0
+    dt = max(t_full - t_prefill, 1e-9)
+    decoded = max_new - 1
+
+    n_devices = len(jax.devices())
+    tok_per_sec = batch * decoded / dt / n_devices
+
+    # Bandwidth roofline: each decode step reads every param once plus the
+    # valid KV cache slots (2 caches, avg fill over the run).
+    gen = detect_generation()
+    avg_len = prompt_len + max_new / 2
+    kv_bytes = (2 * cfg.num_layers * batch * avg_len * cfg.num_kv_heads
+                * cfg.head_dim * jnp.dtype(cfg.dtype).itemsize)
+    step_bytes = param_bytes(cfg) + kv_bytes
+    # Per-step time bounds MBU; batch tokens amortize one weight read.
+    step_time = dt / decoded
+    mbu = step_bytes / step_time / PEAK_HBM_GBS[gen]
+
+    if verbose:
+        print(
+            f"# decode model={model} batch={batch} prompt={prompt_len} "
+            f"max_new={max_new} tok/s={tok_per_sec:.1f} mbu={mbu:.3f}",
+            file=sys.stderr,
+        )
+    return {
+        "metric": f"serving_decode_tokens_per_sec_per_chip[{model},{gen}]",
+        "value": round(tok_per_sec, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mbu / 0.40, 4),
+    }
+
+
+def first_compile_metric() -> dict:
+    assert _first_compile_s is not None, "run a train bench first"
+    return {
+        "metric": "pod_to_first_xla_compile_seconds",
+        "value": round(_first_compile_s, 2),
+        "unit": "s",
+        "vs_baseline": round(FIRST_COMPILE_BUDGET_S / _first_compile_s, 4),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--only", default="",
+                   help="comma-separated subset: train500m,train1b,"
+                        "flash4k,decode (default: full sweep for the "
+                        "backend)")
+    p.add_argument("--json-only", action="store_true")
+    args = p.parse_args()
+
+    on_tpu = jax.default_backend() == "tpu"
+    all_names = ("train500m", "train1b", "flash4k", "decode")
+    sweep = (list(all_names) if on_tpu else ["train500m", "decode"])
+    if args.only:
+        wanted = [s.strip() for s in args.only.split(",") if s.strip()]
+        unknown = [s for s in wanted if s not in all_names]
+        if unknown:
+            p.error(f"unknown --only entries {unknown}; known: "
+                    f"{list(all_names)}")
+        unavailable = [s for s in wanted if s not in sweep]
+        if unavailable:
+            p.error(f"--only entries {unavailable} need a TPU backend "
+                    f"(current: {jax.default_backend()})")
+        sweep = [s for s in sweep if s in wanted]
+
+    verbose = not args.json_only
+    headline = None
+    extras: list[dict] = []
+
+    def emit(m: dict) -> None:
+        nonlocal headline
+        if headline is None:
+            headline = m
+        else:
+            extras.append(m)
+
+    # Headline first: its first step is the process's first compile, so
+    # pod-to-first-compile measures the real cold path.
+    if "train500m" in sweep:
+        preset = TRAIN_PRESETS["tpu-v5e-1" if on_tpu else "tiny-cpu"]
+        emit(bench_train(preset, verbose=verbose))
+        extras.append(first_compile_metric())
+    if "train1b" in sweep:
+        emit(bench_train(TRAIN_PRESETS["tpu-1b-bf16"], verbose=verbose))
+    if "flash4k" in sweep:
+        emit(bench_train(TRAIN_PRESETS["tpu-flash-4k"], assert_flash=True,
+                         verbose=verbose))
+    if "decode" in sweep:
+        if on_tpu:
+            emit(bench_decode("bench-500m-serve", batch=16, prompt_len=128,
+                              max_new=256, max_len=512, verbose=verbose))
+        else:
+            emit(bench_decode("tiny", batch=2, prompt_len=8, max_new=8,
+                              max_len=32, verbose=verbose))
+
+    assert headline is not None, "empty sweep"
+    result = dict(headline)
+    if extras:
+        result["extra_metrics"] = extras
+    print(json.dumps(result))
     return 0
 
 

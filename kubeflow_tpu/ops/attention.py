@@ -18,6 +18,20 @@ import jax.numpy as jnp
 
 NEG_INF = -2.0**30  # large-but-finite: avoids NaNs from (-inf) - (-inf)
 
+# Trace-time dispatch counters. `dot_product_attention` runs in Python at
+# trace time, so these count how many traced call sites took each impl —
+# which is how bench.py *proves* the long-seq preset routed through the
+# Pallas flash kernel instead of silently falling back to XLA.
+_impl_counts = {"flash": 0, "xla": 0}
+
+
+def reset_impl_counts() -> None:
+    _impl_counts["flash"] = _impl_counts["xla"] = 0
+
+
+def impl_counts() -> dict[str, int]:
+    return dict(_impl_counts)
+
 
 def _xla_attention(
     q: jnp.ndarray,            # [b, sq, n_q, hd]
@@ -93,6 +107,7 @@ def dot_product_attention(
                 and _flash_kernel_available())
             else "xla"
         )
+    _impl_counts[impl] = _impl_counts.get(impl, 0) + 1
     if impl == "flash":
         if kv_mask is not None or not contiguous_positions:
             raise ValueError(
