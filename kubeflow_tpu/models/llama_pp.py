@@ -18,13 +18,15 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubeflow_tpu.models import llama
 from kubeflow_tpu.models.llama import LlamaConfig, Params
 from kubeflow_tpu.ops.norms import rms_norm
 from kubeflow_tpu.ops.rotary import rope_frequencies
 from kubeflow_tpu.parallel import pipeline as pp
+from kubeflow_tpu.train import trainer as trainer_lib
 
 
 def split_stages(params: Params, cfg: LlamaConfig, n_stages: int) -> Params:
@@ -104,11 +106,118 @@ def loss_pipelined(params, cfg, tokens, targets, mesh, **kw) -> jnp.ndarray:
     return jnp.mean(nll)
 
 
+class PipelineTrainer:
+    """PP composed with the real training stack.
+
+    The same optimizer chain as `train.Trainer` (warmup-cosine AdamW +
+    global-norm clip, `trainer.make_optimizer`) stepping the pipelined
+    Llama forward on a (stage, data) mesh. Residency follows GPipe
+    semantics: block params — and their Adam moments, via the Trainer's
+    path-matched opt-state sharding — shard over `stage_axis` along the
+    layer dim (the contiguous stage-major split that `split_stages`
+    reshapes without data movement); the batch shards over `data_axis`,
+    which stays a GSPMD-auto axis inside the pipeline's shard_map so
+    XLA inserts the data-parallel gradient reductions.
+    """
+
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        mesh: Mesh,
+        *,
+        stage_axis: str = "stage",
+        data_axis: str = "data",
+        num_microbatches: int | None = None,
+        train_config: trainer_lib.TrainConfig = trainer_lib.TrainConfig(),
+    ):
+        S = mesh.shape[stage_axis]
+        if cfg.num_layers % S:
+            raise ValueError(
+                f"num_layers={cfg.num_layers} not divisible by "
+                f"{stage_axis}={S}"
+            )
+        self.cfg = cfg
+        self.mesh = mesh
+        self.stage_axis = stage_axis
+        self.data_axis = data_axis
+        self.num_microbatches = num_microbatches or 2 * S
+        self.tc = train_config
+        self.optimizer = trainer_lib.make_optimizer(train_config)
+
+        params_shapes = jax.eval_shape(
+            lambda k: llama.init(k, cfg),
+            jax.ShapeDtypeStruct((2,), jnp.uint32),
+        )
+
+        def pick(path, leaf):
+            keys = tuple(getattr(p, "key", "") for p in path)
+            spec = P(stage_axis) if "blocks" in keys else P()
+            return NamedSharding(mesh, spec)
+
+        self.param_shardings = jax.tree_util.tree_map_with_path(
+            pick, params_shapes
+        )
+        opt_shapes = jax.eval_shape(self.optimizer.init, params_shapes)
+        self.opt_shardings = trainer_lib._opt_state_shardings(
+            opt_shapes, params_shapes, self.param_shardings, mesh
+        )
+        self.state_shardings = trainer_lib.TrainState(
+            self.param_shardings, self.opt_shardings,
+            NamedSharding(mesh, P()),
+        )
+        self.batch_sharding = NamedSharding(mesh, P(data_axis))
+        self._jit_init = jax.jit(
+            self._init, out_shardings=self.state_shardings
+        )
+        self._jit_step = jax.jit(
+            self._step,
+            in_shardings=(self.state_shardings, self.batch_sharding,
+                          self.batch_sharding),
+            out_shardings=(self.state_shardings,
+                           NamedSharding(self.mesh, P())),
+            donate_argnums=(0,),
+        )
+
+    def _init(self, rng: jax.Array) -> trainer_lib.TrainState:
+        params = llama.init(rng, self.cfg)
+        return trainer_lib.TrainState(
+            params, self.optimizer.init(params), jnp.zeros((), jnp.int32)
+        )
+
+    def _step(self, state: trainer_lib.TrainState, tokens, targets):
+        def loss_fn(params):
+            logits = apply_pipelined(
+                params, self.cfg, tokens, self.mesh,
+                stage_axis=self.stage_axis,
+                num_microbatches=self.num_microbatches,
+            )
+            return trainer_lib.cross_entropy_loss(logits, targets)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = self.optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        return (
+            trainer_lib.TrainState(params, opt_state, state.step + 1),
+            loss,
+        )
+
+    def init(self, rng: jax.Array) -> trainer_lib.TrainState:
+        with jax.set_mesh(self.mesh):
+            return self._jit_init(rng)
+
+    def step(self, state: trainer_lib.TrainState, tokens, targets):
+        with jax.set_mesh(self.mesh):
+            return self._jit_step(state, tokens, targets)
+
+
 def make_train_step(cfg: LlamaConfig, mesh: Mesh, learning_rate: float = 1e-3,
                     **kw):
     """SGD-with-momentum train step over the pipelined loss — enough to
     prove PP trains (grads flow through scan + ppermute); production
-    training composes apply_pipelined into the Trainer's optimizer."""
+    training composes apply_pipelined into the Trainer's optimizer via
+    `PipelineTrainer`."""
 
     @jax.jit
     def step(params, momentum, tokens, targets):

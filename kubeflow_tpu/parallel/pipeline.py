@@ -129,11 +129,17 @@ def pipeline_sharded(
         return pipeline(stage_fn, params_local, x_rep, axis_name=stage_axis)
 
     param_specs = jax.tree.map(lambda _: P(stage_axis), stacked_params)
+    # Manual collectives only over the stage axis; any other mesh axes
+    # (data, fsdp, ...) stay automatic, so GSPMD keeps handling their
+    # sharding — and their gradient reductions — inside the stage loop.
+    # This is what lets PP compose with a (stage, data) mesh and the real
+    # Trainer optimizer without hand-written data-parallel psums.
     fn = jax.shard_map(
         local,
         mesh=mesh,
         in_specs=(param_specs, P()),
         out_specs=P(),
+        axis_names={stage_axis},
         check_vma=False,
     )
     y_mb = fn(stacked_params, x_mb)
