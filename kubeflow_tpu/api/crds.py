@@ -41,6 +41,10 @@ class TpuSpec:
     topology: str = ""          # e.g. "v5e-16" (kubeflow_tpu.parallel.mesh)
     # Parallelism layout hint injected as KFTPU_MESH for in-pod JAX.
     mesh: str = ""              # e.g. "data=1,fsdp=16,tensor=1"
+    # Multi-slice job: N whole slices of `topology` gang-scheduled
+    # together; the webhook injects MEGASCALE_* env so JAX builds the
+    # hybrid (dcn x ici) mesh and DP rides DCN across slices.
+    num_slices: int = 1
     reserved: bool = False      # use reserved capacity
 
 

@@ -116,7 +116,11 @@ class NotebookController(Controller):
         if not topo_name:
             return 1
         topo = SLICE_TOPOLOGIES[topo_name]
-        return topo.hosts
+        # Multi-slice jobs gang ALL slices' hosts into one StatefulSet:
+        # ordinals [0, hosts) are slice 0, [hosts, 2*hosts) slice 1, ...
+        # (the webhook derives per-slice worker ids + MEGASCALE env from
+        # the ordinal).
+        return topo.hosts * max(1, nb.spec.tpu.num_slices)
 
     def _desired_statefulset(self, nb: Notebook) -> StatefulSet:
         name, ns = nb.metadata.name, nb.metadata.namespace
@@ -141,6 +145,10 @@ class NotebookController(Controller):
         topo_name = nb.spec.tpu.topology
         if topo_name:
             tmpl.metadata.labels[wh.TOPOLOGY_LABEL] = topo_name
+            if nb.spec.tpu.num_slices > 1:
+                tmpl.metadata.labels[wh.NUM_SLICES_LABEL] = str(
+                    nb.spec.tpu.num_slices
+                )
             if nb.spec.tpu.mesh:
                 tmpl.metadata.labels[wh.MESH_LABEL] = (
                     nb.spec.tpu.mesh.replace(",", "_")

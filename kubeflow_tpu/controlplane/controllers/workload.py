@@ -43,35 +43,47 @@ class NodePool:
     slices: dict[str, int] = field(default_factory=dict)
     cpu_unlimited: bool = True
 
-    def total_hosts(self, topo_name: str) -> int:
-        topo = SLICE_TOPOLOGIES.get(topo_name)
-        if topo is None:
-            return 0
-        return self.slices.get(topo_name, 0) * topo.hosts
-
 
 class Scheduler:
-    """Tracks slice-host allocations by gang. Thread-safe."""
+    """Tracks slice allocations by gang. Thread-safe.
+
+    Reservations are counted in WHOLE SLICES, not hosts: a slice is an
+    ICI domain — a gang that spans part of one is meaningless, and a
+    multi-slice job (Notebook.spec.tpu.num_slices > 1) must get all its
+    slices or none (same all-or-nothing rule as within a slice, one
+    level up). `hosts` is what the StatefulSet wants; the slice count is
+    derived from the topology's hosts-per-slice.
+    """
 
     def __init__(self, pool: NodePool):
         self.pool = pool
         self._lock = threading.Lock()
-        # gang key -> (topology, hosts reserved)
-        self._reservations: dict[tuple[str, str], tuple[str, int]] = {}
+        # gang key -> (topology, hosts, whole slices reserved)
+        self._reservations: dict[tuple[str, str], tuple[str, int, int]] = {}
 
     def try_reserve_gang(
         self, namespace: str, gang: str, topo_name: str, hosts: int
     ) -> bool:
+        topo = SLICE_TOPOLOGIES.get(topo_name)
+        if topo is None:
+            return False
+        need_slices = -(-hosts // topo.hosts)  # ceil: whole slices only
         with self._lock:
             key = (namespace, gang)
-            if key in self._reservations:
+            prev = self._reservations.get(key)
+            if prev is not None and prev == (topo_name, hosts, need_slices):
                 return True
+            # New reservation OR a resize (e.g. the Notebook's num_slices
+            # was edited): re-admit against the pool with this gang's old
+            # reservation excluded — a grown gang that no longer fits
+            # must fail scheduling, not silently run under-reserved.
             used = sum(
-                h for (t, h) in self._reservations.values() if t == topo_name
+                s for k, (t, _, s) in self._reservations.items()
+                if t == topo_name and k != key
             )
-            if used + hosts > self.pool.total_hosts(topo_name):
+            if used + need_slices > self.pool.slices.get(topo_name, 0):
                 return False
-            self._reservations[key] = (topo_name, hosts)
+            self._reservations[key] = (topo_name, hosts, need_slices)
             return True
 
     def release_gang(self, namespace: str, gang: str) -> None:
@@ -81,6 +93,11 @@ class Scheduler:
     def reserved(self, namespace: str, gang: str) -> bool:
         with self._lock:
             return (namespace, gang) in self._reservations
+
+    def reserved_slices(self, namespace: str, gang: str) -> int:
+        with self._lock:
+            res = self._reservations.get((namespace, gang))
+            return res[2] if res else 0
 
 
 class StatefulSetController(Controller):
@@ -112,10 +129,13 @@ class StatefulSetController(Controller):
                         "StatefulSet", namespace, name)
                 }
                 if "FailedScheduling" not in existing:
+                    topo = SLICE_TOPOLOGIES.get(topo_name)
+                    n_slices = -(-want // topo.hosts) if topo else 1
                     store.emit_event(
                         sts, "Warning", "FailedScheduling",
                         f"insufficient TPU capacity for {topo_name} "
-                        f"({want} hosts required, gang is all-or-nothing)",
+                        f"({n_slices} whole slice(s) = {want} hosts "
+                        "required, gang is all-or-nothing)",
                     )
                 return Result(requeue_after=0.5)
         if want == 0 and topo_name:

@@ -39,8 +39,10 @@ GANG_ORDINAL_LABEL = "kubeflow-tpu.dev/gang-ordinal"
 GANG_SIZE_LABEL = "kubeflow-tpu.dev/gang-size"
 TOPOLOGY_LABEL = "kubeflow-tpu.dev/tpu-topology"
 MESH_LABEL = "kubeflow-tpu.dev/mesh"
+NUM_SLICES_LABEL = "kubeflow-tpu.dev/num-slices"
 
 JAX_COORDINATOR_PORT = 8476
+MEGASCALE_COORDINATOR_PORT = 8080
 POD_START_TIME_ENV = "KFTPU_POD_START_TIME"
 
 
@@ -170,24 +172,70 @@ class PodDefaultWebhook:
         topo = SLICE_TOPOLOGIES.get(topo_name)
         if topo is None:
             raise AdmissionDenied(f"unknown TPU topology {topo_name!r}")
-        size = int(labels.get(GANG_SIZE_LABEL, topo.hosts))
+        num_slices = int(labels.get(NUM_SLICES_LABEL, "1"))
+        size = int(labels.get(GANG_SIZE_LABEL, topo.hosts * num_slices))
         ordinal = int(labels.get(GANG_ORDINAL_LABEL, "0"))
+        if num_slices < 1 or size % num_slices:
+            # Same admission depth as the unknown-topology check: broken
+            # gang labels must fail the pod, not emit env that splits
+            # slices at the wrong boundaries.
+            raise AdmissionDenied(
+                f"gang size {size} not divisible into {num_slices} "
+                f"slice(s) (labels {GANG_SIZE_LABEL}/{NUM_SLICES_LABEL} "
+                "disagree)"
+            )
+        if num_slices > 1 and size != topo.hosts * num_slices:
+            # Multi-slice env is derived from ordinal arithmetic: a size
+            # that isn't hosts-per-slice x num_slices would emit
+            # TPU_WORKER_HOSTNAMES lists that split real slices and
+            # libtpu would wait forever for workers that never register.
+            raise AdmissionDenied(
+                f"gang size {size} != {topo.hosts} hosts/slice x "
+                f"{num_slices} slices for topology {topo.name}"
+            )
         ns = pod.metadata.namespace
-        # Stable per-host DNS via the gang's headless service:
-        # <gang>-<ordinal>.<gang>.<ns>.svc (StatefulSet hostname contract).
+
+        def dns(i: int) -> str:
+            # Stable per-host DNS via the gang's headless service:
+            # <gang>-<i>.<gang>.<ns>.svc (StatefulSet hostname contract).
+            return f"{gang}-{i}.{gang}.{ns}.svc"
+
+        # libtpu's worker env is PER SLICE: each slice is its own ICI
+        # domain, so TPU_WORKER_ID/HOSTNAMES enumerate only slice-mates.
+        # The JAX process group (and its coordinator) stays GLOBAL across
+        # all slices — that is what lets jax.distributed + the hybrid
+        # dcn mesh treat the job as one SPMD program with DCN between
+        # slices (SURVEY.md §2b "DCN for cross-slice via JAX multi-slice
+        # env"; env-merge mechanism per ref admission-webhook
+        # main.go:153-188).
+        hosts_per_slice = max(1, size // max(1, num_slices))
+        slice_id = ordinal // hosts_per_slice
+        slice_base = slice_id * hosts_per_slice
         hostnames = ",".join(
-            f"{gang}-{i}.{gang}.{ns}.svc" for i in range(size)
+            dns(slice_base + i) for i in range(hosts_per_slice)
         )
-        coordinator = f"{gang}-0.{gang}.{ns}.svc:{JAX_COORDINATOR_PORT}"
+        coordinator = f"{dns(0)}:{JAX_COORDINATOR_PORT}"
         tpu_env = {
-            "TPU_WORKER_ID": str(ordinal),
+            "TPU_WORKER_ID": str(ordinal - slice_base),
             "TPU_WORKER_HOSTNAMES": hostnames,
             "TPU_CHIPS_PER_HOST_BOUNDS": _chips_per_host_bounds(topo),
             "TPU_ACCELERATOR_TYPE": topo.name,
             "JAX_COORDINATOR_ADDRESS": coordinator,
             "KFTPU_TOPOLOGY": topo.name,
             "KFTPU_NUM_PROCESSES": str(size),
+            # The GLOBAL process id for jax.distributed.initialize —
+            # distinct from TPU_WORKER_ID, which is per-slice for libtpu
+            # and therefore repeats across slices in a multi-slice gang.
+            "KFTPU_PROCESS_ID": str(ordinal),
         }
+        if num_slices > 1:
+            tpu_env.update({
+                "MEGASCALE_NUM_SLICES": str(num_slices),
+                "MEGASCALE_SLICE_ID": str(slice_id),
+                "MEGASCALE_COORDINATOR_ADDRESS":
+                    f"{dns(0)}:{MEGASCALE_COORDINATOR_PORT}",
+                "KFTPU_NUM_SLICES": str(num_slices),
+            })
         mesh = labels.get(MESH_LABEL, "")
         if mesh:
             tpu_env["KFTPU_MESH"] = mesh.replace("_", ",")

@@ -31,7 +31,13 @@ log = logging.getLogger(__name__)
 
 COORDINATOR_ENV = "JAX_COORDINATOR_ADDRESS"
 NUM_PROCESSES_ENV = "KFTPU_NUM_PROCESSES"
-PROCESS_ID_ENV = "TPU_WORKER_ID"
+# Global process id. TPU_WORKER_ID is the fallback for single-slice
+# gangs only: libtpu worker ids are PER SLICE, so in a multi-slice gang
+# they repeat across slices and cannot serve as the jax.distributed
+# process_id — the webhook injects KFTPU_PROCESS_ID (the global gang
+# ordinal) for exactly that reason.
+PROCESS_ID_ENV = "KFTPU_PROCESS_ID"
+WORKER_ID_FALLBACK_ENV = "TPU_WORKER_ID"
 
 _initialized = False
 
@@ -59,7 +65,8 @@ def initialize_from_env(timeout_secs: int | None = None) -> bool:
         return True
     coordinator = os.environ.get(COORDINATOR_ENV, "")
     raw_num = os.environ.get(NUM_PROCESSES_ENV, "")
-    raw_id = os.environ.get(PROCESS_ID_ENV, "")
+    raw_id = (os.environ.get(PROCESS_ID_ENV, "")
+              or os.environ.get(WORKER_ID_FALLBACK_ENV, ""))
     if not coordinator and not raw_num:
         return False
     if not coordinator or not raw_num:
@@ -67,6 +74,16 @@ def initialize_from_env(timeout_secs: int | None = None) -> bool:
             f"half-injected gang env: {COORDINATOR_ENV}={coordinator!r} "
             f"{NUM_PROCESSES_ENV}={raw_num!r} — the TPU webhook injects "
             "both or neither"
+        )
+    multi_slice = any(
+        os.environ.get(v) not in (None, "", "1")
+        for v in ("KFTPU_NUM_SLICES", "MEGASCALE_NUM_SLICES")
+    )
+    if multi_slice and not os.environ.get(PROCESS_ID_ENV):
+        raise ValueError(
+            f"multi-slice gang without {PROCESS_ID_ENV}: the per-slice "
+            f"{WORKER_ID_FALLBACK_ENV} repeats across slices and cannot "
+            "be the global process id"
         )
     try:
         num_processes = int(raw_num)

@@ -9,8 +9,10 @@ from kubeflow_tpu.parallel.mesh import (
     MeshSpec,
     SliceTopology,
     SLICE_TOPOLOGIES,
+    create_hybrid_mesh,
     create_mesh,
     mesh_from_env,
+    num_slices_from_env,
 )
 from kubeflow_tpu.parallel.sharding import (
     ShardingRules,

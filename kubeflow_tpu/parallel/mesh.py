@@ -39,8 +39,13 @@ TENSOR_AXIS = "tensor"
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
 STAGE_AXIS = "stage"
+DCN_AXIS = "dcn"
 
 MESH_AXES = (DATA_AXIS, FSDP_AXIS, TENSOR_AXIS)
+HYBRID_MESH_AXES = (DCN_AXIS,) + MESH_AXES
+
+NUM_SLICES_ENV = "KFTPU_NUM_SLICES"
+MEGASCALE_NUM_SLICES_ENV = "MEGASCALE_NUM_SLICES"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -172,11 +177,95 @@ def create_mesh(
     return Mesh(dev_array, MESH_AXES)
 
 
+def create_hybrid_mesh(
+    spec: MeshSpec | None = None,
+    *,
+    num_slices: int,
+    devices: Sequence[jax.Device] | None = None,
+    topology: str | SliceTopology | None = None,
+) -> Mesh:
+    """Hybrid multi-slice mesh: ("dcn", "data", "fsdp", "tensor").
+
+    The outer `dcn` axis spans TPU slices; collectives over it ride the
+    data-center network, everything inner rides ICI. The scaling-book
+    recipe for >1-slice jobs: keep bandwidth-hungry sharding (fsdp/
+    tensor) inside a slice, put pure data parallelism — one gradient
+    all-reduce per step — across slices. Params carry no `dcn` rule
+    (parallel.sharding.LLAMA_RULES), so they replicate per-slice and
+    only grads cross DCN.
+
+    Slice membership comes from `device.slice_index` when the runtime
+    exposes it (real multi-slice jobs); simulated/virtual device sets
+    fall back to contiguous equal chunks, which matches how
+    `xla_force_host_platform_device_count` lays out virtual devices.
+    `spec` describes the layout WITHIN one slice.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_slices < 1:
+        raise ValueError(f"num_slices must be >= 1, got {num_slices}")
+    if len(devices) % num_slices:
+        raise ValueError(
+            f"{len(devices)} devices not divisible into {num_slices} slices"
+        )
+    per_slice = len(devices) // num_slices
+
+    by_slice: dict[int, list[jax.Device]] = {}
+    if all(getattr(d, "slice_index", None) is not None for d in devices):
+        for d in devices:
+            by_slice.setdefault(d.slice_index, []).append(d)
+        if len(by_slice) != num_slices or any(
+            len(g) != per_slice for g in by_slice.values()
+        ):
+            raise ValueError(
+                f"device slice_index grouping {sorted((k, len(v)) for k, v in by_slice.items())} "
+                f"does not match num_slices={num_slices} x {per_slice}"
+            )
+        groups = [by_slice[k] for k in sorted(by_slice)]
+    else:
+        groups = [
+            list(devices[i * per_slice:(i + 1) * per_slice])
+            for i in range(num_slices)
+        ]
+
+    spec = spec or MeshSpec()
+    if isinstance(topology, str):
+        topology = SLICE_TOPOLOGIES[topology]
+    if topology is not None and per_slice != topology.chips:
+        logging.getLogger(__name__).warning(
+            "simulating %d-slice %s (%d chips each) with %d devices/slice",
+            num_slices, topology.name, topology.chips, per_slice,
+        )
+    sizes = spec.resolve(per_slice)
+    dev_array = np.stack([
+        np.asarray(g).reshape(
+            sizes[DATA_AXIS], sizes[FSDP_AXIS], sizes[TENSOR_AXIS]
+        )
+        for g in groups
+    ])
+    return Mesh(dev_array, HYBRID_MESH_AXES)
+
+
+def num_slices_from_env() -> int:
+    """Slice count injected by the webhook (KFTPU_NUM_SLICES, mirroring
+    MEGASCALE_NUM_SLICES); 1 when absent."""
+    for var in (NUM_SLICES_ENV, MEGASCALE_NUM_SLICES_ENV):
+        raw = os.environ.get(var, "")
+        if raw:
+            try:
+                return max(1, int(raw))
+            except ValueError:
+                raise ValueError(f"malformed {var}={raw!r}") from None
+    return 1
+
+
 def mesh_from_env(devices: Sequence[jax.Device] | None = None) -> Mesh:
     """Build a mesh from control-plane-injected env.
 
     The webhook injects KFTPU_MESH="data=1,fsdp=16,tensor=1" (and the
     topology via KFTPU_TOPOLOGY). Falls back to pure-FSDP over all devices.
+    Multi-slice gangs (KFTPU_NUM_SLICES > 1) get the hybrid mesh with the
+    extra outer "dcn" axis; KFTPU_MESH then describes one slice's layout.
     """
     raw = os.environ.get("KFTPU_MESH", "")
     kwargs: dict[str, int] = {}
@@ -202,4 +291,9 @@ def mesh_from_env(devices: Sequence[jax.Device] | None = None) -> Mesh:
             "validation", topo, sorted(SLICE_TOPOLOGIES),
         )
         topo = None
+    n_slices = num_slices_from_env()
+    if n_slices > 1:
+        return create_hybrid_mesh(
+            spec, num_slices=n_slices, devices=devices, topology=topo
+        )
     return create_mesh(spec, devices=devices, topology=topo)

@@ -56,7 +56,10 @@ LLAMA_RULES = ShardingRules(
         "experts": mesh_lib.TENSOR_AXIS,
         "stage": None,
         # --- activations ---
-        "batch": (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS),
+        # dcn leads: on hybrid multi-slice meshes the batch's outermost
+        # split is across slices (pure DP over DCN); single-slice meshes
+        # have no dcn axis and _filter_spec_to_mesh drops it.
+        "batch": (mesh_lib.DCN_AXIS, mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS),
         "seq": None,
         "act_embed": None,
         "act_heads": mesh_lib.TENSOR_AXIS,

@@ -130,7 +130,13 @@ class Trainer:
             params_shapes, opt_shapes,
             jax.ShapeDtypeStruct((), jnp.int32),
         )
-        self.batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), None))
+        # Batch splits over every data-parallel axis the mesh actually
+        # has: the hybrid multi-slice mesh adds an outer "dcn" axis
+        # (cross-slice pure DP — one grad all-reduce over DCN per step).
+        batch_axes = tuple(
+            a for a in ("dcn", "data", "fsdp") if a in mesh.axis_names
+        )
+        self.batch_sharding = NamedSharding(mesh, P(batch_axes, None))
 
         self._jit_init = jax.jit(self._init, out_shardings=self.state_shardings)
         self._jit_step = jax.jit(

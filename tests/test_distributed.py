@@ -109,3 +109,16 @@ def test_half_injected_env_fails_loudly(monkeypatch):
     monkeypatch.setenv("KFTPU_NUM_PROCESSES", "two")
     with pytest.raises(ValueError, match="non-integer"):
         distributed.initialize_from_env()
+
+
+def test_multislice_requires_global_process_id(monkeypatch):
+    """TPU_WORKER_ID repeats across slices (it is per-slice for libtpu),
+    so a multi-slice gang missing KFTPU_PROCESS_ID must fail loudly
+    instead of registering duplicate process ids at the coordinator."""
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:1")
+    monkeypatch.setenv("KFTPU_NUM_PROCESSES", "8")
+    monkeypatch.setenv("KFTPU_NUM_SLICES", "2")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.delenv("KFTPU_PROCESS_ID", raising=False)
+    with pytest.raises(ValueError, match="KFTPU_PROCESS_ID"):
+        distributed.initialize_from_env()

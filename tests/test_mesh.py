@@ -1,11 +1,13 @@
 """Mesh/topology tests (control-plane ↔ compute shared source of truth)."""
 
 import jax
+import numpy as np
 import pytest
 
 from kubeflow_tpu.parallel import (
     MeshSpec,
     SLICE_TOPOLOGIES,
+    create_hybrid_mesh,
     create_mesh,
     mesh_from_env,
 )
@@ -45,3 +47,41 @@ def test_mesh_from_env(monkeypatch):
     assert mesh.shape == {"data": 1, "fsdp": 4, "tensor": 2}
     monkeypatch.delenv("KFTPU_MESH")
     assert mesh_from_env().shape == {"data": 1, "fsdp": 8, "tensor": 1}
+
+
+def test_hybrid_mesh_axes_and_slice_grouping():
+    """dcn is the OUTER axis; each slice's devices stay a contiguous
+    inner block (virtual devices have no slice_index → contiguous
+    chunks, matching xla_force_host_platform layout)."""
+    mesh = create_hybrid_mesh(
+        MeshSpec(data=1, fsdp=2, tensor=2), num_slices=2)
+    assert mesh.axis_names == ("dcn", "data", "fsdp", "tensor")
+    assert mesh.shape == {"dcn": 2, "data": 1, "fsdp": 2, "tensor": 2}
+    devs = np.asarray(jax.devices())
+    np.testing.assert_array_equal(
+        mesh.devices.reshape(2, 4),
+        devs.reshape(2, 4),
+    )
+
+
+def test_hybrid_mesh_validation():
+    with pytest.raises(ValueError, match="not divisible"):
+        create_hybrid_mesh(MeshSpec(), num_slices=3)
+    with pytest.raises(ValueError, match="num_slices"):
+        create_hybrid_mesh(MeshSpec(), num_slices=0)
+
+
+def test_mesh_from_env_multislice(monkeypatch):
+    """KFTPU_NUM_SLICES>1 (webhook-injected for num_slices>1 notebooks)
+    switches mesh_from_env to the hybrid mesh; KFTPU_MESH then describes
+    one slice's layout."""
+    monkeypatch.setenv("KFTPU_NUM_SLICES", "2")
+    monkeypatch.setenv("KFTPU_MESH", "data=1,fsdp=4,tensor=1")
+    mesh = mesh_from_env()
+    assert mesh.shape == {"dcn": 2, "data": 1, "fsdp": 4, "tensor": 1}
+    # MEGASCALE env alone (no KFTPU mirror) also triggers it.
+    monkeypatch.delenv("KFTPU_NUM_SLICES")
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "4")
+    monkeypatch.setenv("KFTPU_MESH", "data=1,fsdp=2,tensor=1")
+    assert mesh_from_env().shape == {
+        "dcn": 4, "data": 1, "fsdp": 2, "tensor": 1}

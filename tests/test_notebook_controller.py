@@ -9,7 +9,7 @@ from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
 from kubeflow_tpu.controlplane import webhook as wh
 
 
-def mk_notebook(name="nb1", ns="user1", topology="", mesh=""):
+def mk_notebook(name="nb1", ns="user1", topology="", mesh="", num_slices=1):
     nb = Notebook()
     nb.metadata.name = name
     nb.metadata.namespace = ns
@@ -19,6 +19,7 @@ def mk_notebook(name="nb1", ns="user1", topology="", mesh=""):
     )
     nb.spec.tpu.topology = topology
     nb.spec.tpu.mesh = mesh
+    nb.spec.tpu.num_slices = num_slices
     return nb
 
 
@@ -102,6 +103,77 @@ def test_gang_all_or_nothing(cluster):
         import time
         time.sleep(0.1)
     assert len(deadline_pods) == 4
+
+
+def test_multislice_gang_env_and_scheduling():
+    """A 2-slice v5e-16 Notebook gangs 8 pods (4 hosts x 2 slices) with
+    per-slice libtpu env + global MEGASCALE/JAX wiring."""
+    with Cluster(ClusterConfig(tpu_slices={"v5e-16": 2})) as cluster:
+        cluster.store.create(
+            mk_notebook("ms", topology="v5e-16", num_slices=2))
+        assert cluster.wait_idle()
+        sts = cluster.store.get("StatefulSet", "user1", "ms")
+        assert sts.spec.replicas == 8
+        # Both slices reserved as one atomic unit.
+        assert cluster.scheduler.reserved_slices("user1", "ms") == 2
+        pods = cluster.store.list(
+            "Pod", "user1", label_selector={"notebook-name": "ms"})
+        assert len(pods) == 8
+        by_name = {p.metadata.name: p for p in pods}
+        for i in range(8):
+            env = {e.name: e.value
+                   for e in by_name[f"ms-{i}"].spec.containers[0].env}
+            assert env["MEGASCALE_NUM_SLICES"] == "2"
+            assert env["MEGASCALE_SLICE_ID"] == str(i // 4)
+            assert env["KFTPU_NUM_SLICES"] == "2"
+            assert env["TPU_WORKER_ID"] == str(i % 4)
+            base = (i // 4) * 4
+            assert env["TPU_WORKER_HOSTNAMES"] == ",".join(
+                f"ms-{j}.ms.user1.svc" for j in range(base, base + 4))
+            assert env["JAX_COORDINATOR_ADDRESS"] == (
+                "ms-0.ms.user1.svc:8476")
+            assert env["KFTPU_NUM_PROCESSES"] == "8"
+            # Global process id stays the gang ordinal even though the
+            # libtpu worker id is per-slice.
+            assert env["KFTPU_PROCESS_ID"] == str(i)
+
+
+def test_multislice_gang_atomic_reservation(cluster):
+    """2 slices requested, pool has 1: zero pods + FailedScheduling —
+    multi-slice gangs are all-or-nothing across slices, not just within
+    one."""
+    cluster.store.create(
+        mk_notebook("ms2", topology="v5e-16", num_slices=2))
+    assert cluster.wait_idle()
+    pods = cluster.store.list(
+        "Pod", "user1", label_selector={"notebook-name": "ms2"})
+    assert pods == []
+    events = cluster.store.events_for("StatefulSet", "user1", "ms2")
+    assert any(e.reason == "FailedScheduling" and "2 whole slice" in e.message
+               for e in events)
+
+
+def test_scheduler_resize_readmits():
+    """Editing a gang's size re-admits it against the pool: growing past
+    capacity fails (keeping the old reservation for the running pods);
+    growing within capacity updates the reservation atomically."""
+    from kubeflow_tpu.controlplane.controllers.workload import (
+        NodePool, Scheduler)
+
+    sched = Scheduler(NodePool({"v5e-16": 2}))
+    assert sched.try_reserve_gang("ns", "g", "v5e-16", 4)
+    assert sched.reserved_slices("ns", "g") == 1
+    # grow 1 -> 2 slices: fits (pool 2), reservation follows
+    assert sched.try_reserve_gang("ns", "g", "v5e-16", 8)
+    assert sched.reserved_slices("ns", "g") == 2
+    # another gang can't fit now
+    assert not sched.try_reserve_gang("ns", "h", "v5e-16", 4)
+    # grow 2 -> 3 slices: over capacity -> refused, old reservation kept
+    assert not sched.try_reserve_gang("ns", "g", "v5e-16", 12)
+    assert sched.reserved_slices("ns", "g") == 2
+    # shrink 2 -> 1 frees a slice for the other gang
+    assert sched.try_reserve_gang("ns", "g", "v5e-16", 4)
+    assert sched.try_reserve_gang("ns", "h", "v5e-16", 4)
 
 
 def test_stop_annotation_scales_to_zero(cluster):

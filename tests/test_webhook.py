@@ -153,6 +153,66 @@ def test_tpu_env_injection_standalone():
     assert env["TPU_ACCELERATOR_TYPE"] == "v5e-16"
 
 
+def test_tpu_env_multislice_injection():
+    """num-slices label > 1: libtpu worker env becomes per-slice (each
+    slice is its own ICI domain) while the JAX coordinator stays global,
+    and MEGASCALE_* wire the slices over DCN (SURVEY.md §2b)."""
+    s = mk_store()
+    # 2 slices of v5e-16 (4 hosts each) = gang of 8; ordinal 6 is
+    # slice 1, worker 2.
+    pod = mk_pod(labels={
+        wh.GANG_NAME_LABEL: "train",
+        wh.GANG_ORDINAL_LABEL: "6",
+        wh.GANG_SIZE_LABEL: "8",
+        wh.NUM_SLICES_LABEL: "2",
+        wh.TOPOLOGY_LABEL: "v5e-16",
+    })
+    created = s.create(pod)
+    env = {e.name: e.value for e in created.spec.containers[0].env}
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    assert env["MEGASCALE_SLICE_ID"] == "1"
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"] == (
+        "train-0.train.user1.svc:8080")
+    assert env["KFTPU_NUM_SLICES"] == "2"
+    # per-slice worker identity: ordinal 6 = slice 1's worker 2, and the
+    # hostnames list covers only slice-mates (ordinals 4..7)
+    assert env["TPU_WORKER_ID"] == "2"
+    assert env["TPU_WORKER_HOSTNAMES"] == ",".join(
+        f"train-{i}.train.user1.svc" for i in range(4, 8))
+    # the jax.distributed process group spans ALL slices: global
+    # process id = gang ordinal, NOT the per-slice worker id
+    assert env["JAX_COORDINATOR_ADDRESS"] == "train-0.train.user1.svc:8476"
+    assert env["KFTPU_NUM_PROCESSES"] == "8"
+    assert env["KFTPU_PROCESS_ID"] == "6"
+
+
+def test_tpu_env_slice_mismatch_denied():
+    s = mk_store()
+    pod = mk_pod(labels={
+        wh.GANG_NAME_LABEL: "train",
+        wh.GANG_ORDINAL_LABEL: "7",
+        wh.GANG_SIZE_LABEL: "8",
+        wh.NUM_SLICES_LABEL: "3",
+        wh.TOPOLOGY_LABEL: "v5e-16",
+    })
+    with pytest.raises(AdmissionDenied, match="not divisible"):
+        s.create(pod)
+
+
+def test_tpu_env_single_slice_has_no_megascale():
+    s = mk_store()
+    pod = mk_pod(labels={
+        wh.GANG_NAME_LABEL: "train",
+        wh.GANG_ORDINAL_LABEL: "0",
+        wh.GANG_SIZE_LABEL: "4",
+        wh.TOPOLOGY_LABEL: "v5e-16",
+    })
+    created = s.create(pod)
+    env = {e.name: e.value for e in created.spec.containers[0].env}
+    assert "MEGASCALE_NUM_SLICES" not in env
+    assert "KFTPU_NUM_SLICES" not in env
+
+
 def test_tpu_env_unknown_topology_denied():
     s = mk_store()
     pod = mk_pod(labels={
