@@ -1,0 +1,116 @@
+"""CD pipeline builders: push pipelines as code.
+
+The reference's CD is Python that emits Argo push workflows — one ~16-line
+builder per component delegating to a shared base (`/root/reference/py/
+kubeflow/kubeflow/cd/notebook_controller.py:1-16`, base in
+`base_runner.py`/`config.py`). Same split here: ci/workflows.py holds the
+CI (no-push) builders and the YAML renderer; this module holds the CD
+twins — image PUSH on main (kaniko-push equivalent: docker build + push
+tagged with the commit SHA) and a tag-driven release pipeline that gates
+the push on the full test suite + multichip dryrun.
+
+Regenerate with `python -m ci.workflows` (emits both CI and CD).
+"""
+
+from __future__ import annotations
+
+from ci import workflows as ci_wf
+
+REGISTRY_SECRET_USER = "${{ secrets.REGISTRY_USER }}"
+REGISTRY_SECRET_TOKEN = "${{ secrets.REGISTRY_TOKEN }}"
+SHA_TAG = "${{ github.sha }}"
+REF_TAG = "${{ github.ref_name }}"
+
+
+def _login_step() -> dict:
+    return {
+        "name": "registry login",
+        "run": ("echo \"$REGISTRY_TOKEN\" | docker login -u "
+                "\"$REGISTRY_USER\" --password-stdin"),
+        "env": {
+            "REGISTRY_USER": REGISTRY_SECRET_USER,
+            "REGISTRY_TOKEN": REGISTRY_SECRET_TOKEN,
+        },
+    }
+
+
+def image_push_workflow(image: str) -> dict:
+    """CD twin of ci.workflows.image_build_workflow: on main, build the
+    image and push it tagged with the commit SHA (ref cd/*.py kaniko
+    push builders)."""
+    return {
+        "name": f"push {image} image",
+        "on": {"push": {"branches": ["main"],
+                        "paths": [f"images/{image}/**"]}},
+        "jobs": {
+            "push": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    _login_step(),
+                    {"name": "build + push",
+                     "run": (f"make -C images {image} TAG={SHA_TAG} && "
+                             f"docker push "
+                             f"kubeflow-tpu/{image}:{SHA_TAG}")},
+                ],
+            }
+        },
+    }
+
+
+def release_workflow() -> dict:
+    """Tag-driven release: full suite + dryrun gate, then build and push
+    every image at the release tag."""
+    push_all = " && ".join(
+        f"docker push kubeflow-tpu/{img}:{REF_TAG}"
+        for img in ci_wf.IMAGES
+    )
+    return {
+        "name": "release",
+        "on": {"push": {"tags": ["v*"]}},
+        "jobs": {
+            "test": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e . pytest"},
+                    {"name": "full suite",
+                     "run": "python -m pytest tests/ -q",
+                     "env": {
+                         "JAX_PLATFORMS": "cpu",
+                         "XLA_FLAGS":
+                             "--xla_force_host_platform_device_count=8",
+                     }},
+                    {"name": "multichip dryrun",
+                     "run": ("python -c 'import __graft_entry__ as g; "
+                             "g.dryrun_multichip(8)'"),
+                     "env": {
+                         "JAX_PLATFORMS": "cpu",
+                         "XLA_FLAGS":
+                             "--xla_force_host_platform_device_count=8",
+                     }},
+                ],
+            },
+            "publish": {
+                "runs-on": "ubuntu-latest",
+                "needs": ["test"],
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    _login_step(),
+                    {"name": "build + push all images at tag",
+                     "run": (f"make -C images all TAG={REF_TAG} && "
+                             f"{push_all}")},
+                ],
+            },
+        },
+    }
+
+
+def all_workflows() -> dict[str, dict]:
+    out = {}
+    for img in ci_wf.IMAGES:
+        out[f"{img}_image_push.yaml"] = image_push_workflow(img)
+    out["release.yaml"] = release_workflow()
+    return out

@@ -94,6 +94,22 @@ def _scalar(v: Any) -> str:
     if v is None:
         return "null"
     s = str(v)
+    if isinstance(v, str):
+        # Strings that YAML 1.1 would re-type must stay strings: a bare
+        # python-version: 3.10 parses as the float 3.1, "on"/"off" as
+        # booleans, "0x10" as 16.
+        looks_typed = s.lower() in (
+            "true", "false", "null", "~", "yes", "no", "on", "off",
+        )
+        for parse in (float, lambda x: int(x, 0)):
+            try:
+                parse(s)
+                looks_typed = True
+                break
+            except ValueError:
+                pass
+        if looks_typed:
+            return '"' + s + '"'
     if any(c in s for c in ":{}[]#&*!|>'\"%@`") or s != s.strip():
         return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
     return s
@@ -174,13 +190,44 @@ def dryrun_workflow() -> dict:
     }
 
 
+def frontend_workflow() -> dict:
+    """JS runtime tier (ref centraldashboard/karma.conf.js): the SPA's
+    whole module graph is imported and DRIVEN in node+jsdom — render,
+    click, assert the wire calls — not just served over HTTP."""
+    return {
+        "name": "frontend runtime tests",
+        "on": {
+            "pull_request": {"paths": ["kubeflow_tpu/web/frontend/**",
+                                       "tests/frontend/**"]},
+            "push": {"branches": ["main"]},
+        },
+        "jobs": {
+            "domtest": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-node@v4",
+                     "with": {"node-version": "22"}},
+                    {"run": "npm install jsdom@24"},
+                    {"name": "drive the SPA in jsdom",
+                     "run": "node tests/frontend/dom_test.mjs"},
+                ],
+            }
+        },
+    }
+
+
 def all_workflows() -> dict[str, dict]:
+    from ci import cd
+
     out = {}
     for comp in COMPONENTS:
         out[f"{comp}_unit_test.yaml"] = unit_test_workflow(comp)
     for img in IMAGES:
         out[f"{img}_image_build.yaml"] = image_build_workflow(img)
     out["multichip_dryrun.yaml"] = dryrun_workflow()
+    out["frontend_test.yaml"] = frontend_workflow()
+    out.update(cd.all_workflows())
     return out
 
 

@@ -148,7 +148,26 @@ class StatefulSetController(Controller):
                    for r in p.metadata.owner_references)
         }
 
-        changed = False
+        # Template drift replaces pods: a resized/edited gang (e.g.
+        # num_slices bumped) changes the injected env of EVERY member —
+        # keeping old pods would leave a permanently split gang (half
+        # the workers with the old KFTPU_NUM_PROCESSES, jax.distributed
+        # waiting forever). Stale pods are deleted here and recreated
+        # with the current template on the same pass.
+        tmpl_hash = _template_hash(tmpl)
+        stale = [
+            p for p in pods.values()
+            if p.metadata.annotations.get(TEMPLATE_HASH_ANNOTATION)
+            != tmpl_hash
+        ]
+        for pod in stale:
+            try:
+                store.delete("Pod", namespace, pod.metadata.name)
+            except NotFound:
+                pass
+            pods.pop(pod.metadata.name, None)
+
+        changed = bool(stale)
         for i in range(want):
             pod_name = f"{name}-{i}"
             if pod_name in pods:
@@ -161,7 +180,10 @@ class StatefulSetController(Controller):
                 **tmpl.metadata.labels,
                 wh.GANG_ORDINAL_LABEL: str(i),
             }
-            pod.metadata.annotations = dict(tmpl.metadata.annotations)
+            pod.metadata.annotations = {
+                **tmpl.metadata.annotations,
+                TEMPLATE_HASH_ANNOTATION: tmpl_hash,
+            }
             pod.spec.hostname = pod_name
             pod.spec.subdomain = sts.spec.service_name
             set_controller_reference(sts, pod)

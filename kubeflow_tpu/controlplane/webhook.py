@@ -175,7 +175,7 @@ class PodDefaultWebhook:
         num_slices = int(labels.get(NUM_SLICES_LABEL, "1"))
         size = int(labels.get(GANG_SIZE_LABEL, topo.hosts * num_slices))
         ordinal = int(labels.get(GANG_ORDINAL_LABEL, "0"))
-        if num_slices < 1 or size % num_slices:
+        if num_slices < 1 or size < 1 or size % num_slices:
             # Same admission depth as the unknown-topology check: broken
             # gang labels must fail the pod, not emit env that splits
             # slices at the wrong boundaries.
@@ -184,6 +184,7 @@ class PodDefaultWebhook:
                 f"slice(s) (labels {GANG_SIZE_LABEL}/{NUM_SLICES_LABEL} "
                 "disagree)"
             )
+        # From here: num_slices >= 1, size >= 1, size % num_slices == 0.
         if num_slices > 1 and size != topo.hosts * num_slices:
             # Multi-slice env is derived from ordinal arithmetic: a size
             # that isn't hosts-per-slice x num_slices would emit
@@ -208,7 +209,7 @@ class PodDefaultWebhook:
         # slices (SURVEY.md §2b "DCN for cross-slice via JAX multi-slice
         # env"; env-merge mechanism per ref admission-webhook
         # main.go:153-188).
-        hosts_per_slice = max(1, size // max(1, num_slices))
+        hosts_per_slice = size // num_slices
         slice_id = ordinal // hosts_per_slice
         slice_base = slice_id * hosts_per_slice
         hostnames = ",".join(

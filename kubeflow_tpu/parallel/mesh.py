@@ -232,6 +232,17 @@ def create_hybrid_mesh(
     if isinstance(topology, str):
         topology = SLICE_TOPOLOGIES[topology]
     if topology is not None and per_slice != topology.chips:
+        # Same rule as create_mesh: on real TPU a control-plane/slice
+        # disagreement must fail here, not build a silently wrong mesh;
+        # only CPU/virtual simulations downgrade to a warning.
+        backend = getattr(devices[0], "platform", jax.default_backend())
+        if backend == "tpu":
+            raise ValueError(
+                f"topology {topology.name} has {topology.chips} chips "
+                f"per slice but {per_slice} TPU devices per slice are "
+                "visible — control-plane topology env and actual "
+                "slices disagree"
+            )
         logging.getLogger(__name__).warning(
             "simulating %d-slice %s (%d chips each) with %d devices/slice",
             num_slices, topology.name, topology.chips, per_slice,

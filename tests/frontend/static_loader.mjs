@@ -1,0 +1,20 @@
+// ESM resolve hook: the SPA imports its siblings by server path
+// ('/static/app.js' — how the browser loads them from
+// web/platform.py's add_static route); under node those specifiers
+// map onto the frontend source dir. Registered by dom_test.mjs via
+// node:module register().
+import path from 'node:path';
+import { fileURLToPath, pathToFileURL } from 'node:url';
+
+const FRONTEND = path.resolve(
+  path.dirname(fileURLToPath(import.meta.url)),
+  '../../kubeflow_tpu/web/frontend',
+);
+
+export function resolve(specifier, context, nextResolve) {
+  if (specifier.startsWith('/static/')) {
+    const file = path.join(FRONTEND, specifier.slice('/static/'.length));
+    return { url: pathToFileURL(file).href, shortCircuit: true };
+  }
+  return nextResolve(specifier, context);
+}

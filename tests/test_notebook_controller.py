@@ -138,6 +138,34 @@ def test_multislice_gang_env_and_scheduling():
             assert env["KFTPU_PROCESS_ID"] == str(i)
 
 
+def test_multislice_resize_rolls_whole_gang():
+    """Editing num_slices 1 -> 2 must replace EVERY gang pod: the env of
+    existing members (KFTPU_NUM_PROCESSES, MEGASCALE_*) changes too, so
+    keeping them would leave a split gang that never rendezvous."""
+    import time
+
+    with Cluster(ClusterConfig(tpu_slices={"v5e-16": 2})) as cluster:
+        cluster.store.create(mk_notebook("rs", topology="v5e-16"))
+        assert cluster.wait_idle()
+        nb = cluster.store.get("Notebook", "user1", "rs")
+        nb.spec.tpu.num_slices = 2
+        cluster.store.update(nb)
+        pods = []
+        for _ in range(50):
+            assert cluster.wait_idle()
+            pods = cluster.store.list(
+                "Pod", "user1", label_selector={"notebook-name": "rs"})
+            if len(pods) == 8:
+                break
+            time.sleep(0.05)
+        assert len(pods) == 8
+        for p in pods:
+            env = {e.name: e.value for e in p.spec.containers[0].env}
+            assert env["KFTPU_NUM_PROCESSES"] == "8", p.metadata.name
+            assert env["MEGASCALE_NUM_SLICES"] == "2", p.metadata.name
+        assert cluster.scheduler.reserved_slices("user1", "rs") == 2
+
+
 def test_multislice_gang_atomic_reservation(cluster):
     """2 slices requested, pool has 1: zero pods + FailedScheduling —
     multi-slice gangs are all-or-nothing across slices, not just within
