@@ -1,0 +1,12 @@
+# System-wide IPython config, baked at /etc/ipython/ipython_config.py.
+# Runs at every kernel/shell start — including kernels launched into a
+# PVC-mounted $HOME, which would shadow any per-profile startup dir.
+# Forms the gang's jax.distributed process group from the env the
+# admission webhook injected (kubeflow_tpu/controlplane/webhook.py)
+# before the first user cell can touch jax.
+c = get_config()  # noqa: F821 (IPython injects get_config)
+
+c.InteractiveShellApp.exec_lines = [
+    "from kubeflow_tpu.kernel_bootstrap import bootstrap as "
+    "_kftpu_bootstrap; _kftpu_bootstrap(); del _kftpu_bootstrap",
+]

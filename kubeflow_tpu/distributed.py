@@ -12,8 +12,11 @@ num_processes=len(TPU_WORKER_HOSTNAMES), process_id=TPU_WORKER_ID)`).
 The reference's closest mechanism is env merging in its PodDefault
 webhook (admission-webhook/main.go:153-188); it has no consumer because
 it has no compute layer. Ours does: call `initialize_from_env()` first
-thing in a training entrypoint (the jupyter-jax-tpu image does this on
-kernel start), then `parallel.mesh_from_env()` for the sharding layout.
+thing in a training entrypoint — the jupyter-jax-tpu image wires this
+to kernel start via its system IPython config
+(images/jupyter-jax-tpu/ipython_config.py →
+kubeflow_tpu.kernel_bootstrap.bootstrap) — then
+`parallel.mesh_from_env()` for the sharding layout.
 
 Collectives then ride ICI within a slice and DCN across slices — both
 owned by XLA; nothing here opens a socket besides the coordinator

@@ -1,0 +1,45 @@
+"""Kernel-start hook: form the gang's JAX process group automatically.
+
+The jupyter-jax-tpu image bakes a SYSTEM IPython config
+(`/etc/ipython/ipython_config.py`, from images/jupyter-jax-tpu/
+ipython_config.py) whose exec_lines call `bootstrap()` at every kernel
+start — system scope because `$HOME` is the user's workspace PVC
+(web/form.py mounts it there), so anything seeded under
+`~/.ipython/profile_default/startup/` would be shadowed by the volume.
+
+This is the consumer side of the webhook's env injection
+(controlplane/webhook.py): the reference's notebook images run plain
+jupyterlab under s6 (`example-notebook-servers/jupyter/s6/services.d/
+jupyterlab/run`) and have nothing to initialize; ours must rendezvous
+`jax.distributed` BEFORE the first cell touches jax, or a multi-host
+notebook silently computes on one host's chips.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from kubeflow_tpu import distributed
+
+
+def bootstrap() -> bool:
+    """Initialize the gang process group from webhook env; loud either way
+    it matters. Returns True when a multi-process group formed."""
+    try:
+        started = distributed.initialize_from_env()
+    except ValueError as e:
+        # Misconfigured gang: surface in the notebook, fail the kernel
+        # hook loudly rather than letting cells run half-gang'd.
+        print(f"[kubeflow-tpu] gang bootstrap FAILED: {e}", file=sys.stderr)
+        raise
+    if started:
+        import jax
+
+        print(
+            "[kubeflow-tpu] jax.distributed initialized: "
+            f"process {jax.process_index()}/{jax.process_count()}, "
+            f"{jax.local_device_count()} local / "
+            f"{jax.device_count()} global devices",
+            file=sys.stderr,
+        )
+    return started
