@@ -342,6 +342,11 @@ class Event(Resource):
     reason: str = ""
     message: str = ""
     timestamp: float = field(default_factory=_now)
+    # Duplicate aggregation (k8s event count semantics): repeats of the
+    # same (involved, type, reason, message) bump count/last_timestamp
+    # instead of growing the store.
+    count: int = 1
+    last_timestamp: float = 0.0
 
 
 @dataclass

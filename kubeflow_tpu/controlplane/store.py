@@ -67,13 +67,29 @@ Mutator = Callable[[Resource], None]     # in-place mutate or raise AdmissionDen
 
 
 class Store:
-    def __init__(self):
+    # Event GC bounds (k8s inherits a 1h event TTL from etcd leases;
+    # the per-object cap bounds hot reconcile loops that emit faster
+    # than the TTL drains — round-1/2 left growth unbounded).
+    EVENT_TTL_SECS = 3600.0
+    EVENTS_PER_OBJECT = 25
+
+    def __init__(self, *, event_ttl: float | None = None,
+                 events_per_object: int | None = None):
         self._lock = threading.RLock()
         self._objects: dict[tuple[str, str, str], Resource] = {}
         self._rv = itertools.count(1)
         self._watchers: list[tuple[queue.Queue, tuple[str, ...] | None]] = []
         # kind -> mutators run at create; "*" applies to every kind
         self._mutating_webhooks: dict[str, list[Mutator]] = {}
+        self.event_ttl = (self.EVENT_TTL_SECS if event_ttl is None
+                          else event_ttl)
+        self.events_per_object = (self.EVENTS_PER_OBJECT
+                                  if events_per_object is None
+                                  else events_per_object)
+        # namespace -> Event keys: emit/GC touch only a namespace's
+        # events instead of scanning the whole object map under the
+        # global lock (the apiserver-equivalent's hot path).
+        self._events_by_ns: dict[str, set[tuple[str, str, str]]] = {}
 
     # -- admission ---------------------------------------------------------
 
@@ -102,6 +118,9 @@ class Store:
             m.generation = 1
             m.creation_timestamp = m.creation_timestamp or time.time()
             self._objects[obj.key] = obj
+            if obj.kind == "Event":
+                self._events_by_ns.setdefault(
+                    m.namespace, set()).add(obj.key)
             self._notify(WatchEvent("ADDED", obj.clone()))
             return obj.clone()
 
@@ -159,6 +178,8 @@ class Store:
         obj = self._objects.pop(key, None)
         if obj is None:
             return
+        if obj.kind == "Event":
+            self._events_by_ns.get(obj.metadata.namespace, set()).discard(key)
         self._notify(WatchEvent("DELETED", obj.clone()))
         # Cascade: delete objects owned (controller=True) by this one.
         owned = [
@@ -210,16 +231,72 @@ class Store:
     def emit_event(
         self, involved: Resource, type_: str, reason: str, message: str
     ) -> None:
+        ns = involved.metadata.namespace or "default"
+        now = time.time()
+        with self._lock:
+            # Duplicate aggregation: a repeat of an existing live event
+            # bumps count/last_timestamp in place (k8s event count
+            # semantics) — reconcile loops that re-emit the same warning
+            # every pass cost one object, not one per pass. The
+            # namespace index keeps this off the full object map.
+            hit = None
+            for key in self._events_by_ns.get(ns, ()):
+                obj = self._objects.get(key)
+                if obj is None:
+                    continue
+                if (obj.involved_kind == involved.kind
+                        and obj.involved_name == involved.metadata.name
+                        and obj.type == type_ and obj.reason == reason
+                        and obj.message == message
+                        and now - obj.timestamp < self.event_ttl):
+                    hit = obj
+                    break
+            if hit is not None:
+                hit.count += 1
+                hit.last_timestamp = now
+                hit.metadata.resource_version = next(self._rv)
+                self._notify(WatchEvent("MODIFIED", hit.clone()))
+                self._gc_events(ns, involved)
+                return
         ev = Event(
             involved_kind=involved.kind,
             involved_name=involved.metadata.name,
             type=type_,
             reason=reason,
             message=message,
+            last_timestamp=now,
         )
-        ev.metadata.namespace = involved.metadata.namespace or "default"
+        ev.metadata.namespace = ns
         ev.metadata.name = f"{involved.metadata.name}.{uuid.uuid4().hex[:8]}"
         self.create(ev)
+        self._gc_events(ns, involved)
+
+    def _gc_events(self, namespace: str, involved: Resource) -> None:
+        """Bound event growth: drop expired events namespace-wide and
+        keep only the newest `events_per_object` for the emitting
+        object. Runs on the emit path only — reads (events_for) stay
+        scan-only."""
+        now = time.time()
+        with self._lock:
+            expired: set[tuple[str, str, str]] = set()
+            mine: list[tuple[float, tuple[str, str, str]]] = []
+            for key in self._events_by_ns.get(namespace, ()):
+                obj = self._objects.get(key)
+                if obj is None:
+                    continue
+                fresh_at = max(obj.timestamp, obj.last_timestamp)
+                if now - fresh_at >= self.event_ttl:
+                    expired.add(key)
+                elif (obj.involved_kind == involved.kind
+                      and obj.involved_name == involved.metadata.name):
+                    mine.append((fresh_at, key))
+            mine.sort(reverse=True)
+            overflow = [key for _, key in mine[self.events_per_object:]]
+            for key in list(expired) + overflow:
+                obj = self._objects.pop(key, None)
+                self._events_by_ns.get(namespace, set()).discard(key)
+                if obj is not None:
+                    self._notify(WatchEvent("DELETED", obj.clone()))
 
     def events_for(self, kind: str, namespace: str, name: str) -> list[Event]:
         return [

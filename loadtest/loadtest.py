@@ -84,6 +84,20 @@ def run(n_notebooks: int, tpu_slices: int, timeout: float) -> int:
                 print(json.dumps({"error": "partial gang detected",
                                   **stats}))
                 return 1
+        # Event growth after churn must stay bounded (store event GC:
+        # TTL + per-object cap + duplicate aggregation). A hot denied
+        # gang re-emitting FailedScheduling each reconcile pass is
+        # exactly the churn this guards.
+        events = cluster.store.list("Event", "load")
+        cap = cluster.store.events_per_object * max(1, n_notebooks)
+        stats.update(
+            events=len(events),
+            event_repeats_aggregated=sum(e.count - 1 for e in events),
+        )
+        if len(events) > cap:
+            print(json.dumps({"error": "event growth unbounded",
+                              "events": len(events), "cap": cap, **stats}))
+            return 1
         print(json.dumps(stats))
         return 0
 
