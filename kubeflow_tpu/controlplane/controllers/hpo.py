@@ -38,6 +38,7 @@ from kubeflow_tpu.controlplane.runtime import Controller, Result
 from kubeflow_tpu.controlplane.store import (
     AdmissionDenied,
     AlreadyExists,
+    Conflict,
     NotFound,
     Store,
     set_controller_reference,
@@ -166,11 +167,6 @@ class TrialController(Controller):
 
     def __init__(self, executor: TrialExecutor | None = None):
         self.executor = executor
-        # Pod uid → (phase, metric-or-None): executor outcomes recorded
-        # BEFORE the store write, so a Conflict on update + workqueue
-        # retry replays the recorded result instead of re-running the
-        # objective (which may be slow or side-effecting).
-        self._executed: dict[str, tuple[str, str | None]] = {}
 
     def reconcile(self, store: Store, namespace: str, name: str) -> Result:
         try:
@@ -218,33 +214,46 @@ class TrialController(Controller):
             trial = store.update(trial)  # keep rv fresh for the mirror below
 
         # Hermetic executor: run the objective now and complete the pod.
+        # The outcome's ONLY record is the pod itself (terminal phase +
+        # metric annotation) — durable across controller restarts, unlike
+        # the process-local memo this replaces. The objective therefore
+        # must not finish a reconcile un-persisted: the write below
+        # retries Conflicts in place with a refetch (k8s
+        # retry.RetryOnConflict discipline, ref notebook_route.go:119-131)
+        # instead of bailing to a later reconcile that would re-run it.
         if self.executor is not None and pod.phase not in (
             "Succeeded", "Failed"
         ):
-            outcome = self._executed.get(pod.metadata.uid)
-            if outcome is None:
+            try:
+                value = float(self.executor(dict(trial.spec.assignment)))
+                outcome = ("Succeeded", str(value))
+            except Exception as e:  # noqa: BLE001 — user objective
+                outcome = ("Failed", None)
+                log.warning("trial %s objective failed: %s", name, e)
+            for _ in range(8):
+                pod.phase, metric = outcome
+                if metric is None:
+                    pod.metadata.annotations.pop(
+                        TRIAL_METRIC_ANNOTATION, None)
+                else:
+                    pod.metadata.annotations[TRIAL_METRIC_ANNOTATION] = metric
                 try:
-                    value = float(self.executor(dict(trial.spec.assignment)))
-                    outcome = ("Succeeded", str(value))
-                except Exception as e:  # noqa: BLE001 — user objective
-                    outcome = ("Failed", None)
-                    log.warning("trial %s objective failed: %s", name, e)
-                self._executed[pod.metadata.uid] = outcome
-                # The pop below misses pods that turn terminal through
-                # another writer (or trials deleted mid-retry), so bound
-                # the memo by evicting oldest entries — by then their
-                # Conflict retry has long since resolved.
-                while len(self._executed) > 256:
-                    self._executed.pop(next(iter(self._executed)))
-            pod.phase, metric = outcome
-            if metric is None:
-                pod.metadata.annotations.pop(TRIAL_METRIC_ANNOTATION, None)
+                    pod = store.update(pod)
+                    break
+                except Conflict:
+                    try:
+                        pod = store.get("Pod", namespace, pod_name)
+                    except NotFound:
+                        return Result()  # trial/pod deleted mid-run
+                    if pod.phase in ("Succeeded", "Failed"):
+                        break  # another writer finished it; keep theirs
+                except NotFound:
+                    return Result()  # deleted while the objective ran
             else:
-                pod.metadata.annotations[TRIAL_METRIC_ANNOTATION] = metric
-            store.update(pod)
-            # Durably recorded on the pod now; drop the memo so the map
-            # stays bounded over a long-lived controller.
-            self._executed.pop(pod.metadata.uid, None)
+                # Pathological write contention: requeue; the objective
+                # re-runs, which at-least-once semantics permit.
+                log.error("trial %s: could not record outcome", name)
+                return Result(requeue_after=1.0)
 
         # Mirror pod completion into trial status.
         if pod.phase == "Succeeded":

@@ -215,3 +215,44 @@ def test_no_livelock_on_failed_validation():
         time.sleep(1.0)
         rv1 = c.store.get("Experiment", "user1", "exp").metadata.resource_version
         assert rv1 == rv0
+
+
+def test_objective_runs_once_despite_write_conflicts():
+    """The executor outcome is recorded on the pod with in-place
+    Conflict retries: contention on the terminal write must replay the
+    write, never the objective (which may be a multi-hour train run)."""
+    from kubeflow_tpu.controlplane.store import Conflict
+
+    runs = []
+    cfg = ClusterConfig(
+        trial_executor=lambda a: runs.append(dict(a)) or 1.0)
+    with Cluster(cfg) as c:
+        real_update = c.store.update
+        failed_once = set()
+
+        def flaky_update(obj):
+            # First attempt to write each trial pod's terminal phase
+            # conflicts (as if another writer touched the pod between
+            # the executor run and the write).
+            if (obj.kind == "Pod" and obj.metadata.name.endswith("-run")
+                    and obj.phase in ("Succeeded", "Failed")
+                    and obj.metadata.name not in failed_once):
+                failed_once.add(obj.metadata.name)
+                # bump the stored rv so the caller's copy is stale
+                fresh = c.store.get(
+                    "Pod", obj.metadata.namespace, obj.metadata.name)
+                real_update(fresh)
+                raise Conflict("injected")
+            return real_update(obj)
+
+        c.store.update = flaky_update
+        try:
+            c.store.create(_experiment(max_trials=4, parallel=2))
+            assert c.wait_idle(timeout=20)
+        finally:
+            c.store.update = real_update
+        exp = c.store.get("Experiment", "user1", "exp")
+        assert exp.status.phase == "Succeeded", exp.status
+        assert exp.status.trials_succeeded == 4
+        assert len(failed_once) == 4          # every pod write conflicted once
+        assert len(runs) == 4                 # ...but no objective re-ran
