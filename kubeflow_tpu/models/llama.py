@@ -26,9 +26,9 @@ import jax
 import jax.numpy as jnp
 
 from kubeflow_tpu.ops.attention import dot_product_attention
+from kubeflow_tpu.ops.embedding import embed_lookup
 from kubeflow_tpu.ops.norms import rms_norm
 from kubeflow_tpu.ops.rotary import apply_rope, rope_frequencies
-from kubeflow_tpu.parallel import mesh as mesh_lib
 from kubeflow_tpu.parallel.sharding import with_sharding_constraint as wsc
 
 Params = dict[str, Any]
@@ -157,30 +157,9 @@ def _block(cfg: LlamaConfig, x, layer_params, positions, inv_freq, kv_mask,
     return wsc(x, ("batch", "seq", "act_embed"))
 
 
-def _embed_lookup(table: jnp.ndarray, tokens: jnp.ndarray,
-                  dtype) -> jnp.ndarray:
-    """Embedding lookup, mesh-aware.
-
-    With the table sharded (vocab→tensor, embed→fsdp), a gather's output
-    sharding clashes with the batch-sharded activation constraint and
-    XLA's SPMD partitioner falls back to full rematerialization
-    (replicate-then-reshard — the "Involuntary full rematerialization"
-    warning). Under a sharding mesh the lookup is therefore a one-hot
-    contraction riding the MXU: vocab contracts (psum over tensor) and
-    sharding composes cleanly. On a trivial mesh (single chip / pure DP,
-    table effectively replicated) the gather is strictly cheaper — the
-    one-hot adds a full vocab matmul (~2% step time at 32k vocab) for
-    nothing — so it stays a gather there.
-    """
-    mesh = jax.sharding.get_abstract_mesh()
-    sharded = any(
-        mesh.shape.get(ax, 1) > 1
-        for ax in (mesh_lib.FSDP_AXIS, mesh_lib.TENSOR_AXIS)
-    )
-    if not sharded:
-        return table.astype(dtype)[tokens]
-    onehot = jax.nn.one_hot(tokens, table.shape[0], dtype=dtype)
-    return onehot @ table.astype(dtype)
+# Mesh-aware lookup (gather on trivial meshes, one-hot MXU contraction
+# under sharding) now lives in ops.embedding — serving shares it.
+_embed_lookup = embed_lookup
 
 
 def apply(

@@ -1,0 +1,35 @@
+"""Embedding lookup, mesh-aware — shared by training and serving.
+
+With the table sharded (vocab→tensor, embed→fsdp), a gather's output
+sharding clashes with the batch-sharded activation constraint and XLA's
+SPMD partitioner falls back to full rematerialization
+(replicate-then-reshard — the "Involuntary full rematerialization"
+warning). At Gemma vocab scale (256k) that replication is ~2 GB of
+bf16 table per chip per step. Under a sharding mesh the lookup is
+therefore a one-hot contraction riding the MXU: vocab contracts (psum
+over tensor) and sharding composes cleanly. On a trivial mesh (single
+chip / pure DP, table effectively replicated) the gather is strictly
+cheaper — the one-hot adds a full vocab matmul (~2% step time at 32k
+vocab) for nothing — so it stays a gather there.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.parallel import mesh as mesh_lib
+
+
+def embed_lookup(table: jnp.ndarray, tokens: jnp.ndarray,
+                 dtype) -> jnp.ndarray:
+    """tokens [..., s] int32 -> activations [..., s, embed] in `dtype`."""
+    mesh = jax.sharding.get_abstract_mesh()
+    sharded = any(
+        mesh.shape.get(ax, 1) > 1
+        for ax in (mesh_lib.FSDP_AXIS, mesh_lib.TENSOR_AXIS)
+    )
+    if not sharded:
+        return table.astype(dtype)[tokens]
+    onehot = jax.nn.one_hot(tokens, table.shape[0], dtype=dtype)
+    return onehot @ table.astype(dtype)

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from kubeflow_tpu.ops.attention import dot_product_attention
+from kubeflow_tpu.ops.embedding import embed_lookup
 from kubeflow_tpu.ops.norms import rms_norm
 from kubeflow_tpu.ops.rotary import apply_rope, rope_frequencies
 
@@ -97,7 +98,10 @@ class InferenceEngine:
 
     def _embed(self, tokens):
         cfg = self.cfg
-        x = self.params["embed"].astype(cfg.dtype)[tokens]
+        # Mesh-aware (ops.embedding): a gather is fine single-chip, but a
+        # sharded 256k-vocab Gemma table must contract via one-hot or the
+        # SPMD partitioner replicates the full table per step.
+        x = embed_lookup(self.params["embed"], tokens, cfg.dtype)
         if self.family.scale_embed:
             x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.dtype)
         return x
