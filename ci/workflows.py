@@ -68,6 +68,15 @@ def _yaml(obj: Any, indent: int = 0) -> str:
                 lines.append(f"{pad}{k}: {{}}")
             elif isinstance(v, list):
                 lines.append(f"{pad}{k}: []")
+            elif isinstance(v, str) and "\n" in v:
+                # Multi-line strings (ConfigMap payloads) as literal
+                # block scalars — double-quoted flow scalars would fold
+                # the newlines into spaces.
+                body = "\n".join(
+                    f"{pad}  {line}".rstrip() for line in v.split("\n")
+                )
+                marker = "|" if v.endswith("\n") else "|-"
+                lines.append(f"{pad}{k}: {marker}\n{body}".rstrip("\n"))
             else:
                 lines.append(f"{pad}{k}: {_scalar(v)}")
         return "\n".join(lines)

@@ -167,7 +167,6 @@ class StatefulSetController(Controller):
                 pass
             pods.pop(pod.metadata.name, None)
 
-        changed = bool(stale)
         for i in range(want):
             pod_name = f"{name}-{i}"
             if pod_name in pods:
@@ -198,7 +197,6 @@ class StatefulSetController(Controller):
                 # eventually recovers (those changes don't enqueue us).
                 self.scheduler.release_gang(namespace, name)
                 return Result(requeue_after=2.0)
-            changed = True
 
         for pod_name, pod in pods.items():
             try:
@@ -208,7 +206,6 @@ class StatefulSetController(Controller):
             if ordinal >= want:
                 try:
                     store.delete("Pod", namespace, pod_name)
-                    changed = True
                 except NotFound:
                     pass
 
@@ -223,7 +220,6 @@ class StatefulSetController(Controller):
                 p.pod_ip = f"10.0.{abs(hash((namespace, p.metadata.name))) % 250}.{abs(hash(p.metadata.name)) % 250}"
                 p.host_ip = f"node-{abs(hash(p.metadata.name)) % 8}"
                 store.update(p)
-                changed = True
 
         ready = sum(
             1 for p in store.list("Pod", namespace)

@@ -104,6 +104,22 @@ async def _request_counter_middleware(request: web.Request, handler):
     return resp
 
 
+def load_spawner_config(path: str) -> dict | None:
+    """Admin spawner config from a mounted file (the ConfigMap in
+    deploy/overlays mounts at /etc/config/spawner_ui_config.yaml); None
+    (built-in defaults) when unset or absent, like the reference's
+    fallback to the in-repo dev copy (jupyter utils.py:22-53)."""
+    if not path or not os.path.exists(path):
+        return None
+    import yaml
+
+    with open(path) as f:
+        config = yaml.safe_load(f)
+    if not isinstance(config, dict):
+        raise ValueError(f"spawner config {path} must be a mapping")
+    return config
+
+
 def main() -> None:  # pragma: no cover - manual entry point
     import argparse
 
@@ -112,11 +128,18 @@ def main() -> None:  # pragma: no cover - manual entry point
     p = argparse.ArgumentParser()
     p.add_argument("--port", type=int, default=8082)
     p.add_argument("--tpu-slices", default="v5e-16=1,v5e-1=4")
+    p.add_argument("--spawner-config", default="",
+                   help="path to a spawner_ui_config.yaml (the deploy "
+                        "manifests mount the spawner-config ConfigMap "
+                        "here); empty/missing = built-in defaults, "
+                        "matching the reference's dev fallback "
+                        "(jupyter utils.py:22-53)")
     p.add_argument("--dev-user", default="",
                    help="identity to assume when no auth header is present "
                         "(local development without an auth proxy)")
     args = p.parse_args()
 
+    spawner_config = load_spawner_config(args.spawner_config)
     slices = {}
     for part in args.tpu_slices.split(","):
         k, _, v = part.partition("=")
@@ -126,7 +149,8 @@ def main() -> None:  # pragma: no cover - manual entry point
         tpu_slices=slices,
         cluster_admins={args.dev_user} if args.dev_user else set(),
     )).start()
-    app = cluster.create_web_app(dev_user=args.dev_user or None)
+    app = cluster.create_web_app(dev_user=args.dev_user or None,
+                                 spawner_config=spawner_config)
     web.run_app(app, port=args.port)
 
 

@@ -1,0 +1,111 @@
+"""Deploy manifests (VERDICT r2 missing #3: no artifact deploys the
+platform itself). The overlays must be applyable YAML that stands up
+the platform Deployment/Service/RBAC/ConfigMap, and the committed tree
+must match the emitter (same drift rule as .github/workflows)."""
+
+import glob
+import os
+
+import yaml
+
+from deploy import generate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OVERLAY_DIR = os.path.join(REPO, "deploy", "overlays")
+
+
+def _docs(name, fname):
+    with open(os.path.join(OVERLAY_DIR, name, fname)) as f:
+        return list(yaml.safe_load_all(f))
+
+
+def test_committed_manifests_match_emitter():
+    for name in generate.OVERLAYS:
+        want = generate.render_dir(name)
+        have = {
+            os.path.basename(p): open(p).read()
+            for p in glob.glob(os.path.join(OVERLAY_DIR, name, "*.yaml"))
+        }
+        assert sorted(have) == sorted(want), name
+        for fname in want:
+            assert have[fname] == want[fname], (
+                f"{name}/{fname} drifted — rerun `python -m "
+                "deploy.generate`")
+
+
+def test_every_overlay_is_complete_and_valid():
+    for name in generate.OVERLAYS:
+        kustomization = _docs(name, "kustomization.yaml")[0]
+        listed = set(kustomization["resources"])
+        present = {
+            os.path.basename(p)
+            for p in glob.glob(os.path.join(OVERLAY_DIR, name, "*.yaml"))
+        } - {"kustomization.yaml"}
+        assert listed == present, (name, listed, present)
+        kinds = set()
+        for fname in present:
+            for doc in _docs(name, fname):
+                assert doc["apiVersion"] and doc["kind"], (name, fname)
+                kinds.add(doc["kind"])
+        # the minimum set an operator needs to run the platform
+        assert {"Namespace", "Deployment", "Service", "ServiceAccount",
+                "ClusterRole", "ClusterRoleBinding",
+                "ConfigMap"} <= kinds, (name, kinds)
+
+
+def test_platform_deployment_is_runnable():
+    """The Deployment's command/image/probe point at real things."""
+    for name in generate.OVERLAYS:
+        (dep, svc) = _docs(name, "platform.yaml")
+        tmpl = dep["spec"]["template"]["spec"]
+        c = tmpl["containers"][0]
+        # image is one the images/ Makefile builds
+        with open(os.path.join(REPO, "images", "Makefile")) as f:
+            makefile = f.read()
+        image_target = c["image"].split("/")[1].split(":")[0]
+        assert f"{image_target}:" in makefile, c["image"]
+        # command module exists and is importable
+        assert c["command"][:3] == ["python", "-m",
+                                    "kubeflow_tpu.web.platform"]
+        import kubeflow_tpu.web.platform  # noqa: F401
+        # service targets the port the command serves
+        port = int(c["command"][c["command"].index("--port") + 1])
+        assert c["ports"][0]["containerPort"] == port
+        assert svc["spec"]["ports"][0]["targetPort"] == port
+        # RBAC subject matches the pod's service account
+        sa_docs = _docs(name, "rbac.yaml")
+        sa = next(d for d in sa_docs if d["kind"] == "ServiceAccount")
+        assert tmpl["serviceAccountName"] == sa["metadata"]["name"]
+
+
+def test_spawner_configmap_loads_through_form_engine():
+    """The mounted config must be exactly what web/form.py consumes
+    (ref spawner_ui_config.yaml contract)."""
+    from kubeflow_tpu.web import form
+
+    for name in generate.OVERLAYS:
+        cm = _docs(name, "spawner-config.yaml")[0]
+        inner = yaml.safe_load(cm["data"]["spawner_ui_config.yaml"])
+        assert sorted(inner) == sorted(form.DEFAULT_SPAWNER_CONFIG)
+        # the form engine accepts it end to end: parse -> build CR
+        parsed = form.parse_form(
+            {"name": "t", "namespace": "u1",
+             "image": inner["image"]["value"]}, config=inner)
+        nb = form.build_notebook(parsed, config=inner)
+        assert nb.metadata.name == "t"
+        assert nb.spec.template.spec.containers[0].image == (
+            inner["image"]["value"])
+
+
+def test_overlays_differ_where_it_matters():
+    std = _docs("standalone", "platform.yaml")[0]
+    gke = _docs("gke", "platform.yaml")[0]
+
+    def env_of(doc):
+        return {e["name"]: e["value"] for e in
+                doc["spec"]["template"]["spec"]["containers"][0]["env"]}
+
+    assert env_of(std)["ENABLE_CULLING"] == "false"
+    assert env_of(gke)["ENABLE_CULLING"] == "true"
+    gke_cmd = gke["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert any("v5e-16" in a for a in gke_cmd)
