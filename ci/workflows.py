@@ -48,8 +48,9 @@ COMPONENTS: dict[str, dict[str, Any]] = {
     },
 }
 
-IMAGES = ["base", "jupyter-jax", "jupyter-jax-tpu", "jupyter-scipy",
-          "codeserver-jax"]
+IMAGES = ["base", "jupyter-jax", "jupyter-jax-tpu", "jupyter-jax-full",
+          "jupyter-scipy", "codeserver-jax", "rstudio",
+          "rstudio-tidyverse"]
 
 
 def _yaml(obj: Any, indent: int = 0) -> str:
@@ -106,8 +107,9 @@ def _scalar(v: Any) -> str:
     if isinstance(v, str):
         # Strings that YAML 1.1 would re-type must stay strings: a bare
         # python-version: 3.10 parses as the float 3.1, "on"/"off" as
-        # booleans, "0x10" as 16.
-        looks_typed = s.lower() in (
+        # booleans, "0x10" as 16, and an empty scalar as null (the core
+        # API group "" in RBAC rules!).
+        looks_typed = s == "" or s.lower() in (
             "true", "false", "null", "~", "yes", "no", "on", "off",
         )
         for parse in (float, lambda x: int(x, 0)):

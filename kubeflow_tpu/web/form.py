@@ -78,10 +78,16 @@ DEFAULT_SPAWNER_CONFIG: dict[str, Any] = {
     "image": {
         "value": "kubeflow-tpu/jupyter-jax:latest",
         "options": [
-            "kubeflow-tpu/jupyter-jax:latest",       # jax[tpu] + pallas
-            "kubeflow-tpu/jupyter-jax-full:latest",  # + flax/optax/orbax etc.
+            # the images/ matrix (images/README.md) — every option is a
+            # target `make -C images all` builds (tests/test_ci.py pins
+            # this list to the Makefile)
+            "kubeflow-tpu/jupyter-jax:latest",
+            "kubeflow-tpu/jupyter-jax-tpu:latest",
+            "kubeflow-tpu/jupyter-jax-full:latest",
+            "kubeflow-tpu/jupyter-scipy:latest",
             "kubeflow-tpu/codeserver-jax:latest",
             "kubeflow-tpu/rstudio:latest",
+            "kubeflow-tpu/rstudio-tidyverse:latest",
         ],
         "readOnly": False,
     },
