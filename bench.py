@@ -166,6 +166,7 @@ def bench_train(preset: Preset, *, assert_flash: bool = False,
     from kubeflow_tpu.ops import attention
     from kubeflow_tpu.parallel import MeshSpec, create_mesh
     from kubeflow_tpu.train import Trainer, TrainConfig
+    from kubeflow_tpu.train.trainer import chunked_cross_entropy_from_hidden
     from kubeflow_tpu.utils import profiling
 
     cfg = bench_configs()[preset.model]
@@ -174,12 +175,22 @@ def bench_train(preset: Preset, *, assert_flash: bool = False,
     # Global batch must divide evenly over the data*fsdp axes.
     batch = -(-preset.batch // n_devices) * n_devices
 
+    def chunked_loss(params, tokens, targets, mask):
+        # Never materializes the [b, s, vocab] fp32 logits — the step's
+        # largest tensor (2 GB at batch 8 x 2048 x 32k) and its
+        # cotangent both go away (trainer.py chunked CE docstring).
+        h = llama.hidden(params, cfg, tokens)
+        return chunked_cross_entropy_from_hidden(
+            h, llama.unembed_matrix(params, cfg), targets, mask,
+            num_chunks=16)
+
     trainer = Trainer(
         mesh=mesh,
         apply_fn=lambda p_, t: llama.apply(p_, cfg, t),
         init_fn=lambda k: llama.init(k, cfg),
         logical_axes=llama.param_logical_axes(cfg),
         train_config=TrainConfig(warmup_steps=10, total_steps=1000),
+        loss_fn=chunked_loss,
     )
     state = trainer.init(jax.random.key(0))
 

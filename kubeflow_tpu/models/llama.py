@@ -162,14 +162,16 @@ def _block(cfg: LlamaConfig, x, layer_params, positions, inv_freq, kv_mask,
 _embed_lookup = embed_lookup
 
 
-def apply(
+def hidden(
     params: Params,
     cfg: LlamaConfig,
     tokens: jnp.ndarray,              # [b, s] int32
     positions: jnp.ndarray | None = None,
     kv_mask: jnp.ndarray | None = None,  # [b, s] bool, False = padding
 ) -> jnp.ndarray:
-    """Forward pass → logits [b, s, vocab] (fp32)."""
+    """Forward pass through the blocks → final NORMED hidden [b, s, D]
+    in cfg.dtype. Callers that don't need full logits (the chunked-CE
+    training loss) stop here; `apply` adds the unembedding."""
     b, s = tokens.shape
     contiguous = positions is None  # safe to use index-masked flash kernel
     if positions is None:
@@ -186,8 +188,24 @@ def apply(
         block_fn = jax.checkpoint(block_fn)
     x, _ = jax.lax.scan(block_fn, x, params["blocks"])
 
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def unembed_matrix(params: Params, cfg: LlamaConfig) -> jnp.ndarray:
+    """[D, vocab] unembedding (the tied table transposed, or lm_head)."""
+    return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+
+
+def apply(
+    params: Params,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,              # [b, s] int32
+    positions: jnp.ndarray | None = None,
+    kv_mask: jnp.ndarray | None = None,  # [b, s] bool, False = padding
+) -> jnp.ndarray:
+    """Forward pass → logits [b, s, vocab] (fp32)."""
+    x = hidden(params, cfg, tokens, positions, kv_mask)
+    head = unembed_matrix(params, cfg)
     logits = x.astype(jnp.float32) @ head.astype(jnp.float32)
     return wsc(logits, ("batch", "seq", "act_vocab"))
 

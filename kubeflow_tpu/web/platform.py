@@ -14,6 +14,7 @@ import os
 from aiohttp import web
 
 from kubeflow_tpu.controlplane.store import Store
+from kubeflow_tpu.web.apis_app import create_apis_app
 from kubeflow_tpu.web.dashboard_app import create_dashboard_app
 from kubeflow_tpu.web.jupyter_app import create_jupyter_app
 from kubeflow_tpu.web.kfam_app import create_kfam_app
@@ -59,8 +60,6 @@ def create_platform_app(
     # clients, not browsers — exempt from the SPA's cookie CSRF dance,
     # with its own custom-header CSRF defense on mutations
     # (apis_app.API_CLIENT_HEADER).
-    from kubeflow_tpu.web.apis_app import create_apis_app
-
     root["csrf_exempt_prefixes"] = ("/kfam/", "/apis/")
     root.add_subapp("/apis/", create_apis_app(
         store, cluster_admins=cluster_admins, csrf=False))
