@@ -27,6 +27,8 @@ import jax.numpy as jnp
 
 from kubeflow_tpu.ops.attention import dot_product_attention
 from kubeflow_tpu.ops.embedding import embed_lookup
+from jax.ad_checkpoint import checkpoint_name
+
 from kubeflow_tpu.ops.norms import rms_norm
 from kubeflow_tpu.ops.rotary import apply_rope, rope_frequencies
 from kubeflow_tpu.parallel.sharding import with_sharding_constraint as wsc
@@ -49,6 +51,17 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16      # activation dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # What the per-block jax.checkpoint keeps (HBM) vs recomputes (FLOPs):
+    #   "full" — keep only block boundaries; bwd reruns the whole block
+    #            fwd (~+2N matmul FLOPs, the classic 8N/6N = 33% tax).
+    #   "mlp"  — additionally keep the three MLP matmul outputs
+    #            (gate/up/down — 82% of a block's matmul FLOPs at Llama
+    #            shapes) so bwd only reruns the attention side.
+    #   "dots" — keep every matmul output (jax dots_with_no_batch_dims
+    #            policy); bwd reruns just elementwise + the flash kernel.
+    # Picked per preset by HBM headroom: chunked CE (train.trainer) freed
+    # the logit tensor, which is what makes "mlp"/"dots" fit on one chip.
+    remat_policy: str = "full"
 
     @property
     def q_dim(self) -> int:
@@ -57,6 +70,16 @@ class LlamaConfig:
     @property
     def kv_dim(self) -> int:
         return self.num_kv_heads * self.head_dim
+
+
+# Remat save-policies, keyed by LlamaConfig.remat_policy (factories so
+# import never touches jax state).
+_REMAT_POLICIES = {
+    "full": lambda: None,
+    "mlp": lambda: jax.checkpoint_policies.save_only_these_names(
+        "mlp_gate", "mlp_up", "mlp_down"),
+    "dots": lambda: jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
 
 
 # BASELINE.json flagship + scaled-down siblings for single-chip benches and
@@ -150,10 +173,13 @@ def _block(cfg: LlamaConfig, x, layer_params, positions, inv_freq, kv_mask,
     x = wsc(x, ("batch", "seq", "act_embed"))
 
     h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(h @ p["w_gate"].astype(cfg.dtype))
-    up = h @ p["w_up"].astype(cfg.dtype)
+    # checkpoint_name is inert unless cfg.remat_policy == "mlp" selects
+    # these tensors as the save set (see _REMAT_POLICIES).
+    gate = jax.nn.silu(
+        checkpoint_name(h @ p["w_gate"].astype(cfg.dtype), "mlp_gate"))
+    up = checkpoint_name(h @ p["w_up"].astype(cfg.dtype), "mlp_up")
     ff = wsc(gate * up, ("batch", "seq", "act_mlp"))
-    x = x + ff @ p["w_down"].astype(cfg.dtype)
+    x = x + checkpoint_name(ff @ p["w_down"].astype(cfg.dtype), "mlp_down")
     return wsc(x, ("batch", "seq", "act_embed"))
 
 
@@ -185,7 +211,8 @@ def hidden(
         _block(cfg, x, lp, positions, inv_freq, kv_mask,
                contiguous_positions=contiguous), None)
     if cfg.remat:
-        block_fn = jax.checkpoint(block_fn)
+        block_fn = jax.checkpoint(
+            block_fn, policy=_REMAT_POLICIES[cfg.remat_policy]())
     x, _ = jax.lax.scan(block_fn, x, params["blocks"])
 
     return rms_norm(x, params["final_norm"], cfg.norm_eps)

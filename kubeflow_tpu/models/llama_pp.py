@@ -81,7 +81,8 @@ def apply_pipelined(
             ), None
 
         if cfg.remat:
-            blk = jax.checkpoint(blk)
+            blk = jax.checkpoint(
+                blk, policy=llama._REMAT_POLICIES[cfg.remat_policy]())
         x, _ = jax.lax.scan(blk, x, stage_blocks)
         return x
 

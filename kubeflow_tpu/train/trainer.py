@@ -28,6 +28,16 @@ from kubeflow_tpu.parallel.sharding import ShardingRules
 Params = Any
 
 
+def _masked_mean(
+    nll: jnp.ndarray,                 # [b, s] per-position losses
+    mask: jnp.ndarray | None,         # [b, s] float/bool, 0 = ignore
+) -> jnp.ndarray:
+    if mask is None:
+        return jnp.mean(nll)
+    mask = mask.astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
 def cross_entropy_loss(
     logits: jnp.ndarray,   # [b, s, vocab] fp32
     targets: jnp.ndarray,  # [b, s] int32
@@ -36,11 +46,7 @@ def cross_entropy_loss(
     """Mean next-token cross entropy over valid positions."""
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    nll = logz - gold
-    if mask is None:
-        return jnp.mean(nll)
-    mask = mask.astype(jnp.float32)
-    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return _masked_mean(logz - gold, mask)
 
 
 def chunked_cross_entropy_from_hidden(
@@ -70,14 +76,15 @@ def chunked_cross_entropy_from_hidden(
     # Largest divisor of vocab <= requested: never silently degrade to
     # one full-vocab chunk (that would materialize exactly the logits
     # this function exists to avoid).
+    requested = num_chunks
     num_chunks = max(1, min(num_chunks, vocab))
     while vocab % num_chunks:
         num_chunks -= 1
-    if num_chunks == 1 and vocab > 4096:
+    if num_chunks == 1 and requested > 1 and vocab > 4096:
         logging.getLogger(__name__).warning(
             "chunked CE running UNCHUNKED: vocab %d shares no divisor "
-            "with the requested chunk count — full [b, s, vocab] logits "
-            "will materialize", vocab)
+            "<= the requested chunk count %d — full [b, s, vocab] "
+            "logits will materialize", vocab, requested)
     chunk = vocab // num_chunks
     hidden = hidden.astype(jnp.float32)
     offsets = (jnp.arange(num_chunks, dtype=jnp.int32) * chunk)
@@ -108,11 +115,7 @@ def chunked_cross_entropy_from_hidden(
         jnp.zeros((b, s), jnp.float32),
     )
     (m, acc, gold), _ = jax.lax.scan(body, init, offsets)
-    nll = (m + jnp.log(acc)) - gold
-    if mask is None:
-        return jnp.mean(nll)
-    mask = mask.astype(jnp.float32)
-    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return _masked_mean((m + jnp.log(acc)) - gold, mask)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -141,6 +141,34 @@ def test_hybrid_dcn_trainer_matches_single_slice():
         )
 
 
+def test_remat_policies_match_full_remat(params):
+    """Every remat_policy ("mlp" save-list, "dots") is a pure
+    HBM-for-FLOPs schedule change: loss and grads must match the default
+    full-remat path to fp32 rounding (llama.py _REMAT_POLICIES; exact
+    bitwise equality is NOT guaranteed — the save-set moves XLA fusion
+    boundaries, which may reassociate reductions)."""
+    import dataclasses
+
+    rng = np.random.default_rng(7)
+    toks = jnp.asarray(rng.integers(0, CFG.vocab_size, (2, 16)), jnp.int32)
+    tgts = jnp.roll(toks, -1, axis=1)
+
+    def loss_and_grads(cfg):
+        f = lambda p: cross_entropy_loss(llama.apply(p, cfg, toks), tgts)
+        return jax.value_and_grad(f)(params)
+
+    base = dataclasses.replace(CFG, remat=True)
+    ref_l, ref_g = loss_and_grads(base)
+    assert list(llama._REMAT_POLICIES) == ["full", "mlp", "dots"]
+    for policy in ("mlp", "dots"):
+        l, g = loss_and_grads(
+            dataclasses.replace(base, remat_policy=policy))
+        np.testing.assert_allclose(float(l), float(ref_l), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(ref_g), jax.tree.leaves(g)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
 def test_cross_entropy_masked():
     logits = jnp.zeros((1, 4, 10))
     targets = jnp.zeros((1, 4), jnp.int32)
