@@ -75,7 +75,7 @@ def release_workflow() -> dict:
                     {"uses": "actions/checkout@v4"},
                     {"uses": "actions/setup-python@v5",
                      "with": {"python-version": "3.11"}},
-                    {"run": "pip install -e . pytest"},
+                    {"run": "pip install -e .[ci] pytest"},
                     {"name": "full suite",
                      "run": "python -m pytest tests/ -q",
                      "env": {

@@ -142,7 +142,7 @@ def unit_test_workflow(component: str) -> dict:
                     {"uses": "actions/checkout@v4"},
                     {"uses": "actions/setup-python@v5",
                      "with": {"python-version": "3.11"}},
-                    {"run": "pip install -e . pytest"},
+                    {"run": "pip install -e .[ci] pytest"},
                     {"name": "run tests",
                      "run": spec["tests"],
                      "env": {
@@ -174,6 +174,29 @@ def image_build_workflow(image: str) -> dict:
     }
 
 
+def e2e_workflow() -> dict:
+    """Out-of-process lifecycle suite (ref odh `make e2e-test` +
+    run-e2e-test.sh driving e2e/notebook_*_test.go phases)."""
+    return {
+        "name": "platform e2e",
+        "on": {"pull_request": {}, "push": {"branches": ["main"]}},
+        "jobs": {
+            "e2e": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e .[ci]"},
+                    {"name": "real-process platform lifecycle",
+                     "run": "python e2e/run_e2e.py",
+                     "env": {"JAX_PLATFORMS": "cpu"}},
+                ],
+            }
+        },
+    }
+
+
 def dryrun_workflow() -> dict:
     """The multichip compile gate: dryrun_multichip on a virtual mesh."""
     return {
@@ -186,7 +209,7 @@ def dryrun_workflow() -> dict:
                     {"uses": "actions/checkout@v4"},
                     {"uses": "actions/setup-python@v5",
                      "with": {"python-version": "3.11"}},
-                    {"run": "pip install -e ."},
+                    {"run": "pip install -e .[ci]"},
                     {"name": "8-device virtual mesh dryrun",
                      "run": ("python -c 'import __graft_entry__ as g; "
                              "g.dryrun_multichip(8)'"),
@@ -237,6 +260,7 @@ def all_workflows() -> dict[str, dict]:
     for img in IMAGES:
         out[f"{img}_image_build.yaml"] = image_build_workflow(img)
     out["multichip_dryrun.yaml"] = dryrun_workflow()
+    out["platform_e2e.yaml"] = e2e_workflow()
     out["frontend_test.yaml"] = frontend_workflow()
     out.update(cd.all_workflows())
     return out

@@ -63,6 +63,12 @@ class LlamaConfig:
     # the logit tensor, which is what makes "mlp"/"dots" fit on one chip.
     remat_policy: str = "full"
 
+    def __post_init__(self):
+        if self.remat_policy not in _REMAT_POLICIES:
+            raise ValueError(
+                f"remat_policy {self.remat_policy!r} unknown "
+                f"(choose from {sorted(_REMAT_POLICIES)})")
+
     @property
     def q_dim(self) -> int:
         return self.num_heads * self.head_dim

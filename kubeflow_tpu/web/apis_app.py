@@ -23,8 +23,35 @@ from kubeflow_tpu.api import versioning
 from kubeflow_tpu.controlplane.store import Store
 from kubeflow_tpu.web.common import base_app, ensure_authorized
 
-# kind <-> URL plural segment for the kinds this API serves
-PLURALS = {"notebooks": "Notebook"}
+# kind <-> URL plural segment for the kinds this API serves. CRs plus
+# the owned workload kinds an operator inspects with kubectl (the
+# reference's L0 serves these natively; e2e and conformance read them
+# through this door instead of reaching into the store).
+PLURALS = {
+    "notebooks": "Notebook",
+    "tensorboards": "Tensorboard",
+    "experiments": "Experiment",
+    "trials": "Trial",
+    "pods": "Pod",
+    "statefulsets": "StatefulSet",
+    "services": "Service",
+    "events": "Event",
+    "persistentvolumeclaims": "PersistentVolumeClaim",
+}
+# Controller-owned kinds are served READ-ONLY: their lifecycle belongs
+# to reconcilers (ownership + cascade), and authz checks verbs, not
+# kinds — without this gate any namespace editor could delete a live
+# gang pod or a workspace PVC out from under its controller.
+READONLY_KINDS = frozenset(
+    {"Pod", "StatefulSet", "Service", "Event", "PersistentVolumeClaim"})
+
+
+def _require_mutable(kind: str) -> None:
+    if kind in READONLY_KINDS:
+        raise web.HTTPMethodNotAllowed(
+            "POST/DELETE", ["GET"],
+            text=f"{kind} is read-only through /apis/ — it is owned by a "
+                 "controller; mutate the owning custom resource instead")
 
 # Mutations require this custom header. Browsers will not attach custom
 # headers to cross-site requests without a CORS preflight (which we
@@ -91,6 +118,7 @@ async def get_resource(request: web.Request) -> web.Response:
 async def create_resource(request: web.Request) -> web.Response:
     store: Store = request.app["store"]
     kind = _kind(request)
+    _require_mutable(kind)
     version = _version(request, kind)
     ns = request.match_info["ns"]
     _require_api_client(request)
@@ -114,6 +142,7 @@ async def create_resource(request: web.Request) -> web.Response:
 async def delete_resource(request: web.Request) -> web.Response:
     store: Store = request.app["store"]
     kind = _kind(request)
+    _require_mutable(kind)
     _version(request, kind)
     ns, name = request.match_info["ns"], request.match_info["name"]
     _require_api_client(request)

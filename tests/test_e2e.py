@@ -1,0 +1,28 @@
+"""Runs the out-of-process e2e suite (e2e/run_e2e.py) under pytest so
+`pytest tests/` exercises the real server binary too — the hermetic
+analog of the reference wiring `make e2e-test` into CI (odh
+Makefile:172). The suite spawns its own server subprocess; this wrapper
+only asserts the phase report."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_e2e_suite_passes():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "e2e", "run_e2e.py")],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["ok"] is True
+    phases = {p["phase"]: p["status"] for p in report["phases"]}
+    # the three reference phases (creation/update/deletion) plus ours
+    for must in ("profile-creation", "notebook-creation",
+                 "gang-env-injection", "notebook-stop-restart",
+                 "notebook-deletion", "profile-deletion"):
+        assert phases.get(must) == "pass", phases

@@ -138,3 +138,41 @@ async def test_versioned_rest_api_end_to_end(loop):
     finally:
         await client.close()
         cluster.stop()
+
+
+async def test_owned_workload_kinds_read_only(loop):
+    """Pods/STS/Services/PVCs/Events are kubectl-visible through /apis/
+    but controller-owned: GET works, POST/DELETE are 405 even with the
+    API-client header (apis_app READONLY_KINDS)."""
+    cluster = Cluster(ClusterConfig(
+        tpu_slices={"v5e-16": 1},
+        cluster_admins={"alice@example.com"})).start()
+    app = cluster.create_web_app(csrf=False)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        base = "/apis/kubeflow-tpu.dev/v1/namespaces/user1"
+        r = await client.post(
+            "/apis/kubeflow-tpu.dev/v1alpha1/namespaces/user1/notebooks",
+            json=_v1alpha1_notebook(), headers=API_CLIENT)
+        assert r.status == 201, await r.text()
+        assert cluster.wait_idle()
+
+        r = await client.get(f"{base}/pods", headers=USER)
+        pods = (await r.json())["items"]
+        assert len(pods) == 4  # the reconciled v5e-16 gang is visible
+        victim = pods[0]["metadata"]["name"]
+
+        r = await client.delete(f"{base}/pods/{victim}", headers=API_CLIENT)
+        assert r.status == 405, await r.text()
+        assert cluster.store.try_get("Pod", "user1", victim) is not None
+
+        r = await client.post(f"{base}/events",
+                              json={"kind": "Event"}, headers=API_CLIENT)
+        assert r.status == 405, await r.text()
+
+        r = await client.get(f"{base}/statefulsets/old", headers=USER)
+        assert (await r.json())["spec"]["replicas"] == 4
+    finally:
+        await client.close()
+        cluster.stop()
