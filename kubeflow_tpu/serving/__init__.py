@@ -15,6 +15,8 @@ from kubeflow_tpu.serving.engine import (
     DecodeState,
     EngineConfig,
     InferenceEngine,
+    SamplingParams,
+    filter_logits,
     GEMMA_FAMILY,
     LLAMA_FAMILY,
 )

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Any, Callable
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -51,9 +51,51 @@ GEMMA_FAMILY = Family(
 class EngineConfig:
     max_len: int = 1024        # cache bucket; one compile per value
     temperature: float = 0.0   # 0 = greedy
+    top_k: int = 0             # keep k highest-logit tokens; 0 = off
+    top_p: float = 1.0         # nucleus: smallest set w/ cum prob >= p
     # When set, sequences that emit EOS keep emitting EOS for the rest of
     # the (fixed-length) scan, so callers can trim on first EOS.
     eos_token: int | None = None
+
+
+class SamplingParams(NamedTuple):
+    """Per-request sampling knobs as TRACED scalars: requests with
+    different temperature/top_k/top_p reuse one compiled decode scan
+    (static shapes, dynamic values — recompiling a 30s scan per slider
+    move would be the wrong TPU trade)."""
+
+    temperature: jnp.ndarray   # [] f32; <= 0 means greedy
+    top_k: jnp.ndarray         # [] i32; 0 disables
+    top_p: jnp.ndarray         # [] f32; >= 1 disables
+
+
+def filter_logits(logits: jnp.ndarray, top_k: jnp.ndarray,
+                  top_p: jnp.ndarray) -> jnp.ndarray:
+    """Mask logits outside the top-k set and the top-p nucleus to -inf.
+
+    Both knobs are dynamic. HF-style order: the caller temperature-
+    scales first, then k, then p (computed on the softmax of what
+    remains representable — scaling changes the nucleus, as it should).
+    """
+    vocab = logits.shape[-1]
+    # Decide in the sorted domain, scatter the mask back through the
+    # inverse permutation. (Comparing original-domain probs against a
+    # sorted-domain cutoff would be ulp-fragile: softmax sums in a
+    # different order on each side, and one ulp can empty the nucleus.)
+    order = jnp.argsort(-logits, axis=-1)           # descending
+    desc = jnp.take_along_axis(logits, order, axis=-1)
+    idx = jnp.arange(vocab)
+    # top-k: the first k sorted positions. k=0 -> keep all.
+    keep_desc = jnp.where(top_k > 0, idx < top_k, True)
+    # top-p: the smallest prefix of descending probs whose mass reaches
+    # p (the first token always survives; p>=1 keeps all).
+    probs_desc = jax.nn.softmax(desc, axis=-1)
+    before = jnp.cumsum(probs_desc, axis=-1) - probs_desc
+    keep_desc &= before < top_p
+    inv = jnp.argsort(order, axis=-1)
+    keep = jnp.take_along_axis(
+        jnp.broadcast_to(keep_desc, logits.shape), inv, axis=-1)
+    return jnp.where(keep, logits, -jnp.inf)
 
 
 class DecodeState:
@@ -170,17 +212,28 @@ class InferenceEngine:
             jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype),
             jnp.zeros((), jnp.int32))
 
-    def _sample(self, logits, rng):
-        if self.ec.temperature <= 0.0:
+    def _sample(self, logits, rng, sp: SamplingParams):
+        # lax.cond, not jnp.where: greedy decode must not pay the
+        # sampled branch's full-vocab argsorts/cumsum/categorical per
+        # step (256k vocab on Gemma) just to discard the result.
+        def greedy(_):
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            rng, logits / self.ec.temperature, axis=-1).astype(jnp.int32)
 
-    def _generate(self, prompt, state, rng, *, max_new: int):
+        def sampled(_):
+            scaled = logits.astype(jnp.float32) / jnp.maximum(
+                sp.temperature, 1e-6)
+            filtered = filter_logits(scaled, sp.top_k, sp.top_p)
+            return jax.random.categorical(
+                rng, filtered, axis=-1).astype(jnp.int32)
+
+        return jax.lax.cond(sp.temperature > 0.0, sampled, greedy, None)
+
+    def _generate(self, prompt, state, rng, sp: SamplingParams, *,
+                  max_new: int):
         eos = self.ec.eos_token
         rng, sub = jax.random.split(rng)  # use-once key discipline
         logits, state = self._forward_cached(prompt, state)
-        first = self._sample(logits, sub)
+        first = self._sample(logits, sub, sp)
         done0 = (first == eos) if eos is not None else jnp.zeros(
             first.shape, bool)
 
@@ -188,7 +241,7 @@ class InferenceEngine:
             state, tok, rng, done = carry
             rng, sub = jax.random.split(rng)
             logits, state = self._forward_cached(tok[:, None], state)
-            nxt = self._sample(logits, sub)
+            nxt = self._sample(logits, sub, sp)
             if eos is not None:
                 # Sequences past EOS emit EOS forever (static shapes —
                 # the scan always runs max_new steps; callers trim).
@@ -208,23 +261,44 @@ class InferenceEngine:
         *,
         max_new: int = 32,
         rng: jax.Array | None = None,
+        temperature: float | None = None,
+        top_k: int | None = None,
+        top_p: float | None = None,
     ) -> jnp.ndarray:
         """Generate `max_new` tokens after the prompt. Returns [b, max_new]
-        (post-hoc EOS trimming is the caller's job — shapes stay static)."""
+        (post-hoc EOS trimming is the caller's job — shapes stay static).
+
+        temperature/top_k/top_p default from EngineConfig; per-call
+        overrides are dynamic (no recompile across values)."""
         b, s = prompt_tokens.shape
         if s + max_new > self.ec.max_len:
             raise ValueError(
                 f"prompt {s} + max_new {max_new} exceeds cache bucket "
                 f"{self.ec.max_len}")
+        temperature = (self.ec.temperature if temperature is None
+                       else temperature)
+        top_k = self.ec.top_k if top_k is None else top_k
+        top_p = self.ec.top_p if top_p is None else top_p
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        sp = SamplingParams(
+            temperature=jnp.asarray(temperature, jnp.float32),
+            top_k=jnp.asarray(top_k, jnp.int32),
+            top_p=jnp.asarray(top_p, jnp.float32),
+        )
         if rng is None:
-            if self.ec.temperature > 0.0:
+            if temperature > 0.0:
                 # Fresh entropy per request — a constant default key would
                 # make every "sampled" completion identical.
                 rng = jax.random.key(
                     int.from_bytes(os.urandom(4), "little"))
             else:
-                rng = jax.random.key(0)  # greedy: key is never consumed
+                # greedy: the cond's sampled branch never runs, so the
+                # constant key is never drawn from at runtime
+                rng = jax.random.key(0)
         state = self.init_state(b)
         toks, _ = self._generate_jit(
-            prompt_tokens, state, rng, max_new=max_new)
+            prompt_tokens, state, rng, sp, max_new=max_new)
         return toks

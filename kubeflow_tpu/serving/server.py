@@ -19,6 +19,7 @@ pass token IDs from their own tokenizer.
 from __future__ import annotations
 
 import asyncio
+import math
 from typing import Any
 
 import jax.numpy as jnp
@@ -111,6 +112,35 @@ async def generate(request: web.Request):
             or max_new < 1:
         return web.json_response(
             {"error": "max_new must be a positive integer"}, status=400)
+
+    # Per-request sampling (dynamic in the compiled scan — no recompile).
+    sampling: dict[str, Any] = {}
+    temperature = body.get("temperature")
+    if temperature is not None:
+        # isfinite also rejects NaN/Infinity, which json.loads accepts
+        # and which would otherwise pass a `< 0` check silently.
+        if not isinstance(temperature, (int, float)) \
+                or isinstance(temperature, bool) \
+                or not math.isfinite(temperature) or temperature < 0:
+            return web.json_response(
+                {"error": "temperature must be a finite number >= 0"},
+                status=400)
+        sampling["temperature"] = float(temperature)
+    top_k = body.get("top_k")
+    if top_k is not None:
+        if not isinstance(top_k, int) or isinstance(top_k, bool) \
+                or top_k < 0 or top_k >= 2**31:
+            return web.json_response(
+                {"error": "top_k must be an integer in [0, 2**31)"},
+                status=400)
+        sampling["top_k"] = top_k
+    top_p = body.get("top_p")
+    if top_p is not None:
+        if not isinstance(top_p, (int, float)) \
+                or isinstance(top_p, bool) or not 0.0 < top_p <= 1.0:
+            return web.json_response(
+                {"error": "top_p must be in (0, 1]"}, status=400)
+        sampling["top_p"] = float(top_p)
     lens = {len(t) for t in token_lists}
     if len(lens) != 1:
         return web.json_response(
@@ -135,7 +165,8 @@ async def generate(request: web.Request):
         toks = await asyncio.get_event_loop().run_in_executor(
             None,
             lambda: np.asarray(
-                engine.generate(jnp.asarray(arr), max_new=max_new)),
+                engine.generate(jnp.asarray(arr), max_new=max_new,
+                                **sampling)),
         )
     resp: dict[str, Any] = {"tokens": toks.tolist()}
     if text_mode:
