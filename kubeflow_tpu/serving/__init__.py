@@ -20,3 +20,4 @@ from kubeflow_tpu.serving.engine import (
     GEMMA_FAMILY,
     LLAMA_FAMILY,
 )
+from kubeflow_tpu.serving.speculative import SpecStats, SpeculativeEngine

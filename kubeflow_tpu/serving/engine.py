@@ -88,8 +88,11 @@ def filter_logits(logits: jnp.ndarray, top_k: jnp.ndarray,
     # top-k: the first k sorted positions. k=0 -> keep all.
     keep_desc = jnp.where(top_k > 0, idx < top_k, True)
     # top-p: the smallest prefix of descending probs whose mass reaches
-    # p (the first token always survives; p>=1 keeps all).
-    probs_desc = jax.nn.softmax(desc, axis=-1)
+    # p, over the distribution REMAINING after top-k (HF sequential
+    # semantics: k filters, renormalize, then the nucleus) — the first
+    # surviving token always stays; p>=1 keeps all.
+    probs_desc = jnp.where(keep_desc, jax.nn.softmax(desc, axis=-1), 0.0)
+    probs_desc = probs_desc / jnp.sum(probs_desc, axis=-1, keepdims=True)
     before = jnp.cumsum(probs_desc, axis=-1) - probs_desc
     keep_desc &= before < top_p
     inv = jnp.argsort(order, axis=-1)
@@ -154,9 +157,12 @@ class InferenceEngine:
         head = params["embed"].T if tied else params["lm_head"]
         return x.astype(jnp.float32) @ head.astype(jnp.float32)
 
-    def _forward_cached(self, tokens, state: DecodeState):
+    def _forward_cached(self, tokens, state: DecodeState, *,
+                        return_all: bool = False):
         """Run [b, s] tokens starting at state.length; returns
-        (last-position logits [b, vocab], updated state)."""
+        (last-position logits [b, vocab], updated state) — or all
+        positions' logits [b, s, vocab] with return_all (speculative
+        decoding scores every drafted position in one pass)."""
         cfg, fam, params = self.cfg, self.family, self.params
         b, s = tokens.shape
         start = state.length
@@ -199,7 +205,7 @@ class InferenceEngine:
         x, (k_new, v_new) = jax.lax.scan(
             layer, x, (params["blocks"], state.k, state.v))
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-        logits = self._head(x[:, -1])
+        logits = self._head(x if return_all else x[:, -1])
         return logits, DecodeState(k_new, v_new, start + s)
 
     # -- public API --------------------------------------------------------
@@ -222,11 +228,48 @@ class InferenceEngine:
         def sampled(_):
             scaled = logits.astype(jnp.float32) / jnp.maximum(
                 sp.temperature, 1e-6)
-            filtered = filter_logits(scaled, sp.top_k, sp.top_p)
+            # Same reasoning one level down: temperature-only sampling
+            # must not pay the filter's argsorts for an all-True mask.
+            filtered = jax.lax.cond(
+                (sp.top_k > 0) | (sp.top_p < 1.0),
+                lambda s: filter_logits(s, sp.top_k, sp.top_p),
+                lambda s: s, scaled)
             return jax.random.categorical(
                 rng, filtered, axis=-1).astype(jnp.int32)
 
         return jax.lax.cond(sp.temperature > 0.0, sampled, greedy, None)
+
+    def _resolve_sampling(
+        self, temperature: float | None, top_k: int | None,
+        top_p: float | None, rng: jax.Array | None,
+    ) -> tuple[SamplingParams, jax.Array]:
+        """EngineConfig defaulting + validation + default-rng policy,
+        shared with SpeculativeEngine so the two paths cannot drift."""
+        temperature = (self.ec.temperature if temperature is None
+                       else temperature)
+        top_k = self.ec.top_k if top_k is None else top_k
+        top_p = self.ec.top_p if top_p is None else top_p
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        sp = SamplingParams(
+            temperature=jnp.asarray(temperature, jnp.float32),
+            top_k=jnp.asarray(top_k, jnp.int32),
+            top_p=jnp.asarray(top_p, jnp.float32),
+        )
+        if rng is None:
+            if temperature > 0.0:
+                # Fresh entropy per request — a constant default key
+                # would make every "sampled" completion identical; 64
+                # seed bits keep birthday collisions out of reach.
+                rng = jax.random.key(
+                    int.from_bytes(os.urandom(8), "little"))
+            else:
+                # greedy: the cond's sampled branch never runs, so the
+                # constant key is never drawn from at runtime
+                rng = jax.random.key(0)
+        return sp, rng
 
     def _generate(self, prompt, state, rng, sp: SamplingParams, *,
                   max_new: int):
@@ -275,29 +318,7 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt {s} + max_new {max_new} exceeds cache bucket "
                 f"{self.ec.max_len}")
-        temperature = (self.ec.temperature if temperature is None
-                       else temperature)
-        top_k = self.ec.top_k if top_k is None else top_k
-        top_p = self.ec.top_p if top_p is None else top_p
-        if top_k < 0:
-            raise ValueError(f"top_k must be >= 0, got {top_k}")
-        if not 0.0 < top_p <= 1.0:
-            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-        sp = SamplingParams(
-            temperature=jnp.asarray(temperature, jnp.float32),
-            top_k=jnp.asarray(top_k, jnp.int32),
-            top_p=jnp.asarray(top_p, jnp.float32),
-        )
-        if rng is None:
-            if temperature > 0.0:
-                # Fresh entropy per request — a constant default key would
-                # make every "sampled" completion identical.
-                rng = jax.random.key(
-                    int.from_bytes(os.urandom(4), "little"))
-            else:
-                # greedy: the cond's sampled branch never runs, so the
-                # constant key is never drawn from at runtime
-                rng = jax.random.key(0)
+        sp, rng = self._resolve_sampling(temperature, top_k, top_p, rng)
         state = self.init_state(b)
         toks, _ = self._generate_jit(
             prompt_tokens, state, rng, sp, max_new=max_new)

@@ -1,0 +1,243 @@
+"""Speculative decoding: a small draft model proposes, the target
+verifies — decode latency drops without changing the output law.
+
+Decode is HBM-bandwidth-bound: every step reads all params to emit ONE
+token. Speculative decoding (Leviathan et al. 2023; Chen et al. 2023)
+lets a cheap draft model propose `gamma` tokens autoregressively, then
+the target scores all `gamma+1` positions in ONE forward pass (same
+param read as a single decode step — that is the whole trick on TPU:
+the verify pass rides the MXU at sequence length gamma+1 instead of 1).
+The accept/reject rule preserves the target's sampling distribution
+EXACTLY — accepted-token prefixes are distributed as if the target had
+sampled alone; greedy in = greedy out.
+
+TPU shape discipline: everything is static — the propose/verify loop is
+a `lax.while_loop` with a fixed-capacity output buffer, the draft scan
+always runs `gamma` steps, the verify pass always scores `gamma+1`
+positions, and partial acceptance "rolls back" by moving the KV-cache
+cursor (slots past `length` are masked by kv_valid and overwritten by
+the next write — no copies).
+
+The reference has no serving at all (SURVEY.md §2b; docs_dev/
+tf_serving.md describes the removed TF-Serving proxy); this layers on
+engine.py's KV-cache scan.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.serving.engine import (
+    DecodeState,
+    InferenceEngine,
+    SamplingParams,
+    filter_logits,
+)
+
+
+class SpecStats(NamedTuple):
+    emitted: jnp.ndarray    # [] i32 — tokens produced (>= max_new)
+    accepted: jnp.ndarray   # [] i32 — drafted tokens accepted
+    proposed: jnp.ndarray   # [] i32 — drafted tokens proposed
+
+    @property
+    def acceptance_rate(self) -> float:
+        return float(self.accepted) / max(float(self.proposed), 1.0)
+
+
+def _dist(logits: jnp.ndarray, sp: SamplingParams) -> jnp.ndarray:
+    """[..., vocab] logits -> the sampling distribution under sp.
+
+    Greedy is the temperature->0 limit: a one-hot on the argmax. Using
+    distributions (not samples) everywhere lets one accept/reject code
+    path serve greedy and sampled decoding — for one-hots the ratio
+    test degenerates to exact token match, which is greedy equivalence.
+    """
+    vocab = logits.shape[-1]
+
+    def greedy(_):
+        return jax.nn.one_hot(
+            jnp.argmax(logits, axis=-1), vocab, dtype=jnp.float32)
+
+    def sampled(_):
+        scaled = logits.astype(jnp.float32) / jnp.maximum(
+            sp.temperature, 1e-6)
+        filtered = jax.lax.cond(
+            (sp.top_k > 0) | (sp.top_p < 1.0),
+            lambda s: filter_logits(s, sp.top_k, sp.top_p),
+            lambda s: s, scaled)
+        return jax.nn.softmax(filtered, axis=-1)
+
+    return jax.lax.cond(sp.temperature > 0.0, sampled, greedy, None)
+
+
+def _draw(rng: jax.Array, probs: jnp.ndarray) -> jnp.ndarray:
+    """Sample [...]-shaped tokens from [..., vocab] probabilities.
+    log(0) = -inf slots are unsampleable; one-hots draw deterministically."""
+    return jax.random.categorical(
+        rng, jnp.log(probs), axis=-1).astype(jnp.int32)
+
+
+class SpeculativeEngine:
+    """Wraps a (target, draft) engine pair. Batch 1 only: acceptance
+    counts diverge across sequences, and per-sequence cache cursors
+    would destroy the single-scalar `length` invariant — speculative
+    decoding is a latency tool, and latency means small batch."""
+
+    def __init__(self, target: InferenceEngine, draft: InferenceEngine):
+        if target.cfg.vocab_size != draft.cfg.vocab_size:
+            raise ValueError(
+                f"target vocab {target.cfg.vocab_size} != draft vocab "
+                f"{draft.cfg.vocab_size}")
+        self.target = target
+        self.draft = draft
+        self._jit = jax.jit(
+            self._speculate, static_argnames=("max_new", "gamma"))
+
+    def generate(
+        self,
+        prompt_tokens: jnp.ndarray,   # [1, s] int32
+        *,
+        max_new: int = 32,
+        gamma: int = 4,
+        rng: jax.Array | None = None,
+        temperature: float | None = None,
+        top_k: int | None = None,
+        top_p: float | None = None,
+    ) -> tuple[jnp.ndarray, SpecStats]:
+        """Returns ([1, max_new] tokens, SpecStats). Output follows the
+        target's sampling law for the given knobs (EngineConfig of the
+        TARGET supplies defaults; EOS early-exit is not special-cased —
+        trim client-side as with InferenceEngine.generate)."""
+        b, s = prompt_tokens.shape
+        if b != 1:
+            raise ValueError(f"speculative decoding is batch-1 (got {b})")
+        if gamma < 1:
+            raise ValueError(f"gamma must be >= 1, got {gamma}")
+        # Worst case one verify window extends gamma+1 past the current
+        # cursor, and the cursor can reach s + max_new - 1.
+        need = s + max_new + gamma
+        for name, eng in (("target", self.target), ("draft", self.draft)):
+            if need > eng.ec.max_len:
+                raise ValueError(
+                    f"prompt {s} + max_new {max_new} + gamma {gamma} "
+                    f"exceeds {name} cache bucket {eng.ec.max_len}")
+        # TARGET EngineConfig supplies defaults; shared resolver keeps
+        # validation/seeding policy identical to InferenceEngine.generate.
+        sp, rng = self.target._resolve_sampling(
+            temperature, top_k, top_p, rng)
+        out, stats = self._jit(
+            prompt_tokens, self.target.init_state(1),
+            self.draft.init_state(1), rng, sp,
+            max_new=max_new, gamma=gamma)
+        return out, SpecStats(*[x for x in stats])
+
+    # -- the jitted propose/verify loop -----------------------------------
+
+    def _speculate(self, prompt, tstate, dstate, rng, sp: SamplingParams,
+                   *, max_new: int, gamma: int):
+        target, draft = self.target, self.draft
+        cap = max_new + gamma  # worst case the last round overshoots
+
+        # Prefill both caches; the target samples the first token.
+        tlogits, tstate = target._forward_cached(prompt, tstate)
+        rng, sub = jax.random.split(rng)
+        first = _draw(sub, _dist(tlogits, sp))          # [1]
+        _, dstate = draft._forward_cached(prompt, dstate)
+
+        out = jnp.zeros((1, cap), jnp.int32)
+        out = jax.lax.dynamic_update_slice(out, first[:, None], (0, 0))
+
+        def cond(carry):
+            return carry[3] < max_new
+
+        def body(carry):
+            tstate, dstate, out, n, last, rng, acc, prop = carry
+
+            # Propose: gamma draft steps from the last emitted token.
+            def dstep(c, _):
+                dstate, tok, rng = c
+                logits, dstate = draft._forward_cached(tok[:, None], dstate)
+                q = _dist(logits, sp)                   # [1, vocab]
+                rng, sub = jax.random.split(rng)
+                d = _draw(sub, q)                       # [1]
+                return (dstate, d, rng), (d[0], q[0])
+
+            (dstate, _, rng), (drafted, qs) = jax.lax.scan(
+                dstep, (dstate, last, rng), None, length=gamma)
+            # drafted: [gamma] i32; qs: [gamma, vocab] f32
+
+            # Verify: one target pass over [last, d_1..d_gamma] scores
+            # every drafted position plus the bonus position.
+            tin = jnp.concatenate([last, drafted], axis=0)[None, :]
+            all_logits, tstate = target._forward_cached(
+                tin, tstate, return_all=True)           # [1, gamma+1, V]
+            ps = _dist(all_logits[0], sp)               # [gamma+1, vocab]
+
+            # Accept d_i with prob min(1, p_{i-1}(d_i) / q_{i-1}(d_i));
+            # k = length of the accepted prefix.
+            rng, sub = jax.random.split(rng)
+            us = jax.random.uniform(sub, (gamma,))
+            p_d = jnp.take_along_axis(
+                ps[:gamma], drafted[:, None], axis=-1)[:, 0]
+            q_d = jnp.take_along_axis(qs, drafted[:, None], axis=-1)[:, 0]
+            accept = us * q_d < p_d   # u < p/q without the 0/0 hazard
+            k = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))
+
+            # One extra token always lands: the bonus draw from the
+            # position after a fully-accepted window, or the residual
+            # max(p-q, 0) resample at the first rejection.
+            rng, sub = jax.random.split(rng)
+
+            def bonus(_):
+                return _draw(sub, ps[gamma][None, :])
+
+            def resample(_):
+                pk = jax.lax.dynamic_index_in_dim(ps, k, keepdims=False)
+                qk = jax.lax.dynamic_index_in_dim(qs, jnp.minimum(
+                    k, gamma - 1), keepdims=False)
+                diff = jnp.clip(pk - qk, 0.0, None)
+                # all-zero residual (p==q to rounding): fall back to p
+                safe = jnp.where(diff.sum() > 0, diff, pk)
+                return _draw(sub, safe[None, :])
+
+            extra = jax.lax.cond(k == gamma, bonus, resample, None)  # [1]
+
+            # Emit d_1..d_k then extra — a fixed-width window write;
+            # positions past the cursor get overwritten next round.
+            emit = jnp.append(drafted, 0).at[k].set(extra[0])
+            out = jax.lax.dynamic_update_slice(out, emit[None, :], (0, n))
+
+            # Roll back caches to the accepted prefix: the verify pass
+            # wrote gamma+1 target slots (1+k valid), the draft wrote
+            # gamma slots (min(1+k, gamma) valid).
+            tstate = DecodeState(
+                tstate.k, tstate.v, tstate.length - gamma + k)
+            dstate = DecodeState(
+                dstate.k, dstate.v,
+                dstate.length - gamma + jnp.minimum(1 + k, gamma))
+            # Full-window acceptance leaves the draft one token behind:
+            # the scan fed [last, d_1..d_{gamma-1}], so d_gamma was never
+            # processed and the next round's proposals would condition on
+            # a prefix with a hole — collapsing acceptance from round 2
+            # on. Feed it unconditionally (static shapes); when k < gamma
+            # the write lands past the rolled-back cursor, stays invalid,
+            # and is overwritten by the next round's first write.
+            _, dfed = draft._forward_cached(
+                drafted[gamma - 1][None, None], dstate)
+            dstate = DecodeState(
+                dfed.k, dfed.v,
+                jnp.where(k == gamma, dfed.length, dstate.length))
+
+            return (tstate, dstate, out, n + k + 1, extra, rng,
+                    acc + k, prop + jnp.asarray(gamma, jnp.int32))
+
+        zero = jnp.zeros((), jnp.int32)
+        (_, _, out, n, _, _, acc, prop) = jax.lax.while_loop(
+            cond, body,
+            (tstate, dstate, out, jnp.ones((), jnp.int32), first, rng,
+             zero, zero))
+        return out[:, :max_new], (n, acc, prop)

@@ -82,6 +82,17 @@ def test_filter_logits_top_p():
         assert list(np.isfinite(np.asarray(out))[0]) == want, p
 
 
+def test_filter_logits_top_p_renormalizes_after_top_k():
+    """HF sequential semantics: k filters, RENORMALIZE, then nucleus.
+    probs [0.4, 0.3, 0.3] with top_k=2 renormalize to [0.571, 0.429];
+    top_p=0.5 must keep only the first token (raw-mass semantics would
+    wrongly keep both: 0.4 < 0.5)."""
+    from kubeflow_tpu.serving import filter_logits
+    logits = jnp.log(jnp.asarray([[0.4, 0.3, 0.3]]))
+    out = filter_logits(logits, jnp.asarray(2), jnp.asarray(0.5))
+    assert list(np.isfinite(np.asarray(out))[0]) == [True, False, False]
+
+
 def test_sampling_params_are_dynamic_and_respected(llama_engine):
     """top_k=1 / tiny top_p must reproduce greedy exactly, sampled runs
     stay inside the allowed set, and sweeping the knobs must NOT

@@ -1,0 +1,126 @@
+"""Speculative decoding: exactness vs the target-only path, cache
+rollback integrity, acceptance stats, and sampled-support correctness."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.serving import EngineConfig, InferenceEngine, LLAMA_FAMILY
+from kubeflow_tpu.serving.speculative import SpeculativeEngine
+
+TCFG = llama.LLAMA_TINY
+# A weaker draft: same vocab, shallower/narrower, different init.
+DCFG = dataclasses.replace(
+    llama.LLAMA_TINY, num_layers=1, hidden_size=64, intermediate_size=192,
+    num_heads=2, num_kv_heads=1)
+
+
+@pytest.fixture(scope="module")
+def engines():
+    target = InferenceEngine(
+        llama.init(jax.random.key(0), TCFG), TCFG, LLAMA_FAMILY,
+        EngineConfig(max_len=96))
+    draft = InferenceEngine(
+        llama.init(jax.random.key(99), DCFG), DCFG, LLAMA_FAMILY,
+        EngineConfig(max_len=96))
+    return target, draft
+
+
+def _prompt(seed=0, s=8):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, TCFG.vocab_size, (1, s)),
+        jnp.int32)
+
+
+def test_greedy_specdecode_equals_target_only(engines):
+    """The whole point: with temperature 0 the speculative output must
+    be BITWISE the target-only greedy decode, whatever the draft says —
+    across a gamma sweep (different accept/rollback patterns)."""
+    target, draft = engines
+    spec = SpeculativeEngine(target, draft)
+    prompt = _prompt()
+    want = np.asarray(target.generate(prompt, max_new=24))
+    for gamma in (1, 2, 4, 7):
+        got, stats = spec.generate(prompt, max_new=24, gamma=gamma)
+        np.testing.assert_array_equal(np.asarray(got), want), gamma
+        assert int(stats.emitted) >= 24
+        assert int(stats.proposed) > 0
+        assert 0 <= int(stats.accepted) <= int(stats.proposed)
+
+
+def test_confident_draft_equals_target_accepts_everything():
+    """p == q makes the ratio test accept with probability 1. A caveat
+    discovered here: the draft decodes one token per forward while the
+    verifier scores gamma+1 per forward, so identical WEIGHTS still
+    produce ulp-different logits (different matmul shapes) — on a
+    random-init model whose logits are near-tied that flips argmaxes
+    and rejects constantly (outputs stay exact; the greedy-sweep test
+    covers that). A model with separated logits — i.e. any trained
+    model — accepts everything, which is what this pins: lm_head is
+    biased so one token dominates by ~10 logits."""
+    params = dict(llama.init(jax.random.key(0), TCFG))
+    params["lm_head"] = params["lm_head"] * 50.0  # widen logit gaps
+    confident = InferenceEngine(params, TCFG, LLAMA_FAMILY,
+                                EngineConfig(max_len=96))
+    spec = SpeculativeEngine(confident, confident)
+    prompt = _prompt(3)
+    want = np.asarray(confident.generate(prompt, max_new=16))
+    got, stats = spec.generate(prompt, max_new=16, gamma=4)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats.acceptance_rate == 1.0, stats
+    _, stats = spec.generate(prompt, max_new=16, gamma=4,
+                             temperature=0.7, rng=jax.random.key(5))
+    assert stats.acceptance_rate > 0.9, stats
+
+
+def test_sampled_specdecode_stays_in_target_support(engines):
+    """With top_k=3 every emitted token must lie in the target's top-3
+    for its position (dense-forward oracle replay) — rejection sampling
+    can never emit outside the target's filtered support."""
+    target, draft = engines
+    spec = SpeculativeEngine(target, draft)
+    prompt = _prompt(7)
+    got, _ = spec.generate(prompt, max_new=12, gamma=3,
+                           temperature=1.0, top_k=3,
+                           rng=jax.random.key(11))
+    drawn = np.asarray(got)
+    params, cfg = target.params, target.cfg
+    seq = np.concatenate([np.asarray(prompt), drawn], axis=1)
+    for step in range(drawn.shape[1]):
+        logits = np.asarray(llama.apply(
+            params, cfg, jnp.asarray(seq[:, :prompt.shape[1] + step])))
+        top3 = np.argsort(-logits[0, -1])[:3]
+        assert drawn[0, step] in top3, step
+
+
+def test_specdecode_validation(engines):
+    target, draft = engines
+    spec = SpeculativeEngine(target, draft)
+    with pytest.raises(ValueError, match="batch-1"):
+        spec.generate(jnp.zeros((2, 4), jnp.int32), max_new=4)
+    with pytest.raises(ValueError, match="gamma"):
+        spec.generate(_prompt(), max_new=4, gamma=0)
+    with pytest.raises(ValueError, match="cache bucket"):
+        spec.generate(_prompt(), max_new=90, gamma=4)
+    bad_vocab = dataclasses.replace(DCFG, vocab_size=1024)
+    with pytest.raises(ValueError, match="vocab"):
+        SpeculativeEngine(target, InferenceEngine(
+            llama.init(jax.random.key(1), bad_vocab), bad_vocab,
+            LLAMA_FAMILY, EngineConfig(max_len=96)))
+
+
+def test_specdecode_sampling_params_do_not_recompile(engines):
+    target, draft = engines
+    spec = SpeculativeEngine(target, draft)
+    prompt = _prompt(9)
+    spec.generate(prompt, max_new=8, gamma=2)
+    before = spec._jit._cache_size()
+    spec.generate(prompt, max_new=8, gamma=2, temperature=0.5, top_k=7,
+                  rng=jax.random.key(2))
+    spec.generate(prompt, max_new=8, gamma=2, temperature=1.3, top_p=0.7,
+                  rng=jax.random.key(3))
+    assert spec._jit._cache_size() == before
