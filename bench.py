@@ -249,16 +249,23 @@ def bench_train(preset: Preset, *, assert_flash: bool = False,
 
 
 def bench_decode(model: str, *, batch: int, prompt_len: int,
-                 max_new: int, max_len: int, verbose: bool = True) -> dict:
+                 max_new: int, max_len: int, int8: bool = False,
+                 verbose: bool = True) -> dict:
     """Serving decode throughput on the KV-cache scan engine."""
     from kubeflow_tpu.models import llama
     from kubeflow_tpu.serving import engine as engine_lib
+    from kubeflow_tpu.serving import quant
 
     cfg = bench_configs()[model]
     # jit the init: eager per-op dispatch is pathological over remote
     # PJRT transports (each op is a round-trip).
     params = jax.jit(lambda k: llama.init(k, cfg))(jax.random.key(0))
     jax.block_until_ready(params)
+    if int8:
+        # weight-only int8: the decode step's HBM read halves vs bf16,
+        # which is the whole metric (MBU roofline) — quantize on device.
+        params = jax.jit(quant.quantize_blocks)(params)
+        jax.block_until_ready(params)
     eng = engine_lib.InferenceEngine(
         params, cfg, engine_lib.LLAMA_FAMILY,
         engine_lib.EngineConfig(max_len=max_len),
@@ -294,7 +301,9 @@ def bench_decode(model: str, *, batch: int, prompt_len: int,
     avg_len = prompt_len + max_new / 2
     kv_bytes = (2 * cfg.num_layers * batch * avg_len * cfg.num_kv_heads
                 * cfg.head_dim * jnp.dtype(cfg.dtype).itemsize)
-    step_bytes = param_bytes(cfg) + kv_bytes
+    weight_bytes = (quant.param_bytes(params) if int8
+                    else param_bytes(cfg))
+    step_bytes = weight_bytes + kv_bytes
     # Per-step time bounds MBU; batch tokens amortize one weight read.
     step_time = dt / decoded
     mbu = step_bytes / step_time / PEAK_HBM_GBS[gen]
@@ -306,7 +315,8 @@ def bench_decode(model: str, *, batch: int, prompt_len: int,
             file=sys.stderr,
         )
     return {
-        "metric": f"serving_decode_tokens_per_sec_per_chip[{model},{gen}]",
+        "metric": ("serving_decode_tokens_per_sec_per_chip"
+                   f"[{model}{'-int8' if int8 else ''},{gen}]"),
         "value": round(tok_per_sec, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mbu / 0.40, 4),
@@ -327,14 +337,16 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--only", default="",
                    help="comma-separated subset: train500m,train1b,"
-                        "flash4k,decode (default: full sweep for the "
-                        "backend)")
+                        "flash4k,decode,decode-int8 (default: full "
+                        "sweep for the backend)")
     p.add_argument("--json-only", action="store_true")
     args = p.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
-    all_names = ("train500m", "train1b", "flash4k", "decode")
-    sweep = (list(all_names) if on_tpu else ["train500m", "decode"])
+    all_names = ("train500m", "train1b", "flash4k", "decode",
+                 "decode-int8")
+    sweep = (list(all_names) if on_tpu
+             else ["train500m", "decode", "decode-int8"])
     if args.only:
         wanted = [s.strip() for s in args.only.split(",") if s.strip()]
         unknown = [s for s in wanted if s not in all_names]
@@ -399,6 +411,17 @@ def main() -> int:
             guarded("decode", lambda: bench_decode(
                 "tiny", batch=2, prompt_len=8, max_new=8, max_len=32,
                 verbose=verbose))
+    if "decode-int8" in sweep:
+        # Same decode, int8 block weights: the MBU denominator halves
+        # (vs bf16), so tokens/s should rise toward the same roofline.
+        if on_tpu:
+            guarded("decode-int8", lambda: bench_decode(
+                "bench-500m-serve", batch=16, prompt_len=128,
+                max_new=128, max_len=512, int8=True, verbose=verbose))
+        else:
+            guarded("decode-int8", lambda: bench_decode(
+                "tiny", batch=2, prompt_len=8, max_new=8, max_len=32,
+                int8=True, verbose=verbose))
 
     assert headline is not None, "empty sweep"
     result = dict(headline)

@@ -20,4 +20,5 @@ from kubeflow_tpu.serving.engine import (
     GEMMA_FAMILY,
     LLAMA_FAMILY,
 )
+from kubeflow_tpu.serving.quant import QTensor, quantize_blocks
 from kubeflow_tpu.serving.speculative import SpecStats, SpeculativeEngine

@@ -58,6 +58,21 @@ class EngineConfig:
     eos_token: int | None = None
 
 
+def scaled_filtered_logits(logits: jnp.ndarray,
+                           sp: "SamplingParams") -> jnp.ndarray:
+    """Temperature-scale then top-k/top-p filter — the ONE definition of
+    the sampled distribution's logits, shared by the engine's sampler
+    and the speculative verifier (a drifted copy there would silently
+    break speculative decoding's target-law exactness). The cond skips
+    the filter's argsorts when both knobs are off (temperature-only
+    sampling keeps its pre-filter cost)."""
+    scaled = logits.astype(jnp.float32) / jnp.maximum(sp.temperature, 1e-6)
+    return jax.lax.cond(
+        (sp.top_k > 0) | (sp.top_p < 1.0),
+        lambda s: filter_logits(s, sp.top_k, sp.top_p),
+        lambda s: s, scaled)
+
+
 class SamplingParams(NamedTuple):
     """Per-request sampling knobs as TRACED scalars: requests with
     different temperature/top_k/top_p reuse one compiled decode scan
@@ -226,16 +241,9 @@ class InferenceEngine:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
         def sampled(_):
-            scaled = logits.astype(jnp.float32) / jnp.maximum(
-                sp.temperature, 1e-6)
-            # Same reasoning one level down: temperature-only sampling
-            # must not pay the filter's argsorts for an all-True mask.
-            filtered = jax.lax.cond(
-                (sp.top_k > 0) | (sp.top_p < 1.0),
-                lambda s: filter_logits(s, sp.top_k, sp.top_p),
-                lambda s: s, scaled)
             return jax.random.categorical(
-                rng, filtered, axis=-1).astype(jnp.int32)
+                rng, scaled_filtered_logits(logits, sp),
+                axis=-1).astype(jnp.int32)
 
         return jax.lax.cond(sp.temperature > 0.0, sampled, greedy, None)
 
@@ -261,10 +269,12 @@ class InferenceEngine:
         if rng is None:
             if temperature > 0.0:
                 # Fresh entropy per request — a constant default key
-                # would make every "sampled" completion identical; 64
-                # seed bits keep birthday collisions out of reach.
+                # would make every "sampled" completion identical; 63
+                # seed bits keep birthday collisions out of reach while
+                # staying inside np.int64 (jax.random.key rejects
+                # Python ints >= 2**63).
                 rng = jax.random.key(
-                    int.from_bytes(os.urandom(8), "little"))
+                    int.from_bytes(os.urandom(8), "little") >> 1)
             else:
                 # greedy: the cond's sampled branch never runs, so the
                 # constant key is never drawn from at runtime

@@ -1,0 +1,106 @@
+"""Weight-only int8 quantization for serving: halve decode's HBM bill.
+
+Decode reads every parameter once per emitted token — it is bandwidth-
+bound, so bytes ARE latency (bench.py's MBU roofline). Symmetric
+per-output-channel int8 cuts the block weights to 1/2 the bytes of
+bf16 (1/4 of fp32) at ~0.3% RMS weight error; XLA fuses the
+int8-load -> convert -> scale chain into the consuming matmul, so HBM
+sees int8 while the MXU still computes in the activation dtype.
+
+Design: `QTensor` is a pytree (q: int8, scale: per-channel) whose
+`.astype(dtype)` returns the dequantized array — exactly the call the
+engine already makes on every weight (`h @ p["wq"].astype(cfg.dtype)`),
+so the engine runs unmodified, and `lax.scan` over stacked per-layer
+blocks slices q and scale together. Only the seven block matmul weights
+quantize; embed/lm_head (quality-critical) and the tiny norms stay in
+their source dtype.
+
+Scope: serving only. Training through rounded weights needs STE
+machinery this deliberately does not have — quantize a checkpoint at
+load time (`quantize_blocks`), never the training params.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+BLOCK_MATMUL_WEIGHTS = (
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+@jax.tree_util.register_pytree_node_class
+class QTensor:
+    """int8 weights + per-output-channel scales, dequantized on read.
+
+    Shapes: q is the original weight shape [..., in, out]; scale is
+    [..., 1, out] (contraction axis reduced, keepdims) so dequant is a
+    broadcast multiply however many stacked leading axes exist — which
+    is also what lets lax.scan slice a stacked [L, in, out] QTensor
+    into per-layer [in, out] QTensors.
+    """
+
+    def __init__(self, q: jnp.ndarray, scale: jnp.ndarray):
+        self.q = q
+        self.scale = scale
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def nbytes(self) -> int:
+        return self.q.size * self.q.dtype.itemsize \
+            + self.scale.size * self.scale.dtype.itemsize
+
+    def astype(self, dtype) -> jnp.ndarray:
+        # The engine's only read path. XLA fuses this into the consumer:
+        # int8 leaves HBM, the multiply rides the convert.
+        return self.q.astype(dtype) * self.scale.astype(dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, _, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return f"QTensor(q={self.q.shape} {self.q.dtype}, " \
+               f"scale={self.scale.shape})"
+
+
+def quantize(w: jnp.ndarray, *, scale_dtype=jnp.bfloat16) -> QTensor:
+    """Symmetric per-output-channel int8 over the contraction axis -2."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)
+    # Round the scale to its STORAGE dtype before quantizing: dequant
+    # multiplies by the stored scale, so quantizing against the fp32
+    # scale would add |q| * (scale - stored) — up to ~0.5 scale at bf16
+    # — on top of the rounding half-step.
+    scale = (jnp.maximum(amax, 1e-30) / 127.0).astype(scale_dtype)
+    q = jnp.clip(jnp.round(w32 / scale.astype(jnp.float32)),
+                 -127, 127).astype(jnp.int8)
+    return QTensor(q, scale)
+
+
+def quantize_blocks(params: Params) -> Params:
+    """Serving params with the seven block matmul weights as QTensors.
+    Everything else (embed, lm_head, norms) passes through untouched."""
+    out = dict(params)
+    blocks = dict(params["blocks"])
+    for name in BLOCK_MATMUL_WEIGHTS:
+        blocks[name] = quantize(blocks[name])
+    out["blocks"] = blocks
+    return out
+
+
+def param_bytes(params: Params) -> int:
+    """HBM bytes a decode step reads for weights (QTensor-aware)."""
+    return sum(
+        leaf.nbytes for leaf in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QTensor)))

@@ -34,7 +34,7 @@ from kubeflow_tpu.serving.engine import (
     DecodeState,
     InferenceEngine,
     SamplingParams,
-    filter_logits,
+    scaled_filtered_logits,
 )
 
 
@@ -63,13 +63,7 @@ def _dist(logits: jnp.ndarray, sp: SamplingParams) -> jnp.ndarray:
             jnp.argmax(logits, axis=-1), vocab, dtype=jnp.float32)
 
     def sampled(_):
-        scaled = logits.astype(jnp.float32) / jnp.maximum(
-            sp.temperature, 1e-6)
-        filtered = jax.lax.cond(
-            (sp.top_k > 0) | (sp.top_p < 1.0),
-            lambda s: filter_logits(s, sp.top_k, sp.top_p),
-            lambda s: s, scaled)
-        return jax.nn.softmax(filtered, axis=-1)
+        return jax.nn.softmax(scaled_filtered_logits(logits, sp), axis=-1)
 
     return jax.lax.cond(sp.temperature > 0.0, sampled, greedy, None)
 
