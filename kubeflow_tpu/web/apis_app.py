@@ -21,7 +21,11 @@ from aiohttp import web
 
 from kubeflow_tpu.api import versioning
 from kubeflow_tpu.controlplane.store import Store
-from kubeflow_tpu.web.common import base_app, ensure_authorized
+from kubeflow_tpu.web.common import (
+    STORE_KEY,
+    base_app,
+    ensure_authorized,
+)
 
 # kind <-> URL plural segment for the kinds this API serves. CRs plus
 # the owned workload kinds an operator inspects with kubectl (the
@@ -89,7 +93,7 @@ def _kind(request: web.Request) -> str:
 
 
 async def list_resources(request: web.Request) -> web.Response:
-    store: Store = request.app["store"]
+    store: Store = request.app[STORE_KEY]
     kind = _kind(request)
     version = _version(request, kind)
     ns = request.match_info["ns"]
@@ -106,7 +110,7 @@ async def list_resources(request: web.Request) -> web.Response:
 
 
 async def get_resource(request: web.Request) -> web.Response:
-    store: Store = request.app["store"]
+    store: Store = request.app[STORE_KEY]
     kind = _kind(request)
     version = _version(request, kind)
     ns, name = request.match_info["ns"], request.match_info["name"]
@@ -116,7 +120,7 @@ async def get_resource(request: web.Request) -> web.Response:
 
 
 async def create_resource(request: web.Request) -> web.Response:
-    store: Store = request.app["store"]
+    store: Store = request.app[STORE_KEY]
     kind = _kind(request)
     _require_mutable(kind)
     version = _version(request, kind)
@@ -140,7 +144,7 @@ async def create_resource(request: web.Request) -> web.Response:
 
 
 async def delete_resource(request: web.Request) -> web.Response:
-    store: Store = request.app["store"]
+    store: Store = request.app[STORE_KEY]
     kind = _kind(request)
     _require_mutable(kind)
     _version(request, kind)

@@ -19,7 +19,7 @@ from typing import Any
 from aiohttp import web
 
 from kubeflow_tpu.controlplane import auth
-from kubeflow_tpu.controlplane.kfam import KfamError
+from kubeflow_tpu.controlplane.kfam import Kfam, KfamError
 from kubeflow_tpu.controlplane.store import (
     AdmissionDenied,
     AlreadyExists,
@@ -29,6 +29,18 @@ from kubeflow_tpu.controlplane.store import (
 )
 
 log = logging.getLogger(__name__)
+
+# Typed application-config keys (aiohttp AppKey). String keys still
+# work but warn (NotAppKeyWarning) and lose type information; these are
+# the platform's shared app-state slots, importable by every subapp.
+STORE_KEY: web.AppKey = web.AppKey("store", Store)
+CLUSTER_ADMINS_KEY: web.AppKey = web.AppKey("cluster_admins", set)
+KFAM_KEY: web.AppKey = web.AppKey("kfam", Kfam)
+SPAWNER_CONFIG_KEY: web.AppKey = web.AppKey("spawner_config", dict)
+LINKS_KEY: web.AppKey = web.AppKey("links", object)
+PLATFORM_METRICS_KEY: web.AppKey = web.AppKey("platform_metrics", object)
+DEV_USER_KEY: web.AppKey = web.AppKey("dev_user", str)
+CSRF_EXEMPT_KEY: web.AppKey = web.AppKey("csrf_exempt_prefixes", tuple)
 
 AUTH_EXEMPT = {"/healthz", "/readyz", "/metrics", "/"}
 # The SPA shell and its assets load before identity is known — the auth
@@ -89,7 +101,7 @@ async def authn_middleware(request: web.Request, handler):
         # the operator opts in (create_platform_app(dev_user=...)) —
         # production deployments sit behind an auth proxy that always
         # injects the header.
-        dev = request.config_dict.get("dev_user")
+        dev = request.config_dict.get(DEV_USER_KEY)
         if not dev:
             raise
         request["user"] = auth.User(dev)
@@ -100,7 +112,7 @@ async def authn_middleware(request: web.Request, handler):
 async def csrf_middleware(request: web.Request, handler):
     # Parent-app middlewares wrap subapp requests too; service APIs
     # (mesh-internal, no browser) opt out by prefix.
-    for prefix in request.app.get("csrf_exempt_prefixes", ()):
+    for prefix in request.app.get(CSRF_EXEMPT_KEY, ()):
         if request.path.startswith(prefix):
             return await handler(request)
     if request.method in ("GET", "HEAD", "OPTIONS"):
@@ -136,8 +148,8 @@ def base_app(store: Store, *, csrf: bool = True,
     if csrf:
         middlewares.append(csrf_middleware)
     app = web.Application(middlewares=middlewares)
-    app["store"] = store
-    app["cluster_admins"] = cluster_admins or set()
+    app[STORE_KEY] = store
+    app[CLUSTER_ADMINS_KEY] = cluster_admins or set()
     add_probes(app)
     return app
 
@@ -145,8 +157,8 @@ def base_app(store: Store, *, csrf: bool = True,
 def ensure_authorized(request: web.Request, verb: str, kind: str,
                       namespace: str) -> auth.User:
     user: auth.User = request["user"]
-    store: Store = request.app["store"]
-    admins = request.app.get("cluster_admins") or set()
+    store: Store = request.app[STORE_KEY]
+    admins = request.app.get(CLUSTER_ADMINS_KEY) or set()
     auth.ensure_authorized(store, user, verb, kind, namespace,
                            cluster_admins=admins)
     return user

@@ -17,7 +17,14 @@ from aiohttp import web
 from kubeflow_tpu.controlplane import auth
 from kubeflow_tpu.controlplane.kfam import Kfam
 from kubeflow_tpu.controlplane.store import Store
-from kubeflow_tpu.web.common import base_app, json_success
+from kubeflow_tpu.web.common import (
+    CLUSTER_ADMINS_KEY,
+    KFAM_KEY,
+    LINKS_KEY,
+    STORE_KEY,
+    base_app,
+    json_success,
+)
 
 DEFAULT_LINKS = {
     "menuLinks": [
@@ -38,8 +45,8 @@ def create_dashboard_app(store: Store, *, cluster_admins: set[str] | None = None
                          links: dict | None = None,
                          csrf: bool = True) -> web.Application:
     app = base_app(store, csrf=csrf, cluster_admins=cluster_admins)
-    app["kfam"] = Kfam(store, cluster_admins)
-    app["links"] = links or DEFAULT_LINKS
+    app[KFAM_KEY] = Kfam(store, cluster_admins)
+    app[LINKS_KEY] = links or DEFAULT_LINKS
 
     app.router.add_get("/api/workgroup/env-info", env_info)
     app.router.add_get("/api/workgroup/exists", workgroup_exists)
@@ -52,10 +59,10 @@ def create_dashboard_app(store: Store, *, cluster_admins: set[str] | None = None
 
 
 async def env_info(request: web.Request):
-    store: Store = request.app["store"]
-    kfam: Kfam = request.app["kfam"]
+    store: Store = request.app[STORE_KEY]
+    kfam: Kfam = request.app[KFAM_KEY]
     user: auth.User = request["user"]
-    namespaces = auth.namespaces_for(store, user, request.app["cluster_admins"])
+    namespaces = auth.namespaces_for(store, user, request.app[CLUSTER_ADMINS_KEY])
     profiles = [p.metadata.name for p in store.list("Profile")
                 if p.spec.owner == user.name]
     return json_success({
@@ -72,7 +79,7 @@ async def env_info(request: web.Request):
 
 
 async def workgroup_exists(request: web.Request):
-    store: Store = request.app["store"]
+    store: Store = request.app[STORE_KEY]
     user: auth.User = request["user"]
     owned = [p for p in store.list("Profile") if p.spec.owner == user.name]
     return json_success({"hasWorkgroup": bool(owned),
@@ -80,7 +87,7 @@ async def workgroup_exists(request: web.Request):
 
 
 async def workgroup_create(request: web.Request):
-    kfam: Kfam = request.app["kfam"]
+    kfam: Kfam = request.app[KFAM_KEY]
     user: auth.User = request["user"]
     body = await request.json() if request.can_read_body else {}
     name = body.get("namespace") or user.name.split("@")[0]
@@ -89,11 +96,11 @@ async def workgroup_create(request: web.Request):
 
 
 async def list_namespaces(request: web.Request):
-    store: Store = request.app["store"]
+    store: Store = request.app[STORE_KEY]
     user: auth.User = request["user"]
     return json_success({
         "namespaces": auth.namespaces_for(
-            store, user, request.app["cluster_admins"])
+            store, user, request.app[CLUSTER_ADMINS_KEY])
     })
 
 
@@ -102,7 +109,7 @@ async def activities(request: web.Request):
     from kubeflow_tpu.web.common import ensure_authorized
 
     ensure_authorized(request, "list", "Event", ns)
-    store: Store = request.app["store"]
+    store: Store = request.app[STORE_KEY]
     events = sorted(store.list("Event", ns), key=lambda e: -e.timestamp)[:50]
     return json_success({
         "activities": [
@@ -115,7 +122,7 @@ async def activities(request: web.Request):
 
 
 async def dashboard_links(request: web.Request):
-    return json_success({"links": request.app["links"]})
+    return json_success({"links": request.app[LINKS_KEY]})
 
 
 async def metrics(request: web.Request):
@@ -125,11 +132,11 @@ async def metrics(request: web.Request):
     admins get the cluster-wide view, everyone else their own tenants
     (the sibling endpoints all gate per-namespace; metrics must not be
     the one cross-tenant leak)."""
-    store: Store = request.app["store"]
+    store: Store = request.app[STORE_KEY]
     user: auth.User = request["user"]
     from kubeflow_tpu.controlplane import webhook as wh
 
-    admins = request.app["cluster_admins"]
+    admins = request.app[CLUSTER_ADMINS_KEY]
     if auth.is_cluster_admin(store, user, admins):
         visible = None  # all namespaces
     else:

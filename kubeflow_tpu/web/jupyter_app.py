@@ -21,14 +21,20 @@ from kubeflow_tpu.api.core import PersistentVolumeClaim
 from kubeflow_tpu.api.crds import Notebook, STOP_ANNOTATION
 from kubeflow_tpu.controlplane.store import AlreadyExists, Store
 from kubeflow_tpu.web import form as form_lib
-from kubeflow_tpu.web.common import base_app, ensure_authorized, json_success
+from kubeflow_tpu.web.common import (
+    SPAWNER_CONFIG_KEY,
+    STORE_KEY,
+    base_app,
+    ensure_authorized,
+    json_success,
+)
 
 
 def create_jupyter_app(store: Store, *, spawner_config=None,
                        cluster_admins: set[str] | None = None,
                        csrf: bool = True) -> web.Application:
     app = base_app(store, csrf=csrf, cluster_admins=cluster_admins)
-    app["spawner_config"] = spawner_config or form_lib.DEFAULT_SPAWNER_CONFIG
+    app[SPAWNER_CONFIG_KEY] = spawner_config or form_lib.DEFAULT_SPAWNER_CONFIG
 
     app.router.add_get("/api/config", get_config)
     app.router.add_get("/api/namespaces/{ns}/notebooks", list_notebooks)
@@ -41,7 +47,7 @@ def create_jupyter_app(store: Store, *, spawner_config=None,
 
 
 async def get_config(request: web.Request):
-    return json_success({"config": request.app["spawner_config"]})
+    return json_success({"config": request.app[SPAWNER_CONFIG_KEY]})
 
 
 def _summarize(store: Store, nb: Notebook) -> dict:
@@ -64,7 +70,7 @@ def _summarize(store: Store, nb: Notebook) -> dict:
 async def list_notebooks(request: web.Request):
     ns = request.match_info["ns"]
     ensure_authorized(request, "list", "Notebook", ns)
-    store: Store = request.app["store"]
+    store: Store = request.app[STORE_KEY]
     return json_success({
         "notebooks": [_summarize(store, nb) for nb in store.list("Notebook", ns)]
     })
@@ -73,7 +79,7 @@ async def list_notebooks(request: web.Request):
 async def get_notebook(request: web.Request):
     ns, name = request.match_info["ns"], request.match_info["name"]
     ensure_authorized(request, "get", "Notebook", ns)
-    store: Store = request.app["store"]
+    store: Store = request.app[STORE_KEY]
     nb = store.get("Notebook", ns, name)
     return json_success({"notebook": _summarize(store, nb)})
 
@@ -81,11 +87,11 @@ async def get_notebook(request: web.Request):
 async def post_notebook(request: web.Request):
     ns = request.match_info["ns"]
     ensure_authorized(request, "create", "Notebook", ns)
-    store: Store = request.app["store"]
+    store: Store = request.app[STORE_KEY]
     body = await request.json()
     body["namespace"] = ns
-    form = form_lib.parse_form(body, request.app["spawner_config"])
-    nb = form_lib.build_notebook(form, request.app["spawner_config"])
+    form = form_lib.parse_form(body, request.app[SPAWNER_CONFIG_KEY])
+    nb = form_lib.build_notebook(form, request.app[SPAWNER_CONFIG_KEY])
 
     # Selected configurations: adopt each TpuPodDefault's selector labels
     # on the pod template so the admission webhook matches it (the JWA
@@ -117,14 +123,14 @@ async def post_notebook(request: web.Request):
 async def delete_notebook(request: web.Request):
     ns, name = request.match_info["ns"], request.match_info["name"]
     ensure_authorized(request, "delete", "Notebook", ns)
-    request.app["store"].delete("Notebook", ns, name)
+    request.app[STORE_KEY].delete("Notebook", ns, name)
     return json_success()
 
 
 async def patch_notebook(request: web.Request):
     ns, name = request.match_info["ns"], request.match_info["name"]
     ensure_authorized(request, "update", "Notebook", ns)
-    store: Store = request.app["store"]
+    store: Store = request.app[STORE_KEY]
     body = await request.json()
     nb = store.get("Notebook", ns, name)
     if "stopped" in body:
@@ -143,7 +149,7 @@ async def patch_notebook(request: web.Request):
 async def list_poddefaults(request: web.Request):
     ns = request.match_info["ns"]
     ensure_authorized(request, "list", "TpuPodDefault", ns)
-    store: Store = request.app["store"]
+    store: Store = request.app[STORE_KEY]
     return json_success({
         "poddefaults": [
             {"name": pd.metadata.name, "desc": pd.spec.desc,

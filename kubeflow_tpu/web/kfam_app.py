@@ -10,7 +10,11 @@ from aiohttp import web
 
 from kubeflow_tpu.controlplane.kfam import Binding, Kfam
 from kubeflow_tpu.controlplane.store import Store
-from kubeflow_tpu.web.common import base_app, json_success
+from kubeflow_tpu.web.common import (
+    KFAM_KEY,
+    base_app,
+    json_success,
+)
 
 
 def create_kfam_app(store: Store, *, cluster_admins: set[str] | None = None,
@@ -18,7 +22,7 @@ def create_kfam_app(store: Store, *, cluster_admins: set[str] | None = None,
     # The reference KFAM sits behind the mesh and uses no CSRF (it is a
     # service API, not a browser app) — kept configurable.
     app = base_app(store, csrf=csrf, cluster_admins=cluster_admins)
-    app["kfam"] = Kfam(store, cluster_admins)
+    app[KFAM_KEY] = Kfam(store, cluster_admins)
 
     app.router.add_get("/v1/bindings", get_bindings)
     app.router.add_post("/v1/bindings", post_binding)
@@ -41,7 +45,7 @@ def _binding_from(body: dict) -> Binding:
 
 
 async def get_bindings(request: web.Request):
-    kfam: Kfam = request.app["kfam"]
+    kfam: Kfam = request.app[KFAM_KEY]
     bindings = kfam.list_bindings(
         request["user"],
         namespace=request.query.get("namespace") or None,
@@ -56,19 +60,19 @@ async def get_bindings(request: web.Request):
 
 
 async def post_binding(request: web.Request):
-    kfam: Kfam = request.app["kfam"]
+    kfam: Kfam = request.app[KFAM_KEY]
     kfam.create_binding(request["user"], _binding_from(await request.json()))
     return json_success(status=201)
 
 
 async def delete_binding(request: web.Request):
-    kfam: Kfam = request.app["kfam"]
+    kfam: Kfam = request.app[KFAM_KEY]
     kfam.delete_binding(request["user"], _binding_from(await request.json()))
     return json_success()
 
 
 async def post_profile(request: web.Request):
-    kfam: Kfam = request.app["kfam"]
+    kfam: Kfam = request.app[KFAM_KEY]
     body = await request.json()
     kfam.create_profile(
         request["user"], body["name"], owner=body.get("owner", ""),
@@ -78,13 +82,13 @@ async def post_profile(request: web.Request):
 
 
 async def delete_profile(request: web.Request):
-    kfam: Kfam = request.app["kfam"]
+    kfam: Kfam = request.app[KFAM_KEY]
     kfam.delete_profile(request["user"], request.match_info["name"])
     return json_success()
 
 
 async def get_clusteradmin(request: web.Request):
-    kfam: Kfam = request.app["kfam"]
+    kfam: Kfam = request.app[KFAM_KEY]
     from kubeflow_tpu.controlplane.auth import User
 
     user = request.query.get("user") or request["user"].name

@@ -14,6 +14,11 @@ import os
 from aiohttp import web
 
 from kubeflow_tpu.controlplane.store import Store
+from kubeflow_tpu.web.common import (
+    CSRF_EXEMPT_KEY,
+    DEV_USER_KEY,
+    PLATFORM_METRICS_KEY,
+)
 from kubeflow_tpu.web.apis_app import create_apis_app
 from kubeflow_tpu.web.dashboard_app import create_dashboard_app
 from kubeflow_tpu.web.jupyter_app import create_jupyter_app
@@ -33,12 +38,12 @@ def create_platform_app(
 ) -> web.Application:
     root = create_dashboard_app(store, cluster_admins=cluster_admins, csrf=csrf)
     if dev_user:
-        root["dev_user"] = dev_user
+        root[DEV_USER_KEY] = dev_user
     if metrics is not None:
         # /metrics + request counters (ref kfam routers.go:82-86 exposes
         # prometheus on the same mux as the API). Outermost middleware so
         # it also counts authn/CSRF rejections and handler crashes.
-        root["platform_metrics"] = metrics
+        root[PLATFORM_METRICS_KEY] = metrics
         root.middlewares.insert(0, _request_counter_middleware)
 
         async def render_metrics(_request):
@@ -60,7 +65,7 @@ def create_platform_app(
     # clients, not browsers — exempt from the SPA's cookie CSRF dance,
     # with its own custom-header CSRF defense on mutations
     # (apis_app.API_CLIENT_HEADER).
-    root["csrf_exempt_prefixes"] = ("/kfam/", "/apis/")
+    root[CSRF_EXEMPT_KEY] = ("/kfam/", "/apis/")
     root.add_subapp("/apis/", create_apis_app(
         store, cluster_admins=cluster_admins, csrf=False))
     add_frontend(root)
@@ -94,7 +99,7 @@ _KNOWN_SERVICES = frozenset(
 
 @web.middleware
 async def _request_counter_middleware(request: web.Request, handler):
-    metrics = request.config_dict.get("platform_metrics")
+    metrics = request.config_dict.get(PLATFORM_METRICS_KEY)
     segment = request.path.split("/")[1] or "dashboard"
     service = segment if segment in _KNOWN_SERVICES else "other"
     try:

@@ -7,7 +7,12 @@ from aiohttp import web
 
 from kubeflow_tpu.api.crds import Tensorboard
 from kubeflow_tpu.controlplane.store import Store
-from kubeflow_tpu.web.common import base_app, ensure_authorized, json_success
+from kubeflow_tpu.web.common import (
+    STORE_KEY,
+    base_app,
+    ensure_authorized,
+    json_success,
+)
 
 
 def create_tensorboards_app(store: Store, *,
@@ -23,7 +28,7 @@ def create_tensorboards_app(store: Store, *,
 async def list_tbs(request: web.Request):
     ns = request.match_info["ns"]
     ensure_authorized(request, "list", "Tensorboard", ns)
-    store: Store = request.app["store"]
+    store: Store = request.app[STORE_KEY]
     return json_success({
         "tensorboards": [
             {
@@ -47,12 +52,12 @@ async def post_tb(request: web.Request):
     tb.metadata.name = body["name"]
     tb.metadata.namespace = ns
     tb.spec.logspath = body["logspath"]
-    request.app["store"].create(tb)
+    request.app[STORE_KEY].create(tb)
     return json_success({"name": tb.metadata.name}, status=201)
 
 
 async def delete_tb(request: web.Request):
     ns, name = request.match_info["ns"], request.match_info["name"]
     ensure_authorized(request, "delete", "Tensorboard", ns)
-    request.app["store"].delete("Tensorboard", ns, name)
+    request.app[STORE_KEY].delete("Tensorboard", ns, name)
     return json_success()

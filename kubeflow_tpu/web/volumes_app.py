@@ -6,7 +6,12 @@ from aiohttp import web
 
 from kubeflow_tpu.api.core import PersistentVolumeClaim
 from kubeflow_tpu.controlplane.store import Store
-from kubeflow_tpu.web.common import base_app, ensure_authorized, json_success
+from kubeflow_tpu.web.common import (
+    STORE_KEY,
+    base_app,
+    ensure_authorized,
+    json_success,
+)
 
 
 def create_volumes_app(store: Store, *, cluster_admins: set[str] | None = None,
@@ -37,7 +42,7 @@ def _used_by(store: Store, ns: str, pvc_name: str) -> list[str]:
 async def list_pvcs(request: web.Request):
     ns = request.match_info["ns"]
     ensure_authorized(request, "list", "PersistentVolumeClaim", ns)
-    store: Store = request.app["store"]
+    store: Store = request.app[STORE_KEY]
     return json_success({
         "pvcs": [
             {
@@ -65,14 +70,14 @@ async def post_pvc(request: web.Request):
         pvc.access_modes = [body["mode"]]
     if body.get("class"):
         pvc.storage_class = body["class"]
-    request.app["store"].create(pvc)
+    request.app[STORE_KEY].create(pvc)
     return json_success({"name": pvc.metadata.name}, status=201)
 
 
 async def delete_pvc(request: web.Request):
     ns, name = request.match_info["ns"], request.match_info["name"]
     ensure_authorized(request, "delete", "PersistentVolumeClaim", ns)
-    store: Store = request.app["store"]
+    store: Store = request.app[STORE_KEY]
     users = _used_by(store, ns, name)
     if users:
         from kubeflow_tpu.web.common import json_error
