@@ -55,6 +55,7 @@ LLAMA_RULES = ShardingRules(
         "layers": None,
         "experts": mesh_lib.TENSOR_AXIS,
         "stage": None,
+        "lora_rank": None,  # rank dim is tiny — always replicated
         # --- activations ---
         # dcn leads: on hybrid multi-slice meshes the batch's outermost
         # split is across slices (pure DP over DCN); single-slice meshes

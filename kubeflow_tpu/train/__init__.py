@@ -7,3 +7,12 @@ from kubeflow_tpu.train.trainer import (
     cross_entropy_loss,
 )
 from kubeflow_tpu.train.checkpoint import CheckpointConfig, Checkpointer
+from kubeflow_tpu.train.lora import (
+    LoraConfig,
+    init_lora,
+    lora_freeze_labels,
+    lora_logical_axes,
+    lora_loss_fn,
+    lora_train_tree,
+    merge_lora,
+)

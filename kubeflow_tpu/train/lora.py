@@ -1,0 +1,149 @@
+"""LoRA: low-rank adapters for fine-tuning on a fraction of the HBM.
+
+Full fine-tuning of an 8B model carries 2x-params Adam moments; LoRA
+(Hu et al. 2021) trains W + (alpha/r) A@B with A,B of rank r, so
+gradients and moments exist only for the adapters (~0.1% of params).
+TPU-first design decisions:
+
+- Adapters are STACKED per layer ([L, in, r] / [L, r, out]) exactly
+  like the model's block weights, so the same `lax.scan` layer loop,
+  the same sharding-rule machinery, and the same Orbax checkpointing
+  apply unchanged.
+- Training MERGES W + AB each step instead of threading a second
+  matmul through the model: the merge is one einsum per weight that
+  XLA schedules once per step, the model code stays untouched, and the
+  backward pass through the merge gives exactly dA = W_grad-contracted
+  ... B^T etc. for free. The base tree rides under
+  `jax.lax.stop_gradient`, so its cotangents are dead code XLA
+  eliminates.
+- The frozen base lives INSIDE the TrainState ({"base": ..., "lora":
+  ...}) rather than as a jit closure constant (an 8B constant would be
+  baked into the executable); Trainer's `freeze_labels` gives the base
+  group zero updates and EMPTY optimizer state (trainer.make_optimizer)
+  — the memory win that makes LoRA LoRA.
+- `merge_lora` also serves deployment: fold adapters into plain params
+  once, then serve (optionally through serving.quant int8).
+
+Reference parity: none — the reference has no training of any kind
+(SURVEY.md §2b); this extends the Trainer the way Katib extends
+experiments: fine-tuning is the HPO sweep's inner loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+# in/out dims of each adaptable block weight, as attributes of the model
+# config (llama and gemma share the schema).
+_TARGET_DIMS = {
+    "wq": ("hidden_size", "q_dim"),
+    "wk": ("hidden_size", "kv_dim"),
+    "wv": ("hidden_size", "kv_dim"),
+    "wo": ("q_dim", "hidden_size"),
+    "w_gate": ("hidden_size", "intermediate_size"),
+    "w_up": ("hidden_size", "intermediate_size"),
+    "w_down": ("intermediate_size", "hidden_size"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    # Which block weights get adapters. Attention-only is the classic
+    # recipe; the default adapts every block matmul.
+    targets: tuple[str, ...] = (
+        "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+    def __post_init__(self):
+        unknown = set(self.targets) - set(_TARGET_DIMS)
+        if unknown:
+            raise ValueError(f"unknown LoRA targets {sorted(unknown)} "
+                             f"(known: {sorted(_TARGET_DIMS)})")
+        if self.rank < 1:
+            raise ValueError(f"rank must be >= 1, got {self.rank}")
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+
+def init_lora(rng: jax.Array, cfg, lora_cfg: LoraConfig,
+              dtype=jnp.float32) -> Params:
+    """Adapters: A ~ fan-in-scaled normal, B = 0 (so the merged model
+    starts EXACTLY at the base model — step 0 changes nothing)."""
+    L = cfg.num_layers
+    out: Params = {"blocks": {}}
+    keys = jax.random.split(rng, len(lora_cfg.targets))
+    for key, name in zip(keys, lora_cfg.targets):
+        d_in = getattr(cfg, _TARGET_DIMS[name][0])
+        d_out = getattr(cfg, _TARGET_DIMS[name][1])
+        out["blocks"][name] = {
+            "A": (jax.random.truncated_normal(
+                key, -2, 2, (L, d_in, lora_cfg.rank), jnp.float32)
+                * (d_in ** -0.5)).astype(dtype),
+            "B": jnp.zeros((L, lora_cfg.rank, d_out), dtype),
+        }
+    return out
+
+
+def merge_lora(base: Params, adapters: Params,
+               lora_cfg: LoraConfig) -> Params:
+    """base params with W <- W + (alpha/r) A@B for every adapted weight.
+    Works on any llama-schema params tree; result dtype follows W."""
+    blocks = dict(base["blocks"])
+    for name, ab in adapters["blocks"].items():
+        w = blocks[name]
+        delta = jnp.einsum(
+            "lir,lro->lio",
+            ab["A"].astype(jnp.float32), ab["B"].astype(jnp.float32))
+        blocks[name] = (w.astype(jnp.float32)
+                        + lora_cfg.scaling * delta).astype(w.dtype)
+    out = dict(base)
+    out["blocks"] = blocks
+    return out
+
+
+def lora_logical_axes(base_axes: Params, lora_cfg: LoraConfig) -> Params:
+    """Adapter logical axes mirroring the base weight's: A keeps the
+    in-axis sharding, B the out-axis; the rank axis replicates (it is
+    tiny). `base_axes` is the model's param_logical_axes tree."""
+    out: Params = {"blocks": {}}
+    for name in lora_cfg.targets:
+        layers_ax, in_ax, out_ax = base_axes["blocks"][name]
+        out["blocks"][name] = {
+            "A": (layers_ax, in_ax, "lora_rank"),
+            "B": (layers_ax, "lora_rank", out_ax),
+        }
+    return out
+
+
+def lora_train_tree(base: Params, adapters: Params) -> Params:
+    return {"base": base, "lora": adapters}
+
+
+def lora_freeze_labels(tree: Params) -> Params:
+    """Trainer freeze_labels for a lora_train_tree: base frozen (no
+    updates, no optimizer state), adapters trained."""
+    return {
+        "base": jax.tree.map(lambda _: "freeze", tree["base"]),
+        "lora": jax.tree.map(lambda _: "train", tree["lora"]),
+    }
+
+
+def lora_loss_fn(model_loss_fn, lora_cfg: LoraConfig):
+    """Wrap a `loss(params, tokens, targets, mask)` into one over the
+    {"base", "lora"} train tree: merge (base under stop_gradient — its
+    cotangents are dead code), then evaluate the model loss."""
+    def loss(tree: Params, tokens, targets, mask):
+        merged = merge_lora(
+            jax.lax.stop_gradient(tree["base"]), tree["lora"], lora_cfg)
+        return model_loss_fn(merged, tokens, targets, mask)
+
+    return loss

@@ -150,7 +150,15 @@ jax.tree_util.register_pytree_node(
 )
 
 
-def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+def make_optimizer(
+    tc: TrainConfig,
+    freeze_labels: Params | None = None,
+) -> optax.GradientTransformation:
+    """AdamW with warmup-cosine. `freeze_labels` (a params-shaped tree
+    of "train"/"freeze") carves the tree into a trained group and a
+    frozen one whose updates are zero AND whose optimizer state is
+    empty — for LoRA that empty state is the point: adapter moments
+    are ~1000x smaller than full-model moments."""
     schedule = optax.warmup_cosine_decay_schedule(
         init_value=0.0,
         peak_value=tc.learning_rate,
@@ -158,10 +166,14 @@ def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
         decay_steps=max(tc.total_steps, tc.warmup_steps + 1),
         end_value=tc.learning_rate * 0.1,
     )
-    return optax.chain(
+    opt = optax.chain(
         optax.clip_by_global_norm(tc.grad_clip),
         optax.adamw(schedule, b1=tc.b1, b2=tc.b2, weight_decay=tc.weight_decay),
     )
+    if freeze_labels is None:
+        return opt
+    return optax.multi_transform(
+        {"train": opt, "freeze": optax.set_to_zero()}, freeze_labels)
 
 
 class Trainer:
@@ -181,18 +193,21 @@ class Trainer:
         rules: ShardingRules = sharding_lib.LLAMA_RULES,
         train_config: TrainConfig = TrainConfig(),
         loss_fn: Callable[..., jnp.ndarray] | None = None,
+        freeze_labels: Params | None = None,
     ):
         """`loss_fn(params, tokens, targets, mask) -> scalar` overrides
         the default apply_fn→cross-entropy pipeline — e.g.
         `chunked_cross_entropy_from_hidden` over `llama.hidden`, which
-        skips materializing the [b, s, vocab] logits entirely."""
+        skips materializing the [b, s, vocab] logits entirely.
+        `freeze_labels` (params-shaped "train"/"freeze" tree) freezes a
+        subtree with no optimizer state (see make_optimizer)."""
         self.mesh = mesh
         self.apply_fn = apply_fn
         self.init_fn = init_fn
         self.rules = rules
         self.tc = train_config
         self.loss_fn = loss_fn
-        self.optimizer = make_optimizer(train_config)
+        self.optimizer = make_optimizer(train_config, freeze_labels)
 
         self.param_shardings = sharding_lib.shard_pytree_specs(
             rules, logical_axes, mesh
@@ -221,6 +236,13 @@ class Trainer:
         self.batch_sharding = NamedSharding(mesh, P(batch_axes, None))
 
         self._jit_init = jax.jit(self._init, out_shardings=self.state_shardings)
+        # Warm-start builder (init_from_params): cached so sweeps that
+        # fine-tune from many checkpoints compile it once.
+        self._jit_build_state = jax.jit(
+            self._build_state,
+            in_shardings=(self.param_shardings,),
+            out_shardings=self.state_shardings,
+        )
         self._jit_step = jax.jit(
             self._step,
             in_shardings=(self.state_shardings, self.batch_sharding,
@@ -229,10 +251,12 @@ class Trainer:
             donate_argnums=(0,),
         )
 
+    def _build_state(self, params: Params) -> TrainState:
+        return TrainState(params, self.optimizer.init(params),
+                          jnp.zeros((), jnp.int32))
+
     def _init(self, rng: jax.Array) -> TrainState:
-        params = self.init_fn(rng)
-        opt_state = self.optimizer.init(params)
-        return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+        return self._build_state(self.init_fn(rng))
 
     def _step(self, state: TrainState, tokens, targets, mask):
         def loss_fn(params):
@@ -251,6 +275,14 @@ class Trainer:
     def init(self, rng: jax.Array) -> TrainState:
         with jax.set_mesh(self.mesh):
             return self._jit_init(rng)
+
+    def init_from_params(self, params: Params) -> TrainState:
+        """Warm-start: fresh optimizer state around EXISTING params
+        (fine-tuning from a checkpoint). Params are a jit argument, not
+        a closure constant — closing over an 8B tree would bake it into
+        the executable."""
+        with jax.set_mesh(self.mesh):
+            return self._jit_build_state(params)
 
     def step(self, state: TrainState, tokens, targets, mask=None):
         if mask is None:
