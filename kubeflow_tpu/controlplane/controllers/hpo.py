@@ -93,10 +93,11 @@ class ExperimentController(Controller):
         if to_create > 0:
             try:
                 space = _space_from_spec(spec.parameters)
+                seeded = spec.algorithm in (
+                    "random", "tpe", "bayesianoptimization")
                 suggester = search_lib.make_suggester(
                     spec.algorithm, space,
-                    **({"seed": spec.seed}
-                       if spec.algorithm == "random" else {}))
+                    **({"seed": spec.seed} if seeded else {}))
             except ValueError as e:
                 if (exp.status.phase, exp.status.message) != (
                     "Failed", str(e)
@@ -105,7 +106,21 @@ class ExperimentController(Controller):
                     exp.status.message = str(e)
                     store.update(exp)
                 return Result()
-            suggester.suggest(len(trials))           # replay
+            if hasattr(suggester, "observe"):
+                # Adaptive algorithms (TPE) learn from finished trials;
+                # unparseable assignments (edited by hand) are skipped
+                # rather than failing the experiment.
+                obs = []
+                for t in done:
+                    if t.status.phase == "Succeeded" \
+                            and t.status.value is not None:
+                        try:
+                            obs.append((space.parse(t.spec.assignment),
+                                        t.status.value))
+                        except ValueError:
+                            pass
+                suggester.observe(obs, spec.objective.goal)
+            suggester.advance(len(trials))           # replay / advance
             batch = suggester.suggest(to_create)
             for a in batch:
                 idx = len(trials)

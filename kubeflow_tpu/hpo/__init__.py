@@ -12,6 +12,7 @@ from kubeflow_tpu.hpo.search import (
     Integer,
     RandomSuggester,
     SearchSpace,
+    TpeSuggester,
     better,
     make_suggester,
 )

@@ -13,7 +13,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import math
-from typing import Any, Iterable, Sequence
+from typing import Any, Iterable, Mapping, Sequence
 
 import numpy as np
 
@@ -69,6 +69,27 @@ class SearchSpace:
         for p in self.parameters:
             p.validate()
 
+    def parse(self, assignment: Mapping[str, str]) -> Assignment:
+        """Typed values from a Trial's string assignment (the CR stores
+        strings); unknown names are ignored, unmatched categorical
+        values raise."""
+        out: Assignment = {}
+        for p in self.parameters:
+            if p.name not in assignment:
+                continue
+            raw = assignment[p.name]
+            if isinstance(p, Double):
+                out[p.name] = float(raw)
+            elif isinstance(p, Integer):
+                out[p.name] = int(float(raw))
+            else:
+                matches = [v for v in p.values if str(v) == str(raw)]
+                if not matches:
+                    raise ValueError(
+                        f"{p.name}: value {raw!r} not in {p.values}")
+                out[p.name] = matches[0]
+        return out
+
 
 class RandomSuggester:
     """Independent uniform (log-uniform for Double(log=True)) sampling."""
@@ -76,6 +97,10 @@ class RandomSuggester:
     def __init__(self, space: SearchSpace, seed: int = 0):
         self.space = space
         self._rng = np.random.default_rng(seed)
+
+    def advance(self, n: int) -> None:
+        """Skip past n prior suggestions (controller replay)."""
+        self.suggest(n)
 
     def suggest(self, n: int) -> list[Assignment]:
         out = []
@@ -125,6 +150,10 @@ class GridSuggester:
         self._grid = itertools.product(*axes)
         self._names = [p.name for p in space.parameters]
 
+    def advance(self, n: int) -> None:
+        """Skip past n prior suggestions (controller replay)."""
+        self.suggest(n)
+
     def suggest(self, n: int) -> list[Assignment]:
         out = []
         for combo in itertools.islice(self._grid, n):
@@ -132,7 +161,156 @@ class GridSuggester:
         return out
 
 
-SUGGESTERS = {"random": RandomSuggester, "grid": GridSuggester}
+class TpeSuggester:
+    """Tree-structured Parzen Estimator (Bergstra et al. 2011) — the
+    algorithm behind Katib's "tpe"/"bayesianoptimization" modes.
+
+    Completed trials split into a good set (top `gamma` fraction under
+    the goal) and a bad set; per dimension, Parzen/kernel densities
+    l(x) (good) and g(x) (bad) are fit, candidates are drawn from l and
+    the candidate maximizing l(x)/g(x) wins — "look like the good
+    trials, not like the bad ones". With fewer than `min_observations`
+    results it falls back to seeded random exploration.
+
+    Controller protocol: the suggester is recreated every reconcile.
+    `observe()` feeds finished-trial (assignment, value) pairs; the
+    replay call `suggest(len(existing_trials))` only advances an
+    internal counter that salts the RNG, so fresh batches never repeat
+    earlier randomness — cheap, and observation-dependent suggestions
+    need no replayability (existing trials are already pinned to their
+    assignments in the store).
+    """
+
+    def __init__(self, space: SearchSpace, seed: int = 0,
+                 gamma: float = 0.25, n_candidates: int = 24,
+                 min_observations: int = 8):
+        self.space = space
+        self.seed = seed
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.min_observations = min_observations
+        self._good: list[Assignment] = []
+        self._bad: list[Assignment] = []
+        self._counter = 0
+
+    def observe(self, observations: Sequence[tuple[Assignment, float]],
+                goal: str) -> None:
+        if not observations:
+            return
+        ranked = sorted(
+            observations, key=lambda av: av[1],
+            reverse=(goal == "maximize"))
+        n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
+        self._good = [a for a, _ in ranked[:n_good]]
+        self._bad = [a for a, _ in ranked[n_good:]]
+
+    # -- per-dimension Parzen machinery -----------------------------------
+
+    def _to_unit(self, p: Parameter, v: Any) -> float:
+        """Map a Double/Integer value into [0, 1] (log-aware)."""
+        if isinstance(p, Double) and p.log:
+            return ((math.log(v) - math.log(p.min))
+                    / (math.log(p.max) - math.log(p.min)))
+        lo, hi = float(p.min), float(p.max)
+        return (float(v) - lo) / max(hi - lo, 1e-12)
+
+    def _from_unit(self, p: Parameter, u: float) -> Any:
+        u = min(max(u, 0.0), 1.0)
+        if isinstance(p, Double):
+            if p.log:
+                v = float(math.exp(
+                    math.log(p.min)
+                    + u * (math.log(p.max) - math.log(p.min))))
+            else:
+                v = float(p.min + u * (p.max - p.min))
+            # exp/log round-trips can land an ulp past the declared
+            # domain; suggestions must honor it exactly
+            return min(max(v, p.min), p.max)
+        return int(round(p.min + u * (p.max - p.min)))
+
+    @staticmethod
+    def _kde_logpdf(u: float, centers: list[float], bw: float) -> float:
+        """Parzen density MIXED with a uniform prior (weight 0.25).
+
+        The prior is load-bearing, not a nicety: where the bad set has
+        no mass (domain edges, under-explored regions) a bare KDE ratio
+        l/g explodes and every suggestion piles onto the clip boundary
+        — observed as 16/16 candidates at lr == max. The uniform floor
+        bounds the ratio where data is sparse, so the argmax lands
+        where the GOOD density actually peaks."""
+        if not centers:
+            return 0.0  # pure prior: uniform over the unit interval
+        kde = np.mean(np.exp(
+            -0.5 * ((u - np.asarray(centers)) / bw) ** 2
+        )) / (bw * math.sqrt(2 * math.pi))
+        return float(np.log(0.75 * kde + 0.25))
+
+    def _cat_probs(self, p: Categorical,
+                   assignments: list[Assignment]) -> np.ndarray:
+        counts = np.ones(len(p.values))  # +1 Dirichlet smoothing
+        for a in assignments:
+            if p.name in a:
+                counts[p.values.index(a[p.name])] += 1
+        return counts / counts.sum()
+
+    def advance(self, n: int) -> None:
+        """Controller replay: salt the RNG past n prior suggestions
+        WITHOUT scoring candidates that would be thrown away."""
+        self._counter += n
+
+    def suggest(self, n: int) -> list[Assignment]:
+        rng = np.random.default_rng((self.seed, self._counter))
+        self._counter += n
+        n_obs = len(self._good) + len(self._bad)
+        if n_obs < self.min_observations:
+            rand = RandomSuggester(self.space, seed=0)
+            rand._rng = rng
+            return rand.suggest(n)
+
+        bw = max(0.1, 1.0 / max(len(self._good), 1) ** 0.5)
+        # Per-dimension stats are invariant across candidates: one pass.
+        dim: dict[str, Any] = {}
+        for p in self.space.parameters:
+            if isinstance(p, Categorical):
+                dim[p.name] = (self._cat_probs(p, self._good),
+                               self._cat_probs(p, self._bad))
+            else:
+                dim[p.name] = (
+                    [self._to_unit(p, x[p.name])
+                     for x in self._good if p.name in x],
+                    [self._to_unit(p, x[p.name])
+                     for x in self._bad if p.name in x])
+        out = []
+        for _ in range(n):
+            best_a, best_score = None, -np.inf
+            for _ in range(self.n_candidates):
+                a: Assignment = {}
+                score = 0.0
+                for p in self.space.parameters:
+                    if isinstance(p, Categorical):
+                        lp, gp = dim[p.name]
+                        i = int(rng.choice(len(p.values), p=lp))
+                        a[p.name] = p.values[i]
+                        score += math.log(lp[i]) - math.log(gp[i])
+                    else:
+                        centers, bad_centers = dim[p.name]
+                        if centers:
+                            u = float(np.clip(
+                                rng.choice(centers)
+                                + bw * rng.standard_normal(), 0, 1))
+                        else:
+                            u = float(rng.uniform())
+                        a[p.name] = self._from_unit(p, u)
+                        score += (self._kde_logpdf(u, centers, bw)
+                                  - self._kde_logpdf(u, bad_centers, bw))
+                if score > best_score:
+                    best_a, best_score = a, score
+            out.append(best_a)
+        return out
+
+
+SUGGESTERS = {"random": RandomSuggester, "grid": GridSuggester,
+              "tpe": TpeSuggester, "bayesianoptimization": TpeSuggester}
 
 
 def make_suggester(algorithm: str, space: SearchSpace, **kwargs):
