@@ -93,8 +93,7 @@ class ExperimentController(Controller):
         if to_create > 0:
             try:
                 space = _space_from_spec(spec.parameters)
-                seeded = spec.algorithm in (
-                    "random", "tpe", "bayesianoptimization")
+                seeded = spec.algorithm in search_lib.SEEDED_ALGORITHMS
                 suggester = search_lib.make_suggester(
                     spec.algorithm, space,
                     **({"seed": spec.seed} if seeded else {}))

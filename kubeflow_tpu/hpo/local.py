@@ -19,6 +19,7 @@ import logging
 from typing import Any, Callable
 
 from kubeflow_tpu.hpo.search import (
+    SEEDED_ALGORITHMS,
     Assignment,
     SearchSpace,
     better,
@@ -73,11 +74,17 @@ def run_sweep(
     """Sequentially evaluate suggested assignments; exceptions in the
     objective mark the trial failed and the sweep continues."""
     better(goal, 0.0, 1.0)  # validates goal early
-    if algorithm == "random":
+    if algorithm in SEEDED_ALGORITHMS:
         algo_kwargs.setdefault("seed", seed)
     suggester = make_suggester(algorithm, space, **algo_kwargs)
     trials: list[TrialResult] = []
     while len(trials) < n_trials:
+        if hasattr(suggester, "observe"):
+            # Adaptive algorithms (TPE) must see finished results or
+            # they degrade to their random fallback forever.
+            suggester.observe(
+                [(t.assignment, t.value) for t in trials
+                 if t.value is not None], goal)
         batch = suggester.suggest(min(8, n_trials - len(trials)))
         if not batch:
             break  # grid exhausted

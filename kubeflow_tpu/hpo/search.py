@@ -71,17 +71,20 @@ class SearchSpace:
 
     def parse(self, assignment: Mapping[str, str]) -> Assignment:
         """Typed values from a Trial's string assignment (the CR stores
-        strings); unknown names are ignored, unmatched categorical
-        values raise."""
+        strings); unknown names are ignored, out-of-domain or unmatched
+        values raise ValueError (a hand-edited lr="0" on a log-scale
+        Double would otherwise detonate later inside TPE's math.log)."""
         out: Assignment = {}
         for p in self.parameters:
             if p.name not in assignment:
                 continue
             raw = assignment[p.name]
-            if isinstance(p, Double):
-                out[p.name] = float(raw)
-            elif isinstance(p, Integer):
-                out[p.name] = int(float(raw))
+            if isinstance(p, (Double, Integer)):
+                v = float(raw) if isinstance(p, Double) else int(float(raw))
+                if not p.min <= v <= p.max:
+                    raise ValueError(
+                        f"{p.name}: {v} outside [{p.min}, {p.max}]")
+                out[p.name] = v
             else:
                 matches = [v for v in p.values if str(v) == str(raw)]
                 if not matches:
@@ -311,6 +314,12 @@ class TpeSuggester:
 
 SUGGESTERS = {"random": RandomSuggester, "grid": GridSuggester,
               "tpe": TpeSuggester, "bayesianoptimization": TpeSuggester}
+# Algorithms whose constructor takes a seed — the single source of truth
+# for callers (Experiment controller, run_sweep) deciding whether to
+# thread spec.seed through; a new algorithm added above only needs this
+# set updated here, not at every call site.
+SEEDED_ALGORITHMS = frozenset(
+    {"random", "tpe", "bayesianoptimization"})
 
 
 def make_suggester(algorithm: str, space: SearchSpace, **kwargs):

@@ -328,6 +328,26 @@ def _bwd(causal, block_q, block_k, interpret, res, do4):
 # -------------------------------------------------------------- public API
 
 
+# Block-level entry points for ring attention (parallel.ring): the ring
+# composes per-KV-shard kernel calls itself — forward merges per-block
+# (o, lse) online, backward re-runs these kernels per visiting block
+# against the FINAL (o, lse) residuals, which is mathematically the
+# whole-sequence flash bwd split along KV blocks (p = exp(logits - LSE)
+# and delta = rowsum(do*o_final) are both global quantities).
+def flash_block_fwd(q4, k4, v4, *, causal, interpret,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """[b, n, s, hd] tensors -> (normalized o4, lse[b, nq, s, 128])."""
+    return _fwd(q4, k4, v4, causal=causal, block_q=block_q,
+                block_k=block_k, interpret=interpret)
+
+
+def flash_block_bwd(res, do4, *, causal, interpret,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """res = (q4, k4, v4, o4, lse128) — o4/lse may be the MERGED ring
+    totals; returns (dq4, dk4, dv4) with GQA group-summing applied."""
+    return _bwd(causal, block_q, block_k, interpret, res, do4)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q4, k4, v4, causal, block_q, block_k, interpret):
     o4, _ = _fwd(q4, k4, v4, causal=causal, block_q=block_q,

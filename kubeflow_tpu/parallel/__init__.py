@@ -24,6 +24,8 @@ from kubeflow_tpu.parallel.sharding import (
 from kubeflow_tpu.parallel.ring import (
     ring_attention,
     ring_attention_sharded,
+    ring_flash_attention,
+    ring_flash_attention_sharded,
     ulysses_attention,
     ulysses_attention_sharded,
 )

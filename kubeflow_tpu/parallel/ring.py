@@ -153,6 +153,196 @@ def ring_attention_sharded(
     return fn(q, k, v)
 
 
+# ------------------------------------------------- ring x flash kernel
+#
+# The XLA ring above materializes per-block [s_local, s_local] fp32
+# logits; this variant runs each block through the Pallas flash kernel
+# (ops.pallas.flash_attention) instead — fused online softmax in VMEM,
+# MXU fp32 accumulation — and adds a real skip: fully-future blocks
+# execute a zero-cost lax.cond branch rather than computing logits and
+# masking them to -inf.
+#
+# Backward is the ring-flash decomposition: flash's bwd formula with the
+# GLOBAL row lse and delta = rowsum(do * o_final) splits cleanly along
+# KV blocks, so the bwd ring re-runs the dq/dkv kernels per visiting
+# block against the final (o, lse) residuals. dk/dv accumulators rotate
+# WITH their blocks; after the last step one more hop lands each
+# accumulator back on its home device.
+
+
+def _lse_rows(lse128: jnp.ndarray) -> jnp.ndarray:
+    return lse128[..., 0]                        # [b, nq, s]
+
+
+def _merge_blocks(o, lse, o_i, lse_i):
+    """Online merge of normalized per-block (o, lse) pairs, -inf-safe."""
+    new = jnp.logaddexp(lse, lse_i)
+    w = jnp.where(lse == NEG_INF, 0.0, jnp.exp(lse - new))
+    w_i = jnp.where(lse_i == NEG_INF, 0.0, jnp.exp(lse_i - new))
+    return o * w[..., None] + o_i * w_i[..., None], new
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q4, k4, v4, axis_name, causal, interpret):
+    o4, _ = _ring_flash_fwd(q4, k4, v4, axis_name, causal, interpret)
+    return o4
+
+
+def _ring_flash_fwd(q4, k4, v4, axis_name, causal, interpret):
+    from kubeflow_tpu.ops.pallas.flash_attention import flash_block_fwd
+
+    size = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, nq, s, hd = q4.shape
+    o = jnp.zeros((b, nq, s, hd), jnp.float32)
+    lse = jnp.full((b, nq, s), NEG_INF, jnp.float32)
+    k_blk, v_blk = k4, v4
+    for i in range(size):
+        if i == 0:
+            # the diagonal block: local causal masking (or full when the
+            # whole attention is bidirectional)
+            o_i, lse_i = flash_block_fwd(
+                q4, k_blk, v_blk, causal=causal, interpret=interpret)
+            o_i, lse_i = o_i.astype(jnp.float32), _lse_rows(lse_i)
+        else:
+            def attend(kv):
+                oo, ll = flash_block_fwd(
+                    q4, kv[0], kv[1], causal=False, interpret=interpret)
+                return oo.astype(jnp.float32), _lse_rows(ll)
+
+            def skip(kv):
+                return (jnp.zeros((b, nq, s, hd), jnp.float32),
+                        jnp.full((b, nq, s), NEG_INF, jnp.float32))
+
+            if causal:
+                # block i hops old = from device my-i: past iff my >= i
+                o_i, lse_i = jax.lax.cond(
+                    my >= i, attend, skip, (k_blk, v_blk))
+            else:
+                o_i, lse_i = attend((k_blk, v_blk))
+        o, lse = _merge_blocks(o, lse, o_i, lse_i)
+        if i + 1 < size:
+            perm = [(d, (d + 1) % size) for d in range(size)]
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+    return o.astype(q4.dtype), lse
+
+
+def _ring_flash_fwd_vjp(q4, k4, v4, axis_name, causal, interpret):
+    o4, lse = _ring_flash_fwd(q4, k4, v4, axis_name, causal, interpret)
+    return o4, (q4, k4, v4, o4, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, interpret, res, do4):
+    from kubeflow_tpu.ops.pallas.flash_attention import flash_block_bwd
+
+    q4, k4, v4, o4, lse = res
+    size = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, nq, s, hd = q4.shape
+    nkv = k4.shape[1]
+    lse128 = jnp.broadcast_to(lse[..., None], (*lse.shape, 128))
+
+    dq = jnp.zeros((b, nq, s, hd), jnp.float32)
+    dk_acc = jnp.zeros((b, nkv, s, hd), jnp.float32)
+    dv_acc = jnp.zeros((b, nkv, s, hd), jnp.float32)
+    k_blk, v_blk = k4, v4
+    perm = [(d, (d + 1) % size) for d in range(size)]
+    for i in range(size):
+        if i == 0:
+            dq_i, dk_i, dv_i = flash_block_bwd(
+                (q4, k_blk, v_blk, o4, lse128), do4,
+                causal=causal, interpret=interpret)
+        else:
+            def backprop(kv):
+                a, bb, c = flash_block_bwd(
+                    (q4, kv[0], kv[1], o4, lse128), do4,
+                    causal=False, interpret=interpret)
+                return (a.astype(jnp.float32), bb.astype(jnp.float32),
+                        c.astype(jnp.float32))
+
+            def skip(kv):
+                return (jnp.zeros((b, nq, s, hd), jnp.float32),
+                        jnp.zeros((b, nkv, s, hd), jnp.float32),
+                        jnp.zeros((b, nkv, s, hd), jnp.float32))
+
+            if causal:
+                dq_i, dk_i, dv_i = jax.lax.cond(
+                    my >= i, backprop, skip, (k_blk, v_blk))
+            else:
+                dq_i, dk_i, dv_i = backprop((k_blk, v_blk))
+        dq = dq + dq_i.astype(jnp.float32)
+        dk_acc = dk_acc + dk_i.astype(jnp.float32)
+        dv_acc = dv_acc + dv_i.astype(jnp.float32)
+        # Accumulators travel WITH their block; the rotation after the
+        # final step is the hop that returns each accumulator home (the
+        # K/V blocks themselves are dead after the last step — no hop).
+        if i + 1 < size:
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+    return (dq.astype(q4.dtype), dk_acc.astype(k4.dtype),
+            dv_acc.astype(v4.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_fwd_vjp, _ring_flash_bwd)
+
+
+def ring_flash_attention(
+    q: jnp.ndarray,  # [b, s_local, n_q, hd]
+    k: jnp.ndarray,  # [b, s_local, n_kv, hd]
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool = True,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Ring attention with Pallas flash blocks. Call inside shard_map;
+    same contract as `ring_attention` (global sequence = shard
+    concatenation in axis order), differentiable via the ring-flash
+    custom VJP. `interpret=None` auto-selects interpreter mode off-TPU."""
+    if interpret is None:
+        from kubeflow_tpu.ops.pallas.flash_attention import (
+            _interpret_default)
+
+        interpret = _interpret_default()
+    q4 = jnp.transpose(q, (0, 2, 1, 3))
+    k4 = jnp.transpose(k, (0, 2, 1, 3))
+    v4 = jnp.transpose(v, (0, 2, 1, 3))
+    o4 = _ring_flash(q4, k4, v4, axis_name, causal, interpret)
+    return jnp.transpose(o4, (0, 2, 1, 3))
+
+
+def ring_flash_attention_sharded(
+    q: jnp.ndarray,  # [b, s_global, n_q, hd]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    seq_axis: str = mesh_lib.FSDP_AXIS,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """shard_map wrapper for ring_flash_attention (see
+    ring_attention_sharded for the layout contract)."""
+    n = mesh.shape[seq_axis]
+    if q.shape[1] % n != 0:
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by "
+            f"{seq_axis}={n}"
+        )
+    spec = P(None, seq_axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_flash_attention, axis_name=seq_axis,
+                          causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
 def ulysses_attention(
     q: jnp.ndarray,  # [b, s_local, n_q, hd]
     k: jnp.ndarray,  # [b, s_local, n_kv, hd]
