@@ -1,5 +1,7 @@
-"""Data layer: native token-shard loader with a pure-Python fallback."""
+"""Data layer: BPE tokenizer + native token-shard loader (with a
+pure-Python fallback)."""
 
+from kubeflow_tpu.data.bpe import Tokenizer, train as train_tokenizer
 from kubeflow_tpu.data.loader import (
     PyTokenLoader,
     TokenShardLoader,

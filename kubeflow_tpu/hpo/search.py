@@ -80,11 +80,13 @@ class SearchSpace:
                 continue
             raw = assignment[p.name]
             if isinstance(p, (Double, Integer)):
-                v = float(raw) if isinstance(p, Double) else int(float(raw))
+                # range-check BEFORE integer truncation: "5.9" against
+                # max=5 must raise, not silently become 5
+                v = float(raw)
                 if not p.min <= v <= p.max:
                     raise ValueError(
                         f"{p.name}: {v} outside [{p.min}, {p.max}]")
-                out[p.name] = v
+                out[p.name] = v if isinstance(p, Double) else int(v)
             else:
                 matches = [v for v in p.values if str(v) == str(raw)]
                 if not matches:

@@ -44,12 +44,32 @@ def byte_decode(tokens: list[int]) -> str:
     return raw.decode("utf-8", errors="replace")
 
 
-def create_serving_app(engines: dict[str, InferenceEngine]) -> web.Application:
+ENGINES_KEY: web.AppKey = web.AppKey("engines", dict)
+GPU_LOCK_KEY: web.AppKey = web.AppKey("gpu_lock", asyncio.Lock)
+TOKENIZER_KEY: web.AppKey = web.AppKey("tokenizer", object)
+
+
+def create_serving_app(engines: dict[str, InferenceEngine],
+                       *, tokenizer=None) -> web.Application:
+    """`tokenizer` (data.bpe.Tokenizer or anything with encode/decode)
+    serves the "text" request mode; without one, the zero-training
+    byte-level fallback applies."""
     app = web.Application()
-    app["engines"] = engines
+    app[ENGINES_KEY] = engines
+    tok_vocab = getattr(tokenizer, "vocab_size", None)
+    if tok_vocab is not None:
+        # Fail at startup, not per request: a tokenizer whose ids exceed
+        # a model's vocab would 400 every text request with a confusing
+        # "token ids must be in range" error.
+        for name, eng in engines.items():
+            if tok_vocab > eng.cfg.vocab_size:
+                raise ValueError(
+                    f"tokenizer vocab {tok_vocab} exceeds model "
+                    f"{name!r} vocab {eng.cfg.vocab_size}")
+    app[TOKENIZER_KEY] = tokenizer
     # One inference at a time per process: the device is the bottleneck,
     # and interleaved generate calls would just thrash compile caches.
-    app["gpu_lock"] = asyncio.Lock()
+    app[GPU_LOCK_KEY] = asyncio.Lock()
     app.router.add_get("/healthz", _ok)
     app.router.add_get("/readyz", _ok)
     app.router.add_get("/v1/models", list_models)
@@ -63,7 +83,7 @@ async def _ok(request: web.Request):
 
 async def list_models(request: web.Request):
     out = []
-    for name, eng in request.app["engines"].items():
+    for name, eng in request.app[ENGINES_KEY].items():
         out.append({
             "name": name,
             "family": eng.family.name,
@@ -77,7 +97,7 @@ async def list_models(request: web.Request):
 
 async def generate(request: web.Request):
     name = request.match_info["name"]
-    engine = request.app["engines"].get(name)
+    engine = request.app[ENGINES_KEY].get(name)
     if engine is None:
         return web.json_response(
             {"error": f"no model {name!r}"}, status=404)
@@ -86,12 +106,14 @@ async def generate(request: web.Request):
     except Exception:
         return web.json_response({"error": "invalid JSON"}, status=400)
 
+    tokenizer = request.app[TOKENIZER_KEY]
     text_mode = "text" in body
     if text_mode:
         if not isinstance(body["text"], str):
             return web.json_response({"error": "'text' must be a string"},
                                      status=400)
-        token_lists = [byte_encode(body["text"])]
+        token_lists = [tokenizer.encode(body["text"], bos=True)
+                       if tokenizer else byte_encode(body["text"])]
     elif "tokens" in body:
         token_lists = body["tokens"]
         if (not isinstance(token_lists, list) or not token_lists
@@ -161,7 +183,7 @@ async def generate(request: web.Request):
         return web.json_response(
             {"error": f"token ids must be in [0, {vocab})"}, status=400)
 
-    async with request.app["gpu_lock"]:
+    async with request.app[GPU_LOCK_KEY]:
         toks = await asyncio.get_event_loop().run_in_executor(
             None,
             lambda: np.asarray(
@@ -170,5 +192,6 @@ async def generate(request: web.Request):
         )
     resp: dict[str, Any] = {"tokens": toks.tolist()}
     if text_mode:
-        resp["text"] = byte_decode(toks[0].tolist())
+        resp["text"] = (tokenizer.decode(toks[0].tolist()) if tokenizer
+                        else byte_decode(toks[0].tolist()))
     return web.json_response(resp)

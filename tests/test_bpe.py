@@ -1,0 +1,117 @@
+"""Byte-level BPE tokenizer: training, roundtrip, persistence."""
+
+import pytest
+
+from kubeflow_tpu.data import bpe
+
+pytest_plugins = ("aiohttp.pytest_plugin",)
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the dog barks at the quick fox",
+    "pack my box with five dozen liquor jugs",
+    "the five boxing wizards jump quickly",
+] * 8
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return bpe.train(CORPUS, vocab_size=256 + 64 + 3)
+
+
+def test_roundtrip_exact(tok):
+    for text in CORPUS + ["completely unseen text!", "  spaces  galore  "]:
+        assert tok.decode(tok.encode(text)) == text, text
+
+
+def test_unicode_roundtrip_via_byte_fallback(tok):
+    text = "café ☃ \U0001F680 tokens"
+    assert tok.decode(tok.encode(text)) == text
+
+
+def test_training_compresses_and_is_deterministic():
+    tok1 = bpe.train(CORPUS, vocab_size=256 + 64 + 3)
+    tok2 = bpe.train(CORPUS, vocab_size=256 + 64 + 3)
+    assert tok1.merges == tok2.merges
+    text = CORPUS[0]
+    n_ids = len(tok1.encode(text))
+    assert n_ids < len(text.encode("utf-8")) * 0.7, (
+        n_ids, len(text.encode()))
+    # " the" (leading-space convention) should be a learned unit
+    the = tok1.encode(" the")
+    assert len(the) == 1, the
+
+
+def test_vocab_ids_and_specials(tok):
+    assert tok.vocab_size == 256 + len(tok.merges) + 3
+    assert tok.pad_id == 256 + len(tok.merges)
+    assert tok.eos_id == tok.special_id("<eos>")
+    ids = tok.encode("hi", bos=True, eos=True)
+    assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+    # specials are dropped on decode, text is preserved
+    assert tok.decode(ids) == "hi"
+    assert all(0 <= i < tok.vocab_size for i in ids)
+
+
+def test_save_load_roundtrip(tok, tmp_path):
+    p = tmp_path / "tok.json"
+    tok.save(str(p))
+    tok2 = bpe.Tokenizer.load(str(p))
+    assert tok2.merges == tok.merges
+    text = "the quick brown fox"
+    assert tok2.encode(text) == tok.encode(text)
+    with pytest.raises(ValueError, match="version"):
+        bpe.Tokenizer.loads('{"version": 9}')
+
+
+def test_vocab_size_too_small_rejected():
+    with pytest.raises(ValueError, match="smaller than"):
+        bpe.train(CORPUS, vocab_size=100)
+
+
+async def test_serving_text_mode_uses_tokenizer(tok, loop):
+    """create_serving_app(tokenizer=...) routes the "text" request mode
+    through the trained BPE instead of the byte fallback."""
+    import jax
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.serving import (EngineConfig, InferenceEngine,
+                                      LLAMA_FAMILY)
+    from kubeflow_tpu.serving import server as server_lib
+
+    import dataclasses
+    cfg = dataclasses.replace(llama.LLAMA_TINY,
+                              vocab_size=max(512, tok.vocab_size))
+    eng = InferenceEngine(llama.init(jax.random.key(0), cfg), cfg,
+                          LLAMA_FAMILY, EngineConfig(max_len=64))
+    app = server_lib.create_serving_app({"m": eng}, tokenizer=tok)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    r = await client.post("/v1/models/m:generate",
+                          json={"text": "the quick fox", "max_new": 4})
+    assert r.status == 200, await r.text()
+    out = await r.json()
+    # prompt was BPE-encoded (few ids), reply decodes through the same
+    # tokenizer into a real string
+    assert isinstance(out["text"], str)
+    assert len(out["tokens"][0]) == 4
+    prompt_ids = tok.encode("the quick fox", bos=True)
+    assert len(prompt_ids) < len("the quick fox") + 1
+    await client.close()
+
+    # a tokenizer bigger than the model's vocab is a deploy-time error
+    small = dataclasses.replace(cfg, vocab_size=tok.vocab_size - 1)
+    small_eng = InferenceEngine(llama.init(jax.random.key(1), small),
+                                small, LLAMA_FAMILY,
+                                EngineConfig(max_len=64))
+    with pytest.raises(ValueError, match="exceeds model"):
+        server_lib.create_serving_app({"s": small_eng}, tokenizer=tok)
+
+
+def test_merge_starved_corpus_stops_early():
+    # a corpus with no repeated pairs cannot fill the requested vocab
+    tok = bpe.train(["ab"], vocab_size=256 + 50 + 3)
+    assert len(tok.merges) <= 1
+    assert tok.decode(tok.encode("ab")) == "ab"

@@ -114,6 +114,10 @@ def test_search_space_parse_roundtrip():
     assert parsed == {"lr": 0.003, "layers": 3, "opt": "sgd"}
     with pytest.raises(ValueError, match="rmsprop"):
         SPACE.parse({"opt": "rmsprop"})
+    with pytest.raises(ValueError, match="outside"):
+        SPACE.parse({"lr": "0"})        # log-scale Double, min 1e-4
+    with pytest.raises(ValueError, match="outside"):
+        SPACE.parse({"layers": "4.9"})  # must not truncate into range
 
 
 def test_search_space_validation():
