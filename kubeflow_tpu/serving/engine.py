@@ -23,6 +23,7 @@ from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from kubeflow_tpu.ops.attention import dot_product_attention
 from kubeflow_tpu.ops.embedding import embed_lookup
@@ -117,15 +118,30 @@ def filter_logits(logits: jnp.ndarray, top_k: jnp.ndarray,
 
 
 class DecodeState:
-    """KV cache + cursor, a pytree (jit-carryable)."""
+    """KV cache + cursor, a pytree (jit-carryable).
 
-    def __init__(self, k, v, length):
+    `pad` marks cache slots holding left-pad keys (excluded from
+    attention); `offset` is each row's pad count, so a token in slot i
+    has LOGICAL position i - offset (what rope sees). Both stay zero
+    for unpadded batches — the variable-length path costs nothing when
+    unused."""
+
+    def __init__(self, k, v, length, pad=None, offset=None):
         self.k = k              # [L, b, max_len, n_kv, hd]
         self.v = v
         self.length = length    # [] int32 — filled slots
+        # Only touch k.shape when defaulting: tree_unflatten passes all
+        # five children, whose leaves may be non-arrays mid-transform
+        # (jax.tree.map over dtypes etc.).
+        if pad is None:
+            pad = jnp.zeros((k.shape[1], k.shape[2]), bool)
+        if offset is None:
+            offset = jnp.zeros((k.shape[1],), jnp.int32)
+        self.pad = pad
+        self.offset = offset
 
     def tree_flatten(self):
-        return (self.k, self.v, self.length), None
+        return (self.k, self.v, self.length, self.pad, self.offset), None
 
     @classmethod
     def tree_unflatten(cls, _, children):
@@ -173,21 +189,38 @@ class InferenceEngine:
         return x.astype(jnp.float32) @ head.astype(jnp.float32)
 
     def _forward_cached(self, tokens, state: DecodeState, *,
-                        return_all: bool = False):
+                        prompt_mask=None, return_all: bool = False):
         """Run [b, s] tokens starting at state.length; returns
         (last-position logits [b, vocab], updated state) — or all
         positions' logits [b, s, vocab] with return_all (speculative
-        decoding scores every drafted position in one pass)."""
+        decoding scores every drafted position in one pass).
+
+        `prompt_mask` [b, s] bool (False = pad) enables variable-length
+        rows in one batch. Pads must be LEFT-aligned (the final column
+        is what the next-token logits read) — pad slots are excluded
+        from every later attention and rope sees logical positions
+        (slot - pad count), so a padded row computes exactly what the
+        unpadded prompt would."""
         cfg, fam, params = self.cfg, self.family, self.params
         b, s = tokens.shape
         start = state.length
+        # Slot positions order the cache for causal masking; rope gets
+        # logical positions (slot - offset) so padding never shifts a
+        # token's rotary phase.
         positions = start + jnp.arange(s, dtype=jnp.int32)[None, :]
         positions = jnp.broadcast_to(positions, (b, s))
+        pad, offset = state.pad, state.offset
+        if prompt_mask is not None:
+            offset = offset + jnp.sum(
+                ~prompt_mask, axis=1, dtype=jnp.int32)
+            pad = jax.lax.dynamic_update_slice(
+                pad, ~prompt_mask, (0, start))
+        rope_positions = jnp.maximum(positions - offset[:, None], 0)
         inv_freq = rope_frequencies(cfg.head_dim, theta=cfg.rope_theta)
         kv_positions = jnp.broadcast_to(
             jnp.arange(self.ec.max_len, dtype=jnp.int32)[None, :],
             (b, self.ec.max_len))
-        kv_valid = kv_positions < (start + s)
+        kv_valid = (kv_positions < (start + s)) & ~pad
 
         x = self._embed(tokens)
 
@@ -200,8 +233,8 @@ class InferenceEngine:
                 b, s, cfg.num_kv_heads, cfg.head_dim)
             v = (h @ p["wv"].astype(cfg.dtype)).reshape(
                 b, s, cfg.num_kv_heads, cfg.head_dim)
-            q = apply_rope(q, positions, inv_freq)
-            k = apply_rope(k, positions, inv_freq)
+            q = apply_rope(q, rope_positions, inv_freq)
+            k = apply_rope(k, rope_positions, inv_freq)
             k_cache = jax.lax.dynamic_update_slice(
                 k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
             v_cache = jax.lax.dynamic_update_slice(
@@ -221,7 +254,7 @@ class InferenceEngine:
             layer, x, (params["blocks"], state.k, state.v))
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
         logits = self._head(x if return_all else x[:, -1])
-        return logits, DecodeState(k_new, v_new, start + s)
+        return logits, DecodeState(k_new, v_new, start + s, pad, offset)
 
     # -- public API --------------------------------------------------------
 
@@ -281,11 +314,12 @@ class InferenceEngine:
                 rng = jax.random.key(0)
         return sp, rng
 
-    def _generate(self, prompt, state, rng, sp: SamplingParams, *,
-                  max_new: int):
+    def _generate(self, prompt, state, rng, sp: SamplingParams,
+                  prompt_mask, *, max_new: int):
         eos = self.ec.eos_token
         rng, sub = jax.random.split(rng)  # use-once key discipline
-        logits, state = self._forward_cached(prompt, state)
+        logits, state = self._forward_cached(
+            prompt, state, prompt_mask=prompt_mask)
         first = self._sample(logits, sub, sp)
         done0 = (first == eos) if eos is not None else jnp.zeros(
             first.shape, bool)
@@ -317,19 +351,34 @@ class InferenceEngine:
         temperature: float | None = None,
         top_k: int | None = None,
         top_p: float | None = None,
+        prompt_mask: jnp.ndarray | None = None,  # [b, s] bool, False=pad
     ) -> jnp.ndarray:
         """Generate `max_new` tokens after the prompt. Returns [b, max_new]
         (post-hoc EOS trimming is the caller's job — shapes stay static).
 
         temperature/top_k/top_p default from EngineConfig; per-call
-        overrides are dynamic (no recompile across values)."""
+        overrides are dynamic (no recompile across values).
+        `prompt_mask` batches variable-length prompts: pads LEFT-aligned
+        (False entries), each row decodes as if it were unpadded."""
         b, s = prompt_tokens.shape
         if s + max_new > self.ec.max_len:
             raise ValueError(
                 f"prompt {s} + max_new {max_new} exceeds cache bucket "
                 f"{self.ec.max_len}")
+        if prompt_mask is not None:
+            if prompt_mask.shape != (b, s):
+                raise ValueError(
+                    f"prompt_mask shape {prompt_mask.shape} != {(b, s)}")
+            m = np.asarray(prompt_mask, bool)
+            if not (np.sort(m, axis=1) == m).all() or not m[:, -1].all():
+                raise ValueError(
+                    "prompt_mask pads must be LEFT-aligned (False... "
+                    "then True...) with a real final token per row")
+            prompt_mask = jnp.asarray(m)
+        else:
+            prompt_mask = jnp.ones((b, s), bool)
         sp, rng = self._resolve_sampling(temperature, top_k, top_p, rng)
         state = self.init_state(b)
         toks, _ = self._generate_jit(
-            prompt_tokens, state, rng, sp, max_new=max_new)
+            prompt_tokens, state, rng, sp, prompt_mask, max_new=max_new)
         return toks

@@ -47,13 +47,145 @@ def byte_decode(tokens: list[int]) -> str:
 ENGINES_KEY: web.AppKey = web.AppKey("engines", dict)
 GPU_LOCK_KEY: web.AppKey = web.AppKey("gpu_lock", asyncio.Lock)
 TOKENIZER_KEY: web.AppKey = web.AppKey("tokenizer", object)
+BATCHERS_KEY: web.AppKey = web.AppKey("batchers", dict)
+
+
+class Batcher:
+    """Dynamic request batching for one engine: concurrent generate
+    requests collected within a small window run as ONE padded batch.
+
+    Decode reads every weight once per step regardless of batch size, so
+    co-scheduling N requests costs ~one request's bandwidth — the
+    classic serving-throughput lever. Variable prompt lengths ride the
+    engine's left-padded prompt_mask path; requests are grouped by
+    sampling knobs (one SamplingParams per compiled batch) and run to
+    the group's max max_new (each caller trims to its own ask).
+    """
+
+    def __init__(self, engine: InferenceEngine, gpu_lock: asyncio.Lock,
+                 *, window_ms: float = 5.0, max_batch: int = 8):
+        self.engine = engine
+        self.gpu_lock = gpu_lock
+        self.window_s = window_ms / 1000.0
+        self.max_batch = max_batch
+        self.calls = 0            # engine invocations (observability)
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._worker: asyncio.Task | None = None
+
+    async def submit(self, tokens: list[int], max_new: int,
+                     sampling: tuple) -> list[int]:
+        if self._worker is None or self._worker.done():
+            self._worker = asyncio.get_event_loop().create_task(
+                self._run())
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        await self._queue.put((tokens, max_new, sampling, fut))
+        return await fut
+
+    async def _run(self):
+        while True:
+            first = await self._queue.get()
+            await asyncio.sleep(self.window_s)  # let siblings arrive
+            batch = [first]
+            while (len(batch) < self.max_batch
+                   and not self._queue.empty()):
+                batch.append(self._queue.get_nowait())
+            # one generate per sampling group (sp applies batch-wide),
+            # split further so padded prompt + group max_new never
+            # exceeds the cache bucket (each request alone fits; their
+            # COMBINATION might not)
+            groups: dict[tuple, list] = {}
+            for item in batch:
+                groups.setdefault(item[2], []).append(item)
+            cap = self.engine.ec.max_len
+            for sampling, items in groups.items():
+                sub: list = []
+                for item in items:
+                    trial = sub + [item]
+                    need = (max(len(t) for t, _, _, _ in trial)
+                            + max(mn for _, mn, _, _ in trial))
+                    if sub and need > cap:
+                        await self._run_group(sampling, sub)
+                        sub = [item]
+                    else:
+                        sub = trial
+                if sub:
+                    await self._run_group(sampling, sub)
+
+    @staticmethod
+    def _bucket(n: int, cap: int) -> int:
+        """Round up to a power of two (>= 16), capped: bounded compile
+        shapes instead of one compile per novel (longest, max_new)."""
+        b = 16
+        while b < n:
+            b *= 2
+        return min(b, cap)
+
+    async def _run_group(self, sampling: tuple, items: list) -> None:
+        cap = self.engine.ec.max_len
+        longest = max(len(t) for t, _, _, _ in items)
+        max_new = max(mn for _, mn, _, _ in items)
+        # Bucket both dims so mixed traffic reuses a handful of
+        # compiled shapes; extra prompt columns are masked pads, extra
+        # new tokens are trimmed per request. Fall back to exact sizes
+        # when the buckets would not fit the cache.
+        max_new_b = self._bucket(max_new, cap - longest)
+        longest_b = self._bucket(longest, cap - max_new_b)
+        if longest_b < longest or max_new_b < max_new:
+            longest_b, max_new_b = longest, max_new
+        rows = 1
+        while rows < len(items):
+            rows *= 2  # batch dim buckets too (dummy rows, outputs dropped)
+        arr = np.zeros((rows, longest_b), np.int32)
+        mask = np.zeros((rows, longest_b), bool)
+        mask[:, -1] = True  # dummy rows need one real token
+        for i, (toks, _, _, _) in enumerate(items):
+            mask[i, :] = False
+            arr[i, longest_b - len(toks):] = toks
+            mask[i, longest_b - len(toks):] = True
+        max_new = max_new_b
+
+        def run():
+            return np.asarray(self.engine.generate(
+                jnp.asarray(arr), max_new=max_new,
+                prompt_mask=jnp.asarray(mask),
+                **dict(sampling)))
+
+        try:
+            async with self.gpu_lock:
+                out = await asyncio.get_event_loop().run_in_executor(
+                    None, run)
+            self.calls += 1
+            for i, (_, mn, _, fut) in enumerate(items):
+                if not fut.done():
+                    fut.set_result(out[i, :mn].tolist())
+        except Exception as e:  # noqa: BLE001 — fail the waiting requests
+            for _, _, _, fut in items:
+                if not fut.done():
+                    fut.set_exception(e)
+
+    async def close(self) -> None:
+        """Cancel the worker and fail anything still queued (app
+        cleanup; without this, shutdown strands queued futures)."""
+        if self._worker is not None:
+            self._worker.cancel()
+            try:
+                await self._worker
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        while not self._queue.empty():
+            _, _, _, fut = self._queue.get_nowait()
+            if not fut.done():
+                fut.set_exception(RuntimeError("server shutting down"))
 
 
 def create_serving_app(engines: dict[str, InferenceEngine],
-                       *, tokenizer=None) -> web.Application:
+                       *, tokenizer=None, batch_window_ms: float = 0.0,
+                       max_batch: int = 8) -> web.Application:
     """`tokenizer` (data.bpe.Tokenizer or anything with encode/decode)
     serves the "text" request mode; without one, the zero-training
-    byte-level fallback applies."""
+    byte-level fallback applies. `batch_window_ms > 0` enables dynamic
+    request batching: concurrent single-prompt requests within the
+    window run as one padded batch per sampling group."""
     app = web.Application()
     app[ENGINES_KEY] = engines
     tok_vocab = getattr(tokenizer, "vocab_size", None)
@@ -69,7 +201,19 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     app[TOKENIZER_KEY] = tokenizer
     # One inference at a time per process: the device is the bottleneck,
     # and interleaved generate calls would just thrash compile caches.
-    app[GPU_LOCK_KEY] = asyncio.Lock()
+    lock = asyncio.Lock()
+    app[GPU_LOCK_KEY] = lock
+    app[BATCHERS_KEY] = (
+        {name: Batcher(eng, lock, window_ms=batch_window_ms,
+                       max_batch=max_batch)
+         for name, eng in engines.items()}
+        if batch_window_ms > 0 else {})
+
+    async def _close_batchers(app_):
+        for b in app_[BATCHERS_KEY].values():
+            await b.close()
+
+    app.on_cleanup.append(_close_batchers)
     app.router.add_get("/healthz", _ok)
     app.router.add_get("/readyz", _ok)
     app.router.add_get("/v1/models", list_models)
@@ -183,13 +327,21 @@ async def generate(request: web.Request):
         return web.json_response(
             {"error": f"token ids must be in [0, {vocab})"}, status=400)
 
-    async with request.app[GPU_LOCK_KEY]:
-        toks = await asyncio.get_event_loop().run_in_executor(
-            None,
-            lambda: np.asarray(
-                engine.generate(jnp.asarray(arr), max_new=max_new,
-                                **sampling)),
-        )
+    batcher = request.app[BATCHERS_KEY].get(name)
+    if batcher is not None and arr.shape[0] == 1:
+        # single-prompt requests ride the dynamic batcher; explicit
+        # client-side batches keep their one-shot path
+        ids = await batcher.submit(
+            arr[0].tolist(), max_new, tuple(sorted(sampling.items())))
+        toks = np.asarray([ids], np.int32)
+    else:
+        async with request.app[GPU_LOCK_KEY]:
+            toks = await asyncio.get_event_loop().run_in_executor(
+                None,
+                lambda: np.asarray(
+                    engine.generate(jnp.asarray(arr), max_new=max_new,
+                                    **sampling)),
+            )
     resp: dict[str, Any] = {"tokens": toks.tolist()}
     if text_mode:
         resp["text"] = (tokenizer.decode(toks[0].tolist()) if tokenizer

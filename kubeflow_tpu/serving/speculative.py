@@ -209,10 +209,12 @@ class SpeculativeEngine:
             # wrote gamma+1 target slots (1+k valid), the draft wrote
             # gamma slots (min(1+k, gamma) valid).
             tstate = DecodeState(
-                tstate.k, tstate.v, tstate.length - gamma + k)
+                tstate.k, tstate.v, tstate.length - gamma + k,
+                tstate.pad, tstate.offset)
             dstate = DecodeState(
                 dstate.k, dstate.v,
-                dstate.length - gamma + jnp.minimum(1 + k, gamma))
+                dstate.length - gamma + jnp.minimum(1 + k, gamma),
+                dstate.pad, dstate.offset)
             # Full-window acceptance leaves the draft one token behind:
             # the scan fed [last, d_1..d_{gamma-1}], so d_gamma was never
             # processed and the next round's proposals would condition on
@@ -224,7 +226,8 @@ class SpeculativeEngine:
                 drafted[gamma - 1][None, None], dstate)
             dstate = DecodeState(
                 dfed.k, dfed.v,
-                jnp.where(k == gamma, dfed.length, dstate.length))
+                jnp.where(k == gamma, dfed.length, dstate.length),
+                dfed.pad, dfed.offset)
 
             return (tstate, dstate, out, n + k + 1, extra, rng,
                     acc + k, prop + jnp.asarray(gamma, jnp.int32))
