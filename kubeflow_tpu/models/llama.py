@@ -48,6 +48,11 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
+    # Sliding-window attention (Mistral-style): each position attends
+    # its last `sliding_window` tokens. None = full causal. Applied to
+    # every layer; both the XLA and Pallas paths honor it, and the
+    # flash kernel skips out-of-band blocks entirely.
+    sliding_window: int | None = None
     dtype: Any = jnp.bfloat16      # activation dtype
     param_dtype: Any = jnp.float32
     remat: bool = True
@@ -173,6 +178,7 @@ def _block(cfg: LlamaConfig, x, layer_params, positions, inv_freq, kv_mask,
     k = wsc(k, ("batch", "seq", "act_kv_heads", None))
     attn = dot_product_attention(q, k, v, positions, positions,
                                  causal=True, kv_mask=kv_mask,
+                                 window=cfg.sliding_window,
                                  contiguous_positions=contiguous_positions)
     attn = attn.reshape(b, s, cfg.q_dim)
     x = x + attn @ p["wo"].astype(cfg.dtype)

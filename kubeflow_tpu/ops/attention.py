@@ -42,6 +42,7 @@ def _xla_attention(
     *,
     causal: bool,
     kv_mask: jnp.ndarray | None,  # [b, skv] bool, False = padded/invalid
+    window: int | None = None,
 ) -> jnp.ndarray:
     b, sq, n_q, hd = q.shape
     n_kv = k.shape[2]
@@ -58,6 +59,10 @@ def _xla_attention(
     mask = jnp.ones((b, sq, k.shape[1]), dtype=bool)
     if causal:
         mask &= q_positions[:, :, None] >= kv_positions[:, None, :]
+    if window is not None:
+        # sliding window: each query attends its last `window` positions
+        mask &= (q_positions[:, :, None]
+                 - kv_positions[:, None, :]) < window
     if kv_mask is not None:
         mask &= kv_mask[:, None, :]
     logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
@@ -84,10 +89,13 @@ def dot_product_attention(
     *,
     causal: bool = True,
     kv_mask: jnp.ndarray | None = None,
+    window: int | None = None,
     impl: str = "auto",
     contiguous_positions: bool = False,
 ) -> jnp.ndarray:
-    """Grouped-query attention.
+    """Grouped-query attention. `window` limits each query to its last
+    `window` positions (sliding-window attention; requires causal) —
+    supported by both impls, position-based in XLA, index-based in flash.
 
     impl: "auto" | "xla" | "flash". "auto" picks the Pallas flash kernel on
     TPU for long sequences when it is safe: kernel present, no kv_mask, and
@@ -96,6 +104,10 @@ def dot_product_attention(
     per-segment position resets MUST take the XLA path, which masks by the
     actual position tensors.
     """
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal attention")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         long_seq = q.shape[1] >= 1024 and q.shape[1] % 512 == 0
@@ -118,7 +130,8 @@ def dot_product_attention(
             )
         from kubeflow_tpu.ops.pallas.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal, window=window)
     return _xla_attention(
-        q, k, v, q_positions, kv_positions, causal=causal, kv_mask=kv_mask
+        q, k, v, q_positions, kv_positions, causal=causal,
+        kv_mask=kv_mask, window=window,
     )

@@ -33,11 +33,29 @@ from jax.experimental.pallas import tpu as pltpu
 from kubeflow_tpu.ops.attention import NEG_INF
 
 
-def _apply_causal_mask(logits, qi, ki, block_q, block_k):
+def _apply_causal_mask(logits, qi, ki, block_q, block_k, window):
     rows = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-    mask = (qi * block_q + rows) >= (ki * block_k + cols)
+    q_pos = qi * block_q + rows
+    k_pos = ki * block_k + cols
+    mask = q_pos >= k_pos
+    if window is not None:
+        # sliding window: attend the last `window` positions (self incl.)
+        mask &= (q_pos - k_pos) < window
     return jnp.where(mask, logits, NEG_INF)
+
+
+def _block_relevant(qi, ki, block_q, block_k, window):
+    """Trace-time predicate: does (q block, k block) intersect the
+    causal band at all? Above-diagonal blocks skip always; with a
+    window, blocks entirely OLDER than the band skip too."""
+    newest_q = qi * block_q + block_q - 1
+    keep = ki * block_k <= newest_q
+    if window is not None:
+        oldest_q = qi * block_q
+        newest_k = ki * block_k + block_k - 1
+        keep &= newest_k > oldest_q - window
+    return keep
 
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
@@ -58,7 +76,7 @@ def _pick_block(s: int, block: int) -> int:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
-                *, scale, causal, block_q, block_k, nk):
+                *, scale, causal, window, block_q, block_k, nk):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -76,7 +94,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
             preferred_element_type=jnp.float32,
         ) * scale                                     # [bq, bk]
         if causal:
-            logits = _apply_causal_mask(logits, qi, ki, block_q, block_k)
+            logits = _apply_causal_mask(logits, qi, ki, block_q, block_k,
+                                        window)
 
         m_prev = m_scr[:, 0]                          # [bq]
         m_new = jnp.maximum(m_prev, jnp.max(logits, axis=1))
@@ -90,8 +109,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
         l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
 
     if causal:
-        # Skip compute for blocks strictly above the diagonal.
-        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        # Skip blocks strictly above the diagonal, and (with a sliding
+        # window) blocks entirely older than the attention band.
+        @pl.when(_block_relevant(qi, ki, block_q, block_k, window))
         def _():
             compute()
     else:
@@ -109,7 +129,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
         lse_ref[0, 0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[2:])
 
 
-def _fwd(q4, k4, v4, *, causal, block_q, block_k, interpret):
+def _fwd(q4, k4, v4, *, causal, window, block_q, block_k, interpret):
     """q4: [b, nq, s, hd]; k4/v4: [b, nkv, s, hd] → (o4, lse[b, nq, s])."""
     b, nq, s, hd = q4.shape
     nkv = k4.shape[1]
@@ -138,7 +158,7 @@ def _fwd(q4, k4, v4, *, causal, block_q, block_k, interpret):
     )
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal,
+        _fwd_kernel, scale=scale, causal=causal, window=window,
         block_q=block_q, block_k=block_k, nk=nkb,
     )
     o4, lse = pl.pallas_call(
@@ -164,7 +184,7 @@ def _fwd(q4, k4, v4, *, causal, block_q, block_k, interpret):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, scale, causal, block_q, block_k, nk):
+               dq_acc, *, scale, causal, window, block_q, block_k, nk):
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -183,7 +203,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            logits = _apply_causal_mask(logits, qi, ki, block_q, block_k)
+            logits = _apply_causal_mask(logits, qi, ki, block_q, block_k,
+                                        window)
         p = jnp.exp(logits - lse[:, None])            # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -193,7 +214,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_acc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32) * scale
 
     if causal:
-        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        @pl.when(_block_relevant(qi, ki, block_q, block_k, window))
         def _():
             compute()
     else:
@@ -206,7 +227,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc,
-                *, scale, causal, block_q, block_k, nq_blocks):
+                *, scale, causal, window, block_q, block_k, nq_blocks):
     ki, qi = pl.program_id(1), pl.program_id(2)
 
     @pl.when(qi == 0)
@@ -226,7 +247,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         ) * scale                                     # [bq, bk]
         if causal:
-            logits = _apply_causal_mask(logits, qi, ki, block_q, block_k)
+            logits = _apply_causal_mask(logits, qi, ki, block_q, block_k,
+                                        window)
         p = jnp.exp(logits - lse[:, None])
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -243,8 +265,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ) * scale
 
     if causal:
-        # Q blocks strictly above the diagonal see none of this K block.
-        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        # Q blocks strictly above the diagonal see none of this K block
+        # (and with a window, q blocks entirely newer than the band).
+        @pl.when(_block_relevant(qi, ki, block_q, block_k, window))
         def _():
             compute()
     else:
@@ -256,7 +279,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(causal, block_q, block_k, interpret, res, do4):
+def _bwd(causal, window, block_q, block_k, interpret, res, do4):
     q4, k4, v4, o4, lse = res
     b, nq, s, hd = q4.shape
     nkv = k4.shape[1]
@@ -280,7 +303,8 @@ def _bwd(causal, block_q, block_k, interpret, res, do4):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nk=nkb),
+                          window=window, block_q=block_q,
+                          block_k=block_k, nk=nkb),
         grid=(b * nq, nqb, nkb),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
@@ -304,7 +328,8 @@ def _bwd(causal, block_q, block_k, interpret, res, do4):
 
     dk_full, dv_full = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nq_blocks=nqb),
+                          window=window, block_q=block_q,
+                          block_k=block_k, nq_blocks=nqb),
         grid=(b * nq, nkb, nqb),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
         out_specs=[dkv_out_spec, dkv_out_spec],
@@ -334,35 +359,35 @@ def _bwd(causal, block_q, block_k, interpret, res, do4):
 # against the FINAL (o, lse) residuals, which is mathematically the
 # whole-sequence flash bwd split along KV blocks (p = exp(logits - LSE)
 # and delta = rowsum(do*o_final) are both global quantities).
-def flash_block_fwd(q4, k4, v4, *, causal, interpret,
+def flash_block_fwd(q4, k4, v4, *, causal, interpret, window=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
     """[b, n, s, hd] tensors -> (normalized o4, lse[b, nq, s, 128])."""
-    return _fwd(q4, k4, v4, causal=causal, block_q=block_q,
-                block_k=block_k, interpret=interpret)
+    return _fwd(q4, k4, v4, causal=causal, window=window,
+                block_q=block_q, block_k=block_k, interpret=interpret)
 
 
-def flash_block_bwd(res, do4, *, causal, interpret,
+def flash_block_bwd(res, do4, *, causal, interpret, window=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
     """res = (q4, k4, v4, o4, lse128) — o4/lse may be the MERGED ring
     totals; returns (dq4, dk4, dv4) with GQA group-summing applied."""
-    return _bwd(causal, block_q, block_k, interpret, res, do4)
+    return _bwd(causal, window, block_q, block_k, interpret, res, do4)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q4, k4, v4, causal, block_q, block_k, interpret):
-    o4, _ = _fwd(q4, k4, v4, causal=causal, block_q=block_q,
-                 block_k=block_k, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q4, k4, v4, causal, window, block_q, block_k, interpret):
+    o4, _ = _fwd(q4, k4, v4, causal=causal, window=window,
+                 block_q=block_q, block_k=block_k, interpret=interpret)
     return o4
 
 
-def _flash_fwd(q4, k4, v4, causal, block_q, block_k, interpret):
-    o4, lse = _fwd(q4, k4, v4, causal=causal, block_q=block_q,
-                   block_k=block_k, interpret=interpret)
+def _flash_fwd(q4, k4, v4, causal, window, block_q, block_k, interpret):
+    o4, lse = _fwd(q4, k4, v4, causal=causal, window=window,
+                   block_q=block_q, block_k=block_k, interpret=interpret)
     return o4, (q4, k4, v4, o4, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, do4):
-    return _bwd(causal, block_q, block_k, interpret, res, do4)
+def _flash_bwd(causal, window, block_q, block_k, interpret, res, do4):
+    return _bwd(causal, window, block_q, block_k, interpret, res, do4)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -374,6 +399,7 @@ def flash_attention(
     v: jnp.ndarray,
     *,
     causal: bool = True,
+    window: int | None = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool | None = None,
@@ -387,6 +413,10 @@ def flash_attention(
     """
     if interpret is None:
         interpret = _interpret_default()
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal attention")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     b, s, n_q, hd = q.shape
     n_kv = k.shape[2]
     if n_q % n_kv:
@@ -396,5 +426,5 @@ def flash_attention(
     q4 = jnp.transpose(q, (0, 2, 1, 3))
     k4 = jnp.transpose(k, (0, 2, 1, 3))
     v4 = jnp.transpose(v, (0, 2, 1, 3))
-    o4 = _flash(q4, k4, v4, causal, block_q, block_k, interpret)
+    o4 = _flash(q4, k4, v4, causal, window, block_q, block_k, interpret)
     return jnp.transpose(o4, (0, 2, 1, 3))

@@ -241,7 +241,8 @@ class InferenceEngine:
                 v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
             attn = dot_product_attention(
                 q, k_cache, v_cache, positions, kv_positions,
-                causal=True, kv_mask=kv_valid)
+                causal=True, kv_mask=kv_valid,
+                window=getattr(cfg, "sliding_window", None))
             x = x + attn.reshape(b, s, cfg.q_dim) @ p["wo"].astype(cfg.dtype)
 
             h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)

@@ -71,9 +71,13 @@ class Batcher:
         self.calls = 0            # engine invocations (observability)
         self._queue: asyncio.Queue = asyncio.Queue()
         self._worker: asyncio.Task | None = None
+        self._inflight: list = []  # dequeued but unresolved (see close)
+        self._closed = False
 
     async def submit(self, tokens: list[int], max_new: int,
                      sampling: tuple) -> list[int]:
+        if self._closed:
+            raise RuntimeError("batcher is shut down")
         if self._worker is None or self._worker.done():
             self._worker = asyncio.get_event_loop().create_task(
                 self._run())
@@ -84,11 +88,16 @@ class Batcher:
     async def _run(self):
         while True:
             first = await self._queue.get()
+            # Everything dequeued is tracked until its future resolves:
+            # cancellation mid-window or mid-run must not strand callers
+            # (close() fails whatever is left here).
+            self._inflight = [first]
             await asyncio.sleep(self.window_s)  # let siblings arrive
             batch = [first]
             while (len(batch) < self.max_batch
                    and not self._queue.empty()):
                 batch.append(self._queue.get_nowait())
+            self._inflight = batch
             # one generate per sampling group (sp applies batch-wide),
             # split further so padded prompt + group max_new never
             # exceeds the cache bucket (each request alone fits; their
@@ -110,6 +119,7 @@ class Batcher:
                         sub = trial
                 if sub:
                     await self._run_group(sampling, sub)
+            self._inflight = []
 
     @staticmethod
     def _bucket(n: int, cap: int) -> int:
@@ -164,16 +174,22 @@ class Batcher:
                     fut.set_exception(e)
 
     async def close(self) -> None:
-        """Cancel the worker and fail anything still queued (app
-        cleanup; without this, shutdown strands queued futures)."""
+        """Cancel the worker and fail everything unresolved — queued
+        AND already dequeued (the worker holds items across the window
+        sleep and the engine call; CancelledError bypasses _run_group's
+        except, so those futures must be failed here)."""
+        self._closed = True   # late submit() raises instead of hanging
         if self._worker is not None:
             self._worker.cancel()
             try:
                 await self._worker
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
+        pending = list(self._inflight)
+        self._inflight = []
         while not self._queue.empty():
-            _, _, _, fut = self._queue.get_nowait()
+            pending.append(self._queue.get_nowait())
+        for _, _, _, fut in pending:
             if not fut.done():
                 fut.set_exception(RuntimeError("server shutting down"))
 
