@@ -59,30 +59,40 @@ class EngineConfig:
     eos_token: int | None = None
 
 
+def _per_row(v: jnp.ndarray) -> jnp.ndarray:
+    """[] stays scalar; [b] gains a trailing axis to broadcast against
+    [b, vocab] logits (per-row sampling knobs)."""
+    return v[..., None] if getattr(v, "ndim", 0) >= 1 else v
+
+
 def scaled_filtered_logits(logits: jnp.ndarray,
                            sp: "SamplingParams") -> jnp.ndarray:
     """Temperature-scale then top-k/top-p filter — the ONE definition of
     the sampled distribution's logits, shared by the engine's sampler
     and the speculative verifier (a drifted copy there would silently
     break speculative decoding's target-law exactness). The cond skips
-    the filter's argsorts when both knobs are off (temperature-only
-    sampling keeps its pre-filter cost)."""
-    scaled = logits.astype(jnp.float32) / jnp.maximum(sp.temperature, 1e-6)
+    the filter's argsorts when every row has both knobs off
+    (temperature-only sampling keeps its pre-filter cost)."""
+    scaled = logits.astype(jnp.float32) / jnp.maximum(
+        _per_row(sp.temperature), 1e-6)
     return jax.lax.cond(
-        (sp.top_k > 0) | (sp.top_p < 1.0),
-        lambda s: filter_logits(s, sp.top_k, sp.top_p),
+        jnp.any((sp.top_k > 0) | (sp.top_p < 1.0)),
+        lambda s: filter_logits(s, _per_row(sp.top_k),
+                                _per_row(sp.top_p)),
         lambda s: s, scaled)
 
 
 class SamplingParams(NamedTuple):
-    """Per-request sampling knobs as TRACED scalars: requests with
-    different temperature/top_k/top_p reuse one compiled decode scan
-    (static shapes, dynamic values — recompiling a 30s scan per slider
-    move would be the wrong TPU trade)."""
+    """Sampling knobs as TRACED values: requests with different
+    temperature/top_k/top_p reuse one compiled decode scan (static
+    shapes, dynamic values — recompiling a 30s scan per slider move
+    would be the wrong TPU trade). Each field is a scalar [] or a
+    per-row [batch] vector, so ONE batch can mix greedy and sampled
+    rows with different knobs (the dynamic batcher relies on this)."""
 
-    temperature: jnp.ndarray   # [] f32; <= 0 means greedy
-    top_k: jnp.ndarray         # [] i32; 0 disables
-    top_p: jnp.ndarray         # [] f32; >= 1 disables
+    temperature: jnp.ndarray   # []/[b] f32; <= 0 means greedy
+    top_k: jnp.ndarray         # []/[b] i32; 0 disables
+    top_p: jnp.ndarray         # []/[b] f32; >= 1 disables
 
 
 def filter_logits(logits: jnp.ndarray, top_k: jnp.ndarray,
@@ -268,40 +278,66 @@ class InferenceEngine:
             jnp.zeros((), jnp.int32))
 
     def _sample(self, logits, rng, sp: SamplingParams):
-        # lax.cond, not jnp.where: greedy decode must not pay the
-        # sampled branch's full-vocab argsorts/cumsum/categorical per
-        # step (256k vocab on Gemma) just to discard the result.
+        # lax.cond, not jnp.where: an all-greedy decode must not pay
+        # the sampled branch's full-vocab argsorts/cumsum/categorical
+        # per step (256k vocab on Gemma) just to discard the result.
+        # Mixed batches take the sampled branch and select per row.
         def greedy(_):
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
         def sampled(_):
-            return jax.random.categorical(
+            drawn = jax.random.categorical(
                 rng, scaled_filtered_logits(logits, sp),
                 axis=-1).astype(jnp.int32)
+            return jnp.where(sp.temperature > 0.0, drawn, greedy(None))
 
-        return jax.lax.cond(sp.temperature > 0.0, sampled, greedy, None)
+        return jax.lax.cond(
+            jnp.any(sp.temperature > 0.0), sampled, greedy, None)
 
     def _resolve_sampling(
-        self, temperature: float | None, top_k: int | None,
-        top_p: float | None, rng: jax.Array | None,
+        self, temperature, top_k, top_p, rng: jax.Array | None,
+        batch: int | None = None,
     ) -> tuple[SamplingParams, jax.Array]:
         """EngineConfig defaulting + validation + default-rng policy,
-        shared with SpeculativeEngine so the two paths cannot drift."""
-        temperature = (self.ec.temperature if temperature is None
-                       else temperature)
-        top_k = self.ec.top_k if top_k is None else top_k
-        top_p = self.ec.top_p if top_p is None else top_p
-        if top_k < 0:
+        shared with SpeculativeEngine so the two paths cannot drift.
+        Each knob is a scalar or a per-row vector (mixed batches)."""
+        temperature = np.asarray(
+            self.ec.temperature if temperature is None else temperature,
+            np.float32)
+        top_k = np.asarray(
+            self.ec.top_k if top_k is None else top_k, np.int64)
+        top_p = np.asarray(
+            self.ec.top_p if top_p is None else top_p, np.float32)
+        for name, arr in (("temperature", temperature), ("top_k", top_k),
+                          ("top_p", top_p)):
+            if arr.ndim > 1:
+                raise ValueError(f"{name} must be scalar or 1-D, "
+                                 f"got shape {arr.shape}")
+            if (arr.ndim == 1 and batch is not None
+                    and len(arr) != batch):
+                raise ValueError(
+                    f"{name} has {len(arr)} entries for a batch of "
+                    f"{batch}")
+        if (top_k < 0).any():
             raise ValueError(f"top_k must be >= 0, got {top_k}")
-        if not 0.0 < top_p <= 1.0:
+        if not ((0.0 < top_p) & (top_p <= 1.0)).all():
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if any(a.ndim == 1 for a in (temperature, top_k, top_p)):
+            # one vector -> all vectors: [] vs [b] are different jit
+            # signatures, and mixed combos would compile 2^3 variants
+            n = (batch if batch is not None else max(
+                a.shape[0] for a in (temperature, top_k, top_p)
+                if a.ndim == 1))
+            temperature = np.broadcast_to(temperature, (n,))
+            top_k = np.broadcast_to(top_k, (n,))
+            top_p = np.broadcast_to(top_p, (n,))
         sp = SamplingParams(
             temperature=jnp.asarray(temperature, jnp.float32),
             top_k=jnp.asarray(top_k, jnp.int32),
             top_p=jnp.asarray(top_p, jnp.float32),
         )
         if rng is None:
-            if temperature > 0.0:
+            if (temperature > 0.0).any():
                 # Fresh entropy per request — a constant default key
                 # would make every "sampled" completion identical; 63
                 # seed bits keep birthday collisions out of reach while
@@ -378,7 +414,8 @@ class InferenceEngine:
             prompt_mask = jnp.asarray(m)
         else:
             prompt_mask = jnp.ones((b, s), bool)
-        sp, rng = self._resolve_sampling(temperature, top_k, top_p, rng)
+        sp, rng = self._resolve_sampling(temperature, top_k, top_p, rng,
+                                         batch=b)
         state = self.init_state(b)
         toks, _ = self._generate_jit(
             prompt_tokens, state, rng, sp, prompt_mask, max_new=max_new)

@@ -98,27 +98,24 @@ class Batcher:
                    and not self._queue.empty()):
                 batch.append(self._queue.get_nowait())
             self._inflight = batch
-            # one generate per sampling group (sp applies batch-wide),
-            # split further so padded prompt + group max_new never
-            # exceeds the cache bucket (each request alone fits; their
-            # COMBINATION might not)
-            groups: dict[tuple, list] = {}
-            for item in batch:
-                groups.setdefault(item[2], []).append(item)
+            # Sampling knobs are per-row vectors (SamplingParams), so
+            # requests with DIFFERENT temperature/top_k/top_p share one
+            # batch; split only when padded prompt + max_new would
+            # exceed the cache bucket (each request alone fits; their
+            # COMBINATION might not).
             cap = self.engine.ec.max_len
-            for sampling, items in groups.items():
-                sub: list = []
-                for item in items:
-                    trial = sub + [item]
-                    need = (max(len(t) for t, _, _, _ in trial)
-                            + max(mn for _, mn, _, _ in trial))
-                    if sub and need > cap:
-                        await self._run_group(sampling, sub)
-                        sub = [item]
-                    else:
-                        sub = trial
-                if sub:
-                    await self._run_group(sampling, sub)
+            sub: list = []
+            for item in batch:
+                trial = sub + [item]
+                need = (max(len(t) for t, _, _, _ in trial)
+                        + max(mn for _, mn, _, _ in trial))
+                if sub and need > cap:
+                    await self._run_group(sub)
+                    sub = [item]
+                else:
+                    sub = trial
+            if sub:
+                await self._run_group(sub)
             self._inflight = []
 
     @staticmethod
@@ -130,7 +127,7 @@ class Batcher:
             b *= 2
         return min(b, cap)
 
-    async def _run_group(self, sampling: tuple, items: list) -> None:
+    async def _run_group(self, items: list) -> None:
         cap = self.engine.ec.max_len
         longest = max(len(t) for t, _, _, _ in items)
         max_new = max(mn for _, mn, _, _ in items)
@@ -148,17 +145,28 @@ class Batcher:
         arr = np.zeros((rows, longest_b), np.int32)
         mask = np.zeros((rows, longest_b), bool)
         mask[:, -1] = True  # dummy rows need one real token
-        for i, (toks, _, _, _) in enumerate(items):
+        ec = self.engine.ec
+        # filler rows get forced-greedy knobs (temp 0, no filters): a
+        # sampled EngineConfig default on a dummy row would drag an
+        # all-greedy batch into the sampled branch's per-step argsorts
+        temp = np.zeros(rows, np.float32)
+        top_k = np.zeros(rows, np.int64)
+        top_p = np.ones(rows, np.float32)
+        for i, (toks, _, sampling, _) in enumerate(items):
             mask[i, :] = False
             arr[i, longest_b - len(toks):] = toks
             mask[i, longest_b - len(toks):] = True
+            s = dict(sampling)
+            temp[i] = s.get("temperature", ec.temperature)
+            top_k[i] = s.get("top_k", ec.top_k)
+            top_p[i] = s.get("top_p", ec.top_p)
         max_new = max_new_b
 
         def run():
             return np.asarray(self.engine.generate(
                 jnp.asarray(arr), max_new=max_new,
                 prompt_mask=jnp.asarray(mask),
-                **dict(sampling)))
+                temperature=temp, top_k=top_k, top_p=top_p))
 
         try:
             async with self.gpu_lock:

@@ -34,6 +34,7 @@ from kubeflow_tpu.serving.engine import (
     DecodeState,
     InferenceEngine,
     SamplingParams,
+    _per_row,
     scaled_filtered_logits,
 )
 
@@ -63,9 +64,14 @@ def _dist(logits: jnp.ndarray, sp: SamplingParams) -> jnp.ndarray:
             jnp.argmax(logits, axis=-1), vocab, dtype=jnp.float32)
 
     def sampled(_):
-        return jax.nn.softmax(scaled_filtered_logits(logits, sp), axis=-1)
+        probs = jax.nn.softmax(scaled_filtered_logits(logits, sp), axis=-1)
+        # per-row vectors mix greedy and sampled rows (same contract as
+        # InferenceEngine._sample — the shared resolver allows both)
+        return jnp.where(_per_row(sp.temperature) > 0.0, probs,
+                         greedy(None))
 
-    return jax.lax.cond(sp.temperature > 0.0, sampled, greedy, None)
+    return jax.lax.cond(
+        jnp.any(sp.temperature > 0.0), sampled, greedy, None)
 
 
 def _draw(rng: jax.Array, probs: jnp.ndarray) -> jnp.ndarray:
@@ -122,7 +128,7 @@ class SpeculativeEngine:
         # TARGET EngineConfig supplies defaults; shared resolver keeps
         # validation/seeding policy identical to InferenceEngine.generate.
         sp, rng = self.target._resolve_sampling(
-            temperature, top_k, top_p, rng)
+            temperature, top_k, top_p, rng, batch=1)
         out, stats = self._jit(
             prompt_tokens, self.target.init_state(1),
             self.draft.init_state(1), rng, sp,

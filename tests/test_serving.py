@@ -339,6 +339,51 @@ async def test_dynamic_batcher_coalesces_concurrent_requests():
     await client.close()
 
 
+async def test_batcher_mixes_sampling_params_in_one_call():
+    """Per-row SamplingParams: requests with DIFFERENT knobs (greedy,
+    sampled, top_k=1-forced-greedy) coalesce into a single engine call,
+    and the deterministic rows still get exactly their solo outputs."""
+    import asyncio as aio
+
+    cfg = llama.LLAMA_TINY
+    params = dict(llama.init(jax.random.key(0), cfg))
+    params["lm_head"] = params["lm_head"] * 50.0
+    engine = InferenceEngine(params, cfg, LLAMA_FAMILY,
+                             EngineConfig(max_len=64))
+    app = server_lib.create_serving_app(
+        {"m": engine}, batch_window_ms=80.0)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(0, cfg.vocab_size, n).tolist()
+               for n in (4, 6, 8)]
+    greedy_refs = [np.asarray(engine.generate(
+        jnp.asarray([p], jnp.int32), max_new=5))[0].tolist()
+        for p in prompts]
+    bodies = [
+        {"tokens": [prompts[0]], "max_new": 5},                 # greedy
+        {"tokens": [prompts[1]], "max_new": 5,
+         "temperature": 0.9, "top_p": 0.8},                     # sampled
+        {"tokens": [prompts[2]], "max_new": 5,
+         "temperature": 1.0, "top_k": 1},                       # =greedy
+    ]
+
+    async def one(body):
+        r = await client.post("/v1/models/m:generate", json=body)
+        assert r.status == 200, await r.text()
+        return (await r.json())["tokens"][0]
+
+    batcher = app[server_lib.BATCHERS_KEY]["m"]
+    before = batcher.calls
+    got = await aio.gather(*(one(b) for b in bodies))
+    assert batcher.calls == before + 1, "mixed knobs must coalesce"
+    assert got[0] == greedy_refs[0]
+    assert got[2] == greedy_refs[2]           # top_k=1 is argmax
+    assert all(0 <= t < cfg.vocab_size for t in got[1])
+    await client.close()
+
+
 def test_byte_decode_drops_out_of_range_ids():
     # vocab-tail ids (>= 256+offset) and specials must not crash decode
     assert server_lib.byte_decode(
