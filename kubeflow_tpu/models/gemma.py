@@ -42,6 +42,9 @@ class GemmaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    # Sliding-window attention (Gemma-2 uses 4096 on alternating
+    # layers; here it applies model-wide like llama.LlamaConfig).
+    sliding_window: int | None = None
 
     @property
     def q_dim(self) -> int:
@@ -118,6 +121,7 @@ def _block(cfg: GemmaConfig, x, p, positions, inv_freq, kv_mask,
     q = wsc(q, ("batch", "seq", "act_heads", None))
     attn = dot_product_attention(q, k, v, positions, positions,
                                  causal=True, kv_mask=kv_mask,
+                                 window=cfg.sliding_window,
                                  contiguous_positions=contiguous_positions)
     x = x + attn.reshape(b, s, cfg.q_dim) @ p["wo"].astype(cfg.dtype)
     x = wsc(x, ("batch", "seq", "act_embed"))

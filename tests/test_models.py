@@ -36,6 +36,25 @@ def test_gemma_causality():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_gemma_sliding_window_locality():
+    """GemmaConfig.sliding_window: a token beyond the window cannot
+    influence the last position (1-layer receptive field == window)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(gemma.GEMMA_TINY, num_layers=1,
+                              sliding_window=3)
+    params = gemma.init(jax.random.key(0), cfg)
+    rng = np.random.default_rng(2)
+    t1 = rng.integers(0, cfg.vocab_size, (1, 12)).astype(np.int32)
+    far = t1.copy(); far[0, 4] = (far[0, 4] + 1) % cfg.vocab_size
+    l1 = np.asarray(gemma.apply(params, cfg, jnp.asarray(t1))[:, -1])
+    l2 = np.asarray(gemma.apply(params, cfg, jnp.asarray(far))[:, -1])
+    np.testing.assert_array_equal(l1, l2)
+    near = t1.copy(); near[0, 10] = (near[0, 10] + 1) % cfg.vocab_size
+    l3 = np.asarray(gemma.apply(params, cfg, jnp.asarray(near))[:, -1])
+    assert np.abs(l3 - l1).max() > 0
+
+
 def test_gemma_trains_sharded():
     """Gemma composes with the FSDP/TP Trainer unchanged."""
     cfg = gemma.GEMMA_TINY
