@@ -22,7 +22,8 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         "tests": ("python -m pytest tests/test_llama.py tests/test_models.py "
                   "tests/test_mesh.py tests/test_ring.py tests/test_moe.py "
                   "tests/test_pipeline.py tests/test_flash.py "
-                  "tests/test_checkpoint.py -q"),
+                  "tests/test_checkpoint.py tests/test_llama_pp.py "
+                  "tests/test_lora.py -q"),
     },
     "controlplane": {
         "paths": ["kubeflow_tpu/api/**", "kubeflow_tpu/controlplane/**"],
@@ -39,12 +40,14 @@ COMPONENTS: dict[str, dict[str, Any]] = {
     },
     "serving": {
         "paths": ["kubeflow_tpu/serving/**"],
-        "tests": "python -m pytest tests/test_serving.py -q",
+        "tests": ("python -m pytest tests/test_serving.py "
+                  "tests/test_speculative.py tests/test_quant.py -q"),
     },
     "native": {
         "paths": ["native/**", "kubeflow_tpu/data/**"],
         "tests": ("make -C native && "
-                  "python -m pytest tests/test_dataloader.py -q"),
+                  "python -m pytest tests/test_dataloader.py "
+                  "tests/test_bpe.py -q"),
     },
 }
 

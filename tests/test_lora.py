@@ -152,9 +152,11 @@ def test_lora_state_checkpoints_and_resumes(tmp_path):
 
     cont, _ = trainer.step(state, toks, tgts)  # uninterrupted path
     restored = ckpt.restore()
-    for (pa, a), (_, b) in zip(
-            jax.tree_util.tree_leaves_with_path(saved_params),
-            jax.tree_util.tree_leaves_with_path(restored.params)):
+    saved_leaves = jax.tree_util.tree_leaves_with_path(saved_params)
+    restored_leaves = jax.tree_util.tree_leaves_with_path(restored.params)
+    assert len(saved_leaves) == len(restored_leaves)
+    for (pa, a), (pb, b) in zip(saved_leaves, restored_leaves):
+        assert pa == pb
         np.testing.assert_array_equal(
             np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)),
             err_msg=str(pa))
