@@ -27,6 +27,7 @@ import numpy as np
 from aiohttp import web
 
 from kubeflow_tpu.serving.engine import InferenceEngine
+from kubeflow_tpu.serving.speculative import SpeculativeEngine
 
 BYTE_OFFSET = 3  # 0=pad, 1=bos, 2=eos
 BOS, EOS = 1, 2
@@ -48,6 +49,7 @@ ENGINES_KEY: web.AppKey = web.AppKey("engines", dict)
 GPU_LOCK_KEY: web.AppKey = web.AppKey("gpu_lock", asyncio.Lock)
 TOKENIZER_KEY: web.AppKey = web.AppKey("tokenizer", object)
 BATCHERS_KEY: web.AppKey = web.AppKey("batchers", dict)
+SPEC_KEY: web.AppKey = web.AppKey("speculative", dict)
 
 
 class Batcher:
@@ -204,14 +206,24 @@ class Batcher:
 
 def create_serving_app(engines: dict[str, InferenceEngine],
                        *, tokenizer=None, batch_window_ms: float = 0.0,
-                       max_batch: int = 8) -> web.Application:
+                       max_batch: int = 8,
+                       drafts: dict[str, InferenceEngine] | None = None,
+                       ) -> web.Application:
     """`tokenizer` (data.bpe.Tokenizer or anything with encode/decode)
     serves the "text" request mode; without one, the zero-training
     byte-level fallback applies. `batch_window_ms > 0` enables dynamic
     request batching: concurrent single-prompt requests within the
-    window run as one padded batch per sampling group."""
+    window run as one padded batch per sampling group. `drafts` maps
+    model names to draft engines; a request with "speculative": true
+    then decodes through SpeculativeEngine (latency lever; batch 1)."""
     app = web.Application()
     app[ENGINES_KEY] = engines
+    unknown = set(drafts or {}) - set(engines)
+    if unknown:
+        raise ValueError(f"drafts registered for unknown models "
+                         f"{sorted(unknown)}")
+    app[SPEC_KEY] = {name: SpeculativeEngine(engines[name], draft)
+                     for name, draft in (drafts or {}).items()}
     tok_vocab = getattr(tokenizer, "vocab_size", None)
     if tok_vocab is not None:
         # Fail at startup, not per request: a tokenizer whose ids exceed
@@ -351,8 +363,49 @@ async def generate(request: web.Request):
         return web.json_response(
             {"error": f"token ids must be in [0, {vocab})"}, status=400)
 
-    batcher = request.app[BATCHERS_KEY].get(name)
-    if batcher is not None and arr.shape[0] == 1:
+    speculative = body.get("speculative", False)
+    if not isinstance(speculative, bool):
+        return web.json_response(
+            {"error": "speculative must be a boolean"}, status=400)
+    gamma = body.get("gamma", 4)
+    if not isinstance(gamma, int) or isinstance(gamma, bool) or gamma < 1:
+        return web.json_response(
+            {"error": "gamma must be a positive integer"}, status=400)
+    resp_extra: dict[str, Any] = {}
+    if speculative:
+        spec = request.app[SPEC_KEY].get(name)
+        if spec is None:
+            return web.json_response(
+                {"error": f"no draft model registered for {name!r}"},
+                status=400)
+        if arr.shape[0] != 1:
+            return web.json_response(
+                {"error": "speculative decoding is batch-1"}, status=400)
+        # the draft's cache must hold the window too (it is usually the
+        # smaller model — and often configured with a smaller bucket)
+        cap = min(engine.ec.max_len, spec.draft.ec.max_len)
+        if prompt_len + max_new + gamma > cap:
+            return web.json_response(
+                {"error": f"prompt {prompt_len} + max_new {max_new} + "
+                          f"gamma {gamma} exceeds model max_len {cap}"},
+                status=400)
+
+        def run_spec():
+            toks_, stats = spec.generate(
+                jnp.asarray(arr), max_new=max_new, gamma=gamma,
+                **sampling)
+            return np.asarray(toks_), stats
+
+        async with request.app[GPU_LOCK_KEY]:
+            toks, stats = await asyncio.get_event_loop().run_in_executor(
+                None, run_spec)
+        resp_extra["speculative"] = {
+            "acceptance_rate": round(stats.acceptance_rate, 4),
+            "proposed": int(stats.proposed),
+            "accepted": int(stats.accepted),
+        }
+    elif (batcher := request.app[BATCHERS_KEY].get(name)) is not None \
+            and arr.shape[0] == 1:
         # single-prompt requests ride the dynamic batcher; explicit
         # client-side batches keep their one-shot path
         ids = await batcher.submit(
@@ -366,7 +419,7 @@ async def generate(request: web.Request):
                     engine.generate(jnp.asarray(arr), max_new=max_new,
                                     **sampling)),
             )
-    resp: dict[str, Any] = {"tokens": toks.tolist()}
+    resp: dict[str, Any] = {"tokens": toks.tolist(), **resp_extra}
     if text_mode:
         resp["text"] = (tokenizer.decode(toks[0].tolist()) if tokenizer
                         else byte_decode(toks[0].tolist()))

@@ -384,6 +384,57 @@ async def test_batcher_mixes_sampling_params_in_one_call():
     await client.close()
 
 
+async def test_speculative_decoding_over_rest():
+    """A model registered with a draft serves "speculative": true —
+    greedy output identical to the plain path, acceptance stats in the
+    response, validation on batch/gamma/missing-draft."""
+    cfg = llama.LLAMA_TINY
+    params = dict(llama.init(jax.random.key(0), cfg))
+    params["lm_head"] = params["lm_head"] * 50.0
+    engine = InferenceEngine(params, cfg, LLAMA_FAMILY,
+                             EngineConfig(max_len=64))
+    app = server_lib.create_serving_app(
+        {"m": engine}, drafts={"m": engine})   # self-draft: accepts all
+    client = TestClient(TestServer(app))
+    await client.start_server()
+
+    prompt = np.random.default_rng(6).integers(
+        0, cfg.vocab_size, 8).tolist()
+    want = np.asarray(engine.generate(
+        jnp.asarray([prompt], jnp.int32), max_new=10))[0].tolist()
+    r = await client.post("/v1/models/m:generate",
+                          json={"tokens": [prompt], "max_new": 10,
+                                "speculative": True, "gamma": 3})
+    assert r.status == 200, await r.text()
+    out = await r.json()
+    assert out["tokens"][0] == want
+    assert out["speculative"]["acceptance_rate"] == 1.0
+    assert out["speculative"]["proposed"] > 0
+
+    r = await client.post("/v1/models/m:generate",
+                          json={"tokens": [prompt, prompt],
+                                "max_new": 4, "speculative": True})
+    assert r.status == 400  # batch-1 only
+    r = await client.post("/v1/models/m:generate",
+                          json={"tokens": [prompt], "max_new": 4,
+                                "speculative": True, "gamma": 0})
+    assert r.status == 400
+    r = await client.post("/v1/models/m:generate",
+                          json={"tokens": [prompt], "max_new": 50,
+                                "speculative": True, "gamma": 8})
+    assert r.status == 400  # gamma overflows the cache bucket
+
+    app2 = server_lib.create_serving_app({"m": engine})
+    client2 = TestClient(TestServer(app2))
+    await client2.start_server()
+    r = await client2.post("/v1/models/m:generate",
+                           json={"tokens": [prompt], "max_new": 4,
+                                 "speculative": True})
+    assert r.status == 400  # no draft registered
+    await client2.close()
+    await client.close()
+
+
 def test_byte_decode_drops_out_of_range_ids():
     # vocab-tail ids (>= 256+offset) and specials must not crash decode
     assert server_lib.byte_decode(
