@@ -381,6 +381,14 @@ async def generate(request: web.Request):
         if arr.shape[0] != 1:
             return web.json_response(
                 {"error": "speculative decoding is batch-1"}, status=400)
+        # gamma is jit-static: bucket it to a power of two <= 8 BEFORE
+        # the capacity check, so a client sweeping gamma cannot mint
+        # unbounded compiles while holding the GPU lock (gamma is
+        # purely a perf knob — bucketing never changes the output law)
+        g = 1
+        while g * 2 <= min(gamma, 8):
+            g *= 2
+        gamma = g
         # the draft's cache must hold the window too (it is usually the
         # smaller model — and often configured with a smaller bucket)
         cap = min(engine.ec.max_len, spec.draft.ec.max_len)
@@ -399,6 +407,15 @@ async def generate(request: web.Request):
         async with request.app[GPU_LOCK_KEY]:
             toks, stats = await asyncio.get_event_loop().run_in_executor(
                 None, run_spec)
+        # SpeculativeEngine does not special-case EOS; match the plain
+        # path's contract (post-EOS tail pinned to EOS) server-side so
+        # the two modes are interchangeable for clients.
+        eos = engine.ec.eos_token
+        if eos is not None:
+            hits = np.where(toks[0] == eos)[0]
+            if hits.size:
+                toks = toks.copy()
+                toks[0, hits[0]:] = eos
         resp_extra["speculative"] = {
             "acceptance_rate": round(stats.acceptance_rate, 4),
             "proposed": int(stats.proposed),

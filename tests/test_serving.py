@@ -411,6 +411,18 @@ async def test_speculative_decoding_over_rest():
     assert out["speculative"]["acceptance_rate"] == 1.0
     assert out["speculative"]["proposed"] > 0
 
+    # client-swept gamma buckets to powers of two <= 8: a second value
+    # in the same bucket must not add a compile
+    compiles = spec_calls = None
+    spec_eng = app[server_lib.SPEC_KEY]["m"]
+    before = spec_eng._jit._cache_size()
+    r = await client.post("/v1/models/m:generate",
+                          json={"tokens": [prompt], "max_new": 10,
+                                "speculative": True, "gamma": 2})
+    assert r.status == 200
+    # first request's gamma=3 bucketed to 2; same bucket -> cached
+    assert spec_eng._jit._cache_size() == before
+
     r = await client.post("/v1/models/m:generate",
                           json={"tokens": [prompt, prompt],
                                 "max_new": 4, "speculative": True})

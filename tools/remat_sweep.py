@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Remat-policy x batch sweep for the bench-500m preset on real TPU.
+
+Full per-block remat costs ~+33% backward matmul FLOPs; chunked CE
+freed the logit tensor's HBM, which may buy a cheaper policy
+(models/llama.py remat_policy: "full" | "mlp" | "dots") or a bigger
+batch. This sweep measures the actual tok/s winner so the bench preset
+default can be chosen from data, not theory.
+
+Run on a TPU host: `python tools/remat_sweep.py [variant,variant,...]`
+Variants: b8-full (current default), b8-mlp, b4-dots, b8-dots,
+b16-full, b16-mlp. Prints one line per variant and a summary dict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+from bench import Preset  # noqa: E402
+
+VARIANTS = [
+    ("b8-full", 8, "full"),
+    ("b8-mlp", 8, "mlp"),
+    ("b4-dots", 4, "dots"),
+    ("b8-dots", 8, "dots"),
+    ("b16-full", 16, "full"),
+    ("b16-mlp", 16, "mlp"),
+]
+
+
+def main() -> int:
+    base = bench.bench_configs()["bench-500m"]
+    variants = VARIANTS
+    if len(sys.argv) > 1:
+        wanted = sys.argv[1].split(",")
+        variants = [v for v in VARIANTS if v[0] in wanted]
+    results = {}
+    for name, batch, policy in variants:
+        cfg = dataclasses.replace(base, remat_policy=policy)
+        key = f"sweep-{name}"
+        orig = bench.bench_configs
+        bench.bench_configs = lambda c=cfg, k=key, o=orig: {**o(), k: c}
+        preset = Preset(name, batch=batch, seq=2048, steps=10, warmup=2,
+                        model=key)
+        try:
+            m = bench.bench_train(preset)
+            results[name] = m["value"]
+            print(f"{name}: {m['value']} tok/s/chip "
+                  f"(mfu*2.5={m['vs_baseline']})", flush=True)
+        except Exception as e:  # noqa: BLE001 — OOM variants report, not die
+            print(f"{name}: FAILED {type(e).__name__}: {str(e)[:200]}",
+                  flush=True)
+        finally:
+            bench.bench_configs = orig
+    print("RESULTS:", results)
+    if results:
+        best = max(results, key=results.get)
+        print(f"BEST: {best} ({results[best]} tok/s/chip)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
