@@ -159,9 +159,11 @@ def _record_first_compile(elapsed_since_pod_start: float) -> None:
 
 
 def bench_train(preset: Preset, *, assert_flash: bool = False,
-                verbose: bool = True) -> dict:
+                verbose: bool = True, config=None) -> dict:
     """One training bench -> metric dict. Also records pod-to-first-compile
-    the first time any train bench finishes its first step."""
+    the first time any train bench finishes its first step. `config`
+    overrides the preset's named model (tools/remat_sweep.py variants).
+    """
     from kubeflow_tpu.models import llama
     from kubeflow_tpu.ops import attention
     from kubeflow_tpu.parallel import MeshSpec, create_mesh
@@ -169,7 +171,7 @@ def bench_train(preset: Preset, *, assert_flash: bool = False,
     from kubeflow_tpu.train.trainer import chunked_cross_entropy_from_hidden
     from kubeflow_tpu.utils import profiling
 
-    cfg = bench_configs()[preset.model]
+    cfg = config if config is not None else bench_configs()[preset.model]
     n_devices = len(jax.devices())
     mesh = create_mesh(MeshSpec(data=1, fsdp=n_devices, tensor=1))
     # Global batch must divide evenly over the data*fsdp axes.

@@ -350,14 +350,19 @@ def ulysses_attention(
     *,
     axis_name: str,
     causal: bool = True,
+    impl: str = "xla",
 ) -> jnp.ndarray:
     """Ulysses sequence parallelism. Call inside `shard_map`.
 
     all-to-all #1: [b, s/N, n, hd] -> [b, s, n/N, hd] (gather sequence,
     scatter heads); full attention on the now-complete sequence for the
     local head group; all-to-all #2 swaps back. Requires n_q and n_kv
-    divisible by the axis size.
+    divisible by the axis size. The local attention is a COMPLETE
+    causal attention over contiguous positions, so impl="flash" routes
+    it straight through the Pallas kernel.
     """
+    if impl not in ("xla", "flash"):
+        raise ValueError(f"impl must be 'xla' or 'flash', got {impl!r}")
     size = jax.lax.psum(1, axis_name)
     n_q, n_kv = q.shape[2], k.shape[2]
     if n_q % size or n_kv % size:
@@ -380,6 +385,10 @@ def ulysses_attention(
 
     qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
     b, s, nh, hd = qh.shape
+    if impl == "flash":
+        from kubeflow_tpu.ops.pallas.flash_attention import flash_attention
+
+        return gather_heads(flash_attention(qh, kh, vh, causal=causal))
     pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     g = nh // kh.shape[2]
     qg = qh.reshape(b, s, kh.shape[2], g, hd)
@@ -401,11 +410,13 @@ def ulysses_attention_sharded(
     *,
     seq_axis: str = mesh_lib.FSDP_AXIS,
     causal: bool = True,
+    impl: str = "xla",
 ) -> jnp.ndarray:
     """shard_map wrapper for `ulysses_attention` (see ring_attention_sharded)."""
     spec = P(None, seq_axis, None, None)
     fn = jax.shard_map(
-        functools.partial(ulysses_attention, axis_name=seq_axis, causal=causal),
+        functools.partial(ulysses_attention, axis_name=seq_axis,
+                          causal=causal, impl=impl),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

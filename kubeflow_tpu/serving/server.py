@@ -420,6 +420,7 @@ async def generate(request: web.Request):
             "acceptance_rate": round(stats.acceptance_rate, 4),
             "proposed": int(stats.proposed),
             "accepted": int(stats.accepted),
+            "gamma": gamma,  # the EFFECTIVE (bucketed) window
         }
     elif (batcher := request.app[BATCHERS_KEY].get(name)) is not None \
             and arr.shape[0] == 1:

@@ -137,6 +137,27 @@ def test_ulysses_matches_full(causal):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_flash_matches_xla(causal):
+    """impl="flash" routes the post-a2a local attention through the
+    Pallas kernel; values and grads must match the XLA path."""
+    from kubeflow_tpu.parallel.ring import ulysses_attention_sharded as ua
+
+    mesh = _seq_mesh(4)
+    q, k, v = _make_qkv(s=32, n_q=8, n_kv=4, hd=16)
+    got = ua(q, k, v, mesh, causal=causal, impl="flash")
+    want = ua(q, k, v, mesh, causal=causal, impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+    if causal:
+        g_f = jax.grad(lambda q: jnp.sum(
+            ua(q, k, v, mesh, impl="flash") ** 2))(q)
+        g_x = jax.grad(lambda q: jnp.sum(
+            ua(q, k, v, mesh, impl="xla") ** 2))(q)
+        np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_x),
+                                   rtol=2e-3, atol=2e-3)
+
+
 def test_ulysses_rejects_indivisible_heads():
     mesh = _seq_mesh(8)
     q, k, v = _make_qkv(n_q=8, n_kv=4)  # n_kv=4 < 8-way axis

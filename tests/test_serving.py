@@ -413,7 +413,6 @@ async def test_speculative_decoding_over_rest():
 
     # client-swept gamma buckets to powers of two <= 8: a second value
     # in the same bucket must not add a compile
-    compiles = spec_calls = None
     spec_eng = app[server_lib.SPEC_KEY]["m"]
     before = spec_eng._jit._cache_size()
     r = await client.post("/v1/models/m:generate",

@@ -38,29 +38,32 @@ def main() -> int:
     variants = VARIANTS
     if len(sys.argv) > 1:
         wanted = sys.argv[1].split(",")
+        known = {v[0] for v in VARIANTS}
+        unknown = [w for w in wanted if w not in known]
+        if unknown:
+            print(f"unknown variants {unknown}; known: {sorted(known)}",
+                  file=sys.stderr)
+            return 2
         variants = [v for v in VARIANTS if v[0] in wanted]
     results = {}
     for name, batch, policy in variants:
         cfg = dataclasses.replace(base, remat_policy=policy)
-        key = f"sweep-{name}"
-        orig = bench.bench_configs
-        bench.bench_configs = lambda c=cfg, k=key, o=orig: {**o(), k: c}
         preset = Preset(name, batch=batch, seq=2048, steps=10, warmup=2,
-                        model=key)
+                        model="bench-500m")
         try:
-            m = bench.bench_train(preset)
+            m = bench.bench_train(preset, config=cfg)
             results[name] = m["value"]
             print(f"{name}: {m['value']} tok/s/chip "
                   f"(mfu*2.5={m['vs_baseline']})", flush=True)
         except Exception as e:  # noqa: BLE001 — OOM variants report, not die
             print(f"{name}: FAILED {type(e).__name__}: {str(e)[:200]}",
                   flush=True)
-        finally:
-            bench.bench_configs = orig
     print("RESULTS:", results)
-    if results:
-        best = max(results, key=results.get)
-        print(f"BEST: {best} ({results[best]} tok/s/chip)")
+    if not results:
+        print("no variant produced a result", file=sys.stderr)
+        return 1
+    best = max(results, key=results.get)
+    print(f"BEST: {best} ({results[best]} tok/s/chip)")
     return 0
 
 
