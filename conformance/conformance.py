@@ -12,7 +12,14 @@ prints a JSON report.
 from __future__ import annotations
 
 import json
+import os
 import sys
+
+# Runnable as `python conformance/conformance.py` or `python
+# loadtest/loadtest.py` without installing the package: script
+# execution puts the SCRIPT's dir on sys.path, not the repo root.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import traceback
 from typing import Callable
 

@@ -149,13 +149,17 @@ def test_ulysses_flash_matches_xla(causal):
     want = ua(q, k, v, mesh, causal=causal, impl="xla")
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-3, atol=2e-3)
-    if causal:
-        g_f = jax.grad(lambda q: jnp.sum(
-            ua(q, k, v, mesh, impl="flash") ** 2))(q)
-        g_x = jax.grad(lambda q: jnp.sum(
-            ua(q, k, v, mesh, impl="xla") ** 2))(q)
-        np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_x),
-                                   rtol=2e-3, atol=2e-3)
+    # grads for q, k, AND v, in BOTH masking modes — a causal-only,
+    # q-only check would miss mask-dependent bwd-kernel regressions
+    g_f = jax.grad(lambda q, k, v: jnp.sum(
+        ua(q, k, v, mesh, causal=causal, impl="flash") ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_x = jax.grad(lambda q, k, v: jnp.sum(
+        ua(q, k, v, mesh, causal=causal, impl="xla") ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for f, x, name in zip(g_f, g_x, "qkv"):
+        np.testing.assert_allclose(np.asarray(f), np.asarray(x),
+                                   rtol=2e-3, atol=2e-3, err_msg=name)
 
 
 def test_ulysses_rejects_indivisible_heads():
