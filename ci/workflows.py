@@ -23,7 +23,7 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                   "tests/test_mesh.py tests/test_ring.py tests/test_moe.py "
                   "tests/test_pipeline.py tests/test_flash.py "
                   "tests/test_checkpoint.py tests/test_llama_pp.py "
-                  "tests/test_lora.py -q"),
+                  "tests/test_lora.py tests/test_llama_moe.py -q"),
     },
     "controlplane": {
         "paths": ["kubeflow_tpu/api/**", "kubeflow_tpu/controlplane/**"],

@@ -162,12 +162,11 @@ def init(rng: jax.Array, cfg: LlamaConfig) -> Params:
     return params
 
 
-def _block(cfg: LlamaConfig, x, layer_params, positions, inv_freq, kv_mask,
-           contiguous_positions=False):
-    """One transformer block. x: [b, s, D] in cfg.dtype."""
+def _attention_half(cfg, x, p, positions, inv_freq, kv_mask,
+                    contiguous_positions=False):
+    """Attention sub-block + residual (shared by the dense, pipelined,
+    and MoE models — cfg needs the llama attention attrs only)."""
     b, s, D = x.shape
-    p = layer_params
-
     h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
     q = (h @ p["wq"].astype(cfg.dtype)).reshape(b, s, cfg.num_heads, cfg.head_dim)
     k = (h @ p["wk"].astype(cfg.dtype)).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
@@ -182,7 +181,15 @@ def _block(cfg: LlamaConfig, x, layer_params, positions, inv_freq, kv_mask,
                                  contiguous_positions=contiguous_positions)
     attn = attn.reshape(b, s, cfg.q_dim)
     x = x + attn @ p["wo"].astype(cfg.dtype)
-    x = wsc(x, ("batch", "seq", "act_embed"))
+    return wsc(x, ("batch", "seq", "act_embed"))
+
+
+def _block(cfg: LlamaConfig, x, layer_params, positions, inv_freq, kv_mask,
+           contiguous_positions=False):
+    """One transformer block. x: [b, s, D] in cfg.dtype."""
+    p = layer_params
+    x = _attention_half(cfg, x, p, positions, inv_freq, kv_mask,
+                        contiguous_positions)
 
     h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
     # checkpoint_name is inert unless cfg.remat_policy == "mlp" selects

@@ -1,0 +1,193 @@
+"""Mixtral-style MoE transformer: Llama attention + expert FFN blocks.
+
+The dense Llama block's SwiGLU MLP is replaced by parallel.moe's top-k
+routed expert layer; everything else (GQA attention, rope, rms norms,
+stacked-layer `lax.scan`, per-block remat) is the Llama recipe. The
+Switch-style load-balancing auxiliary loss accumulates through the
+layer scan and comes back next to the logits so the training loss can
+weight it (`aux_loss_weight`).
+
+TPU notes: expert weights are stacked [L, E, ...] so the same scan
+slices per-layer expert tables; the "experts" logical axis shards over
+tensor by default (parallel/sharding.py) and composes with EP via
+moe.moe_mlp_expert_parallel for explicit all-to-all meshes.
+
+Causality caveat (inherent to capacity-based MoE, not a bug): when an
+expert overflows its capacity, slot assignment is rank-major (Switch
+convention — every token's PRIMARY choice outranks any secondary), so
+a later token can evict an earlier token's secondary route and
+train-time logits are only causal while capacity holds. For strictly
+causal evaluation/decoding, raise `capacity_factor` so nothing drops
+(capacity >= tokens * top_k / num_experts guarantees it).
+
+Reference parity: none — the reference has no models (SURVEY.md §2b);
+this extends the model-family roster the way Mixtral extends Llama.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.llama import _attention_half
+from kubeflow_tpu.ops.embedding import embed_lookup
+from kubeflow_tpu.ops.norms import rms_norm
+from kubeflow_tpu.ops.rotary import rope_frequencies
+from kubeflow_tpu.parallel import moe as moe_lib
+from kubeflow_tpu.parallel.sharding import with_sharding_constraint as wsc
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoELlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    # MoE
+    num_experts: int = 8
+    top_k: int = 2
+    expert_mlp_dim: int = 14336     # per-expert SwiGLU hidden
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    sliding_window: int | None = None   # llama.py semantics
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    def moe_config(self) -> moe_lib.MoEConfig:
+        return moe_lib.MoEConfig(
+            num_experts=self.num_experts, top_k=self.top_k,
+            embed_dim=self.hidden_size, mlp_dim=self.expert_mlp_dim,
+            capacity_factor=self.capacity_factor, dtype=self.dtype)
+
+
+MIXTRAL_TINY = MoELlamaConfig(
+    vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+    num_kv_heads=2, head_dim=32, num_experts=4, top_k=2,
+    expert_mlp_dim=192, dtype=jnp.float32, remat=False)
+
+
+def init(rng: jax.Array, cfg: MoELlamaConfig) -> Params:
+    keys = iter(jax.random.split(rng, 16))
+    pd = cfg.param_dtype
+    L, D, E, M = (cfg.num_layers, cfg.hidden_size, cfg.num_experts,
+                  cfg.expert_mlp_dim)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(pd)
+
+    return {
+        "embed": dense(next(keys), (cfg.vocab_size, D), D),
+        "blocks": {
+            "attn_norm": jnp.zeros((L, D), pd),
+            "wq": dense(next(keys), (L, D, cfg.q_dim), D),
+            "wk": dense(next(keys), (L, D, cfg.kv_dim), D),
+            "wv": dense(next(keys), (L, D, cfg.kv_dim), D),
+            "wo": dense(next(keys), (L, cfg.q_dim, D), cfg.q_dim),
+            "mlp_norm": jnp.zeros((L, D), pd),
+            "router": dense(next(keys), (L, D, E), D),
+            "w_gate": dense(next(keys), (L, E, D, M), D),
+            "w_up": dense(next(keys), (L, E, D, M), D),
+            "w_down": dense(next(keys), (L, E, M, D), M),
+        },
+        "final_norm": jnp.zeros((D,), pd),
+        "lm_head": dense(next(keys), (D, cfg.vocab_size), D),
+    }
+
+
+def param_logical_axes(cfg: MoELlamaConfig) -> Params:
+    block = {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "router": ("layers", "embed", None),
+        "w_gate": ("layers", "experts", "embed", None),
+        "w_up": ("layers", "experts", "embed", None),
+        "w_down": ("layers", "experts", None, "embed"),
+    }
+    return {
+        "embed": ("vocab", "embed"),
+        "blocks": block,
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def _block(cfg: MoELlamaConfig, x, p, positions, inv_freq):
+    # the llama attention half verbatim (shared code — sliding_window,
+    # GQA, sharding constraints all inherited)
+    x = _attention_half(cfg, x, p, positions, inv_freq, None,
+                        contiguous_positions=True)
+
+    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    # cast expert weights to the ACTIVATION dtype: fp32 master params
+    # fed raw would promote the expert einsums — the bulk of a MoE
+    # block's FLOPs — to fp32
+    moe_params = {
+        name: p[name].astype(cfg.dtype)
+        for name in ("router", "w_gate", "w_up", "w_down")
+    }
+    y, aux = moe_lib.moe_mlp(moe_params, h, cfg.moe_config())
+    x = x + y
+    return wsc(x, ("batch", "seq", "act_embed")), aux
+
+
+def apply(
+    params: Params,
+    cfg: MoELlamaConfig,
+    tokens: jnp.ndarray,                # [b, s] int32
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Forward pass → (logits [b, s, vocab] fp32, mean aux loss [])."""
+    b, s = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    inv_freq = rope_frequencies(cfg.head_dim, theta=cfg.rope_theta)
+
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)
+    x = wsc(x, ("batch", "seq", "act_embed"))
+
+    def blk(carry, lp):
+        x, aux = carry
+        x, a = _block(cfg, x, lp, positions, inv_freq)
+        return (x, aux + a), None
+
+    if cfg.remat:
+        blk = jax.checkpoint(blk)
+    (x, aux), _ = jax.lax.scan(
+        blk, (x, jnp.zeros((), jnp.float32)), params["blocks"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    logits = wsc(logits, ("batch", "seq", "act_vocab"))
+    return logits, aux / cfg.num_layers
+
+
+def loss_fn(cfg: MoELlamaConfig):
+    """Trainer-shaped loss: next-token CE + weighted load-balance aux."""
+    from kubeflow_tpu.train.trainer import cross_entropy_loss
+
+    def loss(params, tokens, targets, mask):
+        logits, aux = apply(params, cfg, tokens)
+        return (cross_entropy_loss(logits, targets, mask)
+                + cfg.aux_loss_weight * aux)
+
+    return loss

@@ -1,0 +1,100 @@
+"""Mixtral-style MoE transformer: shapes, causality, routed training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import llama_moe
+from kubeflow_tpu.parallel import MeshSpec, create_mesh
+from kubeflow_tpu.parallel.sharding import LLAMA_RULES, shard_pytree_specs
+from kubeflow_tpu.train import Trainer, TrainConfig
+
+CFG = llama_moe.MIXTRAL_TINY
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama_moe.init(jax.random.key(0), CFG)
+
+
+def test_forward_shapes_and_aux(params):
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, CFG.vocab_size, (2, 16)),
+        jnp.int32)
+    logits, aux = llama_moe.apply(params, CFG, toks)
+    assert logits.shape == (2, 16, CFG.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+    # Switch aux loss: E * sum(frac * mean_prob) ~ 1 at uniform routing
+    assert 0.0 < float(aux) < 10.0
+
+
+def test_causality_with_headroom_and_documented_capacity_leak():
+    """With capacity that never overflows, routing is strictly causal.
+    Under capacity PRESSURE the rank-major Switch slot assignment lets
+    a later token evict an earlier token's secondary route — the
+    documented train-time approximation; pin that it actually happens
+    so a silent semantic change to _route gets noticed either way."""
+    import dataclasses
+
+    rng = np.random.default_rng(1)
+    t1 = rng.integers(0, CFG.vocab_size, (1, 12)).astype(np.int32)
+    t2 = t1.copy()
+    t2[0, -1] = (t2[0, -1] + 5) % CFG.vocab_size
+
+    roomy = dataclasses.replace(CFG, capacity_factor=4.0)
+    params = llama_moe.init(jax.random.key(0), roomy)
+    l1, _ = llama_moe.apply(params, roomy, jnp.asarray(t1))
+    l2, _ = llama_moe.apply(params, roomy, jnp.asarray(t2))
+    np.testing.assert_allclose(np.asarray(l1[:, :-1]),
+                               np.asarray(l2[:, :-1]),
+                               rtol=2e-4, atol=2e-4)
+
+    tight = dataclasses.replace(CFG, capacity_factor=0.5)
+    l1, _ = llama_moe.apply(params, tight, jnp.asarray(t1))
+    l2, _ = llama_moe.apply(params, tight, jnp.asarray(t2))
+    assert np.abs(np.asarray(l1[:, :-1])
+                  - np.asarray(l2[:, :-1])).max() > 0
+
+
+def test_logical_axes_cover_params_and_resolve(params):
+    axes = llama_moe.param_logical_axes(CFG)
+    assert (jax.tree_util.tree_structure(
+        jax.tree.map(lambda _: 0, params))
+        == jax.tree_util.tree_structure(
+            jax.tree.map(lambda _: 0, axes,
+                         is_leaf=lambda x: isinstance(x, tuple))))
+    mesh = create_mesh(MeshSpec(data=1, fsdp=4, tensor=2))
+    shardings = shard_pytree_specs(LLAMA_RULES, axes, mesh)
+    for leaf, sh in zip(jax.tree.leaves(params),
+                        jax.tree.leaves(shardings, is_leaf=lambda x: hasattr(x, "spec"))):
+        assert len(sh.spec) <= leaf.ndim
+
+
+def test_moe_trains_under_sharded_mesh():
+    """CE + aux loss falls under a (data, fsdp, tensor) mesh and the
+    ROUTER learns (its weights move) — the full Mixtral train recipe on
+    the fake-TPU backend."""
+    mesh = create_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    trainer = Trainer(
+        mesh=mesh,
+        apply_fn=lambda p, t: llama_moe.apply(p, CFG, t)[0],
+        init_fn=lambda k: llama_moe.init(k, CFG),
+        logical_axes=llama_moe.param_logical_axes(CFG),
+        train_config=TrainConfig(warmup_steps=2, total_steps=100,
+                                 learning_rate=3e-3),
+        loss_fn=llama_moe.loss_fn(CFG),
+    )
+    state = trainer.init(jax.random.key(0))
+    router_before = np.asarray(state.params["blocks"]["router"])
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, CFG.vocab_size, (8, 32)), jnp.int32)
+    tgts = jnp.roll(toks, -1, 1)
+    losses = []
+    for _ in range(8):
+        state, loss = trainer.step(state, toks, tgts)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    router_after = np.asarray(state.params["blocks"]["router"])
+    assert np.abs(router_after - router_before).max() > 0
