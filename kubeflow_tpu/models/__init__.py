@@ -1,4 +1,4 @@
-"""Model families: Llama-3, ViT, Gemma, MLP (BASELINE.md configs).
+"""Model families: Llama-3 (dense, pipelined, MoE), Gemma, ViT, MLP.
 
 Models are functional JAX: `init(rng, cfg) -> params pytree` plus
 `apply(params, cfg, ...) -> logits`, with a parallel pytree of logical
