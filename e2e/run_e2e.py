@@ -243,6 +243,49 @@ def tensorboard_lifecycle(alice: Client, admin: Client) -> None:
     assert status == 200, status
 
 
+@phase("hpo-experiment")
+def hpo_experiment(alice: Client, admin: Client) -> None:
+    """A TPE Experiment through the versioned API door: trials spawn
+    under the parallelism budget with in-domain assignments."""
+    exp = {"kind": "Experiment", "apiVersion": "kubeflow-tpu.dev/v1",
+           "metadata": {"name": "e2e-sweep"},
+           "spec": {"algorithm": "tpe", "max_trials": 6,
+                    "parallel_trials": 2, "seed": 11,
+                    "objective": {"metric": "loss", "goal": "minimize"},
+                    "parameters": [
+                        {"name": "lr", "type": "double", "min": 1e-4,
+                         "max": 1e-1, "log": True},
+                        {"name": "opt", "type": "categorical",
+                         "values": ["adam", "sgd"]}],
+                    "trial_template": {"spec": {"containers": [
+                        {"name": "train",
+                         "image": "kubeflow-tpu/trainer:latest"}]}}}}
+    status, out = alice.api(
+        "POST", "/apis/kubeflow-tpu.dev/v1/namespaces/alice/experiments",
+        exp)
+    assert status == 201, (status, out)
+
+    def trials():
+        _, r = alice.req(
+            "GET", "/apis/kubeflow-tpu.dev/v1/namespaces/alice/trials")
+        items = [t for t in r["items"]
+                 if t["spec"]["experiment"] == "e2e-sweep"]
+        return items if len(items) == 2 else None  # parallelism budget
+    items = poll("2 parallel trials", trials)
+    for t in items:
+        a = t["spec"]["assignment"]
+        assert 1e-4 <= float(a["lr"]) <= 1e-1, a
+        assert a["opt"] in ("adam", "sgd"), a
+    status, _ = alice.api(
+        "DELETE",
+        "/apis/kubeflow-tpu.dev/v1/namespaces/alice/experiments/e2e-sweep")
+    assert status == 200, status
+    poll("trials cascade-deleted", lambda: not [
+        t for t in alice.req(
+            "GET", "/apis/kubeflow-tpu.dev/v1/namespaces/alice/trials")[1]
+        ["items"] if t["spec"]["experiment"] == "e2e-sweep"])
+
+
 @phase("metrics-surface")
 def metrics_surface(alice: Client, admin: Client) -> None:
     status, text = alice.req("GET", "/metrics")
