@@ -37,12 +37,99 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
+import subprocess
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+# Probe budget: backend init over the axon tunnel normally lands in
+# seconds; a wedged plugin either raises quickly or hangs — 180 s
+# bounds the hang case.
+_PROBE_TIMEOUT_S = float(os.environ.get("KFTPU_BENCH_PROBE_TIMEOUT_S", 180))
+_PROBE_RETRIES = int(os.environ.get("KFTPU_BENCH_PROBE_RETRIES", 2))
+_PROBE_BACKOFF_S = float(os.environ.get("KFTPU_BENCH_PROBE_BACKOFF_S", 10))
+
+
+def resolve_backend() -> str:
+    """Decide the backend WITHOUT poisoning this process's jax state.
+
+    Round-3 lesson (BENCH_r03 rc=1): `jax.default_backend()` at
+    bench.py:main crashed outright when the environment's TPU plugin was
+    wedged ("UNAVAILABLE: TPU backend setup/compile error") and the
+    whole sweep died before its first metric. The probe therefore runs
+    in a SUBPROCESS (armored against both raise and hang), retries with
+    backoff, and returns:
+      - the probed platform name ("tpu", "cpu", ...) on success,
+      - "cpu-fallback" when we ARE the re-exec'd CPU-fallback child,
+      - "unavailable" when every attempt failed (caller re-execs).
+    `KFTPU_FORCE_BACKEND_FAIL=1` makes the probe raise, so tests can
+    prove the fallback path produces an artifact without a wedged TPU.
+    """
+    if os.environ.get("KFTPU_BENCH_CPU_FALLBACK"):
+        return "cpu-fallback"
+    code = (
+        "import os\n"
+        "if os.environ.get('KFTPU_FORCE_BACKEND_FAIL'):\n"
+        "    raise RuntimeError('forced backend failure (test)')\n"
+        "import jax\n"
+        "print('BACKEND=' + jax.default_backend())\n"
+    )
+    last = ""
+    for attempt in range(_PROBE_RETRIES + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                text=True, timeout=_PROBE_TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired:
+            last = f"probe timed out after {_PROBE_TIMEOUT_S:.0f}s"
+        else:
+            if proc.returncode == 0:
+                for line in proc.stdout.splitlines():
+                    if line.startswith("BACKEND="):
+                        return line[len("BACKEND="):].strip()
+                last = "probe exited 0 without a BACKEND line"
+            else:
+                last = (proc.stderr or proc.stdout).strip().splitlines()
+                last = last[-1] if last else f"rc={proc.returncode}"
+        if attempt < _PROBE_RETRIES:
+            print(f"# backend probe failed (attempt {attempt + 1}): "
+                  f"{last}; retrying in {_PROBE_BACKOFF_S:.0f}s",
+                  file=sys.stderr)
+            time.sleep(_PROBE_BACKOFF_S)
+    print(f"# backend probe gave up: {last}", file=sys.stderr)
+    return "unavailable"
+
+
+def _reexec_cpu_fallback() -> int:
+    """Re-run this bench in a fresh interpreter pinned to CPU.
+
+    A failed in-process backend init cannot be recovered (jax caches
+    the poisoned state), and env vars alone are not enough because a
+    sitecustomize may pin the TPU plugin through jax.config — so the
+    child overrides jax.config BEFORE importing this module (same
+    pattern as __graft_entry__._reexec_with_virtual_devices). The child
+    emits the same headline JSON with "backend": "cpu-fallback" so the
+    driver records an honest artifact instead of rc=1.
+    """
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["KFTPU_BENCH_CPU_FALLBACK"] = "1"
+    env.pop("KFTPU_FORCE_BACKEND_FAIL", None)
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "import sys; sys.path.insert(0, {root!r}); "
+        "import bench; sys.exit(bench.main())"
+    ).format(root=_REPO_DIR)
+    proc = subprocess.run(
+        [sys.executable, "-c", code, *sys.argv[1:]], env=env, cwd=_REPO_DIR
+    )
+    return proc.returncode
 
 
 # Peak bf16 FLOPs/sec and HBM GB/s per chip by TPU generation (public).
@@ -344,21 +431,29 @@ def main() -> int:
     p.add_argument("--json-only", action="store_true")
     args = p.parse_args()
 
-    on_tpu = jax.default_backend() == "tpu"
     all_names = ("train500m", "train1b", "flash4k", "decode",
                  "decode-int8")
-    sweep = (list(all_names) if on_tpu
-             else ["train500m", "decode", "decode-int8"])
+    # Validate names BEFORE the backend probe: a typo must not cost
+    # minutes of probe timeouts on a wedged host.
+    wanted: list[str] = []
     if args.only:
         wanted = [s.strip() for s in args.only.split(",") if s.strip()]
         unknown = [s for s in wanted if s not in all_names]
         if unknown:
             p.error(f"unknown --only entries {unknown}; known: "
                     f"{list(all_names)}")
+
+    backend = resolve_backend()
+    if backend == "unavailable":
+        return _reexec_cpu_fallback()
+    on_tpu = backend == "tpu"
+    sweep = (list(all_names) if on_tpu
+             else ["train500m", "decode", "decode-int8"])
+    if wanted:
         unavailable = [s for s in wanted if s not in sweep]
         if unavailable:
             p.error(f"--only entries {unavailable} need a TPU backend "
-                    f"(current: {jax.default_backend()})")
+                    f"(current: {backend})")
         sweep = [s for s in sweep if s in wanted]
 
     verbose = not args.json_only
@@ -387,10 +482,23 @@ def main() -> int:
             })
 
     # Headline first: its first step is the process's first compile, so
-    # pod-to-first-compile measures the real cold path.
+    # pod-to-first-compile measures the real cold path. Even though the
+    # probe subprocess succeeded, this process's own backend init can
+    # still fail (TPU weather can change between the two) — fall back
+    # rather than die with no artifact.
     if "train500m" in sweep:
         preset = TRAIN_PRESETS["tpu-v5e-1" if on_tpu else "tiny-cpu"]
-        emit(bench_train(preset, verbose=verbose))
+        try:
+            emit(bench_train(preset, verbose=verbose))
+        except RuntimeError as e:
+            # backend != cpu-fallback: the fallback child must fail
+            # loudly rather than re-exec an identical child forever.
+            if (headline is None and backend != "cpu-fallback"
+                    and "backend" in str(e).lower()):
+                print(f"# in-process backend init failed after a good "
+                      f"probe: {e}; re-exec'ing on CPU", file=sys.stderr)
+                return _reexec_cpu_fallback()
+            raise
         extras.append(first_compile_metric())
     if "train1b" in sweep:
         guarded("train1b", lambda: bench_train(
@@ -427,6 +535,7 @@ def main() -> int:
 
     assert headline is not None, "empty sweep"
     result = dict(headline)
+    result["backend"] = backend
     if extras:
         result["extra_metrics"] = extras
     print(json.dumps(result))

@@ -40,6 +40,7 @@ from kubeflow_tpu.controlplane.store import (
     AlreadyExists,
     Conflict,
     NotFound,
+    OwnerGone,
     Store,
     set_controller_reference,
 )
@@ -121,6 +122,17 @@ class ExperimentController(Controller):
                 suggester.observe(obs, spec.objective.goal)
             suggester.advance(len(trials))           # replay / advance
             batch = suggester.suggest(to_create)
+            # Re-get immediately before creating: a DELETE landing after
+            # the read at the top of this reconcile has already cascaded
+            # the existing Trials, and creating more with the stale uid
+            # would orphan them (store.OwnerGone backstops the remaining
+            # get→create window).
+            try:
+                exp = store.get("Experiment", namespace, name)
+            except NotFound:
+                return Result()
+            if exp.metadata.deletion_timestamp is not None:
+                return Result()
             for a in batch:
                 idx = len(trials)
                 trial = Trial()
@@ -138,6 +150,10 @@ class ExperimentController(Controller):
                     trials.append(trial)
                 except AlreadyExists:
                     pass
+                except OwnerGone:
+                    # Deleted in the get→create window; the cascade
+                    # already collected the children. Stop creating.
+                    return Result()
 
         # Aggregate status. (Grid exhaustion below max_trials is closed
         # out by the `finished` condition: no running, all trials done.)
@@ -215,6 +231,8 @@ class TrialController(Controller):
                 store.create(pod)
             except AlreadyExists:
                 pass
+            except OwnerGone:
+                return Result()  # trial deleted in the get→create window
             except AdmissionDenied as e:
                 trial.status.phase = "Failed"
                 trial.status.message = f"pod admission denied: {e}"

@@ -21,7 +21,12 @@ import time
 from typing import Callable
 
 from kubeflow_tpu.api.core import Resource
-from kubeflow_tpu.controlplane.store import Conflict, Store, WatchEvent
+from kubeflow_tpu.controlplane.store import (
+    Conflict,
+    OwnerGone,
+    Store,
+    WatchEvent,
+)
 
 log = logging.getLogger(__name__)
 
@@ -207,6 +212,16 @@ class Manager:
                     self.metrics.record_reconcile(
                         type(ctrl).__name__, False, severity="conflict")
                 wq.add_rate_limited(key)
+            except OwnerGone:
+                # The primary was deleted while this reconcile was in
+                # flight and the store refused to resurrect its child.
+                # Not an error: the DELETE's own watch event re-enqueues
+                # the key, and that reconcile sees NotFound and no-ops.
+                log.debug("reconcile %s %s: owner gone mid-flight",
+                          ctrl.KIND, key)
+                if self.metrics is not None:
+                    self.metrics.record_reconcile(type(ctrl).__name__, True)
+                wq.forget(key)
             except Exception:
                 log.exception("reconcile %s %s failed", ctrl.KIND, key)
                 # ref monitoring.go:74 IncRequestErrorCounter (severity label)

@@ -57,6 +57,18 @@ class AdmissionDenied(StoreError):
     pass
 
 
+class OwnerGone(StoreError):
+    """Create rejected: the controller owner-ref uid no longer exists.
+
+    k8s lets such creates through and its GC collects the orphan later;
+    this store has no async GC, so admitting the object would resurrect
+    a cascade-deleted child forever (the round-3 Experiment→Trial race:
+    reconcile read the Experiment, DELETE cascaded the Trials, then the
+    in-flight reconcile re-created them with the dead owner's uid).
+    Rejecting at create is the synchronous equivalent of that GC.
+    """
+
+
 @dataclass(frozen=True)
 class WatchEvent:
     type: str            # ADDED | MODIFIED | DELETED
@@ -90,6 +102,8 @@ class Store:
         # events instead of scanning the whole object map under the
         # global lock (the apiserver-equivalent's hot path).
         self._events_by_ns: dict[str, set[tuple[str, str, str]]] = {}
+        # uid -> key: O(1) liveness checks for owner references.
+        self._uids: dict[str, tuple[str, str, str]] = {}
 
     # -- admission ---------------------------------------------------------
 
@@ -110,6 +124,12 @@ class Store:
                 raise AlreadyExists(f"{obj.key} exists")
             obj = obj.clone()
             self._admit(obj)
+            for ref in obj.metadata.owner_references:
+                if ref.controller and ref.uid and ref.uid not in self._uids:
+                    raise OwnerGone(
+                        f"{obj.key}: controller owner {ref.kind}/{ref.name} "
+                        f"uid={ref.uid} no longer exists"
+                    )
             if dry_run:
                 return obj
             m = obj.metadata
@@ -118,6 +138,7 @@ class Store:
             m.generation = 1
             m.creation_timestamp = m.creation_timestamp or time.time()
             self._objects[obj.key] = obj
+            self._uids[m.uid] = obj.key
             if obj.kind == "Event":
                 self._events_by_ns.setdefault(
                     m.namespace, set()).add(obj.key)
@@ -178,6 +199,7 @@ class Store:
         obj = self._objects.pop(key, None)
         if obj is None:
             return
+        self._uids.pop(obj.metadata.uid, None)
         if obj.kind == "Event":
             self._events_by_ns.get(obj.metadata.namespace, set()).discard(key)
         self._notify(WatchEvent("DELETED", obj.clone()))
@@ -296,6 +318,7 @@ class Store:
                 obj = self._objects.pop(key, None)
                 self._events_by_ns.get(namespace, set()).discard(key)
                 if obj is not None:
+                    self._uids.pop(obj.metadata.uid, None)
                     self._notify(WatchEvent("DELETED", obj.clone()))
 
     def events_for(self, kind: str, namespace: str, name: str) -> list[Event]:

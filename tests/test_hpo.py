@@ -324,3 +324,40 @@ def test_objective_runs_once_despite_write_conflicts():
         assert exp.status.trials_succeeded == 4
         assert len(failed_once) == 4          # every pod write conflicted once
         assert len(runs) == 4                 # ...but no objective re-ran
+
+
+def test_delete_interleaved_with_inflight_reconcile_leaves_no_orphans():
+    """The round-3 cascade race, deterministically: DELETE lands between
+    the Experiment read at the top of reconcile and the Trial create.
+    The re-get + store-level OwnerGone must leave zero orphan Trials
+    (before the fix, reconcile re-created Trials owned by a dead uid and
+    nothing ever collected them)."""
+    from kubeflow_tpu.controlplane.controllers.hpo import (
+        ExperimentController,
+    )
+    from kubeflow_tpu.controlplane.store import Store
+
+    class RaceStore(Store):
+        """Injects the DELETE at a chosen point inside reconcile."""
+        delete_on = None  # "list" (before re-get) | "create" (after)
+
+        def list(self, kind, namespace=None, **kw):
+            if kind == "Trial" and self.delete_on == "list":
+                self.delete_on = None
+                self.delete("Experiment", "user1", "exp")
+            return super().list(kind, namespace, **kw)
+
+        def create(self, obj, **kw):
+            if obj.kind == "Trial" and self.delete_on == "create":
+                self.delete_on = None
+                self.delete("Experiment", "user1", "exp")
+            return super().create(obj, **kw)
+
+    for point in ("list", "create"):
+        store = RaceStore()
+        store.create(_experiment(max_trials=4, parallel=2))
+        store.delete_on = point
+        ExperimentController().reconcile(store, "user1", "exp")  # no raise
+        assert store.list("Trial", "user1") == [], (
+            f"orphan Trials after DELETE injected at {point!r}")
+        assert store.try_get("Experiment", "user1", "exp") is None
