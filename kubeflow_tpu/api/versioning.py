@@ -35,6 +35,7 @@ GROUP = "kubeflow-tpu.dev"
 STORAGE_VERSION = "v1"
 SERVED_VERSIONS: dict[str, tuple[str, ...]] = {
     "Notebook": ("v1alpha1", "v1beta1", "v1"),
+    "Profile": ("v1beta1", "v1"),
 }
 
 # Unrepresentable-field stash (k8s round-trip discipline): conversion TO
@@ -167,3 +168,90 @@ register_conversion("Notebook", "v1alpha1",
 register_conversion("Notebook", "v1beta1",
                     to_hub=_nb_v1beta1_to_hub,
                     from_hub=_nb_hub_to_v1beta1)
+
+
+# ---------------------------------------------------------------------------
+# Profile conversions (ref profile_types.go: served v1beta1 AND v1,
+# storage v1 — api/v1/profile_types.go:59. The reference's two versions
+# are structurally identical; ours carry the real schema delta between
+# the k8s-shaped wire form and the TPU-first hub.)
+# ---------------------------------------------------------------------------
+
+# v1beta1 owner is an rbac Subject {kind, name, apiGroup} (ref
+# ProfileSpec.Owner rbacv1.Subject); the hub keeps only the user id
+# string, so a non-User subject kind rides an annotation to round-trip.
+OWNER_KIND_ANNOTATION = f"{GROUP}/conversion.owner-kind"
+# v1beta1 resourceQuotaSpec is the full k8s ResourceQuotaSpec; the hub
+# keeps only the `hard` map, so the remaining fields (scopes,
+# scopeSelector) ride an annotation — same round-trip rule as above.
+QUOTA_EXTRAS_ANNOTATION = f"{GROUP}/conversion.quota-extras"
+_RBAC_API_GROUP = "rbac.authorization.k8s.io"
+
+
+def _pf_v1beta1_to_hub(data: dict) -> dict:
+    spec = data.get("spec", {})
+    owner = spec.get("owner", {}) or {}
+    if isinstance(owner, dict):
+        spec["owner"] = owner.get("name", "")
+        kind = owner.get("kind", "") or "User"
+        if kind != "User":
+            data.setdefault("metadata", {}).setdefault(
+                "annotations", {})[OWNER_KIND_ANNOTATION] = kind
+    quota = spec.pop("resourceQuotaSpec", {}) or {}
+    spec["resource_quota"] = dict(quota.get("hard", {}) or {})
+    extras = {k: v for k, v in quota.items() if k != "hard"}
+    if extras:
+        import json as _json
+        data.setdefault("metadata", {}).setdefault(
+            "annotations", {})[QUOTA_EXTRAS_ANNOTATION] = (
+            _json.dumps(extras, sort_keys=True))
+    spec["plugins"] = [
+        {"kind": p.get("kind", ""),
+         "options": dict(p.get("spec", {}) or {})}
+        for p in (spec.get("plugins") or [])
+    ]
+    status = data.get("status", {}) or {}
+    conds = status.pop("conditions", None)
+    if conds is not None:
+        # Latest condition wins (status is controller-owned and
+        # regenerated on reconcile; ref ProfileStatus.Conditions).
+        last = conds[-1] if conds else {}
+        status["phase"] = {"Successful": "Ready",
+                           "Failed": "Failed"}.get(last.get("type", ""), "")
+        status["message"] = last.get("message", "")
+        data["status"] = status
+    return data
+
+
+def _pf_hub_to_v1beta1(data: dict) -> dict:
+    spec = data.get("spec", {})
+    ann = data.get("metadata", {}).get("annotations", {})
+    spec["owner"] = {
+        "kind": ann.pop(OWNER_KIND_ANNOTATION, "User"),
+        "name": spec.get("owner", "") or "",
+        "apiGroup": _RBAC_API_GROUP,
+    }
+    quota_wire: dict = {"hard": dict(spec.pop("resource_quota", {}) or {})}
+    if QUOTA_EXTRAS_ANNOTATION in ann:
+        import json as _json
+        quota_wire.update(_json.loads(ann.pop(QUOTA_EXTRAS_ANNOTATION)))
+    spec["resourceQuotaSpec"] = quota_wire
+    spec["plugins"] = [
+        {"kind": p.get("kind", ""),
+         "spec": dict(p.get("options", {}) or {})}
+        for p in (spec.get("plugins") or [])
+    ]
+    status = data.get("status", {}) or {}
+    phase = status.pop("phase", "")
+    message = status.pop("message", "")
+    cond_type = {"Ready": "Successful", "Failed": "Failed"}.get(phase)
+    status["conditions"] = (
+        [{"type": cond_type, "status": "True", "message": message}]
+        if cond_type else [])
+    data["status"] = status
+    return data
+
+
+register_conversion("Profile", "v1beta1",
+                    to_hub=_pf_v1beta1_to_hub,
+                    from_hub=_pf_hub_to_v1beta1)

@@ -39,7 +39,10 @@ _ROLE_MAP = {"admin": ROLE_ADMIN, "edit": ROLE_EDIT, "view": ROLE_VIEW}
 _ROLE_UNMAP = {v: k for k, v in _ROLE_MAP.items()}
 
 _EMAIL_RE = re.compile(r"^[^@\s]+@[^@\s]+$|^sa:[\w.-]+:[\w.-]+$")
-_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
+# RFC-1123 label: a Profile's name becomes its namespace's name. Public
+# so every profile-creating door (KFAM, /apis/) applies the same rule.
+PROFILE_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
+_NAME_RE = PROFILE_NAME_RE
 
 
 class KfamError(Exception):

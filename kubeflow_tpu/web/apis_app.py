@@ -22,6 +22,7 @@ from aiohttp import web
 from kubeflow_tpu.api import versioning
 from kubeflow_tpu.controlplane.store import Store
 from kubeflow_tpu.web.common import (
+    CLUSTER_ADMINS_KEY,
     STORE_KEY,
     base_app,
     ensure_authorized,
@@ -155,6 +156,106 @@ async def delete_resource(request: web.Request) -> web.Response:
     return web.json_response({"status": "deleted"})
 
 
+# -- cluster-scoped resources (Profile) -------------------------------------
+# The reference's L0 serves Profile at BOTH v1beta1 and v1 (storage v1,
+# profile-controller/api/v1/profile_types.go:59, conversion files beside
+# it); old clients built against either version keep working. Authz
+# follows KFAM's owner-or-admin rule (kfam/api_default.go:293-310):
+# admins see/mutate everything, owners see their own profile.
+
+
+def _cluster_admin_and_user(request: web.Request):
+    from kubeflow_tpu.controlplane import auth
+
+    user: auth.User = request["user"]
+    store: Store = request.app[STORE_KEY]
+    admins = request.app.get(CLUSTER_ADMINS_KEY) or set()
+    return auth.is_cluster_admin(store, user, admins), user
+
+
+async def list_profiles(request: web.Request) -> web.Response:
+    store: Store = request.app[STORE_KEY]
+    version = _version(request, "Profile")
+    is_admin, user = _cluster_admin_and_user(request)
+    items = [
+        versioning.to_versioned_dict(p, version)
+        for p in store.list("Profile")
+        if is_admin or p.spec.owner == user.name
+    ]
+    return web.json_response({
+        "apiVersion": f"{versioning.GROUP}/{version}",
+        "kind": "ProfileList",
+        "items": items,
+    })
+
+
+async def get_profile(request: web.Request) -> web.Response:
+    store: Store = request.app[STORE_KEY]
+    version = _version(request, "Profile")
+    name = request.match_info["name"]
+    is_admin, user = _cluster_admin_and_user(request)
+    obj = store.get("Profile", "", name)
+    if not is_admin and obj.spec.owner != user.name:
+        raise web.HTTPForbidden(
+            text=f"{user.name} is not owner/admin of profile {name}")
+    return web.json_response(versioning.to_versioned_dict(obj, version))
+
+
+async def create_profile(request: web.Request) -> web.Response:
+    store: Store = request.app[STORE_KEY]
+    version = _version(request, "Profile")
+    _require_api_client(request)
+    is_admin, user = _cluster_admin_and_user(request)
+    body = await request.json()
+    body.setdefault("kind", "Profile")
+    body.setdefault("apiVersion", f"{versioning.GROUP}/{version}")
+    if versioning.parse_api_version(body["apiVersion"]) != version:
+        raise ValueError(
+            f"body apiVersion {body['apiVersion']!r} does not match "
+            f"request path version {version!r}")
+    obj = versioning.resource_from_versioned_dict(body)
+    if obj.kind != "Profile":
+        raise ValueError(f"body kind {obj.kind!r} != Profile")
+    # Cluster-scoped: a namespace in the body would store the object
+    # under a key no GET/DELETE/reconcile ever reads (phantom profile).
+    obj.metadata.namespace = ""
+    # Same guards as KFAM's create door (kfam.create_profile): the name
+    # becomes a namespace, so it must be a valid non-reserved label.
+    from kubeflow_tpu.controlplane.auth import is_reserved_namespace
+    from kubeflow_tpu.controlplane.kfam import PROFILE_NAME_RE
+
+    name = obj.metadata.name
+    if not PROFILE_NAME_RE.match(name):
+        raise ValueError(f"invalid profile name {name!r}")
+    if is_reserved_namespace(name):
+        raise web.HTTPForbidden(
+            text=f"namespace name {name!r} is reserved")
+    # Self-service registration creates a profile owned by the caller;
+    # creating FOR someone else needs admin (kfam.create_profile rule).
+    obj.spec.owner = obj.spec.owner or user.name
+    if obj.spec.owner != user.name and not is_admin:
+        raise web.HTTPForbidden(
+            text=f"{user.name} cannot create a profile owned by "
+                 f"{obj.spec.owner}")
+    created = store.create(obj)
+    return web.json_response(
+        versioning.to_versioned_dict(created, version), status=201)
+
+
+async def delete_profile(request: web.Request) -> web.Response:
+    store: Store = request.app[STORE_KEY]
+    _version(request, "Profile")
+    name = request.match_info["name"]
+    _require_api_client(request)
+    is_admin, user = _cluster_admin_and_user(request)
+    obj = store.get("Profile", "", name)
+    if not is_admin and obj.spec.owner != user.name:
+        raise web.HTTPForbidden(
+            text=f"{user.name} is not owner/admin of profile {name}")
+    store.delete("Profile", "", name)
+    return web.json_response({"status": "deleted"})
+
+
 def create_apis_app(store: Store, *, cluster_admins=None,
                     csrf: bool = True) -> web.Application:
     app = base_app(store, csrf=csrf, cluster_admins=cluster_admins)
@@ -163,4 +264,9 @@ def create_apis_app(store: Store, *, cluster_admins=None,
     app.router.add_post(base, create_resource)
     app.router.add_get(base + "/{name}", get_resource)
     app.router.add_delete(base + "/{name}", delete_resource)
+    cluster = f"/{versioning.GROUP}/{{version}}/profiles"
+    app.router.add_get(cluster, list_profiles)
+    app.router.add_post(cluster, create_profile)
+    app.router.add_get(cluster + "/{name}", get_profile)
+    app.router.add_delete(cluster + "/{name}", delete_profile)
     return app
