@@ -60,20 +60,48 @@ class HTTPActivityProbe:
     """Probes the notebook pod's Jupyter REST API (ref culler.go:155-201).
 
     10s timeout per the reference (culler.go:19-21).
+
+    DEV mode (ref culler.go:160-164: `DEV=true` proxies through a local
+    `kubectl proxy` instead of in-cluster svc DNS): set
+    `KFTPU_CULLER_DEV=true` to operate the culler OUT of cluster against
+    a remote deployment — probes go through the apiserver service proxy
+    at `KFTPU_DEV_PROXY_BASE` (default http://localhost:8001, kubectl
+    proxy's default listen address).
     """
 
-    def __init__(self, cluster_domain: str = "cluster.local", timeout: float = 10.0):
+    def __init__(self, cluster_domain: str = "cluster.local",
+                 timeout: float = 10.0, *, dev_mode: bool | None = None,
+                 dev_proxy_base: str | None = None):
+        import os
+
         self.cluster_domain = cluster_domain
         self.timeout = timeout
+        self.dev_mode = (
+            os.environ.get("KFTPU_CULLER_DEV", "").lower() == "true"
+            if dev_mode is None else dev_mode)
+        self.dev_proxy_base = (dev_proxy_base
+                               or os.environ.get("KFTPU_DEV_PROXY_BASE",
+                                                 "http://localhost:8001"))
+
+    def url(self, namespace: str, name: str, resource: str) -> str:
+        if self.dev_mode:
+            # apiserver service-proxy path, same shape kubectl proxy
+            # serves (ref culler.go:160-164 DEV branch).
+            return (
+                f"{self.dev_proxy_base}/api/v1/namespaces/{namespace}"
+                f"/services/{name}/proxy/notebook/{namespace}/{name}"
+                f"/api/{resource}"
+            )
+        return (
+            f"http://{name}.{namespace}.svc.{self.cluster_domain}"
+            f"/notebook/{namespace}/{name}/api/{resource}"
+        )
 
     def kernels(self, namespace: str, name: str) -> list[KernelStatus] | None:
         import json
         import urllib.request
 
-        url = (
-            f"http://{name}.{namespace}.svc.{self.cluster_domain}"
-            f"/notebook/{namespace}/{name}/api/kernels"
-        )
+        url = self.url(namespace, name, "kernels")
         try:
             with urllib.request.urlopen(url, timeout=self.timeout) as r:
                 data = json.loads(r.read())

@@ -39,17 +39,32 @@ def _to_word_bytes(word: str) -> tuple[int, ...]:
     return tuple(word.encode("utf-8"))
 
 
+# Longest word the greedy encoder will process whole. Space-free runs
+# (CJK prose, URLs, base64 blobs) otherwise become ONE word, making
+# encode O(bytes^2) in the run length and stuffing unbounded-size
+# entries into the LRU — the server's text mode exposes that to
+# clients. Chunking preserves exact decode (concatenation) and costs
+# only the merges that would have crossed a chunk boundary.
+_MAX_WORD_CHARS = 128
+
+
 def _split_words(text: str) -> list[str]:
     """Leading-space word convention: "a b" -> ["a", " b"] — boundaries
-    survive tokenization, so decode is exact concatenation."""
+    survive tokenization, so decode is exact concatenation. Words longer
+    than _MAX_WORD_CHARS are chunked (see note above)."""
     out: list[str] = []
+
+    def push(word: str) -> None:
+        for i in range(0, len(word), _MAX_WORD_CHARS):
+            out.append(word[i:i + _MAX_WORD_CHARS])
+
     start = 0
     for i in range(1, len(text)):
         if text[i] == " " and text[i - 1] != " ":
-            out.append(text[start:i])
+            push(text[start:i])
             start = i
     if text:
-        out.append(text[start:])
+        push(text[start:])
     return out
 
 

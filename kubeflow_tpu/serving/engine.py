@@ -320,6 +320,12 @@ class InferenceEngine:
                     f"{batch}")
         if (top_k < 0).any():
             raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if (top_k >= 2**31).any():
+            # validated as int64 above, stored int32 below: without this
+            # check a library caller's huge top_k would silently wrap
+            # negative (the HTTP server range-checks; the Python API
+            # must reject identically)
+            raise ValueError(f"top_k must be < 2**31, got {top_k}")
         if not ((0.0 < top_p) & (top_p <= 1.0)).all():
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if any(a.ndim == 1 for a in (temperature, top_k, top_p)):

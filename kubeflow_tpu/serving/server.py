@@ -367,6 +367,15 @@ async def generate(request: web.Request):
     if not isinstance(speculative, bool):
         return web.json_response(
             {"error": "speculative must be a boolean"}, status=400)
+    # max_new is jit-static on the speculative and direct paths (the
+    # Batcher already buckets its groups): bucket it the same way so a
+    # client sweeping max_new mints O(log max_len) compiles, not one
+    # per value, while holding the GPU lock. Generation runs to the
+    # bucket; the response is trimmed back to the client's ask below.
+    max_new_req = max_new
+    max_new = Batcher._bucket(max_new, engine.ec.max_len - prompt_len)
+    if max_new < max_new_req:  # cap clamped below the ask — cannot happen
+        max_new = max_new_req  # (capacity was checked), but stay safe
     gamma = body.get("gamma", 4)
     if not isinstance(gamma, int) or isinstance(gamma, bool) or gamma < 1:
         return web.json_response(
@@ -390,12 +399,16 @@ async def generate(request: web.Request):
             g *= 2
         gamma = g
         # the draft's cache must hold the window too (it is usually the
-        # smaller model — and often configured with a smaller bucket)
+        # smaller model — and often configured with a smaller bucket).
+        # The bucketed max_new shrinks back toward the exact ask before
+        # rejecting: only the CLIENT's numbers may cause a 400.
         cap = min(engine.ec.max_len, spec.draft.ec.max_len)
         if prompt_len + max_new + gamma > cap:
+            max_new = max(cap - prompt_len - gamma, max_new_req)
+        if prompt_len + max_new_req + gamma > cap:
             return web.json_response(
-                {"error": f"prompt {prompt_len} + max_new {max_new} + "
-                          f"gamma {gamma} exceeds model max_len {cap}"},
+                {"error": f"prompt {prompt_len} + max_new {max_new_req} "
+                          f"+ gamma {gamma} exceeds model max_len {cap}"},
                 status=400)
 
         def run_spec():
@@ -427,7 +440,7 @@ async def generate(request: web.Request):
         # single-prompt requests ride the dynamic batcher; explicit
         # client-side batches keep their one-shot path
         ids = await batcher.submit(
-            arr[0].tolist(), max_new, tuple(sorted(sampling.items())))
+            arr[0].tolist(), max_new_req, tuple(sorted(sampling.items())))
         toks = np.asarray([ids], np.int32)
     else:
         async with request.app[GPU_LOCK_KEY]:
@@ -437,6 +450,7 @@ async def generate(request: web.Request):
                     engine.generate(jnp.asarray(arr), max_new=max_new,
                                     **sampling)),
             )
+    toks = toks[:, :max_new_req]  # trim the bucket back to the ask
     resp: dict[str, Any] = {"tokens": toks.tolist(), **resp_extra}
     if text_mode:
         resp["text"] = (tokenizer.decode(toks[0].tolist()) if tokenizer

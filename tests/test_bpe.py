@@ -115,3 +115,24 @@ def test_merge_starved_corpus_stops_early():
     tok = bpe.train(["ab"], vocab_size=256 + 50 + 3)
     assert len(tok.merges) <= 1
     assert tok.decode(tok.encode("ab")) == "ab"
+
+
+def test_space_free_runs_stay_linear_and_roundtrip():
+    """ADVICE r3: a long space-free run (URL/base64/CJK-style) must not
+    go quadratic — words are chunked at _MAX_WORD_CHARS — and decode
+    stays the exact inverse of encode."""
+    import time
+
+    from kubeflow_tpu.data import bpe
+
+    tok = bpe.train(["ab cd ab cd ef" * 50], vocab_size=300)
+    blob = "x" + "abcdef0123456789" * 4096  # 64 KiB, zero spaces
+    t0 = time.perf_counter()
+    ids = tok.encode(blob)
+    dt = time.perf_counter() - t0
+    assert tok.decode(ids) == blob
+    assert dt < 2.0, f"encode of a 64 KiB space-free run took {dt:.1f}s"
+    # the LRU only ever sees bounded words
+    assert max(
+        len(w.encode()) for w in bpe._split_words(blob)
+    ) <= 4 * bpe._MAX_WORD_CHARS

@@ -498,3 +498,32 @@ def test_sharded_gemma_scale_vocab_decode_matches_unsharded():
     with jax.set_mesh(mesh):
         got = engine.generate(prompt, max_new=4)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+async def test_direct_path_buckets_max_new_but_trims_response(llama_engine):
+    """max_new is jit-static on the direct (client-batch) path: the
+    server buckets it (ADVICE r3: a sweep must not mint one compile per
+    value) yet the response carries exactly the requested count."""
+    engine, cfg, _ = llama_engine
+    app = server_lib.create_serving_app({"llama-tiny": engine})
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        for ask in (3, 5, 7):  # same power-of-two bucket (16)
+            r = await client.post(
+                "/v1/models/llama-tiny:generate",
+                json={"tokens": [[1, 2, 3], [4, 5, 6]], "max_new": ask})
+            assert r.status == 200, await r.text()
+            toks = (await r.json())["tokens"]
+            assert [len(t) for t in toks] == [ask, ask]
+    finally:
+        await client.close()
+
+
+def test_top_k_overflow_rejected_in_library_api(llama_engine):
+    """ADVICE r3: top_k >= 2**31 wrapped negative through the int32
+    cast for direct library callers; must ValueError like the server."""
+    engine, cfg, _ = llama_engine
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    with pytest.raises(ValueError, match="2\\*\\*31"):
+        engine.generate(prompt, max_new=2, temperature=1.0, top_k=2**31)
