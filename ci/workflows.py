@@ -237,6 +237,34 @@ def dryrun_workflow() -> dict:
     }
 
 
+def deploy_smoke_workflow() -> dict:
+    """Boot-what-you-ship gate (ref nb_controller_kind_test.yaml:1-30:
+    KinD + kustomize-apply + e2e): deploy/smoke.py stands the platform
+    up from the COMMITTED overlay artifacts and runs the e2e suite."""
+    return {
+        "name": "deploy overlay smoke",
+        "on": {
+            "pull_request": {"paths": ["deploy/**", "e2e/**",
+                                       "kubeflow_tpu/**"]},
+            "push": {"branches": ["main"]},
+        },
+        "jobs": {
+            "smoke": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e .[ci]"},
+                    {"name": "boot the standalone overlay + e2e",
+                     "run": "python deploy/smoke.py standalone",
+                     "env": {"JAX_PLATFORMS": "cpu"}},
+                ],
+            }
+        },
+    }
+
+
 def slow_tier_workflow() -> dict:
     """The compile-heavy opt-in tier: everything marked `slow` that the
     default `-m "not slow"` run (pyproject addopts) deselects. The split
@@ -303,6 +331,7 @@ def all_workflows() -> dict[str, dict]:
         out[f"{img}_image_build.yaml"] = image_build_workflow(img)
     out["multichip_dryrun.yaml"] = dryrun_workflow()
     out["platform_e2e.yaml"] = e2e_workflow()
+    out["deploy_smoke_test.yaml"] = deploy_smoke_workflow()
     out["slow_tier_test.yaml"] = slow_tier_workflow()
     out["frontend_test.yaml"] = frontend_workflow()
     out.update(cd.all_workflows())

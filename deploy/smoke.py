@@ -1,0 +1,161 @@
+"""Boot-what-you-ship smoke tier: stand the platform up FROM the
+rendered overlay artifacts and run the full e2e suite against it.
+
+The reference proves its manifests by booting KinD + Istio and
+`kustomize build | kubectl apply`-ing every component in CI
+(`/root/reference/.github/workflows/nb_controller_kind_test.yaml:1-30`,
+`components/testing/gh-actions/install_kind.sh`). This is the same tier
+without a cluster, in the fake-kubelet spirit the repo's tests use
+everywhere (SURVEY.md §4): act as the kubelet for the platform
+Deployment in `deploy/overlays/<name>/` —
+
+  1. parse the COMMITTED manifests (not the emitter — drift between
+     emitter and committed output is tests/test_deploy.py's job; this
+     tier runs what an operator would `kubectl apply`);
+  2. materialize every ConfigMap the pod mounts into a temp dir and
+     remap the mount paths in the container's command;
+  3. exec the container's exact command with the manifest's env
+     (a free port substituted for the in-cluster one);
+  4. run `e2e/run_e2e.py --base-url` against it.
+
+Exit 0 iff the platform came up from the shipped artifacts and every
+e2e phase passed. Run: `python deploy/smoke.py [standalone|gke]`.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+READY_BUDGET_S = 90.0
+
+
+def _load_yaml_docs(path: str) -> list[dict]:
+    import yaml
+
+    with open(path) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def load_overlay(name: str) -> list[dict]:
+    """All objects from the overlay's committed kustomization."""
+    d = os.path.join(REPO, "deploy", "overlays", name)
+    kust = _load_yaml_docs(os.path.join(d, "kustomization.yaml"))[0]
+    docs: list[dict] = []
+    for res in kust["resources"]:
+        docs.extend(_load_yaml_docs(os.path.join(d, res)))
+    return docs
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def boot_platform(docs: list[dict], workdir: str):
+    """Fake-kubelet for the platform Deployment: returns (proc, base_url,
+    log_path)."""
+    deployments = [d for d in docs if d.get("kind") == "Deployment"]
+    assert len(deployments) == 1, [d.get("kind") for d in docs]
+    pod = deployments[0]["spec"]["template"]["spec"]
+    (container,) = pod["containers"]
+    configmaps = {d["metadata"]["name"]: d for d in docs
+                  if d.get("kind") == "ConfigMap"}
+
+    # Materialize ConfigMap volumes; mount-path -> local-dir remap.
+    remap: dict[str, str] = {}
+    for vol in pod.get("volumes", []):
+        cm_name = vol.get("configMap", {}).get("name")
+        if cm_name is None:
+            continue
+        cm = configmaps[cm_name]  # dangling ref = broken overlay: raise
+        mount = next(m for m in container["volumeMounts"]
+                     if m["name"] == vol["name"])
+        local = os.path.join(workdir, vol["name"])
+        os.makedirs(local, exist_ok=True)
+        for fname, text in cm.get("data", {}).items():
+            with open(os.path.join(local, fname), "w") as f:
+                f.write(text)
+        remap[mount["mountPath"]] = local
+
+    port = _free_port()
+    command = []
+    for arg in container["command"]:
+        for mount_path, local in remap.items():
+            if arg.startswith(mount_path):
+                arg = local + arg[len(mount_path):]
+        command.append(arg)
+    # The in-cluster port becomes a free local one (Service targetPort).
+    for i, arg in enumerate(command):
+        if arg == "--port":
+            command[i + 1] = str(port)
+
+    env = dict(os.environ)
+    for e in container.get("env", []):
+        env[e["name"]] = e.get("value", "")
+
+    log_path = os.path.join(workdir, "platform.log")
+    log = open(log_path, "w")
+    proc = subprocess.Popen(command, cwd=REPO, env=env, stdout=log,
+                            stderr=subprocess.STDOUT, text=True)
+    return proc, f"http://127.0.0.1:{port}", log_path
+
+
+def wait_ready(base: str, proc: subprocess.Popen) -> None:
+    """Poll the manifest's readiness path (the kubelet's job)."""
+    deadline = time.monotonic() + READY_BUDGET_S
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"platform exited rc={proc.returncode} before ready")
+        try:
+            with urllib.request.urlopen(f"{base}/readyz", timeout=2) as r:
+                if r.status == 200:
+                    return
+        except (urllib.error.URLError, OSError):
+            pass
+        time.sleep(0.5)
+    raise RuntimeError(f"platform not ready within {READY_BUDGET_S}s")
+
+
+def main() -> int:
+    overlay = sys.argv[1] if len(sys.argv) > 1 else "standalone"
+    docs = load_overlay(overlay)
+    kinds = sorted({d["kind"] for d in docs})
+    print(f"[smoke] overlay {overlay}: {len(docs)} objects ({kinds})")
+
+    with tempfile.TemporaryDirectory(prefix="kftpu-smoke-") as workdir:
+        proc, base, log_path = boot_platform(docs, workdir)
+        try:
+            wait_ready(base, proc)
+            print(f"[smoke] platform up at {base} "
+                  f"(command from the {overlay} overlay)")
+            e2e = subprocess.run(
+                [sys.executable, os.path.join(REPO, "e2e", "run_e2e.py"),
+                 "--base-url", base], cwd=REPO)
+            return e2e.returncode
+        except Exception as e:  # noqa: BLE001 — report, then log tail
+            print(f"[smoke] FAILED: {e}")
+            with open(log_path) as f:
+                print("---- platform log tail ----")
+                print("\n".join(f.read().splitlines()[-40:]))
+            return 1
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

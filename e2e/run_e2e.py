@@ -128,6 +128,45 @@ def profile_creation(alice: Client, admin: Client) -> None:
          alice.req("GET", "/api/workgroup/env-info")[1]["namespaces"])
 
 
+@phase("profile-multiversion")
+def profile_multiversion(alice: Client, admin: Client) -> None:
+    """The same Profile read through BOTH served versions of the /apis/
+    door (ref profile_types.go:59: v1beta1 and v1, storage v1): an old
+    v1beta1 client sees the rbac-Subject wire shape, a v1 client the
+    storage shape, for the profile the previous phase created."""
+    status, v1 = alice.req(
+        "GET", "/apis/kubeflow-tpu.dev/v1/profiles/alice")
+    assert status == 200, (status, v1)
+    assert v1["spec"]["owner"] == ALICE, v1["spec"]
+
+    status, v1b = alice.req(
+        "GET", "/apis/kubeflow-tpu.dev/v1beta1/profiles/alice")
+    assert status == 200, (status, v1b)
+    owner = v1b["spec"]["owner"]
+    assert owner == {"kind": "User", "name": ALICE,
+                     "apiGroup": "rbac.authorization.k8s.io"}, owner
+    assert "resourceQuotaSpec" in v1b["spec"], v1b["spec"]
+
+    # And a v1beta1-shaped WRITE: create, verify the controller builds
+    # the namespace, read back at v1, delete.
+    body = {"kind": "Profile",
+            "metadata": {"name": "alice-beta"},
+            "spec": {"owner": {"kind": "User", "name": ALICE}}}
+    status, out = alice.api(
+        "POST", "/apis/kubeflow-tpu.dev/v1beta1/profiles", body)
+    assert status == 201, (status, out)
+    poll("alice-beta namespace reconciled", lambda: "alice-beta" in
+         alice.req("GET", "/api/workgroup/env-info")[1]["namespaces"])
+    status, got = alice.req(
+        "GET", "/apis/kubeflow-tpu.dev/v1/profiles/alice-beta")
+    assert status == 200 and got["spec"]["owner"] == ALICE, (status, got)
+    status, _ = alice.api(
+        "DELETE", "/apis/kubeflow-tpu.dev/v1beta1/profiles/alice-beta")
+    assert status == 200, status
+    poll("alice-beta gone", lambda: alice.req(
+        "GET", "/apis/kubeflow-tpu.dev/v1/profiles/alice-beta")[0] == 404)
+
+
 @phase("notebook-creation")
 def notebook_creation(alice: Client, admin: Client) -> None:
     status, cfg = alice.req("GET", "/jupyter/api/config")
@@ -328,17 +367,32 @@ def free_port() -> int:
 
 
 def main() -> int:
-    port = free_port()
-    base = f"http://127.0.0.1:{port}"
-    # Log to a file, not a PIPE: nothing drains a pipe until the end,
-    # and access-logging every poll would fill the 64K buffer and block
-    # the server mid-suite.
-    log = tempfile.NamedTemporaryFile(
-        mode="w+", suffix=".log", prefix="kftpu-e2e-", delete=False)
-    server = subprocess.Popen(
-        [sys.executable, "-m", "kubeflow_tpu.web.platform",
-         "--port", str(port), "--tpu-slices", "v5e-16=2,v5e-1=4"],
-        cwd=REPO, stdout=log, stderr=subprocess.STDOUT, text=True)
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--base-url", default="",
+                   help="run the phases against an ALREADY RUNNING "
+                        "platform (deploy/smoke.py boots one from the "
+                        "rendered overlay artifacts) instead of "
+                        "spawning a dev server from the checkout")
+    args = p.parse_args()
+
+    server = None
+    log = None
+    if args.base_url:
+        base = args.base_url.rstrip("/")
+    else:
+        port = free_port()
+        base = f"http://127.0.0.1:{port}"
+        # Log to a file, not a PIPE: nothing drains a pipe until the
+        # end, and access-logging every poll would fill the 64K buffer
+        # and block the server mid-suite.
+        log = tempfile.NamedTemporaryFile(
+            mode="w+", suffix=".log", prefix="kftpu-e2e-", delete=False)
+        server = subprocess.Popen(
+            [sys.executable, "-m", "kubeflow_tpu.web.platform",
+             "--port", str(port), "--tpu-slices", "v5e-16=2,v5e-1=4"],
+            cwd=REPO, stdout=log, stderr=subprocess.STDOUT, text=True)
     alice = Client(base, ALICE)
     admin = Client(base, "admin@example.com")
     report, failed = [], False
@@ -358,19 +412,20 @@ def main() -> int:
             print(f"[e2e] {name}: {status} ({dt}s)", flush=True)
             report.append({"phase": name, "status": status, "seconds": dt})
     finally:
-        server.terminate()
-        try:
-            server.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            server.kill()
-            server.wait()
-        log.close()
-        if failed:
-            with open(log.name) as f:
-                tail = f.read().splitlines()[-40:]
-            print("---- server log tail ----")
-            print("\n".join(tail))
-        os.unlink(log.name)
+        if server is not None:
+            server.terminate()
+            try:
+                server.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                server.kill()
+                server.wait()
+            log.close()
+            if failed:
+                with open(log.name) as f:
+                    tail = f.read().splitlines()[-40:]
+                print("---- server log tail ----")
+                print("\n".join(tail))
+            os.unlink(log.name)
     print(json.dumps({"suite": "e2e", "phases": report,
                       "ok": not failed}))
     return 1 if failed else 0

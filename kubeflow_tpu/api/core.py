@@ -108,6 +108,7 @@ class Probe:
 class Container:
     name: str = ""
     image: str = ""
+    image_pull_policy: str = ""   # "" | Always | IfNotPresent | Never
     command: list[str] = field(default_factory=list)
     args: list[str] = field(default_factory=list)
     env: list[EnvVar] = field(default_factory=list)

@@ -90,9 +90,50 @@ DEFAULT_SPAWNER_CONFIG: dict[str, Any] = {
             "kubeflow-tpu/rstudio-tidyverse:latest",
         ],
         "readOnly": False,
+        # ref form.py:75-86 customImage: a body {"customImage": "..."}
+        # bypasses the options list — but only when the admin opted in
+        # (the reference trusts custom images unconditionally; an image
+        # allowlist that any user can skip is not an allowlist).
+        "allowCustom": False,
+    },
+    # ref form.py:88-93 set_notebook_image_pull_policy
+    "imagePullPolicy": {
+        "value": "IfNotPresent",
+        "options": ["Always", "IfNotPresent", "Never"],
+        "readOnly": False,
     },
     "cpu": {"value": "0.5", "limitFactor": 1.2, "readOnly": False},
     "memory": {"value": "1.0Gi", "limitFactor": 1.2, "readOnly": False},
+    # Admin-defined placement groups (ref form.py:178-223
+    # set_notebook_affinity/set_notebook_tolerations): the user picks a
+    # KEY; the pod gets the admin's full affinity/toleration payload.
+    # The worked example is the TPU story: pin notebooks to a TPU node
+    # pool and tolerate its taint (generalizes the reference's only
+    # placement-aware code, tensorboard RWO co-scheduling, SURVEY §5).
+    "affinityConfig": {
+        "value": "none",
+        "options": [
+            {"configKey": "tpu-v5e-pool",
+             "desc": "Schedule onto the v5e TPU node pool",
+             "affinity": [
+                 {"key": "cloud.google.com/gke-tpu-accelerator",
+                  "values": ["tpu-v5-lite-podslice"]},
+             ]},
+        ],
+        "readOnly": False,
+    },
+    "tolerationGroup": {
+        "value": "none",
+        "options": [
+            {"groupKey": "tpu-reserved",
+             "desc": "Tolerate the reserved TPU pool taint",
+             "tolerations": [
+                 {"key": "google.com/tpu", "value": "present",
+                  "effect": "NoSchedule"},
+             ]},
+        ],
+        "readOnly": False,
+    },
     # TPU slice picker (replaces the reference's `gpus` vendor block)
     "tpu": {
         "value": {"topology": "", "mesh": ""},
@@ -134,6 +175,9 @@ class NotebookForm:
     tolerations: list[dict] = field(default_factory=list)
     shm: bool = True
     configurations: list[str] = field(default_factory=list)
+    image_pull_policy: str = ""
+    affinity_config: str = "none"     # configKey into admin options
+    toleration_group: str = "none"    # groupKey into admin options
 
 
 def parse_form(body: dict, config: dict[str, Any] | None = None) -> NotebookForm:
@@ -143,12 +187,29 @@ def parse_form(body: dict, config: dict[str, Any] | None = None) -> NotebookForm
     if not name or not namespace:
         raise FormError("name and namespace are required")
 
-    image = get_form_value(body, config, "image")
-    options = config.get("image", {}).get("options", [])
-    # readOnly pins the admin value (trusted by construction); otherwise the
-    # value is user-supplied and MUST be on the allowlist.
-    if options and image not in options and not config["image"].get("readOnly"):
-        raise FormError(f"image {image!r} not in allowed options")
+    image_cfg = config.get("image", {})
+    custom_image = body.get("customImage", "")
+    if custom_image and not image_cfg.get("readOnly"):
+        # ref form.py:75-86: customImage bypasses the picker — gated on
+        # admin opt-in here (readOnly still pins the admin image).
+        if not image_cfg.get("allowCustom"):
+            raise FormError("custom images are not allowed by the "
+                            "admin config (image.allowCustom)")
+        image = str(custom_image)
+    else:
+        image = get_form_value(body, config, "image")
+        options = image_cfg.get("options", [])
+        # readOnly pins the admin value (trusted by construction);
+        # otherwise the value is user-supplied and MUST be allowlisted.
+        if options and image not in options and not image_cfg.get("readOnly"):
+            raise FormError(f"image {image!r} not in allowed options")
+
+    pull_policy = str(get_form_value(body, config, "imagePullPolicy")
+                      or "")
+    pp_options = config.get("imagePullPolicy", {}).get("options", [])
+    if pull_policy and pp_options and pull_policy not in pp_options:
+        raise FormError(
+            f"imagePullPolicy {pull_policy!r} not in {pp_options}")
 
     tpu = get_form_value(body, config, "tpu") or {}
     topo = tpu.get("topology", "")
@@ -168,6 +229,24 @@ def parse_form(body: dict, config: dict[str, Any] | None = None) -> NotebookForm
             f"{name}-workspace"
         )
 
+    # Group-key pickers (ref form.py:178-223): resolved against the
+    # admin options at BUILD time; validate the keys here so a typo is
+    # a 400, not a silently unplaced pod (the reference only logs).
+    aff_key = str(get_form_value(body, config, "affinityConfig")
+                  or "none")
+    aff_keys = {o.get("configKey")
+                for o in config.get("affinityConfig", {}).get("options", [])}
+    if aff_key != "none" and aff_key not in aff_keys:
+        raise FormError(f"unknown affinityConfig key {aff_key!r}; "
+                        f"allowed: {sorted(aff_keys) + ['none']}")
+    tol_key = str(get_form_value(body, config, "tolerationGroup")
+                  or "none")
+    tol_keys = {o.get("groupKey")
+                for o in config.get("tolerationGroup", {}).get("options", [])}
+    if tol_key != "none" and tol_key not in tol_keys:
+        raise FormError(f"unknown tolerationGroup key {tol_key!r}; "
+                        f"allowed: {sorted(tol_keys) + ['none']}")
+
     return NotebookForm(
         name=name,
         namespace=namespace,
@@ -181,6 +260,9 @@ def parse_form(body: dict, config: dict[str, Any] | None = None) -> NotebookForm
         tolerations=get_form_value(body, config, "tolerations") or [],
         shm=bool(get_form_value(body, config, "shm")),
         configurations=get_form_value(body, config, "configurations") or [],
+        image_pull_policy=pull_policy,
+        affinity_config=aff_key,
+        toleration_group=tol_key,
     )
 
 
@@ -195,7 +277,8 @@ def build_notebook(form: NotebookForm, config: dict[str, Any] | None = None) -> 
 
     cpu_factor = float(config.get("cpu", {}).get("limitFactor", 1.2))
     mem_factor = float(config.get("memory", {}).get("limitFactor", 1.2))
-    container = Container(name=form.name, image=form.image)
+    container = Container(name=form.name, image=form.image,
+                          image_pull_policy=form.image_pull_policy)
     container.resources.requests = {"cpu": form.cpu, "memory": form.memory}
     container.resources.limits = {
         "cpu": format_cpu(parse_cpu(form.cpu) * cpu_factor),
@@ -230,6 +313,27 @@ def build_notebook(form: NotebookForm, config: dict[str, Any] | None = None) -> 
             key=t.get("key", ""), value=t.get("value", ""),
             effect=t.get("effect", ""),
         ))
+
+    # Admin placement groups (ref form.py:178-223): the key the user
+    # picked expands to the admin's full payload on the pod template.
+    if form.affinity_config != "none":
+        for opt in config.get("affinityConfig", {}).get("options", []):
+            if opt.get("configKey") == form.affinity_config:
+                from kubeflow_tpu.api.core import NodeSelectorTerm
+                tmpl.spec.affinity_terms.extend(
+                    NodeSelectorTerm(key=a.get("key", ""),
+                                     values=list(a.get("values", [])))
+                    for a in opt.get("affinity", []))
+                break
+    if form.toleration_group != "none":
+        for opt in config.get("tolerationGroup", {}).get("options", []):
+            if opt.get("groupKey") == form.toleration_group:
+                tmpl.spec.tolerations.extend(
+                    Toleration(key=t.get("key", ""),
+                               value=t.get("value", ""),
+                               effect=t.get("effect", ""))
+                    for t in opt.get("tolerations", []))
+                break
     nb.spec.template = tmpl
     return nb
 

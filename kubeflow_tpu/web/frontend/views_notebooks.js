@@ -158,6 +158,25 @@ export async function notebookFormView() {
     ...(tpu.readOnly ? { readonly: '' } : {}),
   });
 
+  const aff = section(config, 'affinityConfig');
+  const tol = section(config, 'tolerationGroup');
+  const groupSelect = (sec, keyField, label) =>
+    h(
+      'select',
+      { 'aria-label': label, ...pinned(sec) },
+      [h('option', { value: 'none', ...(sec.value === 'none' ? { selected: '' } : {}) }, 'none')].concat(
+        (sec.options || []).map((o) =>
+          h(
+            'option',
+            { value: o[keyField], ...(o[keyField] === sec.value ? { selected: '' } : {}) },
+            `${o[keyField]} — ${o.desc || ''}`,
+          ),
+        ),
+      ),
+    );
+  const affSelect = groupSelect(aff, 'configKey', 'Affinity group');
+  const tolSelect = groupSelect(tol, 'groupKey', 'Toleration group');
+
   const wsName = h('input', {
     value: (ws.value || {}).name || '{notebook-name}-workspace',
     ...(ws.readOnly ? { readonly: '' } : {}),
@@ -193,6 +212,8 @@ export async function notebookFormView() {
         cpu: cpuInput.value,
         memory: memInput.value,
         tpu: { topology: topoSelect.value, mesh: meshInput.value.trim() },
+        affinityConfig: affSelect.value,
+        tolerationGroup: tolSelect.value,
         workspace: { name: wsName.value, size: wsSize.value },
         shm: shmCheck.checked,
         configurations: pdChecks
@@ -230,6 +251,10 @@ export async function notebookFormView() {
       h('label', {}, 'Device mesh'),
       meshInput,
       h('div', { class: 'field-note' }, 'Mesh axes (data/fsdp/tensor) must multiply to the slice chip count; leave empty for pure FSDP.'),
+      h('label', {}, 'Affinity group', roPill(aff)),
+      affSelect,
+      h('label', {}, 'Toleration group', roPill(tol)),
+      tolSelect,
       h('label', {}, 'Workspace volume', roPill(ws)),
       h('div', {}, wsName, h('div', { class: 'field-note' }, '{notebook-name} expands to the server name.')),
       h('label', {}, 'Workspace size'),
