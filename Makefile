@@ -1,0 +1,31 @@
+# Developer entry points (the reference drives everything through
+# per-component Makefiles; here one root Makefile covers the repo).
+
+.PHONY: test test-slow test-all e2e smoke conformance bench dryrun native
+
+test:        ## fast tier: compile-heavy tests deselected (<5 min)
+	python -m pytest tests -q
+
+test-slow:   ## the compile-heavy tier only (CI runs it on main)
+	python -m pytest tests -q -m slow
+
+test-all:    ## both tiers
+	python -m pytest tests -q -m "slow or not slow"
+
+e2e:         ## out-of-process platform lifecycle suite
+	python e2e/run_e2e.py
+
+smoke:       ## boot the platform from the shipped overlay + e2e
+	python deploy/smoke.py standalone
+
+conformance: ## capability certification checks
+	python conformance/conformance.py
+
+bench:       ## perf sweep on the local device (CPU falls back safely)
+	python bench.py
+
+dryrun:      ## multi-chip sharding compile gate (8 virtual devices)
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+native:      ## C++ data loader
+	$(MAKE) -C native

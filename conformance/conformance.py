@@ -120,6 +120,46 @@ def tenancy_profile(c: Cluster) -> None:
     assert c.store.get("RoleBinding", "conf-user", "default-editor")
 
 
+@check("multiversion-conversion")
+def multiversion_conversion(c: Cluster) -> None:
+    """Old-client compatibility: Notebook AND Profile serve every
+    registered version with lossless round-trips (ref conversion files
+    beside notebook_types.go / profile_types.go)."""
+    from kubeflow_tpu.api import versioning
+
+    for kind, versions in versioning.SERVED_VERSIONS.items():
+        assert versioning.STORAGE_VERSION in versions, (kind, versions)
+        assert len(versions) >= 2, f"{kind} serves a single version"
+    # Profile: wire round-trip through the down-level version
+    wire = {"apiVersion": f"{versioning.GROUP}/v1beta1", "kind": "Profile",
+            "metadata": {"name": "conf-mv"},
+            "spec": {"owner": {"kind": "User", "name": "mv@example.com"},
+                     "resourceQuotaSpec": {"hard": {"tpu/v5e-chips": "8"}}}}
+    hub = versioning.convert_dict(dict(wire), versioning.STORAGE_VERSION)
+    back = versioning.convert_dict(hub, "v1beta1")
+    assert back["spec"]["owner"]["name"] == "mv@example.com"
+    assert back["spec"]["resourceQuotaSpec"]["hard"] == {
+        "tpu/v5e-chips": "8"}
+
+
+@check("spawner-placement-groups")
+def spawner_placement_groups(c: Cluster) -> None:
+    """Admin placement groups land on the gang pod template (ref
+    form.py:178-223)."""
+    from kubeflow_tpu.web import form as form_lib
+
+    f = form_lib.parse_form({
+        "name": "conf-placed", "namespace": "conf",
+        "tpu": {"topology": "", "mesh": ""},
+        "affinityConfig": "tpu-v5e-pool",
+        "tolerationGroup": "tpu-reserved"})
+    nb = form_lib.build_notebook(f)
+    assert any(t.key == "cloud.google.com/gke-tpu-accelerator"
+               for t in nb.spec.template.spec.affinity_terms)
+    assert any(t.key == "google.com/tpu"
+               for t in nb.spec.template.spec.tolerations)
+
+
 def main() -> int:
     cfg = ClusterConfig(tpu_slices={"v5e-16": 1})
     results = []

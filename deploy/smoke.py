@@ -103,9 +103,12 @@ def boot_platform(docs: list[dict], workdir: str):
         env[e["name"]] = e.get("value", "")
 
     log_path = os.path.join(workdir, "platform.log")
-    log = open(log_path, "w")
-    proc = subprocess.Popen(command, cwd=REPO, env=env, stdout=log,
-                            stderr=subprocess.STDOUT, text=True)
+    with open(log_path, "w") as log:
+        # Popen dups the descriptor; closing our handle right away
+        # means the tail read on failure sees everything the child
+        # flushed, with no second writer racing it.
+        proc = subprocess.Popen(command, cwd=REPO, env=env, stdout=log,
+                                stderr=subprocess.STDOUT, text=True)
     return proc, f"http://127.0.0.1:{port}", log_path
 
 
@@ -134,6 +137,12 @@ def main() -> int:
 
     with tempfile.TemporaryDirectory(prefix="kftpu-smoke-") as workdir:
         proc, base, log_path = boot_platform(docs, workdir)
+
+        def log_tail() -> None:
+            with open(log_path) as f:
+                print("---- platform log tail ----")
+                print("\n".join(f.read().splitlines()[-40:]))
+
         try:
             wait_ready(base, proc)
             print(f"[smoke] platform up at {base} "
@@ -141,12 +150,15 @@ def main() -> int:
             e2e = subprocess.run(
                 [sys.executable, os.path.join(REPO, "e2e", "run_e2e.py"),
                  "--base-url", base], cwd=REPO)
+            if e2e.returncode != 0:
+                # In --base-url mode run_e2e cannot tail the server log
+                # (it never spawned one) — surface it here or a CI
+                # failure ships only the client-side assertion.
+                log_tail()
             return e2e.returncode
         except Exception as e:  # noqa: BLE001 — report, then log tail
             print(f"[smoke] FAILED: {e}")
-            with open(log_path) as f:
-                print("---- platform log tail ----")
-                print("\n".join(f.read().splitlines()[-40:]))
+            log_tail()
             return 1
         finally:
             proc.terminate()

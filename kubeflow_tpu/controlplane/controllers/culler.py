@@ -50,9 +50,16 @@ class KernelStatus:
 class ActivityProbe(Protocol):
     """Transport for kernel/terminal activity. Production impl does HTTP
     GET http://<nb>.<ns>.svc/notebook/<ns>/<nb>/api/kernels (ref
-    culler.go:155-180); tests inject a fake."""
+    culler.go:155-180); tests inject a fake. `terminals` is optional
+    (ref updateTimestampFromTerminalsActivity :357-382): probes without
+    it cull on kernel activity alone."""
 
     def kernels(self, namespace: str, name: str) -> list[KernelStatus] | None:
+        ...
+
+    def terminals(self, namespace: str, name: str) -> list[float] | None:
+        """last_activity timestamps of open terminals, None if
+        unreachable/unsupported."""
         ...
 
 
@@ -97,15 +104,20 @@ class HTTPActivityProbe:
             f"/notebook/{namespace}/{name}/api/{resource}"
         )
 
-    def kernels(self, namespace: str, name: str) -> list[KernelStatus] | None:
+    def _fetch(self, namespace: str, name: str, resource: str):
         import json
         import urllib.request
 
-        url = self.url(namespace, name, "kernels")
+        url = self.url(namespace, name, resource)
         try:
             with urllib.request.urlopen(url, timeout=self.timeout) as r:
-                data = json.loads(r.read())
+                return json.loads(r.read())
         except Exception:
+            return None
+
+    def kernels(self, namespace: str, name: str) -> list[KernelStatus] | None:
+        data = self._fetch(namespace, name, "kernels")
+        if data is None:
             return None
         out = []
         for k in data:
@@ -113,6 +125,12 @@ class HTTPActivityProbe:
             out.append(KernelStatus(k.get("execution_state", "idle"),
                                     _parse_ts(ts)))
         return out
+
+    def terminals(self, namespace: str, name: str) -> list[float] | None:
+        data = self._fetch(namespace, name, "terminals")
+        if data is None:
+            return None
+        return [_parse_ts(t.get("last_activity", 0)) for t in data]
 
 
 def _parse_ts(ts) -> float:
@@ -180,6 +198,14 @@ class Culler(Controller):
 
         busy = any(k.execution_state == "busy" for k in kernels)
         kernel_last = max((k.last_activity for k in kernels), default=0.0)
+        # Terminal activity counts too (ref :357-382): an open shell
+        # running a job must hold the notebook alive even with idle
+        # kernels. Optional on the probe; never blocks on failure.
+        term_fn = getattr(self.probe, "terminals", None)
+        if term_fn is not None:
+            stamps = term_fn(namespace, name)
+            if stamps:
+                kernel_last = max(kernel_last, max(stamps))
         prev = last
         if busy:
             last = now          # ref updateTimestampFromKernelsActivity :323-355

@@ -206,8 +206,12 @@ def parse_form(body: dict, config: dict[str, Any] | None = None) -> NotebookForm
 
     pull_policy = str(get_form_value(body, config, "imagePullPolicy")
                       or "")
-    pp_options = config.get("imagePullPolicy", {}).get("options", [])
-    if pull_policy and pp_options and pull_policy not in pp_options:
+    pp_cfg = config.get("imagePullPolicy", {})
+    pp_options = pp_cfg.get("options", [])
+    # readOnly values are the admin's own (trusted by construction, same
+    # rule as the image allowlist above) — only user input is checked.
+    if (pull_policy and pp_options and pull_policy not in pp_options
+            and not pp_cfg.get("readOnly")):
         raise FormError(
             f"imagePullPolicy {pull_policy!r} not in {pp_options}")
 
@@ -232,18 +236,20 @@ def parse_form(body: dict, config: dict[str, Any] | None = None) -> NotebookForm
     # Group-key pickers (ref form.py:178-223): resolved against the
     # admin options at BUILD time; validate the keys here so a typo is
     # a 400, not a silently unplaced pod (the reference only logs).
+    aff_cfg = config.get("affinityConfig", {})
     aff_key = str(get_form_value(body, config, "affinityConfig")
                   or "none")
-    aff_keys = {o.get("configKey")
-                for o in config.get("affinityConfig", {}).get("options", [])}
-    if aff_key != "none" and aff_key not in aff_keys:
+    aff_keys = {o.get("configKey") for o in aff_cfg.get("options", [])}
+    if (aff_key != "none" and aff_key not in aff_keys
+            and not aff_cfg.get("readOnly")):
         raise FormError(f"unknown affinityConfig key {aff_key!r}; "
                         f"allowed: {sorted(aff_keys) + ['none']}")
+    tol_cfg = config.get("tolerationGroup", {})
     tol_key = str(get_form_value(body, config, "tolerationGroup")
                   or "none")
-    tol_keys = {o.get("groupKey")
-                for o in config.get("tolerationGroup", {}).get("options", [])}
-    if tol_key != "none" and tol_key not in tol_keys:
+    tol_keys = {o.get("groupKey") for o in tol_cfg.get("options", [])}
+    if (tol_key != "none" and tol_key not in tol_keys
+            and not tol_cfg.get("readOnly")):
         raise FormError(f"unknown tolerationGroup key {tol_key!r}; "
                         f"allowed: {sorted(tol_keys) + ['none']}")
 

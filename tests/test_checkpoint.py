@@ -37,6 +37,7 @@ def _batch(rng_seed=0, batch=8, seq=16):
     return t, jnp.roll(t, -1, axis=1)
 
 
+@pytest.mark.slow
 def test_save_restore_roundtrip(trainer, tmp_path):
     ckpt = Checkpointer(
         CheckpointConfig(str(tmp_path / "ckpt"), save_interval_steps=1,
@@ -76,6 +77,7 @@ def test_save_restore_roundtrip(trainer, tmp_path):
     ckpt.close()
 
 
+@pytest.mark.slow
 def test_restore_or_init_and_interval(trainer, tmp_path):
     ckpt = Checkpointer(
         CheckpointConfig(str(tmp_path / "c2"), save_interval_steps=2,

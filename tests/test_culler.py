@@ -162,3 +162,52 @@ def test_http_probe_dev_mode_routes_through_local_proxy(monkeypatch):
     assert from_env.dev_mode
     assert from_env.url("a", "b", "terminals").startswith(
         "http://127.0.0.1:9001/api/v1/namespaces/a/services/b/proxy/")
+
+
+def test_terminal_activity_holds_notebook_alive():
+    """ref updateTimestampFromTerminalsActivity (culler.go:357-382): an
+    active terminal advances last-activity even with idle kernels, so a
+    shell-run job is not culled; probes without terminal support keep
+    the kernel-only behavior."""
+    from kubeflow_tpu.api.crds import (
+        LAST_ACTIVITY_ANNOTATION,
+        STOP_ANNOTATION,
+    )
+    from kubeflow_tpu.controlplane.controllers.culler import (
+        Culler,
+        KernelStatus,
+    )
+    from kubeflow_tpu.controlplane.store import Store
+
+    clock = [1000.0]
+
+    class TermProbe:
+        term_stamp = 0.0
+
+        def kernels(self, ns, name):
+            return [KernelStatus("idle", 0.0)]
+
+        def terminals(self, ns, name):
+            return [self.term_stamp]
+
+    store = Store()
+    mk(store)
+    probe = TermProbe()
+    culler = Culler(probe, idle_time=100.0, clock=lambda: clock[0])
+
+    culler.reconcile(store, "u", "nb")  # initializes the clock
+    # terminal keeps touching the notebook as time passes
+    clock[0] = 1090.0
+    probe.term_stamp = 1085.0
+    culler.reconcile(store, "u", "nb")
+    got = store.get("Notebook", "u", "nb")
+    assert got.metadata.annotations[LAST_ACTIVITY_ANNOTATION] == "1085.0"
+    clock[0] = 1180.0  # 95s after the terminal stamp: still alive
+    culler.reconcile(store, "u", "nb")
+    assert STOP_ANNOTATION not in store.get(
+        "Notebook", "u", "nb").metadata.annotations
+    # terminal goes quiet -> idle window elapses -> culled
+    clock[0] = 1190.0
+    culler.reconcile(store, "u", "nb")
+    assert STOP_ANNOTATION in store.get(
+        "Notebook", "u", "nb").metadata.annotations

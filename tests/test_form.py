@@ -113,3 +113,17 @@ def test_flat_tolerations_still_compose_with_groups():
     nb = build_notebook(form)
     keys = [t.key for t in nb.spec.template.spec.tolerations]
     assert "team" in keys and "google.com/tpu" in keys
+
+
+def test_readonly_pinned_values_bypass_allowlists():
+    """Review finding: readOnly values are the admin's own (trusted by
+    construction) — a pinned pullPolicy/group key outside the options
+    list must not 400 every spawn."""
+    cfg = _cfg(imagePullPolicy={"value": "Custom", "readOnly": True})
+    assert parse_form(_body(), cfg).image_pull_policy == "Custom"
+
+    cfg2 = _cfg(affinityConfig={"value": "renamed-key", "readOnly": True})
+    assert parse_form(_body(), cfg2).affinity_config == "renamed-key"
+    # an unknown pinned key simply matches no option at build time
+    nb = build_notebook(parse_form(_body(), cfg2), cfg2)
+    assert nb.spec.template.spec.affinity_terms == []

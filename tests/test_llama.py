@@ -97,6 +97,7 @@ def test_train_step_runs_and_learns():
     assert int(state.step) == 5
 
 
+@pytest.mark.slow
 def test_hybrid_dcn_trainer_matches_single_slice():
     """DP-over-DCN: the Trainer on a hybrid (dcn=2, fsdp=2, tensor=2)
     mesh — params replicated per slice, grads all-reduced across the dcn
@@ -141,6 +142,7 @@ def test_hybrid_dcn_trainer_matches_single_slice():
         )
 
 
+@pytest.mark.slow
 def test_remat_policies_match_full_remat(params):
     """Every remat_policy ("mlp" save-list, "dots") is a pure
     HBM-for-FLOPs schedule change: loss and grads must match the default
@@ -179,6 +181,7 @@ def test_cross_entropy_masked():
     np.testing.assert_allclose(masked, np.log(10), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_chunked_ce_matches_dense_value_and_grads():
     """chunked_cross_entropy_from_hidden == cross_entropy_loss(hidden @
     head) to fp32 rounding, for values AND parameter gradients, with and
@@ -233,6 +236,7 @@ def test_chunked_ce_indivisible_vocab_falls_back():
     np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_trainer_with_chunked_loss_matches_dense_trainer():
     """The Trainer driven by the chunked loss must train identically to
     the logits path (same losses, same updated params)."""

@@ -72,6 +72,7 @@ def test_logical_axes_cover_params_and_resolve(params):
         assert len(sh.spec) <= leaf.ndim
 
 
+@pytest.mark.slow
 def test_moe_trains_under_sharded_mesh():
     """CE + aux loss falls under a (data, fsdp, tensor) mesh and the
     ROUTER learns (its weights move) — the full Mixtral train recipe on

@@ -15,6 +15,10 @@ import pytest
 from kubeflow_tpu.models import llama, llama_pp
 from kubeflow_tpu.train import trainer as trainer_lib
 
+# Whole module is compile-heavy (multi-device grads/scan compiles, >15s/test
+# on the dev box): slow tier (pyproject addopts deselect; CI runs it on main).
+pytestmark = pytest.mark.slow
+
 
 CFG = llama.LLAMA_TINY  # 2 layers
 # 4 layers: deep enough that 2 stages x 2 layers runs the stage-INTERNAL

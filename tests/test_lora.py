@@ -21,6 +21,10 @@ from kubeflow_tpu.train import (
     merge_lora,
 )
 
+# Whole module is compile-heavy (multi-device grads/scan compiles, >15s/test
+# on the dev box): slow tier (pyproject addopts deselect; CI runs it on main).
+pytestmark = pytest.mark.slow
+
 CFG = llama.LLAMA_TINY
 LC = LoraConfig(rank=4, alpha=8.0)
 

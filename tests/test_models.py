@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kubeflow_tpu.models import gemma, mnist, vit
 from kubeflow_tpu.parallel import MeshSpec, create_mesh
@@ -55,6 +56,7 @@ def test_gemma_sliding_window_locality():
     assert np.abs(l3 - l1).max() > 0
 
 
+@pytest.mark.slow
 def test_gemma_trains_sharded():
     """Gemma composes with the FSDP/TP Trainer unchanged."""
     cfg = gemma.GEMMA_TINY
@@ -93,6 +95,7 @@ def test_vit_forward_and_patchify():
         float(jnp.sum(patches)), float(jnp.sum(imgs)), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_vit_finetune_learns():
     """Few steps of full fine-tune separate two synthetic classes."""
     cfg = vit.VIT_TINY
@@ -131,6 +134,7 @@ def test_vit_finetune_learns():
     assert acc >= 0.9, acc
 
 
+@pytest.mark.slow
 def test_vit_trainer_sharded_smoke():
     """ViT under the sharded Trainer: one FSDP/TP step compiles + runs.
     (Trainer's loss is next-token CE over [b,s,vocab]; ViT emits [b,c] —
@@ -152,6 +156,7 @@ def test_vit_trainer_sharded_smoke():
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_mnist_smoke_learns():
     metrics = mnist.train_smoke(steps=60)
     assert metrics["test_accuracy"] > 0.8, metrics

@@ -9,6 +9,10 @@ from kubeflow_tpu.parallel import MeshSpec, create_mesh
 from kubeflow_tpu.parallel import moe as moe_lib
 from kubeflow_tpu.parallel.moe import MoEConfig, init_moe
 
+# Whole module is compile-heavy (multi-device grads/scan compiles, >15s/test
+# on the dev box): slow tier (pyproject addopts deselect; CI runs it on main).
+pytestmark = pytest.mark.slow
+
 
 CFG = MoEConfig(num_experts=8, top_k=2, embed_dim=32, mlp_dim=64,
                 capacity_factor=8.0)  # generous: no drops → exact routing

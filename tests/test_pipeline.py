@@ -8,6 +8,10 @@ from jax.sharding import Mesh
 
 from kubeflow_tpu.parallel import pipeline as pp
 
+# Whole module is compile-heavy (multi-device grads/scan compiles, >15s/test
+# on the dev box): slow tier (pyproject addopts deselect; CI runs it on main).
+pytestmark = pytest.mark.slow
+
 
 def mk_mesh(n_stages=4):
     return Mesh(np.asarray(jax.devices()[:n_stages]), ("stage",))

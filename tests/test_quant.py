@@ -47,6 +47,7 @@ def test_quantized_blocks_structure_and_bytes(params):
     assert quant.param_bytes(qp) < quant.param_bytes(params)
 
 
+@pytest.mark.slow
 def test_quantized_engine_logits_close_and_decode_runs(params):
     """The engine runs UNMODIFIED on quantized params (QTensor.astype is
     the only read path; lax.scan slices q and scale together); prefill

@@ -17,6 +17,10 @@ from kubeflow_tpu.parallel.ring import (
     ulysses_attention_sharded,
 )
 
+# Whole module is compile-heavy (multi-device grads/scan compiles, >15s/test
+# on the dev box): slow tier (pyproject addopts deselect; CI runs it on main).
+pytestmark = pytest.mark.slow
+
 
 def _make_qkv(b=2, s=64, n_q=8, n_kv=4, hd=16, seed=0):
     rng = np.random.default_rng(seed)

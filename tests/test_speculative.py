@@ -12,6 +12,11 @@ from kubeflow_tpu.models import llama
 from kubeflow_tpu.serving import EngineConfig, InferenceEngine, LLAMA_FAMILY
 from kubeflow_tpu.serving.speculative import SpeculativeEngine
 
+# Whole module is compile-heavy (multi-device grads/scan compiles, >15s/test
+# on the dev box): slow tier (pyproject addopts deselect; CI runs it on main).
+pytestmark = pytest.mark.slow
+
+
 TCFG = llama.LLAMA_TINY
 # A weaker draft: same vocab, shallower/narrower, different init.
 DCFG = dataclasses.replace(

@@ -7,6 +7,8 @@ import time
 import jax
 import jax.numpy as jnp
 
+import pytest
+
 from kubeflow_tpu.api.crds import Profile
 from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
 from kubeflow_tpu.utils import StepTimer, WatchedConfig, time_to_first_compile
@@ -46,6 +48,7 @@ def test_step_timer_summary():
     assert s["p50_s"] <= s["p99_s"] <= s["max_s"]
 
 
+@pytest.mark.slow
 def test_trace_writes_profile(tmp_path):
     logdir = str(tmp_path / "prof")
     with profiling.trace(logdir):
