@@ -29,6 +29,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import json
+import os
 from collections import Counter
 from typing import Iterable, Sequence
 
@@ -112,7 +113,7 @@ class Tokenizer:
     def _ranks_id(self):
         # A hashable capsule for the lru-cached module function: the
         # tokenizer is immutable, so identity keying is sound.
-        return _RanksHandle(self._ranks)
+        return _RanksHandle(self._ranks, self.merges)
 
     def encode(self, text: str, *, bos: bool = False,
                eos: bool = False) -> list[int]:
@@ -159,10 +160,25 @@ class Tokenizer:
 
 
 class _RanksHandle:
-    __slots__ = ("ranks",)
+    """Hashable capsule keying the word cache. Carries the rank table
+    AND (lazily) the native encoder so the cached function can take the
+    C++ path (native/bpe.cpp — bit-identical semantics, tested) without
+    changing cache identity."""
 
-    def __init__(self, ranks):
+    __slots__ = ("ranks", "merges", "_native")
+
+    _NATIVE_UNSET = object()
+
+    def __init__(self, ranks, merges=()):
         self.ranks = ranks
+        self.merges = merges
+        self._native = self._NATIVE_UNSET
+
+    @property
+    def native(self):
+        if self._native is self._NATIVE_UNSET:
+            self._native = _native_encoder(self.merges)
+        return self._native
 
     def __hash__(self):
         return id(self)
@@ -171,10 +187,87 @@ class _RanksHandle:
         return self is other
 
 
+_bpe_build_failed = False
+
+
+def _ensure_bpe_built() -> str | None:
+    """Build libktbpe.so if missing (same lazy-make discipline as
+    loader.ensure_built — a fresh checkout must reach the native path
+    without a manual `make -C native`). Returns the lib path or None."""
+    global _bpe_build_failed
+    from kubeflow_tpu.data import loader as _loader
+
+    native_dir = os.path.dirname(_loader._LIB_PATH)
+    lib_path = os.path.join(native_dir, "libktbpe.so")
+    if os.path.exists(lib_path):
+        return lib_path
+    if _bpe_build_failed:
+        return None
+    import subprocess
+
+    try:
+        subprocess.run(["make", "-C", native_dir, "libktbpe.so"],
+                       check=True, capture_output=True, timeout=120)
+    except Exception:  # noqa: BLE001 — no toolchain: fallback stays
+        _bpe_build_failed = True
+        return None
+    return lib_path if os.path.exists(lib_path) else None
+
+
+def _native_encoder(merges):
+    """ctypes handle over native/bpe.cpp, or None (fallback stays)."""
+    if not merges or os.environ.get("KFTPU_BPE_FORCE_PY"):
+        return None
+    import ctypes
+
+    lib_path = _ensure_bpe_built()
+    if lib_path is None:
+        return None
+    lib = ctypes.CDLL(lib_path)
+    lib.kt_bpe_new.restype = ctypes.c_void_p
+    lib.kt_bpe_new.argtypes = [ctypes.POINTER(ctypes.c_int32),
+                               ctypes.c_int64]
+    lib.kt_bpe_encode_word.restype = ctypes.c_int64
+    lib.kt_bpe_encode_word.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32)]
+    # Without explicit argtypes ctypes passes the handle as a C int —
+    # 32-bit truncation of a 64-bit pointer segfaults in free().
+    lib.kt_bpe_free.restype = None
+    lib.kt_bpe_free.argtypes = [ctypes.c_void_p]
+    flat = (ctypes.c_int32 * (2 * len(merges)))(
+        *(x for pair in merges for x in pair))
+    handle = lib.kt_bpe_new(flat, len(merges))
+
+    class _Native:
+        def __init__(self, lib, handle):
+            self.lib = lib
+            self.handle = handle
+
+        def encode(self, word: tuple[int, ...]) -> tuple[int, ...]:
+            n = len(word)
+            buf_in = (ctypes.c_uint8 * n)(*word)
+            buf_out = (ctypes.c_int32 * n)()
+            count = self.lib.kt_bpe_encode_word(
+                self.handle, buf_in, n, buf_out)
+            return tuple(buf_out[:count])
+
+        def __del__(self):
+            try:
+                self.lib.kt_bpe_free(self.handle)
+            except Exception:  # noqa: BLE001 — interpreter teardown
+                pass
+
+    return _Native(lib, handle)
+
+
 @functools.lru_cache(maxsize=65536)
 def _encode_word_cached(handle: _RanksHandle,
                         word: tuple[int, ...]) -> tuple[int, ...]:
     # returns a tuple: the cache hands the SAME object to every caller
+    native = handle.native
+    if native is not None and word:
+        return native.encode(word)
     ranks = handle.ranks
     pieces = list(word)
     while len(pieces) > 1:

@@ -18,6 +18,7 @@ so one engine serves both families.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 from typing import Any, Callable, NamedTuple
 
@@ -357,15 +358,25 @@ class InferenceEngine:
                 rng = jax.random.key(0)
         return sp, rng
 
-    def _generate(self, prompt, state, rng, sp: SamplingParams,
-                  prompt_mask, *, max_new: int):
+    def _prefill_sample(self, prompt, state, rng, sp: SamplingParams,
+                        prompt_mask):
+        """Prefill + sample token #1. Shared head of generate and
+        generate_stream so both follow the same rng discipline."""
         eos = self.ec.eos_token
         rng, sub = jax.random.split(rng)  # use-once key discipline
         logits, state = self._forward_cached(
             prompt, state, prompt_mask=prompt_mask)
         first = self._sample(logits, sub, sp)
-        done0 = (first == eos) if eos is not None else jnp.zeros(
+        done = (first == eos) if eos is not None else jnp.zeros(
             first.shape, bool)
+        return state, first, rng, done
+
+    def _decode_chunk(self, state, tok, rng, done, sp: SamplingParams,
+                      *, length: int):
+        """`length` decode steps from carry. Returns the new carry and
+        the [b, length] tokens. The ONE step body both entry points
+        scan over — stream-vs-oneshot equality is by construction."""
+        eos = self.ec.eos_token
 
         def step(carry, _):
             state, tok, rng, done = carry
@@ -374,15 +385,22 @@ class InferenceEngine:
             nxt = self._sample(logits, sub, sp)
             if eos is not None:
                 # Sequences past EOS emit EOS forever (static shapes —
-                # the scan always runs max_new steps; callers trim).
+                # the scan always runs `length` steps; callers trim).
                 nxt = jnp.where(done, jnp.asarray(eos, nxt.dtype), nxt)
                 done = done | (nxt == eos)
             return (state, nxt, rng, done), nxt
 
-        (state, _, _, _), rest = jax.lax.scan(
-            step, (state, first, rng, done0), None, length=max_new - 1)
-        toks = jnp.concatenate(
-            [first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1)
+        (state, tok, rng, done), rest = jax.lax.scan(
+            step, (state, tok, rng, done), None, length=length)
+        return state, tok, rng, done, jnp.moveaxis(rest, 0, 1)
+
+    def _generate(self, prompt, state, rng, sp: SamplingParams,
+                  prompt_mask, *, max_new: int):
+        state, first, rng, done = self._prefill_sample(
+            prompt, state, rng, sp, prompt_mask)
+        state, _, _, _, rest = self._decode_chunk(
+            state, first, rng, done, sp, length=max_new - 1)
+        toks = jnp.concatenate([first[:, None], rest], axis=1)
         return toks, state
 
     def generate(
@@ -403,6 +421,17 @@ class InferenceEngine:
         overrides are dynamic (no recompile across values).
         `prompt_mask` batches variable-length prompts: pads LEFT-aligned
         (False entries), each row decodes as if it were unpadded."""
+        sp, rng, prompt_mask, state = self._prep(
+            prompt_tokens, max_new, rng, temperature, top_k, top_p,
+            prompt_mask)
+        toks, _ = self._generate_jit(
+            prompt_tokens, state, rng, sp, prompt_mask, max_new=max_new)
+        return toks
+
+    def _prep(self, prompt_tokens, max_new, rng, temperature, top_k,
+              top_p, prompt_mask):
+        """Shared validation + sampling/state setup for both entry
+        points."""
         b, s = prompt_tokens.shape
         if s + max_new > self.ec.max_len:
             raise ValueError(
@@ -422,7 +451,61 @@ class InferenceEngine:
             prompt_mask = jnp.ones((b, s), bool)
         sp, rng = self._resolve_sampling(temperature, top_k, top_p, rng,
                                          batch=b)
-        state = self.init_state(b)
-        toks, _ = self._generate_jit(
-            prompt_tokens, state, rng, sp, prompt_mask, max_new=max_new)
-        return toks
+        return sp, rng, prompt_mask, self.init_state(b)
+
+    def generate_stream(
+        self,
+        prompt_tokens: jnp.ndarray,   # [b, s] int32
+        *,
+        max_new: int = 32,
+        chunk: int = 8,
+        rng: jax.Array | None = None,
+        temperature: float | None = None,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        prompt_mask: jnp.ndarray | None = None,
+    ):
+        """Yield [b, <=chunk] numpy token chunks as they decode.
+
+        Same sampling law AND same rng split discipline as generate():
+        with equal arguments the concatenated stream equals generate()'s
+        prefix exactly (the shared _prefill_sample/_decode_chunk pair is
+        the proof). Unlike generate(), the stream stops early once every
+        row has hit EOS — a stream's length is allowed to be dynamic.
+        Compiled programs per prompt shape: prefill, the full chunk,
+        and one tail per distinct (max_new-1) % chunk — bounded by
+        `chunk` total, never one per max_new value.
+
+        Validation is eager (this is a plain method returning an inner
+        generator): bad arguments raise HERE, not at first next().
+        """
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        sp, rng, prompt_mask, state = self._prep(
+            prompt_tokens, max_new, rng, temperature, top_k, top_p,
+            prompt_mask)
+
+        def _iter():
+            state_, tok, rng_, done = self._prefill_jit(
+                prompt_tokens, state, rng, sp, prompt_mask)
+            yield np.asarray(tok)[:, None]
+            emitted = 1
+            while emitted < max_new:
+                if self.ec.eos_token is not None and bool(
+                        np.asarray(done).all()):
+                    return
+                n = min(chunk, max_new - emitted)
+                state_, tok, rng_, done, rest = self._chunk_jit(
+                    state_, tok, rng_, done, sp, length=n)
+                yield np.asarray(rest)
+                emitted += n
+
+        return _iter()
+
+    @functools.cached_property
+    def _prefill_jit(self):
+        return jax.jit(self._prefill_sample)
+
+    @functools.cached_property
+    def _chunk_jit(self):
+        return jax.jit(self._decode_chunk, static_argnames=("length",))

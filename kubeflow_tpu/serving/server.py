@@ -275,6 +275,58 @@ async def list_models(request: web.Request):
     return web.json_response({"models": out})
 
 
+# Server-side decode granularity for SSE streams: fixed (not a client
+# knob) so a client sweeping max_new can mint at most STREAM_CHUNK
+# distinct tail-chunk programs per prompt shape (plus prefill + the
+# full chunk) — bounded, never one compile per max_new value.
+STREAM_CHUNK = 8
+
+
+async def _stream_generate(request, engine, arr, max_new, sampling,
+                           text_mode, tokenizer):
+    """SSE token streaming: `data: {"tokens": [[...]]}` per decoded
+    chunk, then `data: {"done": true, ...}`. Same sampling law as the
+    one-shot path (engine.generate_stream's equality guarantee); the
+    stream ends early once every row hits EOS."""
+    import json as _json
+
+    resp = web.StreamResponse(headers={
+        "Content-Type": "text/event-stream",
+        "Cache-Control": "no-cache",
+        "X-Accel-Buffering": "no",
+    })
+    await resp.prepare(request)
+    loop = asyncio.get_event_loop()
+    gen = engine.generate_stream(
+        jnp.asarray(arr), max_new=max_new, chunk=STREAM_CHUNK, **sampling)
+    chunks: list[np.ndarray] = []
+    while True:
+        # Lock only around the device work, NOT the client write: a
+        # slow-reading client must back-pressure its own stream, never
+        # stall every other request behind the GPU lock. Other requests
+        # interleave between chunks (each chunk call is self-contained).
+        async with request.app[GPU_LOCK_KEY]:
+            part = await loop.run_in_executor(
+                None, lambda: next(gen, None))
+        if part is None:
+            break
+        chunks.append(part)
+        await resp.write(
+            b"data: " + _json.dumps(
+                {"tokens": part.tolist()}).encode() + b"\n\n")
+    final: dict[str, Any] = {
+        "done": True,
+        "total": int(sum(c.shape[1] for c in chunks)),
+    }
+    if text_mode and chunks:
+        ids = np.concatenate(chunks, axis=1)[0].tolist()
+        final["text"] = (tokenizer.decode(ids) if tokenizer
+                         else byte_decode(ids))
+    await resp.write(b"data: " + _json.dumps(final).encode() + b"\n\n")
+    await resp.write_eof()
+    return resp
+
+
 async def generate(request: web.Request):
     name = request.match_info["name"]
     engine = request.app[ENGINES_KEY].get(name)
@@ -380,6 +432,19 @@ async def generate(request: web.Request):
     if not isinstance(gamma, int) or isinstance(gamma, bool) or gamma < 1:
         return web.json_response(
             {"error": "gamma must be a positive integer"}, status=400)
+    stream = body.get("stream", False)
+    if not isinstance(stream, bool):
+        return web.json_response(
+            {"error": "stream must be a boolean"}, status=400)
+    if stream:
+        if speculative:
+            return web.json_response(
+                {"error": "stream does not compose with speculative"},
+                status=400)
+        return await _stream_generate(
+            request, engine, arr, max_new_req, sampling, text_mode,
+            tokenizer)
+
     resp_extra: dict[str, Any] = {}
     if speculative:
         spec = request.app[SPEC_KEY].get(name)

@@ -1,0 +1,83 @@
+// Native BPE word encoder: the hot inner loop of tokenization.
+//
+// Same greedy rank-ordered merge semantics as the pure-Python
+// implementation in kubeflow_tpu/data/bpe.py::_encode_word_cached —
+// bit-identical outputs are a tested contract (tests/test_bpe.py), the
+// same native/fallback discipline as dataloader.cpp. The reference has
+// no tokenizer at all (no compute, SURVEY.md §2b); this is part of the
+// TPU framework's native runtime alongside the data loader.
+//
+// C ABI (ctypes-consumed, no C++ types across the boundary):
+//   kt_bpe_new(merges, n)  merges = int32[n*2] (left,right) by rank
+//   kt_bpe_encode_word     utf-8 bytes in, int32 piece ids out
+//   kt_bpe_free
+//
+// Complexity: the scan-for-best-pair loop is O(pieces^2) per word like
+// the Python twin (words are capped at _MAX_WORD_CHARS upstream so the
+// quadratic is bounded); the win here is the constant factor.
+
+#include <cstddef>
+#include <cstdint>
+#include <unordered_map>
+#include <vector>
+
+using std::size_t;
+
+namespace {
+
+struct Encoder {
+  // (left<<32 | right) -> rank
+  std::unordered_map<uint64_t, int32_t> ranks;
+};
+
+inline uint64_t pair_key(int32_t a, int32_t b) {
+  return (static_cast<uint64_t>(static_cast<uint32_t>(a)) << 32) |
+         static_cast<uint32_t>(b);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kt_bpe_new(const int32_t* merges, int64_t n_merges) {
+  auto* enc = new Encoder();
+  enc->ranks.reserve(static_cast<size_t>(n_merges) * 2);
+  for (int64_t i = 0; i < n_merges; ++i) {
+    enc->ranks.emplace(pair_key(merges[2 * i], merges[2 * i + 1]),
+                       static_cast<int32_t>(i));
+  }
+  return enc;
+}
+
+void kt_bpe_free(void* handle) { delete static_cast<Encoder*>(handle); }
+
+// Encode one word. `out` must hold at least n ids. Returns the piece
+// count (<= n). n == 0 returns 0.
+int64_t kt_bpe_encode_word(void* handle, const uint8_t* bytes, int64_t n,
+                           int32_t* out) {
+  const auto* enc = static_cast<Encoder*>(handle);
+  std::vector<int32_t> pieces;
+  pieces.reserve(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) pieces.push_back(bytes[i]);
+
+  while (pieces.size() > 1) {
+    int32_t best_rank = -1;
+    size_t best_i = 0;
+    for (size_t i = 0; i + 1 < pieces.size(); ++i) {
+      auto it = enc->ranks.find(pair_key(pieces[i], pieces[i + 1]));
+      if (it != enc->ranks.end() &&
+          (best_rank < 0 || it->second < best_rank)) {
+        best_rank = it->second;
+        best_i = i;
+      }
+    }
+    if (best_rank < 0) break;
+    pieces[best_i] = 256 + best_rank;
+    pieces.erase(pieces.begin() + static_cast<int64_t>(best_i) + 1);
+  }
+
+  for (size_t i = 0; i < pieces.size(); ++i) out[i] = pieces[i];
+  return static_cast<int64_t>(pieces.size());
+}
+
+}  // extern "C"

@@ -136,3 +136,57 @@ def test_space_free_runs_stay_linear_and_roundtrip():
     assert max(
         len(w.encode()) for w in bpe._split_words(blob)
     ) <= 4 * bpe._MAX_WORD_CHARS
+
+
+def test_native_encoder_bit_identical_to_python():
+    """native/bpe.cpp vs the pure-Python loop: same merges, same words,
+    identical ids (the dataloader's native/fallback parity discipline).
+    Skips only where no C++ toolchain exists."""
+    from kubeflow_tpu.data import bpe
+
+    tok = bpe.train(
+        ["the quick brown fox jumps over the lazy dog " * 30,
+         "pack my box with five dozen liquor jugs " * 30],
+        vocab_size=400)
+    native = bpe._native_encoder(tok.merges)
+    if native is None:
+        import pytest
+        pytest.skip("no native toolchain")
+    texts = ["the quick brown fox", "jugs jugs jugs",
+             "Ünïcödé — 測試 🙂", "x" * 300, "", " leading and  double"]
+    for text in texts:
+        for word in bpe._split_words(text):
+            w = bpe._to_word_bytes(word)
+            py = bpe._encode_word_cached.__wrapped__(
+                bpe._RanksHandle(tok._ranks), w)
+            assert native.encode(w) == py, (word, py)
+    # end-to-end through the Tokenizer (native path active by default)
+    for text in texts:
+        assert tok.decode(tok.encode(text)) == text
+
+
+def test_native_encoder_speedup_on_long_words():
+    """The native encoder must beat the Python loop on the capped
+    worst-case word (why it exists); skip without a toolchain."""
+    import time
+
+    from kubeflow_tpu.data import bpe
+
+    tok = bpe.train(["abcdef " * 500], vocab_size=300)
+    native = bpe._native_encoder(tok.merges)
+    if native is None:
+        import pytest
+        pytest.skip("no native toolchain")
+    word = bpe._to_word_bytes("abcdef" * 80)  # ~480 bytes, heavy merges
+    handle = bpe._RanksHandle(tok._ranks)
+
+    t0 = time.perf_counter()
+    for _ in range(50):
+        py = bpe._encode_word_cached.__wrapped__(handle, word)
+    t_py = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(50):
+        nat = native.encode(word)
+    t_nat = time.perf_counter() - t0
+    assert nat == py
+    assert t_nat < t_py, (t_nat, t_py)

@@ -533,3 +533,74 @@ def test_top_k_overflow_rejected_in_library_api(llama_engine):
     prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
     with pytest.raises(ValueError, match="2\\*\\*31"):
         engine.generate(prompt, max_new=2, temperature=1.0, top_k=2**31)
+
+
+@pytest.mark.slow
+def test_generate_stream_equals_oneshot(llama_engine):
+    """Streamed chunks concatenate to exactly generate()'s output under
+    the same rng — both entry points scan the SAME step body — and the
+    stream stops early once every row hits EOS."""
+    engine, cfg, _ = llama_engine
+    prompt = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+    for kwargs in ({}, {"rng": jax.random.key(7), "temperature": 0.8,
+                        "top_k": 5}):
+        full = np.asarray(engine.generate(prompt, max_new=13, **kwargs))
+        parts = list(engine.generate_stream(
+            prompt, max_new=13, chunk=4, **kwargs))
+        assert [p.shape[0] for p in parts] == [2] * len(parts)
+        got = np.concatenate(parts, axis=1)
+        assert got.shape[1] <= 13
+        assert (got == full[:, :got.shape[1]]).all()
+        # anything generate() produced past an early stream stop is
+        # post-EOS padding by construction
+        if got.shape[1] < 13 and engine.ec.eos_token is not None:
+            assert (full[:, got.shape[1]:] == engine.ec.eos_token).all()
+
+
+@pytest.mark.slow
+async def test_sse_streaming_over_rest(llama_engine):
+    """POST {"stream": true} returns text/event-stream whose chunk
+    events concatenate to the non-streaming response's tokens."""
+    engine, cfg, _ = llama_engine
+    app = server_lib.create_serving_app({"llama-tiny": engine})
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        body = {"tokens": [[1, 2, 3, 4]], "max_new": 11}
+        r = await client.post("/v1/models/llama-tiny:generate", json=body)
+        assert r.status == 200
+        oneshot = (await r.json())["tokens"]
+
+        r = await client.post("/v1/models/llama-tiny:generate",
+                              json={**body, "stream": True})
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        events = []
+        async for line in r.content:
+            line = line.strip()
+            if line.startswith(b"data: "):
+                import json as _json
+                events.append(_json.loads(line[len(b"data: "):]))
+        assert events and events[-1]["done"] is True
+        streamed = [t for e in events[:-1] for t in e["tokens"][0]]
+        assert events[-1]["total"] == len(streamed)
+        assert streamed == oneshot[0][:len(streamed)]
+
+        # stream + speculative is a 400, not a silent fallback
+        r = await client.post(
+            "/v1/models/llama-tiny:generate",
+            json={**body, "stream": True, "speculative": True})
+        assert r.status == 400
+    finally:
+        await client.close()
+
+
+def test_generate_stream_validates_eagerly(llama_engine):
+    """Review finding: bad arguments must raise at CALL time, not at
+    first next() (a server would have already sent SSE headers)."""
+    engine, cfg, _ = llama_engine
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    with pytest.raises(ValueError, match="exceeds cache bucket"):
+        engine.generate_stream(prompt, max_new=10**6)
+    with pytest.raises(ValueError, match="chunk"):
+        engine.generate_stream(prompt, max_new=4, chunk=0)
