@@ -325,6 +325,40 @@ def hpo_experiment(alice: Client, admin: Client) -> None:
         ["items"] if t["spec"]["experiment"] == "e2e-sweep"])
 
 
+@phase("idle-culling")
+def idle_culling(alice: Client, admin: Client) -> None:
+    """The WHOLE culling loop out-of-process (ref culler.go): the
+    platform's Culler probes kernel activity over real HTTP (DEV-proxy
+    path against this suite's kernel-API stub), sees one notebook idle,
+    stamps the stop annotation, and the notebook controller scales its
+    gang to zero. Only runs when this suite booted the server with the
+    culling env (KFTPU_E2E_CULLING); a smoke-booted platform keeps its
+    overlay's culling settings."""
+    if os.environ.get("KFTPU_E2E_CULLING") != "1":
+        return
+    body = {"name": "cull-me",
+            "image": "kubeflow-tpu/jupyter-jax:latest",
+            "cpu": "0.5", "memory": "1.0Gi",
+            "tpu": {"topology": "", "mesh": ""},
+            "workspace": None, "shm": False, "configurations": []}
+    status, out = alice.req(
+        "POST", "/jupyter/api/namespaces/alice/notebooks", body)
+    assert status == 201, (status, out)
+    poll("cull-me running", lambda: [
+        n for n in alice.req(
+            "GET", "/jupyter/api/namespaces/alice/notebooks")[1]["notebooks"]
+        if n["name"] == "cull-me" and n["status"]["phase"] == "ready"])
+    # The stub reports cull-me idle since epoch; every other notebook
+    # busy. The culler (CULL_IDLE_TIME seconds scale) must stop it.
+    poll("culled to stopped", lambda: [
+        n for n in alice.req(
+            "GET", "/jupyter/api/namespaces/alice/notebooks")[1]["notebooks"]
+        if n["name"] == "cull-me" and n["status"]["phase"] == "stopped"])
+    status, _ = alice.req(
+        "DELETE", "/jupyter/api/namespaces/alice/notebooks/cull-me")
+    assert status == 200, status
+
+
 @phase("metrics-surface")
 def metrics_surface(alice: Client, admin: Client) -> None:
     status, text = alice.req("GET", "/metrics")
@@ -366,6 +400,41 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def start_kernel_stub() -> str:
+    """Fake Jupyter kernel API behind the apiserver-proxy path shape
+    (what `kubectl proxy` serves; the culler's DEV mode targets it, ref
+    culler.go:160-164). Reports the notebook named 'cull-me' idle since
+    epoch and every other notebook busy — so the culling phase proves
+    the loop end-to-end without threatening the rest of the suite."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.endswith("/api/kernels"):
+                idle = "/services/cull-me/" in self.path
+                body = [{"execution_state": "idle" if idle else "busy",
+                         "last_activity": "1970-01-01T00:00:00Z"}]
+            elif self.path.endswith("/api/terminals"):
+                body = []
+            else:
+                self.send_error(404)
+                return
+            data = json.dumps(body).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *args):  # noqa: D102 — quiet
+            pass
+
+    server = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return f"http://127.0.0.1:{server.server_address[1]}"
+
+
 def main() -> int:
     import argparse
 
@@ -389,10 +458,24 @@ def main() -> int:
         # and block the server mid-suite.
         log = tempfile.NamedTemporaryFile(
             mode="w+", suffix=".log", prefix="kftpu-e2e-", delete=False)
+        # Culling env, seconds-scale (the knobs are minutes, ref
+        # culler.go:26-28); probes route to this suite's kernel stub
+        # through the DEV-proxy path. The idle-culling phase keys off
+        # KFTPU_E2E_CULLING so a smoke-booted run keeps overlay truth.
+        env = dict(os.environ)
+        env.update({
+            "ENABLE_CULLING": "true",
+            "CULL_IDLE_TIME": "0.02",        # 1.2 s idle threshold
+            "IDLENESS_CHECK_PERIOD": "0.005",  # 0.3 s probe cadence
+            "KFTPU_CULLER_DEV": "true",
+            "KFTPU_DEV_PROXY_BASE": start_kernel_stub(),
+        })
+        os.environ["KFTPU_E2E_CULLING"] = "1"
         server = subprocess.Popen(
             [sys.executable, "-m", "kubeflow_tpu.web.platform",
              "--port", str(port), "--tpu-slices", "v5e-16=2,v5e-1=4"],
-            cwd=REPO, stdout=log, stderr=subprocess.STDOUT, text=True)
+            cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT,
+            text=True)
     alice = Client(base, ALICE)
     admin = Client(base, "admin@example.com")
     report, failed = [], False

@@ -290,6 +290,15 @@ async def _stream_generate(request, engine, arr, max_new, sampling,
     stream ends early once every row hits EOS."""
     import json as _json
 
+    # Build the generator BEFORE sending SSE headers: generate_stream
+    # validates eagerly, so an argument the handler's own checks missed
+    # is still a clean 400 here — never a 200 that dies mid-stream.
+    try:
+        gen = engine.generate_stream(
+            jnp.asarray(arr), max_new=max_new, chunk=STREAM_CHUNK,
+            **sampling)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
     resp = web.StreamResponse(headers={
         "Content-Type": "text/event-stream",
         "Cache-Control": "no-cache",
@@ -297,8 +306,6 @@ async def _stream_generate(request, engine, arr, max_new, sampling,
     })
     await resp.prepare(request)
     loop = asyncio.get_event_loop()
-    gen = engine.generate_stream(
-        jnp.asarray(arr), max_new=max_new, chunk=STREAM_CHUNK, **sampling)
     chunks: list[np.ndarray] = []
     while True:
         # Lock only around the device work, NOT the client write: a

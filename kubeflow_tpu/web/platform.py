@@ -133,10 +133,38 @@ def load_spawner_config(path: str) -> dict | None:
     return config
 
 
+def cluster_config_from_env(**overrides):
+    """ClusterConfig honoring the reference's culler env knobs
+    (culler.go:26-28: ENABLE_CULLING / CULL_IDLE_TIME minutes /
+    IDLENESS_CHECK_PERIOD minutes) — the SAME env the deploy manifests
+    set on the platform Deployment (deploy/generate.py platform()).
+    Before this existed the gke overlay claimed culling and the booted
+    process silently ignored it."""
+    from kubeflow_tpu.controlplane.cluster import ClusterConfig
+    from kubeflow_tpu.controlplane.controllers.culler import (
+        HTTPActivityProbe,
+    )
+
+    enable = os.environ.get("ENABLE_CULLING", "false").lower() == "true"
+    cfg = dict(
+        enable_culling=enable,
+        cull_idle_time=float(os.environ.get("CULL_IDLE_TIME",
+                                            "1440")) * 60.0,
+        cull_check_period=float(os.environ.get("IDLENESS_CHECK_PERIOD",
+                                               "1")) * 60.0,
+    )
+    if enable:
+        cfg["activity_probe"] = HTTPActivityProbe(
+            cluster_domain=os.environ.get("CLUSTER_DOMAIN",
+                                          "cluster.local"))
+    cfg.update(overrides)
+    return ClusterConfig(**cfg)
+
+
 def main() -> None:  # pragma: no cover - manual entry point
     import argparse
 
-    from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
+    from kubeflow_tpu.controlplane.cluster import Cluster
 
     p = argparse.ArgumentParser()
     p.add_argument("--port", type=int, default=8082)
@@ -158,7 +186,7 @@ def main() -> None:  # pragma: no cover - manual entry point
         k, _, v = part.partition("=")
         if k:
             slices[k] = int(v or 1)
-    cluster = Cluster(ClusterConfig(
+    cluster = Cluster(cluster_config_from_env(
         tpu_slices=slices,
         cluster_admins={args.dev_user} if args.dev_user else set(),
     )).start()

@@ -43,8 +43,12 @@ void* kt_bpe_new(const int32_t* merges, int64_t n_merges) {
   auto* enc = new Encoder();
   enc->ranks.reserve(static_cast<size_t>(n_merges) * 2);
   for (int64_t i = 0; i < n_merges; ++i) {
-    enc->ranks.emplace(pair_key(merges[2 * i], merges[2 * i + 1]),
-                       static_cast<int32_t>(i));
+    // operator[] (last-wins) — Python builds _ranks as {pair: i} in a
+    // comprehension where a duplicate pair keeps the LAST rank; emplace
+    // (first-wins) would silently break the bit-identical contract on
+    // tokenizers loaded from JSON that carries duplicates.
+    enc->ranks[pair_key(merges[2 * i], merges[2 * i + 1])] =
+        static_cast<int32_t>(i);
   }
   return enc;
 }

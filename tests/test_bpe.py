@@ -190,3 +190,20 @@ def test_native_encoder_speedup_on_long_words():
     t_nat = time.perf_counter() - t0
     assert nat == py
     assert t_nat < t_py, (t_nat, t_py)
+
+
+def test_native_matches_python_on_duplicate_merges():
+    """Review finding: a JSON tokenizer carrying duplicate merge pairs
+    must encode identically on both paths (last rank wins, like the
+    Python dict comprehension)."""
+    from kubeflow_tpu.data import bpe
+
+    tok = bpe.Tokenizer(merges=((97, 98), (97, 98), (256, 99)))
+    native = bpe._native_encoder(tok.merges)
+    if native is None:
+        import pytest
+        pytest.skip("no native toolchain")
+    word = bpe._to_word_bytes("abcabc")
+    py = bpe._encode_word_cached.__wrapped__(
+        bpe._RanksHandle(tok._ranks), word)
+    assert native.encode(word) == py

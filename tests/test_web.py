@@ -277,3 +277,25 @@ async def test_subapps_honor_cluster_admin(env):
     assert r.status == 200
     r = await client.get("/volumes/api/namespaces/alice/pvcs", headers=ROOT)
     assert r.status == 200
+
+
+def test_cluster_config_from_env_honors_culler_knobs(monkeypatch):
+    """The deploy manifests set the reference culler env on the
+    platform Deployment (deploy/generate.py); the booted process must
+    actually consume it (it silently didn't before round 4)."""
+    from kubeflow_tpu.web.platform import cluster_config_from_env
+
+    monkeypatch.delenv("ENABLE_CULLING", raising=False)
+    off = cluster_config_from_env()
+    assert off.enable_culling is False and off.activity_probe is None
+
+    monkeypatch.setenv("ENABLE_CULLING", "true")
+    monkeypatch.setenv("CULL_IDLE_TIME", "10")       # minutes
+    monkeypatch.setenv("IDLENESS_CHECK_PERIOD", "2")
+    monkeypatch.setenv("CLUSTER_DOMAIN", "corp.local")
+    on = cluster_config_from_env(tpu_slices={"v5e-1": 1})
+    assert on.enable_culling is True
+    assert on.cull_idle_time == 600.0
+    assert on.cull_check_period == 120.0
+    assert on.activity_probe.cluster_domain == "corp.local"
+    assert on.tpu_slices == {"v5e-1": 1}
