@@ -344,16 +344,21 @@ def idle_culling(alice: Client, admin: Client) -> None:
     status, out = alice.req(
         "POST", "/jupyter/api/namespaces/alice/notebooks", body)
     assert status == 201, (status, out)
-    poll("cull-me running", lambda: [
-        n for n in alice.req(
-            "GET", "/jupyter/api/namespaces/alice/notebooks")[1]["notebooks"]
-        if n["name"] == "cull-me" and n["status"]["phase"] == "ready"])
+
+    def phase_is(*phases):
+        return lambda: [
+            n for n in alice.req(
+                "GET",
+                "/jupyter/api/namespaces/alice/notebooks")[1]["notebooks"]
+            if n["name"] == "cull-me" and n["status"]["phase"] in phases]
+    # The idle clock starts at the first reconcile, not at readiness —
+    # on a slow host the culler can win the race and stop the notebook
+    # before this poll ever observes "ready", which is equally a pass
+    # (the stop is the loop working).
+    poll("cull-me scheduled", phase_is("ready", "stopped"))
     # The stub reports cull-me idle since epoch; every other notebook
     # busy. The culler (CULL_IDLE_TIME seconds scale) must stop it.
-    poll("culled to stopped", lambda: [
-        n for n in alice.req(
-            "GET", "/jupyter/api/namespaces/alice/notebooks")[1]["notebooks"]
-        if n["name"] == "cull-me" and n["status"]["phase"] == "stopped"])
+    poll("culled to stopped", phase_is("stopped"))
     status, _ = alice.req(
         "DELETE", "/jupyter/api/namespaces/alice/notebooks/cull-me")
     assert status == 200, status

@@ -167,11 +167,18 @@ class Culler(Controller):
         self.check_period = check_period
         self.clock = clock
         self.metrics = metrics
+        # Probe gate (the reference tracks a last-check timestamp for
+        # the same reason, culler.go): our own annotation write emits a
+        # MODIFIED watch event that re-enqueues this controller — without
+        # the gate a busy notebook becomes a probe+write hot loop at
+        # HTTP latency instead of one probe per check_period.
+        self._last_probe: dict[tuple[str, str], float] = {}
 
     def reconcile(self, store: Store, namespace: str, name: str) -> Result:
         try:
             nb = store.get("Notebook", namespace, name)
         except NotFound:
+            self._last_probe.pop((namespace, name), None)
             return Result()
         assert isinstance(nb, Notebook)
         ann = nb.metadata.annotations
@@ -181,6 +188,14 @@ class Culler(Controller):
             return Result(requeue_after=self.check_period)
 
         now = self.clock()
+        last_probe = self._last_probe.get((namespace, name))
+        if last_probe is not None and now - last_probe < self.check_period:
+            # Re-enqueued by a watch event (often our own write): not
+            # due yet — skip the probe entirely so busy notebooks cost
+            # one probe+write per check_period, not a hot loop.
+            return Result(
+                requeue_after=self.check_period - (now - last_probe))
+        self._last_probe[(namespace, name)] = now
         if LAST_ACTIVITY_ANNOTATION not in ann:
             # First observation: initialize the activity clock (the
             # reference stamps the annotation at notebook creation) —

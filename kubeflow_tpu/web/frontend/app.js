@@ -6,7 +6,7 @@
 
 import { api, routes, ApiError } from '/static/api.js';
 import { homeView } from '/static/views_home.js';
-import { notebooksView, notebookFormView } from '/static/views_notebooks.js';
+import { notebooksView, notebookFormView, notebookDetailView } from '/static/views_notebooks.js';
 import { volumesView } from '/static/views_volumes.js';
 import { tensorboardsView } from '/static/views_tensorboards.js';
 import { contributorsView } from '/static/views_contributors.js';
@@ -77,7 +77,12 @@ function currentRoute() {
 
 export async function render() {
   const route = currentRoute();
-  const view = views[route] || views.home;
+  let view = views[route];
+  if (!view && route.startsWith('jupyter/detail/')) {
+    const name = decodeURIComponent(route.slice('jupyter/detail/'.length));
+    view = (ctx) => notebookDetailView(name, ctx);
+  }
+  view = view || views.home;
   for (const a of document.querySelectorAll('.nav-list a')) {
     a.classList.toggle(
       'active',

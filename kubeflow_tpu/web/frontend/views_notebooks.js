@@ -68,9 +68,11 @@ export async function notebooksView() {
           nb.status.phase,
         ),
       ),
-      h('td', {}, nb.status.phase === 'ready'
-        ? h('a', { href: nb.serverUrl, target: '_blank', rel: 'noopener' }, nb.name)
-        : nb.name),
+      h('td', {},
+        h('a', { href: `#/jupyter/detail/${encodeURIComponent(nb.name)}` }, nb.name),
+        nb.status.phase === 'ready'
+          ? h('span', {}, ' ', h('a', { href: nb.serverUrl, target: '_blank', rel: 'noopener', class: 'small' }, 'open ↗'))
+          : null),
       h('td', {}, nb.image.split('/').pop()),
       h('td', {}, nb.tpu.topology || '—'),
       h('td', {}, String(nb.readyReplicas)),
@@ -100,6 +102,77 @@ export async function notebooksView() {
           h('tbody', {}, rows),
         )
       : h('div', { class: 'empty' }, 'No notebooks yet — spawn one with “New Notebook”.'),
+  );
+}
+
+// -- notebook detail (ref JWA details page: status + events + pods) --
+
+export async function notebookDetailView(name) {
+  const ns = state.namespace;
+  if (!ns) return h('div', { class: 'card empty' }, 'No namespace selected.');
+  const { notebook: nb } = await api.get(routes.notebook(ns, name));
+
+  const eventRows = (nb.events || []).map((e) =>
+    h(
+      'tr',
+      {},
+      h('td', {}, h('span', { class: `dot ${e.type === 'Warning' ? 'warning' : 'ready'}` }), e.type),
+      h('td', {}, e.reason),
+      h('td', {}, e.message),
+      h('td', {}, String(e.count)),
+    ),
+  );
+  const podRows = (nb.pods || []).map((p) =>
+    h(
+      'tr',
+      {},
+      h('td', {}, p.name),
+      h('td', {}, p.phase || 'Pending'),
+      h('td', {}, p.workerId === '' ? '—' : p.workerId),
+    ),
+  );
+
+  return h(
+    'div',
+    { class: 'card' },
+    h(
+      'div',
+      { class: 'toolbar' },
+      h('h2', {}, `Notebook ${name}`),
+      h('button', { onclick: () => (location.hash = '#/jupyter') }, '← Back'),
+    ),
+    h(
+      'div',
+      { class: 'form-grid' },
+      h('label', {}, 'Status'),
+      h('span', { class: 'status' },
+        h('span', { class: `dot ${PHASE_DOT[nb.status.phase] || 'waiting'}` }),
+        `${nb.status.phase} — ${nb.status.message}`),
+      h('label', {}, 'Image'),
+      h('span', {}, nb.image),
+      h('label', {}, 'TPU slice'),
+      h('span', {}, nb.tpu.topology ? `${nb.tpu.topology}${nb.tpu.mesh ? ` (${nb.tpu.mesh})` : ''}` : 'none (CPU only)'),
+      h('label', {}, 'Ready replicas'),
+      h('span', {}, String(nb.readyReplicas)),
+    ),
+    h('h3', {}, `Gang pods (${podRows.length})`),
+    podRows.length
+      ? h(
+          'table',
+          { class: 'grid', id: 'detail-pods' },
+          h('thead', {}, h('tr', {}, h('th', {}, 'Pod'), h('th', {}, 'Phase'), h('th', {}, 'TPU_WORKER_ID'))),
+          h('tbody', {}, podRows),
+        )
+      : h('div', { class: 'empty' }, 'No pods (stopped or pending scheduling).'),
+    h('h3', {}, `Events (${eventRows.length})`),
+    eventRows.length
+      ? h(
+          'table',
+          { class: 'grid', id: 'detail-events' },
+          h('thead', {}, h('tr', {}, h('th', {}, 'Type'), h('th', {}, 'Reason'), h('th', {}, 'Message'), h('th', {}, 'Count'))),
+          h('tbody', {}, eventRows),
+        )
+      : h('div', { class: 'empty' }, 'No events recorded.'),
   );
 }
 

@@ -77,11 +77,32 @@ async def list_notebooks(request: web.Request):
 
 
 async def get_notebook(request: web.Request):
+    """Detail payload: the list summary plus the explain-my-notebook
+    data the reference's JWA details page shows (events via
+    find_error_event/status.py, the pod list via the notebook-name
+    label) — here with the gang structure first-class (per-pod
+    TPU_WORKER_ID)."""
     ns, name = request.match_info["ns"], request.match_info["name"]
     ensure_authorized(request, "get", "Notebook", ns)
     store: Store = request.app[STORE_KEY]
     nb = store.get("Notebook", ns, name)
-    return json_success({"notebook": _summarize(store, nb)})
+    out = _summarize(store, nb)
+    out["events"] = [
+        {"type": e.type, "reason": e.reason, "message": e.message,
+         "count": e.count, "lastTimestamp": e.last_timestamp}
+        for e in sorted(
+            store.events_for("Notebook", ns, name),
+            key=lambda e: e.last_timestamp, reverse=True)
+    ]
+    pods = store.list("Pod", ns, label_selector={"notebook-name": name})
+    out["pods"] = [
+        {"name": p.metadata.name, "phase": p.phase,
+         "workerId": next(
+             (e.value for c in p.spec.containers for e in c.env
+              if e.name == "TPU_WORKER_ID"), "")}
+        for p in pods
+    ]
+    return json_success({"notebook": out})
 
 
 async def post_notebook(request: web.Request):

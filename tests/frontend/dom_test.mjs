@@ -99,8 +99,11 @@ assert.equal(rows.length, 1, 'one notebook row');
 const rowText = rows[0].textContent;
 assert.ok(rowText.includes('nb1'), rowText);
 assert.ok(rowText.includes('v5e-16'), rowText);
-const link = rows[0].querySelector('a');
-assert.equal(link.getAttribute('href'), `/notebook/${NS}/nb1/`,
+const nameLinks = [...rows[0].querySelectorAll('a')];
+assert.equal(nameLinks[0].getAttribute('href'), '#/jupyter/detail/nb1',
+  'name links to the detail view');
+const openLink = nameLinks.find((a) => a.textContent.includes('open'));
+assert.equal(openLink.getAttribute('href'), `/notebook/${NS}/nb1/`,
   'ready notebook links to its server URL');
 
 // -- click Stop: the handler must PATCH {stopped: true} ---------------
@@ -123,5 +126,37 @@ const gets = calls.filter(
     && c.url === `/jupyter/api/namespaces/${NS}/notebooks`);
 assert.ok(gets.length >= 2, 'stop success re-renders the list');
 
+// -- detail view: navigate and assert gang pods + events render ------
+fixtures[`GET /jupyter/api/namespaces/${NS}/notebooks/nb1`] = {
+  notebook: {
+    name: 'nb1',
+    image: 'kubeflow-tpu/jupyter-jax-tpu:latest',
+    readyReplicas: 4,
+    tpu: { topology: 'v5e-16', mesh: 'data=1,fsdp=16,tensor=1' },
+    serverUrl: `/notebook/${NS}/nb1/`,
+    status: { phase: 'ready', message: 'Running' },
+    events: [{ type: 'Warning', reason: 'FailedScheduling',
+      message: 'waiting for a free v5e-16 slice', count: 3,
+      lastTimestamp: 0 }],
+    pods: [0, 1, 2, 3].map((i) => (
+      { name: `nb1-${i}`, phase: 'Running', workerId: String(i) })),
+  },
+};
+dom.window.location.hash = '#/jupyter/detail/nb1';
+await app.render();
+for (let i = 0; i < 20; i += 1) await settle();
+
+const podRows = [...document.querySelectorAll('#detail-pods tbody tr')];
+assert.equal(podRows.length, 4, 'gang pod table renders all 4 workers');
+assert.deepEqual(
+  podRows.map((r) => r.cells[2].textContent),
+  ['0', '1', '2', '3'],
+  'per-pod TPU_WORKER_ID column');
+const evRows = [...document.querySelectorAll('#detail-events tbody tr')];
+assert.equal(evRows.length, 1);
+assert.ok(evRows[0].textContent.includes('FailedScheduling'), evRows[0].textContent);
+assert.ok(document.getElementById('outlet').textContent
+  .includes('data=1,fsdp=16,tensor=1'), 'mesh shown on the detail page');
+
 console.log('frontend dom test OK '
-  + `(${calls.length} fetches, ${rows.length} row rendered)`);
+  + `(${calls.length} fetches, ${rows.length} row rendered, detail view driven)`);

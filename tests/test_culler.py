@@ -193,7 +193,8 @@ def test_terminal_activity_holds_notebook_alive():
     store = Store()
     mk(store)
     probe = TermProbe()
-    culler = Culler(probe, idle_time=100.0, clock=lambda: clock[0])
+    culler = Culler(probe, idle_time=100.0, check_period=5.0,
+                clock=lambda: clock[0])
 
     culler.reconcile(store, "u", "nb")  # initializes the clock
     # terminal keeps touching the notebook as time passes
@@ -211,3 +212,38 @@ def test_terminal_activity_holds_notebook_alive():
     culler.reconcile(store, "u", "nb")
     assert STOP_ANNOTATION in store.get(
         "Notebook", "u", "nb").metadata.annotations
+
+
+def test_busy_notebook_does_not_hot_loop_writes():
+    """Review finding: the busy path's last_activity=now write emits a
+    MODIFIED event that re-enqueues the culler — without the probe gate
+    that is a write loop at probe latency. Re-reconciles inside one
+    check_period must not probe or write."""
+    from kubeflow_tpu.api.crds import LAST_ACTIVITY_ANNOTATION
+
+    calls = []
+
+    class CountingProbe:
+        def kernels(self, ns, name):
+            calls.append(1)
+            return [KernelStatus("busy", 0.0)]
+
+    store = Store()
+    mk(store)
+    clock = FakeClock(1000.0)
+    culler = Culler(CountingProbe(), idle_time=100.0, check_period=60.0,
+                    clock=clock)
+    culler.reconcile(store, "u", "nb")      # init stamp (no probe yet)
+    clock.t += 61.0
+    culler.reconcile(store, "u", "nb")      # first real probe + write
+    rv = store.get("Notebook", "u", "nb").metadata.resource_version
+    for _ in range(10):                     # watch-event storm simulated
+        culler.reconcile(store, "u", "nb")
+    assert len(calls) == 1, f"{len(calls)} probes inside one period"
+    assert store.get("Notebook", "u", "nb").metadata.resource_version == rv
+
+    clock.t += 61.0                         # next period: probes again
+    culler.reconcile(store, "u", "nb")
+    assert len(calls) == 2
+    got = store.get("Notebook", "u", "nb")
+    assert got.metadata.annotations[LAST_ACTIVITY_ANNOTATION] == "1122.0"

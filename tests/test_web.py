@@ -299,3 +299,28 @@ def test_cluster_config_from_env_honors_culler_knobs(monkeypatch):
     assert on.cull_check_period == 120.0
     assert on.activity_probe.cluster_domain == "corp.local"
     assert on.tpu_slices == {"v5e-1": 1}
+
+
+async def test_notebook_detail_payload_has_events_and_gang_pods(env):
+    """The detail endpoint carries what the reference's JWA details
+    page shows (events, status) plus the TPU gang structure (per-pod
+    TPU_WORKER_ID), consumed by the SPA's #/jupyter/detail route."""
+    cluster, client = env
+    await _mk_profile(client, cluster)
+    r = await client.post(
+        "/jupyter/api/namespaces/alice/notebooks",
+        json={"name": "det", "image": "kubeflow-tpu/jupyter-jax:latest",
+              "cpu": "0.5", "memory": "1.0Gi",
+              "tpu": {"topology": "v5e-16", "mesh": ""},
+              "workspace": None, "shm": False, "configurations": []},
+        headers=ALICE)
+    assert r.status == 201, await r.text()
+    assert cluster.wait_idle()
+    r = await client.get("/jupyter/api/namespaces/alice/notebooks/det",
+                         headers=ALICE)
+    nb = (await r.json())["notebook"]
+    assert sorted(p["workerId"] for p in nb["pods"]) == ["0", "1", "2", "3"]
+    assert all(p["name"].startswith("det-") for p in nb["pods"])
+    assert isinstance(nb["events"], list)  # sorted newest-first
+    for e in nb["events"]:
+        assert {"type", "reason", "message", "count"} <= set(e)

@@ -34,6 +34,16 @@ VARIANTS = [
 
 
 def main() -> int:
+    # Same backend armor as bench.py (round-3 lesson): never touch a
+    # possibly-wedged backend in-process. The sweep is only meaningful
+    # on TPU — refuse early with a clear rc instead of hanging.
+    backend = bench.resolve_backend()
+    if backend != "tpu":
+        print(f"remat_sweep needs a TPU backend (probe: {backend}); "
+              "not running — see docs/perf-notes.md for the expected "
+              "outcome model", file=sys.stderr)
+        return 3
+
     base = bench.bench_configs()["bench-500m"]
     variants = VARIANTS
     if len(sys.argv) > 1:
