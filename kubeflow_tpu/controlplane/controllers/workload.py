@@ -143,9 +143,8 @@ class StatefulSetController(Controller):
 
         pods = {
             p.metadata.name: p
-            for p in store.list("Pod", namespace)
-            if any(r.uid == sts.metadata.uid
-                   for r in p.metadata.owner_references)
+            for p in store.list("Pod", namespace,
+                                owner_uid=sts.metadata.uid)
         }
 
         # Template drift replaces pods: a resized/edited gang (e.g.
@@ -210,10 +209,7 @@ class StatefulSetController(Controller):
                     pass
 
         # Simulated kubelet: freshly created pods become Running+ready.
-        for p in store.list("Pod", namespace):
-            if not any(r.uid == sts.metadata.uid
-                       for r in p.metadata.owner_references):
-                continue
+        for p in store.list("Pod", namespace, owner_uid=sts.metadata.uid):
             if p.phase == "Pending":
                 p.phase = "Running"
                 p.ready = True
@@ -222,9 +218,9 @@ class StatefulSetController(Controller):
                 store.update(p)
 
         ready = sum(
-            1 for p in store.list("Pod", namespace)
-            if any(r.uid == sts.metadata.uid for r in p.metadata.owner_references)
-            and p.phase == "Running" and p.ready
+            1 for p in store.list("Pod", namespace,
+                                  owner_uid=sts.metadata.uid)
+            if p.phase == "Running" and p.ready
         )
         fresh = store.try_get("StatefulSet", namespace, name)
         if fresh is not None and fresh.ready_replicas != ready:
@@ -252,10 +248,8 @@ class DeploymentController(Controller):
         tmpl = dep.spec.template
         tmpl_hash = _template_hash(tmpl)
 
-        owned = [
-            p for p in store.list("Pod", namespace)
-            if any(r.uid == dep.metadata.uid for r in p.metadata.owner_references)
-        ]
+        owned = store.list("Pod", namespace,
+                           owner_uid=dep.metadata.uid)
         # Rolling replacement: pods from an older template are retired so
         # a spec change (e.g. a Tensorboard's new --logdir) actually lands.
         stale = [
@@ -293,10 +287,8 @@ class DeploymentController(Controller):
                 pass
 
         ready = 0
-        for p in store.list("Pod", namespace):
-            if not any(r.uid == dep.metadata.uid
-                       for r in p.metadata.owner_references):
-                continue
+        for p in store.list("Pod", namespace,
+                            owner_uid=dep.metadata.uid):
             if p.phase == "Pending":
                 p.phase = "Running"
                 p.ready = True

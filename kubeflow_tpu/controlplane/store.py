@@ -104,6 +104,20 @@ class Store:
         self._events_by_ns: dict[str, set[tuple[str, str, str]]] = {}
         # uid -> key: O(1) liveness checks for owner references.
         self._uids: dict[str, tuple[str, str, str]] = {}
+        # kind -> {key -> Resource}: list(kind) must not scan every
+        # object in the cluster (an informer-style index; the
+        # reconcile-fanout loadtest is the regression harness).
+        self._by_kind: dict[str, dict[tuple[str, str, str], Resource]] = {}
+        # (kind, label, value) -> keys: exact-match label selectors
+        # (every controller's owned-object lookup, e.g. Pods by
+        # notebook-name) resolve without scanning the kind. Maintained
+        # for every label on every object — label sets are tiny.
+        self._labels: dict[tuple[str, str, str],
+                           set[tuple[str, str, str]]] = {}
+        # owner uid -> owned keys: the informer ownerRef index. Gang
+        # controllers resolve "my pods" in O(gang), not O(namespace) —
+        # the other half of the reconcile-fanout quadratic.
+        self._by_owner: dict[str, set[tuple[str, str, str]]] = {}
 
     # -- admission ---------------------------------------------------------
 
@@ -138,6 +152,8 @@ class Store:
             m.generation = 1
             m.creation_timestamp = m.creation_timestamp or time.time()
             self._objects[obj.key] = obj
+            self._by_kind.setdefault(obj.kind, {})[obj.key] = obj
+            self._index_labels(obj)
             self._uids[m.uid] = obj.key
             if obj.kind == "Event":
                 self._events_by_ns.setdefault(
@@ -174,7 +190,10 @@ class Store:
             m.creation_timestamp = cur.metadata.creation_timestamp
             m.resource_version = next(self._rv)
             m.generation = cur.metadata.generation + 1
+            self._unindex_labels(cur)
             self._objects[obj.key] = obj
+            self._by_kind.setdefault(obj.kind, {})[obj.key] = obj
+            self._index_labels(obj)
             self._notify(WatchEvent("MODIFIED", obj.clone()))
             # A finalizer strip on a deleting object may complete deletion.
             if m.deletion_timestamp is not None and not m.finalizers:
@@ -199,17 +218,18 @@ class Store:
         obj = self._objects.pop(key, None)
         if obj is None:
             return
+        self._by_kind.get(obj.kind, {}).pop(key, None)
+        self._unindex_labels(obj)
         self._uids.pop(obj.metadata.uid, None)
         if obj.kind == "Event":
             self._events_by_ns.get(obj.metadata.namespace, set()).discard(key)
         self._notify(WatchEvent("DELETED", obj.clone()))
-        # Cascade: delete objects owned (controller=True) by this one.
-        owned = [
-            o.key
-            for o in list(self._objects.values())
-            if any(r.uid == obj.metadata.uid for r in o.metadata.owner_references)
-        ]
-        # Deleting a Namespace deletes everything namespaced inside it.
+        # Cascade: delete objects owned by this one — resolved through
+        # the owner index (O(owned)), not a cluster scan; the delete
+        # path must scale like the reconcile path it serves.
+        owned = list(self._by_owner.get(obj.metadata.uid, ()))
+        # Deleting a Namespace deletes everything namespaced inside it
+        # (rare admin operation: the scan is acceptable here).
         if obj.kind == "Namespace":
             owned += [
                 o.key
@@ -222,6 +242,27 @@ class Store:
             except NotFound:
                 pass
 
+    def _index_labels(self, obj: Resource) -> None:
+        for k, v in obj.metadata.labels.items():
+            self._labels.setdefault((obj.kind, k, v), set()).add(obj.key)
+        for ref in obj.metadata.owner_references:
+            if ref.uid:
+                self._by_owner.setdefault(ref.uid, set()).add(obj.key)
+
+    def _unindex_labels(self, obj: Resource) -> None:
+        for k, v in obj.metadata.labels.items():
+            entry = self._labels.get((obj.kind, k, v))
+            if entry is not None:
+                entry.discard(obj.key)
+                if not entry:
+                    del self._labels[(obj.kind, k, v)]
+        for ref in obj.metadata.owner_references:
+            entry = self._by_owner.get(ref.uid)
+            if entry is not None:
+                entry.discard(obj.key)
+                if not entry:
+                    del self._by_owner[ref.uid]
+
     # -- queries -----------------------------------------------------------
 
     def list(
@@ -231,13 +272,34 @@ class Store:
         *,
         label_selector: dict[str, str] | None = None,
         field_match: Callable[[Resource], bool] | None = None,
+        owner_uid: str | None = None,
     ) -> list[Resource]:
         with self._lock:
+            pool = self._by_kind.get(kind, {})
+            candidates = pool.values()
+            if owner_uid is not None:
+                candidates = [
+                    pool[key]
+                    for key in self._by_owner.get(owner_uid, ())
+                    if key in pool
+                ]
+            elif label_selector:
+                # Narrow via the label index when any selector entry is
+                # an exact value (wildcards still scan): pick the
+                # smallest posting set, verify the full selector below.
+                exact = [
+                    self._labels.get((kind, k, v), set())
+                    for k, v in label_selector.items()
+                    if not any(c in v for c in "*?[")
+                ]
+                if exact:
+                    keys = min(exact, key=len)
+                    candidates = [pool[key] for key in keys
+                                  if key in pool]
             out = []
-            for (k, ns, _), obj in self._objects.items():
-                if k != kind:
-                    continue
-                if namespace is not None and ns != namespace:
+            for obj in candidates:
+                if (namespace is not None
+                        and obj.metadata.namespace != namespace):
                     continue
                 if label_selector and not _labels_match(
                     obj.metadata.labels, label_selector
@@ -314,12 +376,12 @@ class Store:
                     mine.append((fresh_at, key))
             mine.sort(reverse=True)
             overflow = [key for _, key in mine[self.events_per_object:]]
+            # Events own nothing and carry no finalizers, so the full
+            # delete bookkeeping applies directly — ONE place maintains
+            # the store's indexes (a hand-mirrored copy here silently
+            # corrupted index additions twice during round 4).
             for key in list(expired) + overflow:
-                obj = self._objects.pop(key, None)
-                self._events_by_ns.get(namespace, set()).discard(key)
-                if obj is not None:
-                    self._uids.pop(obj.metadata.uid, None)
-                    self._notify(WatchEvent("DELETED", obj.clone()))
+                self._finalize_delete(key)
 
     def events_for(self, kind: str, namespace: str, name: str) -> list[Event]:
         return [

@@ -194,3 +194,48 @@ def test_create_with_dead_controller_owner_rejected():
     child2 = mk_notebook("child2")
     set_controller_reference(owner2, child2)
     s.create(child2)
+
+
+def test_label_and_owner_indexes_track_updates():
+    """The informer-style indexes (labels, owner uid) power the
+    reconcile-fanout fast path; they must stay exact across update
+    label changes, owner-ref changes, and deletes."""
+    s = Store()
+    owner = s.create(mk_notebook("own"))
+    child = mk_notebook("child")
+    child.metadata.labels = {"team": "a"}
+    set_controller_reference(owner, child)
+    child = s.create(child)
+
+    assert [o.metadata.name for o in s.list(
+        "Notebook", "user1", label_selector={"team": "a"})] == ["child"]
+    assert [o.metadata.name for o in s.list(
+        "Notebook", owner_uid=owner.metadata.uid)] == ["child"]
+
+    # update: label value changes, owner ref dropped
+    child.metadata.labels = {"team": "b"}
+    child.metadata.owner_references = []
+    child = s.update(child)
+    assert s.list("Notebook", "user1", label_selector={"team": "a"}) == []
+    assert [o.metadata.name for o in s.list(
+        "Notebook", "user1", label_selector={"team": "b"})] == ["child"]
+    assert s.list("Notebook", owner_uid=owner.metadata.uid) == []
+
+    # owner_uid composes with label verification
+    child.metadata.owner_references = []
+    set_controller_reference(owner, child)
+    child = s.update(child)
+    assert s.list("Notebook", owner_uid=owner.metadata.uid,
+                  label_selector={"team": "a"}) == []
+    assert [o.metadata.name for o in s.list(
+        "Notebook", owner_uid=owner.metadata.uid,
+        label_selector={"team": "b"})] == ["child"]
+
+    # wildcard selectors bypass the index but still work
+    assert [o.metadata.name for o in s.list(
+        "Notebook", "user1", label_selector={"team": "*"})
+        if o.metadata.name == "child"] == ["child"]
+
+    s.delete("Notebook", "user1", "child")
+    assert s.list("Notebook", "user1", label_selector={"team": "b"}) == []
+    assert s.list("Notebook", owner_uid=owner.metadata.uid) == []
