@@ -144,6 +144,134 @@ async def create_resource(request: web.Request) -> web.Response:
         versioning.to_versioned_dict(created, version), status=201)
 
 
+async def update_resource(request: web.Request) -> web.Response:
+    """PUT: full replace with optimistic concurrency — the body must
+    carry the resourceVersion being replaced (kubectl edit/replace
+    semantics; the store raises Conflict on a stale version)."""
+    store: Store = request.app[STORE_KEY]
+    kind = _kind(request)
+    _require_mutable(kind)
+    version = _version(request, kind)
+    ns, name = request.match_info["ns"], request.match_info["name"]
+    _require_api_client(request)
+    ensure_authorized(request, "update", kind, ns)
+    body = await request.json()
+    body.setdefault("kind", kind)
+    body.setdefault("apiVersion", f"{versioning.GROUP}/{version}")
+    if versioning.parse_api_version(body["apiVersion"]) != version:
+        raise ValueError(
+            f"body apiVersion {body['apiVersion']!r} does not match "
+            f"request path version {version!r}")
+    obj = versioning.resource_from_versioned_dict(body)
+    if obj.kind != kind:
+        raise ValueError(f"body kind {obj.kind!r} != path kind {kind!r}")
+    if obj.metadata.name and obj.metadata.name != name:
+        raise ValueError(
+            f"body name {obj.metadata.name!r} != path name {name!r}")
+    # A client PUT replaces spec + user metadata only (subresource
+    # semantics); the client's resourceVersion is the concurrency token.
+    cur = store.get(kind, ns, name)
+    _pin_controller_fields(obj, cur, keep_client_rv=True)
+    updated = store.update(obj)
+    return web.json_response(versioning.to_versioned_dict(updated, version))
+
+
+# Mutable-by-clients parts of a resource under JSON merge patch:
+# spec plus the user-owned metadata maps. status/ownerRefs/finalizers
+# stay controller-owned (the reference's apiserver guards these with
+# subresources; refusing them here is the equivalent).
+_PATCHABLE_TOP = {"spec"}
+_PATCHABLE_META = {"labels", "annotations"}
+
+
+def _merge_patch(target, patch):
+    """RFC 7386 JSON merge patch: null deletes, objects merge, anything
+    else replaces."""
+    if not isinstance(patch, dict):
+        return patch
+    if not isinstance(target, dict):
+        target = {}
+    out = dict(target)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = _merge_patch(out.get(k), v)
+    return out
+
+
+def _pin_controller_fields(obj, cur, *, keep_client_rv: bool) -> None:
+    """Identity and controller-owned fields are never client-writable
+    through the /apis door: status, ownership, finalizers, and the
+    deletion mark (a PUT that cleared deletion_timestamp would
+    resurrect a terminating object mid-finalization — k8s forbids that
+    transition). resourceVersion stays the CLIENT's on PUT (it is the
+    optimistic-concurrency token) and the STORE's on PATCH (the
+    merge-retry loop re-reads)."""
+    obj.metadata.name = cur.metadata.name
+    obj.metadata.namespace = cur.metadata.namespace
+    obj.metadata.owner_references = cur.metadata.owner_references
+    obj.metadata.finalizers = cur.metadata.finalizers
+    obj.metadata.deletion_timestamp = cur.metadata.deletion_timestamp
+    obj.status = cur.status
+    if not keep_client_rv:
+        obj.metadata.resource_version = cur.metadata.resource_version
+
+
+def _validate_patch_body(patch) -> None:
+    if not isinstance(patch, dict):
+        raise ValueError("merge patch body must be a JSON object")
+    bad_top = set(patch) - _PATCHABLE_TOP - {"metadata"}
+    bad_meta = set(patch.get("metadata", {}) or {}) - _PATCHABLE_META
+    if bad_top or bad_meta:
+        raise ValueError(
+            f"merge patch may touch spec/metadata.labels/annotations "
+            f"only (got {sorted(bad_top) + sorted(bad_meta)})")
+
+
+async def _merge_patch_with_retry(store, kind, ns, name, version, patch,
+                                  check=None) -> web.Response:
+    """The shared kubectl-style PATCH loop: serialize at the request
+    version, merge, convert through the hub, pin controller fields,
+    retry Conflicts from a fresh read. `check(cur, obj)` hooks per-kind
+    authorization/invariants."""
+    from kubeflow_tpu.controlplane.store import Conflict
+
+    for _ in range(5):
+        cur = store.get(kind, ns, name)
+        wire = versioning.to_versioned_dict(cur, version)
+        merged = _merge_patch(wire, patch)
+        obj = versioning.resource_from_versioned_dict(merged)
+        _pin_controller_fields(obj, cur, keep_client_rv=False)
+        if check is not None:
+            check(cur, obj)
+        try:
+            updated = store.update(obj)
+            return web.json_response(
+                versioning.to_versioned_dict(updated, version))
+        except Conflict:
+            continue
+    raise web.HTTPConflict(text=f"{kind} {ns}/{name}: persistent "
+                                "write contention")
+
+
+async def patch_resource(request: web.Request) -> web.Response:
+    """PATCH: RFC 7386 merge patch against the resource serialized at
+    the REQUEST version (patches written by old clients patch the shape
+    they know), then converted through the hub for storage."""
+    store: Store = request.app[STORE_KEY]
+    kind = _kind(request)
+    _require_mutable(kind)
+    version = _version(request, kind)
+    ns, name = request.match_info["ns"], request.match_info["name"]
+    _require_api_client(request)
+    ensure_authorized(request, "update", kind, ns)
+    patch = await request.json()
+    _validate_patch_body(patch)
+    return await _merge_patch_with_retry(store, kind, ns, name, version,
+                                         patch)
+
+
 async def delete_resource(request: web.Request) -> web.Response:
     store: Store = request.app[STORE_KEY]
     kind = _kind(request)
@@ -242,6 +370,29 @@ async def create_profile(request: web.Request) -> web.Response:
         versioning.to_versioned_dict(created, version), status=201)
 
 
+async def patch_profile(request: web.Request) -> web.Response:
+    """Merge-patch a Profile (quota edits etc.): admin, or the owner —
+    but owners cannot reassign ownership to someone else."""
+    store: Store = request.app[STORE_KEY]
+    version = _version(request, "Profile")
+    name = request.match_info["name"]
+    _require_api_client(request)
+    is_admin, user = _cluster_admin_and_user(request)
+    patch = await request.json()
+    _validate_patch_body(patch)
+
+    def check(cur, obj):
+        if not is_admin and cur.spec.owner != user.name:
+            raise web.HTTPForbidden(
+                text=f"{user.name} is not owner/admin of profile {name}")
+        if obj.spec.owner != cur.spec.owner and not is_admin:
+            raise web.HTTPForbidden(
+                text="only cluster admins reassign profile ownership")
+
+    return await _merge_patch_with_retry(store, "Profile", "", name,
+                                         version, patch, check=check)
+
+
 async def delete_profile(request: web.Request) -> web.Response:
     store: Store = request.app[STORE_KEY]
     _version(request, "Profile")
@@ -263,10 +414,13 @@ def create_apis_app(store: Store, *, cluster_admins=None,
     app.router.add_get(base, list_resources)
     app.router.add_post(base, create_resource)
     app.router.add_get(base + "/{name}", get_resource)
+    app.router.add_put(base + "/{name}", update_resource)
+    app.router.add_patch(base + "/{name}", patch_resource)
     app.router.add_delete(base + "/{name}", delete_resource)
     cluster = f"/{versioning.GROUP}/{{version}}/profiles"
     app.router.add_get(cluster, list_profiles)
     app.router.add_post(cluster, create_profile)
     app.router.add_get(cluster + "/{name}", get_profile)
+    app.router.add_patch(cluster + "/{name}", patch_profile)
     app.router.add_delete(cluster + "/{name}", delete_profile)
     return app

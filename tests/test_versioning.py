@@ -346,3 +346,167 @@ async def test_profile_create_ignores_body_namespace(loop):
     finally:
         await client.close()
         cluster.stop()
+
+
+async def test_apis_put_and_patch_verbs(loop):
+    """kubectl-style UPDATE through the /apis door: PUT replaces spec
+    with optimistic concurrency; PATCH is an RFC 7386 merge applied at
+    the request version; status/ownership are not client-writable."""
+    cluster = Cluster(ClusterConfig(
+        tpu_slices={"v5e-16": 1},
+        cluster_admins={"alice@example.com"})).start()
+    app = cluster.create_web_app(csrf=False)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        base = "/apis/kubeflow-tpu.dev"
+        r = await client.post(
+            f"{base}/v1alpha1/namespaces/user1/notebooks",
+            json=_v1alpha1_notebook(), headers=API_CLIENT)
+        assert r.status == 201, await r.text()
+        assert cluster.wait_idle()
+
+        # PATCH at the OLD version: the client patches the shape it
+        # knows (spec.accelerator), storage converts through the hub.
+        r = await client.patch(
+            f"{base}/v1alpha1/namespaces/user1/notebooks/old",
+            json={"metadata": {"labels": {"team": "ml"}},
+                  "spec": {"accelerator": ""}},
+            headers=API_CLIENT)
+        assert r.status == 200, await r.text()
+        stored = cluster.store.get("Notebook", "user1", "old")
+        assert stored.spec.tpu.topology == ""
+        assert stored.metadata.labels["team"] == "ml"
+
+        # PATCH cannot touch status or ownership
+        r = await client.patch(
+            f"{base}/v1/namespaces/user1/notebooks/old",
+            json={"status": {"ready_replicas": 99}}, headers=API_CLIENT)
+        assert r.status == 400, await r.text()
+
+        # PUT: stale resourceVersion is a conflict; fresh succeeds
+        r = await client.get(f"{base}/v1/namespaces/user1/notebooks/old",
+                             headers=USER)
+        wire = await r.json()
+        stale = {**wire, "metadata": {
+            **wire["metadata"], "resource_version": 1}}
+        r = await client.put(
+            f"{base}/v1/namespaces/user1/notebooks/old",
+            json=stale, headers=API_CLIENT)
+        assert r.status == 409, await r.text()
+        # controllers may have written status since the GET: take a
+        # fresh read for the happy-path PUT (kubectl's own retry shape)
+        assert cluster.wait_idle()
+        r = await client.get(f"{base}/v1/namespaces/user1/notebooks/old",
+                             headers=USER)
+        wire = await r.json()
+        wire["spec"]["tpu"]["topology"] = "v5e-16"
+        r = await client.put(
+            f"{base}/v1/namespaces/user1/notebooks/old",
+            json=wire, headers=API_CLIENT)
+        assert r.status == 200, await r.text()
+        assert cluster.store.get(
+            "Notebook", "user1", "old").spec.tpu.topology == "v5e-16"
+
+        # the CSRF custom-header rule applies to the new verbs too
+        r = await client.patch(
+            f"{base}/v1/namespaces/user1/notebooks/old",
+            json={"spec": {}}, headers=USER)
+        assert r.status == 403
+        # controller-owned kinds stay read-only
+        r = await client.patch(
+            f"{base}/v1/namespaces/user1/pods/x",
+            json={"spec": {}}, headers=API_CLIENT)
+        assert r.status == 405
+    finally:
+        await client.close()
+        cluster.stop()
+
+
+async def test_profile_patch_quota_and_ownership_guard(loop):
+    cluster = Cluster(ClusterConfig(
+        cluster_admins={"admin@example.com"})).start()
+    app = cluster.create_web_app(csrf=False)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    alice_api = {"kubeflow-userid": "alice@example.com",
+                 "X-KFTPU-API-CLIENT": "t"}
+    admin_api = {"kubeflow-userid": "admin@example.com",
+                 "X-KFTPU-API-CLIENT": "t"}
+    try:
+        base = "/apis/kubeflow-tpu.dev"
+        r = await client.post(f"{base}/v1beta1/profiles",
+                              json=_v1beta1_profile(), headers=alice_api)
+        assert r.status == 201
+        assert cluster.wait_idle()
+
+        # owner patches quota through the OLD version's wire shape
+        r = await client.patch(
+            f"{base}/v1beta1/profiles/team-a",
+            json={"spec": {"resourceQuotaSpec":
+                           {"hard": {"tpu/v5e-chips": "32"}}}},
+            headers=alice_api)
+        assert r.status == 200, await r.text()
+        assert cluster.store.get("Profile", "", "team-a").spec \
+            .resource_quota["tpu/v5e-chips"] == "32"
+
+        # owner cannot reassign ownership; admin can
+        r = await client.patch(
+            f"{base}/v1/profiles/team-a",
+            json={"spec": {"owner": "mallory@example.com"}},
+            headers=alice_api)
+        assert r.status == 403
+        r = await client.patch(
+            f"{base}/v1/profiles/team-a",
+            json={"spec": {"owner": "bob@example.com"}},
+            headers=admin_api)
+        assert r.status == 200, await r.text()
+        assert cluster.store.get(
+            "Profile", "", "team-a").spec.owner == "bob@example.com"
+    finally:
+        await client.close()
+        cluster.stop()
+
+
+async def test_put_cannot_resurrect_terminating_resource(loop):
+    """Review finding: a PUT without deletion_timestamp must not clear
+    the deletion mark on a finalizer-held object (k8s forbids the
+    transition; the store's strip-finalizer completion path depends on
+    the mark surviving)."""
+    cluster = Cluster(ClusterConfig(
+        tpu_slices={"v5e-16": 1},
+        cluster_admins={"alice@example.com"})).start()
+    app = cluster.create_web_app(csrf=False)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        base = "/apis/kubeflow-tpu.dev"
+        r = await client.post(
+            f"{base}/v1/namespaces/user1/notebooks",
+            json={"kind": "Notebook",
+                  "metadata": {"name": "term",
+                               "finalizers": ["test/hold"]},
+                  "spec": {"template": {"spec": {"containers": [
+                      {"name": "c", "image": "img"}]}}}},
+            headers=API_CLIENT)
+        assert r.status == 201, await r.text()
+        r = await client.delete(f"{base}/v1/namespaces/user1/notebooks/term",
+                                headers=API_CLIENT)
+        assert r.status == 200
+        held = cluster.store.get("Notebook", "user1", "term")
+        assert held.metadata.deletion_timestamp is not None
+
+        r = await client.get(f"{base}/v1/namespaces/user1/notebooks/term",
+                             headers=USER)
+        wire = await r.json()
+        wire["metadata"].pop("deletion_timestamp", None)
+        r = await client.put(f"{base}/v1/namespaces/user1/notebooks/term",
+                             json=wire, headers=API_CLIENT)
+        assert r.status == 200, await r.text()
+        after = cluster.store.get("Notebook", "user1", "term")
+        assert after.metadata.deletion_timestamp is not None, \
+            "PUT resurrected a terminating object"
+        assert after.metadata.finalizers == ["test/hold"]
+    finally:
+        await client.close()
+        cluster.stop()
