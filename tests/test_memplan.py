@@ -1,0 +1,72 @@
+"""Memory-fit planner (tools/memplan.py): the BASELINE north-star
+config must plan green; impossible configs must plan red — all via
+eval_shape, no device allocation."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import memplan  # noqa: E402
+
+
+def test_llama3_8b_fsdp16_fits_v5e():
+    """The BASELINE north star: Llama-3-8B FSDP over a v5e-16 slice."""
+    r = memplan.plan("llama3-8b", {"data": 1, "fsdp": 16, "tensor": 1},
+                     batch=16, seq=2048, generation="v5e")
+    assert r["fits"], r
+    assert 7.9e9 < r["params"] < 8.2e9  # it really is the 8B
+    # fp32 master params 32 GB over 16 chips = 2 GB/chip
+    assert abs(r["per_chip_gb"]["params"] - 2.0) < 0.1
+
+
+def test_llama3_8b_single_chip_does_not_fit():
+    r = memplan.plan("llama3-8b", {"data": 1, "fsdp": 1, "tensor": 1},
+                     batch=1, seq=128, generation="v5e")
+    assert not r["fits"], r  # 32 GB of fp32 params alone > 16 GB HBM
+
+
+def test_tp_shards_the_right_tensors():
+    """tensor-axis sharding reduces per-chip bytes for heads/mlp/vocab
+    tensors: an fsdp16 plan and an fsdp8xtp2 plan land close, both far
+    below fsdp8 alone."""
+    fsdp16 = memplan.plan("llama3-8b",
+                          {"data": 1, "fsdp": 16, "tensor": 1},
+                          batch=16, seq=2048, generation="v5e")
+    mixed = memplan.plan("llama3-8b",
+                         {"data": 1, "fsdp": 8, "tensor": 2},
+                         batch=16, seq=2048, generation="v5e")
+    fsdp8 = memplan.plan("llama3-8b",
+                         {"data": 2, "fsdp": 8, "tensor": 1},
+                         batch=16, seq=2048, generation="v5e")
+    assert fsdp16["per_chip_gb"]["params"] < fsdp8["per_chip_gb"]["params"]
+    assert mixed["per_chip_gb"]["params"] < fsdp8["per_chip_gb"]["params"]
+
+
+def test_cli_contract():
+    """One JSON line on stdout, human table on stderr, rc reflects fit."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "memplan.py"),
+         "--model", "llama3-1b", "--topology", "v5e-4"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert ok.returncode == 0, ok.stderr
+    out = json.loads(ok.stdout.strip().splitlines()[-1])
+    assert out["fits"] is True
+    assert "fits" in ok.stderr
+
+    bad = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "memplan.py"),
+         "--model", "llama3-8b", "--topology", "v5e-1"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert bad.returncode == 1, bad.stderr
+    assert json.loads(bad.stdout.strip().splitlines()[-1])["fits"] is False
+
+    mismatch = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "memplan.py"),
+         "--topology", "v5e-16", "--mesh", "data=2,fsdp=2,tensor=2"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert mismatch.returncode == 2  # argparse error: 8 devices != 16

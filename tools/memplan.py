@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Memory-fit planner: does model × mesh × batch fit per-chip HBM?
+
+Answers the question every operator asks before burning a slice
+reservation — purely from `jax.eval_shape` + the sharding rules, so it
+runs anywhere in milliseconds with ZERO device allocation (and
+therefore never touches a possibly-wedged TPU backend).
+
+    python tools/memplan.py --model llama3-8b --topology v5e-16 \
+        --mesh data=1,fsdp=16,tensor=1 --batch 16 --seq 2048
+
+Prints a per-chip budget table and one JSON line; exits 1 when the
+plan exceeds the chip's HBM (so CI/scripts can gate on it). The
+BASELINE north-star config (Llama-3-8B FSDP on v5e-16) is the worked
+example and a regression test pins that it fits.
+
+Accounting (documented so the numbers can be argued with):
+- params: eval_shape sizes × dtype, divided by each tensor's shard
+  factor (product of the mesh-axis sizes its PartitionSpec names);
+- adam moments: 2 × params (optax.adamw keeps mu/nu in param dtype),
+  sharded like the params (trainer path-suffix matching);
+- gradients: 1 × params (live during the update step);
+- activations: with the default full remat, the residual stream is
+  saved once per layer boundary (batch × seq × hidden × act dtype),
+  sharded over the batch axes (data × fsdp), plus one attention
+  working set for the layer being recomputed and the chunked-CE
+  logits chunk (vocab/num_chunks) — an estimate, deliberately on the
+  conservative side.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import math
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+HBM_BYTES = {"v5e": 16e9, "v5p": 96e9, "v4": 32e9, "v6e": 32e9}
+
+
+def model_registry():
+    """Built from the models' own CONFIGS dicts so new presets appear
+    here automatically; gemma's keys are prefixed where they would
+    collide with llama's ("tiny")."""
+    from kubeflow_tpu.models import gemma, llama
+
+    out = {name: ("llama", cfg) for name, cfg in llama.CONFIGS.items()}
+    for name, cfg in gemma.CONFIGS.items():
+        key = name if name.startswith("gemma") else f"gemma-{name}"
+        out[key] = ("gemma", cfg)
+    return out
+
+
+def param_shapes(family: str, cfg):
+    from kubeflow_tpu.models import gemma, llama
+
+    mod = {"llama": llama, "gemma": gemma}[family]
+    shapes = jax.eval_shape(
+        lambda k: mod.init(k, cfg), jax.ShapeDtypeStruct((2,), jnp.uint32))
+    axes = mod.param_logical_axes(cfg)
+    return shapes, axes
+
+
+def shard_factor(spec_entry, mesh_sizes: dict[str, int]) -> int:
+    if spec_entry is None:
+        return 1
+    if isinstance(spec_entry, (tuple, list)):
+        f = 1
+        for a in spec_entry:
+            f *= mesh_sizes.get(a, 1)
+        return f
+    return mesh_sizes.get(spec_entry, 1)
+
+
+def plan(model: str, mesh_sizes: dict[str, int], batch: int, seq: int,
+         generation: str) -> dict:
+    from kubeflow_tpu.parallel.sharding import LLAMA_RULES
+
+    family, cfg = model_registry()[model]
+    shapes, axes = param_shapes(family, cfg)
+
+    flat_shapes = jax.tree.leaves_with_path(shapes)
+    flat_axes = dict(jax.tree.leaves_with_path(
+        axes, is_leaf=lambda x: isinstance(x, tuple)))
+
+    n_params = 0
+    param_bytes_per_chip = 0.0
+    for path, leaf in flat_shapes:
+        logical = flat_axes[path]
+        spec = LLAMA_RULES.resolve(logical)
+        factor = 1
+        for entry in spec:
+            factor *= shard_factor(entry, mesh_sizes)
+        size = math.prod(leaf.shape)
+        n_params += size
+        param_bytes_per_chip += (
+            size * jnp.dtype(leaf.dtype).itemsize / factor)
+
+    opt_bytes = 2 * param_bytes_per_chip          # adam mu + nu
+    grad_bytes = param_bytes_per_chip
+    batch_shards = mesh_sizes.get("data", 1) * mesh_sizes.get("fsdp", 1)
+    act_itemsize = jnp.dtype(cfg.dtype).itemsize
+    residuals = (batch * seq * cfg.hidden_size * act_itemsize
+                 * cfg.num_layers / batch_shards)
+    attn_work = (batch * seq * cfg.num_heads * cfg.head_dim
+                 * act_itemsize * 4 / batch_shards
+                 / max(mesh_sizes.get("tensor", 1), 1))
+    # chunked-CE logits chunk: the trainer's actual default chunk
+    # count keeps this estimate honest (trainer.py num_chunks=8)
+    import inspect
+
+    from kubeflow_tpu.train.trainer import chunked_cross_entropy_from_hidden
+    num_chunks = inspect.signature(
+        chunked_cross_entropy_from_hidden).parameters["num_chunks"].default
+    ce_chunk = (batch * seq * cfg.vocab_size / num_chunks * 4
+                / batch_shards / max(mesh_sizes.get("tensor", 1), 1))
+    act_bytes = residuals + attn_work + ce_chunk
+
+    total = param_bytes_per_chip + opt_bytes + grad_bytes + act_bytes
+    hbm = HBM_BYTES[generation]
+    budget = hbm * 0.92  # XLA scratch/fragmentation headroom reserve
+    return {
+        "model": model,
+        "params": n_params,
+        "mesh": dict(mesh_sizes),
+        "batch": batch, "seq": seq, "generation": generation,
+        "per_chip_gb": {
+            "params": round(param_bytes_per_chip / 1e9, 3),
+            "adam_moments": round(opt_bytes / 1e9, 3),
+            "gradients": round(grad_bytes / 1e9, 3),
+            "activations_est": round(act_bytes / 1e9, 3),
+            "total": round(total / 1e9, 3),
+            "hbm": round(hbm / 1e9, 1),
+        },
+        "fits": bool(total <= budget),
+        # headroom vs the SAME 0.92-budget the verdict uses — the two
+        # must never disagree in sign
+        "headroom_gb": round((budget - total) / 1e9, 3),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="llama3-8b",
+                   choices=sorted(model_registry()))
+    p.add_argument("--topology", default="v5e-16",
+                   help="slice name (sets chip count + generation)")
+    p.add_argument("--mesh", default="",
+                   help="data=1,fsdp=16,tensor=1 (default: pure FSDP "
+                        "over the whole slice)")
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--seq", type=int, default=2048)
+    args = p.parse_args()
+
+    from kubeflow_tpu.parallel.mesh import SLICE_TOPOLOGIES
+
+    topo = SLICE_TOPOLOGIES.get(args.topology)
+    if topo is None:
+        p.error(f"unknown topology {args.topology!r}; known: "
+                f"{sorted(SLICE_TOPOLOGIES)}")
+    generation = args.topology.split("-")[0]
+    if args.mesh:
+        from kubeflow_tpu.parallel.mesh import HYBRID_MESH_AXES
+        mesh_sizes = {}
+        for part in args.mesh.split(","):
+            k, _, v = part.partition("=")
+            k = k.strip()
+            if k not in HYBRID_MESH_AXES:
+                p.error(f"unknown mesh axis {k!r}; known: "
+                        f"{list(HYBRID_MESH_AXES)} (a typo here would "
+                        "silently plan an unsharded model)")
+            try:
+                mesh_sizes[k] = int(v)
+            except ValueError:
+                p.error(f"mesh axis {k}={v!r} is not an integer")
+    else:
+        mesh_sizes = {"data": 1, "fsdp": topo.chips, "tensor": 1}
+    n_mesh = math.prod(mesh_sizes.values())
+    if n_mesh != topo.chips:
+        p.error(f"mesh {mesh_sizes} has {n_mesh} devices; topology "
+                f"{args.topology} has {topo.chips} chips")
+
+    result = plan(args.model, mesh_sizes, args.batch, args.seq,
+                  generation)
+    gb = result["per_chip_gb"]
+    print(f"# {args.model} on {args.topology} mesh={mesh_sizes} "
+          f"batch={args.batch} seq={args.seq}", file=sys.stderr)
+    for k in ("params", "adam_moments", "gradients", "activations_est",
+              "total", "hbm"):
+        print(f"#   {k:>16}: {gb[k]:8.3f} GB", file=sys.stderr)
+    print(f"#   {'fits':>16}: {result['fits']} "
+          f"(headroom {result['headroom_gb']} GB)", file=sys.stderr)
+    print(json.dumps(result))
+    return 0 if result["fits"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
