@@ -36,7 +36,10 @@ log = logging.getLogger(__name__)
 STORE_KEY: web.AppKey = web.AppKey("store", Store)
 CLUSTER_ADMINS_KEY: web.AppKey = web.AppKey("cluster_admins", set)
 KFAM_KEY: web.AppKey = web.AppKey("kfam", Kfam)
-SPAWNER_CONFIG_KEY: web.AppKey = web.AppKey("spawner_config", dict)
+# dict OR a hot-reloading source with .get() -> dict
+# (platform.SpawnerConfigSource); read through
+# jupyter_app._spawner_config, not directly.
+SPAWNER_CONFIG_KEY: web.AppKey = web.AppKey("spawner_config", object)
 LINKS_KEY: web.AppKey = web.AppKey("links", object)
 PLATFORM_METRICS_KEY: web.AppKey = web.AppKey("platform_metrics", object)
 DEV_USER_KEY: web.AppKey = web.AppKey("dev_user", str)

@@ -33,6 +33,9 @@ from kubeflow_tpu.web.common import (
 def create_jupyter_app(store: Store, *, spawner_config=None,
                        cluster_admins: set[str] | None = None,
                        csrf: bool = True) -> web.Application:
+    """`spawner_config` is a dict OR a hot-reloading source (anything
+    with .get() -> dict, e.g. platform.SpawnerConfigSource wrapping the
+    mounted ConfigMap file)."""
     app = base_app(store, csrf=csrf, cluster_admins=cluster_admins)
     app[SPAWNER_CONFIG_KEY] = spawner_config or form_lib.DEFAULT_SPAWNER_CONFIG
 
@@ -46,8 +49,14 @@ def create_jupyter_app(store: Store, *, spawner_config=None,
     return app
 
 
+def _spawner_config(request: web.Request) -> dict:
+    cfg = request.app[SPAWNER_CONFIG_KEY]
+    return cfg.get() if hasattr(cfg, "get") and not isinstance(
+        cfg, dict) else cfg
+
+
 async def get_config(request: web.Request):
-    return json_success({"config": request.app[SPAWNER_CONFIG_KEY]})
+    return json_success({"config": _spawner_config(request)})
 
 
 def _summarize(store: Store, nb: Notebook) -> dict:
@@ -111,8 +120,9 @@ async def post_notebook(request: web.Request):
     store: Store = request.app[STORE_KEY]
     body = await request.json()
     body["namespace"] = ns
-    form = form_lib.parse_form(body, request.app[SPAWNER_CONFIG_KEY])
-    nb = form_lib.build_notebook(form, request.app[SPAWNER_CONFIG_KEY])
+    config = _spawner_config(request)
+    form = form_lib.parse_form(body, config)
+    nb = form_lib.build_notebook(form, config)
 
     # Selected configurations: adopt each TpuPodDefault's selector labels
     # on the pod template so the admission webhook matches it (the JWA

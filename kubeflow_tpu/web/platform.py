@@ -133,6 +133,50 @@ def load_spawner_config(path: str) -> dict | None:
     return config
 
 
+class SpawnerConfigSource:
+    """Hot-reloading spawner config: the reference's JWA re-reads the
+    mounted spawner_ui_config.yaml on every request (utils.py:22-53),
+    so an admin edits the ConfigMap and the form changes WITHOUT a
+    restart. Same behavior here, mtime-cached so the hot path is one
+    stat. A broken edit keeps serving the last good config (an admin
+    typo must not take the spawner down) and logs once per bad mtime;
+    kubelet ConfigMap updates swap a symlink, which changes the mtime."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._mtime: float | None = None
+        self._config: dict | None = None
+        self._warned_mtime: float | None = None
+        # Fail FAST on a config that is broken at startup (the pre-hot-
+        # reload behavior): "keep the last good config" needs a good
+        # config to keep — otherwise a broken rollout + pod restart
+        # would silently serve the permissive built-in defaults,
+        # lifting admin restrictions (image allowlist, readOnly pins).
+        # A MISSING file stays the documented defaults-fallback.
+        if os.path.exists(path):
+            load_spawner_config(path)  # raises on unparseable/non-dict
+
+    def get(self) -> dict:
+        from kubeflow_tpu.web import form as form_lib
+
+        try:
+            mtime = os.stat(self.path).st_mtime
+        except OSError:
+            mtime = None
+        if mtime is not None and mtime != self._mtime:
+            try:
+                self._config = load_spawner_config(self.path)
+                self._mtime = mtime
+            except Exception as e:  # noqa: BLE001 — keep the last good
+                if self._warned_mtime != mtime:
+                    import logging
+                    logging.getLogger(__name__).error(
+                        "spawner config %s unreadable (%s); keeping the "
+                        "previous config", self.path, e)
+                    self._warned_mtime = mtime
+        return self._config or form_lib.DEFAULT_SPAWNER_CONFIG
+
+
 def cluster_config_from_env(**overrides):
     """ClusterConfig honoring the reference's culler env knobs
     (culler.go:26-28: ENABLE_CULLING / CULL_IDLE_TIME minutes /
@@ -180,7 +224,8 @@ def main() -> None:  # pragma: no cover - manual entry point
                         "(local development without an auth proxy)")
     args = p.parse_args()
 
-    spawner_config = load_spawner_config(args.spawner_config)
+    spawner_config = (SpawnerConfigSource(args.spawner_config)
+                      if args.spawner_config else None)
     slices = {}
     for part in args.tpu_slices.split(","):
         k, _, v = part.partition("=")

@@ -1,6 +1,8 @@
 """Web surface tests: full HTTP round-trips against the platform app
 backed by a live cluster (the reference's KinD smoke tier, hermetic)."""
 
+import os
+
 import pytest
 from aiohttp.test_utils import TestClient, TestServer
 
@@ -324,3 +326,59 @@ async def test_notebook_detail_payload_has_events_and_gang_pods(env):
     assert isinstance(nb["events"], list)  # sorted newest-first
     for e in nb["events"]:
         assert {"type", "reason", "message", "count"} <= set(e)
+
+
+async def test_spawner_config_hot_reloads_from_mounted_file(tmp_path, loop):
+    """The reference's JWA re-reads spawner_ui_config.yaml per request
+    (utils.py:22-53): an admin edits the ConfigMap and the form changes
+    with NO restart. Broken edits keep the last good config."""
+    import yaml as _yaml
+
+    from kubeflow_tpu.web import form as form_lib
+    from kubeflow_tpu.web.platform import SpawnerConfigSource
+
+    path = tmp_path / "spawner_ui_config.yaml"
+    cfg = {**form_lib.DEFAULT_SPAWNER_CONFIG,
+           "cpu": {"value": "1.0", "limitFactor": 1.2, "readOnly": False}}
+    path.write_text(_yaml.safe_dump(cfg))
+
+    cluster = Cluster(ClusterConfig()).start()
+    app = cluster.create_web_app(
+        csrf=False, spawner_config=SpawnerConfigSource(str(path)))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        r = await client.get("/jupyter/api/config", headers=ALICE)
+        assert (await r.json())["config"]["cpu"]["value"] == "1.0"
+
+        # admin edits the mounted file: next request sees it
+        cfg["cpu"]["value"] = "2.5"
+        path.write_text(_yaml.safe_dump(cfg))
+        os.utime(path, (1e9, 2e9))  # force a distinct mtime
+        r = await client.get("/jupyter/api/config", headers=ALICE)
+        assert (await r.json())["config"]["cpu"]["value"] == "2.5"
+
+        # a broken edit must keep serving the last good config
+        path.write_text("cpu: [unclosed")  # YAML parse error
+        os.utime(path, (1e9, 3e9))
+        r = await client.get("/jupyter/api/config", headers=ALICE)
+        assert (await r.json())["config"]["cpu"]["value"] == "2.5"
+    finally:
+        await client.close()
+        cluster.stop()
+
+
+def test_spawner_config_source_fails_fast_on_broken_startup(tmp_path):
+    """Review finding: a config broken AT STARTUP must crash the
+    process (pre-hot-reload behavior) — silently serving permissive
+    defaults would lift admin restrictions. Missing file stays the
+    documented defaults-fallback."""
+    from kubeflow_tpu.web.platform import SpawnerConfigSource
+
+    bad = tmp_path / "broken.yaml"
+    bad.write_text("cpu: [unclosed")
+    with pytest.raises(Exception):
+        SpawnerConfigSource(str(bad))
+
+    missing = SpawnerConfigSource(str(tmp_path / "absent.yaml"))
+    assert missing.get()["cpu"]["value"] == "0.5"  # built-in defaults
