@@ -267,6 +267,35 @@ def contributor_lifecycle(alice: Client, admin: Client) -> None:
     assert status == 403, f"revoked contributor still authorized: {status}"
 
 
+@phase("volumes-lifecycle")
+def volumes_lifecycle(alice: Client, admin: Client) -> None:
+    """VWA parity (ref crud-web-apps/volumes): the workspace PVC from
+    notebook-creation is visible with its consumer; standalone PVC
+    create/delete round-trips; an in-use volume reports usedBy."""
+    status, out = alice.req("GET", "/volumes/api/namespaces/alice/pvcs")
+    assert status == 200, (status, out)
+    by_name = {p["name"]: p for p in out["pvcs"]}
+    ws = by_name.get("e2e-nb-workspace")
+    assert ws is not None, sorted(by_name)
+    assert "e2e-nb" in ws["usedBy"], ws
+
+    status, _ = alice.req("POST", "/volumes/api/namespaces/alice/pvcs",
+                          {"name": "scratch", "size": "10Gi",
+                           "mode": "ReadWriteOnce"})
+    assert status == 201, status
+    status, out = alice.req("GET", "/volumes/api/namespaces/alice/pvcs")
+    scratch = {p["name"]: p for p in out["pvcs"]}["scratch"]
+    assert scratch["size"] == "10Gi", scratch
+    assert scratch["usedBy"] == [], scratch
+
+    status, _ = alice.req(
+        "DELETE", "/volumes/api/namespaces/alice/pvcs/scratch")
+    assert status == 200, status
+    poll("scratch gone", lambda: "scratch" not in {
+        p["name"] for p in alice.req(
+            "GET", "/volumes/api/namespaces/alice/pvcs")[1]["pvcs"]})
+
+
 @phase("tensorboard-lifecycle")
 def tensorboard_lifecycle(alice: Client, admin: Client) -> None:
     status, out = alice.req(

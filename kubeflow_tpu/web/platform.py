@@ -152,9 +152,12 @@ class SpawnerConfigSource:
         # config to keep — otherwise a broken rollout + pod restart
         # would silently serve the permissive built-in defaults,
         # lifting admin restrictions (image allowlist, readOnly pins).
+        # The parse result SEEDS the last-good state, so even an edit
+        # that breaks before the first request keeps the startup config.
         # A MISSING file stays the documented defaults-fallback.
         if os.path.exists(path):
-            load_spawner_config(path)  # raises on unparseable/non-dict
+            self._config = load_spawner_config(path)  # raises if broken
+            self._mtime = os.stat(path).st_mtime
 
     def get(self) -> dict:
         from kubeflow_tpu.web import form as form_lib
