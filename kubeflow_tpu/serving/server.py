@@ -71,6 +71,7 @@ class Batcher:
         self.window_s = window_ms / 1000.0
         self.max_batch = max_batch
         self.calls = 0            # engine invocations (observability)
+        self.requests = 0         # submitted requests (mean batch = requests/calls)
         self._queue: asyncio.Queue = asyncio.Queue()
         self._worker: asyncio.Task | None = None
         self._inflight: list = []  # dequeued but unresolved (see close)
@@ -78,6 +79,7 @@ class Batcher:
 
     async def submit(self, tokens: list[int], max_new: int,
                      sampling: tuple) -> list[int]:
+        self.requests += 1
         if self._closed:
             raise RuntimeError("batcher is shut down")
         if self._worker is None or self._worker.done():
@@ -264,14 +266,21 @@ async def _ok(request: web.Request):
 async def list_models(request: web.Request):
     out = []
     for name, eng in request.app[ENGINES_KEY].items():
-        out.append({
+        entry = {
             "name": name,
             "family": eng.family.name,
             "max_len": eng.ec.max_len,
             "vocab_size": eng.cfg.vocab_size,
             "hidden_size": eng.cfg.hidden_size,
             "num_layers": eng.cfg.num_layers,
-        })
+        }
+        batcher = request.app[BATCHERS_KEY].get(name)
+        if batcher is not None:
+            # coalescing evidence: mean effective batch =
+            # batchedRequests / batcherCalls (loadtest asserts on it)
+            entry["batcherCalls"] = batcher.calls
+            entry["batchedRequests"] = batcher.requests
+        out.append(entry)
     return web.json_response({"models": out})
 
 
