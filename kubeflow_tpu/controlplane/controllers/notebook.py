@@ -67,6 +67,24 @@ class NotebookController(Controller):
         self.culling_check_period = culling_check_period
         self.metrics = metrics
 
+    def watch_keys(self, obj):
+        """Route an Event straight to the notebook it concerns: gang
+        pods are '<nb>-<ordinal>', the STS carries the notebook's own
+        name (ref SetupWithManager's event filtering,
+        notebook_controller.go:703-723). Without this, every event in
+        a namespace re-enqueued EVERY notebook in it — quadratic under
+        a FailedScheduling storm."""
+        if obj.kind != "Event":
+            return None
+        ns = obj.metadata.namespace
+        name = obj.involved_name
+        if obj.involved_kind == "Pod":
+            base, _, ordinal = name.rpartition("-")
+            return [(ns, base)] if base and ordinal.isdigit() else []
+        if obj.involved_kind in ("StatefulSet", "Notebook"):
+            return [(ns, name)]
+        return []  # events on kinds this controller never mirrors
+
     def reconcile(self, store: Store, namespace: str, name: str) -> Result:
         try:
             nb = store.get("Notebook", namespace, name)

@@ -55,6 +55,15 @@ class Controller:
         system-namespace source object mirrored into every namespace."""
         return obj.metadata.namespace or None
 
+    def watch_keys(self, obj: Resource) -> list[Key] | None:
+        """Precise routing for a WATCHES event: return the exact
+        primary keys it concerns (possibly empty), or None for the
+        namespace-wide fan-out. The k8s handler-mapping pattern — a
+        controller that can name the affected primaries must, or every
+        event costs an O(namespace) list + enqueue (quadratic under
+        event storms)."""
+        return None
+
 
 class _WorkQueue:
     """Dedup queue with per-key delayed re-adds (rate-limited retries)."""
@@ -190,6 +199,11 @@ class Manager:
                     if ref.kind == ctrl.KIND:
                         wq.add((obj.metadata.namespace, ref.name))
             elif obj.kind in ctrl.WATCHES:
+                keys = ctrl.watch_keys(obj)
+                if keys is not None:
+                    for key in keys:
+                        wq.add(key)
+                    continue
                 ns = ctrl.watch_fanout_namespace(obj)
                 for primary in self.store.list(ctrl.KIND, ns):
                     wq.add((primary.metadata.namespace, primary.metadata.name))

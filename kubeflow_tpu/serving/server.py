@@ -71,7 +71,7 @@ class Batcher:
         self.window_s = window_ms / 1000.0
         self.max_batch = max_batch
         self.calls = 0            # engine invocations (observability)
-        self.requests = 0         # submitted requests (mean batch = requests/calls)
+        self.requests = 0         # successfully batched requests
         self._queue: asyncio.Queue = asyncio.Queue()
         self._worker: asyncio.Task | None = None
         self._inflight: list = []  # dequeued but unresolved (see close)
@@ -79,7 +79,6 @@ class Batcher:
 
     async def submit(self, tokens: list[int], max_new: int,
                      sampling: tuple) -> list[int]:
-        self.requests += 1
         if self._closed:
             raise RuntimeError("batcher is shut down")
         if self._worker is None or self._worker.done():
@@ -177,6 +176,7 @@ class Batcher:
                 out = await asyncio.get_event_loop().run_in_executor(
                     None, run)
             self.calls += 1
+            self.requests += len(items)  # mean batch = requests/calls
             for i, (_, mn, _, fut) in enumerate(items):
                 if not fut.done():
                     fut.set_result(out[i, :mn].tolist())
@@ -277,9 +277,11 @@ async def list_models(request: web.Request):
         batcher = request.app[BATCHERS_KEY].get(name)
         if batcher is not None:
             # coalescing evidence: mean effective batch =
-            # batchedRequests / batcherCalls (loadtest asserts on it)
-            entry["batcherCalls"] = batcher.calls
-            entry["batchedRequests"] = batcher.requests
+            # batched_requests / batcher_calls. Counted at group
+            # SUCCESS, so failures can't inflate it; pinned by
+            # tests/test_serving.py, reported by serving_loadtest.py.
+            entry["batcher_calls"] = batcher.calls
+            entry["batched_requests"] = batcher.requests
         out.append(entry)
     return web.json_response({"models": out})
 

@@ -77,17 +77,17 @@ def run(clients: int, requests: int, max_new: int,
 
     try:
         deadline = time.monotonic() + 120
+        ready = False
         while time.monotonic() < deadline:
             if proc.poll() is not None:
                 break  # dead: fall through to the diagnostic raise
             try:
                 urllib.request.urlopen(f"{base}/v1/models", timeout=2)
+                ready = True
                 break
             except Exception:
                 time.sleep(0.5)
-        else:
-            proc.poll()
-        if proc.poll() is not None or time.monotonic() >= deadline:
+        if not ready:
             log.flush()
             with open(log.name) as f:
                 tail = "\n".join(f.read().splitlines()[-20:])
@@ -112,7 +112,7 @@ def run(clients: int, requests: int, max_new: int,
             with urllib.request.urlopen(f"{base}/v1/models",
                                         timeout=5) as r:
                 m = json.loads(r.read())["models"][0]
-            return m.get("batchedRequests", 0), m.get("batcherCalls", 0)
+            return m.get("batched_requests", 0), m.get("batcher_calls", 0)
 
         req0, calls0 = batcher_stats()
 

@@ -286,3 +286,31 @@ def test_status_conditions_carry_failure_reason(cluster):
     msg = next(c.message for c in nb_b.status.conditions
                if c.reason == "FailedScheduling")
     assert "capacity" in msg
+
+
+def test_event_watch_routes_to_the_involved_notebook_only():
+    """Precise WATCHES routing (runtime.watch_keys): an event about one
+    notebook's pod/STS must enqueue that notebook, never the whole
+    namespace (quadratic under FailedScheduling storms)."""
+    from kubeflow_tpu.api.core import Event
+    from kubeflow_tpu.controlplane.controllers.notebook import (
+        NotebookController,
+    )
+
+    ctrl = NotebookController()
+
+    def ev(kind, name):
+        e = Event(involved_kind=kind, involved_name=name)
+        e.metadata.namespace = "user1"
+        return e
+
+    assert ctrl.watch_keys(ev("Pod", "my-nb-3")) == [("user1", "my-nb")]
+    assert ctrl.watch_keys(ev("StatefulSet", "my-nb")) == [("user1", "my-nb")]
+    assert ctrl.watch_keys(ev("Notebook", "my-nb")) == [("user1", "my-nb")]
+    assert ctrl.watch_keys(ev("Pod", "nodigits")) == []
+    assert ctrl.watch_keys(ev("Tensorboard", "tb")) == []
+    # non-Event kinds fall back to the namespace fan-out (None)
+    from kubeflow_tpu.api.crds import Notebook
+    nb = Notebook()
+    nb.metadata.namespace = "user1"
+    assert ctrl.watch_keys(nb) is None

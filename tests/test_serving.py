@@ -337,6 +337,8 @@ async def test_dynamic_batcher_coalesces_concurrent_requests():
     batcher = app[server_lib.BATCHERS_KEY]["m"]
     got = await aio.gather(*(one(p) for p in prompts))
     assert batcher.calls == 1, batcher.calls  # coalesced, not serialized
+    assert batcher.requests == len(prompts)  # success-counted: the
+    # mean-effective-batch evidence /v1/models exposes
     for g, w in zip(got, want):
         assert g == w
     await client.close()
