@@ -1,7 +1,16 @@
 # Developer entry points (the reference drives everything through
 # per-component Makefiles; here one root Makefile covers the repo).
 
-.PHONY: test test-slow test-all e2e smoke conformance bench dryrun native
+.PHONY: test test-slow test-all e2e smoke conformance bench dryrun native verify-all
+
+verify-all:  ## the full evidence sweep, one command
+	python -m pytest tests -q -m "slow or not slow"
+	python e2e/run_e2e.py
+	python deploy/smoke.py standalone
+	python conformance/conformance.py
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+	python loadtest/loadtest.py --notebooks 200 --tpu 0
+	python loadtest/serving_loadtest.py
 
 test:        ## fast tier: compile-heavy tests deselected (<5 min)
 	python -m pytest tests -q
