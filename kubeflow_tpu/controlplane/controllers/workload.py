@@ -166,6 +166,44 @@ class StatefulSetController(Controller):
                 pass
             pods.pop(pod.metadata.name, None)
 
+        # Slice-health recovery (SURVEY §5): a TPU gang is ONE SPMD
+        # program — a single failed worker leaves every peer hung in a
+        # collective, so the gang fails AND RESTARTS as a unit. Delete
+        # every member; this same pass recreates them, the webhook
+        # re-injects worker env, and the kernel bootstrap re-forms the
+        # jax.distributed process group (coordinator restart = pod-0
+        # recreated). Exponential backoff via STS annotations bounds
+        # crash-looping workloads.
+        failed = [p for p in pods.values() if p.phase == "Failed"]
+        if want > 0 and failed:
+            import time as _time
+
+            ann = sts.metadata.annotations
+            count = int(ann.get(GANG_RESTART_COUNT_ANNOTATION, "0"))
+            last = float(ann.get(GANG_RESTART_TS_ANNOTATION, "0"))
+            backoff = min(2.0 ** count, 60.0)
+            now = _time.time()
+            if now - last < backoff:
+                return Result(requeue_after=backoff - (now - last))
+            # Record the restart BEFORE destroying anything: a Conflict
+            # here aborts cleanly (runtime retries with the gang
+            # intact); the reverse order would delete the gang and lose
+            # the count + event on the retry pass.
+            ann[GANG_RESTART_COUNT_ANNOTATION] = str(count + 1)
+            ann[GANG_RESTART_TS_ANNOTATION] = str(now)
+            sts = store.update(sts)  # Conflict -> runtime retries us
+            store.emit_event(
+                sts, "Warning", "GangRestart",
+                f"worker {failed[0].metadata.name} failed; restarting "
+                f"the whole gang (restart #{count + 1}) — a TPU gang "
+                "is one SPMD program and must re-rendezvous together")
+            for pod in list(pods.values()):
+                try:
+                    store.delete("Pod", namespace, pod.metadata.name)
+                except NotFound:
+                    pass
+                pods.pop(pod.metadata.name, None)
+
         for i in range(want):
             pod_name = f"{name}-{i}"
             if pod_name in pods:
@@ -223,9 +261,38 @@ class StatefulSetController(Controller):
             if p.phase == "Running" and p.ready
         )
         fresh = store.try_get("StatefulSet", namespace, name)
-        if fresh is not None and fresh.ready_replicas != ready:
+        if fresh is not None:
+            changed = fresh.ready_replicas != ready
             fresh.ready_replicas = ready
-            store.update(fresh)
+            f_ann = fresh.metadata.annotations
+            if (ready == want and want > 0
+                    and GANG_RESTART_COUNT_ANNOTATION in f_ann):
+                # Fully healthy again: a LATER failure deserves a fresh
+                # (fast) restart, not the accumulated backoff — but only
+                # after the gang has STAYED healthy for the current
+                # backoff window. Clearing on the same pass that
+                # restarted would reset the counter every cycle and the
+                # exponential backoff would never engage on a
+                # crash-looping workload.
+                import time as _time
+
+                r_count = int(f_ann.get(GANG_RESTART_COUNT_ANNOTATION,
+                                        "0"))
+                r_last = float(f_ann.get(GANG_RESTART_TS_ANNOTATION,
+                                         "0"))
+                stability = min(2.0 ** r_count, 60.0)
+                if _time.time() - r_last >= stability:
+                    f_ann.pop(GANG_RESTART_COUNT_ANNOTATION, None)
+                    f_ann.pop(GANG_RESTART_TS_ANNOTATION, None)
+                    changed = True
+                else:
+                    # come back to clear once the window has passed
+                    if changed:
+                        store.update(fresh)
+                    return Result(requeue_after=stability
+                                  - (_time.time() - r_last))
+            if changed:
+                store.update(fresh)
         return Result()
 
 
@@ -251,10 +318,13 @@ class DeploymentController(Controller):
         owned = store.list("Pod", namespace,
                            owner_uid=dep.metadata.uid)
         # Rolling replacement: pods from an older template are retired so
-        # a spec change (e.g. a Tensorboard's new --logdir) actually lands.
+        # a spec change (e.g. a Tensorboard's new --logdir) actually
+        # lands; FAILED pods retire the same way (restartPolicy-Always
+        # semantics — no gang coupling here, each pod restarts alone).
         stale = [
             p for p in owned
-            if p.metadata.annotations.get(TEMPLATE_HASH_ANNOTATION) != tmpl_hash
+            if p.metadata.annotations.get(TEMPLATE_HASH_ANNOTATION)
+            != tmpl_hash or p.phase == "Failed"
         ]
         for pod in stale:
             try:
@@ -306,6 +376,8 @@ class DeploymentController(Controller):
 
 
 TEMPLATE_HASH_ANNOTATION = "kubeflow-tpu.dev/template-hash"
+GANG_RESTART_COUNT_ANNOTATION = "kubeflow-tpu.dev/gang-restart-count"
+GANG_RESTART_TS_ANNOTATION = "kubeflow-tpu.dev/gang-restart-ts"
 
 
 def _template_hash(tmpl) -> str:

@@ -314,3 +314,58 @@ def test_event_watch_routes_to_the_involved_notebook_only():
     nb = Notebook()
     nb.metadata.namespace = "user1"
     assert ctrl.watch_keys(nb) is None
+
+
+def test_gang_pod_failure_restarts_the_whole_gang(cluster):
+    """Slice-health recovery (SURVEY §5): one failed worker restarts
+    the gang AS A UNIT — a TPU gang is one SPMD program; peers would
+    hang in collectives against a dead worker. New pods get fresh uids
+    (full re-rendezvous), a GangRestart event explains it, and the
+    backoff annotations reset once healthy."""
+    import time as _t
+
+    from kubeflow_tpu.controlplane.controllers.workload import (
+        GANG_RESTART_COUNT_ANNOTATION,
+    )
+
+    nb = Notebook()
+    nb.metadata.name = "gang"
+    nb.metadata.namespace = "u"
+    nb.spec.template.spec.containers.append(
+        Container(name="c", image="img"))
+    nb.spec.tpu.topology = "v5e-16"
+    cluster.store.create(nb)
+    assert cluster.wait_idle(10)
+    before = {p.metadata.name: p.metadata.uid
+              for p in cluster.store.list("Pod", "u")}
+    assert len(before) == 4
+
+    victim = cluster.store.get("Pod", "u", "gang-2")
+    victim.phase = "Failed"
+    victim.ready = False
+    cluster.store.update(victim)
+    deadline = _t.monotonic() + 10
+    while _t.monotonic() < deadline:
+        cluster.wait_idle(5)
+        pods = cluster.store.list("Pod", "u")
+        uids = {p.metadata.name: p.metadata.uid for p in pods}
+        if (len(uids) == 4
+                and all(p.phase == "Running" and p.ready for p in pods)
+                and all(uids[n] != before[n] for n in uids)):
+            break
+        _t.sleep(0.1)
+    else:
+        raise AssertionError(f"gang never restarted: {uids}")
+
+    events = cluster.store.events_for("StatefulSet", "u", "gang")
+    assert any(e.reason == "GangRestart" and "gang-2" in e.message
+               for e in events), [e.reason for e in events]
+    # healthy again -> backoff state cleared for the next incident
+    deadline = _t.monotonic() + 5
+    while _t.monotonic() < deadline:
+        sts = cluster.store.get("StatefulSet", "u", "gang")
+        if GANG_RESTART_COUNT_ANNOTATION not in sts.metadata.annotations:
+            break
+        cluster.wait_idle(2)
+        _t.sleep(0.05)
+    assert GANG_RESTART_COUNT_ANNOTATION not in sts.metadata.annotations

@@ -87,3 +87,40 @@ def test_spec_change_replaces_pod(cluster):
     assert pods and pods[0].metadata.name not in old_pods
     args = pods[0].spec.containers[0].args
     assert "--logdir=gs://bucket/v2" in args
+
+
+def test_failed_deployment_pod_is_replaced():
+    """restartPolicy-Always semantics for Deployment workloads: a
+    Failed pod retires and a fresh one takes its place (no gang
+    coupling — tensorboards restart alone)."""
+    import time as _t
+
+    from kubeflow_tpu.api.crds import Tensorboard
+    from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
+
+    with Cluster(ClusterConfig()) as c:
+        tb = Tensorboard()
+        tb.metadata.name = "tb"
+        tb.metadata.namespace = "u"
+        tb.spec.logspath = "pvc://logs/run1"
+        c.store.create(tb)
+        assert c.wait_idle(10)
+        pods = [p for p in c.store.list("Pod", "u")
+                if p.metadata.name.startswith("tb-")]
+        assert len(pods) == 1
+        old_uid = pods[0].metadata.uid
+        victim = c.store.get("Pod", "u", pods[0].metadata.name)
+        victim.phase = "Failed"
+        c.store.update(victim)
+        deadline = _t.monotonic() + 10
+        while _t.monotonic() < deadline:
+            c.wait_idle(5)
+            pods = [p for p in c.store.list("Pod", "u")
+                    if p.metadata.name.startswith("tb-")]
+            if (len(pods) == 1 and pods[0].phase == "Running"
+                    and pods[0].metadata.uid != old_uid):
+                break
+            _t.sleep(0.1)
+        else:
+            raise AssertionError(
+                [(p.metadata.name, p.phase) for p in pods])
