@@ -422,6 +422,179 @@ def first_compile_metric() -> dict:
     }
 
 
+# flash4k runs LAST: in round 4 it wedged the tunnel server so hard
+# that even a bare backend attach hung afterwards — every section
+# scheduled after it would have timed out. Ordering the known
+# wedge-risk section after all the others maximizes captured evidence.
+ALL_SECTIONS = ("train500m", "train1b", "decode", "decode-int8",
+                "flash4k")
+# Per-section wall-clock bound for the orchestrated TPU sweep. Sized
+# from measured section times (train sections ~2-4 min incl. compile,
+# decode ~2 min) with slack for tunnel weather; a section that wedges
+# (round-4 postmortem: flash4k sat 30+ min at ZERO client CPU — the
+# axon tunnel stalled server-side, which no in-process guard can catch)
+# is killed at this bound and reported as {section}[timeout].
+_SECTION_TIMEOUT_S = float(
+    os.environ.get("KFTPU_BENCH_SECTION_TIMEOUT_S", 600))
+
+
+def _sweep_for(backend: str, wanted: list[str], p) -> list[str]:
+    sweep = (list(ALL_SECTIONS) if backend == "tpu"
+             else ["train500m", "decode", "decode-int8"])
+    if wanted:
+        unavailable = [s for s in wanted if s not in sweep]
+        if unavailable:
+            p.error(f"--only entries {unavailable} need a TPU backend "
+                    f"(current: {backend})")
+        sweep = [s for s in sweep if s in wanted]
+    return sweep
+
+
+def _marker(name: str) -> dict:
+    """Zero-valued artifact entry recording a section that produced no
+    number (timeout/failed/skipped) — one shape for every such case."""
+    return {"metric": name, "value": 0.0, "unit": "error",
+            "vs_baseline": 0.0}
+
+
+def _run_section_child(section: str, backend: str,
+                       json_only: bool = False) -> tuple[str, dict]:
+    """One sweep section in a fresh interpreter under a hard timeout.
+
+    TPU chips are process-exclusive, so the orchestrating parent never
+    initializes a backend itself: each child takes the chip, emits its
+    JSON line, and releases the chip at exit. Returns (status, payload)
+    where status is "ok" | "timeout" | "failed"; payload is the parsed
+    JSON line when ok, else {}.
+    """
+    env = dict(os.environ)
+    env["KFTPU_BENCH_IN_CHILD"] = "1"
+    env["KFTPU_BENCH_BACKEND"] = backend
+    try:
+        # stderr is inherited, not captured: the child's per-section
+        # progress (# preset=... lines, XLA warnings) streams live to
+        # whoever watches the sweep, and survives for post-hoc reading
+        # when a section is slow or dies. Only stdout (the JSON line)
+        # is captured.
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO_DIR, "bench.py"),
+             "--only", section]
+            + (["--json-only"] if json_only else []),
+            env=env, cwd=_REPO_DIR, stdout=subprocess.PIPE, text=True,
+            timeout=_SECTION_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"# section {section} timed out after "
+              f"{_SECTION_TIMEOUT_S:.0f}s; killed", file=sys.stderr)
+        return "timeout", {}
+    if proc.returncode != 0:
+        print(f"# section {section} failed rc={proc.returncode}",
+              file=sys.stderr)
+        return "failed", {}
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("{"):
+            try:
+                return "ok", json.loads(line)
+            except json.JSONDecodeError:
+                continue  # some library printed a '{'-prefixed non-JSON
+    print(f"# section {section} exited 0 without a JSON line",
+          file=sys.stderr)
+    return "failed", {}
+
+
+def _chip_alive(expect: str = "tpu", timeout_s: float = 120.0) -> bool:
+    """Quick post-timeout health probe: can a fresh process attach to
+    the SAME backend the sweep is benching?
+
+    A section that wedges the tunnel server leaves the chip unreachable
+    for every later attach (observed in round 4: after flash4k hung,
+    even `jax.default_backend()` in a clean interpreter blocked past
+    3x180s probes). When this says dead, remaining sections are skipped
+    as markers instead of each burning a full section timeout. The probe
+    checks the platform NAME, not just that jax imports: a TPU plugin
+    that fails fast makes jax silently fall back to CPU, which would
+    otherwise read as "alive" and run v5e presets on the host CPU.
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('CHIP_BACKEND=' + jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return (proc.returncode == 0
+            and f"CHIP_BACKEND={expect}" in proc.stdout)
+
+
+def _orchestrate(sweep: list[str], backend: str, full_sweep: bool,
+                 json_only: bool = False) -> int:
+    """Run the TPU sweep as bounded per-section children and merge.
+
+    The headline (first) section gets one retry; if it still cannot
+    produce a number and we own the whole sweep, degrade to the CPU
+    fallback rather than exiting artifact-less. Later sections fail
+    soft into [timeout]/[failed] marker entries; a timeout that leaves
+    the chip unreachable skips the rest of the sweep as markers.
+    """
+    headline = None
+    extras: list[dict] = []
+    remaining = list(sweep)
+    while remaining:
+        section = remaining.pop(0)
+        status, payload = _run_section_child(section, backend, json_only)
+        wedged = status == "timeout" and not _chip_alive(backend)
+        if status != "ok" and headline is None and not wedged:
+            print(f"# headline section {section} {status}; retrying once",
+                  file=sys.stderr)
+            status, payload = _run_section_child(section, backend, json_only)
+            wedged = status == "timeout" and not _chip_alive(backend)
+        if wedged:
+            print("# chip unreachable after timeout; skipping remaining "
+                  f"sections {remaining}", file=sys.stderr)
+            if headline is None:
+                if full_sweep:
+                    return _reexec_cpu_fallback()
+                return 1
+            extras.append(_marker(f"{section}[timeout]"))
+            extras.extend(_marker(f"{s}[skipped-wedged-backend]")
+                          for s in remaining)
+            break
+        if status == "ok":
+            sub_extras = payload.pop("extra_metrics", [])
+            payload.pop("backend", None)
+            if headline is None:
+                headline = payload
+            else:
+                extras.append(payload)
+            extras.extend(sub_extras)
+        elif headline is None:
+            if full_sweep:
+                print(f"# headline section {section} {status} twice; "
+                      "degrading to CPU fallback", file=sys.stderr)
+                return _reexec_cpu_fallback()
+            print(f"# headline section {section} {status} twice",
+                  file=sys.stderr)
+            return 1
+        else:
+            extras.append(_marker(f"{section}[{status}]"))
+    return _emit_result(headline, extras, backend)
+
+
+def _emit_result(headline: dict | None, extras: list[dict],
+                 backend: str) -> int:
+    """Print the single-JSON-line artifact (shared by both paths, so
+    the orchestrated and in-process sweeps can never diverge in shape).
+    """
+    assert headline is not None, "empty sweep"
+    result = dict(headline)
+    result["backend"] = backend
+    if extras:
+        result["extra_metrics"] = extras
+    print(json.dumps(result))
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--only", default="",
@@ -431,32 +604,41 @@ def main() -> int:
     p.add_argument("--json-only", action="store_true")
     args = p.parse_args()
 
-    all_names = ("train500m", "train1b", "flash4k", "decode",
-                 "decode-int8")
     # Validate names BEFORE the backend probe: a typo must not cost
     # minutes of probe timeouts on a wedged host.
     wanted: list[str] = []
     if args.only:
         wanted = [s.strip() for s in args.only.split(",") if s.strip()]
-        unknown = [s for s in wanted if s not in all_names]
+        unknown = [s for s in wanted if s not in ALL_SECTIONS]
         if unknown:
             p.error(f"unknown --only entries {unknown}; known: "
-                    f"{list(all_names)}")
+                    f"{list(ALL_SECTIONS)}")
 
-    backend = resolve_backend()
-    if backend == "unavailable":
-        return _reexec_cpu_fallback()
+    in_child = bool(os.environ.get("KFTPU_BENCH_IN_CHILD"))
+    if os.environ.get("KFTPU_BENCH_CPU_FALLBACK"):
+        backend = "cpu-fallback"
+    elif in_child:
+        backend = os.environ.get("KFTPU_BENCH_BACKEND") or resolve_backend()
+    else:
+        backend = resolve_backend()
+        if backend == "unavailable":
+            return _reexec_cpu_fallback()
+        if backend == "tpu":
+            # Never bench on the TPU from this process: orchestrate
+            # bounded children so one wedged section cannot cost the
+            # artifact (and the parent stays off the exclusive chip).
+            sweep = _sweep_for(backend, wanted, p)
+            return _orchestrate(sweep, backend, full_sweep=not wanted,
+                                json_only=args.json_only)
+    sweep = _sweep_for(backend, wanted, p)
+    return _run_sweep(sweep, backend, in_child=in_child,
+                      json_only=args.json_only)
+
+
+def _run_sweep(sweep: list[str], backend: str, *, in_child: bool,
+               json_only: bool) -> int:
     on_tpu = backend == "tpu"
-    sweep = (list(all_names) if on_tpu
-             else ["train500m", "decode", "decode-int8"])
-    if wanted:
-        unavailable = [s for s in wanted if s not in sweep]
-        if unavailable:
-            p.error(f"--only entries {unavailable} need a TPU backend "
-                    f"(current: {backend})")
-        sweep = [s for s in sweep if s in wanted]
-
-    verbose = not args.json_only
+    verbose = not json_only
     headline = None
     extras: list[dict] = []
 
@@ -476,10 +658,7 @@ def main() -> int:
             if headline is None:
                 raise  # the headline itself must fail loudly
             print(f"# bench {label} FAILED: {e}", file=sys.stderr)
-            extras.append({
-                "metric": f"{label}[failed]", "value": 0.0,
-                "unit": "error", "vs_baseline": 0.0,
-            })
+            extras.append(_marker(f"{label}[failed]"))
 
     # Headline first: its first step is the process's first compile, so
     # pod-to-first-compile measures the real cold path. Even though the
@@ -491,9 +670,12 @@ def main() -> int:
         try:
             emit(bench_train(preset, verbose=verbose))
         except RuntimeError as e:
-            # backend != cpu-fallback: the fallback child must fail
-            # loudly rather than re-exec an identical child forever.
-            if (headline is None and backend != "cpu-fallback"
+            # A TPU-section child fails loudly (rc!=0) so its parent
+            # orchestrator can retry/degrade; only the top-level CPU
+            # path re-execs itself (and never from the fallback child,
+            # which would re-exec an identical child forever).
+            if (headline is None and not in_child
+                    and backend != "cpu-fallback"
                     and "backend" in str(e).lower()):
                 print(f"# in-process backend init failed after a good "
                       f"probe: {e}; re-exec'ing on CPU", file=sys.stderr)
@@ -533,13 +715,7 @@ def main() -> int:
                 "tiny", batch=2, prompt_len=8, max_new=8, max_len=32,
                 int8=True, verbose=verbose))
 
-    assert headline is not None, "empty sweep"
-    result = dict(headline)
-    result["backend"] = backend
-    if extras:
-        result["extra_metrics"] = extras
-    print(json.dumps(result))
-    return 0
+    return _emit_result(headline, extras, backend)
 
 
 if __name__ == "__main__":

@@ -95,6 +95,97 @@ def test_dryrun_full_sections_at_default_budget():
     assert "skipped_over_budget" not in out
 
 
+def test_orchestrate_merges_sections_and_fails_soft(monkeypatch, capsys):
+    """The TPU sweep runs each section in a bounded child (round-4
+    postmortem: flash4k wedged server-side for 30+ min at zero client
+    CPU — only a kill-from-outside bound can catch that). A timed-out
+    section becomes a [timeout] marker entry; ok sections merge their
+    own extra_metrics (pod-to-first-compile rides inside train500m's
+    child payload) into one artifact."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    payloads = {
+        "train500m": ("ok", {
+            "metric": "llama_train_tokens_per_sec_per_chip[bench-500m,v5e]",
+            "value": 26000.0, "unit": "tokens/s/chip",
+            "vs_baseline": 1.23, "backend": "tpu",
+            "extra_metrics": [{
+                "metric": "pod_to_first_xla_compile_seconds",
+                "value": 30.0, "unit": "s", "vs_baseline": 4.0}],
+        }),
+        "flash4k": ("timeout", {}),
+        "decode": ("ok", {
+            "metric": "serving_decode_tokens_per_sec_per_chip[x,v5e]",
+            "value": 9000.0, "unit": "tokens/s/chip", "vs_baseline": 1.0,
+            "backend": "tpu"}),
+    }
+    monkeypatch.setattr(
+        bench, "_run_section_child",
+        lambda section, backend, *a: payloads[section])
+    monkeypatch.setattr(bench, "_chip_alive", lambda *a, **k: True)
+    rc = bench._orchestrate(["train500m", "flash4k", "decode"], "tpu",
+                            full_sweep=True)
+    assert rc == 0
+    out = [ln for ln in capsys.readouterr().out.splitlines()
+           if ln.startswith("{")]
+    result = json.loads(out[-1])
+    assert result["value"] == 26000.0 and result["backend"] == "tpu"
+    metrics = [m["metric"] for m in result["extra_metrics"]]
+    assert "pod_to_first_xla_compile_seconds" in metrics
+    assert "flash4k[timeout]" in metrics
+    assert any(m.startswith("serving_decode") for m in metrics)
+
+
+def test_orchestrate_skips_rest_when_chip_wedged(monkeypatch, capsys):
+    """A section timeout that leaves the chip unreachable (round 4:
+    flash4k wedged the tunnel for every later attach) must skip the
+    remaining sections as markers, not burn a full timeout on each."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    calls = []
+
+    def fake_child(section, backend, *a):
+        calls.append(section)
+        if section == "train1b":
+            return "timeout", {}
+        return "ok", {"metric": f"m[{section}]", "value": 1.0,
+                      "unit": "u", "vs_baseline": 1.0, "backend": "tpu"}
+
+    monkeypatch.setattr(bench, "_run_section_child", fake_child)
+    monkeypatch.setattr(bench, "_chip_alive", lambda *a, **k: False)
+    rc = bench._orchestrate(
+        ["train500m", "train1b", "decode", "flash4k"], "tpu",
+        full_sweep=True)
+    assert rc == 0
+    assert calls == ["train500m", "train1b"]  # decode/flash4k never spawned
+    out = [ln for ln in capsys.readouterr().out.splitlines()
+           if ln.startswith("{")]
+    result = json.loads(out[-1])
+    metrics = [m["metric"] for m in result["extra_metrics"]]
+    assert "train1b[timeout]" in metrics
+    assert "decode[skipped-wedged-backend]" in metrics
+    assert "flash4k[skipped-wedged-backend]" in metrics
+
+
+def test_orchestrate_headline_degrades_to_cpu_fallback(monkeypatch):
+    """If the headline section cannot produce a number after a retry,
+    a full sweep degrades to the CPU fallback instead of exiting
+    artifact-less; an explicit --only subset fails honestly instead."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    calls = []
+    monkeypatch.setattr(
+        bench, "_run_section_child",
+        lambda section, backend, *a: calls.append(section) or ("failed", {}))
+    monkeypatch.setattr(bench, "_reexec_cpu_fallback", lambda: 99)
+    assert bench._orchestrate(["train500m"], "tpu", full_sweep=True) == 99
+    assert calls == ["train500m", "train500m"]  # one retry, then degrade
+    assert bench._orchestrate(["flash4k"], "tpu", full_sweep=False) == 1
+
+
 def test_resolve_backend_gives_up_cleanly(monkeypatch):
     """Unit-level: resolve_backend survives probe raise + returns the
     sentinel without touching this process's jax backend."""
