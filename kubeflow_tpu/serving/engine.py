@@ -177,29 +177,35 @@ class InferenceEngine:
         self.cfg = cfg
         self.family = family
         self.ec = engine_config
+        # Params flow through every jitted entry point as an ARGUMENT
+        # (deliberately NOT donated — self.params is reused every call).
+        # Closing over self.params would embed the whole tree into the
+        # lowered module as literal constants — at 500M params that is
+        # a ~1 GB MLIR module whose TPU compile runs past 10 minutes
+        # (measured: 75 s just to lower), vs seconds when the compiler
+        # sees only shapes.
         self._generate_jit = jax.jit(
             self._generate, static_argnames=("max_new",)
         )
 
     # -- model internals ---------------------------------------------------
 
-    def _embed(self, tokens):
+    def _embed(self, params, tokens):
         cfg = self.cfg
         # Mesh-aware (ops.embedding): a gather is fine single-chip, but a
         # sharded 256k-vocab Gemma table must contract via one-hot or the
         # SPMD partitioner replicates the full table per step.
-        x = embed_lookup(self.params["embed"], tokens, cfg.dtype)
+        x = embed_lookup(params["embed"], tokens, cfg.dtype)
         if self.family.scale_embed:
             x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.dtype)
         return x
 
-    def _head(self, x):
-        params, cfg = self.params, self.cfg
+    def _head(self, params, x):
         tied = "lm_head" not in params
         head = params["embed"].T if tied else params["lm_head"]
         return x.astype(jnp.float32) @ head.astype(jnp.float32)
 
-    def _forward_cached(self, tokens, state: DecodeState, *,
+    def _forward_cached(self, params, tokens, state: DecodeState, *,
                         prompt_mask=None, return_all: bool = False):
         """Run [b, s] tokens starting at state.length; returns
         (last-position logits [b, vocab], updated state) — or all
@@ -211,8 +217,11 @@ class InferenceEngine:
         is what the next-token logits read) — pad slots are excluded
         from every later attention and rope sees logical positions
         (slot - pad count), so a padded row computes exactly what the
-        unpadded prompt would."""
-        cfg, fam, params = self.cfg, self.family, self.params
+        unpadded prompt would.
+
+        `params` is threaded as an argument, never closed over — see
+        the constructor note on compile-time cost."""
+        cfg, fam = self.cfg, self.family
         b, s = tokens.shape
         start = state.length
         # Slot positions order the cache for causal masking; rope gets
@@ -233,7 +242,7 @@ class InferenceEngine:
             (b, self.ec.max_len))
         kv_valid = (kv_positions < (start + s)) & ~pad
 
-        x = self._embed(tokens)
+        x = self._embed(params, tokens)
 
         def layer(x, scanned):
             p, k_cache, v_cache = scanned
@@ -265,7 +274,7 @@ class InferenceEngine:
         x, (k_new, v_new) = jax.lax.scan(
             layer, x, (params["blocks"], state.k, state.v))
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-        logits = self._head(x if return_all else x[:, -1])
+        logits = self._head(params, x if return_all else x[:, -1])
         return logits, DecodeState(k_new, v_new, start + s, pad, offset)
 
     # -- public API --------------------------------------------------------
@@ -358,21 +367,21 @@ class InferenceEngine:
                 rng = jax.random.key(0)
         return sp, rng
 
-    def _prefill_sample(self, prompt, state, rng, sp: SamplingParams,
-                        prompt_mask):
+    def _prefill_sample(self, params, prompt, state, rng,
+                        sp: SamplingParams, prompt_mask):
         """Prefill + sample token #1. Shared head of generate and
         generate_stream so both follow the same rng discipline."""
         eos = self.ec.eos_token
         rng, sub = jax.random.split(rng)  # use-once key discipline
         logits, state = self._forward_cached(
-            prompt, state, prompt_mask=prompt_mask)
+            params, prompt, state, prompt_mask=prompt_mask)
         first = self._sample(logits, sub, sp)
         done = (first == eos) if eos is not None else jnp.zeros(
             first.shape, bool)
         return state, first, rng, done
 
-    def _decode_chunk(self, state, tok, rng, done, sp: SamplingParams,
-                      *, length: int):
+    def _decode_chunk(self, params, state, tok, rng, done,
+                      sp: SamplingParams, *, length: int):
         """`length` decode steps from carry. Returns the new carry and
         the [b, length] tokens. The ONE step body both entry points
         scan over — stream-vs-oneshot equality is by construction."""
@@ -381,7 +390,7 @@ class InferenceEngine:
         def step(carry, _):
             state, tok, rng, done = carry
             rng, sub = jax.random.split(rng)
-            logits, state = self._forward_cached(tok[:, None], state)
+            logits, state = self._forward_cached(params, tok[:, None], state)
             nxt = self._sample(logits, sub, sp)
             if eos is not None:
                 # Sequences past EOS emit EOS forever (static shapes —
@@ -394,12 +403,12 @@ class InferenceEngine:
             step, (state, tok, rng, done), None, length=length)
         return state, tok, rng, done, jnp.moveaxis(rest, 0, 1)
 
-    def _generate(self, prompt, state, rng, sp: SamplingParams,
+    def _generate(self, params, prompt, state, rng, sp: SamplingParams,
                   prompt_mask, *, max_new: int):
         state, first, rng, done = self._prefill_sample(
-            prompt, state, rng, sp, prompt_mask)
+            params, prompt, state, rng, sp, prompt_mask)
         state, _, _, _, rest = self._decode_chunk(
-            state, first, rng, done, sp, length=max_new - 1)
+            params, state, first, rng, done, sp, length=max_new - 1)
         toks = jnp.concatenate([first[:, None], rest], axis=1)
         return toks, state
 
@@ -425,7 +434,8 @@ class InferenceEngine:
             prompt_tokens, max_new, rng, temperature, top_k, top_p,
             prompt_mask)
         toks, _ = self._generate_jit(
-            prompt_tokens, state, rng, sp, prompt_mask, max_new=max_new)
+            self.params, prompt_tokens, state, rng, sp, prompt_mask,
+            max_new=max_new)
         return toks
 
     def _prep(self, prompt_tokens, max_new, rng, temperature, top_k,
@@ -487,7 +497,7 @@ class InferenceEngine:
 
         def _iter():
             state_, tok, rng_, done = self._prefill_jit(
-                prompt_tokens, state, rng, sp, prompt_mask)
+                self.params, prompt_tokens, state, rng, sp, prompt_mask)
             yield np.asarray(tok)[:, None]
             emitted = 1
             while emitted < max_new:
@@ -496,7 +506,7 @@ class InferenceEngine:
                     return
                 n = min(chunk, max_new - emitted)
                 state_, tok, rng_, done, rest = self._chunk_jit(
-                    state_, tok, rng_, done, sp, length=n)
+                    self.params, state_, tok, rng_, done, sp, length=n)
                 yield np.asarray(rest)
                 emitted += n
 

@@ -130,6 +130,7 @@ class SpeculativeEngine:
         sp, rng = self.target._resolve_sampling(
             temperature, top_k, top_p, rng, batch=1)
         out, stats = self._jit(
+            self.target.params, self.draft.params,
             prompt_tokens, self.target.init_state(1),
             self.draft.init_state(1), rng, sp,
             max_new=max_new, gamma=gamma)
@@ -137,16 +138,19 @@ class SpeculativeEngine:
 
     # -- the jitted propose/verify loop -----------------------------------
 
-    def _speculate(self, prompt, tstate, dstate, rng, sp: SamplingParams,
-                   *, max_new: int, gamma: int):
+    def _speculate(self, tparams, dparams, prompt, tstate, dstate, rng,
+                   sp: SamplingParams, *, max_new: int, gamma: int):
+        # Both param trees arrive as jit ARGUMENTS (engine.py note: a
+        # closed-over param tree becomes a literal in the lowered
+        # module and wrecks compile time at real model sizes).
         target, draft = self.target, self.draft
         cap = max_new + gamma  # worst case the last round overshoots
 
         # Prefill both caches; the target samples the first token.
-        tlogits, tstate = target._forward_cached(prompt, tstate)
+        tlogits, tstate = target._forward_cached(tparams, prompt, tstate)
         rng, sub = jax.random.split(rng)
         first = _draw(sub, _dist(tlogits, sp))          # [1]
-        _, dstate = draft._forward_cached(prompt, dstate)
+        _, dstate = draft._forward_cached(dparams, prompt, dstate)
 
         out = jnp.zeros((1, cap), jnp.int32)
         out = jax.lax.dynamic_update_slice(out, first[:, None], (0, 0))
@@ -160,7 +164,8 @@ class SpeculativeEngine:
             # Propose: gamma draft steps from the last emitted token.
             def dstep(c, _):
                 dstate, tok, rng = c
-                logits, dstate = draft._forward_cached(tok[:, None], dstate)
+                logits, dstate = draft._forward_cached(
+                    dparams, tok[:, None], dstate)
                 q = _dist(logits, sp)                   # [1, vocab]
                 rng, sub = jax.random.split(rng)
                 d = _draw(sub, q)                       # [1]
@@ -174,7 +179,7 @@ class SpeculativeEngine:
             # every drafted position plus the bonus position.
             tin = jnp.concatenate([last, drafted], axis=0)[None, :]
             all_logits, tstate = target._forward_cached(
-                tin, tstate, return_all=True)           # [1, gamma+1, V]
+                tparams, tin, tstate, return_all=True)  # [1, gamma+1, V]
             ps = _dist(all_logits[0], sp)               # [gamma+1, vocab]
 
             # Accept d_i with prob min(1, p_{i-1}(d_i) / q_{i-1}(d_i));
@@ -229,7 +234,7 @@ class SpeculativeEngine:
             # the write lands past the rolled-back cursor, stays invalid,
             # and is overwritten by the next round's first write.
             _, dfed = draft._forward_cached(
-                drafted[gamma - 1][None, None], dstate)
+                dparams, drafted[gamma - 1][None, None], dstate)
             dstate = DecodeState(
                 dfed.k, dfed.v,
                 jnp.where(k == gamma, dfed.length, dstate.length),

@@ -59,8 +59,8 @@ def test_quantized_engine_logits_close_and_decode_runs(params):
     prompt = jnp.asarray(
         np.random.default_rng(0).integers(0, CFG.vocab_size, (2, 12)),
         jnp.int32)
-    lf, _ = full._forward_cached(prompt, full.init_state(2))
-    lq, _ = qeng._forward_cached(prompt, qeng.init_state(2))
+    lf, _ = full._forward_cached(full.params, prompt, full.init_state(2))
+    lq, _ = qeng._forward_cached(qeng.params, prompt, qeng.init_state(2))
     lf, lq = np.asarray(lf), np.asarray(lq)
     scale = np.abs(lf).max()
     assert np.abs(lq - lf).max() < 0.05 * scale, (
