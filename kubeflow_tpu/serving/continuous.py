@@ -1,0 +1,481 @@
+"""Slot-based continuous batching: requests join and leave the decode
+batch at token boundaries.
+
+The reference's serving design is one-request-at-a-time TF-Serving
+behind an HTTP proxy (`/root/reference/docs_dev/tf_serving.md:1-60`,
+`testing/test_tf_serving.py`); its only batching lever is client-side.
+The window `Batcher` (server.py) already improves on that, but a late
+arrival still waits for the whole in-flight generation, and one short
+request in a group waits for its longest neighbor.
+
+This module is the TPU-idiomatic fix (the JetStream pattern): keep ONE
+compiled decode step over a fixed `[slots]` batch alive and make
+admission DATA, not shape —
+
+- A new request prefills alone through the engine's existing
+  `_prefill_sample` jit (one compile per power-of-two prompt bucket),
+  then its KV rows are scattered into a free slot
+  (`ContinuousEngine._insert`, slot index traced ⇒ one compile total).
+- Every decode step advances ALL slots at once at per-slot cursors
+  (`SlotState.length` is a vector where `DecodeState.length` is a
+  scalar); a request exits the moment IT hits EOS or its own max_new,
+  freeing the slot for the next arrival at the very next token.
+- Freed slots keep computing garbage — static shapes are the TPU
+  contract, and a masked-out row costs the same as the Batcher's dummy
+  rows. Decode is HBM-bound (each step reads every weight once for the
+  whole batch), so a wasted row is ~free; an idle CHIP between window
+  groups is not.
+
+Model math is shared with the engine via `engine.transformer_block`
+(norms/projections/rotary/MLP injected with this module's per-row
+scatter write + per-row masks), so the two serving paths cannot drift.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.ops.attention import dot_product_attention
+from kubeflow_tpu.ops.norms import rms_norm
+from kubeflow_tpu.ops.rotary import rope_frequencies
+from kubeflow_tpu.serving.engine import (
+    InferenceEngine,
+    SamplingParams,
+    transformer_block,
+)
+
+
+def bucket_pow2(n: int, cap: int) -> int:
+    """Round up to a power of two (>= 16), capped — bounded compile
+    shapes instead of one compile per novel length. Shared by the
+    window Batcher and the continuous engine's prefill."""
+    b = 16
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class SlotState:
+    """Per-slot KV cache + cursors, a pytree (jit-carryable).
+
+    The decode-batch analog of `engine.DecodeState`, with every cursor
+    widened to a per-slot vector: slots sit at DIFFERENT sequence
+    positions, which is the whole point of continuous batching.
+    """
+
+    def __init__(self, k, v, length, offset, pad, tok):
+        self.k = k            # [L, S, max_len, n_kv, hd]
+        self.v = v
+        self.length = length  # [S] int32 — filled cache slots per row
+        self.offset = offset  # [S] int32 — left-pad count (rope shift)
+        self.pad = pad        # [S, max_len] bool — padded cache cells
+        self.tok = tok        # [S] int32 — last sampled token per row
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.length, self.offset, self.pad,
+                self.tok), None
+
+    @classmethod
+    def tree_unflatten(cls, _, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    SlotState, SlotState.tree_flatten, SlotState.tree_unflatten
+)
+
+
+class ContinuousEngine:
+    """Device half of continuous batching for one `InferenceEngine`.
+
+    Three compiled programs, all shape-stable for the server's life:
+    prefill (per prompt bucket — the engine's own `_prefill_jit`),
+    `_insert` (slot index is traced data), and `_step` (one token for
+    all S slots). The host half (`ContinuousBatcher`) owns admission,
+    budgets, and EOS retirement — policies live in Python, tensors on
+    device.
+    """
+
+    def __init__(self, engine: InferenceEngine, max_slots: int = 8):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.engine = engine
+        self.S = max_slots
+        # KV buffers dominate serving HBM: donate the old state so step
+        # and insert update in place instead of holding two copies
+        # (same policy as the Trainer's donated TrainState).
+        self._step_jit = jax.jit(self._step, donate_argnums=(1,))
+        self._insert_jit = jax.jit(self._insert, donate_argnums=(0,))
+
+    # -- state ------------------------------------------------------------
+
+    def init_slots(self) -> SlotState:
+        cfg, ec = self.engine.cfg, self.engine.ec
+        shape = (cfg.num_layers, self.S, ec.max_len,
+                 cfg.num_kv_heads, cfg.head_dim)
+        return SlotState(
+            jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype),
+            jnp.zeros((self.S,), jnp.int32),
+            jnp.zeros((self.S,), jnp.int32),
+            jnp.zeros((self.S, ec.max_len), bool),
+            jnp.zeros((self.S,), jnp.int32),
+        )
+
+    # -- admission --------------------------------------------------------
+
+    def prefill(self, tokens: list[int], max_new: int,
+                sampling: dict[str, Any], rng: jax.Array):
+        """Run one prompt through the engine's prefill and sample its
+        first token. Returns (batch-1 DecodeState, first token [1],
+        done [1]) ready for `_insert`. Prompt length is bucketed
+        (left-pad + mask) so mixed traffic reuses a handful of
+        compiles; falls back to the EXACT length when the bucket plus
+        this request's max_new would overrun the cache (bucket pads
+        occupy cache cells, so a bucket the admission check never saw
+        could silently clamp the last decode writes otherwise)."""
+        eng = self.engine
+        cap = eng.ec.max_len
+        n = len(tokens)
+        b = bucket_pow2(n, max(cap - max_new, 0))
+        if b < n:
+            b = n
+        arr = np.zeros((1, b), np.int32)
+        mask = np.zeros((1, b), bool)
+        arr[0, b - n:] = tokens
+        mask[0, b - n:] = True
+        ec = eng.ec
+        sp, rng = eng._resolve_sampling(
+            np.asarray([sampling.get("temperature", ec.temperature)],
+                       np.float32),
+            np.asarray([sampling.get("top_k", ec.top_k)], np.int64),
+            np.asarray([sampling.get("top_p", ec.top_p)], np.float32),
+            rng, batch=1)
+        state, first, _, done = eng._prefill_jit(
+            eng.params, jnp.asarray(arr), eng.init_state(1), rng, sp,
+            jnp.asarray(mask))
+        return state, first, done
+
+    def _insert(self, st: SlotState, slot, pstate, first):
+        """Scatter a prefilled batch-1 DecodeState into slot `slot`.
+        `slot` is traced — one compile serves every slot index."""
+        k = jax.lax.dynamic_update_slice(
+            st.k, pstate.k, (0, slot, 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(
+            st.v, pstate.v, (0, slot, 0, 0, 0))
+        length = st.length.at[slot].set(pstate.length.astype(jnp.int32))
+        offset = st.offset.at[slot].set(pstate.offset[0])
+        pad = st.pad.at[slot].set(pstate.pad[0])
+        tok = st.tok.at[slot].set(first[0])
+        return SlotState(k, v, length, offset, pad, tok)
+
+    def insert(self, st: SlotState, slot: int, pstate, first) -> SlotState:
+        return self._insert_jit(st, jnp.asarray(slot, jnp.int32), pstate,
+                                first)
+
+    # -- decode -----------------------------------------------------------
+
+    def _step(self, params, st: SlotState, sp: SamplingParams, rng):
+        """One decode token for ALL slots at per-slot cursors.
+
+        Mirrors `engine._forward_cached`'s s=1 case with every scalar
+        cursor vectorized: rope positions, causal masks and cache
+        writes are per-row. Retired slots compute garbage (masked by
+        the host); their cursors clamp at max_len so a long-idle slot
+        can never scatter out of bounds.
+        """
+        eng = self.engine
+        cfg, fam, ec = eng.cfg, eng.family, eng.ec
+        S = self.S
+        rng, sub = jax.random.split(rng)
+
+        positions = st.length[:, None]                      # [S, 1]
+        rope_positions = jnp.maximum(positions - st.offset[:, None], 0)
+        inv_freq = rope_frequencies(cfg.head_dim, theta=cfg.rope_theta)
+        kv_positions = jnp.broadcast_to(
+            jnp.arange(ec.max_len, dtype=jnp.int32)[None, :],
+            (S, ec.max_len))
+        # causal q>=kv masking hides stale cells beyond each row's
+        # cursor (a reused slot's old tail); pads are never attended.
+        kv_valid = ~st.pad
+        rows = jnp.arange(S)
+        write_at = jnp.minimum(st.length, ec.max_len - 1)
+
+        x = eng._embed(params, st.tok[:, None])
+
+        def layer(x, scanned):
+            p, k_cache, v_cache = scanned
+
+            def write_kv(k, v):
+                return (
+                    k_cache.at[rows, write_at].set(
+                        k[:, 0].astype(k_cache.dtype)),
+                    v_cache.at[rows, write_at].set(
+                        v[:, 0].astype(v_cache.dtype)),
+                )
+
+            def attn(q, kc, vc):
+                return dot_product_attention(
+                    q, kc, vc, positions, kv_positions,
+                    causal=True, kv_mask=kv_valid,
+                    window=getattr(cfg, "sliding_window", None))
+
+            return transformer_block(
+                cfg, fam, p, x, rope_positions, inv_freq, write_kv, attn)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            layer, x, (params["blocks"], st.k, st.v))
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        logits = eng._head(params, x[:, -1])
+        nxt = eng._sample(logits, sub, sp)
+        st = SlotState(
+            k_new, v_new,
+            jnp.minimum(st.length + 1, ec.max_len),
+            st.offset, st.pad, nxt.astype(jnp.int32))
+        return st, nxt, rng
+
+    def step(self, st: SlotState, sp: SamplingParams, rng):
+        return self._step_jit(self.engine.params, st, sp, rng)
+
+
+class _Slot:
+    """Host-side record for one admitted request."""
+
+    __slots__ = ("fut", "out", "max_new", "queue")
+
+    def __init__(self, fut, max_new: int, queue):
+        self.fut = fut
+        self.out: list[int] = []
+        self.max_new = max_new
+        self.queue = queue  # per-request token stream (None for oneshot)
+
+
+class ContinuousBatcher:
+    """Host orchestrator: admission, per-request budgets, EOS
+    retirement. API-compatible with server.Batcher (`submit`, `close`,
+    `.calls`/`.requests` counters), so `create_serving_app` can swap it
+    in without touching the handler.
+
+    `.calls` counts decode steps and `.requests` admitted requests —
+    `requests / calls` is NOT a mean batch here; the continuous
+    analog `tokens_emitted / calls` (mean occupied slots per step) is
+    exported as `.occupancy()`.
+    """
+
+    def __init__(self, engine: InferenceEngine, gpu_lock: asyncio.Lock,
+                 *, max_slots: int = 8, window_ms: float = 0.0):
+        # window_ms accepted (and ignored) for constructor parity with
+        # Batcher: admission is per-token here, there is no window.
+        del window_ms
+        self.cengine = ContinuousEngine(engine, max_slots)
+        self.engine = engine
+        self.gpu_lock = gpu_lock
+        self.calls = 0            # decode steps (device invocations)
+        self.requests = 0         # admitted requests
+        self.tokens_emitted = 0
+        self._pending: collections.deque = collections.deque()
+        self._wake = asyncio.Event()
+        self._active: dict[int, _Slot] = {}
+        self._free = list(range(max_slots))
+        self._st: SlotState | None = None
+        # greedy filler knobs on free slots: a sampled leftover would
+        # drag an all-greedy step into the sampled branch's argsorts
+        self._temp = np.zeros(max_slots, np.float32)
+        self._topk = np.zeros(max_slots, np.int32)
+        self._topp = np.ones(max_slots, np.float32)
+        self._rng = jax.random.key(
+            int.from_bytes(os.urandom(8), "little") >> 1)
+        self._worker: asyncio.Task | None = None
+        self._closed = False
+
+    def occupancy(self) -> float:
+        return self.tokens_emitted / self.calls if self.calls else 0.0
+
+    # -- public API -------------------------------------------------------
+
+    async def submit(self, tokens: list[int], max_new: int,
+                     sampling: tuple) -> list[int]:
+        """Generate `max_new` tokens for one prompt; resolves when THIS
+        request finishes (other slots keep decoding). The result is
+        EOS-padded to exactly max_new — interchangeable with the window
+        Batcher's fixed-shape contract (a request that hits EOS early
+        stops COMPUTING early here; the pad is host-side)."""
+        fut = self._enqueue(tokens, max_new, sampling, queue=None)
+        out = await fut
+        eos = self.engine.ec.eos_token
+        if eos is not None and len(out) < max_new:
+            out = out + [eos] * (max_new - len(out))
+        return out
+
+    async def stream(self, tokens: list[int], max_new: int,
+                     sampling: tuple):
+        """Async-iterate tokens as they decode (SSE feed). The stream
+        ends at EOS or max_new; the caller owns trimming/decoding."""
+        q: asyncio.Queue = asyncio.Queue()
+        fut = self._enqueue(tokens, max_new, sampling, queue=q)
+        try:
+            while True:
+                item = await q.get()
+                if item is None:
+                    break
+                yield item
+            await fut  # surface admission/step errors after drain
+        finally:
+            # a consumer that stops iterating (client disconnect mid-
+            # SSE) must release its slot — otherwise it decodes to
+            # max_new into a dead queue and reconnect-loop clients
+            # could pin every slot
+            if not fut.done():
+                fut.cancel()
+
+    def _enqueue(self, tokens, max_new, sampling, *, queue):
+        if self._closed:
+            raise RuntimeError("batcher is shut down")
+        cap = self.engine.ec.max_len
+        if len(tokens) + max_new > cap:
+            raise ValueError(
+                f"prompt {len(tokens)} + max_new {max_new} exceeds "
+                f"model max_len {cap}")
+        if self._worker is None or self._worker.done():
+            self._worker = asyncio.get_event_loop().create_task(
+                self._run())
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending.append((tokens, max_new, dict(sampling), fut, queue))
+        self._wake.set()
+        return fut
+
+    # -- worker -----------------------------------------------------------
+
+    def _sp(self) -> SamplingParams:
+        return SamplingParams(
+            temperature=jnp.asarray(self._temp),
+            top_k=jnp.asarray(self._topk),
+            top_p=jnp.asarray(self._topp))
+
+    def _release(self, slot: int) -> None:
+        """Return a slot to the pool with greedy filler knobs (a
+        leftover sampled temperature would drag all-greedy steps into
+        the sampled branch's full-vocab argsorts)."""
+        self._active.pop(slot, None)
+        self._free.append(slot)
+        self._temp[slot], self._topk[slot], self._topp[slot] = 0, 0, 1.0
+
+    def _finish(self, slot: int, rec: _Slot) -> None:
+        self._release(slot)
+        if rec.queue is not None and not rec.fut.done():
+            rec.queue.put_nowait(None)
+        if not rec.fut.done():
+            rec.fut.set_result(rec.out[:rec.max_new])
+
+    def _emit(self, slot: int, rec: _Slot, token: int, *,
+              decode: bool = True) -> None:
+        rec.out.append(token)
+        if decode:
+            # admission-time first tokens (prefill) stay out of the
+            # occupancy numerator — calls counts decode steps only
+            self.tokens_emitted += 1
+        if rec.queue is not None and not rec.fut.done():
+            rec.queue.put_nowait(token)
+        eos = self.engine.ec.eos_token
+        if len(rec.out) >= rec.max_new or (eos is not None
+                                           and token == eos):
+            self._finish(slot, rec)
+
+    async def _admit_one(self, item) -> None:
+        tokens, max_new, sampling, fut, queue = item
+        slot = self._free.pop()
+        loop = asyncio.get_event_loop()
+        try:
+            self._rng, sub = jax.random.split(self._rng)
+            async with self.gpu_lock:
+                pstate, first, done = await loop.run_in_executor(
+                    None, self.cengine.prefill, tokens, max_new,
+                    sampling, sub)
+                if self._st is None:
+                    self._st = self.cengine.init_slots()
+                self._st = await loop.run_in_executor(
+                    None, self.cengine.insert, self._st, slot, pstate,
+                    first)
+        except Exception as e:  # noqa: BLE001 — fail THIS request only
+            self._free.append(slot)
+            if queue is not None and not fut.done():
+                queue.put_nowait(None)  # unblock a stream() consumer
+            if not fut.done():
+                fut.set_exception(e)
+            return
+        self.requests += 1
+        rec = _Slot(fut, max_new, queue)
+        self._active[slot] = rec
+        ec = self.engine.ec
+        self._temp[slot] = sampling.get("temperature", ec.temperature)
+        self._topk[slot] = sampling.get("top_k", ec.top_k)
+        self._topp[slot] = sampling.get("top_p", ec.top_p)
+        self._emit(slot, rec, int(np.asarray(first)[0]), decode=False)
+
+    async def _run(self) -> None:
+        loop = asyncio.get_event_loop()
+        while True:
+            if not self._active and not self._pending:
+                self._wake.clear()
+                await self._wake.wait()
+            # drop requests whose caller vanished before admission
+            while self._pending and self._pending[0][3].done():
+                self._pending.popleft()
+            while self._free and self._pending:
+                await self._admit_one(self._pending.popleft())
+                while self._pending and self._pending[0][3].done():
+                    self._pending.popleft()
+            if not self._active:
+                continue
+            try:
+                self._rng, sub = jax.random.split(self._rng)
+                sp = self._sp()
+                async with self.gpu_lock:
+                    st, toks, _ = await loop.run_in_executor(
+                        None, self.cengine.step, self._st, sp, sub)
+                    self._st = st
+                    toks = np.asarray(toks)
+            except Exception as e:  # noqa: BLE001 — fail active requests
+                for slot, rec in list(self._active.items()):
+                    self._release(slot)
+                    if rec.queue is not None and not rec.fut.done():
+                        rec.queue.put_nowait(None)
+                    if not rec.fut.done():
+                        rec.fut.set_exception(e)
+                self._st = None  # donated buffers may be mid-flight
+                continue
+            self.calls += 1
+            for slot, rec in list(self._active.items()):
+                if rec.fut.done():  # caller cancelled mid-decode
+                    self._finish(slot, rec)
+                    continue
+                self._emit(slot, rec, int(toks[slot]))
+            # let submissions/cancellations interleave between steps
+            await asyncio.sleep(0)
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._worker is not None:
+            self._worker.cancel()
+            try:
+                await self._worker
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        for slot, rec in list(self._active.items()):
+            self._active.pop(slot, None)
+            if rec.queue is not None and not rec.fut.done():
+                rec.queue.put_nowait(None)
+            if not rec.fut.done():
+                rec.fut.set_exception(RuntimeError("server shutting down"))
+        while self._pending:
+            *_, fut, queue = self._pending.popleft()
+            if queue is not None and not fut.done():
+                queue.put_nowait(None)
+            if not fut.done():
+                fut.set_exception(RuntimeError("server shutting down"))

@@ -164,6 +164,37 @@ jax.tree_util.register_pytree_node(
 )
 
 
+def transformer_block(cfg, fam: Family, p, x, rope_positions, inv_freq,
+                      write_kv, attn):
+    """One decoder block on `x` [b, s, h]: norms, QKV/output projections,
+    rotary, gated MLP. The KV-cache write policy and the attention call
+    are injected: prefill writes a contiguous [s]-slice at one shared
+    scalar cursor (`_forward_cached`), the continuous-batching engine
+    scatters a single step per row at per-slot cursors
+    (serving/continuous.py). Keeping every matmul/norm/activation in
+    ONE function is what makes the two serving paths provably the same
+    model — a drifted copy would silently change logits."""
+    b, s = x.shape[:2]
+    h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
+    q = (h @ p["wq"].astype(cfg.dtype)).reshape(
+        b, s, cfg.num_heads, cfg.head_dim)
+    k = (h @ p["wk"].astype(cfg.dtype)).reshape(
+        b, s, cfg.num_kv_heads, cfg.head_dim)
+    v = (h @ p["wv"].astype(cfg.dtype)).reshape(
+        b, s, cfg.num_kv_heads, cfg.head_dim)
+    q = apply_rope(q, rope_positions, inv_freq)
+    k = apply_rope(k, rope_positions, inv_freq)
+    k_cache, v_cache = write_kv(k, v)
+    out = attn(q, k_cache, v_cache)
+    x = x + out.reshape(b, s, cfg.q_dim) @ p["wo"].astype(cfg.dtype)
+
+    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
+    gate = fam.gate_act(h @ p["w_gate"].astype(cfg.dtype))
+    ff = gate * (h @ p["w_up"].astype(cfg.dtype))
+    x = x + ff @ p["w_down"].astype(cfg.dtype)
+    return x, (k_cache, v_cache)
+
+
 class InferenceEngine:
     """Batched greedy/temperature generation for a llama-family model.
 
@@ -246,30 +277,23 @@ class InferenceEngine:
 
         def layer(x, scanned):
             p, k_cache, v_cache = scanned
-            h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
-            q = (h @ p["wq"].astype(cfg.dtype)).reshape(
-                b, s, cfg.num_heads, cfg.head_dim)
-            k = (h @ p["wk"].astype(cfg.dtype)).reshape(
-                b, s, cfg.num_kv_heads, cfg.head_dim)
-            v = (h @ p["wv"].astype(cfg.dtype)).reshape(
-                b, s, cfg.num_kv_heads, cfg.head_dim)
-            q = apply_rope(q, rope_positions, inv_freq)
-            k = apply_rope(k, rope_positions, inv_freq)
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
-            attn = dot_product_attention(
-                q, k_cache, v_cache, positions, kv_positions,
-                causal=True, kv_mask=kv_valid,
-                window=getattr(cfg, "sliding_window", None))
-            x = x + attn.reshape(b, s, cfg.q_dim) @ p["wo"].astype(cfg.dtype)
 
-            h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
-            gate = fam.gate_act(h @ p["w_gate"].astype(cfg.dtype))
-            ff = gate * (h @ p["w_up"].astype(cfg.dtype))
-            x = x + ff @ p["w_down"].astype(cfg.dtype)
-            return x, (k_cache, v_cache)
+            def write_kv(k, v):
+                return (
+                    jax.lax.dynamic_update_slice(
+                        k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)),
+                    jax.lax.dynamic_update_slice(
+                        v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)),
+                )
+
+            def attn(q, kc, vc):
+                return dot_product_attention(
+                    q, kc, vc, positions, kv_positions,
+                    causal=True, kv_mask=kv_valid,
+                    window=getattr(cfg, "sliding_window", None))
+
+            return transformer_block(
+                cfg, fam, p, x, rope_positions, inv_freq, write_kv, attn)
 
         x, (k_new, v_new) = jax.lax.scan(
             layer, x, (params["blocks"], state.k, state.v))

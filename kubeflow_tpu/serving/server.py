@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from aiohttp import web
 
+from kubeflow_tpu.serving.continuous import ContinuousBatcher, bucket_pow2
 from kubeflow_tpu.serving.engine import InferenceEngine
 from kubeflow_tpu.serving.speculative import SpeculativeEngine
 
@@ -121,14 +122,10 @@ class Batcher:
                 await self._run_group(sub)
             self._inflight = []
 
-    @staticmethod
-    def _bucket(n: int, cap: int) -> int:
-        """Round up to a power of two (>= 16), capped: bounded compile
-        shapes instead of one compile per novel (longest, max_new)."""
-        b = 16
-        while b < n:
-            b *= 2
-        return min(b, cap)
+    # Round up to a power of two (>= 16), capped: bounded compile
+    # shapes instead of one compile per novel (longest, max_new).
+    # One definition (continuous.bucket_pow2) serves both batchers.
+    _bucket = staticmethod(bucket_pow2)
 
     async def _run_group(self, items: list) -> None:
         cap = self.engine.ec.max_len
@@ -208,16 +205,20 @@ class Batcher:
 
 def create_serving_app(engines: dict[str, InferenceEngine],
                        *, tokenizer=None, batch_window_ms: float = 0.0,
-                       max_batch: int = 8,
+                       max_batch: int = 8, continuous: bool = False,
                        drafts: dict[str, InferenceEngine] | None = None,
                        ) -> web.Application:
     """`tokenizer` (data.bpe.Tokenizer or anything with encode/decode)
     serves the "text" request mode; without one, the zero-training
     byte-level fallback applies. `batch_window_ms > 0` enables dynamic
     request batching: concurrent single-prompt requests within the
-    window run as one padded batch per sampling group. `drafts` maps
-    model names to draft engines; a request with "speculative": true
-    then decodes through SpeculativeEngine (latency lever; batch 1)."""
+    window run as one padded batch per sampling group.
+    `continuous=True` upgrades batching to slot-based continuous
+    batching (serving/continuous.py): requests join/leave a persistent
+    `max_batch`-slot decode batch at token boundaries — no window, no
+    waiting for a group's longest member. `drafts` maps model names to
+    draft engines; a request with "speculative": true then decodes
+    through SpeculativeEngine (latency lever; batch 1)."""
     app = web.Application()
     app[ENGINES_KEY] = engines
     unknown = set(drafts or {}) - set(engines)
@@ -241,11 +242,16 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     # and interleaved generate calls would just thrash compile caches.
     lock = asyncio.Lock()
     app[GPU_LOCK_KEY] = lock
-    app[BATCHERS_KEY] = (
-        {name: Batcher(eng, lock, window_ms=batch_window_ms,
-                       max_batch=max_batch)
-         for name, eng in engines.items()}
-        if batch_window_ms > 0 else {})
+    if continuous:
+        app[BATCHERS_KEY] = {
+            name: ContinuousBatcher(eng, lock, max_slots=max_batch)
+            for name, eng in engines.items()}
+    else:
+        app[BATCHERS_KEY] = (
+            {name: Batcher(eng, lock, window_ms=batch_window_ms,
+                           max_batch=max_batch)
+             for name, eng in engines.items()}
+            if batch_window_ms > 0 else {})
 
     async def _close_batchers(app_):
         for b in app_[BATCHERS_KEY].values():
@@ -276,12 +282,19 @@ async def list_models(request: web.Request):
         }
         batcher = request.app[BATCHERS_KEY].get(name)
         if batcher is not None:
-            # coalescing evidence: mean effective batch =
-            # batched_requests / batcher_calls. Counted at group
-            # SUCCESS, so failures can't inflate it; pinned by
-            # tests/test_serving.py, reported by serving_loadtest.py.
+            # coalescing evidence: for the window Batcher, mean
+            # effective batch = batched_requests / batcher_calls
+            # (counted at group SUCCESS, so failures can't inflate it;
+            # pinned by tests/test_serving.py). For the continuous
+            # batcher, calls = decode steps and the analog is
+            # occupancy = tokens emitted per step.
             entry["batcher_calls"] = batcher.calls
             entry["batched_requests"] = batcher.requests
+            if isinstance(batcher, ContinuousBatcher):
+                entry["batcher_mode"] = "continuous"
+                entry["occupancy"] = round(batcher.occupancy(), 3)
+            else:
+                entry["batcher_mode"] = "window"
         out.append(entry)
     return web.json_response({"models": out})
 
@@ -338,6 +351,37 @@ async def _stream_generate(request, engine, arr, max_new, sampling,
     }
     if text_mode and chunks:
         ids = np.concatenate(chunks, axis=1)[0].tolist()
+        final["text"] = (tokenizer.decode(ids) if tokenizer
+                         else byte_decode(ids))
+    await resp.write(b"data: " + _json.dumps(final).encode() + b"\n\n")
+    await resp.write_eof()
+    return resp
+
+
+async def _stream_continuous(request, batcher, arr, max_new, sampling,
+                             text_mode, tokenizer):
+    """SSE token streaming through the continuous batcher: one event
+    per decoded token (`data: {"tokens": [[t]]}`), then the same final
+    `{"done": true, ...}` record as `_stream_generate`. Concurrent
+    streams SHARE the slot batch — each consumer awaits only its own
+    tokens, never the GPU lock (the batcher's worker owns that)."""
+    import json as _json
+
+    resp = web.StreamResponse(headers={
+        "Content-Type": "text/event-stream",
+        "Cache-Control": "no-cache",
+        "X-Accel-Buffering": "no",
+    })
+    await resp.prepare(request)
+    ids: list[int] = []
+    async for tok in batcher.stream(
+            arr[0].tolist(), max_new, tuple(sorted(sampling.items()))):
+        ids.append(tok)
+        await resp.write(
+            b"data: " + _json.dumps({"tokens": [[tok]]}).encode()
+            + b"\n\n")
+    final: dict[str, Any] = {"done": True, "total": len(ids)}
+    if text_mode and ids:
         final["text"] = (tokenizer.decode(ids) if tokenizer
                          else byte_decode(ids))
     await resp.write(b"data: " + _json.dumps(final).encode() + b"\n\n")
@@ -459,6 +503,13 @@ async def generate(request: web.Request):
             return web.json_response(
                 {"error": "stream does not compose with speculative"},
                 status=400)
+        cbatcher = request.app[BATCHERS_KEY].get(name)
+        if isinstance(cbatcher, ContinuousBatcher) and arr.shape[0] == 1:
+            # a continuous-batched stream shares the slot batch with
+            # every other request instead of holding the GPU per chunk
+            return await _stream_continuous(
+                request, cbatcher, arr, max_new_req, sampling,
+                text_mode, tokenizer)
         return await _stream_generate(
             request, engine, arr, max_new_req, sampling, text_mode,
             tokenizer)

@@ -1,0 +1,287 @@
+"""Continuous batching: slot engine + host orchestrator + REST surface.
+
+Oracle throughout: `engine.generate` batch-1 greedy (itself pinned to
+full-recompute in test_serving.py). The head is sharpened (*50) so
+argmax cannot flip between batch-1 and batch-S reduction orders —
+the same hazard the window-Batcher tests guard against.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+pytest_plugins = ("aiohttp.pytest_plugin",)
+
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.serving import EngineConfig, InferenceEngine, LLAMA_FAMILY
+from kubeflow_tpu.serving import server as server_lib
+from kubeflow_tpu.serving.continuous import (
+    ContinuousBatcher, ContinuousEngine, bucket_pow2,
+)
+
+
+def _engine(eos=None, max_len=64):
+    cfg = llama.LLAMA_TINY
+    params = dict(llama.init(jax.random.key(0), cfg))
+    params["lm_head"] = params["lm_head"] * 50.0
+    return InferenceEngine(
+        params, cfg, LLAMA_FAMILY,
+        EngineConfig(max_len=max_len, eos_token=eos)), cfg
+
+
+def _solo(engine, prompt, max_new):
+    return np.asarray(engine.generate(
+        jnp.asarray([prompt], jnp.int32), max_new=max_new))[0].tolist()
+
+
+def test_bucket_pow2():
+    assert bucket_pow2(3, 64) == 16
+    assert bucket_pow2(16, 64) == 16
+    assert bucket_pow2(17, 64) == 32
+    assert bucket_pow2(100, 64) == 64
+
+
+def test_slot_step_matches_generate_mixed_cursors():
+    """Device-level check, no asyncio: three prompts of different
+    lengths admitted into different slots decode EXACTLY their solo
+    greedy continuations, in one shared step batch whose per-slot
+    cursors differ (the thing DecodeState's scalar cursor cannot do)."""
+    engine, cfg = _engine()
+    ce = ContinuousEngine(engine, max_slots=4)
+    rng = jax.random.key(7)
+    gen = np.random.default_rng(3)
+    prompts = [gen.integers(0, cfg.vocab_size, n).tolist()
+               for n in (4, 9, 17)]
+    max_new = 6
+    want = [_solo(engine, p, max_new) for p in prompts]
+
+    st = ce.init_slots()
+    got = [[] for _ in prompts]
+    for i, p in enumerate(prompts):
+        pstate, first, _ = ce.prefill(p, max_new, {}, rng)
+        st = ce.insert(st, i, pstate, first)
+        got[i].append(int(np.asarray(first)[0]))
+    sp = engine._resolve_sampling(
+        np.zeros(4, np.float32), np.zeros(4, np.int64),
+        np.ones(4, np.float32), rng, batch=4)[0]
+    for _ in range(max_new - 1):
+        st, toks, rng = ce.step(st, sp, rng)
+        toks = np.asarray(toks)
+        for i in range(len(prompts)):
+            got[i].append(int(toks[i]))
+    assert got == want
+
+
+async def test_batcher_concurrent_requests_match_solo():
+    engine, cfg = _engine()
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=4)
+    gen = np.random.default_rng(4)
+    prompts = [gen.integers(0, cfg.vocab_size, n).tolist()
+               for n in (4, 7, 12, 20)]
+    want = [_solo(engine, p, 5) for p in prompts]
+    got = await asyncio.gather(
+        *(batcher.submit(p, 5, ()) for p in prompts))
+    assert list(got) == want
+    assert batcher.requests == 4
+    # shared steps: 4 requests x 5 tokens each needed only 4 decode
+    # steps (token #1 comes from prefill), not 4 x 4
+    assert batcher.calls <= 8, batcher.calls
+    assert batcher.occupancy() > 1.0
+    await batcher.close()
+
+
+async def test_late_arrival_joins_midflight():
+    """A request submitted while another decodes joins at the next
+    token boundary instead of waiting for the first to finish — total
+    steps stay well under the serial sum."""
+    engine, cfg = _engine()
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=4)
+    gen = np.random.default_rng(5)
+    a = gen.integers(0, cfg.vocab_size, 5).tolist()
+    b = gen.integers(0, cfg.vocab_size, 8).tolist()
+    want_a, want_b = _solo(engine, a, 12), _solo(engine, b, 4)
+
+    task_a = asyncio.ensure_future(batcher.submit(a, 12, ()))
+    while batcher.calls < 3:  # a is mid-decode
+        await asyncio.sleep(0.005)
+    assert not task_a.done()
+    got_b = await batcher.submit(b, 4, ())
+    got_a = await task_a
+    assert got_a == want_a and got_b == want_b
+    # serial would need (12-1) + (4-1) = 14 steps; joined runs share
+    assert batcher.calls < 14, batcher.calls
+    await batcher.close()
+
+
+async def test_eos_retires_slot_early_and_pads_result():
+    engine0, cfg = _engine()
+    gen = np.random.default_rng(6)
+    p = gen.integers(0, cfg.vocab_size, 6).tolist()
+    ref = _solo(engine0, p, 6)
+    eos = ref[2]  # greedy hits this at step 3
+    engine, _ = _engine(eos=eos)
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2)
+    got = await batcher.submit(p, 6, ())
+    # window-Batcher parity: EOS-padded to exactly max_new
+    assert got == ref[:3] + [eos] * 3
+    # the slot retired after 2 decode steps, not 5
+    assert batcher.calls <= 3, batcher.calls
+    # slot is reusable afterwards
+    q = gen.integers(0, cfg.vocab_size, 4).tolist()
+    got_q = await batcher.submit(q, 4, ())
+    want_q = _solo(engine, q, 4)
+    assert got_q == want_q
+    await batcher.close()
+
+
+async def test_slot_reuse_leaks_nothing():
+    """More requests than slots, varied lengths: every result must
+    equal its solo run even though slots are reused with stale KV,
+    stale pads and saturated cursors left behind."""
+    engine, cfg = _engine()
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2)
+    gen = np.random.default_rng(7)
+    prompts = [gen.integers(0, cfg.vocab_size, n).tolist()
+               for n in (20, 3, 9, 17, 5, 11)]
+    want = [_solo(engine, p, 4) for p in prompts]
+    got = await asyncio.gather(
+        *(batcher.submit(p, 4, ()) for p in prompts))
+    assert list(got) == want
+    await batcher.close()
+
+
+async def test_greedy_rows_exact_next_to_sampled_rows():
+    """Per-slot sampling knobs: a temperature row in the batch must not
+    perturb its greedy neighbors (the _sample cond selects per row)."""
+    engine, cfg = _engine()
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=4)
+    gen = np.random.default_rng(8)
+    g1 = gen.integers(0, cfg.vocab_size, 5).tolist()
+    g2 = gen.integers(0, cfg.vocab_size, 9).tolist()
+    s1 = gen.integers(0, cfg.vocab_size, 7).tolist()
+    want1, want2 = _solo(engine, g1, 6), _solo(engine, g2, 6)
+    r1, r2, rs = await asyncio.gather(
+        batcher.submit(g1, 6, ()),
+        batcher.submit(g2, 6, ()),
+        batcher.submit(s1, 6, (("temperature", 0.9), ("top_k", 5))))
+    assert r1 == want1 and r2 == want2
+    assert len(rs) == 6
+    assert all(0 <= t < cfg.vocab_size for t in rs)
+    await batcher.close()
+
+
+async def test_rest_oneshot_and_models_card():
+    engine, cfg = _engine()
+    app = server_lib.create_serving_app(
+        {"m": engine}, continuous=True, max_batch=4)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    gen = np.random.default_rng(9)
+    prompts = [gen.integers(0, cfg.vocab_size, n).tolist()
+               for n in (4, 7, 11)]
+    want = [_solo(engine, p, 5) for p in prompts]
+
+    async def one(p):
+        r = await client.post("/v1/models/m:generate",
+                              json={"tokens": [p], "max_new": 5})
+        assert r.status == 200, await r.text()
+        return (await r.json())["tokens"][0]
+
+    got = await asyncio.gather(*(one(p) for p in prompts))
+    for g, w in zip(got, want):
+        assert g == w
+    r = await client.get("/v1/models")
+    card = (await r.json())["models"][0]
+    assert card["batcher_mode"] == "continuous"
+    assert card["batched_requests"] == 3
+    assert card["occupancy"] > 0
+    await client.close()
+
+
+async def test_rest_sse_stream_rides_the_slot_batch():
+    engine, cfg = _engine()
+    app = server_lib.create_serving_app(
+        {"m": engine}, continuous=True, max_batch=4)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    gen = np.random.default_rng(10)
+    p = gen.integers(0, cfg.vocab_size, 6).tolist()
+    want = _solo(engine, p, 7)
+
+    resp = await client.post(
+        "/v1/models/m:generate",
+        json={"tokens": [p], "max_new": 7, "stream": True})
+    assert resp.status == 200
+    assert resp.headers["Content-Type"].startswith("text/event-stream")
+    import json as _json
+    toks, final = [], None
+    async for line in resp.content:
+        line = line.strip()
+        if not line.startswith(b"data: "):
+            continue
+        obj = _json.loads(line[6:])
+        if obj.get("done"):
+            final = obj
+        else:
+            toks.extend(obj["tokens"][0])
+    assert toks == want
+    assert final is not None and final["total"] == 7
+    await client.close()
+
+
+async def test_prefill_bucket_never_overruns_cache():
+    """A legal request whose power-of-two prompt bucket + max_new
+    would overrun the cache must fall back to the exact prompt length
+    and still decode correctly (silent clamped-write corruption
+    otherwise — the admission check never sees the bucket)."""
+    engine, cfg = _engine()  # max_len = 64
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2)
+    p = np.random.default_rng(11).integers(
+        0, cfg.vocab_size, 5).tolist()
+    # bucket(5) = 16; 16 + 55 = 71 > 64, but 5 + 55 = 60 fits
+    want = _solo(engine, p, 55)
+    got = await batcher.submit(p, 55, ())
+    assert got == want
+    await batcher.close()
+
+
+async def test_abandoned_stream_releases_slot():
+    """A consumer that stops iterating (SSE client disconnect) must
+    free its slot instead of decoding to max_new into a dead queue."""
+    engine, cfg = _engine()
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2)
+    p = np.random.default_rng(12).integers(
+        0, cfg.vocab_size, 6).tolist()
+    agen = batcher.stream(p, 40, ())
+    got = []
+    async for tok in agen:
+        got.append(tok)
+        if len(got) == 3:
+            break
+    await agen.aclose()
+    for _ in range(200):
+        if not batcher._active:
+            break
+        await asyncio.sleep(0.005)
+    assert not batcher._active
+    # the slot retired long before the 39 decode steps max_new implies
+    assert batcher.calls < 30, batcher.calls
+    # and the pool still serves new work
+    q = np.random.default_rng(13).integers(
+        0, cfg.vocab_size, 4).tolist()
+    assert await batcher.submit(q, 4, ()) == _solo(engine, q, 4)
+    await batcher.close()
+
+
+async def test_submit_capacity_and_shutdown():
+    engine, cfg = _engine(max_len=32)
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2)
+    with pytest.raises(ValueError, match="exceeds"):
+        batcher._enqueue(list(range(30)), 8, (), queue=None)
+    await batcher.close()
+    with pytest.raises(RuntimeError, match="shut down"):
+        await batcher.submit([1, 2, 3], 4, ())
