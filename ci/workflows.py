@@ -41,7 +41,8 @@ COMPONENTS: dict[str, dict[str, Any]] = {
     "serving": {
         "paths": ["kubeflow_tpu/serving/**"],
         "tests": ("python -m pytest tests/test_serving.py "
-                  "tests/test_speculative.py tests/test_quant.py -q"),
+                  "tests/test_speculative.py tests/test_quant.py "
+                  "tests/test_continuous.py -q"),
     },
     "native": {
         "paths": ["native/**", "kubeflow_tpu/data/**"],

@@ -111,7 +111,8 @@ class ContinuousEngine:
         # KV buffers dominate serving HBM: donate the old state so step
         # and insert update in place instead of holding two copies
         # (same policy as the Trainer's donated TrainState).
-        self._step_jit = jax.jit(self._step, donate_argnums=(1,))
+        self._step_jit = jax.jit(self._step, donate_argnums=(1,),
+                                 static_argnames=("steps",))
         self._insert_jit = jax.jit(self._insert, donate_argnums=(0,))
 
     # -- state ------------------------------------------------------------
@@ -130,58 +131,105 @@ class ContinuousEngine:
 
     # -- admission --------------------------------------------------------
 
-    def prefill(self, tokens: list[int], max_new: int,
-                sampling: dict[str, Any], rng: jax.Array):
-        """Run one prompt through the engine's prefill and sample its
-        first token. Returns (batch-1 DecodeState, first token [1],
-        done [1]) ready for `_insert`. Prompt length is bucketed
-        (left-pad + mask) so mixed traffic reuses a handful of
-        compiles; falls back to the EXACT length when the bucket plus
-        this request's max_new would overrun the cache (bucket pads
-        occupy cache cells, so a bucket the admission check never saw
-        could silently clamp the last decode writes otherwise)."""
+    def bucket_for(self, n_tokens: int, max_new: int) -> int:
+        """Prefill bucket for one request: power-of-two, falling back
+        to the EXACT length when the bucket plus this request's
+        max_new would overrun the cache (bucket pads occupy cache
+        cells, so a bucket the admission check never saw could
+        silently clamp the last decode writes otherwise)."""
+        cap = self.engine.ec.max_len
+        b = bucket_pow2(n_tokens, max(cap - max_new, 0))
+        return b if b >= n_tokens else n_tokens
+
+    def prefill_batch(self, token_lists: list[list[int]], bucket: int,
+                      samplings: list[dict[str, Any]], rng: jax.Array):
+        """Prefill g prompts sharing one bucket in a single dispatch
+        and sample each prompt's first token. Returns (batch-g
+        DecodeState, first tokens [g]) ready for `insert_row`.
+        Batching admissions matters under load: per-request prefill
+        dispatch is the continuous design's other overhead tax next to
+        per-token stepping."""
         eng = self.engine
-        cap = eng.ec.max_len
-        n = len(tokens)
-        b = bucket_pow2(n, max(cap - max_new, 0))
-        if b < n:
-            b = n
-        arr = np.zeros((1, b), np.int32)
-        mask = np.zeros((1, b), bool)
-        arr[0, b - n:] = tokens
-        mask[0, b - n:] = True
+        g = len(token_lists)
+        arr = np.zeros((g, bucket), np.int32)
+        mask = np.zeros((g, bucket), bool)
+        for i, toks in enumerate(token_lists):
+            arr[i, bucket - len(toks):] = toks
+            mask[i, bucket - len(toks):] = True
         ec = eng.ec
         sp, rng = eng._resolve_sampling(
-            np.asarray([sampling.get("temperature", ec.temperature)],
-                       np.float32),
-            np.asarray([sampling.get("top_k", ec.top_k)], np.int64),
-            np.asarray([sampling.get("top_p", ec.top_p)], np.float32),
-            rng, batch=1)
+            np.asarray([s.get("temperature", ec.temperature)
+                        for s in samplings], np.float32),
+            np.asarray([s.get("top_k", ec.top_k)
+                        for s in samplings], np.int64),
+            np.asarray([s.get("top_p", ec.top_p)
+                        for s in samplings], np.float32),
+            rng, batch=g)
         state, first, _, done = eng._prefill_jit(
-            eng.params, jnp.asarray(arr), eng.init_state(1), rng, sp,
+            eng.params, jnp.asarray(arr), eng.init_state(g), rng, sp,
             jnp.asarray(mask))
         return state, first, done
 
-    def _insert(self, st: SlotState, slot, pstate, first):
-        """Scatter a prefilled batch-1 DecodeState into slot `slot`.
-        `slot` is traced — one compile serves every slot index."""
+    def prefill(self, tokens: list[int], max_new: int,
+                sampling: dict[str, Any], rng: jax.Array):
+        """Single-request admission (the g=1 case of prefill_batch)."""
+        return self.prefill_batch(
+            [tokens], self.bucket_for(len(tokens), max_new),
+            [sampling], rng)
+
+    def _insert(self, st: SlotState, slot, pstate, row, first):
+        """Scatter row `row` of a prefilled batch-g DecodeState into
+        slot `slot`. Both indices are traced — one compile per prefill
+        batch size g serves every (slot, row) combination."""
+        prow = jax.lax.dynamic_slice_in_dim(pstate.k, row, 1, axis=1)
         k = jax.lax.dynamic_update_slice(
-            st.k, pstate.k, (0, slot, 0, 0, 0))
+            st.k, prow, (0, slot, 0, 0, 0))
+        vrow = jax.lax.dynamic_slice_in_dim(pstate.v, row, 1, axis=1)
         v = jax.lax.dynamic_update_slice(
-            st.v, pstate.v, (0, slot, 0, 0, 0))
+            st.v, vrow, (0, slot, 0, 0, 0))
         length = st.length.at[slot].set(pstate.length.astype(jnp.int32))
-        offset = st.offset.at[slot].set(pstate.offset[0])
-        pad = st.pad.at[slot].set(pstate.pad[0])
-        tok = st.tok.at[slot].set(first[0])
+        offset = st.offset.at[slot].set(pstate.offset[row])
+        pad = st.pad.at[slot].set(pstate.pad[row])
+        tok = st.tok.at[slot].set(first[row])
         return SlotState(k, v, length, offset, pad, tok)
 
-    def insert(self, st: SlotState, slot: int, pstate, first) -> SlotState:
+    def insert(self, st: SlotState, slot: int, pstate, first,
+               row: int = 0) -> SlotState:
         return self._insert_jit(st, jnp.asarray(slot, jnp.int32), pstate,
-                                first)
+                                jnp.asarray(row, jnp.int32), first)
+
+    def warmup(self, buckets=(16,), step_sizes=(1,)) -> int:
+        """Compile the serving shape set ahead of traffic: prefill and
+        insert for every power-of-two group size x prompt bucket, and
+        the decode step for every chunk size. The continuous design's
+        whole point is that this set is BOUNDED and shape-stable for
+        the server's life — warming it turns first-arrival compile
+        stalls into startup cost (readiness gates on it). Returns the
+        number of programs warmed."""
+        eng = self.engine
+        rng = jax.random.key(0)
+        st = self.init_slots()
+        sp = eng._resolve_sampling(
+            np.zeros(self.S, np.float32), np.zeros(self.S, np.int64),
+            np.ones(self.S, np.float32), rng, batch=self.S)[0]
+        n = 0
+        g = 1
+        greedy = {"temperature": 0.0, "top_k": 0, "top_p": 1.0}
+        while g <= self.S:
+            for b in buckets:
+                pstate, first, _ = self.prefill_batch(
+                    [[0]] * g, b, [greedy] * g, rng)
+                st = self.insert(st, 0, pstate, first, 0)
+                n += 2
+            g *= 2
+        for steps in step_sizes:
+            st, _, rng = self.step(st, sp, rng, steps)
+            n += 1
+        return n
 
     # -- decode -----------------------------------------------------------
 
-    def _step(self, params, st: SlotState, sp: SamplingParams, rng):
+    def _decode_one(self, params, st: SlotState, sp: SamplingParams, rng):
         """One decode token for ALL slots at per-slot cursors.
 
         Mirrors `engine._forward_cached`'s s=1 case with every scalar
@@ -240,8 +288,29 @@ class ContinuousEngine:
             st.offset, st.pad, nxt.astype(jnp.int32))
         return st, nxt, rng
 
-    def step(self, st: SlotState, sp: SamplingParams, rng):
-        return self._step_jit(self.engine.params, st, sp, rng)
+    def _step(self, params, st: SlotState, sp: SamplingParams, rng, *,
+              steps: int):
+        """`steps` decode tokens for all slots in ONE dispatch (a
+        lax.scan over `_decode_one`). Chunking amortizes per-token host
+        dispatch when no admission is waiting; the host drops back to
+        steps=1 while requests queue so a retiring slot frees at the
+        next token. The token sequence is IDENTICAL either way — the
+        scan body is the single-step program, and retirement only
+        changes what the host keeps, never what the device computes."""
+
+        def body(carry, _):
+            st, rng = carry
+            st, tok, rng = self._decode_one(params, st, sp, rng)
+            return (st, rng), tok
+
+        (st, rng), toks = jax.lax.scan(
+            body, (st, rng), None, length=steps)
+        return st, jnp.moveaxis(toks, 0, 1), rng  # [S, steps]
+
+    def step(self, st: SlotState, sp: SamplingParams, rng,
+             steps: int = 1):
+        return self._step_jit(self.engine.params, st, sp, rng,
+                              steps=steps)
 
 
 class _Slot:
@@ -269,10 +338,20 @@ class ContinuousBatcher:
     """
 
     def __init__(self, engine: InferenceEngine, gpu_lock: asyncio.Lock,
-                 *, max_slots: int = 8, window_ms: float = 0.0):
+                 *, max_slots: int = 8, chunk: int = 4,
+                 window_ms: float = 0.0):
         # window_ms accepted (and ignored) for constructor parity with
         # Batcher: admission is per-token here, there is no window.
         del window_ms
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        # The worker decodes up to `chunk` tokens per dispatch (one
+        # scanned program) — per-token host dispatch is the continuous
+        # design's overhead tax. Admission happens between dispatches,
+        # so a queued request waits at most chunk-1 tokens — still far
+        # under a window group's full-generation wait. Compiles stay
+        # bounded: one program per steps value in [1, chunk].
+        self.chunk = chunk
         self.cengine = ContinuousEngine(engine, max_slots)
         self.engine = engine
         self.gpu_lock = gpu_lock
@@ -296,6 +375,13 @@ class ContinuousBatcher:
 
     def occupancy(self) -> float:
         return self.tokens_emitted / self.calls if self.calls else 0.0
+
+    def warmup(self, buckets=(16,)) -> int:
+        """Blocking ahead-of-traffic compile of the full shape set
+        (call before serving traffic; the app's on_startup hook does
+        when create_serving_app(warmup=True))."""
+        return self.cengine.warmup(
+            buckets=buckets, step_sizes=range(1, self.chunk + 1))
 
     # -- public API -------------------------------------------------------
 
@@ -387,36 +473,71 @@ class ContinuousBatcher:
                                            and token == eos):
             self._finish(slot, rec)
 
-    async def _admit_one(self, item) -> None:
-        tokens, max_new, sampling, fut, queue = item
-        slot = self._free.pop()
+    @staticmethod
+    def _fail(fut, queue, exc) -> None:
+        if queue is not None and not fut.done():
+            queue.put_nowait(None)  # unblock a stream() consumer
+        if not fut.done():
+            fut.set_exception(exc)
+
+    async def _admit_group(self, items: list) -> None:
+        """Admit up to len(self._free) requests; items sharing a
+        prefill bucket share ONE prefill dispatch. A prefill failure
+        fails its bucket group only; an insert failure fails that
+        request only."""
         loop = asyncio.get_event_loop()
-        try:
+        groups: dict[int, list] = {}
+        for item in items:
+            b = self.cengine.bucket_for(len(item[0]), item[1])
+            groups.setdefault(b, []).append(item)
+        for b, group in groups.items():
             self._rng, sub = jax.random.split(self._rng)
-            async with self.gpu_lock:
-                pstate, first, done = await loop.run_in_executor(
-                    None, self.cengine.prefill, tokens, max_new,
-                    sampling, sub)
-                if self._st is None:
-                    self._st = self.cengine.init_slots()
-                self._st = await loop.run_in_executor(
-                    None, self.cengine.insert, self._st, slot, pstate,
-                    first)
-        except Exception as e:  # noqa: BLE001 — fail THIS request only
-            self._free.append(slot)
-            if queue is not None and not fut.done():
-                queue.put_nowait(None)  # unblock a stream() consumer
-            if not fut.done():
-                fut.set_exception(e)
-            return
-        self.requests += 1
-        rec = _Slot(fut, max_new, queue)
-        self._active[slot] = rec
-        ec = self.engine.ec
-        self._temp[slot] = sampling.get("temperature", ec.temperature)
-        self._topk[slot] = sampling.get("top_k", ec.top_k)
-        self._topp[slot] = sampling.get("top_p", ec.top_p)
-        self._emit(slot, rec, int(np.asarray(first)[0]), decode=False)
+            # pad the group to a power of two with greedy dummy rows:
+            # prefill/insert shapes come from a SET of log2(max_slots)
+            # sizes instead of one compile per novel group size (the
+            # same row bucketing the window Batcher does)
+            gp = 1
+            while gp < len(group):
+                gp *= 2
+            lists = [it[0] for it in group] + [[0]] * (gp - len(group))
+            samps = ([it[2] for it in group]
+                     + [{"temperature": 0.0, "top_k": 0, "top_p": 1.0}]
+                     * (gp - len(group)))
+            try:
+                async with self.gpu_lock:
+                    pstate, first, _ = await loop.run_in_executor(
+                        None, self.cengine.prefill_batch,
+                        lists, b, samps, sub)
+            except Exception as e:  # noqa: BLE001
+                for *_, fut, queue in group:
+                    self._fail(fut, queue, e)
+                continue
+            firsts = np.asarray(first)
+            for row, (tokens, max_new, sampling, fut, queue) in \
+                    enumerate(group):
+                if fut.done():  # cancelled while prefilling
+                    continue
+                slot = self._free.pop()
+                try:
+                    if self._st is None:
+                        self._st = self.cengine.init_slots()
+                    async with self.gpu_lock:
+                        self._st = await loop.run_in_executor(
+                            None, self.cengine.insert, self._st, slot,
+                            pstate, first, row)
+                except Exception as e:  # noqa: BLE001
+                    self._free.append(slot)
+                    self._fail(fut, queue, e)
+                    continue
+                self.requests += 1
+                rec = _Slot(fut, max_new, queue)
+                self._active[slot] = rec
+                ec = self.engine.ec
+                self._temp[slot] = sampling.get(
+                    "temperature", ec.temperature)
+                self._topk[slot] = sampling.get("top_k", ec.top_k)
+                self._topp[slot] = sampling.get("top_p", ec.top_p)
+                self._emit(slot, rec, int(firsts[row]), decode=False)
 
     async def _run(self) -> None:
         loop = asyncio.get_event_loop()
@@ -424,21 +545,31 @@ class ContinuousBatcher:
             if not self._active and not self._pending:
                 self._wake.clear()
                 await self._wake.wait()
-            # drop requests whose caller vanished before admission
-            while self._pending and self._pending[0][3].done():
-                self._pending.popleft()
-            while self._free and self._pending:
-                await self._admit_one(self._pending.popleft())
-                while self._pending and self._pending[0][3].done():
-                    self._pending.popleft()
+            # admit up to the free-slot count; dead futures are skipped
+            if self._free and self._pending:
+                take: list = []
+                while self._pending and len(take) < len(self._free):
+                    item = self._pending.popleft()
+                    if not item[3].done():
+                        take.append(item)
+                if take:
+                    await self._admit_group(take)
             if not self._active:
                 continue
+            # never decode past the longest remaining budget (tail
+            # steps would be pure garbage for every slot); queued
+            # arrivals wait at most chunk-1 tokens for a free slot
+            steps = min(self.chunk,
+                        max(rec.max_new - len(rec.out)
+                            for rec in self._active.values()))
+            steps = max(steps, 1)
             try:
                 self._rng, sub = jax.random.split(self._rng)
                 sp = self._sp()
                 async with self.gpu_lock:
                     st, toks, _ = await loop.run_in_executor(
-                        None, self.cengine.step, self._st, sp, sub)
+                        None, self.cengine.step, self._st, sp, sub,
+                        steps)
                     self._st = st
                     toks = np.asarray(toks)
             except Exception as e:  # noqa: BLE001 — fail active requests
@@ -450,12 +581,15 @@ class ContinuousBatcher:
                         rec.fut.set_exception(e)
                 self._st = None  # donated buffers may be mid-flight
                 continue
-            self.calls += 1
+            self.calls += steps
             for slot, rec in list(self._active.items()):
                 if rec.fut.done():  # caller cancelled mid-decode
                     self._finish(slot, rec)
                     continue
-                self._emit(slot, rec, int(toks[slot]))
+                for j in range(steps):
+                    self._emit(slot, rec, int(toks[slot, j]))
+                    if slot not in self._active:
+                        break  # retired mid-chunk; tail is trimmed
             # let submissions/cancellations interleave between steps
             await asyncio.sleep(0)
 

@@ -206,6 +206,7 @@ class Batcher:
 def create_serving_app(engines: dict[str, InferenceEngine],
                        *, tokenizer=None, batch_window_ms: float = 0.0,
                        max_batch: int = 8, continuous: bool = False,
+                       warmup: bool = False,
                        drafts: dict[str, InferenceEngine] | None = None,
                        ) -> web.Application:
     """`tokenizer` (data.bpe.Tokenizer or anything with encode/decode)
@@ -216,9 +217,12 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     `continuous=True` upgrades batching to slot-based continuous
     batching (serving/continuous.py): requests join/leave a persistent
     `max_batch`-slot decode batch at token boundaries — no window, no
-    waiting for a group's longest member. `drafts` maps model names to
-    draft engines; a request with "speculative": true then decodes
-    through SpeculativeEngine (latency lever; batch 1)."""
+    waiting for a group's longest member. `warmup=True` (continuous
+    only) compiles the bounded serving shape set in on_startup, so
+    readiness implies no first-arrival compile stalls — startup takes
+    correspondingly longer. `drafts` maps model names to draft
+    engines; a request with "speculative": true then decodes through
+    SpeculativeEngine (latency lever; batch 1)."""
     app = web.Application()
     app[ENGINES_KEY] = engines
     unknown = set(drafts or {}) - set(engines)
@@ -246,6 +250,13 @@ def create_serving_app(engines: dict[str, InferenceEngine],
         app[BATCHERS_KEY] = {
             name: ContinuousBatcher(eng, lock, max_slots=max_batch)
             for name, eng in engines.items()}
+        if warmup:
+            async def _warm(app_):
+                loop = asyncio.get_event_loop()
+                for b in app_[BATCHERS_KEY].values():
+                    await loop.run_in_executor(None, b.warmup)
+
+            app.on_startup.append(_warm)
     else:
         app[BATCHERS_KEY] = (
             {name: Batcher(eng, lock, window_ms=batch_window_ms,

@@ -8,6 +8,13 @@ and the coalescing evidence (mean effective batch), one JSON line
 (machine-readable like bench.py / loadtest.py).
 
     python loadtest/serving_loadtest.py --clients 16 --requests 96
+    python loadtest/serving_loadtest.py --mode continuous
+
+`--mode continuous` swaps the window Batcher for slot-based continuous
+batching (serving/continuous.py) — same clients, same requests, so the
+two JSON lines are directly comparable; its coalescing evidence is
+occupancy (mean occupied slots per decode step) instead of mean
+effective batch.
 
 Hermetic by default (tiny model, CPU): the number is a CONTROL-PLANE
 number (batching, HTTP, queueing) — model throughput on hardware is
@@ -49,13 +56,15 @@ from kubeflow_tpu.serving import server as srv
 cfg = llama.LLAMA_TINY
 params = llama.init(jax.random.key(0), cfg)
 eng = InferenceEngine(params, cfg, LLAMA_FAMILY, EngineConfig(max_len=128))
-app = srv.create_serving_app({{"tiny": eng}}, batch_window_ms={window_ms})
+app = srv.create_serving_app({{"tiny": eng}}, batch_window_ms={window_ms},
+                             continuous={continuous}, warmup={continuous})
 web.run_app(app, host="127.0.0.1", port={port}, print=None)
 '''
 
 
 def run(clients: int, requests: int, max_new: int,
-        window_ms: int) -> dict:
+        window_ms: int, mode: str = "window",
+        spread: bool = False) -> dict:
     import tempfile
 
     port = free_port()
@@ -63,7 +72,8 @@ def run(clients: int, requests: int, max_new: int,
         mode="w+", suffix=".log", prefix="kftpu-srvload-", delete=False)
     proc = subprocess.Popen(
         [sys.executable, "-c",
-         SERVER_CODE.format(repo=REPO, port=port, window_ms=window_ms)],
+         SERVER_CODE.format(repo=REPO, port=port, window_ms=window_ms,
+                            continuous=(mode == "continuous"))],
         stdout=log, stderr=subprocess.STDOUT)
     base = f"http://127.0.0.1:{port}"
 
@@ -108,48 +118,81 @@ def run(clients: int, requests: int, max_new: int,
             for _ in range(3):
                 list(ex.map(warm, range(clients)))
 
-        def batcher_stats() -> tuple[int, int]:
+        def batcher_stats() -> tuple[int, int, float]:
             with urllib.request.urlopen(f"{base}/v1/models",
                                         timeout=5) as r:
                 m = json.loads(r.read())["models"][0]
-            return m.get("batched_requests", 0), m.get("batcher_calls", 0)
+            return (m.get("batched_requests", 0),
+                    m.get("batcher_calls", 0),
+                    m.get("occupancy", 0.0))
 
-        req0, calls0 = batcher_stats()
+        req0, calls0, occ0 = batcher_stats()
 
         latencies: list[float] = []
+
+        def ask(i: int) -> int:
+            """Per-request max_new: uniform, or (--spread) cycling
+            1/4x..1x so short and long requests coexist — the workload
+            where continuous batching's early-exit matters (a window
+            group runs every member to the group max)."""
+            if not spread:
+                return max_new
+            return max(1, max_new * (1 + i % 4) // 4)
 
         def one(i: int) -> float:
             t0 = time.perf_counter()
             out = post({"tokens": [[1 + i % 7, 2, 3, 4]],
-                        "max_new": max_new})
-            assert len(out["tokens"][0]) == max_new, out
+                        "max_new": ask(i)})
+            assert len(out["tokens"][0]) == ask(i), out
             return time.perf_counter() - t0
 
         t0 = time.perf_counter()
         with concurrent.futures.ThreadPoolExecutor(clients) as ex:
             latencies = list(ex.map(one, range(requests)))
         wall = time.perf_counter() - t0
+        total_tokens = sum(ask(i) for i in range(requests))
+        # per-ask-size medians (spread mode): the fairness evidence —
+        # a short ask coalesced into a window group pays the group's
+        # longest member; continuous retires it at its own max_new
+        by_ask: dict[int, list[float]] = {}
+        for i, lat in enumerate(latencies):
+            by_ask.setdefault(ask(i), []).append(lat)
+        p50_by_ask = {k: round(statistics.median(v), 3)
+                      for k, v in sorted(by_ask.items())}
 
-        req1, calls1 = batcher_stats()
+        req1, calls1, occ1 = batcher_stats()
         d_req, d_calls = req1 - req0, calls1 - calls0
         latencies.sort()
         q = statistics.quantiles(latencies, n=20)
-        return {
+        out = {
             "metric": "serving_rest_throughput",
+            "mode": mode,
             "clients": clients,
             "requests": requests,
             "max_new": max_new,
+            "spread": spread,
             "batch_window_ms": window_ms,
             "requests_per_sec": round(requests / wall, 2),
-            "tokens_per_sec": round(requests * max_new / wall, 1),
+            "tokens_per_sec": round(total_tokens / wall, 1),
             "p50_s": round(q[9], 3),
             "p95_s": round(q[18], 3),
             "wall_s": round(wall, 2),
+        }
+        if spread:
+            out["p50_by_max_new"] = p50_by_ask
+        if mode == "continuous":
+            # occupancy over the TIMED window: /v1/models exposes the
+            # cumulative ratio, so recover per-window tokens from
+            # occ*calls at each end
+            toks = occ1 * calls1 - occ0 * calls0
+            out["occupancy"] = (round(toks / d_calls, 2)
+                                if d_calls else 0.0)
+        else:
             # coalescing evidence: >1 proves the batcher actually
             # merged concurrent requests during the timed window
-            "mean_effective_batch": (round(d_req / d_calls, 2)
-                                     if d_calls else 0.0),
-        }
+            out["mean_effective_batch"] = (round(d_req / d_calls, 2)
+                                           if d_calls else 0.0)
+        return out
     finally:
         log.close()
         os.unlink(log.name)
@@ -167,11 +210,16 @@ def main() -> int:
     p.add_argument("--requests", type=int, default=96)
     p.add_argument("--max-new", type=int, default=16)
     p.add_argument("--batch-window-ms", type=int, default=5)
+    p.add_argument("--mode", choices=("window", "continuous"),
+                   default="window")
+    p.add_argument("--spread", action="store_true",
+                   help="per-request max_new cycles 1/4x..1x of "
+                        "--max-new (heterogeneous workload)")
     args = p.parse_args()
     if args.requests < 2:
         p.error("--requests must be >= 2 (latency quantiles)")
     result = run(args.clients, args.requests, args.max_new,
-                 args.batch_window_ms)
+                 args.batch_window_ms, args.mode, args.spread)
     print(json.dumps(result))
     return 0
 

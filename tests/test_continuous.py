@@ -70,9 +70,30 @@ def test_slot_step_matches_generate_mixed_cursors():
         np.ones(4, np.float32), rng, batch=4)[0]
     for _ in range(max_new - 1):
         st, toks, rng = ce.step(st, sp, rng)
-        toks = np.asarray(toks)
+        toks = np.asarray(toks)       # [slots, 1]
         for i in range(len(prompts)):
-            got[i].append(int(toks[i]))
+            got[i].append(int(toks[i, 0]))
+    assert got == want
+
+
+def test_chunked_steps_emit_identical_tokens():
+    """steps=3 is one scanned dispatch of the SAME per-step program:
+    the emitted tokens must equal three steps=1 calls."""
+    engine, cfg = _engine()
+    ce = ContinuousEngine(engine, max_slots=2)
+    rng = jax.random.key(11)
+    p = np.random.default_rng(14).integers(
+        0, cfg.vocab_size, 7).tolist()
+    want = _solo(engine, p, 7)
+    pstate, first, _ = ce.prefill(p, 7, {}, rng)
+    st = ce.insert(ce.init_slots(), 0, pstate, first)
+    sp = engine._resolve_sampling(
+        np.zeros(2, np.float32), np.zeros(2, np.int64),
+        np.ones(2, np.float32), rng, batch=2)[0]
+    st, toks, rng = ce.step(st, sp, rng, steps=3)
+    got = [int(np.asarray(first)[0])] + np.asarray(toks)[0].tolist()
+    st, toks, rng = ce.step(st, sp, rng, steps=3)
+    got += np.asarray(toks)[0].tolist()
     assert got == want
 
 
@@ -124,7 +145,10 @@ async def test_eos_retires_slot_early_and_pads_result():
     ref = _solo(engine0, p, 6)
     eos = ref[2]  # greedy hits this at step 3
     engine, _ = _engine(eos=eos)
-    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2)
+    # chunk=1: this test pins PER-TOKEN retirement; chunked retirement
+    # (at chunk boundaries) is covered by the identity test above
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2,
+                                chunk=1)
     got = await batcher.submit(p, 6, ())
     # window-Batcher parity: EOS-padded to exactly max_new
     assert got == ref[:3] + [eos] * 3
