@@ -45,16 +45,20 @@ def write_shard(path: str, tokens: np.ndarray) -> None:
 
 
 def ensure_built() -> bool:
-    """Build libktdata.so if missing; returns availability."""
+    """Build libktdata.so if missing OR stale vs its source; returns
+    availability. The staleness check matters: loading a .so built
+    before an ABI change (e.g. kt_loader_open gaining start_ticket)
+    would read garbage arguments instead of failing loudly."""
     global _build_failed
-    if os.path.exists(_LIB_PATH):
-        return True
     if _build_failed:
         return False
     src = os.path.join(_NATIVE_DIR, "dataloader.cpp")
     if not os.path.exists(src):
-        _build_failed = True
-        return False
+        _build_failed = not os.path.exists(_LIB_PATH)
+        return not _build_failed
+    if (os.path.exists(_LIB_PATH)
+            and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src)):
+        return True
     try:
         subprocess.run(
             ["make", "-C", _NATIVE_DIR, "libktdata.so"],
@@ -77,7 +81,7 @@ def _load_lib() -> ctypes.CDLL | None:
     lib.kt_loader_open.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
     ]
     lib.kt_loader_next.restype = ctypes.c_int
     lib.kt_loader_next.argtypes = [ctypes.c_void_p,
@@ -95,11 +99,17 @@ def native_available() -> bool:
 
 
 class TokenShardLoader:
-    """Native loader handle. Iterate with next_batch() -> [b, seq+1] i32."""
+    """Native loader handle. Iterate with next_batch() -> [b, seq+1] i32.
+
+    `start_ticket`/`state_dict()` are the checkpoint/resume pair:
+    batches are pure functions of a dense ticket, so persisting the
+    ticket alongside the TrainState (Checkpointer's data_state item)
+    and reopening at it reproduces the uninterrupted batch stream."""
 
     def __init__(self, paths: Sequence[str], *, batch: int, seq: int,
                  seed: int = 0, host: int = 0, n_hosts: int = 1,
-                 prefetch: int = 4, threads: int = 2):
+                 prefetch: int = 4, threads: int = 2,
+                 start_ticket: int = 0):
         lib = _load_lib()
         if lib is None:
             raise RuntimeError(
@@ -107,11 +117,15 @@ class TokenShardLoader:
                 "PyTokenLoader or open_loader()")
         self._lib = lib
         self.batch, self.seq = batch, seq
+        if start_ticket < 0:
+            raise ValueError(f"start_ticket must be >= 0, got "
+                             f"{start_ticket}")
+        self.ticket = start_ticket  # batches consumed since ticket 0
         c_paths = (ctypes.c_char_p * len(paths))(
             *[p.encode() for p in paths])
         self._h = lib.kt_loader_open(
             c_paths, len(paths), batch, seq, seed, host, n_hosts,
-            prefetch, threads)
+            prefetch, threads, start_ticket)
         if not self._h:
             raise ValueError(
                 f"kt_loader_open: {lib.kt_last_error().decode()}")
@@ -119,6 +133,9 @@ class TokenShardLoader:
     @property
     def n_windows(self) -> int:
         return int(self._lib.kt_loader_n_windows(self._h))
+
+    def state_dict(self) -> dict:
+        return {"ticket": self.ticket}
 
     def next_batch(self) -> np.ndarray:
         # Fresh buffer per call: the C side memcpys straight into it —
@@ -128,6 +145,7 @@ class TokenShardLoader:
             self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
         if rc != 0:
             raise RuntimeError("loader closed")
+        self.ticket += 1
         return out
 
     def close(self) -> None:
@@ -166,9 +184,12 @@ class PyTokenLoader:
 
     def __init__(self, paths: Sequence[str], *, batch: int, seq: int,
                  seed: int = 0, host: int = 0, n_hosts: int = 1,
-                 **_ignored):
+                 start_ticket: int = 0, **_ignored):
         if not paths or batch < 1 or seq < 1 or not (0 <= host < n_hosts):
             raise ValueError("invalid arguments")
+        if start_ticket < 0:
+            raise ValueError(f"start_ticket must be >= 0, got "
+                             f"{start_ticket}")
         self.batch, self.seq = batch, seq
         self.seed, self.host, self.n_hosts = seed, host, n_hosts
         self._shards: list[np.ndarray] = []
@@ -191,9 +212,12 @@ class PyTokenLoader:
         self._batches_per_epoch = self.n_windows // batch
         if self._batches_per_epoch == 0:
             raise ValueError("not enough windows for one batch")
-        self._ticket = 0
+        self.ticket = start_ticket
         self._cached_epoch = -1
         self._order: np.ndarray | None = None
+
+    def state_dict(self) -> dict:
+        return {"ticket": self.ticket}
 
     def _window(self, global_w: int) -> np.ndarray:
         si = 0
@@ -205,9 +229,9 @@ class PyTokenLoader:
         return self._shards[si][start:start + self.seq + 1]
 
     def next_batch(self) -> np.ndarray:
-        epoch = self._ticket // self._batches_per_epoch
-        b = self._ticket % self._batches_per_epoch
-        self._ticket += 1
+        epoch = self.ticket // self._batches_per_epoch
+        b = self.ticket % self._batches_per_epoch
+        self.ticket += 1
         if epoch != self._cached_epoch:
             perm = _lcg_shuffle(self._total_windows, self.seed, epoch)
             self._order = perm[self.host::self.n_hosts]

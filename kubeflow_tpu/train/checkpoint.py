@@ -27,6 +27,7 @@ from kubeflow_tpu.train.trainer import Trainer, TrainState
 
 STATE_ITEM = "state"
 META_ITEM = "run_metadata"
+DATA_ITEM = "data_state"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,25 +64,32 @@ class Checkpointer:
         )
         self._mgr = ocp.CheckpointManager(
             config.directory, options=opts,
-            item_names=(STATE_ITEM, META_ITEM),
+            item_names=(STATE_ITEM, META_ITEM, DATA_ITEM),
         )
 
     # -- save ------------------------------------------------------------
 
-    def save(self, state: TrainState, *, force: bool = False) -> bool:
+    def save(self, state: TrainState, *, force: bool = False,
+             data_state: Mapping[str, Any] | None = None) -> bool:
+        """`data_state` rides along as a JSON item — pass the loader's
+        `state_dict()` (the batch ticket) so a resumed run continues
+        the EXACT batch stream instead of restarting the epoch (the
+        loaders' start_ticket kwarg is the other half)."""
         step = int(jax.device_get(state.step))
         return self._mgr.save(
             step,
             args=ocp.args.Composite(**{
                 STATE_ITEM: ocp.args.StandardSave(_to_tree(state)),
                 META_ITEM: ocp.args.JsonSave(self.run_metadata),
+                DATA_ITEM: ocp.args.JsonSave(dict(data_state or {})),
             }),
             force=force,
         )
 
-    def maybe_save(self, state: TrainState) -> bool:
+    def maybe_save(self, state: TrainState, *,
+                   data_state: Mapping[str, Any] | None = None) -> bool:
         """Save iff the manager's save_interval policy says so."""
-        return self.save(state, force=False)
+        return self.save(state, force=False, data_state=data_state)
 
     # -- restore ---------------------------------------------------------
 
@@ -114,15 +122,33 @@ class Checkpointer:
         )
         return _from_tree(restored[STATE_ITEM])
 
-    def restore_metadata(self, step: int | None = None) -> dict[str, Any]:
+    def _restore_json_item(self, item: str, step: int | None,
+                           *, missing_ok: bool) -> dict[str, Any]:
+        """Shared step resolution + single-JSON-item restore for the
+        metadata and data-state side channels. `missing_ok` absorbs a
+        checkpoint written before the item existed."""
         if step is None:
             step = self.latest_step()
         if step is None:
             return {}
-        restored = self._mgr.restore(
-            step, args=ocp.args.Composite(**{META_ITEM: ocp.args.JsonRestore()})
-        )
-        return dict(restored[META_ITEM] or {})
+        try:
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(**{item: ocp.args.JsonRestore()}),
+            )
+        except (FileNotFoundError, KeyError, ValueError):
+            if missing_ok:
+                return {}
+            raise
+        return dict(restored[item] or {})
+
+    def restore_metadata(self, step: int | None = None) -> dict[str, Any]:
+        return self._restore_json_item(META_ITEM, step, missing_ok=False)
+
+    def restore_data_state(self, step: int | None = None) -> dict[str, Any]:
+        """The loader position saved beside `step` ({} when the
+        checkpoint predates data-state tracking or none was passed)."""
+        return self._restore_json_item(DATA_ITEM, step, missing_ok=True)
 
     def restore_or_init(self, rng: jax.Array) -> TrainState:
         """The resume entry point: latest checkpoint if present, else init."""

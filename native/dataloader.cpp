@@ -16,11 +16,17 @@
 // Shard format ("KTSH"): magic u32 | version u32 | n_tokens u64 | i32[].
 // C ABI (ctypes-consumed, no pybind11 per environment constraints):
 //   kt_loader_open(paths, n_paths, batch, seq, seed, host, n_hosts,
-//                  prefetch, threads) -> handle (0 on error)
+//                  prefetch, threads, start_ticket) -> handle (0 on error)
 //   kt_loader_next(handle, out) -> 0 ok / -1 bad handle
 //   kt_loader_n_windows(handle) -> total windows visible to this host
 //   kt_loader_close(handle)
 //   kt_last_error() -> const char* (thread-local message)
+//
+// start_ticket is the resume cursor: batches are pure functions of a
+// dense ticket (epoch = ticket / batches_per_epoch, order from the
+// seeded per-epoch shuffle), so a loader opened at ticket k emits
+// exactly the stream a fresh loader emits after k next() calls —
+// checkpoint/resume restores the data position without replaying.
 
 #include <atomic>
 #include <condition_variable>
@@ -69,14 +75,17 @@ struct Lcg {
 class Loader {
  public:
   Loader(std::vector<Shard> shards, int batch, int seq, uint64_t seed,
-         int host, int n_hosts, int prefetch, int threads)
+         int host, int n_hosts, int prefetch, int threads,
+         uint64_t start_ticket)
       : shards_(std::move(shards)),
         batch_(batch),
         seq_(seq),
         seed_(seed),
         host_(host),
         n_hosts_(n_hosts),
-        prefetch_(prefetch < 1 ? 1 : prefetch) {
+        prefetch_(prefetch < 1 ? 1 : prefetch),
+        next_ticket_(start_ticket),
+        next_emit_(start_ticket) {
     // Windows never cross shard boundaries; global index = shard-major.
     uint64_t cum = 0;
     for (auto& s : shards_) {
@@ -254,7 +263,7 @@ extern "C" {
 
 void* kt_loader_open(const char** paths, int n_paths, int batch, int seq,
                      uint64_t seed, int host, int n_hosts, int prefetch,
-                     int threads) {
+                     int threads, uint64_t start_ticket) {
   if (n_paths < 1 || batch < 1 || seq < 1 || n_hosts < 1 || host < 0 ||
       host >= n_hosts) {
     g_last_error = "invalid arguments";
@@ -268,7 +277,7 @@ void* kt_loader_open(const char** paths, int n_paths, int batch, int seq,
     }
   }
   auto* loader = new Loader(std::move(shards), batch, seq, seed, host,
-                            n_hosts, prefetch, threads);
+                            n_hosts, prefetch, threads, start_ticket);
   if (loader->batches_per_epoch() == 0) {
     g_last_error = "not enough windows for one batch";
     delete loader;

@@ -105,3 +105,73 @@ def test_restore_or_init_and_interval(trainer, tmp_path):
     assert int(resumed.step) == 4
     ckpt.close()
     ckpt2.close()
+
+
+@pytest.mark.slow
+def test_data_state_resume_reproduces_uninterrupted_run(trainer, tmp_path):
+    """The full crash/resume story: TrainState AND loader ticket ride
+    one checkpoint, and the resumed run's params are bit-identical to
+    a run that never stopped — the data stream continues mid-epoch
+    instead of restarting it."""
+    from kubeflow_tpu.data import loader as dl
+
+    shard = str(tmp_path / "s.ktsh")
+    rng = np.random.default_rng(5)
+    dl.write_shard(
+        shard,
+        rng.integers(0, llama.LLAMA_TINY.vocab_size, 16 * 60 + 1)
+        .astype(np.int32))
+
+    def loader(start=0):
+        return dl.PyTokenLoader([shard], batch=8, seq=16, seed=3,
+                                start_ticket=start)
+
+    def steps(state, ld, n):
+        for _ in range(n):
+            b = jnp.asarray(ld.next_batch())
+            state, _ = trainer.step(state, b[:, :-1], b[:, 1:])
+        return state
+
+    # reference: 6 uninterrupted steps
+    ref = steps(trainer.init(jax.random.key(3)), loader(), 6)
+
+    # interrupted twin: 3 steps, checkpoint WITH the loader ticket
+    ckpt = Checkpointer(
+        CheckpointConfig(str(tmp_path / "c3"), save_interval_steps=1,
+                         enable_async=False), trainer)
+    ld = loader()
+    state = steps(trainer.init(jax.random.key(3)), ld, 3)
+    assert ckpt.save(state, force=True, data_state=ld.state_dict())
+    ckpt.wait()
+
+    # "new process": restore both halves, continue 3 more steps
+    ckpt2 = Checkpointer(
+        CheckpointConfig(str(tmp_path / "c3"), enable_async=False),
+        trainer)
+    resumed = ckpt2.restore_or_init(jax.random.key(9))  # key unused
+    ds = ckpt2.restore_data_state()
+    assert ds == {"ticket": 3}
+    resumed = steps(resumed, loader(start=ds["ticket"]), 3)
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b))),
+        ref.params, resumed.params)
+
+    # old-layout compatibility, exercised for real: strip the
+    # data_state item from the saved step on disk (what a checkpoint
+    # written before this feature looks like) — restore must degrade
+    # to {} instead of raising
+    import shutil
+
+    data_dirs = list((tmp_path / "c3").glob("*/data_state"))
+    assert data_dirs, "expected a data_state item on disk"
+    for d in data_dirs:
+        shutil.rmtree(d)
+    ckpt3 = Checkpointer(
+        CheckpointConfig(str(tmp_path / "c3"), enable_async=False),
+        trainer)
+    assert ckpt3.restore_data_state() == {}
+    ckpt.close()
+    ckpt2.close()
+    ckpt3.close()

@@ -82,6 +82,27 @@ def test_native_multihost_matches_python(shards):
                     nat.next_batch(), py.next_batch())
 
 
+def test_resume_from_ticket_continues_stream(shards):
+    """Checkpoint/resume contract: a loader opened at start_ticket=k
+    emits EXACTLY what an uninterrupted loader emits after k batches —
+    mid-epoch and across the epoch boundary, both implementations."""
+    ref = dl.PyTokenLoader(shards, batch=4, seq=16, seed=7)
+    per_epoch = ref._batches_per_epoch
+    stream = [ref.next_batch() for _ in range(per_epoch + 5)]
+    for k in (3, per_epoch, per_epoch + 2):
+        res = dl.PyTokenLoader(shards, batch=4, seq=16, seed=7,
+                               start_ticket=k)
+        assert res.state_dict() == {"ticket": k}
+        for want in stream[k:]:
+            np.testing.assert_array_equal(res.next_batch(), want)
+    if dl.native_available():
+        with dl.TokenShardLoader(shards, batch=4, seq=16, seed=7,
+                                 start_ticket=3, threads=3) as nat:
+            for want in stream[3:]:
+                np.testing.assert_array_equal(nat.next_batch(), want)
+            assert nat.state_dict() == {"ticket": len(stream)}
+
+
 def test_invalid_shard_rejected(tmp_path):
     p = str(tmp_path / "bad.ktsh")
     with open(p, "wb") as f:
