@@ -11,6 +11,11 @@ ahead-of-time export via `jax.export` (StableHLO) with jax2tf/SavedModel
 available when TensorFlow is present.
 """
 
+from kubeflow_tpu.serving.continuous import (
+    ContinuousBatcher,
+    ContinuousEngine,
+    SlotState,
+)
 from kubeflow_tpu.serving.engine import (
     DecodeState,
     EngineConfig,
@@ -19,6 +24,7 @@ from kubeflow_tpu.serving.engine import (
     filter_logits,
     GEMMA_FAMILY,
     LLAMA_FAMILY,
+    MOE_LLAMA_FAMILY,
 )
 from kubeflow_tpu.serving.quant import QTensor, quantize_blocks
 from kubeflow_tpu.serving.speculative import SpecStats, SpeculativeEngine

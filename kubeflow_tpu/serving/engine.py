@@ -36,17 +36,48 @@ Params = dict[str, Any]
 
 @dataclasses.dataclass(frozen=True)
 class Family:
-    """Model-family adapter for the shared llama/gemma block schema."""
+    """Model-family adapter for the shared llama/gemma block schema.
+
+    `mlp` overrides the block's FFN half entirely (signature
+    `(cfg, layer_params, normed_h) -> delta`): the MoE family routes
+    through experts there while the attention half, KV cache, and
+    sampling machinery stay shared."""
 
     name: str
     gate_act: Callable[[jnp.ndarray], jnp.ndarray]
     scale_embed: bool          # multiply embeddings by sqrt(hidden)
+    mlp: Callable[..., jnp.ndarray] | None = None
 
 
 LLAMA_FAMILY = Family("llama", jax.nn.silu, scale_embed=False)
 GEMMA_FAMILY = Family(
     "gemma", lambda x: jax.nn.gelu(x, approximate=True), scale_embed=True
 )
+
+
+def _moe_serving_mlp(cfg, p, h: jnp.ndarray) -> jnp.ndarray:
+    """Dropless MoE FFN for decode (models/llama_moe.py block schema:
+    router [D,E] + per-expert SwiGLU stacks [E,D,M]). Training's
+    capacity factor trades dropped tokens for load balance; serving
+    must never drop — capacity_factor = E/k makes capacity equal the
+    token count, and a token occupies at most one slot per expert, so
+    every assignment fits. Decode token counts are tiny (batch x 1),
+    so the [T, E, T] dispatch tensors cost nothing."""
+    import dataclasses as _dc
+
+    from kubeflow_tpu.parallel import moe as moe_lib
+
+    mcfg = _dc.replace(
+        cfg.moe_config(),
+        capacity_factor=cfg.num_experts / cfg.top_k)
+    params = {k: p[k].astype(cfg.dtype)
+              for k in ("router", "w_gate", "w_up", "w_down")}
+    y, _aux = moe_lib.moe_mlp(params, h, mcfg)
+    return y
+
+
+MOE_LLAMA_FAMILY = Family(
+    "llama-moe", jax.nn.silu, scale_embed=False, mlp=_moe_serving_mlp)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -189,9 +220,12 @@ def transformer_block(cfg, fam: Family, p, x, rope_positions, inv_freq,
     x = x + out.reshape(b, s, cfg.q_dim) @ p["wo"].astype(cfg.dtype)
 
     h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
-    gate = fam.gate_act(h @ p["w_gate"].astype(cfg.dtype))
-    ff = gate * (h @ p["w_up"].astype(cfg.dtype))
-    x = x + ff @ p["w_down"].astype(cfg.dtype)
+    if fam.mlp is not None:
+        x = x + fam.mlp(cfg, p, h)
+    else:
+        gate = fam.gate_act(h @ p["w_gate"].astype(cfg.dtype))
+        ff = gate * (h @ p["w_up"].astype(cfg.dtype))
+        x = x + ff @ p["w_down"].astype(cfg.dtype)
     return x, (k_cache, v_cache)
 
 

@@ -606,3 +606,69 @@ def test_generate_stream_validates_eagerly(llama_engine):
         engine.generate_stream(prompt, max_new=10**6)
     with pytest.raises(ValueError, match="chunk"):
         engine.generate_stream(prompt, max_new=4, chunk=0)
+
+
+def test_moe_cached_decode_matches_full_recompute():
+    """MoE serving: the engine's injected-FFN family (dropless routing)
+    must match a full-prefix recompute through llama_moe.apply with the
+    same dropless capacity (training's capacity_factor drops tokens by
+    design; serving never may — both sides pinned dropless here so any
+    mismatch is a cache/routing bug, not a drop)."""
+    import dataclasses
+
+    from kubeflow_tpu.models import llama_moe
+    from kubeflow_tpu.serving import MOE_LLAMA_FAMILY
+
+    cfg = dataclasses.replace(
+        llama_moe.MIXTRAL_TINY,
+        capacity_factor=(llama_moe.MIXTRAL_TINY.num_experts
+                         / llama_moe.MIXTRAL_TINY.top_k))
+    params = dict(llama_moe.init(jax.random.key(2), cfg))
+    params["lm_head"] = params["lm_head"] * 50.0
+    engine = InferenceEngine(params, cfg, MOE_LLAMA_FAMILY,
+                             EngineConfig(max_len=32))
+    prompt = jnp.asarray(
+        np.random.default_rng(3).integers(0, cfg.vocab_size, (2, 6)),
+        jnp.int32)
+    got = engine.generate(prompt, max_new=4)
+
+    toks = prompt
+    want = []
+    for _ in range(4):
+        logits, _aux = llama_moe.apply(params, cfg, toks)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        want.append(nxt)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(jnp.stack(want, axis=1)))
+
+
+async def test_moe_serves_through_continuous_batcher():
+    """Composition: the MoE engine rides the continuous batcher (slot
+    KV scatter + injected-FFN step) unchanged."""
+    import asyncio as aio
+    import dataclasses
+
+    from kubeflow_tpu.models import llama_moe
+    from kubeflow_tpu.serving import MOE_LLAMA_FAMILY
+    from kubeflow_tpu.serving.continuous import ContinuousBatcher
+
+    cfg = dataclasses.replace(
+        llama_moe.MIXTRAL_TINY,
+        capacity_factor=(llama_moe.MIXTRAL_TINY.num_experts
+                         / llama_moe.MIXTRAL_TINY.top_k))
+    params = dict(llama_moe.init(jax.random.key(2), cfg))
+    params["lm_head"] = params["lm_head"] * 50.0
+    engine = InferenceEngine(params, cfg, MOE_LLAMA_FAMILY,
+                             EngineConfig(max_len=64))
+    batcher = ContinuousBatcher(engine, aio.Lock(), max_slots=2)
+    gen = np.random.default_rng(4)
+    prompts = [gen.integers(0, cfg.vocab_size, n).tolist()
+               for n in (5, 9)]
+    want = [np.asarray(engine.generate(
+        jnp.asarray([p], jnp.int32), max_new=5))[0].tolist()
+        for p in prompts]
+    got = await aio.gather(
+        *(batcher.submit(p, 5, ()) for p in prompts))
+    assert list(got) == want
+    await batcher.close()
