@@ -103,11 +103,20 @@ class ContinuousEngine:
     device.
     """
 
-    def __init__(self, engine: InferenceEngine, max_slots: int = 8):
+    def __init__(self, engine: InferenceEngine, max_slots: int = 8,
+                 prefill_chunk: int | None = None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{prefill_chunk}")
         self.engine = engine
         self.S = max_slots
+        # Long-prompt admissions prefill in fixed slices (engine.
+        # prefill_chunked): buckets become chunk MULTIPLES, so every
+        # long prompt reuses the one [g, chunk] program instead of
+        # minting a power-of-two bucket compile per length class.
+        self.prefill_chunk = prefill_chunk
         # KV buffers dominate serving HBM: donate the old state so step
         # and insert update in place instead of holding two copies
         # (same policy as the Trainer's donated TrainState).
@@ -132,12 +141,19 @@ class ContinuousEngine:
     # -- admission --------------------------------------------------------
 
     def bucket_for(self, n_tokens: int, max_new: int) -> int:
-        """Prefill bucket for one request: power-of-two, falling back
-        to the EXACT length when the bucket plus this request's
-        max_new would overrun the cache (bucket pads occupy cache
-        cells, so a bucket the admission check never saw could
+        """Prefill bucket for one request: power-of-two (or, past one
+        chunk with chunked prefill enabled, the ceil chunk multiple),
+        falling back to the EXACT length when the bucket plus this
+        request's max_new would overrun the cache (bucket pads occupy
+        cache cells, so a bucket the admission check never saw could
         silently clamp the last decode writes otherwise)."""
         cap = self.engine.ec.max_len
+        c = self.prefill_chunk
+        if c and n_tokens > c:
+            bc = -(-n_tokens // c) * c
+            if bc + max_new <= cap:
+                return bc
+            return n_tokens  # exact single-shot; capacity-checked upstream
         b = bucket_pow2(n_tokens, max(cap - max_new, 0))
         return b if b >= n_tokens else n_tokens
 
@@ -165,9 +181,15 @@ class ContinuousEngine:
             np.asarray([s.get("top_p", ec.top_p)
                         for s in samplings], np.float32),
             rng, batch=g)
-        state, first, _, done = eng._prefill_jit(
-            eng.params, jnp.asarray(arr), eng.init_state(g), rng, sp,
-            jnp.asarray(mask))
+        c = self.prefill_chunk
+        if c and bucket > c and bucket % c == 0:
+            state, first, _, done = eng.prefill_chunked(
+                eng.params, jnp.asarray(arr), eng.init_state(g), rng,
+                sp, jnp.asarray(mask), chunk=c)
+        else:
+            state, first, _, done = eng._prefill_jit(
+                eng.params, jnp.asarray(arr), eng.init_state(g), rng, sp,
+                jnp.asarray(mask))
         return state, first, done
 
     def prefill(self, tokens: list[int], max_new: int,
@@ -339,6 +361,7 @@ class ContinuousBatcher:
 
     def __init__(self, engine: InferenceEngine, gpu_lock: asyncio.Lock,
                  *, max_slots: int = 8, chunk: int = 4,
+                 prefill_chunk: int | None = None,
                  window_ms: float = 0.0):
         # window_ms accepted (and ignored) for constructor parity with
         # Batcher: admission is per-token here, there is no window.
@@ -352,7 +375,8 @@ class ContinuousBatcher:
         # under a window group's full-generation wait. Compiles stay
         # bounded: one program per steps value in [1, chunk].
         self.chunk = chunk
-        self.cengine = ContinuousEngine(engine, max_slots)
+        self.cengine = ContinuousEngine(engine, max_slots,
+                                        prefill_chunk=prefill_chunk)
         self.engine = engine
         self.gpu_lock = gpu_lock
         self.calls = 0            # decode steps (device invocations)
@@ -376,12 +400,19 @@ class ContinuousBatcher:
     def occupancy(self) -> float:
         return self.tokens_emitted / self.calls if self.calls else 0.0
 
-    def warmup(self, buckets=(16,)) -> int:
+    def warmup(self, buckets=None) -> int:
         """Blocking ahead-of-traffic compile of the full shape set
         (call before serving traffic; the app's on_startup hook does
-        when create_serving_app(warmup=True))."""
+        when create_serving_app(warmup=True)). With chunked prefill
+        enabled the default bucket set includes a two-chunk prompt so
+        the chunk-loop and tail programs warm too."""
+        if buckets is None:
+            buckets = [16]
+            c = self.cengine.prefill_chunk
+            if c and 2 * c <= self.engine.ec.max_len and 2 * c != 16:
+                buckets.append(2 * c)
         return self.cengine.warmup(
-            buckets=buckets, step_sizes=range(1, self.chunk + 1))
+            buckets=tuple(buckets), step_sizes=range(1, self.chunk + 1))
 
     # -- public API -------------------------------------------------------
 

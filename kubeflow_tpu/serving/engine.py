@@ -480,6 +480,7 @@ class InferenceEngine:
         top_k: int | None = None,
         top_p: float | None = None,
         prompt_mask: jnp.ndarray | None = None,  # [b, s] bool, False=pad
+        prefill_chunk: int | None = None,
     ) -> jnp.ndarray:
         """Generate `max_new` tokens after the prompt. Returns [b, max_new]
         (post-hoc EOS trimming is the caller's job — shapes stay static).
@@ -487,14 +488,40 @@ class InferenceEngine:
         temperature/top_k/top_p default from EngineConfig; per-call
         overrides are dynamic (no recompile across values).
         `prompt_mask` batches variable-length prompts: pads LEFT-aligned
-        (False entries), each row decodes as if it were unpadded."""
+        (False entries), each row decodes as if it were unpadded.
+        `prefill_chunk` prefills long prompts in fixed slices (see
+        prefill_chunked) — same tokens, chunk-bounded compile shapes
+        and activation memory."""
         sp, rng, prompt_mask, state = self._prep(
             prompt_tokens, max_new, rng, temperature, top_k, top_p,
             prompt_mask)
-        toks, _ = self._generate_jit(
+        if prefill_chunk is None:
+            toks, _ = self._generate_jit(
+                self.params, prompt_tokens, state, rng, sp, prompt_mask,
+                max_new=max_new)
+            return toks
+        if prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{prefill_chunk}")
+        b, n = prompt_tokens.shape
+        pad = (-n) % prefill_chunk
+        if n + pad + max_new > self.ec.max_len:
+            raise ValueError(
+                f"chunk-padded prompt {n + pad} + max_new {max_new} "
+                f"exceeds cache bucket {self.ec.max_len}")
+        if pad:
+            prompt_tokens = jnp.concatenate(
+                [jnp.zeros((b, pad), prompt_tokens.dtype),
+                 prompt_tokens], axis=1)
+            prompt_mask = jnp.concatenate(
+                [jnp.zeros((b, pad), bool), prompt_mask], axis=1)
+        state, first, rng, done = self.prefill_chunked(
             self.params, prompt_tokens, state, rng, sp, prompt_mask,
-            max_new=max_new)
-        return toks
+            chunk=prefill_chunk)
+        _, _, _, _, rest = self._chunk_jit(
+            self.params, state, first, rng, done, sp,
+            length=max_new - 1)
+        return jnp.concatenate([first[:, None], rest], axis=1)
 
     def _prep(self, prompt_tokens, max_new, rng, temperature, top_k,
               top_p, prompt_mask):
@@ -577,3 +604,39 @@ class InferenceEngine:
     @functools.cached_property
     def _chunk_jit(self):
         return jax.jit(self._decode_chunk, static_argnames=("length",))
+
+    @functools.cached_property
+    def _forward_jit(self):
+        return jax.jit(self._forward_cached)
+
+    def prefill_chunked(self, params, prompt, state, rng,
+                        sp: SamplingParams, prompt_mask, *, chunk: int):
+        """Prefill in fixed `chunk`-token slices through the
+        incremental cache, then sample token #1 from the final slice.
+
+        Long-context serving's standard shape-bounding move: a 32k
+        prompt compiles ONE [b, chunk] program instead of one program
+        (and one activation working set) per long-prompt bucket —
+        chunk i attends the cache filled by chunks 0..i-1, which is
+        exactly what `_forward_cached` computes. The final slice goes
+        through `_prefill_sample`, so the rng discipline and sampled
+        law equal the one-shot prefill bit for bit (earlier slices
+        never consume rng). Rows whose pads span whole early slices
+        are safe: a fully-masked row attends nothing (finite NEG_INF
+        masking, no NaN) and its garbage positions are never sampled —
+        only the final slice's last column is.
+
+        `prompt` width must be a multiple of `chunk` (callers left-pad
+        and extend `prompt_mask` accordingly)."""
+        b, n = prompt.shape
+        if n % chunk:
+            raise ValueError(f"prompt width {n} not a multiple of "
+                             f"chunk {chunk} (left-pad first)")
+        for i in range(n // chunk - 1):
+            sl = slice(i * chunk, (i + 1) * chunk)
+            _, state = self._forward_jit(
+                params, prompt[:, sl], state,
+                prompt_mask=prompt_mask[:, sl])
+        return self._prefill_jit(
+            params, prompt[:, n - chunk:], state, rng, sp,
+            prompt_mask[:, n - chunk:])

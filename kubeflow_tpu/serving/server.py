@@ -207,6 +207,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                        *, tokenizer=None, batch_window_ms: float = 0.0,
                        max_batch: int = 8, continuous: bool = False,
                        warmup: bool = False,
+                       prefill_chunk: int | None = None,
                        drafts: dict[str, InferenceEngine] | None = None,
                        ) -> web.Application:
     """`tokenizer` (data.bpe.Tokenizer or anything with encode/decode)
@@ -247,8 +248,11 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     lock = asyncio.Lock()
     app[GPU_LOCK_KEY] = lock
     if continuous:
+        # prefill_chunk: long prompts admit in fixed slices — chunk-
+        # multiple buckets, one [g, chunk] compile for every length
         app[BATCHERS_KEY] = {
-            name: ContinuousBatcher(eng, lock, max_slots=max_batch)
+            name: ContinuousBatcher(eng, lock, max_slots=max_batch,
+                                    prefill_chunk=prefill_chunk)
             for name, eng in engines.items()}
         if warmup:
             async def _warm(app_):

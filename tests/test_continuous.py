@@ -309,3 +309,58 @@ async def test_submit_capacity_and_shutdown():
     await batcher.close()
     with pytest.raises(RuntimeError, match="shut down"):
         await batcher.submit([1, 2, 3], 4, ())
+
+
+def test_chunked_prefill_equals_oneshot_ragged_batch():
+    """generate(prefill_chunk=4) must equal plain generate on a ragged
+    left-padded batch — including a row whose pads span entire early
+    chunks (fully-masked slices attend nothing and sample nothing)."""
+    engine, cfg = _engine()
+    gen = np.random.default_rng(15)
+    longest = 10
+    prompts = [gen.integers(0, cfg.vocab_size, n).tolist()
+               for n in (2, 6, longest)]  # row 0: pads cover chunk 0+
+    arr = np.zeros((3, longest), np.int32)
+    mask = np.zeros((3, longest), bool)
+    for i, p in enumerate(prompts):
+        arr[i, longest - len(p):] = p
+        mask[i, longest - len(p):] = True
+    want = np.asarray(engine.generate(
+        jnp.asarray(arr), max_new=5, prompt_mask=jnp.asarray(mask)))
+    got = np.asarray(engine.generate(
+        jnp.asarray(arr), max_new=5, prompt_mask=jnp.asarray(mask),
+        prefill_chunk=4))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_chunked_prefill_width_validation():
+    engine, cfg = _engine(max_len=32)
+    p = jnp.asarray(np.random.default_rng(16).integers(
+        0, cfg.vocab_size, (1, 8)), jnp.int32)
+    with pytest.raises(ValueError, match="exceeds cache bucket"):
+        engine.generate(p, max_new=24, prefill_chunk=7)  # pads to 14
+    with pytest.raises(ValueError, match="multiple of"):
+        engine.prefill_chunked(
+            engine.params, p, engine.init_state(1), jax.random.key(0),
+            engine._resolve_sampling(0.0, 0, 1.0, None, batch=1)[0],
+            jnp.ones((1, 8), bool), chunk=3)
+
+
+async def test_continuous_long_prompt_admits_in_chunks():
+    """A long prompt admitted with prefill_chunk set gets a chunk-
+    multiple bucket and decodes exactly its solo continuation."""
+    engine, cfg = _engine(max_len=128)
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2,
+                                prefill_chunk=8)
+    assert batcher.cengine.bucket_for(20, 16) == 24  # ceil multiple
+    assert batcher.cengine.bucket_for(5, 16) == 16   # short: pow2
+    gen = np.random.default_rng(17)
+    long_p = gen.integers(0, cfg.vocab_size, 20).tolist()
+    short_p = gen.integers(0, cfg.vocab_size, 5).tolist()
+    want_l = _solo(engine, long_p, 6)
+    want_s = _solo(engine, short_p, 6)
+    got_l, got_s = await asyncio.gather(
+        batcher.submit(long_p, 6, ()),
+        batcher.submit(short_p, 6, ()))
+    assert got_l == want_l and got_s == want_s
+    await batcher.close()

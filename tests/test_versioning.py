@@ -496,12 +496,21 @@ async def test_put_cannot_resurrect_terminating_resource(loop):
         held = cluster.store.get("Notebook", "user1", "term")
         assert held.metadata.deletion_timestamp is not None
 
-        r = await client.get(f"{base}/v1/namespaces/user1/notebooks/term",
-                             headers=USER)
-        wire = await r.json()
-        wire["metadata"].pop("deletion_timestamp", None)
-        r = await client.put(f"{base}/v1/namespaces/user1/notebooks/term",
-                             json=wire, headers=API_CLIENT)
+        # kubectl-style conflict retry: the controller reacts to the
+        # deletion concurrently (status/finalizer updates bump the
+        # resourceVersion), so a GET→PUT pair can legitimately 409 —
+        # re-read and re-send, like any real API client
+        for _ in range(10):
+            r = await client.get(
+                f"{base}/v1/namespaces/user1/notebooks/term",
+                headers=USER)
+            wire = await r.json()
+            wire["metadata"].pop("deletion_timestamp", None)
+            r = await client.put(
+                f"{base}/v1/namespaces/user1/notebooks/term",
+                json=wire, headers=API_CLIENT)
+            if r.status != 409:
+                break
         assert r.status == 200, await r.text()
         after = cluster.store.get("Notebook", "user1", "term")
         assert after.metadata.deletion_timestamp is not None, \
