@@ -76,6 +76,7 @@ def test_slot_step_matches_generate_mixed_cursors():
     assert got == want
 
 
+@pytest.mark.slow
 def test_chunked_steps_emit_identical_tokens():
     """steps=3 is one scanned dispatch of the SAME per-step program:
     the emitted tokens must equal three steps=1 calls."""
@@ -97,6 +98,7 @@ def test_chunked_steps_emit_identical_tokens():
     assert got == want
 
 
+@pytest.mark.slow
 async def test_batcher_concurrent_requests_match_solo():
     engine, cfg = _engine()
     batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=4)
@@ -115,6 +117,7 @@ async def test_batcher_concurrent_requests_match_solo():
     await batcher.close()
 
 
+@pytest.mark.slow
 async def test_late_arrival_joins_midflight():
     """A request submitted while another decodes joins at the next
     token boundary instead of waiting for the first to finish — total
@@ -162,6 +165,7 @@ async def test_eos_retires_slot_early_and_pads_result():
     await batcher.close()
 
 
+@pytest.mark.slow
 async def test_slot_reuse_leaks_nothing():
     """More requests than slots, varied lengths: every result must
     equal its solo run even though slots are reused with stale KV,
@@ -178,6 +182,7 @@ async def test_slot_reuse_leaks_nothing():
     await batcher.close()
 
 
+@pytest.mark.slow
 async def test_greedy_rows_exact_next_to_sampled_rows():
     """Per-slot sampling knobs: a temperature row in the batch must not
     perturb its greedy neighbors (the _sample cond selects per row)."""
@@ -198,6 +203,7 @@ async def test_greedy_rows_exact_next_to_sampled_rows():
     await batcher.close()
 
 
+@pytest.mark.slow
 async def test_rest_oneshot_and_models_card():
     engine, cfg = _engine()
     app = server_lib.create_serving_app(
@@ -226,6 +232,7 @@ async def test_rest_oneshot_and_models_card():
     await client.close()
 
 
+@pytest.mark.slow
 async def test_rest_sse_stream_rides_the_slot_batch():
     engine, cfg = _engine()
     app = server_lib.create_serving_app(
@@ -257,6 +264,7 @@ async def test_rest_sse_stream_rides_the_slot_batch():
     await client.close()
 
 
+@pytest.mark.slow
 async def test_prefill_bucket_never_overruns_cache():
     """A legal request whose power-of-two prompt bucket + max_new
     would overrun the cache must fall back to the exact prompt length
@@ -273,6 +281,7 @@ async def test_prefill_bucket_never_overruns_cache():
     await batcher.close()
 
 
+@pytest.mark.slow
 async def test_abandoned_stream_releases_slot():
     """A consumer that stops iterating (SSE client disconnect) must
     free its slot instead of decoding to max_new into a dead queue."""
@@ -311,6 +320,7 @@ async def test_submit_capacity_and_shutdown():
         await batcher.submit([1, 2, 3], 4, ())
 
 
+@pytest.mark.slow
 def test_chunked_prefill_equals_oneshot_ragged_batch():
     """generate(prefill_chunk=4) must equal plain generate on a ragged
     left-padded batch — including a row whose pads span entire early
@@ -346,6 +356,7 @@ def test_chunked_prefill_width_validation():
             jnp.ones((1, 8), bool), chunk=3)
 
 
+@pytest.mark.slow
 async def test_continuous_long_prompt_admits_in_chunks():
     """A long prompt admitted with prefill_chunk set gets a chunk-
     multiple bucket and decodes exactly its solo continuation."""

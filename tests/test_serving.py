@@ -643,6 +643,7 @@ def test_moe_cached_decode_matches_full_recompute():
         np.asarray(got), np.asarray(jnp.stack(want, axis=1)))
 
 
+@pytest.mark.slow
 async def test_moe_serves_through_continuous_batcher():
     """Composition: the MoE engine rides the continuous batcher (slot
     KV scatter + injected-FFN step) unchanged."""
