@@ -204,6 +204,20 @@ class ObjectiveSpec:
 
 
 @dataclass
+class EarlyStoppingSpec:
+    """Katib-style early stopping. `medianstop`: a running trial whose
+    best objective by reported step s is worse than the MEDIAN of the
+    completed trials' best-by-s is stopped (its compute freed for the
+    next suggestion). Arms only once `min_trials` completed trials
+    have reported intermediate metrics, and never before a trial's
+    `start_step`-th report."""
+
+    algorithm: str = ""                    # "" (off) | medianstop
+    min_trials: int = 3
+    start_step: int = 1
+
+
+@dataclass
 class ExperimentSpec:
     objective: ObjectiveSpec = field(default_factory=ObjectiveSpec)
     algorithm: str = "random"              # random | grid
@@ -211,6 +225,8 @@ class ExperimentSpec:
     parameters: list[ParameterSpec] = field(default_factory=list)
     max_trials: int = 10
     parallel_trials: int = 2
+    early_stopping: EarlyStoppingSpec = field(
+        default_factory=EarlyStoppingSpec)
     # Pod template for each trial; hyperparameters are injected as
     # KFTPU_HP_<NAME> env vars and TPU env rides the normal webhook path.
     trial_template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
@@ -223,6 +239,7 @@ class ExperimentStatus:
     trials_created: int = 0
     trials_succeeded: int = 0
     trials_failed: int = 0
+    trials_early_stopped: int = 0
     best_trial: str = ""
     best_value: float | None = None
     best_assignment: dict[str, str] = field(default_factory=dict)
@@ -247,9 +264,12 @@ class TrialSpec:
 
 @dataclass
 class TrialStatus:
-    phase: str = ""       # "" | Running | Succeeded | Failed
+    phase: str = ""       # "" | Running | Succeeded | Failed | EarlyStopped
     value: float | None = None
     message: str = ""
+    # [step, value] pairs mirrored from the pod's intermediate-metrics
+    # annotation; the median stopping rule reads these.
+    intermediates: list[list[float]] = field(default_factory=list)
 
 
 @dataclass
@@ -262,5 +282,8 @@ class Trial(Resource):
 # Trial pods report their objective via this annotation (written by the
 # in-pod metric reporter; the trial controller mirrors it into status).
 TRIAL_METRIC_ANNOTATION = "kubeflow-tpu.dev/metric-value"
+# Progressive [step, value] JSON reported DURING the run (same writer);
+# feeds the median stopping rule.
+TRIAL_INTERMEDIATE_ANNOTATION = "kubeflow-tpu.dev/intermediate-metrics"
 TRIAL_LABEL = "trial-name"
 EXPERIMENT_LABEL = "experiment-name"

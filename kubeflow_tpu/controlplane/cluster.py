@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from kubeflow_tpu.controlplane.controllers.culler import ActivityProbe, Culler
 from kubeflow_tpu.controlplane.controllers.hpo import (
     ExperimentController,
+    StepwiseTrialExecutor,
     TrialController,
     TrialExecutor,
 )
@@ -57,6 +58,11 @@ class ClusterConfig:
     # Hermetic HPO: when set, trial pods "run" this objective in-process
     # (the envtest-style fake kubelet for trials). None in production.
     trial_executor: TrialExecutor | None = None
+    # Stepwise variant: (assignment, step) -> value | None(done); one
+    # step per reconcile with durable intermediate reports — the path
+    # the median stopping rule observes. Mutually exclusive with
+    # trial_executor.
+    stepwise_trial_executor: StepwiseTrialExecutor | None = None
     # Hot-watched default-namespace-labels file (JSON/YAML mapping); a
     # change re-reconciles every Profile (the fsnotify mechanism,
     # ref profile_controller.go:356-405). Overrides
@@ -105,7 +111,8 @@ class Cluster:
         self.deployment_controller = DeploymentController()
         self.experiment_controller = ExperimentController()
         self.trial_controller = TrialController(
-            executor=self.config.trial_executor)
+            executor=self.config.trial_executor,
+            stepwise_executor=self.config.stepwise_trial_executor)
         self.manager.register(self.experiment_controller)
         self.manager.register(self.trial_controller)
         self.manager.register(self.notebook_controller)

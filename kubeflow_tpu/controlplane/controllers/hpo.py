@@ -30,6 +30,7 @@ from kubeflow_tpu.api.crds import (
     EXPERIMENT_LABEL,
     Experiment,
     ParameterSpec,
+    TRIAL_INTERMEDIATE_ANNOTATION,
     TRIAL_LABEL,
     TRIAL_METRIC_ANNOTATION,
     Trial,
@@ -51,6 +52,36 @@ log = logging.getLogger(__name__)
 # In-process objective for hermetic trials: (assignment) -> metric.
 TrialExecutor = Callable[[dict[str, str]], float]
 
+# Stepwise hermetic objective: (assignment, step_index) -> intermediate
+# value, or None when training is done (final metric = last
+# intermediate). One step runs per reconcile and each step persists to
+# the pod annotation BEFORE the next runs — durable like the one-shot
+# executor's outcome, and it gives the Experiment controller real
+# between-step windows to apply the median stopping rule in.
+StepwiseTrialExecutor = Callable[[dict[str, str], int], float | None]
+
+
+def _parse_intermediates(raw: str) -> list[list[float]] | None:
+    """Validate a pod's intermediate-metrics annotation: JSON list of
+    [step, value] numeric pairs, or None if malformed (annotations are
+    client-writable; the controller must not crash on garbage)."""
+    import json
+
+    try:
+        v = json.loads(raw)
+    except ValueError:
+        return None
+    if not isinstance(v, list):
+        return None
+    out: list[list[float]] = []
+    for e in v:
+        if (not isinstance(e, (list, tuple)) or len(e) != 2
+                or not all(isinstance(x, (int, float))
+                           and not isinstance(x, bool) for x in e)):
+            return None
+        out.append([float(e[0]), float(e[1])])
+    return out
+
 
 def _space_from_spec(params: list[ParameterSpec]) -> search_lib.SearchSpace:
     out: list[search_lib.Parameter] = []
@@ -70,6 +101,75 @@ class ExperimentController(Controller):
     KIND = "Experiment"
     OWNS = ("Trial",)
 
+    @staticmethod
+    def _best(goal: str, values) -> float | None:
+        vals = list(values)
+        if not vals:
+            return None
+        return min(vals) if goal == "minimize" else max(vals)
+
+    def _apply_early_stopping(self, store: Store, spec, running,
+                              done) -> int:
+        """Median stopping rule (the Katib `medianstop` semantics,
+        best-by-step variant): stop a running trial whose best
+        objective by its latest reported step s is worse than the
+        median of completed trials' best values by step s. Completed
+        trials without intermediate reports are excluded — mixing
+        final values measured at different budgets into the median
+        would bias the rule. Returns the number of trials stopped."""
+        es = spec.early_stopping
+        if es.algorithm != "medianstop":
+            return 0
+        goal = spec.objective.goal
+        stopped = 0
+        for t in running:
+            inter = t.status.intermediates
+            if not inter:
+                continue
+            s = inter[-1][0]
+            if s < es.start_step:
+                continue
+            mine = self._best(goal, (v for _, v in inter))
+            # Peers = SUCCEEDED trials only (Katib semantics): letting
+            # early-stopped bests into the pool would drag the median
+            # toward the very trials the rule cut, progressively
+            # disarming it.
+            peers = []
+            for d in done:
+                if d.status.phase != "Succeeded":
+                    continue
+                by_s = [v for st, v in d.status.intermediates if st <= s]
+                if by_s:
+                    peers.append(self._best(goal, by_s))
+            if len(peers) < es.min_trials:
+                continue
+            peers.sort()
+            mid = len(peers) // 2
+            median = (peers[mid] if len(peers) % 2
+                      else (peers[mid - 1] + peers[mid]) / 2.0)
+            worse = (mine > median if goal == "minimize"
+                     else mine < median)
+            if not worse:
+                continue
+            # Mutate a clone: a Conflict must leave the local object
+            # (and the caller's running/done refilter) untouched, or
+            # an unpersisted "stop" would shrink `running` and
+            # overshoot parallel_trials with extra pods.
+            won = t.clone()
+            won.status.phase = "EarlyStopped"
+            won.status.value = mine
+            won.status.message = (
+                f"median stopping rule: best {mine:.6g} by step "
+                f"{int(s)} vs median {median:.6g} of {len(peers)} "
+                f"completed trials")
+            try:
+                store.update(won)
+            except (Conflict, NotFound):
+                continue  # the trial moved under us; re-judged next time
+            t.status = won.status
+            stopped += 1
+        return stopped
+
     def reconcile(self, store: Store, namespace: str, name: str) -> Result:
         try:
             exp = store.get("Experiment", namespace, name)
@@ -83,7 +183,23 @@ class ExperimentController(Controller):
             if t.spec.experiment == name
         ]
         running = [t for t in trials if t.status.phase in ("", "Running")]
-        done = [t for t in trials if t.status.phase in ("Succeeded", "Failed")]
+        done = [t for t in trials
+                if t.status.phase in ("Succeeded", "Failed",
+                                      "EarlyStopped")]
+
+        # Early stopping (medianstop): free underperformers' compute.
+        # An EarlyStopped trial is terminal — it counts toward
+        # max_trials, keeps its best-so-far as a REAL (truncated)
+        # observation for TPE and the best-trial aggregate, and its
+        # pod is deleted by the TrialController.
+        stopped_now = self._apply_early_stopping(store, spec, running,
+                                                 done)
+        if stopped_now:
+            running = [t for t in running
+                       if t.status.phase in ("", "Running")]
+            done = [t for t in trials
+                    if t.status.phase in ("Succeeded", "Failed",
+                                          "EarlyStopped")]
 
         # Spawn up to the parallelism budget. The suggester is recreated
         # deterministically and fast-forwarded past prior suggestions.
@@ -107,12 +223,14 @@ class ExperimentController(Controller):
                     store.update(exp)
                 return Result()
             if hasattr(suggester, "observe"):
-                # Adaptive algorithms (TPE) learn from finished trials;
-                # unparseable assignments (edited by hand) are skipped
-                # rather than failing the experiment.
+                # Adaptive algorithms (TPE) learn from finished trials —
+                # including early-stopped ones, whose best-so-far is a
+                # real (truncated) measurement; unparseable assignments
+                # (edited by hand) are skipped rather than failing the
+                # experiment.
                 obs = []
                 for t in done:
-                    if t.status.phase == "Succeeded" \
+                    if t.status.phase in ("Succeeded", "EarlyStopped") \
                             and t.status.value is not None:
                         try:
                             obs.append((space.parse(t.spec.assignment),
@@ -158,8 +276,9 @@ class ExperimentController(Controller):
         # Aggregate status. (Grid exhaustion below max_trials is closed
         # out by the `finished` condition: no running, all trials done.)
         succeeded = [t for t in done if t.status.phase == "Succeeded"]
+        early = [t for t in done if t.status.phase == "EarlyStopped"]
         best = None
-        for t in succeeded:
+        for t in succeeded + early:  # truncated runs still measured
             if t.status.value is None:
                 continue
             if best is None or search_lib.better(
@@ -170,7 +289,8 @@ class ExperimentController(Controller):
         old_status = _dc.asdict(exp.status)
         exp.status.trials_created = len(trials)
         exp.status.trials_succeeded = len(succeeded)
-        exp.status.trials_failed = len(done) - len(succeeded)
+        exp.status.trials_early_stopped = len(early)
+        exp.status.trials_failed = len(done) - len(succeeded) - len(early)
         if best is not None:
             exp.status.best_trial = best.metadata.name
             exp.status.best_value = best.status.value
@@ -181,7 +301,7 @@ class ExperimentController(Controller):
                         and len(trials) < spec.max_trials))
         if finished:
             exp.status.phase = (
-                "Succeeded" if succeeded else "Failed")
+                "Succeeded" if succeeded or best is not None else "Failed")
         elif trials:
             exp.status.phase = "Running"
         # Update only on change: an unconditional write would emit
@@ -195,8 +315,13 @@ class TrialController(Controller):
     KIND = "Trial"
     OWNS = ("Pod",)
 
-    def __init__(self, executor: TrialExecutor | None = None):
+    def __init__(self, executor: TrialExecutor | None = None,
+                 stepwise_executor: StepwiseTrialExecutor | None = None):
+        if executor is not None and stepwise_executor is not None:
+            raise ValueError(
+                "pass executor OR stepwise_executor, not both")
         self.executor = executor
+        self.stepwise = stepwise_executor
 
     def reconcile(self, store: Store, namespace: str, name: str) -> Result:
         try:
@@ -204,6 +329,17 @@ class TrialController(Controller):
         except NotFound:
             return Result()
         assert isinstance(trial, Trial)
+        if trial.status.phase == "EarlyStopped":
+            # terminal by the Experiment's median rule: free the
+            # compute NOW — the pod (and any in-flight stepwise work)
+            # is torn down instead of running to max steps
+            pod = store.try_get("Pod", namespace, f"{name}-run")
+            if pod is not None:
+                try:
+                    store.delete("Pod", namespace, pod.metadata.name)
+                except NotFound:
+                    pass
+            return Result()
         if trial.status.phase in ("Succeeded", "Failed"):
             return Result()
 
@@ -244,6 +380,99 @@ class TrialController(Controller):
             pod = store.get("Pod", namespace, pod_name)
             trial.status.phase = "Running"
             trial = store.update(trial)  # keep rv fresh for the mirror below
+
+        # Stepwise hermetic executor: ONE training step per reconcile,
+        # each persisted to the pod's intermediate-metrics annotation
+        # before the next runs. Between steps the Experiment controller
+        # gets a real window to apply the median stopping rule — which
+        # is the point: early stopping is unobservable if the whole run
+        # completes inside one reconcile.
+        if self.stepwise is not None and pod.phase not in (
+            "Succeeded", "Failed"
+        ):
+            import json as _json
+
+            inter = _json.loads(pod.metadata.annotations.get(
+                TRIAL_INTERMEDIATE_ANNOTATION, "[]"))
+            try:
+                v = self.stepwise(dict(trial.spec.assignment), len(inter))
+            except Exception as e:  # noqa: BLE001 — user objective
+                log.warning("trial %s step objective failed: %s", name, e)
+                # keep `inter` as reported so far: the recorded history
+                # survives the failure (on the pod AND the mirror below)
+                v = None
+                pod.phase = "Failed"
+            if pod.phase != "Failed":
+                if v is None:
+                    if inter:
+                        pod.phase = "Succeeded"
+                        pod.metadata.annotations[TRIAL_METRIC_ANNOTATION] \
+                            = str(inter[-1][1])
+                    else:
+                        pod.phase = "Failed"  # done before any report
+                else:
+                    inter.append([len(inter) + 1, float(v)])
+                    pod.metadata.annotations[
+                        TRIAL_INTERMEDIATE_ANNOTATION] = _json.dumps(inter)
+            for _ in range(8):
+                try:
+                    pod = store.update(pod)
+                    break
+                except Conflict:
+                    try:
+                        fresh = store.get("Pod", namespace, pod_name)
+                    except NotFound:
+                        return Result()  # early-stopped/deleted mid-step
+                    if fresh.phase in ("Succeeded", "Failed"):
+                        pod = fresh
+                        break
+                    # re-apply this step's outcome onto the fresh copy
+                    fresh.phase = pod.phase
+                    fresh.metadata.annotations.update({
+                        k: pod.metadata.annotations[k]
+                        for k in (TRIAL_METRIC_ANNOTATION,
+                                  TRIAL_INTERMEDIATE_ANNOTATION)
+                        if k in pod.metadata.annotations})
+                    pod = fresh
+                except NotFound:
+                    return Result()
+            else:
+                log.error("trial %s: could not record step", name)
+                return Result(requeue_after=1.0)
+            # mirror progress so the Experiment controller can judge
+            if trial.status.intermediates != inter \
+                    or trial.status.phase != "Running":
+                trial.status.intermediates = inter
+                trial.status.phase = trial.status.phase or "Running"
+                try:
+                    trial = store.update(trial)
+                except (Conflict, NotFound):
+                    return Result(requeue_after=0.001)  # re-judged next
+            if pod.phase not in ("Succeeded", "Failed"):
+                return Result(requeue_after=0.001)  # next step
+
+        # Mirror the pod's intermediate reports into Trial.status in
+        # EVERY mode — production pods' metric-reporter writes the
+        # annotation directly and the Experiment's median rule reads
+        # Trials, not Pods. (The stepwise branch above mirrors eagerly;
+        # this is a no-op there.) Malformed annotations are ignored
+        # with a warning rather than wedging the reconcile loop.
+        raw_inter = pod.metadata.annotations.get(
+            TRIAL_INTERMEDIATE_ANNOTATION)
+        if raw_inter is not None:
+            parsed = _parse_intermediates(raw_inter)
+            if parsed is None:
+                log.warning("trial %s: unparseable intermediate "
+                            "metrics annotation", name)
+            elif parsed != trial.status.intermediates:
+                trial.status.intermediates = parsed
+                if pod.phase not in ("Succeeded", "Failed"):
+                    trial.status.phase = trial.status.phase or "Running"
+                    try:
+                        trial = store.update(trial)
+                    except (Conflict, NotFound):
+                        return Result()  # re-mirrored on the next event
+                # terminal: the completion mirror below persists it
 
         # Hermetic executor: run the objective now and complete the pod.
         # The outcome's ONLY record is the pod itself (terminal phase +

@@ -361,3 +361,154 @@ def test_delete_interleaved_with_inflight_reconcile_leaves_no_orphans():
         assert store.list("Trial", "user1") == [], (
             f"orphan Trials after DELETE injected at {point!r}")
         assert store.try_get("Experiment", "user1", "exp") is None
+
+
+def test_median_stopping_rule_stops_underperformers():
+    """Katib medianstop parity: trials report stepwise intermediates;
+    once min_trials have completed, a running trial whose best-by-step
+    is worse than the completed median is EarlyStopped, its pod torn
+    down, and its truncated best still feeds the experiment aggregate."""
+    STEPS = 6
+
+    def stepwise(assignment, step):
+        if step >= STEPS:
+            return None
+        # loss falls fast for adam, barely for sgd — sgd trials are
+        # clearly worse than the median from their first steps
+        rate = 1.0 if assignment["opt"] == "adam" else 0.01
+        return 10.0 - rate * (step + 1)
+
+    cfg = ClusterConfig(stepwise_trial_executor=stepwise)
+    with Cluster(cfg) as c:
+        exp = _experiment(max_trials=8, parallel=2)
+        exp.spec.seed = 5
+        exp.spec.early_stopping.algorithm = "medianstop"
+        exp.spec.early_stopping.min_trials = 2
+        exp.spec.early_stopping.start_step = 2
+        c.store.create(exp)
+        assert c.wait_idle(timeout=60)
+
+        exp = c.store.get("Experiment", "user1", "exp")
+        trials = [t for t in c.store.list("Trial", "user1")
+                  if t.spec.experiment == "exp"]
+        assert exp.status.phase == "Succeeded", exp.status
+        assert exp.status.trials_created == 8
+        by_phase = {}
+        for t in trials:
+            by_phase.setdefault(t.status.phase, []).append(t)
+        # at least one sgd trial ran after the rule armed and was cut
+        assert by_phase.get("EarlyStopped"), [
+            (t.metadata.name, t.status.phase) for t in trials]
+        assert exp.status.trials_early_stopped == len(
+            by_phase["EarlyStopped"])
+        for t in by_phase["EarlyStopped"]:
+            assert t.spec.assignment["opt"] == "sgd", t.spec.assignment
+            # stopped BEFORE running all steps...
+            assert len(t.status.intermediates) < STEPS
+            # ...with the rule's evidence in the message
+            assert "median stopping rule" in t.status.message
+            # ...its truncated best recorded as a real observation
+            assert t.status.value == pytest.approx(
+                10.0 - 0.01 * len(t.status.intermediates))
+            # ...and its pod torn down (compute freed)
+            assert c.store.try_get(
+                "Pod", "user1", f"{t.metadata.name}-run") is None
+        # completed trials ran the full budget
+        for t in by_phase.get("Succeeded", []):
+            assert len(t.status.intermediates) == STEPS
+        # the best trial is a full adam run, not a truncated sgd one
+        assert exp.status.best_assignment["opt"] == "adam"
+        assert exp.status.best_value == pytest.approx(10.0 - 1.0 * STEPS)
+
+
+def test_stepwise_executor_without_early_stopping_runs_full():
+    """No early_stopping spec -> every trial runs its full budget and
+    the stepwise path reports the last intermediate as the final
+    metric (same contract as the one-shot executor)."""
+    def stepwise(assignment, step):
+        return None if step >= 3 else float(step)
+
+    cfg = ClusterConfig(stepwise_trial_executor=stepwise)
+    with Cluster(cfg) as c:
+        c.store.create(_experiment(max_trials=3, parallel=3))
+        assert c.wait_idle(timeout=30)
+        exp = c.store.get("Experiment", "user1", "exp")
+        assert exp.status.phase == "Succeeded", exp.status
+        assert exp.status.trials_succeeded == 3
+        assert exp.status.trials_early_stopped == 0
+        for t in c.store.list("Trial", "user1"):
+            assert t.status.intermediates == [[1, 0.0], [2, 1.0],
+                                              [3, 2.0]]
+            assert t.status.value == 2.0
+
+
+def test_stepwise_and_oneshot_executors_are_exclusive():
+    from kubeflow_tpu.controlplane.controllers.hpo import TrialController
+
+    with pytest.raises(ValueError, match="not both"):
+        TrialController(executor=lambda a: 1.0,
+                        stepwise_executor=lambda a, s: None)
+
+
+def test_median_stopping_production_path_via_pod_annotations():
+    """No in-process executor (production shape): the metric-reporter
+    writes intermediate annotations on the pod; the TrialController
+    mirrors them into Trial.status, and the median rule stops the
+    underperformer and deletes its pod."""
+    import json
+
+    from kubeflow_tpu.api.crds import (
+        TRIAL_INTERMEDIATE_ANNOTATION as INTER,
+    )
+
+    with Cluster(ClusterConfig()) as c:
+        exp = _experiment(max_trials=3, parallel=3)
+        exp.spec.early_stopping.algorithm = "medianstop"
+        exp.spec.early_stopping.min_trials = 2
+        exp.spec.early_stopping.start_step = 1
+        c.store.create(exp)
+        assert c.wait_idle(timeout=20)
+        pods = sorted((p for p in c.store.list("Pod", "user1")
+                       if "trial-name" in p.metadata.labels),
+                      key=lambda p: p.metadata.name)
+        assert len(pods) == 3
+
+        def report(pod_name, inter, final=None):
+            for _ in range(8):
+                p = c.store.get("Pod", "user1", pod_name)
+                p.metadata.annotations[INTER] = json.dumps(inter)
+                if final is not None:
+                    p.metadata.annotations[TRIAL_METRIC_ANNOTATION] = \
+                        str(final)
+                    p.phase = "Succeeded"
+                try:
+                    c.store.update(p)
+                    return
+                except Exception:  # noqa: BLE001 — conflict: refetch
+                    continue
+            raise AssertionError("could not write report")
+
+        # two trials complete with good curves (the peer pool)
+        report(pods[0].metadata.name, [[1, 3.0], [2, 2.0]], final=2.0)
+        report(pods[1].metadata.name, [[1, 3.2], [2, 2.2]], final=2.2)
+        assert c.wait_idle(timeout=20)
+        # the third reports a clearly-worse curve and keeps "running"
+        report(pods[2].metadata.name, [[1, 9.0], [2, 9.0]])
+        assert c.wait_idle(timeout=20)
+
+        trials = sorted((t for t in c.store.list("Trial", "user1")),
+                        key=lambda t: t.metadata.name)
+        assert [t.status.phase for t in trials] == [
+            "Succeeded", "Succeeded", "EarlyStopped"], [
+                (t.metadata.name, t.status.phase, t.status.message)
+                for t in trials]
+        assert trials[2].status.value == 9.0
+        assert trials[2].status.intermediates == [[1, 9.0], [2, 9.0]]
+        # mirrored intermediates survive on the completed trials too
+        assert trials[0].status.intermediates == [[1, 3.0], [2, 2.0]]
+        # the stopped trial's pod is gone
+        assert c.store.try_get(
+            "Pod", "user1", f"{trials[2].metadata.name}-run") is None
+        exp = c.store.get("Experiment", "user1", "exp")
+        assert exp.status.trials_early_stopped == 1
+        assert exp.status.phase == "Succeeded"
