@@ -42,7 +42,7 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         "paths": ["kubeflow_tpu/serving/**"],
         "tests": ("python -m pytest tests/test_serving.py "
                   "tests/test_speculative.py tests/test_quant.py "
-                  "tests/test_continuous.py -q"),
+                  "tests/test_continuous.py tests/test_multilora.py -q"),
     },
     "native": {
         "paths": ["native/**", "kubeflow_tpu/data/**"],

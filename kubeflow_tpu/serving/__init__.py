@@ -26,5 +26,6 @@ from kubeflow_tpu.serving.engine import (
     LLAMA_FAMILY,
     MOE_LLAMA_FAMILY,
 )
+from kubeflow_tpu.serving.multilora import AdapterPack, build_pack
 from kubeflow_tpu.serving.quant import QTensor, quantize_blocks
 from kubeflow_tpu.serving.speculative import SpecStats, SpeculativeEngine

@@ -70,17 +70,20 @@ class SlotState:
     positions, which is the whole point of continuous batching.
     """
 
-    def __init__(self, k, v, length, offset, pad, tok):
+    def __init__(self, k, v, length, offset, pad, tok, aid=None):
         self.k = k            # [L, S, max_len, n_kv, hd]
         self.v = v
         self.length = length  # [S] int32 — filled cache slots per row
         self.offset = offset  # [S] int32 — left-pad count (rope shift)
         self.pad = pad        # [S, max_len] bool — padded cache cells
         self.tok = tok        # [S] int32 — last sampled token per row
+        if aid is None:       # multi-LoRA adapter id (0 = plain base)
+            aid = jnp.zeros(length.shape, jnp.int32)
+        self.aid = aid        # [S] int32
 
     def tree_flatten(self):
         return (self.k, self.v, self.length, self.offset, self.pad,
-                self.tok), None
+                self.tok, self.aid), None
 
     @classmethod
     def tree_unflatten(cls, _, children):
@@ -119,8 +122,11 @@ class ContinuousEngine:
         self.prefill_chunk = prefill_chunk
         # KV buffers dominate serving HBM: donate the old state so step
         # and insert update in place instead of holding two copies
-        # (same policy as the Trainer's donated TrainState).
-        self._step_jit = jax.jit(self._step, donate_argnums=(1,),
+        # (same policy as the Trainer's donated TrainState). The
+        # adapter pack rides as an ARGUMENT, not a closure — closed-over
+        # arrays bake into the lowered module as constants (see the
+        # params note in engine.InferenceEngine.__init__).
+        self._step_jit = jax.jit(self._step, donate_argnums=(2,),
                                  static_argnames=("steps",))
         self._insert_jit = jax.jit(self._insert, donate_argnums=(0,))
 
@@ -158,13 +164,17 @@ class ContinuousEngine:
         return b if b >= n_tokens else n_tokens
 
     def prefill_batch(self, token_lists: list[list[int]], bucket: int,
-                      samplings: list[dict[str, Any]], rng: jax.Array):
+                      samplings: list[dict[str, Any]], rng: jax.Array,
+                      adapter_ids: list[int] | None = None):
         """Prefill g prompts sharing one bucket in a single dispatch
         and sample each prompt's first token. Returns (batch-g
-        DecodeState, first tokens [g]) ready for `insert_row`.
+        DecodeState, first tokens [g], done [g]) ready for `insert`.
         Batching admissions matters under load: per-request prefill
         dispatch is the continuous design's other overhead tax next to
-        per-token stepping."""
+        per-token stepping. `adapter_ids` (multi-LoRA) selects each
+        row's resident fine-tune; when the engine carries an
+        adapter_pack the adapter arguments are ALWAYS passed (zeros by
+        default) so warmup and traffic share one jit signature."""
         eng = self.engine
         g = len(token_lists)
         arr = np.zeros((g, bucket), np.int32)
@@ -181,15 +191,21 @@ class ContinuousEngine:
             np.asarray([s.get("top_p", ec.top_p)
                         for s in samplings], np.float32),
             rng, batch=g)
+        adapters = ids = None
+        if eng.adapter_pack is not None:
+            adapters = eng.adapter_pack.blocks
+            ids = jnp.asarray(adapter_ids if adapter_ids is not None
+                              else [0] * g, jnp.int32)
         c = self.prefill_chunk
         if c and bucket > c and bucket % c == 0:
             state, first, _, done = eng.prefill_chunked(
                 eng.params, jnp.asarray(arr), eng.init_state(g), rng,
-                sp, jnp.asarray(mask), chunk=c)
+                sp, jnp.asarray(mask), chunk=c,
+                adapters=adapters, adapter_ids=ids)
         else:
             state, first, _, done = eng._prefill_jit(
                 eng.params, jnp.asarray(arr), eng.init_state(g), rng, sp,
-                jnp.asarray(mask))
+                jnp.asarray(mask), adapters=adapters, adapter_ids=ids)
         return state, first, done
 
     def prefill(self, tokens: list[int], max_new: int,
@@ -199,10 +215,10 @@ class ContinuousEngine:
             [tokens], self.bucket_for(len(tokens), max_new),
             [sampling], rng)
 
-    def _insert(self, st: SlotState, slot, pstate, row, first):
+    def _insert(self, st: SlotState, slot, pstate, row, first, aid):
         """Scatter row `row` of a prefilled batch-g DecodeState into
-        slot `slot`. Both indices are traced — one compile per prefill
-        batch size g serves every (slot, row) combination."""
+        slot `slot`. All indices are traced — one compile per prefill
+        batch size g serves every (slot, row, adapter) combination."""
         prow = jax.lax.dynamic_slice_in_dim(pstate.k, row, 1, axis=1)
         k = jax.lax.dynamic_update_slice(
             st.k, prow, (0, slot, 0, 0, 0))
@@ -213,12 +229,14 @@ class ContinuousEngine:
         offset = st.offset.at[slot].set(pstate.offset[row])
         pad = st.pad.at[slot].set(pstate.pad[row])
         tok = st.tok.at[slot].set(first[row])
-        return SlotState(k, v, length, offset, pad, tok)
+        aid_v = st.aid.at[slot].set(aid)
+        return SlotState(k, v, length, offset, pad, tok, aid_v)
 
     def insert(self, st: SlotState, slot: int, pstate, first,
-               row: int = 0) -> SlotState:
+               row: int = 0, aid: int = 0) -> SlotState:
         return self._insert_jit(st, jnp.asarray(slot, jnp.int32), pstate,
-                                jnp.asarray(row, jnp.int32), first)
+                                jnp.asarray(row, jnp.int32), first,
+                                jnp.asarray(aid, jnp.int32))
 
     def warmup(self, buckets=(16,), step_sizes=(1,)) -> int:
         """Compile the serving shape set ahead of traffic: prefill and
@@ -251,7 +269,8 @@ class ContinuousEngine:
 
     # -- decode -----------------------------------------------------------
 
-    def _decode_one(self, params, st: SlotState, sp: SamplingParams, rng):
+    def _decode_one(self, params, adapters, st: SlotState,
+                    sp: SamplingParams, rng):
         """One decode token for ALL slots at per-slot cursors.
 
         Mirrors `engine._forward_cached`'s s=1 case with every scalar
@@ -280,7 +299,14 @@ class ContinuousEngine:
         x = eng._embed(params, st.tok[:, None])
 
         def layer(x, scanned):
-            p, k_cache, v_cache = scanned
+            if adapters is None:
+                p, k_cache, v_cache = scanned
+                proj = None
+            else:
+                from kubeflow_tpu.serving.multilora import lora_proj
+                p, ab, k_cache, v_cache = scanned
+                proj = lora_proj(ab, st.aid,
+                                 eng.adapter_pack.scaling, cfg)
 
             def write_kv(k, v):
                 return (
@@ -297,21 +323,23 @@ class ContinuousEngine:
                     window=getattr(cfg, "sliding_window", None))
 
             return transformer_block(
-                cfg, fam, p, x, rope_positions, inv_freq, write_kv, attn)
+                cfg, fam, p, x, rope_positions, inv_freq, write_kv,
+                attn, proj)
 
-        x, (k_new, v_new) = jax.lax.scan(
-            layer, x, (params["blocks"], st.k, st.v))
+        xs = ((params["blocks"], st.k, st.v) if adapters is None
+              else (params["blocks"], adapters, st.k, st.v))
+        x, (k_new, v_new) = jax.lax.scan(layer, x, xs)
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
         logits = eng._head(params, x[:, -1])
         nxt = eng._sample(logits, sub, sp)
         st = SlotState(
             k_new, v_new,
             jnp.minimum(st.length + 1, ec.max_len),
-            st.offset, st.pad, nxt.astype(jnp.int32))
+            st.offset, st.pad, nxt.astype(jnp.int32), st.aid)
         return st, nxt, rng
 
-    def _step(self, params, st: SlotState, sp: SamplingParams, rng, *,
-              steps: int):
+    def _step(self, params, adapters, st: SlotState, sp: SamplingParams,
+              rng, *, steps: int):
         """`steps` decode tokens for all slots in ONE dispatch (a
         lax.scan over `_decode_one`). Chunking amortizes per-token host
         dispatch when no admission is waiting; the host drops back to
@@ -322,7 +350,7 @@ class ContinuousEngine:
 
         def body(carry, _):
             st, rng = carry
-            st, tok, rng = self._decode_one(params, st, sp, rng)
+            st, tok, rng = self._decode_one(params, adapters, st, sp, rng)
             return (st, rng), tok
 
         (st, rng), toks = jax.lax.scan(
@@ -331,8 +359,10 @@ class ContinuousEngine:
 
     def step(self, st: SlotState, sp: SamplingParams, rng,
              steps: int = 1):
-        return self._step_jit(self.engine.params, st, sp, rng,
-                              steps=steps)
+        pack = self.engine.adapter_pack
+        return self._step_jit(self.engine.params,
+                              None if pack is None else pack.blocks,
+                              st, sp, rng, steps=steps)
 
 
 class _Slot:
@@ -459,11 +489,21 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt {len(tokens)} + max_new {max_new} exceeds "
                 f"model max_len {cap}")
+        sampling = dict(sampling)
+        # multi-LoRA: the adapter name rides the sampling channel;
+        # resolve (and reject unknowns) HERE, before a slot is spent
+        adapter = sampling.get("adapter", "")
+        pack = self.engine.adapter_pack
+        if adapter and pack is None:
+            raise ValueError(
+                f"adapter {adapter!r} requested but no adapter pack "
+                "is loaded on this engine")
+        aid = pack.resolve(adapter) if pack else 0
         if self._worker is None or self._worker.done():
             self._worker = asyncio.get_event_loop().create_task(
                 self._run())
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        self._pending.append((tokens, max_new, dict(sampling), fut, queue))
+        self._pending.append((tokens, max_new, sampling, fut, queue, aid))
         self._wake.set()
         return fut
 
@@ -534,17 +574,18 @@ class ContinuousBatcher:
             samps = ([it[2] for it in group]
                      + [{"temperature": 0.0, "top_k": 0, "top_p": 1.0}]
                      * (gp - len(group)))
+            ids = [it[5] for it in group] + [0] * (gp - len(group))
             try:
                 async with self.gpu_lock:
                     pstate, first, _ = await loop.run_in_executor(
                         None, self.cengine.prefill_batch,
-                        lists, b, samps, sub)
+                        lists, b, samps, sub, ids)
             except Exception as e:  # noqa: BLE001
-                for *_, fut, queue in group:
+                for _, _, _, fut, queue, _ in group:
                     self._fail(fut, queue, e)
                 continue
             firsts = np.asarray(first)
-            for row, (tokens, max_new, sampling, fut, queue) in \
+            for row, (tokens, max_new, sampling, fut, queue, aid) in \
                     enumerate(group):
                 if fut.done():  # cancelled while prefilling
                     continue
@@ -555,7 +596,7 @@ class ContinuousBatcher:
                     async with self.gpu_lock:
                         self._st = await loop.run_in_executor(
                             None, self.cengine.insert, self._st, slot,
-                            pstate, first, row)
+                            pstate, first, row, aid)
                 except Exception as e:  # noqa: BLE001
                     self._free.append(slot)
                     self._fail(fut, queue, e)
@@ -639,7 +680,7 @@ class ContinuousBatcher:
             if not rec.fut.done():
                 rec.fut.set_exception(RuntimeError("server shutting down"))
         while self._pending:
-            *_, fut, queue = self._pending.popleft()
+            _, _, _, fut, queue, _ = self._pending.popleft()
             if queue is not None and not fut.done():
                 queue.put_nowait(None)
             if not fut.done():

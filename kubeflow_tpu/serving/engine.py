@@ -196,36 +196,42 @@ jax.tree_util.register_pytree_node(
 
 
 def transformer_block(cfg, fam: Family, p, x, rope_positions, inv_freq,
-                      write_kv, attn):
+                      write_kv, attn, proj=None):
     """One decoder block on `x` [b, s, h]: norms, QKV/output projections,
     rotary, gated MLP. The KV-cache write policy and the attention call
     are injected: prefill writes a contiguous [s]-slice at one shared
     scalar cursor (`_forward_cached`), the continuous-batching engine
     scatters a single step per row at per-slot cursors
-    (serving/continuous.py). Keeping every matmul/norm/activation in
-    ONE function is what makes the two serving paths provably the same
-    model — a drifted copy would silently change logits."""
+    (serving/continuous.py). `proj(name, h, w)` optionally wraps every
+    block matmul — multi-LoRA serving adds its per-row low-rank delta
+    there (serving/multilora.py) — and defaults to the plain matmul.
+    Keeping every matmul/norm/activation in ONE function is what makes
+    the serving paths provably the same model — a drifted copy would
+    silently change logits."""
+    if proj is None:
+        def proj(name, h, w):
+            return h @ w.astype(cfg.dtype)
+
     b, s = x.shape[:2]
     h = rms_norm(x, p["attn_norm"], cfg.norm_eps)
-    q = (h @ p["wq"].astype(cfg.dtype)).reshape(
-        b, s, cfg.num_heads, cfg.head_dim)
-    k = (h @ p["wk"].astype(cfg.dtype)).reshape(
+    q = proj("wq", h, p["wq"]).reshape(b, s, cfg.num_heads, cfg.head_dim)
+    k = proj("wk", h, p["wk"]).reshape(
         b, s, cfg.num_kv_heads, cfg.head_dim)
-    v = (h @ p["wv"].astype(cfg.dtype)).reshape(
+    v = proj("wv", h, p["wv"]).reshape(
         b, s, cfg.num_kv_heads, cfg.head_dim)
     q = apply_rope(q, rope_positions, inv_freq)
     k = apply_rope(k, rope_positions, inv_freq)
     k_cache, v_cache = write_kv(k, v)
     out = attn(q, k_cache, v_cache)
-    x = x + out.reshape(b, s, cfg.q_dim) @ p["wo"].astype(cfg.dtype)
+    x = x + proj("wo", out.reshape(b, s, cfg.q_dim), p["wo"])
 
     h = rms_norm(x, p["mlp_norm"], cfg.norm_eps)
     if fam.mlp is not None:
         x = x + fam.mlp(cfg, p, h)
     else:
-        gate = fam.gate_act(h @ p["w_gate"].astype(cfg.dtype))
-        ff = gate * (h @ p["w_up"].astype(cfg.dtype))
-        x = x + ff @ p["w_down"].astype(cfg.dtype)
+        gate = fam.gate_act(proj("w_gate", h, p["w_gate"]))
+        ff = gate * proj("w_up", h, p["w_up"])
+        x = x + proj("w_down", ff, p["w_down"])
     return x, (k_cache, v_cache)
 
 
@@ -237,11 +243,15 @@ class InferenceEngine:
     """
 
     def __init__(self, params: Params, cfg, family: Family,
-                 engine_config: EngineConfig = EngineConfig()):
+                 engine_config: EngineConfig = EngineConfig(),
+                 adapter_pack=None):
         self.params = params
         self.cfg = cfg
         self.family = family
         self.ec = engine_config
+        # Multi-LoRA: serving/multilora.AdapterPack of K resident
+        # fine-tunes; requests select per row (id 0 = plain base).
+        self.adapter_pack = adapter_pack
         # Params flow through every jitted entry point as an ARGUMENT
         # (deliberately NOT donated — self.params is reused every call).
         # Closing over self.params would embed the whole tree into the
@@ -271,7 +281,8 @@ class InferenceEngine:
         return x.astype(jnp.float32) @ head.astype(jnp.float32)
 
     def _forward_cached(self, params, tokens, state: DecodeState, *,
-                        prompt_mask=None, return_all: bool = False):
+                        prompt_mask=None, return_all: bool = False,
+                        adapters=None, adapter_ids=None):
         """Run [b, s] tokens starting at state.length; returns
         (last-position logits [b, vocab], updated state) — or all
         positions' logits [b, s, vocab] with return_all (speculative
@@ -310,7 +321,14 @@ class InferenceEngine:
         x = self._embed(params, tokens)
 
         def layer(x, scanned):
-            p, k_cache, v_cache = scanned
+            if adapters is None:
+                p, k_cache, v_cache = scanned
+                proj = None
+            else:
+                from kubeflow_tpu.serving.multilora import lora_proj
+                p, ab, k_cache, v_cache = scanned
+                proj = lora_proj(ab, adapter_ids,
+                                 self.adapter_pack.scaling, cfg)
 
             def write_kv(k, v):
                 return (
@@ -327,10 +345,12 @@ class InferenceEngine:
                     window=getattr(cfg, "sliding_window", None))
 
             return transformer_block(
-                cfg, fam, p, x, rope_positions, inv_freq, write_kv, attn)
+                cfg, fam, p, x, rope_positions, inv_freq, write_kv,
+                attn, proj)
 
-        x, (k_new, v_new) = jax.lax.scan(
-            layer, x, (params["blocks"], state.k, state.v))
+        xs = ((params["blocks"], state.k, state.v) if adapters is None
+              else (params["blocks"], adapters, state.k, state.v))
+        x, (k_new, v_new) = jax.lax.scan(layer, x, xs)
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
         logits = self._head(params, x if return_all else x[:, -1])
         return logits, DecodeState(k_new, v_new, start + s, pad, offset)
@@ -426,20 +446,23 @@ class InferenceEngine:
         return sp, rng
 
     def _prefill_sample(self, params, prompt, state, rng,
-                        sp: SamplingParams, prompt_mask):
+                        sp: SamplingParams, prompt_mask,
+                        adapters=None, adapter_ids=None):
         """Prefill + sample token #1. Shared head of generate and
         generate_stream so both follow the same rng discipline."""
         eos = self.ec.eos_token
         rng, sub = jax.random.split(rng)  # use-once key discipline
         logits, state = self._forward_cached(
-            params, prompt, state, prompt_mask=prompt_mask)
+            params, prompt, state, prompt_mask=prompt_mask,
+            adapters=adapters, adapter_ids=adapter_ids)
         first = self._sample(logits, sub, sp)
         done = (first == eos) if eos is not None else jnp.zeros(
             first.shape, bool)
         return state, first, rng, done
 
     def _decode_chunk(self, params, state, tok, rng, done,
-                      sp: SamplingParams, *, length: int):
+                      sp: SamplingParams, *, length: int,
+                      adapters=None, adapter_ids=None):
         """`length` decode steps from carry. Returns the new carry and
         the [b, length] tokens. The ONE step body both entry points
         scan over — stream-vs-oneshot equality is by construction."""
@@ -448,7 +471,9 @@ class InferenceEngine:
         def step(carry, _):
             state, tok, rng, done = carry
             rng, sub = jax.random.split(rng)
-            logits, state = self._forward_cached(params, tok[:, None], state)
+            logits, state = self._forward_cached(
+                params, tok[:, None], state,
+                adapters=adapters, adapter_ids=adapter_ids)
             nxt = self._sample(logits, sub, sp)
             if eos is not None:
                 # Sequences past EOS emit EOS forever (static shapes —
@@ -462,11 +487,14 @@ class InferenceEngine:
         return state, tok, rng, done, jnp.moveaxis(rest, 0, 1)
 
     def _generate(self, params, prompt, state, rng, sp: SamplingParams,
-                  prompt_mask, *, max_new: int):
+                  prompt_mask, *, max_new: int,
+                  adapters=None, adapter_ids=None):
         state, first, rng, done = self._prefill_sample(
-            params, prompt, state, rng, sp, prompt_mask)
+            params, prompt, state, rng, sp, prompt_mask,
+            adapters, adapter_ids)
         state, _, _, _, rest = self._decode_chunk(
-            params, state, first, rng, done, sp, length=max_new - 1)
+            params, state, first, rng, done, sp, length=max_new - 1,
+            adapters=adapters, adapter_ids=adapter_ids)
         toks = jnp.concatenate([first[:, None], rest], axis=1)
         return toks, state
 
@@ -481,6 +509,7 @@ class InferenceEngine:
         top_p: float | None = None,
         prompt_mask: jnp.ndarray | None = None,  # [b, s] bool, False=pad
         prefill_chunk: int | None = None,
+        adapter: "str | list[str] | None" = None,
     ) -> jnp.ndarray:
         """Generate `max_new` tokens after the prompt. Returns [b, max_new]
         (post-hoc EOS trimming is the caller's job — shapes stay static).
@@ -491,14 +520,30 @@ class InferenceEngine:
         (False entries), each row decodes as if it were unpadded.
         `prefill_chunk` prefills long prompts in fixed slices (see
         prefill_chunked) — same tokens, chunk-bounded compile shapes
-        and activation memory."""
+        and activation memory. `adapter` (needs an adapter_pack) picks
+        a resident LoRA fine-tune — one name for the whole batch or
+        one per row; ''/None rows decode the plain base."""
         sp, rng, prompt_mask, state = self._prep(
             prompt_tokens, max_new, rng, temperature, top_k, top_p,
             prompt_mask)
+        adapters = adapter_ids = None
+        if adapter is not None:
+            if self.adapter_pack is None:
+                raise ValueError("no adapter_pack loaded on this engine")
+            names = ([adapter] * prompt_tokens.shape[0]
+                     if isinstance(adapter, str) else list(adapter))
+            if len(names) != prompt_tokens.shape[0]:
+                raise ValueError(
+                    f"{len(names)} adapter names for a batch of "
+                    f"{prompt_tokens.shape[0]}")
+            adapters = self.adapter_pack.blocks
+            adapter_ids = jnp.asarray(
+                [self.adapter_pack.resolve(n) for n in names], jnp.int32)
         if prefill_chunk is None:
             toks, _ = self._generate_jit(
                 self.params, prompt_tokens, state, rng, sp, prompt_mask,
-                max_new=max_new)
+                max_new=max_new, adapters=adapters,
+                adapter_ids=adapter_ids)
             return toks
         if prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, got "
@@ -517,10 +562,12 @@ class InferenceEngine:
                 [jnp.zeros((b, pad), bool), prompt_mask], axis=1)
         state, first, rng, done = self.prefill_chunked(
             self.params, prompt_tokens, state, rng, sp, prompt_mask,
-            chunk=prefill_chunk)
+            chunk=prefill_chunk, adapters=adapters,
+            adapter_ids=adapter_ids)
         _, _, _, _, rest = self._chunk_jit(
             self.params, state, first, rng, done, sp,
-            length=max_new - 1)
+            length=max_new - 1, adapters=adapters,
+            adapter_ids=adapter_ids)
         return jnp.concatenate([first[:, None], rest], axis=1)
 
     def _prep(self, prompt_tokens, max_new, rng, temperature, top_k,
@@ -610,7 +657,8 @@ class InferenceEngine:
         return jax.jit(self._forward_cached)
 
     def prefill_chunked(self, params, prompt, state, rng,
-                        sp: SamplingParams, prompt_mask, *, chunk: int):
+                        sp: SamplingParams, prompt_mask, *, chunk: int,
+                        adapters=None, adapter_ids=None):
         """Prefill in fixed `chunk`-token slices through the
         incremental cache, then sample token #1 from the final slice.
 
@@ -636,7 +684,9 @@ class InferenceEngine:
             sl = slice(i * chunk, (i + 1) * chunk)
             _, state = self._forward_jit(
                 params, prompt[:, sl], state,
-                prompt_mask=prompt_mask[:, sl])
+                prompt_mask=prompt_mask[:, sl],
+                adapters=adapters, adapter_ids=adapter_ids)
         return self._prefill_jit(
             params, prompt[:, n - chunk:], state, rng, sp,
-            prompt_mask[:, n - chunk:])
+            prompt_mask[:, n - chunk:],
+            adapters=adapters, adapter_ids=adapter_ids)

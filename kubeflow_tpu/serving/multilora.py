@@ -1,0 +1,128 @@
+"""Multi-LoRA serving: N fine-tuned adapters resident over ONE base.
+
+The S-LoRA idea restated TPU-first: fine-tunes of the same base differ
+only by rank-r adapters (~0.1% of params), so serving N of them as N
+merged models wastes N× HBM. Instead the adapters are STACKED into one
+pack and every request carries an adapter id; the decode batch mixes
+requests for different fine-tunes (and the plain base) in one SPMD
+program:
+
+    y = h @ W  +  scaling * (h @ A[id]) @ B[id]
+
+- Pack layout is layer-leading ([L, K, d, r]) so the SAME `lax.scan`
+  layer loop slices adapters beside the block weights — no second loop,
+  no dynamic shapes.
+- `A[id]` is a per-row gather over the K axis: each row reads only its
+  own adapter's weights (HBM cost ∝ selected adapters, not K).
+- Index 0 is reserved as an all-zeros adapter: base-model requests ride
+  the same program and the delta contributes exactly nothing — one
+  compiled path, no cond.
+- The low-rank delta is applied UNMERGED (two skinny matmuls) — unlike
+  training, which merges W+AB per step (train/lora.py): serving cannot
+  merge per request without materializing a full per-request W.
+
+Reference parity: none (the reference has no serving runtime at all);
+this closes the train→serve loop for `train/lora.py` checkpoints.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.train.lora import LoraConfig, _TARGET_DIMS
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class AdapterPack:
+    """K named adapters stacked per target: A [L, K, d_in, r],
+    B [L, K, r, d_out]; id 0 is the reserved zero adapter ("")."""
+
+    blocks: Params
+    scaling: float
+    names: dict[str, int]        # adapter name -> pack index (1-based)
+
+    def resolve(self, name: str) -> int:
+        """'' or None -> the zero adapter; unknown names raise."""
+        if not name:
+            return 0
+        try:
+            return self.names[name]
+        except KeyError:
+            raise ValueError(
+                f"unknown adapter {name!r}; loaded: "
+                f"{sorted(self.names)}") from None
+
+
+def build_pack(cfg, lora_cfg: LoraConfig,
+               adapters: dict[str, Params],
+               dtype=None) -> AdapterPack:
+    """Stack `train/lora.py`-layout adapter trees ({"blocks": {name:
+    {"A": [L, d_in, r], "B": [L, r, d_out]}}}) into one pack. Every
+    adapter must cover the same targets at the same rank (one gather
+    index must address one homogeneous array)."""
+    if not adapters:
+        raise ValueError("need at least one adapter")
+    names = sorted(adapters)
+    targets = list(lora_cfg.targets)
+    L = cfg.num_layers
+    blocks: Params = {}
+    for t in targets:
+        d_in = getattr(cfg, _TARGET_DIMS[t][0])
+        d_out = getattr(cfg, _TARGET_DIMS[t][1])
+        a_stack = [np.zeros((L, d_in, lora_cfg.rank), np.float32)]
+        b_stack = [np.zeros((L, lora_cfg.rank, d_out), np.float32)]
+        for n in names:
+            try:
+                ab = adapters[n]["blocks"][t]
+            except KeyError:
+                raise ValueError(
+                    f"adapter {n!r} missing target {t!r}") from None
+            a, b = np.asarray(ab["A"], np.float32), np.asarray(
+                ab["B"], np.float32)
+            if a.shape != a_stack[0].shape or b.shape != b_stack[0].shape:
+                raise ValueError(
+                    f"adapter {n!r} target {t!r}: shape "
+                    f"{a.shape}/{b.shape} != expected "
+                    f"{a_stack[0].shape}/{b_stack[0].shape} "
+                    "(same rank/targets required across the pack)")
+            a_stack.append(a)
+            b_stack.append(b)
+        dt = dtype if dtype is not None else cfg.dtype
+        # [K+1, L, ...] -> layer-leading [L, K+1, ...] for the scan
+        blocks[t] = {
+            "A": jnp.asarray(np.stack(a_stack, axis=0), dt
+                             ).swapaxes(0, 1),
+            "B": jnp.asarray(np.stack(b_stack, axis=0), dt
+                             ).swapaxes(0, 1),
+        }
+    return AdapterPack(
+        blocks=blocks,
+        scaling=lora_cfg.scaling,
+        names={n: i + 1 for i, n in enumerate(names)},
+    )
+
+
+def lora_proj(layer_pack: Params, ids, scaling: float, cfg):
+    """Projection hook for `engine.transformer_block`: base matmul plus
+    the per-row low-rank delta. `layer_pack` is one layer's slice
+    ({name: {"A": [K, d_in, r], "B": [K, r, d_out]}}), `ids` [b] int32.
+    Targets without adapters fall through to the plain matmul."""
+
+    def proj(name: str, h, w):
+        y = h @ w.astype(cfg.dtype)
+        ab = layer_pack.get(name)
+        if ab is None:
+            return y
+        a = ab["A"][ids].astype(cfg.dtype)     # [b, d_in, r] gather
+        b = ab["B"][ids].astype(cfg.dtype)     # [b, r, d_out]
+        delta = jnp.einsum("bsr,bro->bso",
+                           jnp.einsum("bsd,bdr->bsr", h, a), b)
+        return y + jnp.asarray(scaling, cfg.dtype) * delta
+
+    return proj

@@ -295,6 +295,8 @@ async def list_models(request: web.Request):
             "hidden_size": eng.cfg.hidden_size,
             "num_layers": eng.cfg.num_layers,
         }
+        if eng.adapter_pack is not None:
+            entry["adapters"] = sorted(eng.adapter_pack.names)
         batcher = request.app[BATCHERS_KEY].get(name)
         if batcher is not None:
             # coalescing evidence: for the window Batcher, mean
@@ -472,6 +474,19 @@ async def generate(request: web.Request):
             return web.json_response(
                 {"error": "top_p must be in (0, 1]"}, status=400)
         sampling["top_p"] = float(top_p)
+    adapter = body.get("adapter", "")
+    if not isinstance(adapter, str):
+        return web.json_response(
+            {"error": "adapter must be a string"}, status=400)
+    if adapter:
+        if engine.adapter_pack is None:
+            return web.json_response(
+                {"error": f"model {name!r} has no adapters loaded"},
+                status=400)
+        try:
+            engine.adapter_pack.resolve(adapter)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
     lens = {len(t) for t in token_lists}
     if len(lens) != 1:
         return web.json_response(
@@ -522,14 +537,25 @@ async def generate(request: web.Request):
         if isinstance(cbatcher, ContinuousBatcher) and arr.shape[0] == 1:
             # a continuous-batched stream shares the slot batch with
             # every other request instead of holding the GPU per chunk
+            if adapter:
+                sampling["adapter"] = adapter
             return await _stream_continuous(
                 request, cbatcher, arr, max_new_req, sampling,
                 text_mode, tokenizer)
+        if adapter:
+            return web.json_response(
+                {"error": "adapter streaming requires continuous "
+                          "batching (create_serving_app continuous)"},
+                status=400)
         return await _stream_generate(
             request, engine, arr, max_new_req, sampling, text_mode,
             tokenizer)
 
     resp_extra: dict[str, Any] = {}
+    if speculative and adapter:
+        return web.json_response(
+            {"error": "adapter does not compose with speculative"},
+            status=400)
     if speculative:
         spec = request.app[SPEC_KEY].get(name)
         if spec is None:
@@ -585,13 +611,21 @@ async def generate(request: web.Request):
             "gamma": gamma,  # the EFFECTIVE (bucketed) window
         }
     elif (batcher := request.app[BATCHERS_KEY].get(name)) is not None \
-            and arr.shape[0] == 1:
+            and arr.shape[0] == 1 \
+            and (not adapter or isinstance(batcher, ContinuousBatcher)):
         # single-prompt requests ride the dynamic batcher; explicit
-        # client-side batches keep their one-shot path
+        # client-side batches keep their one-shot path. Adapter
+        # requests ride the CONTINUOUS batcher (per-slot ids); under a
+        # window batcher they fall through to the direct path, which
+        # supports adapters batch-uniformly.
+        if adapter:
+            sampling["adapter"] = adapter
         ids = await batcher.submit(
             arr[0].tolist(), max_new_req, tuple(sorted(sampling.items())))
         toks = np.asarray([ids], np.int32)
     else:
+        if adapter:
+            sampling["adapter"] = adapter  # engine.generate kwarg
         async with request.app[GPU_LOCK_KEY]:
             toks = await asyncio.get_event_loop().run_in_executor(
                 None,
