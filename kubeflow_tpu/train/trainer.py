@@ -127,6 +127,12 @@ class TrainConfig:
     b1: float = 0.9
     b2: float = 0.95
     grad_clip: float = 1.0
+    # Gradient accumulation: split each step's batch into this many
+    # microbatches and average their grads (mask-weighted, fp32
+    # accumulator) before ONE optimizer update — the peak-activation
+    # memory of a batch/grad_accum step at the optimizer behavior of
+    # the full batch. 1 = off.
+    grad_accum: int = 1
 
 
 class TrainState:
@@ -259,13 +265,49 @@ class Trainer:
         return self._build_state(self.init_fn(rng))
 
     def _step(self, state: TrainState, tokens, targets, mask):
-        def loss_fn(params):
+        def loss_fn(params, toks, tgts, m):
             if self.loss_fn is not None:
-                return self.loss_fn(params, tokens, targets, mask)
-            logits = self.apply_fn(params, tokens)
-            return cross_entropy_loss(logits, targets, mask)
+                return self.loss_fn(params, toks, tgts, m)
+            logits = self.apply_fn(params, toks)
+            return cross_entropy_loss(logits, tgts, m)
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        acc = self.tc.grad_accum
+        if acc <= 1:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                state.params, tokens, targets, mask)
+        else:
+            # lax.scan over microbatches: ONE compiled micro-step,
+            # peak activations 1/acc of the full batch. Each micro
+            # loss is a masked MEAN, so grads/losses are re-weighted
+            # by the micro's mask mass — mathematically identical to
+            # the full-batch step (summation order aside), which the
+            # parity test pins to tight tolerance.
+            b = tokens.shape[0]
+            mb = b // acc
+            split = lambda a: a.reshape(acc, mb, *a.shape[1:])  # noqa: E731
+            xs = (split(tokens), split(targets), split(mask))
+            g0 = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+
+            def micro(carry, x):
+                gsum, lsum, wsum = carry
+                toks, tgts, m = x
+                l_, g_ = jax.value_and_grad(loss_fn)(
+                    state.params, toks, tgts, m)
+                w = jnp.sum(m.astype(jnp.float32))
+                gsum = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32) * w, gsum, g_)
+                return (gsum, lsum + l_.astype(jnp.float32) * w,
+                        wsum + w), None
+
+            (gsum, lsum, wsum), _ = jax.lax.scan(
+                micro, (g0, jnp.zeros((), jnp.float32),
+                        jnp.zeros((), jnp.float32)), xs)
+            denom = jnp.maximum(wsum, 1.0)
+            grads = jax.tree.map(
+                lambda g, p: (g / denom).astype(p.dtype), gsum,
+                state.params)
+            loss = lsum / denom
         updates, opt_state = self.optimizer.update(
             grads, state.opt_state, state.params
         )
@@ -287,6 +329,11 @@ class Trainer:
     def step(self, state: TrainState, tokens, targets, mask=None):
         if mask is None:
             mask = jnp.ones_like(tokens, dtype=jnp.float32)
+        if self.tc.grad_accum > 1 \
+                and tokens.shape[0] % self.tc.grad_accum:
+            raise ValueError(
+                f"batch {tokens.shape[0]} not divisible by grad_accum "
+                f"{self.tc.grad_accum}")
         with jax.set_mesh(self.mesh):
             return self._jit_step(state, tokens, targets, mask)
 

@@ -275,3 +275,51 @@ def test_trainer_with_chunked_loss_matches_dense_trainer():
         np.testing.assert_allclose(
             np.asarray(vc), np.asarray(vd), rtol=5e-3, atol=3e-4,
             err_msg=jax.tree_util.keystr(kd))
+
+
+@pytest.mark.slow
+def test_grad_accum_matches_full_batch_step():
+    """grad_accum=N must produce the same loss and (to summation-order
+    tolerance) the same updated params as the full-batch step — the
+    mask-weighted averaging is what makes ragged masks exact."""
+    mesh = create_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+
+    def build(acc):
+        return Trainer(
+            mesh=mesh,
+            apply_fn=lambda p, t: llama.apply(p, CFG, t),
+            init_fn=lambda k: llama.init(k, CFG),
+            logical_axes=llama.param_logical_axes(CFG),
+            train_config=TrainConfig(
+                learning_rate=1e-2, warmup_steps=2, total_steps=50,
+                grad_accum=acc),
+        )
+
+    rng = np.random.default_rng(9)
+    tokens = jnp.asarray(rng.integers(0, CFG.vocab_size, (8, 16)),
+                         jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    # ragged mask: rows carry different token counts, so unweighted
+    # micro averaging would be wrong and this test would catch it
+    mask = jnp.asarray(
+        (np.arange(16)[None, :] < rng.integers(4, 17, (8, 1)))
+        .astype(np.float32))
+
+    ref_t = build(1)
+    state = ref_t.init(jax.random.key(0))
+    ref_state, ref_loss = ref_t.step(state, tokens, targets, mask)
+
+    for acc in (2, 4):
+        t = build(acc)
+        s = t.init(jax.random.key(0))
+        s2, loss = t.step(s, tokens, targets, mask)
+        assert float(loss) == pytest.approx(float(ref_loss), rel=1e-5)
+        ref_leaves = jax.tree.leaves(ref_state.params)
+        got_leaves = jax.tree.leaves(s2.params)
+        for a, b in zip(ref_leaves, got_leaves):
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(a)),
+                np.asarray(jax.device_get(b)), rtol=2e-4, atol=2e-6)
+
+    with pytest.raises(ValueError, match="not divisible"):
+        build(3).step(ref_state, tokens, targets, mask)
