@@ -146,14 +146,16 @@ class ContinuousEngine:
 
     # -- admission --------------------------------------------------------
 
-    def bucket_for(self, n_tokens: int, max_new: int) -> int:
+    def bucket_for(self, n_tokens: int, max_new: int,
+                   reserve: int = 0) -> int:
         """Prefill bucket for one request: power-of-two (or, past one
         chunk with chunked prefill enabled, the ceil chunk multiple),
         falling back to the EXACT length when the bucket plus this
         request's max_new would overrun the cache (bucket pads occupy
         cache cells, so a bucket the admission check never saw could
-        silently clamp the last decode writes otherwise)."""
-        cap = self.engine.ec.max_len
+        silently clamp the last decode writes otherwise). `reserve` is
+        cache already spoken for (a shared prefix's length)."""
+        cap = self.engine.ec.max_len - reserve
         c = self.prefill_chunk
         if c and n_tokens > c:
             bc = -(-n_tokens // c) * c
@@ -165,7 +167,8 @@ class ContinuousEngine:
 
     def prefill_batch(self, token_lists: list[list[int]], bucket: int,
                       samplings: list[dict[str, Any]], rng: jax.Array,
-                      adapter_ids: list[int] | None = None):
+                      adapter_ids: list[int] | None = None,
+                      prefix_state=None):
         """Prefill g prompts sharing one bucket in a single dispatch
         and sample each prompt's first token. Returns (batch-g
         DecodeState, first tokens [g], done [g]) ready for `insert`.
@@ -174,7 +177,12 @@ class ContinuousEngine:
         per-token stepping. `adapter_ids` (multi-LoRA) selects each
         row's resident fine-tune; when the engine carries an
         adapter_pack the adapter arguments are ALWAYS passed (zeros by
-        default) so warmup and traffic share one jit signature."""
+        default) so warmup and traffic share one jit signature.
+        `prefix_state` (a batch-1 `engine.precompute_prefix` result)
+        seeds every row with shared-prefix KV: only the suffix
+        prefills, and since `state.length` is traced data the SAME
+        compiled prefill program serves prefixed and plain
+        admissions."""
         eng = self.engine
         g = len(token_lists)
         arr = np.zeros((g, bucket), np.int32)
@@ -196,15 +204,24 @@ class ContinuousEngine:
             adapters = eng.adapter_pack.blocks
             ids = jnp.asarray(adapter_ids if adapter_ids is not None
                               else [0] * g, jnp.int32)
+        if prefix_state is None:
+            state0 = eng.init_state(g)
+        else:
+            from kubeflow_tpu.serving.engine import DecodeState
+            ps = prefix_state
+            state0 = DecodeState(
+                jnp.repeat(ps.k, g, axis=1), jnp.repeat(ps.v, g, axis=1),
+                ps.length, jnp.repeat(ps.pad, g, axis=0),
+                jnp.repeat(ps.offset, g, axis=0))
         c = self.prefill_chunk
         if c and bucket > c and bucket % c == 0:
             state, first, _, done = eng.prefill_chunked(
-                eng.params, jnp.asarray(arr), eng.init_state(g), rng,
+                eng.params, jnp.asarray(arr), state0, rng,
                 sp, jnp.asarray(mask), chunk=c,
                 adapters=adapters, adapter_ids=ids)
         else:
             state, first, _, done = eng._prefill_jit(
-                eng.params, jnp.asarray(arr), eng.init_state(g), rng, sp,
+                eng.params, jnp.asarray(arr), state0, rng, sp,
                 jnp.asarray(mask), adapters=adapters, adapter_ids=ids)
         return state, first, done
 
@@ -392,6 +409,7 @@ class ContinuousBatcher:
     def __init__(self, engine: InferenceEngine, gpu_lock: asyncio.Lock,
                  *, max_slots: int = 8, chunk: int = 4,
                  prefill_chunk: int | None = None,
+                 prefixes: dict[str, list[int]] | None = None,
                  window_ms: float = 0.0):
         # window_ms accepted (and ignored) for constructor parity with
         # Batcher: admission is per-token here, there is no window.
@@ -407,6 +425,16 @@ class ContinuousBatcher:
         self.chunk = chunk
         self.cengine = ContinuousEngine(engine, max_slots,
                                         prefill_chunk=prefill_chunk)
+        # Shared prefixes (system prompts): token lists registered at
+        # construction; each computes its KV ONCE, lazily, on first use
+        # (device work belongs under the gpu lock, not in __init__).
+        self._prefixes = dict(prefixes or {})
+        for pname, ptoks in self._prefixes.items():
+            if not ptoks or len(ptoks) >= engine.ec.max_len:
+                raise ValueError(
+                    f"prefix {pname!r}: length {len(ptoks)} invalid "
+                    f"for max_len {engine.ec.max_len}")
+        self._prefix_states: dict[str, Any] = {}
         self.engine = engine
         self.gpu_lock = gpu_lock
         self.calls = 0            # decode steps (device invocations)
@@ -499,11 +527,29 @@ class ContinuousBatcher:
                 f"adapter {adapter!r} requested but no adapter pack "
                 "is loaded on this engine")
         aid = pack.resolve(adapter) if pack else 0
+        prefix = sampling.get("prefix", "")
+        if prefix:
+            if prefix not in self._prefixes:
+                raise ValueError(
+                    f"unknown prefix {prefix!r}; registered: "
+                    f"{sorted(self._prefixes)}")
+            if adapter:
+                # prefix KV is computed with the BASE weights; reusing
+                # it under an adapter would silently serve a hybrid
+                raise ValueError(
+                    "prefix does not compose with adapter (the shared "
+                    "KV is base-model KV)")
+            plen = len(self._prefixes[prefix])
+            if plen + len(tokens) + max_new > cap:
+                raise ValueError(
+                    f"prefix {plen} + prompt {len(tokens)} + max_new "
+                    f"{max_new} exceeds model max_len {cap}")
         if self._worker is None or self._worker.done():
             self._worker = asyncio.get_event_loop().create_task(
                 self._run())
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        self._pending.append((tokens, max_new, sampling, fut, queue, aid))
+        self._pending.append(
+            (tokens, max_new, sampling, fut, queue, aid, prefix))
         self._wake.set()
         return fut
 
@@ -551,17 +597,30 @@ class ContinuousBatcher:
         if not fut.done():
             fut.set_exception(exc)
 
+    async def _get_prefix_state(self, name: str):
+        """Lazily compute (once) a registered prefix's KV."""
+        if name in self._prefix_states:
+            return self._prefix_states[name]
+        loop = asyncio.get_event_loop()
+        async with self.gpu_lock:
+            st = await loop.run_in_executor(
+                None, self.engine.precompute_prefix, self._prefixes[name])
+        self._prefix_states[name] = st
+        return st
+
     async def _admit_group(self, items: list) -> None:
         """Admit up to len(self._free) requests; items sharing a
-        prefill bucket share ONE prefill dispatch. A prefill failure
-        fails its bucket group only; an insert failure fails that
-        request only."""
+        prefill bucket AND prefix share ONE prefill dispatch. A prefill
+        failure fails its bucket group only; an insert failure fails
+        that request only."""
         loop = asyncio.get_event_loop()
-        groups: dict[int, list] = {}
+        groups: dict[tuple, list] = {}
         for item in items:
-            b = self.cengine.bucket_for(len(item[0]), item[1])
-            groups.setdefault(b, []).append(item)
-        for b, group in groups.items():
+            prefix = item[6]
+            reserve = len(self._prefixes[prefix]) if prefix else 0
+            b = self.cengine.bucket_for(len(item[0]), item[1], reserve)
+            groups.setdefault((b, prefix), []).append(item)
+        for (b, prefix), group in groups.items():
             self._rng, sub = jax.random.split(self._rng)
             # pad the group to a power of two with greedy dummy rows:
             # prefill/insert shapes come from a SET of log2(max_slots)
@@ -576,16 +635,18 @@ class ContinuousBatcher:
                      * (gp - len(group)))
             ids = [it[5] for it in group] + [0] * (gp - len(group))
             try:
+                pstate0 = (await self._get_prefix_state(prefix)
+                           if prefix else None)
                 async with self.gpu_lock:
                     pstate, first, _ = await loop.run_in_executor(
                         None, self.cengine.prefill_batch,
-                        lists, b, samps, sub, ids)
+                        lists, b, samps, sub, ids, pstate0)
             except Exception as e:  # noqa: BLE001
-                for _, _, _, fut, queue, _ in group:
+                for _, _, _, fut, queue, _, _ in group:
                     self._fail(fut, queue, e)
                 continue
             firsts = np.asarray(first)
-            for row, (tokens, max_new, sampling, fut, queue, aid) in \
+            for row, (tokens, max_new, sampling, fut, queue, aid, _) in \
                     enumerate(group):
                 if fut.done():  # cancelled while prefilling
                     continue
@@ -680,7 +741,7 @@ class ContinuousBatcher:
             if not rec.fut.done():
                 rec.fut.set_exception(RuntimeError("server shutting down"))
         while self._pending:
-            _, _, _, fut, queue, _ = self._pending.popleft()
+            _, _, _, fut, queue, _, _ = self._pending.popleft()
             if queue is not None and not fut.done():
                 queue.put_nowait(None)
             if not fut.done():

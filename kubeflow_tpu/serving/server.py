@@ -208,6 +208,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                        max_batch: int = 8, continuous: bool = False,
                        warmup: bool = False,
                        prefill_chunk: int | None = None,
+                       prefixes: dict[str, list[int]] | None = None,
                        drafts: dict[str, InferenceEngine] | None = None,
                        ) -> web.Application:
     """`tokenizer` (data.bpe.Tokenizer or anything with encode/decode)
@@ -249,10 +250,13 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     app[GPU_LOCK_KEY] = lock
     if continuous:
         # prefill_chunk: long prompts admit in fixed slices — chunk-
-        # multiple buckets, one [g, chunk] compile for every length
+        # multiple buckets, one [g, chunk] compile for every length.
+        # prefixes: named system prompts whose KV computes once; a
+        # request opts in with {"prefix": name}.
         app[BATCHERS_KEY] = {
             name: ContinuousBatcher(eng, lock, max_slots=max_batch,
-                                    prefill_chunk=prefill_chunk)
+                                    prefill_chunk=prefill_chunk,
+                                    prefixes=prefixes)
             for name, eng in engines.items()}
         if warmup:
             async def _warm(app_):
@@ -310,6 +314,9 @@ async def list_models(request: web.Request):
             if isinstance(batcher, ContinuousBatcher):
                 entry["batcher_mode"] = "continuous"
                 entry["occupancy"] = round(batcher.occupancy(), 3)
+                if batcher._prefixes:
+                    entry["prefixes"] = {
+                        n: len(t) for n, t in batcher._prefixes.items()}
             else:
                 entry["batcher_mode"] = "window"
         out.append(entry)
@@ -487,6 +494,10 @@ async def generate(request: web.Request):
             engine.adapter_pack.resolve(adapter)
         except ValueError as e:
             return web.json_response({"error": str(e)}, status=400)
+    prefix = body.get("prefix", "")
+    if not isinstance(prefix, str):
+        return web.json_response(
+            {"error": "prefix must be a string"}, status=400)
     lens = {len(t) for t in token_lists}
     if len(lens) != 1:
         return web.json_response(
@@ -497,6 +508,35 @@ async def generate(request: web.Request):
         return web.json_response(
             {"error": f"prompt {prompt_len} + max_new {max_new} exceeds "
                       f"model max_len {engine.ec.max_len}"}, status=400)
+    if prefix:
+        pbatcher = request.app[BATCHERS_KEY].get(name)
+        if not isinstance(pbatcher, ContinuousBatcher):
+            return web.json_response(
+                {"error": "prefix requires continuous batching"},
+                status=400)
+        if prefix not in pbatcher._prefixes:
+            return web.json_response(
+                {"error": f"unknown prefix {prefix!r}; registered: "
+                          f"{sorted(pbatcher._prefixes)}"}, status=400)
+        if adapter:
+            return web.json_response(
+                {"error": "prefix does not compose with adapter"},
+                status=400)
+        if len(token_lists) != 1:
+            return web.json_response(
+                {"error": "prefix requests are single-prompt"},
+                status=400)
+        if body.get("speculative", False) is True:
+            return web.json_response(
+                {"error": "prefix does not compose with speculative"},
+                status=400)
+        plen = len(pbatcher._prefixes[prefix])
+        if plen + prompt_len + max_new > engine.ec.max_len:
+            return web.json_response(
+                {"error": f"prefix {plen} + prompt {prompt_len} + "
+                          f"max_new {max_new} exceeds model max_len "
+                          f"{engine.ec.max_len}"}, status=400)
+        sampling["prefix"] = prefix
     vocab = engine.cfg.vocab_size
     try:
         arr = np.asarray(token_lists, dtype=np.int32)

@@ -375,3 +375,74 @@ async def test_continuous_long_prompt_admits_in_chunks():
         batcher.submit(short_p, 6, ()))
     assert got_l == want_l and got_s == want_s
     await batcher.close()
+
+
+@pytest.mark.slow
+async def test_shared_prefix_decodes_like_full_prompt():
+    """A request with a registered prefix must decode exactly what the
+    full concatenated prompt decodes — but the prefix KV computes once
+    per server, not per request. Mixed admissions (prefixed and plain)
+    share the slot batch."""
+    engine, cfg = _engine(max_len=96)
+    gen = np.random.default_rng(20)
+    sys_prompt = gen.integers(0, cfg.vocab_size, 23).tolist()
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=4,
+                                prefixes={"sys": sys_prompt})
+    p1 = gen.integers(0, cfg.vocab_size, 6).tolist()
+    p2 = gen.integers(0, cfg.vocab_size, 11).tolist()
+    plain = gen.integers(0, cfg.vocab_size, 5).tolist()
+    want1 = _solo(engine, sys_prompt + p1, 5)
+    want2 = _solo(engine, sys_prompt + p2, 5)
+    want_plain = _solo(engine, plain, 5)
+    got1, got2, got_plain = await asyncio.gather(
+        batcher.submit(p1, 5, (("prefix", "sys"),)),
+        batcher.submit(p2, 5, (("prefix", "sys"),)),
+        batcher.submit(plain, 5, ()))
+    assert got1 == want1
+    assert got2 == want2
+    assert got_plain == want_plain
+    # prefix KV computed exactly once and cached
+    assert set(batcher._prefix_states) == {"sys"}
+    # slot reuse after a prefixed request leaks nothing
+    got3 = await batcher.submit(plain, 5, (("prefix", "sys"),))
+    assert got3 == _solo(engine, sys_prompt + plain, 5)
+    with pytest.raises(ValueError, match="unknown prefix"):
+        await batcher.submit(p1, 5, (("prefix", "nope"),))
+    with pytest.raises(ValueError, match="exceeds"):
+        await batcher.submit(p1, 96 - 23 - len(p1) + 1,
+                             (("prefix", "sys"),))
+    await batcher.close()
+
+
+@pytest.mark.slow
+async def test_rest_prefix_requests():
+    engine, cfg = _engine(max_len=96)
+    gen = np.random.default_rng(21)
+    sys_prompt = gen.integers(0, cfg.vocab_size, 17).tolist()
+    app = server_lib.create_serving_app(
+        {"m": engine}, continuous=True, max_batch=4,
+        prefixes={"sys": sys_prompt})
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    p = gen.integers(0, cfg.vocab_size, 5).tolist()
+    want = _solo(engine, sys_prompt + p, 4)
+
+    r = await client.post("/v1/models/m:generate",
+                          json={"tokens": [p], "max_new": 4,
+                                "prefix": "sys"})
+    assert r.status == 200, await r.text()
+    assert (await r.json())["tokens"][0] == want
+
+    r = await client.get("/v1/models")
+    card = (await r.json())["models"][0]
+    assert card["prefixes"] == {"sys": 17}
+
+    r = await client.post("/v1/models/m:generate",
+                          json={"tokens": [p], "max_new": 4,
+                                "prefix": "nope"})
+    assert r.status == 400
+    r = await client.post("/v1/models/m:generate",
+                          json={"tokens": [p], "max_new": 4,
+                                "prefix": "sys", "speculative": True})
+    assert r.status == 400
+    await client.close()
