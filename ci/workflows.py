@@ -32,7 +32,7 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                   "tests/test_culler.py tests/test_gateway.py "
                   "tests/test_profile_kfam.py tests/test_profile_plugins.py "
                   "tests/test_tensorboard.py tests/test_metrics.py "
-                  "tests/test_hpo.py -q"),
+                  "tests/test_hpo.py tests/test_modelserver.py -q"),
     },
     "web": {
         "paths": ["kubeflow_tpu/web/**"],

@@ -311,6 +311,43 @@ def tensorboard_lifecycle(alice: Client, admin: Client) -> None:
     assert status == 200, status
 
 
+@phase("modelserver-lifecycle")
+def modelserver_lifecycle(alice: Client, admin: Client) -> None:
+    """A ModelServer through the versioned API door: the controller
+    renders the serving Deployment (CLI flags from the spec), the fake
+    kubelet readies it, and status mirrors ready + route URL."""
+    ms = {"kind": "ModelServer", "apiVersion": "kubeflow-tpu.dev/v1",
+          "metadata": {"name": "e2e-srv"},
+          "spec": {"model": "llama-tiny",
+                   "checkpoint": "pvc://e2e-nb-workspace/ckpt",
+                   "max_len": 256, "continuous": True, "warmup": True}}
+    status, out = alice.api(
+        "POST", "/apis/kubeflow-tpu.dev/v1/namespaces/alice/modelservers",
+        ms)
+    assert status == 201, (status, out)
+
+    def ready():
+        _, r = alice.req(
+            "GET",
+            "/apis/kubeflow-tpu.dev/v1/namespaces/alice/modelservers/"
+            "e2e-srv")
+        return r if isinstance(r, dict) and r.get("status", {}).get(
+            "ready") else None
+
+    got = poll("modelserver ready", ready)
+    assert got["status"]["url"] == "/serving/alice/e2e-srv/", got["status"]
+    status, _ = alice.api(
+        "DELETE",
+        "/apis/kubeflow-tpu.dev/v1/namespaces/alice/modelservers/e2e-srv")
+    assert status == 200, status
+    poll("serving deployment cascade-deleted", lambda: not [
+        d for d in alice.req(
+            "GET",
+            "/apis/kubeflow-tpu.dev/v1/namespaces/alice/pods")[1]
+        .get("items", [])
+        if d["metadata"]["name"].startswith("e2e-srv")] or None)
+
+
 @phase("hpo-experiment")
 def hpo_experiment(alice: Client, admin: Client) -> None:
     """A TPE Experiment through the versioned API door: trials spawn

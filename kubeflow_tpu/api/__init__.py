@@ -30,6 +30,8 @@ from kubeflow_tpu.api.core import (
     resource_from_dict,
 )
 from kubeflow_tpu.api.crds import (
+    ModelServer,
+    ModelServerSpec,
     Notebook,
     NotebookSpec,
     NotebookStatus,

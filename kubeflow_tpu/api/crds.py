@@ -178,6 +178,40 @@ class Tensorboard(Resource):
     status: TensorboardStatus = field(default_factory=TensorboardStatus)
 
 
+@dataclass
+class ModelServerSpec:
+    """Serve a model over REST on a TPU slice (the KServe-shaped gap:
+    the reference's serving story was the removed TF-Serving component
+    fronted by Service/VirtualService; here the pod runs
+    `python -m kubeflow_tpu.serving`)."""
+
+    model: str = "llama-tiny"    # serving.__main__ registry name
+    # "pvc://name/subpath" (train.Checkpointer dir on a PVC),
+    # "gs://bucket/path", or "" = random init (smoke/dev)
+    checkpoint: str = ""
+    max_len: int = 1024
+    continuous: bool = True
+    warmup: bool = True
+    max_batch: int = 8
+    prefill_chunk: int = 0       # 0 = off
+    quant: str = ""              # "" | int8
+    tpu: TpuSpec = field(default_factory=TpuSpec)
+
+
+@dataclass
+class ModelServerStatus:
+    ready: bool = False
+    url: str = ""
+    conditions: list[dict] = field(default_factory=list)
+
+
+@dataclass
+class ModelServer(Resource):
+    KIND: ClassVar[str] = "ModelServer"
+    spec: ModelServerSpec = field(default_factory=ModelServerSpec)
+    status: ModelServerStatus = field(default_factory=ModelServerStatus)
+
+
 # ---------------------------------------------------------------------------
 # HPO: Experiment / Trial (Katib StudyJob equivalent — the reference only
 # smoke-tests Katib from outside, testing/katib_studyjob_test.py; the CRD

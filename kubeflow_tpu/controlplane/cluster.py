@@ -27,6 +27,9 @@ from kubeflow_tpu.controlplane.controllers.profile import (
     ProfileController,
     WorkloadIdentityPlugin,
 )
+from kubeflow_tpu.controlplane.controllers.modelserver import (
+    ModelServerController,
+)
 from kubeflow_tpu.controlplane.controllers.tensorboard import TensorboardController
 from kubeflow_tpu.controlplane.controllers.workload import (
     DeploymentController,
@@ -108,6 +111,9 @@ class Cluster:
         self.tensorboard_controller = TensorboardController(
             use_routing=self.config.use_routing
         )
+        self.modelserver_controller = ModelServerController(
+            use_routing=self.config.use_routing
+        )
         self.deployment_controller = DeploymentController()
         self.experiment_controller = ExperimentController()
         self.trial_controller = TrialController(
@@ -119,6 +125,7 @@ class Cluster:
         self.manager.register(self.statefulset_controller)
         self.manager.register(self.profile_controller)
         self.manager.register(self.tensorboard_controller)
+        self.manager.register(self.modelserver_controller)
         self.manager.register(self.deployment_controller)
         self.gateway_controller = None
         self.gateway_webhook = None

@@ -12,4 +12,7 @@ from kubeflow_tpu.controlplane.controllers.profile import (
     ProfileController,
     WorkloadIdentityPlugin,
 )
+from kubeflow_tpu.controlplane.controllers.modelserver import (
+    ModelServerController,
+)
 from kubeflow_tpu.controlplane.controllers.tensorboard import TensorboardController

@@ -1,0 +1,259 @@
+"""ModelServer controller: ModelServer CR → Deployment + Service + route.
+
+Closes the serving loop the reference only documents: its TF-Serving
+component (removed; `/root/reference/docs_dev/tf_serving.md:1-60`,
+smoke-tested by `/root/reference/testing/test_tf_serving.py`) was a
+Deployment behind the same Service/VirtualService machinery as
+notebooks. TPU-native restatement:
+
+- the pod runs `python -m kubeflow_tpu.serving` (the engine CLI) with
+  flags rendered from the spec — continuous batching + AOT warmup on
+  by default, so Ready means "compiled, no first-request stall";
+- checkpoint source dispatch mirrors the tensorboard controller's
+  logspath dispatch (`tensorboard_controller.go:170-239` pattern):
+  `pvc://name/subpath` mounts the PVC at /ckpt, `gs://` mounts the
+  user-gcp-sa secret, "" runs --random (smoke/dev);
+- TPU placement rides the SAME machinery as notebooks: topology label
+  for the webhook's env injection, slice-pool node selector, chip
+  resources (`controllers/notebook.py` wiring);
+- route prefix `/serving/<ns>/<name>/` → the pod's REST port, and
+  status.url surfaces it (`notebook_controller.go:483-510` pattern).
+"""
+
+from __future__ import annotations
+
+import os
+
+from kubeflow_tpu.api.core import (
+    Container,
+    Deployment,
+    DeploymentSpec,
+    EnvVar,
+    HTTPRoute,
+    PodTemplateSpec,
+    Probe,
+    Service,
+    ServicePort,
+    ServiceSpec,
+    VirtualService,
+    VirtualServiceSpec,
+    Volume,
+    VolumeMount,
+)
+from kubeflow_tpu.api.crds import ModelServer
+from kubeflow_tpu.controlplane.controllers.helpers import (
+    copy_spec_and_labels,
+    reconcile_child,
+)
+from kubeflow_tpu.controlplane.controllers.notebook import (
+    TOPOLOGY_NODE_SELECTOR,
+    TPU_RESOURCE_KEY,
+)
+from kubeflow_tpu.controlplane.runtime import Controller, Result
+from kubeflow_tpu.controlplane.store import NotFound, Store
+from kubeflow_tpu.controlplane import webhook as wh
+from kubeflow_tpu.parallel.mesh import SLICE_TOPOLOGIES
+
+# Mirror of serving.__main__.MODEL_NAMES: importing the serving package
+# would pull jax into the control plane (which is deliberately jax-free
+# — controllers must never touch a TPU backend). Drift is pinned by
+# tests/test_modelserver.py.
+MODEL_NAMES = ("llama-tiny", "llama3-1b", "llama3-8b", "gemma-tiny",
+               "gemma-2b", "mixtral-tiny")
+
+DEFAULT_IMAGE = "kubeflow-tpu/serving:latest"  # KFTPU_SERVING_IMAGE env
+SERVE_PORT = 8000
+MS_NAME_LABEL = "modelserver-name"
+
+
+class ModelServerController(Controller):
+    KIND = "ModelServer"
+    OWNS = ("Deployment", "Service", "VirtualService")
+
+    def __init__(self, *, use_routing: bool = True):
+        self.use_routing = use_routing
+
+    def reconcile(self, store: Store, namespace: str, name: str) -> Result:
+        try:
+            ms = store.get("ModelServer", namespace, name)
+        except NotFound:
+            return Result()
+        assert isinstance(ms, ModelServer)
+
+        # user-config errors surface as events, not retry loops (the
+        # notebook controller's InvalidTopology discipline)
+        problem = self._validate(ms)
+        if problem:
+            reason, msg = problem
+            if not any(e.reason == reason for e in
+                       store.events_for("ModelServer", namespace, name)):
+                store.emit_event(ms, "Warning", reason, msg)
+            return Result()
+
+        dep = self._desired_deployment(ms)
+        reconcile_child(store, ms, dep, copy_spec_and_labels)
+        svc = self._desired_service(ms)
+        reconcile_child(store, ms, svc, copy_spec_and_labels)
+        if self.use_routing:
+            vs = self._desired_virtualservice(ms)
+            reconcile_child(store, ms, vs, copy_spec_and_labels)
+
+        cur = store.try_get("Deployment", namespace, name)
+        ready = bool(cur and cur.ready_replicas >= 1)
+        conditions = list(cur.conditions) if cur else []
+        url = f"/serving/{namespace}/{name}/" if self.use_routing else \
+            f"http://{name}.{namespace}.svc"
+        fresh = store.try_get("ModelServer", namespace, name)
+        if fresh is not None and (
+                fresh.status.ready != ready
+                or fresh.status.conditions != conditions
+                or fresh.status.url != url):
+            fresh.status.ready = ready
+            fresh.status.conditions = conditions
+            fresh.status.url = url
+            store.update(fresh)
+        return Result()
+
+    @staticmethod
+    def _validate(ms: ModelServer):
+        spec = ms.spec
+        if spec.model not in MODEL_NAMES:
+            return ("InvalidModel",
+                    f"unknown model {spec.model!r}; known: "
+                    f"{sorted(MODEL_NAMES)}")
+        if spec.tpu.topology and spec.tpu.topology not in SLICE_TOPOLOGIES:
+            return ("InvalidTopology",
+                    f"unknown TPU slice topology {spec.tpu.topology!r}; "
+                    f"known: {sorted(SLICE_TOPOLOGIES)}")
+        if spec.quant not in ("", "int8"):
+            return ("InvalidQuant",
+                    f"unknown quant mode {spec.quant!r}")
+        ckpt = spec.checkpoint
+        if ckpt and not (ckpt.startswith("pvc://")
+                         or ckpt.startswith("gs://")):
+            return ("InvalidCheckpoint",
+                    f"checkpoint {ckpt!r} must be pvc://name/path, "
+                    "gs://bucket/path, or empty (random init)")
+        if ckpt.startswith("pvc://") \
+                and not ckpt[len("pvc://"):].partition("/")[0]:
+            # an empty claim name would render an unbound volume whose
+            # failure surfaces as an opaque kubelet error, not an event
+            return ("InvalidCheckpoint",
+                    f"checkpoint {ckpt!r} names no PVC")
+        if ckpt.startswith("gs://") and not ckpt[len("gs://"):]:
+            return ("InvalidCheckpoint",
+                    f"checkpoint {ckpt!r} names no bucket")
+        if spec.warmup and not spec.continuous:
+            return ("InvalidWarmup",
+                    "warmup requires continuous batching (the window "
+                    "batcher has no ahead-of-traffic shape set)")
+        return None
+
+    def _desired_deployment(self, ms: ModelServer) -> Deployment:
+        name, ns = ms.metadata.name, ms.metadata.namespace
+        spec = ms.spec
+        volumes: list[Volume] = []
+        mounts: list[VolumeMount] = []
+        env: list[EnvVar] = []
+
+        args = ["--model", spec.model, "--port", str(SERVE_PORT),
+                "--max-len", str(spec.max_len),
+                "--max-batch", str(spec.max_batch)]
+        ckpt = spec.checkpoint
+        if ckpt.startswith("pvc://"):
+            rest = ckpt[len("pvc://"):]
+            pvc_name, _, sub_path = rest.partition("/")
+            volumes.append(Volume(name="ckpt", pvc_name=pvc_name))
+            mounts.append(VolumeMount(name="ckpt", mount_path="/ckpt",
+                                      sub_path=sub_path))
+            args += ["--checkpoint", "/ckpt"]
+        elif ckpt.startswith("gs://"):
+            volumes.append(Volume(name="gcp-creds", secret="user-gcp-sa"))
+            mounts.append(VolumeMount(name="gcp-creds",
+                                      mount_path="/secret/gcp"))
+            env.append(EnvVar("GOOGLE_APPLICATION_CREDENTIALS",
+                              "/secret/gcp/user-gcp-sa.json"))
+            args += ["--checkpoint", ckpt]
+        else:
+            args += ["--random"]
+        if spec.continuous:
+            args += ["--continuous"]
+        if spec.warmup:
+            args += ["--warmup"]
+        if spec.prefill_chunk:
+            args += ["--prefill-chunk", str(spec.prefill_chunk)]
+        if spec.quant:
+            args += ["--quant", spec.quant]
+
+        container = Container(
+            name=name,
+            image=os.environ.get("KFTPU_SERVING_IMAGE", DEFAULT_IMAGE),
+            command=["python", "-m", "kubeflow_tpu.serving"],
+            args=args,
+            env=env,
+            ports=[SERVE_PORT],
+            volume_mounts=mounts,
+            # Ready must mean LISTENING — checkpoint restore + warmup
+            # compiles run for minutes before the port binds, and the
+            # server only answers /readyz after on_startup (warmup)
+            # finishes. Without this probe a real kubelet would mark
+            # the pod Ready at process start and the route would serve
+            # connection-refused.
+            readiness_probe=Probe(path="/readyz", port=SERVE_PORT,
+                                  initial_delay_seconds=5,
+                                  period_seconds=5),
+        )
+        dep = Deployment(
+            spec=DeploymentSpec(
+                replicas=1,
+                selector={MS_NAME_LABEL: name},
+                template=PodTemplateSpec(),
+            )
+        )
+        tmpl = dep.spec.template
+        tmpl.metadata.labels = {MS_NAME_LABEL: name}
+        topo_name = spec.tpu.topology
+        if topo_name:
+            # same placement + webhook-env path as notebook gangs
+            tmpl.metadata.labels[wh.TOPOLOGY_LABEL] = topo_name
+            topo = SLICE_TOPOLOGIES[topo_name]
+            tmpl.spec.node_selector.setdefault(
+                TOPOLOGY_NODE_SELECTOR, topo_name)
+            container.resources.limits.setdefault(
+                TPU_RESOURCE_KEY, str(topo.chips_per_host))
+        tmpl.spec.containers = [container]
+        tmpl.spec.volumes = volumes
+        dep.metadata.name = name
+        dep.metadata.namespace = ns
+        dep.metadata.labels = {MS_NAME_LABEL: name}
+        return dep
+
+    def _desired_service(self, ms: ModelServer) -> Service:
+        name, ns = ms.metadata.name, ms.metadata.namespace
+        svc = Service(
+            spec=ServiceSpec(
+                selector={MS_NAME_LABEL: name},
+                ports=[ServicePort("http", 80, SERVE_PORT)],
+            )
+        )
+        svc.metadata.name = name
+        svc.metadata.namespace = ns
+        return svc
+
+    def _desired_virtualservice(self, ms: ModelServer) -> VirtualService:
+        name, ns = ms.metadata.name, ms.metadata.namespace
+        vs = VirtualService(
+            spec=VirtualServiceSpec(
+                gateways=["kubeflow-gateway"],
+                hosts=["*"],
+                http=[HTTPRoute(
+                    prefix=f"/serving/{ns}/{name}/",
+                    rewrite="/",
+                    destination_host=f"{name}.{ns}.svc",
+                    destination_port=80,
+                )],
+            )
+        )
+        vs.metadata.name = f"modelserver-{ns}-{name}"
+        vs.metadata.namespace = ns
+        return vs

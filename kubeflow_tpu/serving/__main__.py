@@ -1,0 +1,151 @@
+"""Serving server CLI: `python -m kubeflow_tpu.serving`.
+
+The deployable entry point the ModelServer controller's pods run —
+and the standalone way to stand the REST server up from a train
+checkpoint (the reference's analog was the removed TF-Serving binary,
+`/root/reference/docs_dev/tf_serving.md:1-60`).
+
+    python -m kubeflow_tpu.serving --model llama-tiny --random --port 8000
+    python -m kubeflow_tpu.serving --model llama3-1b \
+        --checkpoint /ckpt/run7 --continuous --warmup --quant int8
+
+--checkpoint points at a train.Checkpointer directory (Orbax OCDBT);
+the latest step's params are restored (optimizer state is skipped).
+--random initializes fresh params — the smoke/dev path that lets the
+controller's e2e run without weights.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def model_registry():
+    """name -> (config, init_fn, family). (Importing this module pulls
+    jax regardless — the serving package __init__ imports the engine —
+    which is why the ModelServer CONTROLLER mirrors MODEL_NAMES as a
+    literal instead of importing it; tests pin the two together.)"""
+    from kubeflow_tpu.models import gemma, llama, llama_moe
+    from kubeflow_tpu.serving.engine import (
+        GEMMA_FAMILY, LLAMA_FAMILY, MOE_LLAMA_FAMILY,
+    )
+
+    return {
+        "llama-tiny": (llama.LLAMA_TINY, llama.init, LLAMA_FAMILY),
+        "llama3-1b": (llama.LLAMA3_1B, llama.init, LLAMA_FAMILY),
+        "llama3-8b": (llama.LLAMA3_8B, llama.init, LLAMA_FAMILY),
+        "gemma-tiny": (gemma.GEMMA_TINY, gemma.init, GEMMA_FAMILY),
+        "gemma-2b": (gemma.GEMMA_2B, gemma.init, GEMMA_FAMILY),
+        "mixtral-tiny": (llama_moe.MIXTRAL_TINY, llama_moe.init,
+                         MOE_LLAMA_FAMILY),
+    }
+
+
+MODEL_NAMES = tuple(model_registry())
+
+
+def _load_params(args, init1):
+    """`init1` is a rng-only closure over (init_fn, cfg)."""
+    import jax
+
+    if args.random:
+        return init1(jax.random.key(args.seed))
+    import orbax.checkpoint as ocp
+
+    from kubeflow_tpu.train.checkpoint import STATE_ITEM
+
+    mgr = ocp.CheckpointManager(args.checkpoint,
+                                item_names=(STATE_ITEM,))
+    step = mgr.latest_step()
+    if step is None:
+        raise SystemExit(f"no checkpoint under {args.checkpoint}")
+    abstract = jax.eval_shape(
+        init1, jax.ShapeDtypeStruct((2,), "uint32"))
+    restored = mgr.restore(step, args=ocp.args.Composite(**{
+        STATE_ITEM: ocp.args.StandardRestore(
+            {"params": abstract}, strict=False),
+    }))
+    mgr.close()
+    return restored[STATE_ITEM]["params"]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m kubeflow_tpu.serving")
+    p.add_argument("--model", default="llama-tiny", choices=MODEL_NAMES)
+    p.add_argument("--name", default="",
+                   help="served model name (default: --model)")
+    src = p.add_mutually_exclusive_group()
+    src.add_argument("--checkpoint", default="",
+                     help="train.Checkpointer directory")
+    src.add_argument("--random", action="store_true",
+                     help="fresh random params (smoke/dev)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--max-len", type=int, default=1024)
+    p.add_argument("--eos", type=int, default=None)
+    p.add_argument("--continuous", action="store_true")
+    p.add_argument("--warmup", action="store_true")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--batch-window-ms", type=float, default=0.0)
+    p.add_argument("--prefill-chunk", type=int, default=0)
+    p.add_argument("--quant", choices=("", "int8"), default="")
+    p.add_argument("--tokenizer", default="",
+                   help="data.bpe tokenizer file (text mode)")
+    p.add_argument("--cpu", action="store_true",
+                   help="pin the CPU backend (hermetic smoke; pins "
+                        "jax.config BEFORE backend init)")
+    args = p.parse_args(argv)
+    if not args.checkpoint and not args.random:
+        p.error("pass --checkpoint DIR or --random")
+    if args.warmup and not args.continuous:
+        # create_serving_app only wires warmup for the continuous
+        # batcher; silently ignoring the flag would break the "Ready
+        # means compiled" promise
+        p.error("--warmup requires --continuous")
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from aiohttp import web
+
+    from kubeflow_tpu.serving.engine import EngineConfig, InferenceEngine
+    from kubeflow_tpu.serving.server import create_serving_app
+
+    cfg, init_fn, family = model_registry()[args.model]
+    params = _load_params(args, lambda k: init_fn(k, cfg))
+    if args.quant == "int8":
+        from kubeflow_tpu.serving.quant import quantize_blocks
+
+        params = quantize_blocks(params)
+    engine = InferenceEngine(
+        params, cfg, family,
+        EngineConfig(max_len=args.max_len, eos_token=args.eos))
+    tokenizer = None
+    if args.tokenizer:
+        from kubeflow_tpu.data.bpe import Tokenizer
+
+        tokenizer = Tokenizer.load(args.tokenizer)
+    app = create_serving_app(
+        {args.name or args.model: engine},
+        tokenizer=tokenizer,
+        batch_window_ms=args.batch_window_ms,
+        max_batch=args.max_batch,
+        continuous=args.continuous,
+        warmup=args.warmup,
+        prefill_chunk=args.prefill_chunk or None,
+    )
+    print(f"serving {args.name or args.model} "
+          f"({'random' if args.random else args.checkpoint}) on "
+          f"{args.host}:{args.port} backend={jax.default_backend()}",
+          flush=True)
+    web.run_app(app, host=args.host, port=args.port, print=None)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

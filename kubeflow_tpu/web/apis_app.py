@@ -35,6 +35,7 @@ from kubeflow_tpu.web.common import (
 PLURALS = {
     "notebooks": "Notebook",
     "tensorboards": "Tensorboard",
+    "modelservers": "ModelServer",
     "experiments": "Experiment",
     "trials": "Trial",
     "pods": "Pod",
