@@ -68,5 +68,7 @@ export const routes = {
   pvc: (ns, name) => `/volumes/api/namespaces/${ns}/pvcs/${name}`,
   tensorboards: (ns) => `/tensorboards/api/namespaces/${ns}/tensorboards`,
   tensorboard: (ns, name) => `/tensorboards/api/namespaces/${ns}/tensorboards/${name}`,
+  modelservers: (ns) => `/modelservers/api/namespaces/${ns}/modelservers`,
+  modelserver: (ns, name) => `/modelservers/api/namespaces/${ns}/modelservers/${name}`,
   kfamBindings: '/kfam/v1/bindings',
 };

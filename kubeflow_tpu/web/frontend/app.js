@@ -9,6 +9,7 @@ import { homeView } from '/static/views_home.js';
 import { notebooksView, notebookFormView, notebookDetailView } from '/static/views_notebooks.js';
 import { volumesView } from '/static/views_volumes.js';
 import { tensorboardsView } from '/static/views_tensorboards.js';
+import { modelserversView } from '/static/views_modelservers.js';
 import { contributorsView } from '/static/views_contributors.js';
 
 export const state = {
@@ -67,6 +68,7 @@ const views = {
   'jupyter/new': notebookFormView,
   volumes: volumesView,
   tensorboards: tensorboardsView,
+  modelservers: modelserversView,
   contributors: contributorsView,
 };
 

@@ -1,0 +1,129 @@
+// Model servers view: list + create (model, checkpoint, optional
+// topology/quant) + delete. The serving sibling of the tensorboards
+// view; readiness and routed URL come from the ModelServer status.
+
+import { api, routes } from '/static/api.js';
+import { h, state, toast, reportError, render } from '/static/app.js';
+
+export async function modelserversView() {
+  const ns = state.namespace;
+  if (!ns) return h('div', { class: 'card empty' }, 'No namespace selected.');
+  const data = await api.get(routes.modelservers(ns));
+
+  const rows = (data.modelservers || []).map((m) =>
+    h(
+      'tr',
+      {},
+      h(
+        'td',
+        {},
+        h(
+          'span',
+          { class: 'status' },
+          h('span', { class: `dot ${m.ready ? 'ready' : 'waiting'}` }),
+          m.ready ? 'ready' : 'starting',
+        ),
+      ),
+      h('td', {}, m.ready ? h('a', { href: m.url, target: '_blank', rel: 'noopener' }, m.name) : m.name),
+      h('td', {}, m.model),
+      h('td', {}, m.checkpoint || 'random (dev)'),
+      h('td', {}, m.topology || 'cpu'),
+      h('td', {}, m.quant || 'bf16'),
+      h(
+        'td',
+        {},
+        h(
+          'button',
+          {
+            class: 'small danger',
+            onclick: async () => {
+              if (!confirm(`Delete model server ${m.name}?`)) return;
+              try {
+                await api.del(routes.modelserver(ns, m.name));
+                toast(`Deleted ${m.name}`);
+                render();
+              } catch (err) {
+                reportError(err);
+              }
+            },
+          },
+          'Delete',
+        ),
+      ),
+    ),
+  );
+
+  const nameInput = h('input', { placeholder: 'my-server' });
+  const modelInput = h('input', { placeholder: 'llama3-1b' });
+  const ckptInput = h('input', { placeholder: 'pvc://train-out/run7 or gs://bucket/run7 (empty = random)' });
+  const topoInput = h('input', { placeholder: 'v5e-4 (empty = cpu)' });
+  const createBtn = h('button', { class: 'primary' }, 'Create');
+  createBtn.addEventListener('click', async () => {
+    createBtn.disabled = true;
+    try {
+      const body = {
+        name: nameInput.value.trim(),
+        model: modelInput.value.trim(),
+        checkpoint: ckptInput.value.trim(),
+      };
+      if (topoInput.value.trim()) body.topology = topoInput.value.trim();
+      await api.post(routes.modelservers(ns), body);
+      toast(`Model server ${body.name} created`);
+      render();
+    } catch (err) {
+      reportError(err);
+      createBtn.disabled = false;
+    }
+  });
+
+  return h(
+    'div',
+    {},
+    h(
+      'div',
+      { class: 'card' },
+      h('div', { class: 'toolbar' }, h('h2', {}, `Model servers in ${ns}`)),
+      rows.length
+        ? h(
+            'table',
+            { class: 'grid' },
+            h(
+              'thead',
+              {},
+              h(
+                'tr',
+                {},
+                h('th', {}, 'Status'),
+                h('th', {}, 'Name'),
+                h('th', {}, 'Model'),
+                h('th', {}, 'Checkpoint'),
+                h('th', {}, 'TPU'),
+                h('th', {}, 'Weights'),
+                h('th', {}, ''),
+              ),
+            ),
+            h('tbody', {}, rows),
+          )
+        : h('div', { class: 'empty' }, 'No model servers.'),
+    ),
+    h(
+      'div',
+      { class: 'card' },
+      h('h3', {}, 'New model server'),
+      h(
+        'div',
+        { class: 'form-grid' },
+        h('label', {}, 'Name'),
+        nameInput,
+        h('label', {}, 'Model'),
+        modelInput,
+        h('label', {}, 'Checkpoint'),
+        ckptInput,
+        h('label', {}, 'TPU topology'),
+        topoInput,
+        h('div', { class: 'field-note' }, 'The server answers REST at /serving/<ns>/<name>/ once ready (continuous batching + warmup on by default).'),
+        h('div', { class: 'span2' }, createBtn),
+      ),
+    ),
+  );
+}

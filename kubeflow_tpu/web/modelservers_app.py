@@ -1,0 +1,81 @@
+"""Model-servers web app: ModelServer CR CRUD.
+
+The serving sibling of the tensorboards app (ref
+crud-web-apps/tensorboards/backend pattern): list/create/delete model
+servers in a namespace, with readiness and the routed URL surfaced for
+the dashboard. Model/quant validation happens in the CONTROLLER (it
+emits warning events the UI can mine), so this layer stays a thin,
+authz-gated door like its siblings.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from kubeflow_tpu.api.crds import ModelServer
+from kubeflow_tpu.controlplane.store import Store
+from kubeflow_tpu.web.common import (
+    STORE_KEY,
+    base_app,
+    ensure_authorized,
+    json_success,
+)
+
+
+def create_modelservers_app(store: Store, *,
+                            cluster_admins: set[str] | None = None,
+                            csrf: bool = True) -> web.Application:
+    app = base_app(store, csrf=csrf, cluster_admins=cluster_admins)
+    app.router.add_get("/api/namespaces/{ns}/modelservers", list_ms)
+    app.router.add_post("/api/namespaces/{ns}/modelservers", post_ms)
+    app.router.add_delete("/api/namespaces/{ns}/modelservers/{name}",
+                          delete_ms)
+    return app
+
+
+async def list_ms(request: web.Request):
+    ns = request.match_info["ns"]
+    ensure_authorized(request, "list", "ModelServer", ns)
+    store: Store = request.app[STORE_KEY]
+    return json_success({
+        "modelservers": [
+            {
+                "name": m.metadata.name,
+                "model": m.spec.model,
+                "checkpoint": m.spec.checkpoint,
+                "quant": m.spec.quant,
+                "topology": m.spec.tpu.topology,
+                "ready": m.status.ready,
+                "url": m.status.url,
+            }
+            for m in store.list("ModelServer", ns)
+        ]
+    })
+
+
+async def post_ms(request: web.Request):
+    ns = request.match_info["ns"]
+    ensure_authorized(request, "create", "ModelServer", ns)
+    body = await request.json()
+    if not body.get("name") or not body.get("model"):
+        raise ValueError("name and model are required")
+    ms = ModelServer()
+    ms.metadata.name = body["name"]
+    ms.metadata.namespace = ns
+    ms.spec.model = body["model"]
+    ms.spec.checkpoint = body.get("checkpoint", "")
+    if "quant" in body:
+        ms.spec.quant = body["quant"]
+    if "max_len" in body:
+        ms.spec.max_len = int(body["max_len"])
+    if "topology" in body:
+        ms.spec.tpu.topology = body["topology"]
+    request.app[STORE_KEY].create(ms)
+    return json_success({"name": ms.metadata.name}, status=201)
+
+
+async def delete_ms(request: web.Request):
+    ns, name = request.match_info["ns"], request.match_info["name"]
+    ensure_authorized(request, "delete", "ModelServer", ns)
+    request.app[STORE_KEY].delete("ModelServer", ns, name)
+    return json_success({"deleted": name})

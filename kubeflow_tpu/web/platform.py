@@ -23,6 +23,7 @@ from kubeflow_tpu.web.apis_app import create_apis_app
 from kubeflow_tpu.web.dashboard_app import create_dashboard_app
 from kubeflow_tpu.web.jupyter_app import create_jupyter_app
 from kubeflow_tpu.web.kfam_app import create_kfam_app
+from kubeflow_tpu.web.modelservers_app import create_modelservers_app
 from kubeflow_tpu.web.tensorboards_app import create_tensorboards_app
 from kubeflow_tpu.web.volumes_app import create_volumes_app
 
@@ -57,6 +58,8 @@ def create_platform_app(
     root.add_subapp("/volumes/", create_volumes_app(
         store, cluster_admins=cluster_admins, csrf=csrf))
     root.add_subapp("/tensorboards/", create_tensorboards_app(
+        store, cluster_admins=cluster_admins, csrf=csrf))
+    root.add_subapp("/modelservers/", create_modelservers_app(
         store, cluster_admins=cluster_admins, csrf=csrf))
     root.add_subapp("/kfam/", create_kfam_app(
         store, cluster_admins=cluster_admins, csrf=False))
@@ -93,8 +96,8 @@ def add_frontend(app: web.Application) -> None:
 # Bounded label set: unknown first segments (scanners, typos) bucket to
 # "other" so request_total cardinality can't grow without limit.
 _KNOWN_SERVICES = frozenset(
-    {"api", "jupyter", "volumes", "tensorboards", "kfam", "metrics",
-     "healthz", "readyz", "dashboard"})
+    {"api", "jupyter", "volumes", "tensorboards", "modelservers", "kfam",
+     "metrics", "healthz", "readyz", "dashboard"})
 
 
 @web.middleware

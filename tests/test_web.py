@@ -382,3 +382,30 @@ def test_spawner_config_source_fails_fast_on_broken_startup(tmp_path):
 
     missing = SpawnerConfigSource(str(tmp_path / "absent.yaml"))
     assert missing.get()["cpu"]["value"] == "0.5"  # built-in defaults
+
+
+async def test_modelserver_over_http(env):
+    cluster, client = env
+    await _mk_profile(client, cluster)
+    r = await client.post(
+        "/modelservers/api/namespaces/alice/modelservers",
+        json={"name": "srv", "model": "llama-tiny",
+              "checkpoint": "pvc://train-out/run7"},
+        headers=ALICE,
+    )
+    assert r.status == 201, await r.text()
+    assert cluster.wait_idle()
+    r = await client.get(
+        "/modelservers/api/namespaces/alice/modelservers", headers=ALICE)
+    servers = (await r.json())["modelservers"]
+    assert servers[0]["ready"] is True
+    assert servers[0]["url"] == "/serving/alice/srv/"
+    assert servers[0]["model"] == "llama-tiny"
+    # authz: bob has no binding in alice's namespace
+    r = await client.get(
+        "/modelservers/api/namespaces/alice/modelservers", headers=BOB)
+    assert r.status == 403
+    r = await client.delete(
+        "/modelservers/api/namespaces/alice/modelservers/srv",
+        headers=ALICE)
+    assert r.status == 200
