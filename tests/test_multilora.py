@@ -73,6 +73,7 @@ def test_adapter_generate_matches_merged_oracle(setup):
         arr, max_new=5, adapter=""))[0].tolist() == base
 
 
+@pytest.mark.slow
 def test_mixed_adapter_rows_in_one_batch(setup):
     engine, params, adapters = setup
     p = np.random.default_rng(1).integers(0, CFG.vocab_size, 5).tolist()
