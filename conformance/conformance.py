@@ -53,7 +53,7 @@ def _nb(name: str, ns: str = "conf", topology: str = "") -> Notebook:
 def crds_registered(c: Cluster) -> None:
     kinds = registered_kinds()
     for k in ("Notebook", "Profile", "TpuPodDefault", "Tensorboard",
-              "Experiment", "Trial"):
+              "Experiment", "Trial", "ModelServer"):
         assert k in kinds, f"CRD {k} not registered"
 
 
@@ -158,6 +158,28 @@ def spawner_placement_groups(c: Cluster) -> None:
                for t in nb.spec.template.spec.affinity_terms)
     assert any(t.key == "google.com/tpu"
                for t in nb.spec.template.spec.tolerations)
+
+
+@check("modelserver-lifecycle")
+def modelserver_lifecycle(c: Cluster) -> None:
+    """Serving deploys through the platform: CR → Deployment running
+    the serving CLI behind the /serving route, readiness mirrored."""
+    from kubeflow_tpu.api.crds import ModelServer
+
+    ms = ModelServer()
+    ms.metadata.name = "conf-srv"
+    ms.metadata.namespace = "conf"
+    ms.spec.model = "llama-tiny"
+    c.store.create(ms)
+    assert c.wait_idle()
+    dep = c.store.get("Deployment", "conf", "conf-srv")
+    assert dep.spec.template.spec.containers[0].command == [
+        "python", "-m", "kubeflow_tpu.serving"]
+    got = c.store.get("ModelServer", "conf", "conf-srv")
+    assert got.status.ready and got.status.url == "/serving/conf/conf-srv/"
+    c.store.delete("ModelServer", "conf", "conf-srv")
+    assert c.wait_idle()
+    assert c.store.try_get("Deployment", "conf", "conf-srv") is None
 
 
 def main() -> int:
