@@ -133,6 +133,10 @@ class TrainConfig:
     # memory of a batch/grad_accum step at the optimizer behavior of
     # the full batch. 1 = off.
     grad_accum: int = 1
+    # adamw (2x-params moments) or adafactor (factored second moment —
+    # the classic TPU memory saver: 8B-model Adam state is 64 GB fp32,
+    # Adafactor's is ~params/row+col factors).
+    optimizer: str = "adamw"
 
 
 class TrainState:
@@ -172,9 +176,22 @@ def make_optimizer(
         decay_steps=max(tc.total_steps, tc.warmup_steps + 1),
         end_value=tc.learning_rate * 0.1,
     )
+    if tc.optimizer == "adamw":
+        inner = optax.adamw(schedule, b1=tc.b1, b2=tc.b2,
+                            weight_decay=tc.weight_decay)
+    elif tc.optimizer == "adafactor":
+        # factored second moment: the non-mirroring factor leaves fall
+        # through _opt_state_shardings' path+shape match and replicate,
+        # which is exactly right — they are O(rows+cols), not O(params)
+        inner = optax.adafactor(
+            learning_rate=schedule, weight_decay_rate=tc.weight_decay
+            or None)
+    else:
+        raise ValueError(f"unknown optimizer {tc.optimizer!r} "
+                         "(adamw | adafactor)")
     opt = optax.chain(
         optax.clip_by_global_norm(tc.grad_clip),
-        optax.adamw(schedule, b1=tc.b1, b2=tc.b2, weight_decay=tc.weight_decay),
+        inner,
     )
     if freeze_labels is None:
         return opt

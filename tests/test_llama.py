@@ -323,3 +323,56 @@ def test_grad_accum_matches_full_batch_step():
 
     with pytest.raises(ValueError, match="not divisible"):
         build(3).step(ref_state, tokens, targets, mask)
+
+
+@pytest.mark.slow
+def test_adafactor_trains_and_checkpoints():
+    """TrainConfig.optimizer=adafactor: loss falls under the sharded
+    step, the factored second-moment state shards/replicates cleanly
+    (non-mirroring leaves replicate by design), and the state
+    round-trips through the Checkpointer."""
+    from kubeflow_tpu.train.checkpoint import (
+        CheckpointConfig, Checkpointer,
+    )
+
+    mesh = create_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    trainer = Trainer(
+        mesh=mesh,
+        apply_fn=lambda p, t: llama.apply(p, CFG, t),
+        init_fn=lambda k: llama.init(k, CFG),
+        logical_axes=llama.param_logical_axes(CFG),
+        train_config=TrainConfig(learning_rate=1e-2, warmup_steps=2,
+                                 total_steps=50, optimizer="adafactor"),
+    )
+    state = trainer.init(jax.random.key(0))
+    rng = np.random.default_rng(11)
+    tokens = jnp.asarray(rng.integers(0, CFG.vocab_size, (8, 16)),
+                         jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    losses = []
+    for _ in range(5):
+        state, loss = trainer.step(state, tokens, targets)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        ckpt = Checkpointer(
+            CheckpointConfig(d, save_interval_steps=1,
+                             enable_async=False), trainer)
+        assert ckpt.save(state, force=True)
+        restored = ckpt.restore()
+        _, la = trainer.step(state, tokens, targets)
+        _, lb = trainer.step(restored, tokens, targets)
+        assert float(la) == float(lb)
+        ckpt.close()
+
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        Trainer(
+            mesh=mesh,
+            apply_fn=lambda p, t: llama.apply(p, CFG, t),
+            init_fn=lambda k: llama.init(k, CFG),
+            logical_axes=llama.param_logical_axes(CFG),
+            train_config=TrainConfig(optimizer="sgd"),
+        )
