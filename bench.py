@@ -436,6 +436,73 @@ def bench_decode(model: str, *, batch: int, prompt_len: int,
     }
 
 
+def bench_decode_continuous(model: str, *, slots: int, prompt_len: int,
+                            rounds: int, chunk: int, max_len: int,
+                            verbose: bool = True) -> dict:
+    """Steady-state decode through the CONTINUOUS slot engine at full
+    occupancy — quantifies what the slot design (per-row cursors,
+    scatter KV writes, chunked stepping) costs on-device vs the fused
+    decode scan `bench_decode` times. Same model, same batch size, same
+    MBU roofline normalization, so the two metrics are directly
+    comparable in one artifact."""
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.serving import engine as engine_lib
+    from kubeflow_tpu.serving.continuous import ContinuousEngine
+
+    cfg = bench_configs()[model]
+    params = jax.jit(lambda k: llama.init(k, cfg))(jax.random.key(0))
+    jax.block_until_ready(params)
+    eng = engine_lib.InferenceEngine(
+        params, cfg, engine_lib.LLAMA_FAMILY,
+        engine_lib.EngineConfig(max_len=max_len),
+    )
+    ce = ContinuousEngine(eng, max_slots=slots)
+    rng = np.random.default_rng(0)
+    key = jax.random.key(1)
+    st = ce.init_slots()
+    # total decoded tokens across warmup + 3 timing reps — the cache
+    # must hold them all so cursors never clamp mid-measurement
+    budget = (3 * rounds + 1) * chunk
+    assert prompt_len + budget <= max_len, (prompt_len, budget, max_len)
+    for i in range(slots):
+        p = rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+        pstate, first, _ = ce.prefill(p, budget, {}, key)
+        st = ce.insert(st, i, pstate, first)
+    sp = eng._resolve_sampling(
+        np.zeros(slots, np.float32), np.zeros(slots, np.int64),
+        np.ones(slots, np.float32), key, batch=slots)[0]
+    st, toks, key = ce.step(st, sp, key, steps=chunk)  # compile + warm
+    jax.block_until_ready(toks)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            st, toks, key = ce.step(st, sp, key, steps=chunk)
+        jax.block_until_ready(toks)
+        ts.append(time.perf_counter() - t0)
+    dt = min(ts)
+    decoded = rounds * chunk
+    n_devices = len(jax.devices())
+    tok_per_sec = slots * decoded / dt / n_devices
+
+    gen = detect_generation()
+    avg_len = prompt_len + decoded / 2
+    kv_bytes = (2 * cfg.num_layers * slots * avg_len * cfg.num_kv_heads
+                * cfg.head_dim * jnp.dtype(cfg.dtype).itemsize)
+    step_bytes = param_bytes(cfg) + kv_bytes
+    mbu = step_bytes / (dt / decoded) / PEAK_HBM_GBS[gen]
+    if verbose:
+        print(f"# decode-cont model={model} slots={slots} chunk={chunk} "
+              f"tok/s={tok_per_sec:.1f} mbu={mbu:.3f}", file=sys.stderr)
+    return {
+        "metric": ("serving_decode_tokens_per_sec_per_chip"
+                   f"[{model}-cont,{gen}]"),
+        "value": round(tok_per_sec, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mbu / 0.40, 4),
+    }
+
+
 def first_compile_metric() -> dict:
     assert _first_compile_s is not None, "run a train bench first"
     return {
@@ -451,7 +518,7 @@ def first_compile_metric() -> dict:
 # scheduled after it would have timed out. Ordering the known
 # wedge-risk section after all the others maximizes captured evidence.
 ALL_SECTIONS = ("train500m", "train1b", "decode", "decode-int8",
-                "flash4k")
+                "decode-cont", "flash4k")
 # Per-section wall-clock bound for the orchestrated TPU sweep. Sized
 # from measured section times (train sections ~2-4 min incl. compile,
 # decode ~2 min) with slack for tunnel weather; a section that wedges
@@ -464,7 +531,7 @@ _SECTION_TIMEOUT_S = float(
 
 def _sweep_for(backend: str, wanted: list[str], p) -> list[str]:
     sweep = (list(ALL_SECTIONS) if backend == "tpu"
-             else ["train500m", "decode", "decode-int8"])
+             else ["train500m", "decode", "decode-int8", "decode-cont"])
     if wanted:
         unavailable = [s for s in wanted if s not in sweep]
         if unavailable:
@@ -615,7 +682,8 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--only", default="",
                    help="comma-separated subset: train500m,train1b,"
-                        "flash4k,decode,decode-int8 (default: full "
+                        "flash4k,decode,decode-int8,decode-cont "
+                        "(default: full "
                         "sweep for the backend)")
     p.add_argument("--json-only", action="store_true")
     args = p.parse_args()
@@ -740,6 +808,18 @@ def _run_sweep(sweep: list[str], backend: str, *, in_child: bool,
             guarded("decode-int8", lambda: bench_decode(
                 "tiny", batch=2, prompt_len=8, max_new=8, max_len=32,
                 int8=True, verbose=verbose))
+    if "decode-cont" in sweep:
+        # Continuous slot engine at full occupancy, same shapes as
+        # `decode`: the delta between the two metrics IS the measured
+        # cost of per-slot cursors + chunked stepping.
+        if on_tpu:
+            guarded("decode-cont", lambda: bench_decode_continuous(
+                "bench-500m-serve", slots=16, prompt_len=128, rounds=8,
+                chunk=4, max_len=512, verbose=verbose))
+        else:
+            guarded("decode-cont", lambda: bench_decode_continuous(
+                "tiny", slots=2, prompt_len=8, rounds=2, chunk=4,
+                max_len=64, verbose=verbose))
 
     return _emit_result(headline, extras, backend)
 
