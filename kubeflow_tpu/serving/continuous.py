@@ -385,13 +385,14 @@ class ContinuousEngine:
 class _Slot:
     """Host-side record for one admitted request."""
 
-    __slots__ = ("fut", "out", "max_new", "queue")
+    __slots__ = ("fut", "out", "max_new", "queue", "stop")
 
-    def __init__(self, fut, max_new: int, queue):
+    def __init__(self, fut, max_new: int, queue, stop=()):
         self.fut = fut
         self.out: list[int] = []
         self.max_new = max_new
         self.queue = queue  # per-request token stream (None for oneshot)
+        self.stop = stop    # token-id sequences that end generation
 
 
 class ContinuousBatcher:
@@ -480,11 +481,14 @@ class ContinuousBatcher:
         request finishes (other slots keep decoding). The result is
         EOS-padded to exactly max_new — interchangeable with the window
         Batcher's fixed-shape contract (a request that hits EOS early
-        stops COMPUTING early here; the pad is host-side)."""
+        stops COMPUTING early here; the pad is host-side). Requests
+        with stop sequences return the TRIMMED output unpadded —
+        stopping short is the ask."""
         fut = self._enqueue(tokens, max_new, sampling, queue=None)
         out = await fut
         eos = self.engine.ec.eos_token
-        if eos is not None and len(out) < max_new:
+        if eos is not None and len(out) < max_new \
+                and not dict(sampling).get("stop"):
             out = out + [eos] * (max_new - len(out))
         return out
 
@@ -585,6 +589,16 @@ class ContinuousBatcher:
             self.tokens_emitted += 1
         if rec.queue is not None and not rec.fut.done():
             rec.queue.put_nowait(token)
+        # stop sequences: the moment a sequence completes as the
+        # output's suffix, trim it off (OpenAI semantics) and retire
+        # the slot — the compute win the window batcher can't have
+        # (its group runs to the group max regardless)
+        for seq in rec.stop:
+            n = len(seq)
+            if n and len(rec.out) >= n and rec.out[-n:] == list(seq):
+                rec.out = rec.out[:-n]
+                self._finish(slot, rec)
+                return
         eos = self.engine.ec.eos_token
         if len(rec.out) >= rec.max_new or (eos is not None
                                            and token == eos):
@@ -663,7 +677,9 @@ class ContinuousBatcher:
                     self._fail(fut, queue, e)
                     continue
                 self.requests += 1
-                rec = _Slot(fut, max_new, queue)
+                rec = _Slot(fut, max_new, queue,
+                            stop=tuple(tuple(s) for s in
+                                       sampling.get("stop", ())))
                 self._active[slot] = rec
                 ec = self.engine.ec
                 self._temp[slot] = sampling.get(

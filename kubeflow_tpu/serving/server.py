@@ -498,6 +498,15 @@ async def generate(request: web.Request):
     if not isinstance(prefix, str):
         return web.json_response(
             {"error": "prefix must be a string"}, status=400)
+    stop = body.get("stop", [])
+    if (not isinstance(stop, list) or len(stop) > 4
+            or not all(isinstance(s, list) and 0 < len(s) <= 16
+                       and all(isinstance(t, int)
+                               and not isinstance(t, bool) for t in s)
+                       for s in stop)):
+        return web.json_response(
+            {"error": "stop must be up to 4 non-empty token-id lists "
+                      "of at most 16 tokens"}, status=400)
     lens = {len(t) for t in token_lists}
     if len(lens) != 1:
         return web.json_response(
@@ -572,6 +581,13 @@ async def generate(request: web.Request):
         if speculative:
             return web.json_response(
                 {"error": "stream does not compose with speculative"},
+                status=400)
+        if stop:
+            # a streamed stop would need partial-match buffering to
+            # avoid emitting a half-completed stop sequence; explicit
+            # 400 beats silently different trimming semantics
+            return web.json_response(
+                {"error": "stop does not compose with stream"},
                 status=400)
         cbatcher = request.app[BATCHERS_KEY].get(name)
         if isinstance(cbatcher, ContinuousBatcher) and arr.shape[0] == 1:
@@ -660,8 +676,16 @@ async def generate(request: web.Request):
         # supports adapters batch-uniformly.
         if adapter:
             sampling["adapter"] = adapter
+        submit_sampling = dict(sampling)
+        if stop and isinstance(batcher, ContinuousBatcher):
+            # the continuous batcher retires the slot the moment a
+            # stop sequence completes (compute freed); the window
+            # batcher runs its group to the group max and the shared
+            # post-trim below applies the semantics
+            submit_sampling["stop"] = tuple(tuple(s) for s in stop)
         ids = await batcher.submit(
-            arr[0].tolist(), max_new_req, tuple(sorted(sampling.items())))
+            arr[0].tolist(), max_new_req,
+            tuple(sorted(submit_sampling.items())))
         toks = np.asarray([ids], np.int32)
     else:
         if adapter:
@@ -674,8 +698,26 @@ async def generate(request: web.Request):
                                     **sampling)),
             )
     toks = toks[:, :max_new_req]  # trim the bucket back to the ask
-    resp: dict[str, Any] = {"tokens": toks.tolist(), **resp_extra}
+    rows = toks.tolist()
+    if stop:
+        # OpenAI semantics on every path: output ends BEFORE the
+        # earliest stop-sequence occurrence (the continuous batcher
+        # already trimmed its suffix; re-scanning is a no-op there)
+        rows = [_apply_stop(r, stop) for r in rows]
+    resp: dict[str, Any] = {"tokens": rows, **resp_extra}
     if text_mode:
-        resp["text"] = (tokenizer.decode(toks[0].tolist()) if tokenizer
-                        else byte_decode(toks[0].tolist()))
+        resp["text"] = (tokenizer.decode(rows[0]) if tokenizer
+                        else byte_decode(rows[0]))
     return web.json_response(resp)
+
+
+def _apply_stop(row: list[int], stop: list[list[int]]) -> list[int]:
+    """Cut `row` before the earliest occurrence of any stop sequence."""
+    cut = None
+    for seq in stop:
+        n = len(seq)
+        for i in range(len(row) - n + 1):
+            if row[i:i + n] == seq:
+                cut = i if cut is None else min(cut, i)
+                break
+    return row if cut is None else row[:cut]

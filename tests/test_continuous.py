@@ -495,3 +495,54 @@ def test_continuous_engine_under_tensor_parallel_mesh():
     for i in range(len(prompts)):
         got[i].extend(toks[i].tolist())
     assert got == want
+
+
+@pytest.mark.slow
+async def test_stop_sequences_retire_slots_early():
+    """A completed stop sequence trims the output (OpenAI semantics)
+    and frees the slot immediately — the compute win over running to
+    max_new. Unmatched stops change nothing."""
+    engine, cfg = _engine()
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2,
+                                chunk=1)
+    p = np.random.default_rng(30).integers(0, cfg.vocab_size, 6).tolist()
+    ref = _solo(engine, p, 10)
+    stop = (tuple(ref[2:4]),)  # completes at emitted token #4
+    got = await batcher.submit(p, 10, (("stop", stop),))
+    assert got == ref[:2]
+    assert batcher.calls <= 4, batcher.calls  # retired, not run to 10
+    # unmatched stop: full (EOS-unpadded result equals the solo run)
+    got2 = await batcher.submit(p, 10, (("stop", ((99999,),)),))
+    assert got2 == ref
+    await batcher.close()
+
+
+@pytest.mark.slow
+async def test_rest_stop_sequences_all_paths():
+    engine, cfg = _engine()
+    gen = np.random.default_rng(31)
+    p = gen.integers(0, cfg.vocab_size, 5).tolist()
+    want = _solo(engine, p, 8)
+    stop = [want[3:5]]
+
+    for app_kwargs in ({"continuous": True, "max_batch": 4},
+                       {"batch_window_ms": 5.0},
+                       {}):
+        app = server_lib.create_serving_app({"m": engine}, **app_kwargs)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        r = await client.post(
+            "/v1/models/m:generate",
+            json={"tokens": [p], "max_new": 8, "stop": stop})
+        assert r.status == 200, await r.text()
+        assert (await r.json())["tokens"][0] == want[:3], app_kwargs
+        r = await client.post(
+            "/v1/models/m:generate",
+            json={"tokens": [p], "max_new": 8, "stop": stop,
+                  "stream": True})
+        assert r.status == 400
+        r = await client.post(
+            "/v1/models/m:generate",
+            json={"tokens": [p], "max_new": 8, "stop": [[]]})
+        assert r.status == 400
+        await client.close()
