@@ -473,20 +473,23 @@ def bench_decode_continuous(model: str, *, slots: int, prompt_len: int,
         np.ones(slots, np.float32), key, batch=slots)[0]
     st, toks, key = ce.step(st, sp, key, steps=chunk)  # compile + warm
     jax.block_until_ready(toks)
-    ts = []
-    for _ in range(3):
+    decoded = rounds * chunk
+    reps = []  # (dt, avg KV fill DURING this rep) — fill accumulates
+    # across reps on one SlotState, so each rep's KV traffic differs;
+    # MBU must use the WINNING rep's own fill or it undercounts.
+    for r in range(3):
+        start_fill = prompt_len + chunk + r * decoded
         t0 = time.perf_counter()
         for _ in range(rounds):
             st, toks, key = ce.step(st, sp, key, steps=chunk)
         jax.block_until_ready(toks)
-        ts.append(time.perf_counter() - t0)
-    dt = min(ts)
-    decoded = rounds * chunk
+        reps.append((time.perf_counter() - t0,
+                     start_fill + decoded / 2))
+    dt, avg_len = min(reps)
     n_devices = len(jax.devices())
     tok_per_sec = slots * decoded / dt / n_devices
 
     gen = detect_generation()
-    avg_len = prompt_len + decoded / 2
     kv_bytes = (2 * cfg.num_layers * slots * avg_len * cfg.num_kv_heads
                 * cfg.head_dim * jnp.dtype(cfg.dtype).itemsize)
     step_bytes = param_bytes(cfg) + kv_bytes

@@ -392,8 +392,17 @@ class TrialController(Controller):
         ):
             import json as _json
 
-            inter = _json.loads(pod.metadata.annotations.get(
+            # same guarded parse as the mirror path below: the
+            # annotation is client-writable, and garbage must not wedge
+            # the reconcile loop (at-least-once semantics let us
+            # restart the step count from a clean slate)
+            inter = _parse_intermediates(pod.metadata.annotations.get(
                 TRIAL_INTERMEDIATE_ANNOTATION, "[]"))
+            if inter is None:
+                log.warning("trial %s: unparseable intermediate "
+                            "metrics annotation; restarting reports",
+                            name)
+                inter = []
             try:
                 v = self.stepwise(dict(trial.spec.assignment), len(inter))
             except Exception as e:  # noqa: BLE001 — user objective
@@ -439,7 +448,13 @@ class TrialController(Controller):
             else:
                 log.error("trial %s: could not record step", name)
                 return Result(requeue_after=1.0)
-            # mirror progress so the Experiment controller can judge
+            # Mirror progress so the Experiment controller can judge —
+            # from the PERSISTED pod, not the local step: a Conflict
+            # retry may have kept another writer's terminal pod, and
+            # mirroring an unpersisted extra step would let Trial.status
+            # disagree with the pod's durable record.
+            inter = _parse_intermediates(pod.metadata.annotations.get(
+                TRIAL_INTERMEDIATE_ANNOTATION, "[]")) or []
             if trial.status.intermediates != inter \
                     or trial.status.phase != "Running":
                 trial.status.intermediates = inter

@@ -128,6 +128,14 @@ class ModelServerController(Controller):
         if spec.quant not in ("", "int8"):
             return ("InvalidQuant",
                     f"unknown quant mode {spec.quant!r}")
+        # non-positive numerics would render a Deployment whose CLI
+        # dies at startup — a crash loop instead of this event
+        if spec.max_len < 1 or spec.max_batch < 1 \
+                or spec.prefill_chunk < 0:
+            return ("InvalidSpec",
+                    f"max_len ({spec.max_len}) and max_batch "
+                    f"({spec.max_batch}) must be >= 1; prefill_chunk "
+                    f"({spec.prefill_chunk}) must be >= 0")
         ckpt = spec.checkpoint
         if ckpt and not (ckpt.startswith("pvc://")
                          or ckpt.startswith("gs://")):

@@ -70,13 +70,33 @@ def ensure_built() -> bool:
         return False
 
 
+_ABI_VERSION = 2  # must match native/dataloader.cpp kt_abi_version()
+
+
 def _load_lib() -> ctypes.CDLL | None:
-    global _lib
+    global _lib, _build_failed
     if _lib is not None:
         return _lib
     if not ensure_built():
         return None
     lib = ctypes.CDLL(_LIB_PATH)
+    # ABI gate: the mtime staleness check cannot protect a prebuilt
+    # .so shipped WITHOUT its source (deployed wheels); calling a
+    # 9-arg kt_loader_open with 10 arguments would silently misread
+    # seed/host/prefetch instead of failing loudly.
+    try:
+        lib.kt_abi_version.restype = ctypes.c_uint64
+        abi = int(lib.kt_abi_version())
+    except AttributeError:
+        abi = 1  # predates the version export
+    if abi != _ABI_VERSION:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "libktdata.so ABI %d != expected %d; using the Python "
+            "loader (rebuild native/)", abi, _ABI_VERSION)
+        _build_failed = True
+        return None
     lib.kt_loader_open.restype = ctypes.c_void_p
     lib.kt_loader_open.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,

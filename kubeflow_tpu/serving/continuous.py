@@ -256,13 +256,17 @@ class ContinuousEngine:
                                 jnp.asarray(aid, jnp.int32))
 
     def warmup(self, buckets=(16,), step_sizes=(1,)) -> int:
-        """Compile the serving shape set ahead of traffic: prefill and
-        insert for every power-of-two group size x prompt bucket, and
-        the decode step for every chunk size. The continuous design's
-        whole point is that this set is BOUNDED and shape-stable for
-        the server's life — warming it turns first-arrival compile
-        stalls into startup cost (readiness gates on it). Returns the
-        number of programs warmed."""
+        """Compile a serving shape set ahead of traffic: prefill and
+        insert for every power-of-two group size x REGISTERED prompt
+        bucket, and the decode step for every chunk size. Warming
+        turns first-arrival compile stalls into startup cost for the
+        covered buckets; prompts that land in an UNREGISTERED bucket
+        (longer than the warmed set, or an exact-length fallback)
+        still compile on first arrival — cover the deployment's real
+        prompt-length distribution via `buckets` rather than warming
+        every bucket up to max_len (each [g, bucket] prefill compile
+        costs real startup time on TPU). Returns the number of
+        programs warmed."""
         eng = self.engine
         rng = jax.random.key(0)
         st = self.init_slots()
@@ -358,12 +362,13 @@ class ContinuousEngine:
     def _step(self, params, adapters, st: SlotState, sp: SamplingParams,
               rng, *, steps: int):
         """`steps` decode tokens for all slots in ONE dispatch (a
-        lax.scan over `_decode_one`). Chunking amortizes per-token host
-        dispatch when no admission is waiting; the host drops back to
-        steps=1 while requests queue so a retiring slot frees at the
-        next token. The token sequence is IDENTICAL either way — the
-        scan body is the single-step program, and retirement only
-        changes what the host keeps, never what the device computes."""
+        lax.scan over `_decode_one`) — chunking amortizes per-token
+        host dispatch; admission happens between dispatches, so a
+        queued request waits at most steps-1 tokens for a freed slot
+        (the host's worker chooses steps). The token sequence is
+        IDENTICAL for any chunking — the scan body is the single-step
+        program, and retirement only changes what the host keeps,
+        never what the device computes."""
 
         def body(carry, _):
             st, rng = carry
@@ -648,18 +653,26 @@ class ContinuousBatcher:
                      + [{"temperature": 0.0, "top_k": 0, "top_p": 1.0}]
                      * (gp - len(group)))
             ids = [it[5] for it in group] + [0] * (gp - len(group))
+
+            def run_prefill(pstate0=None, lists=lists, b=b, samps=samps,
+                            sub=sub, ids=ids):
+                # host sync (np.asarray) INSIDE the executor: jax
+                # dispatch is async, so syncing on the loop thread
+                # would block the whole HTTP server for the device time
+                pstate, first, _ = self.cengine.prefill_batch(
+                    lists, b, samps, sub, ids, pstate0)
+                return pstate, np.asarray(first)
+
             try:
                 pstate0 = (await self._get_prefix_state(prefix)
                            if prefix else None)
                 async with self.gpu_lock:
-                    pstate, first, _ = await loop.run_in_executor(
-                        None, self.cengine.prefill_batch,
-                        lists, b, samps, sub, ids, pstate0)
+                    pstate, firsts = await loop.run_in_executor(
+                        None, run_prefill, pstate0)
             except Exception as e:  # noqa: BLE001
                 for _, _, _, fut, queue, _, _ in group:
                     self._fail(fut, queue, e)
                 continue
-            firsts = np.asarray(first)
             for row, (tokens, max_new, sampling, fut, queue, aid, _) in \
                     enumerate(group):
                 if fut.done():  # cancelled while prefilling
@@ -671,7 +684,7 @@ class ContinuousBatcher:
                     async with self.gpu_lock:
                         self._st = await loop.run_in_executor(
                             None, self.cengine.insert, self._st, slot,
-                            pstate, first, row, aid)
+                            pstate, firsts, row, aid)
                 except Exception as e:  # noqa: BLE001
                     self._free.append(slot)
                     self._fail(fut, queue, e)
@@ -715,12 +728,15 @@ class ContinuousBatcher:
             try:
                 self._rng, sub = jax.random.split(self._rng)
                 sp = self._sp()
+
+                def run_step(st=self._st, sp=sp, sub=sub, steps=steps):
+                    # host sync inside the executor (see run_prefill)
+                    st, toks, _ = self.cengine.step(st, sp, sub, steps)
+                    return st, np.asarray(toks)
+
                 async with self.gpu_lock:
-                    st, toks, _ = await loop.run_in_executor(
-                        None, self.cengine.step, self._st, sp, sub,
-                        steps)
+                    st, toks = await loop.run_in_executor(None, run_step)
                     self._st = st
-                    toks = np.asarray(toks)
             except Exception as e:  # noqa: BLE001 — fail active requests
                 for slot, rec in list(self._active.items()):
                     self._release(slot)

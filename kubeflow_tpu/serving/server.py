@@ -248,6 +248,12 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     # and interleaved generate calls would just thrash compile caches.
     lock = asyncio.Lock()
     app[GPU_LOCK_KEY] = lock
+    if not continuous and (warmup or prefill_chunk or prefixes):
+        # these knobs only exist on the continuous batcher; silently
+        # ignoring them would ship a server missing configuration the
+        # caller explicitly asked for
+        raise ValueError(
+            "warmup/prefill_chunk/prefixes require continuous=True")
     if continuous:
         # prefill_chunk: long prompts admit in fixed slices — chunk-
         # multiple buckets, one [g, chunk] compile for every length.

@@ -18,6 +18,7 @@ Layout per step: `<dir>/<step>/state/` (Orbax OCDBT tree) plus a
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Mapping
 
 import jax
@@ -125,21 +126,23 @@ class Checkpointer:
     def _restore_json_item(self, item: str, step: int | None,
                            *, missing_ok: bool) -> dict[str, Any]:
         """Shared step resolution + single-JSON-item restore for the
-        metadata and data-state side channels. `missing_ok` absorbs a
-        checkpoint written before the item existed."""
+        metadata and data-state side channels. `missing_ok` absorbs
+        only the ABSENT-item case (a checkpoint written before the
+        item existed) — a present-but-corrupt item raises, because
+        silently restoring {} would e.g. restart the data stream at
+        ticket 0 with no error (the failure the item exists to
+        prevent)."""
         if step is None:
             step = self.latest_step()
         if step is None:
             return {}
-        try:
-            restored = self._mgr.restore(
-                step,
-                args=ocp.args.Composite(**{item: ocp.args.JsonRestore()}),
-            )
-        except (FileNotFoundError, KeyError, ValueError):
-            if missing_ok:
-                return {}
-            raise
+        if missing_ok and not os.path.isdir(
+                os.path.join(self.config.directory, str(step), item)):
+            return {}
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(**{item: ocp.args.JsonRestore()}),
+        )
         return dict(restored[item] or {})
 
     def restore_metadata(self, step: int | None = None) -> dict[str, Any]:

@@ -305,4 +305,9 @@ void kt_loader_close(void* handle) {
 
 const char* kt_last_error() { return g_last_error.c_str(); }
 
+// Bump on ANY C-ABI change (kt_loader_open gained start_ticket at 2).
+// The Python side refuses to load a .so whose version disagrees —
+// loading a stale prebuilt binary would silently misread arguments.
+uint64_t kt_abi_version() { return 2; }
+
 }  // extern "C"

@@ -166,12 +166,22 @@ def test_data_state_resume_reproduces_uninterrupted_run(trainer, tmp_path):
 
     data_dirs = list((tmp_path / "c3").glob("*/data_state"))
     assert data_dirs, "expected a data_state item on disk"
-    for d in data_dirs:
-        shutil.rmtree(d)
+    # corrupt (present but unreadable) must RAISE — silently restoring
+    # {} would restart the data stream at ticket 0 with no error
+    (data_dirs[0] / "metadata").write_text("{truncated")
     ckpt3 = Checkpointer(
         CheckpointConfig(str(tmp_path / "c3"), enable_async=False),
         trainer)
-    assert ckpt3.restore_data_state() == {}
+    with pytest.raises(Exception):
+        ckpt3.restore_data_state()
+    # absent (pre-feature checkpoint) degrades to {}
+    for d in data_dirs:
+        shutil.rmtree(d)
+    ckpt4 = Checkpointer(
+        CheckpointConfig(str(tmp_path / "c3"), enable_async=False),
+        trainer)
+    assert ckpt4.restore_data_state() == {}
     ckpt.close()
     ckpt2.close()
     ckpt3.close()
+    ckpt4.close()

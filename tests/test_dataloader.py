@@ -172,3 +172,24 @@ def test_full_data_story_tokenize_shard_load_train(tmp_path):
             state, loss = trainer.step(state, arr[:, :-1], arr[:, 1:])
             losses.append(float(loss))
     assert min(losses[-4:]) < losses[0] * 0.8, losses
+
+
+def test_stale_abi_library_refused(monkeypatch):
+    """A prebuilt .so whose ABI disagrees (or predates the version
+    export) must be refused — falling back to the Python loader —
+    instead of silently misreading ctypes arguments."""
+    if not dl.native_available():
+        pytest.skip("no C++ toolchain")
+
+    class _StaleLib:
+        def __getattr__(self, name):
+            if name == "kt_abi_version":
+                raise AttributeError(name)  # pre-versioning binary
+            raise AssertionError("stale lib must not be configured")
+
+    monkeypatch.setattr(dl, "_lib", None)
+    monkeypatch.setattr(dl, "_build_failed", False)
+    monkeypatch.setattr(dl, "ensure_built", lambda: True)
+    monkeypatch.setattr(dl.ctypes, "CDLL", lambda path: _StaleLib())
+    assert dl._load_lib() is None
+    assert not dl.native_available()

@@ -512,3 +512,27 @@ def test_median_stopping_production_path_via_pod_annotations():
         exp = c.store.get("Experiment", "user1", "exp")
         assert exp.status.trials_early_stopped == 1
         assert exp.status.phase == "Succeeded"
+
+
+def test_malformed_intermediate_annotation_does_not_wedge():
+    """The intermediate-metrics annotation is client-writable: garbage
+    must not wedge either the stepwise branch or the mirror — the
+    controller warns and keeps reconciling."""
+    def stepwise(assignment, step):
+        return None if step >= 2 else float(step)
+
+    cfg = ClusterConfig(stepwise_trial_executor=stepwise)
+    with Cluster(cfg) as c:
+        c.store.create(_experiment(max_trials=1, parallel=1))
+        assert c.wait_idle(timeout=20)
+        # poison the completed pod's annotation, then force a reconcile
+        pods = [p for p in c.store.list("Pod", "user1")
+                if "trial-name" in p.metadata.labels]
+        assert pods
+        from kubeflow_tpu.api.crds import TRIAL_INTERMEDIATE_ANNOTATION
+        p = c.store.get("Pod", "user1", pods[0].metadata.name)
+        p.metadata.annotations[TRIAL_INTERMEDIATE_ANNOTATION] = "garbage"
+        c.store.update(p)
+        assert c.wait_idle(timeout=20)  # no wedge, no crash loop
+        exp = c.store.get("Experiment", "user1", "exp")
+        assert exp.status.phase == "Succeeded", exp.status

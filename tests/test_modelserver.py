@@ -157,3 +157,11 @@ def test_review_findings_pinned(cluster):
     dep = cluster.store.get("Deployment", "user1", "good")
     probe = dep.spec.template.spec.containers[0].readiness_probe
     assert probe is not None and probe.path == "/readyz"
+
+
+def test_nonpositive_numerics_surface_event(cluster):
+    cluster.store.create(mk_ms("badnum", max_batch=0))
+    assert cluster.wait_idle()
+    evs = cluster.store.events_for("ModelServer", "user1", "badnum")
+    assert any(e.reason == "InvalidSpec" for e in evs), evs
+    assert cluster.store.try_get("Deployment", "user1", "badnum") is None

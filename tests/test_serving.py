@@ -673,3 +673,12 @@ async def test_moe_serves_through_continuous_batcher():
         *(batcher.submit(p, 5, ()) for p in prompts))
     assert list(got) == want
     await batcher.close()
+
+
+def test_continuous_only_knobs_rejected_without_continuous(llama_engine):
+    engine, _, _ = llama_engine
+    with pytest.raises(ValueError, match="require continuous"):
+        server_lib.create_serving_app({"m": engine}, warmup=True)
+    with pytest.raises(ValueError, match="require continuous"):
+        server_lib.create_serving_app({"m": engine},
+                                      prefixes={"sys": [1, 2]})
