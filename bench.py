@@ -466,12 +466,12 @@ def bench_decode_continuous(model: str, *, slots: int, prompt_len: int,
     assert prompt_len + budget <= max_len, (prompt_len, budget, max_len)
     for i in range(slots):
         p = rng.integers(0, cfg.vocab_size, prompt_len).tolist()
-        pstate, first, _ = ce.prefill(p, budget, {}, key)
+        pstate, first, _, _ = ce.prefill(p, budget, {}, key)
         st = ce.insert(st, i, pstate, first)
     sp = eng._resolve_sampling(
         np.zeros(slots, np.float32), np.zeros(slots, np.int64),
         np.ones(slots, np.float32), key, batch=slots)[0]
-    st, toks, key = ce.step(st, sp, key, steps=chunk)  # compile + warm
+    st, toks, _, key = ce.step(st, sp, key, steps=chunk)  # compile + warm
     jax.block_until_ready(toks)
     decoded = rounds * chunk
     reps = []  # (dt, avg KV fill DURING this rep) — fill accumulates
@@ -481,7 +481,7 @@ def bench_decode_continuous(model: str, *, slots: int, prompt_len: int,
         start_fill = prompt_len + chunk + r * decoded
         t0 = time.perf_counter()
         for _ in range(rounds):
-            st, toks, key = ce.step(st, sp, key, steps=chunk)
+            st, toks, _, key = ce.step(st, sp, key, steps=chunk)
         jax.block_until_ready(toks)
         reps.append((time.perf_counter() - t0,
                      start_fill + decoded / 2))

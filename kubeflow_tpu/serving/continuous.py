@@ -215,15 +215,15 @@ class ContinuousEngine:
                 jnp.repeat(ps.offset, g, axis=0))
         c = self.prefill_chunk
         if c and bucket > c and bucket % c == 0:
-            state, first, _, done = eng.prefill_chunked(
+            state, first, _, done, lps = eng.prefill_chunked(
                 eng.params, jnp.asarray(arr), state0, rng,
                 sp, jnp.asarray(mask), chunk=c,
                 adapters=adapters, adapter_ids=ids)
         else:
-            state, first, _, done = eng._prefill_jit(
+            state, first, _, done, lps = eng._prefill_jit(
                 eng.params, jnp.asarray(arr), state0, rng, sp,
                 jnp.asarray(mask), adapters=adapters, adapter_ids=ids)
-        return state, first, done
+        return state, first, done, lps
 
     def prefill(self, tokens: list[int], max_new: int,
                 sampling: dict[str, Any], rng: jax.Array):
@@ -278,13 +278,13 @@ class ContinuousEngine:
         greedy = {"temperature": 0.0, "top_k": 0, "top_p": 1.0}
         while g <= self.S:
             for b in buckets:
-                pstate, first, _ = self.prefill_batch(
+                pstate, first, _, _ = self.prefill_batch(
                     [[0]] * g, b, [greedy] * g, rng)
                 st = self.insert(st, 0, pstate, first, 0)
                 n += 2
             g *= 2
         for steps in step_sizes:
-            st, _, rng = self.step(st, sp, rng, steps)
+            st, _, _, rng = self.step(st, sp, rng, steps)
             n += 1
         return n
 
@@ -352,12 +352,12 @@ class ContinuousEngine:
         x, (k_new, v_new) = jax.lax.scan(layer, x, xs)
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
         logits = eng._head(params, x[:, -1])
-        nxt = eng._sample(logits, sub, sp)
+        nxt, lp = eng._sample(logits, sub, sp)
         st = SlotState(
             k_new, v_new,
             jnp.minimum(st.length + 1, ec.max_len),
             st.offset, st.pad, nxt.astype(jnp.int32), st.aid)
-        return st, nxt, rng
+        return st, nxt, lp, rng
 
     def _step(self, params, adapters, st: SlotState, sp: SamplingParams,
               rng, *, steps: int):
@@ -372,15 +372,18 @@ class ContinuousEngine:
 
         def body(carry, _):
             st, rng = carry
-            st, tok, rng = self._decode_one(params, adapters, st, sp, rng)
-            return (st, rng), tok
+            st, tok, lp, rng = self._decode_one(params, adapters, st,
+                                                sp, rng)
+            return (st, rng), (tok, lp)
 
-        (st, rng), toks = jax.lax.scan(
+        (st, rng), (toks, lps) = jax.lax.scan(
             body, (st, rng), None, length=steps)
-        return st, jnp.moveaxis(toks, 0, 1), rng  # [S, steps]
+        return (st, jnp.moveaxis(toks, 0, 1),
+                jnp.moveaxis(lps, 0, 1), rng)  # [S, steps] each
 
     def step(self, st: SlotState, sp: SamplingParams, rng,
              steps: int = 1):
+        """-> (state, tokens [S, steps], logprobs [S, steps], rng)."""
         pack = self.engine.adapter_pack
         return self._step_jit(self.engine.params,
                               None if pack is None else pack.blocks,
@@ -390,11 +393,12 @@ class ContinuousEngine:
 class _Slot:
     """Host-side record for one admitted request."""
 
-    __slots__ = ("fut", "out", "max_new", "queue", "stop")
+    __slots__ = ("fut", "out", "lps", "max_new", "queue", "stop")
 
     def __init__(self, fut, max_new: int, queue, stop=()):
         self.fut = fut
         self.out: list[int] = []
+        self.lps: list[float] = []  # chosen-token logprobs, out-aligned
         self.max_new = max_new
         self.queue = queue  # per-request token stream (None for oneshot)
         self.stop = stop    # token-id sequences that end generation
@@ -481,16 +485,20 @@ class ContinuousBatcher:
     # -- public API -------------------------------------------------------
 
     async def submit(self, tokens: list[int], max_new: int,
-                     sampling: tuple) -> list[int]:
+                     sampling: tuple, *, with_logprobs: bool = False):
         """Generate `max_new` tokens for one prompt; resolves when THIS
         request finishes (other slots keep decoding). The result is
         EOS-padded to exactly max_new — interchangeable with the window
         Batcher's fixed-shape contract (a request that hits EOS early
         stops COMPUTING early here; the pad is host-side). Requests
-        with stop sequences return the TRIMMED output unpadded —
-        stopping short is the ask."""
+        with stop sequences — or with_logprobs, whose entries must
+        stay 1:1 with real computed tokens — return the trimmed
+        output unpadded. with_logprobs=True returns (tokens,
+        logprobs)."""
         fut = self._enqueue(tokens, max_new, sampling, queue=None)
-        out = await fut
+        out, lps = await fut
+        if with_logprobs:
+            return out, lps
         eos = self.engine.ec.eos_token
         if eos is not None and len(out) < max_new \
                 and not dict(sampling).get("stop"):
@@ -583,11 +591,13 @@ class ContinuousBatcher:
         if rec.queue is not None and not rec.fut.done():
             rec.queue.put_nowait(None)
         if not rec.fut.done():
-            rec.fut.set_result(rec.out[:rec.max_new])
+            rec.fut.set_result((rec.out[:rec.max_new],
+                                rec.lps[:rec.max_new]))
 
-    def _emit(self, slot: int, rec: _Slot, token: int, *,
+    def _emit(self, slot: int, rec: _Slot, token: int, lp: float, *,
               decode: bool = True) -> None:
         rec.out.append(token)
+        rec.lps.append(lp)
         if decode:
             # admission-time first tokens (prefill) stay out of the
             # occupancy numerator — calls counts decode steps only
@@ -602,6 +612,7 @@ class ContinuousBatcher:
             n = len(seq)
             if n and len(rec.out) >= n and rec.out[-n:] == list(seq):
                 rec.out = rec.out[:-n]
+                rec.lps = rec.lps[:-n]
                 self._finish(slot, rec)
                 return
         eos = self.engine.ec.eos_token
@@ -659,15 +670,15 @@ class ContinuousBatcher:
                 # host sync (np.asarray) INSIDE the executor: jax
                 # dispatch is async, so syncing on the loop thread
                 # would block the whole HTTP server for the device time
-                pstate, first, _ = self.cengine.prefill_batch(
+                pstate, first, _, lps = self.cengine.prefill_batch(
                     lists, b, samps, sub, ids, pstate0)
-                return pstate, np.asarray(first)
+                return pstate, np.asarray(first), np.asarray(lps)
 
             try:
                 pstate0 = (await self._get_prefix_state(prefix)
                            if prefix else None)
                 async with self.gpu_lock:
-                    pstate, firsts = await loop.run_in_executor(
+                    pstate, firsts, flps = await loop.run_in_executor(
                         None, run_prefill, pstate0)
             except Exception as e:  # noqa: BLE001
                 for _, _, _, fut, queue, _, _ in group:
@@ -699,7 +710,8 @@ class ContinuousBatcher:
                     "temperature", ec.temperature)
                 self._topk[slot] = sampling.get("top_k", ec.top_k)
                 self._topp[slot] = sampling.get("top_p", ec.top_p)
-                self._emit(slot, rec, int(firsts[row]), decode=False)
+                self._emit(slot, rec, int(firsts[row]),
+                           float(flps[row]), decode=False)
 
     async def _run(self) -> None:
         loop = asyncio.get_event_loop()
@@ -731,11 +743,13 @@ class ContinuousBatcher:
 
                 def run_step(st=self._st, sp=sp, sub=sub, steps=steps):
                     # host sync inside the executor (see run_prefill)
-                    st, toks, _ = self.cengine.step(st, sp, sub, steps)
-                    return st, np.asarray(toks)
+                    st, toks, lps, _ = self.cengine.step(st, sp, sub,
+                                                         steps)
+                    return st, np.asarray(toks), np.asarray(lps)
 
                 async with self.gpu_lock:
-                    st, toks = await loop.run_in_executor(None, run_step)
+                    st, toks, lps = await loop.run_in_executor(
+                        None, run_step)
                     self._st = st
             except Exception as e:  # noqa: BLE001 — fail active requests
                 for slot, rec in list(self._active.items()):
@@ -752,7 +766,8 @@ class ContinuousBatcher:
                     self._finish(slot, rec)
                     continue
                 for j in range(steps):
-                    self._emit(slot, rec, int(toks[slot, j]))
+                    self._emit(slot, rec, int(toks[slot, j]),
+                               float(lps[slot, j]))
                     if slot not in self._active:
                         break  # retired mid-chunk; tail is trimmed
             # let submissions/cancellations interleave between steps

@@ -366,6 +366,11 @@ class InferenceEngine:
             jnp.zeros((), jnp.int32))
 
     def _sample(self, logits, rng, sp: SamplingParams):
+        """-> (tokens [b], logprobs [b]). The logprob is the chosen
+        token's log-softmax under the RAW model distribution
+        (temperature/filters don't rescale it — OpenAI convention);
+        computing it unconditionally costs one O(b·vocab) pass next to
+        the O(b·hidden·vocab) head matmul that produced the logits."""
         # lax.cond, not jnp.where: an all-greedy decode must not pay
         # the sampled branch's full-vocab argsorts/cumsum/categorical
         # per step (256k vocab on Gemma) just to discard the result.
@@ -379,8 +384,11 @@ class InferenceEngine:
                 axis=-1).astype(jnp.int32)
             return jnp.where(sp.temperature > 0.0, drawn, greedy(None))
 
-        return jax.lax.cond(
+        tok = jax.lax.cond(
             jnp.any(sp.temperature > 0.0), sampled, greedy, None)
+        raw = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        lp = jnp.take_along_axis(raw, tok[:, None], axis=-1)[:, 0]
+        return tok, lp
 
     def _resolve_sampling(
         self, temperature, top_k, top_p, rng: jax.Array | None,
@@ -448,23 +456,26 @@ class InferenceEngine:
     def _prefill_sample(self, params, prompt, state, rng,
                         sp: SamplingParams, prompt_mask,
                         adapters=None, adapter_ids=None):
-        """Prefill + sample token #1. Shared head of generate and
-        generate_stream so both follow the same rng discipline."""
+        """Prefill + sample token #1 (and its logprob). Shared head of
+        generate and generate_stream so both follow the same rng
+        discipline."""
         eos = self.ec.eos_token
         rng, sub = jax.random.split(rng)  # use-once key discipline
         logits, state = self._forward_cached(
             params, prompt, state, prompt_mask=prompt_mask,
             adapters=adapters, adapter_ids=adapter_ids)
-        first = self._sample(logits, sub, sp)
+        first, lp = self._sample(logits, sub, sp)
         done = (first == eos) if eos is not None else jnp.zeros(
             first.shape, bool)
-        return state, first, rng, done
+        return state, first, rng, done, lp
 
     def _decode_chunk(self, params, state, tok, rng, done,
                       sp: SamplingParams, *, length: int,
                       adapters=None, adapter_ids=None):
-        """`length` decode steps from carry. Returns the new carry and
-        the [b, length] tokens. The ONE step body both entry points
+        """`length` decode steps from carry. Returns the new carry, the
+        [b, length] tokens and their logprobs (logprob entries past a
+        row's first EOS describe the pre-forcing sampled token and are
+        undefined for callers). The ONE step body both entry points
         scan over — stream-vs-oneshot equality is by construction."""
         eos = self.ec.eos_token
 
@@ -474,29 +485,31 @@ class InferenceEngine:
             logits, state = self._forward_cached(
                 params, tok[:, None], state,
                 adapters=adapters, adapter_ids=adapter_ids)
-            nxt = self._sample(logits, sub, sp)
+            nxt, lp = self._sample(logits, sub, sp)
             if eos is not None:
                 # Sequences past EOS emit EOS forever (static shapes —
                 # the scan always runs `length` steps; callers trim).
                 nxt = jnp.where(done, jnp.asarray(eos, nxt.dtype), nxt)
                 done = done | (nxt == eos)
-            return (state, nxt, rng, done), nxt
+            return (state, nxt, rng, done), (nxt, lp)
 
-        (state, tok, rng, done), rest = jax.lax.scan(
+        (state, tok, rng, done), (rest, lps) = jax.lax.scan(
             step, (state, tok, rng, done), None, length=length)
-        return state, tok, rng, done, jnp.moveaxis(rest, 0, 1)
+        return (state, tok, rng, done, jnp.moveaxis(rest, 0, 1),
+                jnp.moveaxis(lps, 0, 1))
 
     def _generate(self, params, prompt, state, rng, sp: SamplingParams,
                   prompt_mask, *, max_new: int,
                   adapters=None, adapter_ids=None):
-        state, first, rng, done = self._prefill_sample(
+        state, first, rng, done, lp1 = self._prefill_sample(
             params, prompt, state, rng, sp, prompt_mask,
             adapters, adapter_ids)
-        state, _, _, _, rest = self._decode_chunk(
+        state, _, _, _, rest, lps = self._decode_chunk(
             params, state, first, rng, done, sp, length=max_new - 1,
             adapters=adapters, adapter_ids=adapter_ids)
         toks = jnp.concatenate([first[:, None], rest], axis=1)
-        return toks, state
+        lps = jnp.concatenate([lp1[:, None], lps], axis=1)
+        return toks, lps, state
 
     def generate(
         self,
@@ -510,6 +523,7 @@ class InferenceEngine:
         prompt_mask: jnp.ndarray | None = None,  # [b, s] bool, False=pad
         prefill_chunk: int | None = None,
         adapter: "str | list[str] | None" = None,
+        return_logprobs: bool = False,
     ) -> jnp.ndarray:
         """Generate `max_new` tokens after the prompt. Returns [b, max_new]
         (post-hoc EOS trimming is the caller's job — shapes stay static).
@@ -522,7 +536,10 @@ class InferenceEngine:
         prefill_chunked) — same tokens, chunk-bounded compile shapes
         and activation memory. `adapter` (needs an adapter_pack) picks
         a resident LoRA fine-tune — one name for the whole batch or
-        one per row; ''/None rows decode the plain base."""
+        one per row; ''/None rows decode the plain base.
+        `return_logprobs` returns (tokens, logprobs): each chosen
+        token's raw-model log-softmax (entries past a row's first EOS
+        are undefined)."""
         sp, rng, prompt_mask, state = self._prep(
             prompt_tokens, max_new, rng, temperature, top_k, top_p,
             prompt_mask)
@@ -540,11 +557,11 @@ class InferenceEngine:
             adapter_ids = jnp.asarray(
                 [self.adapter_pack.resolve(n) for n in names], jnp.int32)
         if prefill_chunk is None:
-            toks, _ = self._generate_jit(
+            toks, lps, _ = self._generate_jit(
                 self.params, prompt_tokens, state, rng, sp, prompt_mask,
                 max_new=max_new, adapters=adapters,
                 adapter_ids=adapter_ids)
-            return toks
+            return (toks, lps) if return_logprobs else toks
         if prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, got "
                              f"{prefill_chunk}")
@@ -560,15 +577,18 @@ class InferenceEngine:
                  prompt_tokens], axis=1)
             prompt_mask = jnp.concatenate(
                 [jnp.zeros((b, pad), bool), prompt_mask], axis=1)
-        state, first, rng, done = self.prefill_chunked(
+        state, first, rng, done, lp1 = self.prefill_chunked(
             self.params, prompt_tokens, state, rng, sp, prompt_mask,
             chunk=prefill_chunk, adapters=adapters,
             adapter_ids=adapter_ids)
-        _, _, _, _, rest = self._chunk_jit(
+        _, _, _, _, rest, lps = self._chunk_jit(
             self.params, state, first, rng, done, sp,
             length=max_new - 1, adapters=adapters,
             adapter_ids=adapter_ids)
-        return jnp.concatenate([first[:, None], rest], axis=1)
+        toks = jnp.concatenate([first[:, None], rest], axis=1)
+        if return_logprobs:
+            return toks, jnp.concatenate([lp1[:, None], lps], axis=1)
+        return toks
 
     def _prep(self, prompt_tokens, max_new, rng, temperature, top_k,
               top_p, prompt_mask):
@@ -628,7 +648,7 @@ class InferenceEngine:
             prompt_mask)
 
         def _iter():
-            state_, tok, rng_, done = self._prefill_jit(
+            state_, tok, rng_, done, _ = self._prefill_jit(
                 self.params, prompt_tokens, state, rng, sp, prompt_mask)
             yield np.asarray(tok)[:, None]
             emitted = 1
@@ -637,7 +657,7 @@ class InferenceEngine:
                         np.asarray(done).all()):
                     return
                 n = min(chunk, max_new - emitted)
-                state_, tok, rng_, done, rest = self._chunk_jit(
+                state_, tok, rng_, done, rest, _ = self._chunk_jit(
                     self.params, state_, tok, rng_, done, sp, length=n)
                 yield np.asarray(rest)
                 emitted += n

@@ -504,6 +504,10 @@ async def generate(request: web.Request):
     if not isinstance(prefix, str):
         return web.json_response(
             {"error": "prefix must be a string"}, status=400)
+    logprobs = body.get("logprobs", False)
+    if not isinstance(logprobs, bool):
+        return web.json_response(
+            {"error": "logprobs must be a boolean"}, status=400)
     stop = body.get("stop", [])
     if (not isinstance(stop, list) or len(stop) > 4
             or not all(isinstance(s, list) and 0 < len(s) <= 16
@@ -595,6 +599,10 @@ async def generate(request: web.Request):
             return web.json_response(
                 {"error": "stop does not compose with stream"},
                 status=400)
+        if logprobs:
+            return web.json_response(
+                {"error": "logprobs does not compose with stream"},
+                status=400)
         cbatcher = request.app[BATCHERS_KEY].get(name)
         if isinstance(cbatcher, ContinuousBatcher) and arr.shape[0] == 1:
             # a continuous-batched stream shares the slot batch with
@@ -614,6 +622,10 @@ async def generate(request: web.Request):
             tokenizer)
 
     resp_extra: dict[str, Any] = {}
+    if speculative and logprobs:
+        return web.json_response(
+            {"error": "logprobs does not compose with speculative"},
+            status=400)
     if speculative and adapter:
         return web.json_response(
             {"error": "adapter does not compose with speculative"},
@@ -674,7 +686,8 @@ async def generate(request: web.Request):
         }
     elif (batcher := request.app[BATCHERS_KEY].get(name)) is not None \
             and arr.shape[0] == 1 \
-            and (not adapter or isinstance(batcher, ContinuousBatcher)):
+            and (not adapter or isinstance(batcher, ContinuousBatcher)) \
+            and (not logprobs or isinstance(batcher, ContinuousBatcher)):
         # single-prompt requests ride the dynamic batcher; explicit
         # client-side batches keep their one-shot path. Adapter
         # requests ride the CONTINUOUS batcher (per-slot ids); under a
@@ -689,28 +702,52 @@ async def generate(request: web.Request):
             # batcher runs its group to the group max and the shared
             # post-trim below applies the semantics
             submit_sampling["stop"] = tuple(tuple(s) for s in stop)
-        ids = await batcher.submit(
-            arr[0].tolist(), max_new_req,
-            tuple(sorted(submit_sampling.items())))
+        if logprobs and isinstance(batcher, ContinuousBatcher):
+            ids, req_lps = await batcher.submit(
+                arr[0].tolist(), max_new_req,
+                tuple(sorted(submit_sampling.items())),
+                with_logprobs=True)
+            lp_rows = [list(req_lps)]
+        else:
+            ids = await batcher.submit(
+                arr[0].tolist(), max_new_req,
+                tuple(sorted(submit_sampling.items())))
+            lp_rows = None
         toks = np.asarray([ids], np.int32)
     else:
         if adapter:
             sampling["adapter"] = adapter  # engine.generate kwarg
+
+        def run_direct():
+            out = engine.generate(jnp.asarray(arr), max_new=max_new,
+                                  return_logprobs=logprobs, **sampling)
+            if logprobs:
+                t, lp = out
+                return np.asarray(t), np.asarray(lp)
+            return np.asarray(out), None
+
         async with request.app[GPU_LOCK_KEY]:
-            toks = await asyncio.get_event_loop().run_in_executor(
-                None,
-                lambda: np.asarray(
-                    engine.generate(jnp.asarray(arr), max_new=max_new,
-                                    **sampling)),
-            )
+            toks, lp_arr = await asyncio.get_event_loop(
+            ).run_in_executor(None, run_direct)
+        lp_rows = (lp_arr[:, :max_new_req].tolist()
+                   if lp_arr is not None else None)
     toks = toks[:, :max_new_req]  # trim the bucket back to the ask
     rows = toks.tolist()
+    if speculative:
+        lp_rows = None
     if stop:
         # OpenAI semantics on every path: output ends BEFORE the
         # earliest stop-sequence occurrence (the continuous batcher
         # already trimmed its suffix; re-scanning is a no-op there)
         rows = [_apply_stop(r, stop) for r in rows]
+        if lp_rows is not None:
+            lp_rows = [lp[:len(r)] for lp, r in zip(lp_rows, rows)]
     resp: dict[str, Any] = {"tokens": rows, **resp_extra}
+    if logprobs and lp_rows is not None:
+        # 1:1 with tokens; entries past a row's first EOS are
+        # undefined (engine contract)
+        resp["logprobs"] = [[round(float(x), 6) for x in lp[:len(r)]]
+                            for lp, r in zip(lp_rows, rows)]
     if text_mode:
         resp["text"] = (tokenizer.decode(rows[0]) if tokenizer
                         else byte_decode(rows[0]))

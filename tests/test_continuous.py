@@ -62,14 +62,14 @@ def test_slot_step_matches_generate_mixed_cursors():
     st = ce.init_slots()
     got = [[] for _ in prompts]
     for i, p in enumerate(prompts):
-        pstate, first, _ = ce.prefill(p, max_new, {}, rng)
+        pstate, first, _, _ = ce.prefill(p, max_new, {}, rng)
         st = ce.insert(st, i, pstate, first)
         got[i].append(int(np.asarray(first)[0]))
     sp = engine._resolve_sampling(
         np.zeros(4, np.float32), np.zeros(4, np.int64),
         np.ones(4, np.float32), rng, batch=4)[0]
     for _ in range(max_new - 1):
-        st, toks, rng = ce.step(st, sp, rng)
+        st, toks, _, rng = ce.step(st, sp, rng)
         toks = np.asarray(toks)       # [slots, 1]
         for i in range(len(prompts)):
             got[i].append(int(toks[i, 0]))
@@ -86,14 +86,14 @@ def test_chunked_steps_emit_identical_tokens():
     p = np.random.default_rng(14).integers(
         0, cfg.vocab_size, 7).tolist()
     want = _solo(engine, p, 7)
-    pstate, first, _ = ce.prefill(p, 7, {}, rng)
+    pstate, first, _, _ = ce.prefill(p, 7, {}, rng)
     st = ce.insert(ce.init_slots(), 0, pstate, first)
     sp = engine._resolve_sampling(
         np.zeros(2, np.float32), np.zeros(2, np.int64),
         np.ones(2, np.float32), rng, batch=2)[0]
-    st, toks, rng = ce.step(st, sp, rng, steps=3)
+    st, toks, _, rng = ce.step(st, sp, rng, steps=3)
     got = [int(np.asarray(first)[0])] + np.asarray(toks)[0].tolist()
-    st, toks, rng = ce.step(st, sp, rng, steps=3)
+    st, toks, _, rng = ce.step(st, sp, rng, steps=3)
     got += np.asarray(toks)[0].tolist()
     assert got == want
 
@@ -482,15 +482,15 @@ def test_continuous_engine_under_tensor_parallel_mesh():
         st = ce.init_slots()
         got = [[] for _ in prompts]
         for i, p in enumerate(prompts):
-            pstate, first, _ = ce.prefill(p, max_new, {},
-                                          jax.random.key(1))
+            pstate, first, _, _ = ce.prefill(p, max_new, {},
+                                             jax.random.key(1))
             st = ce.insert(st, i, pstate, first)
             got[i].append(int(np.asarray(first)[0]))
         sp = engine._resolve_sampling(
             np.zeros(2, np.float32), np.zeros(2, np.int64),
             np.ones(2, np.float32), jax.random.key(2), batch=2)[0]
         rng = jax.random.key(3)
-        st, toks, rng = ce.step(st, sp, rng, steps=max_new - 1)
+        st, toks, _, rng = ce.step(st, sp, rng, steps=max_new - 1)
         toks = np.asarray(toks)
     for i in range(len(prompts)):
         got[i].extend(toks[i].tolist())
@@ -546,3 +546,54 @@ async def test_rest_stop_sequences_all_paths():
             json={"tokens": [p], "max_new": 8, "stop": [[]]})
         assert r.status == 400
         await client.close()
+
+
+@pytest.mark.slow
+async def test_logprobs_over_rest_all_paths():
+    """'logprobs': true returns the chosen tokens' raw-model
+    log-softmax, 1:1 with tokens, identical between the continuous
+    batcher and the direct path, and each entry is a valid logprob of
+    the returned token."""
+    import math
+
+    engine, cfg = _engine()
+    gen = np.random.default_rng(40)
+    p = gen.integers(0, cfg.vocab_size, 6).tolist()
+
+    got = {}
+    for mode, kwargs in (("continuous",
+                          {"continuous": True, "max_batch": 4}),
+                         ("direct", {})):
+        app = server_lib.create_serving_app({"m": engine}, **kwargs)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        r = await client.post(
+            "/v1/models/m:generate",
+            json={"tokens": [p], "max_new": 5, "logprobs": True})
+        assert r.status == 200, await r.text()
+        body = await r.json()
+        assert len(body["logprobs"][0]) == len(body["tokens"][0]) == 5
+        assert all(lp <= 0.0 and math.isfinite(lp)
+                   for lp in body["logprobs"][0])
+        got[mode] = body
+        r = await client.post(
+            "/v1/models/m:generate",
+            json={"tokens": [p], "max_new": 5, "logprobs": True,
+                  "stream": True})
+        assert r.status == 400
+        await client.close()
+    assert got["continuous"]["tokens"] == got["direct"]["tokens"]
+    for a, b in zip(got["continuous"]["logprobs"][0],
+                    got["direct"]["logprobs"][0]):
+        assert a == pytest.approx(b, abs=1e-4)
+    # oracle: greedy chosen-token logprob == max log-softmax of the
+    # model's own forward at that position
+    toks, lps = engine.generate(
+        jnp.asarray([p], jnp.int32), max_new=5, return_logprobs=True)
+    full = jnp.concatenate([jnp.asarray([p], jnp.int32), toks], axis=1)
+    logits = llama.apply(engine.params, llama.LLAMA_TINY, full)
+    for i in range(5):
+        pos_logits = logits[0, len(p) - 1 + i] * 1.0
+        want = float(jax.nn.log_softmax(pos_logits.astype(jnp.float32))[
+            int(toks[0, i])])
+        assert float(lps[0, i]) == pytest.approx(want, abs=1e-3)
