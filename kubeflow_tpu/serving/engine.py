@@ -676,6 +676,39 @@ class InferenceEngine:
     def _forward_jit(self):
         return jax.jit(self._forward_cached)
 
+    def _score(self, params, tokens, state, prompt_mask):
+        logits, _ = self._forward_cached(
+            params, tokens, state, prompt_mask=prompt_mask,
+            return_all=True)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        # token i is predicted by position i-1: shift, gather, and
+        # zero the pad positions so a masked row scores only its tokens
+        tgt = tokens[:, 1:]
+        got = jnp.take_along_axis(lp[:, :-1], tgt[:, :, None],
+                                  axis=-1)[:, :, 0]
+        return got * prompt_mask[:, 1:].astype(jnp.float32)
+
+    @functools.cached_property
+    def _score_jit(self):
+        return jax.jit(self._score)
+
+    def score(self, tokens: jnp.ndarray,
+              prompt_mask: jnp.ndarray | None = None) -> jnp.ndarray:
+        """Teacher-forced scoring: log P(token_i | tokens_<i) for every
+        position past the first, [b, s-1] fp32 (pad positions 0) — the
+        perplexity/eval path (lm-eval style), no decoding. One forward,
+        `return_all` logits, no cache reuse across calls."""
+        b, s = tokens.shape
+        if s < 2:
+            raise ValueError("scoring needs at least 2 tokens")
+        if s > self.ec.max_len:
+            raise ValueError(
+                f"sequence {s} exceeds cache bucket {self.ec.max_len}")
+        if prompt_mask is None:
+            prompt_mask = jnp.ones((b, s), bool)
+        return self._score_jit(self.params, tokens, self.init_state(b),
+                               prompt_mask)
+
     def precompute_prefix(self, tokens: list[int]):
         """Run a shared prefix (system prompt) ONCE; returns a batch-1
         DecodeState at length=len(tokens). Admissions seeded from this

@@ -287,6 +287,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     app.router.add_get("/readyz", _ok)
     app.router.add_get("/v1/models", list_models)
     app.router.add_post("/v1/models/{name}:generate", generate)
+    app.router.add_post("/v1/models/{name}:score", score)
     return app
 
 
@@ -417,6 +418,69 @@ async def _stream_continuous(request, batcher, arr, max_new, sampling,
     await resp.write(b"data: " + _json.dumps(final).encode() + b"\n\n")
     await resp.write_eof()
     return resp
+
+
+async def score(request: web.Request):
+    """Teacher-forced scoring: log P(token_i | prefix) for a given
+    sequence — the perplexity/eval door (lm-eval style). Body:
+    {"tokens": [[...]]} or {"text": "..."}; response: per-position
+    logprobs (s-1 per row), each row's total, and token count."""
+    name = request.match_info["name"]
+    engine = request.app[ENGINES_KEY].get(name)
+    if engine is None:
+        return web.json_response(
+            {"error": f"no model {name!r}"}, status=404)
+    try:
+        body: dict[str, Any] = await request.json()
+    except Exception:
+        return web.json_response({"error": "invalid JSON"}, status=400)
+    tokenizer = request.app[TOKENIZER_KEY]
+    if "text" in body:
+        if not isinstance(body["text"], str):
+            return web.json_response(
+                {"error": "'text' must be a string"}, status=400)
+        token_lists = [tokenizer.encode(body["text"], bos=True)
+                       if tokenizer else byte_encode(body["text"])]
+    elif "tokens" in body:
+        token_lists = body["tokens"]
+        if (not isinstance(token_lists, list) or not token_lists
+                or not all(
+                    isinstance(t, list) and len(t) >= 2
+                    and all(isinstance(x, int) and not isinstance(x, bool)
+                            for x in t)
+                    for t in token_lists)):
+            return web.json_response(
+                {"error": "tokens must be non-empty integer token-id "
+                          "lists of at least 2 tokens"}, status=400)
+    else:
+        return web.json_response(
+            {"error": "body needs 'text' or 'tokens'"}, status=400)
+    if len({len(t) for t in token_lists}) != 1:
+        return web.json_response(
+            {"error": "all rows must share a length (static shapes)"},
+            status=400)
+    if len(token_lists[0]) > engine.ec.max_len:
+        return web.json_response(
+            {"error": f"sequence {len(token_lists[0])} exceeds model "
+                      f"max_len {engine.ec.max_len}"}, status=400)
+    vocab = engine.cfg.vocab_size
+    try:
+        arr = np.asarray(token_lists, dtype=np.int32)
+    except OverflowError:
+        return web.json_response(
+            {"error": f"token ids must be in [0, {vocab})"}, status=400)
+    if arr.min() < 0 or arr.max() >= vocab:
+        return web.json_response(
+            {"error": f"token ids must be in [0, {vocab})"}, status=400)
+
+    async with request.app[GPU_LOCK_KEY]:
+        lps = await asyncio.get_event_loop().run_in_executor(
+            None, lambda: np.asarray(engine.score(jnp.asarray(arr))))
+    return web.json_response({
+        "logprobs": [[round(float(x), 6) for x in row] for row in lps],
+        "total": [round(float(row.sum()), 6) for row in lps],
+        "count": int(arr.shape[1] - 1),
+    })
 
 
 async def generate(request: web.Request):

@@ -682,3 +682,38 @@ def test_continuous_only_knobs_rejected_without_continuous(llama_engine):
     with pytest.raises(ValueError, match="require continuous"):
         server_lib.create_serving_app({"m": engine},
                                       prefixes={"sys": [1, 2]})
+
+
+@pytest.mark.slow
+async def test_score_endpoint_matches_full_forward(llama_engine):
+    """Teacher-forced scoring: engine.score and the :score door match
+    a direct log-softmax over llama.apply logits, and total/count give
+    perplexity directly."""
+    import math
+
+    engine, cfg, params = llama_engine
+    seq = np.random.default_rng(50).integers(
+        0, cfg.vocab_size, (2, 9)).tolist()
+    lps = np.asarray(engine.score(jnp.asarray(seq, jnp.int32)))
+    logits = llama.apply(params, cfg, jnp.asarray(seq, jnp.int32))
+    want = np.asarray(
+        jnp.take_along_axis(
+            jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32),
+                               axis=-1),
+            jnp.asarray(seq, jnp.int32)[:, 1:, None], axis=-1)[:, :, 0])
+    np.testing.assert_allclose(lps, want, atol=1e-4)
+
+    app = server_lib.create_serving_app({"m": engine})
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    r = await client.post("/v1/models/m:score", json={"tokens": seq})
+    assert r.status == 200, await r.text()
+    body = await r.json()
+    assert body["count"] == 8
+    assert len(body["logprobs"][0]) == 8
+    for row, tot in zip(body["logprobs"], body["total"]):
+        assert tot == pytest.approx(sum(row), abs=1e-3)
+        assert all(lp <= 0.0 and math.isfinite(lp) for lp in row)
+    r = await client.post("/v1/models/m:score", json={"tokens": [[5]]})
+    assert r.status == 400
+    await client.close()
