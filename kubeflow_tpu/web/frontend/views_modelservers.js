@@ -19,9 +19,9 @@ export async function modelserversView() {
         {},
         h(
           'span',
-          { class: 'status' },
+          { class: 'status', title: m.warning || '' },
           h('span', { class: `dot ${m.ready ? 'ready' : 'waiting'}` }),
-          m.ready ? 'ready' : 'starting',
+          m.warning ? 'error' : m.ready ? 'ready' : 'starting',
         ),
       ),
       h('td', {}, m.ready ? h('a', { href: m.url, target: '_blank', rel: 'noopener' }, m.name) : m.name),

@@ -37,6 +37,16 @@ async def list_ms(request: web.Request):
     ns = request.match_info["ns"]
     ensure_authorized(request, "list", "ModelServer", ns)
     store: Store = request.app[STORE_KEY]
+
+    def warning(m) -> str:
+        # the controller explains config rejects as warning events
+        # (InvalidModel/InvalidTopology/...); surface the newest so
+        # "why isn't it Ready" is answered in the list, the same
+        # error-event mining the spawner does (ref status.py:79-95)
+        evs = [e for e in store.events_for(
+            "ModelServer", ns, m.metadata.name) if e.type == "Warning"]
+        return evs[-1].message if evs else ""
+
     return json_success({
         "modelservers": [
             {
@@ -47,6 +57,7 @@ async def list_ms(request: web.Request):
                 "topology": m.spec.tpu.topology,
                 "ready": m.status.ready,
                 "url": m.status.url,
+                "warning": warning(m),
             }
             for m in store.list("ModelServer", ns)
         ]

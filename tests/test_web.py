@@ -409,3 +409,21 @@ async def test_modelserver_over_http(env):
         "/modelservers/api/namespaces/alice/modelservers/srv",
         headers=ALICE)
     assert r.status == 200
+
+
+async def test_modelserver_list_surfaces_config_warnings(env):
+    cluster, client = env
+    await _mk_profile(client, cluster)
+    r = await client.post(
+        "/modelservers/api/namespaces/alice/modelservers",
+        json={"name": "badsrv", "model": "gpt-17"},
+        headers=ALICE,
+    )
+    assert r.status == 201
+    assert cluster.wait_idle()
+    r = await client.get(
+        "/modelservers/api/namespaces/alice/modelservers", headers=ALICE)
+    entry = [m for m in (await r.json())["modelservers"]
+             if m["name"] == "badsrv"][0]
+    assert not entry["ready"]
+    assert "unknown model" in entry["warning"]
