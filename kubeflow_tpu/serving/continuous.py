@@ -390,6 +390,10 @@ class ContinuousEngine:
                               st, sp, rng, steps=steps)
 
 
+class Overloaded(RuntimeError):
+    """Admission queue is full — callers should shed load (HTTP 429)."""
+
+
 class _Slot:
     """Host-side record for one admitted request."""
 
@@ -420,6 +424,7 @@ class ContinuousBatcher:
                  *, max_slots: int = 8, chunk: int = 4,
                  prefill_chunk: int | None = None,
                  prefixes: dict[str, list[int]] | None = None,
+                 max_pending: int = 256,
                  window_ms: float = 0.0):
         # window_ms accepted (and ignored) for constructor parity with
         # Batcher: admission is per-token here, there is no window.
@@ -451,6 +456,10 @@ class ContinuousBatcher:
         self.requests = 0         # admitted requests
         self.tokens_emitted = 0
         self._pending: collections.deque = collections.deque()
+        # Backpressure: an unbounded admission queue turns overload
+        # into unbounded client latency AND unbounded host memory;
+        # past this depth _enqueue raises Overloaded (HTTP 429).
+        self.max_pending = max_pending
         self._wake = asyncio.Event()
         self._active: dict[int, _Slot] = {}
         self._free = list(range(max_slots))
@@ -529,6 +538,10 @@ class ContinuousBatcher:
     def _enqueue(self, tokens, max_new, sampling, *, queue):
         if self._closed:
             raise RuntimeError("batcher is shut down")
+        if len(self._pending) >= self.max_pending:
+            raise Overloaded(
+                f"{len(self._pending)} requests already queued "
+                f"(max_pending={self.max_pending})")
         cap = self.engine.ec.max_len
         if len(tokens) + max_new > cap:
             raise ValueError(

@@ -26,7 +26,11 @@ import jax.numpy as jnp
 import numpy as np
 from aiohttp import web
 
-from kubeflow_tpu.serving.continuous import ContinuousBatcher, bucket_pow2
+from kubeflow_tpu.serving.continuous import (
+    ContinuousBatcher,
+    Overloaded,
+    bucket_pow2,
+)
 from kubeflow_tpu.serving.engine import InferenceEngine
 from kubeflow_tpu.serving.speculative import SpeculativeEngine
 
@@ -321,6 +325,8 @@ async def list_models(request: web.Request):
             if isinstance(batcher, ContinuousBatcher):
                 entry["batcher_mode"] = "continuous"
                 entry["occupancy"] = round(batcher.occupancy(), 3)
+                entry["pending"] = len(batcher._pending)
+                entry["active_slots"] = len(batcher._active)
                 if batcher._prefixes:
                     entry["prefixes"] = {
                         n: len(t) for n, t in batcher._prefixes.items()}
@@ -766,17 +772,22 @@ async def generate(request: web.Request):
             # batcher runs its group to the group max and the shared
             # post-trim below applies the semantics
             submit_sampling["stop"] = tuple(tuple(s) for s in stop)
-        if logprobs and isinstance(batcher, ContinuousBatcher):
-            ids, req_lps = await batcher.submit(
-                arr[0].tolist(), max_new_req,
-                tuple(sorted(submit_sampling.items())),
-                with_logprobs=True)
-            lp_rows = [list(req_lps)]
-        else:
-            ids = await batcher.submit(
-                arr[0].tolist(), max_new_req,
-                tuple(sorted(submit_sampling.items())))
-            lp_rows = None
+        try:
+            if logprobs and isinstance(batcher, ContinuousBatcher):
+                ids, req_lps = await batcher.submit(
+                    arr[0].tolist(), max_new_req,
+                    tuple(sorted(submit_sampling.items())),
+                    with_logprobs=True)
+                lp_rows = [list(req_lps)]
+            else:
+                ids = await batcher.submit(
+                    arr[0].tolist(), max_new_req,
+                    tuple(sorted(submit_sampling.items())))
+                lp_rows = None
+        except Overloaded as e:
+            return web.json_response(
+                {"error": f"server overloaded: {e}"}, status=429,
+                headers={"Retry-After": "1"})
         toks = np.asarray([ids], np.int32)
     else:
         if adapter:

@@ -597,3 +597,22 @@ async def test_logprobs_over_rest_all_paths():
         want = float(jax.nn.log_softmax(pos_logits.astype(jnp.float32))[
             int(toks[0, i])])
         assert float(lps[0, i]) == pytest.approx(want, abs=1e-3)
+
+
+async def test_backpressure_sheds_load():
+    """Past max_pending queued requests, _enqueue raises Overloaded —
+    bounded queueing instead of unbounded latency and host memory."""
+    from kubeflow_tpu.serving.continuous import Overloaded
+
+    engine, cfg = _engine()
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2,
+                                max_pending=3)
+    p = [1, 2, 3]
+    # stuff the pending deque directly (no worker running)
+    for _ in range(3):
+        batcher._pending.append((p, 4, {}, asyncio.get_event_loop()
+                                 .create_future(), None, 0, ""))
+    with pytest.raises(Overloaded, match="max_pending=3"):
+        batcher._enqueue(p, 4, (), queue=None)
+    batcher._pending.clear()
+    await batcher.close()
