@@ -368,9 +368,12 @@ class InferenceEngine:
     def _sample(self, logits, rng, sp: SamplingParams):
         """-> (tokens [b], logprobs [b]). The logprob is the chosen
         token's log-softmax under the RAW model distribution
-        (temperature/filters don't rescale it — OpenAI convention);
-        computing it unconditionally costs one O(b·vocab) pass next to
-        the O(b·hidden·vocab) head matmul that produced the logits."""
+        (temperature/filters don't rescale it — OpenAI convention).
+        Computed UNCONDITIONALLY by design: the O(b·vocab) pass is <1%
+        of the O(b·hidden·vocab) head matmul that produced the logits
+        at real vocab/hidden sizes (tiny-CPU A/Bs exaggerate it), and
+        a jit-static opt-in flag would double the warmed compile set
+        of every serving entry point for that <1%."""
         # lax.cond, not jnp.where: an all-greedy decode must not pay
         # the sampled branch's full-vocab argsorts/cumsum/categorical
         # per step (256k vocab on Gemma) just to discard the result.

@@ -213,6 +213,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                        warmup: bool = False,
                        prefill_chunk: int | None = None,
                        prefixes: dict[str, list[int]] | None = None,
+                       max_pending: int = 256,
                        drafts: dict[str, InferenceEngine] | None = None,
                        ) -> web.Application:
     """`tokenizer` (data.bpe.Tokenizer or anything with encode/decode)
@@ -266,7 +267,8 @@ def create_serving_app(engines: dict[str, InferenceEngine],
         app[BATCHERS_KEY] = {
             name: ContinuousBatcher(eng, lock, max_slots=max_batch,
                                     prefill_chunk=prefill_chunk,
-                                    prefixes=prefixes)
+                                    prefixes=prefixes,
+                                    max_pending=max_pending)
             for name, eng in engines.items()}
         if warmup:
             async def _warm(app_):
@@ -404,6 +406,13 @@ async def _stream_continuous(request, batcher, arr, max_new, sampling,
     tokens, never the GPU lock (the batcher's worker owns that)."""
     import json as _json
 
+    if len(batcher._pending) >= batcher.max_pending:
+        # BEFORE the SSE headers: once 200 is sent, an Overloaded from
+        # the first __anext__ can only abort the connection — the
+        # client deserves the 429 + Retry-After instead
+        return web.json_response(
+            {"error": "server overloaded: admission queue full"},
+            status=429, headers={"Retry-After": "1"})
     resp = web.StreamResponse(headers={
         "Content-Type": "text/event-stream",
         "Cache-Control": "no-cache",
@@ -464,6 +473,10 @@ async def score(request: web.Request):
     if len({len(t) for t in token_lists}) != 1:
         return web.json_response(
             {"error": "all rows must share a length (static shapes)"},
+            status=400)
+    if len(token_lists[0]) < 2:
+        return web.json_response(
+            {"error": "scoring needs at least 2 tokens per row"},
             status=400)
     if len(token_lists[0]) > engine.ec.max_len:
         return web.json_response(
@@ -819,10 +832,19 @@ async def generate(request: web.Request):
             lp_rows = [lp[:len(r)] for lp, r in zip(lp_rows, rows)]
     resp: dict[str, Any] = {"tokens": rows, **resp_extra}
     if logprobs and lp_rows is not None:
-        # 1:1 with tokens; entries past a row's first EOS are
-        # undefined (engine contract)
-        resp["logprobs"] = [[round(float(x), 6) for x in lp[:len(r)]]
-                            for lp, r in zip(lp_rows, rows)]
+        # uniform contract on every path: entries cover tokens up to
+        # AND INCLUDING the row's first EOS — the direct path's
+        # post-EOS tail describes pre-forcing samples of the padded
+        # EOS tokens, which would silently corrupt a client's sequence
+        # total (the continuous path already stops computing there)
+        eos = engine.ec.eos_token
+        out_lps = []
+        for lp, r in zip(lp_rows, rows):
+            n = len(r)
+            if eos is not None and eos in r:
+                n = r.index(eos) + 1
+            out_lps.append([round(float(x), 6) for x in lp[:n]])
+        resp["logprobs"] = out_lps
     if text_mode:
         resp["text"] = (tokenizer.decode(rows[0]) if tokenizer
                         else byte_decode(rows[0]))

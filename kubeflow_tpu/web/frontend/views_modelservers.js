@@ -21,7 +21,7 @@ export async function modelserversView() {
           'span',
           { class: 'status', title: m.warning || '' },
           h('span', { class: `dot ${m.ready ? 'ready' : 'waiting'}` }),
-          m.warning ? 'error' : m.ready ? 'ready' : 'starting',
+          m.ready ? 'ready' : m.warning ? 'error' : 'starting',
         ),
       ),
       h('td', {}, m.ready ? h('a', { href: m.url, target: '_blank', rel: 'noopener' }, m.name) : m.name),

@@ -616,3 +616,40 @@ async def test_backpressure_sheds_load():
         batcher._enqueue(p, 4, (), queue=None)
     batcher._pending.clear()
     await batcher.close()
+
+
+@pytest.mark.slow
+async def test_direct_path_logprobs_stop_at_first_eos():
+    """Uniform logprobs contract: entries cover tokens up to AND
+    INCLUDING the first EOS on the direct path too — the padded tail's
+    pre-forcing sample logprobs must never reach clients."""
+    engine0, cfg = _engine()
+    p = np.random.default_rng(41).integers(0, cfg.vocab_size, 6).tolist()
+    ref = _solo(engine0, p, 6)
+    engine, _ = _engine(eos=ref[2])
+    app = server_lib.create_serving_app({"m": engine})
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    r = await client.post(
+        "/v1/models/m:generate",
+        json={"tokens": [p, p], "max_new": 6, "logprobs": True})
+    assert r.status == 200, await r.text()
+    body = await r.json()
+    for row, lps in zip(body["tokens"], body["logprobs"]):
+        assert row[3:] == [ref[2]] * 3      # EOS-padded tail
+        assert len(lps) == 3                # trimmed at first EOS
+    await client.close()
+
+
+async def test_stream_overload_is_429_not_broken_sse():
+    engine, cfg = _engine()
+    app = server_lib.create_serving_app(
+        {"m": engine}, continuous=True, max_batch=2, max_pending=0)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    r = await client.post(
+        "/v1/models/m:generate",
+        json={"tokens": [[1, 2, 3]], "max_new": 4, "stream": True})
+    assert r.status == 429
+    assert r.headers["Retry-After"] == "1"
+    await client.close()

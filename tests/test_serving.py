@@ -717,3 +717,14 @@ async def test_score_endpoint_matches_full_forward(llama_engine):
     r = await client.post("/v1/models/m:score", json={"tokens": [[5]]})
     assert r.status == 400
     await client.close()
+
+
+async def test_score_text_mode_short_input_is_400(llama_engine):
+    engine, _, _ = llama_engine
+    app = server_lib.create_serving_app({"m": engine})
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    r = await client.post("/v1/models/m:score", json={"text": ""})
+    assert r.status == 400
+    assert "at least 2" in (await r.json())["error"]
+    await client.close()
