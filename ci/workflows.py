@@ -36,7 +36,7 @@ COMPONENTS: dict[str, dict[str, Any]] = {
     },
     "web": {
         "paths": ["kubeflow_tpu/web/**"],
-        "tests": "python -m pytest tests/test_web.py -q",
+        "tests": "python -m pytest tests/test_web.py tests/test_cli.py -q",
     },
     "serving": {
         "paths": ["kubeflow_tpu/serving/**"],

@@ -1,0 +1,188 @@
+"""kftpu: a kubectl-shaped CLI over the platform's /apis door.
+
+The reference leans on kubectl for every operator interaction; this
+platform serves a kubectl-compatible-in-spirit REST door
+(`web/apis_app.py`: versioned kinds, optimistic concurrency,
+merge-patch) and this CLI is the thin client for it — stdlib-only
+(urllib), so it runs anywhere the operator has Python.
+
+    python -m kubeflow_tpu.cli get notebooks -n alice
+    python -m kubeflow_tpu.cli get modelservers -n alice -o json
+    python -m kubeflow_tpu.cli apply -f server.json
+    python -m kubeflow_tpu.cli delete notebooks my-nb -n alice
+
+Server + identity come from flags or env (KFTPU_SERVER, KFTPU_USER).
+Mutations carry the /apis door's CSRF-exempt API-client header.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+GROUP = "kubeflow-tpu.dev"
+API_CLIENT_HEADER = "X-KFTPU-API-CLIENT"
+
+# columns per plural for `get` table output; (header, path-into-obj)
+_COLUMNS = {
+    "notebooks": (("NAME", "metadata.name"),
+                  ("TOPOLOGY", "spec.tpu.topology"),
+                  ("READY", "status.ready_replicas")),
+    "modelservers": (("NAME", "metadata.name"),
+                     ("MODEL", "spec.model"),
+                     ("READY", "status.ready"),
+                     ("URL", "status.url")),
+    "tensorboards": (("NAME", "metadata.name"),
+                     ("LOGSPATH", "spec.logspath"),
+                     ("READY", "status.ready")),
+    "experiments": (("NAME", "metadata.name"),
+                    ("PHASE", "status.phase"),
+                    ("TRIALS", "status.trials_created"),
+                    ("BEST", "status.best_value")),
+    "trials": (("NAME", "metadata.name"),
+               ("PHASE", "status.phase"),
+               ("VALUE", "status.value")),
+    "profiles": (("NAME", "metadata.name"),
+                 ("OWNER", "spec.owner")),
+    "pods": (("NAME", "metadata.name"), ("PHASE", "phase")),
+}
+
+
+def _dig(obj: dict, path: str):
+    cur = obj
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return ""
+        cur = cur[part]
+    return cur
+
+
+class Client:
+    def __init__(self, server: str, user: str, version: str = "v1"):
+        self.server = server.rstrip("/")
+        self.user = user
+        self.version = version
+
+    def req(self, method: str, path: str, body: dict | None = None):
+        url = f"{self.server}/apis/{GROUP}/{self.version}{path}"
+        headers = {"kubeflow-userid": self.user}
+        data = None
+        if body is not None:
+            data = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        if method != "GET":
+            headers[API_CLIENT_HEADER] = "kftpu-cli"
+        r = urllib.request.Request(url, data=data, headers=headers,
+                                   method=method)
+        try:
+            with urllib.request.urlopen(r, timeout=30) as resp:
+                raw = resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace").strip()
+            raise SystemExit(
+                f"error: {e.code} {method} {path}: {detail[:300]}")
+        except urllib.error.URLError as e:
+            raise SystemExit(f"error: cannot reach {self.server}: "
+                             f"{e.reason}")
+        return json.loads(raw) if raw else {}
+
+    def _path(self, plural: str, ns: str, name: str = "") -> str:
+        base = ("/profiles" if plural == "profiles"
+                else f"/namespaces/{ns}/{plural}")
+        return f"{base}/{name}" if name else base
+
+
+def cmd_get(c: Client, args) -> int:
+    path = c._path(args.plural, args.namespace, args.name or "")
+    out = c.req("GET", path)
+    items = [out] if args.name else out.get("items", [])
+    if args.output == "json":
+        print(json.dumps(out if args.name else items, indent=2))
+        return 0
+    cols = _COLUMNS.get(args.plural,
+                        (("NAME", "metadata.name"),))
+    rows = [[str(_dig(i, p)) for _, p in cols] for i in items]
+    widths = [max(len(h), *(len(r[j]) for r in rows), 1) if rows
+              else len(h) for j, (h, _) in enumerate(cols)]
+    print("  ".join(h.ljust(w) for (h, _), w in zip(cols, widths)))
+    for r in rows:
+        print("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    return 0
+
+
+def cmd_apply(c: Client, args) -> int:
+    raw = (sys.stdin.read() if args.filename == "-"
+           else open(args.filename).read())
+    docs = json.loads(raw)
+    if isinstance(docs, dict):
+        docs = [docs]
+    for doc in docs:
+        kind = doc.get("kind", "")
+        plural = (kind.lower() + "s") if kind else ""
+        if not plural:
+            raise SystemExit("error: document missing 'kind'")
+        ns = doc.get("metadata", {}).get("namespace", args.namespace)
+        name = doc.get("metadata", {}).get("name", "")
+        path = c._path(plural, ns)
+        # kubectl-apply semantics: create, or merge-patch on conflict
+        try:
+            c.req("POST", path, doc)
+            print(f"{plural}/{name} created")
+        except SystemExit as e:
+            if "409" not in str(e):
+                raise
+            c.req("PATCH", f"{path}/{name}",
+                  {"spec": doc.get("spec", {})})
+            print(f"{plural}/{name} configured")
+    return 0
+
+
+def cmd_delete(c: Client, args) -> int:
+    c.req("DELETE", c._path(args.plural, args.namespace, args.name))
+    print(f"{args.plural}/{args.name} deleted")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kftpu")
+    p.add_argument("--server",
+                   default=os.environ.get("KFTPU_SERVER",
+                                          "http://localhost:8082"))
+    p.add_argument("--user",
+                   default=os.environ.get("KFTPU_USER",
+                                          "admin@example.com"))
+    p.add_argument("--api-version", default="v1")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("get", help="list or get resources")
+    g.add_argument("plural")
+    g.add_argument("name", nargs="?")
+    g.add_argument("-n", "--namespace", default="default")
+    g.add_argument("-o", "--output", choices=("table", "json"),
+                   default="table")
+
+    a = sub.add_parser("apply", help="create-or-patch from JSON")
+    a.add_argument("-f", "--filename", required=True,
+                   help="JSON file (or - for stdin); one doc or a list")
+    a.add_argument("-n", "--namespace", default="default")
+
+    d = sub.add_parser("delete", help="delete a resource")
+    d.add_argument("plural")
+    d.add_argument("name")
+    d.add_argument("-n", "--namespace", default="default")
+
+    args = p.parse_args(argv)
+    c = Client(args.server, args.user, args.api_version)
+    return {"get": cmd_get, "apply": cmd_apply,
+            "delete": cmd_delete}[args.cmd](c, args)
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        os._exit(0)  # `kftpu get ... | head` is not an error

@@ -1,0 +1,90 @@
+"""kftpu CLI over the /apis door (the kubectl-shaped operator client)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+pytest_plugins = ("aiohttp.pytest_plugin",)
+
+from kubeflow_tpu import cli as cli_mod
+from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
+
+
+@pytest.fixture()
+async def platform(loop):
+    cluster = Cluster(ClusterConfig(
+        tpu_slices={"v5e-4": 2},
+        cluster_admins={"admin@example.com"},
+    )).start()
+    app = cluster.create_web_app(csrf=False)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    yield cluster, client
+    await client.close()
+    cluster.stop()
+
+
+async def _run(client, argv, capsys):
+    """Run the sync urllib CLI in an executor against the test server."""
+    server = f"http://{client.host}:{client.port}"
+    loop = asyncio.get_event_loop()
+    rc = await loop.run_in_executor(
+        None, lambda: cli_mod.main(
+            ["--server", server, "--user", "admin@example.com", *argv]))
+    out = capsys.readouterr().out
+    return rc, out
+
+
+async def test_cli_apply_get_delete_roundtrip(platform, tmp_path, capsys):
+    cluster, client = platform
+    prof = tmp_path / "prof.json"
+    prof.write_text(json.dumps(
+        {"kind": "Profile", "metadata": {"name": "ns1"},
+         "spec": {"owner": "admin@example.com"}}))
+    rc, out = await _run(client, ["apply", "-f", str(prof)], capsys)
+    assert rc == 0 and "profiles/ns1 created" in out
+    assert cluster.wait_idle()
+
+    ms = tmp_path / "ms.json"
+    ms.write_text(json.dumps(
+        {"kind": "ModelServer",
+         "metadata": {"name": "srv", "namespace": "ns1"},
+         "spec": {"model": "llama-tiny"}}))
+    rc, out = await _run(client, ["apply", "-f", str(ms)], capsys)
+    assert "modelservers/srv created" in out
+    assert cluster.wait_idle()
+
+    rc, out = await _run(client, ["get", "modelservers", "-n", "ns1"],
+                         capsys)
+    assert rc == 0
+    assert "srv" in out and "llama-tiny" in out
+    assert "/serving/ns1/srv/" in out  # table shows the routed URL
+
+    # kubectl-apply semantics: second apply of the same name patches
+    ms.write_text(json.dumps(
+        {"kind": "ModelServer",
+         "metadata": {"name": "srv", "namespace": "ns1"},
+         "spec": {"model": "llama-tiny", "quant": "int8"}}))
+    rc, out = await _run(client, ["apply", "-f", str(ms)], capsys)
+    assert "modelservers/srv configured" in out
+    rc, out = await _run(
+        client, ["get", "modelservers", "srv", "-n", "ns1",
+                 "-o", "json"], capsys)
+    assert json.loads(out)["spec"]["quant"] == "int8"
+
+    rc, out = await _run(
+        client, ["delete", "modelservers", "srv", "-n", "ns1"], capsys)
+    assert "deleted" in out
+    assert cluster.wait_idle()
+    rc, out = await _run(client, ["get", "modelservers", "-n", "ns1"],
+                         capsys)
+    assert "srv" not in out
+
+
+async def test_cli_errors_are_clean(platform, capsys):
+    _, client = platform
+    with pytest.raises(SystemExit, match="404"):
+        await _run(client, ["get", "modelservers", "nope",
+                            "-n", "nowhere"], capsys)
