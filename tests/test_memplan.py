@@ -70,3 +70,30 @@ def test_cli_contract():
          "--topology", "v5e-16", "--mesh", "data=2,fsdp=2,tensor=2"],
         capture_output=True, text=True, env=env, timeout=120)
     assert mismatch.returncode == 2  # argparse error: 8 devices != 16
+
+
+def test_eval_ppl_tool(tmp_path, capsys):
+    """tools/eval_ppl: token-weighted NLL over KTSH shards; a random
+    model on uniform-random tokens lands near ln(vocab) (it can't be
+    much better than uniform, and random confident preferences make it
+    somewhat worse)."""
+    import json
+    import math
+
+    import numpy as np
+
+    from kubeflow_tpu.data import loader as dl
+    from kubeflow_tpu.models import llama
+    from tools import eval_ppl
+
+    shard = str(tmp_path / "val.ktsh")
+    dl.write_shard(shard, np.random.default_rng(0).integers(
+        0, llama.LLAMA_TINY.vocab_size, 6000).astype(np.int32))
+    rc = eval_ppl.main(["--shards", shard, "--model", "llama-tiny",
+                        "--random", "--batch", "2", "--seq", "64",
+                        "--max-batches", "3"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["tokens"] == 2 * 64 * 3
+    uniform = math.log(llama.LLAMA_TINY.vocab_size)
+    assert uniform * 0.9 < out["loss"] < uniform * 1.5, out
