@@ -1,7 +1,10 @@
 """kftpu: a kubectl-shaped CLI over the platform's /apis door.
 
-The reference leans on kubectl for every operator interaction; this
-platform serves a kubectl-compatible-in-spirit REST door
+The reference leans on kubectl for every operator interaction (its
+images even bake kubectl in, `/root/reference/components/
+example-notebook-servers/base/Dockerfile:1-67`, and the culler shells
+out to it in DEV mode, `components/notebook-controller/pkg/culler/
+culler.go:160-164`); this platform serves a kubectl-shaped REST door
 (`web/apis_app.py`: versioned kinds, optimistic concurrency,
 merge-patch) and this CLI is the thin client for it — stdlib-only
 (urllib), so it runs anywhere the operator has Python.

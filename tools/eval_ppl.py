@@ -1,6 +1,12 @@
 #!/usr/bin/env python
 """Offline perplexity evaluation: KTSH shards → loss/ppl, one JSON line.
 
+Reference parity: none — the reference has no training or evaluation
+of any kind (SURVEY.md §2b); its closest analog is the TF-Serving
+prediction-equality smoke check
+(`/root/reference/testing/test_tf_serving.py:40-57`), whose serving
+half here is the REST `:score` door.
+
 The eval half of the data story (tokenize → shard → train → EVALUATE):
 streams windows through the (native-or-fallback) loader, teacher-forces
 them through the model, and reports the token-weighted mean NLL and
