@@ -97,3 +97,23 @@ def test_eval_ppl_tool(tmp_path, capsys):
     assert out["tokens"] == 2 * 64 * 3
     uniform = math.log(llama.LLAMA_TINY.vocab_size)
     assert uniform * 0.9 < out["loss"] < uniform * 1.5, out
+
+
+def test_serving_planner_modes():
+    """Serving fit: 8B bf16 cannot fit one v5e chip, int8 can, and
+    TP sharding divides both weights and (kv-head-sharded) cache."""
+    from tools.memplan import plan_serving
+
+    one = {"data": 1, "fsdp": 1, "tensor": 1}
+    bf16 = plan_serving("llama3-8b", one, 8, 4096, "v5e", "")
+    assert not bf16["fits"]
+    int8 = plan_serving("llama3-8b", one, 8, 4096, "v5e", "int8")
+    assert int8["fits"]
+    assert int8["per_chip_gb"]["weights"] == pytest.approx(
+        bf16["per_chip_gb"]["weights"] / 2, rel=0.01)
+    tp4 = plan_serving("llama3-8b", {"data": 1, "fsdp": 1, "tensor": 4},
+                       16, 8192, "v5e", "")
+    assert tp4["fits"]
+    assert tp4["per_chip_gb"]["kv_cache"] == pytest.approx(
+        2 * bf16["per_chip_gb"]["kv_cache"] / 4, rel=0.01)
+    assert tp4["max_slots_that_fit"] >= 16

@@ -147,6 +147,57 @@ def plan(model: str, mesh_sizes: dict[str, int], batch: int, seq: int,
     }
 
 
+def plan_serving(model: str, mesh_sizes: dict[str, int], slots: int,
+                 max_len: int, generation: str, quant: str) -> dict:
+    """Serving-side fit: bf16 (or int8) weights + the continuous
+    batcher's slot KV cache ([L, S, max_len, kv, hd] x2, donated so
+    one copy) + a prefill working set. Decode has no optimizer state,
+    no gradients — the whole budget goes to weights and KV."""
+    from kubeflow_tpu.parallel.sharding import LLAMA_RULES
+
+    family, cfg = model_registry()[model]
+    shapes, axes = param_shapes(family, cfg)
+    flat_shapes = jax.tree.leaves_with_path(shapes)
+    flat_axes = dict(jax.tree.leaves_with_path(
+        axes, is_leaf=lambda x: isinstance(x, tuple)))
+    weight_bytes = 0.0
+    for path, leaf in flat_shapes:
+        spec = LLAMA_RULES.resolve(flat_axes[path])
+        factor = 1
+        for entry in spec:
+            factor *= shard_factor(entry, mesh_sizes)
+        itemsize = 1 if quant == "int8" else 2  # int8 vs bf16 serving
+        weight_bytes += math.prod(leaf.shape) * itemsize / factor
+    kv_shards = max(mesh_sizes.get("tensor", 1), 1)  # kv heads shard on tensor
+    kv_bytes = (2 * cfg.num_layers * slots * max_len
+                * cfg.num_kv_heads * cfg.head_dim * 2 / kv_shards)
+    # prefill working set: one bucket of activations + return_all-free
+    # last-position logits are negligible; residuals dominate
+    prefill_bytes = (slots * max_len * cfg.hidden_size * 2
+                     * 2 / kv_shards)
+    total = weight_bytes + kv_bytes + prefill_bytes
+    hbm = HBM_BYTES[generation]
+    budget = hbm * 0.92
+    return {
+        "model": model, "mode": "serving", "mesh": dict(mesh_sizes),
+        "slots": slots, "max_len": max_len, "quant": quant or "bf16",
+        "generation": generation,
+        "per_chip_gb": {
+            "weights": round(weight_bytes / 1e9, 3),
+            "kv_cache": round(kv_bytes / 1e9, 3),
+            "prefill_est": round(prefill_bytes / 1e9, 3),
+            "total": round(total / 1e9, 3),
+            "hbm": round(hbm / 1e9, 1),
+        },
+        "fits": bool(total <= budget),
+        "headroom_gb": round((budget - total) / 1e9, 3),
+        # the knob with the most leverage when it doesn't fit
+        "max_slots_that_fit": int(
+            max(0, (budget - weight_bytes)
+                // ((kv_bytes + prefill_bytes) / slots))) if slots else 0,
+    }
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="llama3-8b",
@@ -158,6 +209,15 @@ def main() -> int:
                         "over the whole slice)")
     p.add_argument("--batch", type=int, default=16)
     p.add_argument("--seq", type=int, default=2048)
+    p.add_argument("--serve", action="store_true",
+                   help="plan a SERVING deployment instead of training "
+                        "(weights + continuous-batcher slot KV cache)")
+    p.add_argument("--slots", type=int, default=8,
+                   help="continuous batcher slots (--serve)")
+    p.add_argument("--max-len", type=int, default=2048,
+                   help="cache bucket (--serve)")
+    p.add_argument("--quant", choices=("", "int8"), default="",
+                   help="int8 weight-only serving (--serve)")
     args = p.parse_args()
 
     from kubeflow_tpu.parallel.mesh import SLICE_TOPOLOGIES
@@ -188,6 +248,22 @@ def main() -> int:
         p.error(f"mesh {mesh_sizes} has {n_mesh} devices; topology "
                 f"{args.topology} has {topo.chips} chips")
 
+    if args.serve:
+        result = plan_serving(args.model, mesh_sizes, args.slots,
+                              args.max_len, generation, args.quant)
+        gb = result["per_chip_gb"]
+        print(f"# serve {args.model} on {args.topology} "
+              f"mesh={mesh_sizes} slots={args.slots} "
+              f"max_len={args.max_len} quant={result['quant']}",
+              file=sys.stderr)
+        for k in ("weights", "kv_cache", "prefill_est", "total", "hbm"):
+            print(f"#   {k:>16}: {gb[k]:8.3f} GB", file=sys.stderr)
+        print(f"#   {'fits':>16}: {result['fits']} "
+              f"(headroom {result['headroom_gb']} GB; up to "
+              f"{result['max_slots_that_fit']} slots fit)",
+              file=sys.stderr)
+        print(json.dumps(result))
+        return 0
     result = plan(args.model, mesh_sizes, args.batch, args.seq,
                   generation)
     gb = result["per_chip_gb"]
