@@ -35,7 +35,7 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                   "tests/test_hpo.py tests/test_modelserver.py -q"),
     },
     "web": {
-        "paths": ["kubeflow_tpu/web/**"],
+        "paths": ["kubeflow_tpu/web/**", "kubeflow_tpu/cli.py"],
         "tests": "python -m pytest tests/test_web.py tests/test_cli.py -q",
     },
     "serving": {

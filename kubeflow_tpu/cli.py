@@ -30,6 +30,16 @@ import urllib.request
 GROUP = "kubeflow-tpu.dev"
 API_CLIENT_HEADER = "X-KFTPU-API-CLIENT"
 
+
+class ApiError(SystemExit):
+    """HTTP-level failure with the status code preserved — apply's
+    create-or-patch branch must switch on the CODE, never on message
+    text (a namespace named 'team409' must not look like a conflict)."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.status = code
+
 # columns per plural for `get` table output; (header, path-into-obj)
 _COLUMNS = {
     "notebooks": (("NAME", "metadata.name"),
@@ -86,7 +96,8 @@ class Client:
                 raw = resp.read()
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace").strip()
-            raise SystemExit(
+            raise ApiError(
+                e.code,
                 f"error: {e.code} {method} {path}: {detail[:300]}")
         except urllib.error.URLError as e:
             raise SystemExit(f"error: cannot reach {self.server}: "
@@ -135,11 +146,18 @@ def cmd_apply(c: Client, args) -> int:
         try:
             c.req("POST", path, doc)
             print(f"{plural}/{name} created")
-        except SystemExit as e:
-            if "409" not in str(e):
+        except ApiError as e:
+            if e.status != 409:
                 raise
-            c.req("PATCH", f"{path}/{name}",
-                  {"spec": doc.get("spec", {})})
+            patch: dict = {"spec": doc.get("spec", {})}
+            meta = {k: v for k, v in doc.get("metadata", {}).items()
+                    if k in ("labels", "annotations")}
+            if meta:
+                # the /apis door patches these metadata fields too;
+                # dropping them would claim "configured" while
+                # silently ignoring label/annotation edits
+                patch["metadata"] = meta
+            c.req("PATCH", f"{path}/{name}", patch)
             print(f"{plural}/{name} configured")
     return 0
 
@@ -180,12 +198,17 @@ def main(argv=None) -> int:
 
     args = p.parse_args(argv)
     c = Client(args.server, args.user, args.api_version)
-    return {"get": cmd_get, "apply": cmd_apply,
-            "delete": cmd_delete}[args.cmd](c, args)
+    try:
+        return {"get": cmd_get, "apply": cmd_apply,
+                "delete": cmd_delete}[args.cmd](c, args)
+    except BrokenPipeError:
+        # `kftpu get ... | head` is not an error — and the guard must
+        # live HERE so the console-script entry point (pyproject
+        # [project.scripts]) gets it too, not just python -m
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        return 0
 
 
 if __name__ == "__main__":
-    try:
-        sys.exit(main())
-    except BrokenPipeError:
-        os._exit(0)  # `kftpu get ... | head` is not an error
+    sys.exit(main())

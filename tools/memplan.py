@@ -263,7 +263,7 @@ def main() -> int:
               f"{result['max_slots_that_fit']} slots fit)",
               file=sys.stderr)
         print(json.dumps(result))
-        return 0
+        return 0 if result["fits"] else 1  # same gate as training mode
     result = plan(args.model, mesh_sizes, args.batch, args.seq,
                   generation)
     gb = result["per_chip_gb"]
