@@ -63,9 +63,12 @@ def _load_params(args, init1):
         raise SystemExit(f"no checkpoint under {args.checkpoint}")
     abstract = jax.eval_shape(
         init1, jax.ShapeDtypeStruct((2,), "uint32"))
+    # partial restore: serving wants the params SUBTREE only — pulling
+    # the Adam moments (2x params) through disk and HBM to throw away
+    # would double a large model's startup IO
     restored = mgr.restore(step, args=ocp.args.Composite(**{
-        STATE_ITEM: ocp.args.StandardRestore(
-            {"params": abstract}, strict=False),
+        STATE_ITEM: ocp.args.PyTreeRestore(
+            {"params": abstract}, partial_restore=True),
     }))
     mgr.close()
     return restored[STATE_ITEM]["params"]
