@@ -64,7 +64,7 @@ COMPONENTS: dict[str, dict[str, Any]] = {
 
 IMAGES = ["base", "jupyter-jax", "jupyter-jax-tpu", "jupyter-jax-full",
           "jupyter-scipy", "codeserver-jax", "rstudio",
-          "rstudio-tidyverse"]
+          "rstudio-tidyverse", "serving"]
 
 
 def _yaml(obj: Any, indent: int = 0) -> str:
