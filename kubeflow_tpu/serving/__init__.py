@@ -6,7 +6,11 @@ component (`/root/reference/docs_dev/tf_serving.md:1-60`, tested by
 Service/VirtualService machinery as notebooks. The TPU-native redesign
 (SURVEY.md §2b "Model serving"): a pure-JAX engine with a static-shape
 KV cache (bucketed prefill, `lax.scan` decode — XLA-friendly, no dynamic
-shapes), an aiohttp REST server the gateway can route to, and
+shapes), slot-based continuous batching (`continuous.py`), multi-LoRA
+adapter packs (`multilora.py`), speculative decoding, int8 weight-only
+quant, an aiohttp REST server the gateway can route to (generate with
+stop/logprobs/adapters/prefixes, `:score`, SSE streams, 429
+backpressure), a deployable CLI (`python -m kubeflow_tpu.serving`), and
 ahead-of-time export via `jax.export` (StableHLO) with jax2tf/SavedModel
 available when TensorFlow is present.
 """
@@ -14,6 +18,7 @@ available when TensorFlow is present.
 from kubeflow_tpu.serving.continuous import (
     ContinuousBatcher,
     ContinuousEngine,
+    Overloaded,
     SlotState,
 )
 from kubeflow_tpu.serving.engine import (
