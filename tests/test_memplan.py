@@ -121,3 +121,47 @@ def test_serving_planner_modes():
     assert tp4["per_chip_gb"]["kv_cache"] == pytest.approx(
         bf16["per_chip_gb"]["kv_cache"], rel=0.01)
     assert tp4["max_slots_that_fit"] >= 16
+
+
+def test_prepare_data_tool(tmp_path, capsys):
+    """prepare_data: corpus -> tokenizer + shards that the loader and
+    tokenizer round-trip; --tokenizer reuse keeps one vocabulary."""
+    import json
+
+    import numpy as np
+
+    import prepare_data
+    from kubeflow_tpu.data import bpe
+    from kubeflow_tpu.data import loader as dl
+
+    for i in range(2):
+        (tmp_path / f"doc{i}.txt").write_text(
+            ("the quick brown fox jumps over the lazy dog " * 30)
+            + f"document {i} ")
+    out = tmp_path / "out"
+    rc = prepare_data.main([
+        "--input", str(tmp_path / "*.txt"), "--out", str(out),
+        "--vocab-size", "300", "--shard-tokens", "150"])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip())
+    assert summary["shards"] >= 2, summary  # shard-tokens forced a split
+    assert (out / "tokenizer.json").exists()
+
+    shards = sorted(str(s) for s in out.glob("shard-*.ktsh"))
+    with dl.open_loader(shards, batch=2, seq=32, seed=0) as ld:
+        batch = ld.next_batch()
+        assert batch.shape == (2, 33)
+        tok = bpe.Tokenizer.load(str(out / "tokenizer.json"))
+        assert batch.max() < tok.vocab_size
+        text = tok.decode([int(t) for t in batch[0] if t >= 0])
+        assert "fox" in text or "dog" in text or "document" in text
+
+    # val shards reuse the train vocabulary
+    val = tmp_path / "val"
+    rc = prepare_data.main([
+        "--input", str(tmp_path / "doc0.txt"), "--out", str(val),
+        "--tokenizer", str(out / "tokenizer.json")])
+    assert rc == 0
+    summary2 = json.loads(capsys.readouterr().out.strip())
+    assert summary2["vocab_size"] == summary["vocab_size"]
+    assert not (val / "tokenizer.json").exists()  # reused, not retrained

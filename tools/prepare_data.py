@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Prepare training data: text files → BPE tokenizer → KTSH shards.
+
+The front door of the data story (PREPARE → train → evaluate → serve).
+The reference has no data pipeline at all (SURVEY.md §2b — notebooks
+pull datasets ad hoc inside pods); here preparation is one command
+whose outputs feed `data.open_loader` (training), `tools/eval_ppl.py`
+(evaluation), and the server's text mode (the saved tokenizer):
+
+    python tools/prepare_data.py --input corpus/*.txt \
+        --out /data/run7 --vocab-size 32000 --shard-tokens 50000000
+
+Emits `<out>/tokenizer.json`, `<out>/shard-NNNNN.ktsh`, and one JSON
+summary line. `--tokenizer` reuses an existing tokenizer instead of
+training one (so val shards share the train vocabulary — mixing
+vocabularies between shards silently corrupts every downstream loss).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from kubeflow_tpu.data import bpe  # noqa: E402
+from kubeflow_tpu.data import loader as dl  # noqa: E402
+
+
+def _iter_texts(paths: list[str]):
+    for p in paths:
+        with open(p, encoding="utf-8", errors="replace") as f:
+            yield f.read()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--input", required=True, nargs="+",
+                   help="text files (globs ok)")
+    p.add_argument("--out", required=True)
+    p.add_argument("--vocab-size", type=int, default=32000)
+    p.add_argument("--tokenizer", default="",
+                   help="reuse an existing tokenizer.json instead of "
+                        "training one (val/test shards MUST share the "
+                        "train vocabulary)")
+    p.add_argument("--shard-tokens", type=int, default=50_000_000,
+                   help="tokens per KTSH shard")
+    p.add_argument("--eos-between-docs", action="store_true",
+                   default=True)
+    args = p.parse_args(argv)
+
+    paths = sorted(p for pat in args.input for p in glob.glob(pat))
+    if not paths:
+        print(f"no input files match {args.input}", file=sys.stderr)
+        return 1
+    os.makedirs(args.out, exist_ok=True)
+
+    if args.tokenizer:
+        tok = bpe.Tokenizer.load(args.tokenizer)
+        tok_src = args.tokenizer
+    else:
+        tok = bpe.train(_iter_texts(paths), vocab_size=args.vocab_size)
+        tok_src = os.path.join(args.out, "tokenizer.json")
+        tok.save(tok_src)
+
+    shard_idx, buf, total = 0, [], 0
+    shards: list[str] = []
+
+    def flush():
+        nonlocal shard_idx, buf
+        if not buf:
+            return
+        path = os.path.join(args.out, f"shard-{shard_idx:05d}.ktsh")
+        dl.write_shard(path, np.asarray(buf, np.int32))
+        shards.append(path)
+        shard_idx += 1
+        buf = []
+
+    for text in _iter_texts(paths):
+        ids = tok.encode(text, eos=args.eos_between_docs)
+        buf.extend(ids)
+        total += len(ids)
+        if len(buf) >= args.shard_tokens:
+            flush()
+    flush()
+
+    print(json.dumps({
+        "metric": "prepare_data",
+        "files": len(paths),
+        "tokens": total,
+        "shards": len(shards),
+        "vocab_size": tok.vocab_size,
+        "tokenizer": tok_src,
+        "out": args.out,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
