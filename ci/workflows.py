@@ -50,6 +50,10 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                   "python -m pytest tests/test_dataloader.py "
                   "tests/test_bpe.py -q"),
     },
+    "tools": {
+        "paths": ["tools/**"],
+        "tests": "python -m pytest tests/test_memplan.py -q",
+    },
     # The driver evidence pipeline (bench.py + __graft_entry__) runs its
     # FULL tier including the slow subprocess armoring tests: these are
     # the round-3-postmortem regression guards (wedged-TPU fallback,

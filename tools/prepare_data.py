@@ -50,14 +50,23 @@ def main(argv=None) -> int:
                         "train vocabulary)")
     p.add_argument("--shard-tokens", type=int, default=50_000_000,
                    help="tokens per KTSH shard")
-    p.add_argument("--eos-between-docs", action="store_true",
-                   default=True)
+    p.add_argument("--eos-between-docs",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="append EOS after each document "
+                        "(--no-eos-between-docs disables)")
     args = p.parse_args(argv)
 
-    paths = sorted(p for pat in args.input for p in glob.glob(pat))
-    if not paths:
-        print(f"no input files match {args.input}", file=sys.stderr)
-        return 1
+    paths: list[str] = []
+    for pat in args.input:
+        matched = glob.glob(pat)
+        if not matched:
+            # a typo'd pattern must not silently shrink the dataset
+            print(f"no input files match {pat!r}", file=sys.stderr)
+            return 1
+        paths.extend(matched)
+    # dedupe: a file matched by two patterns must not be tokenized
+    # twice (silent data duplication skews every downstream loss)
+    paths = sorted(set(paths))
     os.makedirs(args.out, exist_ok=True)
 
     if args.tokenizer:
