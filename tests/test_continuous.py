@@ -658,3 +658,54 @@ async def test_stream_overload_is_429_not_broken_sse():
     assert r.status == 429
     assert r.headers["Retry-After"] == "1"
     await client.close()
+
+
+@pytest.mark.slow
+async def test_continuous_chaos_soak():
+    """30 concurrent requests over 3 slots with mixed max_new, sampling
+    knobs, stop sequences and mid-flight cancellations: every future
+    must settle, the slot pool must end fully free, and the batcher
+    must still serve afterwards — the no-deadlock/no-leak property the
+    individual tests can't cover in combination."""
+    engine, cfg = _engine(eos=None, max_len=64)
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=3,
+                                chunk=2, max_pending=64)
+    gen = np.random.default_rng(77)
+
+    async def one(i: int):
+        p = gen.integers(0, cfg.vocab_size,
+                         int(gen.integers(2, 12))).tolist()
+        max_new = int(gen.integers(1, 9))
+        sampling = []
+        if i % 3 == 0:
+            sampling.append(("temperature", 0.8))
+        if i % 5 == 0:
+            sampling.append(("stop", ((int(gen.integers(0, 64)),),)))
+        task = asyncio.ensure_future(
+            batcher.submit(p, max_new, tuple(sampling)))
+        if i % 4 == 0:
+            await asyncio.sleep(float(gen.uniform(0, 0.05)))
+            task.cancel()
+        try:
+            out = await asyncio.wait_for(task, timeout=120)
+            # a stop completing on the FIRST token legitimately trims
+            # the output to empty — only the upper bound is invariant
+            assert len(out) <= max_new
+            return "done"
+        except asyncio.CancelledError:
+            return "cancelled"
+
+    results = await asyncio.gather(*(one(i) for i in range(30)))
+    assert set(results) <= {"done", "cancelled"}
+    assert results.count("done") >= 15  # most ran to completion
+    # pool drains completely once the dust settles
+    for _ in range(400):
+        if not batcher._active and not batcher._pending:
+            break
+        await asyncio.sleep(0.01)
+    assert not batcher._active and not batcher._pending
+    assert sorted(batcher._free) == [0, 1, 2]
+    # and the batcher still serves
+    p = gen.integers(0, cfg.vocab_size, 5).tolist()
+    assert await batcher.submit(p, 4, ()) == _solo(engine, p, 4)
+    await batcher.close()
