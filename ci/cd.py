@@ -38,10 +38,12 @@ def image_push_workflow(image: str) -> dict:
     """CD twin of ci.workflows.image_build_workflow: on main, build the
     image and push it tagged with the commit SHA (ref cd/*.py kaniko
     push builders)."""
+    from ci.workflows import _image_paths
+
     return {
         "name": f"push {image} image",
         "on": {"push": {"branches": ["main"],
-                        "paths": [f"images/{image}/**"]}},
+                        "paths": _image_paths(image)}},
         "jobs": {
             "push": {
                 "runs-on": "ubuntu-latest",

@@ -174,11 +174,21 @@ def unit_test_workflow(component: str) -> dict:
     }
 
 
+def _image_paths(image: str) -> list:
+    """Trigger paths for an image. The serving image COPYs the
+    framework source, so source changes must rebuild it — the other
+    images are self-contained Dockerfiles."""
+    paths = [f"images/{image}/**"]
+    if image == "serving":
+        paths += ["kubeflow_tpu/**", "pyproject.toml"]
+    return paths
+
+
 def image_build_workflow(image: str) -> dict:
     """ref ci/*_runner.py kaniko no-push builds: PRs build, never push."""
     return {
         "name": f"build {image} image",
-        "on": {"pull_request": {"paths": [f"images/{image}/**"]}},
+        "on": {"pull_request": {"paths": _image_paths(image)}},
         "jobs": {
             "build": {
                 "runs-on": "ubuntu-latest",

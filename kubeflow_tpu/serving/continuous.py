@@ -499,27 +499,35 @@ class ContinuousBatcher:
         request finishes (other slots keep decoding). The result is
         EOS-padded to exactly max_new — interchangeable with the window
         Batcher's fixed-shape contract (a request that hits EOS early
-        stops COMPUTING early here; the pad is host-side). Requests
-        with stop sequences — or with_logprobs, whose entries must
-        stay 1:1 with real computed tokens — return the trimmed
-        output unpadded. with_logprobs=True returns (tokens,
-        logprobs)."""
+        stops COMPUTING early here; the pad is host-side) — with or
+        without logprobs, so the response SHAPE never depends on the
+        server's batcher mode. Requests with stop sequences return the
+        TRIMMED output unpadded — stopping short is the ask.
+        with_logprobs=True returns (tokens, logprobs); logprobs stays
+        unpadded (entries exist only for computed tokens, through the
+        first EOS)."""
         fut = self._enqueue(tokens, max_new, sampling, queue=None)
         out, lps = await fut
-        if with_logprobs:
-            return out, lps
         eos = self.engine.ec.eos_token
         if eos is not None and len(out) < max_new \
                 and not dict(sampling).get("stop"):
             out = out + [eos] * (max_new - len(out))
-        return out
+        return (out, lps) if with_logprobs else out
+
+    def open_stream(self, tokens: list[int], max_new: int,
+                    sampling: tuple):
+        """Enqueue a streaming request NOW (admission errors — incl.
+        Overloaded — raise here, synchronously) and return (fut,
+        queue). The server calls this BEFORE sending SSE headers so
+        overload is a clean 429, never a mid-stream abort."""
+        q: asyncio.Queue = asyncio.Queue()
+        return self._enqueue(tokens, max_new, sampling, queue=q), q
 
     async def stream(self, tokens: list[int], max_new: int,
                      sampling: tuple):
         """Async-iterate tokens as they decode (SSE feed). The stream
         ends at EOS or max_new; the caller owns trimming/decoding."""
-        q: asyncio.Queue = asyncio.Queue()
-        fut = self._enqueue(tokens, max_new, sampling, queue=q)
+        fut, q = self.open_stream(tokens, max_new, sampling)
         try:
             while True:
                 item = await q.get()

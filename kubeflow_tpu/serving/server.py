@@ -213,7 +213,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                        warmup: bool = False,
                        prefill_chunk: int | None = None,
                        prefixes: dict[str, list[int]] | None = None,
-                       max_pending: int = 256,
+                       max_pending: int | None = None,
                        drafts: dict[str, InferenceEngine] | None = None,
                        ) -> web.Application:
     """`tokenizer` (data.bpe.Tokenizer or anything with encode/decode)
@@ -253,22 +253,25 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     # and interleaved generate calls would just thrash compile caches.
     lock = asyncio.Lock()
     app[GPU_LOCK_KEY] = lock
-    if not continuous and (warmup or prefill_chunk or prefixes):
+    if not continuous and (warmup or prefill_chunk or prefixes
+                           or max_pending is not None):
         # these knobs only exist on the continuous batcher; silently
         # ignoring them would ship a server missing configuration the
-        # caller explicitly asked for
+        # caller explicitly asked for (max_pending especially: the
+        # caller believes overload sheds at that depth)
         raise ValueError(
-            "warmup/prefill_chunk/prefixes require continuous=True")
+            "warmup/prefill_chunk/prefixes/max_pending require "
+            "continuous=True")
     if continuous:
         # prefill_chunk: long prompts admit in fixed slices — chunk-
         # multiple buckets, one [g, chunk] compile for every length.
         # prefixes: named system prompts whose KV computes once; a
         # request opts in with {"prefix": name}.
         app[BATCHERS_KEY] = {
-            name: ContinuousBatcher(eng, lock, max_slots=max_batch,
-                                    prefill_chunk=prefill_chunk,
-                                    prefixes=prefixes,
-                                    max_pending=max_pending)
+            name: ContinuousBatcher(
+                eng, lock, max_slots=max_batch,
+                prefill_chunk=prefill_chunk, prefixes=prefixes,
+                max_pending=256 if max_pending is None else max_pending)
             for name, eng in engines.items()}
         if warmup:
             async def _warm(app_):
@@ -406,13 +409,16 @@ async def _stream_continuous(request, batcher, arr, max_new, sampling,
     tokens, never the GPU lock (the batcher's worker owns that)."""
     import json as _json
 
-    if len(batcher._pending) >= batcher.max_pending:
-        # BEFORE the SSE headers: once 200 is sent, an Overloaded from
-        # the first __anext__ can only abort the connection — the
-        # client deserves the 429 + Retry-After instead
+    try:
+        # enqueue BEFORE the SSE headers: admission errors (Overloaded
+        # included) must be a clean 429/4xx, never a mid-stream abort —
+        # a depth pre-check alone would race a concurrent admission
+        fut, q = batcher.open_stream(
+            arr[0].tolist(), max_new, tuple(sorted(sampling.items())))
+    except Overloaded as e:
         return web.json_response(
-            {"error": "server overloaded: admission queue full"},
-            status=429, headers={"Retry-After": "1"})
+            {"error": f"server overloaded: {e}"}, status=429,
+            headers={"Retry-After": "1"})
     resp = web.StreamResponse(headers={
         "Content-Type": "text/event-stream",
         "Cache-Control": "no-cache",
@@ -420,12 +426,19 @@ async def _stream_continuous(request, batcher, arr, max_new, sampling,
     })
     await resp.prepare(request)
     ids: list[int] = []
-    async for tok in batcher.stream(
-            arr[0].tolist(), max_new, tuple(sorted(sampling.items()))):
-        ids.append(tok)
-        await resp.write(
-            b"data: " + _json.dumps({"tokens": [[tok]]}).encode()
-            + b"\n\n")
+    try:
+        while True:
+            tok = await q.get()
+            if tok is None:
+                break
+            ids.append(tok)
+            await resp.write(
+                b"data: " + _json.dumps({"tokens": [[tok]]}).encode()
+                + b"\n\n")
+        await fut  # surface admission/step errors after drain
+    finally:
+        if not fut.done():
+            fut.cancel()  # consumer gone: release the slot
     final: dict[str, Any] = {"done": True, "total": len(ids)}
     if text_mode and ids:
         final["text"] = (tokenizer.decode(ids) if tokenizer

@@ -40,12 +40,16 @@ async def list_ms(request: web.Request):
 
     def warning(m) -> str:
         # the controller explains config rejects as warning events
-        # (InvalidModel/InvalidTopology/...); surface the newest so
-        # "why isn't it Ready" is answered in the list, the same
-        # error-event mining the spawner does (ref status.py:79-95)
+        # (InvalidModel/InvalidTopology/...); surface the NEWEST BY
+        # TIMESTAMP — store.list orders by name (random uuid suffix),
+        # so [-1] would pick an arbitrary event and an operator could
+        # be sent to fix an already-fixed field (same discipline as
+        # jupyter_app's error-event mining, ref status.py:79-95)
         evs = [e for e in store.events_for(
             "ModelServer", ns, m.metadata.name) if e.type == "Warning"]
-        return evs[-1].message if evs else ""
+        if not evs:
+            return ""
+        return max(evs, key=lambda e: e.last_timestamp).message
 
     return json_success({
         "modelservers": [

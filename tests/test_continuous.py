@@ -709,3 +709,32 @@ async def test_continuous_chaos_soak():
     p = gen.integers(0, cfg.vocab_size, 5).tolist()
     assert await batcher.submit(p, 4, ()) == _solo(engine, p, 4)
     await batcher.close()
+
+
+@pytest.mark.slow
+async def test_logprobs_shape_uniform_across_paths_with_eos():
+    """Response SHAPE must not depend on the server's batcher mode:
+    with EOS hit early and logprobs on, both paths return max_new
+    EOS-padded tokens and EOS-trimmed logprobs."""
+    engine0, cfg = _engine()
+    p = np.random.default_rng(42).integers(0, cfg.vocab_size, 6).tolist()
+    ref = _solo(engine0, p, 6)
+    bodies = {}
+    for mode, kwargs in (("continuous",
+                          {"continuous": True, "max_batch": 2}),
+                         ("direct", {})):
+        engine, _ = _engine(eos=ref[2])
+        app = server_lib.create_serving_app({"m": engine}, **kwargs)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        r = await client.post(
+            "/v1/models/m:generate",
+            json={"tokens": [p], "max_new": 6, "logprobs": True})
+        assert r.status == 200, await r.text()
+        bodies[mode] = await r.json()
+        await client.close()
+    for mode, body in bodies.items():
+        assert len(body["tokens"][0]) == 6, (mode, body)   # EOS-padded
+        assert body["tokens"][0][2:] == [ref[2]] * 4, (mode, body)
+        assert len(body["logprobs"][0]) == 3, (mode, body)  # EOS-trimmed
+    assert bodies["continuous"]["tokens"] == bodies["direct"]["tokens"]

@@ -168,13 +168,19 @@ def plan_serving(model: str, mesh_sizes: dict[str, int], slots: int,
             factor *= shard_factor(entry, mesh_sizes)
         itemsize = 1 if quant == "int8" else 2  # int8 vs bf16 serving
         weight_bytes += math.prod(leaf.shape) * itemsize / factor
-    kv_shards = max(mesh_sizes.get("tensor", 1), 1)  # kv heads shard on tensor
+    # kv heads shard on tensor — but never more ways than heads exist
+    # (MQA: num_kv_heads=1 cannot shard at all; overdividing would
+    # report fits=true for a deployment that OOMs at startup)
+    kv_shards = max(min(mesh_sizes.get("tensor", 1),
+                        cfg.num_kv_heads), 1)
     kv_bytes = (2 * cfg.num_layers * slots * max_len
                 * cfg.num_kv_heads * cfg.head_dim * 2 / kv_shards)
     # prefill working set: one bucket of activations + return_all-free
-    # last-position logits are negligible; residuals dominate
-    prefill_bytes = (slots * max_len * cfg.hidden_size * 2
-                     * 2 / kv_shards)
+    # last-position logits are negligible; residuals dominate — they
+    # shard over the TENSOR axis via the hidden dim (activation
+    # constraints), not the kv-head count
+    t = max(mesh_sizes.get("tensor", 1), 1)
+    prefill_bytes = slots * max_len * cfg.hidden_size * 2 * 2 / t
     total = weight_bytes + kv_bytes + prefill_bytes
     hbm = HBM_BYTES[generation]
     budget = hbm * 0.92
