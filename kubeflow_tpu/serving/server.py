@@ -448,6 +448,44 @@ async def _stream_continuous(request, batcher, arr, max_new, sampling,
     return resp
 
 
+def _parse_token_lists(body: dict, tokenizer, *, min_len: int):
+    """Materialize token rows from "text" or "tokens" — the ONE
+    definition of request-token parsing for the generate and score
+    doors (drifted copies once meant the two validated differently).
+    Returns (token_lists, text_mode) or a 400 Response. `min_len` is
+    the per-row floor: 1 for generation, 2 for teacher-forced scoring
+    (a single token has nothing to predict)."""
+    text_mode = "text" in body
+    if text_mode:
+        if not isinstance(body["text"], str):
+            return web.json_response(
+                {"error": "'text' must be a string"}, status=400)
+        token_lists = [tokenizer.encode(body["text"], bos=True)
+                       if tokenizer else byte_encode(body["text"])]
+        if len(token_lists[0]) < min_len:
+            return web.json_response(
+                {"error": f"text encodes to fewer than {min_len} "
+                          "tokens (at least 2 needed to score)"
+                 if min_len > 1 else "text encodes to no tokens"},
+                status=400)
+    elif "tokens" in body:
+        token_lists = body["tokens"]
+        if (not isinstance(token_lists, list) or not token_lists
+                or not all(
+                    isinstance(t, list) and len(t) >= min_len
+                    and all(isinstance(x, int) and not isinstance(x, bool)
+                            for x in t)
+                    for t in token_lists)):
+            return web.json_response(
+                {"error": "tokens must be a non-empty list of integer "
+                          f"token-id lists with at least {min_len} "
+                          "token(s) each"}, status=400)
+    else:
+        return web.json_response(
+            {"error": "body needs 'text' or 'tokens'"}, status=400)
+    return token_lists, text_mode
+
+
 async def score(request: web.Request):
     """Teacher-forced scoring: log P(token_i | prefix) for a given
     sequence — the perplexity/eval door (lm-eval style). Body:
@@ -463,33 +501,13 @@ async def score(request: web.Request):
     except Exception:
         return web.json_response({"error": "invalid JSON"}, status=400)
     tokenizer = request.app[TOKENIZER_KEY]
-    if "text" in body:
-        if not isinstance(body["text"], str):
-            return web.json_response(
-                {"error": "'text' must be a string"}, status=400)
-        token_lists = [tokenizer.encode(body["text"], bos=True)
-                       if tokenizer else byte_encode(body["text"])]
-    elif "tokens" in body:
-        token_lists = body["tokens"]
-        if (not isinstance(token_lists, list) or not token_lists
-                or not all(
-                    isinstance(t, list) and len(t) >= 2
-                    and all(isinstance(x, int) and not isinstance(x, bool)
-                            for x in t)
-                    for t in token_lists)):
-            return web.json_response(
-                {"error": "tokens must be non-empty integer token-id "
-                          "lists of at least 2 tokens"}, status=400)
-    else:
-        return web.json_response(
-            {"error": "body needs 'text' or 'tokens'"}, status=400)
+    parsed = _parse_token_lists(body, tokenizer, min_len=2)
+    if isinstance(parsed, web.Response):
+        return parsed
+    token_lists, _ = parsed
     if len({len(t) for t in token_lists}) != 1:
         return web.json_response(
             {"error": "all rows must share a length (static shapes)"},
-            status=400)
-    if len(token_lists[0]) < 2:
-        return web.json_response(
-            {"error": "scoring needs at least 2 tokens per row"},
             status=400)
     if len(token_lists[0]) > engine.ec.max_len:
         return web.json_response(
@@ -527,27 +545,10 @@ async def generate(request: web.Request):
         return web.json_response({"error": "invalid JSON"}, status=400)
 
     tokenizer = request.app[TOKENIZER_KEY]
-    text_mode = "text" in body
-    if text_mode:
-        if not isinstance(body["text"], str):
-            return web.json_response({"error": "'text' must be a string"},
-                                     status=400)
-        token_lists = [tokenizer.encode(body["text"], bos=True)
-                       if tokenizer else byte_encode(body["text"])]
-    elif "tokens" in body:
-        token_lists = body["tokens"]
-        if (not isinstance(token_lists, list) or not token_lists
-                or not all(
-                    isinstance(t, list) and t
-                    and all(isinstance(x, int) and not isinstance(x, bool)
-                            for x in t)
-                    for t in token_lists)):
-            return web.json_response(
-                {"error": "tokens must be a non-empty list of non-empty "
-                          "integer token-id lists"}, status=400)
-    else:
-        return web.json_response(
-            {"error": "body needs 'text' or 'tokens'"}, status=400)
+    parsed = _parse_token_lists(body, tokenizer, min_len=1)
+    if isinstance(parsed, web.Response):
+        return parsed
+    token_lists, text_mode = parsed
 
     max_new = body.get("max_new", 16)
     if not isinstance(max_new, int) or isinstance(max_new, bool) \
