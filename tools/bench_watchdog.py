@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""Capture-on-recovery TPU evidence watchdog.
+
+Rounds 3 and 4 lost every hardware number to "TPU weather": the single
+tunneled chip was wedged during the one ~10-minute window in which the
+driver runs `bench.py`, so the armored CPU fallback fired and nothing
+built since r02 has a TPU-captured metric. This tool decouples evidence
+capture from the driver moment (VERDICT r04 task 1): it polls backend
+health cheaply through the WHOLE working session — one fresh-subprocess
+probe per interval, never touching the backend in-process — and the
+moment the chip answers it runs the full evidence chain:
+
+    1. `python bench.py`            -> BENCH_TPU_LATEST.json
+    2. `python tools/remat_sweep.py`-> REMAT_SWEEP_TPU.txt
+    3. `python tools/capture_profile.py` (trace under --profile-dir)
+
+Every probe attempt (timestamp, outcome, latency) is appended to
+BENCH_TPU_PROBELOG.txt so a round that never sees a healthy chip still
+ends with a committed artifact *proving* the chip never answered once.
+
+Run it nohup'd at session start:
+
+    nohup python tools/bench_watchdog.py --deadline-s 39600 \
+        >/tmp/watchdog.out 2>&1 &
+
+The reference has no analog (it is a k8s control plane with no
+hardware); the pattern here generalizes its reconcile-until-converged
+idempotency (SURVEY.md §5 failure detection) to evidence capture: each
+stage is retried until it succeeds, completed stages are never re-run
+(stage outputs are the convergence markers), and a capture that wedges
+the chip mid-chain leaves the remaining stages for the next healthy
+window.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# The watchdog itself NEVER needs a real backend — only its probe
+# subprocesses touch one. Pin this process to CPU before `import bench`
+# (which imports jax at module scope): a sitecustomize pins the TPU
+# plugin via jax.config, and any in-process backend touch during bad
+# weather hangs — the exact failure this tool exists to survive.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import bench  # noqa: E402
+
+PROBELOG = "BENCH_TPU_PROBELOG.txt"
+BENCH_OUT = "BENCH_TPU_LATEST.json"
+REMAT_OUT = "REMAT_SWEEP_TPU.txt"
+
+
+def _utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+def log_probe(path: str, outcome: str, latency_s: float, detail: str = "",
+              now: str | None = None) -> None:
+    """One append-only line per probe: `<utc> <outcome> <latency>s <detail>`.
+
+    The log IS the negative evidence — kept human-readable and
+    append-only so a wedged-all-round session still commits proof of
+    every attempt.
+    """
+    line = f"{now or _utcnow()} {outcome} {latency_s:.1f}s"
+    if detail:
+        line += f" {detail}"
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(line + "\n")
+
+
+def probe_once(timeout_s: float) -> tuple[str, float, str]:
+    """(outcome, latency_s, detail). Outcome: "tpu", "cpu", ... or "down"."""
+    t0 = time.monotonic()
+    name, err = bench._probe_backend(timeout_s)
+    dt = time.monotonic() - t0
+    if name is None:
+        return "down", dt, err
+    return name, dt, ""
+
+
+class Stage:
+    """One capture stage: a command that converges to an output artifact.
+
+    `done()` checks the artifact, so a watchdog restarted mid-session
+    (or a chain interrupted by re-wedging weather) resumes exactly
+    where it left off instead of re-burning a healthy window.
+    """
+
+    def __init__(self, name: str, cmd: list[str], out_path: str,
+                 timeout_s: float, postprocess=None):
+        self.name = name
+        self.cmd = cmd
+        self.out_path = out_path
+        self.timeout_s = timeout_s
+        self.postprocess = postprocess  # (stdout) -> text to write, or None
+
+    def done(self) -> bool:
+        return os.path.exists(self.out_path) and (
+            os.path.getsize(self.out_path) > 0)
+
+    def run(self, log) -> bool:
+        log(f"stage {self.name}: start ({' '.join(self.cmd)})")
+        try:
+            proc = subprocess.run(
+                self.cmd, cwd=_REPO, stdout=subprocess.PIPE, text=True,
+                timeout=self.timeout_s)
+        except subprocess.TimeoutExpired:
+            log(f"stage {self.name}: TIMEOUT after {self.timeout_s:.0f}s")
+            return False
+        if proc.returncode != 0:
+            log(f"stage {self.name}: FAILED rc={proc.returncode}")
+            return False
+        text = proc.stdout
+        if self.postprocess is not None:
+            text = self.postprocess(text)
+            if text is None:
+                log(f"stage {self.name}: rc=0 but no usable output")
+                return False
+        tmp = self.out_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(text)
+        os.replace(tmp, self.out_path)
+        log(f"stage {self.name}: OK -> {self.out_path}")
+        return True
+
+
+def _extract_bench_json(stdout: str) -> str | None:
+    """Keep only the artifact line, stamped with capture time.
+
+    A sweep that degraded to cpu-fallback is NOT TPU evidence — refuse
+    it so the stage stays un-converged and retries next healthy window.
+    """
+    for line in reversed(stdout.splitlines()):
+        if line.startswith("{"):
+            try:
+                payload = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if payload.get("backend") != "tpu":
+                return None
+            payload["captured_at"] = _utcnow()
+            return json.dumps(payload) + "\n"
+    return None
+
+
+def _remat_text(stdout: str) -> str | None:
+    if "RESULTS:" not in stdout:
+        return None
+    return f"# captured {_utcnow()} by tools/bench_watchdog.py\n" + stdout
+
+
+def default_stages(out_dir: str, profile_dir: str) -> list[Stage]:
+    py = sys.executable
+    return [
+        Stage("bench", [py, os.path.join(_REPO, "bench.py")],
+              os.path.join(out_dir, BENCH_OUT), timeout_s=5400,
+              postprocess=_extract_bench_json),
+        Stage("remat", [py, os.path.join(_REPO, "tools", "remat_sweep.py")],
+              os.path.join(out_dir, REMAT_OUT), timeout_s=5400,
+              postprocess=_remat_text),
+        Stage("profile",
+              [py, os.path.join(_REPO, "tools", "capture_profile.py"),
+               "--steps", "3", "--logdir", profile_dir],
+              # capture_profile writes the trace itself; its stdout
+              # summary is the convergence artifact here.
+              os.path.join(out_dir, "PROFILE_TPU.txt"), timeout_s=1800,
+              postprocess=lambda s: s if s.strip() else None),
+    ]
+
+
+def watch(interval_s: float, probe_timeout_s: float, deadline_s: float,
+          out_dir: str, stages: list[Stage], *, once: bool = False,
+          sleep=time.sleep, clock=time.monotonic) -> int:
+    """Poll until deadline; capture on the first healthy window.
+
+    Returns 0 if every stage converged, 2 if the deadline passed (or
+    the single --once probe finished) with stages remaining — the probe
+    log is then the deliverable. The deadline bounds *polling*, not a
+    capture chain already underway: a healthy window found at the
+    deadline's edge still gets its full capture.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    probelog = os.path.join(out_dir, PROBELOG)
+
+    def log(msg: str) -> None:
+        with open(probelog, "a", encoding="utf-8") as f:
+            f.write(f"{_utcnow()} {msg}\n")
+        print(msg, flush=True)
+
+    t_end = clock() + deadline_s
+    while True:
+        pending = [s for s in stages if not s.done()]
+        if not pending:
+            log("all stages converged; watchdog exiting")
+            return 0
+        outcome, dt, detail = probe_once(probe_timeout_s)
+        log_probe(probelog, outcome, dt, detail)
+        if outcome == "tpu":
+            log(f"chip HEALTHY (probe {dt:.1f}s); running "
+                f"{len(pending)} pending stage(s)")
+            for stage in pending:
+                if not stage.run(log):
+                    # Re-probe before continuing the chain: a stage
+                    # that wedged the tunnel makes every later stage a
+                    # guaranteed timeout-burn.
+                    o2, dt2, d2 = probe_once(probe_timeout_s)
+                    log_probe(probelog, o2, dt2, f"post-{stage.name} {d2}")
+                    if o2 != "tpu":
+                        log("chip lost mid-chain; back to polling")
+                        break
+            if not [s for s in stages if not s.done()]:
+                log("all stages converged; watchdog exiting")
+                return 0
+        if once or clock() >= t_end:
+            break
+        sleep(interval_s)
+    remaining = [s.name for s in stages if not s.done()]
+    if remaining:
+        log(f"deadline reached with stages pending: {remaining}")
+        return 2
+    return 0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--interval-s", type=float, default=240,
+                   help="seconds between health probes (default 240)")
+    p.add_argument("--probe-timeout-s", type=float, default=150,
+                   help="per-probe subprocess budget (default 150)")
+    p.add_argument("--deadline-s", type=float, default=11 * 3600,
+                   help="total watch budget (default 11h)")
+    p.add_argument("--out-dir", default=_REPO,
+                   help="where artifacts + probe log land (default repo root)")
+    p.add_argument("--profile-dir", default="/tmp/kftpu-profile-watchdog")
+    p.add_argument("--once", action="store_true",
+                   help="single probe (+capture if healthy), then exit")
+    args = p.parse_args()
+
+    stages = default_stages(args.out_dir, args.profile_dir)
+    return watch(args.interval_s, args.probe_timeout_s, args.deadline_s,
+                 args.out_dir, stages, once=args.once)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
