@@ -648,6 +648,15 @@ class ContinuousBatcher:
         if not fut.done():
             fut.set_exception(exc)
 
+    def _fail_all(self, exc) -> None:
+        """Slot state is unrecoverable (donated buffers consumed by a
+        failed dispatch): fail every active request deterministically
+        and drop the state so the next admission re-inits."""
+        for slot, rec in list(self._active.items()):
+            self._release(slot)
+            self._fail(rec.fut, rec.queue, exc)
+        self._st = None
+
     async def _get_prefix_state(self, name: str):
         """Lazily compute (once) a registered prefix's KV."""
         if name in self._prefix_states:
@@ -720,6 +729,19 @@ class ContinuousBatcher:
                 except Exception as e:  # noqa: BLE001
                     self._free.append(slot)
                     self._fail(fut, queue, e)
+                    # insert donates self._st: a failure that fired
+                    # AFTER dispatch leaves the old buffers consumed,
+                    # and keeping them would crash the NEXT decode step
+                    # with a confusing deleted-buffer error. A failure
+                    # BEFORE dispatch (bad shapes, host-side raise)
+                    # leaves them intact — then only this admission
+                    # dies. Distinguish the two instead of guessing.
+                    if self._st is not None and any(
+                            leaf.is_deleted() for leaf in
+                            jax.tree.leaves(self._st)
+                            if hasattr(leaf, "is_deleted")):
+                        self._fail_all(RuntimeError(
+                            f"slot state lost to donated insert: {e}"))
                     continue
                 self.requests += 1
                 rec = _Slot(fut, max_new, queue,
@@ -773,13 +795,7 @@ class ContinuousBatcher:
                         None, run_step)
                     self._st = st
             except Exception as e:  # noqa: BLE001 — fail active requests
-                for slot, rec in list(self._active.items()):
-                    self._release(slot)
-                    if rec.queue is not None and not rec.fut.done():
-                        rec.queue.put_nowait(None)
-                    if not rec.fut.done():
-                        rec.fut.set_exception(e)
-                self._st = None  # donated buffers may be mid-flight
+                self._fail_all(e)  # donated buffers may be mid-flight
                 continue
             self.calls += steps
             for slot, rec in list(self._active.items()):

@@ -373,28 +373,37 @@ async def _stream_generate(request, engine, arr, max_new, sampling,
     await resp.prepare(request)
     loop = asyncio.get_event_loop()
     chunks: list[np.ndarray] = []
+    error: str | None = None
     while True:
         # Lock only around the device work, NOT the client write: a
         # slow-reading client must back-pressure its own stream, never
         # stall every other request behind the GPU lock. Other requests
         # interleave between chunks (each chunk call is self-contained).
-        async with request.app[GPU_LOCK_KEY]:
-            part = await loop.run_in_executor(
-                None, lambda: next(gen, None))
+        try:
+            async with request.app[GPU_LOCK_KEY]:
+                part = await loop.run_in_executor(
+                    None, lambda: next(gen, None))
+        except Exception as e:  # noqa: BLE001
+            # Same terminal-event contract as _stream_continuous:
+            # headers are out, so raising would abort the connection
+            # indistinguishably from a network drop.
+            error = f"{type(e).__name__}: {e}"
+            break
         if part is None:
             break
         chunks.append(part)
         await resp.write(
             b"data: " + _json.dumps(
                 {"tokens": part.tolist()}).encode() + b"\n\n")
-    final: dict[str, Any] = {
-        "done": True,
-        "total": int(sum(c.shape[1] for c in chunks)),
-    }
-    if text_mode and chunks:
-        ids = np.concatenate(chunks, axis=1)[0].tolist()
-        final["text"] = (tokenizer.decode(ids) if tokenizer
-                         else byte_decode(ids))
+    total = int(sum(c.shape[1] for c in chunks))
+    if error is not None:
+        final: dict[str, Any] = {"error": error, "total": total}
+    else:
+        final = {"done": True, "total": total}
+        if text_mode and chunks:
+            ids = np.concatenate(chunks, axis=1)[0].tolist()
+            final["text"] = (tokenizer.decode(ids) if tokenizer
+                             else byte_decode(ids))
     await resp.write(b"data: " + _json.dumps(final).encode() + b"\n\n")
     await resp.write_eof()
     return resp
@@ -426,6 +435,7 @@ async def _stream_continuous(request, batcher, arr, max_new, sampling,
     })
     await resp.prepare(request)
     ids: list[int] = []
+    error: str | None = None
     try:
         while True:
             tok = await q.get()
@@ -435,14 +445,23 @@ async def _stream_continuous(request, batcher, arr, max_new, sampling,
             await resp.write(
                 b"data: " + _json.dumps({"tokens": [[tok]]}).encode()
                 + b"\n\n")
-        await fut  # surface admission/step errors after drain
+        try:
+            await fut  # surface admission/step errors after drain
+        except Exception as e:  # noqa: BLE001
+            # Headers are already sent: a raise here would abort the
+            # connection, indistinguishable from a network drop. Emit
+            # a deterministic terminal error event instead.
+            error = f"{type(e).__name__}: {e}"
     finally:
         if not fut.done():
             fut.cancel()  # consumer gone: release the slot
-    final: dict[str, Any] = {"done": True, "total": len(ids)}
-    if text_mode and ids:
-        final["text"] = (tokenizer.decode(ids) if tokenizer
-                         else byte_decode(ids))
+    if error is not None:
+        final: dict[str, Any] = {"error": error, "total": len(ids)}
+    else:
+        final = {"done": True, "total": len(ids)}
+        if text_mode and ids:
+            final["text"] = (tokenizer.decode(ids) if tokenizer
+                             else byte_decode(ids))
     await resp.write(b"data: " + _json.dumps(final).encode() + b"\n\n")
     await resp.write_eof()
     return resp

@@ -18,11 +18,11 @@ Layout per step: `<dir>/<step>/state/` (Orbax OCDBT tree) plus a
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Any, Mapping
 
 import jax
 import orbax.checkpoint as ocp
+from etils import epath
 
 from kubeflow_tpu.train.trainer import Trainer, TrainState
 
@@ -136,9 +136,15 @@ class Checkpointer:
             step = self.latest_step()
         if step is None:
             return {}
-        if missing_ok and not os.path.isdir(
-                os.path.join(self.config.directory, str(step), item)):
-            return {}
+        if missing_ok:
+            # epath, not os.path: checkpoint dirs can be remote
+            # (gs://...), where os.path.isdir is always False and the
+            # probe would silently report every item absent — restarting
+            # a resumed data stream at ticket 0, the exact failure this
+            # item exists to prevent.
+            item_dir = epath.Path(self.config.directory) / str(step) / item
+            if not item_dir.exists():
+                return {}
         restored = self._mgr.restore(
             step,
             args=ocp.args.Composite(**{item: ocp.args.JsonRestore()}),

@@ -738,3 +738,138 @@ async def test_logprobs_shape_uniform_across_paths_with_eos():
         assert body["tokens"][0][2:] == [ref[2]] * 4, (mode, body)
         assert len(body["logprobs"][0]) == 3, (mode, body)  # EOS-trimmed
     assert bodies["continuous"]["tokens"] == bodies["direct"]["tokens"]
+
+
+async def test_insert_failure_before_dispatch_spares_active_slots():
+    """ADVICE r04: a host-side insert raise (donated state NOT consumed)
+    must fail only the new admission — requests already decoding keep
+    their KV and finish with correct tokens."""
+    engine, cfg = _engine()
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2,
+                                chunk=1)
+    gen = np.random.default_rng(5)
+    p1 = gen.integers(0, cfg.vocab_size, 6).tolist()
+    p2 = gen.integers(0, cfg.vocab_size, 4).tolist()
+    want1 = _solo(engine, p1, 6)
+
+    t1 = asyncio.ensure_future(batcher.submit(p1, 6, ()))
+    # let the first request admit and start decoding
+    while not batcher._active:
+        await asyncio.sleep(0.01)
+
+    real_insert = batcher.cengine.insert
+
+    def boom(*a, **k):
+        raise ValueError("host-side admission failure")
+
+    batcher.cengine.insert = boom
+    with pytest.raises(ValueError, match="host-side admission"):
+        await batcher.submit(p2, 4, ())
+    batcher.cengine.insert = real_insert
+
+    assert list(await t1) == want1  # survivor unharmed
+    # pool healthy afterwards: a fresh request still serves
+    assert list(await batcher.submit(p1, 6, ())) == want1
+    await batcher.close()
+
+
+async def test_insert_failure_after_dispatch_fails_actives_cleanly():
+    """ADVICE r04: when the donated slot state WAS consumed by a failed
+    insert, active requests must get a deterministic RuntimeError now —
+    not a confusing deleted-buffer crash on the next decode step."""
+    engine, cfg = _engine()
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2,
+                                chunk=1)
+    gen = np.random.default_rng(6)
+    p1 = gen.integers(0, cfg.vocab_size, 6).tolist()
+    want1 = _solo(engine, p1, 6)
+
+    t1 = asyncio.ensure_future(batcher.submit(p1, 20, ()))
+    while not batcher._active:
+        await asyncio.sleep(0.01)
+
+    def consume_and_boom(st, *a, **k):
+        for leaf in jax.tree.leaves(st):
+            leaf.delete()  # what a post-dispatch donation does
+        raise ValueError("mid-insert failure")
+
+    real_insert = batcher.cengine.insert
+    batcher.cengine.insert = consume_and_boom
+    with pytest.raises(ValueError, match="mid-insert"):
+        await batcher.submit(p1, 4, ())
+    batcher.cengine.insert = real_insert
+
+    with pytest.raises(RuntimeError, match="slot state lost"):
+        await t1
+    assert not batcher._active  # slots released, nothing leaked
+    # batcher recovers: state re-inits on the next admission
+    assert list(await batcher.submit(p1, 6, ())) == want1
+    await batcher.close()
+
+
+async def test_stream_worker_failure_emits_terminal_sse_error():
+    """ADVICE r04: a decode-worker failure after SSE headers are sent
+    must end the stream with a deterministic `data: {"error": ...}`
+    record, not a bare connection abort."""
+    engine, cfg = _engine()
+    app = server_lib.create_serving_app(
+        {"m": engine}, continuous=True, max_batch=2)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    batcher = app[server_lib.BATCHERS_KEY]["m"]
+
+    calls = {"n": 0}
+    real_step = batcher.cengine.step
+
+    def failing_step(*a, **k):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise RuntimeError("chip fell over")
+        return real_step(*a, **k)
+
+    batcher.cengine.step = failing_step
+    p = np.random.default_rng(7).integers(0, cfg.vocab_size, 5).tolist()
+    resp = await client.post(
+        "/v1/models/m:generate",
+        json={"tokens": [p], "max_new": 8, "stream": True})
+    assert resp.status == 200
+    import json as _json
+    records = []
+    async for line in resp.content:
+        line = line.strip()
+        if line.startswith(b"data: "):
+            records.append(_json.loads(line[6:]))
+    assert records, "stream produced no records"
+    final = records[-1]
+    assert "error" in final and "chip fell over" in final["error"]
+    assert final.get("done") is None
+    await client.close()
+
+
+async def test_stream_failure_terminal_error_direct_mode_too():
+    """The terminal SSE error contract must hold in BOTH batcher modes
+    (review: continuous-only would make the contract mode-dependent)."""
+    engine, cfg = _engine()
+    app = server_lib.create_serving_app({"m": engine})  # direct mode
+    client = TestClient(TestServer(app))
+    await client.start_server()
+
+    def exploding_stream(*a, **k):
+        yield np.zeros((1, 1), np.int64)
+        raise RuntimeError("chip fell over")
+
+    engine.generate_stream = exploding_stream
+    resp = await client.post(
+        "/v1/models/m:generate",
+        json={"tokens": [[1, 2, 3]], "max_new": 8, "stream": True})
+    assert resp.status == 200
+    import json as _json
+    records = []
+    async for line in resp.content:
+        line = line.strip()
+        if line.startswith(b"data: "):
+            records.append(_json.loads(line[6:]))
+    final = records[-1]
+    assert "error" in final and "chip fell over" in final["error"]
+    assert final.get("done") is None
+    await client.close()
