@@ -336,6 +336,14 @@ def modelserver_lifecycle(alice: Client, admin: Client) -> None:
 
     got = poll("modelserver ready", ready)
     assert got["status"]["url"] == "/serving/alice/e2e-srv/", got["status"]
+    # checkpointed server speaks its training tokenizer: the rendered
+    # CLI carries --tokenizer auto (serving picks up tokenizer.json
+    # the Checkpointer leaves beside the checkpoint)
+    _, dep = alice.req(
+        "GET",
+        "/apis/kubeflow-tpu.dev/v1/namespaces/alice/deployments/e2e-srv")
+    args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--tokenizer" in args and "auto" in args, args
     status, _ = alice.api(
         "DELETE",
         "/apis/kubeflow-tpu.dev/v1/namespaces/alice/modelservers/e2e-srv")

@@ -195,6 +195,10 @@ class ModelServerSpec:
     max_batch: int = 8
     prefill_chunk: int = 0       # 0 = off
     quant: str = ""              # "" | int8
+    # "auto" = tokenizer.json beside the checkpoint when present (the
+    # tools/prepare_data.py output), "none" = byte fallback forced,
+    # else an explicit tokenizer file path/URL for text mode
+    tokenizer: str = "auto"
     tpu: TpuSpec = field(default_factory=TpuSpec)
 
 

@@ -192,6 +192,17 @@ class ModelServerController(Controller):
             args += ["--prefill-chunk", str(spec.prefill_chunk)]
         if spec.quant:
             args += ["--quant", spec.quant]
+        # "none"/"" force byte mode; "auto" lets the server pick up
+        # tokenizer.json beside the checkpoint (the Checkpointer
+        # carries it there from tools/prepare_data.py's output) so a
+        # served prepared checkpoint speaks its training tokenizer.
+        # Gated on a checkpoint being set: "auto" is a no-op without
+        # one, and not rendering it then keeps random-init servers
+        # runnable on serving images predating the flag's auto mode
+        # (controller and image ship from one tree, but image tags are
+        # operator-pinned).
+        if ckpt and spec.tokenizer and spec.tokenizer != "none":
+            args += ["--tokenizer", spec.tokenizer]
 
         container = Container(
             name=name,

@@ -96,7 +96,10 @@ def main(argv=None) -> int:
     p.add_argument("--prefill-chunk", type=int, default=0)
     p.add_argument("--quant", choices=("", "int8"), default="")
     p.add_argument("--tokenizer", default="",
-                   help="data.bpe tokenizer file (text mode)")
+                   help="data.bpe tokenizer file (text mode); 'auto' "
+                        "uses tokenizer.json beside --checkpoint when "
+                        "present (tools/prepare_data.py's output name), "
+                        "byte fallback otherwise")
     p.add_argument("--cpu", action="store_true",
                    help="pin the CPU backend (hermetic smoke; pins "
                         "jax.config BEFORE backend init)")
@@ -129,10 +132,27 @@ def main(argv=None) -> int:
         params, cfg, family,
         EngineConfig(max_len=args.max_len, eos_token=args.eos))
     tokenizer = None
-    if args.tokenizer:
+    tok_ref = args.tokenizer
+    if tok_ref == "auto":
+        # The prepare_data -> train -> serve loop drops its tokenizer
+        # at the last hop unless someone carries it: prefer the trained
+        # tokenizer saved beside the checkpoint over the byte fallback.
+        tok_ref = ""
+        if args.checkpoint:
+            from etils import epath
+
+            cand = epath.Path(args.checkpoint) / "tokenizer.json"
+            if cand.exists():
+                tok_ref = str(cand)
+    if tok_ref:
+        from etils import epath
+
         from kubeflow_tpu.data.bpe import Tokenizer
 
-        tokenizer = Tokenizer.load(args.tokenizer)
+        # epath, not open(): the checkpoint (and its tokenizer) can
+        # live on gs:// — same reasoning as train/checkpoint.py's
+        # data-state probe.
+        tokenizer = Tokenizer.loads(epath.Path(tok_ref).read_text())
     app = create_serving_app(
         {args.name or args.model: engine},
         tokenizer=tokenizer,
@@ -144,7 +164,8 @@ def main(argv=None) -> int:
     )
     print(f"serving {args.name or args.model} "
           f"({'random' if args.random else args.checkpoint}) on "
-          f"{args.host}:{args.port} backend={jax.default_backend()}",
+          f"{args.host}:{args.port} backend={jax.default_backend()} "
+          f"tokenizer={tok_ref or 'byte'}",
           flush=True)
     web.run_app(app, host=args.host, port=args.port, print=None)
     return 0

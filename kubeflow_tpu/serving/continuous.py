@@ -319,23 +319,32 @@ class ContinuousEngine:
 
         x = eng._embed(params, st.tok[:, None])
 
-        def layer(x, scanned):
+        # Cache as scan CARRY with in-place row scatters — same
+        # rationale as engine._forward_cached: ys-stacked cache slices
+        # rewrote the whole cache every token, doubling decode HBM
+        # traffic. Here the per-step write is S rows per layer.
+        def layer(carry, scanned):
+            x, k_all, v_all = carry
             if adapters is None:
-                p, k_cache, v_cache = scanned
+                p, li = scanned
                 proj = None
             else:
                 from kubeflow_tpu.serving.multilora import lora_proj
-                p, ab, k_cache, v_cache = scanned
+                p, ab, li = scanned
                 proj = lora_proj(ab, st.aid,
                                  eng.adapter_pack.scaling, cfg)
+            cell = {}
 
             def write_kv(k, v):
-                return (
-                    k_cache.at[rows, write_at].set(
-                        k[:, 0].astype(k_cache.dtype)),
-                    v_cache.at[rows, write_at].set(
-                        v[:, 0].astype(v_cache.dtype)),
-                )
+                k2 = k_all.at[li, rows, write_at].set(
+                    k[:, 0].astype(k_all.dtype))
+                v2 = v_all.at[li, rows, write_at].set(
+                    v[:, 0].astype(v_all.dtype))
+                cell["k"], cell["v"] = k2, v2
+                return (jax.lax.dynamic_index_in_dim(
+                            k2, li, 0, keepdims=False),
+                        jax.lax.dynamic_index_in_dim(
+                            v2, li, 0, keepdims=False))
 
             def attn(q, kc, vc):
                 return dot_product_attention(
@@ -343,13 +352,16 @@ class ContinuousEngine:
                     causal=True, kv_mask=kv_valid,
                     window=getattr(cfg, "sliding_window", None))
 
-            return transformer_block(
+            x, _ = transformer_block(
                 cfg, fam, p, x, rope_positions, inv_freq, write_kv,
                 attn, proj)
+            return (x, cell["k"], cell["v"]), None
 
-        xs = ((params["blocks"], st.k, st.v) if adapters is None
-              else (params["blocks"], adapters, st.k, st.v))
-        x, (k_new, v_new) = jax.lax.scan(layer, x, xs)
+        layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+        xs = ((params["blocks"], layer_ids) if adapters is None
+              else (params["blocks"], adapters, layer_ids))
+        (x, k_new, v_new), _ = jax.lax.scan(
+            layer, (x, st.k, st.v), xs)
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
         logits = eng._head(params, x[:, -1])
         nxt, lp = eng._sample(logits, sub, sp)
@@ -469,6 +481,11 @@ class ContinuousBatcher:
         self._temp = np.zeros(max_slots, np.float32)
         self._topk = np.zeros(max_slots, np.int32)
         self._topp = np.ones(max_slots, np.float32)
+        # SamplingParams rebuild (3 host->device transfers) only when a
+        # knob actually changed — at steady occupancy every decode
+        # chunk reuses the cached device arrays.
+        self._sp_cache: SamplingParams | None = None
+        self._sp_dirty = True
         self._rng = jax.random.key(
             int.from_bytes(os.urandom(8), "little") >> 1)
         self._worker: asyncio.Task | None = None
@@ -594,10 +611,13 @@ class ContinuousBatcher:
     # -- worker -----------------------------------------------------------
 
     def _sp(self) -> SamplingParams:
-        return SamplingParams(
-            temperature=jnp.asarray(self._temp),
-            top_k=jnp.asarray(self._topk),
-            top_p=jnp.asarray(self._topp))
+        if self._sp_dirty or self._sp_cache is None:
+            self._sp_cache = SamplingParams(
+                temperature=jnp.asarray(self._temp),
+                top_k=jnp.asarray(self._topk),
+                top_p=jnp.asarray(self._topp))
+            self._sp_dirty = False
+        return self._sp_cache
 
     def _release(self, slot: int) -> None:
         """Return a slot to the pool with greedy filler knobs (a
@@ -606,6 +626,7 @@ class ContinuousBatcher:
         self._active.pop(slot, None)
         self._free.append(slot)
         self._temp[slot], self._topk[slot], self._topp[slot] = 0, 0, 1.0
+        self._sp_dirty = True
 
     def _finish(self, slot: int, rec: _Slot) -> None:
         self._release(slot)
@@ -753,6 +774,7 @@ class ContinuousBatcher:
                     "temperature", ec.temperature)
                 self._topk[slot] = sampling.get("top_k", ec.top_k)
                 self._topp[slot] = sampling.get("top_p", ec.top_p)
+                self._sp_dirty = True
                 self._emit(slot, rec, int(firsts[row]),
                            float(flps[row]), decode=False)
 
@@ -781,19 +803,22 @@ class ContinuousBatcher:
                             for rec in self._active.values()))
             steps = max(steps, 1)
             try:
-                self._rng, sub = jax.random.split(self._rng)
                 sp = self._sp()
 
-                def run_step(st=self._st, sp=sp, sub=sub, steps=steps):
-                    # host sync inside the executor (see run_prefill)
-                    st, toks, lps, _ = self.cengine.step(st, sp, sub,
-                                                         steps)
-                    return st, np.asarray(toks), np.asarray(lps)
+                def run_step(st=self._st, sp=sp, steps=steps):
+                    # host sync inside the executor (see run_prefill).
+                    # The rng chains THROUGH the compiled step (it
+                    # splits internally and returns the next key) —
+                    # no host-side jax.random.split dispatch per chunk.
+                    st, toks, lps, rng = self.cengine.step(
+                        st, sp, self._rng, steps)
+                    return st, rng, np.asarray(toks), np.asarray(lps)
 
                 async with self.gpu_lock:
-                    st, toks, lps = await loop.run_in_executor(
+                    st, rng, toks, lps = await loop.run_in_executor(
                         None, run_step)
                     self._st = st
+                    self._rng = rng
             except Exception as e:  # noqa: BLE001 — fail active requests
                 self._fail_all(e)  # donated buffers may be mid-flight
                 continue

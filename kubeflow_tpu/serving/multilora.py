@@ -33,6 +33,7 @@ from typing import Any
 import jax.numpy as jnp
 import numpy as np
 
+from kubeflow_tpu.serving.quant import qdot
 from kubeflow_tpu.train.lora import LoraConfig, _TARGET_DIMS
 
 Params = dict[str, Any]
@@ -115,7 +116,7 @@ def lora_proj(layer_pack: Params, ids, scaling: float, cfg):
     Targets without adapters fall through to the plain matmul."""
 
     def proj(name: str, h, w):
-        y = h @ w.astype(cfg.dtype)
+        y = qdot(h, w, cfg.dtype)
         ab = layer_pack.get(name)
         if ab is None:
             return y

@@ -39,6 +39,13 @@ class CheckpointConfig:
     # Async saves overlap the device→disk copy with the next train steps;
     # close()/wait() must run before the process exits.
     enable_async: bool = True
+    # A trained tokenizer to carry WITH the checkpoint (e.g. the
+    # tools/prepare_data.py output's tokenizer.json): copied once to
+    # <directory>/tokenizer.json on the first save, which is exactly
+    # where the serving CLI's `--tokenizer auto` looks — without this
+    # the prepare -> train -> serve loop drops its tokenizer at the
+    # last hop and text mode silently degrades to bytes.
+    tokenizer_path: str = ""
 
 
 class Checkpointer:
@@ -77,7 +84,7 @@ class Checkpointer:
         the EXACT batch stream instead of restarting the epoch (the
         loaders' start_ticket kwarg is the other half)."""
         step = int(jax.device_get(state.step))
-        return self._mgr.save(
+        saved = self._mgr.save(
             step,
             args=ocp.args.Composite(**{
                 STATE_ITEM: ocp.args.StandardSave(_to_tree(state)),
@@ -86,6 +93,17 @@ class Checkpointer:
             }),
             force=force,
         )
+        if saved and self.config.tokenizer_path:
+            self._carry_tokenizer()
+        return saved
+
+    def _carry_tokenizer(self) -> None:
+        """Copy the configured tokenizer to <dir>/tokenizer.json once
+        (epath: the checkpoint dir can be gs://)."""
+        dst = epath.Path(self.config.directory) / "tokenizer.json"
+        if not dst.exists():
+            dst.write_text(
+                epath.Path(self.config.tokenizer_path).read_text())
 
     def maybe_save(self, state: TrainState, *,
                    data_state: Mapping[str, Any] | None = None) -> bool:

@@ -40,6 +40,7 @@ PLURALS = {
     "trials": "Trial",
     "pods": "Pod",
     "statefulsets": "StatefulSet",
+    "deployments": "Deployment",
     "services": "Service",
     "events": "Event",
     "persistentvolumeclaims": "PersistentVolumeClaim",
@@ -49,7 +50,8 @@ PLURALS = {
 # kinds — without this gate any namespace editor could delete a live
 # gang pod or a workspace PVC out from under its controller.
 READONLY_KINDS = frozenset(
-    {"Pod", "StatefulSet", "Service", "Event", "PersistentVolumeClaim"})
+    {"Pod", "StatefulSet", "Deployment", "Service", "Event",
+     "PersistentVolumeClaim"})
 
 
 def _require_mutable(kind: str) -> None:

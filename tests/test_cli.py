@@ -129,12 +129,25 @@ def test_train_checkpoint_serve_full_loop(tmp_path):
     toks = jnp.asarray(np.random.default_rng(0).integers(
         0, cfg.vocab_size, (4, 16)), jnp.int32)
     state, _ = trainer.step(state, toks, jnp.roll(toks, -1, axis=1))
+    # A trained BPE rides with the checkpoint (CheckpointConfig
+    # .tokenizer_path -> <ckpt>/tokenizer.json), which `--tokenizer
+    # auto` below picks up — the prepare -> train -> serve loop's
+    # tokenizer hop, end to end.
+    from kubeflow_tpu.data import bpe
+
+    tok = bpe.train(["the quick brown fox jumps over the lazy dog"] * 4,
+                    vocab_size=280)
+    tok_src = str(tmp_path / "tokenizer.json")
+    tok.save(tok_src)
+
     ckpt_dir = str(tmp_path / "ckpt")
     ckpt = Checkpointer(
         CheckpointConfig(ckpt_dir, save_interval_steps=1,
-                         enable_async=False), trainer)
+                         enable_async=False, tokenizer_path=tok_src),
+        trainer)
     assert ckpt.save(state, force=True)
     ckpt.close()
+    assert (tmp_path / "ckpt" / "tokenizer.json").exists()
 
     want_engine = InferenceEngine(
         jax.device_get(state.params), cfg, LLAMA_FAMILY,
@@ -151,7 +164,8 @@ def test_train_checkpoint_serve_full_loop(tmp_path):
     proc = subprocess.Popen(
         [sys.executable, "-m", "kubeflow_tpu.serving",
          "--model", "llama-tiny", "--checkpoint", ckpt_dir,
-         "--cpu", "--port", str(port), "--max-len", "32"],
+         "--cpu", "--port", str(port), "--max-len", "32",
+         "--tokenizer", "auto"],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
     try:
         base = f"http://127.0.0.1:{port}"
@@ -176,6 +190,23 @@ def test_train_checkpoint_serve_full_loop(tmp_path):
         with urllib.request.urlopen(r, timeout=120) as resp:
             got = _json.loads(resp.read())["tokens"][0]
         assert got == want  # the CHECKPOINTED weights are serving
+
+        # text mode must speak the TRAINED tokenizer (not bytes):
+        # the expected generation is computed through OUR copy of the
+        # tokenizer, and the response text must decode the same way.
+        text_prompt = "the quick brown fox"
+        ids = tok.encode(text_prompt, bos=True)
+        twant = np.asarray(want_engine.generate(
+            jnp.asarray([ids], jnp.int32), max_new=4))[0].tolist()
+        r2 = urllib.request.Request(
+            f"{base}/v1/models/llama-tiny:generate",
+            data=_json.dumps({"text": text_prompt,
+                              "max_new": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(r2, timeout=120) as resp:
+            body = _json.loads(resp.read())
+        assert body["tokens"][0] == twant
+        assert body["text"] == tok.decode(twant)
     finally:
         proc.terminate()
         proc.wait(timeout=10)

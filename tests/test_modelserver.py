@@ -59,6 +59,30 @@ def test_pvc_checkpoint_and_quant(cluster):
     assert c.volume_mounts[0].sub_path == "run7"
 
 
+def test_tokenizer_flag_rendering(cluster):
+    """VERDICT r04 weak #6: checkpointed servers get --tokenizer auto
+    by default (the Checkpointer carries tokenizer.json beside the
+    checkpoint); random-init servers get NO tokenizer flag (auto is a
+    no-op without a checkpoint, and old serving images lack the
+    mode); "none" opts a checkpointed server back into byte mode."""
+    cluster.store.create(mk_ms(
+        "srv-tok", checkpoint="pvc://train-out/run7"))
+    cluster.store.create(mk_ms("srv-plain"))
+    cluster.store.create(mk_ms(
+        "srv-bytes", checkpoint="pvc://train-out/run8",
+        tokenizer="none"))
+    assert cluster.wait_idle()
+    c = cluster.store.get(
+        "Deployment", "user1",
+        "srv-tok").spec.template.spec.containers[0]
+    i = c.args.index("--tokenizer")
+    assert c.args[i + 1] == "auto"
+    for name in ("srv-plain", "srv-bytes"):
+        c = cluster.store.get(
+            "Deployment", "user1", name).spec.template.spec.containers[0]
+        assert "--tokenizer" not in c.args, (name, c.args)
+
+
 def test_gcs_checkpoint(cluster):
     cluster.store.create(mk_ms(
         "srv3", checkpoint="gs://bucket/run9"))

@@ -14,6 +14,7 @@ b16-full, b16-mlp. Prints one line per variant and a summary dict.
 
 from __future__ import annotations
 
+import argparse
 import dataclasses
 import os
 import sys
@@ -32,34 +33,62 @@ VARIANTS = [
     ("b16-mlp", 16, "mlp"),
 ]
 
+# --allow-cpu grid: the SAME harness end-to-end (variant loop, failure
+# capture, RESULTS/BEST table) on shapes a CPU can finish — this is how
+# the sweep's plumbing + output format stay validated between healthy
+# TPU windows (VERDICT r04 task 8), so the watchdog can run the real
+# grid unattended the moment the chip answers.
+CPU_VARIANTS = [
+    ("b2-full", 2, "full"),
+    ("b2-mlp", 2, "mlp"),
+    ("b2-dots", 2, "dots"),
+]
+
 
 def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("variants", nargs="?", default="",
+                   help="comma-separated subset of the variant grid")
+    p.add_argument("--allow-cpu", action="store_true",
+                   help="run the tiny-model CPU grid (harness "
+                        "validation, not a perf measurement)")
+    args = p.parse_args()
+
     # Same backend armor as bench.py (round-3 lesson): never touch a
     # possibly-wedged backend in-process. The sweep is only meaningful
     # on TPU — refuse early with a clear rc instead of hanging.
     backend = bench.resolve_backend()
-    if backend != "tpu":
+    if backend != "tpu" and not args.allow_cpu:
         print(f"remat_sweep needs a TPU backend (probe: {backend}); "
               "not running — see docs/perf-notes.md for the expected "
-              "outcome model", file=sys.stderr)
+              "outcome model (pass --allow-cpu for a harness check)",
+              file=sys.stderr)
         return 3
 
-    base = bench.bench_configs()["bench-500m"]
-    variants = VARIANTS
-    if len(sys.argv) > 1:
-        wanted = sys.argv[1].split(",")
-        known = {v[0] for v in VARIANTS}
+    on_tpu = backend == "tpu"
+    if not on_tpu:
+        import jax
+        # pin BEFORE any backend touch (sitecustomize may pin the TPU
+        # plugin through jax.config; tests/conftest.py pattern)
+        jax.config.update("jax_platforms", "cpu")
+    model = "bench-500m" if on_tpu else "tiny"
+    base = bench.bench_configs()[model]
+    variants = VARIANTS if on_tpu else CPU_VARIANTS
+    seq, steps, warmup = (2048, 10, 2) if on_tpu else (128, 3, 1)
+    if args.variants:
+        wanted = args.variants.split(",")
+        known = {v[0] for v in variants}
         unknown = [w for w in wanted if w not in known]
         if unknown:
             print(f"unknown variants {unknown}; known: {sorted(known)}",
                   file=sys.stderr)
             return 2
-        variants = [v for v in VARIANTS if v[0] in wanted]
+        variants = [v for v in variants if v[0] in wanted]
     results = {}
     for name, batch, policy in variants:
         cfg = dataclasses.replace(base, remat_policy=policy)
-        preset = Preset(name, batch=batch, seq=2048, steps=10, warmup=2,
-                        model="bench-500m")
+        preset = Preset(name, batch=batch, seq=seq, steps=steps,
+                        warmup=warmup, model=model)
         try:
             m = bench.bench_train(preset, config=cfg)
             results[name] = m["value"]
