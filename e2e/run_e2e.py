@@ -443,6 +443,14 @@ def metrics_surface(alice: Client, admin: Client) -> None:
     status, text = alice.req("GET", "/metrics")
     assert status == 200 and isinstance(text, str), status
     assert "kubeflow_tpu" in text or "notebook" in text, text[:200]
+    # windowed dashboard series (ref metrics_service.ts interval enum):
+    # the live point reflects the running e2e notebook gang
+    status, m = alice.req("GET", "/api/metrics/tpu?window=15")
+    assert status == 200, (status, m)
+    assert m["window"] == 15 and m["points"], m
+    assert m["points"][-1]["tpuHostsInUse"] >= 1, m["points"][-1]
+    status, _ = alice.req("GET", "/api/metrics/tpu?window=42")
+    assert status == 400, status
 
 
 @phase("notebook-deletion")

@@ -184,3 +184,111 @@ class ControlPlaneMetrics:
     def record_request(self, service: str, method: str, code: int) -> None:
         self.request_total.inc(service=service, method=method,
                                code=str(code))
+
+
+def scan_usage(store: Store) -> tuple[list[tuple[str, str]],
+                                      dict[str, int]]:
+    """One store walk shared by the dashboard summary and the history
+    sampler (a drifted copy of the 'TPU host in use' filter would
+    silently desynchronize the summary tiles from the chart's live
+    point): [(namespace, topology)] per running TPU-host pod, plus
+    notebooks per namespace."""
+    from kubeflow_tpu.controlplane import webhook as wh
+
+    pods: list[tuple[str, str]] = []
+    nbs: dict[str, int] = {}
+    for pod in store.list("Pod"):
+        topo = pod.metadata.labels.get(wh.TOPOLOGY_LABEL)
+        if topo and pod.phase == "Running":
+            pods.append((pod.metadata.namespace, topo))
+    for nb in store.list("Notebook"):
+        ns = nb.metadata.namespace
+        nbs[ns] = nbs.get(ns, 0) + 1
+    return pods, nbs
+
+
+class MetricsHistory:
+    """Ring-buffered cluster-usage time series for the dashboard charts.
+
+    The reference's dashboard serves cluster resource charts over
+    5/15/30/60/180-minute windows from Stackdriver
+    (ref centraldashboard/app/metrics_service.ts:2-8, routes
+    api.ts:29-102, impl stackdriver_metrics_service.ts:15-60). The
+    TPU-native platform has no cloud monitoring dependency, so the
+    history lives here: periodic samples of per-namespace TPU-host and
+    notebook counts scanned from the store, kept per NAMESPACE so the
+    serving endpoint can apply the same visibility scoping as the
+    point-in-time summary (cluster-wide series would leak cross-tenant
+    occupancy to non-admins).
+    """
+
+    WINDOWS_MIN = (5, 15, 30, 60, 180)
+
+    def __init__(self, store: Store, *, cadence_s: float = 30.0,
+                 clock: Callable[[], float] | None = None):
+        import collections
+        import time as _time
+
+        self.store = store
+        self.cadence_s = cadence_s
+        self._clock = clock or _time.time
+        # retention = the longest window + one slack sample
+        self._samples: collections.deque = collections.deque(
+            maxlen=int(self.WINDOWS_MIN[-1] * 60 / cadence_s) + 2)
+        self._lock = threading.Lock()
+
+    def _scan(self) -> tuple[dict[str, int], dict[str, int]]:
+        pods, nbs = scan_usage(self.store)
+        tpu: dict[str, int] = {}
+        for ns, _topo in pods:
+            tpu[ns] = tpu.get(ns, 0) + 1
+        return tpu, nbs
+
+    def sample(self) -> None:
+        """Scan the store once and append a ring point. Calls within
+        half a cadence collapse to one sample — the ring fills at
+        CADENCE rate only, so its retention math holds no matter how
+        hard clients poll (request-time freshness is series(live=True),
+        which never stores)."""
+        now = self._clock()
+        with self._lock:
+            if self._samples and \
+                    now - self._samples[-1][0] < self.cadence_s / 2:
+                return
+            tpu, nbs = self._scan()
+            self._samples.append((now, tpu, nbs))
+
+    def series(self, window_min: int,
+               visible: set[str] | None = None,
+               live: "bool | tuple" = False) -> list[dict]:
+        """Points within the window, each summed over `visible`
+        namespaces (None = cluster-wide, the admin view). `live`
+        appends a now-point WITHOUT storing it, so a chart always ends
+        at the present even between cadence ticks — True scans here; a
+        (tpu_by_ns, notebooks_by_ns) tuple reuses a scan the caller
+        already paid for (the dashboard handler's summary walk)."""
+        if window_min not in self.WINDOWS_MIN:
+            raise ValueError(
+                f"window must be one of {self.WINDOWS_MIN} minutes")
+        now = self._clock()
+        cutoff = now - window_min * 60
+
+        def pt(t, tpu, nbs):
+            return {
+                "t": round(t, 3),
+                "tpuHostsInUse": sum(
+                    n for ns, n in tpu.items()
+                    if visible is None or ns in visible),
+                "notebooks": sum(
+                    n for ns, n in nbs.items()
+                    if visible is None or ns in visible),
+            }
+
+        with self._lock:
+            pts = [pt(t, tpu, nbs)
+                   for t, tpu, nbs in self._samples if t >= cutoff]
+            if live is True:
+                pts.append(pt(now, *self._scan()))
+            elif live:
+                pts.append(pt(now, *live))
+        return pts

@@ -211,18 +211,38 @@ def create_hybrid_mesh(
     per_slice = len(devices) // num_slices
 
     by_slice: dict[int, list[jax.Device]] = {}
+    groups: list[list[jax.Device]] | None = None
     if all(getattr(d, "slice_index", None) is not None for d in devices):
         for d in devices:
             by_slice.setdefault(d.slice_index, []).append(d)
-        if len(by_slice) != num_slices or any(
-            len(g) != per_slice for g in by_slice.values()
+        if len(by_slice) == num_slices and all(
+            len(g) == per_slice for g in by_slice.values()
         ):
+            groups = [by_slice[k] for k in sorted(by_slice)]
+        elif getattr(devices[0], "platform",
+                     jax.default_backend()) == "tpu":
+            # Real hardware disagreeing with the control plane must
+            # fail here, not build a mesh whose "cross-slice" axis
+            # doesn't actually cross slices.
             raise ValueError(
-                f"device slice_index grouping {sorted((k, len(v)) for k, v in by_slice.items())} "
+                f"device slice_index grouping "
+                f"{sorted((k, len(v)) for k, v in by_slice.items())} "
                 f"does not match num_slices={num_slices} x {per_slice}"
             )
-        groups = [by_slice[k] for k in sorted(by_slice)]
-    else:
+        else:
+            # Virtual CPU devices carry slice_index=0 across ALL
+            # processes (observed in the 4-process hybrid gang test) —
+            # the attribute exists but is meaningless off-TPU, so fall
+            # through to contiguous chunks, which matches both
+            # xla_force_host_platform_device_count layout and
+            # process-ordinal ordering in multi-process groups.
+            logging.getLogger(__name__).warning(
+                "ignoring non-TPU slice_index grouping %s; using "
+                "contiguous %d-device chunks",
+                sorted((k, len(v)) for k, v in by_slice.items()),
+                per_slice,
+            )
+    if groups is None:
         groups = [
             list(devices[i * per_slice:(i + 1) * per_slice])
             for i in range(num_slices)

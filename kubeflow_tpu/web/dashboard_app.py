@@ -12,10 +12,13 @@ api_workgroup.ts:255-391), re-done over the in-process store + Kfam:
 
 from __future__ import annotations
 
+import asyncio
+
 from aiohttp import web
 
 from kubeflow_tpu.controlplane import auth
 from kubeflow_tpu.controlplane.kfam import Kfam
+from kubeflow_tpu.controlplane.metrics import MetricsHistory
 from kubeflow_tpu.controlplane.store import Store
 from kubeflow_tpu.web.common import (
     CLUSTER_ADMINS_KEY,
@@ -23,8 +26,11 @@ from kubeflow_tpu.web.common import (
     LINKS_KEY,
     STORE_KEY,
     base_app,
+    json_error,
     json_success,
 )
+
+HISTORY_KEY: web.AppKey = web.AppKey("metrics_history", MetricsHistory)
 
 DEFAULT_LINKS = {
     "menuLinks": [
@@ -43,10 +49,40 @@ DEFAULT_LINKS = {
 
 def create_dashboard_app(store: Store, *, cluster_admins: set[str] | None = None,
                          links: dict | None = None,
-                         csrf: bool = True) -> web.Application:
+                         csrf: bool = True,
+                         history_cadence_s: float = 30.0) -> web.Application:
     app = base_app(store, csrf=csrf, cluster_admins=cluster_admins)
     app[KFAM_KEY] = Kfam(store, cluster_admins)
     app[LINKS_KEY] = links or DEFAULT_LINKS
+    app[HISTORY_KEY] = MetricsHistory(store, cadence_s=history_cadence_s)
+
+    # Background sampler: the windowed charts need history even when
+    # nobody is polling (the reference gets this for free from
+    # Stackdriver's own collection). Request-time top-up sampling in
+    # metrics() covers the serve path; this covers the quiet hours.
+    async def _sampler(app_: web.Application):
+        import logging
+
+        async def loop_():
+            while True:
+                try:
+                    app_[HISTORY_KEY].sample()
+                except Exception:  # noqa: BLE001 — sampling must not die
+                    # ...but a chart silently flatlining with no trail
+                    # is its own failure mode: leave a diagnostic.
+                    logging.getLogger(__name__).warning(
+                        "metrics history sample failed", exc_info=True)
+                await asyncio.sleep(app_[HISTORY_KEY].cadence_s)
+
+        task = asyncio.create_task(loop_())
+        yield
+        task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            pass
+
+    app.cleanup_ctx.append(_sampler)
 
     app.router.add_get("/api/workgroup/env-info", env_info)
     app.router.add_get("/api/workgroup/exists", workgroup_exists)
@@ -134,27 +170,48 @@ async def metrics(request: web.Request):
     the one cross-tenant leak)."""
     store: Store = request.app[STORE_KEY]
     user: auth.User = request["user"]
-    from kubeflow_tpu.controlplane import webhook as wh
-
     admins = request.app[CLUSTER_ADMINS_KEY]
     if auth.is_cluster_admin(store, user, admins):
         visible = None  # all namespaces
     else:
         visible = set(auth.namespaces_for(store, user, admins))
 
+    # ONE store walk feeds both the summary tiles and (as the series'
+    # live point) the chart — metrics.scan_usage is the single
+    # definition of "TPU host in use".
+    from kubeflow_tpu.controlplane.metrics import scan_usage
+
+    pods, nbs_by_ns = scan_usage(store)
     by_topo: dict[str, int] = {}
-    notebooks = 0
-    for pod in store.list("Pod"):
-        if visible is not None and pod.metadata.namespace not in visible:
-            continue
-        topo = pod.metadata.labels.get(wh.TOPOLOGY_LABEL)
-        if topo and pod.phase == "Running":
+    tpu_by_ns: dict[str, int] = {}
+    for ns, topo in pods:
+        tpu_by_ns[ns] = tpu_by_ns.get(ns, 0) + 1
+        if visible is None or ns in visible:
             by_topo[topo] = by_topo.get(topo, 0) + 1
-    for nb in store.list("Notebook"):
-        if visible is None or nb.metadata.namespace in visible:
-            notebooks += 1
-    return json_success({
+    notebooks = sum(n for ns, n in nbs_by_ns.items()
+                    if visible is None or ns in visible)
+    body = {
         "type": request.match_info["type"],
         "tpuHostsInUse": by_topo,
         "notebooks": notebooks,
-    })
+    }
+
+    # ?window=<minutes> adds the time series the SPA charts (ref
+    # metrics_service.ts:2-8 interval enum; same 5/15/30/60/180 set).
+    window = request.rel_url.query.get("window")
+    if window is not None:
+        history = request.app[HISTORY_KEY]
+        try:
+            minutes = int(window)
+            # the live now-point reuses the scan above (never stored —
+            # polling cannot evict ring history)
+            points = history.series(minutes, visible,
+                                    live=(tpu_by_ns, nbs_by_ns))
+        except ValueError:
+            return json_error(
+                f"window must be one of "
+                f"{list(MetricsHistory.WINDOWS_MIN)} (minutes), "
+                f"got {window!r}", 400)
+        body["window"] = minutes
+        body["points"] = points
+    return json_success(body)

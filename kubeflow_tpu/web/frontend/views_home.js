@@ -3,12 +3,45 @@
 // /api/activities/{ns}.
 
 import { api, routes } from '/static/api.js';
-import { h, ago } from '/static/app.js';
+import { h, ago, render } from '/static/app.js';
+
+// Windowed usage chart (ref centraldashboard resource charts,
+// metrics_service.ts:2-8): inline SVG — TPU hosts solid, notebooks
+// dashed — over the selected 5/15/30/60/180-minute window.
+export const METRIC_WINDOWS = [5, 15, 30, 60, 180];
+
+function usageChart(points, windowMin) {
+  const W = 640;
+  const H = 140;
+  const PAD = 26;
+  const wrap = h('div', { class: 'chart', 'data-window': windowMin });
+  if (!points || points.length < 2) {
+    wrap.append(h('div', { class: 'empty' }, 'Collecting usage history…'));
+    return wrap;
+  }
+  const t0 = points[0].t;
+  const t1 = points[points.length - 1].t;
+  const maxY = Math.max(1, ...points.map((p) => Math.max(p.tpuHostsInUse, p.notebooks)));
+  const x = (t) => PAD + ((W - 2 * PAD) * (t - t0)) / Math.max(t1 - t0, 1);
+  const y = (v) => H - PAD - ((H - 2 * PAD) * v) / maxY;
+  const line = (key) =>
+    points.map((p, i) => `${i ? 'L' : 'M'}${x(p.t).toFixed(1)},${y(p[key]).toFixed(1)}`).join(' ');
+  wrap.innerHTML = `<svg viewBox="0 0 ${W} ${H}" role="img" aria-label="TPU usage over the last ${windowMin} minutes">
+    <line x1="${PAD}" y1="${H - PAD}" x2="${W - PAD}" y2="${H - PAD}" class="axis"/>
+    <line x1="${PAD}" y1="${PAD}" x2="${PAD}" y2="${H - PAD}" class="axis"/>
+    <text x="${PAD - 4}" y="${PAD + 4}" text-anchor="end" class="tick">${maxY}</text>
+    <text x="${PAD - 4}" y="${H - PAD}" text-anchor="end" class="tick">0</text>
+    <path class="line tpu" d="${line('tpuHostsInUse')}" fill="none"/>
+    <path class="line nbs" d="${line('notebooks')}" fill="none" stroke-dasharray="4 3"/>
+  </svg>`;
+  return wrap;
+}
 
 export async function homeView({ state }) {
   const ns = state.namespace;
+  const windowMin = METRIC_WINDOWS.includes(state.metricsWindow) ? state.metricsWindow : 60;
   const [metrics, links, activities] = await Promise.all([
-    api.get(routes.metrics('summary')),
+    api.get(`${routes.metrics('summary')}?window=${windowMin}`),
     api.get(routes.dashboardLinks),
     ns ? api.get(routes.activities(ns)) : Promise.resolve({ activities: [] }),
   ]);
@@ -37,6 +70,31 @@ export async function homeView({ state }) {
       h('div', { class: 'tile' }, h('div', { class: 'n' }, metrics.notebooks ?? 0), h('div', { class: 't' }, 'notebooks')),
       h('div', { class: 'tile' }, h('div', { class: 'n' }, state.namespaces.length), h('div', { class: 't' }, 'namespaces you can access')),
       tpuTiles.length ? tpuTiles : h('div', { class: 'tile' }, h('div', { class: 'n' }, 0), h('div', { class: 't' }, 'TPU hosts in use')),
+    ),
+    h(
+      'div',
+      { class: 'card' },
+      h('h3', {}, 'Usage history'),
+      h(
+        'div',
+        { class: 'window-picker' },
+        METRIC_WINDOWS.map((m) =>
+          h(
+            'button',
+            {
+              class: `win-btn${m === windowMin ? ' active' : ''}`,
+              'data-minutes': m,
+              onclick: () => {
+                state.metricsWindow = m;
+                render();
+              },
+            },
+            m < 60 ? `${m}m` : `${m / 60}h`,
+          ),
+        ),
+      ),
+      usageChart(metrics.points, windowMin),
+      h('div', { class: 'legend' }, '— TPU hosts   ┄ notebooks'),
     ),
     h(
       'div',

@@ -160,3 +160,50 @@ assert.ok(document.getElementById('outlet').textContent
 
 console.log('frontend dom test OK '
   + `(${calls.length} fetches, ${rows.length} row rendered, detail view driven)`);
+
+// -- home view: windowed usage chart (ref centraldashboard resource
+// charts) — SVG renders from /api/metrics?window=, picker refetches --
+const now = Date.now() / 1000;
+const mkPoints = (n) => Array.from({ length: n }, (_, i) => ({
+  t: now - (n - 1 - i) * 30,
+  tpuHostsInUse: i % 3 === 0 ? 4 : 8,
+  notebooks: 2,
+}));
+fixtures['GET /api/metrics/summary?window=60'] = {
+  type: 'summary', tpuHostsInUse: { 'v5e-16': 8 }, notebooks: 2,
+  window: 60, points: mkPoints(12),
+};
+fixtures['GET /api/metrics/summary?window=180'] = {
+  type: 'summary', tpuHostsInUse: { 'v5e-16': 8 }, notebooks: 2,
+  window: 180, points: mkPoints(30),
+};
+fixtures['GET /api/dashboard-links'] = {
+  links: { quickLinks: [{ desc: 'New notebook', link: '/jupyter/new' }] },
+};
+fixtures[`GET /api/activities/${NS}`] = { activities: [] };
+
+dom.window.location.hash = '#/';
+await app.render();
+for (let i = 0; i < 20; i += 1) await settle();
+
+const chart = document.querySelector('#outlet .chart');
+assert.ok(chart, 'home view renders the usage chart');
+assert.equal(chart.getAttribute('data-window'), '60', 'default window 60m');
+const tpuPath = chart.querySelector('svg path.line.tpu');
+assert.ok(tpuPath, 'chart has the TPU-hosts series');
+assert.ok(tpuPath.getAttribute('d').startsWith('M'), 'series has a path');
+assert.ok(chart.querySelector('svg path.line.nbs'), 'notebooks series');
+const winBtns = [...document.querySelectorAll('#outlet .win-btn')];
+assert.deepEqual(winBtns.map((b) => b.textContent),
+  ['5m', '15m', '30m', '60m', '3h'], 'the reference window enum');
+assert.ok(winBtns[3].classList.contains('active'), '60m marked active');
+
+winBtns[4].click(); // 3h
+for (let i = 0; i < 20; i += 1) await settle();
+assert.ok(calls.some((c) => c.url === '/api/metrics/summary?window=180'),
+  'picker refetches the 180-minute window');
+assert.equal(
+  document.querySelector('#outlet .chart').getAttribute('data-window'),
+  '180');
+
+console.log('usage-chart dom assertions OK');

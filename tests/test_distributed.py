@@ -122,3 +122,119 @@ def test_multislice_requires_global_process_id(monkeypatch):
     monkeypatch.delenv("KFTPU_PROCESS_ID", raising=False)
     with pytest.raises(ValueError, match="KFTPU_PROCESS_ID"):
         distributed.initialize_from_env()
+
+
+HYBRID_CHILD = r"""
+import os
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from kubeflow_tpu import distributed
+from kubeflow_tpu.parallel import mesh_from_env
+
+assert distributed.initialize_from_env(timeout_secs=180)
+assert jax.process_count() == 4, jax.process_count()
+devs = jax.devices()
+assert len(devs) == 8, devs  # 2 slices x 2 processes x 2 devices
+
+# mesh_from_env reads the SAME env the webhook injects and must build
+# the hybrid dcn x ici mesh: dcn spans the slices, KFTPU_MESH lays out
+# one slice.
+mesh = mesh_from_env()
+assert mesh.axis_names == ("dcn", "data", "fsdp", "tensor"), mesh
+assert dict(mesh.shape) == {"dcn": 2, "data": 1, "fsdp": 2,
+                            "tensor": 2}, dict(mesh.shape)
+
+# Cross-slice reduction over the dcn axis: row s carries (s+1); the
+# sum must cross DCN (here: gRPC between the slice process groups).
+gl = np.asarray([1.0, 2.0], np.float32)
+arr = jax.make_array_from_callback(
+    (2,), NamedSharding(mesh, P("dcn")), lambda idx: gl[idx])
+total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+assert float(total) == 3.0, float(total)
+
+# One REAL Trainer step over the hybrid mesh, inputs built shard-wise
+# from a deterministic global batch (every process can materialize any
+# addressable shard).
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.train import Trainer, TrainConfig
+
+cfg = llama.LLAMA_TINY
+trainer = Trainer(
+    mesh=mesh,
+    apply_fn=lambda p, t: llama.apply(p, cfg, t),
+    init_fn=lambda k: llama.init(k, cfg),
+    logical_axes=llama.param_logical_axes(cfg),
+    train_config=TrainConfig(warmup_steps=1, total_steps=10),
+)
+state = trainer.init(jax.random.key(0))
+gtoks = np.random.default_rng(0).integers(
+    0, cfg.vocab_size, (8, 16)).astype(np.int32)
+gtarg = np.roll(gtoks, -1, axis=1)
+toks = jax.make_array_from_callback(
+    gtoks.shape, trainer.batch_sharding, lambda idx: gtoks[idx])
+targ = jax.make_array_from_callback(
+    gtarg.shape, trainer.batch_sharding, lambda idx: gtarg[idx])
+state, loss = trainer.step(state, toks, targ)
+loss = float(loss)
+assert np.isfinite(loss), loss
+print("HYBRID-OK", jax.process_index(), round(loss, 4), flush=True)
+"""
+
+
+def _hybrid_env(slice_id: int, worker_id: int, port: int) -> dict[str, str]:
+    """Exactly the multi-slice env _inject_tpu_env sets for a
+    2-slice x 2-host gang (webhook.py:230-238), DNS -> loopback."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "KFTPU_NUM_PROCESSES": "4",
+        "TPU_WORKER_ID": str(worker_id),          # per-slice (libtpu)
+        "KFTPU_PROCESS_ID": str(slice_id * 2 + worker_id),  # global
+        "KFTPU_NUM_SLICES": "2",
+        "MEGASCALE_NUM_SLICES": "2",
+        "MEGASCALE_SLICE_ID": str(slice_id),
+        "MEGASCALE_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "KFTPU_MESH": "data=1,fsdp=2,tensor=2",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO,
+    })
+    return env
+
+
+@pytest.mark.slow
+def test_two_slice_gang_forms_hybrid_mesh_and_trains():
+    """VERDICT r04 task 7: a 2-slice x 2-process gang wearing the FULL
+    webhook env (MEGASCALE_*, KFTPU_NUM_SLICES=2) forms the hybrid
+    dcn x ici mesh via mesh_from_env, proves a cross-slice reduction,
+    and runs one real Trainer step — the multi-PROCESS proof of what
+    the dryrun exercises single-process."""
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", HYBRID_CHILD],
+            env=_hybrid_env(s, w, port),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for s in range(2) for w in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    losses = set()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"gang member {i} failed:\n{out}"
+        assert f"HYBRID-OK {i}" in out, out
+        losses.add(out.strip().splitlines()[-1].split()[-1])
+    # every process observed the SAME loss — the reduction crossed DCN
+    assert len(losses) == 1, losses

@@ -118,3 +118,56 @@ async def test_metrics_route_and_request_counter(env):
     text = await r.text()
     assert "# TYPE request_total counter" in text
     assert 'service="api"' in text
+
+
+def test_metrics_history_ring_and_scoping():
+    """MetricsHistory: cadence-collapsed sampling, per-namespace
+    scoping, window cutoff, and bounded retention."""
+    from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
+    from kubeflow_tpu.controlplane.metrics import MetricsHistory
+
+    with Cluster(ClusterConfig(tpu_slices={"v5e-16": 2})) as c:
+        now = [1000.0]
+        hist = MetricsHistory(c.store, cadence_s=30.0,
+                              clock=lambda: now[0])
+        # burst of callers within half a cadence -> ONE sample
+        hist.sample()
+        hist.sample()
+        assert len(hist._samples) == 1
+
+        from kubeflow_tpu.api.core import Container, PodTemplateSpec
+        from kubeflow_tpu.api.crds import Notebook
+        nb = Notebook()
+        nb.metadata.name = "nb"
+        nb.metadata.namespace = "team-a"
+        nb.spec.template = PodTemplateSpec()
+        nb.spec.template.spec.containers.append(
+            Container(name="nb", image="kubeflow-tpu/jupyter-jax:latest"))
+        nb.spec.tpu.topology = "v5e-16"
+        c.store.create(nb)
+        assert c.wait_idle()
+        now[0] += 30
+        hist.sample()
+
+        pts = hist.series(5)
+        assert pts[-1]["notebooks"] == 1
+        assert pts[-1]["tpuHostsInUse"] == 4
+        assert pts[0]["notebooks"] == 0  # the pre-create sample
+
+        # scoping: a viewer of nothing sees zeros, not absence
+        pts_b = hist.series(5, visible=set())
+        assert pts_b[-1]["tpuHostsInUse"] == 0
+        pts_a = hist.series(5, visible={"team-a"})
+        assert pts_a[-1]["tpuHostsInUse"] == 4
+
+        # window cutoff: jump past 5 minutes, old points fall out
+        now[0] += 6 * 60
+        hist.sample()
+        assert len(hist.series(5)) == 1
+
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            hist.series(7)
+
+        # retention is bounded by the longest window
+        assert hist._samples.maxlen == int(180 * 60 / 30.0) + 2

@@ -427,3 +427,37 @@ async def test_modelserver_list_surfaces_config_warnings(env):
              if m["name"] == "badsrv"][0]
     assert not entry["ready"]
     assert "unknown model" in entry["warning"]
+
+
+async def test_metrics_windowed_series(env):
+    """?window= adds the reference's 5/15/30/60/180-min series
+    (centraldashboard metrics_service.ts) with the same namespace
+    scoping as the summary; bad windows are a clean 400."""
+    cluster, client = env
+    await _mk_profile(client, cluster)
+    r = await client.post(
+        "/jupyter/api/namespaces/alice/notebooks",
+        json={"name": "t", "tpu": {"topology": "v5e-16"}}, headers=ALICE)
+    assert r.status == 201, await r.text()
+    assert cluster.wait_idle()
+
+    r = await client.get("/api/metrics/tpu?window=15", headers=ALICE)
+    assert r.status == 200
+    m = await r.json()
+    assert m["window"] == 15
+    assert m["points"], "request-time top-up sample must add a point"
+    last = m["points"][-1]
+    assert last["tpuHostsInUse"] == 4  # the v5e-16 gang's 4 host pods
+    assert last["notebooks"] == 1
+
+    # visibility scoping holds for the series too
+    r = await client.get("/api/metrics/tpu?window=15", headers=BOB)
+    m = await r.json()
+    assert all(p["tpuHostsInUse"] == 0 and p["notebooks"] == 0
+               for p in m["points"])
+
+    r = await client.get("/api/metrics/tpu?window=7", headers=ALICE)
+    assert r.status == 400
+    assert "5, 15, 30, 60, 180" in (await r.json())["log"]
+    r = await client.get("/api/metrics/tpu?window=abc", headers=ALICE)
+    assert r.status == 400
