@@ -392,8 +392,12 @@ def bench_decode(model: str, *, batch: int, prompt_len: int,
     # is pure decode-scan time for max_new - 1 tokens. Timing one full
     # generate would attribute the prompt's prefill FLOPs to "decode"
     # and understate tokens/s as prompts grow.
+    from kubeflow_tpu.ops import attention
+
+    attention.reset_impl_counts()
     for mn in (1, max_new):  # compile + warmup both entry points
         np.asarray(eng.generate(prompt, max_new=mn))
+    attn_counts = attention.impl_counts()
 
     def best_of(mn: int, reps: int = 3) -> float:
         # min-of-reps is the standard noise filter for microbenchmarks;
@@ -441,7 +445,8 @@ def bench_decode(model: str, *, batch: int, prompt_len: int,
     if verbose:
         print(
             f"# decode model={model} batch={batch} prompt={prompt_len} "
-            f"max_new={max_new} tok/s={tok_per_sec:.1f} mbu={mbu:.3f}",
+            f"max_new={max_new} tok/s={tok_per_sec:.1f} mbu={mbu:.3f} "
+            f"attn_impl={attn_counts}",
             file=sys.stderr,
         )
     return {

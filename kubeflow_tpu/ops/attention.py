@@ -22,11 +22,12 @@ NEG_INF = -2.0**30  # large-but-finite: avoids NaNs from (-inf) - (-inf)
 # trace time, so these count how many traced call sites took each impl —
 # which is how bench.py *proves* the long-seq preset routed through the
 # Pallas flash kernel instead of silently falling back to XLA.
-_impl_counts = {"flash": 0, "xla": 0}
+_impl_counts = {"flash": 0, "xla": 0, "decode": 0}
 
 
 def reset_impl_counts() -> None:
-    _impl_counts["flash"] = _impl_counts["xla"] = 0
+    for key in _impl_counts:
+        _impl_counts[key] = 0
 
 
 def impl_counts() -> dict[str, int]:
@@ -80,6 +81,14 @@ def _flash_kernel_available() -> bool:
         return False
 
 
+def _decode_kernel_available() -> bool:
+    try:
+        from kubeflow_tpu.ops.pallas import decode_attention  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
 def dot_product_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -112,14 +121,41 @@ def dot_product_attention(
         on_tpu = jax.default_backend() == "tpu"
         long_seq = q.shape[1] >= 1024 and q.shape[1] % 512 == 0
         same_len = q.shape[1] == k.shape[1]
-        impl = (
-            "flash"
-            if (on_tpu and long_seq and same_len and causal
+        # One query token against a longer cache = the serving decode
+        # step. The fused kernel skips cache blocks past each row's
+        # cursor (HBM traffic tracks fill, not max_len) — worthwhile
+        # once the cache is big enough to block (>= 256 cells). It
+        # masks by CACHE CELL INDEX, so like flash it needs the
+        # caller's declaration that positions are cell indices
+        # (`contiguous_positions=True`) — a packed/rotated cache whose
+        # cell index != token position MUST take the XLA path, which
+        # compares the actual position tensors.
+        decode_step = (q.shape[1] == 1 and k.shape[1] >= 256
+                       and causal and contiguous_positions)
+        if (on_tpu and long_seq and same_len and causal
                 and kv_mask is None and contiguous_positions
-                and _flash_kernel_available())
-            else "xla"
-        )
+                and _flash_kernel_available()):
+            impl = "flash"
+        elif on_tpu and decode_step and _decode_kernel_available():
+            impl = "decode"
+        else:
+            impl = "xla"
     _impl_counts[impl] = _impl_counts.get(impl, 0) + 1
+    if impl == "decode":
+        if q.shape[1] != 1:
+            raise ValueError("impl='decode' is for single-token steps")
+        if not causal:
+            # the kernel masks idx <= cursor unconditionally; a
+            # bidirectional single-query lookup would silently lose
+            # the cells past the cursor (same discipline as the
+            # flash door's unsupported-combo raises)
+            raise ValueError("impl='decode' is causal-only")
+        from kubeflow_tpu.ops.pallas.decode_attention import (
+            decode_attention,
+        )
+
+        return decode_attention(
+            q, k, v, q_positions[:, 0], kv_mask, window=window)
     if impl == "flash":
         if kv_mask is not None or not contiguous_positions:
             raise ValueError(

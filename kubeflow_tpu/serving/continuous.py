@@ -347,10 +347,14 @@ class ContinuousEngine:
                             v2, li, 0, keepdims=False))
 
             def attn(q, kc, vc):
+                # cell index == token position here too (see
+                # engine._forward_cached) — enables the fused decode
+                # kernel on TPU
                 return dot_product_attention(
                     q, kc, vc, positions, kv_positions,
                     causal=True, kv_mask=kv_valid,
-                    window=getattr(cfg, "sliding_window", None))
+                    window=getattr(cfg, "sliding_window", None),
+                    contiguous_positions=True)
 
             x, _ = transformer_block(
                 cfg, fam, p, x, rope_positions, inv_freq, write_kv,

@@ -356,10 +356,14 @@ class InferenceEngine:
                             v2, li, 0, keepdims=False))
 
             def attn(q, kc, vc):
+                # contiguous_positions: this cache's cell index IS the
+                # token position (kv_positions = arange(max_len)), the
+                # declaration the fused decode kernel dispatches on
                 return dot_product_attention(
                     q, kc, vc, positions, kv_positions,
                     causal=True, kv_mask=kv_valid,
-                    window=getattr(cfg, "sliding_window", None))
+                    window=getattr(cfg, "sliding_window", None),
+                    contiguous_positions=True)
 
             x, _ = transformer_block(
                 cfg, fam, p, x, rope_positions, inv_freq, write_kv,

@@ -1,0 +1,128 @@
+"""decode_attention kernel vs the XLA oracle (interpret mode on CPU).
+
+Same tier as test_flash.py: the kernel must match
+ops.attention._xla_attention bit-for-meaning on the decode shape class
+— per-row cursors, left-pad holes, GQA grouping, sliding windows, and
+the block-skip path (cursors far below max_len)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.ops.attention import _xla_attention
+from kubeflow_tpu.ops.pallas.decode_attention import decode_attention
+
+
+def _mk(b, max_len, n_q, n_kv, hd, seed=0):
+    gen = np.random.default_rng(seed)
+    q = jnp.asarray(gen.normal(size=(b, 1, n_q, hd)), jnp.float32)
+    k = jnp.asarray(gen.normal(size=(b, max_len, n_kv, hd)), jnp.float32)
+    v = jnp.asarray(gen.normal(size=(b, max_len, n_kv, hd)), jnp.float32)
+    return gen, q, k, v
+
+
+def _oracle(q, k, v, pos, kv_mask, window=None):
+    b, max_len = k.shape[0], k.shape[1]
+    q_positions = pos[:, None].astype(jnp.int32)
+    kv_positions = jnp.broadcast_to(
+        jnp.arange(max_len, dtype=jnp.int32)[None], (b, max_len))
+    return _xla_attention(q, k, v, q_positions, kv_positions,
+                          causal=True, kv_mask=kv_mask, window=window)
+
+
+@pytest.mark.parametrize("n_q,n_kv", [(4, 4), (8, 2)])
+def test_matches_oracle_ragged_cursors(n_q, n_kv):
+    b, max_len, hd = 4, 256, 32
+    gen, q, k, v = _mk(b, max_len, n_q, n_kv, hd)
+    pos = jnp.asarray([3, 77, 128, 255], jnp.int32)
+    mask = jnp.ones((b, max_len), bool)
+    got = decode_attention(q, k, v, pos, mask, block_k=64)
+    want = _oracle(q, k, v, pos, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_matches_oracle_with_pad_holes():
+    """Left-pad holes (the engines' bucket padding) inside the visible
+    prefix must be excluded exactly like the oracle's kv_mask."""
+    b, max_len, n_q, n_kv, hd = 3, 128, 4, 2, 32
+    gen, q, k, v = _mk(b, max_len, n_q, n_kv, hd, seed=1)
+    pos = jnp.asarray([40, 90, 127], jnp.int32)
+    mask_np = np.ones((b, max_len), bool)
+    mask_np[0, :5] = False     # 5 pad cells at the head
+    mask_np[1, 10:20] = False  # a hole mid-prefix
+    mask = jnp.asarray(mask_np)
+    got = decode_attention(q, k, v, pos, mask, block_k=32)
+    want = _oracle(q, k, v, pos, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_matches_oracle_sliding_window():
+    b, max_len, n_q, n_kv, hd = 2, 128, 4, 4, 32
+    gen, q, k, v = _mk(b, max_len, n_q, n_kv, hd, seed=2)
+    pos = jnp.asarray([100, 127], jnp.int32)
+    mask = jnp.ones((b, max_len), bool)
+    got = decode_attention(q, k, v, pos, mask, window=16, block_k=32)
+    want = _oracle(q, k, v, pos, mask, window=16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_fresh_row_cursor_zero():
+    """pos=0: only the just-written cell is visible — the degenerate
+    single-cell softmax must return exactly that cell's value."""
+    b, max_len, n_q, n_kv, hd = 1, 64, 2, 2, 16
+    gen, q, k, v = _mk(b, max_len, n_q, n_kv, hd, seed=3)
+    pos = jnp.asarray([0], jnp.int32)
+    mask = jnp.ones((b, max_len), bool)
+    got = decode_attention(q, k, v, pos, mask, block_k=16)
+    want = _oracle(q, k, v, pos, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+    # and it literally equals v[:, 0] repeated over the q group
+    np.testing.assert_allclose(
+        np.asarray(got)[0, 0], np.asarray(v)[0, 0], atol=1e-6)
+
+
+def test_rejects_multi_token_queries():
+    gen, q, k, v = _mk(1, 64, 2, 2, 16)
+    q2 = jnp.concatenate([q, q], axis=1)
+    with pytest.raises(ValueError, match="s=1 only"):
+        decode_attention(q2, k, v, jnp.asarray([0], jnp.int32))
+
+
+def test_dispatcher_impl_decode_matches_xla():
+    """dot_product_attention(impl='decode') must agree with the XLA
+    path on the exact call shape the engines make."""
+    from kubeflow_tpu.ops.attention import dot_product_attention
+
+    b, max_len, n_q, n_kv, hd = 3, 256, 8, 2, 32
+    gen, q, k, v = _mk(b, max_len, n_q, n_kv, hd, seed=7)
+    pos = jnp.asarray([12, 200, 255], jnp.int32)
+    q_positions = pos[:, None]
+    kv_positions = jnp.broadcast_to(
+        jnp.arange(max_len, dtype=jnp.int32)[None], (b, max_len))
+    mask_np = np.ones((b, max_len), bool)
+    mask_np[1, :7] = False
+    mask = jnp.asarray(mask_np)
+    got = dot_product_attention(
+        q, k, v, q_positions, kv_positions, causal=True, kv_mask=mask,
+        impl="decode")
+    want = dot_product_attention(
+        q, k, v, q_positions, kv_positions, causal=True, kv_mask=mask,
+        impl="xla")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_dispatcher_decode_door_is_causal_only():
+    from kubeflow_tpu.ops.attention import dot_product_attention
+
+    gen, q, k, v = _mk(1, 256, 2, 2, 16, seed=8)
+    q_positions = jnp.asarray([[5]], jnp.int32)
+    kv_positions = jnp.arange(256, dtype=jnp.int32)[None]
+    with pytest.raises(ValueError, match="causal-only"):
+        dot_product_attention(q, k, v, q_positions, kv_positions,
+                              causal=False, impl="decode")
