@@ -106,11 +106,14 @@ def dot_product_attention(
     `window` positions (sliding-window attention; requires causal) —
     supported by both impls, position-based in XLA, index-based in flash.
 
-    impl: "auto" | "xla" | "flash". "auto" picks the Pallas flash kernel on
-    TPU for long sequences when it is safe: kernel present, no kv_mask, and
-    the caller declared positions contiguous (`contiguous_positions=True`).
-    The flash kernel masks by row/col index, so packed sequences with
-    per-segment position resets MUST take the XLA path, which masks by the
+    impl: "auto" | "xla" | "flash" | "decode". "auto" picks, on TPU:
+    the Pallas flash kernel for long sequences when safe (kernel
+    present, no kv_mask, positions declared contiguous), or the fused
+    decode kernel for single-token causal steps against a >=256-cell
+    cache (again only with `contiguous_positions=True` — it masks by
+    cache cell index against each row's cursor). Packed sequences with
+    per-segment position resets, and caches whose cell index is not
+    the token position, MUST take the XLA path, which masks by the
     actual position tensors.
     """
     if window is not None and not causal:
@@ -144,6 +147,12 @@ def dot_product_attention(
     if impl == "decode":
         if q.shape[1] != 1:
             raise ValueError("impl='decode' is for single-token steps")
+        if not contiguous_positions:
+            raise ValueError(
+                "impl='decode' masks by cache cell index: the caller "
+                "must declare cell index == token position "
+                "(contiguous_positions=True); packed/rotated caches "
+                "must use impl='xla'")
         if not causal:
             # the kernel masks idx <= cursor unconditionally; a
             # bidirectional single-query lookup would silently lose

@@ -36,19 +36,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from kubeflow_tpu.ops.attention import NEG_INF
+from kubeflow_tpu.ops.pallas.flash_attention import (
+    _interpret_default,
+    _pick_block,
+)
 
 DEFAULT_BLOCK_K = 256
-
-
-def _interpret_default() -> bool:
-    return jax.default_backend() != "tpu"
-
-
-def _pick_block(s: int, block: int) -> int:
-    b = min(block, s)
-    while s % b:
-        b //= 2
-    return max(b, 1)
 
 
 def _kernel(pos_ref, q_ref, k_ref, v_ref, mask_ref, o_ref,

@@ -190,13 +190,64 @@ function roPill(sec) {
   return sec.readOnly ? h('span', { class: 'readonly-pill' }, 'admin-pinned') : null;
 }
 
+// Live validators (ref: the Angular spawner's per-field validation,
+// crud-web-apps/jupyter/frontend form). These mirror the BACKEND's
+// laws (web/form.py parse_form / parse_cpu / scale_memory + the
+// notebook controller's mesh check) so a user learns about a bad
+// value at the field, not from a 400 — the backend stays the
+// authority either way.
+export const validators = {
+  name(v) {
+    if (!v) return 'a name is required';
+    if (v.length > 63) return 'at most 63 characters';
+    if (!/^[a-z0-9]([-a-z0-9]*[a-z0-9])?$/.test(v)) {
+      return 'lowercase letters, digits and dashes; must start and end alphanumeric';
+    }
+    return '';
+  },
+  cpu(v) {
+    if (!v) return 'required';
+    if (/^\d+m$/.test(v)) return '';
+    return /^\d+(\.\d+)?$/.test(v) ? '' : "cores ('0.5') or millicores ('500m')";
+  },
+  memory(v) {
+    if (!v) return 'required';
+    return /^\d+(\.\d+)?(Ki|Mi|Gi|Ti|K|M|G|T)?$/.test(v)
+      ? '' : "a quantity like '1Gi' or '512Mi'";
+  },
+  mesh(v, chips) {
+    if (!v) return ''; // empty = pure FSDP
+    let product = 1;
+    const seen = new Set();
+    for (const part of v.split(',')) {
+      const m = /^\s*(data|fsdp|tensor)\s*=\s*(\d+)\s*$/.exec(part);
+      if (!m) return "entries like 'data=1,fsdp=16,tensor=1'";
+      // the backend keeps the LAST value per axis (dict overwrite), so
+      // a duplicate whose product happens to match would green-light a
+      // mesh that fails at runtime
+      if (seen.has(m[1])) return `axis '${m[1]}' given twice`;
+      seen.add(m[1]);
+      product *= Number(m[2]);
+    }
+    if (chips && product !== chips) {
+      return `axes multiply to ${product}, but the slice has ${chips} chips`;
+    }
+    return '';
+  },
+  size(v) {
+    return /^\d+(\.\d+)?(Ki|Mi|Gi|Ti)$/.test(v) ? '' : "a size like '5Gi'";
+  },
+};
+
 export async function notebookFormView() {
   const ns = state.namespace;
   if (!ns) return h('div', { class: 'card empty' }, 'No namespace selected.');
-  const [{ config }, pdResp] = await Promise.all([
+  const [cfgResp, pdResp] = await Promise.all([
     api.get(routes.spawnerConfig),
     api.get(routes.poddefaults(ns)),
   ]);
+  const { config } = cfgResp;
+  const tpuTopologies = cfgResp.tpuTopologies || {};
   const poddefaults = pdResp.poddefaults || [];
 
   const img = section(config, 'image');
@@ -276,7 +327,39 @@ export async function notebookFormView() {
   });
 
   const submit = h('button', { class: 'primary' }, 'Launch');
+
+  // live validation: each field gets an inline error line, updated on
+  // input; Launch disables while anything is invalid
+  const errEls = {};
+  const fieldErr = (key) => {
+    errEls[key] = h('div', { class: 'field-err', 'data-for': key });
+    return errEls[key];
+  };
+  const checks = {
+    name: () => validators.name(nameInput.value.trim()),
+    cpu: () => (cpu.readOnly ? '' : validators.cpu(cpuInput.value.trim())),
+    memory: () => (mem.readOnly ? '' : validators.memory(memInput.value.trim())),
+    mesh: () => (tpu.readOnly ? '' : validators.mesh(
+      meshInput.value.trim(), tpuTopologies[topoSelect.value] || 0)),
+    size: () => (ws.readOnly ? '' : validators.size(wsSize.value.trim())),
+  };
+  const revalidate = () => {
+    let bad = false;
+    for (const [key, check] of Object.entries(checks)) {
+      const msg = check();
+      if (errEls[key]) errEls[key].textContent = msg;
+      bad = bad || !!msg;
+    }
+    submit.disabled = bad;
+    return !bad;
+  };
+  for (const el of [nameInput, cpuInput, memInput, meshInput, wsSize]) {
+    el.addEventListener('input', revalidate);
+  }
+  topoSelect.addEventListener('change', revalidate);
+
   submit.addEventListener('click', async () => {
+    if (!revalidate()) return;
     submit.disabled = true;
     try {
       const body = {
@@ -312,17 +395,17 @@ export async function notebookFormView() {
       'div',
       { class: 'form-grid' },
       h('label', {}, 'Name'),
-      nameInput,
+      h('div', {}, nameInput, fieldErr('name')),
       h('label', {}, 'Image', roPill(img)),
       imageSelect,
       h('label', {}, 'CPU'),
-      cpuInput,
+      h('div', {}, cpuInput, fieldErr('cpu')),
       h('label', {}, 'Memory'),
-      memInput,
+      h('div', {}, memInput, fieldErr('memory')),
       h('label', {}, 'TPU slice', roPill(tpu)),
       topoSelect,
       h('label', {}, 'Device mesh'),
-      meshInput,
+      h('div', {}, meshInput, fieldErr('mesh')),
       h('div', { class: 'field-note' }, 'Mesh axes (data/fsdp/tensor) must multiply to the slice chip count; leave empty for pure FSDP.'),
       h('label', {}, 'Affinity group', roPill(aff)),
       affSelect,
@@ -331,7 +414,7 @@ export async function notebookFormView() {
       h('label', {}, 'Workspace volume', roPill(ws)),
       h('div', {}, wsName, h('div', { class: 'field-note' }, '{notebook-name} expands to the server name.')),
       h('label', {}, 'Workspace size'),
-      wsSize,
+      h('div', {}, wsSize, fieldErr('size')),
       h('label', {}, 'Shared memory'),
       h('label', { class: 'check-row' }, shmCheck, 'mount /dev/shm'),
       h('label', { class: 'span2' }, 'Configurations (TpuPodDefaults)'),

@@ -56,7 +56,16 @@ def _spawner_config(request: web.Request) -> dict:
 
 
 async def get_config(request: web.Request):
-    return json_success({"config": _spawner_config(request)})
+    # tpuTopologies rides along so the SPA form can validate the mesh
+    # product against the picked slice's chip count CLIENT-side (the
+    # backend stays the authority — form.parse_form re-checks).
+    from kubeflow_tpu.parallel.mesh import SLICE_TOPOLOGIES
+
+    return json_success({
+        "config": _spawner_config(request),
+        "tpuTopologies": {name: t.chips
+                          for name, t in SLICE_TOPOLOGIES.items()},
+    })
 
 
 def _summarize(store: Store, nb: Notebook) -> dict:

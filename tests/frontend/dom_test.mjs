@@ -207,3 +207,60 @@ assert.equal(
   '180');
 
 console.log('usage-chart dom assertions OK');
+
+// -- spawner form: live validation (ref the Angular form's per-field
+// validators) — bad values surface at the field and gate Launch ------
+fixtures['GET /jupyter/api/config'] = {
+  config: {
+    image: { value: 'kubeflow-tpu/jupyter-jax-tpu:latest',
+      options: ['kubeflow-tpu/jupyter-jax-tpu:latest'] },
+    cpu: { value: '0.5' }, memory: { value: '1Gi' },
+    tpu: { value: { topology: '' }, options: ['', 'v5e-16'] },
+    workspaceVolume: { value: { name: '{notebook-name}-workspace', size: '5Gi' } },
+    shm: { value: true }, configurations: { value: [] },
+    affinityConfig: { value: 'none', options: [] },
+    tolerationGroup: { value: 'none', options: [] },
+  },
+  tpuTopologies: { 'v5e-16': 16 },
+};
+fixtures[`GET /jupyter/api/namespaces/${NS}/poddefaults`] = { poddefaults: [] };
+
+dom.window.location.hash = '#/jupyter/new';
+await app.render();
+for (let i = 0; i < 20; i += 1) await settle();
+
+const outlet = document.getElementById('outlet');
+const launch = [...outlet.querySelectorAll('button')]
+  .find((b) => b.textContent === 'Launch');
+const nameField = outlet.querySelector('input[aria-label="Name"]');
+assert.ok(launch && nameField, 'form rendered');
+
+const type = (el, value) => {
+  el.value = value;
+  el.dispatchEvent(new dom.window.Event('input', { bubbles: true }));
+};
+
+type(nameField, 'Bad_Name!');
+assert.ok(launch.disabled, 'invalid name disables Launch');
+const nameErr = outlet.querySelector('.field-err[data-for="name"]');
+assert.ok(nameErr.textContent.includes('lowercase'), nameErr.textContent);
+
+type(nameField, 'good-name');
+assert.equal(nameErr.textContent, '', 'valid name clears the error');
+assert.ok(!launch.disabled, 'valid form enables Launch');
+
+// mesh validation against the picked slice's chip count
+const topo = outlet.querySelector('select[aria-label="TPU slice"]');
+topo.value = 'v5e-16';
+topo.dispatchEvent(new dom.window.Event('change', { bubbles: true }));
+const meshField = [...outlet.querySelectorAll('input')]
+  .find((i) => (i.getAttribute('placeholder') || '').startsWith('data='));
+type(meshField, 'data=1,fsdp=4,tensor=1');
+const meshErr = outlet.querySelector('.field-err[data-for="mesh"]');
+assert.ok(meshErr.textContent.includes('16 chips'), meshErr.textContent);
+assert.ok(launch.disabled, 'mesh/chips mismatch disables Launch');
+type(meshField, 'data=1,fsdp=16,tensor=1');
+assert.equal(meshErr.textContent, '');
+assert.ok(!launch.disabled);
+
+console.log('spawner live-validation dom assertions OK');

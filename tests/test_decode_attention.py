@@ -109,7 +109,7 @@ def test_dispatcher_impl_decode_matches_xla():
     mask = jnp.asarray(mask_np)
     got = dot_product_attention(
         q, k, v, q_positions, kv_positions, causal=True, kv_mask=mask,
-        impl="decode")
+        impl="decode", contiguous_positions=True)
     want = dot_product_attention(
         q, k, v, q_positions, kv_positions, causal=True, kv_mask=mask,
         impl="xla")
@@ -125,4 +125,16 @@ def test_dispatcher_decode_door_is_causal_only():
     kv_positions = jnp.arange(256, dtype=jnp.int32)[None]
     with pytest.raises(ValueError, match="causal-only"):
         dot_product_attention(q, k, v, q_positions, kv_positions,
-                              causal=False, impl="decode")
+                              causal=False, impl="decode",
+                              contiguous_positions=True)
+
+
+def test_dispatcher_decode_door_requires_cell_index_contract():
+    from kubeflow_tpu.ops.attention import dot_product_attention
+
+    gen, q, k, v = _mk(1, 256, 2, 2, 16, seed=9)
+    q_positions = jnp.asarray([[5]], jnp.int32)
+    kv_positions = jnp.arange(256, dtype=jnp.int32)[None]
+    with pytest.raises(ValueError, match="cell index"):
+        dot_product_attention(q, k, v, q_positions, kv_positions,
+                              causal=True, impl="decode")

@@ -461,3 +461,16 @@ async def test_metrics_windowed_series(env):
     assert "5, 15, 30, 60, 180" in (await r.json())["log"]
     r = await client.get("/api/metrics/tpu?window=abc", headers=ALICE)
     assert r.status == 400
+
+
+async def test_spawner_config_carries_topology_chip_counts(env):
+    """The SPA's mesh validator needs slice chip counts; the backend
+    stays the authority (form.parse_form re-checks)."""
+    cluster, client = env
+    await _mk_profile(client, cluster)
+    r = await client.get("/jupyter/api/config", headers=ALICE)
+    assert r.status == 200
+    body = await r.json()
+    topos = body["tpuTopologies"]
+    assert topos["v5e-16"] == 16
+    assert all(isinstance(v, int) and v >= 1 for v in topos.values())
