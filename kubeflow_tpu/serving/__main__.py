@@ -94,6 +94,9 @@ def main(argv=None) -> int:
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--batch-window-ms", type=float, default=0.0)
     p.add_argument("--prefill-chunk", type=int, default=0)
+    p.add_argument("--pipeline-depth", type=int, default=0,
+                   help="decode dispatch-ahead depth (0 = backend-"
+                        "aware default: 2 on TPU, 1 elsewhere)")
     p.add_argument("--quant", choices=("", "int8"), default="")
     p.add_argument("--tokenizer", default="",
                    help="data.bpe tokenizer file (text mode); 'auto' "
@@ -161,6 +164,7 @@ def main(argv=None) -> int:
         continuous=args.continuous,
         warmup=args.warmup,
         prefill_chunk=args.prefill_chunk or None,
+        pipeline_depth=args.pipeline_depth or None,
     )
     print(f"serving {args.name or args.model} "
           f"({'random' if args.random else args.checkpoint}) on "

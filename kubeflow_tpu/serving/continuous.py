@@ -441,12 +441,32 @@ class ContinuousBatcher:
                  prefill_chunk: int | None = None,
                  prefixes: dict[str, list[int]] | None = None,
                  max_pending: int = 256,
+                 pipeline_depth: int | None = None,
                  window_ms: float = 0.0):
         # window_ms accepted (and ignored) for constructor parity with
         # Batcher: admission is per-token here, there is no window.
         del window_ms
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        # Dispatch-ahead depth: with depth 2 the worker queues the next
+        # decode chunk while the previous one is still computing, so
+        # host-side emit/retirement work overlaps device time instead
+        # of idling the chip between chunks. The price is bounded
+        # speculation: a slot that retires early (EOS/stop) may decode
+        # up to (depth-1) x chunk garbage tokens before the host sees
+        # it — the free-row cost model this engine is built on. Depth 1
+        # restores strict per-chunk retirement.
+        #
+        # Default is backend-aware (measured, docs/perf-notes.md): on
+        # an accelerator the overlap hides host time behind device
+        # time; on CPU "device" compute shares the host's cores, so
+        # speculation only adds waste (-6% on the loadtest A/B).
+        if pipeline_depth is None:
+            pipeline_depth = 2 if jax.default_backend() == "tpu" else 1
+        if pipeline_depth < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {pipeline_depth}")
+        self.pipeline_depth = pipeline_depth
         # The worker decodes up to `chunk` tokens per dispatch (one
         # scanned program) — per-token host dispatch is the continuous
         # design's overhead tax. Admission happens between dispatches,
@@ -782,10 +802,78 @@ class ContinuousBatcher:
                 self._emit(slot, rec, int(firsts[row]),
                            float(flps[row]), decode=False)
 
+    def _plan_steps(self, inflight) -> int:
+        """Next chunk size: bounded by the longest remaining budget NOT
+        already covered by in-flight chunks (per slot — a slot admitted
+        after a dispatch isn't covered by it). 0 = nothing useful to
+        dispatch ahead."""
+        if not self._active:
+            return 0
+        best = 0
+        for slot, rec in self._active.items():
+            cover = sum(r["steps"] for r in inflight
+                        if r["snap"].get(slot) is rec)
+            best = max(best, rec.max_new - len(rec.out) - cover)
+        return min(self.chunk, best) if best > 0 else 0
+
+    async def _dispatch_chunk(self, loop, steps: int) -> dict:
+        """Dispatch one decode chunk WITHOUT host sync: device arrays
+        come back as futures, the device starts computing, and the
+        host keeps working. The snapshot maps slot -> the _Slot RECORD
+        active at dispatch: chunk tokens are valid only for that exact
+        request. Identity (not slot id) matters — a slot freed by a
+        retirement and re-admitted while this chunk is in flight
+        carries a NEW request whose tokens start with the next
+        dispatch; emitting this chunk's row into it would corrupt its
+        stream (caught by test_stop_sequences_retire_slots_early)."""
+        sp = self._sp()
+        snap = dict(self._active)
+
+        def run_step(st=self._st, sp=sp, steps=steps):
+            # The rng chains THROUGH the compiled step (it splits
+            # internally and returns the next key) — no host-side
+            # jax.random.split dispatch per chunk.
+            return self.cengine.step(st, sp, self._rng, steps)
+
+        async with self.gpu_lock:
+            st, toks, lps, rng = await loop.run_in_executor(
+                None, run_step)
+            self._st = st
+            self._rng = rng
+        self.calls += steps
+        return {"toks": toks, "lps": lps, "steps": steps, "snap": snap}
+
+    @staticmethod
+    async def _sync_chunk(loop, rec: dict) -> None:
+        """Force a chunk's results to host (in the executor: jax
+        dispatch is async and syncing on the loop thread would block
+        the whole HTTP server for the device time)."""
+        rec["toks"], rec["lps"] = await loop.run_in_executor(
+            None, lambda: (np.asarray(rec["toks"]),
+                           np.asarray(rec["lps"])))
+
+    def _process_chunk(self, rec: dict) -> None:
+        toks = np.asarray(rec["toks"])
+        lps = np.asarray(rec["lps"])
+        for slot, srec in list(self._active.items()):
+            if rec["snap"].get(slot) is not srec:
+                continue  # admitted after dispatch: tokens not its own
+            if srec.fut.done():  # caller cancelled mid-decode
+                self._finish(slot, srec)
+                continue
+            for j in range(rec["steps"]):
+                self._emit(slot, srec, int(toks[slot, j]),
+                           float(lps[slot, j]))
+                if slot not in self._active:
+                    break  # retired mid-chunk; tail is trimmed
+
     async def _run(self) -> None:
         loop = asyncio.get_event_loop()
+        # Chunks in flight on device, oldest first. Depth > 1 keeps the
+        # chip busy while the host emits/retires the previous chunk.
+        inflight: collections.deque = collections.deque()
         while True:
-            if not self._active and not self._pending:
+            if not self._active and not self._pending and not inflight:
                 self._wake.clear()
                 await self._wake.wait()
             # admit up to the free-slot count; dead futures are skipped
@@ -797,45 +885,28 @@ class ContinuousBatcher:
                         take.append(item)
                 if take:
                     await self._admit_group(take)
-            if not self._active:
-                continue
-            # never decode past the longest remaining budget (tail
-            # steps would be pure garbage for every slot); queued
-            # arrivals wait at most chunk-1 tokens for a free slot
-            steps = min(self.chunk,
-                        max(rec.max_new - len(rec.out)
-                            for rec in self._active.values()))
-            steps = max(steps, 1)
             try:
-                sp = self._sp()
-
-                def run_step(st=self._st, sp=sp, steps=steps):
-                    # host sync inside the executor (see run_prefill).
-                    # The rng chains THROUGH the compiled step (it
-                    # splits internally and returns the next key) —
-                    # no host-side jax.random.split dispatch per chunk.
-                    st, toks, lps, rng = self.cengine.step(
-                        st, sp, self._rng, steps)
-                    return st, rng, np.asarray(toks), np.asarray(lps)
-
-                async with self.gpu_lock:
-                    st, rng, toks, lps = await loop.run_in_executor(
-                        None, run_step)
-                    self._st = st
-                    self._rng = rng
+                # drain whatever already finished, without blocking.
+                # INSIDE the try: an async-dispatched chunk that failed
+                # on device reports ready and raises at materialization
+                # — that must reach _fail_all like every other failure,
+                # not kill the worker and hang every future.
+                while inflight and inflight[0]["toks"].is_ready():
+                    self._process_chunk(inflight.popleft())
+                steps = self._plan_steps(inflight)
+                if steps and len(inflight) < self.pipeline_depth:
+                    inflight.append(
+                        await self._dispatch_chunk(loop, steps))
+                elif inflight:
+                    # nothing useful to dispatch ahead: block on the
+                    # oldest chunk and process it
+                    head = inflight.popleft()
+                    await self._sync_chunk(loop, head)
+                    self._process_chunk(head)
             except Exception as e:  # noqa: BLE001 — fail active requests
                 self._fail_all(e)  # donated buffers may be mid-flight
+                inflight.clear()
                 continue
-            self.calls += steps
-            for slot, rec in list(self._active.items()):
-                if rec.fut.done():  # caller cancelled mid-decode
-                    self._finish(slot, rec)
-                    continue
-                for j in range(steps):
-                    self._emit(slot, rec, int(toks[slot, j]),
-                               float(lps[slot, j]))
-                    if slot not in self._active:
-                        break  # retired mid-chunk; tail is trimmed
             # let submissions/cancellations interleave between steps
             await asyncio.sleep(0)
 

@@ -214,6 +214,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                        prefill_chunk: int | None = None,
                        prefixes: dict[str, list[int]] | None = None,
                        max_pending: int | None = None,
+                       pipeline_depth: int | None = None,
                        drafts: dict[str, InferenceEngine] | None = None,
                        ) -> web.Application:
     """`tokenizer` (data.bpe.Tokenizer or anything with encode/decode)
@@ -254,14 +255,15 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     lock = asyncio.Lock()
     app[GPU_LOCK_KEY] = lock
     if not continuous and (warmup or prefill_chunk or prefixes
-                           or max_pending is not None):
+                           or max_pending is not None
+                           or pipeline_depth is not None):
         # these knobs only exist on the continuous batcher; silently
         # ignoring them would ship a server missing configuration the
         # caller explicitly asked for (max_pending especially: the
         # caller believes overload sheds at that depth)
         raise ValueError(
-            "warmup/prefill_chunk/prefixes/max_pending require "
-            "continuous=True")
+            "warmup/prefill_chunk/prefixes/max_pending/pipeline_depth "
+            "require continuous=True")
     if continuous:
         # prefill_chunk: long prompts admit in fixed slices — chunk-
         # multiple buckets, one [g, chunk] compile for every length.
@@ -271,7 +273,8 @@ def create_serving_app(engines: dict[str, InferenceEngine],
             name: ContinuousBatcher(
                 eng, lock, max_slots=max_batch,
                 prefill_chunk=prefill_chunk, prefixes=prefixes,
-                max_pending=256 if max_pending is None else max_pending)
+                max_pending=256 if max_pending is None else max_pending,
+                pipeline_depth=pipeline_depth)
             for name, eng in engines.items()}
         if warmup:
             async def _warm(app_):

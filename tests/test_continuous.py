@@ -153,10 +153,11 @@ async def test_eos_retires_slot_early_and_pads_result():
     ref = _solo(engine0, p, 6)
     eos = ref[2]  # greedy hits this at step 3
     engine, _ = _engine(eos=eos)
-    # chunk=1: this test pins PER-TOKEN retirement; chunked retirement
-    # (at chunk boundaries) is covered by the identity test above
+    # chunk=1, depth=1: this test pins PER-TOKEN retirement; chunked
+    # retirement is covered by the identity test above, and bounded
+    # speculative overshoot (depth>1) by the pipelining tests below
     batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2,
-                                chunk=1)
+                                chunk=1, pipeline_depth=1)
     got = await batcher.submit(p, 6, ())
     # window-Batcher parity: EOS-padded to exactly max_new
     assert got == ref[:3] + [eos] * 3
@@ -873,3 +874,93 @@ async def test_stream_failure_terminal_error_direct_mode_too():
     assert "error" in final and "chip fell over" in final["error"]
     assert final.get("done") is None
     await client.close()
+
+
+async def test_pipelined_depth2_tokens_identical_to_depth1():
+    """Dispatch-ahead must never change WHAT is emitted — only when
+    the host sees it. Same prompts, same budgets, both depths."""
+    engine, cfg = _engine()
+    gen = np.random.default_rng(21)
+    prompts = [gen.integers(0, cfg.vocab_size, n).tolist()
+               for n in (5, 9, 14)]
+    want = [_solo(engine, p, 6) for p in prompts]
+    for depth in (1, 2):
+        batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2,
+                                    chunk=2, pipeline_depth=depth)
+        got = await asyncio.gather(
+            *(batcher.submit(p, 6, ()) for p in prompts))
+        assert list(got) == want, f"depth={depth}"
+        await batcher.close()
+
+
+async def test_pipelined_eos_overshoot_is_bounded():
+    """With depth 2, an EOS retirement may cost at most (depth-1) x
+    chunk speculative steps beyond the depth-1 minimum — never an
+    unbounded run-on."""
+    engine0, cfg = _engine()
+    gen = np.random.default_rng(22)
+    p = gen.integers(0, cfg.vocab_size, 6).tolist()
+    ref = _solo(engine0, p, 8)
+    eos = ref[2]  # greedy hits this at decode step 2
+    engine, _ = _engine(eos=eos)
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2,
+                                chunk=2, pipeline_depth=2)
+    got = await batcher.submit(p, 8, ())
+    assert got == ref[:3] + [eos] * 5  # EOS-padded, same answer
+    # minimum decode steps to see EOS with chunk=2 is 2; speculation
+    # may add at most (depth-1) x chunk = 2 more
+    assert batcher.calls <= 4, batcher.calls
+    # pool healthy afterwards
+    q = gen.integers(0, cfg.vocab_size, 4).tolist()
+    assert await batcher.submit(q, 4, ()) == _solo(engine, q, 4)
+    await batcher.close()
+
+
+async def test_pipelined_rejects_bad_depth():
+    engine, _ = _engine()
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        ContinuousBatcher(engine, asyncio.Lock(), pipeline_depth=0)
+
+
+async def test_async_device_failure_in_drain_path_fails_cleanly():
+    """An async-dispatched chunk that FAILED on device reports ready
+    and raises at materialization (the TPU failure mode). The drain
+    path must route that through _fail_all — every future settles with
+    the error and the batcher recovers — never kill the worker and
+    hang the streams (review finding on the pipelined loop)."""
+    engine, cfg = _engine()
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2,
+                                chunk=2, pipeline_depth=2)
+    gen = np.random.default_rng(31)
+    p = gen.integers(0, cfg.vocab_size, 5).tolist()
+
+    class PoisonArray:
+        """Looks ready; dies on host transfer, like a failed XLA
+        computation surfacing at np.asarray."""
+
+        def is_ready(self):
+            return True
+
+        def __array__(self, *a, **k):
+            raise RuntimeError("device computation failed")
+
+    real_step = batcher.cengine.step
+    calls = {"n": 0}
+
+    def poisoned_step(st, sp, rng, steps):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            st2, toks, lps, rng2 = real_step(st, sp, rng, steps)
+            return st2, PoisonArray(), PoisonArray(), rng2
+        return real_step(st, sp, rng, steps)
+
+    batcher.cengine.step = poisoned_step
+    with pytest.raises(RuntimeError, match="device computation failed"):
+        await asyncio.wait_for(batcher.submit(p, 6, ()), timeout=30)
+    assert not batcher._active  # nothing leaked
+
+    # the worker survived: a fresh request serves correctly
+    want = _solo(engine, p, 4)
+    got = await asyncio.wait_for(batcher.submit(p, 4, ()), timeout=60)
+    assert got == want
+    await batcher.close()
