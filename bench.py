@@ -592,6 +592,11 @@ def bench_vit(model: str, *, batch: int, steps: int, warmup: int = 2,
     """BASELINE config #2: ViT fine-tune throughput under the sharded
     Trainer (images/s + MFU). `model` is a kubeflow_tpu.models.vit
     CONFIGS key ("tiny" CPU twin / "vit-b16" the real v5e-1 config)."""
+    if warmup < 1:
+        # the first step is the compile; timing without one warm step
+        # measures compilation, and `loss` below is bound in the
+        # warmup loop
+        raise ValueError(f"warmup must be >= 1, got {warmup}")
     from kubeflow_tpu.models import vit
     from kubeflow_tpu.parallel import MeshSpec, create_mesh
     from kubeflow_tpu.train import Trainer, TrainConfig

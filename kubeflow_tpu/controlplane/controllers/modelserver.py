@@ -196,12 +196,14 @@ class ModelServerController(Controller):
         # tokenizer.json beside the checkpoint (the Checkpointer
         # carries it there from tools/prepare_data.py's output) so a
         # served prepared checkpoint speaks its training tokenizer.
-        # Gated on a checkpoint being set: "auto" is a no-op without
-        # one, and not rendering it then keeps random-init servers
-        # runnable on serving images predating the flag's auto mode
-        # (controller and image ship from one tree, but image tags are
-        # operator-pinned).
-        if ckpt and spec.tokenizer and spec.tokenizer != "none":
+        # ONLY "auto" is gated on a checkpoint being set (it is a
+        # no-op without one, and not rendering it then keeps
+        # random-init servers runnable on serving images predating the
+        # auto mode); an EXPLICIT tokenizer path renders regardless —
+        # silently dropping configuration the operator asked for would
+        # serve byte-mode text with no error anywhere.
+        if spec.tokenizer and spec.tokenizer != "none" \
+                and (ckpt or spec.tokenizer != "auto"):
             args += ["--tokenizer", spec.tokenizer]
 
         container = Container(

@@ -246,10 +246,11 @@ class MetricsHistory:
 
     def sample(self) -> None:
         """Scan the store once and append a ring point. Calls within
-        half a cadence collapse to one sample — the ring fills at
-        CADENCE rate only, so its retention math holds no matter how
-        hard clients poll (request-time freshness is series(live=True),
-        which never stores)."""
+        half a cadence collapse to one sample, so the ring fills at
+        CADENCE rate and its retention math holds even if multiple
+        samplers ever run. The dashboard's background task is the ONLY
+        caller today; request-time freshness is series(live=...),
+        which never stores."""
         now = self._clock()
         with self._lock:
             if self._samples and \

@@ -56,7 +56,16 @@ def _kernel(pos_ref, q_ref, k_ref, v_ref, mask_ref, o_ref,
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
 
-    @pl.when(ki * block_k <= pos)
+    # Relevance: skip blocks past the cursor AND (with a sliding
+    # window) blocks wholly older than the attention band — without
+    # the lower bound, a window-1024 model at cursor 32k would stream
+    # all 32k cells per token, the exact waste this kernel exists to
+    # cut on the causal side.
+    relevant = ki * block_k <= pos
+    if window is not None:
+        relevant &= (ki * block_k + block_k - 1) >= pos - window + 1
+
+    @pl.when(relevant)
     def _compute():
         n_q = n_kv * group
         q = q_ref[0, 0].astype(jnp.float32)           # [n_q, hd]
@@ -131,14 +140,22 @@ def decode_attention(
     nk = max_len // block_k
     positions = q_positions.astype(jnp.int32)
 
-    # Clamped index maps: iterations past a row's last needed block
-    # re-reference that block — consecutive equal indices skip the DMA,
-    # which is where the ragged saving comes from.
+    # Clamped index maps: iterations outside a row's needed block range
+    # re-reference a boundary block — consecutive equal indices skip
+    # the DMA, which is where the ragged saving comes from. The range
+    # is [first block the window can see, cursor block].
+    def _clamp(ki, pos):
+        hi = pos // block_k
+        if window is None:
+            return jnp.minimum(ki, hi)
+        lo = jnp.maximum((pos - window + 1) // block_k, 0)
+        return jnp.clip(ki, lo, hi)
+
     def kv_map(b_i, ki, pos_ref):
-        return (b_i, jnp.minimum(ki, pos_ref[b_i] // block_k), 0, 0)
+        return (b_i, _clamp(ki, pos_ref[b_i]), 0, 0)
 
     def mask_map(b_i, ki, pos_ref):
-        return (b_i, jnp.minimum(ki, pos_ref[b_i] // block_k))
+        return (b_i, _clamp(ki, pos_ref[b_i]))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,

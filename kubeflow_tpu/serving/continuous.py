@@ -469,8 +469,10 @@ class ContinuousBatcher:
         self.pipeline_depth = pipeline_depth
         # The worker decodes up to `chunk` tokens per dispatch (one
         # scanned program) — per-token host dispatch is the continuous
-        # design's overhead tax. Admission happens between dispatches,
-        # so a queued request waits at most chunk-1 tokens — still far
+        # design's overhead tax. Admission happens between dispatches:
+        # a queued request waits at most chunk-1 tokens at depth 1, up
+        # to ~pipeline_depth x chunk under dispatch-ahead (a freed
+        # slot is only observed once its chunk drains) — still far
         # under a window group's full-generation wait. Compiles stay
         # bounded: one program per steps value in [1, chunk].
         self.chunk = chunk

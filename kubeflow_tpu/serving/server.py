@@ -19,6 +19,7 @@ pass token IDs from their own tokenizer.
 from __future__ import annotations
 
 import asyncio
+import logging
 import math
 from typing import Any
 
@@ -389,7 +390,12 @@ async def _stream_generate(request, engine, arr, max_new, sampling,
         except Exception as e:  # noqa: BLE001
             # Same terminal-event contract as _stream_continuous:
             # headers are out, so raising would abort the connection
-            # indistinguishably from a network drop.
+            # indistinguishably from a network drop. Log server-side —
+            # the raise-through path used to leave an aiohttp
+            # traceback, and a device falling over mid-stream must
+            # stay diagnosable from the server logs.
+            logging.getLogger(__name__).exception(
+                "decode failed mid-stream")
             error = f"{type(e).__name__}: {e}"
             break
         if part is None:
@@ -453,7 +459,10 @@ async def _stream_continuous(request, batcher, arr, max_new, sampling,
         except Exception as e:  # noqa: BLE001
             # Headers are already sent: a raise here would abort the
             # connection, indistinguishable from a network drop. Emit
-            # a deterministic terminal error event instead.
+            # a deterministic terminal error event instead (and keep
+            # the server-side trail — see _stream_generate).
+            logging.getLogger(__name__).exception(
+                "continuous decode failed mid-stream")
             error = f"{type(e).__name__}: {e}"
     finally:
         if not fut.done():

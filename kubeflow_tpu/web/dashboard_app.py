@@ -56,10 +56,12 @@ def create_dashboard_app(store: Store, *, cluster_admins: set[str] | None = None
     app[LINKS_KEY] = links or DEFAULT_LINKS
     app[HISTORY_KEY] = MetricsHistory(store, cadence_s=history_cadence_s)
 
-    # Background sampler: the windowed charts need history even when
-    # nobody is polling (the reference gets this for free from
-    # Stackdriver's own collection). Request-time top-up sampling in
-    # metrics() covers the serve path; this covers the quiet hours.
+    # Background sampler: ALL ring history comes from this task (the
+    # reference gets collection for free from Stackdriver). metrics()
+    # never stores — it appends a per-request live point to the
+    # RESPONSE only — so if this task dies the chart degrades to a
+    # single live point, which is why the loop logs failures instead
+    # of dying.
     async def _sampler(app_: web.Application):
         import logging
 

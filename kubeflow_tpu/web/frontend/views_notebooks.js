@@ -207,12 +207,14 @@ export const validators = {
   },
   cpu(v) {
     if (!v) return 'required';
-    if (/^\d+m$/.test(v)) return '';
+    // mirror web/form.py parse_cpu exactly: float millicores allowed
+    if (/^\d+(\.\d+)?m$/.test(v)) return '';
     return /^\d+(\.\d+)?$/.test(v) ? '' : "cores ('0.5') or millicores ('500m')";
   },
   memory(v) {
     if (!v) return 'required';
-    return /^\d+(\.\d+)?(Ki|Mi|Gi|Ti|K|M|G|T)?$/.test(v)
+    // mirror web/form.py scale_memory's unit set (incl. Pi/Ei)
+    return /^\d+(\.\d+)?(Ki|Mi|Gi|Ti|Pi|Ei|K|M|G|T|P|E)?$/.test(v)
       ? '' : "a quantity like '1Gi' or '512Mi'";
   },
   mesh(v, chips) {

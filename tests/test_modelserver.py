@@ -83,6 +83,20 @@ def test_tokenizer_flag_rendering(cluster):
         assert "--tokenizer" not in c.args, (name, c.args)
 
 
+def test_explicit_tokenizer_renders_without_checkpoint(cluster):
+    """Review r05: only 'auto' is checkpoint-gated — an explicit path
+    the operator configured must render even for random-init servers
+    (silently dropping it would serve byte-mode text with no error)."""
+    cluster.store.create(mk_ms(
+        "srv-exp-tok", tokenizer="/mnt/tok/tokenizer.json"))
+    assert cluster.wait_idle()
+    c = cluster.store.get(
+        "Deployment", "user1",
+        "srv-exp-tok").spec.template.spec.containers[0]
+    i = c.args.index("--tokenizer")
+    assert c.args[i + 1] == "/mnt/tok/tokenizer.json"
+
+
 def test_gcs_checkpoint(cluster):
     cluster.store.create(mk_ms(
         "srv3", checkpoint="gs://bucket/run9"))

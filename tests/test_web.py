@@ -445,7 +445,7 @@ async def test_metrics_windowed_series(env):
     assert r.status == 200
     m = await r.json()
     assert m["window"] == 15
-    assert m["points"], "request-time top-up sample must add a point"
+    assert m["points"], "the live now-point must always be present"
     last = m["points"][-1]
     assert last["tpuHostsInUse"] == 4  # the v5e-16 gang's 4 host pods
     assert last["notebooks"] == 1
