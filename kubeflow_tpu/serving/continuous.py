@@ -129,6 +129,8 @@ class ContinuousEngine:
         self._step_jit = jax.jit(self._step, donate_argnums=(2,),
                                  static_argnames=("steps",))
         self._insert_jit = jax.jit(self._insert, donate_argnums=(0,))
+        self._insert_many_jit = jax.jit(self._insert_many,
+                                        donate_argnums=(0,))
 
     # -- state ------------------------------------------------------------
 
@@ -255,6 +257,37 @@ class ContinuousEngine:
                                 jnp.asarray(row, jnp.int32), first,
                                 jnp.asarray(aid, jnp.int32))
 
+    def _insert_many(self, st: SlotState, slots, pstate, rows, first,
+                     aids):
+        """A whole admission group's scatters in one program (a scan
+        over `_insert`) — one device dispatch per group instead of one
+        per request, the admission-side sibling of the group prefill."""
+
+        def body(st, xs):
+            slot, row, aid = xs
+            return self._insert(st, slot, pstate, row, first, aid), None
+
+        st, _ = jax.lax.scan(body, st, (slots, rows, aids))
+        return st
+
+    def insert_many(self, st: SlotState, slots: list[int], pstate,
+                    rows: list[int], first,
+                    aids: list[int] | None = None) -> SlotState:
+        """Insert prefilled rows `rows` into `slots` in ONE dispatch.
+        Compiles one cheap program per group SIZE (bounded by
+        max_slots); the batcher's admission path uses this, the g=1
+        `insert` stays for benches and direct callers."""
+        n = len(slots)
+        if len(rows) != n or (aids is not None and len(aids) != n):
+            raise ValueError(
+                f"insert_many: {n} slots vs {len(rows)} rows"
+                + (f" vs {len(aids)} aids" if aids is not None else ""))
+        return self._insert_many_jit(
+            st, jnp.asarray(slots, jnp.int32), pstate,
+            jnp.asarray(rows, jnp.int32), first,
+            jnp.asarray(aids if aids is not None else [0] * n,
+                        jnp.int32))
+
     def warmup(self, buckets=(16,), step_sizes=(1,)) -> int:
         """Compile a serving shape set ahead of traffic: prefill and
         insert for every power-of-two group size x REGISTERED prompt
@@ -280,7 +313,11 @@ class ContinuousEngine:
             for b in buckets:
                 pstate, first, _, _ = self.prefill_batch(
                     [[0]] * g, b, [greedy] * g, rng)
-                st = self.insert(st, 0, pstate, first, 0)
+                # admissions insert as a GROUP (insert_many), padded
+                # to a power of two by the batcher — warming each pow2
+                # size covers EVERY arrival count
+                st = self.insert_many(
+                    st, list(range(g)), pstate, list(range(g)), first)
                 n += 2
             g *= 2
         for steps in step_sizes:
@@ -717,9 +754,11 @@ class ContinuousBatcher:
 
     async def _admit_group(self, items: list) -> None:
         """Admit up to len(self._free) requests; items sharing a
-        prefill bucket AND prefix share ONE prefill dispatch. A prefill
+        prefill bucket AND prefix share ONE prefill dispatch, and the
+        group's slot scatters share one insert_many dispatch. A prefill
         failure fails its bucket group only; an insert failure fails
-        that request only."""
+        its whole admit group (and every active request too when the
+        donated buffers were consumed — see the except block)."""
         loop = asyncio.get_event_loop()
         groups: dict[tuple, list] = {}
         for item in items:
@@ -761,35 +800,55 @@ class ContinuousBatcher:
                 for _, _, _, fut, queue, _, _ in group:
                     self._fail(fut, queue, e)
                 continue
-            for row, (tokens, max_new, sampling, fut, queue, aid, _) in \
-                    enumerate(group):
-                if fut.done():  # cancelled while prefilling
-                    continue
-                slot = self._free.pop()
-                try:
-                    if self._st is None:
-                        self._st = self.cengine.init_slots()
-                    async with self.gpu_lock:
-                        self._st = await loop.run_in_executor(
-                            None, self.cengine.insert, self._st, slot,
-                            pstate, firsts, row, aid)
-                except Exception as e:  # noqa: BLE001
-                    self._free.append(slot)
+            admit = [(row, item) for row, item in enumerate(group)
+                     if not item[3].done()]  # skip cancelled-in-prefill
+            if not admit:
+                continue
+            slots = [self._free.pop() for _ in admit]
+            # Pad the scatter list to a power of two by REPEATING the
+            # last (slot, row, aid) triple — re-inserting the same row
+            # into the same slot is idempotent under the sequential
+            # scan — so insert_many's compile set stays the warmed
+            # log2(max_slots) sizes instead of one program per novel
+            # arrival count (a mid-traffic TPU compile stalls every
+            # active decode for seconds).
+            np2 = 1
+            while np2 < len(admit):
+                np2 *= 2
+            pad = np2 - len(admit)
+            ins_slots = slots + [slots[-1]] * pad
+            ins_rows = [r for r, _ in admit] + [admit[-1][0]] * pad
+            ins_aids = ([it[5] for _, it in admit]
+                        + [admit[-1][1][5]] * pad)
+            try:
+                if self._st is None:
+                    self._st = self.cengine.init_slots()
+                async with self.gpu_lock:
+                    # ONE dispatch for the whole group's scatters (the
+                    # admission-side sibling of the group prefill)
+                    self._st = await loop.run_in_executor(
+                        None, self.cengine.insert_many, self._st,
+                        ins_slots, pstate, ins_rows, firsts, ins_aids)
+            except Exception as e:  # noqa: BLE001
+                self._free.extend(slots)
+                for _, (_, _, _, fut, queue, _, _) in admit:
                     self._fail(fut, queue, e)
-                    # insert donates self._st: a failure that fired
-                    # AFTER dispatch leaves the old buffers consumed,
-                    # and keeping them would crash the NEXT decode step
-                    # with a confusing deleted-buffer error. A failure
-                    # BEFORE dispatch (bad shapes, host-side raise)
-                    # leaves them intact — then only this admission
-                    # dies. Distinguish the two instead of guessing.
-                    if self._st is not None and any(
-                            leaf.is_deleted() for leaf in
-                            jax.tree.leaves(self._st)
-                            if hasattr(leaf, "is_deleted")):
-                        self._fail_all(RuntimeError(
-                            f"slot state lost to donated insert: {e}"))
-                    continue
+                # insert donates self._st: a failure that fired AFTER
+                # dispatch leaves the old buffers consumed, and keeping
+                # them would crash the NEXT decode step with a
+                # confusing deleted-buffer error. A failure BEFORE
+                # dispatch (bad shapes, host-side raise) leaves them
+                # intact — then only this group dies. Distinguish the
+                # two instead of guessing.
+                if self._st is not None and any(
+                        leaf.is_deleted() for leaf in
+                        jax.tree.leaves(self._st)
+                        if hasattr(leaf, "is_deleted")):
+                    self._fail_all(RuntimeError(
+                        f"slot state lost to donated insert: {e}"))
+                continue
+            for slot, (row, (tokens, max_new, sampling, fut, queue,
+                             aid, _)) in zip(slots, admit):
                 self.requests += 1
                 rec = _Slot(fut, max_new, queue,
                             stop=tuple(tuple(s) for s in

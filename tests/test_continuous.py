@@ -758,15 +758,15 @@ async def test_insert_failure_before_dispatch_spares_active_slots():
     while not batcher._active:
         await asyncio.sleep(0.01)
 
-    real_insert = batcher.cengine.insert
+    real_insert = batcher.cengine.insert_many
 
     def boom(*a, **k):
         raise ValueError("host-side admission failure")
 
-    batcher.cengine.insert = boom
+    batcher.cengine.insert_many = boom
     with pytest.raises(ValueError, match="host-side admission"):
         await batcher.submit(p2, 4, ())
-    batcher.cengine.insert = real_insert
+    batcher.cengine.insert_many = real_insert
 
     assert list(await t1) == want1  # survivor unharmed
     # pool healthy afterwards: a fresh request still serves
@@ -794,11 +794,11 @@ async def test_insert_failure_after_dispatch_fails_actives_cleanly():
             leaf.delete()  # what a post-dispatch donation does
         raise ValueError("mid-insert failure")
 
-    real_insert = batcher.cengine.insert
-    batcher.cengine.insert = consume_and_boom
+    real_insert = batcher.cengine.insert_many
+    batcher.cengine.insert_many = consume_and_boom
     with pytest.raises(ValueError, match="mid-insert"):
         await batcher.submit(p1, 4, ())
-    batcher.cengine.insert = real_insert
+    batcher.cengine.insert_many = real_insert
 
     with pytest.raises(RuntimeError, match="slot state lost"):
         await t1
