@@ -52,14 +52,21 @@ from kubeflow_tpu.serving.engine import (
 )
 
 
+def pow2_ceil(n: int) -> int:
+    """Smallest power of two >= n (n >= 1) — the group-size law shared
+    by prefill padding and admission-scatter padding (one compiled
+    program per pow2 size, not per novel count)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
 def bucket_pow2(n: int, cap: int) -> int:
     """Round up to a power of two (>= 16), capped — bounded compile
     shapes instead of one compile per novel length. Shared by the
     window Batcher and the continuous engine's prefill."""
-    b = 16
-    while b < n:
-        b *= 2
-    return min(b, cap)
+    return min(max(pow2_ceil(n), 16), cap)
 
 
 class SlotState:
@@ -772,9 +779,7 @@ class ContinuousBatcher:
             # prefill/insert shapes come from a SET of log2(max_slots)
             # sizes instead of one compile per novel group size (the
             # same row bucketing the window Batcher does)
-            gp = 1
-            while gp < len(group):
-                gp *= 2
+            gp = pow2_ceil(len(group))
             lists = [it[0] for it in group] + [[0]] * (gp - len(group))
             samps = ([it[2] for it in group]
                      + [{"temperature": 0.0, "top_k": 0, "top_p": 1.0}]
@@ -812,10 +817,7 @@ class ContinuousBatcher:
             # log2(max_slots) sizes instead of one program per novel
             # arrival count (a mid-traffic TPU compile stalls every
             # active decode for seconds).
-            np2 = 1
-            while np2 < len(admit):
-                np2 *= 2
-            pad = np2 - len(admit)
+            pad = pow2_ceil(len(admit)) - len(admit)
             ins_slots = slots + [slots[-1]] * pad
             ins_rows = [r for r, _ in admit] + [admit[-1][0]] * pad
             ins_aids = ([it[5] for _, it in admit]

@@ -18,7 +18,7 @@ from aiohttp import web
 
 from kubeflow_tpu.controlplane import auth
 from kubeflow_tpu.controlplane.kfam import Kfam
-from kubeflow_tpu.controlplane.metrics import MetricsHistory
+from kubeflow_tpu.controlplane.metrics import MetricsHistory, scan_usage
 from kubeflow_tpu.controlplane.store import Store
 from kubeflow_tpu.web.common import (
     CLUSTER_ADMINS_KEY,
@@ -181,8 +181,6 @@ async def metrics(request: web.Request):
     # ONE store walk feeds both the summary tiles and (as the series'
     # live point) the chart — metrics.scan_usage is the single
     # definition of "TPU host in use".
-    from kubeflow_tpu.controlplane.metrics import scan_usage
-
     pods, nbs_by_ns = scan_usage(store)
     by_topo: dict[str, int] = {}
     tpu_by_ns: dict[str, int] = {}

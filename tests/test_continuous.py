@@ -964,3 +964,33 @@ async def test_async_device_failure_in_drain_path_fails_cleanly():
     got = await asyncio.wait_for(batcher.submit(p, 4, ()), timeout=60)
     assert got == want
     await batcher.close()
+
+
+def test_insert_many_equals_sequential_inserts():
+    """The fused group scatter must land EXACTLY the same state as
+    per-request inserts, including the pow2 padding's idempotent
+    repeat of the last triple."""
+    engine, cfg = _engine()
+    ce = ContinuousEngine(engine, max_slots=4)
+    gen = np.random.default_rng(40)
+    key = jax.random.key(2)
+    lists = [gen.integers(0, cfg.vocab_size, n).tolist()
+             for n in (4, 7, 3)]
+    greedy = {"temperature": 0.0, "top_k": 0, "top_p": 1.0}
+    pstate, first, _, _ = ce.prefill_batch(
+        lists + [[0]], 16, [greedy] * 4, key)
+
+    st_seq = ce.init_slots()
+    for slot, row in zip((2, 0, 3), range(3)):
+        st_seq = ce.insert(st_seq, slot, pstate, first, row)
+
+    st_many = ce.init_slots()
+    # padded to 4 by repeating the last (slot, row) — idempotent
+    st_many = ce.insert_many(st_many, [2, 0, 3, 3], pstate,
+                             [0, 1, 2, 2], first)
+
+    for a, b in zip(jax.tree.leaves(st_seq), jax.tree.leaves(st_many)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    with pytest.raises(ValueError, match="insert_many"):
+        ce.insert_many(ce.init_slots(), [0, 1], pstate, [0], first)
