@@ -994,3 +994,36 @@ def test_insert_many_equals_sequential_inserts():
 
     with pytest.raises(ValueError, match="insert_many"):
         ce.insert_many(ce.init_slots(), [0, 1], pstate, [0], first)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family_name", ["gemma", "moe"])
+async def test_non_llama_families_through_the_slot_engine(family_name):
+    """The continuous batcher has only ever been exercised with llama;
+    gemma (GQA 4:1, sliding window, scaled embeddings) and MoE (routed
+    mlp injection) must decode identically to their solo engines
+    through slot admission, scatter insert, and chunked stepping."""
+    from kubeflow_tpu.serving import GEMMA_FAMILY, MOE_LLAMA_FAMILY
+
+    if family_name == "gemma":
+        from kubeflow_tpu.models import gemma
+        cfg = gemma.GEMMA_TINY
+        params = dict(gemma.init(jax.random.key(1), cfg))
+        fam = GEMMA_FAMILY
+    else:
+        from kubeflow_tpu.models import llama_moe
+        cfg = llama_moe.MIXTRAL_TINY
+        params = dict(llama_moe.init(jax.random.key(1), cfg))
+        fam = MOE_LLAMA_FAMILY
+    engine = InferenceEngine(params, cfg, fam, EngineConfig(max_len=64))
+    gen = np.random.default_rng(50)
+    prompts = [gen.integers(0, cfg.vocab_size, n).tolist()
+               for n in (4, 9, 6)]
+    want = [_solo(engine, p, 5) for p in prompts]
+
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2,
+                                chunk=2)
+    got = await asyncio.gather(
+        *(batcher.submit(p, 5, ()) for p in prompts))
+    assert list(got) == want
+    await batcher.close()
