@@ -1027,3 +1027,34 @@ async def test_non_llama_families_through_the_slot_engine(family_name):
         *(batcher.submit(p, 5, ()) for p in prompts))
     assert list(got) == want
     await batcher.close()
+
+
+@pytest.mark.slow
+async def test_pipelined_depth2_with_chunked_prefill_and_prefixes():
+    """The depth-2 seam against round-4 admission features: chunked
+    long-prompt prefill and shared-prefix KV, interleaved with plain
+    requests, must stay token-exact while chunks dispatch ahead."""
+    engine, cfg = _engine(max_len=128)
+    gen = np.random.default_rng(60)
+    sys_prompt = gen.integers(0, cfg.vocab_size, 17).tolist()
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=3,
+                                chunk=2, pipeline_depth=2,
+                                prefill_chunk=8,
+                                prefixes={"sys": sys_prompt})
+    long_p = gen.integers(0, cfg.vocab_size, 21).tolist()
+    pref_p = gen.integers(0, cfg.vocab_size, 6).tolist()
+    plain = gen.integers(0, cfg.vocab_size, 5).tolist()
+    want_long = _solo(engine, long_p, 6)
+    want_pref = _solo(engine, sys_prompt + pref_p, 6)
+    want_plain = _solo(engine, plain, 6)
+    got_long, got_pref, got_plain = await asyncio.gather(
+        batcher.submit(long_p, 6, ()),
+        batcher.submit(pref_p, 6, (("prefix", "sys"),)),
+        batcher.submit(plain, 6, ()))
+    assert got_long == want_long
+    assert got_pref == want_pref
+    assert got_plain == want_plain
+    # churn: reuse slots under depth 2 once more
+    got2 = await batcher.submit(plain, 4, ())
+    assert got2 == _solo(engine, plain, 4)
+    await batcher.close()

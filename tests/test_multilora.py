@@ -169,3 +169,27 @@ async def test_rest_adapter_requests(setup):
                                 "adapter": "bob", "speculative": True})
     assert r.status == 400
     await client.close()
+
+
+@pytest.mark.slow
+async def test_adapters_under_pipelined_depth2(setup):
+    """Per-slot adapter ids must survive dispatch-ahead slot reuse: a
+    freed slot re-admitted with a DIFFERENT adapter while a chunk is
+    in flight must decode its own fine-tune, not its predecessor's."""
+    engine, params, adapters = setup
+    gen = np.random.default_rng(70)
+    p1 = gen.integers(0, CFG.vocab_size, 5).tolist()
+    p2 = gen.integers(0, CFG.vocab_size, 8).tolist()
+    want_alice = _merged_solo(params, adapters, "alice", p1, 5)
+    want_bob = _merged_solo(params, adapters, "bob", p2, 5)
+
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=1,
+                                chunk=2, pipeline_depth=2)
+    # max_slots=1 forces serial slot reuse with chunks in flight
+    got_alice = await batcher.submit(
+        p1, 5, (("adapter", "alice"),))
+    got_bob = await batcher.submit(
+        p2, 5, (("adapter", "bob"),))
+    assert got_alice == want_alice
+    assert got_bob == want_bob
+    await batcher.close()
