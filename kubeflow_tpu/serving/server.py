@@ -336,6 +336,7 @@ async def list_models(request: web.Request):
                 entry["occupancy"] = round(batcher.occupancy(), 3)
                 entry["pending"] = len(batcher._pending)
                 entry["active_slots"] = len(batcher._active)
+                entry["pipeline_depth"] = batcher.pipeline_depth
                 if batcher._prefixes:
                     entry["prefixes"] = {
                         n: len(t) for n, t in batcher._prefixes.items()}

@@ -235,6 +235,7 @@ async def test_rest_oneshot_and_models_card():
     assert card["batcher_mode"] == "continuous"
     assert card["batched_requests"] == 3
     assert card["occupancy"] > 0
+    assert card["pipeline_depth"] == 1  # backend-aware default on CPU
     await client.close()
 
 
