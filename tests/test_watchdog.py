@@ -154,3 +154,19 @@ def test_watch_skips_converged_stages(tmp_path, monkeypatch):
                   sleep=lambda s: None, clock=iter([0.0, 1.0]).__next__)
     assert rc == 0
     assert done.read_text() == "already captured"
+
+
+def test_default_stages_cover_the_evidence_chain(tmp_path):
+    """The watchdog's capture chain must stay bench -> remat ->
+    profile with repo-root artifacts — a renamed stage or output would
+    silently break the round-close evidence contract."""
+    stages = wd.default_stages(str(tmp_path), "/tmp/prof")
+    assert [s.name for s in stages] == ["bench", "remat", "profile"]
+    assert stages[0].out_path.endswith(wd.BENCH_OUT)
+    assert stages[1].out_path.endswith(wd.REMAT_OUT)
+    assert all(s.timeout_s >= 1800 for s in stages)
+    # bench stage refuses non-TPU evidence; remat requires the table
+    assert stages[0].postprocess('{"backend": "cpu-fallback"}') is None
+    assert stages[1].postprocess("no results here") is None
+    got = stages[1].postprocess("b8-mlp: 1 tok/s\nRESULTS: {'b8-mlp': 1}")
+    assert "RESULTS:" in got and "captured" in got
