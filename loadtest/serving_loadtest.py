@@ -57,14 +57,15 @@ cfg = llama.LLAMA_TINY
 params = llama.init(jax.random.key(0), cfg)
 eng = InferenceEngine(params, cfg, LLAMA_FAMILY, EngineConfig(max_len=128))
 app = srv.create_serving_app({{"tiny": eng}}, batch_window_ms={window_ms},
-                             continuous={continuous}, warmup={continuous})
+                             continuous={continuous}, warmup={continuous},
+                             pipeline_depth={pipeline_depth})
 web.run_app(app, host="127.0.0.1", port={port}, print=None)
 '''
 
 
 def run(clients: int, requests: int, max_new: int,
         window_ms: int, mode: str = "window",
-        spread: bool = False) -> dict:
+        spread: bool = False, pipeline_depth: int = 0) -> dict:
     import tempfile
 
     port = free_port()
@@ -73,7 +74,11 @@ def run(clients: int, requests: int, max_new: int,
     proc = subprocess.Popen(
         [sys.executable, "-c",
          SERVER_CODE.format(repo=REPO, port=port, window_ms=window_ms,
-                            continuous=(mode == "continuous"))],
+                            continuous=(mode == "continuous"),
+                            # unconditional: an invalid combination
+                            # must hit create_serving_app's loud
+                            # guard, not be silently dropped here
+                            pipeline_depth=(pipeline_depth or None))],
         stdout=log, stderr=subprocess.STDOUT)
     base = f"http://127.0.0.1:{port}"
 
@@ -187,6 +192,9 @@ def run(clients: int, requests: int, max_new: int,
             toks = occ1 * calls1 - occ0 * calls0
             out["occupancy"] = (round(toks / d_calls, 2)
                                 if d_calls else 0.0)
+            # record the depth the A/B ran at (0 = backend default) —
+            # two depth runs must be distinguishable from their JSON
+            out["pipeline_depth"] = pipeline_depth
         else:
             # coalescing evidence: >1 proves the batcher actually
             # merged concurrent requests during the timed window
@@ -215,11 +223,20 @@ def main() -> int:
     p.add_argument("--spread", action="store_true",
                    help="per-request max_new cycles 1/4x..1x of "
                         "--max-new (heterogeneous workload)")
+    p.add_argument("--pipeline-depth", type=int, default=0,
+                   help="continuous mode's dispatch-ahead depth "
+                        "(0 = backend-aware default) — the knob the "
+                        "depth-1-vs-2 A/B in docs/perf-notes.md used")
     args = p.parse_args()
     if args.requests < 2:
         p.error("--requests must be >= 2 (latency quantiles)")
+    if args.pipeline_depth and args.mode != "continuous":
+        p.error("--pipeline-depth requires --mode continuous")
+    if args.pipeline_depth < 0:
+        p.error("--pipeline-depth must be >= 0")
     result = run(args.clients, args.requests, args.max_new,
-                 args.batch_window_ms, args.mode, args.spread)
+                 args.batch_window_ms, args.mode, args.spread,
+                 pipeline_depth=args.pipeline_depth)
     print(json.dumps(result))
     return 0
 
