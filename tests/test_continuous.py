@@ -45,6 +45,7 @@ def test_bucket_pow2():
     assert bucket_pow2(100, 64) == 64
 
 
+@pytest.mark.slow
 def test_slot_step_matches_generate_mixed_cursors():
     """Device-level check, no asyncio: three prompts of different
     lengths admitted into different slots decode EXACTLY their solo
@@ -742,6 +743,7 @@ async def test_logprobs_shape_uniform_across_paths_with_eos():
     assert bodies["continuous"]["tokens"] == bodies["direct"]["tokens"]
 
 
+@pytest.mark.slow
 async def test_insert_failure_before_dispatch_spares_active_slots():
     """ADVICE r04: a host-side insert raise (donated state NOT consumed)
     must fail only the new admission — requests already decoding keep
@@ -775,6 +777,7 @@ async def test_insert_failure_before_dispatch_spares_active_slots():
     await batcher.close()
 
 
+@pytest.mark.slow
 async def test_insert_failure_after_dispatch_fails_actives_cleanly():
     """ADVICE r04: when the donated slot state WAS consumed by a failed
     insert, active requests must get a deterministic RuntimeError now —
@@ -877,6 +880,7 @@ async def test_stream_failure_terminal_error_direct_mode_too():
     await client.close()
 
 
+@pytest.mark.slow
 async def test_pipelined_depth2_tokens_identical_to_depth1():
     """Dispatch-ahead must never change WHAT is emitted — only when
     the host sees it. Same prompts, same budgets, both depths."""
@@ -894,6 +898,7 @@ async def test_pipelined_depth2_tokens_identical_to_depth1():
         await batcher.close()
 
 
+@pytest.mark.slow
 async def test_pipelined_eos_overshoot_is_bounded():
     """With depth 2, an EOS retirement may cost at most (depth-1) x
     chunk speculative steps beyond the depth-1 minimum — never an
@@ -923,6 +928,7 @@ async def test_pipelined_rejects_bad_depth():
         ContinuousBatcher(engine, asyncio.Lock(), pipeline_depth=0)
 
 
+@pytest.mark.slow
 async def test_async_device_failure_in_drain_path_fails_cleanly():
     """An async-dispatched chunk that FAILED on device reports ready
     and raises at materialization (the TPU failure mode). The drain
