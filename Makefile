@@ -1,7 +1,7 @@
 # Developer entry points (the reference drives everything through
 # per-component Makefiles; here one root Makefile covers the repo).
 
-.PHONY: test test-slow test-all e2e smoke conformance bench dryrun native verify-all
+.PHONY: test test-slow test-all e2e smoke conformance bench dryrun native verify-all obs-check
 
 verify-all:  ## the full evidence sweep, one command
 	python -m pytest tests -q -m "slow or not slow"
@@ -29,6 +29,9 @@ smoke:       ## boot the platform from the shipped overlay + e2e
 
 conformance: ## capability certification checks
 	python conformance/conformance.py
+
+obs-check:   ## strict /metrics parse + /debug/traces gate on a live app
+	python -m ci.obs_check
 
 bench:       ## perf sweep on the local device (CPU falls back safely)
 	python bench.py

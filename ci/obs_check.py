@@ -1,0 +1,354 @@
+"""Metrics-contract gate: scrape a live platform app, parse STRICTLY.
+
+`make obs-check` (and the observability CI workflow) boots the
+in-process Cluster + platform web app, generates traffic through all
+three instrumented layers it can reach on CPU (HTTP requests, notebook
+reconciles), then:
+
+  1. scrapes `/metrics` and runs it through `parse_exposition`, a
+     strict Prometheus text-format parser — HELP/TYPE coverage, label
+     escape round-trips, histogram invariants (cumulative nondecreasing
+     buckets ending at `+Inf` == `_count`, `_sum` present), duplicate
+     series detection;
+  2. pulls `/debug/traces` and checks it is Chrome-trace-loadable JSON
+     containing an `http.request` span.
+
+The parser is intentionally pedantic where Prometheus' own parser is
+forgiving: render bugs (a histogram that forgets `+Inf`, an unescaped
+quote in a label) should fail CI here, not corrupt dashboards later.
+Tests import `parse_exposition` directly (tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+
+# -- strict exposition parser -------------------------------------------
+
+
+class ExpositionError(ValueError):
+    """A violation of the exposition contract (line number included)."""
+
+
+def _unescape_label_value(raw: str, lineno: int) -> str:
+    out = []
+    i = 0
+    while i < len(raw):
+        c = raw[i]
+        if c == "\\":
+            if i + 1 >= len(raw):
+                raise ExpositionError(
+                    f"line {lineno}: dangling backslash in label value")
+            nxt = raw[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+            elif nxt == '"':
+                out.append('"')
+            elif nxt == "n":
+                out.append("\n")
+            else:
+                raise ExpositionError(
+                    f"line {lineno}: bad escape \\{nxt} in label value")
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_labels(body: str, lineno: int) -> dict[str, str]:
+    """Parse the inside of `{...}` honoring escapes; quotes/commas
+    inside label VALUES must not split pairs."""
+    labels: dict[str, str] = {}
+    i = 0
+    n = len(body)
+    while i < n:
+        eq = body.find("=", i)
+        if eq < 0:
+            raise ExpositionError(f"line {lineno}: label without '='")
+        name = body[i:eq].strip()
+        if not name or not name.replace("_", "a").isalnum():
+            raise ExpositionError(f"line {lineno}: bad label name {name!r}")
+        if eq + 1 >= n or body[eq + 1] != '"':
+            raise ExpositionError(
+                f"line {lineno}: label value for {name} not quoted")
+        j = eq + 2
+        while j < n:
+            if body[j] == "\\":
+                j += 2
+                continue
+            if body[j] == '"':
+                break
+            j += 1
+        if j >= n:
+            raise ExpositionError(
+                f"line {lineno}: unterminated label value for {name}")
+        if name in labels:
+            raise ExpositionError(f"line {lineno}: duplicate label {name}")
+        labels[name] = _unescape_label_value(body[eq + 2:j], lineno)
+        i = j + 1
+        if i < n:
+            if body[i] != ",":
+                raise ExpositionError(
+                    f"line {lineno}: expected ',' between labels, "
+                    f"got {body[i]!r}")
+            i += 1
+    return labels
+
+
+def _parse_value(raw: str, lineno: int) -> float:
+    if raw in ("+Inf", "Inf"):
+        return math.inf
+    if raw == "-Inf":
+        return -math.inf
+    try:
+        return float(raw)
+    except ValueError:
+        raise ExpositionError(
+            f"line {lineno}: unparseable sample value {raw!r}") from None
+
+
+_HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def parse_exposition(text: str) -> dict[str, dict]:
+    """Parse + validate a Prometheus text exposition.
+
+    Returns {family_name: {"type": str, "help": str, "samples":
+    {(sample_name, ((label, value), ...)): float}}}. Raises
+    ExpositionError on any contract violation.
+    """
+    families: dict[str, dict] = {}
+
+    def family_of(sample_name: str, lineno: int) -> dict:
+        if sample_name in families:
+            return families[sample_name]
+        for suffix in _HISTOGRAM_SUFFIXES:
+            base = sample_name.removesuffix(suffix)
+            if base != sample_name and base in families \
+                    and families[base]["type"] == "histogram":
+                return families[base]
+        raise ExpositionError(
+            f"line {lineno}: sample {sample_name!r} has no preceding "
+            "# TYPE declaration")
+
+    for lineno, line in enumerate(text.split("\n"), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line[len("# HELP "):].split(" ", 1)
+            fam = families.setdefault(
+                parts[0], {"type": None, "help": None, "samples": {}})
+            fam["help"] = parts[1] if len(parts) > 1 else ""
+            continue
+        if line.startswith("# TYPE "):
+            parts = line[len("# TYPE "):].split(" ", 1)
+            if len(parts) != 2 or parts[1] not in (
+                    "counter", "gauge", "histogram", "summary", "untyped"):
+                raise ExpositionError(f"line {lineno}: bad TYPE line")
+            fam = families.setdefault(
+                parts[0], {"type": None, "help": None, "samples": {}})
+            if fam["type"] is not None:
+                raise ExpositionError(
+                    f"line {lineno}: duplicate TYPE for {parts[0]}")
+            fam["type"] = parts[1]
+            continue
+        if line.startswith("#"):
+            continue  # comment
+        # sample line: name[{labels}] value
+        brace = line.find("{")
+        if brace >= 0:
+            close = line.rfind("}")
+            if close < brace:
+                raise ExpositionError(f"line {lineno}: unbalanced braces")
+            name = line[:brace]
+            labels = _parse_labels(line[brace + 1:close], lineno)
+            rest = line[close + 1:].strip()
+        else:
+            name, _, rest = line.partition(" ")
+            labels = {}
+            rest = rest.strip()
+        if not name or not rest or " " in rest:
+            raise ExpositionError(f"line {lineno}: malformed sample line")
+        fam = family_of(name, lineno)
+        if fam["type"] is None:
+            raise ExpositionError(
+                f"line {lineno}: sample {name!r} precedes its TYPE")
+        key = (name, tuple(sorted(labels.items())))
+        if key in fam["samples"]:
+            raise ExpositionError(
+                f"line {lineno}: duplicate series {name}{labels}")
+        fam["samples"][key] = _parse_value(rest, lineno)
+
+    for fname, fam in families.items():
+        if fam["type"] is None:
+            raise ExpositionError(f"family {fname}: HELP without TYPE")
+        if fam["help"] is None:
+            raise ExpositionError(f"family {fname}: TYPE without HELP")
+        if not fam["samples"]:
+            continue
+        if fam["type"] == "counter":
+            for (sname, labels), v in fam["samples"].items():
+                if v < 0:
+                    raise ExpositionError(
+                        f"counter {sname}{dict(labels)} is negative ({v})")
+        if fam["type"] == "histogram":
+            _check_histogram(fname, fam)
+    return families
+
+
+def _check_histogram(fname: str, fam: dict) -> None:
+    """Cumulative nondecreasing buckets, +Inf == _count, _sum present —
+    per label-set (le excluded)."""
+    by_labelset: dict[tuple, dict] = {}
+    for (sname, labels), v in fam["samples"].items():
+        ldict = dict(labels)
+        le = ldict.pop("le", None)
+        group = by_labelset.setdefault(
+            tuple(sorted(ldict.items())),
+            {"buckets": [], "sum": None, "count": None})
+        if sname == fname + "_bucket":
+            if le is None:
+                raise ExpositionError(f"{sname}: bucket without le label")
+            group["buckets"].append((_parse_value(le, 0), v))
+        elif sname == fname + "_sum":
+            group["sum"] = v
+        elif sname == fname + "_count":
+            group["count"] = v
+        else:
+            raise ExpositionError(
+                f"{sname}: unexpected sample in histogram {fname}")
+    for labelset, group in by_labelset.items():
+        where = f"histogram {fname}{dict(labelset)}"
+        if group["sum"] is None or group["count"] is None:
+            raise ExpositionError(f"{where}: missing _sum or _count")
+        if not group["buckets"]:
+            raise ExpositionError(f"{where}: no buckets")
+        les = [le for le, _ in group["buckets"]]
+        if les != sorted(les):
+            raise ExpositionError(f"{where}: buckets not in le order")
+        if len(set(les)) != len(les):
+            raise ExpositionError(f"{where}: duplicate le buckets")
+        counts = [c for _, c in group["buckets"]]
+        if any(b > a for b, a in zip(counts, counts[1:])):
+            raise ExpositionError(f"{where}: bucket counts not cumulative")
+        if les[-1] != math.inf:
+            raise ExpositionError(f"{where}: last bucket is not +Inf")
+        if counts[-1] != group["count"]:
+            raise ExpositionError(
+                f"{where}: +Inf bucket {counts[-1]} != _count "
+                f"{group['count']}")
+
+
+# -- the live scrape gate -----------------------------------------------
+
+REQUIRED_FAMILIES = (
+    "reconcile_duration_seconds",
+    "workqueue_queue_latency_seconds",
+    "workqueue_depth",
+    "request_duration_seconds",
+    "request_total",
+)
+
+
+async def run_check() -> list[str]:
+    """Boot Cluster + platform app, drive traffic, validate /metrics and
+    /debug/traces. Returns a list of failures (empty = pass)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu.api.core import Container, PodTemplateSpec
+    from kubeflow_tpu.api.crds import Notebook
+    from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
+
+    failures: list[str] = []
+    with Cluster(ClusterConfig(tpu_slices={"v5e-1": 2})) as cluster:
+        # control-plane traffic: reconcile a notebook end to end
+        nb = Notebook()
+        nb.metadata.name = "obs-check"
+        nb.metadata.namespace = "default"
+        nb.spec.template = PodTemplateSpec()
+        nb.spec.template.spec.containers.append(
+            Container(name="obs-check",
+                      image="kubeflow-tpu/jupyter-jax:latest"))
+        cluster.store.create(nb)
+        cluster.wait_idle()
+
+        app = cluster.create_web_app(csrf=False)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            # web traffic (auth-exempt paths: keep the gate hermetic)
+            for path in ("/healthz", "/healthz", "/readyz"):
+                resp = await client.get(path)
+                if resp.status != 200:
+                    failures.append(f"GET {path} -> {resp.status}")
+                if "X-Trace-Id" not in resp.headers:
+                    failures.append(f"GET {path}: no X-Trace-Id header")
+
+            resp = await client.get("/metrics")
+            text = await resp.text()
+            try:
+                families = parse_exposition(text)
+            except ExpositionError as e:
+                return [f"/metrics failed strict parse: {e}"]
+            for fam in REQUIRED_FAMILIES:
+                if fam not in families:
+                    failures.append(f"/metrics missing family {fam}")
+                elif not families[fam]["samples"]:
+                    failures.append(f"/metrics family {fam} has no samples")
+            recon = families.get("reconcile_duration_seconds")
+            if recon and not any(
+                    ("kind", "NotebookController") in labels
+                    for _, labels in recon["samples"]):
+                failures.append(
+                    "no NotebookController reconcile_duration samples — "
+                    "did the reconcile instrumentation regress?")
+            # Instrumentation must never break the instrumented path: a
+            # broken span call surfaces as reconcile errors here.
+            errs = families.get("reconcile_total", {"samples": {}})
+            for (sname, labels), v in errs["samples"].items():
+                if ("severity", "error") in labels and v > 0:
+                    failures.append(
+                        f"reconcile errors during the check: "
+                        f"{sname}{dict(labels)} = {v}")
+
+            resp = await client.get("/debug/traces")
+            if resp.content_type != "application/json":
+                failures.append(
+                    f"/debug/traces content type {resp.content_type}")
+            payload = json.loads(await resp.text())
+            events = payload.get("traceEvents")
+            if not isinstance(events, list) or not events:
+                failures.append("/debug/traces has no traceEvents")
+            else:
+                names = {e.get("name") for e in events}
+                if "http.request" not in names:
+                    failures.append(
+                        "/debug/traces missing http.request spans")
+                for e in events:
+                    if e.get("ph") != "X" or "ts" not in e or "dur" not in e:
+                        failures.append(
+                            f"malformed trace event: {e!r:.120}")
+                        break
+        finally:
+            await client.close()
+    return failures
+
+
+def main() -> int:
+    import asyncio
+
+    failures = asyncio.run(run_check())
+    if failures:
+        for f in failures:
+            print(f"obs-check FAIL: {f}", file=sys.stderr)
+        return 1
+    print("obs-check: /metrics strict-parses and /debug/traces is "
+          "Chrome-trace-loadable")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,6 +54,14 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         "paths": ["tools/**"],
         "tests": "python -m pytest tests/test_memplan.py -q",
     },
+    # Observability layer: unit tier plus the obs-check gate, which
+    # scrapes a LIVE platform app and strict-parses the exposition —
+    # render bugs fail here, not in a Prometheus dashboard later.
+    "observability": {
+        "paths": ["kubeflow_tpu/obs/**", "ci/obs_check.py"],
+        "tests": ("python -m pytest tests/test_obs.py -q && "
+                  "python -m ci.obs_check"),
+    },
     # The driver evidence pipeline (bench.py + __graft_entry__) runs its
     # FULL tier including the slow subprocess armoring tests: these are
     # the round-3-postmortem regression guards (wedged-TPU fallback,

@@ -84,7 +84,14 @@ class Cluster:
         self.webhook = PodDefaultWebhook(self.store)
         self.store.register_mutating_webhook("Pod", self.webhook)
         self.metrics = ControlPlaneMetrics(self.store)
-        self.manager = Manager(self.store, metrics=self.metrics)
+        # One tracer spans the whole control plane: reconcile spans and
+        # the web layer's request spans land in the same ring, so the
+        # dashboard's /debug/traces correlates them.
+        from kubeflow_tpu.obs import Tracer
+
+        self.tracer = Tracer()
+        self.manager = Manager(self.store, metrics=self.metrics,
+                               tracer=self.tracer)
         self.notebook_controller = NotebookController(
             use_routing=self.config.use_routing, metrics=self.metrics
         )
@@ -161,6 +168,7 @@ class Cluster:
 
         kwargs.setdefault("cluster_admins", self.cluster_admins)
         kwargs.setdefault("metrics", self.metrics)
+        kwargs.setdefault("tracer", self.tracer)
         return create_platform_app(self.store, **kwargs)
 
     def start(self) -> "Cluster":

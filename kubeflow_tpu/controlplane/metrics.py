@@ -21,6 +21,11 @@ import threading
 from typing import Callable, Iterable
 
 from kubeflow_tpu.controlplane.store import Store
+from kubeflow_tpu.obs.metrics import (
+    LATENCY_BUCKETS,
+    Histogram,
+    format_float,
+)
 
 
 def _escape_label_value(v: str) -> str:
@@ -58,11 +63,23 @@ class Counter:
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels: str) -> float:
-        return self._values.get(tuple(sorted(labels.items())), 0.0)
+        # Under the lock: a bare dict read races concurrent inc/set
+        # rehashing the table (CPython mostly saves us, but "mostly" is
+        # not a memory model — and PEP 703 builds drop the GIL).
+        with self._lock:
+            return self._values.get(tuple(sorted(labels.items())), 0.0)
 
     def samples(self) -> Iterable[tuple[dict[str, str], float]]:
         with self._lock:
             return [(dict(k), v) for k, v in self._values.items()]
+
+    def expositions(self) -> Iterable[tuple[str, dict[str, str], float]]:
+        """(sample_name, labels, value) in exposition order — the one
+        render protocol shared with obs.metrics.Histogram (which emits
+        _bucket/_sum/_count under this same hook)."""
+        for labels, v in sorted(self.samples(),
+                                key=lambda s: sorted(s[0].items())):
+            yield self.name, labels, v
 
     TYPE = "counter"
 
@@ -86,7 +103,19 @@ class Registry:
 
     def register(self, metric: Counter) -> None:
         with self._lock:
+            if any(m.name == metric.name for m in self._metrics):
+                raise ValueError(
+                    f"metric {metric.name!r} already registered")
             self._metrics.append(metric)
+
+    def get(self, name: str):
+        """The registered metric named `name`, or None — the
+        get-or-create hook obs.get_or_create_histogram builds on."""
+        with self._lock:
+            for m in self._metrics:
+                if m.name == name:
+                    return m
+        return None
 
     def register_collector(self, fn: Callable[[], None]) -> None:
         """`fn` refreshes gauges from live state; runs on every render
@@ -107,10 +136,9 @@ class Registry:
             # No samples yet → emit nothing (a synthetic unlabeled 0 would
             # create a timeseries that goes stale once labeled samples
             # appear; prometheus_client behaves the same way).
-            samples = sorted(m.samples(), key=lambda s: sorted(s[0].items()))
-            for labels, v in samples:
-                num = int(v) if float(v).is_integer() else v
-                lines.append(f"{m.name}{_fmt_labels(labels)} {num}")
+            for name, labels, v in m.expositions():
+                lines.append(
+                    f"{name}{_fmt_labels(labels)} {format_float(v)}")
         return "\n".join(lines) + "\n"
 
 
@@ -144,6 +172,25 @@ class ControlPlaneMetrics:
         self.request_total = Counter(
             "request_total", "HTTP requests by service/method/code "
             "(ref kfam/monitoring.go)", self.registry)
+        # Latency layer (ISSUE 1): the reference never measured how long
+        # anything took; these three are the control plane's hot paths.
+        self.reconcile_duration = Histogram(
+            "reconcile_duration_seconds",
+            "Reconcile wall time by controller kind", self.registry,
+            buckets=LATENCY_BUCKETS)
+        self.workqueue_latency = Histogram(
+            "workqueue_queue_latency_seconds",
+            "Time a key waited in a controller workqueue before a "
+            "worker picked it up", self.registry,
+            buckets=LATENCY_BUCKETS)
+        self.workqueue_depth = Gauge(
+            "workqueue_depth",
+            "Keys waiting (ready + delayed) per controller workqueue",
+            self.registry)
+        self.request_duration = Histogram(
+            "request_duration_seconds",
+            "Platform HTTP request latency by service/method",
+            self.registry, buckets=LATENCY_BUCKETS)
         self.registry.register_collector(self._scrape)
 
     def _scrape(self) -> None:
@@ -181,9 +228,19 @@ class ControlPlaneMetrics:
             kind=kind,
             severity=severity or ("info" if ok else "error"))
 
-    def record_request(self, service: str, method: str, code: int) -> None:
+    def record_reconcile_duration(self, kind: str, seconds: float) -> None:
+        self.reconcile_duration.observe(seconds, kind=kind)
+
+    def record_queue_latency(self, kind: str, seconds: float) -> None:
+        self.workqueue_latency.observe(seconds, kind=kind)
+
+    def record_request(self, service: str, method: str, code: int,
+                       seconds: float | None = None) -> None:
         self.request_total.inc(service=service, method=method,
                                code=str(code))
+        if seconds is not None:
+            self.request_duration.observe(seconds, service=service,
+                                          method=method)
 
 
 def scan_usage(store: Store) -> tuple[list[tuple[str, str]],
@@ -271,6 +328,14 @@ class MetricsHistory:
         if window_min not in self.WINDOWS_MIN:
             raise ValueError(
                 f"window must be one of {self.WINDOWS_MIN} minutes")
+        if not isinstance(live, bool) and not (
+                isinstance(live, (tuple, list)) and len(live) == 2
+                and all(isinstance(d, dict) for d in live)):
+            # Without this check a malformed tuple surfaces as an
+            # opaque TypeError deep inside pt() — name the contract.
+            raise ValueError(
+                "live must be True, False, or a (tpu_by_namespace, "
+                "notebooks_by_namespace) pair of dicts")
         now = self._clock()
         cutoff = now - window_min * 60
 

@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Callable
 
+from kubeflow_tpu import obs
 from kubeflow_tpu.api.core import Resource
 from kubeflow_tpu.controlplane.store import (
     Conflict,
@@ -74,14 +75,24 @@ class _WorkQueue:
         self._pending: set[Key] = set()
         self._delayed: dict[Key, float] = {}
         self._failures: dict[Key, int] = {}
+        self._added_at: dict[Key, float] = {}
         self._shutdown = False
+        # queue-latency hook (seconds a key sat ready before a worker
+        # took it); Manager wires it to the workqueue histogram.
+        self.on_latency = None
 
     def add(self, key: Key) -> None:
         with self._cond:
             if key not in self._pending:
                 self._pending.add(key)
                 self._ready.append(key)
+                self._added_at[key] = time.monotonic()
             self._cond.notify()
+
+    def depth(self) -> int:
+        """Keys waiting (ready + scheduled), the backlog gauge."""
+        with self._cond:
+            return len(self._ready) + len(self._delayed)
 
     def add_after(self, key: Key, delay: float) -> None:
         with self._cond:
@@ -112,9 +123,16 @@ class _WorkQueue:
                         if key not in self._pending:
                             self._pending.add(key)
                             self._ready.append(key)
+                            # latency clock starts when the key becomes
+                            # READY — a deliberate requeue_after delay
+                            # is scheduling, not queueing backlog
+                            self._added_at[key] = now
                 if self._ready:
                     key = self._ready.pop(0)
                     self._pending.discard(key)
+                    added = self._added_at.pop(key, None)
+                    if added is not None and self.on_latency is not None:
+                        self.on_latency(time.monotonic() - added)
                     return key
                 if self._shutdown or now >= deadline:
                     return None
@@ -133,18 +151,36 @@ class Manager:
     """Runs controllers against a store. start()/stop(), or use
     wait_idle() in tests for deterministic settling (envtest-style)."""
 
-    def __init__(self, store: Store, metrics=None):
+    def __init__(self, store: Store, metrics=None, tracer=None):
         self.store = store
         self.metrics = metrics   # ControlPlaneMetrics | None
+        self.tracer = tracer or obs.DEFAULT_TRACER
         self._controllers: list[tuple[Controller, _WorkQueue]] = []
         self._threads: list[threading.Thread] = []
         self._watch = None
         self._stop = threading.Event()
         self._active = 0
         self._active_cond = threading.Condition()
+        # Scrape-time depth gauge: one collector covers every queue,
+        # registered once (controllers added later are picked up — the
+        # collector walks the live list).
+        registry = getattr(metrics, "registry", None)
+        if registry is not None and hasattr(metrics, "workqueue_depth"):
+            registry.register_collector(self._scrape_queue_depth)
+
+    def _scrape_queue_depth(self) -> None:
+        for ctrl, wq in list(self._controllers):
+            self.metrics.workqueue_depth.set(
+                float(wq.depth()), kind=type(ctrl).__name__)
 
     def register(self, controller: Controller) -> None:
-        self._controllers.append((controller, _WorkQueue()))
+        wq = _WorkQueue()
+        if self.metrics is not None and hasattr(self.metrics,
+                                                "record_queue_latency"):
+            kind = type(controller).__name__
+            wq.on_latency = (
+                lambda s, _k=kind: self.metrics.record_queue_latency(_k, s))
+        self._controllers.append((controller, wq))
 
     def enqueue_all(self, kind: str, namespace: str | None = None) -> None:
         """Re-enqueue every primary of `kind` (the reference's fsnotify
@@ -217,8 +253,12 @@ class Manager:
                 continue
             with self._active_cond:
                 self._active += 1
+            t0 = time.perf_counter()
             try:
-                result = ctrl.reconcile(self.store, key[0], key[1])
+                with self.tracer.span("reconcile",
+                                      kind=type(ctrl).__name__,
+                                      namespace=key[0], name=key[1]):
+                    result = ctrl.reconcile(self.store, key[0], key[1])
             except Conflict:
                 # A conflict retry is neither success nor failure, but a
                 # sustained storm must be visible on reconcile_total.
@@ -249,6 +289,13 @@ class Manager:
                 if result and result.requeue_after:
                     wq.add_after(key, result.requeue_after)
             finally:
+                # Duration on every outcome (success, conflict, crash):
+                # a controller that only fails slowly must still show up
+                # in the latency histogram.
+                if self.metrics is not None and hasattr(
+                        self.metrics, "record_reconcile_duration"):
+                    self.metrics.record_reconcile_duration(
+                        type(ctrl).__name__, time.perf_counter() - t0)
                 with self._active_cond:
                     self._active -= 1
                     self._active_cond.notify_all()

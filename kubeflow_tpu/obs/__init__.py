@@ -1,0 +1,65 @@
+"""Unified observability layer: histograms + in-process span tracing.
+
+One import point for the three layers (control plane, serving, train):
+
+    from kubeflow_tpu import obs
+    with obs.DEFAULT_TRACER.span("reconcile", kind="Notebook"):
+        ...
+    obs.get_or_create_histogram(reg, "x_seconds", "...").observe(dt)
+
+`Histogram` registers into the EXISTING controlplane Registry (or any
+object with register()/get()); `Tracer` is standalone. The module-level
+defaults exist for components with no natural registry/tracer owner
+(the Trainer); apps that serve `/metrics` and `/debug/traces` should
+own their instances and pass them down (Cluster does).
+
+Import discipline: this package must not import controlplane at module
+scope — controlplane.metrics imports `obs.metrics` for its own
+histograms, and an eager reverse import would cycle. `default_registry`
+imports lazily instead.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.obs.metrics import (
+    LATENCY_BUCKETS,
+    SIZE_BUCKETS,
+    Histogram,
+    format_float,
+    get_or_create_histogram,
+)
+from kubeflow_tpu.obs.tracing import (
+    Span,
+    Tracer,
+    traces_response_payload,
+)
+
+__all__ = [
+    "LATENCY_BUCKETS",
+    "SIZE_BUCKETS",
+    "Histogram",
+    "Span",
+    "Tracer",
+    "DEFAULT_TRACER",
+    "default_registry",
+    "format_float",
+    "get_or_create_histogram",
+    "traces_response_payload",
+]
+
+# Process-wide default tracer: components without an injected tracer
+# (Trainer, ad-hoc scripts) share it, so one /debug/traces view can
+# correlate them.
+DEFAULT_TRACER = Tracer()
+
+_default_registry = None
+
+
+def default_registry():
+    """Lazy process-wide Registry (see import discipline above)."""
+    global _default_registry
+    if _default_registry is None:
+        from kubeflow_tpu.controlplane.metrics import Registry
+
+        _default_registry = Registry()
+    return _default_registry

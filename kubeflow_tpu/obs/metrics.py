@@ -1,0 +1,127 @@
+"""Histogram metric: Prometheus exposition-compatible latency buckets.
+
+The control plane's `Registry` (controlplane/metrics.py) renders any
+metric exposing `name`, `help`, `TYPE` and `expositions()`; Histogram
+is deliberately standalone (no controlplane import) so the serving and
+training layers can observe latencies without pulling the store in.
+
+Exposition follows the text format exactly: per label set, cumulative
+`_bucket{le="..."}` lines in ascending bucket order ending at
+`le="+Inf"` (== `_count`), then `_sum` and `_count`. `observe` is a
+single bisect + three additions under one lock — cheap enough for the
+serving hot path.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Iterator
+
+# Latency buckets (seconds): sub-ms workqueue pops through multi-second
+# compiles. The classic prometheus default, extended one decade down.
+LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+# Batch/queue-size buckets: powers of two up to the largest slot counts.
+SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
+
+
+def format_float(v: float) -> str:
+    """Prometheus-style number formatting: integral floats render with
+    one decimal place stripped to int-ish text (`1`, not `1.0`, for
+    counts; bucket bounds keep their written form via repr)."""
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+class Histogram:
+    """Cumulative histogram with optional labels.
+
+    `buckets` are upper bounds (exclusive of +Inf, which is implicit);
+    they must be strictly increasing. Per label set the state is
+    (per-bucket counts, sum, count) — cumulation happens at render so
+    observe stays O(log buckets).
+    """
+
+    TYPE = "histogram"
+
+    def __init__(self, name: str, help: str, registry=None,
+                 *, buckets: tuple[float, ...] = LATENCY_BUCKETS):
+        if not buckets:
+            raise ValueError("histogram needs at least one bucket")
+        bs = tuple(float(b) for b in buckets)
+        if any(b2 <= b1 for b1, b2 in zip(bs, bs[1:])):
+            raise ValueError(f"buckets must be strictly increasing: {bs}")
+        self.name = name
+        self.help = help
+        self.buckets = bs
+        self._lock = threading.Lock()
+        # label key -> [counts per bucket (+Inf last), sum, count]
+        self._data: dict[tuple[tuple[str, str], ...],
+                         tuple[list[int], list[float]]] = {}
+        if registry is not None:
+            registry.register(self)
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        i = bisect.bisect_left(self.buckets, float(value))
+        with self._lock:
+            row = self._data.get(key)
+            if row is None:
+                row = ([0] * (len(self.buckets) + 1), [0.0, 0.0])
+                self._data[key] = row
+            row[0][i] += 1
+            row[1][0] += float(value)
+            row[1][1] += 1.0
+
+    # -- read side ---------------------------------------------------------
+
+    def count(self, **labels: str) -> int:
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            row = self._data.get(key)
+            return int(row[1][1]) if row else 0
+
+    def sum(self, **labels: str) -> float:
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            row = self._data.get(key)
+            return row[1][0] if row else 0.0
+
+    def samples(self):
+        """(labels, count) pairs — the Counter-shaped view some generic
+        consumers (collectors resetting gauges) expect."""
+        with self._lock:
+            return [(dict(k), row[1][1]) for k, row in self._data.items()]
+
+    def expositions(self) -> Iterator[tuple[str, dict[str, str], float]]:
+        """(sample_name, labels, value) triples in exposition order."""
+        with self._lock:
+            snap = [(dict(k), [list(row[0]), list(row[1])])
+                    for k, row in sorted(self._data.items())]
+        for labels, (counts, sum_count) in snap:
+            acc = 0
+            for b, c in zip(self.buckets, counts):
+                acc += c
+                yield (f"{self.name}_bucket",
+                       {**labels, "le": format_float(b)}, float(acc))
+            acc += counts[-1]
+            yield (f"{self.name}_bucket", {**labels, "le": "+Inf"},
+                   float(acc))
+            yield f"{self.name}_sum", dict(labels), sum_count[0]
+            yield f"{self.name}_count", dict(labels), sum_count[1]
+
+
+def get_or_create_histogram(registry, name: str, help: str,
+                            *, buckets: tuple[float, ...] = LATENCY_BUCKETS
+                            ) -> Histogram:
+    """Idempotent registration: several Trainer/app instances sharing a
+    registry (the module default) must not register duplicate series."""
+    existing = registry.get(name)
+    if existing is not None:
+        if not isinstance(existing, Histogram):
+            raise ValueError(
+                f"metric {name!r} already registered as {existing.TYPE}")
+        return existing
+    return Histogram(name, help, registry, buckets=buckets)

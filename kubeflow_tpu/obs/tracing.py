@@ -1,0 +1,228 @@
+"""In-process span tracer: contextvar parent propagation, bounded trace
+ring, Chrome-trace JSON export.
+
+The platform's cross-layer latency story (ISSUE 1): reconcile loops,
+serving requests and train steps all open spans through one Tracer, so
+`/debug/traces` can show a serving request's child spans next to the
+reconcile that scheduled its pod. No OpenTelemetry dependency — traces
+stay in a process-local ring and export as Chrome trace events
+(`chrome://tracing` / Perfetto load them directly); the XLA profiler
+(utils/profiling.py) remains the inside-the-step microscope, these
+spans are the between-steps map.
+
+Propagation is `contextvars`, so spans nest correctly across asyncio
+tasks (each request handler is its own context) and plain call stacks.
+A span opened with no current parent starts a new trace; finishing a
+root span commits the whole trace to the ring (oldest trace evicted
+first).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import secrets
+import threading
+import time
+from typing import Any, Callable, Iterator
+
+# Spans per trace are bounded too: a runaway loop opening child spans
+# must not grow one trace without limit while it stays unfinished.
+MAX_SPANS_PER_TRACE = 512
+
+
+class Span:
+    """One timed operation. `start`/`end` are epoch seconds."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start",
+                 "end", "attrs", "thread", "_trace")
+
+    def __init__(self, name: str, trace_id: str, span_id: str,
+                 parent_id: str | None, start: float,
+                 attrs: dict[str, Any], trace: "_Trace"):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start = start
+        self.end: float | None = None
+        self.attrs = attrs
+        self.thread = threading.get_ident()
+        self._trace = trace
+
+    @property
+    def duration(self) -> float:
+        return (self.end if self.end is not None else self.start) - self.start
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "start": self.start,
+            "durationMs": round(self.duration * 1e3, 3),
+            "attrs": dict(self.attrs),
+        }
+
+
+class _Trace:
+    """Finished-span collector for one trace id (root + descendants)."""
+
+    __slots__ = ("trace_id", "spans", "root", "seq")
+
+    def __init__(self, trace_id: str, seq: int):
+        self.trace_id = trace_id
+        self.spans: list[Span] = []
+        self.root: Span | None = None
+        self.seq = seq  # monotonic commit order (newest-first sorting)
+
+    def add(self, span: Span) -> None:
+        if len(self.spans) < MAX_SPANS_PER_TRACE:
+            self.spans.append(span)
+
+
+class Tracer:
+    """`with tracer.span("name", key=value): ...`
+
+    Thread-safe; each Tracer owns its ring so tests and independently
+    deployed apps stay isolated. `max_traces` bounds memory — the ring
+    evicts the OLDEST finished trace first.
+    """
+
+    def __init__(self, max_traces: int = 256,
+                 clock: Callable[[], float] | None = None):
+        import collections
+
+        self.max_traces = max_traces
+        self._clock = clock or time.time
+        self._traces: "collections.deque[_Trace]" = collections.deque(
+            maxlen=max_traces)
+        self._lock = threading.Lock()
+        self._seq = itertools.count()
+        self._current: contextvars.ContextVar[Span | None] = \
+            contextvars.ContextVar(f"obs_span_{id(self)}", default=None)
+
+    # -- span lifecycle ----------------------------------------------------
+
+    def current_span(self) -> Span | None:
+        return self._current.get()
+
+    def current_trace_id(self) -> str | None:
+        s = self._current.get()
+        return s.trace_id if s is not None else None
+
+    @contextlib.contextmanager
+    def span(self, name: str, /, **attrs: Any) -> Iterator[Span]:
+        # positional-only `name`: attrs are arbitrary key=value pairs
+        # and "name" is a natural attr key (reconcile object names).
+        parent = self._current.get()
+        if parent is None:
+            trace = _Trace(secrets.token_hex(16), next(self._seq))
+            trace_id, parent_id = trace.trace_id, None
+        else:
+            trace = parent._trace
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        s = Span(name, trace_id, secrets.token_hex(8), parent_id,
+                 self._clock(), dict(attrs), trace)
+        token = self._current.set(s)
+        try:
+            yield s
+        except BaseException as e:
+            s.attrs.setdefault("error", type(e).__name__)
+            raise
+        finally:
+            s.end = self._clock()
+            self._current.reset(token)
+            trace.add(s)
+            if parent is None:
+                trace.root = s
+                with self._lock:
+                    self._traces.append(trace)
+
+    def wrap(self, fn: Callable, name: str, /, **attrs: Any) -> Callable:
+        """Propagate the CURRENT context into a thread-pool callable
+        (run_in_executor does not copy contextvars): the returned
+        closure re-enters this context and opens `name` inside it, so
+        device work dispatched to an executor still nests under the
+        request's root span."""
+        ctx = contextvars.copy_context()
+
+        def run(*args, **kwargs):
+            def inner():
+                with self.span(name, **attrs):
+                    return fn(*args, **kwargs)
+
+            return ctx.run(inner)
+
+        return run
+
+    # -- read side ---------------------------------------------------------
+
+    def traces(self, name: str | None = None,
+               limit: int | None = None) -> list[dict[str, Any]]:
+        """Finished traces, NEWEST first, optionally filtered by root
+        span name. Each entry: trace summary + its spans."""
+        with self._lock:
+            snap = list(self._traces)
+        snap.sort(key=lambda t: t.seq, reverse=True)
+        out = []
+        for t in snap:
+            root = t.root
+            if root is None:
+                continue
+            if name is not None and root.name != name:
+                continue
+            out.append({
+                "traceId": t.trace_id,
+                "name": root.name,
+                "start": root.start,
+                "durationMs": round(root.duration * 1e3, 3),
+                "spans": [s.to_dict() for s in t.spans],
+            })
+            if limit is not None and len(out) >= limit:
+                break
+        return out
+
+    def chrome_trace(self, name: str | None = None,
+                     limit: int | None = None) -> dict[str, Any]:
+        """Chrome trace-event JSON (the `chrome://tracing` / Perfetto
+        load format): one complete ("ph": "X") event per span, ts/dur
+        in microseconds, traces ordered newest first. `args` carries
+        the span attrs plus trace/span ids so events remain joinable
+        back to `X-Trace-Id` response headers."""
+        events = []
+        for t in self.traces(name=name, limit=limit):
+            for s in t["spans"]:
+                events.append({
+                    "name": s["name"],
+                    "cat": "obs",
+                    "ph": "X",
+                    "ts": round(s["start"] * 1e6, 1),
+                    "dur": round(s["durationMs"] * 1e3, 1),
+                    "pid": 1,
+                    "tid": 1,
+                    "args": {
+                        "trace_id": s["traceId"],
+                        "span_id": s["spanId"],
+                        "parent_id": s["parentId"],
+                        **s["attrs"],
+                    },
+                })
+        return {"displayTimeUnit": "ms", "traceEvents": events}
+
+
+def traces_response_payload(tracer: Tracer, query) -> dict[str, Any]:
+    """Shared `/debug/traces` handler body for the dashboard and
+    serving apps: `?name=` filters by root span name, `?limit=` caps
+    trace count (default 100), `?format=summary` returns the span-tree
+    summaries instead of Chrome events."""
+    name = query.get("name") or None
+    try:
+        limit = int(query.get("limit", "100"))
+    except ValueError as e:
+        raise ValueError(f"limit must be an integer: {e}") from None
+    if query.get("format") == "summary":
+        return {"traces": tracer.traces(name=name, limit=limit)}
+    return tracer.chrome_trace(name=name, limit=limit)

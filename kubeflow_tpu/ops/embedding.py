@@ -24,8 +24,8 @@ from kubeflow_tpu.parallel import mesh as mesh_lib
 def embed_lookup(table: jnp.ndarray, tokens: jnp.ndarray,
                  dtype) -> jnp.ndarray:
     """tokens [..., s] int32 -> activations [..., s, embed] in `dtype`."""
-    mesh = jax.sharding.get_abstract_mesh()
-    sharded = any(
+    mesh = mesh_lib.get_abstract_mesh()
+    sharded = mesh is not None and any(
         mesh.shape.get(ax, 1) > 1
         for ax in (mesh_lib.FSDP_AXIS, mesh_lib.TENSOR_AXIS)
     )

@@ -11,8 +11,10 @@ from kubeflow_tpu.parallel.mesh import (
     SLICE_TOPOLOGIES,
     create_hybrid_mesh,
     create_mesh,
+    get_abstract_mesh,
     mesh_from_env,
     num_slices_from_env,
+    set_mesh,
 )
 from kubeflow_tpu.parallel.sharding import (
     ShardingRules,

@@ -143,7 +143,7 @@ def ring_attention_sharded(
             f"{seq_axis}={n}"
         )
     spec = P(None, seq_axis, None, None)
-    fn = jax.shard_map(
+    fn = mesh_lib.shard_map(
         functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -332,7 +332,7 @@ def ring_flash_attention_sharded(
             f"{seq_axis}={n}"
         )
     spec = P(None, seq_axis, None, None)
-    fn = jax.shard_map(
+    fn = mesh_lib.shard_map(
         functools.partial(ring_flash_attention, axis_name=seq_axis,
                           causal=causal),
         mesh=mesh,
@@ -414,7 +414,7 @@ def ulysses_attention_sharded(
 ) -> jnp.ndarray:
     """shard_map wrapper for `ulysses_attention` (see ring_attention_sharded)."""
     spec = P(None, seq_axis, None, None)
-    fn = jax.shard_map(
+    fn = mesh_lib.shard_map(
         functools.partial(ulysses_attention, axis_name=seq_axis,
                           causal=causal, impl=impl),
         mesh=mesh,

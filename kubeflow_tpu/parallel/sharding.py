@@ -101,14 +101,21 @@ def _filter_spec_to_mesh(spec: P) -> P:
     definition, so dropping them is the correct meaning of the
     constraint, not a silent loss (typos are still caught earlier by
     rules.resolve on the LOGICAL name)."""
-    mesh = jax.sharding.get_abstract_mesh()
-    if not getattr(mesh, "axis_names", ()):
+    mesh = mesh_lib.get_abstract_mesh()
+    if mesh is None:
         return spec  # no mesh context; with_sharding_constraint will no-op
-    auto = {
-        name
-        for name, t in zip(mesh.axis_names, mesh.axis_types)
-        if t == jax.sharding.AxisType.Auto
-    }
+    axis_types = getattr(mesh, "axis_types", None)
+    axis_type_cls = getattr(jax.sharding, "AxisType", None)
+    if axis_types and axis_type_cls is not None:
+        auto = {
+            name
+            for name, t in zip(mesh.axis_names, axis_types)
+            if t == axis_type_cls.Auto
+        }
+    else:
+        # legacy global-mesh context (pre-AxisType jax): every axis is
+        # auto-sharded, so only filter axes absent from the mesh
+        auto = set(mesh.axis_names)
 
     def filt(entry):
         if entry is None:

@@ -14,6 +14,7 @@ import os
 from typing import Any, Callable
 
 import jax
+from jax import export as jax_export  # submodule; not an auto-imported jax attr
 
 
 def export_stablehlo(
@@ -25,7 +26,7 @@ def export_stablehlo(
 
     Returns the artifact size in bytes. Reload with `load_stablehlo`.
     """
-    exported = jax.export.export(jax.jit(fn))(*example_args)
+    exported = jax_export.export(jax.jit(fn))(*example_args)
     blob = exported.serialize()
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "wb") as f:
@@ -36,7 +37,7 @@ def export_stablehlo(
 def load_stablehlo(path: str):
     """Deserialize an exported artifact; `.call(*args)` runs it."""
     with open(path, "rb") as f:
-        return jax.export.deserialize(f.read())
+        return jax_export.deserialize(f.read())
 
 
 def export_saved_model(

@@ -21,12 +21,14 @@ from __future__ import annotations
 import asyncio
 import logging
 import math
+import time
 from typing import Any
 
 import jax.numpy as jnp
 import numpy as np
 from aiohttp import web
 
+from kubeflow_tpu import obs as obs_lib
 from kubeflow_tpu.serving.continuous import (
     ContinuousBatcher,
     Overloaded,
@@ -56,6 +58,81 @@ GPU_LOCK_KEY: web.AppKey = web.AppKey("gpu_lock", asyncio.Lock)
 TOKENIZER_KEY: web.AppKey = web.AppKey("tokenizer", object)
 BATCHERS_KEY: web.AppKey = web.AppKey("batchers", dict)
 SPEC_KEY: web.AppKey = web.AppKey("speculative", dict)
+OBS_KEY: web.AppKey = web.AppKey("obs", object)
+
+
+class ServingObs:
+    """Per-app observability bundle: metric registry + span tracer +
+    the serving hot-path histograms (ISSUE 1). `/metrics` renders the
+    registry, `/debug/traces` exports the tracer's ring; every request
+    carries its trace id back in `X-Trace-Id`."""
+
+    def __init__(self, registry=None, tracer=None):
+        # controlplane.metrics is pure Python (no jax/store state is
+        # touched here) — the ONE Registry implementation serves all
+        # three layers rather than a drifted serving copy.
+        from kubeflow_tpu.controlplane.metrics import Registry
+
+        self.registry = registry if registry is not None else Registry()
+        self.tracer = tracer if tracer is not None else obs_lib.Tracer()
+        self.request_latency = obs_lib.get_or_create_histogram(
+            self.registry, "serving_request_duration_seconds",
+            "Serving HTTP request latency by route/method")
+        self.ttft = obs_lib.get_or_create_histogram(
+            self.registry, "serving_time_to_first_token_seconds",
+            "Request arrival to first generated token, per model "
+            "(streaming: first token on the wire; one-shot: full "
+            "generation, an upper bound)")
+        self.batch_size = obs_lib.get_or_create_histogram(
+            self.registry, "serving_batch_size",
+            "Requests co-scheduled per engine invocation",
+            buckets=obs_lib.SIZE_BUCKETS)
+
+
+_OBS_T0 = "obs_request_start"
+_OBS_TTFT_DONE = "obs_ttft_recorded"
+
+
+def _observe_first_token(request: web.Request, model: str) -> None:
+    """Record time-to-first-token ONCE per request (stream paths call
+    on the first emitted token; the one-shot path after generate)."""
+    sobs = request.app.get(OBS_KEY)
+    t0 = request.get(_OBS_T0)
+    if sobs is None or t0 is None or request.get(_OBS_TTFT_DONE):
+        return
+    request[_OBS_TTFT_DONE] = True
+    sobs.ttft.observe(time.perf_counter() - t0, model=model)
+
+
+@web.middleware
+async def _obs_middleware(request: web.Request, handler):
+    """Root span + latency histogram + X-Trace-Id for every serving
+    response. Routes label by PATTERN (`/v1/models/{name}:generate`),
+    never raw path — label cardinality must not scale with model names
+    scanners probe for."""
+    sobs: ServingObs = request.app[OBS_KEY]
+    resource = getattr(request.match_info.route, "resource", None)
+    route = getattr(resource, "canonical", None) or "unmatched"
+    request[_OBS_T0] = time.perf_counter()
+    status = 500
+    with sobs.tracer.span("http.request", method=request.method,
+                          route=route) as span:
+        try:
+            resp = await handler(request)
+            status = resp.status
+            span.attrs["status"] = status
+            if not resp.prepared:  # stream paths set it pre-prepare
+                resp.headers.setdefault("X-Trace-Id", span.trace_id)
+            return resp
+        except web.HTTPException as exc:
+            status = exc.status
+            span.attrs["status"] = status
+            exc.headers.setdefault("X-Trace-Id", span.trace_id)
+            raise
+        finally:
+            sobs.request_latency.observe(
+                time.perf_counter() - request[_OBS_T0],
+                route=route, method=request.method)
 
 
 class Batcher:
@@ -78,6 +155,7 @@ class Batcher:
         self.max_batch = max_batch
         self.calls = 0            # engine invocations (observability)
         self.requests = 0         # successfully batched requests
+        self.on_batch = None      # hook(batch_size) per successful group
         self._queue: asyncio.Queue = asyncio.Queue()
         self._worker: asyncio.Task | None = None
         self._inflight: list = []  # dequeued but unresolved (see close)
@@ -179,6 +257,8 @@ class Batcher:
                     None, run)
             self.calls += 1
             self.requests += len(items)  # mean batch = requests/calls
+            if self.on_batch is not None:
+                self.on_batch(len(items))
             for i, (_, mn, _, fut) in enumerate(items):
                 if not fut.done():
                     fut.set_result(out[i, :mn].tolist())
@@ -217,6 +297,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                        max_pending: int | None = None,
                        pipeline_depth: int | None = None,
                        drafts: dict[str, InferenceEngine] | None = None,
+                       registry=None, tracer=None,
                        ) -> web.Application:
     """`tokenizer` (data.bpe.Tokenizer or anything with encode/decode)
     serves the "text" request mode; without one, the zero-training
@@ -231,8 +312,12 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     readiness implies no first-arrival compile stalls — startup takes
     correspondingly longer. `drafts` maps model names to draft
     engines; a request with "speculative": true then decodes through
-    SpeculativeEngine (latency lever; batch 1)."""
-    app = web.Application()
+    SpeculativeEngine (latency lever; batch 1). `registry`/`tracer`
+    share an external metric registry / span tracer; by default the app
+    owns fresh ones, exposed at `/metrics` and `/debug/traces`."""
+    app = web.Application(middlewares=[_obs_middleware])
+    sobs = ServingObs(registry=registry, tracer=tracer)
+    app[OBS_KEY] = sobs
     app[ENGINES_KEY] = engines
     unknown = set(drafts or {}) - set(engines)
     if unknown:
@@ -290,14 +375,31 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                            max_batch=max_batch)
              for name, eng in engines.items()}
             if batch_window_ms > 0 else {})
+    for model_name, b in app[BATCHERS_KEY].items():
+        if isinstance(b, Batcher):
+            # coalescing evidence as a histogram, not just the
+            # calls/requests counters list_models reports
+            b.on_batch = (lambda n, _m=model_name:
+                          sobs.batch_size.observe(n, model=_m))
 
     async def _close_batchers(app_):
         for b in app_[BATCHERS_KEY].values():
             await b.close()
 
     app.on_cleanup.append(_close_batchers)
+
+    async def render_metrics(_request):
+        return web.Response(text=sobs.registry.render(),
+                            content_type="text/plain")
+
+    async def debug_traces(request):
+        return web.json_response(obs_lib.traces_response_payload(
+            sobs.tracer, request.rel_url.query))
+
     app.router.add_get("/healthz", _ok)
     app.router.add_get("/readyz", _ok)
+    app.router.add_get("/metrics", render_metrics)
+    app.router.add_get("/debug/traces", debug_traces)
     app.router.add_get("/v1/models", list_models)
     app.router.add_post("/v1/models/{name}:generate", generate)
     app.router.add_post("/v1/models/{name}:score", score)
@@ -370,41 +472,52 @@ async def _stream_generate(request, engine, arr, max_new, sampling,
             **sampling)
     except ValueError as e:
         return web.json_response({"error": str(e)}, status=400)
-    resp = web.StreamResponse(headers={
+    sobs = request.app[OBS_KEY]
+    model = request.match_info.get("name", "")
+    headers = {
         "Content-Type": "text/event-stream",
         "Cache-Control": "no-cache",
         "X-Accel-Buffering": "no",
-    })
+    }
+    # The obs middleware cannot add headers after prepare(); stream
+    # responses carry their trace id from birth.
+    trace_id = sobs.tracer.current_trace_id()
+    if trace_id:
+        headers["X-Trace-Id"] = trace_id
+    resp = web.StreamResponse(headers=headers)
     await resp.prepare(request)
     loop = asyncio.get_event_loop()
     chunks: list[np.ndarray] = []
     error: str | None = None
-    while True:
-        # Lock only around the device work, NOT the client write: a
-        # slow-reading client must back-pressure its own stream, never
-        # stall every other request behind the GPU lock. Other requests
-        # interleave between chunks (each chunk call is self-contained).
-        try:
-            async with request.app[GPU_LOCK_KEY]:
-                part = await loop.run_in_executor(
-                    None, lambda: next(gen, None))
-        except Exception as e:  # noqa: BLE001
-            # Same terminal-event contract as _stream_continuous:
-            # headers are out, so raising would abort the connection
-            # indistinguishably from a network drop. Log server-side —
-            # the raise-through path used to leave an aiohttp
-            # traceback, and a device falling over mid-stream must
-            # stay diagnosable from the server logs.
-            logging.getLogger(__name__).exception(
-                "decode failed mid-stream")
-            error = f"{type(e).__name__}: {e}"
-            break
-        if part is None:
-            break
-        chunks.append(part)
-        await resp.write(
-            b"data: " + _json.dumps(
-                {"tokens": part.tolist()}).encode() + b"\n\n")
+    with sobs.tracer.span("stream.decode", model=model):
+        while True:
+            # Lock only around the device work, NOT the client write: a
+            # slow-reading client must back-pressure its own stream,
+            # never stall every other request behind the GPU lock.
+            # Other requests interleave between chunks (each chunk call
+            # is self-contained).
+            try:
+                async with request.app[GPU_LOCK_KEY]:
+                    part = await loop.run_in_executor(
+                        None, lambda: next(gen, None))
+            except Exception as e:  # noqa: BLE001
+                # Same terminal-event contract as _stream_continuous:
+                # headers are out, so raising would abort the connection
+                # indistinguishably from a network drop. Log server-side
+                # — the raise-through path used to leave an aiohttp
+                # traceback, and a device falling over mid-stream must
+                # stay diagnosable from the server logs.
+                logging.getLogger(__name__).exception(
+                    "decode failed mid-stream")
+                error = f"{type(e).__name__}: {e}"
+                break
+            if part is None:
+                break
+            chunks.append(part)
+            _observe_first_token(request, model)
+            await resp.write(
+                b"data: " + _json.dumps(
+                    {"tokens": part.tolist()}).encode() + b"\n\n")
     total = int(sum(c.shape[1] for c in chunks))
     if error is not None:
         final: dict[str, Any] = {"error": error, "total": total}
@@ -438,23 +551,31 @@ async def _stream_continuous(request, batcher, arr, max_new, sampling,
         return web.json_response(
             {"error": f"server overloaded: {e}"}, status=429,
             headers={"Retry-After": "1"})
-    resp = web.StreamResponse(headers={
+    sobs = request.app[OBS_KEY]
+    model = request.match_info.get("name", "")
+    headers = {
         "Content-Type": "text/event-stream",
         "Cache-Control": "no-cache",
         "X-Accel-Buffering": "no",
-    })
+    }
+    trace_id = sobs.tracer.current_trace_id()
+    if trace_id:
+        headers["X-Trace-Id"] = trace_id
+    resp = web.StreamResponse(headers=headers)
     await resp.prepare(request)
     ids: list[int] = []
     error: str | None = None
     try:
-        while True:
-            tok = await q.get()
-            if tok is None:
-                break
-            ids.append(tok)
-            await resp.write(
-                b"data: " + _json.dumps({"tokens": [[tok]]}).encode()
-                + b"\n\n")
+        with sobs.tracer.span("stream.continuous", model=model):
+            while True:
+                tok = await q.get()
+                if tok is None:
+                    break
+                ids.append(tok)
+                _observe_first_token(request, model)
+                await resp.write(
+                    b"data: " + _json.dumps({"tokens": [[tok]]}).encode()
+                    + b"\n\n")
         try:
             await fut  # surface admission/step errors after drain
         except Exception as e:  # noqa: BLE001
@@ -555,9 +676,14 @@ async def score(request: web.Request):
         return web.json_response(
             {"error": f"token ids must be in [0, {vocab})"}, status=400)
 
-    async with request.app[GPU_LOCK_KEY]:
-        lps = await asyncio.get_event_loop().run_in_executor(
-            None, lambda: np.asarray(engine.score(jnp.asarray(arr))))
+    sobs: ServingObs = request.app[OBS_KEY]
+    with sobs.tracer.span("engine.score", model=name,
+                          batch=int(arr.shape[0])):
+        async with request.app[GPU_LOCK_KEY]:
+            lps = await asyncio.get_event_loop().run_in_executor(
+                None, sobs.tracer.wrap(
+                    lambda: np.asarray(engine.score(jnp.asarray(arr))),
+                    "device.score"))
     return web.json_response({
         "logprobs": [[round(float(x), 6) for x in row] for row in lps],
         "total": [round(float(row.sum()), 6) for row in lps],
@@ -795,9 +921,14 @@ async def generate(request: web.Request):
                 **sampling)
             return np.asarray(toks_), stats
 
-        async with request.app[GPU_LOCK_KEY]:
-            toks, stats = await asyncio.get_event_loop().run_in_executor(
-                None, run_spec)
+        sobs: ServingObs = request.app[OBS_KEY]
+        with sobs.tracer.span("engine.speculative", model=name,
+                              gamma=gamma, max_new=max_new):
+            async with request.app[GPU_LOCK_KEY]:
+                toks, stats = await asyncio.get_event_loop(
+                ).run_in_executor(
+                    None, sobs.tracer.wrap(run_spec, "device.generate"))
+        _observe_first_token(request, name)
         # SpeculativeEngine does not special-case EOS; match the plain
         # path's contract (post-EOS tail pinned to EOS) server-side so
         # the two modes are interchangeable for clients.
@@ -831,22 +962,26 @@ async def generate(request: web.Request):
             # batcher runs its group to the group max and the shared
             # post-trim below applies the semantics
             submit_sampling["stop"] = tuple(tuple(s) for s in stop)
+        sobs: ServingObs = request.app[OBS_KEY]
         try:
-            if logprobs and isinstance(batcher, ContinuousBatcher):
-                ids, req_lps = await batcher.submit(
-                    arr[0].tolist(), max_new_req,
-                    tuple(sorted(submit_sampling.items())),
-                    with_logprobs=True)
-                lp_rows = [list(req_lps)]
-            else:
-                ids = await batcher.submit(
-                    arr[0].tolist(), max_new_req,
-                    tuple(sorted(submit_sampling.items())))
-                lp_rows = None
+            with sobs.tracer.span("batcher.submit", model=name,
+                                  max_new=max_new_req):
+                if logprobs and isinstance(batcher, ContinuousBatcher):
+                    ids, req_lps = await batcher.submit(
+                        arr[0].tolist(), max_new_req,
+                        tuple(sorted(submit_sampling.items())),
+                        with_logprobs=True)
+                    lp_rows = [list(req_lps)]
+                else:
+                    ids = await batcher.submit(
+                        arr[0].tolist(), max_new_req,
+                        tuple(sorted(submit_sampling.items())))
+                    lp_rows = None
         except Overloaded as e:
             return web.json_response(
                 {"error": f"server overloaded: {e}"}, status=429,
                 headers={"Retry-After": "1"})
+        _observe_first_token(request, name)
         toks = np.asarray([ids], np.int32)
     else:
         if adapter:
@@ -860,9 +995,16 @@ async def generate(request: web.Request):
                 return np.asarray(t), np.asarray(lp)
             return np.asarray(out), None
 
-        async with request.app[GPU_LOCK_KEY]:
-            toks, lp_arr = await asyncio.get_event_loop(
-            ).run_in_executor(None, run_direct)
+        sobs = request.app[OBS_KEY]
+        with sobs.tracer.span("engine.generate", model=name,
+                              batch=int(arr.shape[0]),
+                              max_new=max_new):
+            async with request.app[GPU_LOCK_KEY]:
+                toks, lp_arr = await asyncio.get_event_loop(
+                ).run_in_executor(
+                    None, sobs.tracer.wrap(run_direct, "device.generate"))
+        sobs.batch_size.observe(arr.shape[0], model=name)
+        _observe_first_token(request, name)
         lp_rows = (lp_arr[:, :max_new_req].tolist()
                    if lp_arr is not None else None)
     toks = toks[:, :max_new_req]  # trim the bucket back to the ask

@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import time
 from typing import Any, Callable
 
 import jax
@@ -22,6 +23,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kubeflow_tpu import obs
+from kubeflow_tpu.parallel import mesh as mesh_lib
 from kubeflow_tpu.parallel import sharding as sharding_lib
 from kubeflow_tpu.parallel.sharding import ShardingRules
 
@@ -217,6 +220,8 @@ class Trainer:
         train_config: TrainConfig = TrainConfig(),
         loss_fn: Callable[..., jnp.ndarray] | None = None,
         freeze_labels: Params | None = None,
+        tracer=None,
+        registry=None,
     ):
         """`loss_fn(params, tokens, targets, mask) -> scalar` overrides
         the default apply_fn→cross-entropy pipeline — e.g.
@@ -273,6 +278,24 @@ class Trainer:
             out_shardings=(self.state_shardings, NamedSharding(mesh, P())),
             donate_argnums=(0,),
         )
+        # Obs bridge (spans + /metrics histograms). The Trainer has no
+        # natural registry owner, so the process defaults apply unless a
+        # caller injects shared ones; get_or_create keeps many Trainers
+        # in one process (sweeps, tests) on the same series.
+        self.tracer = tracer if tracer is not None else obs.DEFAULT_TRACER
+        reg = registry if registry is not None else obs.default_registry()
+        self.step_seconds = obs.get_or_create_histogram(
+            reg, "train_step_seconds",
+            "train step wall time: dispatch only once compiled (jit is "
+            "async — use StepTimer(ready=...) for device step time); the "
+            "first call blocks on trace+compile")
+        self.compile_seconds = obs.get_or_create_histogram(
+            reg, "train_compile_seconds",
+            "first-step trace+compile+execute wall time (the north-star "
+            "pod-to-first-compile component this process controls)",
+            buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                     120.0, 300.0, 600.0))
+        self._stepped = False
 
     def _build_state(self, params: Params) -> TrainState:
         return TrainState(params, self.optimizer.init(params),
@@ -332,7 +355,7 @@ class Trainer:
         return TrainState(params, opt_state, state.step + 1), loss
 
     def init(self, rng: jax.Array) -> TrainState:
-        with jax.set_mesh(self.mesh):
+        with mesh_lib.set_mesh(self.mesh):
             return self._jit_init(rng)
 
     def init_from_params(self, params: Params) -> TrainState:
@@ -340,7 +363,7 @@ class Trainer:
         (fine-tuning from a checkpoint). Params are a jit argument, not
         a closure constant — closing over an 8B tree would bake it into
         the executable."""
-        with jax.set_mesh(self.mesh):
+        with mesh_lib.set_mesh(self.mesh):
             return self._jit_build_state(params)
 
     def step(self, state: TrainState, tokens, targets, mask=None):
@@ -351,8 +374,22 @@ class Trainer:
             raise ValueError(
                 f"batch {tokens.shape[0]} not divisible by grad_accum "
                 f"{self.tc.grad_accum}")
-        with jax.set_mesh(self.mesh):
-            return self._jit_step(state, tokens, targets, mask)
+        # No added blocking: steady-state timings measure dispatch (the
+        # async-dispatch pipelining is the perf contract). The FIRST call
+        # is synchronous through trace+compile, so it alone is a
+        # meaningful wall measurement → train_compile_seconds.
+        compiling = not self._stepped
+        t0 = time.perf_counter()
+        with self.tracer.span("train.step", batch=int(tokens.shape[0]),
+                              compile=compiling):
+            with mesh_lib.set_mesh(self.mesh):
+                out = self._jit_step(state, tokens, targets, mask)
+        dt = time.perf_counter() - t0
+        self.step_seconds.observe(dt)
+        if compiling:
+            self._stepped = True
+            self.compile_seconds.observe(dt)
+        return out
 
 
 def _opt_state_shardings(opt_shapes, params_shapes, param_shardings, mesh):
@@ -366,7 +403,9 @@ def _opt_state_shardings(opt_shapes, params_shapes, param_shardings, mesh):
     wo's adam moments wrong and force per-step resharding over ICI.
     """
     param_by_path: dict[tuple, Any] = {}
-    flat_params = jax.tree.leaves_with_path(params_shapes)
+    # jax.tree.leaves_with_path only landed in 0.4.35+aliases; the
+    # tree_util spelling works across the versions we support.
+    flat_params = jax.tree_util.tree_leaves_with_path(params_shapes)
     flat_shard = jax.tree.leaves(param_shardings)
     for (path, leaf), sh in zip(flat_params, flat_shard):
         param_by_path[tuple(str(p) for p in path)] = (leaf.shape, sh)

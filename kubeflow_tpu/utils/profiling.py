@@ -45,18 +45,26 @@ def time_to_first_compile(
 
 
 @contextlib.contextmanager
-def trace(logdir: str):
+def trace(logdir: str, tracer: Any = None):
     """XLA profiler trace → `logdir` (open with TensorBoard's profile
     plugin). Wraps steps of interest:
 
         with profiling.trace("/tmp/profile"):
             state, loss = trainer.step(state, batch, targets)
+
+    Pass an `obs.Tracer` to also drop an `xla.profile` span into the
+    app-level trace ring, marking WHICH wall-clock window the heavy XLA
+    trace covers — /debug/traces shows the window, TensorBoard's
+    profile plugin shows what happened inside it.
     """
-    jax.profiler.start_trace(logdir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+    ctx = (tracer.span("xla.profile", logdir=logdir)
+           if tracer is not None else contextlib.nullcontext())
+    with ctx:
+        jax.profiler.start_trace(logdir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
 
 
 class StepTimer:
@@ -64,21 +72,35 @@ class StepTimer:
 
     `with timer.step(): ...` — the exit blocks on `ready` (pass the
     step's output) so async dispatch doesn't fake a fast step.
+
+    Optional obs bridge: give it a `tracer` and/or `histogram` and each
+    timed step also becomes a span (named `name`) and a histogram
+    observation — the summary here stays process-local, the histogram
+    is what /metrics scrapes.
     """
 
-    def __init__(self):
+    def __init__(self, tracer: Any = None, histogram: Any = None,
+                 name: str = "train.step"):
         self.durations: list[float] = []
+        self.tracer = tracer
+        self.histogram = histogram
+        self.name = name
 
     @contextlib.contextmanager
-    def step(self, ready: Any = None):
-        t0 = time.perf_counter()
-        yield
-        if ready is not None:
-            jax.block_until_ready(ready)
-        self.durations.append(time.perf_counter() - t0)
+    def step(self, ready: Any = None, **attrs: Any):
+        ctx = (self.tracer.span(self.name, **attrs)
+               if self.tracer is not None else contextlib.nullcontext())
+        with ctx:
+            t0 = time.perf_counter()
+            yield
+            if ready is not None:
+                jax.block_until_ready(ready)
+            self.record(time.perf_counter() - t0)
 
     def record(self, seconds: float) -> None:
         self.durations.append(seconds)
+        if self.histogram is not None:
+            self.histogram.observe(seconds)
 
     def summary(self) -> dict[str, float]:
         if not self.durations:

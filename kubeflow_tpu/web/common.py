@@ -42,10 +42,12 @@ KFAM_KEY: web.AppKey = web.AppKey("kfam", Kfam)
 SPAWNER_CONFIG_KEY: web.AppKey = web.AppKey("spawner_config", object)
 LINKS_KEY: web.AppKey = web.AppKey("links", object)
 PLATFORM_METRICS_KEY: web.AppKey = web.AppKey("platform_metrics", object)
+# obs.Tracer serving request spans + /debug/traces (set by platform.py).
+TRACER_KEY: web.AppKey = web.AppKey("tracer", object)
 DEV_USER_KEY: web.AppKey = web.AppKey("dev_user", str)
 CSRF_EXEMPT_KEY: web.AppKey = web.AppKey("csrf_exempt_prefixes", tuple)
 
-AUTH_EXEMPT = {"/healthz", "/readyz", "/metrics", "/"}
+AUTH_EXEMPT = {"/healthz", "/readyz", "/metrics", "/debug/traces", "/"}
 # The SPA shell and its assets load before identity is known — the auth
 # proxy injects the userid header on API calls; the shell itself is
 # public (same as the reference serving the dashboard bundle).
@@ -88,6 +90,29 @@ async def error_middleware(request: web.Request, handler):
     except Exception:
         log.exception("unhandled error for %s", request.path)
         return json_error("internal error", 500)
+
+
+@web.middleware
+async def tracing_middleware(request: web.Request, handler):
+    """Root span per request + `X-Trace-Id` on the response, so a slow
+    call's server-side trace is one header copy-paste away. Outermost
+    (platform.py inserts it first): authn/CSRF rejections and handler
+    crashes are spans too."""
+    tracer = request.config_dict.get(TRACER_KEY)
+    if tracer is None:
+        return await handler(request)
+    with tracer.span("http.request", method=request.method,
+                     path=request.path) as span:
+        try:
+            resp = await handler(request)
+        except web.HTTPException as exc:
+            span.attrs["status"] = exc.status
+            exc.headers.setdefault("X-Trace-Id", span.trace_id)
+            raise
+        span.attrs["status"] = resp.status
+        if not resp.prepared:  # streamed responses set it pre-prepare
+            resp.headers.setdefault("X-Trace-Id", span.trace_id)
+        return resp
 
 
 @web.middleware

@@ -18,6 +18,8 @@ from kubeflow_tpu.web.common import (
     CSRF_EXEMPT_KEY,
     DEV_USER_KEY,
     PLATFORM_METRICS_KEY,
+    TRACER_KEY,
+    tracing_middleware,
 )
 from kubeflow_tpu.web.apis_app import create_apis_app
 from kubeflow_tpu.web.dashboard_app import create_dashboard_app
@@ -35,11 +37,25 @@ def create_platform_app(
     spawner_config=None,
     csrf: bool = True,
     metrics=None,
+    tracer=None,
     dev_user: str | None = None,
 ) -> web.Application:
     root = create_dashboard_app(store, cluster_admins=cluster_admins, csrf=csrf)
     if dev_user:
         root[DEV_USER_KEY] = dev_user
+    # Request tracing + /debug/traces next to /metrics. A fresh Tracer
+    # per app unless the caller shares one (Cluster.create_web_app
+    # passes the control plane's, so reconcile spans land here too).
+    from kubeflow_tpu import obs
+
+    root[TRACER_KEY] = tracer if tracer is not None else obs.Tracer()
+    root.middlewares.insert(0, tracing_middleware)
+
+    async def debug_traces(request):
+        return web.json_response(obs.traces_response_payload(
+            request.app[TRACER_KEY], request.rel_url.query))
+
+    root.router.add_get("/debug/traces", debug_traces)
     if metrics is not None:
         # /metrics + request counters (ref kfam routers.go:82-86 exposes
         # prometheus on the same mux as the API). Outermost middleware so
@@ -102,21 +118,27 @@ _KNOWN_SERVICES = frozenset(
 
 @web.middleware
 async def _request_counter_middleware(request: web.Request, handler):
+    import time
+
     metrics = request.config_dict.get(PLATFORM_METRICS_KEY)
     segment = request.path.split("/")[1] or "dashboard"
     service = segment if segment in _KNOWN_SERVICES else "other"
+    t0 = time.perf_counter()
     try:
         resp = await handler(request)
     except web.HTTPException as exc:
         if metrics is not None:
-            metrics.record_request(service, request.method, exc.status)
+            metrics.record_request(service, request.method, exc.status,
+                                   seconds=time.perf_counter() - t0)
         raise
     except Exception:
         if metrics is not None:
-            metrics.record_request(service, request.method, 500)
+            metrics.record_request(service, request.method, 500,
+                                   seconds=time.perf_counter() - t0)
         raise
     if metrics is not None:
-        metrics.record_request(service, request.method, resp.status)
+        metrics.record_request(service, request.method, resp.status,
+                               seconds=time.perf_counter() - t0)
     return resp
 
 

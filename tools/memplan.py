@@ -87,8 +87,8 @@ def plan(model: str, mesh_sizes: dict[str, int], batch: int, seq: int,
     family, cfg = model_registry()[model]
     shapes, axes = param_shapes(family, cfg)
 
-    flat_shapes = jax.tree.leaves_with_path(shapes)
-    flat_axes = dict(jax.tree.leaves_with_path(
+    flat_shapes = jax.tree_util.tree_leaves_with_path(shapes)
+    flat_axes = dict(jax.tree_util.tree_leaves_with_path(
         axes, is_leaf=lambda x: isinstance(x, tuple)))
 
     n_params = 0
@@ -157,8 +157,8 @@ def plan_serving(model: str, mesh_sizes: dict[str, int], slots: int,
 
     family, cfg = model_registry()[model]
     shapes, axes = param_shapes(family, cfg)
-    flat_shapes = jax.tree.leaves_with_path(shapes)
-    flat_axes = dict(jax.tree.leaves_with_path(
+    flat_shapes = jax.tree_util.tree_leaves_with_path(shapes)
+    flat_axes = dict(jax.tree_util.tree_leaves_with_path(
         axes, is_leaf=lambda x: isinstance(x, tuple)))
     weight_bytes = 0.0
     for path, leaf in flat_shapes:
