@@ -528,6 +528,113 @@ def bench_decode_continuous(model: str, *, slots: int, prompt_len: int,
     }
 
 
+def bench_decode_paged(model: str, *, slots: int, prompt_len: int,
+                       max_new: int, requests: int, max_len: int,
+                       block_size: int, verbose: bool = True) -> dict:
+    """Repeated-prompt serving through the ContinuousBatcher's paged KV
+    cache + radix prefix cache. Every request carries the SAME prompt,
+    so after the first admission (the cold miss) each later admission
+    should seed its prefill from cached blocks and compute only the
+    uncacheable last token — the workload the prefix cache exists for.
+
+    Headline: decoded tokens/s/chip. Extra metrics carry the cache's
+    own evidence: hit rate (> 0 or the radix tree is dead), prompt
+    tokens actually prefilled vs the `requests * prompt_len` a no-reuse
+    baseline would compute (vs_baseline = baseline/actual, > 1 means
+    reuse saved prefill work), tokens served from cache, and KV HBM
+    bytes — pool blocks in use x block bytes vs the dense per-slot
+    cache the paged pool replaced."""
+    import asyncio
+
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.serving import engine as engine_lib
+    from kubeflow_tpu.serving.continuous import ContinuousBatcher
+
+    cfg = bench_configs()[model]
+    params = jax.jit(lambda k: llama.init(k, cfg))(jax.random.key(0))
+    jax.block_until_ready(params)
+    eng = engine_lib.InferenceEngine(
+        params, cfg, engine_lib.LLAMA_FAMILY,
+        engine_lib.EngineConfig(max_len=max_len),
+    )
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+    warm = rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+
+    async def run():
+        batcher = ContinuousBatcher(
+            eng, asyncio.Lock(), max_slots=slots, chunk=4,
+            kv_block_size=block_size)
+        try:
+            # compile + page-pool warm on a DIFFERENT prompt, then
+            # snapshot the counters so the timed phase's stats are its
+            # own (the warm prompt shares no prefix, so it costs pool
+            # blocks but no hits)
+            await batcher.submit(warm, max_new, ())
+            base = batcher.prefix_cache_stats()
+            t0 = time.perf_counter()
+            # first request alone: its retirement donates the prompt's
+            # blocks, making every later admission a deterministic hit
+            # (concurrent first-wave admissions would share in-flight
+            # anyway, but sequencing makes the measured rate exact)
+            await batcher.submit(prompt, max_new, ())
+            await asyncio.gather(*[
+                batcher.submit(prompt, max_new, ())
+                for _ in range(requests - 1)])
+            dt = time.perf_counter() - t0
+            stats = batcher.prefix_cache_stats()
+            blocks_in_use = batcher.kv_blocks_in_use()
+            blk_bytes = batcher.cengine.kv_block_bytes()
+            return dt, {k: stats[k] - base.get(k, 0)
+                        for k in ("hits", "misses", "tokens_prefilled",
+                                  "tokens_reused")}, \
+                blocks_in_use, blk_bytes
+        finally:
+            await batcher.close()
+
+    dt, stats, blocks_in_use, blk_bytes = asyncio.run(run())
+    n_devices = len(jax.devices())
+    tok_per_sec = requests * max_new / dt / n_devices
+    hit_rate = stats["hits"] / max(1, stats["hits"] + stats["misses"])
+    no_reuse = requests * prompt_len  # every prompt fully prefilled
+    prefilled = stats["tokens_prefilled"]
+    paged_bytes = blocks_in_use * blk_bytes
+    dense_bytes = eng.kv_cache_bytes(slots)
+
+    gen = detect_generation()
+    if verbose:
+        print(f"# decode-paged model={model} slots={slots} "
+              f"requests={requests} tok/s={tok_per_sec:.1f} "
+              f"hit_rate={hit_rate:.3f} prefilled={prefilled} "
+              f"reused={stats['tokens_reused']} "
+              f"kv_bytes={paged_bytes} (dense {dense_bytes})",
+              file=sys.stderr)
+    return {
+        "metric": ("serving_decode_tokens_per_sec_per_chip"
+                   f"[{model}-paged,{gen}]"),
+        "value": round(tok_per_sec, 2),
+        "unit": "tokens/s/chip",
+        # prefill-work saving vs a no-reuse baseline; > 1 == cache won
+        "vs_baseline": round(no_reuse / max(1, prefilled), 4),
+        "extra_metrics": [
+            {"metric": f"serving_prefix_cache_hit_rate[{model},{gen}]",
+             "value": round(hit_rate, 4), "unit": "ratio",
+             "vs_baseline": round(hit_rate, 4)},
+            {"metric": f"serving_prefill_tokens_computed[{model},{gen}]",
+             "value": float(prefilled), "unit": "tokens",
+             "vs_baseline": round(no_reuse / max(1, prefilled), 4)},
+            {"metric": f"serving_prefill_tokens_reused[{model},{gen}]",
+             "value": float(stats["tokens_reused"]), "unit": "tokens",
+             "vs_baseline": round(
+                 stats["tokens_reused"] / max(1, no_reuse), 4)},
+            {"metric": f"serving_kv_hbm_bytes_paged[{model},{gen}]",
+             "value": float(paged_bytes), "unit": "bytes",
+             "vs_baseline": round(
+                 dense_bytes / max(1, paged_bytes), 4)},
+        ],
+    }
+
+
 def bench_mnist(*, steps: int = 200, batch: int = 256,
                 verbose: bool = True) -> dict:
     """BASELINE config #1: MNIST-MLP smoke train (images/s + accuracy).
@@ -672,7 +779,8 @@ def first_compile_metric() -> dict:
 # mnist/vit/decode-gemma complete the BASELINE.md config matrix
 # (configs #1, #2, #5 — VERDICT r04 weak #4).
 ALL_SECTIONS = ("train500m", "train1b", "decode", "decode-int8",
-                "decode-cont", "decode-gemma", "mnist", "vit", "flash4k")
+                "decode-cont", "decode-paged", "decode-gemma", "mnist",
+                "vit", "flash4k")
 # Per-section wall-clock bound for the orchestrated TPU sweep. Sized
 # from measured section times (train sections ~2-4 min incl. compile,
 # decode ~2 min) with slack for tunnel weather; a section that wedges
@@ -686,7 +794,7 @@ _SECTION_TIMEOUT_S = float(
 def _sweep_for(backend: str, wanted: list[str], p) -> list[str]:
     sweep = (list(ALL_SECTIONS) if backend == "tpu"
              else ["train500m", "decode", "decode-int8", "decode-cont",
-                   "decode-gemma", "mnist", "vit"])
+                   "decode-paged", "decode-gemma", "mnist", "vit"])
     if wanted:
         unavailable = [s for s in wanted if s not in sweep]
         if unavailable:
@@ -837,8 +945,8 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--only", default="",
                    help="comma-separated subset: train500m,train1b,"
-                        "flash4k,decode,decode-int8,decode-cont "
-                        "(default: full "
+                        "flash4k,decode,decode-int8,decode-cont,"
+                        "decode-paged (default: full "
                         "sweep for the backend)")
     p.add_argument("--json-only", action="store_true")
     args = p.parse_args()
@@ -979,6 +1087,27 @@ def _run_sweep(sweep: list[str], backend: str, *, in_child: bool,
             guarded("decode-cont", lambda: bench_decode_continuous(
                 "tiny", slots=2, prompt_len=8, rounds=2, chunk=4,
                 max_len=64, verbose=verbose))
+    if "decode-paged" in sweep:
+        # Paged KV + radix prefix cache under a repeated-prompt
+        # workload. The bench returns its cache-evidence metrics
+        # (hit rate, prefilled-vs-reused tokens, KV HBM bytes) as
+        # sub-entries; lift them into the artifact's extras alongside
+        # the throughput number.
+        def _paged() -> dict:
+            if on_tpu:
+                m = bench_decode_paged(
+                    "bench-500m-serve", slots=8, prompt_len=128,
+                    max_new=32, requests=24, max_len=512,
+                    block_size=64, verbose=verbose)
+            else:
+                m = bench_decode_paged(
+                    "tiny", slots=2, prompt_len=16, max_new=8,
+                    requests=6, max_len=64, block_size=8,
+                    verbose=verbose)
+            extras.extend(m.pop("extra_metrics", []))
+            return m
+
+        guarded("decode-paged", _paged)
     if "decode-gemma" in sweep:
         # BASELINE config #5 (Gemma-2B serving): same decode harness,
         # gemma family (GQA 8q/1kv, huge vocab — a different serving

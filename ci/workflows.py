@@ -42,7 +42,8 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         "paths": ["kubeflow_tpu/serving/**"],
         "tests": ("python -m pytest tests/test_serving.py "
                   "tests/test_speculative.py tests/test_quant.py "
-                  "tests/test_continuous.py tests/test_multilora.py -q"),
+                  "tests/test_continuous.py tests/test_multilora.py "
+                  "tests/test_paged_kv.py -q"),
     },
     "native": {
         "paths": ["native/**", "kubeflow_tpu/data/**"],
@@ -344,6 +345,43 @@ def frontend_workflow() -> dict:
     }
 
 
+def serving_check_workflow() -> dict:
+    """Serving correctness gate (the obs-check pattern applied to the
+    paged-KV path): `make serving-check` runs BOTH test tiers of the
+    serving suite on CPU, so the dense-oracle token-parity tests for
+    the paged cache / radix prefix reuse (slow-marked — compile-heavy)
+    execute on every serving or attention change, not just on main."""
+    return {
+        "name": "serving check",
+        "on": {
+            "pull_request": {"paths": ["kubeflow_tpu/serving/**",
+                                       "kubeflow_tpu/ops/**",
+                                       "tests/test_paged_kv.py",
+                                       "tests/test_continuous.py",
+                                       "Makefile"]},
+            "push": {"branches": ["main"]},
+        },
+        "jobs": {
+            "serving-check": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e .[ci] pytest"},
+                    {"name": "paged-KV dense-oracle parity gate",
+                     "run": "make serving-check",
+                     "env": {
+                         "JAX_PLATFORMS": "cpu",
+                         "XLA_FLAGS":
+                             "--xla_force_host_platform_device_count=8",
+                     }},
+                ],
+            }
+        },
+    }
+
+
 def all_workflows() -> dict[str, dict]:
     from ci import cd
 
@@ -356,6 +394,7 @@ def all_workflows() -> dict[str, dict]:
     out["platform_e2e.yaml"] = e2e_workflow()
     out["deploy_smoke_test.yaml"] = deploy_smoke_workflow()
     out["slow_tier_test.yaml"] = slow_tier_workflow()
+    out["serving_check.yaml"] = serving_check_workflow()
     out["frontend_test.yaml"] = frontend_workflow()
     out.update(cd.all_workflows())
     return out

@@ -24,6 +24,7 @@ from __future__ import annotations
 from kubeflow_tpu.obs.metrics import (
     LATENCY_BUCKETS,
     SIZE_BUCKETS,
+    TOKEN_BUCKETS,
     Histogram,
     format_float,
     get_or_create_histogram,
@@ -37,6 +38,7 @@ from kubeflow_tpu.obs.tracing import (
 __all__ = [
     "LATENCY_BUCKETS",
     "SIZE_BUCKETS",
+    "TOKEN_BUCKETS",
     "Histogram",
     "Span",
     "Tracer",

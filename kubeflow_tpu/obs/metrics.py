@@ -26,6 +26,11 @@ LATENCY_BUCKETS = (
 )
 # Batch/queue-size buckets: powers of two up to the largest slot counts.
 SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
+# Token-count buckets (prompt/prefill sizes): powers of two out to the
+# longest context lengths served — used by the prefix-cache histogram
+# (tokens computed vs reused per admission).
+TOKEN_BUCKETS = (1.0, 4.0, 16.0, 64.0, 256.0, 1024.0, 4096.0, 16384.0,
+                 65536.0)
 
 
 def format_float(v: float) -> str:

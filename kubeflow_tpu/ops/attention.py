@@ -22,7 +22,7 @@ NEG_INF = -2.0**30  # large-but-finite: avoids NaNs from (-inf) - (-inf)
 # trace time, so these count how many traced call sites took each impl —
 # which is how bench.py *proves* the long-seq preset routed through the
 # Pallas flash kernel instead of silently falling back to XLA.
-_impl_counts = {"flash": 0, "xla": 0, "decode": 0}
+_impl_counts = {"flash": 0, "xla": 0, "decode": 0, "paged": 0}
 
 
 def reset_impl_counts() -> None:
@@ -179,4 +179,46 @@ def dot_product_attention(
     return _xla_attention(
         q, k, v, q_positions, kv_positions, causal=causal,
         kv_mask=kv_mask, window=window,
+    )
+
+
+def paged_attention(
+    q: jnp.ndarray,            # [b, 1, n_q, hd] — single decode step
+    k_pool: jnp.ndarray,       # [num_blocks, block_size, n_kv, hd]
+    v_pool: jnp.ndarray,       # [num_blocks, block_size, n_kv, hd]
+    block_table: jnp.ndarray,  # [b, blocks_per_slot] int32 physical ids
+    q_positions: jnp.ndarray,  # [b, 1]
+    kv_positions: jnp.ndarray, # [b, blocks_per_slot * block_size]
+    *,
+    causal: bool = True,
+    kv_mask: jnp.ndarray | None = None,  # [b, blocks_per_slot * block_size]
+    window: int | None = None,
+) -> jnp.ndarray:
+    """Decode attention against a paged KV cache.
+
+    Each row's K/V is gathered from a shared block pool through its
+    block table, then fed to the same grouped-query attention as the
+    dense path. Because masked cells contribute an exact +0.0 to the
+    softmax sums (NEG_INF logits underflow to 0.0 in fp32 exp), the
+    gathered layout is bit-identical to a dense cache holding the same
+    tokens at the same logical cells — which is what lets the tests
+    compare paged decode against dense decode exactly.
+
+    The gather materializes `[b, blocks_per_slot * block_size]` of K/V
+    per layer — fine for XLA/CPU and short-to-mid contexts; a fused
+    Pallas kernel that walks the table in-kernel is the TPU follow-up
+    (see docs/perf-notes.md).
+    """
+    b = q.shape[0]
+    blocks_per_slot = block_table.shape[1]
+    block_size, n_kv, hd = k_pool.shape[1:]
+    width = blocks_per_slot * block_size
+    k = k_pool[block_table].reshape(b, width, n_kv, hd)
+    v = v_pool[block_table].reshape(b, width, n_kv, hd)
+    _impl_counts["paged"] += 1
+    # Cell index == logical token position by construction (insert-time
+    # compaction strips prefill padding), so positions are contiguous.
+    return dot_product_attention(
+        q, k, v, q_positions, kv_positions, causal=causal,
+        kv_mask=kv_mask, window=window, contiguous_positions=True,
     )

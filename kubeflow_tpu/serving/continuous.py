@@ -29,6 +29,19 @@ admission DATA, not shape —
 Model math is shared with the engine via `engine.transformer_block`
 (norms/projections/rotary/MLP injected with this module's per-row
 scatter write + per-row masks), so the two serving paths cannot drift.
+
+KV memory is PAGED (the vLLM/SGLang move): instead of a dense
+[L, S, max_len] buffer, slots address a shared pool of fixed-size
+blocks through per-slot block tables, decode gathers K/V through the
+table (`ops.paged_attention`), and prefilled rows are compacted
+(bucket left-pads stripped) as they're scattered into blocks — so a
+block's content is a pure function of its token prefix. That canonical
+form feeds the automatic RADIX PREFIX CACHE (serving/paged.py): prompt
+blocks are indexed by token prefix at admission and donated back to
+the tree at retirement, and a new request reuses every cached cell it
+shares with ANY earlier one, prefilling only its suffix. The one-shot
+`InferenceEngine` keeps its dense cache — batch-1 generate has no
+sharing to exploit.
 """
 
 from __future__ import annotations
@@ -42,14 +55,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kubeflow_tpu.ops.attention import dot_product_attention
+from kubeflow_tpu.ops.attention import dot_product_attention, paged_attention
 from kubeflow_tpu.ops.norms import rms_norm
 from kubeflow_tpu.ops.rotary import rope_frequencies
 from kubeflow_tpu.serving.engine import (
+    DecodeState,
     InferenceEngine,
     SamplingParams,
     transformer_block,
 )
+from kubeflow_tpu.serving.paged import BlockPool, RadixPrefixCache
 
 
 def pow2_ceil(n: int) -> int:
@@ -77,20 +92,26 @@ class SlotState:
     positions, which is the whole point of continuous batching.
     """
 
-    def __init__(self, k, v, length, offset, pad, tok, aid=None):
-        self.k = k            # [L, S, max_len, n_kv, hd]
-        self.v = v
-        self.length = length  # [S] int32 — filled cache slots per row
+    def __init__(self, k, v, length, offset, pad, tok, aid=None,
+                 block_table=None):
+        self.k = k            # [L, num_blocks, block_size, n_kv, hd]
+        self.v = v            # (paged pool; block 0 is the trash block)
+        self.length = length  # [S] int32 — filled cache cells per row
         self.offset = offset  # [S] int32 — left-pad count (rope shift)
-        self.pad = pad        # [S, max_len] bool — padded cache cells
+        self.pad = pad        # [S, W] bool — padded cache cells
         self.tok = tok        # [S] int32 — last sampled token per row
         if aid is None:       # multi-LoRA adapter id (0 = plain base)
             aid = jnp.zeros(length.shape, jnp.int32)
         self.aid = aid        # [S] int32
+        # [S, blocks_per_slot] int32 — physical block per logical block.
+        # Cell c of slot s lives at pool[:, table[s, c // bs], c % bs]:
+        # the paged indirection that lets slots share prefix blocks and
+        # frees HBM accounting from the dense S * max_len worst case.
+        self.block_table = block_table
 
     def tree_flatten(self):
         return (self.k, self.v, self.length, self.offset, self.pad,
-                self.tok, self.aid), None
+                self.tok, self.aid, self.block_table), None
 
     @classmethod
     def tree_unflatten(cls, _, children):
@@ -114,14 +135,38 @@ class ContinuousEngine:
     """
 
     def __init__(self, engine: InferenceEngine, max_slots: int = 8,
-                 prefill_chunk: int | None = None):
+                 prefill_chunk: int | None = None,
+                 block_size: int = 64, num_blocks: int | None = None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, got "
                              f"{prefill_chunk}")
+        if block_size < 2 or block_size & (block_size - 1):
+            raise ValueError(
+                f"block_size must be a power of two >= 2, got {block_size}")
         self.engine = engine
         self.S = max_slots
+        # Paged KV geometry. The cache is a POOL of fixed-size blocks
+        # [L, num_blocks, block_size, n_kv, hd] plus a per-slot block
+        # table; block 0 is the reserved trash block (unallocated table
+        # entries point there, so a retired-but-unreset slot's garbage
+        # writes land harmlessly). The default pool is the dense
+        # equivalent (every slot can hold max_len) — shrink num_blocks
+        # to cap KV HBM below S * max_len when real requests are short.
+        self.block_size = block_size
+        self.blocks_per_slot = -(-engine.ec.max_len // block_size)
+        self.kv_width = self.blocks_per_slot * block_size
+        if num_blocks is None:
+            num_blocks = 1 + max_slots * self.blocks_per_slot
+        if num_blocks < 1 + self.blocks_per_slot:
+            raise ValueError(
+                f"num_blocks {num_blocks} < {1 + self.blocks_per_slot} "
+                f"(trash + one slot's worth at max_len "
+                f"{engine.ec.max_len} / block_size {block_size}): a "
+                "single max-length request could never be admitted")
+        self.num_blocks = num_blocks
+        self.pool = BlockPool(num_blocks, block_size)
         # Long-prompt admissions prefill in fixed slices (engine.
         # prefill_chunked): buckets become chunk MULTIPLES, so every
         # long prompt reuses the one [g, chunk] program instead of
@@ -138,20 +183,32 @@ class ContinuousEngine:
         self._insert_jit = jax.jit(self._insert, donate_argnums=(0,))
         self._insert_many_jit = jax.jit(self._insert_many,
                                         donate_argnums=(0,))
+        self._gather_seed_jit = jax.jit(self._gather_seed)
+        self._reset_jit = jax.jit(self._reset_slots, donate_argnums=(0,))
 
     # -- state ------------------------------------------------------------
 
     def init_slots(self) -> SlotState:
-        cfg, ec = self.engine.cfg, self.engine.ec
-        shape = (cfg.num_layers, self.S, ec.max_len,
+        cfg = self.engine.cfg
+        shape = (cfg.num_layers, self.num_blocks, self.block_size,
                  cfg.num_kv_heads, cfg.head_dim)
         return SlotState(
             jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype),
             jnp.zeros((self.S,), jnp.int32),
             jnp.zeros((self.S,), jnp.int32),
-            jnp.zeros((self.S, ec.max_len), bool),
+            jnp.zeros((self.S, self.kv_width), bool),
             jnp.zeros((self.S,), jnp.int32),
+            None,
+            jnp.zeros((self.S, self.blocks_per_slot), jnp.int32),
         )
+
+    def kv_block_bytes(self) -> int:
+        """HBM bytes one pool block holds (K+V, all layers) — the unit
+        `serving_kv_blocks_in_use` and bench_decode_paged report in."""
+        cfg = self.engine.cfg
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        return (2 * cfg.num_layers * self.block_size
+                * cfg.num_kv_heads * cfg.head_dim * itemsize)
 
     # -- admission --------------------------------------------------------
 
@@ -215,8 +272,10 @@ class ContinuousEngine:
                               else [0] * g, jnp.int32)
         if prefix_state is None:
             state0 = eng.init_state(g)
+        elif prefix_state.k.shape[1] == g:
+            # already batch-g (a gather_seed radix-cache seed)
+            state0 = prefix_state
         else:
-            from kubeflow_tpu.serving.engine import DecodeState
             ps = prefix_state
             state0 = DecodeState(
                 jnp.repeat(ps.k, g, axis=1), jnp.repeat(ps.v, g, axis=1),
@@ -241,59 +300,163 @@ class ContinuousEngine:
             [tokens], self.bucket_for(len(tokens), max_new),
             [sampling], rng)
 
-    def _insert(self, st: SlotState, slot, pstate, row, first, aid):
+    def _insert(self, st: SlotState, slot, pstate, row, first, aid,
+                table, seed_len):
         """Scatter row `row` of a prefilled batch-g DecodeState into
-        slot `slot`. All indices are traced — one compile per prefill
-        batch size g serves every (slot, row, adapter) combination."""
-        prow = jax.lax.dynamic_slice_in_dim(pstate.k, row, 1, axis=1)
-        k = jax.lax.dynamic_update_slice(
-            st.k, prow, (0, slot, 0, 0, 0))
-        vrow = jax.lax.dynamic_slice_in_dim(pstate.v, row, 1, axis=1)
-        v = jax.lax.dynamic_update_slice(
-            st.v, vrow, (0, slot, 0, 0, 0))
-        length = st.length.at[slot].set(pstate.length.astype(jnp.int32))
-        offset = st.offset.at[slot].set(pstate.offset[row])
-        pad = st.pad.at[slot].set(pstate.pad[row])
+        the pool blocks listed in `table`, and point slot `slot` at
+        them. All indices are traced — one compile per prefill batch
+        size g serves every (slot, row, adapter, table) combination.
+
+        The row is COMPACTED on the way in: prefill left-pads prompts
+        to their bucket, so cells [seed_len, seed_len + npad) of the
+        dense row are padding. The gather below drops them, making
+        pool blocks a pure function of the token prefix — cell index
+        == logical position, offset 0, no pads. That canonical form is
+        what lets the radix tree share blocks across requests whose
+        prompts merely share tokens (their bucket pads differ).
+
+        The write covers EVERY cell of every block in `table` — unused
+        tail entries must be the trash block (0). Fully overwriting the
+        table is a safety invariant: a freed block may still receive
+        in-flight garbage writes from its previous slot's last decode
+        chunk, and this insert is ordered after that chunk by the state
+        donation chain, so it always lands last.
+        """
+        eng = self.engine
+        ec = eng.ec
+        L = eng.cfg.num_layers
+        bs, mb, w = self.block_size, self.blocks_per_slot, self.kv_width
+        npad = pstate.offset[row].astype(jnp.int32)
+        j = jnp.arange(w, dtype=jnp.int32)
+        src = jnp.minimum(jnp.where(j < seed_len, j, j + npad),
+                          ec.max_len - 1)
+        prow_k = jax.lax.dynamic_slice_in_dim(pstate.k, row, 1, axis=1)
+        prow_v = jax.lax.dynamic_slice_in_dim(pstate.v, row, 1, axis=1)
+        ck = jnp.take(prow_k[:, 0], src, axis=1)  # [L, w, n_kv, hd]
+        cv = jnp.take(prow_v[:, 0], src, axis=1)
+        ck = ck.reshape(L, mb, bs, *ck.shape[2:])
+        cv = cv.reshape(L, mb, bs, *cv.shape[2:])
+        k = st.k.at[:, table].set(ck.astype(st.k.dtype))
+        v = st.v.at[:, table].set(cv.astype(st.v.dtype))
+        length = st.length.at[slot].set(
+            (pstate.length - npad).astype(jnp.int32))
+        offset = st.offset.at[slot].set(0)
+        pad = st.pad.at[slot].set(False)
         tok = st.tok.at[slot].set(first[row])
         aid_v = st.aid.at[slot].set(aid)
-        return SlotState(k, v, length, offset, pad, tok, aid_v)
+        bt = st.block_table.at[slot].set(table)
+        return SlotState(k, v, length, offset, pad, tok, aid_v, bt)
+
+    def _auto_table(self, slot: int) -> np.ndarray:
+        """Canonical block table for engine-managed allocation (direct
+        `insert` callers: benches, tests, warmup): slot s owns blocks
+        [1 + s*MB, 1 + (s+1)*MB), the dense-equivalent layout. With a
+        pool smaller than the default the mapping wraps (aliases) —
+        fine for warmup (content is throwaway) but direct callers who
+        need correctness should keep the default pool size or pass
+        explicit tables. The batcher always passes explicit tables."""
+        usable = self.num_blocks - 1
+        base = slot * self.blocks_per_slot
+        return np.asarray(
+            [1 + (base + j) % usable
+             for j in range(self.blocks_per_slot)], np.int32)
 
     def insert(self, st: SlotState, slot: int, pstate, first,
-               row: int = 0, aid: int = 0) -> SlotState:
+               row: int = 0, aid: int = 0, *, table=None,
+               seed_len: int = 0) -> SlotState:
+        if table is None:
+            table = self._auto_table(slot)
         return self._insert_jit(st, jnp.asarray(slot, jnp.int32), pstate,
                                 jnp.asarray(row, jnp.int32), first,
-                                jnp.asarray(aid, jnp.int32))
+                                jnp.asarray(aid, jnp.int32),
+                                jnp.asarray(table, jnp.int32),
+                                jnp.asarray(seed_len, jnp.int32))
 
     def _insert_many(self, st: SlotState, slots, pstate, rows, first,
-                     aids):
+                     aids, tables, seed_lens):
         """A whole admission group's scatters in one program (a scan
         over `_insert`) — one device dispatch per group instead of one
         per request, the admission-side sibling of the group prefill."""
 
         def body(st, xs):
-            slot, row, aid = xs
-            return self._insert(st, slot, pstate, row, first, aid), None
+            slot, row, aid, table, seed_len = xs
+            return self._insert(st, slot, pstate, row, first, aid,
+                                table, seed_len), None
 
-        st, _ = jax.lax.scan(body, st, (slots, rows, aids))
+        st, _ = jax.lax.scan(body, st,
+                             (slots, rows, aids, tables, seed_lens))
         return st
 
     def insert_many(self, st: SlotState, slots: list[int], pstate,
                     rows: list[int], first,
-                    aids: list[int] | None = None) -> SlotState:
+                    aids: list[int] | None = None, *, tables=None,
+                    seed_lens: list[int] | None = None) -> SlotState:
         """Insert prefilled rows `rows` into `slots` in ONE dispatch.
         Compiles one cheap program per group SIZE (bounded by
         max_slots); the batcher's admission path uses this, the g=1
-        `insert` stays for benches and direct callers."""
+        `insert` stays for benches and direct callers. `tables` ([n,
+        blocks_per_slot] physical block ids, trash-padded) and
+        `seed_lens` (cells [0, seed_len) of each row are an already-
+        compact shared-prefix seed) default to the engine-managed
+        dense-equivalent layout with no seed."""
         n = len(slots)
         if len(rows) != n or (aids is not None and len(aids) != n):
             raise ValueError(
                 f"insert_many: {n} slots vs {len(rows)} rows"
                 + (f" vs {len(aids)} aids" if aids is not None else ""))
+        if tables is None:
+            tables = np.stack([self._auto_table(s) for s in slots])
+        if seed_lens is None:
+            seed_lens = [0] * n
         return self._insert_many_jit(
             st, jnp.asarray(slots, jnp.int32), pstate,
             jnp.asarray(rows, jnp.int32), first,
             jnp.asarray(aids if aids is not None else [0] * n,
-                        jnp.int32))
+                        jnp.int32),
+            jnp.asarray(tables, jnp.int32),
+            jnp.asarray(seed_lens, jnp.int32))
+
+    def _gather_seed(self, k_pool, v_pool, chains, m):
+        """Assemble a batch-g prefill seed (`DecodeState`) from cached
+        pool blocks: row i's cells [0, m) are read through block chain
+        `chains[i]` (trash-padded past ceil(m / block_size)). Offset 0
+        and no pads by the blocks' canonical-form invariant."""
+        g = chains.shape[0]
+        max_len = self.engine.ec.max_len
+        k = k_pool[:, chains]  # [L, g, MB, bs, n_kv, hd]
+        v = v_pool[:, chains]
+        k = k.reshape(*k.shape[:2], self.kv_width, *k.shape[4:])
+        v = v.reshape(*v.shape[:2], self.kv_width, *v.shape[4:])
+        return DecodeState(
+            k[:, :, :max_len], v[:, :, :max_len],
+            m.astype(jnp.int32),
+            jnp.zeros((g, max_len), bool),
+            jnp.zeros((g,), jnp.int32))
+
+    def gather_seed(self, st: SlotState, chains, m: int) -> DecodeState:
+        return self._gather_seed_jit(st.k, st.v,
+                                     jnp.asarray(chains, jnp.int32),
+                                     jnp.asarray(m, jnp.int32))
+
+    def _reset_slots(self, st: SlotState, slots):
+        """Point retired slots back at the trash block and zero their
+        cursors. Ordered after the slots' last in-flight decode chunk
+        by the donation chain, this guarantees a freed block sees no
+        further writes once it's re-allocated (or adopted by the radix
+        tree) — the paged design's one cross-slot hazard."""
+        bt = st.block_table.at[slots].set(0)
+        length = st.length.at[slots].set(0)
+        offset = st.offset.at[slots].set(0)
+        pad = st.pad.at[slots].set(False)
+        return SlotState(st.k, st.v, length, offset, pad, st.tok,
+                         st.aid, bt)
+
+    def reset_slots(self, st: SlotState, slots: list[int]) -> SlotState:
+        """Host entry: pads the slot list to a power of two by
+        repeating (idempotent) so compiles stay bounded."""
+        n = pow2_ceil(len(slots))
+        padded = list(slots) + [slots[-1]] * (n - len(slots))
+        return self._reset_jit(st, jnp.asarray(padded, jnp.int32))
 
     def warmup(self, buckets=(16,), step_sizes=(1,)) -> int:
         """Compile a serving shape set ahead of traffic: prefill and
@@ -330,7 +493,11 @@ class ContinuousEngine:
         for steps in step_sizes:
             st, _, _, rng = self.step(st, sp, rng, steps)
             n += 1
-        return n
+        # the batcher resets retired slots' block tables between
+        # chunks — warm that program too (pow2-padded, so size 1
+        # covers every retirement count)
+        st = self.reset_slots(st, [0])
+        return n + 1
 
     # -- decode -----------------------------------------------------------
 
@@ -353,13 +520,17 @@ class ContinuousEngine:
         rope_positions = jnp.maximum(positions - st.offset[:, None], 0)
         inv_freq = rope_frequencies(cfg.head_dim, theta=cfg.rope_theta)
         kv_positions = jnp.broadcast_to(
-            jnp.arange(ec.max_len, dtype=jnp.int32)[None, :],
-            (S, ec.max_len))
+            jnp.arange(self.kv_width, dtype=jnp.int32)[None, :],
+            (S, self.kv_width))
         # causal q>=kv masking hides stale cells beyond each row's
         # cursor (a reused slot's old tail); pads are never attended.
         kv_valid = ~st.pad
-        rows = jnp.arange(S)
         write_at = jnp.minimum(st.length, ec.max_len - 1)
+        # paged write coordinates: logical cell -> (physical block,
+        # offset) through each row's block table
+        rows = jnp.arange(S)
+        write_blk = st.block_table[rows, write_at // self.block_size]
+        write_off = write_at % self.block_size
 
         x = eng._embed(params, st.tok[:, None])
 
@@ -380,9 +551,11 @@ class ContinuousEngine:
             cell = {}
 
             def write_kv(k, v):
-                k2 = k_all.at[li, rows, write_at].set(
+                # one [S]-row scatter into the shared block pool:
+                # slot s's token lands at (table[s, at//bs], at%bs)
+                k2 = k_all.at[li, write_blk, write_off].set(
                     k[:, 0].astype(k_all.dtype))
-                v2 = v_all.at[li, rows, write_at].set(
+                v2 = v_all.at[li, write_blk, write_off].set(
                     v[:, 0].astype(v_all.dtype))
                 cell["k"], cell["v"] = k2, v2
                 return (jax.lax.dynamic_index_in_dim(
@@ -390,15 +563,16 @@ class ContinuousEngine:
                         jax.lax.dynamic_index_in_dim(
                             v2, li, 0, keepdims=False))
 
-            def attn(q, kc, vc):
-                # cell index == token position here too (see
-                # engine._forward_cached) — enables the fused decode
-                # kernel on TPU
-                return dot_product_attention(
-                    q, kc, vc, positions, kv_positions,
+            def attn(q, kp, vp):
+                # kp/vp are this layer's block POOL; the paged path
+                # gathers each row's K/V through its block table.
+                # Insert-time compaction keeps cell index == logical
+                # token position, so masking semantics (and bits — see
+                # paged_attention's docstring) match the dense path.
+                return paged_attention(
+                    q, kp, vp, st.block_table, positions, kv_positions,
                     causal=True, kv_mask=kv_valid,
-                    window=getattr(cfg, "sliding_window", None),
-                    contiguous_positions=True)
+                    window=getattr(cfg, "sliding_window", None))
 
             x, _ = transformer_block(
                 cfg, fam, p, x, rope_positions, inv_freq, write_kv,
@@ -416,7 +590,8 @@ class ContinuousEngine:
         st = SlotState(
             k_new, v_new,
             jnp.minimum(st.length + 1, ec.max_len),
-            st.offset, st.pad, nxt.astype(jnp.int32), st.aid)
+            st.offset, st.pad, nxt.astype(jnp.int32), st.aid,
+            st.block_table)
         return st, nxt, lp, rng
 
     def _step(self, params, adapters, st: SlotState, sp: SamplingParams,
@@ -457,7 +632,8 @@ class Overloaded(RuntimeError):
 class _Slot:
     """Host-side record for one admitted request."""
 
-    __slots__ = ("fut", "out", "lps", "max_new", "queue", "stop")
+    __slots__ = ("fut", "out", "lps", "max_new", "queue", "stop",
+                 "kv_toks", "owned", "node_refs", "freed")
 
     def __init__(self, fut, max_new: int, queue, stop=()):
         self.fut = fut
@@ -466,6 +642,16 @@ class _Slot:
         self.max_new = max_new
         self.queue = queue  # per-request token stream (None for oneshot)
         self.stop = stop    # token-id sequences that end generation
+        # paged-KV bookkeeping: the tokens whose KV this slot's blocks
+        # hold (full prompt incl. any registered prefix, then every
+        # emitted token UNTRIMMED — stop-sequence trimming edits `out`,
+        # not the cache), the exclusively-owned physical blocks by
+        # logical block index, and the radix nodes this request holds
+        # refs on (shared prefix chain + in-flight-indexed own blocks).
+        self.kv_toks: list[int] = []
+        self.owned: dict[int, int] = {}
+        self.node_refs: list = []
+        self.freed = False  # block bookkeeping already released
 
 
 class ContinuousBatcher:
@@ -486,7 +672,9 @@ class ContinuousBatcher:
                  prefixes: dict[str, list[int]] | None = None,
                  max_pending: int = 256,
                  pipeline_depth: int | None = None,
-                 window_ms: float = 0.0):
+                 window_ms: float = 0.0,
+                 kv_block_size: int = 64,
+                 kv_pool_blocks: int | None = None):
         # window_ms accepted (and ignored) for constructor parity with
         # Batcher: admission is per-token here, there is no window.
         del window_ms
@@ -521,7 +709,27 @@ class ContinuousBatcher:
         # bounded: one program per steps value in [1, chunk].
         self.chunk = chunk
         self.cengine = ContinuousEngine(engine, max_slots,
-                                        prefill_chunk=prefill_chunk)
+                                        prefill_chunk=prefill_chunk,
+                                        block_size=kv_block_size,
+                                        num_blocks=kv_pool_blocks)
+        # Automatic radix prefix cache over the block pool: every
+        # admitted prompt's full blocks are indexed by token prefix
+        # (at admission, so even in-flight prefills are sharable), and
+        # retirement donates a request's blocks back to the tree. A new
+        # prompt sharing a cached prefix seeds its prefill from those
+        # blocks and only computes the suffix. Refcount-0 blocks are
+        # LRU-evicted when admission needs the space — the automatic
+        # generalization of the manual `prefixes` registration (which
+        # stays as a pre-warm hint).
+        self._radix = RadixPrefixCache(self.cengine.pool)
+        self._dirty: list[int] = []  # freed slots awaiting table reset
+        self.prefix_hits = 0      # admissions that reused cached cells
+        self.prefix_misses = 0
+        self.tokens_prefilled = 0  # suffix tokens actually computed
+        self.tokens_reused = 0     # prompt cells served from cache
+        # optional hook(computed: int, reused: int, hit: bool), called
+        # per admission — the server wires metrics through this
+        self.on_prefix = None
         # Shared prefixes (system prompts): token lists registered at
         # construction; each computes its KV ONCE, lazily, on first use
         # (device work belongs under the gpu lock, not in __init__).
@@ -563,6 +771,22 @@ class ContinuousBatcher:
 
     def occupancy(self) -> float:
         return self.tokens_emitted / self.calls if self.calls else 0.0
+
+    def kv_blocks_in_use(self) -> int:
+        """Pool blocks held by active requests + the radix cache (the
+        `serving_kv_blocks_in_use` gauge; x `kv_block_bytes()` for
+        HBM)."""
+        return self.cengine.pool.in_use
+
+    def prefix_cache_stats(self) -> dict[str, int]:
+        return {
+            "hits": self.prefix_hits,
+            "misses": self.prefix_misses,
+            "tokens_prefilled": self.tokens_prefilled,
+            "tokens_reused": self.tokens_reused,
+            "cached_blocks": self._radix.cached_blocks,
+            "blocks_in_use": self.cengine.pool.in_use,
+        }
 
     def warmup(self, buckets=None) -> int:
         """Blocking ahead-of-traffic compile of the full shape set
@@ -692,13 +916,75 @@ class ContinuousBatcher:
     def _release(self, slot: int) -> None:
         """Return a slot to the pool with greedy filler knobs (a
         leftover sampled temperature would drag all-greedy steps into
-        the sampled branch's full-vocab argsorts)."""
-        self._active.pop(slot, None)
+        the sampled branch's full-vocab argsorts). Releases the slot's
+        KV blocks and marks its device-side block table dirty (reset
+        to trash before the next admission, so the freed blocks stop
+        receiving the retired slot's garbage decode writes)."""
+        rec = self._active.pop(slot, None)
         self._free.append(slot)
         self._temp[slot], self._topk[slot], self._topp[slot] = 0, 0, 1.0
         self._sp_dirty = True
+        if rec is not None:
+            self._release_blocks(rec)
+            self._dirty.append(slot)
+
+    def _release_blocks(self, rec: _Slot) -> None:
+        """Drop a request's claim on pool blocks: unref its radix
+        nodes (tree-owned blocks stay cached, evictable once idle) and
+        free the exclusively-owned ones. Idempotent."""
+        if rec.freed:
+            return
+        rec.freed = True
+        if rec.node_refs:
+            self._radix.unref(rec.node_refs)
+            rec.node_refs = []
+        if rec.owned:
+            self.cengine.pool.free(rec.owned.values())
+            rec.owned = {}
+
+    def _cache_blocks(self, rec: _Slot) -> None:
+        """At clean retirement, donate the request's full KV blocks to
+        the radix tree instead of freeing them — the automatic prefix
+        cache. Only cells [0, len(kv_toks) - 1) are guaranteed written
+        (the final token's KV may still be in flight), so only full
+        blocks below that line are indexed; in-flight garbage writes
+        land strictly above it (the slot's cursor never moves back),
+        so adopted blocks are immutable. Must run BEFORE
+        `_release_blocks` frees the rest."""
+        if rec.freed or not rec.kv_toks:
+            return
+        bs = self.cengine.block_size
+        n_full = (len(rec.kv_toks) - 1) // bs
+        if n_full <= 0:
+            return
+        blocks = {i: rec.owned[i] for i in range(n_full)
+                  if i in rec.owned}
+        adopted, _ = self._radix.insert(rec.kv_toks[:n_full * bs],
+                                        blocks)
+        for i in adopted:
+            del rec.owned[i]
+
+    def _index_inflight(self, rec: _Slot) -> None:
+        """At admission, index the prompt's full blocks in the radix
+        tree immediately — a concurrent request sharing the prefix can
+        seed from them while this one is still decoding (device order
+        is safe: its gather is dispatched after our insert). Created
+        nodes start with a ref held by this request (`hold=True`): the
+        tree must not evict a block our own table points at."""
+        bs = self.cengine.block_size
+        n_full = len(rec.kv_toks) // bs
+        if n_full <= 0:
+            return
+        blocks = {i: rec.owned[i] for i in range(n_full)
+                  if i in rec.owned}
+        adopted, held = self._radix.insert(rec.kv_toks[:n_full * bs],
+                                           blocks, hold=True)
+        for i in adopted:
+            del rec.owned[i]
+        rec.node_refs.extend(held)
 
     def _finish(self, slot: int, rec: _Slot) -> None:
+        self._cache_blocks(rec)
         self._release(slot)
         if rec.queue is not None and not rec.fut.done():
             rec.queue.put_nowait(None)
@@ -710,6 +996,7 @@ class ContinuousBatcher:
               decode: bool = True) -> None:
         rec.out.append(token)
         rec.lps.append(lp)
+        rec.kv_toks.append(token)  # cache-content log, never trimmed
         if decode:
             # admission-time first tokens (prefill) stay out of the
             # occupancy numerator — calls counts decode steps only
@@ -747,44 +1034,156 @@ class ContinuousBatcher:
             self._release(slot)
             self._fail(rec.fut, rec.queue, exc)
         self._st = None
+        # the pool array just died with the state: cached tree blocks
+        # describe content that no longer exists — drop them, and the
+        # pending table resets with them (nothing left to reset)
+        self._radix.clear()
+        self._dirty.clear()
 
     async def _get_prefix_state(self, name: str):
-        """Lazily compute (once) a registered prefix's KV."""
-        if name in self._prefix_states:
-            return self._prefix_states[name]
-        loop = asyncio.get_event_loop()
-        async with self.gpu_lock:
-            st = await loop.run_in_executor(
-                None, self.engine.precompute_prefix, self._prefixes[name])
-        self._prefix_states[name] = st
-        return st
+        """Lazily compute (once) a registered prefix's KV, memoized as
+        a single-flight task per name: concurrent first users await the
+        SAME device computation instead of each re-running
+        `precompute_prefix` through the executor (the old check-then-
+        compute raced across its awaits and could prefill the prefix
+        once per concurrent miss). A failed compute is evicted so the
+        next use retries."""
+        task = self._prefix_states.get(name)
+        if task is None:
+            loop = asyncio.get_event_loop()
+
+            async def compute():
+                async with self.gpu_lock:
+                    return await loop.run_in_executor(
+                        None, self.engine.precompute_prefix,
+                        self._prefixes[name])
+
+            task = loop.create_task(compute())
+            self._prefix_states[name] = task
+        try:
+            return await task
+        except Exception:
+            if self._prefix_states.get(name) is task:
+                self._prefix_states.pop(name)
+            raise
+
+    def _plan_blocks(self, item):
+        """Match one request against the radix cache and reserve its
+        physical blocks. Returns a plan dict, or None when the pool
+        can't cover it even after evicting idle cached blocks — the
+        caller defers the request until retirements free space.
+
+        Plan fields: `full` (prompt incl. registered prefix — the
+        token stream the slot's KV will hold), `suffix` (what prefill
+        must actually compute), `m` (cached cells seeding the prefill:
+        cell index == token index by the blocks' canonical form),
+        `chain` (ref'd radix nodes backing cells [0, m - m % bs)),
+        `extra` (ref'd node whose block holds a PARTIAL tail of the
+        match — read-only seed source; the diverging request writes
+        its own fresh block, which is the copy-on-write), `fresh`
+        (newly allocated blocks), `table` (the slot's physical block
+        table, trash-padded)."""
+        tokens, max_new, _sampling, _fut, _queue, _aid, prefix = item
+        ceng = self.cengine
+        bs, mb = ceng.block_size, ceng.blocks_per_slot
+        chain: list = []
+        extra = None
+        m = 0
+        if prefix:
+            # registered-prefix path: seeded from the precomputed
+            # batch-1 state (base-model KV), not the radix tree
+            full = list(self._prefixes[prefix]) + list(tokens)
+            suffix = list(tokens)
+            m = len(self._prefixes[prefix])
+        else:
+            full = list(tokens)
+            if self._st is not None:
+                nodes, pnode, plen = self._radix.match(full)
+                # always leave >= 1 token to prefill: sampling the
+                # first output needs a forward pass over something
+                m = min(len(nodes) * bs + plen, len(full) - 1)
+                cut = m // bs
+                if cut < len(nodes):
+                    # cap bit inside the full-block chain: the node at
+                    # the cut becomes the partial (copy-on-write) seed
+                    extra = nodes[cut] if m % bs else None
+                    nodes = nodes[:cut]
+                elif m % bs:
+                    extra = pnode
+                chain = nodes
+            suffix = full[m:]
+        n_total = -(-min(len(full) + max_new,
+                         self.engine.ec.max_len) // bs)
+        n_fresh = n_total - len(chain)
+        fresh = ceng.pool.alloc(n_fresh)
+        if fresh is None:
+            self._radix.evict(n_fresh - ceng.pool.num_free)
+            fresh = ceng.pool.alloc(n_fresh)
+            if fresh is None:
+                return None
+        self._radix.ref(chain)
+        if extra is not None:
+            self._radix.ref([extra])
+        table = np.zeros(mb, np.int32)
+        phys = [n.block for n in chain] + fresh
+        table[:len(phys)] = phys
+        return {"full": full, "suffix": suffix, "m": m, "chain": chain,
+                "extra": extra, "fresh": fresh, "table": table}
+
+    def _drop_plan(self, plan) -> None:
+        """Roll back `_plan_blocks` reservations (admission failed or
+        the request was cancelled before insert)."""
+        self._radix.unref(plan["chain"])
+        if plan["extra"] is not None:
+            self._radix.unref([plan["extra"]])
+        if plan["fresh"]:
+            self.cengine.pool.free(plan["fresh"])
 
     async def _admit_group(self, items: list) -> None:
         """Admit up to len(self._free) requests; items sharing a
-        prefill bucket AND prefix share ONE prefill dispatch, and the
-        group's slot scatters share one insert_many dispatch. A prefill
-        failure fails its bucket group only; an insert failure fails
-        its whole admit group (and every active request too when the
-        donated buffers were consumed — see the except block)."""
+        prefill bucket, prefix AND cached-seed length share ONE prefill
+        dispatch, and the group's slot scatters share one insert_many
+        dispatch. A prefill failure fails its bucket group only; an
+        insert failure fails its whole admit group (and every active
+        request too when the donated buffers were consumed — see the
+        except block). Admission is now accounted in BLOCKS, not just
+        slots: a request whose worst-case block need outruns the pool
+        (even after evicting idle cached blocks) is deferred back to
+        the queue head until retirements free blocks — later, smaller
+        requests may admit past it (the slot-only admission had no
+        such case: every slot held max_len by construction)."""
         loop = asyncio.get_event_loop()
-        groups: dict[tuple, list] = {}
+        plans = []
+        deferred = []
         for item in items:
+            plan = self._plan_blocks(item)
+            if plan is None:
+                deferred.append(item)
+            else:
+                plans.append((item, plan))
+        for item in reversed(deferred):
+            self._pending.appendleft(item)
+        groups: dict[tuple, list] = {}
+        for item, plan in plans:
             prefix = item[6]
-            reserve = len(self._prefixes[prefix]) if prefix else 0
-            b = self.cengine.bucket_for(len(item[0]), item[1], reserve)
-            groups.setdefault((b, prefix), []).append(item)
-        for (b, prefix), group in groups.items():
+            reserve = plan["m"]
+            b = self.cengine.bucket_for(len(plan["suffix"]), item[1],
+                                        reserve)
+            groups.setdefault((b, prefix, plan["m"]), []).append(
+                (item, plan))
+        for (b, prefix, m), group in groups.items():
             self._rng, sub = jax.random.split(self._rng)
             # pad the group to a power of two with greedy dummy rows:
             # prefill/insert shapes come from a SET of log2(max_slots)
             # sizes instead of one compile per novel group size (the
             # same row bucketing the window Batcher does)
             gp = pow2_ceil(len(group))
-            lists = [it[0] for it in group] + [[0]] * (gp - len(group))
-            samps = ([it[2] for it in group]
+            npad_rows = gp - len(group)
+            lists = [pl["suffix"] for _, pl in group] + [[0]] * npad_rows
+            samps = ([it[2] for it, _ in group]
                      + [{"temperature": 0.0, "top_k": 0, "top_p": 1.0}]
-                     * (gp - len(group)))
-            ids = [it[5] for it in group] + [0] * (gp - len(group))
+                     * npad_rows)
+            ids = [it[5] for it, _ in group] + [0] * npad_rows
 
             def run_prefill(pstate0=None, lists=lists, b=b, samps=samps,
                             sub=sub, ids=ids):
@@ -796,45 +1195,80 @@ class ContinuousBatcher:
                 return pstate, np.asarray(first), np.asarray(lps)
 
             try:
-                pstate0 = (await self._get_prefix_state(prefix)
-                           if prefix else None)
+                if prefix:
+                    pstate0 = await self._get_prefix_state(prefix)
+                elif m > 0:
+                    # seed rows from cached pool blocks: gather each
+                    # row's chain (+ partial CoW block) into a batch-g
+                    # DecodeState. self._st exists — a non-empty radix
+                    # tree implies blocks were inserted into it.
+                    mb = self.cengine.blocks_per_slot
+                    chains = np.zeros((gp, mb), np.int32)
+                    for i, (_, pl) in enumerate(group):
+                        phys = [n.block for n in pl["chain"]]
+                        if pl["extra"] is not None:
+                            phys.append(pl["extra"].block)
+                        chains[i, :len(phys)] = phys
+
+                    def run_gather(st=self._st, chains=chains, m=m):
+                        return self.cengine.gather_seed(st, chains, m)
+
+                    async with self.gpu_lock:
+                        pstate0 = await loop.run_in_executor(
+                            None, run_gather)
+                else:
+                    pstate0 = None
                 async with self.gpu_lock:
                     pstate, firsts, flps = await loop.run_in_executor(
                         None, run_prefill, pstate0)
             except Exception as e:  # noqa: BLE001
-                for _, _, _, fut, queue, _, _ in group:
-                    self._fail(fut, queue, e)
+                for it, pl in group:
+                    self._drop_plan(pl)
+                    self._fail(it[3], it[4], e)
                 continue
-            admit = [(row, item) for row, item in enumerate(group)
-                     if not item[3].done()]  # skip cancelled-in-prefill
+            admit = []
+            for row, (item, plan) in enumerate(group):
+                if item[3].done():  # cancelled while prefilling
+                    self._drop_plan(plan)
+                else:
+                    admit.append((row, item, plan))
             if not admit:
                 continue
             slots = [self._free.pop() for _ in admit]
             # Pad the scatter list to a power of two by REPEATING the
-            # last (slot, row, aid) triple — re-inserting the same row
-            # into the same slot is idempotent under the sequential
-            # scan — so insert_many's compile set stays the warmed
-            # log2(max_slots) sizes instead of one program per novel
-            # arrival count (a mid-traffic TPU compile stalls every
-            # active decode for seconds).
+            # last (slot, row, aid, table, seed) tuple — re-inserting
+            # the same row into the same slot is idempotent under the
+            # sequential scan — so insert_many's compile set stays the
+            # warmed log2(max_slots) sizes instead of one program per
+            # novel arrival count (a mid-traffic TPU compile stalls
+            # every active decode for seconds).
             pad = pow2_ceil(len(admit)) - len(admit)
             ins_slots = slots + [slots[-1]] * pad
-            ins_rows = [r for r, _ in admit] + [admit[-1][0]] * pad
-            ins_aids = ([it[5] for _, it in admit]
+            ins_rows = [r for r, _, _ in admit] + [admit[-1][0]] * pad
+            ins_aids = ([it[5] for _, it, _ in admit]
                         + [admit[-1][1][5]] * pad)
+            tables = np.stack([pl["table"] for _, _, pl in admit]
+                              + [admit[-1][2]["table"]] * pad)
+            seed_lens = [m] * len(ins_slots)
             try:
                 if self._st is None:
                     self._st = self.cengine.init_slots()
+
+                def run_insert(st=self._st):
+                    return self.cengine.insert_many(
+                        st, ins_slots, pstate, ins_rows, firsts,
+                        ins_aids, tables=tables, seed_lens=seed_lens)
+
                 async with self.gpu_lock:
                     # ONE dispatch for the whole group's scatters (the
                     # admission-side sibling of the group prefill)
                     self._st = await loop.run_in_executor(
-                        None, self.cengine.insert_many, self._st,
-                        ins_slots, pstate, ins_rows, firsts, ins_aids)
+                        None, run_insert)
             except Exception as e:  # noqa: BLE001
                 self._free.extend(slots)
-                for _, (_, _, _, fut, queue, _, _) in admit:
-                    self._fail(fut, queue, e)
+                for _, it, pl in admit:
+                    self._drop_plan(pl)
+                    self._fail(it[3], it[4], e)
                 # insert donates self._st: a failure that fired AFTER
                 # dispatch leaves the old buffers consumed, and keeping
                 # them would crash the NEXT decode step with a
@@ -850,12 +1284,37 @@ class ContinuousBatcher:
                         f"slot state lost to donated insert: {e}"))
                 continue
             for slot, (row, (tokens, max_new, sampling, fut, queue,
-                             aid, _)) in zip(slots, admit):
+                             aid, _), plan) in zip(slots, admit):
                 self.requests += 1
                 rec = _Slot(fut, max_new, queue,
                             stop=tuple(tuple(s) for s in
                                        sampling.get("stop", ())))
+                rec.kv_toks = list(plan["full"])
+                rec.node_refs = list(plan["chain"])
+                cut = len(plan["chain"])
+                rec.owned = {cut + i: blk
+                             for i, blk in enumerate(plan["fresh"])}
+                if plan["extra"] is not None:
+                    # the partial block was only a read-only seed
+                    # source; its content now lives in this row's own
+                    # fresh block (the copy half of copy-on-write)
+                    self._radix.unref([plan["extra"]])
                 self._active[slot] = rec
+                # make this prompt's blocks reusable immediately, not
+                # just at retirement (in-flight prefix sharing)
+                self._index_inflight(rec)
+                computed, reused = len(plan["suffix"]), plan["m"]
+                self.tokens_prefilled += computed
+                self.tokens_reused += reused
+                if reused > 0:
+                    self.prefix_hits += 1
+                else:
+                    self.prefix_misses += 1
+                if self.on_prefix is not None:
+                    try:
+                        self.on_prefix(computed, reused, reused > 0)
+                    except Exception:  # noqa: BLE001 — metrics hook
+                        pass           # must never kill the worker
                 ec = self.engine.ec
                 self._temp[slot] = sampling.get(
                     "temperature", ec.temperature)
@@ -939,6 +1398,27 @@ class ContinuousBatcher:
             if not self._active and not self._pending and not inflight:
                 self._wake.clear()
                 await self._wake.wait()
+            # Reset retired slots' block tables to trash BEFORE any
+            # admission can hand their freed blocks to a new request:
+            # the reset rides the state-donation chain, so it lands
+            # after the retiree's last in-flight garbage writes and
+            # before the new owner's insert. (Slots re-admitted in the
+            # same iteration are safe either way — insert overwrites
+            # the table — but an idle freed slot must stop writing.)
+            if self._dirty and self._st is not None:
+                dirty = sorted(set(self._dirty))
+                try:
+                    async with self.gpu_lock:
+                        self._st = await loop.run_in_executor(
+                            None, self.cengine.reset_slots,
+                            self._st, dirty)
+                except Exception as e:  # noqa: BLE001
+                    self._fail_all(e)
+                    inflight.clear()
+                    continue
+                self._dirty.clear()
+            elif self._dirty:
+                self._dirty.clear()  # no state left to reset
             # admit up to the free-slot count; dead futures are skipped
             if self._free and self._pending:
                 take: list = []
@@ -983,6 +1463,7 @@ class ContinuousBatcher:
                 pass
         for slot, rec in list(self._active.items()):
             self._active.pop(slot, None)
+            self._release_blocks(rec)
             if rec.queue is not None and not rec.fut.done():
                 rec.queue.put_nowait(None)
             if not rec.fut.done():

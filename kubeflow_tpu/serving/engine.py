@@ -390,6 +390,19 @@ class InferenceEngine:
             jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype),
             jnp.zeros((), jnp.int32))
 
+    def kv_cache_bytes(self, batch: int, cells: int | None = None) -> int:
+        """KV-cache HBM for `batch` rows of `cells` cache cells (K+V,
+        all layers; defaults to max_len — the dense worst case). The
+        common yardstick for the paged bench and the observability
+        docs: the dense engine always pays batch * max_len, the paged
+        pool pays blocks_in_use * block_size."""
+        cfg = self.cfg
+        if cells is None:
+            cells = self.ec.max_len
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        return (2 * cfg.num_layers * batch * cells
+                * cfg.num_kv_heads * cfg.head_dim * itemsize)
+
     def _sample(self, logits, rng, sp: SamplingParams):
         """-> (tokens [b], logprobs [b]). The logprob is the chosen
         token's log-softmax under the RAW model distribution

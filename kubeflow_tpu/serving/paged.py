@@ -1,0 +1,247 @@
+"""Paged KV-cache bookkeeping: block pool + radix prefix cache.
+
+This module is pure host-side Python — no jax. The device arrays (the
+block pool itself, `[L, num_blocks, block_size, n_kv, hd]`) live inside
+`ContinuousEngine`'s `SlotState`; here we only track which physical
+blocks are free, which are owned by an in-flight request, and which are
+retained by the radix tree for cross-request prefix reuse.
+
+Conventions
+-----------
+- Block 0 is the reserved *trash* block. Unallocated block-table entries
+  point at it, and writes from retired-but-not-yet-reset slots land
+  there harmlessly. It is never handed out by the pool.
+- The radix tree has one node per *full* block: an edge is exactly
+  `block_size` tokens. Partial-block prefixes are matched by comparing
+  against a child's key and are handled by the caller as copy-on-write
+  (the matched block seeds the prefill state; the new request writes its
+  own fresh block, so the shared one is never mutated).
+- `refs` on a node counts *active requests whose block table points at
+  that physical block*. Only refcount-0 nodes may be evicted, and only
+  leaves (evicting an interior node would orphan its children's token
+  paths).
+"""
+
+from __future__ import annotations
+
+__all__ = ["BlockPool", "RadixPrefixCache", "TRASH_BLOCK"]
+
+TRASH_BLOCK = 0
+
+
+class BlockPool:
+    """Free-list allocator over physical KV block ids `[1, num_blocks)`.
+
+    Block 0 (trash) is reserved and never allocated. The pool knows
+    nothing about the radix tree; blocks held by the tree are simply
+    "in use" until `RadixPrefixCache.evict` returns them.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (1 trash + 1 usable), got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # LIFO off the tail; initialised so the first allocs are 1, 2, ...
+        self._free = list(range(num_blocks - 1, 0, -1))
+
+    @property
+    def capacity(self) -> int:
+        """Usable blocks (excludes the trash block)."""
+        return self.num_blocks - 1
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.capacity - len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """Take `n` blocks, or None (and take nothing) if fewer are free."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks) -> None:
+        for b in blocks:
+            if not (0 < b < self.num_blocks):
+                raise ValueError(f"freeing out-of-range block {b}")
+            self._free.append(b)
+
+
+class _Node:
+    __slots__ = ("key", "block", "children", "refs", "last_use", "parent")
+
+    def __init__(self, key, block, parent):
+        self.key = key          # tuple of block_size token ids (None at root)
+        self.block = block      # physical block id (None at root)
+        self.children = {}      # key tuple -> _Node
+        self.refs = 0           # active requests pointing at self.block
+        self.last_use = 0       # logical clock, for LRU eviction
+        self.parent = parent
+
+
+class RadixPrefixCache:
+    """Token-prefix index over full KV blocks, with ref-counted sharing.
+
+    `match` walks full-block edges and additionally reports a *partial*
+    match inside the next edge (for copy-on-write seeding). `insert`
+    adopts caller-owned blocks into the tree; blocks whose token path
+    already exists are left with the caller (duplicates — free them).
+    `evict` pops refcount-0 leaves in LRU order back to the pool.
+    """
+
+    def __init__(self, pool: BlockPool):
+        self.pool = pool
+        self.block_size = pool.block_size
+        self.root = _Node(None, None, None)
+        self._clock = 0
+        self.cached_blocks = 0  # blocks currently owned by the tree
+
+    # -- internals ---------------------------------------------------------
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _touch(self, node: _Node) -> None:
+        t = self._tick()
+        while node is not None and node is not self.root:
+            node.last_use = t
+            node = node.parent
+
+    # -- queries -----------------------------------------------------------
+
+    def match(self, tokens) -> tuple[list["_Node"], "_Node | None", int]:
+        """Longest cached prefix of `tokens`.
+
+        Returns `(nodes, partial_node, partial_len)`: `nodes` are the
+        fully-matched block edges in order; `partial_node` (if any) is a
+        child whose key shares `partial_len in [1, block_size)` leading
+        tokens with the remainder. Does NOT take refs — callers decide
+        which nodes they depend on and `ref` those.
+        """
+        bs = self.block_size
+        nodes: list[_Node] = []
+        node = self.root
+        i = 0
+        while i + bs <= len(tokens):
+            child = node.children.get(tuple(tokens[i : i + bs]))
+            if child is None:
+                break
+            nodes.append(child)
+            node = child
+            i += bs
+        partial_node, partial_len = None, 0
+        rest = tuple(tokens[i : i + bs])
+        if rest:
+            for key, child in node.children.items():
+                n = 0
+                for a, b in zip(rest, key):
+                    if a != b:
+                        break
+                    n += 1
+                if n > partial_len:
+                    partial_node, partial_len = child, n
+        if nodes:
+            self._touch(nodes[-1])
+        if partial_node is not None:
+            self._touch(partial_node)
+        return nodes, partial_node, partial_len
+
+    # -- ref management ----------------------------------------------------
+
+    def ref(self, nodes) -> None:
+        for n in nodes:
+            n.refs += 1
+        if nodes:
+            self._touch(nodes[-1])
+
+    def unref(self, nodes) -> None:
+        for n in nodes:
+            n.refs -= 1
+            assert n.refs >= 0, "refcount underflow"
+
+    # -- growth ------------------------------------------------------------
+
+    def insert(self, tokens, blocks: dict[int, int], *, hold: bool = False):
+        """Index `tokens` (length must be a multiple of block_size) into
+        the tree. `blocks[i]` is the caller-owned physical block holding
+        tokens `[i*bs, (i+1)*bs)`; only consulted for edges that don't
+        exist yet. Returns `(adopted, held_nodes)` where `adopted` is
+        the set of block indices the tree took ownership of, and
+        `held_nodes` the nodes created with an initial ref for the
+        caller (only when `hold=True` — the caller's block table points
+        at those blocks, so they must not be evicted underneath it).
+        """
+        bs = self.block_size
+        assert len(tokens) % bs == 0, len(tokens)
+        adopted: set[int] = set()
+        held: list[_Node] = []
+        node = self.root
+        for i in range(len(tokens) // bs):
+            key = tuple(tokens[i * bs : (i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                phys = blocks.get(i)
+                if phys is None:
+                    break  # caller had nothing for this edge; stop here
+                child = _Node(key, phys, node)
+                node.children[key] = child
+                adopted.add(i)
+                self.cached_blocks += 1
+                if hold:
+                    child.refs = 1
+                    held.append(child)
+            node = child
+        if node is not self.root:
+            self._touch(node)
+        return adopted, held
+
+    # -- shrink ------------------------------------------------------------
+
+    def evict(self, need: int) -> int:
+        """Free refcount-0 LRU leaves back to the pool until `need`
+        blocks have been released (or no candidates remain). Returns
+        how many were actually freed."""
+        freed = 0
+        while freed < need:
+            victim = None
+            stack = [self.root]
+            while stack:
+                n = stack.pop()
+                stack.extend(n.children.values())
+                if n is self.root or n.children or n.refs > 0:
+                    continue
+                if victim is None or n.last_use < victim.last_use:
+                    victim = n
+            if victim is None:
+                break
+            del victim.parent.children[victim.key]
+            self.pool.free([victim.block])
+            self.cached_blocks -= 1
+            freed += 1
+        return freed
+
+    def clear(self) -> None:
+        """Drop the whole tree, returning every cached block to the pool.
+
+        Must be called whenever the device-side pool array is discarded
+        (e.g. after a failed dispatch poisons the state): the tree's
+        blocks describe content that no longer exists.
+        """
+        blocks = []
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            blocks.append(n.block)
+            stack.extend(n.children.values())
+        self.root.children.clear()
+        if blocks:
+            self.pool.free(blocks)
+        self.cached_blocks = 0

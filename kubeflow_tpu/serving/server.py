@@ -45,12 +45,22 @@ def byte_encode(text: str) -> list[int]:
     return [BOS] + [b + BYTE_OFFSET for b in text.encode("utf-8")]
 
 
-def byte_decode(tokens: list[int]) -> str:
-    # Ids outside the byte range (specials below, vocab tail above — the
-    # model's vocab is larger than 256+offset) are dropped, not crashed on.
-    raw = bytes(t - BYTE_OFFSET for t in tokens
-                if BYTE_OFFSET <= t < BYTE_OFFSET + 256)
-    return raw.decode("utf-8", errors="replace")
+def byte_decode(tokens: list[int], on_dropped=None) -> str:
+    # Ids outside the byte range are dropped, not crashed on. Specials
+    # below the offset (pad/bos/eos) are expected in generated rows and
+    # stay silent; vocab-TAIL ids (the model's vocab is larger than
+    # 256+offset, so a sampled tail id means tokenizer/model drift) are
+    # the ones worth surfacing — silent drops there hide drift, and
+    # debugging a prefix-cache mismatch starts from the token stream.
+    # Callers pass `on_dropped(count)` to count tail drops; the serving
+    # app feeds `serving_tokenizer_dropped_tokens_total`.
+    kept = [t - BYTE_OFFSET for t in tokens
+            if BYTE_OFFSET <= t < BYTE_OFFSET + 256]
+    if on_dropped is not None:
+        tail = sum(1 for t in tokens if t >= BYTE_OFFSET + 256)
+        if tail:
+            on_dropped(tail)
+    return bytes(kept).decode("utf-8", errors="replace")
 
 
 ENGINES_KEY: web.AppKey = web.AppKey("engines", dict)
@@ -71,7 +81,11 @@ class ServingObs:
         # controlplane.metrics is pure Python (no jax/store state is
         # touched here) — the ONE Registry implementation serves all
         # three layers rather than a drifted serving copy.
-        from kubeflow_tpu.controlplane.metrics import Registry
+        from kubeflow_tpu.controlplane.metrics import (
+            Counter,
+            Gauge,
+            Registry,
+        )
 
         self.registry = registry if registry is not None else Registry()
         self.tracer = tracer if tracer is not None else obs_lib.Tracer()
@@ -87,6 +101,31 @@ class ServingObs:
             self.registry, "serving_batch_size",
             "Requests co-scheduled per engine invocation",
             buckets=obs_lib.SIZE_BUCKETS)
+        # Paged-KV / radix-prefix-cache instrumentation (continuous
+        # batcher only; the gauge is refreshed by a render-time
+        # collector so /metrics always reports the live pool).
+        self.prefix_hits = Counter(
+            "serving_prefix_cache_hits_total",
+            "Admissions that reused cached KV cells (radix prefix "
+            "cache or a registered prefix)", self.registry)
+        self.prefix_misses = Counter(
+            "serving_prefix_cache_misses_total",
+            "Admissions that prefilled their whole prompt (no cached "
+            "prefix matched)", self.registry)
+        self.kv_blocks = Gauge(
+            "serving_kv_blocks_in_use",
+            "KV pool blocks held by active requests plus the radix "
+            "prefix cache, per model", self.registry)
+        self.prefill_tokens = obs_lib.get_or_create_histogram(
+            self.registry, "serving_prefill_tokens",
+            "Per-admission prompt tokens by source: computed (suffix "
+            "actually prefilled) vs reused (served from cached KV)",
+            buckets=obs_lib.TOKEN_BUCKETS)
+        self.dropped_tokens = Counter(
+            "serving_tokenizer_dropped_tokens_total",
+            "Generated token ids outside the byte-decoder's range "
+            "(vocab tail / specials) dropped from text responses — "
+            "nonzero means tokenizer/model drift", self.registry)
 
 
 _OBS_T0 = "obs_request_start"
@@ -296,6 +335,8 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                        prefixes: dict[str, list[int]] | None = None,
                        max_pending: int | None = None,
                        pipeline_depth: int | None = None,
+                       kv_block_size: int = 64,
+                       kv_pool_blocks: int | None = None,
                        drafts: dict[str, InferenceEngine] | None = None,
                        registry=None, tracer=None,
                        ) -> web.Application:
@@ -312,7 +353,12 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     readiness implies no first-arrival compile stalls — startup takes
     correspondingly longer. `drafts` maps model names to draft
     engines; a request with "speculative": true then decodes through
-    SpeculativeEngine (latency lever; batch 1). `registry`/`tracer`
+    SpeculativeEngine (latency lever; batch 1). `kv_block_size` /
+    `kv_pool_blocks` (continuous only) shape the paged KV cache: pow2
+    tokens per block and total pool blocks per model (default: the
+    dense equivalent, every slot can reach max_len — shrink the pool
+    to cap KV HBM, admission then accounts by blocks free and defers
+    requests the pool can't cover). `registry`/`tracer`
     share an external metric registry / span tracer; by default the app
     owns fresh ones, exposed at `/metrics` and `/debug/traces`."""
     app = web.Application(middlewares=[_obs_middleware])
@@ -342,14 +388,16 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     app[GPU_LOCK_KEY] = lock
     if not continuous and (warmup or prefill_chunk or prefixes
                            or max_pending is not None
-                           or pipeline_depth is not None):
+                           or pipeline_depth is not None
+                           or kv_block_size != 64
+                           or kv_pool_blocks is not None):
         # these knobs only exist on the continuous batcher; silently
         # ignoring them would ship a server missing configuration the
         # caller explicitly asked for (max_pending especially: the
         # caller believes overload sheds at that depth)
         raise ValueError(
-            "warmup/prefill_chunk/prefixes/max_pending/pipeline_depth "
-            "require continuous=True")
+            "warmup/prefill_chunk/prefixes/max_pending/pipeline_depth/"
+            "kv_block_size/kv_pool_blocks require continuous=True")
     if continuous:
         # prefill_chunk: long prompts admit in fixed slices — chunk-
         # multiple buckets, one [g, chunk] compile for every length.
@@ -360,7 +408,9 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                 eng, lock, max_slots=max_batch,
                 prefill_chunk=prefill_chunk, prefixes=prefixes,
                 max_pending=256 if max_pending is None else max_pending,
-                pipeline_depth=pipeline_depth)
+                pipeline_depth=pipeline_depth,
+                kv_block_size=kv_block_size,
+                kv_pool_blocks=kv_pool_blocks)
             for name, eng in engines.items()}
         if warmup:
             async def _warm(app_):
@@ -381,6 +431,30 @@ def create_serving_app(engines: dict[str, InferenceEngine],
             # calls/requests counters list_models reports
             b.on_batch = (lambda n, _m=model_name:
                           sobs.batch_size.observe(n, model=_m))
+        elif isinstance(b, ContinuousBatcher):
+            def on_prefix(computed, reused, hit, _m=model_name):
+                (sobs.prefix_hits if hit
+                 else sobs.prefix_misses).inc(model=_m)
+                sobs.prefill_tokens.observe(
+                    computed, model=_m, source="computed")
+                if reused:
+                    sobs.prefill_tokens.observe(
+                        reused, model=_m, source="reused")
+
+            b.on_prefix = on_prefix
+            # seed zero samples so the exposition carries the series
+            # (and a 0 reading) before the first admission
+            sobs.prefix_hits.inc(0, model=model_name)
+            sobs.prefix_misses.inc(0, model=model_name)
+    if continuous:
+        def collect_kv_blocks():
+            # gauge refreshed at render: /metrics reads the LIVE pool,
+            # not the pool as of the last admission/retirement
+            for _m, _b in app[BATCHERS_KEY].items():
+                if isinstance(_b, ContinuousBatcher):
+                    sobs.kv_blocks.set(_b.kv_blocks_in_use(), model=_m)
+
+        sobs.registry.register_collector(collect_kv_blocks)
 
     async def _close_batchers(app_):
         for b in app_[BATCHERS_KEY].values():
@@ -439,6 +513,9 @@ async def list_models(request: web.Request):
                 entry["pending"] = len(batcher._pending)
                 entry["active_slots"] = len(batcher._active)
                 entry["pipeline_depth"] = batcher.pipeline_depth
+                entry["kv_block_size"] = batcher.cengine.block_size
+                entry["kv_pool_blocks"] = batcher.cengine.num_blocks
+                entry["prefix_cache"] = batcher.prefix_cache_stats()
                 if batcher._prefixes:
                     entry["prefixes"] = {
                         n: len(t) for n, t in batcher._prefixes.items()}
@@ -526,7 +603,10 @@ async def _stream_generate(request, engine, arr, max_new, sampling,
         if text_mode and chunks:
             ids = np.concatenate(chunks, axis=1)[0].tolist()
             final["text"] = (tokenizer.decode(ids) if tokenizer
-                             else byte_decode(ids))
+                             else byte_decode(
+                                 ids,
+                                 on_dropped=lambda n: sobs.dropped_tokens
+                                 .inc(n, model=model)))
     await resp.write(b"data: " + _json.dumps(final).encode() + b"\n\n")
     await resp.write_eof()
     return resp
@@ -595,7 +675,10 @@ async def _stream_continuous(request, batcher, arr, max_new, sampling,
         final = {"done": True, "total": len(ids)}
         if text_mode and ids:
             final["text"] = (tokenizer.decode(ids) if tokenizer
-                             else byte_decode(ids))
+                             else byte_decode(
+                                 ids,
+                                 on_dropped=lambda n: sobs.dropped_tokens
+                                 .inc(n, model=model)))
     await resp.write(b"data: " + _json.dumps(final).encode() + b"\n\n")
     await resp.write_eof()
     return resp
@@ -1035,7 +1118,10 @@ async def generate(request: web.Request):
         resp["logprobs"] = out_lps
     if text_mode:
         resp["text"] = (tokenizer.decode(rows[0]) if tokenizer
-                        else byte_decode(rows[0]))
+                        else byte_decode(
+                            rows[0],
+                            on_dropped=lambda n: sobs.dropped_tokens
+                            .inc(n, model=name)))
     return web.json_response(resp)
 
 

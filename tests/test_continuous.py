@@ -627,13 +627,44 @@ async def test_backpressure_sheds_load():
 
 
 @pytest.mark.slow
+async def test_block_admission_defers_until_blocks_free():
+    """Admission is accounted in KV BLOCKS, not just slots: with a pool
+    holding 8 usable blocks (kv_pool_blocks=9) and two 40-token prompts
+    each needing ceil(48/8)=6 blocks, both slots are free but only one
+    request fits — the second must defer until the first retires (and
+    its refcount-0 blocks are evicted), then decode exactly."""
+    engine, cfg = _engine()
+    batcher = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2,
+                                kv_block_size=8, kv_pool_blocks=9)
+    cap = batcher.cengine.pool.capacity
+    gen = np.random.default_rng(23)
+    prompts = [gen.integers(0, cfg.vocab_size, 40).tolist()
+               for _ in range(2)]
+    want = [_solo(engine, p, 8) for p in prompts]
+    got = await asyncio.gather(
+        *(batcher.submit(p, 8, ()) for p in prompts))
+    assert list(got) == want
+    assert batcher.requests == 2
+    # never over-committed, and accounting closes once both retired:
+    # every in-use block is owned by the radix cache
+    assert batcher.cengine.pool.in_use <= cap
+    assert batcher.kv_blocks_in_use() == \
+        batcher.prefix_cache_stats()["cached_blocks"]
+    await batcher.close()
+
+
+@pytest.mark.slow
 async def test_direct_path_logprobs_stop_at_first_eos():
     """Uniform logprobs contract: entries cover tokens up to AND
     INCLUDING the first EOS on the direct path too — the padded tail's
     pre-forcing sample logprobs must never reach clients."""
     engine0, cfg = _engine()
-    p = np.random.default_rng(41).integers(0, cfg.vocab_size, 6).tolist()
+    p = np.random.default_rng(42).integers(0, cfg.vocab_size, 6).tolist()
     ref = _solo(engine0, p, 6)
+    # the construction needs EOS to FIRST appear at index 2 — a seed
+    # whose continuation repeats ref[2] earlier would fire EOS at
+    # token 0 and trim everything (the way this test once rotted)
+    assert ref[2] not in ref[:2], ref
     engine, _ = _engine(eos=ref[2])
     app = server_lib.create_serving_app({"m": engine})
     client = TestClient(TestServer(app))
