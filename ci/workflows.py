@@ -63,6 +63,14 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         "tests": ("python -m pytest tests/test_obs.py -q && "
                   "python -m ci.obs_check"),
     },
+    # Fleet layer (router / registry / autoscale): pure-host code, no
+    # jax at import time in the router itself, but the suite also
+    # exercises the serving drain path so it runs under the CPU pin.
+    "fleet": {
+        "paths": ["kubeflow_tpu/fleet/**",
+                  "loadtest/serving_loadtest.py"],
+        "tests": "python -m pytest tests/test_fleet.py -q",
+    },
     # The driver evidence pipeline (bench.py + __graft_entry__) runs its
     # FULL tier including the slow subprocess armoring tests: these are
     # the round-3-postmortem regression guards (wedged-TPU fallback,
@@ -382,6 +390,39 @@ def serving_check_workflow() -> dict:
     }
 
 
+def fleet_check_workflow() -> dict:
+    """Fleet router acceptance gate: `make fleet-check` runs the unit
+    suite AND a 2-replica loadtest through the router, so the
+    prefix-affinity hit-rate claim and the drain/failover behavior are
+    re-proven on every fleet or serving change — not asserted once in
+    a perf note and left to rot."""
+    return {
+        "name": "fleet check",
+        "on": {
+            "pull_request": {"paths": ["kubeflow_tpu/fleet/**",
+                                       "kubeflow_tpu/serving/**",
+                                       "loadtest/serving_loadtest.py",
+                                       "tests/test_fleet.py",
+                                       "Makefile"]},
+            "push": {"branches": ["main"]},
+        },
+        "jobs": {
+            "fleet-check": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e .[ci] pytest"},
+                    {"name": "fleet unit + routed loadtest gate",
+                     "run": "make fleet-check",
+                     "env": {"JAX_PLATFORMS": "cpu"}},
+                ],
+            }
+        },
+    }
+
+
 def all_workflows() -> dict[str, dict]:
     from ci import cd
 
@@ -395,6 +436,7 @@ def all_workflows() -> dict[str, dict]:
     out["deploy_smoke_test.yaml"] = deploy_smoke_workflow()
     out["slow_tier_test.yaml"] = slow_tier_workflow()
     out["serving_check.yaml"] = serving_check_workflow()
+    out["fleet_check.yaml"] = fleet_check_workflow()
     out["frontend_test.yaml"] = frontend_workflow()
     out.update(cd.all_workflows())
     return out

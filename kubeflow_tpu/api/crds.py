@@ -189,6 +189,14 @@ class ModelServerSpec:
     # "pvc://name/subpath" (train.Checkpointer dir on a PVC),
     # "gs://bucket/path", or "" = random init (smoke/dev)
     checkpoint: str = ""
+    # Fleet sizing (ISSUE 3): `replicas` is the baseline (and the
+    # autoscale floor); `max_replicas > 0` enables annotation-driven
+    # autoscaling — the fleet router's recommendation is written to
+    # the kubeflow-tpu.dev/desired-replicas annotation and the
+    # controller clamps it into [replicas, max_replicas], draining
+    # excess pods before deleting them on scale-down.
+    replicas: int = 1
+    max_replicas: int = 0        # 0 = autoscale off
     max_len: int = 1024
     continuous: bool = True
     warmup: bool = True

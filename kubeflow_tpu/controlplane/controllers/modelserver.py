@@ -23,6 +23,7 @@ notebooks. TPU-native restatement:
 from __future__ import annotations
 
 import os
+import time
 
 from kubeflow_tpu.api.core import (
     Container,
@@ -65,6 +66,18 @@ DEFAULT_IMAGE = "kubeflow-tpu/serving:latest"  # KFTPU_SERVING_IMAGE env
 SERVE_PORT = 8000
 MS_NAME_LABEL = "modelserver-name"
 
+# Autoscale handshake (ISSUE 3): whatever consumes the fleet router's
+# /fleet/autoscale recommendation writes the number here; the
+# controller clamps it into [spec.replicas, spec.max_replicas].
+DESIRED_REPLICAS_ANNOTATION = "kubeflow-tpu.dev/desired-replicas"
+# Scale-down protocol: excess pods are annotated draining-since first
+# (a real deployment would POST /drain to the replica, which stops
+# admission and finishes in-flight slots); only after DRAIN_GRACE_S
+# does the controller delete them and shrink the Deployment. Module
+# constant so tests shrink the window instead of sleeping 5 s.
+DRAIN_ANNOTATION = "kubeflow-tpu.dev/draining-since"
+DRAIN_GRACE_S = 5.0
+
 
 class ModelServerController(Controller):
     KIND = "ModelServer"
@@ -90,7 +103,16 @@ class ModelServerController(Controller):
                 store.emit_event(ms, "Warning", reason, msg)
             return Result()
 
-        dep = self._desired_deployment(ms)
+        desired = self._desired_replica_count(store, ms)
+        requeue = None
+        cur_dep = store.try_get("Deployment", namespace, name)
+        if cur_dep is not None and desired < cur_dep.spec.replicas:
+            # scale-down drains before delete: hold the Deployment at
+            # its current size while excess pods sit in their drain
+            # window, then delete them and shrink
+            desired, requeue = self._drain_scale_down(
+                store, ms, cur_dep, desired)
+        dep = self._desired_deployment(ms, replicas=desired)
         reconcile_child(store, ms, dep, copy_spec_and_labels)
         svc = self._desired_service(ms)
         reconcile_child(store, ms, svc, copy_spec_and_labels)
@@ -112,7 +134,79 @@ class ModelServerController(Controller):
             fresh.status.conditions = conditions
             fresh.status.url = url
             store.update(fresh)
-        return Result()
+        return Result(requeue_after=requeue)
+
+    def _desired_replica_count(self, store: Store, ms: ModelServer) -> int:
+        """spec.replicas, lifted by the autoscale annotation when
+        max_replicas enables it — clamped to [replicas, max_replicas]
+        so a runaway recommender can never scale past the operator's
+        ceiling or below the configured baseline."""
+        spec = ms.spec
+        desired = max(1, spec.replicas)
+        ann = ms.metadata.annotations.get(DESIRED_REPLICAS_ANNOTATION)
+        if ann is None or not spec.max_replicas:
+            return desired
+        try:
+            want = int(ann)
+        except ValueError:
+            reason = "InvalidDesiredReplicas"
+            if not any(e.reason == reason for e in store.events_for(
+                    "ModelServer", ms.metadata.namespace,
+                    ms.metadata.name)):
+                store.emit_event(
+                    ms, "Warning", reason,
+                    f"annotation {DESIRED_REPLICAS_ANNOTATION}={ann!r} "
+                    "is not an integer; using spec.replicas")
+            return desired
+        return max(spec.replicas, min(want, spec.max_replicas))
+
+    @staticmethod
+    def _drain_scale_down(store: Store, ms: ModelServer, cur_dep,
+                          desired: int):
+        """Mark excess pods draining (newest first are removed; the
+        oldest `desired` stay), hold the Deployment at its current
+        size until every excess pod's drain window has elapsed, then
+        delete the drained pods and let the Deployment shrink.
+        Returns (replicas_to_render_now, requeue_after)."""
+        ns, name = ms.metadata.namespace, ms.metadata.name
+        now = time.time()
+        pods = sorted(
+            store.list("Pod", ns, owner_uid=cur_dep.metadata.uid),
+            key=lambda p: (p.metadata.creation_timestamp,
+                           p.metadata.name))
+        excess = pods[desired:]
+        if not excess:
+            # pods already gone (or never created): shrink directly
+            return desired, None
+        remaining = 0.0
+        newly = []
+        for pod in excess:
+            since = pod.metadata.annotations.get(DRAIN_ANNOTATION)
+            if since is None:
+                pod.metadata.annotations[DRAIN_ANNOTATION] = repr(now)
+                store.update(pod)
+                newly.append(pod.metadata.name)
+                remaining = max(remaining, DRAIN_GRACE_S)
+            else:
+                remaining = max(
+                    remaining, float(since) + DRAIN_GRACE_S - now)
+        if newly:
+            store.emit_event(
+                ms, "Normal", "DrainingReplica",
+                f"draining {len(newly)} replica pod(s) before "
+                f"scale-down to {desired}")
+        if remaining > 0:
+            # hold at current size; requeue when the window closes
+            return cur_dep.spec.replicas, remaining
+        for pod in excess:
+            try:
+                store.delete("Pod", ns, pod.metadata.name)
+            except NotFound:
+                pass
+        store.emit_event(ms, "Normal", "ScaledDown",
+                         f"scaled {name} to {desired} replica(s) after "
+                         "drain")
+        return desired, None
 
     @staticmethod
     def _validate(ms: ModelServer):
@@ -136,6 +230,13 @@ class ModelServerController(Controller):
                     f"max_len ({spec.max_len}) and max_batch "
                     f"({spec.max_batch}) must be >= 1; prefill_chunk "
                     f"({spec.prefill_chunk}) must be >= 0")
+        if spec.replicas < 1:
+            return ("InvalidReplicas",
+                    f"replicas ({spec.replicas}) must be >= 1")
+        if spec.max_replicas and spec.max_replicas < spec.replicas:
+            return ("InvalidReplicas",
+                    f"max_replicas ({spec.max_replicas}) must be 0 "
+                    f"(autoscale off) or >= replicas ({spec.replicas})")
         ckpt = spec.checkpoint
         if ckpt and not (ckpt.startswith("pvc://")
                          or ckpt.startswith("gs://")):
@@ -157,7 +258,8 @@ class ModelServerController(Controller):
                     "batcher has no ahead-of-traffic shape set)")
         return None
 
-    def _desired_deployment(self, ms: ModelServer) -> Deployment:
+    def _desired_deployment(self, ms: ModelServer,
+                            replicas: int = 1) -> Deployment:
         name, ns = ms.metadata.name, ms.metadata.namespace
         spec = ms.spec
         volumes: list[Volume] = []
@@ -226,7 +328,7 @@ class ModelServerController(Controller):
         )
         dep = Deployment(
             spec=DeploymentSpec(
-                replicas=1,
+                replicas=replicas,
                 selector={MS_NAME_LABEL: name},
                 template=PodTemplateSpec(),
             )

@@ -1,0 +1,42 @@
+"""Serving-fleet layer: replica registry, prefix-affinity router,
+autoscale signals.
+
+The missing subsystem between `ModelServerController` (which turns a CR
+into pods) and `serving/server.py` (one well-instrumented replica): a
+thin HTTP front door that (1) tracks replica health through a
+registration + heartbeat handshake (`registry.py`), (2) routes
+generate traffic by consistent-hash prefix affinity so repeated
+prompts land on the replica already holding the radix-cache entry,
+with least-queue-depth fallback, retry/backoff and hedged requests
+(`router.py`), and (3) aggregates queue-depth + KV-pool-pressure into
+a desired-replica recommendation the ModelServer controller consumes
+(`autoscale.py`).
+
+Import discipline: `registry` and `autoscale` are pure Python (the
+control plane imports `autoscale` and must stay jax-free); `router`
+adds aiohttp + obs, still no jax — the router process boots in
+milliseconds while replicas compile.
+"""
+
+from kubeflow_tpu.fleet.registry import (
+    DEAD,
+    DEGRADED,
+    DRAINING,
+    READY,
+    Replica,
+    ReplicaRegistry,
+    rendezvous,
+)
+from kubeflow_tpu.fleet.autoscale import Recommendation, recommend_replicas
+
+__all__ = [
+    "DEAD",
+    "DEGRADED",
+    "DRAINING",
+    "READY",
+    "Recommendation",
+    "Replica",
+    "ReplicaRegistry",
+    "recommend_replicas",
+    "rendezvous",
+]

@@ -1,0 +1,114 @@
+"""Desired-replica recommendation from fleet signals.
+
+Pure math, pure Python: the control plane imports this (it must stay
+jax-free), the router exposes it at `/fleet/autoscale`, and tests pin
+it directly. Two signals, per the serving engine's actual bottlenecks:
+
+- queue depth: admitted work beyond the slot capacity waits in the
+  batcher's pending deque — sustained queue means the fleet is short
+  on decode slots, the one resource continuous batching multiplexes;
+- KV-pool pressure: a replica whose block pool is nearly exhausted
+  defers admissions even with free slots (paged-KV accounting), so
+  pool pressure scales the fleet BEFORE queue depth shows it.
+
+The recommendation is a pure function of a replica-stats snapshot —
+no internal state, no timers. Hysteresis lives in the math (scale down
+only when the shrunken fleet still has `scale_down_headroom` spare),
+smoothing across evaluations is the caller's job if it wants any.
+
+The ModelServer controller consumes the recommendation through the
+`kubeflow-tpu.dev/desired-replicas` annotation (see
+controlplane/controllers/modelserver.py): whatever agent runs this
+function — the router process, a cron, an operator — writes the
+number there, and the controller clamps it to the spec's
+[replicas, max_replicas] band and drains before removing.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Iterable
+
+from kubeflow_tpu.fleet.registry import DEGRADED, READY
+
+
+@dataclass(frozen=True)
+class Recommendation:
+    desired: int
+    reason: str
+    signals: dict
+
+
+def _get(rep: Any, name: str, default=0):
+    """Stats accessor over either `registry.Replica` objects or plain
+    dicts (the router's JSON snapshot round-trips through clients)."""
+    if isinstance(rep, dict):
+        return rep.get(name, default)
+    return getattr(rep, name, default)
+
+
+def recommend_replicas(replicas: Iterable[Any], *,
+                       min_replicas: int = 1, max_replicas: int = 8,
+                       kv_pressure_high: float = 0.9,
+                       scale_down_headroom: float = 0.7) -> Recommendation:
+    """Aggregate fleet stats into a desired replica count.
+
+    - demand = active slots + queued requests across live (ready or
+      degraded; draining/dead replicas are already on their way out)
+      replicas, in slot units;
+    - desired_by_load = ceil(demand / mean slots per replica): the
+      smallest fleet whose slot capacity covers current demand;
+    - KV pressure (max over live replicas of pool blocks used/total)
+      above `kv_pressure_high` forces at least one extra replica even
+      when slots are free — admission is about to start deferring;
+    - scale-down needs headroom: shrink only if demand fits within
+      `scale_down_headroom` of the SHRUNKEN fleet's capacity, so a
+      fleet bouncing around a boundary does not flap.
+    """
+    if min_replicas < 1 or max_replicas < min_replicas:
+        raise ValueError(
+            f"need 1 <= min_replicas <= max_replicas, got "
+            f"[{min_replicas}, {max_replicas}]")
+
+    def clamp(n: int) -> int:
+        return max(min_replicas, min(n, max_replicas))
+
+    live = [r for r in replicas
+            if _get(r, "state", READY) in (READY, DEGRADED)]
+    n = len(live)
+    if n == 0:
+        return Recommendation(
+            clamp(min_replicas), "no live replicas",
+            {"live": 0, "demand": 0, "kv_pressure": 0.0})
+
+    queued = sum(_get(r, "queue_depth") for r in live)
+    active = sum(_get(r, "active_slots") for r in live)
+    slots = sum(_get(r, "max_slots") for r in live)
+    slots_per = slots / n if slots else 1.0
+    demand = active + queued
+
+    kv_pressure = 0.0
+    for r in live:
+        total = _get(r, "kv_blocks_total")
+        if total > 0:
+            used = total - _get(r, "kv_blocks_free")
+            kv_pressure = max(kv_pressure, used / total)
+
+    desired = max(1, math.ceil(demand / slots_per))
+    reason = (f"demand {demand} over {slots_per:g} slots/replica "
+              f"needs {desired}")
+    if kv_pressure >= kv_pressure_high:
+        if n + 1 > desired:
+            desired = n + 1
+            reason = (f"kv pressure {kv_pressure:.2f} >= "
+                      f"{kv_pressure_high:g}: scale out")
+    if desired < n and demand > scale_down_headroom * desired * slots_per:
+        desired = n
+        reason = (f"hold at {n}: demand {demand} lacks "
+                  f"{scale_down_headroom:g} headroom on fewer replicas")
+    return Recommendation(clamp(desired), reason, {
+        "live": n, "demand": demand, "queued": queued, "active": active,
+        "slots_per_replica": round(slots_per, 2),
+        "kv_pressure": round(kv_pressure, 4),
+    })

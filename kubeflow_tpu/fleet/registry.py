@@ -1,0 +1,258 @@
+"""Replica registry: heartbeat-driven health states + rendezvous hash.
+
+One registry instance lives inside the router process and is fed by the
+serving replicas' registration handshake (`serving.server.
+enable_fleet_registration`): register once at startup, heartbeat every
+couple of seconds with routing/autoscale stats, deregister at shutdown.
+
+Health state machine (per replica):
+
+    register ──> ready ──(no heartbeat > degraded_after_s)──> degraded
+                   ^            │
+                   │            └──(no heartbeat > dead_after_s)──> dead
+                   └──(heartbeat)── degraded / dead        (recovery)
+    drain() / heartbeat{draining: true} ──> draining  (terminal until
+                                            deregister: admission
+                                            stopped, in-flight finishes)
+
+Router-observed failures are a second, faster signal than heartbeat
+staleness: `note_failure` (connection refused / 5xx) degrades a replica
+immediately and kills it after `dead_failures` consecutive errors —
+a crashed replica stops receiving traffic on the FIRST failed proxy,
+not a heartbeat window later.
+
+Routing targets come from `pick()`: rendezvous (highest-random-weight)
+hashing of the request's first KV-block of tokens over the ready set.
+Rendezvous rather than a hash ring because stability under replica
+add/remove is the whole point — removing a replica remaps ONLY the
+keys that lived on it, adding one steals only the keys it now wins
+(pinned by tests/test_fleet.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Iterable
+
+READY = "ready"
+DEGRADED = "degraded"
+DRAINING = "draining"
+DEAD = "dead"
+STATES = (READY, DEGRADED, DRAINING, DEAD)
+
+
+def rendezvous(key: bytes, ids: Iterable[str]) -> str | None:
+    """Highest-random-weight winner for `key` among `ids` (stable:
+    independent per-(key, id) scores, so membership changes move only
+    the keys whose winner joined/left)."""
+    best, best_score = None, b""
+    for rid in ids:
+        score = hashlib.sha256(rid.encode() + b"\x00" + key).digest()
+        if best is None or score > best_score:
+            best, best_score = rid, score
+    return best
+
+
+@dataclass
+class Replica:
+    """One serving replica as the router sees it."""
+
+    id: str
+    url: str
+    models: list[str] = field(default_factory=list)
+    state: str = READY
+    registered_at: float = 0.0
+    last_heartbeat: float = 0.0
+    # heartbeat-reported routing/autoscale signals
+    queue_depth: int = 0
+    active_slots: int = 0
+    max_slots: int = 0
+    kv_blocks_free: int = 0
+    kv_blocks_total: int = 0
+    # router-side accounting
+    inflight: int = 0            # proxied requests currently open
+    failures: int = 0            # consecutive router-observed failures
+
+    def load(self) -> int:
+        """Least-queue-depth ordering key: heartbeat-reported queue plus
+        the router's own open requests (fresher than any heartbeat)."""
+        return self.queue_depth + self.inflight
+
+    def snapshot(self) -> dict:
+        return {
+            "id": self.id, "url": self.url, "models": list(self.models),
+            "state": self.state, "queue_depth": self.queue_depth,
+            "active_slots": self.active_slots,
+            "max_slots": self.max_slots,
+            "kv_blocks_free": self.kv_blocks_free,
+            "kv_blocks_total": self.kv_blocks_total,
+            "inflight": self.inflight, "failures": self.failures,
+            "last_heartbeat_age_s": None,
+        }
+
+
+class ReplicaRegistry:
+    """Single-threaded (event-loop) replica table. `clock` is injectable
+    so tests drive the staleness transitions deterministically."""
+
+    def __init__(self, *, degraded_after_s: float = 6.0,
+                 dead_after_s: float = 20.0, dead_failures: int = 3,
+                 overload_depth: int = 64,
+                 clock: Callable[[], float] = time.monotonic):
+        if not degraded_after_s < dead_after_s:
+            raise ValueError(
+                f"degraded_after_s ({degraded_after_s}) must be < "
+                f"dead_after_s ({dead_after_s})")
+        self.degraded_after_s = degraded_after_s
+        self.dead_after_s = dead_after_s
+        self.dead_failures = dead_failures
+        # affinity target past this load routes by least-depth instead:
+        # a hot prefix must not pile the whole fleet's traffic onto one
+        # replica once the cache win is smaller than the queue loss
+        self.overload_depth = overload_depth
+        self.clock = clock
+        self._replicas: dict[str, Replica] = {}
+
+    # -- membership -------------------------------------------------------
+
+    def register(self, url: str, *, replica_id: str = "",
+                 models: Iterable[str] = (), **stats) -> Replica:
+        """Idempotent: re-registration (replica restart, router restart
+        losing state) refreshes the record and returns it ready."""
+        rid = replica_id or url
+        now = self.clock()
+        rep = self._replicas.get(rid)
+        if rep is None:
+            rep = Replica(id=rid, url=url, registered_at=now)
+            self._replicas[rid] = rep
+        rep.url = url
+        rep.models = sorted(models)
+        rep.state = READY
+        rep.failures = 0
+        rep.last_heartbeat = now
+        self._apply_stats(rep, stats)
+        return rep
+
+    def deregister(self, replica_id: str) -> bool:
+        return self._replicas.pop(replica_id, None) is not None
+
+    def get(self, replica_id: str) -> Replica | None:
+        return self._replicas.get(replica_id)
+
+    def replicas(self) -> list[Replica]:
+        return list(self._replicas.values())
+
+    # -- health signals ---------------------------------------------------
+
+    def heartbeat(self, replica_id: str, **stats) -> bool:
+        """Refresh liveness + stats. Returns False for an unknown id —
+        the replica should re-register (router restarted)."""
+        rep = self._replicas.get(replica_id)
+        if rep is None:
+            return False
+        rep.last_heartbeat = self.clock()
+        self._apply_stats(rep, stats)
+        if stats.get("draining"):
+            rep.state = DRAINING
+        elif rep.state in (DEGRADED, DEAD):
+            rep.state = READY      # recovery
+            rep.failures = 0
+        return True
+
+    @staticmethod
+    def _apply_stats(rep: Replica, stats: dict) -> None:
+        for k in ("queue_depth", "active_slots", "max_slots",
+                  "kv_blocks_free", "kv_blocks_total"):
+            v = stats.get(k)
+            if isinstance(v, int) and not isinstance(v, bool) and v >= 0:
+                setattr(rep, k, v)
+
+    def drain(self, replica_id: str) -> bool:
+        rep = self._replicas.get(replica_id)
+        if rep is None:
+            return False
+        rep.state = DRAINING
+        return True
+
+    def note_dispatch(self, replica_id: str) -> None:
+        rep = self._replicas.get(replica_id)
+        if rep is not None:
+            rep.inflight += 1
+
+    def note_done(self, replica_id: str) -> None:
+        rep = self._replicas.get(replica_id)
+        if rep is not None and rep.inflight > 0:
+            rep.inflight -= 1
+
+    def note_failure(self, replica_id: str) -> None:
+        """Router-observed proxy failure (connect error / 5xx): degrade
+        NOW, kill after `dead_failures` in a row — faster than waiting
+        out a heartbeat window when the process is already gone."""
+        rep = self._replicas.get(replica_id)
+        if rep is None:
+            return
+        rep.failures += 1
+        if rep.failures >= self.dead_failures:
+            rep.state = DEAD
+        elif rep.state == READY:
+            rep.state = DEGRADED
+
+    def note_success(self, replica_id: str) -> None:
+        rep = self._replicas.get(replica_id)
+        if rep is not None:
+            rep.failures = 0
+
+    def sweep(self) -> None:
+        """Apply heartbeat-staleness transitions. Call before routing
+        decisions and gauge renders; draining/dead states are sticky
+        (only a fresh heartbeat resurrects dead, nothing unsticks
+        draining but deregister)."""
+        now = self.clock()
+        for rep in self._replicas.values():
+            if rep.state in (DRAINING, DEAD):
+                continue
+            age = now - rep.last_heartbeat
+            if age > self.dead_after_s:
+                rep.state = DEAD
+            elif age > self.degraded_after_s:
+                rep.state = DEGRADED
+
+    def counts(self) -> dict[str, int]:
+        """State -> replica count, zero-filled (the `fleet_replicas`
+        gauge must carry all four series from the first render)."""
+        out = {s: 0 for s in STATES}
+        for rep in self._replicas.values():
+            out[rep.state] += 1
+        return out
+
+    # -- routing ----------------------------------------------------------
+
+    def routable(self, exclude: frozenset | set = frozenset()
+                 ) -> list[Replica]:
+        """Candidates in preference order: the ready set, else (every
+        ready replica excluded/absent) the degraded set — a degraded
+        replica may still answer, and retrying it beats a client 503."""
+        ready = [r for r in self._replicas.values()
+                 if r.state == READY and r.id not in exclude]
+        if ready:
+            return ready
+        return [r for r in self._replicas.values()
+                if r.state == DEGRADED and r.id not in exclude]
+
+    def pick(self, key: bytes, exclude: frozenset | set = frozenset()
+             ) -> tuple[Replica | None, str]:
+        """Route one request: rendezvous affinity target for `key` if it
+        is routable and not overloaded, else least-loaded fallback.
+        Returns (replica, "affinity" | "fallback") or (None, _)."""
+        self.sweep()
+        pool = self.routable(exclude)
+        if not pool:
+            return None, "fallback"
+        if key:
+            winner = rendezvous(key, [r.id for r in pool])
+            target = self._replicas[winner]
+            if target.load() < self.overload_depth:
+                return target, "affinity"
+        return min(pool, key=lambda r: (r.load(), r.id)), "fallback"

@@ -1,0 +1,578 @@
+"""Fleet router: the HTTP front door over N serving replicas.
+
+Routes `POST /v1/models/{name}:generate` by consistent-hash prefix
+affinity — the routing key is the request's first `kv_block_size`
+tokens (the first block is what the replicas' radix prefix cache
+indexes), so repeated prompts land on the replica that already holds
+the cached KV and prefill only computes the suffix. When the affinity
+target is unavailable (draining/dead) or overloaded, the request falls
+back to the least-loaded replica; proxy failures retry on the next
+candidate with exponential backoff; a request still unanswered after
+`hedge_after_s` is duplicated to a second replica and the first
+response wins (tail-latency insurance — the loser is cancelled).
+
+The router is deliberately jax-free: it boots in milliseconds, knows
+nothing about models beyond their names, and observes replicas purely
+through the registration/heartbeat handshake
+(`serving.server.enable_fleet_registration`) plus its own proxy
+outcomes. Decisions are observable: `fleet_route_total{reason}`,
+`fleet_hedge_wins_total`, `fleet_replicas{state}` (render-time
+collector), a route-latency histogram, and spans whose
+`replica_trace` attribute carries the replica's `X-Trace-Id` — one
+trace id per hop, joined in the router's span attrs.
+
+    from kubeflow_tpu.fleet.router import create_router_app
+    web.run_app(create_router_app(block_size=64), port=9000)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+
+import aiohttp
+from aiohttp import web
+
+from kubeflow_tpu import obs as obs_lib
+from kubeflow_tpu.fleet import autoscale
+from kubeflow_tpu.fleet.registry import ReplicaRegistry
+
+log = logging.getLogger(__name__)
+
+FLEET_KEY: web.AppKey = web.AppKey("fleet_state", object)
+
+ROUTE_REASONS = ("affinity", "fallback", "hedge", "retry")
+
+# Mirrors serving.server's byte tokenizer constants (BOS=1, bytes at
+# +3): the router must hash "text" bodies to the SAME first block the
+# replica will tokenize, without importing the jax-loaded server
+# module. Drift is pinned by tests/test_fleet.py.
+_BOS, _BYTE_OFFSET = 1, 3
+
+
+def affinity_key(body: dict, block_size: int) -> bytes:
+    """Routing key: the first `block_size`-aligned token block of the
+    prompt. Requests sharing it co-locate on one replica (where the
+    radix cache can serve it); malformed bodies key to b"" (no
+    affinity — the replica will 400 them, but through a live one)."""
+    toks = None
+    if isinstance(body, dict):
+        t = body.get("tokens")
+        if (isinstance(t, list) and t and isinstance(t[0], list)
+                and all(isinstance(x, int) and not isinstance(x, bool)
+                        for x in t[0])):
+            toks = t[0]
+        elif isinstance(body.get("text"), str):
+            toks = [_BOS] + [b + _BYTE_OFFSET
+                             for b in body["text"].encode("utf-8")]
+    if not toks:
+        return b""
+    return " ".join(str(x) for x in toks[:block_size]).encode()
+
+
+class FleetObs:
+    """Router observability bundle (the serving `ServingObs` pattern):
+    metric registry + tracer + the fleet_* instruments."""
+
+    def __init__(self, reg: ReplicaRegistry, registry=None, tracer=None):
+        from kubeflow_tpu.controlplane.metrics import (
+            Counter,
+            Gauge,
+            Registry,
+        )
+
+        self.registry = registry if registry is not None else Registry()
+        self.tracer = tracer if tracer is not None else obs_lib.Tracer()
+        self.route_total = Counter(
+            "fleet_route_total",
+            "Routing decisions by reason: affinity (rendezvous target), "
+            "fallback (least-loaded), retry (previous replica failed), "
+            "hedge (duplicate dispatch after the latency deadline)",
+            self.registry)
+        self.hedge_wins = Counter(
+            "fleet_hedge_wins_total",
+            "Hedged duplicates that answered before the primary",
+            self.registry)
+        self.route_latency = obs_lib.get_or_create_histogram(
+            self.registry, "fleet_route_duration_seconds",
+            "Routed request latency through the router, by model and "
+            "final routing reason")
+        replicas_g = Gauge(
+            "fleet_replicas",
+            "Registered replicas by health state "
+            "(ready/degraded/draining/dead)", self.registry)
+        # zero-seed so the series exist (at 0) before any traffic
+        for reason in ROUTE_REASONS:
+            self.route_total.inc(0, reason=reason)
+        self.hedge_wins.inc(0)
+
+        def collect():
+            reg.sweep()
+            for state, nn in reg.counts().items():
+                replicas_g.set(nn, state=state)
+
+        self.registry.register_collector(collect)
+
+
+class _FleetState:
+    def __init__(self, registry: ReplicaRegistry, obs: FleetObs, *,
+                 block_size: int, policy: str, hedge_after_s: float,
+                 retries: int, backoff_s: float, timeout_s: float):
+        self.registry = registry
+        self.obs = obs
+        self.block_size = block_size
+        self.policy = policy
+        self.hedge_after_s = hedge_after_s
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self.timeout_s = timeout_s
+        self.session: aiohttp.ClientSession | None = None
+        self.rr = 0  # round-robin cursor (policy="roundrobin" A/B arm)
+
+
+class _UpstreamError(RuntimeError):
+    """Replica-side failure (connect error, timeout, 5xx) — retryable
+    on another replica, unlike a 4xx which is the client's problem."""
+
+
+@web.middleware
+async def _router_obs_middleware(request: web.Request, handler):
+    st: _FleetState = request.app[FLEET_KEY]
+    resource = getattr(request.match_info.route, "resource", None)
+    route = getattr(resource, "canonical", None) or "unmatched"
+    with st.obs.tracer.span("fleet.request", method=request.method,
+                            route=route) as span:
+        try:
+            resp = await handler(request)
+            span.attrs["status"] = resp.status
+            if not resp.prepared:
+                resp.headers.setdefault("X-Trace-Id", span.trace_id)
+            return resp
+        except web.HTTPException as exc:
+            span.attrs["status"] = exc.status
+            exc.headers.setdefault("X-Trace-Id", span.trace_id)
+            raise
+
+
+def _choose(st: _FleetState, key: bytes, exclude: set):
+    """One routing decision under the configured policy. The
+    "roundrobin" policy exists for the affinity-vs-random A/B
+    (loadtest --fleet-policy roundrobin) and labels as fallback."""
+    if st.policy == "roundrobin":
+        pool = st.registry.routable(exclude)
+        if not pool:
+            st.registry.sweep()
+            pool = st.registry.routable(exclude)
+        if not pool:
+            return None, "fallback"
+        pool.sort(key=lambda r: r.id)
+        st.rr += 1
+        return pool[st.rr % len(pool)], "fallback"
+    return st.registry.pick(key, exclude)
+
+
+async def _call_replica(st: _FleetState, rep, name: str, raw: bytes,
+                        tried: set):
+    """One proxied generate against one replica. Success returns
+    (status, payload, replica, upstream_trace_id); replica-side
+    failures mark the replica, add it to `tried`, and raise
+    `_UpstreamError` so the caller moves on."""
+    st.registry.note_dispatch(rep.id)
+    try:
+        async with st.session.post(
+                f"{rep.url}/v1/models/{name}:generate", data=raw,
+                headers={"Content-Type": "application/json"},
+                timeout=aiohttp.ClientTimeout(total=st.timeout_s)) as r:
+            payload = await r.read()
+            if r.status >= 500:
+                raise _UpstreamError(
+                    f"replica {rep.id} answered {r.status}")
+            st.registry.note_success(rep.id)
+            return r.status, payload, rep, r.headers.get("X-Trace-Id", "")
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+            _UpstreamError) as e:
+        st.registry.note_failure(rep.id)
+        tried.add(rep.id)
+        raise _UpstreamError(str(e)) from e
+    finally:
+        st.registry.note_done(rep.id)
+
+
+async def _race_hedged(st: _FleetState, primary, name: str, raw: bytes,
+                       key: bytes, tried: set, model: str):
+    """Dispatch to `primary`; past the hedge deadline, duplicate to a
+    second replica and take whichever answers first. Returns
+    (status, payload, replica, hedge_won, upstream_trace) or None when
+    every dispatched replica failed (all are in `tried` by then)."""
+    tasks = {asyncio.create_task(_call_replica(st, primary, name, raw,
+                                               tried))}
+    hedged_id = None
+    if st.hedge_after_s > 0:
+        done, _pending = await asyncio.wait(tasks,
+                                            timeout=st.hedge_after_s)
+        if not done:
+            hedge_rep, _ = _choose(st, key, tried | {primary.id})
+            if hedge_rep is not None:
+                hedged_id = hedge_rep.id
+                st.obs.route_total.inc(reason="hedge")
+                tasks.add(asyncio.create_task(_call_replica(
+                    st, hedge_rep, name, raw, tried)))
+    winner = None
+    pending = tasks
+    while pending:
+        done, pending = await asyncio.wait(
+            pending, return_when=asyncio.FIRST_COMPLETED)
+        for t in done:
+            if not t.cancelled() and t.exception() is None:
+                winner = t
+                break
+        if winner is not None:
+            break
+    for t in pending:
+        t.cancel()
+    if pending:
+        await asyncio.gather(*pending, return_exceptions=True)
+    if winner is None:
+        return None
+    status, payload, rep, trace = winner.result()
+    hedge_won = hedged_id is not None and rep.id == hedged_id
+    if hedge_won:
+        st.obs.hedge_wins.inc()
+    return status, payload, rep, hedge_won, trace
+
+
+async def _routed_generate(request: web.Request):
+    st: _FleetState = request.app[FLEET_KEY]
+    name = request.match_info["name"]
+    raw = await request.read()
+    try:
+        body = json.loads(raw)
+    except Exception:
+        return web.json_response({"error": "invalid JSON"}, status=400)
+    if isinstance(body, dict) and body.get("stream"):
+        return await _routed_stream(request, st, name, raw, body)
+    key = affinity_key(body, st.block_size)
+    t0 = time.perf_counter()
+    tried: set[str] = set()
+    with st.obs.tracer.span("fleet.route", model=name) as span:
+        for attempt in range(st.retries + 1):
+            replica, reason = _choose(st, key, tried)
+            if replica is None:
+                break
+            if attempt:
+                reason = "retry"
+                await asyncio.sleep(
+                    min(st.backoff_s * (2 ** (attempt - 1)), 1.0))
+            result = await _race_hedged(st, replica, name, raw, key,
+                                        tried, name)
+            if result is None:
+                continue  # dispatched replicas failed; retry others
+            status, payload, rep, hedge_won, trace = result
+            dt = time.perf_counter() - t0
+            st.obs.route_total.inc(reason=reason)
+            st.obs.route_latency.observe(dt, model=name, reason=reason)
+            span.attrs.update(replica=rep.id, reason=reason,
+                              hedge_won=hedge_won, status=status)
+            if trace:
+                span.attrs["replica_trace"] = trace
+            headers = {"X-Fleet-Replica": rep.id,
+                       "X-Fleet-Route-Reason": reason}
+            if trace:
+                headers["X-Fleet-Replica-Trace"] = trace
+            return web.Response(body=payload, status=status,
+                                content_type="application/json",
+                                headers=headers)
+        span.attrs["status"] = 503
+    return web.json_response(
+        {"error": "no serving replica available"}, status=503,
+        headers={"Retry-After": "1"})
+
+
+async def _routed_stream(request: web.Request, st: _FleetState,
+                         name: str, raw: bytes, body: dict):
+    """SSE passthrough: affinity-routed, retried only BEFORE the first
+    upstream byte (once headers are out a failure is the client's to
+    see — same contract as the replicas' own mid-stream errors). No
+    hedging: duplicating a stream would decode the prompt twice for
+    one winner on every long request, the exact tail case hedging is
+    meant to be cheap insurance for."""
+    key = affinity_key(body, st.block_size)
+    tried: set[str] = set()
+    for attempt in range(st.retries + 1):
+        replica, reason = _choose(st, key, tried)
+        if replica is None:
+            break
+        if attempt:
+            reason = "retry"
+            await asyncio.sleep(
+                min(st.backoff_s * (2 ** (attempt - 1)), 1.0))
+        st.registry.note_dispatch(replica.id)
+        try:
+            async with st.session.post(
+                    f"{replica.url}/v1/models/{name}:generate", data=raw,
+                    headers={"Content-Type": "application/json"},
+                    timeout=aiohttp.ClientTimeout(
+                        total=st.timeout_s)) as up:
+                if up.status >= 500:
+                    st.registry.note_failure(replica.id)
+                    tried.add(replica.id)
+                    continue
+                st.obs.route_total.inc(reason=reason)
+                if up.content_type != "text/event-stream":
+                    # replica rejected pre-stream (4xx): passthrough
+                    payload = await up.read()
+                    return web.Response(
+                        body=payload, status=up.status,
+                        content_type="application/json",
+                        headers={"X-Fleet-Replica": replica.id})
+                headers = {
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                    "X-Fleet-Replica": replica.id,
+                }
+                up_trace = up.headers.get("X-Trace-Id", "")
+                if up_trace:
+                    headers["X-Fleet-Replica-Trace"] = up_trace
+                resp = web.StreamResponse(headers=headers)
+                await resp.prepare(request)
+                async for chunk in up.content.iter_any():
+                    await resp.write(chunk)
+                await resp.write_eof()
+                st.registry.note_success(replica.id)
+                return resp
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            st.registry.note_failure(replica.id)
+            tried.add(replica.id)
+        finally:
+            st.registry.note_done(replica.id)
+    return web.json_response(
+        {"error": "no serving replica available"}, status=503,
+        headers={"Retry-After": "1"})
+
+
+# -- fleet control-plane endpoints ---------------------------------------
+
+
+async def _register(request: web.Request):
+    st: _FleetState = request.app[FLEET_KEY]
+    try:
+        body = await request.json()
+    except Exception:
+        return web.json_response({"error": "invalid JSON"}, status=400)
+    url = body.get("url")
+    if not isinstance(url, str) or not url.startswith("http"):
+        return web.json_response(
+            {"error": "body needs an http 'url'"}, status=400)
+    models = body.get("models", [])
+    if not isinstance(models, list):
+        models = []
+    rep = st.registry.register(
+        url.rstrip("/"), replica_id=str(body.get("id", "")),
+        models=[m for m in models if isinstance(m, str)],
+        **{k: v for k, v in body.items()
+           if k in ("queue_depth", "active_slots", "max_slots",
+                    "kv_blocks_free", "kv_blocks_total")})
+    log.info("fleet: registered replica %s at %s", rep.id, rep.url)
+    return web.json_response({"id": rep.id, "state": rep.state})
+
+
+async def _heartbeat(request: web.Request):
+    st: _FleetState = request.app[FLEET_KEY]
+    try:
+        body = await request.json()
+    except Exception:
+        return web.json_response({"error": "invalid JSON"}, status=400)
+    rid = str(body.get("id", ""))
+    ok = st.registry.heartbeat(rid, **{
+        k: v for k, v in body.items()
+        if k in ("queue_depth", "active_slots", "max_slots",
+                 "kv_blocks_free", "kv_blocks_total", "draining")})
+    if not ok:
+        # unknown id: the router restarted and lost its table — 404
+        # tells the replica to re-register (server.py's beat loop does)
+        return web.json_response(
+            {"error": f"unknown replica {rid!r}"}, status=404)
+    return web.json_response({"ok": True})
+
+
+async def _deregister(request: web.Request):
+    st: _FleetState = request.app[FLEET_KEY]
+    try:
+        body = await request.json()
+    except Exception:
+        return web.json_response({"error": "invalid JSON"}, status=400)
+    rid = str(body.get("id", ""))
+    removed = st.registry.deregister(rid)
+    if removed:
+        log.info("fleet: deregistered replica %s", rid)
+    return web.json_response({"removed": removed})
+
+
+async def _drain(request: web.Request):
+    """Mark a replica draining in the table AND forward the drain to
+    the replica itself (stop admission, finish in-flight) — the
+    scale-down path the ModelServer controller models."""
+    st: _FleetState = request.app[FLEET_KEY]
+    try:
+        body = await request.json()
+    except Exception:
+        return web.json_response({"error": "invalid JSON"}, status=400)
+    rid = str(body.get("id", ""))
+    rep = st.registry.get(rid)
+    if rep is None:
+        return web.json_response(
+            {"error": f"unknown replica {rid!r}"}, status=404)
+    st.registry.drain(rid)
+    forwarded: dict = {}
+    try:
+        async with st.session.post(
+                f"{rep.url}/drain",
+                timeout=aiohttp.ClientTimeout(total=5)) as r:
+            if r.content_type == "application/json":
+                forwarded = await r.json()
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+        pass  # marking it draining here already stops routing
+    return web.json_response({"id": rid, "state": "draining",
+                              "replica": forwarded})
+
+
+async def _replicas(request: web.Request):
+    st: _FleetState = request.app[FLEET_KEY]
+    st.registry.sweep()
+    now = st.registry.clock()
+    out = []
+    for rep in st.registry.replicas():
+        snap = rep.snapshot()
+        snap["last_heartbeat_age_s"] = round(now - rep.last_heartbeat, 3)
+        out.append(snap)
+    return web.json_response({"replicas": out,
+                              "counts": st.registry.counts()})
+
+
+async def _autoscale(request: web.Request):
+    st: _FleetState = request.app[FLEET_KEY]
+    st.registry.sweep()
+    q = request.rel_url.query
+    try:
+        lo = int(q.get("min", 1))
+        hi = int(q.get("max", 8))
+        rec = autoscale.recommend_replicas(
+            st.registry.replicas(), min_replicas=lo, max_replicas=hi)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    return web.json_response({"desired": rec.desired,
+                              "reason": rec.reason,
+                              "signals": rec.signals})
+
+
+async def _stats(request: web.Request):
+    """Machine-readable routing counters (the loadtest's evidence feed
+    — same numbers as /metrics, without a Prometheus parse)."""
+    st: _FleetState = request.app[FLEET_KEY]
+    return web.json_response({
+        "route_total": {reason: st.obs.route_total.value(reason=reason)
+                        for reason in ROUTE_REASONS},
+        "hedge_wins": st.obs.hedge_wins.value(),
+    })
+
+
+async def _healthz(request: web.Request):
+    st: _FleetState = request.app[FLEET_KEY]
+    st.registry.sweep()
+    counts = st.registry.counts()
+    return web.json_response({
+        "status": "ok",
+        "routable": counts["ready"] + counts["degraded"],
+        "replicas": counts,
+    })
+
+
+async def _proxied_models(request: web.Request):
+    """GET /v1/models via the least-loaded routable replica — clients
+    written against a single server work unchanged through the door."""
+    st: _FleetState = request.app[FLEET_KEY]
+    st.registry.sweep()
+    tried: set[str] = set()
+    for _ in range(st.retries + 1):
+        pool = st.registry.routable(tried)
+        if not pool:
+            break
+        rep = min(pool, key=lambda r: (r.load(), r.id))
+        try:
+            async with st.session.get(
+                    f"{rep.url}/v1/models",
+                    timeout=aiohttp.ClientTimeout(total=10)) as r:
+                payload = await r.read()
+                if r.status >= 500:
+                    raise _UpstreamError(str(r.status))
+                return web.Response(
+                    body=payload, status=r.status,
+                    content_type="application/json",
+                    headers={"X-Fleet-Replica": rep.id})
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                _UpstreamError):
+            st.registry.note_failure(rep.id)
+            tried.add(rep.id)
+    return web.json_response(
+        {"error": "no serving replica available"}, status=503)
+
+
+def create_router_app(registry: ReplicaRegistry | None = None, *,
+                      block_size: int = 64, policy: str = "affinity",
+                      hedge_after_s: float = 2.0, retries: int = 3,
+                      backoff_s: float = 0.05,
+                      request_timeout_s: float = 300.0,
+                      metrics_registry=None, tracer=None,
+                      ) -> web.Application:
+    """Build the router app. `block_size` must match the replicas'
+    `kv_block_size` (the affinity key is the first block — a mismatch
+    only costs cache hits, never correctness). `policy` is "affinity"
+    or "roundrobin" (the A/B control arm). `hedge_after_s <= 0`
+    disables hedging. `metrics_registry`/`tracer` share external obs
+    instances; by default the app owns fresh ones at `/metrics` and
+    `/debug/traces`."""
+    if policy not in ("affinity", "roundrobin"):
+        raise ValueError(f"unknown policy {policy!r}")
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    reg = registry if registry is not None else ReplicaRegistry()
+    obs = FleetObs(reg, registry=metrics_registry, tracer=tracer)
+    st = _FleetState(reg, obs, block_size=block_size, policy=policy,
+                     hedge_after_s=hedge_after_s, retries=retries,
+                     backoff_s=backoff_s, timeout_s=request_timeout_s)
+    app = web.Application(middlewares=[_router_obs_middleware])
+    app[FLEET_KEY] = st
+
+    async def _start(app_):
+        st.session = aiohttp.ClientSession()
+
+    async def _stop(app_):
+        if st.session is not None:
+            await st.session.close()
+
+    app.on_startup.append(_start)
+    app.on_cleanup.append(_stop)
+
+    async def render_metrics(_request):
+        return web.Response(text=obs.registry.render(),
+                            content_type="text/plain")
+
+    async def debug_traces(request):
+        return web.json_response(obs_lib.traces_response_payload(
+            obs.tracer, request.rel_url.query))
+
+    app.router.add_get("/healthz", _healthz)
+    app.router.add_get("/metrics", render_metrics)
+    app.router.add_get("/debug/traces", debug_traces)
+    app.router.add_post("/fleet/register", _register)
+    app.router.add_post("/fleet/heartbeat", _heartbeat)
+    app.router.add_post("/fleet/deregister", _deregister)
+    app.router.add_post("/fleet/drain", _drain)
+    app.router.add_get("/fleet/replicas", _replicas)
+    app.router.add_get("/fleet/autoscale", _autoscale)
+    app.router.add_get("/fleet/stats", _stats)
+    app.router.add_get("/v1/models", _proxied_models)
+    app.router.add_post("/v1/models/{name}:generate", _routed_generate)
+    return app

@@ -106,6 +106,15 @@ def main(argv=None) -> int:
     p.add_argument("--cpu", action="store_true",
                    help="pin the CPU backend (hermetic smoke; pins "
                         "jax.config BEFORE backend init)")
+    p.add_argument("--drain-grace-s", type=float, default=30.0,
+                   help="shutdown waits this long for in-flight "
+                        "generations before closing")
+    p.add_argument("--fleet-router", default="",
+                   help="fleet router base URL; the replica registers "
+                        "and heartbeats there (kubeflow_tpu.fleet)")
+    p.add_argument("--advertise", default="",
+                   help="URL the fleet router should reach this "
+                        "replica at (default http://HOST:PORT)")
     args = p.parse_args(argv)
     if not args.checkpoint and not args.random:
         p.error("pass --checkpoint DIR or --random")
@@ -114,6 +123,8 @@ def main(argv=None) -> int:
         # batcher; silently ignoring the flag would break the "Ready
         # means compiled" promise
         p.error("--warmup requires --continuous")
+    if args.advertise and not args.fleet_router:
+        p.error("--advertise requires --fleet-router")
 
     import jax
 
@@ -123,7 +134,10 @@ def main(argv=None) -> int:
     from aiohttp import web
 
     from kubeflow_tpu.serving.engine import EngineConfig, InferenceEngine
-    from kubeflow_tpu.serving.server import create_serving_app
+    from kubeflow_tpu.serving.server import (
+        create_serving_app,
+        enable_fleet_registration,
+    )
 
     cfg, init_fn, family = model_registry()[args.model]
     params = _load_params(args, lambda k: init_fn(k, cfg))
@@ -165,7 +179,12 @@ def main(argv=None) -> int:
         warmup=args.warmup,
         prefill_chunk=args.prefill_chunk or None,
         pipeline_depth=args.pipeline_depth or None,
+        drain_grace_s=args.drain_grace_s,
     )
+    if args.fleet_router:
+        enable_fleet_registration(
+            app, args.fleet_router,
+            args.advertise or f"http://{args.host}:{args.port}")
     print(f"serving {args.name or args.model} "
           f"({'random' if args.random else args.checkpoint}) on "
           f"{args.host}:{args.port} backend={jax.default_backend()} "

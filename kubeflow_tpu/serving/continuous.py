@@ -49,6 +49,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import os
+import time
 from typing import Any
 
 import jax
@@ -768,6 +769,15 @@ class ContinuousBatcher:
             int.from_bytes(os.urandom(8), "little") >> 1)
         self._worker: asyncio.Task | None = None
         self._closed = False
+        self._draining = False
+        # Admitted-but-unfinished request count. NOT derivable from
+        # _pending/_active: the worker holds requests in local buffers
+        # between popleft and slot assignment (prefill pipelining), so
+        # drain() polling those containers would declare victory with a
+        # request mid-prefill. Every record's fut resolves terminally
+        # on every path (emit, error, cancel, close), so a done
+        # callback is the one watertight decrement point.
+        self._admitted = 0
 
     def occupancy(self) -> float:
         return self.tokens_emitted / self.calls if self.calls else 0.0
@@ -857,6 +867,8 @@ class ContinuousBatcher:
     def _enqueue(self, tokens, max_new, sampling, *, queue):
         if self._closed:
             raise RuntimeError("batcher is shut down")
+        if self._draining:
+            raise RuntimeError("batcher is draining")
         if len(self._pending) >= self.max_pending:
             raise Overloaded(
                 f"{len(self._pending)} requests already queued "
@@ -897,10 +909,15 @@ class ContinuousBatcher:
             self._worker = asyncio.get_event_loop().create_task(
                 self._run())
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._admitted += 1
+        fut.add_done_callback(lambda _f: self._req_done())
         self._pending.append(
             (tokens, max_new, sampling, fut, queue, aid, prefix))
         self._wake.set()
         return fut
+
+    def _req_done(self) -> None:
+        self._admitted -= 1
 
     # -- worker -----------------------------------------------------------
 
@@ -1452,6 +1469,32 @@ class ContinuousBatcher:
                 continue
             # let submissions/cancellations interleave between steps
             await asyncio.sleep(0)
+
+    def in_flight(self) -> int:
+        """Admitted-but-unfinished requests (pending, mid-prefill in
+        the worker's local pipeline, or active in a slot). Zero means
+        `close()` has nothing to abandon."""
+        return self._admitted
+
+    def begin_drain(self) -> None:
+        """Stop admission (new `_enqueue` calls raise) while in-flight
+        requests keep decoding to completion. Sticky until close()."""
+        self._draining = True
+
+    async def drain(self, timeout: float | None = None) -> bool:
+        """Stop admission and wait for in-flight work to finish.
+        Returns True when everything completed, False on timeout (or a
+        dead worker with work still admitted) — the caller decides
+        whether to close() anyway. Safe to call multiple times."""
+        self._draining = True
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._admitted > 0:
+            if self._worker is None or self._worker.done():
+                return False  # nobody left to finish the work
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            await asyncio.sleep(0.01)
+        return True
 
     async def close(self) -> None:
         self._closed = True

@@ -69,6 +69,8 @@ TOKENIZER_KEY: web.AppKey = web.AppKey("tokenizer", object)
 BATCHERS_KEY: web.AppKey = web.AppKey("batchers", dict)
 SPEC_KEY: web.AppKey = web.AppKey("speculative", dict)
 OBS_KEY: web.AppKey = web.AppKey("obs", object)
+DRAIN_KEY: web.AppKey = web.AppKey("drain_state", dict)
+FLEET_REG_KEY: web.AppKey = web.AppKey("fleet_registration", dict)
 
 
 class ServingObs:
@@ -199,11 +201,36 @@ class Batcher:
         self._worker: asyncio.Task | None = None
         self._inflight: list = []  # dequeued but unresolved (see close)
         self._closed = False
+        self._draining = False
+
+    def in_flight(self) -> int:
+        """Admitted-but-unfinished work (queued + dequeued-unresolved)."""
+        return self._queue.qsize() + len(self._inflight)
+
+    def begin_drain(self) -> None:
+        """Stop admission; queued work still runs. Sticky until close()."""
+        self._draining = True
+
+    async def drain(self, timeout: float | None = None) -> bool:
+        """Stop admission and wait for admitted work to resolve. Same
+        contract as ContinuousBatcher.drain (False on timeout / dead
+        worker with work left)."""
+        self._draining = True
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.in_flight():
+            if self._worker is None or self._worker.done():
+                return False
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            await asyncio.sleep(0.01)
+        return True
 
     async def submit(self, tokens: list[int], max_new: int,
                      sampling: tuple) -> list[int]:
         if self._closed:
             raise RuntimeError("batcher is shut down")
+        if self._draining:
+            raise RuntimeError("batcher is draining")
         if self._worker is None or self._worker.done():
             self._worker = asyncio.get_event_loop().create_task(
                 self._run())
@@ -339,6 +366,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                        kv_pool_blocks: int | None = None,
                        drafts: dict[str, InferenceEngine] | None = None,
                        registry=None, tracer=None,
+                       drain_grace_s: float = 30.0,
                        ) -> web.Application:
     """`tokenizer` (data.bpe.Tokenizer or anything with encode/decode)
     serves the "text" request mode; without one, the zero-training
@@ -360,8 +388,11 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     to cap KV HBM, admission then accounts by blocks free and defers
     requests the pool can't cover). `registry`/`tracer`
     share an external metric registry / span tracer; by default the app
-    owns fresh ones, exposed at `/metrics` and `/debug/traces`."""
+    owns fresh ones, exposed at `/metrics` and `/debug/traces`.
+    `drain_grace_s` bounds how long shutdown (and POST /drain via
+    cleanup) waits for in-flight generations before closing."""
     app = web.Application(middlewares=[_obs_middleware])
+    app[DRAIN_KEY] = {"draining": False, "grace_s": float(drain_grace_s)}
     sobs = ServingObs(registry=registry, tracer=tracer)
     app[OBS_KEY] = sobs
     app[ENGINES_KEY] = engines
@@ -457,6 +488,20 @@ def create_serving_app(engines: dict[str, InferenceEngine],
         sobs.registry.register_collector(collect_kv_blocks)
 
     async def _close_batchers(app_):
+        # ISSUE 3 bugfix: shutdown used to close() straight away, which
+        # failed every in-flight generation with "server shutting down".
+        # Drain first — stop admission, let admitted work decode to
+        # completion within the grace window — THEN close (which only
+        # has stragglers to fail, usually none).
+        app_[DRAIN_KEY]["draining"] = True
+        grace = app_[DRAIN_KEY]["grace_s"]
+        for b in app_[BATCHERS_KEY].values():
+            b.begin_drain()
+        for b in app_[BATCHERS_KEY].values():
+            if not await b.drain(timeout=grace):
+                logging.getLogger(__name__).warning(
+                    "shutdown drain timed out with %d request(s) "
+                    "in flight; closing anyway", b.in_flight())
         for b in app_[BATCHERS_KEY].values():
             await b.close()
 
@@ -470,10 +515,11 @@ def create_serving_app(engines: dict[str, InferenceEngine],
         return web.json_response(obs_lib.traces_response_payload(
             sobs.tracer, request.rel_url.query))
 
-    app.router.add_get("/healthz", _ok)
+    app.router.add_get("/healthz", healthz)
     app.router.add_get("/readyz", _ok)
     app.router.add_get("/metrics", render_metrics)
     app.router.add_get("/debug/traces", debug_traces)
+    app.router.add_post("/drain", drain_endpoint)
     app.router.add_get("/v1/models", list_models)
     app.router.add_post("/v1/models/{name}:generate", generate)
     app.router.add_post("/v1/models/{name}:score", score)
@@ -482,6 +528,81 @@ def create_serving_app(engines: dict[str, InferenceEngine],
 
 async def _ok(request: web.Request):
     return web.json_response({"status": "ok"})
+
+
+def _in_flight(app: web.Application) -> int:
+    return sum(b.in_flight() for b in app[BATCHERS_KEY].values())
+
+
+def fleet_stats(app: web.Application) -> dict:
+    """Routing/autoscale stats in the fleet heartbeat's vocabulary
+    (summed over models — the fleet registry tracks replicas, not
+    model shards). max_slots for the window batcher is its max_batch
+    (the analog: requests co-scheduled per device call)."""
+    queue_depth = active = max_slots = 0
+    kv_free = kv_total = 0
+    for b in app[BATCHERS_KEY].values():
+        if isinstance(b, ContinuousBatcher):
+            queue_depth += len(b._pending)
+            active += len(b._active)
+            max_slots += len(b._free) + len(b._active)
+            kv_free += b.cengine.pool.num_free
+            kv_total += b.cengine.num_blocks
+        else:
+            queue_depth += b._queue.qsize()
+            active += len(b._inflight)
+            max_slots += b.max_batch
+    return {
+        "queue_depth": queue_depth, "active_slots": active,
+        "max_slots": max_slots, "kv_blocks_free": kv_free,
+        "kv_blocks_total": kv_total,
+        "draining": app[DRAIN_KEY]["draining"],
+    }
+
+
+async def healthz(request: web.Request):
+    """Readiness with substance (the fleet router's health probe, and
+    a gateway's): 200 only when the server admits work — not draining,
+    engines loaded, admission queue below its shed depth. /readyz
+    stays the bare liveness 200."""
+    app = request.app
+    if app[DRAIN_KEY]["draining"]:
+        return web.json_response(
+            {"status": "draining", "in_flight": _in_flight(app)},
+            status=503)
+    models = {}
+    overloaded = False
+    for name, b in app[BATCHERS_KEY].items():
+        if isinstance(b, ContinuousBatcher):
+            pending = len(b._pending)
+            models[name] = {
+                "pending": pending,
+                "active_slots": len(b._active),
+                "kv_blocks_free": b.cengine.pool.num_free,
+                "kv_blocks_total": b.cengine.num_blocks,
+            }
+            overloaded = overloaded or pending >= b.max_pending
+        else:
+            models[name] = {"pending": b._queue.qsize(),
+                            "active_slots": len(b._inflight)}
+    if overloaded:
+        return web.json_response(
+            {"status": "overloaded", "models": models}, status=503)
+    return web.json_response({"status": "ok", "models": models})
+
+
+async def drain_endpoint(request: web.Request):
+    """Stop admission NOW, report what is still in flight. In-flight
+    generations keep decoding to completion; new generate/score
+    requests get 503 (the fleet router stops sending them anyway once
+    the heartbeat reports draining). Standalone-usable: an operator
+    can drain a single server ahead of a restart with one POST."""
+    app = request.app
+    app[DRAIN_KEY]["draining"] = True
+    for b in app[BATCHERS_KEY].values():
+        b.begin_drain()
+    return web.json_response(
+        {"draining": True, "in_flight": _in_flight(app)})
 
 
 async def list_models(request: web.Request):
@@ -727,6 +848,10 @@ async def score(request: web.Request):
     sequence — the perplexity/eval door (lm-eval style). Body:
     {"tokens": [[...]]} or {"text": "..."}; response: per-position
     logprobs (s-1 per row), each row's total, and token count."""
+    if request.app[DRAIN_KEY]["draining"]:
+        return web.json_response(
+            {"error": "server is draining"}, status=503,
+            headers={"Retry-After": "5"})
     name = request.match_info["name"]
     engine = request.app[ENGINES_KEY].get(name)
     if engine is None:
@@ -775,6 +900,13 @@ async def score(request: web.Request):
 
 
 async def generate(request: web.Request):
+    if request.app[DRAIN_KEY]["draining"]:
+        # admission stops at the door; in-flight work keeps decoding.
+        # 503 (not 429): the SERVER is going away — a client or the
+        # fleet router should try another replica, not wait this one out
+        return web.json_response(
+            {"error": "server is draining"}, status=503,
+            headers={"Retry-After": "5"})
     name = request.match_info["name"]
     engine = request.app[ENGINES_KEY].get(name)
     if engine is None:
@@ -1135,3 +1267,84 @@ def _apply_stop(row: list[int], stop: list[list[int]]) -> list[int]:
                 cut = i if cut is None else min(cut, i)
                 break
     return row if cut is None else row[:cut]
+
+
+def enable_fleet_registration(app: web.Application, router_url: str,
+                              advertise_url: str, *,
+                              replica_id: str | None = None,
+                              period_s: float = 2.0) -> None:
+    """Wire this replica into a fleet router (kubeflow_tpu.fleet):
+    register on startup, heartbeat `fleet_stats` every `period_s`
+    (re-registering when the router answers 404 — it restarted and
+    lost its table), deregister on cleanup. Router unavailability is
+    never fatal: the replica serves standalone and keeps retrying —
+    the router and replicas boot in either order."""
+    import aiohttp
+
+    router = router_url.rstrip("/")
+    state: dict[str, Any] = {
+        "router": router, "advertise": advertise_url,
+        "id": replica_id or advertise_url, "period_s": period_s,
+        "session": None, "task": None,
+    }
+    app[FLEET_REG_KEY] = state
+    log = logging.getLogger(__name__)
+
+    def _payload(app_) -> dict:
+        return {"id": state["id"], "url": state["advertise"],
+                "models": sorted(app_[ENGINES_KEY]),
+                **fleet_stats(app_)}
+
+    async def _register(app_) -> bool:
+        try:
+            async with state["session"].post(
+                    f"{router}/fleet/register", json=_payload(app_),
+                    timeout=aiohttp.ClientTimeout(total=5)) as r:
+                return r.status == 200
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            return False
+
+    async def _beat_loop(app_):
+        while True:
+            await asyncio.sleep(state["period_s"])
+            try:
+                async with state["session"].post(
+                        f"{router}/fleet/heartbeat",
+                        json=_payload(app_),
+                        timeout=aiohttp.ClientTimeout(total=5)) as r:
+                    if r.status == 404:
+                        await _register(app_)
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                pass  # router down/restarting: keep beating
+
+    async def _start(app_):
+        state["session"] = aiohttp.ClientSession()
+        if not await _register(app_):
+            log.warning("fleet: could not register with router %s "
+                        "(will keep retrying via heartbeat)", router)
+        state["task"] = asyncio.get_event_loop().create_task(
+            _beat_loop(app_))
+
+    async def _stop(app_):
+        task = state["task"]
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        if state["session"] is not None:
+            try:
+                async with state["session"].post(
+                        f"{router}/fleet/deregister",
+                        json={"id": state["id"]},
+                        timeout=aiohttp.ClientTimeout(total=5)):
+                    pass
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                pass
+            await state["session"].close()
+
+    app.on_startup.append(_start)
+    # deregister BEFORE the drain-and-close hook: the router must stop
+    # routing here while the drain window is still finishing in-flight
+    app.on_cleanup.insert(0, _stop)

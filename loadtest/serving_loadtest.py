@@ -16,6 +16,14 @@ two JSON lines are directly comparable; its coalescing evidence is
 occupancy (mean occupied slots per decode step) instead of mean
 effective batch.
 
+`--mode fleet` stands N continuous replicas behind the fleet router
+(kubeflow_tpu.fleet) and drives the ROUTER with the same clients and
+requests — the JSON line adds the affinity hit rate (replica
+prefix-cache deltas) and routing-reason counts, so affinity vs
+`--fleet-policy roundrobin` is a direct prefix-hit A/B, and
+`--fleet-kill-one` proves retry/fallback completes every request when
+a replica dies mid-run.
+
 Hermetic by default (tiny model, CPU): the number is a CONTROL-PLANE
 number (batching, HTTP, queueing) — model throughput on hardware is
 bench.py's job.
@@ -27,6 +35,7 @@ import argparse
 import concurrent.futures
 import json
 import os
+import random
 import socket
 import statistics
 import subprocess
@@ -61,6 +70,256 @@ app = srv.create_serving_app({{"tiny": eng}}, batch_window_ms={window_ms},
                              pipeline_depth={pipeline_depth})
 web.run_app(app, host="127.0.0.1", port={port}, print=None)
 '''
+
+
+ROUTER_CODE = r'''
+import sys
+sys.path.insert(0, {repo!r})
+from aiohttp import web
+from kubeflow_tpu.fleet.router import create_router_app
+app = create_router_app(block_size={block_size}, policy={policy!r},
+                        hedge_after_s={hedge_after_s})
+web.run_app(app, host="127.0.0.1", port={port}, print=None)
+'''
+
+# One fleet replica: continuous batching + warmup, kv_block_size sized
+# for the loadtest's short prompts (the radix cache only caches FULL
+# blocks — the default 64 would cache nothing of a 24-token prompt),
+# registered with the router and heartbeating fast enough that a short
+# timed window sees fresh queue stats.
+FLEET_REPLICA_CODE = r'''
+import os, sys
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+sys.path.insert(0, {repo!r})
+import jax; jax.config.update("jax_platforms", "cpu")
+from aiohttp import web
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.serving.engine import InferenceEngine, LLAMA_FAMILY, EngineConfig
+from kubeflow_tpu.serving import server as srv
+cfg = llama.LLAMA_TINY
+params = llama.init(jax.random.key(0), cfg)
+eng = InferenceEngine(params, cfg, LLAMA_FAMILY, EngineConfig(max_len=128))
+app = srv.create_serving_app({{"tiny": eng}}, continuous=True, warmup=True,
+                             kv_block_size={block_size})
+srv.enable_fleet_registration(app, {router!r},
+                              "http://127.0.0.1:{port}",
+                              replica_id="replica-{idx}", period_s=0.5)
+web.run_app(app, host="127.0.0.1", port={port}, print=None)
+'''
+
+
+def _get_json(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def run_fleet(clients: int, requests: int, max_new: int, *,
+              replicas: int = 2, policy: str = "affinity",
+              block_size: int = 8, kill_one: bool = False,
+              hedge_after_s: float = 10.0) -> dict:
+    """N replicas behind the fleet router; clients hit the ROUTER.
+    Reports the single-server JSON schema plus the fleet evidence:
+    affinity hit rate (replica prefix-cache deltas over the timed
+    window), routing-reason counts, and — with --fleet-kill-one — that
+    killing a replica mid-run loses zero requests."""
+    import tempfile
+
+    router_port = free_port()
+    rep_ports = [free_port() for _ in range(replicas)]
+    router_base = f"http://127.0.0.1:{router_port}"
+    log = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".log", prefix="kftpu-fleetload-", delete=False)
+    procs: list[subprocess.Popen] = []
+    try:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c",
+             ROUTER_CODE.format(repo=REPO, port=router_port,
+                                block_size=block_size, policy=policy,
+                                hedge_after_s=hedge_after_s)],
+            stdout=log, stderr=subprocess.STDOUT))
+        for idx, port in enumerate(rep_ports):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 FLEET_REPLICA_CODE.format(
+                     repo=REPO, port=port, idx=idx,
+                     router=router_base, block_size=block_size)],
+                stdout=log, stderr=subprocess.STDOUT))
+
+        deadline = time.monotonic() + 180
+        ready = False
+        while time.monotonic() < deadline:
+            if any(p.poll() is not None for p in procs):
+                break
+            try:
+                counts = _get_json(
+                    f"{router_base}/fleet/replicas")["counts"]
+                if counts["ready"] >= replicas:
+                    ready = True
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        if not ready:
+            log.flush()
+            with open(log.name) as f:
+                tail = "\n".join(f.read().splitlines()[-30:])
+            rcs = [p.poll() for p in procs]
+            raise RuntimeError(
+                f"fleet never became ready (rcs={rcs}):\n{tail}")
+
+        def post(base: str, body: dict, timeout: float = 120.0) -> dict:
+            req = urllib.request.Request(
+                f"{base}/v1/models/tiny:generate",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return json.loads(r.read())
+
+        # Warm each replica DIRECTLY (compiles admission-group shapes
+        # beyond warmup's buckets) with a prompt FULLY disjoint from
+        # the measured set — the radix cache matches partial blocks
+        # (copy-on-write seeds), so even one shared leading token
+        # counts as a request-level "hit"; warming through the router,
+        # or any shared token 0, would saturate the A/B's metric.
+        prompt_len = 3 * block_size
+        warm_prompt = [255, 99] + [5 + t % 200
+                                   for t in range(prompt_len - 2)]
+
+        def warm(i: int) -> None:
+            base = f"http://127.0.0.1:{rep_ports[i % replicas]}"
+            post(base, {"tokens": [warm_prompt], "max_new": max_new})
+
+        with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+            for _ in range(3):
+                list(ex.map(warm, range(max(clients, replicas))))
+
+        # K distinct prompts, each repeated ~requests/K times: the
+        # workload where prefix affinity pays. Prompts differ from
+        # token 0 (and from the warm prompt), so a repeat is the ONLY
+        # source of cache reuse — the first touch of each prompt on
+        # each replica is an honest miss.
+        k = max(1, requests // 4)
+        prompts = [[3 + j % 250, 100] + [7 + (j + t) % 200
+                                         for t in range(prompt_len - 2)]
+                   for j in range(k)]
+        # Shuffled (seeded) prompt order, exact repeat counts: a plain
+        # `i % k` cycle aliases with round-robin's `i % replicas`
+        # whenever k divides evenly — every repeat of a prompt would
+        # land on the same replica BY COINCIDENCE and the control arm
+        # would measure affinity it does not have.
+        prompt_order = [i % k for i in range(requests)]
+        random.Random(0).shuffle(prompt_order)
+
+        def prefix_stats(port: int) -> tuple[int, int, int, int]:
+            m = _get_json(
+                f"http://127.0.0.1:{port}/v1/models")["models"][0]
+            pc = m.get("prefix_cache", {})
+            return (pc.get("hits", 0), pc.get("misses", 0),
+                    pc.get("tokens_reused", 0),
+                    pc.get("tokens_prefilled", 0))
+
+        stats0 = {p: prefix_stats(p) for p in rep_ports}
+        route0 = _get_json(f"{router_base}/fleet/stats")
+
+        failures = 0
+        latencies: list[float] = []
+        lock = __import__("threading").Lock()
+
+        def one(i: int) -> float:
+            t0 = time.perf_counter()
+            try:
+                out = post(router_base,
+                           {"tokens": [prompts[prompt_order[i]]],
+                            "max_new": max_new})
+                assert len(out["tokens"][0]) == max_new, out
+            except Exception:
+                nonlocal failures
+                with lock:
+                    failures += 1
+                raise
+            return time.perf_counter() - t0
+
+        killed = None
+        t0 = time.perf_counter()
+        if kill_one:
+            half = requests // 2
+            with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+                latencies = list(ex.map(one, range(half)))
+            # snapshot the victim's cache stats BEFORE it dies, then
+            # SIGKILL it mid-run (terminate() would run the graceful
+            # path — deregister + drain — and the router would never
+            # see a failure): the router must absorb the crash via
+            # note_failure + retry/fallback with zero client errors
+            killed = replicas - 1
+            stats_prekill = prefix_stats(rep_ports[killed])
+            procs[1 + killed].kill()
+            procs[1 + killed].wait()
+            with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+                latencies += list(ex.map(one, range(half, requests)))
+        else:
+            with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+                latencies = list(ex.map(one, range(requests)))
+        wall = time.perf_counter() - t0
+
+        hits = misses = reused = prefilled = 0
+        for pi, port in enumerate(rep_ports):
+            if killed is not None and pi == killed:
+                s1 = stats_prekill
+            else:
+                s1 = prefix_stats(port)
+            hits += s1[0] - stats0[port][0]
+            misses += s1[1] - stats0[port][1]
+            reused += s1[2] - stats0[port][2]
+            prefilled += s1[3] - stats0[port][3]
+        route1 = _get_json(f"{router_base}/fleet/stats")
+        reasons = {r: int(route1["route_total"][r]
+                          - route0["route_total"][r])
+                   for r in route1["route_total"]}
+
+        latencies.sort()
+        q = statistics.quantiles(latencies, n=20)
+        return {
+            "metric": "serving_rest_throughput",
+            "mode": "fleet",
+            "fleet_replicas": replicas,
+            "policy": policy,
+            "clients": clients,
+            "requests": requests,
+            "max_new": max_new,
+            "kv_block_size": block_size,
+            "distinct_prompts": k,
+            "requests_per_sec": round(requests / wall, 2),
+            "tokens_per_sec": round(requests * max_new / wall, 1),
+            "p50_s": round(q[9], 3),
+            "p95_s": round(q[18], 3),
+            "wall_s": round(wall, 2),
+            "prefix_hits": hits,
+            "prefix_misses": misses,
+            "affinity_hit_rate": (round(hits / (hits + misses), 3)
+                                  if hits + misses else 0.0),
+            # prompt cells served from cache / prompt cells total —
+            # the bandwidth view of the same A/B (a hit that reuses 2
+            # of 24 tokens is not much of a win)
+            "token_reuse_rate": (round(reused / (reused + prefilled), 3)
+                                 if reused + prefilled else 0.0),
+            "route_reasons": reasons,
+            "hedge_wins": int(route1["hedge_wins"]
+                              - route0["hedge_wins"]),
+            "killed_replica": killed,
+            "client_failures": failures,
+        }
+    finally:
+        log.close()
+        os.unlink(log.name)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
 
 
 def run(clients: int, requests: int, max_new: int,
@@ -218,8 +477,25 @@ def main() -> int:
     p.add_argument("--requests", type=int, default=96)
     p.add_argument("--max-new", type=int, default=16)
     p.add_argument("--batch-window-ms", type=int, default=5)
-    p.add_argument("--mode", choices=("window", "continuous"),
+    p.add_argument("--mode", choices=("window", "continuous", "fleet"),
                    default="window")
+    p.add_argument("--fleet-replicas", type=int, default=2,
+                   help="fleet mode: serving replicas behind the router")
+    p.add_argument("--fleet-policy", choices=("affinity", "roundrobin"),
+                   default="affinity",
+                   help="fleet mode: routing policy (roundrobin is the "
+                        "A/B control arm for the prefix-hit comparison)")
+    p.add_argument("--fleet-kill-one", action="store_true",
+                   help="fleet mode: kill one replica halfway through "
+                        "the timed run (retry/fallback must complete "
+                        "every request)")
+    p.add_argument("--fleet-block-size", type=int, default=8,
+                   help="fleet mode: kv_block_size on the replicas AND "
+                        "the router's affinity-key block")
+    p.add_argument("--fleet-hedge-after-s", type=float, default=10.0,
+                   help="fleet mode: router hedge deadline (high "
+                        "default: CPU compile stalls should retry, "
+                        "not duplicate)")
     p.add_argument("--spread", action="store_true",
                    help="per-request max_new cycles 1/4x..1x of "
                         "--max-new (heterogeneous workload)")
@@ -234,9 +510,23 @@ def main() -> int:
         p.error("--pipeline-depth requires --mode continuous")
     if args.pipeline_depth < 0:
         p.error("--pipeline-depth must be >= 0")
-    result = run(args.clients, args.requests, args.max_new,
-                 args.batch_window_ms, args.mode, args.spread,
-                 pipeline_depth=args.pipeline_depth)
+    if args.mode == "fleet":
+        if args.fleet_replicas < 1:
+            p.error("--fleet-replicas must be >= 1")
+        if args.fleet_kill_one and args.fleet_replicas < 2:
+            p.error("--fleet-kill-one needs --fleet-replicas >= 2")
+        if args.fleet_block_size < 1:
+            p.error("--fleet-block-size must be >= 1")
+        result = run_fleet(
+            args.clients, args.requests, args.max_new,
+            replicas=args.fleet_replicas, policy=args.fleet_policy,
+            block_size=args.fleet_block_size,
+            kill_one=args.fleet_kill_one,
+            hedge_after_s=args.fleet_hedge_after_s)
+    else:
+        result = run(args.clients, args.requests, args.max_new,
+                     args.batch_window_ms, args.mode, args.spread,
+                     pipeline_depth=args.pipeline_depth)
     print(json.dumps(result))
     return 0
 
