@@ -1,7 +1,7 @@
 # Developer entry points (the reference drives everything through
 # per-component Makefiles; here one root Makefile covers the repo).
 
-.PHONY: test test-slow test-all e2e smoke conformance bench dryrun native verify-all obs-check serving-check fleet-check
+.PHONY: test test-slow test-all e2e smoke conformance bench dryrun native verify-all obs-check serving-check fleet-check kernels-check
 
 verify-all:  ## the full evidence sweep, one command
 	python -m pytest tests -q -m "slow or not slow"
@@ -44,6 +44,11 @@ serving-check: ## CPU dense-oracle parity gate for the paged-KV serving path
 	  tests/test_speculative.py -q -m "slow or not slow" \
 	  --deselect tests/test_continuous.py::test_continuous_engine_under_tensor_parallel_mesh \
 	  --deselect tests/test_serving.py::test_sharded_gemma_scale_vocab_decode_matches_unsharded
+
+kernels-check: ## Pallas kernels vs XLA oracles, interpret mode, both tiers
+	JAX_PLATFORMS=cpu python -m pytest tests/test_flash.py \
+	  tests/test_decode_attention.py \
+	  tests/test_paged_attention_kernel.py -q -m "slow or not slow"
 
 fleet-check: ## fleet router gate: unit suite + 2-replica routed loadtest
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q

@@ -635,6 +635,104 @@ def bench_decode_paged(model: str, *, slots: int, prompt_len: int,
     }
 
 
+def bench_decode_paged_kernel(*, b: int, n_q: int, n_kv: int, hd: int,
+                              block_size: int, blocks_per_slot: int,
+                              iters: int,
+                              verbose: bool = True) -> dict:
+    """Ops-level A/B of the two paged-attention impls on one synthetic
+    pool: the XLA gather (materializes every row's full
+    `blocks_per_slot * block_size` window) vs the fused Pallas kernel
+    (walks the block table in-kernel; interpret mode on CPU, so its
+    CPU tokens/s is a numerics vehicle, not a speed claim — the HBM
+    model below is the portable number).
+
+    Timed at LOW fill — the regime the fused kernel exists for: a
+    long-max_len pool where most of each row's window is dead. Per-step
+    HBM bytes are modeled from what each impl demonstrably reads
+    (tests/test_paged_attention_kernel.py's NaN-poison test): gather =
+    full window regardless of fill; fused = each row's live blocks,
+    `ceil((cursor+1)/block_size)`. Reported at two fills so the
+    artifact shows fused bytes SCALING WITH FILL while gather stays
+    flat — vs_baseline on the byte entries is gather/fused, the
+    modeled traffic saving."""
+    from kubeflow_tpu.ops.attention import paged_attention
+
+    width = blocks_per_slot * block_size
+    num_blocks = 1 + b * blocks_per_slot
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(b, 1, n_q, hd)), jnp.float32)
+    kp = jnp.asarray(
+        rng.normal(size=(num_blocks, block_size, n_kv, hd)), jnp.float32)
+    vp = jnp.asarray(
+        rng.normal(size=(num_blocks, block_size, n_kv, hd)), jnp.float32)
+    # each row owns a disjoint live chain; tails point at trash block 0
+    fill_lo, fill_hi = width // 8 - 1, width - 1
+    pos = np.full((b,), fill_lo, np.int32)
+    table = np.zeros((b, blocks_per_slot), np.int32)
+    for i in range(b):
+        live = pos[i] // block_size + 1
+        table[i, :live] = 1 + i * blocks_per_slot + np.arange(live)
+    table = jnp.asarray(table)
+    qpos = jnp.asarray(pos)[:, None]
+    kvpos = jnp.broadcast_to(
+        jnp.arange(width, dtype=jnp.int32)[None], (b, width))
+    mask = jnp.ones((b, width), bool)
+
+    def timed(impl: str) -> float:
+        fn = jax.jit(lambda *a: paged_attention(
+            *a, causal=True, impl=impl))
+        jax.block_until_ready(fn(q, kp, vp, table, qpos, kvpos))
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(q, kp, vp, table, qpos, kvpos)
+        jax.block_until_ready(out)
+        return b * iters / (time.perf_counter() - t0)
+
+    xla_tok_s = timed("xla")
+    pallas_tok_s = timed("pallas")
+
+    cell_bytes = 2 * n_kv * hd * kp.dtype.itemsize  # K + V per cell
+    gather_bytes = b * width * cell_bytes  # fill-independent
+    def fused_bytes(fill):
+        return b * (fill // block_size + 1) * block_size * cell_bytes
+
+    gen = detect_generation()
+    if verbose:
+        print(f"# decode-paged-kernel b={b} width={width} "
+              f"fill={fill_lo} xla_tok/s={xla_tok_s:.1f} "
+              f"pallas_tok/s={pallas_tok_s:.1f} "
+              f"hbm_gather={gather_bytes} "
+              f"hbm_fused@{fill_lo}={fused_bytes(fill_lo)} "
+              f"hbm_fused@{fill_hi}={fused_bytes(fill_hi)}",
+              file=sys.stderr)
+    return {
+        "metric": f"paged_attention_fused_tokens_per_sec[{gen}]",
+        "value": round(pallas_tok_s, 2),
+        "unit": "tokens/s",
+        # measured step-rate ratio vs the gather at the same low fill
+        "vs_baseline": round(pallas_tok_s / max(1e-9, xla_tok_s), 4),
+        "extra_metrics": [
+            {"metric": f"paged_attention_gather_tokens_per_sec[{gen}]",
+             "value": round(xla_tok_s, 2), "unit": "tokens/s",
+             "vs_baseline": 1.0},
+            {"metric": f"paged_attention_hbm_bytes_gather[{gen}]",
+             "value": float(gather_bytes), "unit": "bytes/step",
+             "vs_baseline": 1.0},
+            {"metric": ("paged_attention_hbm_bytes_fused"
+                        f"[fill={fill_lo},{gen}]"),
+             "value": float(fused_bytes(fill_lo)), "unit": "bytes/step",
+             "vs_baseline": round(
+                 gather_bytes / fused_bytes(fill_lo), 4)},
+            {"metric": ("paged_attention_hbm_bytes_fused"
+                        f"[fill={fill_hi},{gen}]"),
+             "value": float(fused_bytes(fill_hi)), "unit": "bytes/step",
+             "vs_baseline": round(
+                 gather_bytes / fused_bytes(fill_hi), 4)},
+        ],
+    }
+
+
 def bench_mnist(*, steps: int = 200, batch: int = 256,
                 verbose: bool = True) -> dict:
     """BASELINE config #1: MNIST-MLP smoke train (images/s + accuracy).
@@ -779,8 +877,8 @@ def first_compile_metric() -> dict:
 # mnist/vit/decode-gemma complete the BASELINE.md config matrix
 # (configs #1, #2, #5 — VERDICT r04 weak #4).
 ALL_SECTIONS = ("train500m", "train1b", "decode", "decode-int8",
-                "decode-cont", "decode-paged", "decode-gemma", "mnist",
-                "vit", "flash4k")
+                "decode-cont", "decode-paged", "decode-paged-kernel",
+                "decode-gemma", "mnist", "vit", "flash4k")
 # Per-section wall-clock bound for the orchestrated TPU sweep. Sized
 # from measured section times (train sections ~2-4 min incl. compile,
 # decode ~2 min) with slack for tunnel weather; a section that wedges
@@ -794,7 +892,8 @@ _SECTION_TIMEOUT_S = float(
 def _sweep_for(backend: str, wanted: list[str], p) -> list[str]:
     sweep = (list(ALL_SECTIONS) if backend == "tpu"
              else ["train500m", "decode", "decode-int8", "decode-cont",
-                   "decode-paged", "decode-gemma", "mnist", "vit"])
+                   "decode-paged", "decode-paged-kernel",
+                   "decode-gemma", "mnist", "vit"])
     if wanted:
         unavailable = [s for s in wanted if s not in sweep]
         if unavailable:
@@ -946,8 +1045,8 @@ def main() -> int:
     p.add_argument("--only", default="",
                    help="comma-separated subset: train500m,train1b,"
                         "flash4k,decode,decode-int8,decode-cont,"
-                        "decode-paged (default: full "
-                        "sweep for the backend)")
+                        "decode-paged,decode-paged-kernel (default: "
+                        "full sweep for the backend)")
     p.add_argument("--json-only", action="store_true")
     args = p.parse_args()
 
@@ -1108,6 +1207,25 @@ def _run_sweep(sweep: list[str], backend: str, *, in_child: bool,
             return m
 
         guarded("decode-paged", _paged)
+    if "decode-paged-kernel" in sweep:
+        # XLA gather vs fused Pallas kernel over the same block pool
+        # (ops-level, no engine). CPU runs the kernel in interpret
+        # mode — tiny shapes keep the interpreter's per-block Python
+        # cost bounded; the modeled HBM-byte entries are the numbers
+        # that transfer to hardware.
+        def _paged_kernel() -> dict:
+            if on_tpu:
+                m = bench_decode_paged_kernel(
+                    b=16, n_q=16, n_kv=2, hd=128, block_size=64,
+                    blocks_per_slot=32, iters=32, verbose=verbose)
+            else:
+                m = bench_decode_paged_kernel(
+                    b=4, n_q=8, n_kv=2, hd=64, block_size=16,
+                    blocks_per_slot=16, iters=8, verbose=verbose)
+            extras.extend(m.pop("extra_metrics", []))
+            return m
+
+        guarded("decode-paged-kernel", _paged_kernel)
     if "decode-gemma" in sweep:
         # BASELINE config #5 (Gemma-2B serving): same decode harness,
         # gemma family (GQA 8q/1kv, huge vocab — a different serving

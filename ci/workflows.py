@@ -22,6 +22,8 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         "tests": ("python -m pytest tests/test_llama.py tests/test_models.py "
                   "tests/test_mesh.py tests/test_ring.py tests/test_moe.py "
                   "tests/test_pipeline.py tests/test_flash.py "
+                  "tests/test_decode_attention.py "
+                  "tests/test_paged_attention_kernel.py "
                   "tests/test_checkpoint.py tests/test_llama_pp.py "
                   "tests/test_lora.py tests/test_llama_moe.py -q"),
     },
@@ -423,6 +425,45 @@ def fleet_check_workflow() -> dict:
     }
 
 
+def kernels_check_workflow() -> dict:
+    """Pallas kernel gate: `make kernels-check` runs all three kernel
+    suites (flash, fused decode, fused paged decode) in interpret mode
+    on CPU, BOTH tiers — so the oracle-parity pins (including the
+    slow-marked engine token-parity tests) execute on every kernel or
+    attention change, not just on main's slow tier."""
+    return {
+        "name": "kernels check",
+        "on": {
+            "pull_request": {"paths": [
+                "kubeflow_tpu/ops/**",
+                "tests/test_flash.py",
+                "tests/test_decode_attention.py",
+                "tests/test_paged_attention_kernel.py",
+                "Makefile"]},
+            "push": {"branches": ["main"]},
+        },
+        "jobs": {
+            "kernels-check": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e .[ci] pytest"},
+                    {"name": "pallas kernels vs XLA oracles "
+                             "(interpret mode)",
+                     "run": "make kernels-check",
+                     "env": {
+                         "JAX_PLATFORMS": "cpu",
+                         "XLA_FLAGS":
+                             "--xla_force_host_platform_device_count=8",
+                     }},
+                ],
+            }
+        },
+    }
+
+
 def all_workflows() -> dict[str, dict]:
     from ci import cd
 
@@ -437,6 +478,7 @@ def all_workflows() -> dict[str, dict]:
     out["slow_tier_test.yaml"] = slow_tier_workflow()
     out["serving_check.yaml"] = serving_check_workflow()
     out["fleet_check.yaml"] = fleet_check_workflow()
+    out["kernels_check.yaml"] = kernels_check_workflow()
     out["frontend_test.yaml"] = frontend_workflow()
     out.update(cd.all_workflows())
     return out

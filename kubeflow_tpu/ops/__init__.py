@@ -7,4 +7,8 @@ would only hurt (see /opt/skills/guides/pallas_guide.md).
 
 from kubeflow_tpu.ops.norms import rms_norm
 from kubeflow_tpu.ops.rotary import apply_rope, rope_frequencies
-from kubeflow_tpu.ops.attention import dot_product_attention, paged_attention
+from kubeflow_tpu.ops.attention import (
+    dot_product_attention,
+    paged_attention,
+    resolve_paged_attention_impl,
+)

@@ -22,7 +22,8 @@ NEG_INF = -2.0**30  # large-but-finite: avoids NaNs from (-inf) - (-inf)
 # trace time, so these count how many traced call sites took each impl —
 # which is how bench.py *proves* the long-seq preset routed through the
 # Pallas flash kernel instead of silently falling back to XLA.
-_impl_counts = {"flash": 0, "xla": 0, "decode": 0, "paged": 0}
+_impl_counts = {"flash": 0, "xla": 0, "decode": 0, "paged": 0,
+                "paged_xla": 0, "paged_pallas": 0}
 
 
 def reset_impl_counts() -> None:
@@ -87,6 +88,35 @@ def _decode_kernel_available() -> bool:
         return True
     except ImportError:
         return False
+
+
+def _paged_kernel_available() -> bool:
+    try:
+        from kubeflow_tpu.ops.pallas import paged_attention  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def resolve_paged_attention_impl(impl: str) -> str:
+    """Resolve a `paged_attention` impl request to "xla" or "pallas".
+
+    "auto" picks the fused Pallas kernel on TPU when present (falling
+    back to the gather if the kernel fails to import), the XLA gather
+    everywhere else — CPU runs the kernel only in interpret mode, which
+    is a numerics/test vehicle, not a fast path. Resolving once at
+    engine construction (rather than per trace) is what lets serving
+    label its metrics with the impl that actually runs.
+    """
+    if impl not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"paged attention impl must be 'auto', 'xla' or 'pallas', "
+            f"got {impl!r}")
+    if impl == "auto":
+        if jax.default_backend() == "tpu" and _paged_kernel_available():
+            return "pallas"
+        return "xla"
+    return impl
 
 
 def dot_product_attention(
@@ -193,29 +223,82 @@ def paged_attention(
     causal: bool = True,
     kv_mask: jnp.ndarray | None = None,  # [b, blocks_per_slot * block_size]
     window: int | None = None,
+    impl: str = "xla",
+    interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Decode attention against a paged KV cache.
 
-    Each row's K/V is gathered from a shared block pool through its
-    block table, then fed to the same grouped-query attention as the
-    dense path. Because masked cells contribute an exact +0.0 to the
-    softmax sums (NEG_INF logits underflow to 0.0 in fp32 exp), the
-    gathered layout is bit-identical to a dense cache holding the same
-    tokens at the same logical cells — which is what lets the tests
-    compare paged decode against dense decode exactly.
+    impl: "auto" | "xla" | "pallas".
 
-    The gather materializes `[b, blocks_per_slot * block_size]` of K/V
-    per layer — fine for XLA/CPU and short-to-mid contexts; a fused
-    Pallas kernel that walks the table in-kernel is the TPU follow-up
-    (see docs/perf-notes.md).
+    - "xla" (default): each row's K/V is gathered from the block pool
+      through its table, then fed to the same grouped-query attention
+      as the dense path. Because masked cells contribute an exact +0.0
+      to the softmax sums (NEG_INF logits underflow to 0.0 in fp32
+      exp), the gathered layout is bit-identical to a dense cache
+      holding the same tokens at the same logical cells — which is
+      what lets the tests compare paged decode against dense decode
+      exactly. The gather materializes the full
+      `[b, blocks_per_slot * block_size]` K/V window per layer — fine
+      for CPU and short-to-mid contexts, HBM-wasteful at long max_len.
+    - "pallas": the fused kernel (ops/pallas/paged_attention.py) walks
+      the block table IN-KERNEL — scalar-prefetched cursors clamp the
+      DMA range to each row's live blocks, so HBM traffic tracks cache
+      fill instead of the full window. Causal-only (it masks by cell
+      index against the cursor, so it also requires the pool's
+      cell-index == token-position invariant, which insert-time
+      compaction guarantees). `interpret` forces Pallas interpret mode
+      (default: on for non-TPU backends) — the CPU test vehicle.
+    - "auto": pallas on TPU when the kernel imports, xla otherwise.
+
+    The two impls agree to fp32 tolerance (online-softmax merge vs
+    single-pass softmax); tests/test_paged_attention_kernel.py pins
+    the kernel against this gather path as the numerics oracle.
     """
     b = q.shape[0]
+    if block_table.ndim != 2 or block_table.shape[0] != b:
+        raise ValueError(
+            f"block_table must be [b={b}, blocks_per_slot], got "
+            f"{block_table.shape}")
+    if k_pool.shape != v_pool.shape:
+        raise ValueError(
+            f"k_pool/v_pool shapes disagree: {k_pool.shape} vs "
+            f"{v_pool.shape}")
     blocks_per_slot = block_table.shape[1]
     block_size, n_kv, hd = k_pool.shape[1:]
     width = blocks_per_slot * block_size
+    # Geometry mismatches (a pool rebuilt with a different block_size
+    # than the tables/masks were laid out for) used to surface as an
+    # opaque reshape/gather shape error deep inside jit; check here
+    # with the actual numbers instead.
+    if kv_positions.shape != (b, width):
+        raise ValueError(
+            f"kv_positions shape {kv_positions.shape} does not match "
+            f"blocks_per_slot * block_size = {blocks_per_slot} * "
+            f"{block_size} = {width} (pool {k_pool.shape}, table "
+            f"{block_table.shape})")
+    if kv_mask is not None and kv_mask.shape != (b, width):
+        raise ValueError(
+            f"kv_mask shape {kv_mask.shape} does not match "
+            f"blocks_per_slot * block_size = {blocks_per_slot} * "
+            f"{block_size} = {width}")
+    impl = resolve_paged_attention_impl(impl)
+    _impl_counts["paged"] += 1
+    _impl_counts["paged_" + impl] += 1
+    if impl == "pallas":
+        if not causal:
+            # the kernel masks idx <= cursor unconditionally (same
+            # door discipline as impl='decode')
+            raise ValueError("impl='pallas' paged attention is "
+                             "causal-only; use impl='xla'")
+        from kubeflow_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention,
+        )
+
+        return paged_decode_attention(
+            q, k_pool, v_pool, block_table, q_positions[:, 0],
+            kv_mask, window=window, interpret=interpret)
     k = k_pool[block_table].reshape(b, width, n_kv, hd)
     v = v_pool[block_table].reshape(b, width, n_kv, hd)
-    _impl_counts["paged"] += 1
     # Cell index == logical token position by construction (insert-time
     # compaction strips prefill padding), so positions are contiguous.
     return dot_product_attention(

@@ -6,3 +6,4 @@ tested against the fake-TPU backend.
 """
 
 from kubeflow_tpu.ops.pallas.flash_attention import flash_attention
+from kubeflow_tpu.ops.pallas.paged_attention import paged_decode_attention

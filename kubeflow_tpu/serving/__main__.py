@@ -97,6 +97,13 @@ def main(argv=None) -> int:
     p.add_argument("--pipeline-depth", type=int, default=0,
                    help="decode dispatch-ahead depth (0 = backend-"
                         "aware default: 2 on TPU, 1 elsewhere)")
+    p.add_argument("--paged-attention-impl", default="auto",
+                   choices=("auto", "xla", "pallas"),
+                   help="decode attention over the paged KV pool "
+                        "(continuous only): xla gathers each row's "
+                        "full window through the block table, pallas "
+                        "walks the table in-kernel (interpret mode "
+                        "off-TPU), auto = pallas on TPU")
     p.add_argument("--quant", choices=("", "int8"), default="")
     p.add_argument("--tokenizer", default="",
                    help="data.bpe tokenizer file (text mode); 'auto' "
@@ -123,6 +130,8 @@ def main(argv=None) -> int:
         # batcher; silently ignoring the flag would break the "Ready
         # means compiled" promise
         p.error("--warmup requires --continuous")
+    if args.paged_attention_impl != "auto" and not args.continuous:
+        p.error("--paged-attention-impl requires --continuous")
     if args.advertise and not args.fleet_router:
         p.error("--advertise requires --fleet-router")
 
@@ -179,6 +188,7 @@ def main(argv=None) -> int:
         warmup=args.warmup,
         prefill_chunk=args.prefill_chunk or None,
         pipeline_depth=args.pipeline_depth or None,
+        paged_attention_impl=args.paged_attention_impl,
         drain_grace_s=args.drain_grace_s,
     )
     if args.fleet_router:

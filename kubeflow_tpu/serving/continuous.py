@@ -56,7 +56,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kubeflow_tpu.ops.attention import dot_product_attention, paged_attention
+from kubeflow_tpu.ops.attention import (
+    dot_product_attention,
+    paged_attention,
+    resolve_paged_attention_impl,
+)
 from kubeflow_tpu.ops.norms import rms_norm
 from kubeflow_tpu.ops.rotary import rope_frequencies
 from kubeflow_tpu.serving.engine import (
@@ -137,7 +141,9 @@ class ContinuousEngine:
 
     def __init__(self, engine: InferenceEngine, max_slots: int = 8,
                  prefill_chunk: int | None = None,
-                 block_size: int = 64, num_blocks: int | None = None):
+                 block_size: int = 64, num_blocks: int | None = None,
+                 paged_attention_impl: str = "auto",
+                 pool: BlockPool | None = None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if prefill_chunk is not None and prefill_chunk < 1:
@@ -146,6 +152,13 @@ class ContinuousEngine:
         if block_size < 2 or block_size & (block_size - 1):
             raise ValueError(
                 f"block_size must be a power of two >= 2, got {block_size}")
+        # Resolve the attention impl ONCE at construction (validates
+        # the name too): the decode closure passes it through every
+        # trace, and serving labels its metrics with the resolved
+        # value. "auto" = pallas on TPU, xla elsewhere.
+        self.paged_attention_impl = paged_attention_impl
+        self.attention_impl = resolve_paged_attention_impl(
+            paged_attention_impl)
         self.engine = engine
         self.S = max_slots
         # Paged KV geometry. The cache is a POOL of fixed-size blocks
@@ -159,7 +172,8 @@ class ContinuousEngine:
         self.blocks_per_slot = -(-engine.ec.max_len // block_size)
         self.kv_width = self.blocks_per_slot * block_size
         if num_blocks is None:
-            num_blocks = 1 + max_slots * self.blocks_per_slot
+            num_blocks = (pool.num_blocks if pool is not None
+                          else 1 + max_slots * self.blocks_per_slot)
         if num_blocks < 1 + self.blocks_per_slot:
             raise ValueError(
                 f"num_blocks {num_blocks} < {1 + self.blocks_per_slot} "
@@ -167,7 +181,26 @@ class ContinuousEngine:
                 f"{engine.ec.max_len} / block_size {block_size}): a "
                 "single max-length request could never be admitted")
         self.num_blocks = num_blocks
-        self.pool = BlockPool(num_blocks, block_size)
+        if pool is not None:
+            # A caller-supplied pool must agree with the geometry
+            # `ops.paged_attention` will see (tables/masks are laid out
+            # in `blocks_per_slot * block_size` cells over a
+            # `[num_blocks, block_size]` pool). A mismatch used to
+            # surface only as an opaque gather/reshape shape error deep
+            # inside jit on the first decode step.
+            if (pool.block_size != block_size
+                    or pool.num_blocks != num_blocks):
+                raise ValueError(
+                    f"BlockPool geometry (num_blocks="
+                    f"{pool.num_blocks}, block_size={pool.block_size}) "
+                    f"does not match the engine's paged-attention "
+                    f"layout (num_blocks={num_blocks}, block_size="
+                    f"{block_size}, blocks_per_slot="
+                    f"{self.blocks_per_slot}): block tables and KV "
+                    f"masks would disagree with the pool shape")
+            self.pool = pool
+        else:
+            self.pool = BlockPool(num_blocks, block_size)
         # Long-prompt admissions prefill in fixed slices (engine.
         # prefill_chunked): buckets become chunk MULTIPLES, so every
         # long prompt reuses the one [g, chunk] program instead of
@@ -573,7 +606,8 @@ class ContinuousEngine:
                 return paged_attention(
                     q, kp, vp, st.block_table, positions, kv_positions,
                     causal=True, kv_mask=kv_valid,
-                    window=getattr(cfg, "sliding_window", None))
+                    window=getattr(cfg, "sliding_window", None),
+                    impl=self.attention_impl)
 
             x, _ = transformer_block(
                 cfg, fam, p, x, rope_positions, inv_freq, write_kv,
@@ -675,7 +709,8 @@ class ContinuousBatcher:
                  pipeline_depth: int | None = None,
                  window_ms: float = 0.0,
                  kv_block_size: int = 64,
-                 kv_pool_blocks: int | None = None):
+                 kv_pool_blocks: int | None = None,
+                 paged_attention_impl: str = "auto"):
         # window_ms accepted (and ignored) for constructor parity with
         # Batcher: admission is per-token here, there is no window.
         del window_ms
@@ -709,10 +744,10 @@ class ContinuousBatcher:
         # under a window group's full-generation wait. Compiles stay
         # bounded: one program per steps value in [1, chunk].
         self.chunk = chunk
-        self.cengine = ContinuousEngine(engine, max_slots,
-                                        prefill_chunk=prefill_chunk,
-                                        block_size=kv_block_size,
-                                        num_blocks=kv_pool_blocks)
+        self.cengine = ContinuousEngine(
+            engine, max_slots, prefill_chunk=prefill_chunk,
+            block_size=kv_block_size, num_blocks=kv_pool_blocks,
+            paged_attention_impl=paged_attention_impl)
         # Automatic radix prefix cache over the block pool: every
         # admitted prompt's full blocks are indexed by token prefix
         # (at admission, so even in-flight prefills are sharable), and
@@ -731,6 +766,11 @@ class ContinuousBatcher:
         # optional hook(computed: int, reused: int, hit: bool), called
         # per admission — the server wires metrics through this
         self.on_prefix = None
+        # optional obs.Tracer: when set (the server wires it), every
+        # decode-chunk dispatch opens a `decode.attention` span in the
+        # executor thread, tagged with the RESOLVED attention impl —
+        # traces show which kernel served a step
+        self.tracer = None
         # Shared prefixes (system prompts): token lists registered at
         # construction; each computes its KV ONCE, lazily, on first use
         # (device work belongs under the gpu lock, not in __init__).
@@ -1374,6 +1414,13 @@ class ContinuousBatcher:
             # jax.random.split dispatch per chunk.
             return self.cengine.step(st, sp, self._rng, steps)
 
+        if self.tracer is not None:
+            # Tracer.wrap propagates the current context into the
+            # executor thread, so the span nests under the request's
+            # root when one is active.
+            run_step = self.tracer.wrap(
+                run_step, "decode.attention",
+                impl=self.cengine.attention_impl, steps=steps)
         async with self.gpu_lock:
             st, toks, lps, rng = await loop.run_in_executor(
                 None, run_step)

@@ -128,6 +128,15 @@ class ServingObs:
             "Generated token ids outside the byte-decoder's range "
             "(vocab tail / specials) dropped from text responses — "
             "nonzero means tokenizer/model drift", self.registry)
+        # One-shot info gauge (value is always 1; the information is
+        # the label): which paged-attention impl decode resolved to —
+        # xla gather or the fused pallas kernel. Set once per model at
+        # app creation; joins cleanly against the per-model latency
+        # series.
+        self.attention_impl = Gauge(
+            "serving_attention_impl",
+            "Resolved paged-attention impl per model (info gauge: "
+            "value 1, impl in the label)", self.registry)
 
 
 _OBS_T0 = "obs_request_start"
@@ -364,6 +373,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                        pipeline_depth: int | None = None,
                        kv_block_size: int = 64,
                        kv_pool_blocks: int | None = None,
+                       paged_attention_impl: str = "auto",
                        drafts: dict[str, InferenceEngine] | None = None,
                        registry=None, tracer=None,
                        drain_grace_s: float = 30.0,
@@ -386,7 +396,12 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     tokens per block and total pool blocks per model (default: the
     dense equivalent, every slot can reach max_len — shrink the pool
     to cap KV HBM, admission then accounts by blocks free and defers
-    requests the pool can't cover). `registry`/`tracer`
+    requests the pool can't cover). `paged_attention_impl`
+    (continuous only) selects decode's attention path: "xla" (gather
+    through the block table), "pallas" (fused kernel walking the table
+    in-kernel; interpret mode off-TPU), or "auto" (pallas on TPU, xla
+    elsewhere) — the resolved choice is exported as the
+    `serving_attention_impl` info gauge. `registry`/`tracer`
     share an external metric registry / span tracer; by default the app
     owns fresh ones, exposed at `/metrics` and `/debug/traces`.
     `drain_grace_s` bounds how long shutdown (and POST /drain via
@@ -421,14 +436,16 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                            or max_pending is not None
                            or pipeline_depth is not None
                            or kv_block_size != 64
-                           or kv_pool_blocks is not None):
+                           or kv_pool_blocks is not None
+                           or paged_attention_impl != "auto"):
         # these knobs only exist on the continuous batcher; silently
         # ignoring them would ship a server missing configuration the
         # caller explicitly asked for (max_pending especially: the
         # caller believes overload sheds at that depth)
         raise ValueError(
             "warmup/prefill_chunk/prefixes/max_pending/pipeline_depth/"
-            "kv_block_size/kv_pool_blocks require continuous=True")
+            "kv_block_size/kv_pool_blocks/paged_attention_impl "
+            "require continuous=True")
     if continuous:
         # prefill_chunk: long prompts admit in fixed slices — chunk-
         # multiple buckets, one [g, chunk] compile for every length.
@@ -441,7 +458,8 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                 max_pending=256 if max_pending is None else max_pending,
                 pipeline_depth=pipeline_depth,
                 kv_block_size=kv_block_size,
-                kv_pool_blocks=kv_pool_blocks)
+                kv_pool_blocks=kv_pool_blocks,
+                paged_attention_impl=paged_attention_impl)
             for name, eng in engines.items()}
         if warmup:
             async def _warm(app_):
@@ -477,6 +495,12 @@ def create_serving_app(engines: dict[str, InferenceEngine],
             # (and a 0 reading) before the first admission
             sobs.prefix_hits.inc(0, model=model_name)
             sobs.prefix_misses.inc(0, model=model_name)
+            # which attention impl decode resolved to, as an info
+            # gauge; the tracer hook makes each decode chunk a
+            # `decode.attention` span carrying the same label
+            sobs.attention_impl.set(
+                1, model=model_name, impl=b.cengine.attention_impl)
+            b.tracer = sobs.tracer
     if continuous:
         def collect_kv_blocks():
             # gauge refreshed at render: /metrics reads the LIVE pool,

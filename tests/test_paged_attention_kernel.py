@@ -1,0 +1,319 @@
+"""Fused paged-attention kernel vs the XLA gather oracle.
+
+The kernel (ops/pallas/paged_attention.py) walks each row's block
+table in-kernel; `ops.paged_attention(impl="xla")` gathers the full
+window through the same table. The two must agree to fp32 tolerance
+(online-softmax merge vs single-pass softmax) across everything the
+serving engine can throw at them: GQA ratios, ragged cursors, sliding
+windows, CoW-shared tables, and the trash-block-0 convention — and the
+continuous engine must emit IDENTICAL tokens with either impl.
+
+All kernel runs here are interpret mode (CPU backend — see conftest).
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models import gemma, llama
+from kubeflow_tpu.ops.attention import (
+    impl_counts,
+    paged_attention,
+    resolve_paged_attention_impl,
+)
+from kubeflow_tpu.ops.pallas.paged_attention import paged_decode_attention
+from kubeflow_tpu.serving import (
+    GEMMA_FAMILY,
+    LLAMA_FAMILY,
+    EngineConfig,
+    InferenceEngine,
+)
+from kubeflow_tpu.serving.continuous import ContinuousBatcher, ContinuousEngine
+from kubeflow_tpu.serving.paged import BlockPool
+
+TOL = dict(atol=1e-5, rtol=1e-5)
+
+
+def _mk(seed, b=3, n_q=8, n_kv=2, hd=32, bs=8, nb=6, num_blocks=32):
+    """Random pool + per-row table/cursor in the engine's layout:
+    ragged cursors, live blocks allocated from the pool, table tails
+    trash-padded (block 0), a pad hole punched into the mask."""
+    rng = np.random.default_rng(seed)
+    width = nb * bs
+    q = jnp.asarray(rng.normal(size=(b, 1, n_q, hd)), jnp.float32)
+    kp = np.asarray(rng.normal(size=(num_blocks, bs, n_kv, hd)),
+                    np.float32)
+    vp = np.asarray(rng.normal(size=(num_blocks, bs, n_kv, hd)),
+                    np.float32)
+    kp[0] = vp[0] = 0.0  # the trash block holds no real tokens
+    pos = rng.integers(0, width, size=(b,)).astype(np.int32)
+    table = np.zeros((b, nb), np.int32)
+    used = {0}
+    for i in range(b):
+        for j in range(pos[i] // bs + 1):
+            blk = int(rng.choice([x for x in range(1, num_blocks)
+                                  if x not in used]))
+            used.add(blk)
+            table[i, j] = blk
+    mask = np.ones((b, width), bool)
+    mask[:, 3] = False  # a left-pad hole, same for every row
+    kv_pos = np.broadcast_to(np.arange(width, dtype=np.int32), (b, width))
+    return (q, jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(table), jnp.asarray(pos), jnp.asarray(mask),
+            jnp.asarray(kv_pos))
+
+
+def _oracle(q, kp, vp, table, pos, mask, kv_pos, window=None):
+    return paged_attention(q, kp, vp, table, pos[:, None], kv_pos,
+                           causal=True, kv_mask=mask, window=window,
+                           impl="xla")
+
+
+@pytest.mark.parametrize("n_q,n_kv", [(8, 2), (4, 4), (8, 1)])
+def test_kernel_matches_oracle_across_gqa_ratios(n_q, n_kv):
+    for seed in (0, 1):
+        q, kp, vp, table, pos, mask, kv_pos = _mk(
+            seed, n_q=n_q, n_kv=n_kv)
+        want = _oracle(q, kp, vp, table, pos, mask, kv_pos)
+        got = paged_decode_attention(q, kp, vp, table, pos, mask,
+                                     interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   **TOL)
+
+
+def test_kernel_matches_oracle_ragged_cursors():
+    # cursors pinned to the raggedest corners: empty-but-one, block
+    # boundaries either side, full window
+    q, kp, vp, table, _, mask, kv_pos = _mk(2, b=5, nb=6, bs=8)
+    pos = jnp.asarray([0, 7, 8, 33, 47], jnp.int32)
+    table = jnp.asarray(np.where(
+        np.arange(6)[None] <= np.asarray(pos)[:, None] // 8,
+        np.asarray(table), 0))
+    want = _oracle(q, kp, vp, table, pos, mask, kv_pos)
+    got = paged_decode_attention(q, kp, vp, table, pos, mask,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), **TOL)
+
+
+@pytest.mark.parametrize("window", [1, 4, 13, 100])
+def test_kernel_matches_oracle_sliding_window(window):
+    q, kp, vp, table, pos, mask, kv_pos = _mk(3)
+    want = _oracle(q, kp, vp, table, pos, mask, kv_pos, window=window)
+    got = paged_decode_attention(q, kp, vp, table, pos, mask,
+                                 window=window, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), **TOL)
+
+
+def test_kernel_matches_oracle_cow_shared_tables():
+    """Two rows point at the SAME physical block (radix sharing /
+    copy-on-write): the indirection must read it once per row without
+    cross-talk."""
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(size=(2, 1, 4, 16)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(8, 4, 2, 16)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(8, 4, 2, 16)), jnp.float32)
+    table = jnp.asarray([[3, 5, 0], [3, 6, 0]], jnp.int32)  # share 3
+    pos = jnp.asarray([6, 7], jnp.int32)
+    kv_pos = jnp.broadcast_to(jnp.arange(12, dtype=jnp.int32), (2, 12))
+    want = paged_attention(q, kp, vp, table, pos[:, None], kv_pos,
+                           causal=True, impl="xla")
+    got = paged_decode_attention(q, kp, vp, table, pos, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), **TOL)
+
+
+def test_kernel_never_reads_the_trash_tail():
+    """Trash-block-0 convention: table tails point at block 0. The
+    kernel's clamp must confine DMA to live blocks — poison the trash
+    block with NaN and the output must stay finite and match the
+    oracle run on a clean pool. (The oracle itself is NOT given the
+    poison: its gather multiplies trash V cells by probability 0.0,
+    and 0 * NaN = NaN — the full-window read the kernel exists to
+    avoid.)"""
+    q, kp, vp, table, pos, mask, kv_pos = _mk(4)
+    want = _oracle(q, kp, vp, table, pos, mask, kv_pos)
+    kp_bad = jnp.asarray(np.asarray(kp)).at[0].set(np.nan)
+    vp_bad = jnp.asarray(np.asarray(vp)).at[0].set(np.nan)
+    got = paged_decode_attention(q, kp_bad, vp_bad, table, pos, mask,
+                                 interpret=True)
+    assert np.isfinite(np.asarray(got)).all()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), **TOL)
+
+
+# -- dispatcher doors -------------------------------------------------------
+
+
+def test_paged_attention_impl_dispatch_and_counters():
+    q, kp, vp, table, pos, mask, kv_pos = _mk(5)
+    base = impl_counts()
+    want = _oracle(q, kp, vp, table, pos, mask, kv_pos)
+    got = paged_attention(q, kp, vp, table, pos[:, None], kv_pos,
+                          causal=True, kv_mask=mask, impl="pallas",
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), **TOL)
+    now = impl_counts()
+    assert now["paged_pallas"] == base["paged_pallas"] + 1
+    assert now["paged_xla"] == base["paged_xla"] + 1  # the oracle call
+
+
+def test_resolve_impl():
+    assert resolve_paged_attention_impl("xla") == "xla"
+    assert resolve_paged_attention_impl("pallas") == "pallas"
+    # conftest pins the CPU backend, so auto must gather
+    assert resolve_paged_attention_impl("auto") == "xla"
+    with pytest.raises(ValueError, match="impl"):
+        resolve_paged_attention_impl("cuda")
+
+
+def test_dispatcher_validation_doors():
+    q, kp, vp, table, pos, mask, kv_pos = _mk(6)
+    with pytest.raises(ValueError, match="causal-only"):
+        paged_attention(q, kp, vp, table, pos[:, None], kv_pos,
+                        causal=False, impl="pallas", interpret=True)
+    # geometry mismatches raise with the actual numbers, not an opaque
+    # jit gather/reshape error
+    with pytest.raises(ValueError, match="kv_positions"):
+        paged_attention(q, kp, vp, table, pos[:, None],
+                        kv_pos[:, :-8], causal=True)
+    with pytest.raises(ValueError, match="kv_mask"):
+        paged_attention(q, kp, vp, table, pos[:, None], kv_pos,
+                        causal=True, kv_mask=mask[:, :-8])
+    with pytest.raises(ValueError, match="disagree"):
+        paged_attention(q, kp, vp[:-1], table, pos[:, None], kv_pos,
+                        causal=True)
+    with pytest.raises(ValueError, match="block_table"):
+        paged_attention(q, kp, vp, table[0], pos[:, None], kv_pos,
+                        causal=True)
+
+
+def test_kernel_validation_doors():
+    q, kp, vp, table, pos, mask, _ = _mk(6)
+    with pytest.raises(ValueError, match="s=1"):
+        paged_decode_attention(jnp.concatenate([q, q], axis=1), kp, vp,
+                               table, pos, interpret=True)
+    with pytest.raises(ValueError, match="q_positions"):
+        paged_decode_attention(q, kp, vp, table, pos[:, None],
+                               interpret=True)
+    with pytest.raises(ValueError, match="kv_mask"):
+        paged_decode_attention(q, kp, vp, table, pos,
+                               mask[:, :-1], interpret=True)
+    with pytest.raises(ValueError, match="grouped"):
+        paged_decode_attention(q[:, :, :3], kp, vp, table, pos,
+                               interpret=True)
+
+
+# -- engine construction geometry ------------------------------------------
+
+
+def _llama_engine(max_len=32):
+    cfg = llama.LLAMA_TINY
+    params = dict(llama.init(jax.random.key(0), cfg))
+    params["lm_head"] = params["lm_head"] * 50.0  # argmax can't flip
+    return InferenceEngine(params, cfg, LLAMA_FAMILY,
+                           EngineConfig(max_len=max_len)), cfg
+
+
+def test_engine_rejects_mismatched_pool_geometry():
+    engine, _ = _llama_engine()
+    # matching pool: accepted and adopted
+    pool = BlockPool(9, 8)
+    ce = ContinuousEngine(engine, max_slots=2, block_size=8,
+                          num_blocks=9, pool=pool)
+    assert ce.pool is pool
+    # wrong block_size: the table/mask layout would disagree with the
+    # pool shape — must fail HERE, not deep inside jit
+    with pytest.raises(ValueError, match="block_size=16"):
+        ContinuousEngine(engine, max_slots=2, block_size=8,
+                         num_blocks=9, pool=BlockPool(9, 16))
+    with pytest.raises(ValueError, match="num_blocks=32"):
+        ContinuousEngine(engine, max_slots=2, block_size=8,
+                         num_blocks=9, pool=BlockPool(32, 8))
+
+
+def test_engine_rejects_bad_impl_name():
+    engine, _ = _llama_engine()
+    with pytest.raises(ValueError, match="impl"):
+        ContinuousEngine(engine, max_slots=2, paged_attention_impl="tpu")
+    ce = ContinuousEngine(engine, max_slots=2,
+                          paged_attention_impl="auto")
+    assert ce.attention_impl == "xla"  # CPU backend resolves to gather
+
+
+def test_server_exports_attention_impl_and_wires_tracer():
+    """The observability contract: the app publishes which impl decode
+    resolved to (info gauge) and hands the batcher its tracer so
+    decode chunks become `decode.attention` spans."""
+    from kubeflow_tpu.serving.server import (
+        BATCHERS_KEY,
+        OBS_KEY,
+        create_serving_app,
+    )
+
+    engine, _ = _llama_engine()
+    app = create_serving_app({"m": engine}, continuous=True,
+                             kv_block_size=8)
+    sobs = app[OBS_KEY]
+    b = app[BATCHERS_KEY]["m"]
+    assert b.tracer is sobs.tracer
+    assert b.cengine.attention_impl == "xla"  # CPU auto-resolution
+    text = sobs.registry.render()
+    assert 'serving_attention_impl{impl="xla",model="m"} 1' in text
+    # the knob is continuous-only, like the rest of the paged config
+    with pytest.raises(ValueError, match="paged_attention_impl"):
+        create_serving_app({"m": engine},
+                           paged_attention_impl="pallas")
+
+
+# -- continuous engine end-to-end token parity ------------------------------
+
+
+def _decode_all(engine, prompts, max_new, impl, tracer=None):
+    async def run():
+        b = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2,
+                              kv_block_size=8,
+                              paged_attention_impl=impl)
+        assert b.cengine.attention_impl == impl
+        b.tracer = tracer
+        out = await asyncio.gather(
+            *(b.submit(p, max_new, ()) for p in prompts))
+        await b.close()
+        return [list(o) for o in out]
+
+    return asyncio.get_event_loop().run_until_complete(run())
+
+
+@pytest.mark.slow
+def test_continuous_token_parity_llama():
+    from kubeflow_tpu import obs
+
+    engine, cfg = _llama_engine()
+    gen = np.random.default_rng(5)
+    prompts = [gen.integers(0, cfg.vocab_size, n).tolist()
+               for n in (9, 5)]
+    tracer = obs.Tracer()
+    xla = _decode_all(engine, prompts, 5, "xla", tracer=tracer)
+    pallas = _decode_all(engine, prompts, 5, "pallas", tracer=tracer)
+    assert xla == pallas
+    # every decode chunk became a span tagged with the impl that ran it
+    impls = {s["attrs"]["impl"]
+             for t in tracer.traces("decode.attention")
+             for s in t["spans"] if s["name"] == "decode.attention"}
+    assert impls == {"xla", "pallas"}
+
+
+@pytest.mark.slow
+def test_continuous_token_parity_gemma():
+    # gemma exercises the other family: 8q/1kv GQA and the
+    # sliding-window-capable attention plumbing
+    cfg = gemma.GEMMA_TINY
+    engine = InferenceEngine(
+        gemma.init(jax.random.key(1), cfg), cfg, GEMMA_FAMILY,
+        EngineConfig(max_len=32))
+    gen = np.random.default_rng(9)
+    prompts = [gen.integers(0, cfg.vocab_size, n).tolist()
+               for n in (7, 11)]
+    xla = _decode_all(engine, prompts, 5, "xla")
+    pallas = _decode_all(engine, prompts, 5, "pallas")
+    assert xla == pallas
