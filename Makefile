@@ -1,7 +1,7 @@
 # Developer entry points (the reference drives everything through
 # per-component Makefiles; here one root Makefile covers the repo).
 
-.PHONY: test test-slow test-all e2e smoke conformance bench dryrun native verify-all obs-check serving-check fleet-check kernels-check
+.PHONY: test test-slow test-all e2e smoke conformance bench dryrun native verify-all obs-check serving-check fleet-check kernels-check tenancy-check
 
 verify-all:  ## the full evidence sweep, one command
 	python -m pytest tests -q -m "slow or not slow"
@@ -54,6 +54,12 @@ fleet-check: ## fleet router gate: unit suite + 2-replica routed loadtest
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q
 	JAX_PLATFORMS=cpu python loadtest/serving_loadtest.py --mode fleet \
 	  --fleet-replicas 2 --clients 4 --requests 12 --max-new 8
+
+tenancy-check: ## multi-tenant QoS gate: unit suite + noisy-neighbor A/B loadtest
+	JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q \
+	  -m "slow or not slow"
+	JAX_PLATFORMS=cpu python loadtest/serving_loadtest.py --mode tenants \
+	  --tenant-bulk-clients 4 --tenant-live-requests 6
 
 bench:       ## perf sweep on the local device (CPU falls back safely)
 	python bench.py

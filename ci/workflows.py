@@ -41,7 +41,7 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         "tests": "python -m pytest tests/test_web.py tests/test_cli.py -q",
     },
     "serving": {
-        "paths": ["kubeflow_tpu/serving/**"],
+        "paths": ["kubeflow_tpu/serving/**", "kubeflow_tpu/tenancy/**"],
         "tests": ("python -m pytest tests/test_serving.py "
                   "tests/test_speculative.py tests/test_quant.py "
                   "tests/test_continuous.py tests/test_multilora.py "
@@ -425,6 +425,41 @@ def fleet_check_workflow() -> dict:
     }
 
 
+def tenancy_check_workflow() -> dict:
+    """Multi-tenant QoS gate: `make tenancy-check` runs the tenancy
+    unit suite (fair-share math, preemption token-identity, prefix
+    isolation, header plumbing) AND the noisy-neighbor A/B loadtest,
+    so the interactive-TTFT-under-batch-flood claim is re-proven on
+    every scheduler or serving change — not measured once in a perf
+    note and left to rot."""
+    return {
+        "name": "tenancy check",
+        "on": {
+            "pull_request": {"paths": ["kubeflow_tpu/tenancy/**",
+                                       "kubeflow_tpu/serving/**",
+                                       "kubeflow_tpu/fleet/**",
+                                       "loadtest/serving_loadtest.py",
+                                       "tests/test_tenancy.py",
+                                       "Makefile"]},
+            "push": {"branches": ["main"]},
+        },
+        "jobs": {
+            "tenancy-check": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e .[ci] pytest"},
+                    {"name": "QoS unit + noisy-neighbor A/B gate",
+                     "run": "make tenancy-check",
+                     "env": {"JAX_PLATFORMS": "cpu"}},
+                ],
+            }
+        },
+    }
+
+
 def kernels_check_workflow() -> dict:
     """Pallas kernel gate: `make kernels-check` runs all three kernel
     suites (flash, fused decode, fused paged decode) in interpret mode
@@ -478,6 +513,7 @@ def all_workflows() -> dict[str, dict]:
     out["slow_tier_test.yaml"] = slow_tier_workflow()
     out["serving_check.yaml"] = serving_check_workflow()
     out["fleet_check.yaml"] = fleet_check_workflow()
+    out["tenancy_check.yaml"] = tenancy_check_workflow()
     out["kernels_check.yaml"] = kernels_check_workflow()
     out["frontend_test.yaml"] = frontend_workflow()
     out.update(cd.all_workflows())

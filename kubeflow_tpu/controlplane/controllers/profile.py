@@ -287,6 +287,23 @@ class ProfileController(Controller):
             store.update(profile)
             return Result()  # re-enqueued by our own MODIFIED event
 
+        # Serving-QoS bridge: the `kubeflow-tpu.dev/serving-tenant`
+        # annotation becomes a data-plane tenant spec
+        # (tenancy.config_from_profiles), so a malformed one must fail
+        # HERE at reconcile time — not later inside a serving process
+        # that loads tenant configs from Profiles.
+        from kubeflow_tpu.tenancy import tenant_from_profile
+
+        try:
+            tenant_from_profile(profile)
+        except ValueError as e:
+            fresh = store.try_get("Profile", "", name)
+            if fresh is not None and fresh.status.message != str(e):
+                fresh.status.phase = "Failed"
+                fresh.status.message = str(e)
+                store.update(fresh)
+            return Result()
+
         if not self._ensure_namespace(store, profile):
             return Result()  # ownership conflict surfaced in status
         self._ensure_service_accounts(store, profile)

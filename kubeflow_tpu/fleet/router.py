@@ -30,6 +30,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import math
 import time
 
 import aiohttp
@@ -38,6 +39,7 @@ from aiohttp import web
 from kubeflow_tpu import obs as obs_lib
 from kubeflow_tpu.fleet import autoscale
 from kubeflow_tpu.fleet.registry import ReplicaRegistry
+from kubeflow_tpu.tenancy import TenancyConfig, TenantLedger, Throttled
 
 log = logging.getLogger(__name__)
 
@@ -103,6 +105,20 @@ class FleetObs:
             "fleet_replicas",
             "Registered replicas by health state "
             "(ready/degraded/draining/dead)", self.registry)
+        # Per-tenant routing accounting (X-Tenant header). With a
+        # tenancy config, names resolve through it (bounded by
+        # configuration); without one, raw header values pass the
+        # cardinality guard so scanners can't mint unbounded series.
+        self.tenant_requests = Counter(
+            "fleet_tenant_requests_total",
+            "Routed generate requests by tenant (X-Tenant header)",
+            self.registry)
+        self.tenant_throttled = Counter(
+            "fleet_tenant_throttled_total",
+            "Requests 429'd at the router door by the tenant's "
+            "request bucket, before any replica dispatch",
+            self.registry)
+        self.tenant_guard = obs_lib.LabelGuard()
         # zero-seed so the series exist (at 0) before any traffic
         for reason in ROUTE_REASONS:
             self.route_total.inc(0, reason=reason)
@@ -119,7 +135,8 @@ class FleetObs:
 class _FleetState:
     def __init__(self, registry: ReplicaRegistry, obs: FleetObs, *,
                  block_size: int, policy: str, hedge_after_s: float,
-                 retries: int, backoff_s: float, timeout_s: float):
+                 retries: int, backoff_s: float, timeout_s: float,
+                 tenancy: TenancyConfig | None = None):
         self.registry = registry
         self.obs = obs
         self.block_size = block_size
@@ -130,6 +147,13 @@ class _FleetState:
         self.timeout_s = timeout_s
         self.session: aiohttp.ClientSession | None = None
         self.rr = 0  # round-robin cursor (policy="roundrobin" A/B arm)
+        # Router-side tenant rate limiting: the same TenancyConfig the
+        # replicas run, enforced at the fleet door so a flooding tenant
+        # is shed ONCE here instead of N times downstream. The replicas
+        # keep their own ledgers (per-replica limits still apply).
+        self.tenancy = tenancy
+        self.ledger = TenantLedger(tenancy) if tenancy is not None \
+            else None
 
 
 class _UpstreamError(RuntimeError):
@@ -174,7 +198,7 @@ def _choose(st: _FleetState, key: bytes, exclude: set):
 
 
 async def _call_replica(st: _FleetState, rep, name: str, raw: bytes,
-                        tried: set):
+                        tried: set, headers: dict):
     """One proxied generate against one replica. Success returns
     (status, payload, replica, upstream_trace_id); replica-side
     failures mark the replica, add it to `tried`, and raise
@@ -183,7 +207,7 @@ async def _call_replica(st: _FleetState, rep, name: str, raw: bytes,
     try:
         async with st.session.post(
                 f"{rep.url}/v1/models/{name}:generate", data=raw,
-                headers={"Content-Type": "application/json"},
+                headers=headers,
                 timeout=aiohttp.ClientTimeout(total=st.timeout_s)) as r:
             payload = await r.read()
             if r.status >= 500:
@@ -201,13 +225,14 @@ async def _call_replica(st: _FleetState, rep, name: str, raw: bytes,
 
 
 async def _race_hedged(st: _FleetState, primary, name: str, raw: bytes,
-                       key: bytes, tried: set, model: str):
+                       key: bytes, tried: set, model: str,
+                       headers: dict):
     """Dispatch to `primary`; past the hedge deadline, duplicate to a
     second replica and take whichever answers first. Returns
     (status, payload, replica, hedge_won, upstream_trace) or None when
     every dispatched replica failed (all are in `tried` by then)."""
     tasks = {asyncio.create_task(_call_replica(st, primary, name, raw,
-                                               tried))}
+                                               tried, headers))}
     hedged_id = None
     if st.hedge_after_s > 0:
         done, _pending = await asyncio.wait(tasks,
@@ -218,7 +243,7 @@ async def _race_hedged(st: _FleetState, primary, name: str, raw: bytes,
                 hedged_id = hedge_rep.id
                 st.obs.route_total.inc(reason="hedge")
                 tasks.add(asyncio.create_task(_call_replica(
-                    st, hedge_rep, name, raw, tried)))
+                    st, hedge_rep, name, raw, tried, headers)))
     winner = None
     pending = tasks
     while pending:
@@ -243,6 +268,34 @@ async def _race_hedged(st: _FleetState, primary, name: str, raw: bytes,
     return status, payload, rep, hedge_won, trace
 
 
+def _tenant_gate(st: _FleetState, request: web.Request):
+    """Tenant admission at the fleet door. Returns (forward_headers,
+    None) when admitted, or (None, 429 response) when the tenant's
+    request bucket is empty. Always forwards X-Tenant so the replica's
+    own ledger/scheduler sees the same identity the router billed."""
+    headers = {"Content-Type": "application/json"}
+    tenant_hdr = request.headers.get("X-Tenant", "")
+    if tenant_hdr:
+        headers["X-Tenant"] = tenant_hdr
+    if st.ledger is not None:
+        tname = st.tenancy.resolve(tenant_hdr).name
+        try:
+            st.ledger.check_request(tname)
+        except Throttled as e:
+            st.obs.tenant_throttled.inc(tenant=tname)
+            return None, web.json_response(
+                {"error": str(e)}, status=429,
+                headers={"Retry-After": str(max(1, min(
+                    60, math.ceil(e.retry_after))))})
+        st.obs.tenant_requests.inc(tenant=tname)
+    elif tenant_hdr:
+        # tenant-blind router still counts per tenant, behind the
+        # cardinality guard (the header is raw client input here)
+        st.obs.tenant_requests.inc(
+            tenant=st.obs.tenant_guard.admit(tenant_hdr))
+    return headers, None
+
+
 async def _routed_generate(request: web.Request):
     st: _FleetState = request.app[FLEET_KEY]
     name = request.match_info["name"]
@@ -251,8 +304,12 @@ async def _routed_generate(request: web.Request):
         body = json.loads(raw)
     except Exception:
         return web.json_response({"error": "invalid JSON"}, status=400)
+    fwd_headers, throttled = _tenant_gate(st, request)
+    if throttled is not None:
+        return throttled
     if isinstance(body, dict) and body.get("stream"):
-        return await _routed_stream(request, st, name, raw, body)
+        return await _routed_stream(request, st, name, raw, body,
+                                    fwd_headers)
     key = affinity_key(body, st.block_size)
     t0 = time.perf_counter()
     tried: set[str] = set()
@@ -266,7 +323,7 @@ async def _routed_generate(request: web.Request):
                 await asyncio.sleep(
                     min(st.backoff_s * (2 ** (attempt - 1)), 1.0))
             result = await _race_hedged(st, replica, name, raw, key,
-                                        tried, name)
+                                        tried, name, fwd_headers)
             if result is None:
                 continue  # dispatched replicas failed; retry others
             status, payload, rep, hedge_won, trace = result
@@ -291,7 +348,8 @@ async def _routed_generate(request: web.Request):
 
 
 async def _routed_stream(request: web.Request, st: _FleetState,
-                         name: str, raw: bytes, body: dict):
+                         name: str, raw: bytes, body: dict,
+                         fwd_headers: dict):
     """SSE passthrough: affinity-routed, retried only BEFORE the first
     upstream byte (once headers are out a failure is the client's to
     see — same contract as the replicas' own mid-stream errors). No
@@ -312,7 +370,7 @@ async def _routed_stream(request: web.Request, st: _FleetState,
         try:
             async with st.session.post(
                     f"{replica.url}/v1/models/{name}:generate", data=raw,
-                    headers={"Content-Type": "application/json"},
+                    headers=fwd_headers,
                     timeout=aiohttp.ClientTimeout(
                         total=st.timeout_s)) as up:
                 if up.status >= 500:
@@ -525,6 +583,7 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
                       backoff_s: float = 0.05,
                       request_timeout_s: float = 300.0,
                       metrics_registry=None, tracer=None,
+                      tenancy: TenancyConfig | None = None,
                       ) -> web.Application:
     """Build the router app. `block_size` must match the replicas'
     `kv_block_size` (the affinity key is the first block — a mismatch
@@ -532,16 +591,27 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
     or "roundrobin" (the A/B control arm). `hedge_after_s <= 0`
     disables hedging. `metrics_registry`/`tracer` share external obs
     instances; by default the app owns fresh ones at `/metrics` and
-    `/debug/traces`."""
+    `/debug/traces`. `tenancy` enables router-side tenant rate
+    limiting (`tenancy.TenancyConfig`, normally the same file the
+    replicas load): a tenant over its requests/s bucket is 429'd at
+    the fleet door before any replica dispatch. With or without it,
+    the X-Tenant header is forwarded to replicas verbatim."""
     if policy not in ("affinity", "roundrobin"):
         raise ValueError(f"unknown policy {policy!r}")
     if block_size < 1:
         raise ValueError(f"block_size must be >= 1, got {block_size}")
     reg = registry if registry is not None else ReplicaRegistry()
     obs = FleetObs(reg, registry=metrics_registry, tracer=tracer)
+    if tenancy is not None:
+        # zero-seed the per-tenant series for every configured name
+        for _t in tenancy.names():
+            obs.tenant_guard.admit(_t)
+            obs.tenant_requests.inc(0, tenant=_t)
+            obs.tenant_throttled.inc(0, tenant=_t)
     st = _FleetState(reg, obs, block_size=block_size, policy=policy,
                      hedge_after_s=hedge_after_s, retries=retries,
-                     backoff_s=backoff_s, timeout_s=request_timeout_s)
+                     backoff_s=backoff_s, timeout_s=request_timeout_s,
+                     tenancy=tenancy)
     app = web.Application(middlewares=[_router_obs_middleware])
     app[FLEET_KEY] = st
 

@@ -21,6 +21,7 @@ imports lazily instead.
 
 from __future__ import annotations
 
+from kubeflow_tpu.obs.cardinality import OVERFLOW_LABEL, LabelGuard
 from kubeflow_tpu.obs.metrics import (
     LATENCY_BUCKETS,
     SIZE_BUCKETS,
@@ -40,6 +41,8 @@ __all__ = [
     "SIZE_BUCKETS",
     "TOKEN_BUCKETS",
     "Histogram",
+    "LabelGuard",
+    "OVERFLOW_LABEL",
     "Span",
     "Tracer",
     "DEFAULT_TRACER",

@@ -1,0 +1,54 @@
+"""Label-cardinality guard for the metrics layer.
+
+Per-tenant labels are bounded by tenant CONFIG on the serving side
+(unknown identities resolve to `default` before they reach a metric),
+but anywhere a raw client-supplied value becomes a label — the router's
+per-tenant counters, span attributes echoing an `X-Tenant` header — an
+attacker sending a fresh value per request would mint a fresh
+timeseries per request. `LabelGuard` caps the distinct values a label
+may take: known (seeded) values pass through, novel values pass until
+the cap, and everything past the cap collapses into one overflow
+bucket (`other`)."""
+
+from __future__ import annotations
+
+import threading
+
+OVERFLOW_LABEL = "other"
+
+
+class LabelGuard:
+    """Bounded admission of label values. Thread-safe: counters are
+    bumped from handler threads and rendered from scrape time."""
+
+    def __init__(self, max_values: int = 32,
+                 overflow: str = OVERFLOW_LABEL, seed=()):
+        if max_values < 1:
+            raise ValueError(f"max_values must be >= 1, got {max_values}")
+        self.max_values = int(max_values)
+        self.overflow = overflow
+        self._lock = threading.Lock()
+        self._values: set[str] = set()
+        self.overflowed = 0  # values that hit the cap, cumulative
+        for v in seed:
+            self.admit(v)
+
+    def admit(self, value: str) -> str:
+        """The label value to actually use for `value`: itself while
+        under the cap, the overflow bucket after. The overflow bucket
+        itself never counts against the cap."""
+        value = value or self.overflow
+        if value == self.overflow:
+            return self.overflow
+        with self._lock:
+            if value in self._values:
+                return value
+            if len(self._values) < self.max_values:
+                self._values.add(value)
+                return value
+            self.overflowed += 1
+            return self.overflow
+
+    def known(self) -> set[str]:
+        with self._lock:
+            return set(self._values)

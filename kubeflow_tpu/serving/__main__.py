@@ -116,6 +116,12 @@ def main(argv=None) -> int:
     p.add_argument("--drain-grace-s", type=float, default=30.0,
                    help="shutdown waits this long for in-flight "
                         "generations before closing")
+    p.add_argument("--tenants", default="",
+                   help="tenancy config JSON file (continuous only): "
+                        "per-tenant weights, priorities, rate limits, "
+                        "KV shares — see kubeflow_tpu.tenancy. "
+                        "Requests select a tenant with the X-Tenant "
+                        "header; absent/unknown maps to 'default'")
     p.add_argument("--fleet-router", default="",
                    help="fleet router base URL; the replica registers "
                         "and heartbeats there (kubeflow_tpu.fleet)")
@@ -132,6 +138,11 @@ def main(argv=None) -> int:
         p.error("--warmup requires --continuous")
     if args.paged_attention_impl != "auto" and not args.continuous:
         p.error("--paged-attention-impl requires --continuous")
+    if args.tenants and not args.continuous:
+        # the QoS scheduler replaces the CONTINUOUS batcher's queue;
+        # silently ignoring the file would serve without the quotas
+        # the operator configured
+        p.error("--tenants requires --continuous")
     if args.advertise and not args.fleet_router:
         p.error("--advertise requires --fleet-router")
 
@@ -179,6 +190,11 @@ def main(argv=None) -> int:
         # live on gs:// — same reasoning as train/checkpoint.py's
         # data-state probe.
         tokenizer = Tokenizer.loads(epath.Path(tok_ref).read_text())
+    tenancy = None
+    if args.tenants:
+        from kubeflow_tpu.tenancy import load_config
+
+        tenancy = load_config(args.tenants)
     app = create_serving_app(
         {args.name or args.model: engine},
         tokenizer=tokenizer,
@@ -190,6 +206,7 @@ def main(argv=None) -> int:
         pipeline_depth=args.pipeline_depth or None,
         paged_attention_impl=args.paged_attention_impl,
         drain_grace_s=args.drain_grace_s,
+        tenancy=tenancy,
     )
     if args.fleet_router:
         enable_fleet_registration(

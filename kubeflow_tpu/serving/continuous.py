@@ -70,6 +70,8 @@ from kubeflow_tpu.serving.engine import (
     transformer_block,
 )
 from kubeflow_tpu.serving.paged import BlockPool, RadixPrefixCache
+from kubeflow_tpu.tenancy.ledger import TenantLedger
+from kubeflow_tpu.tenancy.scheduler import FairShareQueue, ReqMeta
 
 
 def pow2_ceil(n: int) -> int:
@@ -668,7 +670,8 @@ class _Slot:
     """Host-side record for one admitted request."""
 
     __slots__ = ("fut", "out", "lps", "max_new", "queue", "stop",
-                 "kv_toks", "owned", "node_refs", "freed")
+                 "kv_toks", "owned", "node_refs", "freed",
+                 "meta", "sampling", "aid", "block_charge")
 
     def __init__(self, fut, max_new: int, queue, stop=()):
         self.fut = fut
@@ -677,6 +680,13 @@ class _Slot:
         self.max_new = max_new
         self.queue = queue  # per-request token stream (None for oneshot)
         self.stop = stop    # token-id sequences that end generation
+        # tenancy/preemption bookkeeping: the scheduling record, plus
+        # enough of the original request (sampling knobs, adapter id)
+        # to re-enqueue it if this decode gets preempted
+        self.meta: ReqMeta | None = None
+        self.sampling: dict | None = None
+        self.aid = 0
+        self.block_charge = 0  # pool blocks charged to the tenant ledger
         # paged-KV bookkeeping: the tokens whose KV this slot's blocks
         # hold (full prompt incl. any registered prefix, then every
         # emitted token UNTRIMMED — stop-sequence trimming edits `out`,
@@ -710,7 +720,8 @@ class ContinuousBatcher:
                  window_ms: float = 0.0,
                  kv_block_size: int = 64,
                  kv_pool_blocks: int | None = None,
-                 paged_attention_impl: str = "auto"):
+                 paged_attention_impl: str = "auto",
+                 tenancy=None):
         # window_ms accepted (and ignored) for constructor parity with
         # Batcher: admission is per-token here, there is no window.
         del window_ms
@@ -786,7 +797,25 @@ class ContinuousBatcher:
         self.calls = 0            # decode steps (device invocations)
         self.requests = 0         # admitted requests
         self.tokens_emitted = 0
-        self._pending: collections.deque = collections.deque()
+        # Multi-tenant QoS (kubeflow_tpu.tenancy): with a TenancyConfig
+        # the FIFO pending deque becomes a priority + weighted
+        # fair-share queue and a per-tenant ledger enforces rate limits
+        # and KV shares; interactive arrivals may PREEMPT the youngest
+        # batch-class decode (see _maybe_preempt). Tenant-blind
+        # deployments (tenancy=None) keep the exact FIFO deque.
+        self.tenancy = tenancy
+        self._ledger = (TenantLedger(tenancy)
+                        if tenancy is not None else None)
+        if tenancy is not None:
+            self._pending: Any = FairShareQueue(tenancy, self._ledger)
+        else:
+            self._pending = collections.deque()
+        self.preemptions = 0      # batch decodes evicted for interactive
+        self._interactive_blocked = False  # interactive plan deferred
+        self._seq = 0             # admission sequence (preempt youngest)
+        # EWMA of enqueue->finish service time, feeding the dynamic
+        # Retry-After on Overloaded 429s
+        self.service_ewma = 0.0
         # Backpressure: an unbounded admission queue turns overload
         # into unbounded client latency AND unbounded host memory;
         # past this depth _enqueue raises Overloaded (HTTP 429).
@@ -919,6 +948,16 @@ class ContinuousBatcher:
                 f"prompt {len(tokens)} + max_new {max_new} exceeds "
                 f"model max_len {cap}")
         sampling = dict(sampling)
+        # the tenant identity rides the sampling channel (like adapter
+        # and prefix do) but is popped back out — it is routing
+        # metadata, not a sampling knob
+        tenant = sampling.pop("tenant", "")
+        spec = (self.tenancy.resolve(tenant)
+                if self.tenancy is not None else None)
+        if self._ledger is not None:
+            # rate-limit door: raises tenancy.Throttled (HTTP 429 with
+            # the bucket's refill time) before anything is spent
+            self._ledger.check_request(spec.name)
         # multi-LoRA: the adapter name rides the sampling channel;
         # resolve (and reject unknowns) HERE, before a slot is spent
         adapter = sampling.get("adapter", "")
@@ -951,8 +990,18 @@ class ContinuousBatcher:
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._admitted += 1
         fut.add_done_callback(lambda _f: self._req_done())
+        meta = ReqMeta(
+            tenant=spec.name if spec is not None else "",
+            priority=spec.priority if spec is not None else "standard",
+            weight=spec.weight if spec is not None else 1.0,
+            cost=float(max_new),
+            t_enqueue=time.monotonic(),
+            seq=self._seq,
+            ns=(spec.name if spec is not None and spec.prefix_isolation
+                else ""))
+        self._seq += 1
         self._pending.append(
-            (tokens, max_new, sampling, fut, queue, aid, prefix))
+            (tokens, max_new, sampling, fut, queue, aid, prefix, meta))
         self._wake.set()
         return fut
 
@@ -992,6 +1041,9 @@ class ContinuousBatcher:
         if rec.freed:
             return
         rec.freed = True
+        if self._ledger is not None and rec.meta is not None:
+            self._ledger.note_slot_released(rec.meta.tenant,
+                                            rec.block_charge)
         if rec.node_refs:
             self._radix.unref(rec.node_refs)
             rec.node_refs = []
@@ -1016,8 +1068,9 @@ class ContinuousBatcher:
             return
         blocks = {i: rec.owned[i] for i in range(n_full)
                   if i in rec.owned}
-        adopted, _ = self._radix.insert(rec.kv_toks[:n_full * bs],
-                                        blocks)
+        adopted, _ = self._radix.insert(
+            rec.kv_toks[:n_full * bs], blocks,
+            ns=rec.meta.ns if rec.meta is not None else "")
         for i in adopted:
             del rec.owned[i]
 
@@ -1034,8 +1087,9 @@ class ContinuousBatcher:
             return
         blocks = {i: rec.owned[i] for i in range(n_full)
                   if i in rec.owned}
-        adopted, held = self._radix.insert(rec.kv_toks[:n_full * bs],
-                                           blocks, hold=True)
+        adopted, held = self._radix.insert(
+            rec.kv_toks[:n_full * bs], blocks, hold=True,
+            ns=rec.meta.ns if rec.meta is not None else "")
         for i in adopted:
             del rec.owned[i]
         rec.node_refs.extend(held)
@@ -1043,6 +1097,12 @@ class ContinuousBatcher:
     def _finish(self, slot: int, rec: _Slot) -> None:
         self._cache_blocks(rec)
         self._release(slot)
+        if rec.meta is not None:
+            dt = time.monotonic() - rec.meta.t_enqueue
+            self.service_ewma = (0.8 * self.service_ewma + 0.2 * dt
+                                 if self.service_ewma > 0 else dt)
+            if self._ledger is not None:
+                self._ledger.note_completed(rec.meta.tenant)
         if rec.queue is not None and not rec.fut.done():
             rec.queue.put_nowait(None)
         if not rec.fut.done():
@@ -1054,6 +1114,10 @@ class ContinuousBatcher:
         rec.out.append(token)
         rec.lps.append(lp)
         rec.kv_toks.append(token)  # cache-content log, never trimmed
+        if self._ledger is not None and rec.meta is not None:
+            # tokens/s pacing: generated tokens charge the bucket; a
+            # tenant in debt stops being popped until it refills
+            self._ledger.charge_tokens(rec.meta.tenant, 1)
         if decode:
             # admission-time first tokens (prefill) stay out of the
             # occupancy numerator — calls counts decode steps only
@@ -1096,6 +1160,73 @@ class ContinuousBatcher:
         # pending table resets with them (nothing left to reset)
         self._radix.clear()
         self._dirty.clear()
+
+    def _maybe_preempt(self) -> None:
+        """When an interactive request is waiting and can't admit —
+        every slot is busy, or its block plan just deferred — evict the
+        YOUNGEST batch-class decode. Its full KV blocks are donated to
+        the radix tree first, so re-admission replays the prefix from
+        cache and only recomputes the partial tail: the cheap
+        preemption the paged/radix layer was built to enable. One
+        victim per worker iteration keeps it bounded; the next
+        iteration preempts again if the pressure persists."""
+        if self._ledger is None:
+            return
+        blocked = self._interactive_blocked
+        self._interactive_blocked = False
+        if self._free and not blocked:
+            return
+        if not self._pending.has_waiting("interactive"):
+            return
+        victim, vseq = None, -1
+        for slot, rec in self._active.items():
+            m = rec.meta
+            if m is None or m.priority != "batch" or rec.fut.done():
+                continue
+            if m.seq > vseq:
+                victim, vseq = slot, m.seq
+        if victim is not None:
+            self._preempt(victim)
+
+    def _preempt(self, slot: int) -> None:
+        """Evict one active decode and re-enqueue it at the head of
+        its tenant's queue. The clean-retirement path minus resolving
+        the future: cache the full blocks, release the slot (its table
+        resets to trash before the next admission reuses the freed
+        blocks — same invariant as normal retirement, which is why the
+        worker preempts BEFORE the dirty-slot reset step). Replay is
+        token-identical under greedy decoding: the resumed prompt is
+        prompt + everything emitted so far, its prefix KV comes back
+        bit-exact from the cache, and the recomputed suffix produces
+        the same argmax continuation."""
+        rec = self._active[slot]
+        meta = rec.meta
+        self._cache_blocks(rec)
+        self._release(slot)
+        self.preemptions += 1
+        if self._ledger is not None:
+            self._ledger.note_preempted(meta.tenant)
+        meta.resume = {"out": list(rec.out), "lps": list(rec.lps),
+                       "max_new": rec.max_new}
+        # the re-enqueued item plans blocks with the REMAINING budget
+        # (full already holds the emitted tokens) and its fair-share
+        # cost drops to the remainder so the tenant isn't double-billed
+        remaining = max(1, rec.max_new - len(rec.out))
+        meta.cost = float(remaining)
+        self._pending.appendleft(
+            (list(rec.kv_toks), remaining, rec.sampling, rec.fut,
+             rec.queue, rec.aid, "", meta))
+        self._wake.set()
+
+    def tenant_stats(self) -> dict:
+        """Per-tenant live usage + queue depth ({} when tenant-blind)
+        — the `serving_tenant_*` collector and `/v1/models` read this."""
+        if self._ledger is None:
+            return {}
+        stats = self._ledger.stats()
+        for tenant, depth in self._pending.depths().items():
+            stats.setdefault(tenant, {})["queued"] = depth
+        return stats
 
     async def _get_prefix_state(self, name: str):
         """Lazily compute (once) a registered prefix's KV, memoized as
@@ -1140,7 +1271,7 @@ class ContinuousBatcher:
         its own fresh block, which is the copy-on-write), `fresh`
         (newly allocated blocks), `table` (the slot's physical block
         table, trash-padded)."""
-        tokens, max_new, _sampling, _fut, _queue, _aid, prefix = item
+        tokens, max_new, _sampling, _fut, _queue, _aid, prefix, meta = item
         ceng = self.cengine
         bs, mb = ceng.block_size, ceng.blocks_per_slot
         chain: list = []
@@ -1155,7 +1286,7 @@ class ContinuousBatcher:
         else:
             full = list(tokens)
             if self._st is not None:
-                nodes, pnode, plen = self._radix.match(full)
+                nodes, pnode, plen = self._radix.match(full, ns=meta.ns)
                 # always leave >= 1 token to prefill: sampling the
                 # first output needs a forward pass over something
                 m = min(len(nodes) * bs + plen, len(full) - 1)
@@ -1172,6 +1303,18 @@ class ContinuousBatcher:
         n_total = -(-min(len(full) + max_new,
                          self.engine.ec.max_len) // bs)
         n_fresh = n_total - len(chain)
+        if self._ledger is not None:
+            # per-tenant KV share: a tenant already holding blocks may
+            # not take the pool past its share — defer until its own
+            # retirements free some. A tenant holding NOTHING always
+            # admits (the share bounds CONCURRENT holdings; deferring a
+            # lone oversized request forever would just wedge it).
+            lim = self._ledger.block_limit(meta.tenant,
+                                           ceng.pool.capacity)
+            held = self._ledger.blocks_held(meta.tenant)
+            if lim is not None and held > 0 and held + n_fresh > lim:
+                self._ledger.note_throttled(meta.tenant, "kv_quota")
+                return None
         fresh = ceng.pool.alloc(n_fresh)
         if fresh is None:
             self._radix.evict(n_fresh - ceng.pool.num_free)
@@ -1216,6 +1359,11 @@ class ContinuousBatcher:
             plan = self._plan_blocks(item)
             if plan is None:
                 deferred.append(item)
+                if item[7].priority == "interactive":
+                    # an interactive request couldn't get blocks: let
+                    # the worker consider preempting a batch decode
+                    # even though free SLOTS exist
+                    self._interactive_blocked = True
             else:
                 plans.append((item, plan))
         for item in reversed(deferred):
@@ -1341,11 +1489,26 @@ class ContinuousBatcher:
                         f"slot state lost to donated insert: {e}"))
                 continue
             for slot, (row, (tokens, max_new, sampling, fut, queue,
-                             aid, _), plan) in zip(slots, admit):
+                             aid, _, meta), plan) in zip(slots, admit):
                 self.requests += 1
                 rec = _Slot(fut, max_new, queue,
                             stop=tuple(tuple(s) for s in
                                        sampling.get("stop", ())))
+                rec.meta = meta
+                rec.sampling = sampling
+                rec.aid = aid
+                if meta.resume is not None:
+                    # preemption replay: restore the already-emitted
+                    # tokens and the ORIGINAL budget (item max_new was
+                    # only the remainder, for block planning)
+                    rec.out = list(meta.resume["out"])
+                    rec.lps = list(meta.resume["lps"])
+                    rec.max_new = meta.resume["max_new"]
+                    meta.resume = None
+                if self._ledger is not None:
+                    rec.block_charge = len(plan["fresh"])
+                    self._ledger.note_slot_taken(meta.tenant,
+                                                 rec.block_charge)
                 rec.kv_toks = list(plan["full"])
                 rec.node_refs = list(plan["chain"])
                 cut = len(plan["chain"])
@@ -1462,6 +1625,12 @@ class ContinuousBatcher:
             if not self._active and not self._pending and not inflight:
                 self._wake.clear()
                 await self._wake.wait()
+            # Preemption runs BEFORE the dirty-slot reset so an evicted
+            # slot's table is trash-reset in this same iteration —
+            # admission below may hand its freed blocks to the
+            # interactive request that triggered the eviction.
+            if self._ledger is not None and self._pending:
+                self._maybe_preempt()
             # Reset retired slots' block tables to trash BEFORE any
             # admission can hand their freed blocks to a new request:
             # the reset rides the state-donation chain, so it lands
@@ -1488,10 +1657,23 @@ class ContinuousBatcher:
                 take: list = []
                 while self._pending and len(take) < len(self._free):
                     item = self._pending.popleft()
+                    if item is None:
+                        # fair-share queue: requests are waiting but
+                        # every queued tenant is token-paced
+                        break
                     if not item[3].done():
                         take.append(item)
                 if take:
                     await self._admit_group(take)
+                elif not self._active and not inflight and self._pending:
+                    # nothing to decode and nothing admittable (all
+                    # queued tenants paced): nap for the shortest
+                    # refill instead of spinning the loop hot
+                    delay = 0.05
+                    if self._ledger is not None:
+                        delay = min(max(
+                            self._pending.pacing_delay(), 0.001), 0.05)
+                    await asyncio.sleep(delay)
             try:
                 # drain whatever already finished, without blocking.
                 # INSIDE the try: an async-dispatched chunk that failed
@@ -1558,8 +1740,13 @@ class ContinuousBatcher:
                 rec.queue.put_nowait(None)
             if not rec.fut.done():
                 rec.fut.set_exception(RuntimeError("server shutting down"))
-        while self._pending:
-            _, _, _, fut, queue, _, _ = self._pending.popleft()
+        if self._ledger is not None:
+            leftovers = self._pending.drain_all()
+        else:
+            leftovers = list(self._pending)
+            self._pending.clear()
+        for item in leftovers:
+            fut, queue = item[3], item[4]
             if queue is not None and not fut.done():
                 queue.put_nowait(None)
             if not fut.done():

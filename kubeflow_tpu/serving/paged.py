@@ -100,10 +100,22 @@ class RadixPrefixCache:
         self.pool = pool
         self.block_size = pool.block_size
         self.root = _Node(None, None, None)
+        # Namespaced roots (tenant prefix isolation): ns "" is the
+        # shared default tree (`self.root`, kept as an attribute for
+        # back-compat); any other ns gets its own root on first use, so
+        # two namespaces can never match each other's entries — not
+        # even the timing side channel of a shared-prefix hit.
+        self._roots: dict[str, _Node] = {"": self.root}
         self._clock = 0
         self.cached_blocks = 0  # blocks currently owned by the tree
 
     # -- internals ---------------------------------------------------------
+
+    def _root_for(self, ns: str) -> _Node:
+        root = self._roots.get(ns)
+        if root is None:
+            root = self._roots[ns] = _Node(None, None, None)
+        return root
 
     def _tick(self) -> int:
         self._clock += 1
@@ -111,14 +123,16 @@ class RadixPrefixCache:
 
     def _touch(self, node: _Node) -> None:
         t = self._tick()
-        while node is not None and node is not self.root:
+        # roots (any namespace) are the only nodes with key None
+        while node is not None and node.key is not None:
             node.last_use = t
             node = node.parent
 
     # -- queries -----------------------------------------------------------
 
-    def match(self, tokens) -> tuple[list["_Node"], "_Node | None", int]:
-        """Longest cached prefix of `tokens`.
+    def match(self, tokens, *,
+              ns: str = "") -> tuple[list["_Node"], "_Node | None", int]:
+        """Longest cached prefix of `tokens` within namespace `ns`.
 
         Returns `(nodes, partial_node, partial_len)`: `nodes` are the
         fully-matched block edges in order; `partial_node` (if any) is a
@@ -128,7 +142,7 @@ class RadixPrefixCache:
         """
         bs = self.block_size
         nodes: list[_Node] = []
-        node = self.root
+        node = self._root_for(ns)
         i = 0
         while i + bs <= len(tokens):
             child = node.children.get(tuple(tokens[i : i + bs]))
@@ -169,21 +183,23 @@ class RadixPrefixCache:
 
     # -- growth ------------------------------------------------------------
 
-    def insert(self, tokens, blocks: dict[int, int], *, hold: bool = False):
+    def insert(self, tokens, blocks: dict[int, int], *,
+               hold: bool = False, ns: str = ""):
         """Index `tokens` (length must be a multiple of block_size) into
-        the tree. `blocks[i]` is the caller-owned physical block holding
-        tokens `[i*bs, (i+1)*bs)`; only consulted for edges that don't
-        exist yet. Returns `(adopted, held_nodes)` where `adopted` is
-        the set of block indices the tree took ownership of, and
-        `held_nodes` the nodes created with an initial ref for the
-        caller (only when `hold=True` — the caller's block table points
-        at those blocks, so they must not be evicted underneath it).
+        namespace `ns` of the tree. `blocks[i]` is the caller-owned
+        physical block holding tokens `[i*bs, (i+1)*bs)`; only consulted
+        for edges that don't exist yet. Returns `(adopted, held_nodes)`
+        where `adopted` is the set of block indices the tree took
+        ownership of, and `held_nodes` the nodes created with an initial
+        ref for the caller (only when `hold=True` — the caller's block
+        table points at those blocks, so they must not be evicted
+        underneath it).
         """
         bs = self.block_size
         assert len(tokens) % bs == 0, len(tokens)
         adopted: set[int] = set()
         held: list[_Node] = []
-        node = self.root
+        node = self._root_for(ns)
         for i in range(len(tokens) // bs):
             key = tuple(tokens[i * bs : (i + 1) * bs])
             child = node.children.get(key)
@@ -199,7 +215,7 @@ class RadixPrefixCache:
                     child.refs = 1
                     held.append(child)
             node = child
-        if node is not self.root:
+        if node.key is not None:
             self._touch(node)
         return adopted, held
 
@@ -212,11 +228,11 @@ class RadixPrefixCache:
         freed = 0
         while freed < need:
             victim = None
-            stack = [self.root]
+            stack = list(self._roots.values())  # evict across namespaces
             while stack:
                 n = stack.pop()
                 stack.extend(n.children.values())
-                if n is self.root or n.children or n.refs > 0:
+                if n.key is None or n.children or n.refs > 0:
                     continue
                 if victim is None or n.last_use < victim.last_use:
                     victim = n
@@ -236,12 +252,13 @@ class RadixPrefixCache:
         blocks describe content that no longer exists.
         """
         blocks = []
-        stack = list(self.root.children.values())
-        while stack:
-            n = stack.pop()
-            blocks.append(n.block)
-            stack.extend(n.children.values())
-        self.root.children.clear()
+        for root in self._roots.values():
+            stack = list(root.children.values())
+            while stack:
+                n = stack.pop()
+                blocks.append(n.block)
+                stack.extend(n.children.values())
+            root.children.clear()
         if blocks:
             self.pool.free(blocks)
         self.cached_blocks = 0

@@ -36,6 +36,7 @@ from kubeflow_tpu.serving.continuous import (
 )
 from kubeflow_tpu.serving.engine import InferenceEngine
 from kubeflow_tpu.serving.speculative import SpeculativeEngine
+from kubeflow_tpu.tenancy import THROTTLE_REASONS, TenancyConfig, Throttled
 
 BYTE_OFFSET = 3  # 0=pad, 1=bos, 2=eos
 BOS, EOS = 1, 2
@@ -71,6 +72,7 @@ SPEC_KEY: web.AppKey = web.AppKey("speculative", dict)
 OBS_KEY: web.AppKey = web.AppKey("obs", object)
 DRAIN_KEY: web.AppKey = web.AppKey("drain_state", dict)
 FLEET_REG_KEY: web.AppKey = web.AppKey("fleet_registration", dict)
+TENANCY_KEY: web.AppKey = web.AppKey("tenancy", object)  # TenancyConfig|None
 
 
 class ServingObs:
@@ -137,6 +139,30 @@ class ServingObs:
             "serving_attention_impl",
             "Resolved paged-attention impl per model (info gauge: "
             "value 1, impl in the label)", self.registry)
+        # Multi-tenant QoS series (continuous batcher with a tenancy
+        # config only — tenant-blind deployments register the families
+        # but emit no samples). Counters sync from the ledger's
+        # cumulative stats at scrape time; the gauge reads live depth.
+        self.tenant_queue_depth = Gauge(
+            "serving_tenant_queue_depth",
+            "Requests waiting in a tenant's admission sub-queue",
+            self.registry)
+        self.tenant_tokens = Counter(
+            "serving_tenant_tokens_total",
+            "Tokens generated per tenant and model", self.registry)
+        self.tenant_throttled = Counter(
+            "serving_tenant_throttled_total",
+            "Admissions shed or deferred per tenant by reason: rate "
+            "(request bucket empty, HTTP 429) or kv_quota (concurrent "
+            "KV-block share spent, request waits)", self.registry)
+        self.tenant_preemptions = Counter(
+            "serving_tenant_preemptions_total",
+            "Batch-class decodes evicted mid-generation to free a slot "
+            "for interactive work, per tenant", self.registry)
+        # X-Tenant is a raw client header: anywhere it becomes a label
+        # or span attribute it passes this guard, so a scanner minting
+        # fresh values cannot mint unbounded timeseries.
+        self.tenant_guard = obs_lib.LabelGuard()
 
 
 _OBS_T0 = "obs_request_start"
@@ -167,6 +193,10 @@ async def _obs_middleware(request: web.Request, handler):
     status = 500
     with sobs.tracer.span("http.request", method=request.method,
                           route=route) as span:
+        tenant_hdr = request.headers.get("X-Tenant")
+        if tenant_hdr:
+            # guarded: the attribute echoes a client-chosen value
+            span.attrs["tenant"] = sobs.tenant_guard.admit(tenant_hdr)
         try:
             resp = await handler(request)
             status = resp.status
@@ -377,6 +407,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                        drafts: dict[str, InferenceEngine] | None = None,
                        registry=None, tracer=None,
                        drain_grace_s: float = 30.0,
+                       tenancy: TenancyConfig | None = None,
                        ) -> web.Application:
     """`tokenizer` (data.bpe.Tokenizer or anything with encode/decode)
     serves the "text" request mode; without one, the zero-training
@@ -405,7 +436,14 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     share an external metric registry / span tracer; by default the app
     owns fresh ones, exposed at `/metrics` and `/debug/traces`.
     `drain_grace_s` bounds how long shutdown (and POST /drain via
-    cleanup) waits for in-flight generations before closing."""
+    cleanup) waits for in-flight generations before closing.
+    `tenancy` (continuous only) is a `tenancy.TenancyConfig`: requests
+    carry their tenant in the `X-Tenant` header (unknown/absent →
+    `default`), admission becomes priority + weighted fair-share with
+    per-tenant rate limits, KV-block shares, and batch-class
+    preemption, and `/metrics` grows zero-seeded `serving_tenant_*`
+    series. Without it the server is tenant-blind: FIFO admission,
+    identical to before."""
     app = web.Application(middlewares=[_obs_middleware])
     app[DRAIN_KEY] = {"draining": False, "grace_s": float(drain_grace_s)}
     sobs = ServingObs(registry=registry, tracer=tracer)
@@ -437,15 +475,18 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                            or pipeline_depth is not None
                            or kv_block_size != 64
                            or kv_pool_blocks is not None
-                           or paged_attention_impl != "auto"):
+                           or paged_attention_impl != "auto"
+                           or tenancy is not None):
         # these knobs only exist on the continuous batcher; silently
         # ignoring them would ship a server missing configuration the
         # caller explicitly asked for (max_pending especially: the
-        # caller believes overload sheds at that depth)
+        # caller believes overload sheds at that depth; tenancy
+        # especially: the caller believes quotas are enforced)
         raise ValueError(
             "warmup/prefill_chunk/prefixes/max_pending/pipeline_depth/"
-            "kv_block_size/kv_pool_blocks/paged_attention_impl "
+            "kv_block_size/kv_pool_blocks/paged_attention_impl/tenancy "
             "require continuous=True")
+    app[TENANCY_KEY] = tenancy
     if continuous:
         # prefill_chunk: long prompts admit in fixed slices — chunk-
         # multiple buckets, one [g, chunk] compile for every length.
@@ -459,7 +500,8 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                 pipeline_depth=pipeline_depth,
                 kv_block_size=kv_block_size,
                 kv_pool_blocks=kv_pool_blocks,
-                paged_attention_impl=paged_attention_impl)
+                paged_attention_impl=paged_attention_impl,
+                tenancy=tenancy)
             for name, eng in engines.items()}
         if warmup:
             async def _warm(app_):
@@ -510,6 +552,44 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                     sobs.kv_blocks.set(_b.kv_blocks_in_use(), model=_m)
 
         sobs.registry.register_collector(collect_kv_blocks)
+    if tenancy is not None:
+        # zero-seed the full per-tenant series set so dashboards see
+        # every configured tenant (at 0) from the first scrape, and
+        # pre-admit configured names into the label guard
+        for _t in tenancy.names():
+            sobs.tenant_guard.admit(_t)
+            for _m in app[BATCHERS_KEY]:
+                sobs.tenant_queue_depth.set(0, model=_m, tenant=_t)
+                sobs.tenant_tokens.inc(0, model=_m, tenant=_t)
+                sobs.tenant_preemptions.inc(0, model=_m, tenant=_t)
+                for _r in THROTTLE_REASONS:
+                    sobs.tenant_throttled.inc(
+                        0, model=_m, tenant=_t, reason=_r)
+
+        def _sync_counter(counter, total, **labels):
+            # the ledger keeps cumulative totals; a counter can only
+            # inc, so apply the delta since the last scrape
+            cur = counter.value(**labels)
+            if total > cur:
+                counter.inc(total - cur, **labels)
+
+        def collect_tenants():
+            for _m, _b in app[BATCHERS_KEY].items():
+                if not isinstance(_b, ContinuousBatcher):
+                    continue
+                for _t, s in _b.tenant_stats().items():
+                    _t = sobs.tenant_guard.admit(_t)
+                    sobs.tenant_queue_depth.set(
+                        s.get("queued", 0), model=_m, tenant=_t)
+                    _sync_counter(sobs.tenant_tokens, s["tokens"],
+                                  model=_m, tenant=_t)
+                    _sync_counter(sobs.tenant_preemptions,
+                                  s["preempted"], model=_m, tenant=_t)
+                    for _r, n in s["throttled"].items():
+                        _sync_counter(sobs.tenant_throttled, n,
+                                      model=_m, tenant=_t, reason=_r)
+
+        sobs.registry.register_collector(collect_tenants)
 
     async def _close_batchers(app_):
         # ISSUE 3 bugfix: shutdown used to close() straight away, which
@@ -661,6 +741,9 @@ async def list_models(request: web.Request):
                 entry["kv_block_size"] = batcher.cengine.block_size
                 entry["kv_pool_blocks"] = batcher.cengine.num_blocks
                 entry["prefix_cache"] = batcher.prefix_cache_stats()
+                tstats = batcher.tenant_stats()
+                if tstats:
+                    entry["tenants"] = tstats
                 if batcher._prefixes:
                     entry["prefixes"] = {
                         n: len(t) for n, t in batcher._prefixes.items()}
@@ -675,6 +758,27 @@ async def list_models(request: web.Request):
 # distinct tail-chunk programs per prompt shape (plus prefill + the
 # full chunk) — bounded, never one compile per max_new value.
 STREAM_CHUNK = 8
+
+# Retry-After ceiling: past this, a client should re-resolve (hit the
+# fleet router / another replica) rather than camp on one server.
+RETRY_AFTER_CAP_S = 60
+
+
+def _retry_after_s(batcher, exc) -> str:
+    """Dynamic Retry-After for a 429, replacing the old hardcoded "1".
+    Throttled carries the tenant bucket's actual refill time; for
+    Overloaded (queue full) estimate when the backlog clears: queue
+    depth x the recent per-request service time, spread over the slot
+    count. Clamped to [1, RETRY_AFTER_CAP_S] whole seconds."""
+    if isinstance(exc, Throttled):
+        est = exc.retry_after
+    else:
+        slots = max(1, len(batcher._free) + len(batcher._active))
+        # service_ewma is 0.0 until the first completion; fall back to
+        # a second per request — the old constant, now a floor
+        est = (len(batcher._pending) + 1) \
+            * (batcher.service_ewma or 1.0) / slots
+    return str(max(1, min(RETRY_AFTER_CAP_S, math.ceil(est))))
 
 
 async def _stream_generate(request, engine, arr, max_new, sampling,
@@ -772,10 +876,14 @@ async def _stream_continuous(request, batcher, arr, max_new, sampling,
         # a depth pre-check alone would race a concurrent admission
         fut, q = batcher.open_stream(
             arr[0].tolist(), max_new, tuple(sorted(sampling.items())))
+    except Throttled as e:
+        return web.json_response(
+            {"error": str(e)}, status=429,
+            headers={"Retry-After": _retry_after_s(batcher, e)})
     except Overloaded as e:
         return web.json_response(
             {"error": f"server overloaded: {e}"}, status=429,
-            headers={"Retry-After": "1"})
+            headers={"Retry-After": _retry_after_s(batcher, e)})
     sobs = request.app[OBS_KEY]
     model = request.match_info.get("name", "")
     headers = {
@@ -936,6 +1044,11 @@ async def generate(request: web.Request):
     if engine is None:
         return web.json_response(
             {"error": f"no model {name!r}"}, status=404)
+    # tenant identity is a HEADER, not a body field: proxies (the fleet
+    # router) forward it without parsing the payload, and a gateway can
+    # inject it from auth without rewriting bodies. Absent/unknown
+    # resolves to the `default` tenant inside the batcher.
+    tenant_hdr = request.headers.get("X-Tenant", "")
     try:
         body: dict[str, Any] = await request.json()
     except Exception:
@@ -1103,6 +1216,10 @@ async def generate(request: web.Request):
             # every other request instead of holding the GPU per chunk
             if adapter:
                 sampling["adapter"] = adapter
+            if tenant_hdr:
+                # rides the sampling channel like adapter/prefix; the
+                # batcher pops it back out before grouping
+                sampling["tenant"] = tenant_hdr
             return await _stream_continuous(
                 request, cbatcher, arr, max_new_req, sampling,
                 text_mode, tokenizer)
@@ -1195,6 +1312,11 @@ async def generate(request: web.Request):
         if adapter:
             sampling["adapter"] = adapter
         submit_sampling = dict(sampling)
+        if tenant_hdr and isinstance(batcher, ContinuousBatcher):
+            # NOT under the window Batcher: its sampling tuple is the
+            # coalescing group key, and a per-tenant key would split
+            # batches by identity for no scheduling benefit
+            submit_sampling["tenant"] = tenant_hdr
         if stop and isinstance(batcher, ContinuousBatcher):
             # the continuous batcher retires the slot the moment a
             # stop sequence completes (compute freed); the window
@@ -1216,10 +1338,14 @@ async def generate(request: web.Request):
                         arr[0].tolist(), max_new_req,
                         tuple(sorted(submit_sampling.items())))
                     lp_rows = None
+        except Throttled as e:
+            return web.json_response(
+                {"error": str(e)}, status=429,
+                headers={"Retry-After": _retry_after_s(batcher, e)})
         except Overloaded as e:
             return web.json_response(
                 {"error": f"server overloaded: {e}"}, status=429,
-                headers={"Retry-After": "1"})
+                headers={"Retry-After": _retry_after_s(batcher, e)})
         _observe_first_token(request, name)
         toks = np.asarray([ids], np.int32)
     else:

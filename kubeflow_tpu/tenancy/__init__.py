@@ -1,0 +1,52 @@
+"""Multi-tenant QoS for the serving data plane.
+
+The control plane already has Profile-style multi-tenancy with
+first-class TPU quota; this package carries that identity into the
+data plane: per-tenant rate limits and KV shares (`ledger`), a
+priority + weighted fair-share admission queue with preemption
+(`scheduler`), and tenant specs loadable from a file or bridged from
+Profile annotations (`config`).
+
+Pure host-side Python — no jax, no aiohttp — so the fleet router and
+the serving worker can both import it, and the math is unit-testable
+with a fake clock.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.tenancy.config import (
+    DEFAULT_TENANT,
+    PRIORITIES,
+    SERVING_TENANT_ANNOTATION,
+    TenancyConfig,
+    TenantSpec,
+    config_from_dict,
+    config_from_profiles,
+    load_config,
+    tenant_from_profile,
+)
+from kubeflow_tpu.tenancy.ledger import (
+    THROTTLE_REASONS,
+    TenantLedger,
+    Throttled,
+    TokenBucket,
+)
+from kubeflow_tpu.tenancy.scheduler import FairShareQueue, ReqMeta
+
+__all__ = [
+    "DEFAULT_TENANT",
+    "PRIORITIES",
+    "SERVING_TENANT_ANNOTATION",
+    "THROTTLE_REASONS",
+    "FairShareQueue",
+    "ReqMeta",
+    "TenancyConfig",
+    "TenantLedger",
+    "TenantSpec",
+    "Throttled",
+    "TokenBucket",
+    "config_from_dict",
+    "config_from_profiles",
+    "load_config",
+    "tenant_from_profile",
+]

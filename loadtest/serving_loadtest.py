@@ -24,6 +24,15 @@ prefix-cache deltas) and routing-reason counts, so affinity vs
 `--fleet-kill-one` proves retry/fallback completes every request when
 a replica dies mid-run.
 
+`--mode tenants` is the noisy-neighbor A/B for the multi-tenant QoS
+scheduler (kubeflow_tpu.tenancy): a batch-class tenant floods the
+server with long generations while an interactive tenant streams
+short ones and measures time-to-first-token. The run executes BOTH
+arms — fair-share + priority + preemption ON (tenancy configured)
+and OFF (tenant-blind FIFO) — against identical workloads and
+reports interactive TTFT percentiles side by side, plus the
+preemption/throughput evidence that batch work kept flowing.
+
 Hermetic by default (tiny model, CPU): the number is a CONTROL-PLANE
 number (batching, HTTP, queueing) — model throughput on hardware is
 bench.py's job.
@@ -104,6 +113,29 @@ app = srv.create_serving_app({{"tiny": eng}}, continuous=True, warmup=True,
 srv.enable_fleet_registration(app, {router!r},
                               "http://127.0.0.1:{port}",
                               replica_id="replica-{idx}", period_s=0.5)
+web.run_app(app, host="127.0.0.1", port={port}, print=None)
+'''
+
+
+TENANT_SERVER_CODE = r'''
+import os, sys
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+sys.path.insert(0, {repo!r})
+import jax; jax.config.update("jax_platforms", "cpu")
+from aiohttp import web
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.serving.engine import InferenceEngine, LLAMA_FAMILY, EngineConfig
+from kubeflow_tpu.serving import server as srv
+from kubeflow_tpu.tenancy import config_from_dict
+cfg = llama.LLAMA_TINY
+params = llama.init(jax.random.key(0), cfg)
+eng = InferenceEngine(params, cfg, LLAMA_FAMILY, EngineConfig(max_len=128))
+tenancy = config_from_dict({{"tenants": {{
+    "live": {{"priority": "interactive"}},
+    "bulk": {{"priority": "batch"}},
+}}}}) if {qos} else None
+app = srv.create_serving_app({{"tiny": eng}}, continuous=True, warmup=True,
+                             max_batch={max_batch}, tenancy=tenancy)
 web.run_app(app, host="127.0.0.1", port={port}, print=None)
 '''
 
@@ -322,6 +354,178 @@ def run_fleet(clients: int, requests: int, max_new: int, *,
                 p.wait()
 
 
+def _tenant_arm(qos: bool, *, bulk_clients: int, live_requests: int,
+                bulk_max_new: int, live_max_new: int,
+                max_batch: int) -> dict:
+    """One arm of the noisy-neighbor A/B: flood with batch-class work,
+    stream interactive requests through the backlog, measure TTFT."""
+    import tempfile
+    import threading
+
+    port = free_port()
+    base = f"http://127.0.0.1:{port}"
+    log = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".log", prefix="kftpu-tenload-", delete=False)
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         TENANT_SERVER_CODE.format(repo=REPO, port=port, qos=qos,
+                                   max_batch=max_batch)],
+        stdout=log, stderr=subprocess.STDOUT)
+
+    def post(body: dict, tenant: str, timeout: float = 180.0) -> dict:
+        req = urllib.request.Request(
+            f"{base}/v1/models/tiny:generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Tenant": tenant})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    try:
+        deadline = time.monotonic() + 180
+        ready = False
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                break
+            try:
+                urllib.request.urlopen(f"{base}/v1/models", timeout=2)
+                ready = True
+                break
+            except Exception:
+                time.sleep(0.5)
+        if not ready:
+            log.flush()
+            with open(log.name) as f:
+                tail = "\n".join(f.read().splitlines()[-20:])
+            raise RuntimeError(
+                f"server never came up (rc={proc.returncode}):\n{tail}")
+        # warm the admission-group shapes both workloads will hit
+        # (bulk-sized and live-sized), concurrently like run() does
+        with concurrent.futures.ThreadPoolExecutor(bulk_clients) as ex:
+            for _ in range(2):
+                list(ex.map(
+                    lambda i: post({"tokens": [[1, 2, 3, 4]],
+                                    "max_new": bulk_max_new}, "bulk"),
+                    range(bulk_clients)))
+        post({"tokens": [[1, 2, 3, 4]], "max_new": live_max_new}, "live")
+
+        stop = threading.Event()
+        bulk_done = [0]
+        bulk_429 = [0]
+        lock = threading.Lock()
+
+        def bulk_loop() -> None:
+            # the noisy neighbor: keep a long generation in flight per
+            # thread until the interactive phase is over
+            i = 0
+            while not stop.is_set():
+                i += 1
+                try:
+                    post({"tokens": [[5 + i % 7, 2, 3, 4]],
+                          "max_new": bulk_max_new}, "bulk")
+                    with lock:
+                        bulk_done[0] += 1
+                except urllib.error.HTTPError as e:
+                    if e.code != 429:
+                        raise
+                    with lock:
+                        bulk_429[0] += 1
+                    e.close()
+                    time.sleep(0.05)
+
+        threads = [threading.Thread(target=bulk_loop, daemon=True)
+                   for _ in range(bulk_clients)]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(1.5)  # let the backlog build before measuring
+
+        def live_ttft(i: int) -> float:
+            """One streamed interactive request; TTFT = first SSE
+            token event on the wire (the serving_ttft definition)."""
+            req = urllib.request.Request(
+                f"{base}/v1/models/tiny:generate",
+                data=json.dumps({"tokens": [[9 + i % 5, 8, 7, 6]],
+                                 "max_new": live_max_new,
+                                 "stream": True}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Tenant": "live"})
+            t0 = time.perf_counter()
+            ttft = None
+            with urllib.request.urlopen(req, timeout=180) as r:
+                for line in r:
+                    if line.startswith(b"data:") and ttft is None:
+                        ttft = time.perf_counter() - t0
+                    # drain to the terminal event so the slot retires
+            assert ttft is not None
+            return ttft
+
+        ttfts = []
+        for i in range(live_requests):
+            ttfts.append(live_ttft(i))
+            time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=180)
+        wall = time.perf_counter() - t_start
+
+        m = _get_json(f"{base}/v1/models")["models"][0]
+        tstats = m.get("tenants", {})
+        ttfts.sort()
+        q = statistics.quantiles(ttfts, n=20) if len(ttfts) >= 2 \
+            else list(ttfts) * 19
+        return {
+            "qos": qos,
+            "ttft_p50_s": round(q[9], 3),
+            "ttft_p95_s": round(q[18], 3),
+            "bulk_completed": bulk_done[0],
+            "bulk_throttled_429": bulk_429[0],
+            "bulk_tokens_per_sec": round(
+                bulk_done[0] * bulk_max_new / wall, 1),
+            "preemptions": tstats.get("bulk", {}).get("preempted", 0),
+        }
+    finally:
+        log.close()
+        os.unlink(log.name)
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+
+
+def run_tenants(*, bulk_clients: int = 6, live_requests: int = 8,
+                bulk_max_new: int = 32, live_max_new: int = 8,
+                max_batch: int = 4) -> dict:
+    """Noisy-neighbor A/B: identical flood + interactive workloads,
+    once with the QoS scheduler on and once tenant-blind. The headline
+    number is the interactive TTFT ratio — how much of the batch
+    tenant's backlog the interactive tenant no longer waits behind."""
+    on = _tenant_arm(True, bulk_clients=bulk_clients,
+                     live_requests=live_requests,
+                     bulk_max_new=bulk_max_new,
+                     live_max_new=live_max_new, max_batch=max_batch)
+    off = _tenant_arm(False, bulk_clients=bulk_clients,
+                      live_requests=live_requests,
+                      bulk_max_new=bulk_max_new,
+                      live_max_new=live_max_new, max_batch=max_batch)
+    return {
+        "metric": "serving_tenant_qos",
+        "mode": "tenants",
+        "bulk_clients": bulk_clients,
+        "live_requests": live_requests,
+        "bulk_max_new": bulk_max_new,
+        "live_max_new": live_max_new,
+        "max_batch": max_batch,
+        "qos_on": on,
+        "qos_off": off,
+        "ttft_p95_improvement": (
+            round(off["ttft_p95_s"] / on["ttft_p95_s"], 2)
+            if on["ttft_p95_s"] else 0.0),
+    }
+
+
 def run(clients: int, requests: int, max_new: int,
         window_ms: int, mode: str = "window",
         spread: bool = False, pipeline_depth: int = 0) -> dict:
@@ -477,8 +681,15 @@ def main() -> int:
     p.add_argument("--requests", type=int, default=96)
     p.add_argument("--max-new", type=int, default=16)
     p.add_argument("--batch-window-ms", type=int, default=5)
-    p.add_argument("--mode", choices=("window", "continuous", "fleet"),
+    p.add_argument("--mode",
+                   choices=("window", "continuous", "fleet", "tenants"),
                    default="window")
+    p.add_argument("--tenant-bulk-clients", type=int, default=6,
+                   help="tenants mode: concurrent batch-class flooder "
+                        "threads (the noisy neighbor)")
+    p.add_argument("--tenant-live-requests", type=int, default=8,
+                   help="tenants mode: sequential interactive streams "
+                        "measured for TTFT")
     p.add_argument("--fleet-replicas", type=int, default=2,
                    help="fleet mode: serving replicas behind the router")
     p.add_argument("--fleet-policy", choices=("affinity", "roundrobin"),
@@ -523,6 +734,14 @@ def main() -> int:
             block_size=args.fleet_block_size,
             kill_one=args.fleet_kill_one,
             hedge_after_s=args.fleet_hedge_after_s)
+    elif args.mode == "tenants":
+        if args.tenant_bulk_clients < 1:
+            p.error("--tenant-bulk-clients must be >= 1")
+        if args.tenant_live_requests < 2:
+            p.error("--tenant-live-requests must be >= 2 (quantiles)")
+        result = run_tenants(
+            bulk_clients=args.tenant_bulk_clients,
+            live_requests=args.tenant_live_requests)
     else:
         result = run(args.clients, args.requests, args.max_new,
                      args.batch_window_ms, args.mode, args.spread,
