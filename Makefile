@@ -1,7 +1,7 @@
 # Developer entry points (the reference drives everything through
 # per-component Makefiles; here one root Makefile covers the repo).
 
-.PHONY: test test-slow test-all e2e smoke conformance bench dryrun native verify-all obs-check serving-check fleet-check kernels-check tenancy-check
+.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check serving-check fleet-check kernels-check tenancy-check
 
 verify-all:  ## the full evidence sweep, one command
 	python -m pytest tests -q -m "slow or not slow"
@@ -59,10 +59,14 @@ tenancy-check: ## multi-tenant QoS gate: unit suite + noisy-neighbor A/B loadtes
 	JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q \
 	  -m "slow or not slow"
 	JAX_PLATFORMS=cpu python loadtest/serving_loadtest.py --mode tenants \
-	  --tenant-bulk-clients 4 --tenant-live-requests 6
+	  --tenant-bulk-clients 8 --tenant-live-requests 6
 
 bench:       ## perf sweep on the local device (CPU falls back safely)
 	python bench.py
+
+bench-gate:  ## perf sweep + regression compare vs ci/bench_baseline.json
+	python bench.py --json-out /tmp/bench_run.json
+	python -m ci.bench_gate /tmp/bench_run.json
 
 dryrun:      ## multi-chip sharding compile gate (8 virtual devices)
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

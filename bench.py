@@ -1026,6 +1026,12 @@ def _orchestrate(sweep: list[str], backend: str, full_sweep: bool,
     return _emit_result(headline, extras, backend)
 
 
+# Set by main() from --json-out; only the parent process sees the flag
+# (_run_section_child builds its own child argv), so the artifact file
+# is written exactly once, by whoever owns the whole sweep.
+_json_out_path: str | None = None
+
+
 def _emit_result(headline: dict | None, extras: list[dict],
                  backend: str) -> int:
     """Print the single-JSON-line artifact (shared by both paths, so
@@ -1036,7 +1042,13 @@ def _emit_result(headline: dict | None, extras: list[dict],
     result["backend"] = backend
     if extras:
         result["extra_metrics"] = extras
-    print(json.dumps(result))
+    line = json.dumps(result)
+    print(line)
+    if _json_out_path:
+        # same line, durably on disk — the machine-readable artifact
+        # ci/bench_gate.py compares against the committed baseline
+        with open(_json_out_path, "w") as f:
+            f.write(line + "\n")
     return 0
 
 
@@ -1048,7 +1060,13 @@ def main() -> int:
                         "decode-paged,decode-paged-kernel (default: "
                         "full sweep for the backend)")
     p.add_argument("--json-only", action="store_true")
+    p.add_argument("--json-out", default="",
+                   help="also write the sweep's single JSON artifact "
+                        "line to this path (the bench-gate input)")
     args = p.parse_args()
+    if args.json_out:
+        global _json_out_path
+        _json_out_path = args.json_out
 
     # Validate names BEFORE the backend probe: a typo must not cost
     # minutes of probe timeouts on a wedged host.

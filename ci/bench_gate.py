@@ -1,0 +1,133 @@
+"""Perf-regression gate over bench.py's JSON artifact.
+
+`make bench-gate` runs the sweep with `--json-out`, then compares the
+run against the committed baseline (`ci/bench_baseline.json`,
+regenerate with `--write-baseline` on a quiet machine):
+
+- throughput metrics (unit contains "/s", plus "ratio" — the prefix
+  cache hit rate) must not drop more than `--tolerance` below baseline;
+- latency metrics (unit "s"/"seconds") must not rise more than
+  `--tolerance` above it;
+- byte/token footprints are direction-free and informational only,
+  as are markers with unit "error" (a bench that failed to run fails
+  the RUN, not the compare — bench.py already printed why);
+- a metric present in the baseline but MISSING from the run fails
+  (a silently dropped benchmark is how regressions go unnoticed);
+  a new metric not yet in the baseline only warns.
+
+The default tolerance is wide (30%) because the gate must hold on
+shared CPU CI runners; it still catches the step-function regressions
+worth gating on (a kernel falling off its fast path, an accidental
+recompile per request). Tighten per-deployment on dedicated hardware.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+
+BASELINE_PATH = "ci/bench_baseline.json"
+DEFAULT_TOLERANCE = 0.30
+
+HIGHER_IS_BETTER_UNITS = ("ratio",)
+LOWER_IS_BETTER_UNITS = ("s", "seconds")
+
+
+def direction(unit: str) -> str | None:
+    """"higher" | "lower" | None (informational)."""
+    if unit == "error":
+        return None
+    if "/s" in unit and not unit.startswith("bytes"):
+        return "higher"   # tokens/s, images/s, .../s/chip rates
+    if unit in HIGHER_IS_BETTER_UNITS:
+        return "higher"
+    if unit in LOWER_IS_BETTER_UNITS:
+        return "lower"
+    return None           # bytes, tokens, counts: footprints, not perf
+
+
+def load_metrics(path: str) -> dict[str, tuple[float, str]]:
+    """metric name -> (value, unit), flattening extra_metrics."""
+    with open(path) as f:
+        doc = json.loads(f.read())
+    out = {doc["metric"]: (float(doc["value"]), doc.get("unit", ""))}
+    for m in doc.get("extra_metrics", []):
+        out[m["metric"]] = (float(m["value"]), m.get("unit", ""))
+    return out
+
+
+def compare(run: dict[str, tuple[float, str]],
+            base: dict[str, tuple[float, str]],
+            tolerance: float) -> list[str]:
+    """Returns failure strings (empty = pass); prints per-metric info."""
+    failures: list[str] = []
+    for name in sorted(base):
+        bval, bunit = base[name]
+        if name not in run:
+            if bunit == "error":
+                continue  # the baseline machine couldn't run it either
+            failures.append(f"{name}: in baseline but missing from run")
+            continue
+        rval, runit = run[name]
+        d = direction(runit)
+        if d is None or bunit == "error" or bval == 0:
+            print(f"bench-gate  info  {name}: {rval:g} {runit} "
+                  f"(baseline {bval:g}, not gated)")
+            continue
+        ratio = rval / bval
+        if d == "higher" and ratio < 1.0 - tolerance:
+            failures.append(
+                f"{name}: {rval:g} {runit} is {(1 - ratio) * 100:.1f}% "
+                f"below baseline {bval:g} (tolerance {tolerance:.0%})")
+        elif d == "lower" and ratio > 1.0 + tolerance:
+            failures.append(
+                f"{name}: {rval:g} {runit} is {(ratio - 1) * 100:.1f}% "
+                f"above baseline {bval:g} (tolerance {tolerance:.0%})")
+        else:
+            print(f"bench-gate  ok    {name}: {rval:g} {runit} "
+                  f"(baseline {bval:g}, x{ratio:.3f})")
+    for name in sorted(set(run) - set(base)):
+        rval, runit = run[name]
+        print(f"bench-gate  NEW   {name}: {rval:g} {runit} — not in "
+              f"baseline; re-run with --write-baseline to adopt",
+              file=sys.stderr)
+    return failures
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("run_json", help="bench.py --json-out artifact")
+    p.add_argument("--baseline", default=BASELINE_PATH)
+    p.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                   help="allowed fractional regression (default 0.30)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="adopt the run as the new committed baseline "
+                        "instead of comparing")
+    args = p.parse_args()
+    if args.write_baseline:
+        shutil.copyfile(args.run_json, args.baseline)
+        print(f"bench-gate: baseline written to {args.baseline}")
+        return 0
+    try:
+        base = load_metrics(args.baseline)
+    except FileNotFoundError:
+        print(f"bench-gate FAIL: no baseline at {args.baseline} — "
+              f"run `python -m ci.bench_gate {args.run_json} "
+              f"--write-baseline` on a known-good tree and commit it",
+              file=sys.stderr)
+        return 1
+    run = load_metrics(args.run_json)
+    failures = compare(run, base, args.tolerance)
+    if failures:
+        for f in failures:
+            print(f"bench-gate FAIL: {f}", file=sys.stderr)
+        return 1
+    print(f"bench-gate: {len(base)} baseline metrics held within "
+          f"{args.tolerance:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,230 +17,28 @@ The parser is intentionally pedantic where Prometheus' own parser is
 forgiving: render bugs (a histogram that forgets `+Inf`, an unescaped
 quote in a label) should fail CI here, not corrupt dashboards later.
 Tests import `parse_exposition` directly (tests/test_obs.py).
+
+The parser itself moved to `kubeflow_tpu.obs.exposition` when metrics
+federation made it a runtime dependency of the fleet router (ISSUE 6);
+this module re-exports it so existing importers keep working, and the
+gate grew a second act: boot a router over two stub replicas, scrape
+the federated `/fleet/metrics`, and hold it to the same strict
+contract plus zero-seeded `slo_burn_rate` gauges.
 """
 
 from __future__ import annotations
 
 import json
-import math
 import sys
 
-# -- strict exposition parser -------------------------------------------
-
-
-class ExpositionError(ValueError):
-    """A violation of the exposition contract (line number included)."""
-
-
-def _unescape_label_value(raw: str, lineno: int) -> str:
-    out = []
-    i = 0
-    while i < len(raw):
-        c = raw[i]
-        if c == "\\":
-            if i + 1 >= len(raw):
-                raise ExpositionError(
-                    f"line {lineno}: dangling backslash in label value")
-            nxt = raw[i + 1]
-            if nxt == "\\":
-                out.append("\\")
-            elif nxt == '"':
-                out.append('"')
-            elif nxt == "n":
-                out.append("\n")
-            else:
-                raise ExpositionError(
-                    f"line {lineno}: bad escape \\{nxt} in label value")
-            i += 2
-        else:
-            out.append(c)
-            i += 1
-    return "".join(out)
-
-
-def _parse_labels(body: str, lineno: int) -> dict[str, str]:
-    """Parse the inside of `{...}` honoring escapes; quotes/commas
-    inside label VALUES must not split pairs."""
-    labels: dict[str, str] = {}
-    i = 0
-    n = len(body)
-    while i < n:
-        eq = body.find("=", i)
-        if eq < 0:
-            raise ExpositionError(f"line {lineno}: label without '='")
-        name = body[i:eq].strip()
-        if not name or not name.replace("_", "a").isalnum():
-            raise ExpositionError(f"line {lineno}: bad label name {name!r}")
-        if eq + 1 >= n or body[eq + 1] != '"':
-            raise ExpositionError(
-                f"line {lineno}: label value for {name} not quoted")
-        j = eq + 2
-        while j < n:
-            if body[j] == "\\":
-                j += 2
-                continue
-            if body[j] == '"':
-                break
-            j += 1
-        if j >= n:
-            raise ExpositionError(
-                f"line {lineno}: unterminated label value for {name}")
-        if name in labels:
-            raise ExpositionError(f"line {lineno}: duplicate label {name}")
-        labels[name] = _unescape_label_value(body[eq + 2:j], lineno)
-        i = j + 1
-        if i < n:
-            if body[i] != ",":
-                raise ExpositionError(
-                    f"line {lineno}: expected ',' between labels, "
-                    f"got {body[i]!r}")
-            i += 1
-    return labels
-
-
-def _parse_value(raw: str, lineno: int) -> float:
-    if raw in ("+Inf", "Inf"):
-        return math.inf
-    if raw == "-Inf":
-        return -math.inf
-    try:
-        return float(raw)
-    except ValueError:
-        raise ExpositionError(
-            f"line {lineno}: unparseable sample value {raw!r}") from None
-
-
-_HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
-
-
-def parse_exposition(text: str) -> dict[str, dict]:
-    """Parse + validate a Prometheus text exposition.
-
-    Returns {family_name: {"type": str, "help": str, "samples":
-    {(sample_name, ((label, value), ...)): float}}}. Raises
-    ExpositionError on any contract violation.
-    """
-    families: dict[str, dict] = {}
-
-    def family_of(sample_name: str, lineno: int) -> dict:
-        if sample_name in families:
-            return families[sample_name]
-        for suffix in _HISTOGRAM_SUFFIXES:
-            base = sample_name.removesuffix(suffix)
-            if base != sample_name and base in families \
-                    and families[base]["type"] == "histogram":
-                return families[base]
-        raise ExpositionError(
-            f"line {lineno}: sample {sample_name!r} has no preceding "
-            "# TYPE declaration")
-
-    for lineno, line in enumerate(text.split("\n"), start=1):
-        if not line.strip():
-            continue
-        if line.startswith("# HELP "):
-            parts = line[len("# HELP "):].split(" ", 1)
-            fam = families.setdefault(
-                parts[0], {"type": None, "help": None, "samples": {}})
-            fam["help"] = parts[1] if len(parts) > 1 else ""
-            continue
-        if line.startswith("# TYPE "):
-            parts = line[len("# TYPE "):].split(" ", 1)
-            if len(parts) != 2 or parts[1] not in (
-                    "counter", "gauge", "histogram", "summary", "untyped"):
-                raise ExpositionError(f"line {lineno}: bad TYPE line")
-            fam = families.setdefault(
-                parts[0], {"type": None, "help": None, "samples": {}})
-            if fam["type"] is not None:
-                raise ExpositionError(
-                    f"line {lineno}: duplicate TYPE for {parts[0]}")
-            fam["type"] = parts[1]
-            continue
-        if line.startswith("#"):
-            continue  # comment
-        # sample line: name[{labels}] value
-        brace = line.find("{")
-        if brace >= 0:
-            close = line.rfind("}")
-            if close < brace:
-                raise ExpositionError(f"line {lineno}: unbalanced braces")
-            name = line[:brace]
-            labels = _parse_labels(line[brace + 1:close], lineno)
-            rest = line[close + 1:].strip()
-        else:
-            name, _, rest = line.partition(" ")
-            labels = {}
-            rest = rest.strip()
-        if not name or not rest or " " in rest:
-            raise ExpositionError(f"line {lineno}: malformed sample line")
-        fam = family_of(name, lineno)
-        if fam["type"] is None:
-            raise ExpositionError(
-                f"line {lineno}: sample {name!r} precedes its TYPE")
-        key = (name, tuple(sorted(labels.items())))
-        if key in fam["samples"]:
-            raise ExpositionError(
-                f"line {lineno}: duplicate series {name}{labels}")
-        fam["samples"][key] = _parse_value(rest, lineno)
-
-    for fname, fam in families.items():
-        if fam["type"] is None:
-            raise ExpositionError(f"family {fname}: HELP without TYPE")
-        if fam["help"] is None:
-            raise ExpositionError(f"family {fname}: TYPE without HELP")
-        if not fam["samples"]:
-            continue
-        if fam["type"] == "counter":
-            for (sname, labels), v in fam["samples"].items():
-                if v < 0:
-                    raise ExpositionError(
-                        f"counter {sname}{dict(labels)} is negative ({v})")
-        if fam["type"] == "histogram":
-            _check_histogram(fname, fam)
-    return families
-
-
-def _check_histogram(fname: str, fam: dict) -> None:
-    """Cumulative nondecreasing buckets, +Inf == _count, _sum present —
-    per label-set (le excluded)."""
-    by_labelset: dict[tuple, dict] = {}
-    for (sname, labels), v in fam["samples"].items():
-        ldict = dict(labels)
-        le = ldict.pop("le", None)
-        group = by_labelset.setdefault(
-            tuple(sorted(ldict.items())),
-            {"buckets": [], "sum": None, "count": None})
-        if sname == fname + "_bucket":
-            if le is None:
-                raise ExpositionError(f"{sname}: bucket without le label")
-            group["buckets"].append((_parse_value(le, 0), v))
-        elif sname == fname + "_sum":
-            group["sum"] = v
-        elif sname == fname + "_count":
-            group["count"] = v
-        else:
-            raise ExpositionError(
-                f"{sname}: unexpected sample in histogram {fname}")
-    for labelset, group in by_labelset.items():
-        where = f"histogram {fname}{dict(labelset)}"
-        if group["sum"] is None or group["count"] is None:
-            raise ExpositionError(f"{where}: missing _sum or _count")
-        if not group["buckets"]:
-            raise ExpositionError(f"{where}: no buckets")
-        les = [le for le, _ in group["buckets"]]
-        if les != sorted(les):
-            raise ExpositionError(f"{where}: buckets not in le order")
-        if len(set(les)) != len(les):
-            raise ExpositionError(f"{where}: duplicate le buckets")
-        counts = [c for _, c in group["buckets"]]
-        if any(b > a for b, a in zip(counts, counts[1:])):
-            raise ExpositionError(f"{where}: bucket counts not cumulative")
-        if les[-1] != math.inf:
-            raise ExpositionError(f"{where}: last bucket is not +Inf")
-        if counts[-1] != group["count"]:
-            raise ExpositionError(
-                f"{where}: +Inf bucket {counts[-1]} != _count "
-                f"{group['count']}")
-
+from kubeflow_tpu.obs.exposition import (  # noqa: F401  (re-exports)
+    ExpositionError,
+    _check_histogram,
+    _parse_labels,
+    _parse_value,
+    _unescape_label_value,
+    parse_exposition,
+)
 
 # -- the live scrape gate -----------------------------------------------
 
@@ -337,16 +135,103 @@ async def run_check() -> list[str]:
     return failures
 
 
+async def run_fleet_check() -> list[str]:
+    """Second act (ISSUE 6): boot a fleet router over two STUB
+    replicas — real metric registries behind real HTTP servers, no jax
+    — and hold the federated `/fleet/metrics` to the same strict
+    contract: parseable, counters summed, histogram buckets merged,
+    `slo_burn_rate` zero-seeded, `fleet_federation_up` covering every
+    replica. Stubs keep the gate fast and make the expected sums exact."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu import obs as obs_lib
+    from kubeflow_tpu.controlplane.metrics import Counter, Registry
+    from kubeflow_tpu.fleet.router import create_router_app
+    from kubeflow_tpu.obs import endpoints as obs_endpoints
+
+    failures: list[str] = []
+
+    def stub_replica(reqs: int, latencies: list[float]):
+        reg = Registry()
+        Counter("stub_requests_total", "stub traffic", reg).inc(reqs)
+        hist = obs_lib.get_or_create_histogram(
+            reg, "stub_latency_seconds", "stub latency")
+        for v in latencies:
+            hist.observe(v)
+        reg.register(obs_lib.SloEngine([
+            obs_lib.Slo("stub_latency", 0.95, threshold_s=1.0)]))
+        app = web.Application()
+        obs_endpoints.mount_observability(
+            app, registry=reg, tracer=obs_lib.Tracer())
+        return app
+
+    replicas = [TestServer(stub_replica(3, [0.1, 0.2])),
+                TestServer(stub_replica(4, [0.3]))]
+    router = TestClient(TestServer(create_router_app()))
+    try:
+        for srv in replicas:
+            await srv.start_server()
+        await router.start_server()
+        for i, srv in enumerate(replicas):
+            resp = await router.post("/fleet/register", json={
+                "id": f"stub-{i}",
+                "url": str(srv.make_url("")).rstrip("/")})
+            if resp.status != 200:
+                failures.append(
+                    f"register stub-{i} -> {resp.status}")
+        resp = await router.get("/fleet/metrics")
+        text = await resp.text()
+        try:
+            families = parse_exposition(text)
+        except ExpositionError as e:
+            return [f"/fleet/metrics failed strict parse: {e}"]
+
+        def sample(fam: str, sname: str, **labels):
+            f = families.get(fam)
+            if f is None:
+                failures.append(f"/fleet/metrics missing family {fam}")
+                return None
+            key = (sname, tuple(sorted(labels.items())))
+            if key not in f["samples"]:
+                failures.append(
+                    f"/fleet/metrics missing sample {sname}{labels}")
+                return None
+            return f["samples"][key]
+
+        if sample("stub_requests_total", "stub_requests_total") != 7:
+            failures.append(
+                "counters not summed across replicas (want 3+4=7)")
+        if sample("stub_latency_seconds",
+                  "stub_latency_seconds_count") != 3:
+            failures.append(
+                "histogram _count not merged (want 2+1=3)")
+        # burn-rate gauges federate like any gauge, zero-seeded
+        for window in ("short", "long"):
+            sample("slo_burn_rate", "slo_burn_rate",
+                   slo="stub_latency", window=window)
+        for i in range(len(replicas)):
+            if sample("fleet_federation_up", "fleet_federation_up",
+                      replica=f"stub-{i}") != 1:
+                failures.append(f"fleet_federation_up[stub-{i}] != 1")
+    finally:
+        await router.close()
+        for srv in replicas:
+            await srv.close()
+    return failures
+
+
 def main() -> int:
     import asyncio
 
-    failures = asyncio.run(run_check())
+    failures = asyncio.run(run_check()) + asyncio.run(run_fleet_check())
     if failures:
         for f in failures:
             print(f"obs-check FAIL: {f}", file=sys.stderr)
         return 1
-    print("obs-check: /metrics strict-parses and /debug/traces is "
-          "Chrome-trace-loadable")
+    print("obs-check: /metrics strict-parses, /debug/traces is "
+          "Chrome-trace-loadable, and /fleet/metrics federates "
+          "two replicas under the same contract")
     return 0
 
 

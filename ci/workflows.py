@@ -59,9 +59,14 @@ COMPONENTS: dict[str, dict[str, Any]] = {
     },
     # Observability layer: unit tier plus the obs-check gate, which
     # scrapes a LIVE platform app and strict-parses the exposition —
-    # render bugs fail here, not in a Prometheus dashboard later.
+    # render bugs fail here, not in a Prometheus dashboard later. The
+    # gate's second act boots a router over stub replicas and holds the
+    # federated /fleet/metrics (merged counters/histograms, zero-seeded
+    # slo_burn_rate gauges) to the same contract, so the router trigger
+    # paths ride along.
     "observability": {
-        "paths": ["kubeflow_tpu/obs/**", "ci/obs_check.py"],
+        "paths": ["kubeflow_tpu/obs/**", "kubeflow_tpu/fleet/router.py",
+                  "ci/obs_check.py"],
         "tests": ("python -m pytest tests/test_obs.py -q && "
                   "python -m ci.obs_check"),
     },

@@ -39,6 +39,7 @@ from aiohttp import web
 from kubeflow_tpu import obs as obs_lib
 from kubeflow_tpu.fleet import autoscale
 from kubeflow_tpu.fleet.registry import ReplicaRegistry
+from kubeflow_tpu.obs import endpoints as obs_endpoints
 from kubeflow_tpu.tenancy import TenancyConfig, TenantLedger, Throttled
 
 log = logging.getLogger(__name__)
@@ -119,6 +120,24 @@ class FleetObs:
             "request bucket, before any replica dispatch",
             self.registry)
         self.tenant_guard = obs_lib.LabelGuard()
+        # Federation: bounds the `replica` label on /fleet/metrics so a
+        # churning fleet can't grow the merged exposition unboundedly.
+        self.replica_guard = obs_lib.LabelGuard()
+        # Router-side SLOs: end-to-end routed latency (what the CLIENT
+        # experiences through the door, retries and hedges included)
+        # and availability (5xx / no-replica-at-all are budget spends).
+        self.slo = obs_lib.SloEngine([
+            obs_lib.Slo("fleet_route_latency", 0.95, threshold_s=2.5,
+                        description="95% of routed generates under "
+                        "2.5 s end to end"),
+            obs_lib.Slo("fleet_availability", 0.99,
+                        description="99% of routed generates answered "
+                        "by some replica without a 5xx"),
+        ])
+        try:
+            self.registry.register(self.slo)
+        except ValueError:
+            pass  # shared registry already carries a burn-rate gauge
         # zero-seed so the series exist (at 0) before any traffic
         for reason in ROUTE_REASONS:
             self.route_total.inc(0, reason=reason)
@@ -197,6 +216,19 @@ def _choose(st: _FleetState, key: bytes, exclude: set):
     return st.registry.pick(key, exclude)
 
 
+def _inject_trace_context(st: _FleetState, headers: dict) -> dict:
+    """Propagate the CURRENT span's context into an upstream dispatch:
+    the replica's middleware adopts `X-Trace-Id`/`X-Parent-Span` via
+    `Tracer.span_from_remote`, so its segment commits under the
+    router's trace id. Copied per dispatch — retries and hedges each
+    carry the live span ids."""
+    span = st.obs.tracer.current_span()
+    if span is None:
+        return headers
+    return {**headers, "X-Trace-Id": span.trace_id,
+            "X-Parent-Span": span.span_id}
+
+
 async def _call_replica(st: _FleetState, rep, name: str, raw: bytes,
                         tried: set, headers: dict):
     """One proxied generate against one replica. Success returns
@@ -207,7 +239,7 @@ async def _call_replica(st: _FleetState, rep, name: str, raw: bytes,
     try:
         async with st.session.post(
                 f"{rep.url}/v1/models/{name}:generate", data=raw,
-                headers=headers,
+                headers=_inject_trace_context(st, headers),
                 timeout=aiohttp.ClientTimeout(total=st.timeout_s)) as r:
             payload = await r.read()
             if r.status >= 500:
@@ -330,6 +362,8 @@ async def _routed_generate(request: web.Request):
             dt = time.perf_counter() - t0
             st.obs.route_total.inc(reason=reason)
             st.obs.route_latency.observe(dt, model=name, reason=reason)
+            st.obs.slo.observe("fleet_route_latency", dt)
+            st.obs.slo.record("fleet_availability", status < 500)
             span.attrs.update(replica=rep.id, reason=reason,
                               hedge_won=hedge_won, status=status)
             if trace:
@@ -342,6 +376,7 @@ async def _routed_generate(request: web.Request):
                                 content_type="application/json",
                                 headers=headers)
         span.attrs["status"] = 503
+    st.obs.slo.record("fleet_availability", False)
     return web.json_response(
         {"error": "no serving replica available"}, status=503,
         headers={"Retry-After": "1"})
@@ -370,7 +405,7 @@ async def _routed_stream(request: web.Request, st: _FleetState,
         try:
             async with st.session.post(
                     f"{replica.url}/v1/models/{name}:generate", data=raw,
-                    headers=fwd_headers,
+                    headers=_inject_trace_context(st, fwd_headers),
                     timeout=aiohttp.ClientTimeout(
                         total=st.timeout_s)) as up:
                 if up.status >= 500:
@@ -536,6 +571,69 @@ async def _stats(request: web.Request):
     })
 
 
+async def _scrape_replicas(st: _FleetState, path: str, *,
+                           params: dict | None = None,
+                           as_json: bool, timeout_s: float = 10.0):
+    """GET `path` from every routable replica concurrently. Returns
+    [(replica_id, body-or-None), ...] — None marks an unreachable or
+    non-200 replica; the caller decides what a hole means."""
+    st.registry.sweep()
+    reps = sorted(st.registry.routable(set()), key=lambda r: r.id)
+
+    async def fetch(rep):
+        try:
+            async with st.session.get(
+                    f"{rep.url}{path}", params=params,
+                    timeout=aiohttp.ClientTimeout(total=timeout_s)) as r:
+                if r.status != 200:
+                    return rep.id, None
+                return rep.id, (await r.json() if as_json
+                                else await r.text())
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                json.JSONDecodeError):
+            return rep.id, None
+
+    return await asyncio.gather(*(fetch(rep) for rep in reps))
+
+
+async def _fleet_metrics(request: web.Request):
+    """GET /fleet/metrics — one exposition for the whole fleet: every
+    routable replica's /metrics scraped, strictly parsed, and merged
+    (counters/gauges summed, histogram buckets merged on the union
+    grid) with a `fleet_federation_up{replica}` coverage gauge. The
+    router's OWN metrics stay at /metrics; federating them in would
+    double-count once an external Prometheus scrapes both."""
+    st: _FleetState = request.app[FLEET_KEY]
+    scrapes = await _scrape_replicas(st, "/metrics", as_json=False)
+    text = obs_lib.federate(dict(scrapes), guard=st.obs.replica_guard)
+    return web.Response(text=text, content_type="text/plain")
+
+
+async def _merged_traces(request: web.Request):
+    """GET /debug/traces with cross-process merge: `?trace_id=` (the id
+    from any X-Trace-Id header) additionally fetches each replica's
+    segment of that trace and merges all Chrome events into one
+    document, router and replicas as separate process tracks. Without
+    `trace_id` (or with `format=summary`) this is the plain local
+    endpoint every other app mounts."""
+    st: _FleetState = request.app[FLEET_KEY]
+    q = request.rel_url.query
+    try:
+        local = obs_lib.traces_response_payload(st.obs.tracer, q)
+    except ValueError as e:
+        raise web.HTTPBadRequest(text=str(e)) from None
+    trace_id = q.get("trace_id") or None
+    if trace_id is None or q.get("format") == "summary":
+        return web.json_response(local)
+    segments = [("router", local)]
+    for rid, payload in await _scrape_replicas(
+            st, "/debug/traces", params={"trace_id": trace_id},
+            as_json=True):
+        if isinstance(payload, dict) and payload.get("traceEvents"):
+            segments.append((rid, payload))
+    return web.json_response(obs_lib.merge_chrome_traces(segments))
+
+
 async def _healthz(request: web.Request):
     st: _FleetState = request.app[FLEET_KEY]
     st.registry.sweep()
@@ -625,17 +723,13 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
     app.on_startup.append(_start)
     app.on_cleanup.append(_stop)
 
-    async def render_metrics(_request):
-        return web.Response(text=obs.registry.render(),
-                            content_type="text/plain")
-
-    async def debug_traces(request):
-        return web.json_response(obs_lib.traces_response_payload(
-            obs.tracer, request.rel_url.query))
-
     app.router.add_get("/healthz", _healthz)
-    app.router.add_get("/metrics", render_metrics)
-    app.router.add_get("/debug/traces", debug_traces)
+    # /metrics via the shared helper; /debug/traces is the router's own
+    # handler because it grows the cross-process ?trace_id= merge.
+    app.router.add_get("/metrics",
+                       obs_endpoints.metrics_handler(obs.registry))
+    app.router.add_get("/debug/traces", _merged_traces)
+    app.router.add_get("/fleet/metrics", _fleet_metrics)
     app.router.add_post("/fleet/register", _register)
     app.router.add_post("/fleet/heartbeat", _heartbeat)
     app.router.add_post("/fleet/deregister", _deregister)

@@ -22,6 +22,12 @@ imports lazily instead.
 from __future__ import annotations
 
 from kubeflow_tpu.obs.cardinality import OVERFLOW_LABEL, LabelGuard
+from kubeflow_tpu.obs.exposition import (
+    ExpositionError,
+    parse_exposition,
+    render_families,
+)
+from kubeflow_tpu.obs.federation import federate, merge_families
 from kubeflow_tpu.obs.metrics import (
     LATENCY_BUCKETS,
     SIZE_BUCKETS,
@@ -30,25 +36,42 @@ from kubeflow_tpu.obs.metrics import (
     format_float,
     get_or_create_histogram,
 )
+from kubeflow_tpu.obs.slo import Slo, SloEngine
+from kubeflow_tpu.obs.timeline import RequestTimeline, TimelineStore
 from kubeflow_tpu.obs.tracing import (
     Span,
     Tracer,
+    merge_chrome_traces,
     traces_response_payload,
 )
+
+# obs.endpoints (the shared aiohttp /metrics + /debug/traces handlers)
+# is deliberately NOT imported here: importing `obs` must not pull
+# aiohttp into HTTP-free processes (the Trainer).
 
 __all__ = [
     "LATENCY_BUCKETS",
     "SIZE_BUCKETS",
     "TOKEN_BUCKETS",
+    "ExpositionError",
     "Histogram",
     "LabelGuard",
     "OVERFLOW_LABEL",
+    "RequestTimeline",
+    "Slo",
+    "SloEngine",
     "Span",
+    "TimelineStore",
     "Tracer",
     "DEFAULT_TRACER",
     "default_registry",
+    "federate",
     "format_float",
     "get_or_create_histogram",
+    "merge_chrome_traces",
+    "merge_families",
+    "parse_exposition",
+    "render_families",
     "traces_response_payload",
 ]
 

@@ -1,0 +1,50 @@
+"""Shared aiohttp observability endpoints.
+
+Every HTTP-speaking process in the platform (dashboard, serving
+replica, fleet router) exposes the same two doors — `/metrics` and
+`/debug/traces` — and until ISSUE 6 each app re-implemented them as
+inline closures. These factories are that closure, once: hand them a
+registry/tracer and mount the returned handler.
+
+Kept in its own module (not `obs/__init__`) so importing `obs` never
+pulls aiohttp into processes that don't serve HTTP (the Trainer).
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from .tracing import Tracer, traces_response_payload
+
+
+def metrics_handler(registry):
+    """GET /metrics handler over a `controlplane.metrics.Registry`."""
+
+    async def render_metrics(_request: web.Request) -> web.Response:
+        return web.Response(text=registry.render(),
+                            content_type="text/plain")
+
+    return render_metrics
+
+
+def traces_handler(tracer: Tracer):
+    """GET /debug/traces handler over a Tracer. Query contract lives in
+    `traces_response_payload`; a bad `?limit=` is the caller's fault
+    (400), not a crash."""
+
+    async def debug_traces(request: web.Request) -> web.Response:
+        try:
+            payload = traces_response_payload(tracer,
+                                              request.rel_url.query)
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e)) from None
+        return web.json_response(payload)
+
+    return debug_traces
+
+
+def mount_observability(app: web.Application, *, registry,
+                        tracer: Tracer) -> None:
+    """Mount GET /metrics and GET /debug/traces on `app`."""
+    app.router.add_get("/metrics", metrics_handler(registry))
+    app.router.add_get("/debug/traces", traces_handler(tracer))

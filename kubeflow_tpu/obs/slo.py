@@ -1,0 +1,144 @@
+"""Declarative SLOs evaluated into multi-window burn-rate gauges.
+
+An `Slo` names an objective ("99% of interactive requests see TTFT
+under 500 ms"); the `SloEngine` turns a stream of good/bad events into
+**burn rates** over a short and a long window:
+
+    burn = bad_fraction_in_window / error_budget,
+    error_budget = 1 - objective
+
+Burn 1.0 means the service is spending its error budget exactly as
+fast as the objective allows; the classic multi-window alert fires
+when BOTH windows burn hot (short window = it is happening now, long
+window = it is not just a blip). We expose the raw rates and leave the
+AND to the alerting layer.
+
+The engine IS a registry metric (duck-typed like `obs.Histogram`:
+`name`/`help`/`TYPE`/`expositions()`), so wiring is one
+`registry.register(engine)` and the gauge is computed live at scrape
+time. Every `slo x window` pair is always emitted — zero-seeded — so
+rates are well-defined from the first scrape even before traffic.
+
+Feeders run on the serving hot path and the batcher worker thread, so
+`observe`/`record` are a deque append under one lock; windows are
+pruned lazily. The clock is injectable for tests.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Iterator
+
+# Events kept per SLO: bounds memory if a window is set absurdly long
+# or traffic is extreme; at the default 600 s long window this is only
+# reached past ~27 events/s, where subsampling barely moves a fraction.
+MAX_EVENTS_PER_SLO = 16384
+
+WINDOWS = ("short", "long")
+
+
+class Slo:
+    """One objective. `objective` is the good-fraction target (0,1);
+    `threshold_s` lets latency feeders call `observe(name, seconds)`
+    instead of pre-classifying good/bad themselves."""
+
+    __slots__ = ("name", "objective", "threshold_s", "description")
+
+    def __init__(self, name: str, objective: float,
+                 threshold_s: float | None = None, description: str = ""):
+        if not 0.0 < objective < 1.0:
+            raise ValueError(
+                f"slo {name!r}: objective must be in (0, 1), "
+                f"got {objective}")
+        if threshold_s is not None and threshold_s <= 0:
+            raise ValueError(
+                f"slo {name!r}: threshold_s must be positive")
+        self.name = name
+        self.objective = objective
+        self.threshold_s = threshold_s
+        self.description = description
+
+    @property
+    def error_budget(self) -> float:
+        return 1.0 - self.objective
+
+
+class SloEngine:
+    """Burn-rate evaluator over a set of Slos; also the
+    `slo_burn_rate{slo,window}` gauge metric."""
+
+    name = "slo_burn_rate"
+    help = ("error-budget burn rate per SLO and window (1.0 = spending "
+            "budget exactly at the objective's rate; >1 = burning hot)")
+    TYPE = "gauge"
+
+    def __init__(self, slos: Iterator[Slo] | list[Slo], *,
+                 short_window_s: float = 60.0,
+                 long_window_s: float = 600.0,
+                 clock: Callable[[], float] | None = None):
+        slos = list(slos)
+        if len({s.name for s in slos}) != len(slos):
+            raise ValueError("duplicate SLO names")
+        if not short_window_s < long_window_s:
+            raise ValueError("short window must be shorter than long")
+        self.slos: dict[str, Slo] = {s.name: s for s in slos}
+        self.windows = {"short": float(short_window_s),
+                        "long": float(long_window_s)}
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        # per slo: deque of (t, bad) — bad is 0/1
+        self._events: dict[str, collections.deque] = {
+            s.name: collections.deque(maxlen=MAX_EVENTS_PER_SLO)
+            for s in slos}
+
+    # -- feed side ---------------------------------------------------------
+
+    def record(self, name: str, good: bool) -> None:
+        """One pre-classified event against SLO `name`. Unknown names
+        are dropped silently: feeders must never crash the fed path."""
+        dq = self._events.get(name)
+        if dq is None:
+            return
+        with self._lock:
+            dq.append((self._clock(), 0 if good else 1))
+
+    def observe(self, name: str, seconds: float) -> None:
+        """One latency sample against a threshold SLO."""
+        slo = self.slos.get(name)
+        if slo is None or slo.threshold_s is None:
+            return
+        self.record(name, seconds <= slo.threshold_s)
+
+    # -- read side ---------------------------------------------------------
+
+    def burn_rates(self) -> dict[tuple[str, str], float]:
+        """{(slo, window): burn}. Windows with no events burn 0.0."""
+        now = self._clock()
+        horizon = now - self.windows["long"]
+        out: dict[tuple[str, str], float] = {}
+        with self._lock:
+            for name, dq in self._events.items():
+                while dq and dq[0][0] < horizon:
+                    dq.popleft()
+                snap = list(dq)
+                slo = self.slos[name]
+                for wname in WINDOWS:
+                    cutoff = now - self.windows[wname]
+                    total = bad = 0
+                    for t, b in reversed(snap):
+                        if t < cutoff:
+                            break
+                        total += 1
+                        bad += b
+                    frac = (bad / total) if total else 0.0
+                    out[(name, wname)] = frac / slo.error_budget
+        return out
+
+    def expositions(self) -> Iterator[tuple[str, dict[str, str], float]]:
+        rates = self.burn_rates()
+        for name in sorted(self.slos):
+            for wname in WINDOWS:
+                yield (self.name, {"slo": name, "window": wname},
+                       rates[(name, wname)])

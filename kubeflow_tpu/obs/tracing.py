@@ -32,6 +32,14 @@ from typing import Any, Callable, Iterator
 MAX_SPANS_PER_TRACE = 512
 
 
+def _valid_span_id(raw: str) -> bool:
+    """Remote trace/span ids arrive in HTTP headers; accept only what
+    `secrets.token_hex` could have minted (lowercase hex, sane length)
+    so a hostile header cannot smuggle junk into trace exports."""
+    return (isinstance(raw, str) and 8 <= len(raw) <= 64
+            and all(c in "0123456789abcdef" for c in raw))
+
+
 class Span:
     """One timed operation. `start`/`end` are epoch seconds."""
 
@@ -141,6 +149,46 @@ class Tracer:
                 with self._lock:
                     self._traces.append(trace)
 
+    @contextlib.contextmanager
+    def span_from_remote(self, name: str, trace_id: str,
+                         parent_span_id: str, /,
+                         **attrs: Any) -> Iterator[Span]:
+        """Open a root span that ADOPTS a remote parent context — the
+        receiving half of cross-process propagation (`X-Trace-Id` +
+        `X-Parent-Span` injected by the fleet router). The local trace
+        commits under the REMOTE trace id with the remote span as
+        parent, so both processes' rings hold joinable segments of one
+        logical trace and a merger can reassemble the full tree.
+
+        Malformed ids (propagation is an open HTTP header — never
+        trust it) or an already-open local parent fall back to a
+        normal `span()`: a bad header must not corrupt local nesting.
+        """
+        if (self._current.get() is not None
+                or not _valid_span_id(trace_id)
+                or not _valid_span_id(parent_span_id)):
+            with self.span(name, **attrs) as s:
+                yield s
+            return
+        trace = _Trace(trace_id, next(self._seq))
+        s = Span(name, trace_id, secrets.token_hex(8), parent_span_id,
+                 self._clock(), dict(attrs), trace)
+        token = self._current.set(s)
+        try:
+            yield s
+        except BaseException as e:
+            s.attrs.setdefault("error", type(e).__name__)
+            raise
+        finally:
+            s.end = self._clock()
+            self._current.reset(token)
+            trace.add(s)
+            # This span is the local root: it commits the trace even
+            # though its parent_id points at the remote caller's span.
+            trace.root = s
+            with self._lock:
+                self._traces.append(trace)
+
     def wrap(self, fn: Callable, name: str, /, **attrs: Any) -> Callable:
         """Propagate the CURRENT context into a thread-pool callable
         (run_in_executor does not copy contextvars): the returned
@@ -161,9 +209,11 @@ class Tracer:
     # -- read side ---------------------------------------------------------
 
     def traces(self, name: str | None = None,
-               limit: int | None = None) -> list[dict[str, Any]]:
+               limit: int | None = None,
+               trace_id: str | None = None) -> list[dict[str, Any]]:
         """Finished traces, NEWEST first, optionally filtered by root
-        span name. Each entry: trace summary + its spans."""
+        span name and/or exact trace id. Each entry: trace summary +
+        its spans."""
         with self._lock:
             snap = list(self._traces)
         snap.sort(key=lambda t: t.seq, reverse=True)
@@ -173,6 +223,8 @@ class Tracer:
             if root is None:
                 continue
             if name is not None and root.name != name:
+                continue
+            if trace_id is not None and t.trace_id != trace_id:
                 continue
             out.append({
                 "traceId": t.trace_id,
@@ -186,14 +238,15 @@ class Tracer:
         return out
 
     def chrome_trace(self, name: str | None = None,
-                     limit: int | None = None) -> dict[str, Any]:
+                     limit: int | None = None,
+                     trace_id: str | None = None) -> dict[str, Any]:
         """Chrome trace-event JSON (the `chrome://tracing` / Perfetto
         load format): one complete ("ph": "X") event per span, ts/dur
         in microseconds, traces ordered newest first. `args` carries
         the span attrs plus trace/span ids so events remain joinable
         back to `X-Trace-Id` response headers."""
         events = []
-        for t in self.traces(name=name, limit=limit):
+        for t in self.traces(name=name, limit=limit, trace_id=trace_id):
             for s in t["spans"]:
                 events.append({
                     "name": s["name"],
@@ -217,12 +270,33 @@ def traces_response_payload(tracer: Tracer, query) -> dict[str, Any]:
     """Shared `/debug/traces` handler body for the dashboard and
     serving apps: `?name=` filters by root span name, `?limit=` caps
     trace count (default 100), `?format=summary` returns the span-tree
-    summaries instead of Chrome events."""
+    summaries instead of Chrome events, `?trace_id=` selects one
+    trace exactly (the id from an `X-Trace-Id` response header)."""
     name = query.get("name") or None
+    trace_id = query.get("trace_id") or None
     try:
         limit = int(query.get("limit", "100"))
     except ValueError as e:
         raise ValueError(f"limit must be an integer: {e}") from None
     if query.get("format") == "summary":
-        return {"traces": tracer.traces(name=name, limit=limit)}
-    return tracer.chrome_trace(name=name, limit=limit)
+        return {"traces": tracer.traces(name=name, limit=limit,
+                                        trace_id=trace_id)}
+    return tracer.chrome_trace(name=name, limit=limit, trace_id=trace_id)
+
+
+def merge_chrome_traces(
+        segments: list[tuple[str, dict[str, Any]]]) -> dict[str, Any]:
+    """Merge per-process Chrome-trace payloads into one document — the
+    cross-process half of distributed tracing. Each segment gets its
+    own `pid` plus a `process_name` metadata event, so Perfetto shows
+    "router" and each replica as separate process tracks while spans
+    stay joinable through the shared `args.trace_id`/`parent_id`."""
+    events: list[dict[str, Any]] = []
+    for pid, (source, payload) in enumerate(segments, start=1):
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 1, "args": {"name": source}})
+        for e in payload.get("traceEvents", []):
+            if e.get("ph") == "M":
+                continue  # sources' own metadata is superseded
+            events.append({**e, "pid": pid})
+    return {"displayTimeUnit": "ms", "traceEvents": events}

@@ -69,6 +69,7 @@ from kubeflow_tpu.serving.engine import (
     SamplingParams,
     transformer_block,
 )
+from kubeflow_tpu.obs.timeline import RequestTimeline, TimelineStore
 from kubeflow_tpu.serving.paged import BlockPool, RadixPrefixCache
 from kubeflow_tpu.tenancy.ledger import TenantLedger
 from kubeflow_tpu.tenancy.scheduler import FairShareQueue, ReqMeta
@@ -721,7 +722,7 @@ class ContinuousBatcher:
                  kv_block_size: int = 64,
                  kv_pool_blocks: int | None = None,
                  paged_attention_impl: str = "auto",
-                 tenancy=None):
+                 tenancy=None, clock=None):
         # window_ms accepted (and ignored) for constructor parity with
         # Batcher: admission is per-token here, there is no window.
         del window_ms
@@ -777,6 +778,18 @@ class ContinuousBatcher:
         # optional hook(computed: int, reused: int, hit: bool), called
         # per admission — the server wires metrics through this
         self.on_prefix = None
+        # Per-request token timelines (obs.timeline): every request
+        # gets a RequestTimeline stamped with its structural events
+        # plus every emitted token's timestamp; the bounded store backs
+        # `/v1/requests/{id}/timeline`. The injectable clock lets tests
+        # assert exact ITL math. Like on_prefix, the optional hooks —
+        # on_itl(gap_s) per decode token, on_queue_wait(wait_s) per
+        # first admission — feed server histograms and must never kill
+        # the worker.
+        self._clock = clock or time.monotonic
+        self.timelines = TimelineStore()
+        self.on_itl = None
+        self.on_queue_wait = None
         # optional obs.Tracer: when set (the server wires it), every
         # decode-chunk dispatch opens a `decode.attention` span in the
         # executor thread, tagged with the RESOLVED attention impl —
@@ -952,6 +965,11 @@ class ContinuousBatcher:
         # and prefix do) but is popped back out — it is routing
         # metadata, not a sampling knob
         tenant = sampling.pop("tenant", "")
+        # the request id rides the sampling channel the same way; the
+        # server mints it (X-Request-Id) — direct batcher callers get a
+        # sequence-derived fallback so timelines always have a key
+        request_id = str(sampling.pop("request_id", "")) \
+            or f"req-{self._seq:06d}"
         spec = (self.tenancy.resolve(tenant)
                 if self.tenancy is not None else None)
         if self._ledger is not None:
@@ -990,16 +1008,24 @@ class ContinuousBatcher:
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._admitted += 1
         fut.add_done_callback(lambda _f: self._req_done())
+        tl = RequestTimeline(
+            request_id,
+            tenant=spec.name if spec is not None else tenant,
+            clock=self._clock)
         meta = ReqMeta(
             tenant=spec.name if spec is not None else "",
             priority=spec.priority if spec is not None else "standard",
             weight=spec.weight if spec is not None else 1.0,
             cost=float(max_new),
-            t_enqueue=time.monotonic(),
+            t_enqueue=self._clock(),
             seq=self._seq,
             ns=(spec.name if spec is not None and spec.prefix_isolation
-                else ""))
+                else ""),
+            request_id=request_id, timeline=tl)
         self._seq += 1
+        tl.event("enqueue", tokens=len(tokens), max_new=max_new,
+                 priority=meta.priority)
+        self.timelines.add(tl)
         self._pending.append(
             (tokens, max_new, sampling, fut, queue, aid, prefix, meta))
         self._wake.set()
@@ -1098,11 +1124,13 @@ class ContinuousBatcher:
         self._cache_blocks(rec)
         self._release(slot)
         if rec.meta is not None:
-            dt = time.monotonic() - rec.meta.t_enqueue
+            dt = self._clock() - rec.meta.t_enqueue
             self.service_ewma = (0.8 * self.service_ewma + 0.2 * dt
                                  if self.service_ewma > 0 else dt)
             if self._ledger is not None:
                 self._ledger.note_completed(rec.meta.tenant)
+            if rec.meta.timeline is not None:
+                rec.meta.timeline.event("finish", tokens=len(rec.out))
         if rec.queue is not None and not rec.fut.done():
             rec.queue.put_nowait(None)
         if not rec.fut.done():
@@ -1114,6 +1142,15 @@ class ContinuousBatcher:
         rec.out.append(token)
         rec.lps.append(lp)
         rec.kv_toks.append(token)  # cache-content log, never trimmed
+        if rec.meta is not None and rec.meta.timeline is not None:
+            gap = rec.meta.timeline.token()
+            # first token (and first after a preempt/resume hole)
+            # returns None: not an inter-token latency
+            if decode and gap is not None and self.on_itl is not None:
+                try:
+                    self.on_itl(gap)
+                except Exception:  # noqa: BLE001 — metrics hook
+                    pass           # must never kill the worker
         if self._ledger is not None and rec.meta is not None:
             # tokens/s pacing: generated tokens charge the bucket; a
             # tenant in debt stops being popped until it refills
@@ -1206,6 +1243,9 @@ class ContinuousBatcher:
         self.preemptions += 1
         if self._ledger is not None:
             self._ledger.note_preempted(meta.tenant)
+        if meta.timeline is not None:
+            meta.timeline.event("preempt", slot=slot,
+                                emitted=len(rec.out))
         meta.resume = {"out": list(rec.out), "lps": list(rec.lps),
                        "max_new": rec.max_new}
         # the re-enqueued item plans blocks with the REMAINING budget
@@ -1497,7 +1537,8 @@ class ContinuousBatcher:
                 rec.meta = meta
                 rec.sampling = sampling
                 rec.aid = aid
-                if meta.resume is not None:
+                resumed = meta.resume is not None
+                if resumed:
                     # preemption replay: restore the already-emitted
                     # tokens and the ORIGINAL budget (item max_new was
                     # only the remainder, for block planning)
@@ -1535,6 +1576,17 @@ class ContinuousBatcher:
                         self.on_prefix(computed, reused, reused > 0)
                     except Exception:  # noqa: BLE001 — metrics hook
                         pass           # must never kill the worker
+                if meta.timeline is not None:
+                    meta.timeline.event(
+                        "resume" if resumed else "admit", slot=slot,
+                        prefill_computed=computed,
+                        prefill_reused=reused)
+                if not resumed and self.on_queue_wait is not None:
+                    try:
+                        self.on_queue_wait(
+                            self._clock() - meta.t_enqueue)
+                    except Exception:  # noqa: BLE001 — metrics hook
+                        pass
                 ec = self.engine.ec
                 self._temp[slot] = sampling.get(
                     "temperature", ec.temperature)

@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import math
+import secrets
 import time
 from typing import Any
 
@@ -29,6 +30,7 @@ import numpy as np
 from aiohttp import web
 
 from kubeflow_tpu import obs as obs_lib
+from kubeflow_tpu.obs import endpoints as obs_endpoints
 from kubeflow_tpu.serving.continuous import (
     ContinuousBatcher,
     Overloaded,
@@ -36,7 +38,12 @@ from kubeflow_tpu.serving.continuous import (
 )
 from kubeflow_tpu.serving.engine import InferenceEngine
 from kubeflow_tpu.serving.speculative import SpeculativeEngine
-from kubeflow_tpu.tenancy import THROTTLE_REASONS, TenancyConfig, Throttled
+from kubeflow_tpu.tenancy import (
+    PRIORITIES,
+    THROTTLE_REASONS,
+    TenancyConfig,
+    Throttled,
+)
 
 BYTE_OFFSET = 3  # 0=pad, 1=bos, 2=eos
 BOS, EOS = 1, 2
@@ -75,13 +82,28 @@ FLEET_REG_KEY: web.AppKey = web.AppKey("fleet_registration", dict)
 TENANCY_KEY: web.AppKey = web.AppKey("tenancy", object)  # TenancyConfig|None
 
 
+# Replica SLO defaults (ISSUE 6). TTFT thresholds are per priority
+# class — interactive traffic is the one the burn-rate gauge exists to
+# defend; batch gets slack. Overridable per deployment via
+# `create_serving_app(slo_ttft_s=...)` (the loadtest tunes interactive
+# to the hardware it runs on).
+SLO_TTFT_THRESHOLDS_S = {
+    "interactive": 0.5,
+    "standard": 2.0,
+    "batch": 10.0,
+}
+SLO_ITL_THRESHOLD_S = 0.25
+SLO_LATENCY_OBJECTIVE = 0.95   # 95% of requests under threshold
+SLO_ERROR_OBJECTIVE = 0.99     # 99% of requests without a 5xx
+
+
 class ServingObs:
     """Per-app observability bundle: metric registry + span tracer +
     the serving hot-path histograms (ISSUE 1). `/metrics` renders the
     registry, `/debug/traces` exports the tracer's ring; every request
     carries its trace id back in `X-Trace-Id`."""
 
-    def __init__(self, registry=None, tracer=None):
+    def __init__(self, registry=None, tracer=None, *, slo_ttft_s=None):
         # controlplane.metrics is pure Python (no jax/store state is
         # touched here) — the ONE Registry implementation serves all
         # three layers rather than a drifted serving copy.
@@ -159,6 +181,49 @@ class ServingObs:
             "serving_tenant_preemptions_total",
             "Batch-class decodes evicted mid-generation to free a slot "
             "for interactive work, per tenant", self.registry)
+        # Token-timeline companions (ISSUE 6): the continuous batcher's
+        # on_itl/on_queue_wait hooks feed these, so the fleet view gets
+        # the same numbers the per-request timeline endpoint shows.
+        self.itl = obs_lib.get_or_create_histogram(
+            self.registry, "serving_itl_seconds",
+            "Inter-token latency: gap between consecutive decode "
+            "tokens of one request, per model (gaps spanning a "
+            "preempt/resume hole are excluded — those measure "
+            "scheduling, see serving_queue_wait_seconds)")
+        self.queue_wait = obs_lib.get_or_create_histogram(
+            self.registry, "serving_queue_wait_seconds",
+            "Enqueue to first admission into the decode batch, per "
+            "model (scheduling delay; excludes prefill)")
+        # SLO burn rates (obs.slo): the engine IS the gauge metric —
+        # registering it zero-seeds every slo x window series. TTFT
+        # objectives are per priority class; error-rate likewise;
+        # ITL is fleet-wide (a preempted batch decode and a healthy
+        # interactive one share the decode loop).
+        ttft_thr = dict(SLO_TTFT_THRESHOLDS_S)
+        ttft_thr.update(slo_ttft_s or {})
+        slos = [obs_lib.Slo(
+                    f"serving_ttft_{cls}", SLO_LATENCY_OBJECTIVE,
+                    threshold_s=ttft_thr[cls],
+                    description=f"p95 TTFT for {cls} traffic under "
+                                f"{ttft_thr[cls]:g} s")
+                for cls in PRIORITIES]
+        slos.append(obs_lib.Slo(
+            "serving_itl", SLO_LATENCY_OBJECTIVE,
+            threshold_s=SLO_ITL_THRESHOLD_S,
+            description=f"p95 inter-token latency under "
+                        f"{SLO_ITL_THRESHOLD_S:g} s"))
+        slos.extend(obs_lib.Slo(
+                        f"serving_errors_{cls}", SLO_ERROR_OBJECTIVE,
+                        description=f"99% of {cls} requests answered "
+                                    "without a 5xx")
+                    for cls in PRIORITIES)
+        self.slo = obs_lib.SloEngine(slos)
+        try:
+            self.registry.register(self.slo)
+        except ValueError:
+            # shared registry already carries a burn-rate gauge (one
+            # process hosting several apps): feed the existing one
+            self.slo = self.registry.get("slo_burn_rate") or self.slo
         # X-Tenant is a raw client header: anywhere it becomes a label
         # or span attribute it passes this guard, so a scanner minting
         # fresh values cannot mint unbounded timeseries.
@@ -169,6 +234,17 @@ _OBS_T0 = "obs_request_start"
 _OBS_TTFT_DONE = "obs_ttft_recorded"
 
 
+def _priority_class(request: web.Request) -> str:
+    """Resolve the request's tenant priority class for SLO accounting.
+    Tenant-blind deployments are all `standard` — the SLO families
+    still zero-seed for every class, so dashboards don't change shape
+    when tenancy is switched on."""
+    tenancy = request.app.get(TENANCY_KEY)
+    if tenancy is None:
+        return "standard"
+    return tenancy.resolve(request.headers.get("X-Tenant", "")).priority
+
+
 def _observe_first_token(request: web.Request, model: str) -> None:
     """Record time-to-first-token ONCE per request (stream paths call
     on the first emitted token; the one-shot path after generate)."""
@@ -177,7 +253,14 @@ def _observe_first_token(request: web.Request, model: str) -> None:
     if sobs is None or t0 is None or request.get(_OBS_TTFT_DONE):
         return
     request[_OBS_TTFT_DONE] = True
-    sobs.ttft.observe(time.perf_counter() - t0, model=model)
+    dt = time.perf_counter() - t0
+    labels = {"model": model}
+    tenant_hdr = request.headers.get("X-Tenant")
+    if tenant_hdr:
+        # guarded: the label echoes a client-chosen value
+        labels["tenant"] = sobs.tenant_guard.admit(tenant_hdr)
+    sobs.ttft.observe(dt, **labels)
+    sobs.slo.observe(f"serving_ttft_{_priority_class(request)}", dt)
 
 
 @web.middleware
@@ -191,8 +274,21 @@ async def _obs_middleware(request: web.Request, handler):
     route = getattr(resource, "canonical", None) or "unmatched"
     request[_OBS_T0] = time.perf_counter()
     status = 500
-    with sobs.tracer.span("http.request", method=request.method,
-                          route=route) as span:
+    # Cross-process propagation (ISSUE 6): a request routed through
+    # the fleet router carries its trace context in headers; adopt it
+    # so this replica's segment commits under the ROUTER's trace id
+    # (span_from_remote validates the ids — an arbitrary client header
+    # can't corrupt the ring).
+    remote_tid = request.headers.get("X-Trace-Id", "")
+    remote_psid = request.headers.get("X-Parent-Span", "")
+    if remote_tid and remote_psid:
+        span_cm = sobs.tracer.span_from_remote(
+            "http.request", remote_tid, remote_psid,
+            method=request.method, route=route)
+    else:
+        span_cm = sobs.tracer.span("http.request",
+                                   method=request.method, route=route)
+    with span_cm as span:
         tenant_hdr = request.headers.get("X-Tenant")
         if tenant_hdr:
             # guarded: the attribute echoes a client-chosen value
@@ -213,6 +309,12 @@ async def _obs_middleware(request: web.Request, handler):
             sobs.request_latency.observe(
                 time.perf_counter() - request[_OBS_T0],
                 route=route, method=request.method)
+            if route.startswith("/v1/models/"):
+                # availability SLO counts model-inference traffic
+                # only — probe/debug endpoints would dilute the budget
+                sobs.slo.record(
+                    f"serving_errors_{_priority_class(request)}",
+                    status < 500)
 
 
 class Batcher:
@@ -408,6 +510,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                        registry=None, tracer=None,
                        drain_grace_s: float = 30.0,
                        tenancy: TenancyConfig | None = None,
+                       slo_ttft_s: dict[str, float] | None = None,
                        ) -> web.Application:
     """`tokenizer` (data.bpe.Tokenizer or anything with encode/decode)
     serves the "text" request mode; without one, the zero-training
@@ -443,10 +546,13 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     per-tenant rate limits, KV-block shares, and batch-class
     preemption, and `/metrics` grows zero-seeded `serving_tenant_*`
     series. Without it the server is tenant-blind: FIFO admission,
-    identical to before."""
+    identical to before. `slo_ttft_s` overrides the per-priority-class
+    TTFT SLO thresholds (`SLO_TTFT_THRESHOLDS_S`) feeding the
+    `slo_burn_rate` gauges — e.g. `{"interactive": 0.2}`."""
     app = web.Application(middlewares=[_obs_middleware])
     app[DRAIN_KEY] = {"draining": False, "grace_s": float(drain_grace_s)}
-    sobs = ServingObs(registry=registry, tracer=tracer)
+    sobs = ServingObs(registry=registry, tracer=tracer,
+                      slo_ttft_s=slo_ttft_s)
     app[OBS_KEY] = sobs
     app[ENGINES_KEY] = engines
     unknown = set(drafts or {}) - set(engines)
@@ -533,6 +639,18 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                         reused, model=_m, source="reused")
 
             b.on_prefix = on_prefix
+
+            # token-timeline companions: the batcher hands back every
+            # decode gap and first-admission wait (ISSUE 6)
+            def on_itl(gap, _m=model_name):
+                sobs.itl.observe(gap, model=_m)
+                sobs.slo.observe("serving_itl", gap)
+
+            def on_queue_wait(wait, _m=model_name):
+                sobs.queue_wait.observe(wait, model=_m)
+
+            b.on_itl = on_itl
+            b.on_queue_wait = on_queue_wait
             # seed zero samples so the exposition carries the series
             # (and a 0 reading) before the first admission
             sobs.prefix_hits.inc(0, model=model_name)
@@ -611,20 +729,31 @@ def create_serving_app(engines: dict[str, InferenceEngine],
 
     app.on_cleanup.append(_close_batchers)
 
-    async def render_metrics(_request):
-        return web.Response(text=sobs.registry.render(),
-                            content_type="text/plain")
-
-    async def debug_traces(request):
-        return web.json_response(obs_lib.traces_response_payload(
-            sobs.tracer, request.rel_url.query))
+    async def request_timeline(request):
+        # the TimelineStore keeps live AND finished requests (bounded,
+        # oldest evicted): an operator pastes the X-Request-Id from a
+        # slow response and reads where its time went
+        rid = request.match_info["id"]
+        for b in request.app[BATCHERS_KEY].values():
+            if isinstance(b, ContinuousBatcher):
+                tl = b.timelines.get(rid)
+                if tl is not None:
+                    return web.json_response(tl.to_dict())
+        return web.json_response(
+            {"error": f"no timeline for request {rid!r} (timelines "
+                      "exist for continuous-batching requests only, "
+                      "and the store is bounded)"},
+            status=404)
 
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/readyz", _ok)
-    app.router.add_get("/metrics", render_metrics)
-    app.router.add_get("/debug/traces", debug_traces)
+    app.router.add_get("/metrics",
+                       obs_endpoints.metrics_handler(sobs.registry))
+    app.router.add_get("/debug/traces",
+                       obs_endpoints.traces_handler(sobs.tracer))
     app.router.add_post("/drain", drain_endpoint)
     app.router.add_get("/v1/models", list_models)
+    app.router.add_get("/v1/requests/{id}/timeline", request_timeline)
     app.router.add_post("/v1/models/{name}:generate", generate)
     app.router.add_post("/v1/models/{name}:score", score)
     return app
@@ -894,6 +1023,9 @@ async def _stream_continuous(request, batcher, arr, max_new, sampling,
     trace_id = sobs.tracer.current_trace_id()
     if trace_id:
         headers["X-Trace-Id"] = trace_id
+    rid = sampling.get("request_id")
+    if rid:
+        headers["X-Request-Id"] = rid
     resp = web.StreamResponse(headers=headers)
     await resp.prepare(request)
     ids: list[int] = []
@@ -1049,6 +1181,7 @@ async def generate(request: web.Request):
     # inject it from auth without rewriting bodies. Absent/unknown
     # resolves to the `default` tenant inside the batcher.
     tenant_hdr = request.headers.get("X-Tenant", "")
+    req_id: str | None = None  # minted on continuous-batcher paths
     try:
         body: dict[str, Any] = await request.json()
     except Exception:
@@ -1220,6 +1353,8 @@ async def generate(request: web.Request):
                 # rides the sampling channel like adapter/prefix; the
                 # batcher pops it back out before grouping
                 sampling["tenant"] = tenant_hdr
+            # timeline key; _stream_continuous echoes X-Request-Id
+            sampling["request_id"] = secrets.token_hex(8)
             return await _stream_continuous(
                 request, cbatcher, arr, max_new_req, sampling,
                 text_mode, tokenizer)
@@ -1317,6 +1452,11 @@ async def generate(request: web.Request):
             # coalescing group key, and a per-tenant key would split
             # batches by identity for no scheduling benefit
             submit_sampling["tenant"] = tenant_hdr
+        if isinstance(batcher, ContinuousBatcher):
+            # server-minted id keys the token timeline
+            # (/v1/requests/{id}/timeline); echoed as X-Request-Id
+            req_id = secrets.token_hex(8)
+            submit_sampling["request_id"] = req_id
         if stop and isinstance(batcher, ContinuousBatcher):
             # the continuous batcher retires the slot the moment a
             # stop sequence completes (compute freed); the window
@@ -1404,7 +1544,8 @@ async def generate(request: web.Request):
                             rows[0],
                             on_dropped=lambda n: sobs.dropped_tokens
                             .inc(n, model=name)))
-    return web.json_response(resp)
+    return web.json_response(
+        resp, headers={"X-Request-Id": req_id} if req_id else None)
 
 
 def _apply_stop(row: list[int], stop: list[list[int]]) -> list[int]:

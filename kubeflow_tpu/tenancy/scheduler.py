@@ -41,11 +41,13 @@ class ReqMeta:
     timestamp the server's dynamic Retry-After is computed from)."""
 
     __slots__ = ("tenant", "priority", "weight", "cost", "t_enqueue",
-                 "seq", "ns", "resume", "charged")
+                 "seq", "ns", "resume", "charged", "request_id",
+                 "timeline")
 
     def __init__(self, tenant: str = "", priority: str = "standard",
                  weight: float = 1.0, cost: float = 1.0,
-                 t_enqueue: float = 0.0, seq: int = 0, ns: str = ""):
+                 t_enqueue: float = 0.0, seq: int = 0, ns: str = "",
+                 request_id: str = "", timeline=None):
         self.tenant = tenant
         self.priority = priority
         self.weight = weight
@@ -55,6 +57,10 @@ class ReqMeta:
         self.ns = ns              # radix-cache namespace (prefix_isolation)
         self.resume = None        # preemption carry-over: {out, lps, max_new}
         self.charged = 0.0        # virtual time charged by the last pop
+        self.request_id = request_id
+        # obs.timeline.RequestTimeline — rides the meta so the record
+        # survives preemption's re-enqueue round trip
+        self.timeline = timeline
 
 
 class FairShareQueue:

@@ -133,9 +133,17 @@ eng = InferenceEngine(params, cfg, LLAMA_FAMILY, EngineConfig(max_len=128))
 tenancy = config_from_dict({{"tenants": {{
     "live": {{"priority": "interactive"}},
     "bulk": {{"priority": "batch"}},
-}}}}) if {qos} else None
+}}}})
 app = srv.create_serving_app({{"tiny": eng}}, continuous=True, warmup=True,
-                             max_batch={max_batch}, tenancy=tenancy)
+                             max_batch={max_batch},
+                             tenancy=tenancy if {qos} else None,
+                             slo_ttft_s={{"interactive": {slo_ttft_s}}})
+if not {qos}:
+    # classification-only: the batcher stays tenant-blind FIFO, but the
+    # SLO engine still attributes live-tenant requests to the
+    # interactive class, so both arms feed the SAME burn-rate gauge
+    # and the A/B contrast is scheduler policy, not accounting.
+    app[srv.TENANCY_KEY] = tenancy
 web.run_app(app, host="127.0.0.1", port={port}, print=None)
 '''
 
@@ -143,6 +151,50 @@ web.run_app(app, host="127.0.0.1", port={port}, print=None)
 def _get_json(url: str, timeout: float = 5.0) -> dict:
     with urllib.request.urlopen(url, timeout=timeout) as r:
         return json.loads(r.read())
+
+
+def _scrape_metrics(base: str) -> dict:
+    """GET /metrics and strict-parse it (the loadtest doubles as a
+    contract check: an exposition the parser rejects fails the run)."""
+    from kubeflow_tpu.obs.exposition import parse_exposition
+    with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+        return parse_exposition(r.read().decode())
+
+
+def _burn_rate(families: dict, slo: str, window: str) -> float:
+    """slo_burn_rate{slo=...,window=...} — KeyError means the gauge
+    family regressed (it is zero-seeded, so absence is a bug)."""
+    samples = families["slo_burn_rate"]["samples"]
+    return samples[("slo_burn_rate",
+                    (("slo", slo), ("window", window)))]
+
+
+def _hist_quantile_bracket(families: dict, family: str, q: float,
+                           **labels) -> tuple[float, float]:
+    """(lo, hi] bucket bracket containing the q-quantile of a server
+    histogram, from cumulative bucket counts. hi may be +inf."""
+    want = tuple(sorted(labels.items()))
+    buckets = []
+    for (sname, lbls), v in families[family]["samples"].items():
+        if sname != f"{family}_bucket":
+            continue
+        if tuple(kv for kv in lbls if kv[0] != "le") != want:
+            continue
+        le = dict(lbls)["le"]
+        buckets.append(
+            (float("inf") if le == "+Inf" else float(le), v))
+    if not buckets:
+        raise AssertionError(
+            f"{family}: no buckets with labels {labels} — did the "
+            f"tenant label on the server-side histogram regress?")
+    buckets.sort()
+    total = buckets[-1][1]
+    lo = 0.0
+    for le, cum in buckets:
+        if cum >= q * total - 1e-9:
+            return lo, le
+        lo = le
+    return lo, float("inf")
 
 
 def run_fleet(clients: int, requests: int, max_new: int, *,
@@ -356,9 +408,12 @@ def run_fleet(clients: int, requests: int, max_new: int, *,
 
 def _tenant_arm(qos: bool, *, bulk_clients: int, live_requests: int,
                 bulk_max_new: int, live_max_new: int,
-                max_batch: int) -> dict:
+                max_batch: int, slo_ttft_s: float) -> dict:
     """One arm of the noisy-neighbor A/B: flood with batch-class work,
-    stream interactive requests through the backlog, measure TTFT."""
+    stream interactive requests through the backlog, measure TTFT.
+    Also scrapes the server's own view — the interactive burn-rate
+    gauge and the TTFT histogram — so the A/B doubles as an SLO-plane
+    check (client-measured and server-exposed latency must agree)."""
     import tempfile
     import threading
 
@@ -369,7 +424,8 @@ def _tenant_arm(qos: bool, *, bulk_clients: int, live_requests: int,
     proc = subprocess.Popen(
         [sys.executable, "-c",
          TENANT_SERVER_CODE.format(repo=REPO, port=port, qos=qos,
-                                   max_batch=max_batch)],
+                                   max_batch=max_batch,
+                                   slo_ttft_s=slo_ttft_s)],
         stdout=log, stderr=subprocess.STDOUT)
 
     def post(body: dict, tenant: str, timeout: float = 180.0) -> dict:
@@ -399,15 +455,38 @@ def _tenant_arm(qos: bool, *, bulk_clients: int, live_requests: int,
                 tail = "\n".join(f.read().splitlines()[-20:])
             raise RuntimeError(
                 f"server never came up (rc={proc.returncode}):\n{tail}")
+        def live_ttft(i: int) -> float:
+            """One streamed interactive request; TTFT = first SSE
+            token event on the wire (the serving_ttft definition)."""
+            req = urllib.request.Request(
+                f"{base}/v1/models/tiny:generate",
+                data=json.dumps({"tokens": [[9 + i % 5, 8, 7, 6]],
+                                 "max_new": live_max_new,
+                                 "stream": True}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Tenant": "live"})
+            t0 = time.perf_counter()
+            ttft = None
+            with urllib.request.urlopen(req, timeout=180) as r:
+                for line in r:
+                    if line.startswith(b"data:") and ttft is None:
+                        ttft = time.perf_counter() - t0
+                    # drain to the terminal event so the slot retires
+            assert ttft is not None
+            return ttft
+
         # warm the admission-group shapes both workloads will hit
-        # (bulk-sized and live-sized), concurrently like run() does
+        # (bulk-sized and live-sized), concurrently like run() does.
+        # The live warmup STREAMS: the one-shot path observes TTFT at
+        # generation end, and that inflated sample would pollute the
+        # interactive SLO set both arms' burn gauges are asserted on.
         with concurrent.futures.ThreadPoolExecutor(bulk_clients) as ex:
             for _ in range(2):
                 list(ex.map(
                     lambda i: post({"tokens": [[1, 2, 3, 4]],
                                     "max_new": bulk_max_new}, "bulk"),
                     range(bulk_clients)))
-        post({"tokens": [[1, 2, 3, 4]], "max_new": live_max_new}, "live")
+        live_ttft(0)
 
         stop = threading.Event()
         bulk_done = [0]
@@ -440,30 +519,14 @@ def _tenant_arm(qos: bool, *, bulk_clients: int, live_requests: int,
             t.start()
         time.sleep(1.5)  # let the backlog build before measuring
 
-        def live_ttft(i: int) -> float:
-            """One streamed interactive request; TTFT = first SSE
-            token event on the wire (the serving_ttft definition)."""
-            req = urllib.request.Request(
-                f"{base}/v1/models/tiny:generate",
-                data=json.dumps({"tokens": [[9 + i % 5, 8, 7, 6]],
-                                 "max_new": live_max_new,
-                                 "stream": True}).encode(),
-                headers={"Content-Type": "application/json",
-                         "X-Tenant": "live"})
-            t0 = time.perf_counter()
-            ttft = None
-            with urllib.request.urlopen(req, timeout=180) as r:
-                for line in r:
-                    if line.startswith(b"data:") and ttft is None:
-                        ttft = time.perf_counter() - t0
-                    # drain to the terminal event so the slot retires
-            assert ttft is not None
-            return ttft
-
         ttfts = []
         for i in range(live_requests):
             ttfts.append(live_ttft(i))
             time.sleep(0.2)
+        # scrape while the interactive observations are still inside
+        # the burn engine's short (60 s) window — before waiting out
+        # the bulk threads' in-flight generations
+        families = _scrape_metrics(base)
         stop.set()
         for t in threads:
             t.join(timeout=180)
@@ -474,10 +537,27 @@ def _tenant_arm(qos: bool, *, bulk_clients: int, live_requests: int,
         ttfts.sort()
         q = statistics.quantiles(ttfts, n=20) if len(ttfts) >= 2 \
             else list(ttfts) * 19
+        burn = _burn_rate(families, "serving_ttft_interactive", "short")
+        lo, hi = _hist_quantile_bracket(
+            families, "serving_time_to_first_token_seconds", 0.95,
+            model="tiny", tenant="live")
+        # client p95 must land in (a generously widened) server p95
+        # bucket bracket: same requests, measured from both ends of the
+        # wire. Catches mislabeled observations and unit slips, not
+        # statistical noise — hence the wide slack.
+        if not (lo * 0.5 - 1e-3 <= q[18]
+                and (hi == float("inf") or q[18] <= hi * 3 + 0.05)):
+            raise AssertionError(
+                f"client p95 TTFT {q[18]:.3f}s disagrees with the "
+                f"server-side histogram p95 bucket ({lo:g}, {hi:g}] "
+                f"(qos={qos})")
         return {
             "qos": qos,
             "ttft_p50_s": round(q[9], 3),
             "ttft_p95_s": round(q[18], 3),
+            "slo_burn_interactive_short": round(burn, 2),
+            "ttft_server_p95_bracket_s": [
+                lo, None if hi == float("inf") else hi],
             "bulk_completed": bulk_done[0],
             "bulk_throttled_429": bulk_429[0],
             "bulk_tokens_per_sec": round(
@@ -495,21 +575,50 @@ def _tenant_arm(qos: bool, *, bulk_clients: int, live_requests: int,
             proc.wait()
 
 
-def run_tenants(*, bulk_clients: int = 6, live_requests: int = 8,
-                bulk_max_new: int = 32, live_max_new: int = 8,
-                max_batch: int = 4) -> dict:
+def run_tenants(*, bulk_clients: int = 8, live_requests: int = 8,
+                bulk_max_new: int = 64, live_max_new: int = 8,
+                max_batch: int = 4, slo_ttft_s: float = 0.03,
+                slo_alert_burn: float = 6.0) -> dict:
     """Noisy-neighbor A/B: identical flood + interactive workloads,
     once with the QoS scheduler on and once tenant-blind. The headline
     number is the interactive TTFT ratio — how much of the batch
-    tenant's backlog the interactive tenant no longer waits behind."""
+    tenant's backlog the interactive tenant no longer waits behind.
+
+    The SLO plane rides the same A/B: both arms run the interactive
+    TTFT objective at `slo_ttft_s` (set between the two arms' expected
+    p95s so the threshold discriminates policy, not machine speed),
+    and the run asserts the server's own `slo_burn_rate` gauge tells
+    the story — above the fast-burn alert line (`slo_alert_burn`,
+    default 6x budget: the conventional page threshold) when QoS is
+    off, below it when QoS is on."""
     on = _tenant_arm(True, bulk_clients=bulk_clients,
                      live_requests=live_requests,
                      bulk_max_new=bulk_max_new,
-                     live_max_new=live_max_new, max_batch=max_batch)
+                     live_max_new=live_max_new, max_batch=max_batch,
+                     slo_ttft_s=slo_ttft_s)
     off = _tenant_arm(False, bulk_clients=bulk_clients,
                       live_requests=live_requests,
                       bulk_max_new=bulk_max_new,
-                      live_max_new=live_max_new, max_batch=max_batch)
+                      live_max_new=live_max_new, max_batch=max_batch,
+                      slo_ttft_s=slo_ttft_s)
+    burn_on = on["slo_burn_interactive_short"]
+    burn_off = off["slo_burn_interactive_short"]
+    if burn_off <= burn_on:
+        raise AssertionError(
+            f"interactive burn rate did not rise when QoS was turned "
+            f"off: qos_on={burn_on} qos_off={burn_off} "
+            f"(slo_ttft_s={slo_ttft_s})")
+    if burn_off < slo_alert_burn:
+        raise AssertionError(
+            f"qos_off burn {burn_off} below the alert line "
+            f"{slo_alert_burn} — the flood is not violating the "
+            f"{slo_ttft_s}s interactive TTFT objective; lower "
+            f"--slo-ttft-s or raise the bulk load")
+    if burn_on >= slo_alert_burn:
+        raise AssertionError(
+            f"qos_on burn {burn_on} at/above the alert line "
+            f"{slo_alert_burn} — the scheduler is not protecting the "
+            f"interactive class at the {slo_ttft_s}s objective")
     return {
         "metric": "serving_tenant_qos",
         "mode": "tenants",
@@ -518,6 +627,8 @@ def run_tenants(*, bulk_clients: int = 6, live_requests: int = 8,
         "bulk_max_new": bulk_max_new,
         "live_max_new": live_max_new,
         "max_batch": max_batch,
+        "slo_ttft_s": slo_ttft_s,
+        "slo_alert_burn": slo_alert_burn,
         "qos_on": on,
         "qos_off": off,
         "ttft_p95_improvement": (
@@ -684,12 +795,23 @@ def main() -> int:
     p.add_argument("--mode",
                    choices=("window", "continuous", "fleet", "tenants"),
                    default="window")
-    p.add_argument("--tenant-bulk-clients", type=int, default=6,
+    p.add_argument("--tenant-bulk-clients", type=int, default=8,
                    help="tenants mode: concurrent batch-class flooder "
-                        "threads (the noisy neighbor)")
+                        "threads (the noisy neighbor); must exceed the "
+                        "server's max_batch or nothing ever queues and "
+                        "there is no backlog to measure against")
     p.add_argument("--tenant-live-requests", type=int, default=8,
                    help="tenants mode: sequential interactive streams "
                         "measured for TTFT")
+    p.add_argument("--slo-ttft-s", type=float, default=0.03,
+                   help="tenants mode: interactive TTFT objective fed "
+                        "to both arms' SLO engines; set between the "
+                        "arms' expected p95s so the burn-rate gauge "
+                        "discriminates scheduler policy")
+    p.add_argument("--slo-alert-burn", type=float, default=6.0,
+                   help="tenants mode: fast-burn alert line the "
+                        "qos-off arm must exceed and the qos-on arm "
+                        "must stay below")
     p.add_argument("--fleet-replicas", type=int, default=2,
                    help="fleet mode: serving replicas behind the router")
     p.add_argument("--fleet-policy", choices=("affinity", "roundrobin"),
@@ -741,7 +863,9 @@ def main() -> int:
             p.error("--tenant-live-requests must be >= 2 (quantiles)")
         result = run_tenants(
             bulk_clients=args.tenant_bulk_clients,
-            live_requests=args.tenant_live_requests)
+            live_requests=args.tenant_live_requests,
+            slo_ttft_s=args.slo_ttft_s,
+            slo_alert_burn=args.slo_alert_burn)
     else:
         result = run(args.clients, args.requests, args.max_new,
                      args.batch_window_ms, args.mode, args.spread,

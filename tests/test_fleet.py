@@ -609,3 +609,65 @@ def test_modelserver_annotation_clamped_and_validated(cluster):
     assert any(e.reason == "InvalidReplicas" for e in
                cluster.store.events_for("ModelServer", "user1",
                                         "srv-inv"))
+
+
+# -- cross-process trace merge (ISSUE 6) ------------------------------------
+
+
+async def test_router_merges_replica_trace_segments(
+        tiny_engine, aiohttp_client):
+    """One generate through the router lands on a real serving replica;
+    `/debug/traces?trace_id=` on the router then reassembles BOTH
+    processes' segments into one Chrome trace: same trace id
+    everywhere, replica root parented on the router's upstream span,
+    per-process tracks. Two replicas, round-robin, so the merge is
+    exercised against a fleet, not a single backend."""
+    from kubeflow_tpu.serving import server as server_lib
+
+    reg = ReplicaRegistry()
+    reps = []
+    for i in range(2):
+        app = server_lib.create_serving_app({"tiny": tiny_engine},
+                                            continuous=True, max_batch=2)
+        srv = TestServer(app)
+        await srv.start_server()
+        reg.register(f"http://127.0.0.1:{srv.port}",
+                     replica_id=f"rep-{i}")
+        reps.append(srv)
+    # hedging off: a hedge during the first compile-heavy generate
+    # would advance the round-robin cursor mid-request
+    client = await aiohttp_client(
+        router_mod.create_router_app(reg, policy="roundrobin",
+                                     hedge_after_s=0))
+    try:
+        by_replica: dict[str, str] = {}
+        for i in range(4):
+            r = await client.post(
+                "/v1/models/tiny:generate",
+                json={"tokens": [[1 + i, 2, 3]], "max_new": 2})
+            assert r.status == 200
+            by_replica.setdefault(r.headers["X-Fleet-Replica"],
+                                  r.headers["X-Trace-Id"])
+        assert set(by_replica) == {"rep-0", "rep-1"}  # both exercised
+
+        for rep_id, tid in sorted(by_replica.items()):
+            r = await client.get(f"/debug/traces?trace_id={tid}")
+            doc = await r.json()
+            meta = {e["args"]["name"]: e["pid"]
+                    for e in doc["traceEvents"] if e["ph"] == "M"}
+            assert "router" in meta and rep_id in meta
+            spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+            assert spans and all(
+                e["args"]["trace_id"] == tid for e in spans)
+            rep_roots = [e for e in spans
+                         if e["pid"] == meta[rep_id]
+                         and e["name"] == "http.request"]
+            assert rep_roots, "replica segment missing from the merge"
+            # the replica's root span is parented on a ROUTER span —
+            # the joinable edge X-Parent-Span propagated
+            router_span_ids = {e["args"]["span_id"] for e in spans
+                               if e["pid"] == meta["router"]}
+            assert rep_roots[0]["args"]["parent_id"] in router_span_ids
+    finally:
+        for srv in reps:
+            await srv.close()

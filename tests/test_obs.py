@@ -412,3 +412,187 @@ def test_trainer_step_observes_histograms_and_spans():
     steps = [t for t in tr.traces() if t["name"] == "train.step"]
     assert len(steps) == 2
     assert steps[-1]["spans"][0]["attrs"]["compile"] is True
+
+
+# -- metrics federation (ISSUE 6) ----------------------------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_federation_round_trip_sums_and_merges():
+    """Two real registries -> render -> federate -> strict re-parse:
+    counters sum, histogram _sum/_count add, and the merged document
+    itself passes the same parser the replicas' /metrics must."""
+    regs = [Registry(), Registry()]
+    for i, reg in enumerate(regs):
+        Counter("fed_requests_total", "reqs", reg).inc(3 + i)
+        h = obs.get_or_create_histogram(reg, "fed_latency_seconds", "lat")
+        h.observe(0.01 * (i + 1))
+        h.observe(0.2)
+    merged = parse_exposition(obs.federate(
+        {"r0": regs[0].render(), "r1": regs[1].render(), "gone": None}))
+    c = merged["fed_requests_total"]["samples"]
+    assert c[("fed_requests_total", ())] == 7
+    hs = merged["fed_latency_seconds"]["samples"]
+    assert hs[("fed_latency_seconds_count", ())] == 4
+    assert hs[("fed_latency_seconds_sum", ())] == pytest.approx(0.43)
+    up = merged["fleet_federation_up"]["samples"]
+    assert up[("fleet_federation_up", (("replica", "r0"),))] == 1
+    assert up[("fleet_federation_up", (("replica", "gone"),))] == 0
+
+
+def test_federation_union_grid_floor_interpolation():
+    """Replicas with DIFFERENT bucket grids merge on the union grid;
+    a replica contributes its cumulative count at its largest own
+    boundary <= u. Hand-built texts pin the arithmetic exactly."""
+    a = ("# HELP h x\n# TYPE h histogram\n"
+         'h_bucket{le="0.1"} 1\nh_bucket{le="+Inf"} 2\n'
+         "h_sum 0.6\nh_count 2\n")
+    b = ("# HELP h x\n# TYPE h histogram\n"
+         'h_bucket{le="0.5"} 3\nh_bucket{le="+Inf"} 3\n'
+         "h_sum 0.9\nh_count 3\n")
+    merged = parse_exposition(obs.federate({"a": a, "b": b}))
+    hs = merged["h"]["samples"]
+    # at 0.1: a contributes 1, b has no boundary <= 0.1 -> 0
+    assert hs[("h_bucket", (("le", "0.1"),))] == 1
+    # at 0.5: a floors to its 0.1 bucket (1), b contributes 3
+    assert hs[("h_bucket", (("le", "0.5"),))] == 4
+    assert hs[("h_bucket", (("le", "+Inf"),))] == 5
+    assert hs[("h_count", ())] == 5
+
+
+def test_federation_type_conflict_and_bad_replica():
+    """A TYPE disagreement is a deploy bug -> ExpositionError; a
+    replica whose text fails the strict parse is marked down instead
+    of poisoning the merge."""
+    good = "# HELP x y\n# TYPE x counter\nx 1\n"
+    with pytest.raises(ExpositionError, match="TYPE conflict"):
+        obs.merge_families([
+            parse_exposition(good),
+            parse_exposition("# HELP x y\n# TYPE x gauge\nx 1\n")])
+    merged = parse_exposition(obs.federate(
+        {"ok": good, "junk": "not an exposition {{{"}))
+    up = merged["fleet_federation_up"]["samples"]
+    assert up[("fleet_federation_up", (("replica", "ok"),))] == 1
+    assert up[("fleet_federation_up", (("replica", "junk"),))] == 0
+    assert merged["x"]["samples"][("x", ())] == 1
+
+
+# -- cross-process trace propagation (ISSUE 6) ---------------------------
+
+
+def test_span_from_remote_adopts_context():
+    tr = obs.Tracer()
+    with tr.span_from_remote("http.request", "ab" * 16, "cd" * 8,
+                             route="/x") as s:
+        assert s.trace_id == "ab" * 16
+        assert s.parent_id == "cd" * 8
+        with tr.span("inner") as child:
+            assert child.trace_id == "ab" * 16
+    t = tr.traces(trace_id="ab" * 16)[0]
+    assert t["name"] == "http.request"
+    assert {sp["name"] for sp in t["spans"]} == {"http.request", "inner"}
+
+
+def test_span_from_remote_rejects_malformed_ids():
+    """Propagation headers are attacker-controlled: malformed ids must
+    fall back to a fresh local trace, not corrupt the ring."""
+    tr = obs.Tracer()
+    for bad_tid, bad_psid in (("", "cd" * 8), ("ab" * 16, "NOPE"),
+                              ("ab" * 40, "cd" * 8), ("g" * 16, "cd" * 8)):
+        with tr.span_from_remote("r", bad_tid, bad_psid) as s:
+            assert s.trace_id != bad_tid or s.parent_id != bad_psid
+    # an already-open local parent wins over the remote context
+    with tr.span("outer") as outer:
+        with tr.span_from_remote("r", "ab" * 16, "cd" * 8) as s:
+            assert s.trace_id == outer.trace_id
+
+
+def test_merge_chrome_traces_assigns_process_tracks():
+    tr_a, tr_b = obs.Tracer(), obs.Tracer()
+    with tr_a.span_from_remote("route", "ee" * 16, "ff" * 8):
+        pass
+    with tr_b.span_from_remote("serve", "ee" * 16, "ff" * 8):
+        pass
+    doc = obs.merge_chrome_traces([
+        ("router", tr_a.chrome_trace(trace_id="ee" * 16)),
+        ("replica-0", tr_b.chrome_trace(trace_id="ee" * 16))])
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert [m["args"]["name"] for m in meta] == ["router", "replica-0"]
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in spans} == {1, 2}
+    assert {e["args"]["trace_id"] for e in spans} == {"ee" * 16}
+
+
+# -- request timelines + SLO burn rates (ISSUE 6) ------------------------
+
+
+def test_request_timeline_itl_excludes_preemption_holes():
+    clk = _FakeClock()
+    tl = obs.RequestTimeline("req-1", model="tiny", tenant="live",
+                             clock=clk)
+    tl.event("enqueue")
+    clk.t = 1.0
+    tl.event("admit", slot=0)
+    clk.t = 1.5
+    assert tl.token() is None          # first token: no predecessor
+    clk.t = 1.6
+    assert tl.token() == pytest.approx(0.1)
+    clk.t = 2.0
+    tl.event("preempt", slot=0)
+    clk.t = 5.0
+    tl.event("resume", slot=1)
+    clk.t = 5.2
+    assert tl.token() is None          # gap spans the hole: not an ITL
+    clk.t = 5.3
+    assert tl.token() == pytest.approx(0.1)
+    tl.event("finish")
+    assert tl.done
+    assert tl.queue_wait_s == pytest.approx(1.0)
+    assert tl.ttft_s == pytest.approx(1.5)
+    assert tl.itls() == [pytest.approx(0.1), pytest.approx(0.1)]
+    d = tl.to_dict()
+    assert d["tokens"] == 4 and d["itl"]["count"] == 2
+    assert d["events"][0]["t"] == 0.0  # times relative to enqueue
+    json.dumps(d)  # endpoint shape must be JSON-serializable
+
+
+def test_timeline_store_evicts_oldest():
+    store = obs.TimelineStore(capacity=2)
+    for rid in ("a", "b", "c"):
+        store.add(obs.RequestTimeline(rid))
+    assert store.get("a") is None
+    assert store.get("c") is not None and len(store) == 2
+
+
+def test_slo_engine_burn_rates_windowed():
+    clk = _FakeClock()
+    eng = obs.SloEngine(
+        [obs.Slo("ttft", 0.95, threshold_s=0.5),
+         obs.Slo("errors", 0.99)],
+        short_window_s=60, long_window_s=600, clock=clk)
+    # zero-seeded: every slo x window emitted before any traffic
+    assert {(lbl["slo"], lbl["window"]) for _, lbl, _ in
+            eng.expositions()} == {("ttft", "short"), ("ttft", "long"),
+                                   ("errors", "short"), ("errors", "long")}
+    for v in (0.1, 0.2, 0.6, 0.7):     # 2 bad of 4 -> frac 0.5
+        eng.observe("ttft", v)
+    eng.observe("unknown", 9.9)        # dropped silently, never raises
+    rates = eng.burn_rates()
+    assert rates[("ttft", "short")] == pytest.approx(0.5 / 0.05)
+    # the bad samples age out of the short window but not the long one
+    clk.t = 120.0
+    for v in (0.1, 0.1):
+        eng.observe("ttft", v)
+    rates = eng.burn_rates()
+    assert rates[("ttft", "short")] == 0.0
+    assert rates[("ttft", "long")] == pytest.approx((2 / 6) / 0.05)
+    eng.record("errors", good=False)
+    assert eng.burn_rates()[("errors", "short")] == \
+        pytest.approx(1.0 / 0.01)
