@@ -1,7 +1,7 @@
 # Developer entry points (the reference drives everything through
 # per-component Makefiles; here one root Makefile covers the repo).
 
-.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check serving-check fleet-check kernels-check tenancy-check
+.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check serving-check fleet-check kernels-check tenancy-check chaos-check
 
 verify-all:  ## the full evidence sweep, one command
 	python -m pytest tests -q -m "slow or not slow"
@@ -50,10 +50,17 @@ kernels-check: ## Pallas kernels vs XLA oracles, interpret mode, both tiers
 	  tests/test_decode_attention.py \
 	  tests/test_paged_attention_kernel.py -q -m "slow or not slow"
 
-fleet-check: ## fleet router gate: unit suite + 2-replica routed loadtest
-	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q
+fleet-check: ## fleet router gate: unit + migration suites + 2-replica routed loadtest
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py \
+	  tests/test_migration.py -q -m "slow or not slow"
 	JAX_PLATFORMS=cpu python loadtest/serving_loadtest.py --mode fleet \
 	  --fleet-replicas 2 --clients 4 --requests 12 --max-new 8
+
+chaos-check: ## fault-injection gate: migration parity suite + seeded chaos loadtest
+	JAX_PLATFORMS=cpu python -m pytest tests/test_migration.py \
+	  tests/test_fleet.py -q -m "slow or not slow"
+	JAX_PLATFORMS=cpu python loadtest/serving_loadtest.py --mode chaos \
+	  --clients 8 --requests 48 --max-new 16
 
 tenancy-check: ## multi-tenant QoS gate: unit suite + noisy-neighbor A/B loadtest
 	JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q \

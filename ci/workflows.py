@@ -410,6 +410,7 @@ def fleet_check_workflow() -> dict:
                                        "kubeflow_tpu/serving/**",
                                        "loadtest/serving_loadtest.py",
                                        "tests/test_fleet.py",
+                                       "tests/test_migration.py",
                                        "Makefile"]},
             "push": {"branches": ["main"]},
         },
@@ -423,6 +424,43 @@ def fleet_check_workflow() -> dict:
                     {"run": "pip install -e .[ci] pytest"},
                     {"name": "fleet unit + routed loadtest gate",
                      "run": "make fleet-check",
+                     "env": {"JAX_PLATFORMS": "cpu"}},
+                ],
+            }
+        },
+    }
+
+
+def chaos_check_workflow() -> dict:
+    """Fault-injection gate: `make chaos-check` runs the migration
+    token-identity/rollback suite AND the seeded chaos loadtest —
+    drop/delay/duplicate faults, a SIGKILLed replica, an instant
+    migrate-drain, and a wedged-transfer probe, all asserted to zero
+    client-visible failures and token-exact streams. Failover and
+    drain are robustness claims; this keeps them re-proven on every
+    serving or fleet change instead of measured once and left to
+    rot."""
+    return {
+        "name": "chaos check",
+        "on": {
+            "pull_request": {"paths": ["kubeflow_tpu/fleet/**",
+                                       "kubeflow_tpu/serving/**",
+                                       "loadtest/serving_loadtest.py",
+                                       "tests/test_fleet.py",
+                                       "tests/test_migration.py",
+                                       "Makefile"]},
+            "push": {"branches": ["main"]},
+        },
+        "jobs": {
+            "chaos-check": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e .[ci] pytest"},
+                    {"name": "migration suite + chaos loadtest gate",
+                     "run": "make chaos-check",
                      "env": {"JAX_PLATFORMS": "cpu"}},
                 ],
             }
@@ -518,6 +556,7 @@ def all_workflows() -> dict[str, dict]:
     out["slow_tier_test.yaml"] = slow_tier_workflow()
     out["serving_check.yaml"] = serving_check_workflow()
     out["fleet_check.yaml"] = fleet_check_workflow()
+    out["chaos_check.yaml"] = chaos_check_workflow()
     out["tenancy_check.yaml"] = tenancy_check_workflow()
     out["kernels_check.yaml"] = kernels_check_workflow()
     out["frontend_test.yaml"] = frontend_workflow()

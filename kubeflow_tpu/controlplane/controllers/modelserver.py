@@ -71,12 +71,15 @@ MS_NAME_LABEL = "modelserver-name"
 # controller clamps it into [spec.replicas, spec.max_replicas].
 DESIRED_REPLICAS_ANNOTATION = "kubeflow-tpu.dev/desired-replicas"
 # Scale-down protocol: excess pods are annotated draining-since first
-# (a real deployment would POST /drain to the replica, which stops
-# admission and finishes in-flight slots); only after DRAIN_GRACE_S
-# does the controller delete them and shrink the Deployment. Module
-# constant so tests shrink the window instead of sleeping 5 s.
+# (a real deployment POSTs /fleet/drain, which now pushes every
+# in-flight sequence to healthy peers via live KV-block migration);
+# only after DRAIN_GRACE_S does the controller delete them and shrink
+# the Deployment. With migrate-and-exit the replica is empty within
+# ~2 s regardless of generation length, so the grace window matches
+# that bound instead of the old wait-out-the-longest-generation guess.
+# Module constant so tests shrink the window instead of sleeping.
 DRAIN_ANNOTATION = "kubeflow-tpu.dev/draining-since"
-DRAIN_GRACE_S = 5.0
+DRAIN_GRACE_S = 2.0
 
 
 class ModelServerController(Controller):

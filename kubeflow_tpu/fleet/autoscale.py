@@ -30,7 +30,7 @@ import math
 from dataclasses import dataclass
 from typing import Any, Iterable
 
-from kubeflow_tpu.fleet.registry import DEGRADED, READY
+from kubeflow_tpu.fleet.registry import DEGRADED, DRAINING, READY
 
 
 @dataclass(frozen=True)
@@ -74,13 +74,20 @@ def recommend_replicas(replicas: Iterable[Any], *,
     def clamp(n: int) -> int:
         return max(min_replicas, min(n, max_replicas))
 
-    live = [r for r in replicas
+    reps = list(replicas)
+    live = [r for r in reps
             if _get(r, "state", READY) in (READY, DEGRADED)]
+    # draining replicas are exiting capacity — surfaced as a signal so
+    # the autoscale consumer can tell "shrinking on purpose" from
+    # "shrunk by failures" when it reads the recommendation
+    draining = sum(1 for r in reps
+                   if _get(r, "state", READY) == DRAINING)
     n = len(live)
     if n == 0:
         return Recommendation(
             clamp(min_replicas), "no live replicas",
-            {"live": 0, "demand": 0, "kv_pressure": 0.0})
+            {"live": 0, "demand": 0, "kv_pressure": 0.0,
+             "draining": draining})
 
     queued = sum(_get(r, "queue_depth") for r in live)
     active = sum(_get(r, "active_slots") for r in live)
@@ -111,4 +118,5 @@ def recommend_replicas(replicas: Iterable[Any], *,
         "live": n, "demand": demand, "queued": queued, "active": active,
         "slots_per_replica": round(slots_per, 2),
         "kv_pressure": round(kv_pressure, 4),
+        "draining": draining,
     })

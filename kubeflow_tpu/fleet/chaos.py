@@ -1,0 +1,100 @@
+"""Deterministic fleet fault injection.
+
+One `ChaosInjector` rides inside the router (`create_router_app(
+chaos=...)`) and perturbs the router->replica path with a SEEDED fault
+plan, so a chaos run is reproducible bit-for-bit: same seed, same
+faults, same order. Faults at this layer:
+
+- **drop** — the dispatch never reaches the replica (raised as a
+  retryable upstream error; the router's retry/hedge machinery must
+  absorb it with zero client-visible failures);
+- **delay** — the dispatch is held for `delay_s` before it proceeds
+  (inflates tails; the chaos loadtest asserts the inflation stays
+  bounded);
+- **duplicate** — the same request body is dispatched twice (the
+  shadow's outcome is discarded; replicas must tolerate at-least-once
+  delivery);
+- **heartbeat blackhole** — a replica's heartbeats are swallowed for a
+  window (the router's sweeper sees staleness and walks the
+  degraded/dead path with the process still alive).
+
+Process-level faults (SIGKILL a replica, wedge a migration
+mid-transfer) don't belong here — they are driven by the chaos
+loadtest (`loadtest.serving_loadtest --mode chaos`), which owns the
+replica processes. This module is pure host Python with no jax or
+aiohttp imports; the injector is event-loop-friendly (its only await
+is `asyncio.sleep`).
+
+The plan is decided per-call from a dedicated `random.Random(seed)`:
+injecting a fault never consumes entropy from anything else, and two
+routers built with the same seed and fed the same call sequence make
+identical decisions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+__all__ = ["ChaosInjector"]
+
+
+class ChaosInjector:
+    def __init__(self, seed: int, *, drop_rate: float = 0.0,
+                 delay_rate: float = 0.0, delay_s: float = 0.05,
+                 duplicate_rate: float = 0.0,
+                 heartbeat_blackhole: dict[str, int] | None = None):
+        """`*_rate` are per-dispatch probabilities in [0, 1] (drawn in
+        a fixed order, so the fault sequence is a pure function of the
+        seed and the call count). `heartbeat_blackhole` maps replica id
+        -> number of consecutive heartbeats to swallow, armed by
+        `blackhole()` at any point mid-run."""
+        for nm, rate in (("drop_rate", drop_rate),
+                         ("delay_rate", delay_rate),
+                         ("duplicate_rate", duplicate_rate)):
+            if not 0.0 <= rate <= 1.0:
+                raise ValueError(f"{nm} must be in [0, 1], got {rate}")
+        self.seed = int(seed)
+        self.drop_rate = drop_rate
+        self.delay_rate = delay_rate
+        self.delay_s = delay_s
+        self.duplicate_rate = duplicate_rate
+        self._rng = random.Random(self.seed)
+        self._blackhole: dict[str, int] = dict(heartbeat_blackhole or {})
+        # ledger of every injected fault, for the loadtest's evidence
+        # line: {"drop": N, "delay": N, "duplicate": N, "blackhole": N}
+        self.injected: dict[str, int] = {
+            "drop": 0, "delay": 0, "duplicate": 0, "blackhole": 0}
+
+    async def before_dispatch(self, replica_id: str) -> str | None:
+        """Called by the router once per upstream dispatch. Returns
+        "drop" / "duplicate" / None; a delay fault sleeps here before
+        returning. Draw order is fixed (drop, delay, duplicate) so the
+        fault sequence replays exactly under one seed."""
+        r_drop = self._rng.random()
+        r_delay = self._rng.random()
+        r_dup = self._rng.random()
+        if r_drop < self.drop_rate:
+            self.injected["drop"] += 1
+            return "drop"
+        if r_delay < self.delay_rate:
+            self.injected["delay"] += 1
+            await asyncio.sleep(self.delay_s)
+        if r_dup < self.duplicate_rate:
+            self.injected["duplicate"] += 1
+            return "duplicate"
+        return None
+
+    def blackhole(self, replica_id: str, beats: int) -> None:
+        """Arm a heartbeat blackhole: swallow the next `beats`
+        heartbeats from `replica_id`."""
+        self._blackhole[replica_id] = max(
+            int(beats), self._blackhole.get(replica_id, 0))
+
+    def heartbeat_blackholed(self, replica_id: str) -> bool:
+        left = self._blackhole.get(replica_id, 0)
+        if left <= 0:
+            return False
+        self._blackhole[replica_id] = left - 1
+        self.injected["blackhole"] += 1
+        return True

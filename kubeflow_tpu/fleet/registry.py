@@ -74,6 +74,12 @@ class Replica:
     # router-side accounting
     inflight: int = 0            # proxied requests currently open
     failures: int = 0            # consecutive router-observed failures
+    # circuit breaker: while clock() < circuit_open_until the replica
+    # is skipped by fresh routing picks. After the cooldown the next
+    # pick IS the half-open probe: one more failure re-opens the
+    # circuit (failures is still at/over the trip line), one success
+    # closes it (note_success zeroes both).
+    circuit_open_until: float = 0.0
 
     def load(self) -> int:
         """Least-queue-depth ordering key: heartbeat-reported queue plus
@@ -89,6 +95,7 @@ class Replica:
             "kv_blocks_free": self.kv_blocks_free,
             "kv_blocks_total": self.kv_blocks_total,
             "inflight": self.inflight, "failures": self.failures,
+            "circuit_open_until": self.circuit_open_until,
             "last_heartbeat_age_s": None,
         }
 
@@ -99,6 +106,8 @@ class ReplicaRegistry:
 
     def __init__(self, *, degraded_after_s: float = 6.0,
                  dead_after_s: float = 20.0, dead_failures: int = 3,
+                 circuit_failures: int = 2,
+                 circuit_cooldown_s: float = 2.0,
                  overload_depth: int = 64,
                  clock: Callable[[], float] = time.monotonic):
         if not degraded_after_s < dead_after_s:
@@ -108,6 +117,11 @@ class ReplicaRegistry:
         self.degraded_after_s = degraded_after_s
         self.dead_after_s = dead_after_s
         self.dead_failures = dead_failures
+        # consecutive failures that open the per-replica circuit (must
+        # stay below dead_failures to matter — DEAD already unroutes)
+        # and how long the circuit stays open before a half-open probe
+        self.circuit_failures = circuit_failures
+        self.circuit_cooldown_s = circuit_cooldown_s
         # affinity target past this load routes by least-depth instead:
         # a hot prefix must not pile the whole fleet's traffic onto one
         # replica once the cache win is smaller than the queue loss
@@ -159,6 +173,7 @@ class ReplicaRegistry:
         elif rep.state in (DEGRADED, DEAD):
             rep.state = READY      # recovery
             rep.failures = 0
+            rep.circuit_open_until = 0.0  # live heartbeat = probe passed
         return True
 
     @staticmethod
@@ -194,6 +209,8 @@ class ReplicaRegistry:
         if rep is None:
             return
         rep.failures += 1
+        if rep.failures >= self.circuit_failures:
+            rep.circuit_open_until = self.clock() + self.circuit_cooldown_s
         if rep.failures >= self.dead_failures:
             rep.state = DEAD
         elif rep.state == READY:
@@ -203,6 +220,14 @@ class ReplicaRegistry:
         rep = self._replicas.get(replica_id)
         if rep is not None:
             rep.failures = 0
+            rep.circuit_open_until = 0.0
+
+    def circuit_open(self, replica_id: str) -> bool:
+        """Is this replica's circuit currently open? (the
+        `fleet_circuit_open{replica}` gauge reads this)"""
+        rep = self._replicas.get(replica_id)
+        return (rep is not None
+                and self.clock() < rep.circuit_open_until)
 
     def sweep(self) -> None:
         """Apply heartbeat-staleness transitions. Call before routing
@@ -234,12 +259,21 @@ class ReplicaRegistry:
         """Candidates in preference order: the ready set, else (every
         ready replica excluded/absent) the degraded set — a degraded
         replica may still answer, and retrying it beats a client 503."""
+        now = self.clock()
+
+        def _closed(pool: list[Replica]) -> list[Replica]:
+            # skip open circuits — but when EVERY candidate's circuit
+            # is open, route anyway: a long-shot retry beats a certain
+            # client 503, and the attempt doubles as the probe
+            ok = [r for r in pool if now >= r.circuit_open_until]
+            return ok or pool
+
         ready = [r for r in self._replicas.values()
                  if r.state == READY and r.id not in exclude]
         if ready:
-            return ready
-        return [r for r in self._replicas.values()
-                if r.state == DEGRADED and r.id not in exclude]
+            return _closed(ready)
+        return _closed([r for r in self._replicas.values()
+                        if r.state == DEGRADED and r.id not in exclude])
 
     def pick(self, key: bytes, exclude: frozenset | set = frozenset()
              ) -> tuple[Replica | None, str]:

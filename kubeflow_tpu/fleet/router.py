@@ -31,6 +31,7 @@ import asyncio
 import json
 import logging
 import math
+import secrets
 import time
 
 import aiohttp
@@ -75,6 +76,85 @@ def affinity_key(body: dict, block_size: int) -> bytes:
     return " ".join(str(x) for x in toks[:block_size]).encode()
 
 
+def _byte_decode_fleet(ids) -> str:
+    """Best-effort byte-tokenizer decode for SPLICED text-mode
+    responses (mirrors the serving byte tokenizer: bytes at +3,
+    specials below). Only used when the router itself rebuilds the
+    text of a failed-over generation; replicas with a real tokenizer
+    should use token-mode bodies through the fleet door."""
+    return bytes(t - _BYTE_OFFSET for t in ids
+                 if t >= _BYTE_OFFSET).decode("utf-8", errors="replace")
+
+
+def _resume_from_checkpoint(body: dict, ck: dict,
+                            sent: list) -> tuple[bytes | None, int]:
+    """Failover re-dispatch body from a heartbeat checkpoint: replay
+    prompt = checkpoint prompt (embeds any registered-prefix
+    expansion, so 'prefix' is dropped) + every token the client
+    already holds; budget = what remains. Returns (raw, remaining) —
+    remaining <= 0 means the generation already completed."""
+    toks = [int(t) for t in ck.get("tokens", [])]
+    n_out = len(ck.get("out", []))
+    prompt = toks[: len(toks) - n_out]
+    remaining = int(ck.get("max_new", 0)) - len(sent)
+    if remaining <= 0 or not prompt:
+        return None, remaining
+    nb = {k: v for k, v in body.items()
+          if k not in ("text", "tokens", "prefix", "max_new")}
+    nb["tokens"] = [prompt + [int(t) for t in sent]]
+    nb["max_new"] = remaining
+    return json.dumps(nb).encode(), remaining
+
+
+def _resume_from_body(body: dict, sent: list) -> bytes | None:
+    """Checkpoint-less failover for token-mode bodies with an explicit
+    max_new: splice the delivered tokens onto the client's own prompt.
+    (The 'prefix' field stays — the replica re-expands it exactly as
+    the dead one did.) Returns None when the body is not resumable
+    this way — the caller re-sends the original and skips."""
+    t = body.get("tokens")
+    if (not isinstance(t, list) or len(t) != 1
+            or not isinstance(t[0], list)
+            or not isinstance(body.get("max_new"), int)):
+        return None
+    remaining = body["max_new"] - len(sent)
+    if remaining <= 0:
+        return None
+    nb = {k: v for k, v in body.items() if k not in ("tokens", "max_new")}
+    nb["tokens"] = [list(t[0]) + [int(x) for x in sent]]
+    nb["max_new"] = remaining
+    return json.dumps(nb).encode()
+
+
+def _splice_oneshot(payload: bytes, prepend: list,
+                    text_mode: bool) -> bytes:
+    """Merge a resumed one-shot response with the tokens the dead
+    replica already produced: the client must see ONE complete row, as
+    if nothing failed. Unparseable payloads pass through untouched."""
+    try:
+        pj = json.loads(payload)
+        rows = pj["tokens"]
+        rows[0] = [int(t) for t in prepend] + rows[0]
+    except (KeyError, IndexError, TypeError, ValueError):
+        return payload
+    if text_mode:
+        pj["text"] = _byte_decode_fleet(rows[0])
+    return json.dumps(pj).encode()
+
+
+def _parse_sse_event(raw: bytes) -> dict | None:
+    """One `data: {...}` SSE frame -> dict, or None for anything the
+    serving replicas don't emit (comments, malformed JSON)."""
+    line = raw.strip()
+    if not line.startswith(b"data:"):
+        return None
+    try:
+        ev = json.loads(line[5:].strip())
+    except (ValueError, UnicodeDecodeError):
+        return None
+    return ev if isinstance(ev, dict) else None
+
+
 class FleetObs:
     """Router observability bundle (the serving `ServingObs` pattern):
     metric registry + tracer + the fleet_* instruments."""
@@ -98,6 +178,11 @@ class FleetObs:
             "fleet_hedge_wins_total",
             "Hedged duplicates that answered before the primary",
             self.registry)
+        self.failover = Counter(
+            "fleet_failover_total",
+            "In-flight generations re-dispatched to a healthy replica "
+            "after their replica failed mid-request (checkpoint resume "
+            "or seamless stream splice)", self.registry)
         self.route_latency = obs_lib.get_or_create_histogram(
             self.registry, "fleet_route_duration_seconds",
             "Routed request latency through the router, by model and "
@@ -138,24 +223,39 @@ class FleetObs:
             self.registry.register(self.slo)
         except ValueError:
             pass  # shared registry already carries a burn-rate gauge
+        circuit_g = Gauge(
+            "fleet_circuit_open",
+            "1 while the replica's circuit breaker is open (skipped by "
+            "fresh routing picks until the half-open probe)",
+            self.registry)
         # zero-seed so the series exist (at 0) before any traffic
         for reason in ROUTE_REASONS:
             self.route_total.inc(0, reason=reason)
         self.hedge_wins.inc(0)
+        self.failover.inc(0)
 
         def collect():
             reg.sweep()
             for state, nn in reg.counts().items():
                 replicas_g.set(nn, state=state)
+            for rep in reg.replicas():
+                circuit_g.set(int(reg.circuit_open(rep.id)),
+                              replica=self.replica_guard.admit(rep.id))
 
         self.registry.register_collector(collect)
 
 
 class _FleetState:
+    # bounds on the heartbeat-fed checkpoint store: entries older than
+    # the TTL describe requests that finished or already failed over
+    CHECKPOINT_TTL_S = 60.0
+    CHECKPOINT_CAP = 4096
+
     def __init__(self, registry: ReplicaRegistry, obs: FleetObs, *,
                  block_size: int, policy: str, hedge_after_s: float,
                  retries: int, backoff_s: float, timeout_s: float,
-                 tenancy: TenancyConfig | None = None):
+                 tenancy: TenancyConfig | None = None,
+                 max_attempts: int | None = None, chaos=None):
         self.registry = registry
         self.obs = obs
         self.block_size = block_size
@@ -164,8 +264,20 @@ class _FleetState:
         self.retries = retries
         self.backoff_s = backoff_s
         self.timeout_s = timeout_s
+        # retry BUDGET: total upstream dispatches one client request
+        # may cost (primaries + retries + hedges together) — a slow
+        # fleet must not amplify every request into an unbounded fan
+        self.max_attempts = (max_attempts if max_attempts is not None
+                             else retries + 2)
         self.session: aiohttp.ClientSession | None = None
         self.rr = 0  # round-robin cursor (policy="roundrobin" A/B arm)
+        # fleet.chaos.ChaosInjector (loadtest --mode chaos): seeded
+        # fault hooks on the router->replica path. None in production.
+        self.chaos = chaos
+        # request_id -> {"ck": checkpoint, "replica": id, "t": stamp}
+        # fed by heartbeats; read by the failover paths when the
+        # owning replica dies mid-request
+        self.checkpoints: dict[str, dict] = {}
         # Router-side tenant rate limiting: the same TenancyConfig the
         # replicas run, enforced at the fleet door so a flooding tenant
         # is shed ONCE here instead of N times downstream. The replicas
@@ -173,6 +285,34 @@ class _FleetState:
         self.tenancy = tenancy
         self.ledger = TenantLedger(tenancy) if tenancy is not None \
             else None
+
+    def ingest_checkpoints(self, replica_id: str, cks) -> None:
+        """Fold one heartbeat's sequence checkpoints into the store
+        (bounded: stale entries pruned, oldest dropped over the cap)."""
+        now = time.monotonic()
+        if isinstance(cks, list):
+            for ck in cks[:512]:
+                if not isinstance(ck, dict):
+                    continue
+                rid = str(ck.get("request_id", ""))
+                if rid:
+                    self.checkpoints[rid] = {
+                        "ck": ck, "replica": replica_id, "t": now}
+        stale = now - self.CHECKPOINT_TTL_S
+        for rid in [r for r, e in self.checkpoints.items()
+                    if e["t"] < stale]:
+            del self.checkpoints[rid]
+        while len(self.checkpoints) > self.CHECKPOINT_CAP:
+            oldest = min(self.checkpoints, key=lambda r:
+                         self.checkpoints[r]["t"])
+            del self.checkpoints[oldest]
+
+    def checkpoint_for(self, request_id: str) -> dict | None:
+        entry = self.checkpoints.get(request_id)
+        if entry is None or (time.monotonic() - entry["t"]
+                             > self.CHECKPOINT_TTL_S):
+            return None
+        return entry["ck"]
 
 
 class _UpstreamError(RuntimeError):
@@ -229,6 +369,36 @@ def _inject_trace_context(st: _FleetState, headers: dict) -> dict:
             "X-Parent-Span": span.span_id}
 
 
+async def _chaos_shadow(st: _FleetState, url: str, raw: bytes,
+                        headers: dict) -> None:
+    """Fire-and-forget duplicate dispatch (chaos 'duplicate' fault):
+    exercises at-least-once delivery — the replica must tolerate the
+    same request body arriving twice. The shadow's outcome is
+    discarded."""
+    try:
+        async with st.session.post(
+                url, data=raw, headers=headers,
+                timeout=aiohttp.ClientTimeout(total=st.timeout_s)) as r:
+            await r.read()
+    except Exception:  # noqa: BLE001 — shadow outcomes never surface
+        pass
+
+
+async def _chaos_gate(st: _FleetState, rep, name: str, raw: bytes,
+                      headers: dict) -> None:
+    """Apply the injector's dispatch faults for one router->replica
+    call: may sleep (delay), spawn a duplicate shadow dispatch, or
+    raise `_UpstreamError` (drop)."""
+    if st.chaos is None:
+        return
+    action = await st.chaos.before_dispatch(rep.id)
+    if action == "duplicate":
+        asyncio.ensure_future(_chaos_shadow(
+            st, f"{rep.url}/v1/models/{name}:generate", raw, headers))
+    elif action == "drop":
+        raise _UpstreamError(f"chaos: dropped dispatch to {rep.id}")
+
+
 async def _call_replica(st: _FleetState, rep, name: str, raw: bytes,
                         tried: set, headers: dict):
     """One proxied generate against one replica. Success returns
@@ -237,6 +407,7 @@ async def _call_replica(st: _FleetState, rep, name: str, raw: bytes,
     `_UpstreamError` so the caller moves on."""
     st.registry.note_dispatch(rep.id)
     try:
+        await _chaos_gate(st, rep, name, raw, headers)
         async with st.session.post(
                 f"{rep.url}/v1/models/{name}:generate", data=raw,
                 headers=_inject_trace_context(st, headers),
@@ -258,20 +429,24 @@ async def _call_replica(st: _FleetState, rep, name: str, raw: bytes,
 
 async def _race_hedged(st: _FleetState, primary, name: str, raw: bytes,
                        key: bytes, tried: set, model: str,
-                       headers: dict):
+                       headers: dict, budget: list):
     """Dispatch to `primary`; past the hedge deadline, duplicate to a
-    second replica and take whichever answers first. Returns
+    second replica and take whichever answers first. Every dispatch
+    (primary and hedge alike) spends one unit of the request's attempt
+    `budget` — a hedge is skipped once the budget is gone. Returns
     (status, payload, replica, hedge_won, upstream_trace) or None when
     every dispatched replica failed (all are in `tried` by then)."""
+    budget[0] -= 1
     tasks = {asyncio.create_task(_call_replica(st, primary, name, raw,
                                                tried, headers))}
     hedged_id = None
     if st.hedge_after_s > 0:
         done, _pending = await asyncio.wait(tasks,
                                             timeout=st.hedge_after_s)
-        if not done:
+        if not done and budget[0] > 0:
             hedge_rep, _ = _choose(st, key, tried | {primary.id})
             if hedge_rep is not None:
+                budget[0] -= 1
                 hedged_id = hedge_rep.id
                 st.obs.route_total.inc(reason="hedge")
                 tasks.add(asyncio.create_task(_call_replica(
@@ -339,26 +514,69 @@ async def _routed_generate(request: web.Request):
     fwd_headers, throttled = _tenant_gate(st, request)
     if throttled is not None:
         return throttled
+    # Router-minted request id: forwarded to every dispatch (the
+    # replica keys its token timeline and sequence checkpoints by it),
+    # so a failover resume finds the dead replica's checkpoint and the
+    # timeline survives the hop.
+    rid = request.headers.get("X-Request-Id") or secrets.token_hex(8)
+    fwd_headers["X-Request-Id"] = rid
     if isinstance(body, dict) and body.get("stream"):
         return await _routed_stream(request, st, name, raw, body,
-                                    fwd_headers)
+                                    fwd_headers, rid)
     key = affinity_key(body, st.block_size)
     t0 = time.perf_counter()
     tried: set[str] = set()
+    budget = [st.max_attempts]
     with st.obs.tracer.span("fleet.route", model=name) as span:
         for attempt in range(st.retries + 1):
-            replica, reason = _choose(st, key, tried)
-            if replica is None:
+            if budget[0] <= 0:
                 break
+            replica, reason = _choose(st, key, tried)
+            if replica is None and tried:
+                # every routable replica failed once this request:
+                # transient faults (a chaos drop, a connection blip)
+                # deserve a fresh sweep while attempt budget remains —
+                # persistent corpses are held off by their circuit
+                # breakers, not by this per-request memory
+                tried.clear()
+                replica, reason = _choose(st, key, tried)
+            if replica is None:
+                # fleet-wide blip: every replica dead or draining for a
+                # beat (a lone survivor can trip its breaker to DEAD
+                # with the heartbeat that would resurrect it still in
+                # flight). Burn a retry waiting — the sleep yields the
+                # event loop so that heartbeat can land — instead of
+                # 503ing with attempt budget left.
+                await asyncio.sleep(
+                    min(st.backoff_s * (2 ** attempt), 1.0))
+                continue
             if attempt:
                 reason = "retry"
                 await asyncio.sleep(
                     min(st.backoff_s * (2 ** (attempt - 1)), 1.0))
-            result = await _race_hedged(st, replica, name, raw, key,
-                                        tried, name, fwd_headers)
+            # crash failover: a retry whose dead replica checkpointed
+            # partial output resumes from it (re-prefill, decode only
+            # the remainder) instead of regenerating from scratch
+            dispatch_raw, prepend = raw, []
+            ck = st.checkpoint_for(rid) if attempt else None
+            if (ck is not None and ck.get("out")
+                    and isinstance(body, dict)
+                    and not body.get("logprobs")):
+                rb, remaining = _resume_from_checkpoint(
+                    body, ck, list(ck["out"]))
+                if rb is not None and remaining > 0:
+                    dispatch_raw, prepend = rb, list(ck["out"])
+            result = await _race_hedged(st, replica, name,
+                                        dispatch_raw, key, tried,
+                                        name, fwd_headers, budget)
             if result is None:
                 continue  # dispatched replicas failed; retry others
             status, payload, rep, hedge_won, trace = result
+            if prepend and status == 200:
+                payload = _splice_oneshot(
+                    payload, prepend,
+                    isinstance(body, dict) and "text" in body)
+                st.obs.failover.inc()
             dt = time.perf_counter() - t0
             st.obs.route_total.inc(reason=reason)
             st.obs.route_latency.observe(dt, model=name, reason=reason)
@@ -369,7 +587,8 @@ async def _routed_generate(request: web.Request):
             if trace:
                 span.attrs["replica_trace"] = trace
             headers = {"X-Fleet-Replica": rep.id,
-                       "X-Fleet-Route-Reason": reason}
+                       "X-Fleet-Route-Reason": reason,
+                       "X-Request-Id": rid}
             if trace:
                 headers["X-Fleet-Replica-Trace"] = trace
             return web.Response(body=payload, status=status,
@@ -384,27 +603,75 @@ async def _routed_generate(request: web.Request):
 
 async def _routed_stream(request: web.Request, st: _FleetState,
                          name: str, raw: bytes, body: dict,
-                         fwd_headers: dict):
-    """SSE passthrough: affinity-routed, retried only BEFORE the first
-    upstream byte (once headers are out a failure is the client's to
-    see — same contract as the replicas' own mid-stream errors). No
-    hedging: duplicating a stream would decode the prompt twice for
-    one winner on every long request, the exact tail case hedging is
-    meant to be cheap insurance for."""
+                         fwd_headers: dict, rid: str):
+    """SSE with mid-stream failover. The router PARSES the upstream
+    event stream instead of blind passthrough: token events are
+    re-emitted to the client as they arrive, and when the replica dies
+    mid-stream (connection cut, 5xx, or a terminal error event) the
+    router picks another replica, resumes from the heartbeat
+    checkpoint — or re-issues the request and swallows the tokens the
+    client already has — and splices the two halves into ONE stream
+    with no duplicate or missing tokens. Retries before the first
+    byte behave as before. No hedging: duplicating a stream would
+    decode the prompt twice for one winner on every long request."""
     key = affinity_key(body, st.block_size)
     tried: set[str] = set()
+    sent: list[int] = []   # token ids already forwarded to the client
+    resp: web.StreamResponse | None = None
+    text_mode = isinstance(body, dict) and "text" in body
+    budget = st.max_attempts
+    failed_over = False
+    final_evt: dict | None = None
     for attempt in range(st.retries + 1):
-        replica, reason = _choose(st, key, tried)
-        if replica is None:
+        if budget <= 0 or final_evt is not None:
             break
+        replica, reason = _choose(st, key, tried)
+        if replica is None and tried:
+            # same fresh sweep as the one-shot path: a transient fault
+            # on the last untried replica must not strand the stream
+            # while attempt budget remains
+            tried.clear()
+            replica, reason = _choose(st, key, tried)
+        if replica is None:
+            # same fleet-wide-blip wait as the one-shot path: hold the
+            # stream open through a beat where nobody is routable
+            # rather than abandoning it with budget left
+            await asyncio.sleep(min(st.backoff_s * (2 ** attempt), 1.0))
+            continue
         if attempt:
             reason = "retry"
             await asyncio.sleep(
                 min(st.backoff_s * (2 ** (attempt - 1)), 1.0))
+        dispatch_raw, skip = raw, 0
+        if sent:
+            # mid-stream failover: prefer the checkpoint (re-prefill
+            # only), else splice onto the client's own token prompt,
+            # else replay in full and swallow what was already sent
+            ck = st.checkpoint_for(rid)
+            if ck is not None and isinstance(ck.get("out"), list):
+                rb, remaining = _resume_from_checkpoint(body, ck, sent)
+                if remaining <= 0:
+                    final_evt = {"done": True, "total": len(sent)}
+                    break
+                if rb is not None:
+                    dispatch_raw = rb
+            else:
+                rb = _resume_from_body(body, sent)
+                if rb is not None:
+                    dispatch_raw = rb
+                else:
+                    dispatch_raw, skip = raw, len(sent)
+            if not failed_over:
+                failed_over = True
+                st.obs.failover.inc()
         st.registry.note_dispatch(replica.id)
+        budget -= 1
         try:
+            await _chaos_gate(st, replica, name, dispatch_raw,
+                              fwd_headers)
             async with st.session.post(
-                    f"{replica.url}/v1/models/{name}:generate", data=raw,
+                    f"{replica.url}/v1/models/{name}:generate",
+                    data=dispatch_raw,
                     headers=_inject_trace_context(st, fwd_headers),
                     timeout=aiohttp.ClientTimeout(
                         total=st.timeout_s)) as up:
@@ -412,37 +679,99 @@ async def _routed_stream(request: web.Request, st: _FleetState,
                     st.registry.note_failure(replica.id)
                     tried.add(replica.id)
                     continue
-                st.obs.route_total.inc(reason=reason)
                 if up.content_type != "text/event-stream":
-                    # replica rejected pre-stream (4xx): passthrough
                     payload = await up.read()
-                    return web.Response(
-                        body=payload, status=up.status,
-                        content_type="application/json",
-                        headers={"X-Fleet-Replica": replica.id})
-                headers = {
-                    "Content-Type": "text/event-stream",
-                    "Cache-Control": "no-cache",
-                    "X-Fleet-Replica": replica.id,
-                }
-                up_trace = up.headers.get("X-Trace-Id", "")
-                if up_trace:
-                    headers["X-Fleet-Replica-Trace"] = up_trace
-                resp = web.StreamResponse(headers=headers)
-                await resp.prepare(request)
+                    if resp is None:
+                        # replica rejected pre-stream (4xx): passthrough
+                        st.obs.route_total.inc(reason=reason)
+                        return web.Response(
+                            body=payload, status=up.status,
+                            content_type="application/json",
+                            headers={"X-Fleet-Replica": replica.id,
+                                     "X-Request-Id": rid})
+                    # resume rejected (e.g. peer started draining):
+                    # retryable, the client stream is still open
+                    tried.add(replica.id)
+                    continue
+                st.obs.route_total.inc(reason=reason)
+                if resp is None:
+                    headers = {
+                        "Content-Type": "text/event-stream",
+                        "Cache-Control": "no-cache",
+                        "X-Fleet-Replica": replica.id,
+                        "X-Request-Id": rid,
+                    }
+                    up_trace = up.headers.get("X-Trace-Id", "")
+                    if up_trace:
+                        headers["X-Fleet-Replica-Trace"] = up_trace
+                    resp = web.StreamResponse(headers=headers)
+                    await resp.prepare(request)
+                buf = b""
+                to_skip = skip
+                upstream_error = False
                 async for chunk in up.content.iter_any():
-                    await resp.write(chunk)
-                await resp.write_eof()
+                    buf += chunk
+                    while b"\n\n" in buf:
+                        frame, buf = buf.split(b"\n\n", 1)
+                        ev = _parse_sse_event(frame)
+                        if ev is None:
+                            continue
+                        if "error" in ev:
+                            # terminal error event: NOT forwarded —
+                            # the router absorbs it and fails over
+                            upstream_error = True
+                            break
+                        if ev.get("done"):
+                            final_evt = ev
+                            break
+                        toks = ev.get("tokens")
+                        if (not isinstance(toks, list) or not toks
+                                or not isinstance(toks[0], list)
+                                or not toks[0]):
+                            continue
+                        for tok in toks[0]:
+                            if to_skip > 0:
+                                to_skip -= 1
+                                continue
+                            sent.append(int(tok))
+                            await resp.write(
+                                b"data: " + json.dumps(
+                                    {"tokens": [[int(tok)]]}).encode()
+                                + b"\n\n")
+                    if upstream_error or final_evt is not None:
+                        break
+                if upstream_error or final_evt is None:
+                    # error event or connection ended with no terminal
+                    # frame: the replica is gone mid-stream
+                    st.registry.note_failure(replica.id)
+                    tried.add(replica.id)
+                    continue
                 st.registry.note_success(replica.id)
-                return resp
-        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                _UpstreamError):
+            # _UpstreamError covers a chaos-gate drop BEFORE the
+            # dispatch: same failover path as a replica dying mid-frame
             st.registry.note_failure(replica.id)
             tried.add(replica.id)
         finally:
             st.registry.note_done(replica.id)
-    return web.json_response(
-        {"error": "no serving replica available"}, status=503,
-        headers={"Retry-After": "1"})
+    if resp is None:
+        return web.json_response(
+            {"error": "no serving replica available"}, status=503,
+            headers={"Retry-After": "1"})
+    if final_evt is None:
+        final = {"error": "no serving replica available",
+                 "total": len(sent)}
+    else:
+        final = dict(final_evt)
+        final["total"] = len(sent)
+        if failed_over and final.get("done") and text_mode:
+            # the resumed replica only saw the tail; rebuild the text
+            # over the FULL spliced output (byte tokenizer mirror)
+            final["text"] = _byte_decode_fleet(sent)
+    await resp.write(b"data: " + json.dumps(final).encode() + b"\n\n")
+    await resp.write_eof()
+    return resp
 
 
 # -- fleet control-plane endpoints ---------------------------------------
@@ -467,6 +796,7 @@ async def _register(request: web.Request):
         **{k: v for k, v in body.items()
            if k in ("queue_depth", "active_slots", "max_slots",
                     "kv_blocks_free", "kv_blocks_total")})
+    st.ingest_checkpoints(rep.id, body.get("checkpoints"))
     log.info("fleet: registered replica %s at %s", rep.id, rep.url)
     return web.json_response({"id": rep.id, "state": rep.state})
 
@@ -478,6 +808,14 @@ async def _heartbeat(request: web.Request):
     except Exception:
         return web.json_response({"error": "invalid JSON"}, status=400)
     rid = str(body.get("id", ""))
+    if st.chaos is not None and st.chaos.heartbeat_blackholed(rid):
+        # chaos blackhole: swallow the beat (the replica believes it
+        # landed; the sweeper sees staleness) — the crash-detection
+        # path without killing anything
+        return web.json_response({"ok": True})
+    # sequence checkpoints ride the heartbeat raw payload (they are
+    # NOT registry stats): fold them into the failover store first
+    st.ingest_checkpoints(rid, body.get("checkpoints"))
     ok = st.registry.heartbeat(rid, **{
         k: v for k, v in body.items()
         if k in ("queue_depth", "active_slots", "max_slots",
@@ -505,8 +843,13 @@ async def _deregister(request: web.Request):
 
 async def _drain(request: web.Request):
     """Mark a replica draining in the table AND forward the drain to
-    the replica itself (stop admission, finish in-flight) — the
-    scale-down path the ModelServer controller models."""
+    the replica itself — the scale-down path the ModelServer
+    controller models. INSTANT drain: when healthy peers exist, the
+    forwarded drain carries `{"migrate": true, "peers": [...]}` so the
+    replica pushes every in-flight sequence (KV blocks included) to
+    them and can exit in seconds instead of waiting out its longest
+    generation. A lone replica falls back to the legacy wait-out
+    drain — there is nowhere to migrate to."""
     st: _FleetState = request.app[FLEET_KEY]
     try:
         body = await request.json()
@@ -518,17 +861,38 @@ async def _drain(request: web.Request):
         return web.json_response(
             {"error": f"unknown replica {rid!r}"}, status=404)
     st.registry.drain(rid)
+    peers = sorted(st.registry.routable({rid}),
+                   key=lambda r: (r.load(), r.id))
+    migrate = bool(peers) and body.get("migrate", True)
+    payload = ({"migrate": True, "peers": [r.url for r in peers]}
+               if migrate else None)
     forwarded: dict = {}
     try:
         async with st.session.post(
-                f"{rep.url}/drain",
-                timeout=aiohttp.ClientTimeout(total=5)) as r:
+                f"{rep.url}/drain", json=payload,
+                timeout=aiohttp.ClientTimeout(
+                    total=30 if migrate else 5)) as r:
             if r.content_type == "application/json":
                 forwarded = await r.json()
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
         pass  # marking it draining here already stops routing
     return web.json_response({"id": rid, "state": "draining",
                               "replica": forwarded})
+
+
+async def _placements(request: web.Request):
+    """GET /fleet/placements?exclude=a,b — advisory migration targets:
+    healthy peers (least-loaded first) a draining replica should push
+    its sequences to. `/fleet/drain` computes the same list itself;
+    this endpoint serves operators and the chaos harness."""
+    st: _FleetState = request.app[FLEET_KEY]
+    st.registry.sweep()
+    excl = {e for e in
+            request.rel_url.query.get("exclude", "").split(",") if e}
+    peers = sorted(st.registry.routable(excl),
+                   key=lambda r: (r.load(), r.id))
+    return web.json_response({"peers": [r.url for r in peers],
+                              "ids": [r.id for r in peers]})
 
 
 async def _replicas(request: web.Request):
@@ -568,6 +932,11 @@ async def _stats(request: web.Request):
         "route_total": {reason: st.obs.route_total.value(reason=reason)
                         for reason in ROUTE_REASONS},
         "hedge_wins": st.obs.hedge_wins.value(),
+        "failover": st.obs.failover.value(),
+        "checkpoints": len(st.checkpoints),
+        # fault-injection ledger (None outside chaos runs): the chaos
+        # loadtest's proof that faults actually fired
+        "chaos": dict(st.chaos.injected) if st.chaos else None,
     })
 
 
@@ -682,7 +1051,8 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
                       request_timeout_s: float = 300.0,
                       metrics_registry=None, tracer=None,
                       tenancy: TenancyConfig | None = None,
-                      ) -> web.Application:
+                      max_attempts: int | None = None,
+                      chaos=None) -> web.Application:
     """Build the router app. `block_size` must match the replicas'
     `kv_block_size` (the affinity key is the first block — a mismatch
     only costs cache hits, never correctness). `policy` is "affinity"
@@ -693,7 +1063,11 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
     limiting (`tenancy.TenancyConfig`, normally the same file the
     replicas load): a tenant over its requests/s bucket is 429'd at
     the fleet door before any replica dispatch. With or without it,
-    the X-Tenant header is forwarded to replicas verbatim."""
+    the X-Tenant header is forwarded to replicas verbatim.
+    `max_attempts` caps TOTAL upstream dispatches per request —
+    primaries, retries and hedges together (default `retries + 2`).
+    `chaos` is a `fleet.chaos.ChaosInjector` for the fault-injection
+    loadtest; leave None in production."""
     if policy not in ("affinity", "roundrobin"):
         raise ValueError(f"unknown policy {policy!r}")
     if block_size < 1:
@@ -709,7 +1083,8 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
     st = _FleetState(reg, obs, block_size=block_size, policy=policy,
                      hedge_after_s=hedge_after_s, retries=retries,
                      backoff_s=backoff_s, timeout_s=request_timeout_s,
-                     tenancy=tenancy)
+                     tenancy=tenancy, max_attempts=max_attempts,
+                     chaos=chaos)
     app = web.Application(middlewares=[_router_obs_middleware])
     app[FLEET_KEY] = st
 
@@ -734,6 +1109,7 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
     app.router.add_post("/fleet/heartbeat", _heartbeat)
     app.router.add_post("/fleet/deregister", _deregister)
     app.router.add_post("/fleet/drain", _drain)
+    app.router.add_get("/fleet/placements", _placements)
     app.router.add_get("/fleet/replicas", _replicas)
     app.router.add_get("/fleet/autoscale", _autoscale)
     app.router.add_get("/fleet/stats", _stats)

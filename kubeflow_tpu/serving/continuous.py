@@ -70,6 +70,7 @@ from kubeflow_tpu.serving.engine import (
     transformer_block,
 )
 from kubeflow_tpu.obs.timeline import RequestTimeline, TimelineStore
+from kubeflow_tpu.serving import migration
 from kubeflow_tpu.serving.paged import BlockPool, RadixPrefixCache
 from kubeflow_tpu.tenancy.ledger import TenantLedger
 from kubeflow_tpu.tenancy.scheduler import FairShareQueue, ReqMeta
@@ -222,6 +223,12 @@ class ContinuousEngine:
                                         donate_argnums=(0,))
         self._gather_seed_jit = jax.jit(self._gather_seed)
         self._reset_jit = jax.jit(self._reset_slots, donate_argnums=(0,))
+        # migration (serving/migration.py): export gathers block
+        # payloads without touching the state; import scatters them in
+        # place (donated, like insert/step — KV dominates serving HBM)
+        self._export_jit = jax.jit(self._export_blocks)
+        self._import_jit = jax.jit(self._import_blocks,
+                                   donate_argnums=(0,))
 
     # -- state ------------------------------------------------------------
 
@@ -495,6 +502,51 @@ class ContinuousEngine:
         padded = list(slots) + [slots[-1]] * (n - len(slots))
         return self._reset_jit(st, jnp.asarray(padded, jnp.int32))
 
+    # -- migration --------------------------------------------------------
+
+    def _export_blocks(self, k_pool, v_pool, ids):
+        return k_pool[:, ids], v_pool[:, ids]
+
+    def export_blocks(self, st: SlotState, block_ids):
+        """Host copies of the K/V payloads held by physical blocks
+        `block_ids` — `(k, v)`, each `[L, n, block_size, n_kv, hd]`
+        numpy, in id order. The transfer unit of live sequence
+        migration (serving/migration.py): one device gather + one
+        transfer covers an arbitrary id list (one cheap compile per
+        list LENGTH). Does not touch the state."""
+        ids = jnp.asarray(list(block_ids), jnp.int32)
+        k, v = self._export_jit(st.k, st.v, ids)
+        return np.asarray(k), np.asarray(v)
+
+    def _import_blocks(self, st: SlotState, ids, k, v):
+        kp = st.k.at[:, ids].set(k.astype(st.k.dtype))
+        vp = st.v.at[:, ids].set(v.astype(st.v.dtype))
+        return SlotState(kp, vp, st.length, st.offset, st.pad, st.tok,
+                         st.aid, st.block_table)
+
+    def import_blocks(self, st: SlotState, block_ids, k, v) -> SlotState:
+        """Scatter migrated block payloads into locally-allocated
+        blocks `block_ids` (donates `st` — in-place pool update, same
+        policy as insert/step). Payloads keep the exporter's canonical
+        form (cell index == logical token position), so imported
+        blocks are immediately radix-shareable. Raises ValueError when
+        the payload shape disagrees with this pool's block geometry —
+        a silent shape coercion here would corrupt every sequence that
+        later seeds from these blocks."""
+        cfg = self.engine.cfg
+        want = (cfg.num_layers, len(list(block_ids)), self.block_size,
+                cfg.num_kv_heads, cfg.head_dim)
+        k = np.asarray(k)
+        v = np.asarray(v)
+        if tuple(k.shape) != want or tuple(v.shape) != want:
+            raise ValueError(
+                f"import_blocks: payload shape k={tuple(k.shape)} "
+                f"v={tuple(v.shape)} does not match pool block "
+                f"geometry [L, n, block_size, n_kv, hd] = {want}")
+        return self._import_jit(st,
+                                jnp.asarray(list(block_ids), jnp.int32),
+                                jnp.asarray(k), jnp.asarray(v))
+
     def warmup(self, buckets=(16,), step_sizes=(1,)) -> int:
         """Compile a serving shape set ahead of traffic: prefill and
         insert for every power-of-two group size x REGISTERED prompt
@@ -665,6 +717,19 @@ class ContinuousEngine:
 
 class Overloaded(RuntimeError):
     """Admission queue is full — callers should shed load (HTTP 429)."""
+
+
+class MigratedAway(RuntimeError):
+    """The request's state was exported to a peer replica (instant
+    drain). Not a failure: the router resumes the generation on the
+    peer from the migrated KV, and clients never see this exception —
+    the server maps it to a retryable error the router absorbs."""
+
+    def __init__(self, request_id: str = ""):
+        super().__init__(
+            f"request {request_id or '<unknown>'} migrated to a peer "
+            "replica")
+        self.request_id = request_id
 
 
 class _Slot:
@@ -852,6 +917,10 @@ class ContinuousBatcher:
         self._worker: asyncio.Task | None = None
         self._closed = False
         self._draining = False
+        # migration halt: export_sequences() asks the worker to park
+        # at its next loop boundary (never mid-admission — a cancel
+        # there would strand requests in the worker's local buffers)
+        self._halt = False
         # Admitted-but-unfinished request count. NOT derivable from
         # _pending/_active: the worker holds requests in local buffers
         # between popleft and slot assignment (prefill pipelining), so
@@ -1677,6 +1746,11 @@ class ContinuousBatcher:
             if not self._active and not self._pending and not inflight:
                 self._wake.clear()
                 await self._wake.wait()
+            if self._halt:
+                # migration export wants the batcher quiescent: park at
+                # the loop boundary (active/pending intact, no local
+                # buffers in flight) and let export_sequences serialize
+                return
             # Preemption runs BEFORE the dirty-slot reset so an evicted
             # slot's table is trash-reset in this same iteration —
             # admission below may hand its freed blocks to the
@@ -1750,6 +1824,227 @@ class ContinuousBatcher:
                 continue
             # let submissions/cancellations interleave between steps
             await asyncio.sleep(0)
+
+    # -- migration / failover ---------------------------------------------
+
+    def checkpoints(self) -> list[dict]:
+        """Lightweight resume records (tokens only, no KV) for every
+        admitted request — the crash-failover feed each fleet
+        heartbeat carries to the router. `tokens` is the full replay
+        prompt (original prompt incl. any registered-prefix expansion,
+        plus every emitted token); a healthy peer resumes by
+        re-prefilling `tokens` with budget `max_new - len(out)` —
+        token-identical under greedy sampling, the same replay
+        contract preemption relies on."""
+        out: list[dict] = []
+        for rec in self._active.values():
+            if rec.fut.done() or rec.meta is None:
+                continue
+            # the replay tokens already embed any registered prefix —
+            # re-expanding it on the peer would double-prefix
+            samp = {k: v for k, v in (rec.sampling or {}).items()
+                    if k != "prefix"}
+            out.append({
+                "request_id": rec.meta.request_id,
+                "tenant": rec.meta.tenant,
+                "tokens": list(rec.kv_toks),
+                "out": list(rec.out),
+                "max_new": rec.max_new,
+                "sampling": samp,
+            })
+        pending = (self._pending.items() if self._ledger is not None
+                   else list(self._pending))
+        for item in pending:
+            tokens, max_new, sampling, fut, _q, _aid, _pfx, meta = item
+            if fut.done() or meta is None:
+                continue
+            emitted: list[int] = []
+            samp = dict(sampling)
+            if meta.resume is not None:
+                # preempted-and-parked: tokens is already the replay
+                # prompt (incl. emitted), budget is the original
+                emitted = list(meta.resume["out"])
+                max_new = meta.resume["max_new"]
+                samp.pop("prefix", None)
+            out.append({
+                "request_id": meta.request_id,
+                "tenant": meta.tenant,
+                "tokens": list(tokens),
+                "out": emitted,
+                "max_new": max_new,
+                "sampling": samp,
+            })
+        return out
+
+    async def export_sequences(self) -> list[dict]:
+        """Instant drain: stop admission, park the worker at a loop
+        boundary, and serialize EVERY admitted request — active slots
+        with their guaranteed-written full KV blocks, pending items
+        tokens-only — into versioned migration wire records
+        (serving.migration). Each exported future fails with
+        `MigratedAway` (the router absorbs it and resumes on the
+        peer); all blocks are released, so the replica can exit
+        immediately instead of waiting out its longest generation."""
+        self._draining = True
+        w = self._worker
+        if w is not None and not w.done():
+            self._halt = True
+            self._wake.set()
+            try:
+                await w
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            finally:
+                self._halt = False
+        loop = asyncio.get_event_loop()
+        ceng = self.cengine
+        bs = ceng.block_size
+        geometry = migration.pool_geometry(ceng)
+        # Only full blocks strictly below len(kv_toks) - 1 are
+        # guaranteed written (the final token's KV may still be in
+        # flight from a discarded chunk) — the same line _cache_blocks
+        # trusts. The tail re-prefills on the destination.
+        exports = []
+        tables = (np.asarray(self._st.block_table)
+                  if self._st is not None else None)
+        for slot, rec in list(self._active.items()):
+            if rec.fut.done():
+                self._release(slot)
+                continue
+            n_full = (len(rec.kv_toks) - 1) // bs if rec.kv_toks else 0
+            phys = ([int(b) for b in tables[slot][:n_full]]
+                    if tables is not None and n_full > 0 else [])
+            exports.append((slot, rec, phys))
+        all_ids = [b for _, _, phys in exports for b in phys]
+        k_host = v_host = None
+        if all_ids:
+            async with self.gpu_lock:
+                k_host, v_host = await loop.run_in_executor(
+                    None, ceng.export_blocks, self._st, all_ids)
+        records: list[dict] = []
+        off = 0
+        for slot, rec, phys in exports:
+            n = len(phys)
+            kv = ((k_host[:, off:off + n], v_host[:, off:off + n])
+                  if n else None)
+            off += n
+            meta = rec.meta
+            rid = meta.request_id if meta is not None else ""
+            samp = {k: v for k, v in (rec.sampling or {}).items()
+                    if k != "prefix"}  # tokens already embed it
+            records.append(migration.pack_record(
+                request_id=rid,
+                tenant=meta.tenant if meta is not None else "",
+                ns=meta.ns if meta is not None else "",
+                tokens=list(rec.kv_toks), out=list(rec.out),
+                lps=list(rec.lps), max_new=rec.max_new,
+                sampling=samp, geometry=geometry, kv=kv))
+            if meta is not None and meta.timeline is not None:
+                meta.timeline.event("migrate_out",
+                                    emitted=len(rec.out), blocks=n)
+            self._release(slot)
+            self._fail(rec.fut, rec.queue, MigratedAway(rid))
+        if self._ledger is not None:
+            leftovers = self._pending.drain_all()
+        else:
+            leftovers = list(self._pending)
+            self._pending.clear()
+        for item in leftovers:
+            tokens, max_new, sampling, fut, queue, _aid, _p, meta = item
+            if fut.done():
+                continue
+            out_toks: list[int] = []
+            lps: list[float] = []
+            samp = dict(sampling)
+            if meta is not None and meta.resume is not None:
+                out_toks = list(meta.resume["out"])
+                lps = list(meta.resume["lps"])
+                max_new = meta.resume["max_new"]
+                samp.pop("prefix", None)
+            rid = meta.request_id if meta is not None else ""
+            records.append(migration.pack_record(
+                request_id=rid,
+                tenant=meta.tenant if meta is not None else "",
+                ns=meta.ns if meta is not None else "",
+                tokens=list(tokens), out=out_toks, lps=lps,
+                max_new=max_new, sampling=samp, geometry=geometry,
+                kv=None))
+            if meta is not None and meta.timeline is not None:
+                meta.timeline.event("migrate_out",
+                                    emitted=len(out_toks), blocks=0)
+            self._fail(fut, queue, MigratedAway(rid))
+        return records
+
+    async def import_sequence(self, record: dict, *,
+                              wedge: bool = False) -> int:
+        """Import one migrated sequence's KV blocks into the local
+        pool and index them in the radix cache under the record's
+        namespace — cache-WARM, not an orphan decode: the router
+        re-issues the generation (`tokens`, remaining budget), which
+        radix-hits the imported prefix and prefills only the tail.
+        Returns the number of blocks the cache adopted (0 for
+        tokens-only records or already-cached prefixes). Raises
+        ValueError on wire/geometry mismatch. On ANY failure —
+        including a wedged transfer (`wedge=True`, the chaos harness's
+        mid-transfer fault) — every allocated block is freed back: a
+        failed import must leak nothing."""
+        rec = migration.unpack_record(record)
+        migration.validate_geometry(rec["geometry"], self.cengine)
+        if rec["kv"] is None:
+            return 0
+        k, v = migration.decode_kv(rec["kv"])
+        n_full = int(k.shape[1])
+        bs = self.cengine.block_size
+        if n_full * bs > len(rec["tokens"]):
+            raise ValueError(
+                f"migration record claims {n_full} full blocks "
+                f"({n_full * bs} cells) but carries only "
+                f"{len(rec['tokens'])} tokens")
+        pool = self.cengine.pool
+        fresh = pool.alloc(n_full)
+        if fresh is None:
+            self._radix.evict(n_full - pool.num_free)
+            fresh = pool.alloc(n_full)
+            if fresh is None:
+                raise RuntimeError(
+                    f"migration import needs {n_full} blocks, pool "
+                    f"has {pool.num_free} free")
+        loop = asyncio.get_event_loop()
+        done = False
+        dup: list[int] = []
+        try:
+            if wedge:
+                raise RuntimeError(
+                    "migration transfer wedged (fault injection)")
+            if self._st is None:
+                self._st = self.cengine.init_slots()
+
+            def run_import(st=self._st):
+                return self.cengine.import_blocks(st, fresh, k, v)
+
+            async with self.gpu_lock:
+                self._st = await loop.run_in_executor(None, run_import)
+            # index LAST: once the tree adopts a block it owns it, and
+            # the rollback below must never free tree-owned blocks
+            blocks = {i: b for i, b in enumerate(fresh)}
+            adopted, _ = self._radix.insert(
+                rec["tokens"][:n_full * bs], blocks, ns=rec["ns"])
+            dup = [b for i, b in blocks.items() if i not in adopted]
+            done = True
+        finally:
+            if not done:
+                pool.free(fresh)
+                if self._st is not None and any(
+                        leaf.is_deleted() for leaf in
+                        jax.tree.leaves(self._st)
+                        if hasattr(leaf, "is_deleted")):
+                    self._fail_all(RuntimeError(
+                        "slot state lost to donated migration import"))
+        if dup:
+            # this prefix (or part of it) was already cached locally:
+            # the tree kept its own blocks, ours are duplicates
+            pool.free(dup)
+        return n_full - len(dup)
 
     def in_flight(self) -> int:
         """Admitted-but-unfinished requests (pending, mid-prefill in

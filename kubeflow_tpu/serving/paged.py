@@ -44,6 +44,10 @@ class BlockPool:
         self.block_size = int(block_size)
         # LIFO off the tail; initialised so the first allocs are 1, 2, ...
         self._free = list(range(num_blocks - 1, 0, -1))
+        # Membership mirror of _free: free() must reject a block that is
+        # already free (double-free would hand the same physical block to
+        # two owners and silently corrupt both sequences' KV).
+        self._free_set = set(self._free)
 
     @property
     def capacity(self) -> int:
@@ -65,13 +69,17 @@ class BlockPool:
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(out)
         return out
 
     def free(self, blocks) -> None:
         for b in blocks:
             if not (0 < b < self.num_blocks):
                 raise ValueError(f"freeing out-of-range block {b}")
+            if b in self._free_set:
+                raise ValueError(f"double-free of block {b}")
             self._free.append(b)
+            self._free_set.add(b)
 
 
 class _Node:

@@ -33,6 +33,7 @@ from kubeflow_tpu import obs as obs_lib
 from kubeflow_tpu.obs import endpoints as obs_endpoints
 from kubeflow_tpu.serving.continuous import (
     ContinuousBatcher,
+    MigratedAway,
     Overloaded,
     bucket_pow2,
 )
@@ -181,6 +182,28 @@ class ServingObs:
             "serving_tenant_preemptions_total",
             "Batch-class decodes evicted mid-generation to free a slot "
             "for interactive work, per tenant", self.registry)
+        # Live KV-block migration (ISSUE 7): instant drain exports
+        # in-flight sequences to peers; /v1/migrate/in imports them.
+        # Failures always roll back (zero leaked blocks) and count
+        # here by direction.
+        self.migration_out = Counter(
+            "serving_migration_out_total",
+            "In-flight sequences exported to a peer replica on "
+            "instant drain, per model", self.registry)
+        self.migration_in = Counter(
+            "serving_migration_in_total",
+            "Migrated sequences imported into the local KV pool "
+            "(cache-warm; the router re-dispatch resumes them), per "
+            "model", self.registry)
+        self.migration_failed = Counter(
+            "serving_migration_failed_total",
+            "Migration transfers that failed and rolled back, per "
+            "model and direction (in: import rejected or wedged, "
+            "out: no peer accepted the record)", self.registry)
+        self.migration_blocks = Counter(
+            "serving_migration_blocks_total",
+            "KV pool blocks moved by live migration, per model and "
+            "direction", self.registry)
         # Token-timeline companions (ISSUE 6): the continuous batcher's
         # on_itl/on_queue_wait hooks feed these, so the fleet view gets
         # the same numbers the per-request timeline endpoint shows.
@@ -655,6 +678,13 @@ def create_serving_app(engines: dict[str, InferenceEngine],
             # (and a 0 reading) before the first admission
             sobs.prefix_hits.inc(0, model=model_name)
             sobs.prefix_misses.inc(0, model=model_name)
+            sobs.migration_out.inc(0, model=model_name)
+            sobs.migration_in.inc(0, model=model_name)
+            for _d in ("in", "out"):
+                sobs.migration_failed.inc(
+                    0, model=model_name, direction=_d)
+                sobs.migration_blocks.inc(
+                    0, model=model_name, direction=_d)
             # which attention impl decode resolved to, as an info
             # gauge; the tracer hook makes each decode chunk a
             # `decode.attention` span carrying the same label
@@ -752,6 +782,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     app.router.add_get("/debug/traces",
                        obs_endpoints.traces_handler(sobs.tracer))
     app.router.add_post("/drain", drain_endpoint)
+    app.router.add_post("/v1/migrate/in", migrate_in)
     app.router.add_get("/v1/models", list_models)
     app.router.add_get("/v1/requests/{id}/timeline", request_timeline)
     app.router.add_post("/v1/models/{name}:generate", generate)
@@ -825,17 +856,133 @@ async def healthz(request: web.Request):
 
 
 async def drain_endpoint(request: web.Request):
-    """Stop admission NOW, report what is still in flight. In-flight
-    generations keep decoding to completion; new generate/score
-    requests get 503 (the fleet router stops sending them anyway once
-    the heartbeat reports draining). Standalone-usable: an operator
-    can drain a single server ahead of a restart with one POST."""
+    """Stop admission NOW. Bodyless (legacy): in-flight generations
+    keep decoding to completion and the response reports what is still
+    in flight — the wait-out drain. With `{"migrate": true, "peers":
+    [url, ...]}` (the router's instant-drain path): every active +
+    pending sequence is EXPORTED (serving.migration wire records) and
+    pushed round-robin to the peers' `/v1/migrate/in`, so the replica
+    can exit in seconds instead of waiting out its longest generation.
+    Sequences whose transfer fails everywhere still resume via the
+    router's checkpoint failover (heartbeats carried their tokens-so-
+    far) — migration only saves the peer the re-prefill. Standalone-
+    usable either way: an operator can drain one server with one
+    POST."""
     app = request.app
     app[DRAIN_KEY]["draining"] = True
     for b in app[BATCHERS_KEY].values():
         b.begin_drain()
+    try:
+        body = await request.json()
+    except Exception:  # noqa: BLE001 — bodyless legacy drain
+        body = {}
+    if not (isinstance(body, dict) and body.get("migrate")):
+        return web.json_response(
+            {"draining": True, "in_flight": _in_flight(app)})
+    import aiohttp
+
+    peers = [str(p).rstrip("/") for p in body.get("peers", []) if p]
+    sobs: ServingObs = app[OBS_KEY]
+    t0 = time.monotonic()
+    migrated = failed = 0
+    async with aiohttp.ClientSession() as session:
+        for name, b in app[BATCHERS_KEY].items():
+            if not isinstance(b, ContinuousBatcher):
+                continue
+            with sobs.tracer.span("migrate.out", model=name):
+                records = await b.export_sequences()
+            for i, record in enumerate(records):
+                ok = False
+                for j in range(len(peers)):
+                    peer = peers[(i + j) % len(peers)]
+                    try:
+                        async with session.post(
+                                f"{peer}/v1/migrate/in",
+                                json={"model": name, "record": record},
+                                timeout=aiohttp.ClientTimeout(
+                                    total=30)) as r:
+                            if r.status == 200:
+                                ok = True
+                                break
+                    except (aiohttp.ClientError, asyncio.TimeoutError,
+                            OSError):
+                        continue
+                if ok:
+                    migrated += 1
+                    sobs.migration_out.inc(model=name)
+                    kv = record.get("kv")
+                    if kv:
+                        sobs.migration_blocks.inc(
+                            kv["n_full"], model=name, direction="out")
+                else:
+                    failed += 1
+                    sobs.migration_failed.inc(model=name,
+                                              direction="out")
+    return web.json_response({
+        "draining": True, "in_flight": _in_flight(app),
+        "migrated": migrated, "failed": failed,
+        "migrate_s": round(time.monotonic() - t0, 3)})
+
+
+async def migrate_in(request: web.Request):
+    """Import one migrated sequence (body: `{"model": name, "record":
+    <serving.migration wire record>}`): validate geometry, allocate
+    local blocks, scatter the KV payload, and index the prefix in the
+    radix cache under the record's tenant namespace. The sequence is
+    NOT enqueued here — the router re-dispatches the generation
+    (replay prompt + remaining budget), which radix-hits the imported
+    prefix and resumes token-identically under greedy sampling. Any
+    failure — including a wedged transfer (`"wedge": true`, the chaos
+    harness's mid-transfer fault) — rolls back completely: the
+    destination pool frees every partially-imported block."""
+    app = request.app
+    try:
+        body: dict[str, Any] = await request.json()
+    except Exception:
+        return web.json_response({"error": "invalid JSON"}, status=400)
+    name = body.get("model", "")
+    batcher = app[BATCHERS_KEY].get(name)
+    if name not in app[ENGINES_KEY]:
+        return web.json_response(
+            {"error": f"no model {name!r}"}, status=404)
+    if not isinstance(batcher, ContinuousBatcher):
+        return web.json_response(
+            {"error": "migration import requires continuous batching"},
+            status=400)
+    record = body.get("record")
+    wedge = bool(body.get("wedge", False))
+    sobs: ServingObs = app[OBS_KEY]
+    try:
+        with sobs.tracer.span("migrate.in", model=name, wedge=wedge):
+            blocks = await batcher.import_sequence(record, wedge=wedge)
+    except ValueError as e:
+        sobs.migration_failed.inc(model=name, direction="in")
+        return web.json_response({"error": str(e)}, status=400)
+    except Exception as e:  # noqa: BLE001 — rolled back inside
+        sobs.migration_failed.inc(model=name, direction="in")
+        return web.json_response(
+            {"error": f"{type(e).__name__}: {e}"}, status=500)
+    sobs.migration_in.inc(model=name)
+    if blocks:
+        sobs.migration_blocks.inc(blocks, model=name, direction="in")
+    rid = (str(record.get("request_id", ""))
+           if isinstance(record, dict) else "")
     return web.json_response(
-        {"draining": True, "in_flight": _in_flight(app)})
+        {"imported": True, "blocks": blocks, "request_id": rid})
+
+
+def sequence_checkpoints(app: web.Application) -> list[dict]:
+    """Lightweight resume records (tokens only, no KV) for every
+    admitted request across models — the crash-failover feed
+    `enable_fleet_registration` attaches to each heartbeat. When the
+    registry sweeper declares this replica dead, the router replays
+    them on a healthy peer from exactly where the stream stopped."""
+    out = []
+    for name, b in app[BATCHERS_KEY].items():
+        if isinstance(b, ContinuousBatcher):
+            for ck in b.checkpoints():
+                out.append({"model": name, **ck})
+    return out
 
 
 async def list_models(request: web.Request):
@@ -1353,8 +1500,12 @@ async def generate(request: web.Request):
                 # rides the sampling channel like adapter/prefix; the
                 # batcher pops it back out before grouping
                 sampling["tenant"] = tenant_hdr
-            # timeline key; _stream_continuous echoes X-Request-Id
-            sampling["request_id"] = secrets.token_hex(8)
+            # timeline key; _stream_continuous echoes X-Request-Id.
+            # The fleet router mints its own id so a failover resume
+            # keeps the same timeline — honor it when present.
+            sampling["request_id"] = (
+                request.headers.get("X-Request-Id")
+                or secrets.token_hex(8))
             return await _stream_continuous(
                 request, cbatcher, arr, max_new_req, sampling,
                 text_mode, tokenizer)
@@ -1454,8 +1605,11 @@ async def generate(request: web.Request):
             submit_sampling["tenant"] = tenant_hdr
         if isinstance(batcher, ContinuousBatcher):
             # server-minted id keys the token timeline
-            # (/v1/requests/{id}/timeline); echoed as X-Request-Id
-            req_id = secrets.token_hex(8)
+            # (/v1/requests/{id}/timeline); echoed as X-Request-Id.
+            # Router-supplied ids win so failover resumes share one
+            # timeline across replicas.
+            req_id = (request.headers.get("X-Request-Id")
+                      or secrets.token_hex(8))
             submit_sampling["request_id"] = req_id
         if stop and isinstance(batcher, ContinuousBatcher):
             # the continuous batcher retires the slot the moment a
@@ -1486,6 +1640,13 @@ async def generate(request: web.Request):
             return web.json_response(
                 {"error": f"server overloaded: {e}"}, status=429,
                 headers={"Retry-After": _retry_after_s(batcher, e)})
+        except MigratedAway as e:
+            # instant drain shipped this sequence to a peer; the
+            # router treats the 503 as retryable and resumes from its
+            # checkpoint (or the migrated prefix) elsewhere
+            return web.json_response(
+                {"error": str(e), "migrated": True}, status=503,
+                headers={"Retry-After": "0"})
         _observe_first_token(request, name)
         toks = np.asarray([ids], np.int32)
     else:
@@ -1584,6 +1745,7 @@ def enable_fleet_registration(app: web.Application, router_url: str,
     def _payload(app_) -> dict:
         return {"id": state["id"], "url": state["advertise"],
                 "models": sorted(app_[ENGINES_KEY]),
+                "checkpoints": sequence_checkpoints(app_),
                 **fleet_stats(app_)}
 
     async def _register(app_) -> bool:

@@ -172,6 +172,15 @@ class FairShareQueue:
             out[tenant] = len(q)
         return out
 
+    def items(self) -> list:
+        """Non-destructive snapshot of every queued item, in tenant
+        order (the checkpoint/migration export paths read this; pops
+        and pacing state are untouched)."""
+        out = []
+        for tenant in sorted(self._queues):
+            out.extend(self._queues[tenant])
+        return out
+
     def drain_all(self) -> list:
         """Remove and return every queued item (shutdown path)."""
         items = []

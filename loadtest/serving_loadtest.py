@@ -24,6 +24,18 @@ prefix-cache deltas) and routing-reason counts, so affinity vs
 `--fleet-kill-one` proves retry/fallback completes every request when
 a replica dies mid-run.
 
+`--mode chaos` is the fleet fault-injection harness: replicas behind a
+router whose dispatch path runs a SEEDED `fleet.chaos.ChaosInjector`
+(drop / delay / duplicate / heartbeat blackhole), plus the two
+process-level faults this script owns — SIGKILL one replica mid-run
+and instant-drain (live KV migration) another while generations are in
+flight. Every response, one-shot or streamed, is compared token-for-
+token against a fault-free oracle; the run FAILS unless client-visible
+failures and token mismatches are both zero, the wedged-transfer probe
+rolls back without leaking a pool block, and p95 stays bounded. The
+JSON line records the injected-fault ledger and the drain-to-exit
+time.
+
 `--mode tenants` is the noisy-neighbor A/B for the multi-tenant QoS
 scheduler (kubeflow_tpu.tenancy): a batch-class tenant floods the
 server with long generations while an interactive tenant streams
@@ -50,9 +62,10 @@ import statistics
 import subprocess
 import sys
 import time
+import urllib.error
 import urllib.request
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO =os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
@@ -117,6 +130,55 @@ web.run_app(app, host="127.0.0.1", port={port}, print=None)
 '''
 
 
+# Chaos-arm router: same fleet router, with a seeded ChaosInjector on
+# the dispatch path and hedging OFF (a hedge is an intentional
+# duplicate — it would alias with the injector's duplicate fault and
+# muddy the ledger). The blackhole is armed at construction: the first
+# N heartbeats from replica-1 vanish, so the sweeper walks the
+# degraded path on a live process while the run warms up.
+CHAOS_ROUTER_CODE = r'''
+import sys
+sys.path.insert(0, {repo!r})
+from aiohttp import web
+from kubeflow_tpu.fleet.chaos import ChaosInjector
+from kubeflow_tpu.fleet.router import create_router_app
+chaos = ChaosInjector({seed}, drop_rate={drop_rate},
+                      delay_rate={delay_rate}, delay_s={delay_s},
+                      duplicate_rate={duplicate_rate})
+chaos.blackhole("replica-1", {blackhole_beats})
+app = create_router_app(block_size={block_size}, policy="affinity",
+                        hedge_after_s=0.0, retries={retries},
+                        backoff_s=0.05, chaos=chaos)
+web.run_app(app, host="127.0.0.1", port={port}, print=None)
+'''
+
+# Chaos-arm replica: FLEET_REPLICA_CODE with a sharpened lm_head
+# (x50, the test suite's idiom) so greedy argmax cannot flip across
+# batch shapes — the token-exactness oracle requires byte-for-byte
+# deterministic generations no matter how requests coalesce, migrate,
+# or replay after a crash.
+CHAOS_REPLICA_CODE = r'''
+import os, sys
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+sys.path.insert(0, {repo!r})
+import jax; jax.config.update("jax_platforms", "cpu")
+from aiohttp import web
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.serving.engine import InferenceEngine, LLAMA_FAMILY, EngineConfig
+from kubeflow_tpu.serving import server as srv
+cfg = llama.LLAMA_TINY
+params = dict(llama.init(jax.random.key(0), cfg))
+params["lm_head"] = params["lm_head"] * 50.0
+eng = InferenceEngine(params, cfg, LLAMA_FAMILY, EngineConfig(max_len=128))
+app = srv.create_serving_app({{"tiny": eng}}, continuous=True, warmup=True,
+                             kv_block_size={block_size})
+srv.enable_fleet_registration(app, {router!r},
+                              "http://127.0.0.1:{port}",
+                              replica_id="replica-{idx}", period_s=0.5)
+web.run_app(app, host="127.0.0.1", port={port}, print=None)
+'''
+
+
 TENANT_SERVER_CODE = r'''
 import os, sys
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
@@ -151,6 +213,47 @@ web.run_app(app, host="127.0.0.1", port={port}, print=None)
 def _get_json(url: str, timeout: float = 5.0) -> dict:
     with urllib.request.urlopen(url, timeout=timeout) as r:
         return json.loads(r.read())
+
+
+def _post_json(url: str, body: dict | None, timeout: float = 60.0) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(body or {}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _sse_generate(base: str, body: dict, timeout: float = 120.0) -> list[int]:
+    """POST a streaming generate and collect token ids from the SSE
+    frames (the router re-emits one token per event; the terminal
+    frame carries done+total). Raises on a missing/err terminal frame
+    or a total that disagrees with the tokens actually received —
+    either would be a duplicate/gap the splice failed to hide."""
+    req = urllib.request.Request(
+        f"{base}/v1/models/tiny:generate",
+        data=json.dumps(dict(body, stream=True)).encode(),
+        headers={"Content-Type": "application/json"})
+    toks: list[int] = []
+    final: dict | None = None
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        for line in r:
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            ev = json.loads(line[len(b"data: "):])
+            if ev.get("done") or "error" in ev:
+                final = ev
+                break
+            t = ev.get("tokens")
+            if t:
+                toks.extend(int(x) for x in t[0])
+    if final is None or not final.get("done"):
+        raise AssertionError(f"stream ended without done frame: {final}")
+    if final.get("total") != len(toks):
+        raise AssertionError(
+            f"stream total {final.get('total')} != {len(toks)} tokens "
+            "received — the failover splice dropped or duplicated")
+    return toks
 
 
 def _scrape_metrics(base: str) -> dict:
@@ -391,6 +494,278 @@ def run_fleet(clients: int, requests: int, max_new: int, *,
                               - route0["hedge_wins"]),
             "killed_replica": killed,
             "client_failures": failures,
+        }
+    finally:
+        log.close()
+        os.unlink(log.name)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+
+def run_chaos(clients: int, requests: int, max_new: int, *,
+              replicas: int = 3, block_size: int = 8, seed: int = 1,
+              drop_rate: float = 0.08, delay_rate: float = 0.08,
+              delay_s: float = 0.02, duplicate_rate: float = 0.05,
+              blackhole_beats: int = 14, retries: int = 6) -> dict:
+    """The fleet fault-injection run. N replicas behind a chaos-armed
+    router; every third request streams, the rest are one-shot, and
+    ALL of them are compared token-for-token against a fault-free
+    oracle taken directly from a replica before the faults start.
+    Mid-run the harness SIGKILLs the last replica (crash failover, no
+    graceful path) and instant-drains replica-0 (live KV migration to
+    the survivors) while the second half is in flight; afterwards it
+    probes a wedged migration transfer against a survivor and checks
+    the rollback leaked nothing. The run raises unless client-visible
+    failures and token mismatches are both zero."""
+    import tempfile
+
+    router_port = free_port()
+    rep_ports = [free_port() for _ in range(replicas)]
+    router_base = f"http://127.0.0.1:{router_port}"
+    log = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".log", prefix="kftpu-chaosload-", delete=False)
+    procs: list[subprocess.Popen] = []
+    try:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c",
+             CHAOS_ROUTER_CODE.format(
+                 repo=REPO, port=router_port, block_size=block_size,
+                 seed=seed, drop_rate=drop_rate, delay_rate=delay_rate,
+                 delay_s=delay_s, duplicate_rate=duplicate_rate,
+                 blackhole_beats=blackhole_beats, retries=retries)],
+            stdout=log, stderr=subprocess.STDOUT))
+        for idx, port in enumerate(rep_ports):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 CHAOS_REPLICA_CODE.format(
+                     repo=REPO, port=port, idx=idx,
+                     router=router_base, block_size=block_size)],
+                stdout=log, stderr=subprocess.STDOUT))
+
+        # the armed heartbeat blackhole can hold replica-1 DEGRADED for
+        # stretches of the warmup window — the poll just needs one
+        # moment where every replica's beat has landed
+        deadline = time.monotonic() + 240
+        ready = False
+        while time.monotonic() < deadline:
+            if any(p.poll() is not None for p in procs):
+                break
+            try:
+                counts = _get_json(
+                    f"{router_base}/fleet/replicas")["counts"]
+                if counts["ready"] >= replicas:
+                    ready = True
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        if not ready:
+            log.flush()
+            with open(log.name) as f:
+                tail = "\n".join(f.read().splitlines()[-30:])
+            rcs = [p.poll() for p in procs]
+            raise RuntimeError(
+                f"chaos fleet never became ready (rcs={rcs}):\n{tail}")
+
+        def post(base: str, body: dict, timeout: float = 120.0) -> dict:
+            return _post_json(f"{base}/v1/models/tiny:generate", body,
+                              timeout=timeout)
+
+        # Warm every replica directly (compile the batch shapes before
+        # timing); first token 255 keeps the warm prompt's radix line
+        # disjoint from the measured prompts (3..10) and the wedge
+        # probe (509).
+        prompt_len = 3 * block_size
+        warm_prompt = [255, 99] + [5 + t % 200
+                                   for t in range(prompt_len - 2)]
+
+        def warm(i: int) -> None:
+            base = f"http://127.0.0.1:{rep_ports[i % replicas]}"
+            post(base, {"tokens": [warm_prompt], "max_new": max_new})
+
+        with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+            for _ in range(3):
+                list(ex.map(warm, range(max(clients, replicas))))
+
+        # Fault-free oracle: greedy outputs per distinct prompt, taken
+        # DIRECTLY from replica-0 (no router, no injector). Sharpened
+        # lm_head makes these byte-reproducible however the chaos
+        # phase batches, migrates, or replays them.
+        k = max(1, requests // 6)
+        prompts = [[3 + j % 250, 100] + [7 + (j + t) % 200
+                                         for t in range(prompt_len - 2)]
+                   for j in range(k)]
+        rep0 = f"http://127.0.0.1:{rep_ports[0]}"
+        oracle = [post(rep0, {"tokens": [pr], "max_new": max_new})
+                  ["tokens"][0] for pr in prompts]
+
+        prompt_order = [i % k for i in range(requests)]
+        random.Random(seed).shuffle(prompt_order)
+        route0 = _get_json(f"{router_base}/fleet/stats")
+
+        failures: list[str] = []
+        mismatches: list[str] = []
+        lock = __import__("threading").Lock()
+
+        def one(i: int) -> float | None:
+            j = prompt_order[i]
+            body = {"tokens": [prompts[j]], "max_new": max_new}
+            t0 = time.perf_counter()
+            try:
+                if i % 3 == 0:
+                    got = _sse_generate(router_base, body)
+                else:
+                    got = post(router_base, body)["tokens"][0]
+            except Exception as e:  # noqa: BLE001 — tallied, asserted
+                with lock:
+                    failures.append(f"req {i}: {type(e).__name__}: {e}")
+                return None
+            if [int(t) for t in got] != [int(t) for t in oracle[j]]:
+                with lock:
+                    mismatches.append(
+                        f"req {i} prompt {j}: {got} != {oracle[j]}")
+            return time.perf_counter() - t0
+
+        half = requests // 2
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+            latencies = [x for x in ex.map(one, range(half))
+                         if x is not None]
+        # second half: both process-level faults land MID-BURST, while
+        # generations are genuinely in flight — SIGKILL (not terminate,
+        # which would run the graceful deregister+drain path) the last
+        # replica, then instant-drain replica-0 THROUGH the router:
+        # export + push of its live sequences must finish in seconds
+        killed = replicas - 1
+        with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+            futs = [ex.submit(one, i) for i in range(half, requests)]
+            time.sleep(0.05)
+            procs[1 + killed].kill()
+            t_dr = time.perf_counter()
+            dr = _post_json(f"{router_base}/fleet/drain",
+                            {"id": "replica-0"}, timeout=60.0)
+            drain_s = time.perf_counter() - t_dr
+            latencies += [x for x in (f.result() for f in futs)
+                          if x is not None]
+        procs[1 + killed].wait()
+        wall = time.perf_counter() - t0
+        fwd = dr.get("replica") or {}
+        if fwd.get("in_flight") != 0:
+            raise AssertionError(
+                f"drain left work in flight on replica-0: {dr}")
+        try:
+            _get_json(f"{rep0}/healthz", timeout=5)
+            drained_health = 200
+        except urllib.error.HTTPError as e:
+            drained_health = e.code
+        if drained_health != 503:
+            raise AssertionError(
+                f"drained replica still admits work "
+                f"(healthz={drained_health})")
+
+        # wedge probe against the survivor: a mid-transfer fault must
+        # roll back without leaking a single pool block, and the same
+        # record must import cleanly afterwards
+        from kubeflow_tpu.models import llama as _llama
+        from kubeflow_tpu.serving import migration as _mig
+        import numpy as _np
+        _cfg = _llama.LLAMA_TINY
+        geom = {"block_size": block_size,
+                "num_kv_heads": int(_cfg.num_kv_heads),
+                "head_dim": int(_cfg.head_dim),
+                "num_layers": int(_cfg.num_layers)}
+        kv_shape = (geom["num_layers"], 1, block_size,
+                    geom["num_kv_heads"], geom["head_dim"])
+        probe = _mig.pack_record(
+            request_id="chaos-wedge-probe", tenant="", ns="",
+            tokens=[509 - t for t in range(block_size + 1)], out=[],
+            lps=[], max_new=4, sampling={}, geometry=geom,
+            kv=(_np.zeros(kv_shape, _np.float32),
+                _np.zeros(kv_shape, _np.float32)))
+        surv = f"http://127.0.0.1:{rep_ports[1]}"
+
+        def _free_blocks() -> int:
+            return _get_json(f"{surv}/healthz")["models"]["tiny"][
+                "kv_blocks_free"]
+
+        free0 = _free_blocks()
+        try:
+            _post_json(f"{surv}/v1/migrate/in",
+                       {"model": "tiny", "record": probe, "wedge": True})
+            raise AssertionError("wedged import reported success")
+        except urllib.error.HTTPError as e:
+            wedge_body = e.read().decode()
+            if e.code != 500 or "wedged" not in wedge_body:
+                raise AssertionError(
+                    f"wedge probe: {e.code} {wedge_body}") from e
+        if _free_blocks() != free0:
+            raise AssertionError(
+                f"wedged import leaked pool blocks: {free0} -> "
+                f"{_free_blocks()}")
+        imported = _post_json(f"{surv}/v1/migrate/in",
+                              {"model": "tiny", "record": probe})
+        if imported.get("blocks") != 1 or _free_blocks() != free0 - 1:
+            raise AssertionError(f"clean re-import failed: {imported}")
+
+        route1 = _get_json(f"{router_base}/fleet/stats")
+        ledger = route1.get("chaos") or {}
+        if sum(ledger.values()) <= 0:
+            raise AssertionError(
+                f"no faults were injected (ledger {ledger}) — the "
+                "chaos arm ran fault-free")
+        if failures:
+            raise AssertionError(
+                f"{len(failures)} client-visible failures under "
+                f"chaos: {failures[:5]}")
+        if mismatches:
+            raise AssertionError(
+                f"{len(mismatches)} token mismatches vs the fault-free "
+                f"oracle: {mismatches[:3]}")
+        latencies.sort()
+        q = statistics.quantiles(latencies, n=20)
+        if q[18] >= 30.0:
+            raise AssertionError(
+                f"p95 {q[18]:.1f}s unbounded under chaos (retry storm "
+                "or wedged dispatch)")
+        return {
+            "metric": "serving_chaos",
+            "mode": "chaos",
+            "fleet_replicas": replicas,
+            "clients": clients,
+            "requests": requests,
+            "max_new": max_new,
+            "kv_block_size": block_size,
+            "seed": seed,
+            "drop_rate": drop_rate,
+            "delay_rate": delay_rate,
+            "duplicate_rate": duplicate_rate,
+            "stream_requests": sum(1 for i in range(requests)
+                                   if i % 3 == 0),
+            "requests_per_sec": round(requests / wall, 2),
+            "tokens_per_sec": round(requests * max_new / wall, 1),
+            "p50_s": round(q[9], 3),
+            "p95_s": round(q[18], 3),
+            "wall_s": round(wall, 2),
+            "injected": ledger,
+            "failover": int(route1["failover"] - route0["failover"]),
+            "retries": int(route1["route_total"].get("retry", 0)
+                           - route0["route_total"].get("retry", 0)),
+            "killed_replica": killed,
+            "drain_s": round(drain_s, 3),
+            "drain_under_2s": drain_s < 2.0,
+            "drain_migrated": int(fwd.get("migrated", 0)),
+            "drain_failed": int(fwd.get("failed", 0)),
+            "migrate_s": fwd.get("migrate_s"),
+            "wedge_rollback_ok": True,
+            "client_failures": 0,
+            "token_mismatches": 0,
         }
     finally:
         log.close()
@@ -793,8 +1168,22 @@ def main() -> int:
     p.add_argument("--max-new", type=int, default=16)
     p.add_argument("--batch-window-ms", type=int, default=5)
     p.add_argument("--mode",
-                   choices=("window", "continuous", "fleet", "tenants"),
+                   choices=("window", "continuous", "fleet", "tenants",
+                            "chaos"),
                    default="window")
+    p.add_argument("--chaos-seed", type=int, default=1,
+                   help="chaos mode: fault-plan seed (same seed, same "
+                        "fault sequence)")
+    p.add_argument("--chaos-drop-rate", type=float, default=0.08,
+                   help="chaos mode: per-dispatch drop probability")
+    p.add_argument("--chaos-delay-rate", type=float, default=0.08,
+                   help="chaos mode: per-dispatch delay probability")
+    p.add_argument("--chaos-duplicate-rate", type=float, default=0.05,
+                   help="chaos mode: per-dispatch duplicate probability")
+    p.add_argument("--chaos-blackhole-beats", type=int, default=14,
+                   help="chaos mode: heartbeats to swallow from "
+                        "replica-1 (>=13 walks the degraded path at "
+                        "the default 6s staleness / 0.5s period)")
     p.add_argument("--tenant-bulk-clients", type=int, default=8,
                    help="tenants mode: concurrent batch-class flooder "
                         "threads (the noisy neighbor); must exceed the "
@@ -812,8 +1201,10 @@ def main() -> int:
                    help="tenants mode: fast-burn alert line the "
                         "qos-off arm must exceed and the qos-on arm "
                         "must stay below")
-    p.add_argument("--fleet-replicas", type=int, default=2,
-                   help="fleet mode: serving replicas behind the router")
+    p.add_argument("--fleet-replicas", type=int, default=None,
+                   help="fleet/chaos modes: serving replicas behind "
+                        "the router (default 2; chaos defaults to 3 — "
+                        "one to kill, one to drain, one survivor)")
     p.add_argument("--fleet-policy", choices=("affinity", "roundrobin"),
                    default="affinity",
                    help="fleet mode: routing policy (roundrobin is the "
@@ -843,6 +1234,8 @@ def main() -> int:
         p.error("--pipeline-depth requires --mode continuous")
     if args.pipeline_depth < 0:
         p.error("--pipeline-depth must be >= 0")
+    if args.fleet_replicas is None:
+        args.fleet_replicas = 3 if args.mode == "chaos" else 2
     if args.mode == "fleet":
         if args.fleet_replicas < 1:
             p.error("--fleet-replicas must be >= 1")
@@ -856,6 +1249,22 @@ def main() -> int:
             block_size=args.fleet_block_size,
             kill_one=args.fleet_kill_one,
             hedge_after_s=args.fleet_hedge_after_s)
+    elif args.mode == "chaos":
+        if args.fleet_replicas < 3:
+            # one SIGKILLed + one drained + at least one survivor to
+            # absorb the migrated sequences and the wedge probe
+            p.error("--mode chaos needs --fleet-replicas >= 3")
+        if args.requests < 12:
+            p.error("--mode chaos needs --requests >= 12")
+        result = run_chaos(
+            args.clients, args.requests, args.max_new,
+            replicas=args.fleet_replicas,
+            block_size=args.fleet_block_size,
+            seed=args.chaos_seed,
+            drop_rate=args.chaos_drop_rate,
+            delay_rate=args.chaos_delay_rate,
+            duplicate_rate=args.chaos_duplicate_rate,
+            blackhole_beats=args.chaos_blackhole_beats)
     elif args.mode == "tenants":
         if args.tenant_bulk_clients < 1:
             p.error("--tenant-bulk-clients must be >= 1")

@@ -2,6 +2,7 @@
 retry/hedging, autoscale math, drain (batcher, server, controller)."""
 
 import asyncio
+import json
 import socket
 import time
 
@@ -356,6 +357,396 @@ async def test_router_drain_endpoint_stops_routing(aiohttp_client):
     finally:
         await a_server.close()
         await b_server.close()
+
+
+# -- circuit breaker / retry budget / failover / chaos ----------------------
+
+
+def test_circuit_breaker_trips_cooldown_and_half_open():
+    clk = FakeClock()
+    reg = ReplicaRegistry(circuit_failures=2, circuit_cooldown_s=2.0,
+                          dead_failures=5, clock=clk)
+    reg.register("http://a:1", replica_id="a")
+    reg.register("http://b:1", replica_id="b")
+    reg.note_failure("a")
+    assert not reg.circuit_open("a")    # one failure never trips
+    reg.note_failure("a")
+    assert reg.circuit_open("a")
+    reg.note_failure("b")               # b degraded, circuit closed
+    # no ready replicas left: the degraded pool is circuit-filtered
+    assert [r.id for r in reg.routable()] == ["b"]
+    clk.t = 2.5                         # cooldown over: half-open
+    assert not reg.circuit_open("a")
+    assert {r.id for r in reg.routable()} == {"a", "b"}
+    reg.note_failure("a")               # the probe failed: re-trips
+    assert reg.circuit_open("a")
+    reg.note_success("a")               # probe passed: closes
+    assert not reg.circuit_open("a")
+    # a live heartbeat clears the circuit too (recovery path)
+    reg.note_failure("b")
+    assert reg.circuit_open("b")
+    reg.heartbeat("b")
+    assert not reg.circuit_open("b")
+    # every circuit open -> still routable: a long-shot retry beats a
+    # certain client 503 (and the attempt doubles as the probe)
+    solo = ReplicaRegistry(circuit_failures=1, clock=FakeClock())
+    solo.register("http://x:1", replica_id="x")
+    solo.note_failure("x")
+    assert solo.circuit_open("x")
+    assert [r.id for r in solo.routable()] == ["x"]
+
+
+async def test_circuit_gauge_and_placements_endpoint(aiohttp_client):
+    reg = ReplicaRegistry(circuit_failures=2)
+    reg.register("http://a:1", replica_id="r0")
+    reg.register("http://b:1", replica_id="r1")
+    client = await aiohttp_client(router_mod.create_router_app(reg))
+    reg.note_failure("r0")
+    reg.note_failure("r0")
+    text = await (await client.get("/metrics")).text()
+    assert 'fleet_circuit_open{replica="r0"} 1' in text
+    assert 'fleet_circuit_open{replica="r1"} 0' in text
+    assert "fleet_failover_total 0" in text
+    # placements: healthy migration targets, least-loaded first
+    r = await client.get("/fleet/placements")
+    assert (await r.json())["ids"] == ["r1"]     # r0 is degraded
+    r = await client.get("/fleet/placements?exclude=r1")
+    body = await r.json()
+    assert body["ids"] == ["r0"] and body["peers"] == ["http://a:1"]
+
+
+async def test_retry_budget_caps_total_dispatches(aiohttp_client):
+    """max_attempts bounds TOTAL upstream dispatches per request — a
+    dead fleet must not amplify one client request into retries
+    against every replica."""
+    reg = ReplicaRegistry()
+    for i in range(4):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            reg.register(f"http://127.0.0.1:{s.getsockname()[1]}",
+                         replica_id=f"d{i}")
+    client = await aiohttp_client(router_mod.create_router_app(
+        reg, retries=6, max_attempts=2, backoff_s=0.001,
+        hedge_after_s=0))
+    r = await client.post("/v1/models/tiny:generate",
+                          json={"tokens": [[1, 2, 3]], "max_new": 2})
+    assert r.status == 503
+    # exactly two dispatches spent: the budget, not the retry count
+    assert sum(rep.failures for rep in reg.replicas()) == 2
+
+
+async def test_transient_fault_on_last_replica_gets_fresh_sweep(
+        aiohttp_client):
+    """A chaos drop on the ONLY routable replica must not strand the
+    request: once every candidate is in the per-request tried set, the
+    router clears it and sweeps again while attempt budget remains —
+    transient faults recover, persistent corpses are the circuit
+    breaker's job. Regression: a lone survivor's dropped dispatch
+    once 503'd with budget left."""
+    from kubeflow_tpu.fleet.chaos import ChaosInjector
+
+    server, url = await _start_stub("solo")
+    reg = ReplicaRegistry()
+    reg.register(url, replica_id="solo")
+    # seed 1: first draw 0.134 < 0.2 -> the first dispatch drops
+    chaos = ChaosInjector(1, drop_rate=0.2)
+    client = await aiohttp_client(router_mod.create_router_app(
+        reg, retries=3, backoff_s=0.001, hedge_after_s=0, chaos=chaos))
+    try:
+        r = await client.post("/v1/models/tiny:generate",
+                              json={"tokens": [[1, 2, 3]], "max_new": 2})
+        assert r.status == 200            # second sweep, same replica
+        assert chaos.injected["drop"] == 1
+        stats = await (await client.get("/fleet/stats")).json()
+        assert stats["route_total"]["retry"] >= 1
+    finally:
+        await server.close()
+
+
+async def test_fleet_wide_blip_waits_for_heartbeat_resurrection(
+        aiohttp_client):
+    """When EVERY replica is momentarily unroutable — the lone
+    survivor just tripped its breaker to DEAD with the heartbeat that
+    would resurrect it still in flight — the router must burn retries
+    waiting (the sleep yields the event loop so the heartbeat can
+    land) instead of 503ing with attempt budget left. Regression: a
+    chaos run under CPU contention turned this sub-second blip into
+    18 client-visible 503s."""
+    server, url = await _start_stub("solo")
+    reg = ReplicaRegistry()
+    reg.register(url, replica_id="solo")
+    for _ in range(3):                    # dead_failures -> DEAD
+        reg.note_failure("solo")
+    assert reg.routable() == []
+
+    async def late_heartbeat():
+        await asyncio.sleep(0.05)
+        assert reg.heartbeat("solo")      # READY again
+
+    client = await aiohttp_client(router_mod.create_router_app(
+        reg, retries=6, backoff_s=0.02, hedge_after_s=0))
+    task = asyncio.ensure_future(late_heartbeat())
+    try:
+        r = await client.post("/v1/models/tiny:generate",
+                              json={"tokens": [[1, 2, 3]], "max_new": 2})
+        assert r.status == 200
+    finally:
+        await task
+        await server.close()
+
+
+def _sse_stub(name, toks, *, die=False, seen=None):
+    """Streaming replica stub: emits one SSE token event per entry of
+    `toks`, then either a terminal done frame or (die=True) an abrupt
+    connection cut with no terminal frame — a mid-stream crash."""
+    async def gen(request):
+        body = await request.json()
+        if seen is not None:
+            seen.append(body)
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream"})
+        await resp.prepare(request)
+        for t in toks:
+            await resp.write(
+                b"data: " + json.dumps({"tokens": [[t]]}).encode()
+                + b"\n\n")
+        if die:
+            request.transport.close()
+        else:
+            await resp.write(b"data: " + json.dumps(
+                {"done": True, "total": len(toks)}).encode() + b"\n\n")
+            await resp.write_eof()
+        return resp
+
+    app = web.Application()
+    app.router.add_post("/v1/models/{name}:generate", gen)
+    return app
+
+
+async def test_stream_failover_splices_without_dup_or_gap(
+        aiohttp_client):
+    """A replica dies two tokens into an SSE stream: the router must
+    resume on a peer and splice the halves into ONE stream with no
+    duplicate and no missing tokens, terminal frame included."""
+    seen: list = []
+    dying = TestServer(_sse_stub("dying", [1, 2], die=True))
+    healer = TestServer(_sse_stub("healer", [3, 4, 5], seen=seen))
+    await dying.start_server()
+    await healer.start_server()
+    reg = ReplicaRegistry()
+    reg.register(f"http://127.0.0.1:{dying.port}", replica_id="dying")
+    reg.register(f"http://127.0.0.1:{healer.port}", replica_id="healer")
+    client = await aiohttp_client(router_mod.create_router_app(
+        reg, block_size=4, backoff_s=0.001, hedge_after_s=0))
+    try:
+        toks = _prompt_mapped_to(reg, "dying")
+        r = await client.post(
+            "/v1/models/tiny:generate",
+            json={"tokens": [toks], "max_new": 5, "stream": True})
+        assert r.status == 200
+        assert r.headers["X-Fleet-Replica"] == "dying"  # first owner
+        events = [json.loads(f.split(b"data:", 1)[1])
+                  for f in (await r.read()).split(b"\n\n") if f.strip()]
+        stream = [e["tokens"][0][0] for e in events if "tokens" in e]
+        assert stream == [1, 2, 3, 4, 5]        # no dup, no gap
+        assert events[-1]["done"] is True and events[-1]["total"] == 5
+        # checkpoint-less resume: the healer got the client's prompt
+        # spliced with the 2 delivered tokens, budget = remainder only
+        assert seen[0]["tokens"] == [[*toks, 1, 2]]
+        assert seen[0]["max_new"] == 3
+        stats = await (await client.get("/fleet/stats")).json()
+        assert stats["failover"] == 1
+    finally:
+        await dying.close()
+        await healer.close()
+
+
+async def test_oneshot_failover_resumes_from_checkpoint(aiohttp_client):
+    """Crash failover for a one-shot generate: the dead replica's last
+    heartbeat carried a sequence checkpoint; the retry re-prefills the
+    CHECKPOINT prompt (not the original body) with only the remaining
+    budget, and the response splices into one complete row."""
+    seen: list = []
+
+    async def gen(request):
+        body = await request.json()
+        seen.append(body)
+        return web.json_response(
+            {"tokens": [[8] * body["max_new"]], "served_by": "healer"})
+
+    app = web.Application()
+    app.router.add_post("/v1/models/{name}:generate", gen)
+    healer = TestServer(app)
+    await healer.start_server()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_url = f"http://127.0.0.1:{s.getsockname()[1]}"
+    reg = ReplicaRegistry()
+    reg.register(dead_url, replica_id="dead")
+    reg.register(f"http://127.0.0.1:{healer.port}", replica_id="healer")
+    client = await aiohttp_client(router_mod.create_router_app(
+        reg, block_size=4, backoff_s=0.001, hedge_after_s=0))
+    try:
+        r = await client.post("/fleet/heartbeat", json={
+            "id": "dead", "checkpoints": [{
+                "request_id": "req-ck", "tokens": [9, 8, 1, 2],
+                "out": [1, 2], "max_new": 5, "sampling": {}}]})
+        assert r.status == 200
+        toks = _prompt_mapped_to(reg, "dead")
+        r = await client.post(
+            "/v1/models/tiny:generate",
+            json={"tokens": [toks], "max_new": 5},
+            headers={"X-Request-Id": "req-ck"})
+        assert r.status == 200
+        body = await r.json()
+        # spliced: checkpointed [1, 2] + the healer's 3-token tail
+        assert body["tokens"] == [[1, 2, 8, 8, 8]]
+        assert r.headers["X-Request-Id"] == "req-ck"
+        assert seen[0]["tokens"] == [[9, 8, 1, 2]]
+        assert seen[0]["max_new"] == 3
+        stats = await (await client.get("/fleet/stats")).json()
+        assert stats["failover"] >= 1 and stats["checkpoints"] == 1
+    finally:
+        await healer.close()
+
+
+async def test_router_drain_forwards_migrate_peers(aiohttp_client):
+    """`/fleet/drain` forwards `{"migrate": true, "peers": [...]}` to
+    the replica when healthy peers exist; a lone replica gets the
+    legacy bodiless wait-out drain (nowhere to migrate to)."""
+    bodies: dict = {}
+
+    def drainable(name):
+        app = _stub_app(name)
+
+        async def drain_h(request):
+            bodies[name] = await request.text()
+            return web.json_response({"draining": True, "in_flight": 0,
+                                      "migrated": 1, "failed": 0})
+
+        app.router.add_post("/drain", drain_h)
+        return app
+
+    a = TestServer(drainable("a"))
+    b = TestServer(drainable("b"))
+    await a.start_server()
+    await b.start_server()
+    b_url = f"http://127.0.0.1:{b.port}"
+    reg = ReplicaRegistry()
+    reg.register(f"http://127.0.0.1:{a.port}", replica_id="a")
+    reg.register(b_url, replica_id="b")
+    client = await aiohttp_client(router_mod.create_router_app(reg))
+    try:
+        r = await client.post("/fleet/drain", json={"id": "a"})
+        body = await r.json()
+        assert body["state"] == "draining"
+        assert body["replica"]["migrated"] == 1
+        sent = json.loads(bodies["a"])
+        assert sent["migrate"] is True and sent["peers"] == [b_url]
+        # b is now the lone healthy replica: legacy drain, no body
+        r = await client.post("/fleet/drain", json={"id": "b"})
+        assert (await r.json())["state"] == "draining"
+        assert bodies["b"] == ""
+    finally:
+        await a.close()
+        await b.close()
+
+
+async def test_chaos_injector_is_seed_deterministic():
+    from kubeflow_tpu.fleet.chaos import ChaosInjector
+
+    a = ChaosInjector(7, drop_rate=0.3, delay_rate=0.0,
+                      duplicate_rate=0.2)
+    b = ChaosInjector(7, drop_rate=0.3, delay_rate=0.0,
+                      duplicate_rate=0.2)
+    sa = [await a.before_dispatch("r") for _ in range(60)]
+    sb = [await b.before_dispatch("r") for _ in range(60)]
+    assert sa == sb                      # same seed, same fault plan
+    assert a.injected == b.injected
+    assert a.injected["drop"] > 0 and a.injected["duplicate"] > 0
+    with pytest.raises(ValueError):
+        ChaosInjector(1, drop_rate=1.5)
+    # blackhole arms, decrements, and ledgers
+    a.blackhole("x", 2)
+    assert a.heartbeat_blackholed("x")
+    assert a.heartbeat_blackholed("x")
+    assert not a.heartbeat_blackholed("x")
+    assert a.injected["blackhole"] == 2
+
+
+async def test_chaos_drop_absorbed_and_heartbeat_blackhole(
+        aiohttp_client):
+    from kubeflow_tpu.fleet.chaos import ChaosInjector
+
+    g1, g1_url = await _start_stub("g1")
+    g2, g2_url = await _start_stub("g2")
+    reg = ReplicaRegistry()
+    reg.register(g1_url, replica_id="g1")
+    reg.register(g2_url, replica_id="g2")
+    # seed 1: first draw 0.134 < 0.2 -> the FIRST dispatch drops;
+    # the second call's draws all miss. Deterministic by contract.
+    chaos = ChaosInjector(1, drop_rate=0.2)
+    client = await aiohttp_client(router_mod.create_router_app(
+        reg, block_size=4, retries=3, backoff_s=0.001,
+        hedge_after_s=0, chaos=chaos))
+    try:
+        r = await client.post("/v1/models/tiny:generate",
+                              json={"tokens": [[1, 2, 3]], "max_new": 2})
+        assert r.status == 200           # the retry absorbed the drop
+        assert chaos.injected["drop"] == 1
+        stats = await (await client.get("/fleet/stats")).json()
+        assert stats["route_total"]["retry"] >= 1
+        # heartbeat blackhole: the beat is swallowed (stats untouched,
+        # replica believes it landed), then the window closes
+        chaos.blackhole("g1", 1)
+        r = await client.post("/fleet/heartbeat",
+                              json={"id": "g1", "queue_depth": 9})
+        assert (await r.json())["ok"] is True
+        assert reg.get("g1").queue_depth == 0
+        await client.post("/fleet/heartbeat",
+                          json={"id": "g1", "queue_depth": 9})
+        assert reg.get("g1").queue_depth == 9
+    finally:
+        await g1.close()
+        await g2.close()
+
+
+async def test_chaos_drop_on_stream_retries_not_500(aiohttp_client):
+    """A chaos drop fires BEFORE the streaming dispatch: the router
+    must treat it like any upstream failure (retry on a peer), not let
+    it escape the handler as a client-visible 500. Regression: the
+    stream path's except clause once missed `_UpstreamError`."""
+    from kubeflow_tpu.fleet.chaos import ChaosInjector
+
+    a = TestServer(_sse_stub("a", [1, 2, 3]))
+    b = TestServer(_sse_stub("b", [1, 2, 3]))
+    await a.start_server()
+    await b.start_server()
+    reg = ReplicaRegistry()
+    reg.register(f"http://127.0.0.1:{a.port}", replica_id="a")
+    reg.register(f"http://127.0.0.1:{b.port}", replica_id="b")
+    # seed 1: first draw 0.134 < 0.2 -> the first dispatch drops
+    chaos = ChaosInjector(1, drop_rate=0.2)
+    client = await aiohttp_client(router_mod.create_router_app(
+        reg, block_size=4, retries=3, backoff_s=0.001,
+        hedge_after_s=0, chaos=chaos))
+    try:
+        r = await client.post(
+            "/v1/models/tiny:generate",
+            json={"tokens": [[1, 2, 3]], "max_new": 3, "stream": True})
+        assert r.status == 200
+        events = [json.loads(f.split(b"data:", 1)[1])
+                  for f in (await r.read()).split(b"\n\n") if f.strip()]
+        stream = [e["tokens"][0][0] for e in events if "tokens" in e]
+        assert stream == [1, 2, 3]
+        assert events[-1]["done"] is True
+        assert chaos.injected["drop"] == 1
+        stats = await (await client.get("/fleet/stats")).json()
+        assert stats["route_total"]["retry"] >= 1
+        assert stats["chaos"]["drop"] == 1     # ledger on /fleet/stats
+    finally:
+        await a.close()
+        await b.close()
 
 
 def test_create_router_app_validates():

@@ -1,0 +1,214 @@
+"""Live KV-block migration: token-identity parity and rollback.
+
+The migration contract is the same one preemption replay pins: a
+generation exported from replica A and resumed on replica B must emit
+EXACTLY the tokens the uninterrupted run would have — `rec["out"] +
+resumed == solo oracle` under greedy sampling. The suite exercises the
+three interesting migrate points (never admitted / mid-block /
+past a block boundary) on llama, the block-boundary case on gemma
+(GQA 4:1, different pool geometry), plus the failure edges: a wedged
+transfer must roll back without leaking a single pool block, and a
+record from a pool with different geometry must be rejected before
+anything is allocated."""
+
+import asyncio
+
+import pytest
+
+pytest_plugins = ("aiohttp.pytest_plugin",)
+
+import jax
+import numpy as np
+
+from kubeflow_tpu.serving import (
+    EngineConfig,
+    GEMMA_FAMILY,
+    InferenceEngine,
+    LLAMA_FAMILY,
+)
+from kubeflow_tpu.serving import migration
+from kubeflow_tpu.serving.continuous import ContinuousBatcher, MigratedAway
+
+BS = 8          # kv block size: small enough that 24 tokens cross blocks
+MAX_NEW = 24
+
+
+def _build_engine(family: str) -> InferenceEngine:
+    if family == "llama":
+        from kubeflow_tpu.models import llama
+        cfg = llama.LLAMA_TINY
+        params = dict(llama.init(jax.random.key(0), cfg))
+        params["lm_head"] = params["lm_head"] * 50.0  # argmax can't flip
+        return InferenceEngine(params, cfg, LLAMA_FAMILY,
+                               EngineConfig(max_len=64))
+    from kubeflow_tpu.models import gemma
+    cfg = gemma.GEMMA_TINY
+    params = dict(gemma.init(jax.random.key(1), cfg))
+    return InferenceEngine(params, cfg, GEMMA_FAMILY,
+                           EngineConfig(max_len=64))
+
+
+@pytest.fixture(scope="module")
+def llama_engine():
+    return _build_engine("llama")
+
+
+@pytest.fixture(scope="module")
+def gemma_engine():
+    return _build_engine("gemma")
+
+
+def _solo(engine, prompt, max_new):
+    import jax.numpy as jnp
+
+    return np.asarray(engine.generate(
+        jnp.asarray([prompt], jnp.int32), max_new=max_new))[0].tolist()
+
+
+def _batcher(engine):
+    return ContinuousBatcher(engine, asyncio.Lock(), max_slots=2,
+                             kv_block_size=BS)
+
+
+async def _export_at(batcher, prompt, k):
+    """Start one streaming generation, consume `k` tokens, then drain
+    the batcher via export. Returns the single wire record (the
+    emitted `out` in it is authoritative — the worker may have decoded
+    a chunk ahead of what the stream consumer has seen)."""
+    fut, q = batcher.open_stream(prompt, MAX_NEW, ())
+    for _ in range(k):
+        tok = await q.get()
+        assert tok is not None, "stream ended before the migrate point"
+    records = await batcher.export_sequences()
+    with pytest.raises(MigratedAway):
+        await fut
+    assert len(records) == 1
+    return records[0]
+
+
+async def _resume_and_check(engine, rec, oracle):
+    """Import on a fresh 'replica' and re-issue the remaining budget —
+    the router's resume contract — asserting token identity."""
+    b = _batcher(engine)
+    try:
+        adopted = await b.import_sequence(rec)
+        if rec["kv"] is not None:
+            # fresh pool, nothing cached: the radix tree must adopt
+            # every migrated block, and the resumed prefill must hit it
+            assert adopted == rec["kv"]["n_full"] > 0
+        else:
+            assert adopted == 0
+        out_b = await b.submit(rec["tokens"],
+                               rec["max_new"] - len(rec["out"]), ())
+        assert rec["out"] + out_b == oracle
+        if rec["kv"] is not None:
+            assert b.prefix_hits >= 1
+            assert b.tokens_reused >= rec["kv"]["n_full"] * BS
+    finally:
+        await b.close()
+
+
+@pytest.mark.parametrize("k", [0, 3, 11],
+                         ids=["token0", "mid-block", "block-boundary"])
+async def test_migration_is_token_identical_llama(llama_engine, k):
+    prompt = [3, 5, 7, 11, 13, 17]
+    oracle = _solo(llama_engine, prompt, MAX_NEW)
+    a = _batcher(llama_engine)
+    try:
+        rec = await _export_at(a, prompt, k)
+    finally:
+        await a.close()
+    if k == 0:
+        # exported straight from the pending queue: tokens-only record
+        assert rec["kv"] is None and rec["out"] == []
+    else:
+        assert len(rec["out"]) >= k
+        # kv_toks = prompt + out; full blocks strictly below the tail
+        want_full = (len(prompt) + len(rec["out"]) - 1) // BS
+        assert (rec["kv"]["n_full"] if rec["kv"] else 0) == want_full
+        if k == 11:          # 6 + >=11 tokens: past the second boundary
+            assert rec["kv"]["n_full"] >= 2
+    assert rec["version"] == migration.MIGRATION_WIRE_VERSION
+    await _resume_and_check(llama_engine, rec, oracle)
+
+
+@pytest.mark.slow
+async def test_migration_is_token_identical_gemma(gemma_engine):
+    """Different family, different pool geometry (GQA 4:1, head_dim
+    32): the block-boundary migrate point must stay token-exact."""
+    gen = np.random.default_rng(7)
+    prompt = gen.integers(0, 512, 6).tolist()
+    oracle = _solo(gemma_engine, prompt, MAX_NEW)
+    a = _batcher(gemma_engine)
+    try:
+        rec = await _export_at(a, prompt, 11)
+    finally:
+        await a.close()
+    assert rec["kv"] is not None and rec["kv"]["n_full"] >= 2
+    await _resume_and_check(gemma_engine, rec, oracle)
+
+
+async def test_wedged_import_rolls_back_without_leaking(llama_engine):
+    """The chaos harness's mid-transfer fault: a wedged import must
+    free every block it allocated (pool occupancy unchanged), and the
+    same record must import cleanly afterwards."""
+    prompt = [2, 4, 6, 8, 10, 12]
+    oracle = _solo(llama_engine, prompt, MAX_NEW)
+    a = _batcher(llama_engine)
+    try:
+        rec = await _export_at(a, prompt, 3)
+    finally:
+        await a.close()
+    assert rec["kv"] is not None
+
+    b = _batcher(llama_engine)
+    try:
+        free0 = b.cengine.pool.num_free
+        with pytest.raises(RuntimeError, match="wedged"):
+            await b.import_sequence(rec, wedge=True)
+        assert b.cengine.pool.num_free == free0  # zero-leak rollback
+        # the wedge left no state behind: the real import still works
+        assert await b.import_sequence(rec) == rec["kv"]["n_full"]
+        out_b = await b.submit(rec["tokens"],
+                               rec["max_new"] - len(rec["out"]), ())
+        assert rec["out"] + out_b == oracle
+    finally:
+        await b.close()
+
+
+async def test_import_rejects_bad_records_before_allocating(llama_engine):
+    """Geometry / envelope guards fire BEFORE any block is allocated:
+    a rejected record must not move pool occupancy at all."""
+    prompt = [9, 8, 7, 6, 5, 4]
+    a = _batcher(llama_engine)
+    try:
+        rec = await _export_at(a, prompt, 3)
+    finally:
+        await a.close()
+
+    b = _batcher(llama_engine)
+    try:
+        free0 = b.cengine.pool.num_free
+
+        wrong_geom = {**rec, "geometry":
+                      {**rec["geometry"],
+                       "num_kv_heads": rec["geometry"]["num_kv_heads"] + 1}}
+        with pytest.raises(ValueError,
+                           match="migration geometry mismatch"):
+            await b.import_sequence(wrong_geom)
+
+        wrong_ver = {**rec, "version": 99}
+        with pytest.raises(ValueError, match="wire version"):
+            await b.import_sequence(wrong_ver)
+
+        # more full blocks than the token log can back: a foreign
+        # payload must not be scattered under a too-short prefix
+        n_full = rec["kv"]["n_full"]
+        short = {**rec, "out": [],
+                 "tokens": rec["tokens"][:n_full * BS - 1]}
+        with pytest.raises(ValueError, match="carries only"):
+            await b.import_sequence(short)
+
+        assert b.cengine.pool.num_free == free0
+    finally:
+        await b.close()

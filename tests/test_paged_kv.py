@@ -319,3 +319,49 @@ async def test_radix_eviction_under_pool_pressure():
     await batcher.close()
     # post-shutdown the only blocks in use are the tree's cache
     assert batcher.cengine.pool.in_use == batcher._radix.cached_blocks
+
+
+# -- migration-hardening guards --------------------------------------------
+
+
+def test_block_pool_double_free_guard():
+    """Freeing a block twice is always an accounting bug (migration
+    rollback + radix donation both free; overlapping would corrupt the
+    free list into handing one block to two sequences) — the pool must
+    refuse loudly, not absorb it."""
+    pool = BlockPool(num_blocks=6, block_size=4)
+    got = pool.alloc(3)
+    pool.free(got[:1])
+    with pytest.raises(ValueError, match="double-free"):
+        pool.free(got[:1])
+    # a duplicate id inside ONE call hits the same guard
+    with pytest.raises(ValueError, match="double-free"):
+        pool.free([got[1], got[1]])
+    # freeing a block the pool never handed out is a double-free too
+    fresh = BlockPool(num_blocks=6, block_size=4)
+    with pytest.raises(ValueError, match="double-free"):
+        fresh.free([2])
+
+
+def test_import_blocks_geometry_guard_and_roundtrip():
+    """Foreign block payloads scatter into the pool only when their
+    shape matches the local geometry exactly; a mismatched import must
+    raise before touching the device. Matching payloads round-trip
+    export -> import -> export bitwise."""
+    engine, cfg = _llama_engine()
+    ce = ContinuousEngine(engine, max_slots=2, block_size=8)
+    st = ce.init_slots()
+    rng = np.random.default_rng(3)
+    shape = (cfg.num_layers, 2, 8, cfg.num_kv_heads, cfg.head_dim)
+    k = rng.standard_normal(shape).astype(np.float32)
+    v = rng.standard_normal(shape).astype(np.float32)
+    st = ce.import_blocks(st, [1, 2], k, v)
+    got_k, got_v = ce.export_blocks(st, [1, 2])
+    np.testing.assert_array_equal(got_k, k)
+    np.testing.assert_array_equal(got_v, v)
+    # payload from a pool with a different block size
+    with pytest.raises(ValueError, match="pool block geometry"):
+        ce.import_blocks(st, [1, 2], k[:, :, :4], v[:, :, :4])
+    # block-count mismatch between ids and payload
+    with pytest.raises(ValueError, match="pool block geometry"):
+        ce.import_blocks(st, [1], k, v)

@@ -418,6 +418,49 @@ async def test_preemption_replay_is_token_identical():
         await b.close()
 
 
+async def test_drain_completes_preempted_request():
+    """Drain-vs-preemption seam: a batch-class request preempted back
+    into the pending queue while the batcher is DRAINING must still be
+    re-admitted and finish token-identically — drain refuses NEW
+    arrivals, never work that was already accepted. (The preemption
+    path re-enqueues via the scheduler directly, bypassing the
+    draining door; this pins that bypass.)"""
+    from kubeflow_tpu.serving.continuous import ContinuousBatcher
+
+    engine = _engine()
+    p1, p2, p3 = [3, 5, 7, 11], [4, 6, 8, 10], [9, 2, 4, 8]
+    want1, want2 = _solo(engine, p1, 24), _solo(engine, p2, 24)
+    want3 = _solo(engine, p3, 8)
+    b = ContinuousBatcher(engine, asyncio.Lock(), max_slots=2,
+                          tenancy=config_from_dict(QOS))
+    try:
+        f1 = asyncio.ensure_future(
+            b.submit(p1, 24, (("tenant", "bulk"),)))
+        f2 = asyncio.ensure_future(
+            b.submit(p2, 24, (("tenant", "bulk"),)))
+        for _ in range(400):
+            if len(b._active) == 2:
+                break
+            await asyncio.sleep(0.02)
+        assert len(b._active) == 2
+        f3 = asyncio.ensure_future(
+            b.submit(p3, 8, (("tenant", "live"),)))
+        for _ in range(400):            # wait for the preemption event
+            if b.preemptions >= 1:
+                break
+            await asyncio.sleep(0.02)
+        assert b.preemptions >= 1
+        # drain NOW, with the preempted bulk request parked in pending
+        assert await b.drain(timeout=60.0)
+        with pytest.raises(RuntimeError, match="draining"):
+            await b.submit(p3, 4, (("tenant", "live"),))
+        assert await f3 == want3
+        assert await f1 == want1       # the preempted one, replayed
+        assert await f2 == want2
+    finally:
+        await b.close()
+
+
 async def test_tenant_blind_batcher_is_plain_fifo():
     """No tenancy config: the pending queue stays a deque (FIFO), no
     ledger exists, and tenant_stats is empty — the tenant-blind
