@@ -1,7 +1,7 @@
 # Developer entry points (the reference drives everything through
 # per-component Makefiles; here one root Makefile covers the repo).
 
-.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check serving-check fleet-check kernels-check tenancy-check chaos-check
+.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check profile-check serving-check fleet-check kernels-check tenancy-check chaos-check
 
 verify-all:  ## the full evidence sweep, one command
 	python -m pytest tests -q -m "slow or not slow"
@@ -32,6 +32,9 @@ conformance: ## capability certification checks
 
 obs-check:   ## strict /metrics parse + /debug/traces gate on a live app
 	python -m ci.obs_check
+
+profile-check: ## step-anatomy gate: /debug/profile + zero-seeded phase/recompile families
+	JAX_PLATFORMS=cpu python -m ci.obs_check profile
 
 # serving-check deselects two KNOWN-RED tests: the sharded-vs-unsharded
 # parity tests fail at the DENSE engine level (sharded generate emits

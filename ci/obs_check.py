@@ -50,6 +50,38 @@ REQUIRED_FAMILIES = (
     "request_total",
 )
 
+# The step-anatomy families (ISSUE 8) every serving /metrics must
+# expose ZERO-SEEDED: a dashboard built before traffic arrives sees the
+# full phase/fn label space, not holes.
+PROFILE_FAMILIES = (
+    "serving_step_phase_seconds",
+    "serving_step_tokens",
+    "serving_goodput_ratio",
+    "serving_bubble_fraction",
+    "serving_kv_blocks_high_water",
+    "serving_recompiles_total",
+)
+
+
+def _check_trace_events(events: list, where: str,
+                        failures: list[str]) -> None:
+    """Chrome-trace event shape: complete spans (`X`: ts + dur), the
+    profiler's counter tracks (`C`: ts + args), and metadata (`M`).
+    Anything else is malformed for our payloads."""
+    for e in events:
+        ph = e.get("ph")
+        if ph == "X":
+            ok = "ts" in e and "dur" in e
+        elif ph == "C":
+            ok = "ts" in e and isinstance(e.get("args"), dict)
+        elif ph == "M":
+            ok = "name" in e
+        else:
+            ok = False
+        if not ok:
+            failures.append(f"{where}: malformed trace event: {e!r:.120}")
+            break
+
 
 async def run_check() -> list[str]:
     """Boot Cluster + platform app, drive traffic, validate /metrics and
@@ -125,13 +157,128 @@ async def run_check() -> list[str]:
                 if "http.request" not in names:
                     failures.append(
                         "/debug/traces missing http.request spans")
-                for e in events:
-                    if e.get("ph") != "X" or "ts" not in e or "dur" not in e:
-                        failures.append(
-                            f"malformed trace event: {e!r:.120}")
-                        break
+                _check_trace_events(events, "/debug/traces", failures)
         finally:
             await client.close()
+    return failures
+
+
+async def run_profile_check() -> list[str]:
+    """Third act (ISSUE 8): boot the serving app with a tiny continuous
+    engine, drive one real generate, and hold the step-anatomy plane to
+    the contract: `/metrics` strict-parses with every PROFILE_FAMILIES
+    member zero-seeded over its CLOSED label sets (all phases, all
+    watched fns), `/debug/profile` serves the rolling anatomy with the
+    goodput ledger and recompile counts, and `/debug/traces` carries
+    the profiler's counter tracks alongside the spans."""
+    import jax
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu import obs as obs_lib
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.serving import (
+        EngineConfig,
+        InferenceEngine,
+        LLAMA_FAMILY,
+    )
+    from kubeflow_tpu.serving import server as server_lib
+
+    failures: list[str] = []
+    cfg = llama.LLAMA_TINY
+    params = llama.init(jax.random.key(0), cfg)
+    engine = InferenceEngine(params, cfg, LLAMA_FAMILY,
+                             EngineConfig(max_len=64))
+    app = server_lib.create_serving_app(
+        {"m": engine}, continuous=True, max_batch=2)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        import asyncio
+
+        gen = np.random.default_rng(0)
+        prompts = [gen.integers(0, cfg.vocab_size, n).tolist()
+                   for n in (4, 6)]
+        resps = await asyncio.gather(*(
+            client.post("/v1/models/m:generate",
+                        json={"tokens": [p], "max_new": 4})
+            for p in prompts))
+        for resp in resps:
+            if resp.status != 200:
+                return [f"generate -> {resp.status}: "
+                        f"{await resp.text()}"]
+
+        # 1. /metrics: strict parse + zero-seeded closed label sets
+        text = await (await client.get("/metrics")).text()
+        try:
+            families = parse_exposition(text)
+        except ExpositionError as e:
+            return [f"serving /metrics failed strict parse: {e}"]
+        for fam in PROFILE_FAMILIES:
+            if fam not in families:
+                failures.append(f"/metrics missing family {fam}")
+        phased = families.get("serving_step_phase_seconds",
+                              {"samples": {}})
+        have = {dict(labels).get("phase")
+                for (sname, labels) in phased["samples"]
+                if sname.endswith("_count")}
+        missing = set(obs_lib.SERVING_PHASES) - have
+        if missing:
+            failures.append(
+                f"serving_step_phase_seconds not zero-seeded for "
+                f"phases {sorted(missing)}")
+        rec = families.get("serving_recompiles_total", {"samples": {}})
+        have_fns = {dict(labels).get("fn")
+                    for (_s, labels) in rec["samples"]}
+        missing = set(obs_lib.WATCHED_SERVING_FNS) - have_fns
+        if missing:
+            failures.append(
+                f"serving_recompiles_total not zero-seeded for fns "
+                f"{sorted(missing)}")
+
+        # 2. /debug/profile: the rolling anatomy
+        resp = await client.get("/debug/profile")
+        if resp.content_type != "application/json":
+            failures.append(
+                f"/debug/profile content type {resp.content_type}")
+        prof = json.loads(await resp.text())
+        m = prof.get("models", {}).get("m")
+        if m is None:
+            failures.append("/debug/profile has no model 'm'")
+        else:
+            for key in ("phases", "goodput", "wall_s", "recompiles"):
+                if key not in m:
+                    failures.append(f"/debug/profile missing {key!r}")
+            for p in obs_lib.SERVING_PHASES:
+                if p not in m.get("phases", {}):
+                    failures.append(
+                        f"/debug/profile missing phase {p!r}")
+            if m.get("phases", {}).get("decode", {}).get("count", 0) < 1:
+                failures.append(
+                    "/debug/profile: no decode phase samples after a "
+                    "generate — is the batcher instrumented?")
+            for fn in obs_lib.WATCHED_SERVING_FNS:
+                if fn not in m.get("recompiles", {}):
+                    failures.append(
+                        f"/debug/profile missing recompile fn {fn!r}")
+
+        # 3. /debug/traces: spans + the profiler's counter tracks
+        payload = json.loads(
+            await (await client.get("/debug/traces")).text())
+        events = payload.get("traceEvents")
+        if not isinstance(events, list) or not events:
+            failures.append("serving /debug/traces has no traceEvents")
+        else:
+            _check_trace_events(events, "serving /debug/traces",
+                                failures)
+            counters = {e.get("name") for e in events
+                        if e.get("ph") == "C"}
+            if not any(c.startswith("m.") for c in counters):
+                failures.append(
+                    "serving /debug/traces has no per-model counter "
+                    f"tracks (got {sorted(counters)})")
+    finally:
+        await client.close()
     return failures
 
 
@@ -161,6 +308,20 @@ async def run_fleet_check() -> list[str]:
             hist.observe(v)
         reg.register(obs_lib.SloEngine([
             obs_lib.Slo("stub_latency", 0.95, threshold_s=1.0)]))
+        # the step-anatomy families exactly as a serving replica
+        # zero-seeds them (ISSUE 8): federation must merge the closed
+        # phase/fn label sets without traffic
+        phase = obs_lib.get_or_create_histogram(
+            reg, "serving_step_phase_seconds", "stub step anatomy")
+        for p in obs_lib.SERVING_PHASES:
+            phase.seed(model="stub", phase=p)
+        from kubeflow_tpu.controlplane.metrics import Gauge
+
+        Gauge("serving_goodput_ratio", "stub goodput",
+              reg).set(0.0, model="stub")
+        rec = Counter("serving_recompiles_total", "stub retraces", reg)
+        for fn in obs_lib.WATCHED_SERVING_FNS:
+            rec.inc(0, model="stub", fn=fn)
         app = web.Application()
         obs_endpoints.mount_observability(
             app, registry=reg, tracer=obs_lib.Tracer())
@@ -210,6 +371,28 @@ async def run_fleet_check() -> list[str]:
         for window in ("short", "long"):
             sample("slo_burn_rate", "slo_burn_rate",
                    slo="stub_latency", window=window)
+        # zero-seeded step-anatomy families survive federation with
+        # their closed label sets intact: phase histograms merge
+        # (2 replicas x 0 observations), recompile counters sum
+        from kubeflow_tpu.obs.profiling import (
+            SERVING_PHASES,
+            WATCHED_SERVING_FNS,
+        )
+
+        for p in SERVING_PHASES:
+            if sample("serving_step_phase_seconds",
+                      "serving_step_phase_seconds_count",
+                      model="stub", phase=p) not in (0, None):
+                failures.append(
+                    f"federated phase histogram [{p}] not zero")
+        for fn in WATCHED_SERVING_FNS:
+            if sample("serving_recompiles_total",
+                      "serving_recompiles_total",
+                      model="stub", fn=fn) not in (0, None):
+                failures.append(
+                    f"federated serving_recompiles_total[{fn}] not zero")
+        sample("serving_goodput_ratio", "serving_goodput_ratio",
+               model="stub")
         for i in range(len(replicas)):
             if sample("fleet_federation_up", "fleet_federation_up",
                       replica=f"stub-{i}") != 1:
@@ -221,17 +404,37 @@ async def run_fleet_check() -> list[str]:
     return failures
 
 
-def main() -> int:
+def main(argv: list[str] | None = None) -> int:
+    """Default: all three acts. `python -m ci.obs_check profile` runs
+    only the serving step-anatomy act (`make profile-check`) — it is
+    the only act that compiles jax programs, so the fast acts stay
+    usable on their own."""
     import asyncio
 
-    failures = asyncio.run(run_check()) + asyncio.run(run_fleet_check())
+    argv = sys.argv[1:] if argv is None else argv
+    acts = {
+        "check": run_check,
+        "profile": run_profile_check,
+        "fleet": run_fleet_check,
+    }
+    wanted = argv or list(acts)
+    unknown = [a for a in wanted if a not in acts]
+    if unknown:
+        print(f"obs-check: unknown acts {unknown}; known: "
+              f"{list(acts)}", file=sys.stderr)
+        return 2
+    failures = []
+    for a in wanted:
+        failures += asyncio.run(acts[a]())
     if failures:
         for f in failures:
             print(f"obs-check FAIL: {f}", file=sys.stderr)
         return 1
-    print("obs-check: /metrics strict-parses, /debug/traces is "
-          "Chrome-trace-loadable, and /fleet/metrics federates "
-          "two replicas under the same contract")
+    print(f"obs-check [{','.join(wanted)}]: /metrics strict-parses, "
+          "/debug/traces is Chrome-trace-loadable (spans + counter "
+          "tracks), /debug/profile serves the step anatomy, and "
+          "/fleet/metrics federates two replicas under the same "
+          "contract")
     return 0
 
 

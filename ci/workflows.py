@@ -542,6 +542,46 @@ def kernels_check_workflow() -> dict:
     }
 
 
+def profile_check_workflow() -> dict:
+    """Step-anatomy gate (ISSUE 8): `make profile-check` boots the
+    serving app with a tiny continuous engine, drives a real generate,
+    and holds `/debug/profile`, the zero-seeded phase/goodput/recompile
+    metric families, and the counter-track-merged `/debug/traces` to
+    the strict exposition contract."""
+    return {
+        "name": "profile check",
+        "on": {
+            "pull_request": {"paths": [
+                "kubeflow_tpu/obs/**",
+                "kubeflow_tpu/serving/**",
+                "kubeflow_tpu/train/trainer.py",
+                "kubeflow_tpu/utils/profiling.py",
+                "ci/obs_check.py",
+                "tests/test_profiling.py",
+                "Makefile"]},
+            "push": {"branches": ["main"]},
+        },
+        "jobs": {
+            "profile-check": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e .[ci] pytest"},
+                    {"name": "step-anatomy unit suite",
+                     "run": ("python -m pytest tests/test_profiling.py "
+                             "-q"),
+                     "env": {"JAX_PLATFORMS": "cpu"}},
+                    {"name": "/debug/profile + zero-seeded families "
+                             "contract",
+                     "run": "make profile-check"},
+                ],
+            }
+        },
+    }
+
+
 def all_workflows() -> dict[str, dict]:
     from ci import cd
 
@@ -559,6 +599,7 @@ def all_workflows() -> dict[str, dict]:
     out["chaos_check.yaml"] = chaos_check_workflow()
     out["tenancy_check.yaml"] = tenancy_check_workflow()
     out["kernels_check.yaml"] = kernels_check_workflow()
+    out["profile_check.yaml"] = profile_check_workflow()
     out["frontend_test.yaml"] = frontend_workflow()
     out.update(cd.all_workflows())
     return out

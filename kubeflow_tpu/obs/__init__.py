@@ -35,6 +35,17 @@ from kubeflow_tpu.obs.metrics import (
     Histogram,
     format_float,
     get_or_create_histogram,
+    sample_quantile,
+)
+from kubeflow_tpu.obs.profiling import (
+    SERVING_PHASES,
+    TRAIN_PHASES,
+    WATCHED_SERVING_FNS,
+    WATCHED_TRAIN_FNS,
+    CompileWatch,
+    PhaseProfiler,
+    abstract_signature,
+    merge_counter_tracks,
 )
 from kubeflow_tpu.obs.slo import Slo, SloEngine
 from kubeflow_tpu.obs.timeline import RequestTimeline, TimelineStore
@@ -53,10 +64,16 @@ __all__ = [
     "LATENCY_BUCKETS",
     "SIZE_BUCKETS",
     "TOKEN_BUCKETS",
+    "SERVING_PHASES",
+    "TRAIN_PHASES",
+    "WATCHED_SERVING_FNS",
+    "WATCHED_TRAIN_FNS",
+    "CompileWatch",
     "ExpositionError",
     "Histogram",
     "LabelGuard",
     "OVERFLOW_LABEL",
+    "PhaseProfiler",
     "RequestTimeline",
     "Slo",
     "SloEngine",
@@ -64,14 +81,17 @@ __all__ = [
     "TimelineStore",
     "Tracer",
     "DEFAULT_TRACER",
+    "abstract_signature",
     "default_registry",
     "federate",
     "format_float",
     "get_or_create_histogram",
     "merge_chrome_traces",
+    "merge_counter_tracks",
     "merge_families",
     "parse_exposition",
     "render_families",
+    "sample_quantile",
     "traces_response_payload",
 ]
 

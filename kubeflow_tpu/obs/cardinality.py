@@ -22,28 +22,35 @@ class LabelGuard:
     bumped from handler threads and rendered from scrape time."""
 
     def __init__(self, max_values: int = 32,
-                 overflow: str = OVERFLOW_LABEL, seed=()):
+                 overflow: str = OVERFLOW_LABEL, seed=(),
+                 closed: bool = False):
         if max_values < 1:
             raise ValueError(f"max_values must be >= 1, got {max_values}")
         self.max_values = int(max_values)
         self.overflow = overflow
+        # closed guards admit ONLY the seeded set — the right mode for
+        # label values that enumerate code (phase names, watched fn
+        # names), where a novel value is a bug, not a new tenant
+        self.closed = bool(closed)
         self._lock = threading.Lock()
         self._values: set[str] = set()
         self.overflowed = 0  # values that hit the cap, cumulative
         for v in seed:
-            self.admit(v)
+            with self._lock:
+                self._values.add(v or self.overflow)
 
     def admit(self, value: str) -> str:
         """The label value to actually use for `value`: itself while
-        under the cap, the overflow bucket after. The overflow bucket
-        itself never counts against the cap."""
+        seeded (closed mode) or under the cap (open mode), the overflow
+        bucket after. The overflow bucket itself never counts against
+        the cap."""
         value = value or self.overflow
         if value == self.overflow:
             return self.overflow
         with self._lock:
             if value in self._values:
                 return value
-            if len(self._values) < self.max_values:
+            if not self.closed and len(self._values) < self.max_values:
                 self._values.add(value)
                 return value
             self.overflowed += 1

@@ -40,6 +40,28 @@ def format_float(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
 
 
+def sample_quantile(xs, q: float) -> float | None:
+    """Linear-interpolated quantile over RAW samples — the exact-sample
+    analog of `Histogram.quantile`'s within-bucket interpolation, and
+    the one quantile definition every process-local summary in the repo
+    uses (`StepTimer.summary`, `PhaseProfiler.snapshot`). A naive index
+    pick (`xs[int(q * n)]`) disagrees with the histogram-side estimate
+    by up to a full sample gap; this is the standard `q * (n - 1)`
+    order-statistic interpolation instead."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    if len(xs) == 1:
+        return float(xs[0])
+    q = min(max(float(q), 0.0), 1.0)
+    pos = q * (len(xs) - 1)
+    lo = int(pos)
+    frac = pos - lo
+    if frac == 0.0 or lo + 1 >= len(xs):
+        return float(xs[lo])
+    return float(xs[lo] + (xs[lo + 1] - xs[lo]) * frac)
+
+
 class Histogram:
     """Cumulative histogram with optional labels.
 
@@ -80,7 +102,41 @@ class Histogram:
             row[1][0] += float(value)
             row[1][1] += 1.0
 
+    def seed(self, **labels: str) -> None:
+        """Create the label set with ZERO observations. An all-zero row
+        is a valid exposition (every bucket 0, `+Inf` == `_count` == 0,
+        `_sum` 0), so seeded series appear on the first scrape — the
+        histogram analog of `Counter.inc(0, **labels)` zero-seeding."""
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            if key not in self._data:
+                self._data[key] = ([0] * (len(self.buckets) + 1),
+                                   [0.0, 0.0])
+
     # -- read side ---------------------------------------------------------
+
+    def quantile(self, q: float, **labels: str) -> float | None:
+        """Estimate the q-quantile from bucket counts, prometheus
+        `histogram_quantile` style: find the bucket where the cumulative
+        count crosses `q * count`, then interpolate linearly inside it
+        (a sample in the `+Inf` bucket clamps to the highest finite
+        bound). None when the label set has no observations."""
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            row = self._data.get(key)
+            if row is None or row[1][1] <= 0:
+                return None
+            counts = list(row[0])
+            total = row[1][1]
+        rank = min(max(float(q), 0.0), 1.0) * total
+        acc = 0.0
+        lo = 0.0
+        for bound, c in zip(self.buckets, counts):
+            if acc + c >= rank and c > 0:
+                return lo + (bound - lo) * (rank - acc) / c
+            acc += c
+            lo = bound
+        return self.buckets[-1]
 
     def count(self, **labels: str) -> int:
         key = tuple(sorted((k, str(v)) for k, v in labels.items()))

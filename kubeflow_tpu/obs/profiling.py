@@ -1,0 +1,455 @@
+"""Step-anatomy profiling: phase attribution, goodput, compile-watch.
+
+Metrics say HOW LONG a step took; traces say WHICH step was slow. This
+module answers WHERE the time went: every `ContinuousBatcher` worker
+iteration (and every `Trainer.step`) decomposes into named phases with
+per-phase wall time, token counts, and occupancy, aggregated three
+ways —
+
+  1. `serving_step_phase_seconds{phase}` / `serving_step_tokens{phase}`
+     histograms (the server binds them through `on_phase`, zero-seeded
+     so dashboards see every phase from the first scrape),
+  2. a goodput ledger: decode device-time over total non-idle step
+     time, bubble fraction (host-gap share), and occupancy / KV-pool
+     high-water marks,
+  3. Chrome-trace COUNTER tracks (`"ph": "C"`) merged into the same
+     `/debug/traces` payload as the span events, so one trace shows
+     phase budgets and pool fill over time next to the spans.
+
+Phase mapping for the continuous batcher (the honest one for this
+architecture — sampling is fused into the device step, so the host-side
+phases measure what the HOST does around it):
+
+  admit       queue pop, block planning, grouping, insert dispatch
+  prefill     the grouped prefill/gather device call
+  decode      decode-chunk dispatch + waiting on device results
+  sample      host materialization of sampled tokens (device->numpy)
+  detokenize  per-token emit bookkeeping (stop-seq scan, timelines,
+              stream queues)
+  preempt     evicting a batch decode (cache blocks, release slot)
+  resume      zero-duration marker per preemption replay admission
+  host_gap    the iteration residual no explicit phase claims — the
+              bubble dispatch-ahead exists to hide
+  idle        waiting for work (empty batcher); excluded from goodput
+
+Phase and fn label values are CLOSED SETS behind `LabelGuard`s: an
+unknown name collapses to `other` instead of minting a series.
+
+The compile-watch wraps jitted callables and keys every call by the
+ABSTRACT signature of its arguments (shape/dtype for arrays, value for
+python scalars — matching `static_argnames` semantics for the wrapped
+fns here, whose only scalar args are static). A signature never seen
+before, beyond the fn's first (the expected initial compile), is a
+retrace: the counter hook fires (`serving_recompiles_total{fn}` /
+`train_recompiles_total{fn}`) and a `recompile` span records the
+offending signature. Steady-state decode repeats one signature, so a
+nonzero rate is always news.
+
+No jax import here: obs stays importable in jax-free processes, and
+signatures duck-type ``.shape``/``.dtype`` instead of tracing.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import functools
+import threading
+import time
+from typing import Any, Callable
+
+from kubeflow_tpu.obs.cardinality import LabelGuard
+from kubeflow_tpu.obs.metrics import sample_quantile
+
+# The serving step anatomy (ContinuousBatcher worker loop).
+SERVING_PHASES = ("admit", "prefill", "decode", "sample", "detokenize",
+                  "preempt", "resume", "host_gap", "idle")
+# The training step anatomy (Trainer.step): one device phase plus the
+# host gap between consecutive steps (input pipeline, checkpointing).
+TRAIN_PHASES = ("step", "host_gap")
+# Goodput numerator per anatomy: the phase that is useful device work.
+GOODPUT_PHASES = ("decode", "step")
+# Phases excluded from the goodput denominator: an empty batcher
+# parked on its wake event is not a bubble, it has no work.
+IDLE_PHASES = ("idle",)
+
+# Jitted callables the serving compile-watch wraps (closed fn set).
+WATCHED_SERVING_FNS = ("decode_step", "prefill", "insert_many",
+                       "gather_seed", "reset_slots")
+WATCHED_TRAIN_FNS = ("train_step",)
+
+_MAX_COUNTER_EVENTS = 2048
+
+
+def abstract_signature(args: tuple, kwargs: dict) -> str:
+    """Compact hashable key for a call's abstract shapes: arrays render
+    as `dtype[d0,d1,...]` (duck-typed — works for jax/numpy arrays and
+    ShapeDtypeStructs without importing either), python scalars by
+    value (static-arg semantics), containers structurally."""
+
+    def sig(x: Any) -> str:
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            return f"{dtype}[{','.join(str(d) for d in shape)}]"
+        if isinstance(x, (bool, int, float, str, bytes)) or x is None:
+            return repr(x)
+        if isinstance(x, (tuple, list)):
+            return "(" + ",".join(sig(v) for v in x) + ")"
+        if isinstance(x, dict):
+            items = sorted(x.items(), key=lambda kv: str(kv[0]))
+            return "{" + ",".join(f"{k}:{sig(v)}" for k, v in items) + "}"
+        # opaque leaves (pytree nodes the duck-typing missed) key by
+        # TYPE only: better to miss a retrace than to invent one per
+        # object identity
+        return type(x).__name__
+
+    return sig(args) + sig(kwargs) if kwargs else sig(args)
+
+
+class _PhaseStats:
+    __slots__ = ("count", "total_s", "tokens", "window")
+
+    def __init__(self, window: int | None):
+        self.count = 0
+        self.total_s = 0.0
+        self.tokens = 0
+        self.window: Any = (collections.deque(maxlen=window)
+                            if window else [])
+
+
+class PhaseProfiler:
+    """Aggregates named-phase timings into totals, rolling-window
+    percentiles, a goodput ledger, and Chrome counter tracks.
+
+    Usage (the batcher/trainer side):
+
+        with profiler.phase("decode", tokens=steps * occupancy):
+            ... device call ...
+
+    Phases nest: a parent's recorded duration EXCLUDES time spent in
+    nested phases (admit excludes the prefill dispatch it contains), so
+    phase sums reconcile against wall time without double counting.
+    `begin_iteration`/`end_iteration` bracket one worker-loop pass and
+    book the unclaimed residual as `host_gap` — by construction the
+    phase sums then equal the measured loop wall time.
+
+    Everything here is defensive pure python: a profiler bug must never
+    kill the instrumented worker, so the `on_phase` hook is swallowed
+    like every other batcher hook and internal state is lock-guarded.
+    """
+
+    def __init__(self, *, phases: tuple[str, ...] = SERVING_PHASES,
+                 clock: Callable[[], float] | None = None,
+                 wall_clock: Callable[[], float] | None = None,
+                 window: int | None = 512):
+        self.phases = tuple(phases)
+        self.guard = LabelGuard(seed=self.phases, closed=True)
+        self._clock = clock or time.perf_counter
+        self._wall = wall_clock or time.time
+        self._window = window
+        self._lock = threading.Lock()
+        self._stats: dict[str, _PhaseStats] = {
+            p: _PhaseStats(window) for p in self.phases}
+        # nesting stack (single worker task/thread by construction):
+        # [name, start, child_seconds]
+        self._stack: list[list] = []
+        self._iter_t0: float | None = None
+        self._iter_claimed = 0.0
+        self._t_first: float | None = None
+        self._t_last: float | None = None
+        # optional hook(phase, seconds, tokens) — the server wires the
+        # labeled histograms through it; exceptions are swallowed.
+        # seconds is None for token-only attributions (add_tokens).
+        self.on_phase: Callable[[str, float | None, int], None] | None \
+            = None
+        # goodput ledger extras
+        self.pool_high_water = 0
+        self.pool_capacity = 0
+        self.occupancy_high_water = 0
+        self.slots = 0
+        self._pool_last = -1
+        self._occ_last = -1
+        self._events: collections.deque = collections.deque(
+            maxlen=_MAX_COUNTER_EVENTS)
+
+    # -- recording ---------------------------------------------------------
+
+    @contextlib.contextmanager
+    def phase(self, name: str, tokens: int = 0):
+        start = self._clock()
+        if self._t_first is None:
+            # the observed-wall window opens at the first phase START
+            # (record() only back-dates by the EXCLUSIVE duration, which
+            # undercounts when the first record is a nested child)
+            self._t_first = start
+        frame = [name, start, 0.0]
+        self._stack.append(frame)
+        try:
+            yield
+        finally:
+            dur = self._clock() - start
+            if self._stack and self._stack[-1] is frame:
+                self._stack.pop()
+            if self._stack:
+                self._stack[-1][2] += dur
+            self.record(name, max(0.0, dur - frame[2]), tokens=tokens)
+
+    def record(self, name: str, seconds: float, tokens: int = 0) -> None:
+        name = self.guard.admit(name)
+        seconds = max(0.0, float(seconds))
+        now = self._clock()
+        with self._lock:
+            st = self._stats.get(name)
+            if st is None:
+                st = self._stats[name] = _PhaseStats(self._window)
+            st.count += 1
+            st.total_s += seconds
+            st.tokens += int(tokens)
+            st.window.append(seconds)
+            self._t_last = now
+            if self._t_first is None:
+                self._t_first = now - seconds
+            if self._iter_t0 is not None:
+                # phases record EXCLUSIVE durations (nesting subtracts
+                # child time), so summing every record — nested or
+                # not — claims exactly the inclusive wall of the
+                # iteration's top-level phases
+                self._iter_claimed += seconds
+        if self.on_phase is not None:
+            try:
+                self.on_phase(name, seconds, int(tokens))
+            except Exception:  # noqa: BLE001 — metrics hook
+                pass           # must never kill the instrumented loop
+
+    def add_tokens(self, name: str, tokens: int) -> None:
+        """Attribute tokens to a phase without a timing sample (decode
+        tokens are counted where they are OBSERVED — at host
+        processing — while decode time is measured at dispatch)."""
+        if tokens <= 0:
+            return
+        name = self.guard.admit(name)
+        with self._lock:
+            st = self._stats.get(name)
+            if st is None:
+                st = self._stats[name] = _PhaseStats(self._window)
+            st.tokens += int(tokens)
+        if self.on_phase is not None:
+            try:
+                self.on_phase(name, None, int(tokens))
+            except Exception:  # noqa: BLE001 — metrics hook
+                pass
+
+    def begin_iteration(self) -> None:
+        self._iter_t0 = self._clock()
+        self._iter_claimed = 0.0
+
+    def end_iteration(self) -> None:
+        """Book the loop-pass residual (wall minus every top-level
+        phase recorded since begin_iteration) as `host_gap` — the
+        attribution invariant `sum(phases) == loop wall` holds by
+        construction."""
+        if self._iter_t0 is None:
+            return
+        residual = (self._clock() - self._iter_t0) - self._iter_claimed
+        self._iter_t0 = None
+        if residual > 0.0:
+            self.record("host_gap", residual)
+        self._emit_phase_track()
+
+    # -- pool / occupancy high-water marks ---------------------------------
+
+    def note_pool(self, in_use: int, capacity: int) -> None:
+        self.pool_capacity = int(capacity)
+        in_use = int(in_use)
+        if in_use > self.pool_high_water:
+            self.pool_high_water = in_use
+        if in_use != self._pool_last:
+            self._pool_last = in_use
+            self._events.append({
+                "name": "kv_blocks", "ph": "C",
+                "ts": round(self._wall() * 1e6, 1), "pid": 1, "tid": 0,
+                "args": {"in_use": in_use}})
+
+    def note_occupancy(self, occupied: int, slots: int) -> None:
+        self.slots = int(slots)
+        occupied = int(occupied)
+        if occupied > self.occupancy_high_water:
+            self.occupancy_high_water = occupied
+        if occupied != self._occ_last:
+            self._occ_last = occupied
+            self._events.append({
+                "name": "batch_occupancy", "ph": "C",
+                "ts": round(self._wall() * 1e6, 1), "pid": 1, "tid": 0,
+                "args": {"slots_active": occupied}})
+
+    def _emit_phase_track(self) -> None:
+        with self._lock:
+            args = {p: round(st.total_s, 6)
+                    for p, st in self._stats.items() if st.count}
+        if args:
+            self._events.append({
+                "name": "phase_seconds", "ph": "C",
+                "ts": round(self._wall() * 1e6, 1), "pid": 1, "tid": 0,
+                "args": args})
+
+    # -- read side ---------------------------------------------------------
+
+    def counter_events(self, *, prefix: str = "") -> list[dict]:
+        """Chrome counter-track events (`"ph": "C"`), timestamped on
+        the same wall clock as the tracer's span events so they merge
+        into one `/debug/traces` payload. `prefix` namespaces the track
+        names per model."""
+        out = []
+        for e in list(self._events):
+            e = dict(e)
+            if prefix:
+                e["name"] = f"{prefix}.{e['name']}"
+            out.append(e)
+        return out
+
+    def totals(self) -> dict[str, float]:
+        with self._lock:
+            return {p: st.total_s for p, st in self._stats.items()}
+
+    def phase_tokens(self) -> dict[str, int]:
+        with self._lock:
+            return {p: st.tokens for p, st in self._stats.items()}
+
+    def samples(self, name: str) -> list[float]:
+        with self._lock:
+            st = self._stats.get(name)
+            return list(st.window) if st else []
+
+    def wall_s(self) -> float:
+        """Wall window the profiler has observed (first record to
+        last) — what the attribution 5%-reconciliation compares phase
+        sums against."""
+        with self._lock:
+            if self._t_first is None or self._t_last is None:
+                return 0.0
+            return self._t_last - self._t_first
+
+    def goodput(self) -> dict[str, float]:
+        """The ledger: useful-device-time share of non-idle wall, the
+        bubble (host_gap) share, and the high-water marks."""
+        with self._lock:
+            totals = {p: st.total_s for p, st in self._stats.items()}
+        busy = sum(s for p, s in totals.items() if p not in IDLE_PHASES)
+        good = sum(totals.get(p, 0.0) for p in GOODPUT_PHASES)
+        bubble = totals.get("host_gap", 0.0)
+        return {
+            "goodput_ratio": good / busy if busy > 0 else 0.0,
+            "bubble_fraction": bubble / busy if busy > 0 else 0.0,
+            "busy_s": busy,
+            "idle_s": sum(totals.get(p, 0.0) for p in IDLE_PHASES),
+            "kv_blocks_high_water": self.pool_high_water,
+            "kv_blocks_capacity": self.pool_capacity,
+            "occupancy_high_water": self.occupancy_high_water,
+            "slots": self.slots,
+        }
+
+    def snapshot(self) -> dict:
+        """The `/debug/profile` building block: per-phase counts,
+        totals, tokens, and rolling p50/p95 (same interpolation as
+        `Histogram.quantile` — see `sample_quantile`), plus the goodput
+        ledger."""
+        phases = {}
+        with self._lock:
+            items = [(p, st.count, st.total_s, st.tokens,
+                      list(st.window)) for p, st in self._stats.items()]
+        for p, count, total_s, tokens, win in items:
+            phases[p] = {
+                "count": count,
+                "total_s": round(total_s, 6),
+                "tokens": tokens,
+                "p50_s": sample_quantile(win, 0.50),
+                "p95_s": sample_quantile(win, 0.95),
+            }
+        return {"phases": phases, "goodput": self.goodput(),
+                "wall_s": round(self.wall_s(), 6)}
+
+
+class CompileWatch:
+    """Retrace detector over jitted callables.
+
+    `watch(fn, name)` returns a wrapper that keys every call by
+    `abstract_signature(args, kwargs)`. The FIRST signature per fn is
+    the expected initial compile; every novel signature after it is a
+    retrace: the local ledger increments, `on_recompile(fn, sig)` fires
+    (the server binds the `*_recompiles_total{fn}` counter there), and
+    when a tracer is attached a `recompile` span records the offending
+    signature. Calls repeating a seen signature cost one string build
+    and a set lookup.
+
+    fn names are a closed set behind a LabelGuard (seeded by `watch`),
+    so the label space cannot grow past the wrapped callables.
+    """
+
+    def __init__(self, *, tracer=None,
+                 on_recompile: Callable[[str, str], None] | None = None):
+        self.tracer = tracer
+        self.on_recompile = on_recompile
+        self.guard = LabelGuard()
+        self._seen: dict[str, set[str]] = {}
+        self._recompiles: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def watch(self, fn: Callable, name: str) -> Callable:
+        name = self.guard.admit(name)
+        with self._lock:
+            self._seen.setdefault(name, set())
+            self._recompiles.setdefault(name, 0)
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            try:
+                sig = abstract_signature(args, kwargs)
+            except Exception:  # noqa: BLE001 — watch must not break fn
+                return fn(*args, **kwargs)
+            with self._lock:
+                seen = self._seen[name]
+                novel = sig not in seen
+                first = novel and not seen
+                if novel:
+                    seen.add(sig)
+                    if not first:
+                        self._recompiles[name] += 1
+            if novel and not first:
+                self._note_recompile(name, sig)
+            return fn(*args, **kwargs)
+
+        return wrapped
+
+    def _note_recompile(self, name: str, sig: str) -> None:
+        if self.tracer is not None:
+            try:
+                with self.tracer.span("recompile", fn=name,
+                                      signature=sig[:512]):
+                    pass
+            except Exception:  # noqa: BLE001
+                pass
+        if self.on_recompile is not None:
+            try:
+                self.on_recompile(name, sig)
+            except Exception:  # noqa: BLE001 — metrics hook
+                pass
+
+    def counts(self) -> dict[str, int]:
+        """Per-fn retrace counts (the `/debug/profile` `recompiles`
+        block; mirrors the `*_recompiles_total{fn}` counters)."""
+        with self._lock:
+            return dict(self._recompiles)
+
+    def watched(self) -> tuple[str, ...]:
+        with self._lock:
+            return tuple(self._seen)
+
+
+def merge_counter_tracks(payload: dict, events: list[dict]) -> dict:
+    """Append counter-track events to a Chrome-trace payload in place
+    (no-op for summary payloads without `traceEvents`)."""
+    if isinstance(payload, dict) and isinstance(
+            payload.get("traceEvents"), list):
+        payload["traceEvents"].extend(events)
+    return payload

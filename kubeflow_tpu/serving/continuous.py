@@ -69,6 +69,7 @@ from kubeflow_tpu.serving.engine import (
     SamplingParams,
     transformer_block,
 )
+from kubeflow_tpu.obs.profiling import CompileWatch, PhaseProfiler
 from kubeflow_tpu.obs.timeline import RequestTimeline, TimelineStore
 from kubeflow_tpu.serving import migration
 from kubeflow_tpu.serving.paged import BlockPool, RadixPrefixCache
@@ -860,6 +861,34 @@ class ContinuousBatcher:
         # executor thread, tagged with the RESOLVED attention impl —
         # traces show which kernel served a step
         self.tracer = None
+        # Step-anatomy profiler (ISSUE 8): always on — pure-python
+        # phase accounting is a few clock reads per iteration. The
+        # server binds `/metrics` histograms through profiler.on_phase
+        # (the on_prefix hook idiom) and `/debug/profile` reads
+        # profiler.snapshot(); bench --attribution reads it directly.
+        # Shares the injectable clock so tests reconcile profiler
+        # totals against timeline stamps on one timebase.
+        self.profiler = PhaseProfiler(clock=self._clock)
+        # Compile-watch: every jitted callable on this batcher's hot
+        # path keys calls by abstract shape signature; a novel
+        # signature past each fn's first is a retrace — counted here,
+        # surfaced as serving_recompiles_total{fn} once the server
+        # binds compile_watch.on_recompile. (warmup() walks the bounded
+        # compile set through these wrappers, so the counters start at
+        # the warmed-shape count; steady state is flat — the alert is
+        # on the RATE.)
+        self.compile_watch = CompileWatch()
+        ce = self.cengine
+        ce._step_jit = self.compile_watch.watch(
+            ce._step_jit, "decode_step")
+        ce._insert_many_jit = self.compile_watch.watch(
+            ce._insert_many_jit, "insert_many")
+        ce._gather_seed_jit = self.compile_watch.watch(
+            ce._gather_seed_jit, "gather_seed")
+        ce._reset_jit = self.compile_watch.watch(
+            ce._reset_jit, "reset_slots")
+        engine._prefill_jit = self.compile_watch.watch(
+            engine._prefill_jit, "prefill")
         # Shared prefixes (system prompts): token lists registered at
         # construction; each computes its KV ONCE, lazily, on first use
         # (device work belongs under the gpu lock, not in __init__).
@@ -1295,6 +1324,10 @@ class ContinuousBatcher:
             self._preempt(victim)
 
     def _preempt(self, slot: int) -> None:
+        with self.profiler.phase("preempt"):
+            self._preempt_inner(slot)
+
+    def _preempt_inner(self, slot: int) -> None:
         """Evict one active decode and re-enqueue it at the head of
         its tenant's queue. The clean-retirement path minus resolving
         the future: cache the full blocks, release the slot (its table
@@ -1449,6 +1482,14 @@ class ContinuousBatcher:
             self.cengine.pool.free(plan["fresh"])
 
     async def _admit_group(self, items: list) -> None:
+        # `admit` phase wraps the whole admission pass; the grouped
+        # prefill/gather device call inside is its own nested `prefill`
+        # phase (nesting subtracts: admit records planning + insert
+        # only, never double-counts prefill time)
+        with self.profiler.phase("admit"):
+            await self._admit_group_inner(items)
+
+    async def _admit_group_inner(self, items: list) -> None:
         """Admit up to len(self._free) requests; items sharing a
         prefill bucket, prefix AND cached-seed length share ONE prefill
         dispatch, and the group's slot scatters share one insert_many
@@ -1508,33 +1549,39 @@ class ContinuousBatcher:
                     lists, b, samps, sub, ids, pstate0)
                 return pstate, np.asarray(first), np.asarray(lps)
 
+            ptoks = sum(len(pl["suffix"]) for _, pl in group)
             try:
-                if prefix:
-                    pstate0 = await self._get_prefix_state(prefix)
-                elif m > 0:
-                    # seed rows from cached pool blocks: gather each
-                    # row's chain (+ partial CoW block) into a batch-g
-                    # DecodeState. self._st exists — a non-empty radix
-                    # tree implies blocks were inserted into it.
-                    mb = self.cengine.blocks_per_slot
-                    chains = np.zeros((gp, mb), np.int32)
-                    for i, (_, pl) in enumerate(group):
-                        phys = [n.block for n in pl["chain"]]
-                        if pl["extra"] is not None:
-                            phys.append(pl["extra"].block)
-                        chains[i, :len(phys)] = phys
+                with self.profiler.phase("prefill", tokens=ptoks):
+                    if prefix:
+                        pstate0 = await self._get_prefix_state(prefix)
+                    elif m > 0:
+                        # seed rows from cached pool blocks: gather
+                        # each row's chain (+ partial CoW block) into a
+                        # batch-g DecodeState. self._st exists — a
+                        # non-empty radix tree implies blocks were
+                        # inserted into it.
+                        mb = self.cengine.blocks_per_slot
+                        chains = np.zeros((gp, mb), np.int32)
+                        for i, (_, pl) in enumerate(group):
+                            phys = [n.block for n in pl["chain"]]
+                            if pl["extra"] is not None:
+                                phys.append(pl["extra"].block)
+                            chains[i, :len(phys)] = phys
 
-                    def run_gather(st=self._st, chains=chains, m=m):
-                        return self.cengine.gather_seed(st, chains, m)
+                        def run_gather(st=self._st, chains=chains,
+                                       m=m):
+                            return self.cengine.gather_seed(
+                                st, chains, m)
 
+                        async with self.gpu_lock:
+                            pstate0 = await loop.run_in_executor(
+                                None, run_gather)
+                    else:
+                        pstate0 = None
                     async with self.gpu_lock:
-                        pstate0 = await loop.run_in_executor(
-                            None, run_gather)
-                else:
-                    pstate0 = None
-                async with self.gpu_lock:
-                    pstate, firsts, flps = await loop.run_in_executor(
-                        None, run_prefill, pstate0)
+                        pstate, firsts, flps = \
+                            await loop.run_in_executor(
+                                None, run_prefill, pstate0)
             except Exception as e:  # noqa: BLE001
                 for it, pl in group:
                     self._drop_plan(pl)
@@ -1645,6 +1692,11 @@ class ContinuousBatcher:
                         self.on_prefix(computed, reused, reused > 0)
                     except Exception:  # noqa: BLE001 — metrics hook
                         pass           # must never kill the worker
+                if resumed:
+                    # zero-duration marker: the replay's cost already
+                    # lives in admit/prefill; the marker's COUNT is
+                    # what reconciles against timeline `resume` events
+                    self.profiler.record("resume", 0.0)
                 if meta.timeline is not None:
                     meta.timeline.event(
                         "resume" if resumed else "admit", slot=slot,
@@ -1705,11 +1757,15 @@ class ContinuousBatcher:
             run_step = self.tracer.wrap(
                 run_step, "decode.attention",
                 impl=self.cengine.attention_impl, steps=steps)
-        async with self.gpu_lock:
-            st, toks, lps, rng = await loop.run_in_executor(
-                None, run_step)
-            self._st = st
-            self._rng = rng
+        # `decode` phase = dispatch + any blocking inside run_step.
+        # Tokens are attributed where they're OBSERVED (_process_chunk)
+        # so over-decoded garbage rows never inflate the count.
+        with self.profiler.phase("decode"):
+            async with self.gpu_lock:
+                st, toks, lps, rng = await loop.run_in_executor(
+                    None, run_step)
+                self._st = st
+                self._rng = rng
         self.calls += steps
         return {"toks": toks, "lps": lps, "steps": steps, "snap": snap}
 
@@ -1723,19 +1779,29 @@ class ContinuousBatcher:
                            np.asarray(rec["lps"])))
 
     def _process_chunk(self, rec: dict) -> None:
-        toks = np.asarray(rec["toks"])
-        lps = np.asarray(rec["lps"])
-        for slot, srec in list(self._active.items()):
-            if rec["snap"].get(slot) is not srec:
-                continue  # admitted after dispatch: tokens not its own
-            if srec.fut.done():  # caller cancelled mid-decode
-                self._finish(slot, srec)
-                continue
-            for j in range(rec["steps"]):
-                self._emit(slot, srec, int(toks[slot, j]),
-                           float(lps[slot, j]))
-                if slot not in self._active:
-                    break  # retired mid-chunk; tail is trimmed
+        # `sample` = host materialization of the device's sampled
+        # tokens; `detokenize` = per-token emit bookkeeping. Decode
+        # TOKENS are booked here (each emitted token exactly once, so
+        # preempt/resume replay — which RESTORES rec.out rather than
+        # re-emitting — cannot double count).
+        with self.profiler.phase("sample"):
+            toks = np.asarray(rec["toks"])
+            lps = np.asarray(rec["lps"])
+        emitted0 = self.tokens_emitted
+        with self.profiler.phase("detokenize"):
+            for slot, srec in list(self._active.items()):
+                if rec["snap"].get(slot) is not srec:
+                    continue  # admitted after dispatch: not its tokens
+                if srec.fut.done():  # caller cancelled mid-decode
+                    self._finish(slot, srec)
+                    continue
+                for j in range(rec["steps"]):
+                    self._emit(slot, srec, int(toks[slot, j]),
+                               float(lps[slot, j]))
+                    if slot not in self._active:
+                        break  # retired mid-chunk; tail is trimmed
+        self.profiler.add_tokens("decode",
+                                 self.tokens_emitted - emitted0)
 
     async def _run(self) -> None:
         loop = asyncio.get_event_loop()
@@ -1745,12 +1811,20 @@ class ContinuousBatcher:
         while True:
             if not self._active and not self._pending and not inflight:
                 self._wake.clear()
-                await self._wake.wait()
+                # `idle` (no work) is its own phase, excluded from the
+                # goodput denominator — an empty batcher parked on its
+                # wake event is not a bubble
+                with self.profiler.phase("idle"):
+                    await self._wake.wait()
             if self._halt:
                 # migration export wants the batcher quiescent: park at
                 # the loop boundary (active/pending intact, no local
                 # buffers in flight) and let export_sequences serialize
                 return
+            # One profiled iteration: every explicit phase below claims
+            # its wall time; end_iteration books the residual as
+            # host_gap, so phase sums reconcile against loop wall time
+            self.profiler.begin_iteration()
             # Preemption runs BEFORE the dirty-slot reset so an evicted
             # slot's table is trash-reset in this same iteration —
             # admission below may hand its freed blocks to the
@@ -1767,10 +1841,15 @@ class ContinuousBatcher:
             if self._dirty and self._st is not None:
                 dirty = sorted(set(self._dirty))
                 try:
-                    async with self.gpu_lock:
-                        self._st = await loop.run_in_executor(
-                            None, self.cengine.reset_slots,
-                            self._st, dirty)
+                    # slot recycling is part of the admission path's
+                    # block management — attribute it there, not to the
+                    # host_gap residual (its first call is also the
+                    # reset program's compile)
+                    with self.profiler.phase("admit"):
+                        async with self.gpu_lock:
+                            self._st = await loop.run_in_executor(
+                                None, self.cengine.reset_slots,
+                                self._st, dirty)
                 except Exception as e:  # noqa: BLE001
                     self._fail_all(e)
                     inflight.clear()
@@ -1814,14 +1893,21 @@ class ContinuousBatcher:
                         await self._dispatch_chunk(loop, steps))
                 elif inflight:
                     # nothing useful to dispatch ahead: block on the
-                    # oldest chunk and process it
+                    # oldest chunk and process it (the blocking wait IS
+                    # device decode time: attribute it to `decode`)
                     head = inflight.popleft()
-                    await self._sync_chunk(loop, head)
+                    with self.profiler.phase("decode"):
+                        await self._sync_chunk(loop, head)
                     self._process_chunk(head)
             except Exception as e:  # noqa: BLE001 — fail active requests
                 self._fail_all(e)  # donated buffers may be mid-flight
                 inflight.clear()
                 continue
+            self.profiler.note_pool(self.cengine.pool.in_use,
+                                    self.cengine.pool.capacity)
+            self.profiler.note_occupancy(
+                len(self._active), len(self._active) + len(self._free))
+            self.profiler.end_iteration()
             # let submissions/cancellations interleave between steps
             await asyncio.sleep(0)
 

@@ -217,6 +217,42 @@ class ServingObs:
             self.registry, "serving_queue_wait_seconds",
             "Enqueue to first admission into the decode batch, per "
             "model (scheduling delay; excludes prefill)")
+        # Step-anatomy profiling plane (ISSUE 8): the continuous
+        # batcher's PhaseProfiler decomposes every worker iteration
+        # into named phases; these families carry the decomposition.
+        # Phase/fn labels are CLOSED SETS (obs.profiling guards them),
+        # zero-seeded per model at app creation.
+        self.step_phase_seconds = obs_lib.get_or_create_histogram(
+            self.registry, "serving_step_phase_seconds",
+            "Wall time per worker-loop phase (admit, prefill, decode, "
+            "sample, detokenize, preempt, resume, host_gap, idle), "
+            "per model — phases record exclusive time, so summing "
+            "them reconstructs loop wall time")
+        self.step_tokens = obs_lib.get_or_create_histogram(
+            self.registry, "serving_step_tokens",
+            "Tokens attributed per phase and model (prefill: suffix "
+            "tokens computed per grouped prefill; decode: tokens "
+            "emitted per chunk)", buckets=obs_lib.TOKEN_BUCKETS)
+        self.goodput = Gauge(
+            "serving_goodput_ratio",
+            "Decode device-time share of total non-idle step time, "
+            "per model (the Podracer-style goodput ledger; 1.0 means "
+            "every non-idle second decoded tokens)", self.registry)
+        self.bubble = Gauge(
+            "serving_bubble_fraction",
+            "host_gap share of total non-idle step time, per model — "
+            "the bubble dispatch-ahead exists to hide", self.registry)
+        self.kv_high_water = Gauge(
+            "serving_kv_blocks_high_water",
+            "High-water mark of KV pool blocks in use since startup, "
+            "per model (capacity headroom for the pool sizing knob)",
+            self.registry)
+        self.recompiles = Counter(
+            "serving_recompiles_total",
+            "Retraces of a watched jitted callable (a novel abstract "
+            "shape signature past the fn's first) — nonzero RATE in "
+            "steady state means the compile-shape bucketing leaked",
+            self.registry)
         # SLO burn rates (obs.slo): the engine IS the gauge metric —
         # registering it zero-seeds every slo x window series. TTFT
         # objectives are per priority class; error-rate likewise;
@@ -691,6 +727,36 @@ def create_serving_app(engines: dict[str, InferenceEngine],
             sobs.attention_impl.set(
                 1, model=model_name, impl=b.cengine.attention_impl)
             b.tracer = sobs.tracer
+            # Step-anatomy plane (ISSUE 8): zero-seed the full closed
+            # phase/fn label sets so dashboards see every series from
+            # the first scrape, then bind the profiler and
+            # compile-watch hooks (same swallowed-exception contract
+            # as on_prefix — see PhaseProfiler)
+            for _p in obs_lib.SERVING_PHASES:
+                sobs.step_phase_seconds.seed(model=model_name, phase=_p)
+                sobs.step_tokens.seed(model=model_name, phase=_p)
+            sobs.goodput.set(0.0, model=model_name)
+            sobs.bubble.set(0.0, model=model_name)
+            sobs.kv_high_water.set(0, model=model_name)
+            for _fn in obs_lib.WATCHED_SERVING_FNS:
+                sobs.recompiles.inc(0, model=model_name, fn=_fn)
+
+            def on_phase(phase, seconds, tokens, _m=model_name):
+                # seconds is None for token-only attributions
+                if seconds is not None:
+                    sobs.step_phase_seconds.observe(
+                        seconds, model=_m, phase=phase)
+                if tokens:
+                    sobs.step_tokens.observe(
+                        tokens, model=_m, phase=phase)
+
+            b.profiler.on_phase = on_phase
+            b.compile_watch.tracer = sobs.tracer
+
+            def on_recompile(fn, sig, _m=model_name):
+                sobs.recompiles.inc(model=_m, fn=fn)
+
+            b.compile_watch.on_recompile = on_recompile
     if continuous:
         def collect_kv_blocks():
             # gauge refreshed at render: /metrics reads the LIVE pool,
@@ -700,6 +766,19 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                     sobs.kv_blocks.set(_b.kv_blocks_in_use(), model=_m)
 
         sobs.registry.register_collector(collect_kv_blocks)
+
+        def collect_goodput():
+            # the goodput ledger is derived state: recompute at render
+            # from the profiler's phase totals + high-water marks
+            for _m, _b in app[BATCHERS_KEY].items():
+                if isinstance(_b, ContinuousBatcher):
+                    g = _b.profiler.goodput()
+                    sobs.goodput.set(g["goodput_ratio"], model=_m)
+                    sobs.bubble.set(g["bubble_fraction"], model=_m)
+                    sobs.kv_high_water.set(
+                        g["kv_blocks_high_water"], model=_m)
+
+        sobs.registry.register_collector(collect_goodput)
     if tenancy is not None:
         # zero-seed the full per-tenant series set so dashboards see
         # every configured tenant (at 0) from the first scrape, and
@@ -775,12 +854,40 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                       "and the store is bounded)"},
             status=404)
 
+    async def debug_traces(request):
+        # the shared traces handler plus this app's counter tracks
+        # (ISSUE 8): phase budgets and pool fill ride the SAME Chrome
+        # trace as the spans, namespaced per model
+        try:
+            payload = obs_lib.traces_response_payload(
+                sobs.tracer, request.rel_url.query)
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e)) from None
+        for _m, _b in request.app[BATCHERS_KEY].items():
+            if isinstance(_b, ContinuousBatcher):
+                obs_lib.merge_counter_tracks(
+                    payload, _b.profiler.counter_events(prefix=_m))
+        return web.json_response(payload)
+
+    async def debug_profile(request):
+        # rolling step anatomy: per-phase p50/p95 + totals, the
+        # goodput ledger, and per-fn retrace counts — the JSON the
+        # "reading a step anatomy" walkthrough (docs/observability.md)
+        # narrates
+        models = {}
+        for _m, _b in request.app[BATCHERS_KEY].items():
+            if isinstance(_b, ContinuousBatcher):
+                snap = _b.profiler.snapshot()
+                snap["recompiles"] = _b.compile_watch.counts()
+                models[_m] = snap
+        return web.json_response({"models": models})
+
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/readyz", _ok)
     app.router.add_get("/metrics",
                        obs_endpoints.metrics_handler(sobs.registry))
-    app.router.add_get("/debug/traces",
-                       obs_endpoints.traces_handler(sobs.tracer))
+    app.router.add_get("/debug/traces", debug_traces)
+    app.router.add_get("/debug/profile", debug_profile)
     app.router.add_post("/drain", drain_endpoint)
     app.router.add_post("/v1/migrate/in", migrate_in)
     app.router.add_get("/v1/models", list_models)

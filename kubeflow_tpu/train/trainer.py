@@ -296,6 +296,44 @@ class Trainer:
             buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
                      120.0, 300.0, 600.0))
         self._stepped = False
+        # Step-anatomy plane (ISSUE 8): the SAME PhaseProfiler the
+        # serving batcher uses, with the training anatomy — `step`
+        # (the jit call) and `host_gap` (wall between consecutive
+        # steps: input pipeline, checkpointing, logging). Goodput for
+        # a trainer is step-time over (step + host_gap).
+        self.profiler = obs.PhaseProfiler(phases=obs.TRAIN_PHASES)
+        self.phase_seconds = obs.get_or_create_histogram(
+            reg, "train_step_phase_seconds",
+            "Wall time per training phase: step (jit dispatch; the "
+            "first call blocks through compile) and host_gap (time "
+            "between consecutive steps)")
+        for _p in obs.TRAIN_PHASES:
+            self.phase_seconds.seed(phase=_p)
+
+        def _on_phase(phase, seconds, tokens):
+            if seconds is not None:
+                self.phase_seconds.observe(seconds, phase=phase)
+
+        self.profiler.on_phase = _on_phase
+        # Compile-watch over the jitted step: a batch/seq shape change
+        # mid-run is a retrace the owner should know about (it stalls
+        # every replica for the compile) — counted per fn, with a
+        # `recompile` span naming the offending signature.
+        self.recompiles = reg.get("train_recompiles_total")
+        if self.recompiles is None:
+            from kubeflow_tpu.controlplane.metrics import Counter
+
+            self.recompiles = Counter(
+                "train_recompiles_total",
+                "Retraces of the jitted train step (novel abstract "
+                "batch shape past the first compile)", reg)
+        self._compile_watch = obs.CompileWatch(
+            tracer=self.tracer,
+            on_recompile=lambda fn, sig: self.recompiles.inc(fn=fn))
+        self._jit_step = self._compile_watch.watch(
+            self._jit_step, "train_step")
+        self.recompiles.inc(0, fn="train_step")
+        self._last_step_end: float | None = None
 
     def _build_state(self, params: Params) -> TrainState:
         return TrainState(params, self.optimizer.init(params),
@@ -380,11 +418,20 @@ class Trainer:
         # meaningful wall measurement → train_compile_seconds.
         compiling = not self._stepped
         t0 = time.perf_counter()
+        if self._last_step_end is not None:
+            # Everything between consecutive step() calls — input
+            # pipeline, checkpoint writes, eval, logging — is the
+            # trainer's host gap.
+            self.profiler.record("host_gap", t0 - self._last_step_end)
         with self.tracer.span("train.step", batch=int(tokens.shape[0]),
                               compile=compiling):
             with mesh_lib.set_mesh(self.mesh):
-                out = self._jit_step(state, tokens, targets, mask)
+                with self.profiler.phase(
+                        "step", tokens=int(tokens.shape[0])
+                        * int(tokens.shape[1])):
+                    out = self._jit_step(state, tokens, targets, mask)
         dt = time.perf_counter() - t0
+        self._last_step_end = time.perf_counter()
         self.step_seconds.observe(dt)
         if compiling:
             self._stepped = True

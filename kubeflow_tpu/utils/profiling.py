@@ -68,7 +68,14 @@ def trace(logdir: str, tracer: Any = None):
 
 
 class StepTimer:
-    """Blocking step timer with percentile summary.
+    """Blocking step timer with percentile summary — a thin adapter
+    over `obs.profiling.PhaseProfiler` (ISSUE 8): every recorded step
+    is a `name` phase on the profiler, so training processes get the
+    same step-anatomy aggregation (totals, rolling percentiles,
+    counter tracks) the serving batcher has, and `summary()` uses the
+    same quantile interpolation as `obs.metrics.Histogram.quantile`
+    (`sample_quantile` — the old naive index pick disagreed with the
+    histogram-side p95 asserted by the tenants loadtest).
 
     `with timer.step(): ...` — the exit blocks on `ready` (pass the
     step's output) so async dispatch doesn't fake a fast step.
@@ -76,15 +83,20 @@ class StepTimer:
     Optional obs bridge: give it a `tracer` and/or `histogram` and each
     timed step also becomes a span (named `name`) and a histogram
     observation — the summary here stays process-local, the histogram
-    is what /metrics scrapes.
+    is what /metrics scrapes. Pass a shared `profiler` (the Trainer
+    passes its own) to aggregate into an existing step anatomy.
     """
 
     def __init__(self, tracer: Any = None, histogram: Any = None,
-                 name: str = "train.step"):
+                 name: str = "train.step", profiler: Any = None):
+        from kubeflow_tpu.obs.profiling import PhaseProfiler
+
         self.durations: list[float] = []
         self.tracer = tracer
         self.histogram = histogram
         self.name = name
+        self.profiler = (profiler if profiler is not None
+                         else PhaseProfiler(phases=(name,)))
 
     @contextlib.contextmanager
     def step(self, ready: Any = None, **attrs: Any):
@@ -99,22 +111,21 @@ class StepTimer:
 
     def record(self, seconds: float) -> None:
         self.durations.append(seconds)
+        self.profiler.record(self.name, seconds)
         if self.histogram is not None:
             self.histogram.observe(seconds)
 
     def summary(self) -> dict[str, float]:
+        from kubeflow_tpu.obs.metrics import sample_quantile
+
         if not self.durations:
             return {}
         xs = sorted(self.durations)
-
-        def pct(p: float) -> float:
-            return xs[min(len(xs) - 1, int(p * len(xs)))]
-
         return {
             "count": len(xs),
             "mean_s": sum(xs) / len(xs),
-            "p50_s": pct(0.50),
-            "p90_s": pct(0.90),
-            "p99_s": pct(0.99),
+            "p50_s": sample_quantile(xs, 0.50),
+            "p90_s": sample_quantile(xs, 0.90),
+            "p99_s": sample_quantile(xs, 0.99),
             "max_s": xs[-1],
         }
