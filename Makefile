@@ -44,14 +44,16 @@ profile-check: ## step-anatomy gate: /debug/profile + zero-seeded phase/recompil
 serving-check: ## CPU dense-oracle parity gate for the paged-KV serving path
 	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
 	  tests/test_continuous.py tests/test_paged_kv.py \
-	  tests/test_speculative.py -q -m "slow or not slow" \
+	  tests/test_speculative.py tests/test_chunked_prefill.py \
+	  tests/test_spec_paged.py -q -m "slow or not slow" \
 	  --deselect tests/test_continuous.py::test_continuous_engine_under_tensor_parallel_mesh \
 	  --deselect tests/test_serving.py::test_sharded_gemma_scale_vocab_decode_matches_unsharded
 
 kernels-check: ## Pallas kernels vs XLA oracles, interpret mode, both tiers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_flash.py \
 	  tests/test_decode_attention.py \
-	  tests/test_paged_attention_kernel.py -q -m "slow or not slow"
+	  tests/test_paged_attention_kernel.py \
+	  tests/test_prefill_append_kernel.py -q -m "slow or not slow"
 
 fleet-check: ## fleet router gate: unit + migration suites + 2-replica routed loadtest
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py \

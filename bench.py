@@ -635,6 +635,171 @@ def bench_decode_paged(model: str, *, slots: int, prompt_len: int,
     }
 
 
+def bench_decode_spec_paged(model: str, *, slots: int, prompt_len: int,
+                            max_new: int, requests: int, max_len: int,
+                            block_size: int, gamma: int,
+                            verbose: bool = True) -> dict:
+    """Speculative decoding folded into the continuous/paged engine
+    (ISSUE 9), A/B'd against the SAME batcher with speculation off on
+    the same request mix. Self-draft (draft == target): under greedy
+    sampling every proposal accepts, so the measured ratio is the
+    upper bound of the speculation win at this gamma — each round
+    replaces gamma + 1 sequential decode dispatches with gamma batched
+    draft forwards plus ONE fused paged verify. A real deployment's
+    ratio scales with its draft's acceptance rate (reported as an
+    extra metric straight from the batcher's own counters)."""
+    import asyncio
+
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.serving import engine as engine_lib
+    from kubeflow_tpu.serving.continuous import ContinuousBatcher
+
+    cfg = bench_configs()[model]
+    params = jax.jit(lambda k: llama.init(k, cfg))(jax.random.key(0))
+    jax.block_until_ready(params)
+    eng = engine_lib.InferenceEngine(
+        params, cfg, engine_lib.LLAMA_FAMILY,
+        engine_lib.EngineConfig(max_len=max_len),
+    )
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(requests)]
+    warm = rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+
+    def run(draft):
+        async def go():
+            b = ContinuousBatcher(
+                eng, asyncio.Lock(), max_slots=slots, chunk=4,
+                kv_block_size=block_size, draft=draft,
+                spec_gamma=gamma)
+            try:
+                await b.submit(warm, max_new, ())  # compile + warm
+                t0 = time.perf_counter()
+                await asyncio.gather(*[
+                    b.submit(p, max_new, ()) for p in prompts])
+                dt = time.perf_counter() - t0
+                return dt, b.spec_proposed, b.spec_accepted
+            finally:
+                await b.close()
+
+        return asyncio.run(go())
+
+    plain_dt, _, _ = run(None)
+    dt, proposed, accepted = run(eng)
+    n_devices = len(jax.devices())
+    tok_per_sec = requests * max_new / dt / n_devices
+    plain_tok_s = requests * max_new / plain_dt / n_devices
+    accept_rate = accepted / max(1, proposed)
+
+    gen = detect_generation()
+    if verbose:
+        print(f"# decode-spec-paged model={model} slots={slots} "
+              f"gamma={gamma} tok/s={tok_per_sec:.1f} "
+              f"(plain {plain_tok_s:.1f}, "
+              f"x{tok_per_sec / plain_tok_s:.2f}) "
+              f"accept={accept_rate:.3f} "
+              f"({accepted}/{proposed})", file=sys.stderr)
+    return {
+        "metric": ("serving_decode_tokens_per_sec_per_chip"
+                   f"[{model}-spec,{gen}]"),
+        "value": round(tok_per_sec, 2),
+        "unit": "tokens/s/chip",
+        # > 1 == speculation beat plain decode on this workload
+        "vs_baseline": round(tok_per_sec / max(plain_tok_s, 1e-9), 4),
+        "extra_metrics": [
+            {"metric": f"serving_spec_acceptance_rate[{model},{gen}]",
+             "value": round(accept_rate, 4), "unit": "ratio",
+             "vs_baseline": round(accept_rate, 4)},
+        ],
+    }
+
+
+def bench_decode_cont_ttft(model: str, *, slots: int, short_len: int,
+                           long_len: int, budget: int, max_len: int,
+                           block_size: int,
+                           verbose: bool = True) -> dict:
+    """TTFT of a SHORT interactive request that arrives just after a
+    LONG prompt was submitted — the collision chunked prefill exists
+    for. Monolithic admission prefills the long prompt in one gpu
+    call, so the short request's first token waits out the whole
+    thing; with `prefill_chunk_tokens=budget` the long prompt trickles
+    in budget-size slices and the shortest-remaining-first scheduler
+    finishes the short prompt ahead of it. Headline = chunked TTFT;
+    vs_baseline = monolithic/chunked (> 1 == chunking cut TTFT)."""
+    import asyncio
+
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.serving import engine as engine_lib
+    from kubeflow_tpu.serving.continuous import ContinuousBatcher
+
+    cfg = bench_configs()[model]
+    params = jax.jit(lambda k: llama.init(k, cfg))(jax.random.key(0))
+    jax.block_until_ready(params)
+    eng = engine_lib.InferenceEngine(
+        params, cfg, engine_lib.LLAMA_FAMILY,
+        engine_lib.EngineConfig(max_len=max_len),
+    )
+    rng = np.random.default_rng(0)
+
+    def measure(chunk_budget):
+        async def go():
+            b = ContinuousBatcher(
+                eng, asyncio.Lock(), max_slots=slots, chunk=4,
+                kv_block_size=block_size,
+                prefill_chunk_tokens=chunk_budget)
+            try:
+                # compile both prefill shapes + decode before timing
+                await asyncio.gather(
+                    b.submit(rng.integers(
+                        0, cfg.vocab_size, long_len).tolist(), 2, ()),
+                    b.submit(rng.integers(
+                        0, cfg.vocab_size, short_len).tolist(), 2, ()))
+                ttfts = []
+                for _ in range(3):  # fresh prompts: no radix shortcut
+                    long_p = rng.integers(
+                        0, cfg.vocab_size, long_len).tolist()
+                    short_p = rng.integers(
+                        0, cfg.vocab_size, short_len).tolist()
+                    fut_l = asyncio.ensure_future(
+                        b.submit(long_p, 2, ()))
+                    await asyncio.sleep(0)  # long enqueues FIRST
+                    t0 = time.perf_counter()
+                    fut_s, q = b.open_stream(short_p, 2, ())
+                    tok = await q.get()
+                    ttfts.append(time.perf_counter() - t0)
+                    while tok is not None:  # drain the stream
+                        tok = await q.get()
+                    await fut_s
+                    await fut_l
+                return min(ttfts)
+            finally:
+                await b.close()
+
+        return asyncio.run(go())
+
+    mono_s = measure(None)
+    chunk_s = measure(budget)
+    gen = detect_generation()
+    if verbose:
+        print(f"# decode-cont-ttft model={model} long={long_len} "
+              f"short={short_len} budget={budget} "
+              f"ttft chunked={chunk_s * 1e3:.1f}ms "
+              f"monolithic={mono_s * 1e3:.1f}ms "
+              f"(x{mono_s / chunk_s:.2f})", file=sys.stderr)
+    return {
+        "metric": f"serving_interactive_ttft_ms[{model}-cont,{gen}]",
+        "value": round(chunk_s * 1e3, 2),
+        "unit": "ms",
+        "vs_baseline": round(mono_s / max(chunk_s, 1e-9), 4),
+        "extra_metrics": [
+            {"metric": ("serving_interactive_ttft_ms"
+                        f"[{model}-cont-monolithic,{gen}]"),
+             "value": round(mono_s * 1e3, 2), "unit": "ms",
+             "vs_baseline": 1.0},
+        ],
+    }
+
+
 def bench_attribution(model: str, *, slots: int, prompt_len: int,
                       max_new: int, max_len: int,
                       verbose: bool = True) -> dict:
@@ -1013,8 +1178,9 @@ def first_compile_metric() -> dict:
 # mnist/vit/decode-gemma complete the BASELINE.md config matrix
 # (configs #1, #2, #5 — VERDICT r04 weak #4).
 ALL_SECTIONS = ("train500m", "train1b", "decode", "decode-int8",
-                "decode-cont", "decode-paged", "decode-paged-kernel",
-                "decode-gemma", "mnist", "vit", "flash4k")
+                "decode-cont", "decode-paged", "decode-spec-paged",
+                "decode-paged-kernel", "decode-gemma", "mnist", "vit",
+                "flash4k")
 # Per-section wall-clock bound for the orchestrated TPU sweep. Sized
 # from measured section times (train sections ~2-4 min incl. compile,
 # decode ~2 min) with slack for tunnel weather; a section that wedges
@@ -1028,8 +1194,9 @@ _SECTION_TIMEOUT_S = float(
 def _sweep_for(backend: str, wanted: list[str], p) -> list[str]:
     sweep = (list(ALL_SECTIONS) if backend == "tpu"
              else ["train500m", "decode", "decode-int8", "decode-cont",
-                   "decode-paged", "decode-paged-kernel",
-                   "decode-gemma", "mnist", "vit"])
+                   "decode-paged", "decode-spec-paged",
+                   "decode-paged-kernel", "decode-gemma", "mnist",
+                   "vit"])
     if wanted:
         unavailable = [s for s in wanted if s not in sweep]
         if unavailable:
@@ -1193,8 +1360,9 @@ def main() -> int:
     p.add_argument("--only", default="",
                    help="comma-separated subset: train500m,train1b,"
                         "flash4k,decode,decode-int8,decode-cont,"
-                        "decode-paged,decode-paged-kernel (default: "
-                        "full sweep for the backend)")
+                        "decode-paged,decode-spec-paged,"
+                        "decode-paged-kernel (default: full sweep for "
+                        "the backend)")
     p.add_argument("--json-only", action="store_true")
     p.add_argument("--json-out", default="",
                    help="also write the sweep's single JSON artifact "
@@ -1362,6 +1530,25 @@ def _run_sweep(sweep: list[str], backend: str, *, in_child: bool,
             guarded("decode-cont", lambda: bench_decode_continuous(
                 "tiny", slots=2, prompt_len=8, rounds=2, chunk=4,
                 max_len=64, verbose=verbose))
+
+        # TTFT under a long-prompt collision: monolithic admission vs
+        # chunked prefill, same continuous engine — the latency side
+        # of the decode-cont story.
+        def _cont_ttft() -> dict:
+            if on_tpu:
+                m = bench_decode_cont_ttft(
+                    "bench-500m-serve", slots=8, short_len=16,
+                    long_len=384, budget=64, max_len=512,
+                    block_size=64, verbose=verbose)
+            else:
+                m = bench_decode_cont_ttft(
+                    "tiny", slots=4, short_len=6, long_len=48,
+                    budget=8, max_len=64, block_size=8,
+                    verbose=verbose)
+            extras.extend(m.pop("extra_metrics", []))
+            return m
+
+        guarded("decode-cont-ttft", _cont_ttft)
     if "decode-paged" in sweep:
         # Paged KV + radix prefix cache under a repeated-prompt
         # workload. The bench returns its cache-evidence metrics
@@ -1383,6 +1570,26 @@ def _run_sweep(sweep: list[str], backend: str, *, in_child: bool,
             return m
 
         guarded("decode-paged", _paged)
+    if "decode-spec-paged" in sweep:
+        # Speculative decoding on the paged continuous engine, A/B'd
+        # in-function against the same batcher with speculation off.
+        # Self-draft = the gamma-bound upper limit of the win; the
+        # acceptance-rate extra is the knob a real draft scales it by.
+        def _spec_paged() -> dict:
+            if on_tpu:
+                m = bench_decode_spec_paged(
+                    "bench-500m-serve", slots=8, prompt_len=128,
+                    max_new=32, requests=16, max_len=512,
+                    block_size=64, gamma=4, verbose=verbose)
+            else:
+                m = bench_decode_spec_paged(
+                    "tiny", slots=2, prompt_len=8, max_new=8,
+                    requests=6, max_len=64, block_size=8, gamma=3,
+                    verbose=verbose)
+            extras.extend(m.pop("extra_metrics", []))
+            return m
+
+        guarded("decode-spec-paged", _spec_paged)
     if "decode-paged-kernel" in sweep:
         # XLA gather vs fused Pallas kernel over the same block pool
         # (ops-level, no engine). CPU runs the kernel in interpret

@@ -45,7 +45,8 @@ COMPONENTS: dict[str, dict[str, Any]] = {
         "tests": ("python -m pytest tests/test_serving.py "
                   "tests/test_speculative.py tests/test_quant.py "
                   "tests/test_continuous.py tests/test_multilora.py "
-                  "tests/test_paged_kv.py -q"),
+                  "tests/test_paged_kv.py tests/test_chunked_prefill.py "
+                  "tests/test_spec_paged.py -q"),
     },
     "native": {
         "paths": ["native/**", "kubeflow_tpu/data/**"],
@@ -373,6 +374,8 @@ def serving_check_workflow() -> dict:
                                        "kubeflow_tpu/ops/**",
                                        "tests/test_paged_kv.py",
                                        "tests/test_continuous.py",
+                                       "tests/test_chunked_prefill.py",
+                                       "tests/test_spec_paged.py",
                                        "Makefile"]},
             "push": {"branches": ["main"]},
         },
@@ -517,6 +520,7 @@ def kernels_check_workflow() -> dict:
                 "tests/test_flash.py",
                 "tests/test_decode_attention.py",
                 "tests/test_paged_attention_kernel.py",
+                "tests/test_prefill_append_kernel.py",
                 "Makefile"]},
             "push": {"branches": ["main"]},
         },

@@ -62,20 +62,25 @@ from kubeflow_tpu.obs.cardinality import LabelGuard
 from kubeflow_tpu.obs.metrics import sample_quantile
 
 # The serving step anatomy (ContinuousBatcher worker loop).
-SERVING_PHASES = ("admit", "prefill", "decode", "sample", "detokenize",
+# prefill_chunk = chunked-prefill slices interleaved with decode
+# (ISSUE 9); draft/verify = the speculative round's two device legs.
+SERVING_PHASES = ("admit", "prefill", "prefill_chunk", "decode",
+                  "draft", "verify", "sample", "detokenize",
                   "preempt", "resume", "host_gap", "idle")
 # The training step anatomy (Trainer.step): one device phase plus the
 # host gap between consecutive steps (input pipeline, checkpointing).
 TRAIN_PHASES = ("step", "host_gap")
-# Goodput numerator per anatomy: the phase that is useful device work.
-GOODPUT_PHASES = ("decode", "step")
+# Goodput numerator per anatomy: the phase that is useful device work
+# (draft/verify are the speculative round's token-producing legs).
+GOODPUT_PHASES = ("decode", "draft", "verify", "step")
 # Phases excluded from the goodput denominator: an empty batcher
 # parked on its wake event is not a bubble, it has no work.
 IDLE_PHASES = ("idle",)
 
 # Jitted callables the serving compile-watch wraps (closed fn set).
 WATCHED_SERVING_FNS = ("decode_step", "prefill", "insert_many",
-                       "gather_seed", "reset_slots")
+                       "gather_seed", "reset_slots", "prefill_append",
+                       "spec_draft", "spec_verify")
 WATCHED_TRAIN_FNS = ("train_step",)
 
 _MAX_COUNTER_EVENTS = 2048

@@ -10,5 +10,7 @@ from kubeflow_tpu.ops.rotary import apply_rope, rope_frequencies
 from kubeflow_tpu.ops.attention import (
     dot_product_attention,
     paged_attention,
+    paged_prefill_attention,
     resolve_paged_attention_impl,
+    resolve_paged_prefill_impl,
 )

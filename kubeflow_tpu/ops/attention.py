@@ -23,7 +23,8 @@ NEG_INF = -2.0**30  # large-but-finite: avoids NaNs from (-inf) - (-inf)
 # which is how bench.py *proves* the long-seq preset routed through the
 # Pallas flash kernel instead of silently falling back to XLA.
 _impl_counts = {"flash": 0, "xla": 0, "decode": 0, "paged": 0,
-                "paged_xla": 0, "paged_pallas": 0}
+                "paged_xla": 0, "paged_pallas": 0, "paged_prefill": 0,
+                "paged_prefill_xla": 0, "paged_prefill_pallas": 0}
 
 
 def reset_impl_counts() -> None:
@@ -96,6 +97,32 @@ def _paged_kernel_available() -> bool:
         return True
     except ImportError:
         return False
+
+
+def _prefill_append_kernel_available() -> bool:
+    try:
+        from kubeflow_tpu.ops.pallas import prefill_append  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def resolve_paged_prefill_impl(impl: str) -> str:
+    """Resolve a `paged_prefill_attention` impl request to "xla" or
+    "pallas" — same policy as `resolve_paged_attention_impl`: "auto" is
+    the fused Pallas kernel on TPU when it imports, the XLA
+    scatter+gather everywhere else (CPU runs the kernel only in
+    interpret mode, the numerics/test vehicle)."""
+    if impl not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"paged prefill impl must be 'auto', 'xla' or 'pallas', "
+            f"got {impl!r}")
+    if impl == "auto":
+        if (jax.default_backend() == "tpu"
+                and _prefill_append_kernel_available()):
+            return "pallas"
+        return "xla"
+    return impl
 
 
 def resolve_paged_attention_impl(impl: str) -> str:
@@ -305,3 +332,98 @@ def paged_attention(
         q, k, v, q_positions, kv_positions, causal=causal,
         kv_mask=kv_mask, window=window, contiguous_positions=True,
     )
+
+
+def paged_prefill_attention(
+    q: jnp.ndarray,            # [b, s, n_q, hd] — s new tokens per row
+    k_new: jnp.ndarray,        # [b, s, n_kv, hd]
+    v_new: jnp.ndarray,        # [b, s, n_kv, hd]
+    k_pool: jnp.ndarray,       # [num_blocks, block_size, n_kv, hd]
+    v_pool: jnp.ndarray,       # [num_blocks, block_size, n_kv, hd]
+    block_table: jnp.ndarray,  # [b, blocks_per_slot] int32 physical ids
+    q_start: jnp.ndarray,      # [b] int32 — append cursor per row
+    q_lens: jnp.ndarray | None = None,  # [b] int32 — valid new tokens
+    *,
+    kv_mask: jnp.ndarray | None = None,  # [b, blocks_per_slot*block_size]
+    window: int | None = None,
+    impl: str = "xla",
+    interpret: bool | None = None,
+):
+    """Append s new tokens per row into the paged KV pool and attend
+    them against everything written so far. Returns
+    `(out [b, s, n_q, hd], k_pool, v_pool)` — the serving primitive
+    behind chunked prefill (the chunk's tokens) and speculative verify
+    (the γ+1 draft-window tokens).
+
+    Row r's token t lands at logical cell `q_start[r] + t` (physical:
+    through the row's block table) and attends causally by absolute
+    cell index — cell index == logical token position is a
+    precondition, as for `paged_attention`. Tokens with `t >= q_lens[r]`
+    are group padding: their K/V is routed to the trash block and their
+    attention output is garbage the caller discards.
+
+    impl: "auto" | "xla" | "pallas".
+    - "xla" (default): scatter the new cells through the table with
+      `.at[].set`, then gather the full window and run the shared XLA
+      grouped-query attention — correct everywhere, but the new cells
+      round-trip through HBM and the dead tail streams every chunk.
+    - "pallas": the fused kernel (ops/pallas/prefill_append.py) merges
+      the new tokens into each live block in-register, writes the pool
+      in place (input_output_aliases) and attends in the same pass —
+      one read+write of `ceil((q_start+s)/block_size)` blocks per row.
+      Causal-only. `interpret` forces interpret mode (default: on for
+      non-TPU backends) — the CPU test vehicle.
+    - "auto": pallas on TPU when the kernel imports, xla otherwise.
+    """
+    b, s, n_q, hd = q.shape
+    n_kv = k_pool.shape[2]
+    if k_pool.shape != v_pool.shape:
+        raise ValueError(
+            f"k_pool/v_pool shapes disagree: {k_pool.shape} vs "
+            f"{v_pool.shape}")
+    if block_table.ndim != 2 or block_table.shape[0] != b:
+        raise ValueError(
+            f"block_table must be [b={b}, blocks_per_slot], got "
+            f"{block_table.shape}")
+    blocks_per_slot = block_table.shape[1]
+    block_size = k_pool.shape[1]
+    width = blocks_per_slot * block_size
+    if q_lens is None:
+        q_lens = jnp.full((b,), s, jnp.int32)
+    if kv_mask is not None and kv_mask.shape != (b, width):
+        raise ValueError(
+            f"kv_mask shape {kv_mask.shape} does not match "
+            f"blocks_per_slot * block_size = {blocks_per_slot} * "
+            f"{block_size} = {width}")
+    impl = resolve_paged_prefill_impl(impl)
+    _impl_counts["paged_prefill"] += 1
+    _impl_counts["paged_prefill_" + impl] += 1
+    if impl == "pallas":
+        from kubeflow_tpu.ops.pallas.prefill_append import (
+            paged_prefill_append,
+        )
+
+        return paged_prefill_append(
+            q, k_new, v_new, k_pool, v_pool, block_table,
+            q_start, q_lens, kv_mask, window=window,
+            interpret=interpret)
+    # XLA reference: scatter the new cells through the table (invalid
+    # tokens to the trash block — the pool's garbage-write convention),
+    # then gather and attend with the shared fp32 path.
+    pos = (q_start[:, None].astype(jnp.int32)
+           + jnp.arange(s, dtype=jnp.int32)[None, :])
+    valid = jnp.arange(s)[None, :] < q_lens[:, None]
+    safe = jnp.minimum(pos, width - 1)
+    blk = jnp.take_along_axis(block_table, safe // block_size, axis=1)
+    blk = jnp.where(valid, blk, 0)
+    off = safe % block_size
+    k_pool = k_pool.at[blk, off].set(k_new.astype(k_pool.dtype))
+    v_pool = v_pool.at[blk, off].set(v_new.astype(v_pool.dtype))
+    k = k_pool[block_table].reshape(b, width, n_kv, hd)
+    v = v_pool[block_table].reshape(b, width, n_kv, hd)
+    kv_positions = jnp.broadcast_to(
+        jnp.arange(width, dtype=jnp.int32)[None, :], (b, width))
+    out = _xla_attention(
+        q, k, v, pos, kv_positions, causal=True, kv_mask=kv_mask,
+        window=window)
+    return out, k_pool, v_pool

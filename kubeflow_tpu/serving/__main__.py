@@ -94,6 +94,28 @@ def main(argv=None) -> int:
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--batch-window-ms", type=float, default=0.0)
     p.add_argument("--prefill-chunk", type=int, default=0)
+    p.add_argument("--prefill-chunk-tokens", type=int, default=0,
+                   help="chunked prefill token budget (continuous "
+                        "only): admission prefill feeds at most this "
+                        "many prompt tokens per worker iteration, "
+                        "interleaved with decode chunks — bounds the "
+                        "decode stall a long prompt imposes. 0 = "
+                        "monolithic admission prefill")
+    p.add_argument("--spec-decode", action="store_true",
+                   help="speculative decoding on the paged KV cache "
+                        "(continuous only): every request drafts "
+                        "--spec-gamma tokens with --draft-model and "
+                        "verifies them in one fused batched pass — "
+                        "token-identical to plain decode")
+    p.add_argument("--draft-model", default="",
+                   choices=("",) + MODEL_NAMES,
+                   help="draft model for --spec-decode (must share "
+                        "the target's vocab)")
+    p.add_argument("--draft-checkpoint", default="",
+                   help="train.Checkpointer directory for the draft "
+                        "params (default: random init — smoke/dev)")
+    p.add_argument("--spec-gamma", type=int, default=4,
+                   help="draft tokens proposed per speculative round")
     p.add_argument("--pipeline-depth", type=int, default=0,
                    help="decode dispatch-ahead depth (0 = backend-"
                         "aware default: 2 on TPU, 1 elsewhere)")
@@ -138,6 +160,14 @@ def main(argv=None) -> int:
         p.error("--warmup requires --continuous")
     if args.paged_attention_impl != "auto" and not args.continuous:
         p.error("--paged-attention-impl requires --continuous")
+    if args.prefill_chunk_tokens and not args.continuous:
+        p.error("--prefill-chunk-tokens requires --continuous")
+    if args.spec_decode and not args.continuous:
+        p.error("--spec-decode requires --continuous")
+    if args.spec_decode and not args.draft_model:
+        p.error("--spec-decode requires --draft-model")
+    if args.draft_model and not args.spec_decode:
+        p.error("--draft-model requires --spec-decode")
     if args.tenants and not args.continuous:
         # the QoS scheduler replaces the CONTINUOUS batcher's queue;
         # silently ignoring the file would serve without the quotas
@@ -195,16 +225,38 @@ def main(argv=None) -> int:
         from kubeflow_tpu.tenancy import load_config
 
         tenancy = load_config(args.tenants)
+    name = args.name or args.model
+    drafts = None
+    if args.spec_decode:
+        dcfg, dinit, dfamily = model_registry()[args.draft_model]
+        if args.draft_checkpoint:
+            dargs = argparse.Namespace(
+                random=False, seed=args.seed,
+                checkpoint=args.draft_checkpoint)
+            dparams = _load_params(dargs, lambda k: dinit(k, dcfg))
+        else:
+            # random draft: proposals are junk (low acceptance) but the
+            # plumbing — and token parity — is exactly production's
+            dparams = dinit(jax.random.key(args.seed + 1), dcfg)
+        # draft must cover the target's sequence space: verify appends
+        # through the SAME cursor positions
+        drafts = {name: InferenceEngine(
+            dparams, dcfg, dfamily,
+            EngineConfig(max_len=args.max_len, eos_token=args.eos))}
     app = create_serving_app(
-        {args.name or args.model: engine},
+        {name: engine},
         tokenizer=tokenizer,
         batch_window_ms=args.batch_window_ms,
         max_batch=args.max_batch,
         continuous=args.continuous,
         warmup=args.warmup,
         prefill_chunk=args.prefill_chunk or None,
+        prefill_chunk_tokens=args.prefill_chunk_tokens or None,
         pipeline_depth=args.pipeline_depth or None,
         paged_attention_impl=args.paged_attention_impl,
+        drafts=drafts,
+        spec_decode=args.spec_decode,
+        spec_gamma=args.spec_gamma,
         drain_grace_s=args.drain_grace_s,
         tenancy=tenancy,
     )

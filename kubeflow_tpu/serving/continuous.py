@@ -59,7 +59,9 @@ import numpy as np
 from kubeflow_tpu.ops.attention import (
     dot_product_attention,
     paged_attention,
+    paged_prefill_attention,
     resolve_paged_attention_impl,
+    resolve_paged_prefill_impl,
 )
 from kubeflow_tpu.ops.norms import rms_norm
 from kubeflow_tpu.ops.rotary import rope_frequencies
@@ -73,6 +75,7 @@ from kubeflow_tpu.obs.profiling import CompileWatch, PhaseProfiler
 from kubeflow_tpu.obs.timeline import RequestTimeline, TimelineStore
 from kubeflow_tpu.serving import migration
 from kubeflow_tpu.serving.paged import BlockPool, RadixPrefixCache
+from kubeflow_tpu.serving.speculative import _dist, _draw
 from kubeflow_tpu.tenancy.ledger import TenantLedger
 from kubeflow_tpu.tenancy.scheduler import FairShareQueue, ReqMeta
 
@@ -103,7 +106,7 @@ class SlotState:
     """
 
     def __init__(self, k, v, length, offset, pad, tok, aid=None,
-                 block_table=None):
+                 block_table=None, frozen=None):
         self.k = k            # [L, num_blocks, block_size, n_kv, hd]
         self.v = v            # (paged pool; block 0 is the trash block)
         self.length = length  # [S] int32 — filled cache cells per row
@@ -118,10 +121,17 @@ class SlotState:
         # the paged indirection that lets slots share prefix blocks and
         # frees HBM accounting from the dense S * max_len worst case.
         self.block_table = block_table
+        # [S] bool — mid-chunked-prefill rows. A frozen row rides along
+        # in decode/speculative dispatches but is fully masked there:
+        # its KV writes are routed to the trash block and its cursors
+        # (length, tok) never move — only `append_rows` advances it.
+        if frozen is None:
+            frozen = jnp.zeros(length.shape, bool)
+        self.frozen = frozen
 
     def tree_flatten(self):
         return (self.k, self.v, self.length, self.offset, self.pad,
-                self.tok, self.aid, self.block_table), None
+                self.tok, self.aid, self.block_table, self.frozen), None
 
     @classmethod
     def tree_unflatten(cls, _, children):
@@ -130,6 +140,37 @@ class SlotState:
 
 jax.tree_util.register_pytree_node(
     SlotState, SlotState.tree_flatten, SlotState.tree_unflatten
+)
+
+
+class DraftSlots:
+    """Per-slot DRAFT-model KV cache for continuous speculative
+    decoding, a pytree (jit-carryable).
+
+    The draft cache stays DENSE ([L, S, draft_max_len, n_kv, hd]) where
+    the target cache is paged: the draft model is small by design, so
+    its cache is a rounding error next to the target pool, and paging
+    it would add a second block table to every rollback. Rows are
+    compacted like the target's (cell index == logical position, offset
+    0), and `length` tracks the TARGET row's cursor exactly — after
+    every speculative round both caches agree on how many tokens are
+    committed, which is the whole rollback contract."""
+
+    def __init__(self, k, v, length):
+        self.k = k            # [L, S, W_draft, n_kv_d, hd_d]
+        self.v = v
+        self.length = length  # [S] int32 — committed cells per row
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.length), None
+
+    @classmethod
+    def tree_unflatten(cls, _, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    DraftSlots, DraftSlots.tree_flatten, DraftSlots.tree_unflatten
 )
 
 
@@ -148,9 +189,29 @@ class ContinuousEngine:
                  prefill_chunk: int | None = None,
                  block_size: int = 64, num_blocks: int | None = None,
                  paged_attention_impl: str = "auto",
-                 pool: BlockPool | None = None):
+                 pool: BlockPool | None = None,
+                 draft: InferenceEngine | None = None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if draft is not None:
+            # continuous speculative decoding (ISSUE 9): the accept
+            # rule compares draft and target distributions tokenwise,
+            # and the draft cache row mirrors the target row cursor
+            if draft.cfg.vocab_size != engine.cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft.cfg.vocab_size} != target "
+                    f"vocab {engine.cfg.vocab_size}")
+            if draft.ec.max_len < engine.ec.max_len:
+                raise ValueError(
+                    f"draft max_len {draft.ec.max_len} < target "
+                    f"max_len {engine.ec.max_len}: the draft cache row "
+                    "must cover every target cursor position")
+            if engine.adapter_pack is not None:
+                raise ValueError(
+                    "speculative decoding does not compose with a "
+                    "multi-LoRA adapter pack (the verify pass would "
+                    "score base-model logits against adapter rows)")
+        self.draft = draft
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, got "
                              f"{prefill_chunk}")
@@ -163,6 +224,11 @@ class ContinuousEngine:
         # value. "auto" = pallas on TPU, xla elsewhere.
         self.paged_attention_impl = paged_attention_impl
         self.attention_impl = resolve_paged_attention_impl(
+            paged_attention_impl)
+        # chunked-prefill / draft-verify writes go through the fused
+        # prefill/append op — same knob, separately resolved (the
+        # prefill kernel has its own availability probe)
+        self.prefill_impl = resolve_paged_prefill_impl(
             paged_attention_impl)
         self.engine = engine
         self.S = max_slots
@@ -230,6 +296,24 @@ class ContinuousEngine:
         self._export_jit = jax.jit(self._export_blocks)
         self._import_jit = jax.jit(self._import_blocks,
                                    donate_argnums=(0,))
+        # chunked prefill (ISSUE 9): adopt points a frozen slot at its
+        # planned blocks, copy_cells seeds a partial CoW block, and
+        # append_rows feeds budget-size prompt slices through the fused
+        # prefill/append path between decode chunks
+        self._append_jit = jax.jit(self._append_rows,
+                                   donate_argnums=(2,))
+        self._adopt_jit = jax.jit(self._adopt, donate_argnums=(0,))
+        self._copy_cells_jit = jax.jit(self._copy_cells,
+                                       donate_argnums=(0,))
+        if draft is not None:
+            self._spec_draft_jit = jax.jit(
+                self._spec_draft, donate_argnums=(1,),
+                static_argnames=("gamma",))
+            self._spec_verify_jit = jax.jit(
+                self._spec_verify, donate_argnums=(2, 3),
+                static_argnames=("gamma",))
+            self._dinsert_jit = jax.jit(self._draft_insert,
+                                        donate_argnums=(0,))
 
     # -- state ------------------------------------------------------------
 
@@ -390,7 +474,9 @@ class ContinuousEngine:
         tok = st.tok.at[slot].set(first[row])
         aid_v = st.aid.at[slot].set(aid)
         bt = st.block_table.at[slot].set(table)
-        return SlotState(k, v, length, offset, pad, tok, aid_v, bt)
+        frozen = st.frozen.at[slot].set(False)
+        return SlotState(k, v, length, offset, pad, tok, aid_v, bt,
+                         frozen)
 
     def _auto_table(self, slot: int) -> np.ndarray:
         """Canonical block table for engine-managed allocation (direct
@@ -493,8 +579,9 @@ class ContinuousEngine:
         length = st.length.at[slots].set(0)
         offset = st.offset.at[slots].set(0)
         pad = st.pad.at[slots].set(False)
+        frozen = st.frozen.at[slots].set(False)
         return SlotState(st.k, st.v, length, offset, pad, st.tok,
-                         st.aid, bt)
+                         st.aid, bt, frozen)
 
     def reset_slots(self, st: SlotState, slots: list[int]) -> SlotState:
         """Host entry: pads the slot list to a power of two by
@@ -523,7 +610,7 @@ class ContinuousEngine:
         kp = st.k.at[:, ids].set(k.astype(st.k.dtype))
         vp = st.v.at[:, ids].set(v.astype(st.v.dtype))
         return SlotState(kp, vp, st.length, st.offset, st.pad, st.tok,
-                         st.aid, st.block_table)
+                         st.aid, st.block_table, st.frozen)
 
     def import_blocks(self, st: SlotState, block_ids, k, v) -> SlotState:
         """Scatter migrated block payloads into locally-allocated
@@ -617,9 +704,13 @@ class ContinuousEngine:
         kv_valid = ~st.pad
         write_at = jnp.minimum(st.length, ec.max_len - 1)
         # paged write coordinates: logical cell -> (physical block,
-        # offset) through each row's block table
+        # offset) through each row's block table. Frozen rows (mid
+        # chunked prefill) write to the trash block instead — a decode
+        # step must never touch cells `append_rows` will fill.
         rows = jnp.arange(S)
-        write_blk = st.block_table[rows, write_at // self.block_size]
+        write_blk = jnp.where(
+            st.frozen, 0,
+            st.block_table[rows, write_at // self.block_size])
         write_off = write_at % self.block_size
 
         x = eng._embed(params, st.tok[:, None])
@@ -678,11 +769,16 @@ class ContinuousEngine:
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
         logits = eng._head(params, x[:, -1])
         nxt, lp = eng._sample(logits, sub, sp)
+        # frozen rows keep their cursors: length marks the prefilled
+        # frontier and tok the NEXT prompt token — a decode step's
+        # garbage sample must not clobber either
         st = SlotState(
             k_new, v_new,
-            jnp.minimum(st.length + 1, ec.max_len),
-            st.offset, st.pad, nxt.astype(jnp.int32), st.aid,
-            st.block_table)
+            jnp.where(st.frozen, st.length,
+                      jnp.minimum(st.length + 1, ec.max_len)),
+            st.offset, st.pad,
+            jnp.where(st.frozen, st.tok, nxt.astype(jnp.int32)),
+            st.aid, st.block_table, st.frozen)
         return st, nxt, lp, rng
 
     def _step(self, params, adapters, st: SlotState, sp: SamplingParams,
@@ -715,6 +811,378 @@ class ContinuousEngine:
                               None if pack is None else pack.blocks,
                               st, sp, rng, steps=steps)
 
+    # -- chunked prefill (fused paged append) -----------------------------
+
+    def _paged_forward(self, params, adapters, st: SlotState, slots,
+                       tokens, n_valid, start):
+        """Forward `[g, s]` tokens for slot rows `slots` THROUGH the
+        paged pool: each layer's K/V projections are written into the
+        rows' block tables at cells [start, start + n_valid) and
+        attended in the same fused op (ops.paged_prefill_attention).
+        Shared by chunked prefill (`_append_rows`) and the speculative
+        verify pass (`_spec_verify`) so the two paths cannot drift.
+        Returns (final-norm hidden states [g, s, D], k_pool, v_pool).
+
+        Write disjointness holds by construction: a row only ever
+        writes cells at/above its own cursor, which land in its
+        exclusively-owned fresh blocks — radix-shared blocks all sit
+        strictly below the cursor (see the kernel's docstring)."""
+        eng = self.engine
+        cfg, fam = eng.cfg, eng.family
+        table = st.block_table[slots]
+        aid = st.aid[slots]
+        s = tokens.shape[1]
+        positions = (start[:, None]
+                     + jnp.arange(s, dtype=jnp.int32)[None, :])
+        rope_positions = jnp.maximum(
+            positions - st.offset[slots][:, None], 0)
+        inv_freq = rope_frequencies(cfg.head_dim, theta=cfg.rope_theta)
+        kv_valid = ~st.pad[slots]
+        x = eng._embed(params, tokens)
+
+        def layer(carry, scanned):
+            x, k_all, v_all = carry
+            if adapters is None:
+                p, li = scanned
+                proj = None
+            else:
+                from kubeflow_tpu.serving.multilora import lora_proj
+                p, ab, li = scanned
+                proj = lora_proj(ab, aid, eng.adapter_pack.scaling, cfg)
+            cell = {}
+
+            def write_kv(k, v):
+                # defer the write: the fused op scatters K/V through
+                # the block table and attends in one pass
+                cell["new"] = (k, v)
+                return (jax.lax.dynamic_index_in_dim(
+                            k_all, li, 0, keepdims=False),
+                        jax.lax.dynamic_index_in_dim(
+                            v_all, li, 0, keepdims=False))
+
+            def attn(q, kp, vp):
+                kn, vn = cell["new"]
+                out, kp2, vp2 = paged_prefill_attention(
+                    q, kn, vn, kp, vp, table, start, n_valid,
+                    kv_mask=kv_valid,
+                    window=getattr(cfg, "sliding_window", None),
+                    impl=self.prefill_impl)
+                cell["k"] = jax.lax.dynamic_update_index_in_dim(
+                    k_all, kp2, li, 0)
+                cell["v"] = jax.lax.dynamic_update_index_in_dim(
+                    v_all, vp2, li, 0)
+                return out
+
+            x, _ = transformer_block(
+                cfg, fam, p, x, rope_positions, inv_freq, write_kv,
+                attn, proj)
+            return (x, cell["k"], cell["v"]), None
+
+        layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+        xs = ((params["blocks"], layer_ids) if adapters is None
+              else (params["blocks"], adapters, layer_ids))
+        (x, k_new, v_new), _ = jax.lax.scan(
+            layer, (x, st.k, st.v), xs)
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        return x, k_new, v_new
+
+    def _append_rows(self, params, adapters, st: SlotState, slots,
+                     tokens, n_valid, finish, sp, rng):
+        """One chunked-prefill slice: feed `tokens[i, :n_valid[i]]` of
+        each listed slot's remaining prompt through the paged pool,
+        advancing the row cursor by n_valid. Rows with `finish` sample
+        their first output token and unfreeze; others stay frozen (the
+        decode step keeps masking them). Padding rows (a repeated slot
+        with n_valid 0) are no-ops: `.add(0)` moves nothing and their
+        sampled token is discarded by `finish=False`."""
+        eng, ec = self.engine, self.engine.ec
+        rng, sub = jax.random.split(rng)
+        start = st.length[slots]
+        x, k_new, v_new = self._paged_forward(
+            params, adapters, st, slots, tokens, n_valid, start)
+        last = jnp.maximum(n_valid - 1, 0)
+        x_last = jnp.take_along_axis(
+            x, last[:, None, None], axis=1)[:, 0]
+        logits = eng._head(params, x_last)
+        sp_rows = SamplingParams(temperature=sp.temperature[slots],
+                                 top_k=sp.top_k[slots],
+                                 top_p=sp.top_p[slots])
+        nxt, lp = eng._sample(logits, sub, sp_rows)
+        length = jnp.minimum(st.length.at[slots].add(n_valid),
+                             ec.max_len)
+        newtok = jnp.where(finish, nxt.astype(jnp.int32),
+                           st.tok[slots])
+        tok = st.tok.at[slots].set(newtok)
+        frozen = st.frozen.at[slots].set(
+            jnp.where(finish, False, st.frozen[slots]))
+        st = SlotState(k_new, v_new, length, st.offset, st.pad, tok,
+                       st.aid, st.block_table, frozen)
+        return st, nxt, lp, rng
+
+    def append_rows(self, st: SlotState, slots, tokens, n_valid,
+                    finish, sp: SamplingParams, rng):
+        """Host entry for one chunked-prefill slice. -> (state,
+        first_token [g], logprob [g], rng); first_token/logprob are
+        only meaningful for rows with finish=True."""
+        pack = self.engine.adapter_pack
+        return self._append_jit(
+            self.engine.params,
+            None if pack is None else pack.blocks,
+            st, jnp.asarray(slots, jnp.int32),
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(n_valid, jnp.int32),
+            jnp.asarray(finish, bool), sp, rng)
+
+    def _adopt(self, st: SlotState, slot, table, seed_len, tok, aid):
+        """Point `slot` at its planned block `table` with `seed_len`
+        cells already seeded from the radix cache, FROZEN for chunked
+        prefill: decode steps mask the row until `append_rows` has fed
+        the whole suffix. `tok` is the next prompt token (kept for the
+        cursor invariant; append feeds tokens explicitly)."""
+        return SlotState(
+            st.k, st.v,
+            st.length.at[slot].set(seed_len),
+            st.offset.at[slot].set(0),
+            st.pad.at[slot].set(False),
+            st.tok.at[slot].set(tok),
+            st.aid.at[slot].set(aid),
+            st.block_table.at[slot].set(table),
+            st.frozen.at[slot].set(True))
+
+    def adopt_slot(self, st: SlotState, slot: int, table, seed_len: int,
+                   tok: int, aid: int = 0) -> SlotState:
+        return self._adopt_jit(
+            st, jnp.asarray(slot, jnp.int32),
+            jnp.asarray(table, jnp.int32),
+            jnp.asarray(seed_len, jnp.int32),
+            jnp.asarray(tok, jnp.int32), jnp.asarray(aid, jnp.int32))
+
+    def _copy_cells(self, st: SlotState, src, dst, n):
+        """Copy cells [0, n) of pool block `src` into block `dst` —
+        the copy half of copy-on-write for a partially-matched radix
+        block: the new request seeds its own fresh block from the
+        shared one and diverges there."""
+        i = jnp.arange(self.block_size)
+        sel = (i < n)[None, :, None, None]
+        kd = jnp.where(sel, st.k[:, src], st.k[:, dst])
+        vd = jnp.where(sel, st.v[:, src], st.v[:, dst])
+        return SlotState(
+            st.k.at[:, dst].set(kd), st.v.at[:, dst].set(vd),
+            st.length, st.offset, st.pad, st.tok, st.aid,
+            st.block_table, st.frozen)
+
+    def copy_cells(self, st: SlotState, src: int, dst: int,
+                   n: int) -> SlotState:
+        return self._copy_cells_jit(
+            st, jnp.asarray(src, jnp.int32), jnp.asarray(dst, jnp.int32),
+            jnp.asarray(n, jnp.int32))
+
+    # -- speculative decoding on paged KV ---------------------------------
+
+    def init_draft_slots(self) -> DraftSlots:
+        cfg = self.draft.cfg
+        shape = (cfg.num_layers, self.S, self.draft.ec.max_len,
+                 cfg.num_kv_heads, cfg.head_dim)
+        return DraftSlots(
+            jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype),
+            jnp.zeros((self.S,), jnp.int32))
+
+    def _draft_decode_one(self, dparams, dst: DraftSlots, feed):
+        """One draft-model token for ALL slots against the dense
+        per-slot draft cache (the draft-side mirror of `_decode_one`).
+        Cell index == position, so causal masking alone hides stale
+        tail cells; every cell is written before it is first attended."""
+        deng = self.draft
+        cfg, fam = deng.cfg, deng.family
+        W = deng.ec.max_len
+        S = self.S
+        positions = dst.length[:, None]
+        inv_freq = rope_frequencies(cfg.head_dim, theta=cfg.rope_theta)
+        kv_positions = jnp.broadcast_to(
+            jnp.arange(W, dtype=jnp.int32)[None, :], (S, W))
+        write_at = jnp.minimum(dst.length, W - 1)
+        rows = jnp.arange(S)
+        x = deng._embed(dparams, feed[:, None])
+
+        def layer(carry, scanned):
+            x, k_all, v_all = carry
+            p, li = scanned
+            cell = {}
+
+            def write_kv(k, v):
+                k2 = k_all.at[li, rows, write_at].set(
+                    k[:, 0].astype(k_all.dtype))
+                v2 = v_all.at[li, rows, write_at].set(
+                    v[:, 0].astype(v_all.dtype))
+                cell["k"], cell["v"] = k2, v2
+                return (jax.lax.dynamic_index_in_dim(
+                            k2, li, 0, keepdims=False),
+                        jax.lax.dynamic_index_in_dim(
+                            v2, li, 0, keepdims=False))
+
+            def attn(q, kp, vp):
+                return dot_product_attention(
+                    q, kp, vp, positions, kv_positions, causal=True,
+                    window=getattr(cfg, "sliding_window", None),
+                    contiguous_positions=True)
+
+            x, _ = transformer_block(
+                cfg, fam, p, x, positions, inv_freq, write_kv, attn,
+                None)
+            return (x, cell["k"], cell["v"]), None
+
+        layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+        (x, k_new, v_new), _ = jax.lax.scan(
+            layer, (x, dst.k, dst.v), (dparams["blocks"], layer_ids))
+        x = rms_norm(x, dparams["final_norm"], cfg.norm_eps)
+        logits = deng._head(dparams, x[:, -1])
+        dst = DraftSlots(k_new, v_new,
+                         jnp.minimum(dst.length + 1, W))
+        return dst, logits
+
+    def _draft_insert(self, dst: DraftSlots, slot, pstate, npad):
+        """Compact row 0 of a batch-1 draft prefill `DecodeState` into
+        draft-cache row `slot` (bucket left-pads stripped, mirroring
+        `_insert`'s canonical form)."""
+        W = self.draft.ec.max_len
+        j = jnp.arange(W, dtype=jnp.int32)
+        src = jnp.minimum(j + npad, W - 1)
+        ck = jnp.take(pstate.k[:, 0], src, axis=1)
+        cv = jnp.take(pstate.v[:, 0], src, axis=1)
+        return DraftSlots(
+            dst.k.at[:, slot].set(ck.astype(dst.k.dtype)),
+            dst.v.at[:, slot].set(cv.astype(dst.v.dtype)),
+            dst.length.at[slot].set(
+                (pstate.length - npad).astype(jnp.int32)))
+
+    def draft_prefill(self, dst: DraftSlots, slot: int,
+                      tokens: list[int], rng):
+        """Seed draft-cache row `slot` with `tokens`' KV (one draft
+        prefill dispatch + one compacting scatter). -> (dst, rng)."""
+        deng = self.draft
+        b = max(bucket_pow2(len(tokens), deng.ec.max_len), len(tokens))
+        arr = np.zeros((1, b), np.int32)
+        mask = np.zeros((1, b), bool)
+        arr[0, b - len(tokens):] = tokens
+        mask[0, b - len(tokens):] = True
+        sp, rng = deng._resolve_sampling(
+            np.zeros(1, np.float32), np.zeros(1, np.int64),
+            np.ones(1, np.float32), rng, batch=1)
+        out = deng._prefill_jit(
+            deng.params, jnp.asarray(arr), deng.init_state(1), rng, sp,
+            jnp.asarray(mask), adapters=None, adapter_ids=None)
+        dst = self._dinsert_jit(dst, jnp.asarray(slot, jnp.int32),
+                                out[0],
+                                jnp.asarray(b - len(tokens), jnp.int32))
+        return dst, rng
+
+    def _spec_draft(self, dparams, dst: DraftSlots, tok, sp, rng, *,
+                    gamma):
+        """Draft `gamma` tokens per slot autoregressively. Returns
+        (dst, drafted [S, gamma], q-dists [S, gamma, V], rng) — the
+        full draft distributions ride along for the residual resample
+        in `_spec_verify`."""
+        rng, sub = jax.random.split(rng)
+
+        def body(carry, r):
+            dstate, feed = carry
+            dstate, logits = self._draft_decode_one(dparams, dstate,
+                                                    feed)
+            q = _dist(logits, sp)
+            d = _draw(r, q)
+            return (dstate, d), (d, q)
+
+        (dst, _), (dts, qts) = jax.lax.scan(
+            body, (dst, tok), jax.random.split(sub, gamma))
+        return (dst, jnp.moveaxis(dts, 0, 1),
+                jnp.moveaxis(qts, 0, 1), rng)
+
+    def spec_draft(self, st: SlotState, dst: DraftSlots,
+                   sp: SamplingParams, rng, gamma: int):
+        return self._spec_draft_jit(self.draft.params, dst, st.tok,
+                                    sp, rng, gamma=gamma)
+
+    def _spec_verify(self, params, dparams, st: SlotState,
+                     dst: DraftSlots, drafted, qs, sp, rng, *, gamma):
+        """Target-verify the drafted window through the paged pool and
+        roll both caches back to the accepted frontier.
+
+        The accept/bonus/residual math is the one-shot
+        `SpeculativeEngine._speculate` rule vectorized over slots
+        (Leviathan et al.): accept drafted[j] while u*q < p; on full
+        acceptance draw the bonus token from the target's gamma-th
+        distribution, otherwise resample from the clipped residual
+        p - q (all-zero rows fall back to p). Rejected tokens' KV cells
+        sit strictly above the rolled-back cursors and are rewritten
+        before they can ever be attended — rollback is cursor motion,
+        not data motion, which is what makes it CoW-safe: shared radix
+        blocks all live below the cursor and are never touched.
+
+        Frozen (mid-chunked-prefill) rows ride along fully masked:
+        their cursors and tokens never move, and their verify writes
+        land above their prefill frontier where `append_rows` rewrites
+        them before first attend."""
+        eng = self.engine
+        ec = eng.ec
+        S = self.S
+        rng, r_us, r_x = jax.random.split(rng, 3)
+        tin = jnp.concatenate([st.tok[:, None], drafted], axis=1)
+        slots = jnp.arange(S, dtype=jnp.int32)
+        n_valid = jnp.full((S,), gamma + 1, jnp.int32)
+        x, k_pool, v_pool = self._paged_forward(
+            params, None, st, slots, tin, n_valid, st.length)
+        all_logits = eng._head(params, x)          # [S, gamma+1, V]
+        ps = jax.vmap(lambda lg: _dist(lg, sp),
+                      in_axes=1, out_axes=1)(all_logits)
+        us = jax.random.uniform(r_us, (S, gamma))
+        p_d = jnp.take_along_axis(
+            ps[:, :gamma], drafted[..., None], axis=2)[..., 0]
+        q_d = jnp.take_along_axis(qs, drafted[..., None], axis=2)[..., 0]
+        accept = us * q_d < p_d
+        k = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                    axis=1)                        # [S] accepted count
+        pk = jnp.take_along_axis(ps, k[:, None, None], axis=1)[:, 0]
+        qk = jnp.take_along_axis(
+            qs, jnp.minimum(k, gamma - 1)[:, None, None], axis=1)[:, 0]
+        resid = jnp.clip(pk - qk, 0.0, None)
+        resid = jnp.where(
+            jnp.sum(resid, axis=1, keepdims=True) > 0.0, resid, pk)
+        dist = jnp.where((k == gamma)[:, None], ps[:, gamma], resid)
+        extra = _draw(r_x, dist)                   # [S]
+        rows = jnp.arange(S)
+        emit = jnp.concatenate(
+            [drafted, jnp.zeros((S, 1), jnp.int32)], axis=1)
+        emit = emit.at[rows, k].set(extra)
+        lsm = jax.nn.log_softmax(all_logits, axis=-1)
+        lps = jnp.take_along_axis(lsm, emit[..., None], axis=2)[..., 0]
+        length = jnp.where(
+            st.frozen, st.length,
+            jnp.minimum(st.length + k + 1, ec.max_len))
+        tok = jnp.where(st.frozen, st.tok, extra.astype(jnp.int32))
+        st = SlotState(k_pool, v_pool, length, st.offset, st.pad, tok,
+                       st.aid, st.block_table, st.frozen)
+        # draft rollback: the scan advanced every row by gamma; keep
+        # the k+1 cells the accepted tokens fed (capped at gamma),
+        # then feed the last drafted token unconditionally — its write
+        # only COMMITS (advances length) on full acceptance, otherwise
+        # it lands above the kept cursor and is rewritten next round
+        dlen = dst.length - gamma + jnp.minimum(k + 1, gamma)
+        dst = DraftSlots(dst.k, dst.v, dlen)
+        dfed, _ = self._draft_decode_one(dparams, dst,
+                                         drafted[:, gamma - 1])
+        dst = DraftSlots(dfed.k, dfed.v,
+                         jnp.where(k == gamma, dfed.length, dlen))
+        return st, dst, emit, lps, k, rng
+
+    def spec_verify(self, st: SlotState, dst: DraftSlots, drafted, qs,
+                    sp: SamplingParams, rng, gamma: int):
+        """-> (state, draft state, emitted [S, gamma+1], logprobs
+        [S, gamma+1], accepted counts [S], rng). Row i's valid emitted
+        tokens are emit[i, :k[i] + 1]."""
+        return self._spec_verify_jit(
+            self.engine.params, self.draft.params, st, dst, drafted,
+            qs, sp, rng, gamma=gamma)
+
 
 class Overloaded(RuntimeError):
     """Admission queue is full — callers should shed load (HTTP 429)."""
@@ -738,7 +1206,8 @@ class _Slot:
 
     __slots__ = ("fut", "out", "lps", "max_new", "queue", "stop",
                  "kv_toks", "owned", "node_refs", "freed",
-                 "meta", "sampling", "aid", "block_charge")
+                 "meta", "sampling", "aid", "block_charge",
+                 "prefilling")
 
     def __init__(self, fut, max_new: int, queue, stop=()):
         self.fut = fut
@@ -764,6 +1233,11 @@ class _Slot:
         self.owned: dict[int, int] = {}
         self.node_refs: list = []
         self.freed = False  # block bookkeeping already released
+        # chunked prefill: {"suffix": [...], "fed": n} while the prompt
+        # is still being fed in budget slices; None once decodable.
+        # Mid-prefill the slot's device row is FROZEN and the record is
+        # excluded from decode snapshots, preemption, and KV export.
+        self.prefilling: dict | None = None
 
 
 class ContinuousBatcher:
@@ -781,6 +1255,7 @@ class ContinuousBatcher:
     def __init__(self, engine: InferenceEngine, gpu_lock: asyncio.Lock,
                  *, max_slots: int = 8, chunk: int = 4,
                  prefill_chunk: int | None = None,
+                 prefill_chunk_tokens: int | None = None,
                  prefixes: dict[str, list[int]] | None = None,
                  max_pending: int = 256,
                  pipeline_depth: int | None = None,
@@ -788,12 +1263,35 @@ class ContinuousBatcher:
                  kv_block_size: int = 64,
                  kv_pool_blocks: int | None = None,
                  paged_attention_impl: str = "auto",
+                 draft: InferenceEngine | None = None,
+                 spec_gamma: int = 4,
                  tenancy=None, clock=None):
         # window_ms accepted (and ignored) for constructor parity with
         # Batcher: admission is per-token here, there is no window.
         del window_ms
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        # Chunked prefill (ISSUE 9): instead of prefilling a whole
+        # prompt in one dispatch while every active decode stalls, the
+        # worker feeds at most `prefill_chunk_tokens` prompt tokens per
+        # loop iteration through the fused paged append path,
+        # interleaved with decode chunks — the per-step token budget
+        # that keeps the decode batch dense. None keeps the monolithic
+        # admission prefill. (Distinct from `prefill_chunk`, which only
+        # slices the MONOLITHIC prefill's compile shapes.)
+        if prefill_chunk_tokens is not None and prefill_chunk_tokens < 1:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 1, got "
+                f"{prefill_chunk_tokens}")
+        self.prefill_chunk_tokens = prefill_chunk_tokens
+        # Speculative decoding on paged KV (ISSUE 9): with a draft
+        # engine, every decode iteration becomes a draft(gamma) +
+        # verify(gamma+1) round batched across slots; accepted tokens
+        # append through the block tables, rejections roll the cursors
+        # back. Replaces chunk-scan decode (spec rounds are the chunk).
+        if spec_gamma < 1:
+            raise ValueError(f"spec_gamma must be >= 1, got {spec_gamma}")
+        self.spec_gamma = spec_gamma
         # Dispatch-ahead depth: with depth 2 the worker queues the next
         # decode chunk while the previous one is still computing, so
         # host-side emit/retirement work overlaps device time instead
@@ -825,7 +1323,7 @@ class ContinuousBatcher:
         self.cengine = ContinuousEngine(
             engine, max_slots, prefill_chunk=prefill_chunk,
             block_size=kv_block_size, num_blocks=kv_pool_blocks,
-            paged_attention_impl=paged_attention_impl)
+            paged_attention_impl=paged_attention_impl, draft=draft)
         # Automatic radix prefix cache over the block pool: every
         # admitted prompt's full blocks are indexed by token prefix
         # (at admission, so even in-flight prefills are sharable), and
@@ -889,6 +1387,13 @@ class ContinuousBatcher:
             ce._reset_jit, "reset_slots")
         engine._prefill_jit = self.compile_watch.watch(
             engine._prefill_jit, "prefill")
+        ce._append_jit = self.compile_watch.watch(
+            ce._append_jit, "prefill_append")
+        if ce.draft is not None:
+            ce._spec_draft_jit = self.compile_watch.watch(
+                ce._spec_draft_jit, "spec_draft")
+            ce._spec_verify_jit = self.compile_watch.watch(
+                ce._spec_verify_jit, "spec_verify")
         # Shared prefixes (system prompts): token lists registered at
         # construction; each computes its KV ONCE, lazily, on first use
         # (device work belongs under the gpu lock, not in __init__).
@@ -931,6 +1436,13 @@ class ContinuousBatcher:
         self._active: dict[int, _Slot] = {}
         self._free = list(range(max_slots))
         self._st: SlotState | None = None
+        # chunked-prefill progress queue (slot ids, FIFO: the oldest
+        # admission finishes first, minimizing its TTFT) and the draft
+        # model's per-slot cache (lazily built, like _st)
+        self._prefill_q: collections.deque[int] = collections.deque()
+        self._dst = None
+        self.spec_proposed = 0  # drafted tokens proposed across rounds
+        self.spec_accepted = 0  # drafted tokens accepted by the target
         # greedy filler knobs on free slots: a sampled leftover would
         # drag an all-greedy step into the sampled branch's argsorts
         self._temp = np.zeros(max_slots, np.float32)
@@ -1184,7 +1696,9 @@ class ContinuousBatcher:
         land strictly above it (the slot's cursor never moves back),
         so adopted blocks are immutable. Must run BEFORE
         `_release_blocks` frees the rest."""
-        if rec.freed or not rec.kv_toks:
+        if rec.freed or not rec.kv_toks or rec.prefilling is not None:
+            # mid-chunked-prefill retirement (cancel): cells past the
+            # fed frontier are unwritten — nothing safely cacheable
             return
         bs = self.cengine.block_size
         n_full = (len(rec.kv_toks) - 1) // bs
@@ -1290,6 +1804,8 @@ class ContinuousBatcher:
             self._release(slot)
             self._fail(rec.fut, rec.queue, exc)
         self._st = None
+        self._dst = None
+        self._prefill_q.clear()
         # the pool array just died with the state: cached tree blocks
         # describe content that no longer exists — drop them, and the
         # pending table resets with them (nothing left to reset)
@@ -1317,6 +1833,11 @@ class ContinuousBatcher:
         for slot, rec in self._active.items():
             m = rec.meta
             if m is None or m.priority != "batch" or rec.fut.done():
+                continue
+            if rec.prefilling is not None:
+                # mid-chunked-prefill: its blocks hold no complete KV
+                # to cache and its replay would cost a full re-prefill
+                # for zero decode progress reclaimed — never a victim
                 continue
             if m.seq > vseq:
                 victim, vseq = slot, m.seq
@@ -1503,6 +2024,15 @@ class ContinuousBatcher:
         requests may admit past it (the slot-only admission had no
         such case: every slot held max_len by construction)."""
         loop = asyncio.get_event_loop()
+        if self.prefill_chunk_tokens:
+            # chunked-prefill mode: non-prefix requests adopt a frozen
+            # slot now and feed their prompt in budget slices between
+            # decode chunks. Registered-prefix requests keep the
+            # monolithic path (their KV seed lives in a dense prefix
+            # state, not pool blocks) and fall through below.
+            items = await self._admit_chunked(loop, items)
+            if not items:
+                return
         plans = []
         deferred = []
         for item in items:
@@ -1714,8 +2244,287 @@ class ContinuousBatcher:
                 self._topk[slot] = sampling.get("top_k", ec.top_k)
                 self._topp[slot] = sampling.get("top_p", ec.top_p)
                 self._sp_dirty = True
+                if self.cengine.draft is not None:
+                    # seed the draft cache row BEFORE the first token
+                    # is appended: the draft row must hold exactly the
+                    # prompt's KV, aligned with the target cursor
+                    await self._draft_seed(loop, slot, rec)
                 self._emit(slot, rec, int(firsts[row]),
                            float(flps[row]), decode=False)
+
+    async def _admit_chunked(self, loop, items: list) -> list:
+        """Chunked-prefill admission: reserve each request's blocks
+        (same planner as the monolithic path — radix seeding, CoW and
+        tenancy quotas identical), point a FROZEN slot at them, and
+        queue the suffix for budget-slice feeding by the worker loop.
+        Returns the items this path does not handle (registered-prefix
+        requests), for the monolithic admission to pick up."""
+        rest = [it for it in items if it[6]]
+        mine = [it for it in items if not it[6]]
+        if not mine:
+            return rest
+        deferred = []
+        for item in mine:
+            if item[3].done():
+                continue
+            plan = self._plan_blocks(item)
+            if plan is None:
+                deferred.append(item)
+                if item[7].priority == "interactive":
+                    self._interactive_blocked = True
+                continue
+            try:
+                await self._adopt_one(loop, item, plan)
+            except Exception as e:  # noqa: BLE001
+                self._drop_plan(plan)
+                self._fail(item[3], item[4], e)
+                # adopt donates self._st: distinguish pre- from
+                # post-dispatch failure exactly like insert does
+                if self._st is not None and any(
+                        leaf.is_deleted() for leaf in
+                        jax.tree.leaves(self._st)
+                        if hasattr(leaf, "is_deleted")):
+                    self._fail_all(RuntimeError(
+                        f"slot state lost to donated adopt: {e}"))
+                    return []
+        for item in reversed(deferred):
+            self._pending.appendleft(item)
+        return rest
+
+    async def _adopt_one(self, loop, item, plan) -> None:
+        """Device + bookkeeping half of one chunked admission: install
+        the planned block table on a free slot (frozen, cursor at the
+        cached-seed length), copy the partial CoW seed block if any,
+        and register the host record with its pending suffix."""
+        tokens, max_new, sampling, fut, queue, aid, _pfx, meta = item
+        slot = self._free.pop()
+        full, m = plan["full"], plan["m"]
+        bs = self.cengine.block_size
+        try:
+            if self._st is None:
+                self._st = self.cengine.init_slots()
+
+            def run_adopt(st=self._st):
+                st = self.cengine.adopt_slot(
+                    st, slot, plan["table"], m, full[m], aid)
+                if plan["extra"] is not None:
+                    # cells [cut*bs, m) seed from the partially-matched
+                    # shared block into this row's first fresh block —
+                    # the copy half of copy-on-write
+                    st = self.cengine.copy_cells(
+                        st, plan["extra"].block, plan["fresh"][0],
+                        m % bs)
+                return st
+
+            async with self.gpu_lock:
+                self._st = await loop.run_in_executor(None, run_adopt)
+        except Exception:
+            self._free.append(slot)
+            raise
+        self.requests += 1
+        rec = _Slot(fut, max_new, queue,
+                    stop=tuple(tuple(s) for s in
+                               sampling.get("stop", ())))
+        rec.meta = meta
+        rec.sampling = sampling
+        rec.aid = aid
+        resumed = meta.resume is not None
+        if resumed:
+            rec.out = list(meta.resume["out"])
+            rec.lps = list(meta.resume["lps"])
+            rec.max_new = meta.resume["max_new"]
+            meta.resume = None
+        if self._ledger is not None:
+            rec.block_charge = len(plan["fresh"])
+            self._ledger.note_slot_taken(meta.tenant, rec.block_charge)
+        rec.kv_toks = list(full)
+        rec.node_refs = list(plan["chain"])
+        cut = len(plan["chain"])
+        rec.owned = {cut + i: blk
+                     for i, blk in enumerate(plan["fresh"])}
+        if plan["extra"] is not None:
+            # read-only seed consumed (the copy is dispatched and
+            # ordered before any later write by the donation chain)
+            self._radix.unref([plan["extra"]])
+        rec.prefilling = {"suffix": list(plan["suffix"]), "fed": 0}
+        self._active[slot] = rec
+        self._prefill_q.append(slot)
+        self.tokens_reused += m
+        if m > 0:
+            self.prefix_hits += 1
+        else:
+            self.prefix_misses += 1
+        ec = self.engine.ec
+        self._temp[slot] = sampling.get("temperature", ec.temperature)
+        self._topk[slot] = sampling.get("top_k", ec.top_k)
+        self._topp[slot] = sampling.get("top_p", ec.top_p)
+        self._sp_dirty = True
+        if resumed:
+            self.profiler.record("resume", 0.0)
+        if meta.timeline is not None:
+            meta.timeline.event(
+                "resume" if resumed else "admit", slot=slot,
+                prefill_computed=len(plan["suffix"]),
+                prefill_reused=m)
+        if not resumed and self.on_queue_wait is not None:
+            try:
+                self.on_queue_wait(self._clock() - meta.t_enqueue)
+            except Exception:  # noqa: BLE001 — metrics hook
+                pass
+
+    async def _advance_prefills(self, loop) -> None:
+        """Feed ONE budget-size slice of an unfinished chunked prefill
+        through the fused append path. One slice per worker iteration
+        bounds the decode stall at exactly the token budget; among
+        waiting slots the slice goes to the SHORTEST REMAINING suffix
+        (FIFO on ties), so a short interactive prompt that arrived
+        behind a long bulk prefill finishes ahead of it instead of
+        paying the whole bulk prompt in TTFT. Starvation is bounded:
+        a long prefill competes only with already-admitted slots
+        (at most max_slots - 1 of them), not the unbounded queue.
+        The finishing slice samples the request's first token, unrefs
+        the frozen flag, and indexes the now-complete prompt blocks in
+        the radix tree (the same in-flight indexing the monolithic
+        path does at admission)."""
+        best = None
+        for cand in list(self._prefill_q):
+            crec = self._active.get(cand)
+            if crec is None or crec.prefilling is None:
+                self._prefill_q.remove(cand)  # retired underneath us
+                continue
+            if crec.fut.done():               # cancelled mid-prefill
+                self._prefill_q.remove(cand)
+                self._finish(cand, crec)
+                continue
+            left = (len(crec.prefilling["suffix"])
+                    - crec.prefilling["fed"])
+            if best is None or left < best[0]:
+                best = (left, cand, crec)
+        if best is None:
+            return
+        _, slot, rec = best
+        pf = rec.prefilling
+        s = self.prefill_chunk_tokens
+        fed, suffix = pf["fed"], pf["suffix"]
+        n = min(s, len(suffix) - fed)
+        finish = fed + n == len(suffix)
+        toks = np.zeros((1, s), np.int32)
+        toks[0, :n] = suffix[fed:fed + n]
+        sp = self._sp()
+
+        def run_append(st=self._st, toks=toks, n=n, finish=finish,
+                       slot=slot, sp=sp):
+            st, nxt, lp, rng = self.cengine.append_rows(
+                st, [slot], toks, [n], [finish], sp, self._rng)
+            if finish:  # host-sync only the slice that samples
+                return (st, int(np.asarray(nxt)[0]),
+                        float(np.asarray(lp)[0]), rng)
+            return st, None, None, rng
+
+        with self.profiler.phase("prefill_chunk", tokens=n):
+            async with self.gpu_lock:
+                st, first, flp, rng = await loop.run_in_executor(
+                    None, run_append)
+                self._st = st
+                self._rng = rng
+        pf["fed"] = fed + n
+        self.tokens_prefilled += n
+        if not finish:
+            return
+        self._prefill_q.remove(slot)
+        rec.prefilling = None
+        self._index_inflight(rec)
+        reused = len(rec.kv_toks) - len(suffix)
+        if self.on_prefix is not None:
+            try:
+                self.on_prefix(len(suffix), reused, reused > 0)
+            except Exception:  # noqa: BLE001 — metrics hook
+                pass           # must never kill the worker
+        if self.cengine.draft is not None:
+            with self.profiler.phase("draft"):
+                await self._draft_seed(loop, slot, rec)
+        self._emit(slot, rec, first, flp, decode=False)
+
+    async def _draft_seed(self, loop, slot: int, rec: _Slot) -> None:
+        """Seed the draft model's cache row for a freshly-admitted
+        slot. Called BEFORE the first token is emitted, so the row
+        holds exactly the prompt's KV and the draft cursor equals the
+        target cursor — the alignment every speculative round
+        preserves."""
+        toks = list(rec.kv_toks)
+
+        def run(dst=self._dst):
+            if dst is None:
+                dst = self.cengine.init_draft_slots()
+            return self.cengine.draft_prefill(dst, slot, toks,
+                                              self._rng)
+
+        async with self.gpu_lock:
+            dst, rng = await loop.run_in_executor(None, run)
+            self._dst = dst
+            self._rng = rng
+
+    async def _spec_round(self, loop) -> None:
+        """One speculative round for every live (non-frozen) slot:
+        gamma draft proposals, one fused paged verify, then k+1 tokens
+        emitted per row. Synchronous (no dispatch-ahead): acceptance
+        counts gate retirement, so the host must observe each round
+        before planning the next."""
+        sp = self._sp()
+        gamma = self.spec_gamma
+        # cancelled (fut.done) rows stay IN the snapshot — the
+        # detokenize loop below is where they get finished, exactly
+        # like _process_chunk; only frozen rows are excluded
+        snap = {s: r for s, r in self._active.items()
+                if r.prefilling is None}
+        if not snap:
+            return
+
+        def run_draft(st=self._st, dst=self._dst):
+            return self.cengine.spec_draft(st, dst, sp, self._rng,
+                                           gamma)
+
+        with self.profiler.phase("draft", tokens=gamma * len(snap)):
+            async with self.gpu_lock:
+                dst, drafted, qs, rng = await loop.run_in_executor(
+                    None, run_draft)
+                self._dst = dst
+                self._rng = rng
+
+        def run_verify(st=self._st, dst=self._dst, drafted=drafted,
+                       qs=qs):
+            st, dst, emit, lps, k, rng = self.cengine.spec_verify(
+                st, dst, drafted, qs, sp, self._rng, gamma)
+            # host sync inside the executor, like every other dispatch
+            return (st, dst, np.asarray(emit), np.asarray(lps),
+                    np.asarray(k), rng)
+
+        with self.profiler.phase("verify"):
+            async with self.gpu_lock:
+                st, dst, emit, lps, k, rng = \
+                    await loop.run_in_executor(None, run_verify)
+                self._st = st
+                self._dst = dst
+                self._rng = rng
+        self.calls += 1
+        self.spec_proposed += gamma * len(snap)
+        emitted0 = self.tokens_emitted
+        with self.profiler.phase("detokenize"):
+            for slot, srec in list(self._active.items()):
+                if snap.get(slot) is not srec:
+                    continue
+                if srec.fut.done():  # cancelled mid-round
+                    self._finish(slot, srec)
+                    continue
+                acc = int(k[slot])
+                self.spec_accepted += acc
+                for j in range(acc + 1):
+                    self._emit(slot, srec, int(emit[slot, j]),
+                               float(lps[slot, j]))
+                    if slot not in self._active:
+                        break  # retired mid-window; tail is dropped
+        self.profiler.add_tokens("verify",
+                                 self.tokens_emitted - emitted0)
 
     def _plan_steps(self, inflight) -> int:
         """Next chunk size: bounded by the longest remaining budget NOT
@@ -1726,6 +2535,8 @@ class ContinuousBatcher:
             return 0
         best = 0
         for slot, rec in self._active.items():
+            if rec.prefilling is not None:
+                continue  # frozen row: no decode budget yet
             cover = sum(r["steps"] for r in inflight
                         if r["snap"].get(slot) is rec)
             best = max(best, rec.max_new - len(rec.out) - cover)
@@ -1742,7 +2553,11 @@ class ContinuousBatcher:
         dispatch; emitting this chunk's row into it would corrupt its
         stream (caught by test_stop_sequences_retire_slots_early)."""
         sp = self._sp()
-        snap = dict(self._active)
+        # frozen (mid-chunked-prefill) rows are excluded at DISPATCH
+        # time: the device masks them, so their chunk rows are garbage
+        # even if they unfreeze while this chunk is in flight
+        snap = {s: r for s, r in self._active.items()
+                if r.prefilling is None}
 
         def run_step(st=self._st, sp=sp, steps=steps):
             # The rng chains THROUGH the compiled step (it splits
@@ -1879,6 +2694,16 @@ class ContinuousBatcher:
                         delay = min(max(
                             self._pending.pacing_delay(), 0.001), 0.05)
                     await asyncio.sleep(delay)
+            if self._prefill_q and self._st is not None:
+                # one prompt slice per iteration: the decode stall a
+                # monolithic prefill would impose is chopped into
+                # budget-size pieces interleaved with decode chunks
+                try:
+                    await self._advance_prefills(loop)
+                except Exception as e:  # noqa: BLE001
+                    self._fail_all(e)
+                    inflight.clear()
+                    continue
             try:
                 # drain whatever already finished, without blocking.
                 # INSIDE the try: an async-dispatched chunk that failed
@@ -1887,7 +2712,14 @@ class ContinuousBatcher:
                 # not kill the worker and hang every future.
                 while inflight and inflight[0]["toks"].is_ready():
                     self._process_chunk(inflight.popleft())
-                steps = self._plan_steps(inflight)
+                if self.cengine.draft is not None:
+                    # speculative rounds replace plain decode chunks;
+                    # synchronous (acceptance gates retirement), so the
+                    # inflight pipeline stays empty in spec mode
+                    await self._spec_round(loop)
+                    steps = 0
+                else:
+                    steps = self._plan_steps(inflight)
                 if steps and len(inflight) < self.pipeline_depth:
                     inflight.append(
                         await self._dispatch_chunk(loop, steps))
@@ -1997,7 +2829,13 @@ class ContinuousBatcher:
             if rec.fut.done():
                 self._release(slot)
                 continue
-            n_full = (len(rec.kv_toks) - 1) // bs if rec.kv_toks else 0
+            if rec.prefilling is not None:
+                # mid-chunked-prefill: blocks past the fed frontier are
+                # unwritten — export tokens-only, the peer re-prefills
+                n_full = 0
+            else:
+                n_full = ((len(rec.kv_toks) - 1) // bs
+                          if rec.kv_toks else 0)
             phys = ([int(b) for b in tables[slot][:n_full]]
                     if tables is not None and n_full > 0 else [])
             exports.append((slot, rec, phys))

@@ -20,6 +20,36 @@ Conventions
   that physical block*. Only refcount-0 nodes may be evicted, and only
   leaves (evicting an interior node would orphan its children's token
   paths).
+
+Write disjointness
+------------------
+The fused prefill/append kernel (`ops/pallas/prefill_append.py`)
+rewrites every block it visits *in full* — including the cells below
+each row's cursor, which it writes back as the content it read. That
+is only safe under the invariant this module maintains by
+construction: **a row's write range `[q_start, q_start + q_lens)`
+lies in blocks no OTHER row's block table references.**
+
+Concretely:
+
+- New cells land only in *fresh* blocks the pool just allocated to
+  exactly one request (`BlockPool` hands a block to one owner; the
+  `_free_set` mirror makes double-allocation impossible).
+- Radix-shared blocks sit strictly *below* every sharer's cursor:
+  the tree only indexes full blocks of already-written prompt prefix,
+  and a partial-block match is copy-on-write (the new request copies
+  the cells into its own fresh block rather than appending into the
+  shared one). A visited shared block is therefore read-only for all
+  sharers, and the kernel's full-block rewrite reproduces its
+  contents bit-for-bit.
+- Concurrent rows in one fused dispatch come from different slots,
+  whose table tails are disjoint fresh chains — so no two rows'
+  write ranges can alias.
+
+`tests/test_prefill_append_kernel.py` pins the consequences (shared
+block survives both sharers' visits byte-identically; unvisited
+blocks untouched) but the invariant itself is a *precondition* the
+engine guarantees, not a behavior the kernel checks at runtime.
 """
 
 from __future__ import annotations

@@ -559,6 +559,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                        max_batch: int = 8, continuous: bool = False,
                        warmup: bool = False,
                        prefill_chunk: int | None = None,
+                       prefill_chunk_tokens: int | None = None,
                        prefixes: dict[str, list[int]] | None = None,
                        max_pending: int | None = None,
                        pipeline_depth: int | None = None,
@@ -566,6 +567,8 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                        kv_pool_blocks: int | None = None,
                        paged_attention_impl: str = "auto",
                        drafts: dict[str, InferenceEngine] | None = None,
+                       spec_decode: bool = False,
+                       spec_gamma: int = 4,
                        registry=None, tracer=None,
                        drain_grace_s: float = 30.0,
                        tenancy: TenancyConfig | None = None,
@@ -584,7 +587,17 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     readiness implies no first-arrival compile stalls — startup takes
     correspondingly longer. `drafts` maps model names to draft
     engines; a request with "speculative": true then decodes through
-    SpeculativeEngine (latency lever; batch 1). `kv_block_size` /
+    SpeculativeEngine (latency lever; batch 1). `spec_decode=True`
+    (continuous only) instead folds each model's draft into its
+    continuous batcher: EVERY request decodes speculatively on the
+    paged KV cache, `spec_gamma` draft tokens verified per round in
+    one fused batched pass — token-identical to plain decode, and it
+    composes with radix caching, preemption and migration. Requires a
+    draft for every served model. `prefill_chunk_tokens` (continuous
+    only) turns admission prefill into budget-size slices interleaved
+    with decode chunks: no decode stall longer than the budget while a
+    long prompt prefills (distinct from `prefill_chunk`, which only
+    buckets the monolithic prefill's compile shapes). `kv_block_size` /
     `kv_pool_blocks` (continuous only) shape the paged KV cache: pow2
     tokens per block and total pool blocks per model (default: the
     dense equivalent, every slot can reach max_len — shrink the pool
@@ -636,6 +649,8 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     lock = asyncio.Lock()
     app[GPU_LOCK_KEY] = lock
     if not continuous and (warmup or prefill_chunk or prefixes
+                           or prefill_chunk_tokens is not None
+                           or spec_decode
                            or max_pending is not None
                            or pipeline_depth is not None
                            or kv_block_size != 64
@@ -648,9 +663,18 @@ def create_serving_app(engines: dict[str, InferenceEngine],
         # caller believes overload sheds at that depth; tenancy
         # especially: the caller believes quotas are enforced)
         raise ValueError(
-            "warmup/prefill_chunk/prefixes/max_pending/pipeline_depth/"
-            "kv_block_size/kv_pool_blocks/paged_attention_impl/tenancy "
+            "warmup/prefill_chunk/prefill_chunk_tokens/prefixes/"
+            "max_pending/pipeline_depth/kv_block_size/kv_pool_blocks/"
+            "paged_attention_impl/spec_decode/tenancy "
             "require continuous=True")
+    if spec_decode:
+        missing = set(engines) - set(drafts or {})
+        if missing:
+            # silently decoding some models speculatively and others
+            # not would make the latency story per-model surprising
+            raise ValueError(
+                f"spec_decode=True requires a draft for every served "
+                f"model; missing {sorted(missing)}")
     app[TENANCY_KEY] = tenancy
     if continuous:
         # prefill_chunk: long prompts admit in fixed slices — chunk-
@@ -660,12 +684,16 @@ def create_serving_app(engines: dict[str, InferenceEngine],
         app[BATCHERS_KEY] = {
             name: ContinuousBatcher(
                 eng, lock, max_slots=max_batch,
-                prefill_chunk=prefill_chunk, prefixes=prefixes,
+                prefill_chunk=prefill_chunk,
+                prefill_chunk_tokens=prefill_chunk_tokens,
+                prefixes=prefixes,
                 max_pending=256 if max_pending is None else max_pending,
                 pipeline_depth=pipeline_depth,
                 kv_block_size=kv_block_size,
                 kv_pool_blocks=kv_pool_blocks,
                 paged_attention_impl=paged_attention_impl,
+                draft=(drafts or {}).get(name) if spec_decode else None,
+                spec_gamma=spec_gamma,
                 tenancy=tenancy)
             for name, eng in engines.items()}
         if warmup:

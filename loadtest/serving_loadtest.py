@@ -198,6 +198,7 @@ tenancy = config_from_dict({{"tenants": {{
 }}}})
 app = srv.create_serving_app({{"tiny": eng}}, continuous=True, warmup=True,
                              max_batch={max_batch},
+                             prefill_chunk_tokens={chunk} or None,
                              tenancy=tenancy if {qos} else None,
                              slo_ttft_s={{"interactive": {slo_ttft_s}}})
 if not {qos}:
@@ -782,6 +783,7 @@ def run_chaos(clients: int, requests: int, max_new: int, *,
 
 
 def _tenant_arm(qos: bool, *, bulk_clients: int, live_requests: int,
+                bulk_prompt_len: int, prefill_chunk_tokens: int,
                 bulk_max_new: int, live_max_new: int,
                 max_batch: int, slo_ttft_s: float) -> dict:
     """One arm of the noisy-neighbor A/B: flood with batch-class work,
@@ -800,6 +802,7 @@ def _tenant_arm(qos: bool, *, bulk_clients: int, live_requests: int,
         [sys.executable, "-c",
          TENANT_SERVER_CODE.format(repo=REPO, port=port, qos=qos,
                                    max_batch=max_batch,
+                                   chunk=prefill_chunk_tokens,
                                    slo_ttft_s=slo_ttft_s)],
         stdout=log, stderr=subprocess.STDOUT)
 
@@ -855,11 +858,19 @@ def _tenant_arm(qos: bool, *, bulk_clients: int, live_requests: int,
         # The live warmup STREAMS: the one-shot path observes TTFT at
         # generation end, and that inflated sample would pollute the
         # interactive SLO set both arms' burn gauges are asserted on.
+        def bulk_prompt(i: int) -> list[int]:
+            """Distinct per call: identical prompts would collapse
+            into radix prefix hits after the first retirement and the
+            flood would stop exercising prefill at all."""
+            return [5 + (i * 31 + j * 7) % 480
+                    for j in range(bulk_prompt_len)]
+
         with concurrent.futures.ThreadPoolExecutor(bulk_clients) as ex:
-            for _ in range(2):
+            for r in range(2):
                 list(ex.map(
-                    lambda i: post({"tokens": [[1, 2, 3, 4]],
-                                    "max_new": bulk_max_new}, "bulk"),
+                    lambda i: post(
+                        {"tokens": [bulk_prompt(-1 - i - r * 64)],
+                         "max_new": bulk_max_new}, "bulk"),
                     range(bulk_clients)))
         live_ttft(0)
 
@@ -868,14 +879,15 @@ def _tenant_arm(qos: bool, *, bulk_clients: int, live_requests: int,
         bulk_429 = [0]
         lock = threading.Lock()
 
-        def bulk_loop() -> None:
+        def bulk_loop(tid: int) -> None:
             # the noisy neighbor: keep a long generation in flight per
             # thread until the interactive phase is over
             i = 0
             while not stop.is_set():
                 i += 1
                 try:
-                    post({"tokens": [[5 + i % 7, 2, 3, 4]],
+                    post({"tokens": [
+                              bulk_prompt(i * bulk_clients + tid)],
                           "max_new": bulk_max_new}, "bulk")
                     with lock:
                         bulk_done[0] += 1
@@ -887,8 +899,9 @@ def _tenant_arm(qos: bool, *, bulk_clients: int, live_requests: int,
                     e.close()
                     time.sleep(0.05)
 
-        threads = [threading.Thread(target=bulk_loop, daemon=True)
-                   for _ in range(bulk_clients)]
+        threads = [threading.Thread(target=bulk_loop, args=(t,),
+                                    daemon=True)
+                   for t in range(bulk_clients)]
         t_start = time.perf_counter()
         for t in threads:
             t.start()
@@ -952,6 +965,7 @@ def _tenant_arm(qos: bool, *, bulk_clients: int, live_requests: int,
 
 def run_tenants(*, bulk_clients: int = 8, live_requests: int = 8,
                 bulk_max_new: int = 64, live_max_new: int = 8,
+                bulk_prompt_len: int = 4, prefill_chunk_tokens: int = 0,
                 max_batch: int = 4, slo_ttft_s: float = 0.03,
                 slo_alert_burn: float = 6.0) -> dict:
     """Noisy-neighbor A/B: identical flood + interactive workloads,
@@ -969,12 +983,18 @@ def run_tenants(*, bulk_clients: int = 8, live_requests: int = 8,
     on = _tenant_arm(True, bulk_clients=bulk_clients,
                      live_requests=live_requests,
                      bulk_max_new=bulk_max_new,
-                     live_max_new=live_max_new, max_batch=max_batch,
+                     live_max_new=live_max_new,
+                     bulk_prompt_len=bulk_prompt_len,
+                     prefill_chunk_tokens=prefill_chunk_tokens,
+                     max_batch=max_batch,
                      slo_ttft_s=slo_ttft_s)
     off = _tenant_arm(False, bulk_clients=bulk_clients,
                       live_requests=live_requests,
                       bulk_max_new=bulk_max_new,
-                      live_max_new=live_max_new, max_batch=max_batch,
+                      live_max_new=live_max_new,
+                      bulk_prompt_len=bulk_prompt_len,
+                      prefill_chunk_tokens=prefill_chunk_tokens,
+                      max_batch=max_batch,
                       slo_ttft_s=slo_ttft_s)
     burn_on = on["slo_burn_interactive_short"]
     burn_off = off["slo_burn_interactive_short"]
@@ -1001,6 +1021,8 @@ def run_tenants(*, bulk_clients: int = 8, live_requests: int = 8,
         "live_requests": live_requests,
         "bulk_max_new": bulk_max_new,
         "live_max_new": live_max_new,
+        "bulk_prompt_len": bulk_prompt_len,
+        "prefill_chunk_tokens": prefill_chunk_tokens,
         "max_batch": max_batch,
         "slo_ttft_s": slo_ttft_s,
         "slo_alert_burn": slo_alert_burn,
@@ -1189,6 +1211,15 @@ def main() -> int:
                         "threads (the noisy neighbor); must exceed the "
                         "server's max_batch or nothing ever queues and "
                         "there is no backlog to measure against")
+    p.add_argument("--tenant-bulk-prompt", type=int, default=4,
+                   help="tenants mode: batch-class prompt length in "
+                        "tokens — long prompts make every bulk "
+                        "admission a monolithic-prefill stall unless "
+                        "--prefill-chunk-tokens bounds it")
+    p.add_argument("--prefill-chunk-tokens", type=int, default=0,
+                   help="tenants mode: chunked-prefill token budget "
+                        "for BOTH arms' servers (0 = monolithic "
+                        "admission prefill)")
     p.add_argument("--tenant-live-requests", type=int, default=8,
                    help="tenants mode: sequential interactive streams "
                         "measured for TTFT")
@@ -1270,9 +1301,15 @@ def main() -> int:
             p.error("--tenant-bulk-clients must be >= 1")
         if args.tenant_live_requests < 2:
             p.error("--tenant-live-requests must be >= 2 (quantiles)")
+        if args.tenant_bulk_prompt < 1:
+            p.error("--tenant-bulk-prompt must be >= 1")
+        if args.prefill_chunk_tokens < 0:
+            p.error("--prefill-chunk-tokens must be >= 0")
         result = run_tenants(
             bulk_clients=args.tenant_bulk_clients,
             live_requests=args.tenant_live_requests,
+            bulk_prompt_len=args.tenant_bulk_prompt,
+            prefill_chunk_tokens=args.prefill_chunk_tokens,
             slo_ttft_s=args.slo_ttft_s,
             slo_alert_burn=args.slo_alert_burn)
     else:

@@ -1,0 +1,293 @@
+"""Speculative decoding folded into the continuous/paged engine.
+
+Unlike serving/speculative.py (batch 1, dense KV), the continuous
+engine drafts gamma tokens for EVERY live slot at once and verifies
+them in ONE fused paged forward — accepted tokens' KV lands through
+the block table, rejected cells are rolled back by cursor arithmetic
+(write-before-read makes their garbage unattendable). The acceptance
+rule is the standard ratio test, so greedy in = greedy out: every
+test pins bit-exact parity against the non-speculative continuous
+batcher / solo oracle, across gamma, families, EOS mid-window,
+preemption, migration, and composition with chunked prefill.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytest_plugins = ("aiohttp.pytest_plugin",)
+
+from kubeflow_tpu.models import gemma, llama
+from kubeflow_tpu.serving import (
+    EngineConfig,
+    GEMMA_FAMILY,
+    InferenceEngine,
+    LLAMA_FAMILY,
+    build_pack,
+)
+from kubeflow_tpu.serving.continuous import (
+    ContinuousBatcher,
+    ContinuousEngine,
+    MigratedAway,
+)
+from kubeflow_tpu.tenancy import config_from_dict
+from kubeflow_tpu.train.lora import LoraConfig, init_lora
+
+BS = 8
+
+
+def _build(family="llama", seed=0, max_len=96, eos=None, sharpen=True):
+    if family == "llama":
+        cfg = llama.LLAMA_TINY
+        params = dict(llama.init(jax.random.key(seed), cfg))
+    else:
+        cfg = gemma.GEMMA_TINY
+        params = dict(gemma.init(jax.random.key(seed), cfg))
+    if sharpen and "lm_head" in params:  # gemma ties its embeddings
+        params["lm_head"] = params["lm_head"] * 50.0  # argmax can't flip
+    fam = LLAMA_FAMILY if family == "llama" else GEMMA_FAMILY
+    return InferenceEngine(params, cfg, fam,
+                           EngineConfig(max_len=max_len,
+                                        eos_token=eos)), cfg
+
+
+@pytest.fixture(scope="module")
+def llama_pair():
+    target, cfg = _build("llama", seed=0)
+    draft, _ = _build("llama", seed=5)
+    return target, draft, cfg
+
+
+def _solo(engine, prompt, max_new):
+    return np.asarray(engine.generate(
+        jnp.asarray([prompt], jnp.int32), max_new=max_new))[0].tolist()
+
+
+def _batcher(engine, draft=None, gamma=4, **kw):
+    return ContinuousBatcher(engine, asyncio.Lock(), max_slots=4,
+                             kv_block_size=BS, draft=draft,
+                             spec_gamma=gamma, **kw)
+
+
+async def _run_all(batcher, prompts, max_new):
+    try:
+        out = await asyncio.gather(
+            *(batcher.submit(p, max_new, ()) for p in prompts))
+        return [list(o) for o in out]
+    finally:
+        await batcher.close()
+
+
+async def test_spec_parity_across_gamma_llama(llama_pair):
+    """A draft that DISAGREES with the target (different random init:
+    near-zero acceptance) exercises the rejection/rollback path every
+    round — the emitted tokens must still be the oracle's, for any
+    gamma."""
+    target, draft, cfg = llama_pair
+    gen = np.random.default_rng(4)
+    prompts = [gen.integers(0, cfg.vocab_size, n).tolist()
+               for n in (4, 7, 12, 20)]
+    want = [_solo(target, p, 6) for p in prompts]
+    for gamma in (1, 3, 5):
+        b = _batcher(target, draft=draft, gamma=gamma)
+        got = await _run_all(b, prompts, 6)
+        assert got == want, f"gamma={gamma}"
+        assert b.spec_proposed > 0
+
+
+async def test_spec_self_draft_accepts_everything(llama_pair):
+    """Draft == target under greedy sampling: the ratio test accepts
+    every proposal (argmax agrees with itself), so each round advances
+    gamma + 1 tokens. Pins the ACCEPT path end-to-end — including the
+    draft-cache rollback arithmetic in its k == gamma branch."""
+    target, _, cfg = llama_pair
+    gen = np.random.default_rng(7)
+    prompts = [gen.integers(0, cfg.vocab_size, n).tolist()
+               for n in (5, 9)]
+    want = [_solo(target, p, 9) for p in prompts]
+    b = _batcher(target, draft=target, gamma=4)
+    got = await _run_all(b, prompts, 9)
+    assert got == want
+    assert b.spec_accepted == b.spec_proposed > 0
+
+
+@pytest.mark.slow
+async def test_spec_parity_gemma():
+    """The other family: GQA 4:1 + sliding-window plumbing through
+    the fused verify forward."""
+    target, cfg = _build("gemma", seed=1)
+    draft, _ = _build("gemma", seed=8)
+    gen = np.random.default_rng(9)
+    prompts = [gen.integers(0, cfg.vocab_size, n).tolist()
+               for n in (7, 11)]
+    want = [_solo(target, p, 6) for p in prompts]
+    got = await _run_all(_batcher(target, draft=draft, gamma=3),
+                         prompts, 6)
+    assert got == want
+
+
+async def test_spec_eos_mid_window(llama_pair):
+    """EOS landing in the MIDDLE of an accepted window: emit must stop
+    at it exactly like plain decode does (the tail of the window is
+    dropped with the retired slot). Oracle: the non-spec continuous
+    batcher on the same EOS-configured engine."""
+    target, _, cfg = llama_pair
+    prompt = [3, 5, 7, 11]
+    # pick the oracle's 3rd emitted token as EOS: with self-draft and
+    # gamma=4 the first verify window covers it mid-window
+    trace = _solo(target, prompt, 8)
+    eos_target, _ = _build("llama", seed=0, eos=trace[2])
+    plain = await _run_all(_batcher(eos_target), [prompt], 8)
+    spec = await _run_all(_batcher(eos_target, draft=eos_target,
+                                   gamma=4), [prompt], 8)
+    assert spec == plain
+    assert plain[0][2] == trace[2]          # truncated at the EOS...
+    assert len([t for t in plain[0]
+                if t != trace[2]]) < 8      # ...not run to budget
+
+
+async def test_spec_with_preemption(llama_pair):
+    """Tenancy preemption composes with speculation: the preempted
+    bulk request replays through the radix cache and re-enters
+    speculative decode token-identically."""
+    target, draft, _ = llama_pair
+    qos = {"tenants": {"live": {"priority": "interactive"},
+                       "bulk": {"priority": "batch"}}}
+    p1, p2, p3 = [3, 5, 7, 11], [4, 6, 8, 10], [9, 2, 4, 8]
+    want1, want2 = _solo(target, p1, 80), _solo(target, p2, 80)
+    want3 = _solo(target, p3, 8)
+    b = ContinuousBatcher(target, asyncio.Lock(), max_slots=2,
+                          kv_block_size=BS, draft=draft, spec_gamma=2,
+                          tenancy=config_from_dict(qos))
+    try:
+        f1 = asyncio.ensure_future(
+            b.submit(p1, 80, (("tenant", "bulk"),)))
+        f2 = asyncio.ensure_future(
+            b.submit(p2, 80, (("tenant", "bulk"),)))
+        for _ in range(400):
+            if len(b._active) == 2:
+                break
+            await asyncio.sleep(0.02)
+        assert len(b._active) == 2
+        got3 = await b.submit(p3, 8, (("tenant", "live"),))
+        assert b.preemptions >= 1
+        assert await f1 == want1
+        assert await f2 == want2
+        assert got3 == want3
+    finally:
+        await b.close()
+
+
+async def test_spec_migration_mid_generation(llama_pair):
+    """Export mid-generation from a speculative batcher, resume on
+    another speculative batcher: the draft cache is replica-local
+    state (re-seeded at admission from the replayed prompt), so the
+    wire format is unchanged and tokens stay exact."""
+    target, draft, _ = llama_pair
+    prompt = [3, 5, 7, 11, 13, 17]
+    want = _solo(target, prompt, 24)
+    a = _batcher(target, draft=draft, gamma=2)
+    fut, q = a.open_stream(prompt, 24, ())
+    try:
+        for _ in range(9):
+            tok = await asyncio.wait_for(q.get(), 30)
+            assert tok is not None
+        records = await a.export_sequences()
+        with pytest.raises(MigratedAway):
+            await fut
+    finally:
+        await a.close()
+    (rec,) = records
+    assert rec["kv"] is not None and rec["kv"]["n_full"] >= 1
+    bb = _batcher(target, draft=draft, gamma=2)
+    try:
+        await bb.import_sequence(rec)
+        out = await bb.submit(rec["tokens"],
+                              rec["max_new"] - len(rec["out"]), ())
+        assert rec["out"] + out == want
+    finally:
+        await bb.close()
+
+
+async def test_spec_composes_with_chunked_prefill(llama_pair):
+    """Both tentpole mechanisms at once: chunk-admitted requests join
+    speculative rounds only after their prefill completes (frozen rows
+    are masked out of draft AND verify), still token-exact."""
+    target, draft, cfg = llama_pair
+    gen = np.random.default_rng(11)
+    prompts = [gen.integers(0, cfg.vocab_size, n).tolist()
+               for n in (4, 9, 26)]
+    want = [_solo(target, p, 6) for p in prompts]
+    b = _batcher(target, draft=draft, gamma=2,
+                 prefill_chunk_tokens=3)
+    got = await _run_all(b, prompts, 6)
+    assert got == want
+
+
+# -- construction doors -----------------------------------------------------
+
+
+def test_engine_rejects_incompatible_drafts(llama_pair):
+    target, draft, _ = llama_pair
+    # vocab mismatch: the ratio test compares distributions index-wise
+    import dataclasses
+    vcfg = dataclasses.replace(llama.LLAMA_TINY, vocab_size=256)
+    vdraft = InferenceEngine(
+        dict(llama.init(jax.random.key(3), vcfg)), vcfg, LLAMA_FAMILY,
+        EngineConfig(max_len=96))
+    with pytest.raises(ValueError, match="vocab"):
+        ContinuousEngine(target, max_slots=2, draft=vdraft)
+    # a draft that can't reach the target's max_len would run out of
+    # cache mid-sequence — fail at construction, not at token 60
+    short, _ = _build("llama", seed=5, max_len=32)
+    with pytest.raises(ValueError, match="max_len"):
+        ContinuousEngine(target, max_slots=2, draft=short)
+    # speculative + multi-LoRA: the draft has no per-request adapters;
+    # accepted tokens would mix base-draft proposals into adapter
+    # streams. Refuse the combination outright.
+    cfg = llama.LLAMA_TINY
+    pack = build_pack(cfg, LoraConfig(rank=4),
+                      {"a": init_lora(jax.random.key(1), cfg,
+                                      LoraConfig(rank=4))})
+    packed = InferenceEngine(
+        dict(llama.init(jax.random.key(0), cfg)), cfg, LLAMA_FAMILY,
+        EngineConfig(max_len=64), adapter_pack=pack)
+    with pytest.raises(ValueError, match="adapter"):
+        ContinuousEngine(packed, max_slots=2, draft=draft)
+
+
+def test_batcher_and_server_knob_validation(llama_pair):
+    target, draft, _ = llama_pair
+    with pytest.raises(ValueError, match="spec_gamma"):
+        ContinuousBatcher(target, asyncio.Lock(), max_slots=2,
+                          draft=draft, spec_gamma=0)
+    from kubeflow_tpu.serving.server import create_serving_app
+    with pytest.raises(ValueError, match="require continuous"):
+        create_serving_app({"m": target}, drafts={"m": draft},
+                           spec_decode=True)
+    with pytest.raises(ValueError, match="missing"):
+        create_serving_app({"m": target}, continuous=True,
+                           spec_decode=True)
+
+
+async def test_server_spec_decode_end_to_end(llama_pair, aiohttp_client):
+    """The REST surface: spec_decode=True serves token-identical
+    completions through the continuous batcher, and /v1/models still
+    lists the model."""
+    from kubeflow_tpu.serving.server import create_serving_app
+
+    target, draft, cfg = llama_pair
+    prompt = [3, 1, 4, 1, 5]
+    want = _solo(target, prompt, 6)
+    app = create_serving_app({"m": target}, continuous=True,
+                             kv_block_size=BS, drafts={"m": draft},
+                             spec_decode=True, spec_gamma=2)
+    client = await aiohttp_client(app)
+    resp = await client.post("/v1/models/m:generate",
+                             json={"tokens": [prompt], "max_new": 6})
+    assert resp.status == 200
+    body = await resp.json()
+    assert body["tokens"][0] == want
