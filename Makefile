@@ -1,7 +1,7 @@
 # Developer entry points (the reference drives everything through
 # per-component Makefiles; here one root Makefile covers the repo).
 
-.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check profile-check serving-check fleet-check kernels-check tenancy-check chaos-check
+.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check profile-check serving-check fleet-check kernels-check tenancy-check chaos-check train-check
 
 verify-all:  ## the full evidence sweep, one command
 	python -m pytest tests -q -m "slow or not slow"
@@ -36,18 +36,11 @@ obs-check:   ## strict /metrics parse + /debug/traces gate on a live app
 profile-check: ## step-anatomy gate: /debug/profile + zero-seeded phase/recompile families
 	JAX_PLATFORMS=cpu python -m ci.obs_check profile
 
-# serving-check deselects two KNOWN-RED tests: the sharded-vs-unsharded
-# parity tests fail at the DENSE engine level (sharded generate emits
-# different tokens than unsharded — pre-existing on the seed tree, see
-# ROADMAP.md), so they cannot gate the paged-KV path. Re-enable once
-# sharded parity is fixed.
 serving-check: ## CPU dense-oracle parity gate for the paged-KV serving path
 	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py \
 	  tests/test_continuous.py tests/test_paged_kv.py \
 	  tests/test_speculative.py tests/test_chunked_prefill.py \
-	  tests/test_spec_paged.py -q -m "slow or not slow" \
-	  --deselect tests/test_continuous.py::test_continuous_engine_under_tensor_parallel_mesh \
-	  --deselect tests/test_serving.py::test_sharded_gemma_scale_vocab_decode_matches_unsharded
+	  tests/test_spec_paged.py -q -m "slow or not slow"
 
 kernels-check: ## Pallas kernels vs XLA oracles, interpret mode, both tiers
 	JAX_PLATFORMS=cpu python -m pytest tests/test_flash.py \
@@ -66,6 +59,13 @@ chaos-check: ## fault-injection gate: migration parity suite + seeded chaos load
 	  tests/test_fleet.py -q -m "slow or not slow"
 	JAX_PLATFORMS=cpu python loadtest/serving_loadtest.py --mode chaos \
 	  --clients 8 --requests 48 --max-new 16
+
+train-check: ## elastic-training gate: resize/ZeRO/commit-marker suites + metric zero-seed check + trainer chaos loadtest
+	JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py \
+	  tests/test_checkpoint.py -q -m "slow or not slow"
+	JAX_PLATFORMS=cpu python -m ci.obs_check train
+	JAX_PLATFORMS=cpu python loadtest/serving_loadtest.py --mode train-chaos \
+	  --train-replicas 2 --train-steps 8 --train-save-every 2
 
 tenancy-check: ## multi-tenant QoS gate: unit suite + noisy-neighbor A/B loadtest
 	JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q \

@@ -345,6 +345,162 @@ def bench_train(preset: Preset, *, assert_flash: bool = False,
     }
 
 
+def _train_zero_measure(*, steps: int = 5, warmup: int = 1, batch: int = 8,
+                        seq: int = 64, verbose: bool = True) -> dict:
+    """ZeRO A/B on a data=4 mesh: throughput + per-replica optimizer
+    bytes with the optimizer sharded over the data axis vs fully
+    replicated. Needs >=4 devices (bench_train_zero arranges them).
+
+    The shard ratio (replicated bytes / ZeRO bytes per replica) is the
+    acceptance number: ~= the data-axis extent (4), since every
+    divisible optimizer leaf drops to 1/N per device and only scalar
+    leaves (step counters) stay mirrored.
+    """
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.parallel import MeshSpec, create_mesh
+    from kubeflow_tpu.train import Trainer, TrainConfig
+
+    cfg = bench_configs()["tiny"]
+    n = len(jax.devices())
+    if n < 4:
+        raise RuntimeError(f"train-zero needs >=4 devices, have {n}")
+    data = 4
+    devices = jax.devices()[: data * (n // data)]
+    mesh = create_mesh(
+        MeshSpec(data=data, fsdp=len(devices) // data, tensor=1),
+        devices=devices)
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    arms = {}
+    for zero in (True, False):
+        trainer = Trainer(
+            mesh=mesh,
+            apply_fn=lambda p_, t: llama.apply(p_, cfg, t),
+            init_fn=lambda k: llama.init(k, cfg),
+            logical_axes=llama.param_logical_axes(cfg),
+            train_config=TrainConfig(warmup_steps=10, total_steps=1000,
+                                     zero_optimizer=zero),
+        )
+        state = trainer.init(jax.random.key(0))
+        for _ in range(warmup):
+            state, loss = trainer.step(state, tokens, targets)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, loss = trainer.step(state, tokens, targets)
+        final_loss = float(loss)
+        dt = time.perf_counter() - t0
+        arms[zero] = {
+            "tok_per_sec_per_chip":
+                batch * seq * steps / dt / len(devices),
+            "opt_bytes_per_replica":
+                trainer.opt_state_bytes(per_replica=True),
+            "loss": final_loss,
+        }
+        del state, trainer
+
+    # Both arms run the mathematically identical update — ZeRO only
+    # re-shards where the state lives. Divergence means a sharding bug,
+    # which must fail the bench rather than publish a tainted number.
+    loss_div = abs(arms[True]["loss"] - arms[False]["loss"])
+    if loss_div > 1e-4:
+        raise AssertionError(
+            f"ZeRO arm diverged from replicated arm: "
+            f"{arms[True]['loss']:.6f} vs {arms[False]['loss']:.6f}")
+
+    zb = arms[True]["opt_bytes_per_replica"]
+    rb = arms[False]["opt_bytes_per_replica"]
+    ratio = rb / max(zb, 1)
+    gen = detect_generation()
+    if verbose:
+        print(
+            f"# train-zero devices={len(devices)} data={data} "
+            f"opt_bytes/replica zero={zb} replicated={rb} "
+            f"ratio={ratio:.3f} loss_div={loss_div:.2e}",
+            file=sys.stderr,
+        )
+    return {
+        "metric": f"llama_train_tokens_per_sec_per_chip[tiny-zero,{gen}]",
+        "value": round(arms[True]["tok_per_sec_per_chip"], 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(
+            arms[True]["tok_per_sec_per_chip"]
+            / max(arms[False]["tok_per_sec_per_chip"], 1e-9), 4),
+        "extra_metrics": [
+            {
+                "metric":
+                    f"llama_train_tokens_per_sec_per_chip"
+                    f"[tiny-zero-off,{gen}]",
+                "value": round(arms[False]["tok_per_sec_per_chip"], 2),
+                "unit": "tokens/s/chip",
+                "vs_baseline": 1.0,
+            },
+            {
+                "metric": f"train_opt_bytes_per_replica[tiny-zero,{gen}]",
+                "value": int(zb),
+                "unit": "bytes",
+                "vs_baseline": round(zb / rb, 4),
+            },
+            {
+                "metric":
+                    f"train_opt_bytes_per_replica[tiny-replicated,{gen}]",
+                "value": int(rb),
+                "unit": "bytes",
+                "vs_baseline": 1.0,
+            },
+            {
+                # The ISSUE acceptance gate: ~= data-axis extent (4.0).
+                # Unit "ratio" makes bench_gate treat it higher-better,
+                # so a sharding regression (ratio -> 1.0) fails CI.
+                "metric": f"train_zero_opt_shard_ratio[{gen}]",
+                "value": round(ratio, 4),
+                "unit": "ratio",
+                "vs_baseline": round(ratio / data, 4),
+            },
+        ],
+    }
+
+
+def bench_train_zero(*, verbose: bool = True) -> dict:
+    """ZeRO A/B section. On a real multi-device backend it runs
+    in-process; a CPU bench process has ONE device (no virtual-device
+    forcing here, unlike tests/conftest.py), so the data=4 mesh needs a
+    child interpreter with forced host devices — XLA_FLAGS must be set
+    before jax import, hence the _reexec_cpu_fallback-style `-c` child
+    rather than any in-process toggle.
+    """
+    if len(jax.devices()) >= 4:
+        return _train_zero_measure(verbose=verbose)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "import sys, json; sys.path.insert(0, {root!r}); "
+        "import bench; "
+        "print(json.dumps(bench._train_zero_measure(verbose=False)))"
+    ).format(root=_REPO_DIR)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=_REPO_DIR,
+        stdout=subprocess.PIPE, text=True, timeout=_SECTION_TIMEOUT_S)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"train-zero child failed rc={proc.returncode}")
+    out = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    m = json.loads(out[-1])
+    if verbose:
+        extras = {e["metric"]: e["value"] for e in m["extra_metrics"]}
+        print(f"# train-zero (child, 8 virtual cpu devices): "
+              f"headline={m['value']} {m['unit']} extras={extras}",
+              file=sys.stderr)
+    return m
+
+
 def _decode_model(name: str):
     """(cfg, init_fn, family) for the decode benches: the llama bench
     configs plus the gemma family (BASELINE config #5 "Gemma-2B
@@ -1177,10 +1333,10 @@ def first_compile_metric() -> dict:
 # flash4k stays LAST (known wedge risk — see ordering note below);
 # mnist/vit/decode-gemma complete the BASELINE.md config matrix
 # (configs #1, #2, #5 — VERDICT r04 weak #4).
-ALL_SECTIONS = ("train500m", "train1b", "decode", "decode-int8",
-                "decode-cont", "decode-paged", "decode-spec-paged",
-                "decode-paged-kernel", "decode-gemma", "mnist", "vit",
-                "flash4k")
+ALL_SECTIONS = ("train500m", "train1b", "train-zero", "decode",
+                "decode-int8", "decode-cont", "decode-paged",
+                "decode-spec-paged", "decode-paged-kernel",
+                "decode-gemma", "mnist", "vit", "flash4k")
 # Per-section wall-clock bound for the orchestrated TPU sweep. Sized
 # from measured section times (train sections ~2-4 min incl. compile,
 # decode ~2 min) with slack for tunnel weather; a section that wedges
@@ -1193,8 +1349,8 @@ _SECTION_TIMEOUT_S = float(
 
 def _sweep_for(backend: str, wanted: list[str], p) -> list[str]:
     sweep = (list(ALL_SECTIONS) if backend == "tpu"
-             else ["train500m", "decode", "decode-int8", "decode-cont",
-                   "decode-paged", "decode-spec-paged",
+             else ["train500m", "train-zero", "decode", "decode-int8",
+                   "decode-cont", "decode-paged", "decode-spec-paged",
                    "decode-paged-kernel", "decode-gemma", "mnist",
                    "vit"])
     if wanted:
@@ -1485,6 +1641,16 @@ def _run_sweep(sweep: list[str], backend: str, *, in_child: bool,
     if "train1b" in sweep:
         guarded("train1b", lambda: bench_train(
             TRAIN_PRESETS["tpu-1b-bf16"], verbose=verbose))
+    if "train-zero" in sweep:
+        # ZeRO A/B over a data=4 mesh: sharded-optimizer throughput vs
+        # the replicated baseline, plus the per-replica optimizer-byte
+        # shard ratio (the elastic-training acceptance number, ~= 4).
+        def _train_zero() -> dict:
+            m = bench_train_zero(verbose=verbose)
+            extras.extend(m.pop("extra_metrics", []))
+            return m
+
+        guarded("train-zero", _train_zero)
     if "flash4k" in sweep:
         guarded("flash4k", lambda: bench_train(
             TRAIN_PRESETS["tpu-flash-4k"], assert_flash=True,

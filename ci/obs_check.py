@@ -404,8 +404,105 @@ async def run_fleet_check() -> list[str]:
     return failures
 
 
+async def run_train_check() -> list[str]:
+    """Fourth act (ISSUE 11): boot the elastic-training coordinator —
+    real aiohttp app, no jax — and hold its /metrics to the strict
+    contract: the full train_* catalog visible zero-seeded in ONE
+    scrape before any trainer ever checkpointed, then the gauges and
+    the restart counter tracking a registered gang losing a member."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu.controlplane.metrics import Registry
+    from kubeflow_tpu.fleet.registry import STATES
+    from kubeflow_tpu.train.elastic import (
+        ElasticCoordinator,
+        create_coordinator_app,
+    )
+
+    failures: list[str] = []
+    clock_t = [0.0]
+    coord = ElasticCoordinator(
+        min_replicas=2, degraded_after_s=5.0, dead_after_s=10.0,
+        clock=lambda: clock_t[0], registry=Registry())
+    client = TestClient(TestServer(create_coordinator_app(coord)))
+    try:
+        await client.start_server()
+
+        async def scrape() -> dict:
+            resp = await client.get("/metrics")
+            text = await resp.text()
+            try:
+                return parse_exposition(text)
+            except ExpositionError as e:
+                failures.append(f"/metrics failed strict parse: {e}")
+                return {}
+
+        def sample(families: dict, fam: str, sname: str, **labels):
+            f = families.get(fam)
+            if f is None:
+                failures.append(f"/metrics missing family {fam}")
+                return None
+            key = (sname, tuple(sorted(labels.items())))
+            if key not in f["samples"]:
+                failures.append(
+                    f"/metrics missing sample {sname}{labels}")
+                return None
+            return f["samples"][key]
+
+        fams = await scrape()
+        for state in STATES:
+            if sample(fams, "train_replicas", "train_replicas",
+                      state=state) not in (0, None):
+                failures.append(
+                    f"train_replicas[{state}] not zero-seeded")
+        if sample(fams, "train_generation", "train_generation") \
+                not in (0, None):
+            failures.append("train_generation not zero-seeded")
+        if sample(fams, "train_restarts_total",
+                  "train_restarts_total") not in (0, None):
+            failures.append("train_restarts_total not zero-seeded")
+        for fam in ("train_checkpoint_save_seconds",
+                    "train_checkpoint_restore_seconds"):
+            if sample(fams, fam, f"{fam}_count") not in (0, None):
+                failures.append(f"{fam}_count not zero-seeded")
+
+        # a gang forms, then loses a member: gauges + counter move
+        for rid in ("tr0", "tr1"):
+            resp = await client.post(
+                "/elastic/register",
+                json={"replica_id": rid, "step": 0})
+            if resp.status != 200:
+                failures.append(f"register {rid} -> {resp.status}")
+        clock_t[0] = 11.0  # tr0 never beats again -> dead
+        await client.post("/elastic/heartbeat",
+                          json={"replica_id": "tr1", "step": 4})
+        world = await (await client.get("/elastic/world")).json()
+        if world.get("members") != ["tr1"]:
+            failures.append(
+                f"/elastic/world kept a dead member: {world}")
+        fams = await scrape()
+        if sample(fams, "train_replicas", "train_replicas",
+                  state="ready") != 1:
+            failures.append("train_replicas[ready] != 1 after death")
+        if sample(fams, "train_replicas", "train_replicas",
+                  state="dead") != 1:
+            failures.append("train_replicas[dead] != 1 after death")
+        if sample(fams, "train_restarts_total",
+                  "train_restarts_total") != 1:
+            failures.append(
+                "train_restarts_total != 1 after losing a member")
+        gen = sample(fams, "train_generation", "train_generation")
+        if gen is not None and gen < 3:
+            failures.append(
+                f"train_generation {gen} did not track two joins + "
+                "one death")
+    finally:
+        await client.close()
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
-    """Default: all three acts. `python -m ci.obs_check profile` runs
+    """Default: all four acts. `python -m ci.obs_check profile` runs
     only the serving step-anatomy act (`make profile-check`) — it is
     the only act that compiles jax programs, so the fast acts stay
     usable on their own."""
@@ -416,6 +513,7 @@ def main(argv: list[str] | None = None) -> int:
         "check": run_check,
         "profile": run_profile_check,
         "fleet": run_fleet_check,
+        "train": run_train_check,
     }
     wanted = argv or list(acts)
     unknown = [a for a in wanted if a not in acts]
@@ -432,9 +530,10 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     print(f"obs-check [{','.join(wanted)}]: /metrics strict-parses, "
           "/debug/traces is Chrome-trace-loadable (spans + counter "
-          "tracks), /debug/profile serves the step anatomy, and "
+          "tracks), /debug/profile serves the step anatomy, "
           "/fleet/metrics federates two replicas under the same "
-          "contract")
+          "contract, and the train_* catalog zero-seeds + tracks "
+          "membership")
     return 0
 
 

@@ -25,7 +25,8 @@ COMPONENTS: dict[str, dict[str, Any]] = {
                   "tests/test_decode_attention.py "
                   "tests/test_paged_attention_kernel.py "
                   "tests/test_checkpoint.py tests/test_llama_pp.py "
-                  "tests/test_lora.py tests/test_llama_moe.py -q"),
+                  "tests/test_lora.py tests/test_llama_moe.py "
+                  "tests/test_elastic.py -q"),
     },
     "controlplane": {
         "paths": ["kubeflow_tpu/api/**", "kubeflow_tpu/controlplane/**"],
@@ -471,6 +472,44 @@ def chaos_check_workflow() -> dict:
     }
 
 
+def train_check_workflow() -> dict:
+    """Elastic-training gate: `make train-check` runs the resize/ZeRO/
+    commit-marker suites, the train_* metric zero-seed check, and the
+    trainer chaos loadtest — a SIGKILL mid-step and another mid-
+    checkpoint-save, each gang required to auto-resume at N-1 replicas
+    from the last COMMITTED checkpoint with a loss curve matching the
+    fault-free oracle. Elasticity is a robustness claim; this keeps it
+    re-proven on every train/parallel/fleet change."""
+    return {
+        "name": "train check",
+        "on": {
+            "pull_request": {"paths": ["kubeflow_tpu/train/**",
+                                       "kubeflow_tpu/parallel/**",
+                                       "kubeflow_tpu/fleet/registry.py",
+                                       "loadtest/serving_loadtest.py",
+                                       "tests/test_elastic.py",
+                                       "tests/test_checkpoint.py",
+                                       "ci/obs_check.py",
+                                       "Makefile"]},
+            "push": {"branches": ["main"]},
+        },
+        "jobs": {
+            "train-check": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e .[ci] pytest"},
+                    {"name": "elastic suites + trainer chaos gate",
+                     "run": "make train-check",
+                     "env": {"JAX_PLATFORMS": "cpu"}},
+                ],
+            }
+        },
+    }
+
+
 def tenancy_check_workflow() -> dict:
     """Multi-tenant QoS gate: `make tenancy-check` runs the tenancy
     unit suite (fair-share math, preemption token-identity, prefix
@@ -601,6 +640,7 @@ def all_workflows() -> dict[str, dict]:
     out["serving_check.yaml"] = serving_check_workflow()
     out["fleet_check.yaml"] = fleet_check_workflow()
     out["chaos_check.yaml"] = chaos_check_workflow()
+    out["train_check.yaml"] = train_check_workflow()
     out["tenancy_check.yaml"] = tenancy_check_workflow()
     out["kernels_check.yaml"] = kernels_check_workflow()
     out["profile_check.yaml"] = profile_check_workflow()

@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax.numpy as jnp
 
 
@@ -24,10 +26,29 @@ def apply_rope(
     """Rotate (pairs-split convention: first half/second half, as Llama).
 
     fp32 sin/cos for precision; result cast back to x.dtype.
+
+    Implemented as elementwise multiplies plus a fixed signed
+    PERMUTATION gather along head_dim — deliberately no split/
+    concatenate. Under tensor parallelism the fused QKV projections
+    leave head_dim sharded whenever the head count doesn't divide the
+    tensor axis (e.g. 2 KV heads on tensor=4), and a concatenate whose
+    operands are sharded along the concat axis forces the SPMD
+    partitioner into "involuntary full rematerialization" — slow on
+    TPU, and numerically WRONG on the multi-device CPU backend (the
+    tensor-parallel parity bug: sharded generate emitted different
+    tokens from the first prefill token). Gathers with constant
+    indices partition cleanly; unsharded numerics are bit-identical
+    to the split/concat form.
     """
-    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [b, s, hd/2]
+    hd = x.shape[-1]
+    hd2 = hd // 2
+    idx = np.arange(hd)
+    angles = (positions[..., None].astype(jnp.float32)
+              * inv_freq[idx % hd2])             # [b, s, hd]
     sin = jnp.sin(angles)[:, :, None, :]
     cos = jnp.cos(angles)[:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-    return rotated.astype(x.dtype)
+    xf = x.astype(jnp.float32)
+    # rotate_half(x) = [-x2, x1]: partner index + sign, one gather.
+    rotated_half = xf[..., (idx + hd2) % hd] * np.where(
+        idx < hd2, -1.0, 1.0).astype(np.float32)
+    return (xf * cos + rotated_half * sin).astype(x.dtype)

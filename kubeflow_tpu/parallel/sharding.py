@@ -91,6 +91,90 @@ def shard_pytree_specs(rules: ShardingRules, logical: Any, mesh: Mesh) -> Any:
     )
 
 
+def _spec_axes(spec: P) -> set[str]:
+    """All mesh axis names a PartitionSpec already consumes."""
+    used: set[str] = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return used
+
+
+def zero_extend_spec(spec: P, shape: tuple[int, ...], mesh: Mesh,
+                     axis: str = mesh_lib.DATA_AXIS) -> P:
+    """Fold `axis` (default "data") into `spec`, ZeRO-style.
+
+    Optimizer moments normally mirror their parameter's sharding, which
+    leaves them REPLICATED over the data axis — every data-parallel
+    replica holds a full copy. ZeRO partitions that redundancy away:
+    extend the spec so the first dimension that (a) is divisible by the
+    axis size after any existing sharding and (b) doesn't already use
+    the axis, is additionally split over `axis`. XLA then materializes
+    the update as reduce-scatter(grads) + sharded-update + all-gather
+    (params) instead of an all-reduce plus N redundant updates.
+
+    Returns `spec` unchanged when the axis is absent/size-1, already
+    used, or no dimension divides — so data=1 meshes (all existing
+    tests) are exact no-ops.
+    """
+    if axis not in mesh.axis_names:
+        return spec
+    axis_size = mesh.shape[axis]
+    if axis_size <= 1 or axis in _spec_axes(spec):
+        return spec
+    entries: list[Any] = list(spec) + [None] * (len(shape) - len(spec))
+    for i, dim in enumerate(shape):
+        entry = entries[i]
+        if entry is None:
+            existing: tuple[str, ...] = ()
+        elif isinstance(entry, (tuple, list)):
+            existing = tuple(entry)
+        else:
+            existing = (entry,)
+        sharded_by = 1
+        for name in existing:
+            sharded_by *= mesh.shape.get(name, 1)
+        per_shard = dim // sharded_by if sharded_by and dim % sharded_by == 0 else 0
+        if per_shard and per_shard % axis_size == 0:
+            entries[i] = existing + (axis,) if existing else axis
+            return P(*entries)
+    return spec  # nothing divides (scalars, tiny leaves) — stay mirrored
+
+
+def zero_extend_sharding(sharding: NamedSharding, shape: tuple[int, ...],
+                         axis: str = mesh_lib.DATA_AXIS) -> NamedSharding:
+    """NamedSharding-level zero_extend_spec (same mesh, extended spec)."""
+    spec = zero_extend_spec(sharding.spec, shape, sharding.mesh, axis)
+    return NamedSharding(sharding.mesh, spec)
+
+
+def make_shard_and_gather_fns(shardings: Any):
+    """Per-leaf (shard_fns, gather_fns) for a pytree of NamedShardings.
+
+    shard_fns place a host/numpy leaf onto the mesh under its spec;
+    gather_fns pull a (possibly sharded) leaf back to a host array.
+    This is the checkpoint-resize bridge: gather under the OLD mesh,
+    shard under the NEW one — the two meshes never need to coexist
+    inside a single jit.
+    """
+    is_leaf = lambda x: isinstance(x, NamedSharding)  # noqa: E731
+
+    def make_shard(s: NamedSharding):
+        return lambda x: jax.device_put(x, s)
+
+    def make_gather(_s: NamedSharding):
+        return lambda x: jax.device_get(x)
+
+    return (
+        jax.tree.map(make_shard, shardings, is_leaf=is_leaf),
+        jax.tree.map(make_gather, shardings, is_leaf=is_leaf),
+    )
+
+
 def _filter_spec_to_mesh(spec: P) -> P:
     """Drop mesh axes the current context can't constrain.
 

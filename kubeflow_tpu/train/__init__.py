@@ -7,6 +7,13 @@ from kubeflow_tpu.train.trainer import (
     cross_entropy_loss,
 )
 from kubeflow_tpu.train.checkpoint import CheckpointConfig, Checkpointer
+from kubeflow_tpu.train.elastic import (
+    ElasticCoordinator,
+    WorkerConfig,
+    create_coordinator_app,
+    resize_state,
+    run_worker,
+)
 from kubeflow_tpu.train.lora import (
     LoraConfig,
     init_lora,

@@ -13,22 +13,40 @@ mesh wants it — no host-side gather, no resharding traffic on ICI.
 
 Layout per step: `<dir>/<step>/state/` (Orbax OCDBT tree) plus a
 `metadata` entry carrying the user-supplied run config for provenance.
+
+Crash safety (ISSUE 11): each fully-durable step dir additionally gets a
+`COMMITTED` marker, written only after the (possibly async) Orbax write
+has finished. Restore resolves "latest" through the markers, so a step
+dir left behind by a SIGKILL mid-save is SKIPPED with a log line instead
+of being restored half-written. Resize-on-restore: restore targets the
+CURRENT trainer's shardings, so a run saved at N virtual replicas (mesh
+data-axis size) restores cleanly at M != N — the saved replica count is
+recorded in run_metadata and the resize is logged.
 """
 
 from __future__ import annotations
 
+import atexit
 import dataclasses
+import logging
+import os
+import signal
+import time
 from typing import Any, Mapping
 
 import jax
 import orbax.checkpoint as ocp
 from etils import epath
 
+from kubeflow_tpu import obs
 from kubeflow_tpu.train.trainer import Trainer, TrainState
 
 STATE_ITEM = "state"
 META_ITEM = "run_metadata"
 DATA_ITEM = "data_state"
+COMMIT_MARKER = "COMMITTED"
+
+log = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,6 +64,12 @@ class CheckpointConfig:
     # the prepare -> train -> serve loop drops its tokenizer at the
     # last hop and text mode silently degrades to bytes.
     tokenizer_path: str = ""
+    # Register SIGTERM + atexit handlers that drain the async save
+    # queue (wait + close) before the process dies, so a preempted
+    # trainer's in-flight checkpoint still commits. Off by default:
+    # library users (tests, notebooks) shouldn't have their process
+    # signal disposition changed by constructing an object.
+    install_crash_handlers: bool = False
 
 
 class Checkpointer:
@@ -61,7 +85,8 @@ class Checkpointer:
     """
 
     def __init__(self, config: CheckpointConfig, trainer: Trainer,
-                 run_metadata: Mapping[str, Any] | None = None):
+                 run_metadata: Mapping[str, Any] | None = None,
+                 registry=None):
         self.config = config
         self.trainer = trainer
         self.run_metadata = dict(run_metadata or {})
@@ -74,6 +99,28 @@ class Checkpointer:
             config.directory, options=opts,
             item_names=(STATE_ITEM, META_ITEM, DATA_ITEM),
         )
+        self._pending_commits: set[int] = set()
+        self._closed = False
+        self._handlers_installed = False
+        reg = registry if registry is not None else obs.default_registry()
+        self.save_seconds = obs.get_or_create_histogram(
+            reg, "train_checkpoint_save_seconds",
+            "checkpoint save wall time (async: dispatch + previous-save "
+            "drain, not the device->disk copy itself)")
+        self.restore_seconds = obs.get_or_create_histogram(
+            reg, "train_checkpoint_restore_seconds",
+            "checkpoint restore wall time onto the current mesh "
+            "(includes cross-replica-count resharding on resize)")
+        self.save_seconds.seed()
+        self.restore_seconds.seed()
+        if config.install_crash_handlers:
+            self.install_crash_handlers()
+
+    @property
+    def virtual_replicas(self) -> int:
+        """The trainer mesh's data-axis size — the replica count a
+        checkpoint saved through this Checkpointer is stamped with."""
+        return int(self.trainer.mesh.shape.get("data", 1))
 
     # -- save ------------------------------------------------------------
 
@@ -84,18 +131,65 @@ class Checkpointer:
         the EXACT batch stream instead of restarting the epoch (the
         loaders' start_ticket kwarg is the other half)."""
         step = int(jax.device_get(state.step))
+        t0 = time.perf_counter()
+        # The previous async save is durable once wait() returns (Orbax
+        # serializes saves anyway, so this barrier is ~free) — only THEN
+        # may its COMMITTED marker appear.
+        self._flush_commits()
+        step_dir = epath.Path(self.config.directory) / str(step)
+        if step_dir.exists():
+            if self._is_committed(step):
+                # Replaying up to an already-durable step (post-restore
+                # catch-up) — nothing to write.
+                log.info("step %d already committed under %s — "
+                         "skipping save", step, self.config.directory)
+                return False
+            # Garbage from a crashed incarnation (its COMMITTED marker
+            # never appeared): clear it or Orbax refuses the step.
+            log.warning(
+                "removing stale uncommitted dir for step %d under %s "
+                "before re-save", step, self.config.directory)
+            step_dir.rmtree()
+            reload_fn = getattr(self._mgr, "reload", None)
+            if callable(reload_fn):
+                reload_fn()
+        meta = dict(self.run_metadata)
+        meta["virtual_replicas"] = self.virtual_replicas
         saved = self._mgr.save(
             step,
             args=ocp.args.Composite(**{
                 STATE_ITEM: ocp.args.StandardSave(_to_tree(state)),
-                META_ITEM: ocp.args.JsonSave(self.run_metadata),
+                META_ITEM: ocp.args.JsonSave(meta),
                 DATA_ITEM: ocp.args.JsonSave(dict(data_state or {})),
             }),
             force=force,
         )
-        if saved and self.config.tokenizer_path:
-            self._carry_tokenizer()
+        if saved:
+            if self.config.enable_async:
+                self._pending_commits.add(step)
+            else:
+                self._commit(step)
+            if self.config.tokenizer_path:
+                self._carry_tokenizer()
+            self.save_seconds.observe(time.perf_counter() - t0)
         return saved
+
+    def _commit(self, step: int) -> None:
+        marker = (epath.Path(self.config.directory) / str(step)
+                  / COMMIT_MARKER)
+        if marker.parent.exists():
+            marker.write_text(f"{step}\n")
+
+    def _flush_commits(self) -> None:
+        """Write COMMITTED markers for saves whose async write finished."""
+        if not self._pending_commits:
+            return
+        self._mgr.wait_until_finished()
+        on_disk = set(self._mgr.all_steps())
+        for step in sorted(self._pending_commits):
+            if step in on_disk:
+                self._commit(step)
+        self._pending_commits.clear()
 
     def _carry_tokenizer(self) -> None:
         """Copy the configured tokenizer to <dir>/tokenizer.json once
@@ -115,6 +209,29 @@ class Checkpointer:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def _is_committed(self, step: int) -> bool:
+        return (epath.Path(self.config.directory) / str(step)
+                / COMMIT_MARKER).exists()
+
+    def committed_steps(self) -> list[int]:
+        """Steps with a durable COMMITTED marker, ascending. Dirs left
+        by a crash mid-save carry no marker and are excluded (and
+        logged) — they are what restore must never touch."""
+        out: list[int] = []
+        for step in sorted(self._mgr.all_steps()):
+            if self._is_committed(step):
+                out.append(step)
+            else:
+                log.warning(
+                    "checkpoint step %d under %s has no %s marker "
+                    "(crash mid-save?) — skipping uncommitted step",
+                    step, self.config.directory, COMMIT_MARKER)
+        return out
+
+    def latest_committed_step(self) -> int | None:
+        steps = self.committed_steps()
+        return steps[-1] if steps else None
+
     def abstract_state(self) -> dict[str, Any]:
         """ShapeDtypeStructs + NamedShardings describing the state tree."""
         def abstr(leaf, sh):
@@ -127,19 +244,66 @@ class Checkpointer:
         )
 
     def restore(self, step: int | None = None) -> TrainState:
-        if step is None:
-            step = self.latest_step()
-        if step is None:
+        """Restore onto the CURRENT trainer's mesh/shardings.
+
+        `step=None` resolves through the COMMITTED markers and falls
+        back to the next-older committed step if the newest one fails
+        to deserialize (partial write that still got a dir); an
+        explicit `step` is restored exactly or raises. Works across
+        replica counts: Orbax reshards the saved global arrays onto
+        whatever NamedShardings `abstract_state()` carries now.
+        """
+        self._flush_commits()
+        if step is not None:
+            candidates = [step]
+        else:
+            candidates = list(reversed(self.committed_steps()))
+            if not candidates and self.latest_step() is not None:
+                raise FileNotFoundError(
+                    f"checkpoints exist under {self.config.directory} "
+                    "but none carry a COMMITTED marker — all were "
+                    "interrupted mid-save")
+        if not candidates:
             raise FileNotFoundError(
                 f"no checkpoint under {self.config.directory}"
             )
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(**{
-                STATE_ITEM: ocp.args.StandardRestore(self.abstract_state()),
-            }),
-        )
-        return _from_tree(restored[STATE_ITEM])
+        t0 = time.perf_counter()
+        last_err: Exception | None = None
+        for i, cand in enumerate(candidates):
+            try:
+                restored = self._mgr.restore(
+                    cand,
+                    args=ocp.args.Composite(**{
+                        STATE_ITEM: ocp.args.StandardRestore(
+                            self.abstract_state()),
+                    }),
+                )
+            except Exception as e:  # noqa: BLE001 — fall back, then re-raise
+                last_err = e
+                if step is not None or i + 1 >= len(candidates):
+                    raise
+                log.warning(
+                    "committed checkpoint step %d failed to restore "
+                    "(%s) — falling back to step %d",
+                    cand, e, candidates[i + 1])
+                continue
+            self.restore_seconds.observe(time.perf_counter() - t0)
+            self._log_resize(cand)
+            return _from_tree(restored[STATE_ITEM])
+        raise last_err  # pragma: no cover — loop always returns/raises
+
+    def _log_resize(self, step: int) -> None:
+        try:
+            meta = self.restore_metadata(step)
+        except Exception:  # noqa: BLE001 — provenance only, never fatal
+            return
+        saved = meta.get("virtual_replicas")
+        if saved and int(saved) != self.virtual_replicas:
+            log.info(
+                "resize-on-restore: step %d was saved at %d virtual "
+                "replicas, restored at %d (optimizer state re-partitioned "
+                "over the new data axis)",
+                step, int(saved), self.virtual_replicas)
 
     def _restore_json_item(self, item: str, step: int | None,
                            *, missing_ok: bool) -> dict[str, Any]:
@@ -178,19 +342,63 @@ class Checkpointer:
         return self._restore_json_item(DATA_ITEM, step, missing_ok=True)
 
     def restore_or_init(self, rng: jax.Array) -> TrainState:
-        """The resume entry point: latest checkpoint if present, else init."""
-        if self.latest_step() is not None:
+        """The resume entry point: latest COMMITTED checkpoint if
+        present, else fresh init (a directory holding only interrupted
+        saves logs and initializes rather than crash-looping)."""
+        self._flush_commits()
+        if self.latest_committed_step() is not None:
             return self.restore()
+        if self.latest_step() is not None:
+            log.warning(
+                "no committed checkpoint under %s (only interrupted "
+                "saves) — initializing fresh state",
+                self.config.directory)
         return self.trainer.init(rng)
 
     # -- lifecycle -------------------------------------------------------
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
+        self._flush_commits()
 
     def close(self) -> None:
+        if self._closed:
+            return
         self._mgr.wait_until_finished()
+        self._flush_commits()
         self._mgr.close()
+        self._closed = True
+
+    def install_crash_handlers(self) -> None:
+        """Drain + commit on SIGTERM and interpreter exit, chaining any
+        prior SIGTERM disposition. Idempotent. A SIGKILL (the chaos
+        harness's weapon) of course bypasses this — that is what the
+        COMMITTED markers are for."""
+        if self._handlers_installed:
+            return
+        self._handlers_installed = True
+        atexit.register(self._drain_quietly)
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _on_sigterm(signum, frame):
+            self._drain_quietly()
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        try:
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            # not the main thread — atexit alone still drains
+            log.debug("SIGTERM handler not installed (non-main thread)")
+
+    def _drain_quietly(self) -> None:
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — dying anyway; don't mask the signal
+            log.exception("checkpoint drain on shutdown failed")
 
 
 def _to_tree(state) -> dict[str, Any]:

@@ -1,0 +1,617 @@
+"""Elastic fault-tolerant trainer fleet: membership, resize, restart.
+
+The serving fleet already knows how to keep a replica set alive
+(fleet.registry: heartbeats, staleness sweep, dead detection). This
+module points that same machinery at TRAINER replicas and closes the
+loop the paper's trainer story needs: when a trainer dies mid-run, the
+surviving replicas restart from the last COMMITTED checkpoint at the
+new replica count — resize-on-restore (train.checkpoint) re-partitions
+the ZeRO-sharded optimizer state over the smaller (or larger) data
+axis, and training continues with identical global math.
+
+Roles:
+  * `ElasticCoordinator` — wraps a ReplicaRegistry; trainers register/
+    heartbeat with (step, loss, phase); the coordinator decides the
+    surviving world and stamps it with a monotonically increasing
+    `generation`. Any membership change bumps the generation; losing a
+    previously-live member also counts a restart (the survivors will
+    restart from checkpoint). Exposes `train_replicas{state}`,
+    `train_restarts_total` and `train_generation` on its registry.
+  * `create_coordinator_app` — the aiohttp surface (register/heartbeat/
+    world + /metrics) the worker subprocesses and the chaos harness
+    talk to.
+  * `run_worker` / `python -m kubeflow_tpu.train.elastic worker` — a
+    trainer replica: replicated execution (every worker computes the
+    full global step; the mesh's data axis tracks the live world size,
+    which is what ZeRO partitions over), chief-only checkpoint writes,
+    and in-process restart-from-checkpoint when the generation moves.
+  * `resize_state` — live cross-mesh resize without a disk round trip:
+    gather under the old trainer's mesh, shard under the new one
+    (parallel.sharding.make_shard_and_gather_fns).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+import time
+from typing import Any, Callable, Mapping
+
+from kubeflow_tpu import obs
+from kubeflow_tpu.controlplane.metrics import Counter, Gauge
+from kubeflow_tpu.fleet import registry as fleet_registry
+from kubeflow_tpu.fleet.registry import STATES, ReplicaRegistry
+
+log = logging.getLogger(__name__)
+
+LIVE_STATES = (fleet_registry.READY, fleet_registry.DEGRADED)
+
+# Heartbeat phases a trainer replica reports. "saving" matters to the
+# chaos harness: it is the window in which a SIGKILL lands mid-
+# checkpoint-save.
+PHASE_STEP = "step"
+PHASE_SAVING = "saving"
+PHASE_RESTORING = "restoring"
+PHASE_DONE = "done"
+
+
+class ElasticCoordinator:
+    """Decides the surviving trainer world from heartbeats.
+
+    Reuses ReplicaRegistry's staleness machinery verbatim; what it adds
+    is trainer-shaped stats (float loss, monotonic step, phase — the
+    registry's int-stat schema is serving-specific) and the generation/
+    restart bookkeeping the workers key their restarts off.
+    """
+
+    def __init__(self, *, min_replicas: int = 1,
+                 degraded_after_s: float = 6.0,
+                 dead_after_s: float = 20.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry=None):
+        self.min_replicas = int(min_replicas)
+        self._registry = ReplicaRegistry(
+            degraded_after_s=degraded_after_s,
+            dead_after_s=dead_after_s,
+            clock=clock,
+        )
+        self._lock = threading.Lock()
+        self._stats: dict[str, dict[str, Any]] = {}
+        self._members: tuple[str, ...] = ()
+        self._generation = 0
+        self.registry = registry if registry is not None \
+            else obs.default_registry()
+        self.replicas_gauge = self.registry.get("train_replicas")
+        if self.replicas_gauge is None:
+            self.replicas_gauge = Gauge(
+                "train_replicas",
+                "Trainer replicas by health state (heartbeat-driven; "
+                "dead replicas shrink the next generation's world)",
+                self.registry)
+        self.generation_gauge = self.registry.get("train_generation")
+        if self.generation_gauge is None:
+            self.generation_gauge = Gauge(
+                "train_generation",
+                "Monotonic world generation; bumps on any trainer "
+                "membership change", self.registry)
+        self.restarts_total = self.registry.get("train_restarts_total")
+        if self.restarts_total is None:
+            self.restarts_total = Counter(
+                "train_restarts_total",
+                "Fleet-wide restart-from-checkpoint events (a "
+                "previously-live trainer left the world)", self.registry)
+        for s in STATES:
+            self.replicas_gauge.set(0.0, state=s)
+        self.generation_gauge.set(0.0)
+        self.restarts_total.inc(0.0)
+        # The full train_* metric catalog lives on the coordinator's
+        # registry so one /metrics scrape sees every family zero-seeded
+        # (ci.obs_check train) even before any checkpoint I/O happened.
+        obs.get_or_create_histogram(
+            self.registry, "train_checkpoint_save_seconds",
+            "checkpoint save wall time (async: dispatch + previous-save "
+            "drain, not the device->disk copy itself)").seed()
+        obs.get_or_create_histogram(
+            self.registry, "train_checkpoint_restore_seconds",
+            "checkpoint restore wall time onto the current mesh "
+            "(includes cross-replica-count resharding on resize)").seed()
+
+    # -- membership ------------------------------------------------------
+
+    def register(self, replica_id: str, **stats) -> dict[str, Any]:
+        with self._lock:
+            self._registry.register(
+                f"trainer://{replica_id}", replica_id=replica_id,
+                models=("trainer",))
+            self._stats.setdefault(replica_id, {})
+            self._note(replica_id, stats)
+            self._recompute()
+            return self._world_locked()
+
+    def heartbeat(self, replica_id: str, **stats) -> bool:
+        """Refresh liveness + trainer stats. False for an unknown id —
+        the worker must re-register (coordinator restarted)."""
+        with self._lock:
+            known = self._registry.heartbeat(replica_id)
+            if known:
+                self._note(replica_id, stats)
+            self._recompute()
+            return known
+
+    def _note(self, replica_id: str, stats: Mapping[str, Any]) -> None:
+        slot = self._stats.setdefault(replica_id, {})
+        for key in ("step", "loss", "phase", "generation"):
+            if stats.get(key) is not None:
+                slot[key] = stats[key]
+
+    def sweep(self) -> None:
+        with self._lock:
+            self._recompute()
+
+    def _recompute(self) -> None:
+        self._registry.sweep()
+        live = tuple(sorted(
+            rep.id for rep in self._registry.replicas()
+            if rep.state in LIVE_STATES))
+        if live != self._members:
+            lost = set(self._members) - set(live)
+            self._generation += 1
+            if lost:
+                self.restarts_total.inc()
+                log.warning(
+                    "trainer world change: lost %s, generation %d -> "
+                    "world %s (survivors restart from last committed "
+                    "checkpoint)", sorted(lost), self._generation, live)
+            else:
+                log.info("trainer world grew to %s (generation %d)",
+                         live, self._generation)
+            self._members = live
+        for state, n in self._registry.counts().items():
+            self.replicas_gauge.set(float(n), state=state)
+        self.generation_gauge.set(float(self._generation))
+
+    # -- world view ------------------------------------------------------
+
+    def _world_locked(self, include_stats: bool = False) -> dict[str, Any]:
+        world: dict[str, Any] = {
+            "generation": self._generation,
+            "members": list(self._members),
+            "world_size": len(self._members),
+            "min_replicas": self.min_replicas,
+            "ready": len(self._members) >= self.min_replicas,
+            "chief": self._members[0] if self._members else None,
+            # per-member progress rides on every response: workers use
+            # it for soft lockstep (never run ahead of the slowest live
+            # member by more than a couple of steps)
+            "steps": {
+                rid: self._stats.get(rid, {}).get("step")
+                for rid in self._members
+            },
+            "phases": {
+                rid: self._stats.get(rid, {}).get("phase")
+                for rid in self._members
+            },
+        }
+        if include_stats:
+            world["replicas"] = {
+                rid: dict(self._stats.get(rid, {}))
+                for rid in self._members
+            }
+        return world
+
+    def world(self, include_stats: bool = False) -> dict[str, Any]:
+        with self._lock:
+            self._recompute()
+            return self._world_locked(include_stats)
+
+
+def create_coordinator_app(coord: ElasticCoordinator):
+    """The aiohttp surface workers and the chaos harness talk to."""
+    from aiohttp import web
+
+    from kubeflow_tpu.obs import endpoints as obs_endpoints
+
+    app = web.Application()
+
+    async def register(request):
+        body = await request.json()
+        world = coord.register(
+            str(body["replica_id"]),
+            step=body.get("step"), loss=body.get("loss"),
+            phase=body.get("phase"), generation=body.get("generation"))
+        return web.json_response(world)
+
+    async def heartbeat(request):
+        body = await request.json()
+        known = coord.heartbeat(
+            str(body["replica_id"]),
+            step=body.get("step"), loss=body.get("loss"),
+            phase=body.get("phase"), generation=body.get("generation"))
+        world = coord.world()
+        world["known"] = known
+        return web.json_response(world)
+
+    async def world(request):
+        return web.json_response(coord.world(include_stats=True))
+
+    app.router.add_post("/elastic/register", register)
+    app.router.add_post("/elastic/heartbeat", heartbeat)
+    app.router.add_get("/elastic/world", world)
+    obs_endpoints.mount_observability(
+        app, registry=coord.registry, tracer=obs.DEFAULT_TRACER)
+    return app
+
+
+# -- live cross-mesh resize ---------------------------------------------
+
+
+def resize_state(state, to_trainer):
+    """Re-partition a TrainState onto `to_trainer`'s mesh (e.g. a
+    different virtual-replica count) without a checkpoint round trip:
+    gather every leaf to host under the old mesh, then place it under
+    the new trainer's shardings. The two meshes never meet in one jit.
+    """
+    import jax
+
+    from kubeflow_tpu.parallel import sharding as sharding_lib
+
+    host = jax.tree.map(jax.device_get, state)
+    shard_fns, _ = sharding_lib.make_shard_and_gather_fns(
+        to_trainer.state_shardings)
+    return jax.tree.map(lambda fn, leaf: fn(leaf), shard_fns, host)
+
+
+# -- worker --------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerConfig:
+    coordinator_url: str
+    replica_id: str
+    ckpt_dir: str
+    total_steps: int = 16
+    save_every: int = 4
+    # 12 divides by every world size up to 4 (and 6): the global batch
+    # must shard over the data axis at EVERY size the world may shrink
+    # or grow to, or a resize would change the global math.
+    batch: int = 12
+    seq: int = 16
+    seed: int = 0
+    heartbeat_s: float = 0.05
+    # Chaos knob: sleep this long after dispatching a checkpoint save,
+    # BEFORE the COMMITTED marker can be written — widens the window in
+    # which a SIGKILL leaves an uncommitted step dir on disk.
+    slow_save_s: float = 0.0
+    loss_log: str = ""
+    join_timeout_s: float = 60.0
+
+
+class _CoordinatorClient:
+    """Tiny sync JSON client (urllib; workers have no aiohttp loop)."""
+
+    def __init__(self, base_url: str):
+        self.base = base_url.rstrip("/")
+
+    def _post(self, path: str, body: dict) -> dict:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.base + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read().decode())
+
+    def register(self, replica_id: str, **stats) -> dict:
+        return self._post("/elastic/register",
+                          {"replica_id": replica_id, **stats})
+
+    def heartbeat(self, replica_id: str, **stats) -> dict:
+        return self._post("/elastic/heartbeat",
+                          {"replica_id": replica_id, **stats})
+
+
+def _deterministic_batch(cfg_vocab: int, batch: int, seq: int, seed: int,
+                         step: int):
+    """The data stream is a pure function of (seed, step) so every
+    replica — and every post-restart incarnation at any world size —
+    sees the IDENTICAL global batch. That is what makes loss-curve
+    parity across elastic resizes a hard assertion instead of a vibe."""
+    import numpy as np
+
+    rng = np.random.default_rng((seed + 1) * 1_000_003 + step)
+    toks = rng.integers(0, cfg_vocab, (batch, seq))
+    tgts = rng.integers(0, cfg_vocab, (batch, seq))
+    return toks, tgts
+
+
+def _build_trainer(world_size: int, cfg):
+    import jax
+
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.parallel import MeshSpec, create_mesh
+    from kubeflow_tpu.train.trainer import TrainConfig, Trainer
+
+    devices = jax.devices()
+    if world_size > len(devices):
+        raise ValueError(
+            f"world size {world_size} exceeds {len(devices)} devices")
+    # data axis == world size over a device SUBSET (fsdp=1): any world
+    # size up to the device count forms a mesh, so a 3-replica world
+    # doesn't need to divide the 8 virtual devices.
+    mesh = create_mesh(MeshSpec(data=world_size, fsdp=1, tensor=1),
+                       devices=devices[:world_size])
+    return Trainer(
+        mesh=mesh,
+        apply_fn=lambda p, t: llama.apply(p, cfg, t),
+        init_fn=lambda k: llama.init(k, cfg),
+        logical_axes=llama.param_logical_axes(cfg),
+        train_config=TrainConfig(warmup_steps=2, total_steps=1000),
+    )
+
+
+class _Heartbeater(threading.Thread):
+    """Off-thread heartbeat loop: the training thread blocks for tens
+    of seconds inside the first (and first-post-resize) jit compile,
+    which must NOT read as death to the coordinator. The thread posts
+    the latest (step, loss, phase) snapshot every `interval` and keeps
+    the freshest world view for the training loop to poll locally."""
+
+    def __init__(self, client: _CoordinatorClient, replica_id: str,
+                 interval: float, world: dict[str, Any]):
+        super().__init__(daemon=True, name=f"heartbeat-{replica_id}")
+        self.client = client
+        self.replica_id = replica_id
+        self.interval = interval
+        self.status: dict[str, Any] = {"phase": PHASE_RESTORING}
+        self.world = world
+        self._stop = threading.Event()
+
+    def update(self, **status) -> None:
+        self.status = {**self.status, **status}
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            snap = dict(self.status)
+            try:
+                w = self.client.heartbeat(self.replica_id, **snap)
+                if not w.get("known"):
+                    w = self.client.register(self.replica_id, **snap)
+                self.world = w
+            except Exception as e:  # noqa: BLE001 — transient; keep beating
+                log.debug("heartbeat failed: %s", e)
+            self._stop.wait(self.interval)
+
+
+def run_worker(wc: WorkerConfig) -> dict[str, Any]:
+    """A trainer replica under the elastic coordinator.
+
+    Replicated execution: each worker computes the full global step on
+    its own (virtual) device set, with the mesh's data axis sized to
+    the live world — the single-process stand-in for one slice of a
+    multi-host data-parallel gang, faithful to the resize semantics
+    (the data axis IS the replica count ZeRO partitions over). The
+    chief (lowest live id) alone writes checkpoints; every generation
+    bump triggers restart-from-last-committed at the new world size.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.train.checkpoint import (
+        CheckpointConfig, Checkpointer,
+    )
+
+    cfg = llama.LLAMA_TINY
+    client = _CoordinatorClient(wc.coordinator_url)
+    loss_f = open(wc.loss_log, "a", buffering=1) if wc.loss_log else None
+
+    def log_loss(step: int, loss: float, generation: int) -> None:
+        if loss_f is not None:
+            loss_f.write(json.dumps({
+                "replica": wc.replica_id, "step": step, "loss": loss,
+                "generation": generation}) + "\n")
+
+    world = client.register(wc.replica_id, phase=PHASE_RESTORING)
+    hb = _Heartbeater(client, wc.replica_id, wc.heartbeat_s, world)
+    hb.start()
+    deadline = time.monotonic() + wc.join_timeout_s
+    while not hb.world.get("ready"):
+        if time.monotonic() > deadline:
+            hb.stop()
+            raise TimeoutError(
+                f"world never reached min_replicas="
+                f"{hb.world.get('min_replicas')}: {hb.world}")
+        time.sleep(wc.heartbeat_s)
+    world = hb.world
+
+    generation = world["generation"]
+    restores = 0
+    corrupt_restores = 0
+    trainer = ckpt = state = None
+    last_loss = float("nan")
+    last_saved = -1
+
+    def rebuild(world_size: int):
+        nonlocal trainer, ckpt, state, restores, last_saved
+        last_saved = -1
+        if ckpt is not None:
+            ckpt.close()
+        trainer = _build_trainer(world_size, cfg)
+        ckpt = Checkpointer(
+            CheckpointConfig(
+                wc.ckpt_dir, save_interval_steps=wc.save_every,
+                enable_async=True, install_crash_handlers=True),
+            trainer,
+            run_metadata={"replica": wc.replica_id},
+        )
+        state = ckpt.restore_or_init(jax.random.key(wc.seed))
+        restores += 1
+
+    try:
+        rebuild(world["world_size"])
+    except Exception:
+        corrupt_restores += 1
+        hb.stop()
+        raise
+    log.info("worker %s joined generation %d at world %d, step %d",
+             wc.replica_id, generation, world["world_size"],
+             int(jax.device_get(state.step)))
+
+    def others_behind(world, my_step: int, lag: int = 2) -> bool:
+        """Soft lockstep: don't run more than `lag` steps ahead of the
+        slowest LIVE member (a restoring survivor re-winds to the last
+        committed step; the gang waits for it exactly like a real
+        collective would)."""
+        steps = [s for rid, s in world.get("steps", {}).items()
+                 if rid != wc.replica_id and s is not None]
+        return bool(steps) and min(steps) < my_step - lag
+
+    while True:
+        step = int(jax.device_get(state.step))
+        if step >= wc.total_steps:
+            break
+        hb.update(step=step, loss=last_loss, phase=PHASE_STEP,
+                  generation=generation)
+        world = hb.world
+        if world["generation"] == generation and \
+                others_behind(world, step):
+            time.sleep(wc.heartbeat_s)
+            continue
+        # `ready` gated only initial formation: a world that shrank
+        # BELOW min_replicas still continues (that is the point of
+        # elasticity) as long as anyone is left.
+        if world["generation"] != generation and world["world_size"] >= 1:
+            generation = world["generation"]
+            log.warning(
+                "worker %s: generation %d, world -> %s; restarting "
+                "from last committed checkpoint at %d replicas",
+                wc.replica_id, generation, world["members"],
+                world["world_size"])
+            hb.update(phase=PHASE_RESTORING, generation=generation)
+            try:
+                rebuild(world["world_size"])
+            except Exception:
+                corrupt_restores += 1
+                hb.stop()
+                raise
+            continue
+        toks, tgts = _deterministic_batch(
+            cfg.vocab_size, wc.batch, wc.seq, wc.seed, step)
+        state, loss = trainer.step(
+            state, jnp.asarray(toks, jnp.int32),
+            jnp.asarray(tgts, jnp.int32))
+        last_loss = float(jax.device_get(loss))
+        step = int(jax.device_get(state.step))
+        log_loss(step, last_loss, generation)
+        chief = world.get("chief") == wc.replica_id
+        if chief and step % wc.save_every == 0 and step != last_saved:
+            hb.update(step=step, loss=last_loss, phase=PHASE_SAVING,
+                      generation=generation)
+            ckpt.save(state, force=True)
+            last_saved = step
+            if wc.slow_save_s > 0:
+                # Chaos window: the save is dispatched but its
+                # COMMITTED marker cannot appear until the next
+                # save/wait — a SIGKILL in here is a mid-save crash.
+                time.sleep(wc.slow_save_s)
+            hb.update(phase=PHASE_STEP)
+
+    final_step = int(jax.device_get(state.step))
+    hb.update(step=final_step, loss=last_loss, phase=PHASE_DONE,
+              generation=generation)
+    world = hb.world
+    if world.get("chief") == wc.replica_id and final_step != last_saved:
+        ckpt.save(state, force=True)
+    ckpt.close()  # drains async saves + writes COMMITTED markers
+    # Drain barrier: keep heartbeating until every live member reports
+    # done — vanishing the moment WE finish would read as a death to a
+    # straggler (soft lockstep keeps the skew to a couple of steps, so
+    # this is brief).
+    drain_deadline = time.monotonic() + wc.join_timeout_s
+    while time.monotonic() < drain_deadline:
+        world = hb.world
+        steps = world.get("steps", {})
+        if all(s is not None and s >= wc.total_steps
+               for s in steps.values()):
+            break
+        time.sleep(wc.heartbeat_s)
+    hb.stop()
+    result = {
+        "replica": wc.replica_id,
+        "final_step": final_step,
+        "final_loss": last_loss,
+        "generation": generation,
+        "restores": restores,
+        "corrupt_restores": corrupt_restores,
+        "world_size": world["world_size"],
+    }
+    if loss_f is not None:
+        loss_f.close()
+    return result
+
+
+# -- CLI -----------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="elastic trainer fleet: coordinator / worker roles")
+    parser.add_argument("role", choices=("coordinator", "worker"))
+    parser.add_argument("--port", type=int, default=0,
+                        help="coordinator listen port")
+    parser.add_argument("--min-replicas", type=int, default=1)
+    parser.add_argument("--degraded-after-s", type=float, default=1.0)
+    parser.add_argument("--dead-after-s", type=float, default=2.0)
+    parser.add_argument("--coordinator", default="",
+                        help="worker: coordinator base URL")
+    parser.add_argument("--replica-id", default="trainer-0")
+    parser.add_argument("--ckpt-dir", default="")
+    parser.add_argument("--steps", type=int, default=16)
+    parser.add_argument("--save-every", type=int, default=4)
+    parser.add_argument("--batch", type=int, default=12)
+    parser.add_argument("--seq", type=int, default=16)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--slow-save-s", type=float, default=0.0)
+    parser.add_argument("--loss-log", default="")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    if args.role == "coordinator":
+        from aiohttp import web
+
+        coord = ElasticCoordinator(
+            min_replicas=args.min_replicas,
+            degraded_after_s=args.degraded_after_s,
+            dead_after_s=args.dead_after_s,
+        )
+        web.run_app(create_coordinator_app(coord), port=args.port,
+                    print=None)
+        return 0
+    if not args.coordinator or not args.ckpt_dir:
+        parser.error("worker needs --coordinator and --ckpt-dir")
+    result = run_worker(WorkerConfig(
+        coordinator_url=args.coordinator,
+        replica_id=args.replica_id,
+        ckpt_dir=args.ckpt_dir,
+        total_steps=args.steps,
+        save_every=args.save_every,
+        batch=args.batch,
+        seq=args.seq,
+        seed=args.seed,
+        slow_save_s=args.slow_save_s,
+        loss_log=args.loss_log,
+    ))
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -211,6 +211,48 @@ web.run_app(app, host="127.0.0.1", port={port}, print=None)
 '''
 
 
+# Elastic-training coordinator: the trainer-fleet membership plane.
+# Fast staleness windows (vs the prod 6s/20s defaults) so a SIGKILLed
+# worker is declared dead — and the survivors' generation bumps —
+# within a couple of seconds of the fault.
+TRAIN_COORDINATOR_CODE = r'''
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+from aiohttp import web
+from kubeflow_tpu.train.elastic import (
+    ElasticCoordinator, create_coordinator_app,
+)
+coord = ElasticCoordinator(min_replicas={min_replicas},
+                           degraded_after_s={degraded_s},
+                           dead_after_s={dead_s})
+web.run_app(create_coordinator_app(coord), host="127.0.0.1",
+            port={port}, print=None)
+'''
+
+# One elastic trainer worker. 8 virtual CPU devices so any live world
+# size up to 8 can form a mesh (the worker takes a device SUBSET sized
+# to the world). RESULT line is the harness's per-worker oracle:
+# final_step / restores / corrupt_restores / world_size.
+TRAIN_WORKER_CODE = r'''
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, {repo!r})
+import json
+from kubeflow_tpu.train.elastic import WorkerConfig, run_worker
+result = run_worker(WorkerConfig(
+    coordinator_url={coordinator!r},
+    replica_id={rid!r},
+    ckpt_dir={ckpt!r},
+    total_steps={steps},
+    save_every={save_every},
+    slow_save_s={slow_save_s},
+    loss_log={loss_log!r}))
+print("RESULT " + json.dumps(result), flush=True)
+'''
+
+
 def _get_json(url: str, timeout: float = 5.0) -> dict:
     with urllib.request.urlopen(url, timeout=timeout) as r:
         return json.loads(r.read())
@@ -782,6 +824,315 @@ def run_chaos(clients: int, requests: int, max_new: int, *,
                 p.wait()
 
 
+def _train_arm(workdir: str, *, replicas: int, steps: int,
+               save_every: int, kill: str | None,
+               slow_save_s: float) -> dict:
+    """One elastic-training gang: a coordinator + `replicas` workers on
+    a shared checkpoint dir. `kill` selects the fault:
+
+    - None: fault-free run (the loss oracle).
+    - "mid-step": SIGKILL a NON-chief worker once every member is past
+      2*save_every+1 (so a committed resume point exists) while it is
+      between checkpoints.
+    - "mid-save": give the CHIEF a widened post-dispatch save window
+      (slow_save_s) and SIGKILL it while /elastic/world shows its phase
+      == "saving" — the step dir exists on disk but its COMMITTED
+      marker cannot have landed, so the survivors must detect the
+      partial save, fall back to the last committed step, and re-save
+      over the stale dir.
+
+    Survivors must run to `steps` at world N-1 with zero corrupt
+    restores. Returns per-worker RESULT dicts, the merged step->loss
+    curve (last write wins — replays after a restore overwrite), and
+    the coordinator's restart counter.
+    """
+    os.makedirs(workdir, exist_ok=True)
+    port = free_port()
+    base = f"http://127.0.0.1:{port}"
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    rids = [f"tr{i}" for i in range(replicas)]
+    chief_rid, victim_rid = rids[0], rids[-1]
+    if kill == "mid-save":
+        victim_rid = chief_rid
+    logs = {rid: os.path.join(workdir, f"{rid}.log") for rid in rids}
+    loss_logs = {rid: os.path.join(workdir, f"{rid}.loss.jsonl")
+                 for rid in rids}
+    coord_log = open(os.path.join(workdir, "coord.log"), "w")
+    procs: dict[str, subprocess.Popen] = {}
+    worker_logs: dict[str, object] = {}
+    try:
+        coord = subprocess.Popen(
+            [sys.executable, "-c",
+             TRAIN_COORDINATOR_CODE.format(
+                 repo=REPO, port=port, min_replicas=replicas,
+                 degraded_s=1.0, dead_s=2.5)],
+            stdout=coord_log, stderr=subprocess.STDOUT)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                _get_json(f"{base}/elastic/world")
+                break
+            except Exception:
+                if coord.poll() is not None:
+                    raise RuntimeError(
+                        f"train coordinator died rc={coord.poll()}")
+                time.sleep(0.2)
+        else:
+            raise RuntimeError("train coordinator never came up")
+        for rid in rids:
+            f = open(logs[rid], "w")
+            worker_logs[rid] = f
+            procs[rid] = subprocess.Popen(
+                [sys.executable, "-c",
+                 TRAIN_WORKER_CODE.format(
+                     repo=REPO, coordinator=base, rid=rid,
+                     ckpt=ckpt_dir, steps=steps, save_every=save_every,
+                     slow_save_s=(slow_save_s if rid == victim_rid
+                                  and kill == "mid-save" else 0.0),
+                     loss_log=loss_logs[rid])],
+                stdout=f, stderr=subprocess.STDOUT)
+
+        def world() -> dict:
+            return _get_json(f"{base}/elastic/world")
+
+        def tail(rid: str) -> str:
+            worker_logs[rid].flush()
+            with open(logs[rid]) as f:
+                return "\n".join(f.read().splitlines()[-25:])
+
+        # formation: every worker registered and stepping (first jit
+        # compile takes tens of seconds on CPU — the background
+        # heartbeater keeps them alive through it)
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            w = world()
+            if w["world_size"] == replicas and w["ready"]:
+                break
+            dead = [r for r, p in procs.items() if p.poll() is not None]
+            if dead:
+                raise RuntimeError(
+                    f"worker(s) {dead} died during formation:\n"
+                    + tail(dead[0]))
+            time.sleep(0.3)
+        else:
+            raise AssertionError(
+                f"gang never formed at {replicas} replicas: {world()}")
+
+        killed_at = None
+        if kill is not None:
+            # Arm the fault one save interval in: the first save is
+            # dispatched (its COMMITTED marker flushes when the
+            # surviving chief's rebuild() closes the old checkpointer),
+            # and — critically — EARLY enough that the survivors hit
+            # the soft-lockstep wall (kill_step + lag + 1 < steps) and
+            # are still mid-run when dead-detection bumps the
+            # generation. Killing later lets a fast survivor finish
+            # before the restart fires and the arm proves nothing.
+            resume_floor = save_every
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline:
+                w = world()
+                step_map = w.get("steps", {})
+                phases = w.get("phases", {})
+                if kill == "mid-step":
+                    if step_map and all(
+                            s is not None and s >= resume_floor
+                            for s in step_map.values()):
+                        break
+                else:  # mid-save: catch the chief inside the window
+                    if (phases.get(victim_rid) == "saving"
+                            and (step_map.get(victim_rid) or 0)
+                            >= 2 * save_every):
+                        break
+                if procs[victim_rid].poll() is not None:
+                    raise RuntimeError(
+                        f"victim {victim_rid} exited before the kill:\n"
+                        + tail(victim_rid))
+                time.sleep(0.02)
+            else:
+                raise AssertionError(
+                    f"{kill} kill window never opened: {world()}")
+            if kill == "mid-save":
+                # Let the async writer get the step dir onto disk
+                # first — the COMMITTED marker still cannot appear
+                # until the NEXT save's flush, so this lands the kill
+                # in the worst spot: bytes present, marker absent. The
+                # survivor must skip the uncommitted dir at restore and
+                # re-save over it.
+                time.sleep(slow_save_s * 0.6)
+            procs[victim_rid].kill()
+            procs[victim_rid].wait()
+            killed_at = dict(world().get("steps", {}))
+
+        survivors = [r for r in rids if r != victim_rid or kill is None]
+        deadline = time.monotonic() + 300
+        for rid in survivors:
+            remaining = max(1.0, deadline - time.monotonic())
+            try:
+                procs[rid].wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                raise AssertionError(
+                    f"survivor {rid} hung after the {kill} kill "
+                    f"(world {world()}):\n" + tail(rid))
+            if procs[rid].returncode != 0:
+                raise AssertionError(
+                    f"survivor {rid} exited rc={procs[rid].returncode} "
+                    f"after the {kill} kill:\n" + tail(rid))
+
+        results = {}
+        for rid in survivors:
+            worker_logs[rid].flush()
+            with open(logs[rid]) as f:
+                lines = [ln for ln in f.read().splitlines()
+                         if ln.startswith("RESULT ")]
+            if not lines:
+                raise AssertionError(
+                    f"worker {rid} printed no RESULT line:\n"
+                    + tail(rid))
+            results[rid] = json.loads(lines[-1][len("RESULT "):])
+
+        # merged loss curve: later lines overwrite (a replay after a
+        # restore re-runs steps — determinism means the overwrite is a
+        # no-op up to resharding noise, which the parity gate bounds)
+        losses: dict[int, float] = {}
+        for rid in rids:
+            if not os.path.exists(loss_logs[rid]):
+                continue
+            with open(loss_logs[rid]) as f:
+                for ln in f:
+                    rec = json.loads(ln)
+                    losses[int(rec["step"])] = float(rec["loss"])
+
+        fams = _scrape_metrics(base)
+        restarts = sum(
+            fams["train_restarts_total"]["samples"].values())
+        committed = sorted(
+            int(d) for d in os.listdir(ckpt_dir)
+            if d.isdigit() and os.path.exists(
+                os.path.join(ckpt_dir, d, "COMMITTED")))
+        uncommitted = sorted(
+            int(d) for d in os.listdir(ckpt_dir)
+            if d.isdigit() and not os.path.exists(
+                os.path.join(ckpt_dir, d, "COMMITTED")))
+        return {
+            "results": results,
+            "losses": losses,
+            "restarts": restarts,
+            "killed_at": killed_at,
+            "victim": victim_rid if kill else None,
+            "committed_steps": committed,
+            "uncommitted_steps": uncommitted,
+        }
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        coord.terminate()
+        try:
+            coord.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            coord.kill()
+            coord.wait()
+        coord_log.close()
+        for f in worker_logs.values():
+            f.close()
+
+
+def run_train_chaos(*, replicas: int = 2, steps: int = 8,
+                    save_every: int = 2,
+                    slow_save_s: float = 1.5) -> dict:
+    """The elastic-training fault-injection run. Three gangs on fresh
+    checkpoint dirs: a fault-free single-replica oracle for the loss
+    curve, then a mid-step SIGKILL of a non-chief worker, then a
+    mid-checkpoint-save SIGKILL of the chief. Each chaos gang must
+    auto-resume at replicas-1 from the last COMMITTED checkpoint, run
+    to completion with zero corrupt restores, and reproduce the
+    oracle's loss curve step-for-step (replicated execution makes the
+    global batch a pure function of (seed, step), so parity is a hard
+    assertion, not a similarity score)."""
+    import tempfile
+
+    if replicas < 2:
+        raise ValueError("train chaos needs >= 2 replicas "
+                         "(one to kill, one to survive)")
+    root = tempfile.mkdtemp(prefix="kftpu-trainchaos-")
+    t0 = time.perf_counter()
+    try:
+        oracle = _train_arm(
+            os.path.join(root, "oracle"), replicas=1, steps=steps,
+            save_every=save_every, kill=None, slow_save_s=0.0)
+        scenarios = {}
+        for kill in ("mid-step", "mid-save"):
+            arm = _train_arm(
+                os.path.join(root, kill), replicas=replicas,
+                steps=steps, save_every=save_every, kill=kill,
+                slow_save_s=slow_save_s)
+            for rid, res in arm["results"].items():
+                if res["final_step"] != steps:
+                    raise AssertionError(
+                        f"{kill}: survivor {rid} stopped at step "
+                        f"{res['final_step']} != {steps}")
+                if res["corrupt_restores"] != 0:
+                    raise AssertionError(
+                        f"{kill}: survivor {rid} hit "
+                        f"{res['corrupt_restores']} corrupt restores")
+                if res["world_size"] != replicas - 1:
+                    raise AssertionError(
+                        f"{kill}: survivor {rid} finished at world "
+                        f"{res['world_size']} != {replicas - 1}")
+                if res["restores"] < 2:
+                    raise AssertionError(
+                        f"{kill}: survivor {rid} never restarted "
+                        f"(restores={res['restores']})")
+            if arm["restarts"] < 1:
+                raise AssertionError(
+                    f"{kill}: coordinator counted no restarts")
+            missing = [s for s in range(1, steps + 1)
+                       if s not in arm["losses"]]
+            if missing:
+                raise AssertionError(
+                    f"{kill}: loss curve has holes at steps {missing}")
+            div = max(abs(arm["losses"][s] - oracle["losses"][s])
+                      for s in range(1, steps + 1))
+            if div > 5e-4:
+                raise AssertionError(
+                    f"{kill}: loss curve diverged from the fault-free "
+                    f"oracle by {div} (> 5e-4)")
+            scenarios[kill.replace("-", "_")] = {
+                "victim": arm["victim"],
+                "killed_at_steps": arm["killed_at"],
+                "survivor_world_size": replicas - 1,
+                "restarts": arm["restarts"],
+                "restores": {rid: r["restores"]
+                             for rid, r in arm["results"].items()},
+                "committed_steps": arm["committed_steps"],
+                "uncommitted_steps": arm["uncommitted_steps"],
+                "max_loss_divergence": div,
+            }
+        wall = time.perf_counter() - t0
+        return {
+            "metric": "train_chaos",
+            "mode": "train-chaos",
+            "replicas": replicas,
+            "steps": steps,
+            "save_every": save_every,
+            "slow_save_s": slow_save_s,
+            "oracle_final_loss": oracle["losses"][steps],
+            "scenarios": scenarios,
+            "corrupt_restores": 0,
+            "wall_s": round(wall, 2),
+        }
+    finally:
+        import shutil
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _tenant_arm(qos: bool, *, bulk_clients: int, live_requests: int,
                 bulk_prompt_len: int, prefill_chunk_tokens: int,
                 bulk_max_new: int, live_max_new: int,
@@ -1191,8 +1542,24 @@ def main() -> int:
     p.add_argument("--batch-window-ms", type=int, default=5)
     p.add_argument("--mode",
                    choices=("window", "continuous", "fleet", "tenants",
-                            "chaos"),
+                            "chaos", "train-chaos"),
                    default="window")
+    p.add_argument("--train-replicas", type=int, default=2,
+                   help="train-chaos mode: trainer gang size (one "
+                        "worker is SIGKILLed; the rest must finish at "
+                        "N-1)")
+    p.add_argument("--train-steps", type=int, default=8,
+                   help="train-chaos mode: total optimizer steps per "
+                        "gang")
+    p.add_argument("--train-save-every", type=int, default=2,
+                   help="train-chaos mode: checkpoint interval in "
+                        "steps (the kill arms after 2 intervals so a "
+                        "COMMITTED resume point exists)")
+    p.add_argument("--train-slow-save-s", type=float, default=1.5,
+                   help="train-chaos mode: post-dispatch sleep on the "
+                        "chief's save path — widens the window where a "
+                        "SIGKILL lands between the checkpoint write "
+                        "and its COMMITTED marker")
     p.add_argument("--chaos-seed", type=int, default=1,
                    help="chaos mode: fault-plan seed (same seed, same "
                         "fault sequence)")
@@ -1296,6 +1663,19 @@ def main() -> int:
             delay_rate=args.chaos_delay_rate,
             duplicate_rate=args.chaos_duplicate_rate,
             blackhole_beats=args.chaos_blackhole_beats)
+    elif args.mode == "train-chaos":
+        if args.train_replicas < 2:
+            p.error("--train-replicas must be >= 2 (one to kill, one "
+                    "to survive)")
+        if args.train_steps < 2 * args.train_save_every + 4:
+            p.error("--train-steps must leave room for the survivors "
+                    "to be mid-run when dead-detection fires "
+                    "(>= 2*save_every + 4)")
+        result = run_train_chaos(
+            replicas=args.train_replicas,
+            steps=args.train_steps,
+            save_every=args.train_save_every,
+            slow_save_s=args.train_slow_save_s)
     elif args.mode == "tenants":
         if args.tenant_bulk_clients < 1:
             p.error("--tenant-bulk-clients must be >= 1")
