@@ -1190,6 +1190,59 @@ def bench_decode_paged_kernel(*, b: int, n_q: int, n_kv: int, hd: int,
     }
 
 
+def bench_serving_disagg(*, clients: int = 12, requests: int = 48,
+                         max_new: int = 16,
+                         verbose: bool = True) -> dict:
+    """Disaggregated prefill/decode pools vs an equal-total symmetric
+    fleet (ISSUE 12), measured by the loadtest's `--mode disagg` A/B:
+    real router + replica subprocesses, mixed long-prompt/short-decode
+    traffic, cross-arm token parity, and a SIGKILLed prefill replica
+    after the timed window. Headline = the disagg arm's aggregate
+    client tokens/s; vs_baseline = disagg/symmetric (> 1 == the split
+    fleet out-served the same replica count mixed)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serving_loadtest",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "loadtest", "serving_loadtest.py"))
+    lt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lt)
+    r = lt.run_disagg(clients, requests, max_new)
+    if r["client_failures"] or not r["token_parity"]:
+        raise RuntimeError(
+            f"disagg A/B failed its own bars: failures="
+            f"{r['client_failures']} parity={r['token_parity']}")
+    gen = detect_generation()
+    if verbose:
+        print(f"# serving-disagg pools={r['prefill_replicas']}p+"
+              f"{r['decode_replicas']}d tok/s={r['tokens_per_sec']} "
+              f"(symmetric {r['symmetric_tokens_per_sec']}) "
+              f"speedup={r['disagg_speedup']} "
+              f"handoff={r['handoff']}", file=sys.stderr)
+    return {
+        "metric": f"serving_disagg_tokens_per_sec[tiny,{gen}]",
+        "value": r["tokens_per_sec"],
+        "unit": "tokens/s",
+        "vs_baseline": r["disagg_speedup"],
+        "extra_metrics": [
+            # informational ("x"), not gated: on a shared/1-core CI
+            # host the 5-subprocess A/B is scheduling-noise-dominated
+            # and the ratio swings well past the gate tolerance; the
+            # parity and zero-failure bars above are the hard claims.
+            # The symmetric control arm's absolute tok/s is headline
+            # value divided by this ratio — not emitted separately so
+            # the gate doesn't hold a second noisy throughput.
+            {"metric": f"serving_disagg_speedup[tiny,{gen}]",
+             "value": r["disagg_speedup"], "unit": "x",
+             "vs_baseline": r["disagg_speedup"]},
+            {"metric": f"serving_disagg_handoff_bytes[tiny,{gen}]",
+             "value": float(r["handoff_bytes"]), "unit": "bytes",
+             "vs_baseline": 1.0},
+        ],
+    }
+
+
 def bench_mnist(*, steps: int = 200, batch: int = 256,
                 verbose: bool = True) -> dict:
     """BASELINE config #1: MNIST-MLP smoke train (images/s + accuracy).
@@ -1336,7 +1389,8 @@ def first_compile_metric() -> dict:
 ALL_SECTIONS = ("train500m", "train1b", "train-zero", "decode",
                 "decode-int8", "decode-cont", "decode-paged",
                 "decode-spec-paged", "decode-paged-kernel",
-                "decode-gemma", "mnist", "vit", "flash4k")
+                "decode-gemma", "serving-disagg", "mnist", "vit",
+                "flash4k")
 # Per-section wall-clock bound for the orchestrated TPU sweep. Sized
 # from measured section times (train sections ~2-4 min incl. compile,
 # decode ~2 min) with slack for tunnel weather; a section that wedges
@@ -1351,8 +1405,8 @@ def _sweep_for(backend: str, wanted: list[str], p) -> list[str]:
     sweep = (list(ALL_SECTIONS) if backend == "tpu"
              else ["train500m", "train-zero", "decode", "decode-int8",
                    "decode-cont", "decode-paged", "decode-spec-paged",
-                   "decode-paged-kernel", "decode-gemma", "mnist",
-                   "vit"])
+                   "decode-paged-kernel", "decode-gemma",
+                   "serving-disagg", "mnist", "vit"])
     if wanted:
         unavailable = [s for s in wanted if s not in sweep]
         if unavailable:
@@ -1787,6 +1841,18 @@ def _run_sweep(sweep: list[str], backend: str, *, in_child: bool,
             guarded("decode-gemma", lambda: bench_decode(
                 "gemma-tiny", batch=2, prompt_len=8, max_new=8,
                 max_len=64, verbose=verbose))
+    if "serving-disagg" in sweep:
+        # Disaggregated prefill/decode pools vs an equal-count
+        # symmetric fleet, via the loadtest's subprocess A/B (the
+        # replicas pin themselves to CPU regardless of backend). The
+        # headline + speedup ratio feed the bench gate; parity and
+        # zero-client-failure bars are enforced inside the run.
+        def _disagg() -> dict:
+            m = bench_serving_disagg(verbose=verbose)
+            extras.extend(m.pop("extra_metrics", []))
+            return m
+
+        guarded("serving-disagg", _disagg)
     if "mnist" in sweep:
         # BASELINE config #1 (MNIST-MLP smoke) — same section on every
         # backend; the metric label carries where it ran.

@@ -501,11 +501,162 @@ async def run_train_check() -> list[str]:
     return failures
 
 
+async def run_disagg_check() -> list[str]:
+    """Fifth act (ISSUE 12): boot the router over pool-labeled STUB
+    replicas — no jax — and hold the disaggregation plane to the
+    contract: the pool-labeled fleet catalog (`fleet_replicas{state,
+    pool}`, `fleet_route_total{reason,pool}`, `fleet_handoff_seconds`,
+    `fleet_handoff_bytes_total`) visible ZERO-SEEDED in one scrape
+    before any replica registers, then a real prefill->decode handoff
+    moving the ok-counter and the shipped-bytes counter, and
+    `/fleet/autoscale?pools=1` splitting replicas off the federated
+    phase attribution."""
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu.fleet.registry import DECODE, MIXED, POOLS, PREFILL, STATES
+    from kubeflow_tpu.fleet.router import ROUTE_REASONS, create_router_app
+
+    failures: list[str] = []
+
+    def stub_pool_app(replica_name: str):
+        async def gen(request):
+            body = await request.json()
+            return web.json_response(
+                {"tokens": [[7] * int(body.get("max_new", 4))],
+                 "served_by": replica_name})
+
+        async def prefill(request):
+            await request.json()
+            return web.json_response(
+                {"prefilled": True, "handoff": True, "blocks": 2,
+                 "bytes": 4096, "handoff_s": 0.01, "request_id": ""})
+
+        app = web.Application()
+        app.router.add_post("/v1/models/{name}:generate", gen)
+        app.router.add_post("/v1/models/{name}:prefill", prefill)
+        return app
+
+    router = TestClient(TestServer(
+        create_router_app(block_size=4, hedge_after_s=0)))
+    replicas = [TestServer(stub_pool_app(f"stub-{p}-{i}"))
+                for p, i in (("prefill", 0), ("decode", 0), ("decode", 1))]
+    try:
+        await router.start_server()
+
+        async def scrape() -> dict:
+            text = await (await router.get("/metrics")).text()
+            try:
+                return parse_exposition(text)
+            except ExpositionError as e:
+                failures.append(f"router /metrics failed strict "
+                                f"parse: {e}")
+                return {}
+
+        def sample(families: dict, fam: str, sname: str, **labels):
+            f = families.get(fam)
+            if f is None:
+                failures.append(f"router /metrics missing family {fam}")
+                return None
+            key = (sname, tuple(sorted(labels.items())))
+            if key not in f["samples"]:
+                failures.append(
+                    f"router /metrics missing sample {sname}{labels}")
+                return None
+            return f["samples"][key]
+
+        # 1. the pool-labeled catalog zero-seeds before any replica
+        fams = await scrape()
+        for state in STATES:
+            for pool in POOLS:
+                if sample(fams, "fleet_replicas", "fleet_replicas",
+                          state=state, pool=pool) not in (0, None):
+                    failures.append(
+                        f"fleet_replicas[{state},{pool}] not "
+                        "zero-seeded")
+        for reason in ROUTE_REASONS:
+            for pool in POOLS:
+                if sample(fams, "fleet_route_total", "fleet_route_total",
+                          reason=reason, pool=pool) not in (0, None):
+                    failures.append(
+                        f"fleet_route_total[{reason},{pool}] not "
+                        "zero-seeded")
+        for outcome in ("ok", "skipped", "failed"):
+            if sample(fams, "fleet_handoff_seconds",
+                      "fleet_handoff_seconds_count",
+                      outcome=outcome) not in (0, None):
+                failures.append(
+                    f"fleet_handoff_seconds[{outcome}] not zero-seeded")
+        if sample(fams, "fleet_handoff_bytes_total",
+                  "fleet_handoff_bytes_total") not in (0, None):
+            failures.append("fleet_handoff_bytes_total not zero-seeded")
+
+        # 2. register a split fleet with phase attribution, hand off
+        pools = (PREFILL, DECODE, DECODE)
+        for i, (srv, pool) in enumerate(zip(replicas, pools)):
+            await srv.start_server()
+            resp = await router.post("/fleet/register", json={
+                "id": f"stub-{i}",
+                "url": f"http://127.0.0.1:{srv.port}",
+                "pool": pool,
+                "phase_seconds": {"prefill": 3.0, "decode": 1.0},
+                "active": 2, "queue_depth": 2})
+            if resp.status != 200:
+                failures.append(f"register stub-{i} -> {resp.status}")
+        resp = await router.post("/v1/models/m:generate",
+                                 json={"tokens": [[5, 6, 7, 8]],
+                                       "max_new": 3})
+        if resp.status != 200:
+            failures.append(
+                f"disagg generate -> {resp.status}: "
+                f"{await resp.text()}")
+        stats = await (await router.get("/fleet/stats")).json()
+        if stats.get("handoff", {}).get("ok") != 1:
+            failures.append(
+                f"handoff did not land: {stats.get('handoff')}")
+        fams = await scrape()
+        if sample(fams, "fleet_handoff_seconds",
+                  "fleet_handoff_seconds_count", outcome="ok") != 1:
+            failures.append("fleet_handoff_seconds[ok] != 1 after "
+                            "a handoff")
+        if sample(fams, "fleet_handoff_bytes_total",
+                  "fleet_handoff_bytes_total") != 4096:
+            failures.append("fleet_handoff_bytes_total != 4096 after "
+                            "a 4096-byte handoff")
+        if sample(fams, "fleet_replicas", "fleet_replicas",
+                  state="ready", pool=PREFILL) != 1:
+            failures.append("fleet_replicas[ready,prefill] != 1")
+        if sample(fams, "fleet_replicas", "fleet_replicas",
+                  state="ready", pool=MIXED) not in (0, None):
+            failures.append(
+                "fleet_replicas[ready,mixed] != 0 in a split fleet")
+
+        # 3. the autoscaler splits pools off the phase shares
+        resp = await router.get("/fleet/autoscale",
+                                params={"pools": "1", "min": "2",
+                                        "max": "8"})
+        rec = await resp.json()
+        split = rec.get("pools")
+        if not isinstance(split, dict):
+            failures.append(
+                f"/fleet/autoscale?pools=1 has no pool split: {rec}")
+        elif (split.get("prefill", 0) < 1 or split.get("decode", 0) < 1
+              or split["prefill"] + split["decode"] != rec.get("desired")):
+            failures.append(
+                f"pool split does not partition desired: {rec}")
+    finally:
+        await router.close()
+        for srv in replicas:
+            await srv.close()
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
-    """Default: all four acts. `python -m ci.obs_check profile` runs
+    """Default: all five acts. `python -m ci.obs_check profile` runs
     only the serving step-anatomy act (`make profile-check`) — it is
     the only act that compiles jax programs, so the fast acts stay
-    usable on their own."""
+    usable on their own. `python -m ci.obs_check disagg` is the
+    metrics half of `make disagg-check`."""
     import asyncio
 
     argv = sys.argv[1:] if argv is None else argv
@@ -514,6 +665,7 @@ def main(argv: list[str] | None = None) -> int:
         "profile": run_profile_check,
         "fleet": run_fleet_check,
         "train": run_train_check,
+        "disagg": run_disagg_check,
     }
     wanted = argv or list(acts)
     unknown = [a for a in wanted if a not in acts]
@@ -532,8 +684,9 @@ def main(argv: list[str] | None = None) -> int:
           "/debug/traces is Chrome-trace-loadable (spans + counter "
           "tracks), /debug/profile serves the step anatomy, "
           "/fleet/metrics federates two replicas under the same "
-          "contract, and the train_* catalog zero-seeds + tracks "
-          "membership")
+          "contract, the train_* catalog zero-seeds + tracks "
+          "membership, and the pool-labeled disaggregation plane "
+          "zero-seeds + tracks a prefill->decode handoff")
     return 0
 
 

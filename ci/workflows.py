@@ -510,6 +510,47 @@ def train_check_workflow() -> dict:
     }
 
 
+def disagg_check_workflow() -> dict:
+    """Disaggregated-serving gate (ISSUE 12): `make disagg-check` runs
+    the pool/handoff unit suite (pool-aware pick, handoff token parity
+    vs the symmetric oracle on two model families, dead-prefill retry,
+    autoscaler pool-split math), the pool-labeled metrics contract
+    (`fleet_replicas{state,pool}` / `fleet_route_total{reason,pool}` /
+    `fleet_handoff_*` zero-seeded and moved by a real handoff), and
+    the equal-capacity disagg-vs-symmetric A/B loadtest with a
+    SIGKILLed prefill replica. Disaggregation is both a perf claim and
+    a robustness claim; this re-proves both on every fleet or serving
+    change."""
+    return {
+        "name": "disagg check",
+        "on": {
+            "pull_request": {"paths": ["kubeflow_tpu/fleet/**",
+                                       "kubeflow_tpu/serving/**",
+                                       "loadtest/serving_loadtest.py",
+                                       "tests/test_disagg.py",
+                                       "tests/test_fleet.py",
+                                       "ci/obs_check.py",
+                                       "Makefile"]},
+            "push": {"branches": ["main"]},
+        },
+        "jobs": {
+            "disagg-check": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e .[ci] pytest"},
+                    {"name": "pool suite + metrics contract + "
+                             "disagg A/B gate",
+                     "run": "make disagg-check",
+                     "env": {"JAX_PLATFORMS": "cpu"}},
+                ],
+            }
+        },
+    }
+
+
 def tenancy_check_workflow() -> dict:
     """Multi-tenant QoS gate: `make tenancy-check` runs the tenancy
     unit suite (fair-share math, preemption token-identity, prefix
@@ -641,6 +682,7 @@ def all_workflows() -> dict[str, dict]:
     out["fleet_check.yaml"] = fleet_check_workflow()
     out["chaos_check.yaml"] = chaos_check_workflow()
     out["train_check.yaml"] = train_check_workflow()
+    out["disagg_check.yaml"] = disagg_check_workflow()
     out["tenancy_check.yaml"] = tenancy_check_workflow()
     out["kernels_check.yaml"] = kernels_check_workflow()
     out["profile_check.yaml"] = profile_check_workflow()

@@ -197,6 +197,15 @@ class ModelServerSpec:
     # excess pods before deleting them on scale-down.
     replicas: int = 1
     max_replicas: int = 0        # 0 = autoscale off
+    # Disaggregated serving (ISSUE 12): when both are > 0 the fleet
+    # splits into a prefill pool and a decode pool of these sizes
+    # (replacing the symmetric `replicas` count; requires
+    # `continuous`). Prefill pods run with zero decode pressure, fill
+    # paged KV blocks, and ship them to the decode pool through the
+    # router's handoff; the pools scale independently off the
+    # phase-seconds split (`/fleet/autoscale?pools=1`).
+    prefill_replicas: int = 0    # 0 = symmetric (no disaggregation)
+    decode_replicas: int = 0
     max_len: int = 1024
     continuous: bool = True
     warmup: bool = True

@@ -65,11 +65,20 @@ MODEL_NAMES = ("llama-tiny", "llama3-1b", "llama3-8b", "gemma-tiny",
 DEFAULT_IMAGE = "kubeflow-tpu/serving:latest"  # KFTPU_SERVING_IMAGE env
 SERVE_PORT = 8000
 MS_NAME_LABEL = "modelserver-name"
+# Disaggregated pools render one Deployment per pool; the pool label
+# keeps their selectors disjoint (two Deployments selecting the same
+# label set would adopt each other's pods in a real cluster).
+MS_POOL_LABEL = "modelserver-pool"
 
 # Autoscale handshake (ISSUE 3): whatever consumes the fleet router's
 # /fleet/autoscale recommendation writes the number here; the
 # controller clamps it into [spec.replicas, spec.max_replicas].
 DESIRED_REPLICAS_ANNOTATION = "kubeflow-tpu.dev/desired-replicas"
+# Disaggregated twin (ISSUE 12): the consumer of
+# /fleet/autoscale?pools=1 writes the per-pool split here; each is
+# clamped into [spec.<pool>_replicas, spec.max_replicas].
+DESIRED_PREFILL_ANNOTATION = "kubeflow-tpu.dev/desired-prefill-replicas"
+DESIRED_DECODE_ANNOTATION = "kubeflow-tpu.dev/desired-decode-replicas"
 # Scale-down protocol: excess pods are annotated draining-since first
 # (a real deployment POSTs /fleet/drain, which now pushes every
 # in-flight sequence to healthy peers via live KV-block migration);
@@ -106,26 +115,67 @@ class ModelServerController(Controller):
                 store.emit_event(ms, "Warning", reason, msg)
             return Result()
 
-        desired = self._desired_replica_count(store, ms)
+        disagg = ms.spec.prefill_replicas > 0
         requeue = None
-        cur_dep = store.try_get("Deployment", namespace, name)
-        if cur_dep is not None and desired < cur_dep.spec.replicas:
-            # scale-down drains before delete: hold the Deployment at
-            # its current size while excess pods sit in their drain
-            # window, then delete them and shrink
-            desired, requeue = self._drain_scale_down(
-                store, ms, cur_dep, desired)
-        dep = self._desired_deployment(ms, replicas=desired)
-        reconcile_child(store, ms, dep, copy_spec_and_labels)
+        if disagg:
+            # one Deployment per pool; each scale-down drains through
+            # the same window as the symmetric path
+            for suffix, pool, want in (
+                    ("-prefill", "prefill",
+                     self._desired_pool_count(store, ms, "prefill")),
+                    ("-decode", "decode",
+                     self._desired_pool_count(store, ms, "decode"))):
+                child = name + suffix
+                cur_dep = store.try_get("Deployment", namespace, child)
+                if cur_dep is not None and want < cur_dep.spec.replicas:
+                    want, rq = self._drain_scale_down(
+                        store, ms, cur_dep, want)
+                    if rq is not None:
+                        requeue = rq if requeue is None \
+                            else min(requeue, rq)
+                dep = self._desired_deployment(
+                    ms, replicas=want, pool=pool, child_name=child)
+                reconcile_child(store, ms, dep, copy_spec_and_labels)
+            # a spec flipped from symmetric: retire the old fleet
+            try:
+                store.delete("Deployment", namespace, name)
+            except NotFound:
+                pass
+        else:
+            desired = self._desired_replica_count(store, ms)
+            cur_dep = store.try_get("Deployment", namespace, name)
+            if cur_dep is not None and desired < cur_dep.spec.replicas:
+                # scale-down drains before delete: hold the Deployment
+                # at its current size while excess pods sit in their
+                # drain window, then delete them and shrink
+                desired, requeue = self._drain_scale_down(
+                    store, ms, cur_dep, desired)
+            dep = self._desired_deployment(ms, replicas=desired)
+            reconcile_child(store, ms, dep, copy_spec_and_labels)
+            for suffix in ("-prefill", "-decode"):
+                # a spec flipped from disaggregated: retire the pools
+                try:
+                    store.delete("Deployment", namespace, name + suffix)
+                except NotFound:
+                    pass
         svc = self._desired_service(ms)
         reconcile_child(store, ms, svc, copy_spec_and_labels)
         if self.use_routing:
             vs = self._desired_virtualservice(ms)
             reconcile_child(store, ms, vs, copy_spec_and_labels)
 
-        cur = store.try_get("Deployment", namespace, name)
-        ready = bool(cur and cur.ready_replicas >= 1)
-        conditions = list(cur.conditions) if cur else []
+        if disagg:
+            deps = [store.try_get("Deployment", namespace,
+                                  name + suffix)
+                    for suffix in ("-prefill", "-decode")]
+            ready = all(d is not None and d.ready_replicas >= 1
+                        for d in deps)
+            conditions = [c for d in deps if d
+                          for c in d.conditions]
+        else:
+            cur = store.try_get("Deployment", namespace, name)
+            ready = bool(cur and cur.ready_replicas >= 1)
+            conditions = list(cur.conditions) if cur else []
         url = f"/serving/{namespace}/{name}/" if self.use_routing else \
             f"http://{name}.{namespace}.svc"
         fresh = store.try_get("ModelServer", namespace, name)
@@ -162,6 +212,34 @@ class ModelServerController(Controller):
                     "is not an integer; using spec.replicas")
             return desired
         return max(spec.replicas, min(want, spec.max_replicas))
+
+    def _desired_pool_count(self, store: Store, ms: ModelServer,
+                            pool: str) -> int:
+        """Per-pool twin of `_desired_replica_count`: the spec's pool
+        size, lifted by the pool's autoscale annotation (written off
+        `/fleet/autoscale?pools=1`) and clamped into
+        [spec.<pool>_replicas, spec.max_replicas]."""
+        spec = ms.spec
+        floor = max(1, spec.prefill_replicas if pool == "prefill"
+                    else spec.decode_replicas)
+        ann_key = (DESIRED_PREFILL_ANNOTATION if pool == "prefill"
+                   else DESIRED_DECODE_ANNOTATION)
+        ann = ms.metadata.annotations.get(ann_key)
+        if ann is None or not spec.max_replicas:
+            return floor
+        try:
+            want = int(ann)
+        except ValueError:
+            reason = "InvalidDesiredReplicas"
+            if not any(e.reason == reason for e in store.events_for(
+                    "ModelServer", ms.metadata.namespace,
+                    ms.metadata.name)):
+                store.emit_event(
+                    ms, "Warning", reason,
+                    f"annotation {ann_key}={ann!r} is not an "
+                    f"integer; using spec {pool} size")
+            return floor
+        return max(floor, min(want, spec.max_replicas))
 
     @staticmethod
     def _drain_scale_down(store: Store, ms: ModelServer, cur_dep,
@@ -259,10 +337,26 @@ class ModelServerController(Controller):
             return ("InvalidWarmup",
                     "warmup requires continuous batching (the window "
                     "batcher has no ahead-of-traffic shape set)")
+        if spec.prefill_replicas < 0 or spec.decode_replicas < 0:
+            return ("InvalidReplicas",
+                    f"prefill_replicas ({spec.prefill_replicas}) and "
+                    f"decode_replicas ({spec.decode_replicas}) must "
+                    "be >= 0")
+        if (spec.prefill_replicas > 0) != (spec.decode_replicas > 0):
+            return ("InvalidReplicas",
+                    "disaggregation needs BOTH prefill_replicas and "
+                    "decode_replicas > 0 (a lone pool cannot serve); "
+                    "set both to 0 for a symmetric fleet")
+        if spec.prefill_replicas > 0 and not spec.continuous:
+            return ("InvalidPool",
+                    "disaggregated pools require continuous batching "
+                    "(the prefill->decode handoff ships paged KV "
+                    "blocks)")
         return None
 
-    def _desired_deployment(self, ms: ModelServer,
-                            replicas: int = 1) -> Deployment:
+    def _desired_deployment(self, ms: ModelServer, replicas: int = 1,
+                            pool: str = "",
+                            child_name: str = "") -> Deployment:
         name, ns = ms.metadata.name, ms.metadata.namespace
         spec = ms.spec
         volumes: list[Volume] = []
@@ -310,9 +404,11 @@ class ModelServerController(Controller):
         if spec.tokenizer and spec.tokenizer != "none" \
                 and (ckpt or spec.tokenizer != "auto"):
             args += ["--tokenizer", spec.tokenizer]
+        if pool:
+            args += ["--pool", pool]
 
         container = Container(
-            name=name,
+            name=child_name or name,
             image=os.environ.get("KFTPU_SERVING_IMAGE", DEFAULT_IMAGE),
             command=["python", "-m", "kubeflow_tpu.serving"],
             args=args,
@@ -329,15 +425,18 @@ class ModelServerController(Controller):
                                   initial_delay_seconds=5,
                                   period_seconds=5),
         )
+        selector = {MS_NAME_LABEL: name}
+        if pool:
+            selector[MS_POOL_LABEL] = pool
         dep = Deployment(
             spec=DeploymentSpec(
                 replicas=replicas,
-                selector={MS_NAME_LABEL: name},
+                selector=dict(selector),
                 template=PodTemplateSpec(),
             )
         )
         tmpl = dep.spec.template
-        tmpl.metadata.labels = {MS_NAME_LABEL: name}
+        tmpl.metadata.labels = dict(selector)
         topo_name = spec.tpu.topology
         if topo_name:
             # same placement + webhook-env path as notebook gangs
@@ -349,9 +448,9 @@ class ModelServerController(Controller):
                 TPU_RESOURCE_KEY, str(topo.chips_per_host))
         tmpl.spec.containers = [container]
         tmpl.spec.volumes = volumes
-        dep.metadata.name = name
+        dep.metadata.name = child_name or name
         dep.metadata.namespace = ns
-        dep.metadata.labels = {MS_NAME_LABEL: name}
+        dep.metadata.labels = dict(selector)
         return dep
 
     def _desired_service(self, ms: ModelServer) -> Service:

@@ -40,6 +40,20 @@ class Recommendation:
     signals: dict
 
 
+@dataclass(frozen=True)
+class PoolRecommendation:
+    """Per-pool desired counts for a disaggregated fleet."""
+
+    prefill: int
+    decode: int
+    reason: str
+    signals: dict
+
+    @property
+    def desired(self) -> int:
+        return self.prefill + self.decode
+
+
 def _get(rep: Any, name: str, default=0):
     """Stats accessor over either `registry.Replica` objects or plain
     dicts (the router's JSON snapshot round-trips through clients)."""
@@ -120,3 +134,73 @@ def recommend_replicas(replicas: Iterable[Any], *,
         "kv_pressure": round(kv_pressure, 4),
         "draining": draining,
     })
+
+
+def split_pools(total: int, phase_seconds: dict) -> tuple[int, int]:
+    """Split `total` replicas into (prefill, decode) proportional to
+    the fleet's cumulative phase-time shares.
+
+    `phase_seconds` is the summed `serving_step_phase_seconds` totals
+    ({"prefill": s, "decode": s}) — the pool whose phase share
+    dominates is the bottleneck and gets the larger slice; no other
+    signal is needed (ISSUE 12). Each pool keeps at least one replica
+    (a disaggregated fleet with an empty pool cannot serve at all),
+    which requires `total >= 2`. With no phase signal yet (cold fleet)
+    the split is even, decode taking the odd replica — decode is the
+    steady-state phase a fresh fleet grows into."""
+    if total < 2:
+        raise ValueError(
+            f"a disaggregated fleet needs >= 2 replicas, got {total}")
+    p = float(phase_seconds.get("prefill", 0.0) or 0.0)
+    d = float(phase_seconds.get("decode", 0.0) or 0.0)
+    if p < 0.0 or d < 0.0:
+        raise ValueError(
+            f"phase seconds must be >= 0, got prefill={p} decode={d}")
+    share = p / (p + d) if (p + d) > 0.0 else 0.5
+    # round the DECODE side half-up (not banker's) so ties — the cold
+    # even split included — hand decode the odd replica
+    decode = int(math.floor(total * (1.0 - share) + 0.5))
+    decode = max(1, min(decode, total - 1))
+    return total - decode, decode
+
+
+def recommend_pools(replicas: Iterable[Any], *,
+                    min_replicas: int = 2, max_replicas: int = 8,
+                    kv_pressure_high: float = 0.9,
+                    scale_down_headroom: float = 0.7
+                    ) -> PoolRecommendation:
+    """Desired per-pool counts for a disaggregated fleet.
+
+    The TOTAL comes from `recommend_replicas` (same demand + KV
+    pressure + hysteresis math — disaggregation changes where capacity
+    sits, not how much is needed); the SPLIT comes from the summed
+    phase-seconds shares the replicas heartbeat
+    (`Replica.phase_seconds`, fed by each replica's PhaseProfiler).
+    `min_replicas` must be >= 2 so both pools can hold a replica."""
+    if min_replicas < 2:
+        raise ValueError(
+            f"disaggregated fleets need min_replicas >= 2, "
+            f"got {min_replicas}")
+    reps = list(replicas)
+    rec = recommend_replicas(
+        reps, min_replicas=min_replicas, max_replicas=max_replicas,
+        kv_pressure_high=kv_pressure_high,
+        scale_down_headroom=scale_down_headroom)
+    phases = {"prefill": 0.0, "decode": 0.0}
+    for r in reps:
+        if _get(r, "state", READY) not in (READY, DEGRADED):
+            continue
+        ph = _get(r, "phase_seconds", {}) or {}
+        for k in phases:
+            v = ph.get(k, 0.0) if isinstance(ph, dict) else 0.0
+            if isinstance(v, (int, float)) and v >= 0.0:
+                phases[k] += float(v)
+    prefill, decode = split_pools(max(2, rec.desired), phases)
+    share = (phases["prefill"] / (phases["prefill"] + phases["decode"])
+             if (phases["prefill"] + phases["decode"]) > 0.0 else 0.5)
+    reason = (f"{rec.reason}; prefill phase share {share:.2f} "
+              f"-> {prefill}p/{decode}d")
+    signals = dict(rec.signals)
+    signals["phase_seconds"] = {k: round(v, 4) for k, v in phases.items()}
+    signals["prefill_share"] = round(share, 4)
+    return PoolRecommendation(prefill, decode, reason, signals)

@@ -42,6 +42,16 @@ DRAINING = "draining"
 DEAD = "dead"
 STATES = (READY, DEGRADED, DRAINING, DEAD)
 
+# Disaggregated serving roles. "mixed" (the default) is the symmetric
+# replica that both prefills and decodes; "prefill"/"decode" replicas
+# specialize and hand sequences off over /v1/migrate/in. The set is
+# CLOSED — it bounds the `pool` metric label and the router's routing
+# table, so an unknown role in a heartbeat falls back to "mixed".
+PREFILL = "prefill"
+DECODE = "decode"
+MIXED = "mixed"
+POOLS = (PREFILL, DECODE, MIXED)
+
 
 def rendezvous(key: bytes, ids: Iterable[str]) -> str | None:
     """Highest-random-weight winner for `key` among `ids` (stable:
@@ -65,12 +75,18 @@ class Replica:
     state: str = READY
     registered_at: float = 0.0
     last_heartbeat: float = 0.0
+    # disaggregation role ("prefill" / "decode" / "mixed"): which pool
+    # the router files this replica under when picking targets
+    pool: str = MIXED
     # heartbeat-reported routing/autoscale signals
     queue_depth: int = 0
     active_slots: int = 0
     max_slots: int = 0
     kv_blocks_free: int = 0
     kv_blocks_total: int = 0
+    # cumulative step-phase seconds from the replica's PhaseProfiler
+    # ({"prefill": s, "decode": s}): the pool autoscaler's only signal
+    phase_seconds: dict = field(default_factory=dict)
     # router-side accounting
     inflight: int = 0            # proxied requests currently open
     failures: int = 0            # consecutive router-observed failures
@@ -89,7 +105,9 @@ class Replica:
     def snapshot(self) -> dict:
         return {
             "id": self.id, "url": self.url, "models": list(self.models),
-            "state": self.state, "queue_depth": self.queue_depth,
+            "state": self.state, "pool": self.pool,
+            "phase_seconds": dict(self.phase_seconds),
+            "queue_depth": self.queue_depth,
             "active_slots": self.active_slots,
             "max_slots": self.max_slots,
             "kv_blocks_free": self.kv_blocks_free,
@@ -183,6 +201,22 @@ class ReplicaRegistry:
             v = stats.get(k)
             if isinstance(v, int) and not isinstance(v, bool) and v >= 0:
                 setattr(rep, k, v)
+        # pool role is a string from a CLOSED set (it becomes a metric
+        # label); anything else quietly stays at the current role
+        p = stats.get("pool")
+        if isinstance(p, str) and p in POOLS:
+            rep.pool = p
+        # cumulative phase seconds: keep only finite non-negative
+        # numbers under string keys (fed straight to the pool
+        # autoscaler, so garbage must die at the door)
+        ph = stats.get("phase_seconds")
+        if isinstance(ph, dict):
+            clean = {k: float(v) for k, v in ph.items()
+                     if isinstance(k, str)
+                     and isinstance(v, (int, float))
+                     and not isinstance(v, bool) and v >= 0.0}
+            if clean or not ph:
+                rep.phase_seconds = clean
 
     def drain(self, replica_id: str) -> bool:
         rep = self._replicas.get(replica_id)
@@ -252,41 +286,81 @@ class ReplicaRegistry:
             out[rep.state] += 1
         return out
 
+    def pool_counts(self) -> dict[str, dict[str, int]]:
+        """Pool -> state -> replica count, zero-filled over the full
+        POOLS x STATES grid (the `fleet_replicas{state,pool}` gauge
+        renders every cell from the first scrape)."""
+        out = {p: {s: 0 for s in STATES} for p in POOLS}
+        for rep in self._replicas.values():
+            out[rep.pool][rep.state] += 1
+        return out
+
+    def disaggregated(self) -> bool:
+        """True when the fleet actually runs split pools: at least one
+        live (ready/degraded) prefill replica AND one live decode
+        replica. The router only engages the handoff path then — a
+        fleet of mixed replicas keeps the symmetric behavior."""
+        live = {PREFILL: 0, DECODE: 0, MIXED: 0}
+        for rep in self._replicas.values():
+            if rep.state in (READY, DEGRADED):
+                live[rep.pool] += 1
+        return live[PREFILL] > 0 and live[DECODE] > 0
+
     # -- routing ----------------------------------------------------------
 
-    def routable(self, exclude: frozenset | set = frozenset()
-                 ) -> list[Replica]:
+    def routable(self, exclude: frozenset | set = frozenset(), *,
+                 pool: str | None = None) -> list[Replica]:
         """Candidates in preference order: the ready set, else (every
         ready replica excluded/absent) the degraded set — a degraded
-        replica may still answer, and retrying it beats a client 503."""
+        replica may still answer, and retrying it beats a client 503.
+        `pool` narrows to one disaggregation role (mixed replicas
+        qualify for EITHER role — they can do both phases); when the
+        requested pool has no candidates at all the filter relaxes to
+        the whole fleet, because any replica beats a 503."""
         now = self.clock()
 
-        def _closed(pool: list[Replica]) -> list[Replica]:
+        def _closed(cands: list[Replica]) -> list[Replica]:
             # skip open circuits — but when EVERY candidate's circuit
             # is open, route anyway: a long-shot retry beats a certain
             # client 503, and the attempt doubles as the probe
-            ok = [r for r in pool if now >= r.circuit_open_until]
-            return ok or pool
+            ok = [r for r in cands if now >= r.circuit_open_until]
+            return ok or cands
 
-        ready = [r for r in self._replicas.values()
-                 if r.state == READY and r.id not in exclude]
-        if ready:
-            return _closed(ready)
-        return _closed([r for r in self._replicas.values()
-                        if r.state == DEGRADED and r.id not in exclude])
+        def _in_pool(r: Replica) -> bool:
+            return pool is None or r.pool == pool or r.pool == MIXED
 
-    def pick(self, key: bytes, exclude: frozenset | set = frozenset()
-             ) -> tuple[Replica | None, str]:
+        def _select(want_pool: bool) -> list[Replica]:
+            ready = [r for r in self._replicas.values()
+                     if r.state == READY and r.id not in exclude
+                     and (not want_pool or _in_pool(r))]
+            if ready:
+                return _closed(ready)
+            deg = [r for r in self._replicas.values()
+                   if r.state == DEGRADED and r.id not in exclude
+                   and (not want_pool or _in_pool(r))]
+            return _closed(deg)
+
+        got = _select(True)
+        if got or pool is None:
+            return got
+        return _select(False)
+
+    def pick(self, key: bytes, exclude: frozenset | set = frozenset(),
+             *, pool: str | None = None) -> tuple[Replica | None, str]:
         """Route one request: rendezvous affinity target for `key` if it
         is routable and not overloaded, else least-loaded fallback.
-        Returns (replica, "affinity" | "fallback") or (None, _)."""
+        `pool` narrows candidates to one disaggregation role (prefix
+        affinity then operates INSIDE that pool, so a disaggregated
+        fleet keeps its radix-cache hit rate among the prefill
+        replicas). Returns (replica, "affinity" | "fallback")
+        or (None, _)."""
         self.sweep()
-        pool = self.routable(exclude)
-        if not pool:
+        cands = self.routable(exclude, pool=pool)
+        if not cands:
             return None, "fallback"
         if key:
-            winner = rendezvous(key, [r.id for r in pool])
+            winner = rendezvous(key, [r.id for r in cands])
             target = self._replicas[winner]
             if target.load() < self.overload_depth:
                 return target, "affinity"
-        return min(pool, key=lambda r: (r.load(), r.id)), "fallback"
+        return min(cands, key=lambda r: (r.load(), r.id)), "fallback"

@@ -39,7 +39,14 @@ from aiohttp import web
 
 from kubeflow_tpu import obs as obs_lib
 from kubeflow_tpu.fleet import autoscale
-from kubeflow_tpu.fleet.registry import ReplicaRegistry
+from kubeflow_tpu.fleet.registry import (
+    DECODE,
+    DEGRADED,
+    POOLS,
+    PREFILL,
+    READY,
+    ReplicaRegistry,
+)
 from kubeflow_tpu.obs import endpoints as obs_endpoints
 from kubeflow_tpu.tenancy import TenancyConfig, TenantLedger, Throttled
 
@@ -170,10 +177,26 @@ class FleetObs:
         self.tracer = tracer if tracer is not None else obs_lib.Tracer()
         self.route_total = Counter(
             "fleet_route_total",
-            "Routing decisions by reason: affinity (rendezvous target), "
-            "fallback (least-loaded), retry (previous replica failed), "
-            "hedge (duplicate dispatch after the latency deadline)",
+            "Routing decisions by reason — affinity (rendezvous "
+            "target), fallback (least-loaded), retry (previous replica "
+            "failed), hedge (duplicate dispatch after the latency "
+            "deadline) — and by the chosen replica's disaggregation "
+            "pool (prefill/decode/mixed)",
             self.registry)
+        # Disaggregated handoff instruments (ISSUE 12): one handoff =
+        # one prefill-pool dispatch whose KV blocks landed on a decode
+        # replica. Bytes are the wire payload (base64 K+V) actually
+        # pushed over /v1/migrate/in.
+        self.handoff_seconds = obs_lib.get_or_create_histogram(
+            self.registry, "fleet_handoff_seconds",
+            "Prefill->decode handoff latency (prefill dispatch + KV "
+            "push to the decode peer), by model and outcome")
+        self.handoff_bytes = Counter(
+            "fleet_handoff_bytes_total",
+            "KV bytes shipped prefill->decode over /v1/migrate/in "
+            "(base64 wire size), by model", self.registry)
+        # pool labels enumerate code, not traffic: closed guard
+        self.pool_guard = obs_lib.LabelGuard(seed=POOLS, closed=True)
         self.hedge_wins = Counter(
             "fleet_hedge_wins_total",
             "Hedged duplicates that answered before the primary",
@@ -190,7 +213,8 @@ class FleetObs:
         replicas_g = Gauge(
             "fleet_replicas",
             "Registered replicas by health state "
-            "(ready/degraded/draining/dead)", self.registry)
+            "(ready/degraded/draining/dead) and disaggregation pool "
+            "(prefill/decode/mixed)", self.registry)
         # Per-tenant routing accounting (X-Tenant header). With a
         # tenancy config, names resolve through it (bounded by
         # configuration); without one, raw header values pass the
@@ -228,21 +252,36 @@ class FleetObs:
             "1 while the replica's circuit breaker is open (skipped by "
             "fresh routing picks until the half-open probe)",
             self.registry)
-        # zero-seed so the series exist (at 0) before any traffic
+        # zero-seed so the series exist (at 0) before any traffic —
+        # the full closed reason x pool grid
         for reason in ROUTE_REASONS:
-            self.route_total.inc(0, reason=reason)
+            for _pool in POOLS:
+                self.route_total.inc(0, reason=reason, pool=_pool)
         self.hedge_wins.inc(0)
         self.failover.inc(0)
+        self.handoff_bytes.inc(0)
+        for _oc in ("ok", "skipped", "failed"):
+            self.handoff_seconds.seed(outcome=_oc)
 
         def collect():
             reg.sweep()
-            for state, nn in reg.counts().items():
-                replicas_g.set(nn, state=state)
+            for _pool, states in reg.pool_counts().items():
+                for state, nn in states.items():
+                    replicas_g.set(nn, state=state,
+                                   pool=self.pool_guard.admit(_pool))
             for rep in reg.replicas():
                 circuit_g.set(int(reg.circuit_open(rep.id)),
                               replica=self.replica_guard.admit(rep.id))
 
         self.registry.register_collector(collect)
+
+    def note_route(self, reason: str, pool: str) -> None:
+        """One routing decision into the reason x pool counter (pool
+        values outside the closed set collapse to the guard's
+        overflow bucket — they cannot happen via the registry, which
+        validates roles at the heartbeat door)."""
+        self.route_total.inc(reason=reason,
+                             pool=self.pool_guard.admit(pool))
 
 
 class _FleetState:
@@ -270,7 +309,13 @@ class _FleetState:
         self.max_attempts = (max_attempts if max_attempts is not None
                              else retries + 2)
         self.session: aiohttp.ClientSession | None = None
-        self.rr = 0  # round-robin cursor (policy="roundrobin" A/B arm)
+        # round-robin cursor + membership snapshot (policy="roundrobin"
+        # A/B arm): the sorted id tuple is rebuilt only when fleet
+        # membership changes, and the cursor walks IT — not whatever
+        # subset this request's exclusions left — so per-request
+        # exclusions cannot bias the rotation (ISSUE 12 satellite)
+        self.rr = 0
+        self.rr_ids: tuple[str, ...] = ()
         # fleet.chaos.ChaosInjector (loadtest --mode chaos): seeded
         # fault hooks on the router->replica path. None in production.
         self.chaos = chaos
@@ -339,21 +384,43 @@ async def _router_obs_middleware(request: web.Request, handler):
             raise
 
 
-def _choose(st: _FleetState, key: bytes, exclude: set):
-    """One routing decision under the configured policy. The
+def _choose(st: _FleetState, key: bytes, exclude: set,
+            pool: str | None = None):
+    """One routing decision under the configured policy. `pool`
+    narrows candidates to one disaggregation role (registry.pick
+    relaxes to the whole fleet when the pool is empty). The
     "roundrobin" policy exists for the affinity-vs-random A/B
-    (loadtest --fleet-policy roundrobin) and labels as fallback."""
+    (loadtest --fleet-policy roundrobin), labels as fallback, and is
+    pool-blind — the A/B control arm measures the symmetric fleet."""
     if st.policy == "roundrobin":
-        pool = st.registry.routable(exclude)
-        if not pool:
+        cands = st.registry.routable(exclude)
+        if not cands:
             st.registry.sweep()
-            pool = st.registry.routable(exclude)
-        if not pool:
+            cands = st.registry.routable(exclude)
+        if not cands:
             return None, "fallback"
-        pool.sort(key=lambda r: r.id)
-        st.rr += 1
-        return pool[st.rr % len(pool)], "fallback"
-    return st.registry.pick(key, exclude)
+        # O(1) round-robin over a STABLE membership snapshot: re-sort
+        # only when the routable id set actually changed, then advance
+        # one persistent cursor over the snapshot, skipping this
+        # request's exclusions — `cursor % len(subset)` over a
+        # per-request subset would both re-sort every request and bias
+        # the rotation whenever exclusions shrink the list.
+        by_id = {r.id: r for r in cands}
+        full = {r.id for r in st.registry.routable(frozenset())} or \
+            set(by_id)
+        if full != set(st.rr_ids):
+            st.rr_ids = tuple(sorted(full))
+            st.rr %= len(st.rr_ids)
+        for _ in range(len(st.rr_ids)):
+            rid = st.rr_ids[st.rr % len(st.rr_ids)]
+            st.rr += 1
+            rep = by_id.get(rid)
+            if rep is not None:
+                return rep, "fallback"
+        # snapshot exhausted without a routable hit (all excluded):
+        # fall back to the first candidate rather than 503
+        return cands[0], "fallback"
+    return st.registry.pick(key, exclude, pool=pool)
 
 
 def _inject_trace_context(st: _FleetState, headers: dict) -> dict:
@@ -429,11 +496,13 @@ async def _call_replica(st: _FleetState, rep, name: str, raw: bytes,
 
 async def _race_hedged(st: _FleetState, primary, name: str, raw: bytes,
                        key: bytes, tried: set, model: str,
-                       headers: dict, budget: list):
+                       headers: dict, budget: list,
+                       pool: str | None = None):
     """Dispatch to `primary`; past the hedge deadline, duplicate to a
-    second replica and take whichever answers first. Every dispatch
-    (primary and hedge alike) spends one unit of the request's attempt
-    `budget` — a hedge is skipped once the budget is gone. Returns
+    second replica (from the same disaggregation `pool`, if any) and
+    take whichever answers first. Every dispatch (primary and hedge
+    alike) spends one unit of the request's attempt `budget` — a hedge
+    is skipped once the budget is gone. Returns
     (status, payload, replica, hedge_won, upstream_trace) or None when
     every dispatched replica failed (all are in `tried` by then)."""
     budget[0] -= 1
@@ -444,11 +513,11 @@ async def _race_hedged(st: _FleetState, primary, name: str, raw: bytes,
         done, _pending = await asyncio.wait(tasks,
                                             timeout=st.hedge_after_s)
         if not done and budget[0] > 0:
-            hedge_rep, _ = _choose(st, key, tried | {primary.id})
+            hedge_rep, _ = _choose(st, key, tried | {primary.id}, pool)
             if hedge_rep is not None:
                 budget[0] -= 1
                 hedged_id = hedge_rep.id
-                st.obs.route_total.inc(reason="hedge")
+                st.obs.note_route("hedge", hedge_rep.pool)
                 tasks.add(asyncio.create_task(_call_replica(
                     st, hedge_rep, name, raw, tried, headers)))
     winner = None
@@ -503,6 +572,135 @@ def _tenant_gate(st: _FleetState, request: web.Request):
     return headers, None
 
 
+def _handoff_body(body, peer: str) -> bytes | None:
+    """Build the `:prefill` dispatch body: the prompt plus the decode
+    peer the prefill replica ships its KV blocks to. Returns None for
+    shapes the handoff endpoint cannot serve (batched prompts,
+    registered-prefix expansion, no prompt at all) — the caller then
+    skips the handoff and lets the decode pool prefill for itself."""
+    if not isinstance(body, dict):
+        return None
+    if body.get("prefix"):
+        return None
+    nb: dict = {"peer": peer}
+    toks = body.get("tokens")
+    text = body.get("text")
+    if isinstance(toks, list) and toks:
+        if isinstance(toks[0], list):
+            if len(toks) != 1 or not toks[0]:
+                return None
+            nb["tokens"] = toks
+        else:
+            nb["tokens"] = [toks]
+    elif isinstance(text, str) and text:
+        nb["text"] = text
+    else:
+        return None
+    return json.dumps(nb).encode()
+
+
+def _prompt_tokens(body) -> int:
+    """Prompt length in tokens (byte count for text bodies — the
+    router's byte-tokenizer mirror, same as affinity_key). 0 for
+    shapes the handoff cannot serve."""
+    if not isinstance(body, dict):
+        return 0
+    toks = body.get("tokens")
+    if isinstance(toks, list) and toks:
+        if isinstance(toks[0], list):
+            return len(toks[0]) if len(toks) == 1 else 0
+        return len(toks)
+    text = body.get("text")
+    if isinstance(text, str):
+        return len(text.encode())
+    return 0
+
+
+async def _disagg_handoff(st: _FleetState, name: str, body,
+                          key: bytes, rid: str, headers: dict):
+    """Prefill->decode handoff for one request on a disaggregated
+    fleet. Picks the least-loaded decode replica as the KV
+    destination, then asks a prefill replica (affinity-picked, so
+    shared prompt prefixes keep landing on the same prefill replica's
+    radix cache) to prefill the prompt and push its paged KV blocks to
+    that peer over `/v1/migrate/in`. Returns the decode replica to pin
+    the generate dispatch to — or None when the fleet has no decode
+    target, in which case the caller routes as a symmetric fleet.
+
+    Best-effort BY DESIGN: a failed or skipped handoff only costs the
+    decode replica a redundant prefill (the generate path prefills
+    whatever the radix cache does not already hold), so a prefill
+    replica dying mid-handoff is retried once and then abandoned
+    without ever surfacing to the client."""
+    decode_rep, _ = st.registry.pick(b"", frozenset(), pool=DECODE)
+    if decode_rep is None or decode_rep.pool != DECODE:
+        return None
+    if _prompt_tokens(body) < st.block_size:
+        # shorter than one KV block: nothing full-block to ship, the
+        # decode replica's own prefill is strictly cheaper than a
+        # handoff round-trip — pin to the decode pool and move on
+        return decode_rep
+    raw = _handoff_body(body, decode_rep.url)
+    if raw is None:
+        st.obs.handoff_seconds.observe(0.0, outcome="skipped")
+        return decode_rep
+    t0 = time.perf_counter()
+    outcome = "failed"
+    tried: set[str] = set()
+    hdrs = _inject_trace_context(st, {**headers, "X-Request-Id": rid})
+    for _ in range(2):  # one in-pool retry: covers a prefill SIGKILL
+        pre, reason = st.registry.pick(key, tried, pool=PREFILL)
+        if pre is None or pre.pool != PREFILL or pre.id in tried:
+            break
+        st.obs.note_route(reason, pre.pool)
+        st.registry.note_dispatch(pre.id)
+        try:
+            async with st.session.post(
+                    f"{pre.url}/v1/models/{name}:prefill", data=raw,
+                    headers=hdrs,
+                    timeout=aiohttp.ClientTimeout(
+                        total=st.timeout_s)) as r:
+                if r.status >= 500:
+                    raise _UpstreamError(f"prefill {r.status}")
+                pj = await r.json(content_type=None)
+                st.registry.note_success(pre.id)
+                if (r.status == 200 and isinstance(pj, dict)
+                        and pj.get("handoff")):
+                    outcome = "ok"
+                    nbytes = int(pj.get("bytes", 0) or 0)
+                    if nbytes > 0:
+                        st.obs.handoff_bytes.inc(nbytes)
+                else:
+                    # prefill ran but the KV push did not land (peer
+                    # draining, prompt shorter than a block, ...):
+                    # not a replica fault, don't retry
+                    outcome = "skipped"
+                break
+        except (_UpstreamError, aiohttp.ClientError,
+                asyncio.TimeoutError, OSError):
+            st.registry.note_failure(pre.id)
+            tried.add(pre.id)
+        finally:
+            st.registry.note_done(pre.id)
+    st.obs.handoff_seconds.observe(time.perf_counter() - t0,
+                                   outcome=outcome)
+    return decode_rep
+
+
+def _pick_target(st: _FleetState, key: bytes, exclude: set,
+                 pool: str | None, pinned):
+    """One generate-dispatch choice, honoring a handoff pin: the
+    decode replica now holding this request's prefilled KV blocks is
+    preferred (its radix cache turns the generate's prefill into a
+    lookup) until it fails once, then routing falls back to the
+    normal pool-aware policy."""
+    if pinned is not None and pinned.id not in exclude:
+        rep = st.registry.get(pinned.id)
+        if rep is not None and rep.state in (READY, DEGRADED):
+            return rep, "affinity"
+    return _choose(st, key, exclude, pool)
+
+
 async def _routed_generate(request: web.Request):
     st: _FleetState = request.app[FLEET_KEY]
     name = request.match_info["name"]
@@ -520,10 +718,25 @@ async def _routed_generate(request: web.Request):
     # timeline survives the hop.
     rid = request.headers.get("X-Request-Id") or secrets.token_hex(8)
     fwd_headers["X-Request-Id"] = rid
+    key = affinity_key(body, st.block_size)
+    # Disaggregated fleet: prefill the prompt on the prefill pool and
+    # ship its KV blocks to a decode replica BEFORE dispatching the
+    # generate, then pin the generate to that decode replica — its
+    # radix cache turns the shipped prefix into a cache hit. The
+    # handoff is best-effort; on any failure the generate simply goes
+    # to the decode pool, which prefills for itself.
+    pool: str | None = None
+    pinned = None
+    if st.registry.disaggregated():
+        pool = DECODE
+        pinned = await _disagg_handoff(st, name, body, key, rid,
+                                       fwd_headers)
+        if pinned is None:
+            pool = None
     if isinstance(body, dict) and body.get("stream"):
         return await _routed_stream(request, st, name, raw, body,
-                                    fwd_headers, rid)
-    key = affinity_key(body, st.block_size)
+                                    fwd_headers, rid, pool=pool,
+                                    pinned=pinned)
     t0 = time.perf_counter()
     tried: set[str] = set()
     budget = [st.max_attempts]
@@ -531,7 +744,7 @@ async def _routed_generate(request: web.Request):
         for attempt in range(st.retries + 1):
             if budget[0] <= 0:
                 break
-            replica, reason = _choose(st, key, tried)
+            replica, reason = _pick_target(st, key, tried, pool, pinned)
             if replica is None and tried:
                 # every routable replica failed once this request:
                 # transient faults (a chaos drop, a connection blip)
@@ -539,7 +752,8 @@ async def _routed_generate(request: web.Request):
                 # persistent corpses are held off by their circuit
                 # breakers, not by this per-request memory
                 tried.clear()
-                replica, reason = _choose(st, key, tried)
+                replica, reason = _pick_target(st, key, tried, pool,
+                                               pinned)
             if replica is None:
                 # fleet-wide blip: every replica dead or draining for a
                 # beat (a lone survivor can trip its breaker to DEAD
@@ -568,7 +782,8 @@ async def _routed_generate(request: web.Request):
                     dispatch_raw, prepend = rb, list(ck["out"])
             result = await _race_hedged(st, replica, name,
                                         dispatch_raw, key, tried,
-                                        name, fwd_headers, budget)
+                                        name, fwd_headers, budget,
+                                        pool=pool)
             if result is None:
                 continue  # dispatched replicas failed; retry others
             status, payload, rep, hedge_won, trace = result
@@ -578,7 +793,7 @@ async def _routed_generate(request: web.Request):
                     isinstance(body, dict) and "text" in body)
                 st.obs.failover.inc()
             dt = time.perf_counter() - t0
-            st.obs.route_total.inc(reason=reason)
+            st.obs.note_route(reason, rep.pool)
             st.obs.route_latency.observe(dt, model=name, reason=reason)
             st.obs.slo.observe("fleet_route_latency", dt)
             st.obs.slo.record("fleet_availability", status < 500)
@@ -603,7 +818,8 @@ async def _routed_generate(request: web.Request):
 
 async def _routed_stream(request: web.Request, st: _FleetState,
                          name: str, raw: bytes, body: dict,
-                         fwd_headers: dict, rid: str):
+                         fwd_headers: dict, rid: str,
+                         pool: str | None = None, pinned=None):
     """SSE with mid-stream failover. The router PARSES the upstream
     event stream instead of blind passthrough: token events are
     re-emitted to the client as they arrive, and when the replica dies
@@ -625,13 +841,13 @@ async def _routed_stream(request: web.Request, st: _FleetState,
     for attempt in range(st.retries + 1):
         if budget <= 0 or final_evt is not None:
             break
-        replica, reason = _choose(st, key, tried)
+        replica, reason = _pick_target(st, key, tried, pool, pinned)
         if replica is None and tried:
             # same fresh sweep as the one-shot path: a transient fault
             # on the last untried replica must not strand the stream
             # while attempt budget remains
             tried.clear()
-            replica, reason = _choose(st, key, tried)
+            replica, reason = _pick_target(st, key, tried, pool, pinned)
         if replica is None:
             # same fleet-wide-blip wait as the one-shot path: hold the
             # stream open through a beat where nobody is routable
@@ -683,7 +899,7 @@ async def _routed_stream(request: web.Request, st: _FleetState,
                     payload = await up.read()
                     if resp is None:
                         # replica rejected pre-stream (4xx): passthrough
-                        st.obs.route_total.inc(reason=reason)
+                        st.obs.note_route(reason, replica.pool)
                         return web.Response(
                             body=payload, status=up.status,
                             content_type="application/json",
@@ -693,7 +909,7 @@ async def _routed_stream(request: web.Request, st: _FleetState,
                     # retryable, the client stream is still open
                     tried.add(replica.id)
                     continue
-                st.obs.route_total.inc(reason=reason)
+                st.obs.note_route(reason, replica.pool)
                 if resp is None:
                     headers = {
                         "Content-Type": "text/event-stream",
@@ -795,7 +1011,8 @@ async def _register(request: web.Request):
         models=[m for m in models if isinstance(m, str)],
         **{k: v for k, v in body.items()
            if k in ("queue_depth", "active_slots", "max_slots",
-                    "kv_blocks_free", "kv_blocks_total")})
+                    "kv_blocks_free", "kv_blocks_total",
+                    "pool", "phase_seconds")})
     st.ingest_checkpoints(rep.id, body.get("checkpoints"))
     log.info("fleet: registered replica %s at %s", rep.id, rep.url)
     return web.json_response({"id": rep.id, "state": rep.state})
@@ -819,7 +1036,8 @@ async def _heartbeat(request: web.Request):
     ok = st.registry.heartbeat(rid, **{
         k: v for k, v in body.items()
         if k in ("queue_depth", "active_slots", "max_slots",
-                 "kv_blocks_free", "kv_blocks_total", "draining")})
+                 "kv_blocks_free", "kv_blocks_total", "draining",
+                 "pool", "phase_seconds")})
     if not ok:
         # unknown id: the router restarted and lost its table — 404
         # tells the replica to re-register (server.py's beat loop does)
@@ -904,17 +1122,36 @@ async def _replicas(request: web.Request):
         snap = rep.snapshot()
         snap["last_heartbeat_age_s"] = round(now - rep.last_heartbeat, 3)
         out.append(snap)
-    return web.json_response({"replicas": out,
-                              "counts": st.registry.counts()})
+    return web.json_response({
+        "replicas": out,
+        "counts": st.registry.counts(),
+        "pools": st.registry.pool_counts(),
+        "disaggregated": st.registry.disaggregated(),
+    })
 
 
 async def _autoscale(request: web.Request):
+    """GET /fleet/autoscale[?pools=1] — replica-count recommendation.
+    With `pools=1` the response adds the prefill/decode split driven
+    by the fleet's phase-seconds shares (autoscale.recommend_pools);
+    the min defaults to 2 there so both pools can hold a replica."""
     st: _FleetState = request.app[FLEET_KEY]
     st.registry.sweep()
     q = request.rel_url.query
+    pools = q.get("pools", "") not in ("", "0", "false")
     try:
-        lo = int(q.get("min", 1))
+        lo = int(q.get("min", 2 if pools else 1))
         hi = int(q.get("max", 8))
+        if pools:
+            prec = autoscale.recommend_pools(
+                st.registry.replicas(), min_replicas=lo,
+                max_replicas=hi)
+            return web.json_response({
+                "desired": prec.desired,
+                "pools": {"prefill": prec.prefill,
+                          "decode": prec.decode},
+                "reason": prec.reason,
+                "signals": prec.signals})
         rec = autoscale.recommend_replicas(
             st.registry.replicas(), min_replicas=lo, max_replicas=hi)
     except ValueError as e:
@@ -928,9 +1165,21 @@ async def _stats(request: web.Request):
     """Machine-readable routing counters (the loadtest's evidence feed
     — same numbers as /metrics, without a Prometheus parse)."""
     st: _FleetState = request.app[FLEET_KEY]
+    # route_total carries (reason, pool) keys; the per-reason view
+    # sums over the closed pool set (Counter.value is exact-key)
     return web.json_response({
-        "route_total": {reason: st.obs.route_total.value(reason=reason)
-                        for reason in ROUTE_REASONS},
+        "route_total": {
+            reason: sum(st.obs.route_total.value(reason=reason, pool=p)
+                        for p in POOLS)
+            for reason in ROUTE_REASONS},
+        "route_by_pool": {
+            p: sum(st.obs.route_total.value(reason=r, pool=p)
+                   for r in ROUTE_REASONS)
+            for p in POOLS},
+        "handoff": {
+            oc: st.obs.handoff_seconds.count(outcome=oc)
+            for oc in ("ok", "skipped", "failed")},
+        "handoff_bytes": st.obs.handoff_bytes.value(),
         "hedge_wins": st.obs.hedge_wins.value(),
         "failover": st.obs.failover.value(),
         "checkpoints": len(st.checkpoints),

@@ -144,6 +144,13 @@ def main(argv=None) -> int:
                         "KV shares — see kubeflow_tpu.tenancy. "
                         "Requests select a tenant with the X-Tenant "
                         "header; absent/unknown maps to 'default'")
+    p.add_argument("--pool", default="mixed",
+                   choices=("mixed", "prefill", "decode"),
+                   help="disaggregation role (continuous only for "
+                        "prefill/decode): 'prefill' replicas serve "
+                        ":prefill handoffs and ship KV blocks to the "
+                        "decode pool; 'decode' replicas receive them; "
+                        "'mixed' serves both phases (default)")
     p.add_argument("--fleet-router", default="",
                    help="fleet router base URL; the replica registers "
                         "and heartbeats there (kubeflow_tpu.fleet)")
@@ -175,6 +182,10 @@ def main(argv=None) -> int:
         p.error("--tenants requires --continuous")
     if args.advertise and not args.fleet_router:
         p.error("--advertise requires --fleet-router")
+    if args.pool != "mixed" and not args.continuous:
+        # the handoff path ships paged KV blocks, which only the
+        # continuous engine has
+        p.error("--pool prefill/decode requires --continuous")
 
     import jax
 
@@ -259,6 +270,7 @@ def main(argv=None) -> int:
         spec_gamma=args.spec_gamma,
         drain_grace_s=args.drain_grace_s,
         tenancy=tenancy,
+        pool=args.pool,
     )
     if args.fleet_router:
         enable_fleet_registration(

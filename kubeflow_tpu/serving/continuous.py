@@ -2943,8 +2943,13 @@ class ContinuousBatcher:
             if self._st is None:
                 self._st = self.cengine.init_slots()
 
-            def run_import(st=self._st):
-                return self.cengine.import_blocks(st, fresh, k, v)
+            def run_import():
+                # read self._st INSIDE the lock: import_blocks donates
+                # the slot-state buffers, so a reference captured before
+                # acquisition (another import, a decode step) would be
+                # deleted by whoever held the lock first
+                return self.cengine.import_blocks(
+                    self._st, fresh, k, v)
 
             async with self.gpu_lock:
                 self._st = await loop.run_in_executor(None, run_import)
@@ -2969,6 +2974,47 @@ class ContinuousBatcher:
             # the tree kept its own blocks, ours are duplicates
             pool.free(dup)
         return n_full - len(dup)
+
+    async def export_prefix(self, tokens: list[int], *, ns: str = "",
+                            request_id: str = "") -> dict | None:
+        """Disaggregated prefill handoff (ISSUE 12): pack the cached
+        full-block KV prefix of `tokens` into a migration wire record
+        with `out=[]` — the prefill half of a prefill->decode handoff.
+        The caller (the server's `:prefill` endpoint) pushes it to a
+        decode peer's `/v1/migrate/in`; the peer's `import_sequence`
+        indexes the blocks in its radix cache, so the re-issued
+        generation radix-hits the prefix and only the partial tail
+        block prefills there. Token-parity holds because radix reuse
+        is bit-exact and the blocks travel in canonical form.
+
+        Returns None when nothing is exportable (no cached full block
+        for this prompt, or no device state yet) — the caller treats
+        that as "skip the handoff", never as an error. Matched nodes
+        are ref-pinned for the duration of the device->host copy so
+        concurrent admission cannot evict them mid-export."""
+        ceng = self.cengine
+        bs = ceng.block_size
+        if self._st is None or len(tokens) < bs:
+            return None
+        nodes, _partial, _plen = self._radix.match(tokens, ns=ns)
+        if not nodes:
+            return None
+        self._radix.ref(nodes)
+        try:
+            phys = [n.block for n in nodes]
+            loop = asyncio.get_event_loop()
+            async with self.gpu_lock:
+                k_host, v_host = await loop.run_in_executor(
+                    None, ceng.export_blocks, self._st, phys)
+        finally:
+            self._radix.unref(nodes)
+        n_full = len(phys)
+        return migration.pack_record(
+            request_id=request_id, tenant="", ns=ns,
+            tokens=[int(t) for t in tokens[:n_full * bs]],
+            out=[], lps=[], max_new=0, sampling={},
+            geometry=migration.pool_geometry(ceng),
+            kv=(k_host, v_host))
 
     def in_flight(self) -> int:
         """Admitted-but-unfinished requests (pending, mid-prefill in

@@ -81,6 +81,11 @@ OBS_KEY: web.AppKey = web.AppKey("obs", object)
 DRAIN_KEY: web.AppKey = web.AppKey("drain_state", dict)
 FLEET_REG_KEY: web.AppKey = web.AppKey("fleet_registration", dict)
 TENANCY_KEY: web.AppKey = web.AppKey("tenancy", object)  # TenancyConfig|None
+POOL_KEY: web.AppKey = web.AppKey("pool_role", str)  # disagg role
+
+# Disaggregation roles (mirrors fleet.registry.POOLS — the serving
+# side must stay importable without the fleet package and vice versa)
+POOL_ROLES = ("mixed", "prefill", "decode")
 
 
 # Replica SLO defaults (ISSUE 6). TTFT thresholds are per priority
@@ -573,6 +578,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                        drain_grace_s: float = 30.0,
                        tenancy: TenancyConfig | None = None,
                        slo_ttft_s: dict[str, float] | None = None,
+                       pool: str = "mixed",
                        ) -> web.Application:
     """`tokenizer` (data.bpe.Tokenizer or anything with encode/decode)
     serves the "text" request mode; without one, the zero-training
@@ -620,8 +626,24 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     series. Without it the server is tenant-blind: FIFO admission,
     identical to before. `slo_ttft_s` overrides the per-priority-class
     TTFT SLO thresholds (`SLO_TTFT_THRESHOLDS_S`) feeding the
-    `slo_burn_rate` gauges — e.g. `{"interactive": 0.2}`."""
+    `slo_burn_rate` gauges — e.g. `{"interactive": 0.2}`.
+    `pool` declares the replica's disaggregation role (ISSUE 12):
+    "mixed" (default) serves both phases exactly as before;
+    "prefill"/"decode" (continuous only) advertise the role in fleet
+    heartbeats so the pool-aware router sends prompts to the prefill
+    pool and hands the filled KV blocks to decode replicas over
+    `/v1/migrate/in`. The role changes ROUTING, not capability —
+    either specialized replica can still serve a full generation, so
+    pool imbalance degrades to symmetric behavior instead of 503s."""
+    if pool not in POOL_ROLES:
+        raise ValueError(
+            f"pool must be one of {POOL_ROLES}, got {pool!r}")
+    if pool != "mixed" and not continuous:
+        raise ValueError(
+            f"pool={pool!r} requires continuous=True (the handoff "
+            "path ships paged KV blocks)")
     app = web.Application(middlewares=[_obs_middleware])
+    app[POOL_KEY] = pool
     app[DRAIN_KEY] = {"draining": False, "grace_s": float(drain_grace_s)}
     sobs = ServingObs(registry=registry, tracer=tracer,
                       slo_ttft_s=slo_ttft_s)
@@ -921,6 +943,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     app.router.add_get("/v1/models", list_models)
     app.router.add_get("/v1/requests/{id}/timeline", request_timeline)
     app.router.add_post("/v1/models/{name}:generate", generate)
+    app.router.add_post("/v1/models/{name}:prefill", prefill_handoff)
     app.router.add_post("/v1/models/{name}:score", score)
     return app
 
@@ -937,9 +960,14 @@ def fleet_stats(app: web.Application) -> dict:
     """Routing/autoscale stats in the fleet heartbeat's vocabulary
     (summed over models — the fleet registry tracks replicas, not
     model shards). max_slots for the window batcher is its max_batch
-    (the analog: requests co-scheduled per device call)."""
+    (the analog: requests co-scheduled per device call). `pool` is
+    this replica's disaggregation role and `phase_seconds` folds the
+    PhaseProfiler's cumulative totals into the two coarse phases the
+    pool autoscaler splits on (prefill + chunked prefill vs decode +
+    speculative draft/verify)."""
     queue_depth = active = max_slots = 0
     kv_free = kv_total = 0
+    phase_prefill = phase_decode = 0.0
     for b in app[BATCHERS_KEY].values():
         if isinstance(b, ContinuousBatcher):
             queue_depth += len(b._pending)
@@ -947,6 +975,12 @@ def fleet_stats(app: web.Application) -> dict:
             max_slots += len(b._free) + len(b._active)
             kv_free += b.cengine.pool.num_free
             kv_total += b.cengine.num_blocks
+            totals = b.profiler.totals()
+            phase_prefill += (totals.get("prefill", 0.0)
+                              + totals.get("prefill_chunk", 0.0))
+            phase_decode += (totals.get("decode", 0.0)
+                             + totals.get("draft", 0.0)
+                             + totals.get("verify", 0.0))
         else:
             queue_depth += b._queue.qsize()
             active += len(b._inflight)
@@ -956,6 +990,9 @@ def fleet_stats(app: web.Application) -> dict:
         "max_slots": max_slots, "kv_blocks_free": kv_free,
         "kv_blocks_total": kv_total,
         "draining": app[DRAIN_KEY]["draining"],
+        "pool": app.get(POOL_KEY, "mixed"),
+        "phase_seconds": {"prefill": round(phase_prefill, 6),
+                          "decode": round(phase_decode, 6)},
     }
 
 
@@ -1104,6 +1141,119 @@ async def migrate_in(request: web.Request):
            if isinstance(record, dict) else "")
     return web.json_response(
         {"imported": True, "blocks": blocks, "request_id": rid})
+
+
+async def prefill_handoff(request: web.Request):
+    """POST /v1/models/{name}:prefill — the prefill half of a
+    disaggregated handoff (ISSUE 12). Body: the usual `tokens`/`text`
+    prompt plus an optional `"peer"` URL (the decode replica the
+    pool-aware router picked). The replica prefills the prompt through
+    its normal admission path (chunked prefill + the fused
+    prefill/append kernel fill paged KV blocks, which the radix cache
+    indexes), exports the full-block prefix as a migration wire
+    record with `out=[]`, and pushes it to the peer's
+    `/v1/migrate/in`. The response reports whether the handoff landed;
+    the ROUTER then dispatches the real generation to the decode pool,
+    where the imported prefix radix-hits and only the partial tail
+    block prefills. Best-effort by design: any failure here just
+    costs the decode replica one ordinary prefill — correctness never
+    depends on this endpoint."""
+    app = request.app
+    if app[DRAIN_KEY]["draining"]:
+        return web.json_response(
+            {"error": "server is draining"}, status=503,
+            headers={"Retry-After": "5"})
+    name = request.match_info["name"]
+    engine = app[ENGINES_KEY].get(name)
+    if engine is None:
+        return web.json_response(
+            {"error": f"no model {name!r}"}, status=404)
+    batcher = app[BATCHERS_KEY].get(name)
+    if not isinstance(batcher, ContinuousBatcher):
+        return web.json_response(
+            {"error": "prefill handoff requires continuous batching"},
+            status=400)
+    try:
+        body: dict[str, Any] = await request.json()
+    except Exception:
+        return web.json_response({"error": "invalid JSON"}, status=400)
+    parsed = _parse_token_lists(body, app[TOKENIZER_KEY], min_len=1)
+    if isinstance(parsed, web.Response):
+        return parsed
+    token_lists, _text_mode = parsed
+    if len(token_lists) != 1:
+        return web.json_response(
+            {"error": "prefill handoff is single-prompt"}, status=400)
+    toks = [int(t) for t in token_lists[0]]
+    vocab = engine.cfg.vocab_size
+    if min(toks) < 0 or max(toks) >= vocab:
+        return web.json_response(
+            {"error": f"token ids must be in [0, {vocab})"}, status=400)
+    if len(toks) + 1 > engine.ec.max_len:
+        return web.json_response(
+            {"error": f"prompt {len(toks)} + 1 exceeds model max_len "
+                      f"{engine.ec.max_len}"}, status=400)
+    peer = body.get("peer", "")
+    if not isinstance(peer, str):
+        return web.json_response(
+            {"error": "peer must be a URL string"}, status=400)
+    rid = request.headers.get("X-Request-Id") or secrets.token_hex(8)
+    sampling: dict[str, Any] = {"request_id": rid}
+    tenant_hdr = request.headers.get("X-Tenant", "")
+    if tenant_hdr:
+        sampling["tenant"] = tenant_hdr
+    sobs: ServingObs = app[OBS_KEY]
+    t0 = time.monotonic()
+    try:
+        # max_new=1: the cheapest submission that runs the full prefill
+        # path and leaves the prompt's blocks indexed in the radix tree
+        # (at admission). The single decode token is discarded — the
+        # decode replica owns the generation.
+        with sobs.tracer.span("prefill.handoff", model=name):
+            await batcher.submit(toks, 1,
+                                 tuple(sorted(sampling.items())))
+    except Throttled as e:
+        return web.json_response(
+            {"error": str(e)}, status=429,
+            headers={"Retry-After": _retry_after_s(batcher, e)})
+    except Overloaded as e:
+        return web.json_response(
+            {"error": f"server overloaded: {e}"}, status=429,
+            headers={"Retry-After": _retry_after_s(batcher, e)})
+    except MigratedAway as e:
+        return web.json_response(
+            {"error": str(e), "migrated": True}, status=503,
+            headers={"Retry-After": "0"})
+    record = await batcher.export_prefix(toks, request_id=rid)
+    blocks = nbytes = 0
+    if record is not None and record.get("kv"):
+        blocks = int(record["kv"]["n_full"])
+        nbytes = len(record["kv"]["k"]) + len(record["kv"]["v"])
+    handoff = False
+    if record is not None and peer:
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                        f"{peer.rstrip('/')}/v1/migrate/in",
+                        json={"model": name, "record": record},
+                        timeout=aiohttp.ClientTimeout(total=30)) as r:
+                    handoff = r.status == 200
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            handoff = False
+        if handoff:
+            sobs.migration_out.inc(model=name)
+            if blocks:
+                sobs.migration_blocks.inc(
+                    blocks, model=name, direction="out")
+        else:
+            sobs.migration_failed.inc(model=name, direction="out")
+    return web.json_response({
+        "prefilled": True, "handoff": handoff, "blocks": blocks,
+        "bytes": nbytes if handoff else 0,
+        "handoff_s": round(time.monotonic() - t0, 6),
+        "request_id": rid})
 
 
 def sequence_checkpoints(app: web.Application) -> list[dict]:

@@ -36,6 +36,18 @@ rolls back without leaking a pool block, and p95 stays bounded. The
 JSON line records the injected-fault ledger and the drain-to-exit
 time.
 
+`--mode disagg` is the disaggregated-pools A/B (ISSUE 12): a fleet
+split into prefill/decode pools (prefill replicas fill paged KV
+blocks and ship them to the decode pool over /v1/migrate/in, the
+router pins each generate to the decode replica holding its prefix)
+against a symmetric fleet of EQUAL total replica count, both serving
+the same mixed long-prompt/short-decode workload. Outputs are
+compared request-for-request across the arms (sharpened lm_head:
+token parity is exact), and the disagg arm SIGKILLs one prefill
+replica after the timed window — zero client failures is the pass
+bar. The JSON line carries both arms' throughput plus the handoff
+outcome counts and shipped KV bytes.
+
 `--mode tenants` is the noisy-neighbor A/B for the multi-tenant QoS
 scheduler (kubeflow_tpu.tenancy): a batch-class tenant floods the
 server with long generations while an interactive tenant streams
@@ -172,6 +184,33 @@ params["lm_head"] = params["lm_head"] * 50.0
 eng = InferenceEngine(params, cfg, LLAMA_FAMILY, EngineConfig(max_len=128))
 app = srv.create_serving_app({{"tiny": eng}}, continuous=True, warmup=True,
                              kv_block_size={block_size})
+srv.enable_fleet_registration(app, {router!r},
+                              "http://127.0.0.1:{port}",
+                              replica_id="replica-{idx}", period_s=0.5)
+web.run_app(app, host="127.0.0.1", port={port}, print=None)
+'''
+
+
+# Disagg-arm replica: CHAOS_REPLICA_CODE (sharpened lm_head — the
+# handoff parity oracle needs byte-exact greedy generations) plus a
+# --pool role. A "prefill" replica serves :prefill handoffs and ships
+# KV blocks; a "decode" replica imports them; "mixed" is the
+# symmetric control arm.
+DISAGG_REPLICA_CODE = r'''
+import os, sys
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+sys.path.insert(0, {repo!r})
+import jax; jax.config.update("jax_platforms", "cpu")
+from aiohttp import web
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.serving.engine import InferenceEngine, LLAMA_FAMILY, EngineConfig
+from kubeflow_tpu.serving import server as srv
+cfg = llama.LLAMA_TINY
+params = dict(llama.init(jax.random.key(0), cfg))
+params["lm_head"] = params["lm_head"] * 50.0
+eng = InferenceEngine(params, cfg, LLAMA_FAMILY, EngineConfig(max_len={max_len}))
+app = srv.create_serving_app({{"tiny": eng}}, continuous=True, warmup=True,
+                             kv_block_size={block_size}, pool={pool!r})
 srv.enable_fleet_registration(app, {router!r},
                               "http://127.0.0.1:{port}",
                               replica_id="replica-{idx}", period_s=0.5)
@@ -550,6 +589,278 @@ def run_fleet(clients: int, requests: int, max_new: int, *,
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.wait()
+
+
+def run_disagg(clients: int, requests: int, max_new: int, *,
+               prefill_replicas: int = 1, decode_replicas: int = 3,
+               block_size: int = 8, long_every: int = 2,
+               long_blocks: int = 28, max_len: int = 256,
+               hedge_after_s: float = 10.0) -> dict:
+    """Disaggregated-pools A/B (ISSUE 12). Two fleets of EQUAL total
+    replica count serve the same mixed long-prompt/short-decode
+    workload through the router:
+
+    - arm A (disagg): `prefill_replicas` pool=prefill replicas +
+      `decode_replicas` pool=decode replicas — long prompts prefill on
+      the prefill pool and ship KV blocks to a decode replica over
+      /v1/migrate/in; short prompts pin straight to the decode pool;
+    - arm B (symmetric): the same total count of mixed replicas.
+
+    Every request's output is captured; the symmetric arm doubles as
+    the token-parity oracle (sharpened lm_head: greedy argmax cannot
+    flip), so the handoff path must be byte-exact against it. After
+    the timed window the disagg arm SIGKILLs one prefill replica and
+    pushes extra traffic through: the handoff is best-effort by
+    construction, so zero client failures is the pass bar."""
+    total = prefill_replicas + decode_replicas
+    # Long prompts must be EXPENSIVE relative to a decode step for the
+    # split to pay: a monolithic admission prefill of `long_blocks`
+    # blocks stalls every decode slot on a mixed replica, which is the
+    # head-of-line blocking the prefill pool absorbs.
+    prompt_len = long_blocks * block_size
+    if prompt_len + max_new > max_len:
+        raise ValueError(
+            f"long prompt {prompt_len} + max_new {max_new} exceeds "
+            f"max_len {max_len}")
+    short_len = block_size - 1          # short: below the handoff bar
+    long_new = max(2, max_new // 8)     # long prompts decode briefly
+    n_short = max(1, requests // 8)     # distinct short prompts (repeat)
+
+    def prompt_for(i: int) -> tuple[list, int]:
+        if i % long_every == 0:
+            # fresh long prompt every time: the prefill-heavy traffic
+            # whose head-of-line blocking disaggregation removes
+            return ([3 + i % 250, 100] + [7 + (i + t) % 200
+                                          for t in range(prompt_len - 2)],
+                    long_new)
+        j = i % n_short
+        return ([9 + j % 200, 50] + [11 + (j + t) % 150
+                                     for t in range(short_len - 2)],
+                max_new)
+
+    def arm(pools: list, kill_extra: bool) -> dict:
+        import tempfile
+
+        router_port = free_port()
+        rep_ports = [free_port() for _ in pools]
+        router_base = f"http://127.0.0.1:{router_port}"
+        log = tempfile.NamedTemporaryFile(
+            mode="w+", suffix=".log", prefix="kftpu-disagg-",
+            delete=False)
+        procs: list = []
+        try:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 ROUTER_CODE.format(repo=REPO, port=router_port,
+                                    block_size=block_size,
+                                    policy="affinity",
+                                    hedge_after_s=hedge_after_s)],
+                stdout=log, stderr=subprocess.STDOUT))
+            for idx, (port, pool) in enumerate(zip(rep_ports, pools)):
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-c",
+                     DISAGG_REPLICA_CODE.format(
+                         repo=REPO, port=port, idx=idx, pool=pool,
+                         router=router_base, block_size=block_size,
+                         max_len=max_len)],
+                    stdout=log, stderr=subprocess.STDOUT))
+
+            deadline = time.monotonic() + 180
+            ready = False
+            while time.monotonic() < deadline:
+                if any(p.poll() is not None for p in procs):
+                    break
+                try:
+                    snap = _get_json(f"{router_base}/fleet/replicas")
+                    if snap["counts"]["ready"] >= len(pools):
+                        ready = True
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.5)
+            if not ready:
+                log.flush()
+                with open(log.name) as f:
+                    tail = "\n".join(f.read().splitlines()[-30:])
+                rcs = [p.poll() for p in procs]
+                raise RuntimeError(
+                    f"disagg fleet never became ready (rcs={rcs}):"
+                    f"\n{tail}")
+
+            def post(base: str, body: dict,
+                     timeout: float = 120.0) -> dict:
+                req = urllib.request.Request(
+                    f"{base}/v1/models/tiny:generate",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return json.loads(r.read())
+
+            # direct warm on every replica: compile the admission
+            # shapes for BOTH prompt classes before the timed window
+            warm_long = [255, 99] + [5 + t % 200
+                                     for t in range(prompt_len - 2)]
+            warm_short = [254, 98] + [6 + t % 200
+                                      for t in range(short_len - 2)]
+
+            def warm(i: int) -> None:
+                base = f"http://127.0.0.1:{rep_ports[i % len(pools)]}"
+                post(base, {"tokens": [warm_long],
+                            "max_new": long_new})
+                post(base, {"tokens": [warm_short],
+                            "max_new": max_new})
+
+            with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+                for _ in range(2):
+                    list(ex.map(warm, range(max(clients, len(pools)))))
+
+            # routed warm: FRESH long prompts through the router so
+            # the disagg arm compiles its whole handoff path (export
+            # gather on the prefill pool, import scatter on every
+            # decode replica) before the timed window — the symmetric
+            # arm gets the same routed traffic for fairness
+            def warm_routed(i: int) -> None:
+                toks = [253 - i % 16, 97] + [4 + (i + t) % 190
+                                             for t in range(prompt_len - 2)]
+                post(router_base, {"tokens": [toks], "max_new": long_new})
+
+            with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+                for _ in range(2):
+                    list(ex.map(warm_routed,
+                                range(max(clients, 2 * len(pools)))))
+
+            failures = 0
+            outputs: dict = {}
+            lock = __import__("threading").Lock()
+
+            def one(i: int) -> float:
+                toks, new = prompt_for(i)
+                t0 = time.perf_counter()
+                try:
+                    out = post(router_base,
+                               {"tokens": [toks], "max_new": new})
+                    assert len(out["tokens"][0]) == new, out
+                except Exception:
+                    nonlocal failures
+                    with lock:
+                        failures += 1
+                    raise
+                if i < requests:
+                    # prompt_for(i) is deterministic, so request i is
+                    # the SAME prompt in both arms — capture for the
+                    # cross-arm parity check (kill-phase extras are
+                    # failure-counted only)
+                    with lock:
+                        outputs[i] = out["tokens"][0]
+                return time.perf_counter() - t0
+
+            stats0 = _get_json(f"{router_base}/fleet/stats")
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+                latencies = list(ex.map(one, range(requests)))
+            wall = time.perf_counter() - t0
+            stats1 = _get_json(f"{router_base}/fleet/stats")
+
+            killed = None
+            if kill_extra:
+                # SIGKILL the first prefill replica (terminate() would
+                # deregister gracefully), then push extra traffic: the
+                # handoff must fail OVER, never fail the client
+                killed = pools.index("prefill")
+                procs[1 + killed].kill()
+                procs[1 + killed].wait()
+                extra = max(8, requests // 4)
+                with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+                    list(ex.map(one, range(requests,
+                                           requests + extra)))
+
+            toks_out = sum(
+                (long_new if i % long_every == 0 else max_new)
+                for i in range(requests))
+            latencies.sort()
+            q = statistics.quantiles(latencies, n=20)
+            return {
+                "wall_s": round(wall, 2),
+                "tokens_per_sec": round(toks_out / wall, 1),
+                "requests_per_sec": round(requests / wall, 2),
+                "p50_s": round(q[9], 3),
+                "p95_s": round(q[18], 3),
+                "outputs": outputs,
+                "client_failures": failures,
+                "killed_replica": killed,
+                "handoff": {
+                    oc: int(stats1["handoff"][oc]
+                            - stats0["handoff"][oc])
+                    for oc in stats1["handoff"]},
+                "handoff_bytes": int(stats1["handoff_bytes"]
+                                     - stats0["handoff_bytes"]),
+                "route_by_pool": {
+                    pool: int(stats1["route_by_pool"][pool]
+                              - stats0["route_by_pool"][pool])
+                    for pool in stats1["route_by_pool"]},
+            }
+        finally:
+            log.close()
+            os.unlink(log.name)
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+
+    sym = arm(["mixed"] * total, kill_extra=False)
+    dis = arm(["prefill"] * prefill_replicas
+              + ["decode"] * decode_replicas, kill_extra=True)
+
+    # token parity: every prompt class the two arms both served must
+    # decode identically — the handoff ships KV, not approximations
+    shared = set(sym["outputs"]) & set(dis["outputs"])
+    assert shared, "arms captured no common requests"
+    mismatches = [i for i in sorted(shared)
+                  if sym["outputs"][i] != dis["outputs"][i]]
+    assert not mismatches, (
+        f"handoff token parity broken for requests {mismatches[:5]}")
+    assert dis["client_failures"] == 0, (
+        f"{dis['client_failures']} client failures in the disagg arm "
+        "(the handoff must be best-effort)")
+    assert dis["handoff"]["ok"] > 0, (
+        f"no handoff ever landed: {dis['handoff']}")
+
+    return {
+        "metric": "serving_disagg_throughput",
+        "mode": "disagg",
+        "prefill_replicas": prefill_replicas,
+        "decode_replicas": decode_replicas,
+        "total_replicas": total,
+        "clients": clients,
+        "requests": requests,
+        "max_new": max_new,
+        "long_every": long_every,
+        "long_prompt_len": prompt_len,
+        "short_prompt_len": short_len,
+        "kv_block_size": block_size,
+        "tokens_per_sec": dis["tokens_per_sec"],
+        "requests_per_sec": dis["requests_per_sec"],
+        "p50_s": dis["p50_s"],
+        "p95_s": dis["p95_s"],
+        "wall_s": dis["wall_s"],
+        "symmetric_tokens_per_sec": sym["tokens_per_sec"],
+        "symmetric_p50_s": sym["p50_s"],
+        "symmetric_p95_s": sym["p95_s"],
+        "disagg_speedup": round(
+            dis["tokens_per_sec"] / sym["tokens_per_sec"], 3),
+        "handoff": dis["handoff"],
+        "handoff_bytes": dis["handoff_bytes"],
+        "route_by_pool": dis["route_by_pool"],
+        "token_parity": True,
+        "parity_requests": len(shared),
+        "killed_prefill_replica": dis["killed_replica"],
+        "client_failures": dis["client_failures"],
+    }
 
 
 def run_chaos(clients: int, requests: int, max_new: int, *,
@@ -1542,8 +1853,18 @@ def main() -> int:
     p.add_argument("--batch-window-ms", type=int, default=5)
     p.add_argument("--mode",
                    choices=("window", "continuous", "fleet", "tenants",
-                            "chaos", "train-chaos"),
+                            "chaos", "train-chaos", "disagg"),
                    default="window")
+    p.add_argument("--disagg-prefill", type=int, default=1,
+                   help="disagg mode: prefill-pool replicas (arm A); "
+                        "the symmetric arm gets prefill+decode mixed "
+                        "replicas so total capacity matches")
+    p.add_argument("--disagg-decode", type=int, default=3,
+                   help="disagg mode: decode-pool replicas (arm A)")
+    p.add_argument("--disagg-long-every", type=int, default=2,
+                   help="disagg mode: every Nth request is a fresh "
+                        "long prompt (prefill-heavy); the rest are "
+                        "short repeated prompts (decode-heavy)")
     p.add_argument("--train-replicas", type=int, default=2,
                    help="train-chaos mode: trainer gang size (one "
                         "worker is SIGKILLed; the rest must finish at "
@@ -1646,6 +1967,23 @@ def main() -> int:
             replicas=args.fleet_replicas, policy=args.fleet_policy,
             block_size=args.fleet_block_size,
             kill_one=args.fleet_kill_one,
+            hedge_after_s=args.fleet_hedge_after_s)
+    elif args.mode == "disagg":
+        if args.disagg_prefill < 1 or args.disagg_decode < 1:
+            p.error("--mode disagg needs --disagg-prefill >= 1 and "
+                    "--disagg-decode >= 1 (an empty pool cannot serve)")
+        if args.disagg_long_every < 2:
+            p.error("--disagg-long-every must be >= 2 (the workload "
+                    "must mix long and short prompts)")
+        if args.requests < 2 * args.disagg_long_every:
+            p.error("--mode disagg needs --requests >= "
+                    "2 * --disagg-long-every")
+        result = run_disagg(
+            args.clients, args.requests, args.max_new,
+            prefill_replicas=args.disagg_prefill,
+            decode_replicas=args.disagg_decode,
+            block_size=args.fleet_block_size,
+            long_every=args.disagg_long_every,
             hedge_after_s=args.fleet_hedge_after_s)
     elif args.mode == "chaos":
         if args.fleet_replicas < 3:

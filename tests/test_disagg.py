@@ -1,0 +1,492 @@
+"""Disaggregated prefill/decode serving pools (ISSUE 12).
+
+Four layers, mirroring the feature's stack:
+
+- registry: pool roles on register/heartbeat, pool-aware `pick` (prefix
+  affinity INSIDE the prefill pool, relaxation when a pool is empty),
+  `disaggregated()` gating, garbage rejection for pool/phase stats;
+- autoscale: the phase-share pool split (`split_pools`) and the full
+  recommendation (`recommend_pools`) on fake phase metrics;
+- batcher: prefill->decode handoff token parity — a prompt prefilled on
+  replica A, its KV prefix exported with `export_prefix` (out=[]) and
+  imported on replica B, must decode EXACTLY what a symmetric replica
+  decodes, on llama AND gemma (different pool geometry);
+- router: the HTTP handoff path end-to-end against stub replicas,
+  including a dead prefill replica mid-handoff — the retry must land
+  the handoff on the live prefill replica and the client request must
+  still succeed (zero client failures by construction).
+"""
+
+import asyncio
+import socket
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+pytest_plugins = ("aiohttp.pytest_plugin",)
+
+from kubeflow_tpu.fleet import autoscale as autoscale_mod
+from kubeflow_tpu.fleet import router as router_mod
+from kubeflow_tpu.fleet.registry import (
+    DECODE,
+    DEGRADED,
+    MIXED,
+    PREFILL,
+    READY,
+    ReplicaRegistry,
+    rendezvous,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# -- registry: pool roles ---------------------------------------------------
+
+
+def test_registry_pool_roles_and_counts():
+    reg = ReplicaRegistry(clock=FakeClock())
+    reg.register("http://p:1", replica_id="p", pool=PREFILL)
+    reg.register("http://d:1", replica_id="d", pool=DECODE)
+    reg.register("http://m:1", replica_id="m")
+    assert reg.get("p").pool == PREFILL
+    assert reg.get("m").pool == MIXED          # default role
+    counts = reg.pool_counts()
+    assert counts[PREFILL][READY] == 1
+    assert counts[DECODE][READY] == 1
+    assert counts[MIXED][READY] == 1
+    assert counts[PREFILL][DEGRADED] == 0      # zero-filled grid
+    assert reg.disaggregated()
+    # the snapshot carries the role (the /fleet/replicas feed)
+    assert reg.get("p").snapshot()["pool"] == PREFILL
+    # role flips ride the heartbeat (a replica restarted with a new
+    # --pool re-registers, but a heartbeat update must also stick)
+    reg.heartbeat("m", pool=DECODE)
+    assert reg.get("m").pool == DECODE
+
+
+def test_registry_disaggregated_needs_both_live_pools():
+    clk = FakeClock()
+    reg = ReplicaRegistry(degraded_after_s=5, dead_after_s=15, clock=clk)
+    reg.register("http://p:1", replica_id="p", pool=PREFILL)
+    assert not reg.disaggregated()              # no decode pool yet
+    reg.register("http://d:1", replica_id="d", pool=DECODE)
+    assert reg.disaggregated()
+    # a DEAD prefill pool un-disaggregates the fleet (the router falls
+    # back to symmetric routing instead of handing off into a void)
+    clk.t = 16.0
+    reg.heartbeat("d")
+    reg.sweep()
+    assert not reg.disaggregated()
+
+
+def test_registry_rejects_garbage_pool_and_phase_stats():
+    reg = ReplicaRegistry(clock=FakeClock())
+    reg.register("http://a:1", replica_id="a", pool=PREFILL,
+                 phase_seconds={"prefill": 2.5, "decode": 0.5})
+    rep = reg.get("a")
+    assert rep.phase_seconds == {"prefill": 2.5, "decode": 0.5}
+    # unknown role string, negative/bool/typed-garbage phases: the
+    # open-world heartbeat body must never corrupt the closed label
+    # set or the autoscaler's math
+    reg.heartbeat("a", pool="gpu", phase_seconds={
+        "prefill": -1.0, "decode": True, 7: 3.0, "idle": 1.25})
+    rep = reg.get("a")
+    assert rep.pool == PREFILL                  # unchanged
+    assert rep.phase_seconds == {"idle": 1.25}  # only the clean entry
+    reg.heartbeat("a", phase_seconds="nope")
+    assert reg.get("a").phase_seconds == {"idle": 1.25}
+
+
+def test_pick_routes_inside_pool_with_affinity():
+    reg = ReplicaRegistry(clock=FakeClock())
+    reg.register("http://p0:1", replica_id="p0", pool=PREFILL)
+    reg.register("http://p1:1", replica_id="p1", pool=PREFILL)
+    reg.register("http://d0:1", replica_id="d0", pool=DECODE)
+    # affinity operates INSIDE the prefill pool: the rendezvous winner
+    # over the pool's candidate ids, never the decode replica
+    for s in range(3, 50):
+        key = f"{s} 1 2 3".encode()
+        rep, reason = reg.pick(key, pool=PREFILL)
+        assert rep.id in ("p0", "p1")
+        assert rep.id == rendezvous(key, ["p0", "p1"])
+        assert reason == "affinity"
+    # decode picks ignore the prefill pool
+    rep, reason = reg.pick(b"", pool=DECODE)
+    assert (rep.id, reason) == ("d0", "fallback")
+    # mixed replicas qualify for either role
+    reg.register("http://m:1", replica_id="m")
+    rep, _ = reg.pick(b"", {"d0"}, pool=DECODE)
+    assert rep.id == "m"
+
+
+def test_pick_relaxes_to_whole_fleet_when_pool_empty():
+    reg = ReplicaRegistry(clock=FakeClock())
+    reg.register("http://d0:1", replica_id="d0", pool=DECODE)
+    # no prefill replica at all: any replica beats a 503
+    rep, _ = reg.pick(b"", pool=PREFILL)
+    assert rep.id == "d0"
+    # but the caller can see the relaxation through the role
+    assert rep.pool == DECODE
+
+
+# -- autoscale: pool split --------------------------------------------------
+
+
+def test_split_pools_math():
+    # cold fleet: even split, decode takes the odd replica
+    assert autoscale_mod.split_pools(2, {}) == (1, 1)
+    assert autoscale_mod.split_pools(3, {}) == (1, 2)
+    assert autoscale_mod.split_pools(5, {}) == (2, 3)
+    # prefill-dominated phase time tilts the split
+    assert autoscale_mod.split_pools(
+        4, {"prefill": 3.0, "decode": 1.0}) == (3, 1)
+    # decode-dominated
+    assert autoscale_mod.split_pools(
+        4, {"prefill": 1.0, "decode": 3.0}) == (1, 3)
+    # each pool keeps at least one replica no matter how lopsided
+    assert autoscale_mod.split_pools(
+        4, {"prefill": 100.0, "decode": 0.0}) == (3, 1)
+    assert autoscale_mod.split_pools(
+        4, {"prefill": 0.0, "decode": 100.0}) == (1, 3)
+    with pytest.raises(ValueError):
+        autoscale_mod.split_pools(1, {})
+    with pytest.raises(ValueError):
+        autoscale_mod.split_pools(4, {"prefill": -1.0})
+
+
+def test_recommend_pools_on_fake_phase_metrics():
+    def rep(**kw):
+        base = {"state": READY, "queue_depth": 0, "active_slots": 0,
+                "max_slots": 8, "kv_blocks_free": 100,
+                "kv_blocks_total": 100,
+                "phase_seconds": {"prefill": 0.0, "decode": 0.0}}
+        base.update(kw)
+        return base
+
+    # demand 32 over 8 slots/replica -> 4 total; prefill phase share
+    # 0.75 -> 3 prefill / 1 decode
+    phases = {"prefill": 7.5, "decode": 2.5}
+    rec = autoscale_mod.recommend_pools(
+        [rep(active_slots=8, queue_depth=8, phase_seconds=phases),
+         rep(active_slots=8, queue_depth=8, phase_seconds=phases)],
+        max_replicas=8)
+    assert (rec.prefill, rec.decode) == (3, 1)
+    assert rec.desired == 4
+    assert rec.signals["prefill_share"] == 0.75
+    assert "3p/1d" in rec.reason
+    # dead replicas contribute no phase signal
+    rec = autoscale_mod.recommend_pools(
+        [rep(phase_seconds={"prefill": 1.0, "decode": 9.0}),
+         rep(state="dead", phase_seconds={"prefill": 500.0})],
+        max_replicas=8)
+    assert rec.signals["prefill_share"] == 0.1
+    assert rec.prefill == 1 and rec.decode >= 1
+    # a disaggregated fleet can never shrink below one replica per
+    # pool, whatever the symmetric math says
+    rec = autoscale_mod.recommend_pools([rep()], max_replicas=8)
+    assert rec.prefill >= 1 and rec.decode >= 1
+    with pytest.raises(ValueError):
+        autoscale_mod.recommend_pools([], min_replicas=1)
+
+
+# -- batcher: handoff token parity ------------------------------------------
+
+BS = 8
+MAX_NEW = 24
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3, 2, 3, 8, 4]
+
+
+def _build_engine(family: str):
+    import jax
+
+    from kubeflow_tpu.serving import (
+        EngineConfig,
+        GEMMA_FAMILY,
+        InferenceEngine,
+        LLAMA_FAMILY,
+    )
+
+    if family == "llama":
+        from kubeflow_tpu.models import llama
+        cfg = llama.LLAMA_TINY
+        params = dict(llama.init(jax.random.key(0), cfg))
+        params["lm_head"] = params["lm_head"] * 50.0  # argmax can't flip
+        return InferenceEngine(params, cfg, LLAMA_FAMILY,
+                               EngineConfig(max_len=64))
+    from kubeflow_tpu.models import gemma
+    cfg = gemma.GEMMA_TINY
+    params = dict(gemma.init(jax.random.key(1), cfg))
+    return InferenceEngine(params, cfg, GEMMA_FAMILY,
+                           EngineConfig(max_len=64))
+
+
+def _batcher(engine):
+    from kubeflow_tpu.serving.continuous import ContinuousBatcher
+
+    return ContinuousBatcher(engine, asyncio.Lock(), max_slots=2,
+                             kv_block_size=BS)
+
+
+@pytest.mark.parametrize("family", ["llama", "gemma"])
+async def test_handoff_token_parity_vs_symmetric_oracle(family):
+    """The disaggregated pipeline — prefill on A, ship the KV prefix,
+    decode on B — must emit EXACTLY the tokens one symmetric replica
+    emits. Radix reuse replays attention over the SAME cached blocks,
+    so this is an identity, not a tolerance."""
+    engine = _build_engine(family)
+    # symmetric-replica oracle: one batcher does everything
+    sym = _batcher(engine)
+    try:
+        oracle = await sym.submit(PROMPT, MAX_NEW, ())
+    finally:
+        await sym.close()
+
+    pre, dec = _batcher(engine), _batcher(engine)
+    try:
+        # prefill replica: max_new=1 runs the full prefill path and
+        # leaves the prompt's blocks radix-indexed (the :prefill
+        # endpoint's exact submission)
+        await pre.submit(PROMPT, 1, ())
+        rec = await pre.export_prefix(PROMPT)
+        assert rec is not None
+        assert rec["out"] == [] and rec["max_new"] == 0
+        n_full = rec["kv"]["n_full"]
+        assert n_full == len(PROMPT) // BS > 0
+        assert rec["tokens"] == PROMPT[:n_full * BS]
+        # decode replica: import the prefix, then decode the real
+        # budget — the imported blocks must radix-hit
+        adopted = await dec.import_sequence(rec)
+        assert adopted == n_full
+        out = await dec.submit(PROMPT, MAX_NEW, ())
+        assert out == oracle
+        assert dec.prefix_hits >= 1
+        assert dec.tokens_reused >= n_full * BS
+    finally:
+        await pre.close()
+        await dec.close()
+
+
+async def test_concurrent_imports_do_not_race_on_donated_state():
+    """Regression: import_blocks DONATES the slot-state buffers, so a
+    second import whose state reference was captured before the lock
+    used to hit 'buffer has been deleted or donated'. Disaggregated
+    handoffs make concurrent imports the steady state — every one of a
+    gather'd batch must adopt its blocks."""
+    engine = _build_engine("llama")
+    prompts = [[31 + i, 7] + [11 + (i + t) % 150
+                              for t in range(2 * BS - 2)]
+               for i in range(6)]
+    pre, dec = _batcher(engine), _batcher(engine)
+    try:
+        records = []
+        for p in prompts:
+            await pre.submit(p, 1, ())
+            rec = await pre.export_prefix(p)
+            assert rec is not None
+            records.append(rec)
+        adopted = await asyncio.gather(
+            *(dec.import_sequence(r) for r in records))
+        assert adopted == [len(p) // BS for p in prompts]
+    finally:
+        await pre.close()
+        await dec.close()
+
+
+async def test_export_prefix_skips_short_or_uncached_prompts():
+    engine = _build_engine("llama")
+    b = _batcher(engine)
+    try:
+        # nothing admitted yet: no slot state, nothing to export
+        assert await b.export_prefix(PROMPT) is None
+        await b.submit(PROMPT, 1, ())
+        # shorter than one block: no full block to ship
+        assert await b.export_prefix(PROMPT[:BS - 1]) is None
+        # a prompt the radix never saw: no cached prefix
+        assert await b.export_prefix([9] * (2 * BS)) is None
+    finally:
+        await b.close()
+
+
+# -- router: HTTP handoff end-to-end ----------------------------------------
+
+
+def _stub_pool_app(replica_name, calls, *, prefill_ok=True):
+    """Stub replica speaking both pool dialects: `:prefill` records
+    the handoff ask and answers like server.prefill_handoff;
+    `:generate` echoes. `calls` collects (endpoint, body) tuples."""
+    async def gen(request):
+        body = await request.json()
+        calls.append(("generate", body))
+        return web.json_response(
+            {"tokens": [[7] * body.get("max_new", 4)],
+             "served_by": replica_name})
+
+    async def prefill(request):
+        body = await request.json()
+        calls.append(("prefill", body))
+        if not prefill_ok:
+            return web.json_response({"error": "boom"}, status=500)
+        return web.json_response(
+            {"prefilled": True, "handoff": True, "blocks": 2,
+             "bytes": 4096, "handoff_s": 0.01,
+             "request_id": request.headers.get("X-Request-Id", "")})
+
+    app = web.Application()
+    app.router.add_post("/v1/models/{name}:generate", gen)
+    app.router.add_post("/v1/models/{name}:prefill", prefill)
+    return app
+
+
+async def _start_pool_stub(name, calls, **kw):
+    server = TestServer(_stub_pool_app(name, calls, **kw))
+    await server.start_server()
+    return server, f"http://127.0.0.1:{server.port}"
+
+
+def _prompt_mapped_to_pool_member(want_id, pool_ids, block_size=4):
+    """First token list whose affinity key rendezvous-maps to want_id
+    AMONG the pool's candidate ids (pool-aware pick hashes over the
+    pool, not the fleet)."""
+    for s in range(3, 4000):
+        toks = [s, 1, 2, 3]
+        key = router_mod.affinity_key({"tokens": [toks]}, block_size)
+        if rendezvous(key, list(pool_ids)) == want_id:
+            return toks
+    raise AssertionError(f"no prompt maps to {want_id}")
+
+
+async def test_router_disagg_handoff_and_pinned_decode(aiohttp_client):
+    """Happy path: the router prefills on the prefill pool, the
+    handoff lands, and the generate is pinned to the decode replica
+    that received the KV blocks."""
+    calls: list = []
+    pre_server, pre_url = await _start_pool_stub("pre", calls)
+    dec_server, dec_url = await _start_pool_stub("dec", calls)
+    reg = ReplicaRegistry()
+    reg.register(pre_url, replica_id="pre", pool=PREFILL)
+    reg.register(dec_url, replica_id="dec", pool=DECODE)
+    client = await aiohttp_client(router_mod.create_router_app(
+        reg, block_size=4, hedge_after_s=0, backoff_s=0.001))
+    try:
+        r = await client.post("/v1/models/tiny:generate",
+                              json={"tokens": [[5, 6, 7, 8]],
+                                    "max_new": 3})
+        assert r.status == 200
+        assert (await r.json())["served_by"] == "dec"
+        assert r.headers["X-Fleet-Replica"] == "dec"
+        # the prefill stub saw the prompt AND the decode peer URL
+        pre_calls = [b for ep, b in calls if ep == "prefill"]
+        assert len(pre_calls) == 1
+        assert pre_calls[0]["tokens"] == [[5, 6, 7, 8]]
+        assert pre_calls[0]["peer"] == dec_url
+        # the generate went ONLY to the decode replica
+        assert all(ep == "prefill" or b.get("max_new") == 3
+                   for ep, b in calls)
+        stats = await (await client.get("/fleet/stats")).json()
+        assert stats["handoff"]["ok"] == 1
+        assert stats["handoff"]["failed"] == 0
+        assert stats["handoff_bytes"] == 4096
+        assert stats["route_by_pool"][DECODE] >= 1
+        assert stats["route_by_pool"][PREFILL] >= 1
+        snap = await (await client.get("/fleet/replicas")).json()
+        assert snap["disaggregated"] is True
+        assert snap["pools"][PREFILL][READY] == 1
+        # the metric families federate from the first scrape
+        text = await (await client.get("/metrics")).text()
+        assert "fleet_handoff_seconds" in text
+        assert "fleet_handoff_bytes_total" in text
+        assert 'fleet_replicas{pool="prefill",state="ready"} 1' in text
+    finally:
+        await pre_server.close()
+        await dec_server.close()
+
+
+async def test_router_retries_handoff_past_dead_prefill_replica(
+        aiohttp_client):
+    """SIGKILL-a-prefill-replica-mid-handoff: the affinity target is a
+    registered prefill replica nobody listens on. The handoff must
+    retry onto the live prefill replica and the client request must
+    succeed — zero client failures."""
+    calls: list = []
+    pre_server, pre_url = await _start_pool_stub("pre-live", calls)
+    dec_server, dec_url = await _start_pool_stub("dec", calls)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_url = f"http://127.0.0.1:{s.getsockname()[1]}"
+    reg = ReplicaRegistry()
+    reg.register(pre_url, replica_id="pre-live", pool=PREFILL)
+    reg.register(dead_url, replica_id="pre-dead", pool=PREFILL)
+    reg.register(dec_url, replica_id="dec", pool=DECODE)
+    client = await aiohttp_client(router_mod.create_router_app(
+        reg, block_size=4, hedge_after_s=0, backoff_s=0.001))
+    try:
+        toks = _prompt_mapped_to_pool_member(
+            "pre-dead", ["pre-live", "pre-dead"])
+        r = await client.post("/v1/models/tiny:generate",
+                              json={"tokens": [toks], "max_new": 3})
+        assert r.status == 200                       # zero client failures
+        assert (await r.json())["served_by"] == "dec"
+        assert reg.get("pre-dead").state == DEGRADED  # failure noted
+        pre_calls = [b for ep, b in calls if ep == "prefill"]
+        assert len(pre_calls) == 1                   # landed on pre-live
+        stats = await (await client.get("/fleet/stats")).json()
+        assert stats["handoff"]["ok"] == 1
+    finally:
+        await pre_server.close()
+        await dec_server.close()
+
+
+async def test_router_skips_handoff_without_live_decode_pool(
+        aiohttp_client):
+    """A prefill-only fleet is NOT disaggregated: no handoff fires and
+    routing stays symmetric (any replica beats a 503)."""
+    calls: list = []
+    pre_server, pre_url = await _start_pool_stub("pre", calls)
+    reg = ReplicaRegistry()
+    reg.register(pre_url, replica_id="pre", pool=PREFILL)
+    client = await aiohttp_client(router_mod.create_router_app(
+        reg, block_size=4, hedge_after_s=0, backoff_s=0.001))
+    try:
+        r = await client.post("/v1/models/tiny:generate",
+                              json={"tokens": [[5, 6, 7, 8]],
+                                    "max_new": 2})
+        assert r.status == 200
+        assert not [1 for ep, _b in calls if ep == "prefill"]
+        stats = await (await client.get("/fleet/stats")).json()
+        assert stats["handoff"] == {"ok": 0, "skipped": 0, "failed": 0}
+    finally:
+        await pre_server.close()
+
+
+async def test_router_autoscale_pools_endpoint(aiohttp_client):
+    reg = ReplicaRegistry()
+    client = await aiohttp_client(router_mod.create_router_app(reg))
+    for rid, pool, phases in (
+            ("p0", PREFILL, {"prefill": 6.0, "decode": 0.0}),
+            ("d0", DECODE, {"prefill": 0.0, "decode": 2.0})):
+        r = await client.post("/fleet/register", json={
+            "id": rid, "url": f"http://{rid}:1", "models": ["tiny"],
+            "max_slots": 8, "active_slots": 8, "queue_depth": 8,
+            "pool": pool, "phase_seconds": phases})
+        assert r.status == 200
+    r = await client.get("/fleet/autoscale?pools=1&min=2&max=8")
+    body = await r.json()
+    assert r.status == 200
+    # demand 32 over 8 slots/replica -> 4; prefill share 0.75 -> 3p/1d
+    assert body["desired"] == 4
+    assert body["pools"] == {"prefill": 3, "decode": 1}
+    assert body["signals"]["prefill_share"] == 0.75
+    # the registry kept the heartbeated roles (the handoff's routing
+    # table and the autoscaler read the same records)
+    assert reg.get("p0").pool == PREFILL
+    assert reg.get("p0").phase_seconds["prefill"] == 6.0
+    # symmetric mode unchanged
+    r = await client.get("/fleet/autoscale?min=1&max=8")
+    assert "pools" not in await r.json()

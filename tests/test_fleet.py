@@ -297,7 +297,9 @@ async def test_router_routes_by_affinity_and_retries_dead_replica(
         # metrics expose the same counters + the replica-state gauge
         text = await (await client.get("/metrics")).text()
         assert "fleet_route_total" in text
-        assert 'fleet_replicas{state="ready"} 1' in text
+        # fleet_replicas carries (state, pool) since disaggregated
+        # pools landed; both replicas here are role-less -> mixed
+        assert 'fleet_replicas{pool="mixed",state="ready"} 1' in text
     finally:
         await good_server.close()
 
