@@ -1,7 +1,7 @@
 # Developer entry points (the reference drives everything through
 # per-component Makefiles; here one root Makefile covers the repo).
 
-.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check profile-check serving-check fleet-check kernels-check tenancy-check chaos-check train-check disagg-check
+.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check profile-check serving-check fleet-check kernels-check tenancy-check chaos-check train-check disagg-check cache-check
 
 verify-all:  ## the full evidence sweep, one command
 	python -m pytest tests -q -m "slow or not slow"
@@ -73,6 +73,11 @@ disagg-check: ## disaggregated prefill/decode gate: unit suite + pool metrics co
 	JAX_PLATFORMS=cpu python -m ci.obs_check disagg
 	JAX_PLATFORMS=cpu python loadtest/serving_loadtest.py --mode disagg \
 	  --clients 12 --requests 48 --max-new 16
+
+cache-check: ## KV-cache observatory gate: ledger/heat/counterfactual suite + cache metrics contract
+	JAX_PLATFORMS=cpu python -m pytest tests/test_cachestats.py -q \
+	  -m "slow or not slow"
+	JAX_PLATFORMS=cpu python -m ci.obs_check cache
 
 tenancy-check: ## multi-tenant QoS gate: unit suite + noisy-neighbor A/B loadtest
 	JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q \

@@ -741,14 +741,15 @@ def bench_decode_paged(model: str, *, slots: int, prompt_len: int,
             stats = batcher.prefix_cache_stats()
             blocks_in_use = batcher.kv_blocks_in_use()
             blk_bytes = batcher.cengine.kv_block_bytes()
+            anatomy = batcher.cache_ledger.snapshot()
             return dt, {k: stats[k] - base.get(k, 0)
                         for k in ("hits", "misses", "tokens_prefilled",
                                   "tokens_reused")}, \
-                blocks_in_use, blk_bytes
+                blocks_in_use, blk_bytes, anatomy
         finally:
             await batcher.close()
 
-    dt, stats, blocks_in_use, blk_bytes = asyncio.run(run())
+    dt, stats, blocks_in_use, blk_bytes, anatomy = asyncio.run(run())
     n_devices = len(jax.devices())
     tok_per_sec = requests * max_new / dt / n_devices
     hit_rate = stats["hits"] / max(1, stats["hits"] + stats["misses"])
@@ -758,6 +759,16 @@ def bench_decode_paged(model: str, *, slots: int, prompt_len: int,
     dense_bytes = eng.kv_cache_bytes(slots)
 
     gen = detect_generation()
+    # cache anatomy (ISSUE 13): recent-window reuse-distance quantiles
+    # (in admissions — how far apart touches of the same block land)
+    # and the eviction-cause mix from the block lifecycle ledger. The
+    # bench is the offline half of the sizing walkthrough in
+    # docs/operator-guide.md: reuse-distance p95 vs pool capacity says
+    # whether kv_pool_blocks has headroom.
+    reuse_p50 = anatomy["reuse_distance"]["p50"] or 0.0
+    reuse_p95 = anatomy["reuse_distance"]["p95"] or 0.0
+    cause_mix = {c: anatomy["frees"].get(c, 0)
+                 for c in ("lru", "pressure", "refdrop")}
     if verbose:
         print(f"# decode-paged model={model} slots={slots} "
               f"requests={requests} tok/s={tok_per_sec:.1f} "
@@ -765,6 +776,9 @@ def bench_decode_paged(model: str, *, slots: int, prompt_len: int,
               f"reused={stats['tokens_reused']} "
               f"kv_bytes={paged_bytes} (dense {dense_bytes})",
               file=sys.stderr)
+        print(f"# decode-paged reuse_distance p50={reuse_p50} "
+              f"p95={reuse_p95} eviction_mix={cause_mix} "
+              f"conserved={anatomy['conserved']}", file=sys.stderr)
     return {
         "metric": ("serving_decode_tokens_per_sec_per_chip"
                    f"[{model}-paged,{gen}]"),
@@ -787,6 +801,17 @@ def bench_decode_paged(model: str, *, slots: int, prompt_len: int,
              "value": float(paged_bytes), "unit": "bytes",
              "vs_baseline": round(
                  dense_bytes / max(1, paged_bytes), 4)},
+            {"metric": f"serving_kv_reuse_distance_p50[{model},{gen}]",
+             "value": float(reuse_p50), "unit": "admissions",
+             "vs_baseline": 1.0},
+            {"metric": f"serving_kv_reuse_distance_p95[{model},{gen}]",
+             "value": float(reuse_p95), "unit": "admissions",
+             "vs_baseline": 1.0},
+            *[{"metric":
+               f"serving_kv_evictions_{c}[{model},{gen}]",
+               "value": float(n), "unit": "blocks",
+               "vs_baseline": 1.0}
+              for c, n in cause_mix.items()],
         ],
     }
 

@@ -404,6 +404,188 @@ async def run_fleet_check() -> list[str]:
     return failures
 
 
+async def run_cache_check() -> list[str]:
+    """Sixth act (ISSUE 13): the KV-cache observatory contract. Boot
+    the serving app with a tiny continuous engine, drive a cold miss +
+    a warm hit (one request tenant-labelled), then hold the cache
+    plane to its contract: `/metrics` strict-parses with the eviction
+    cause set, defer cause set, and tenant-labelled hit/miss series
+    all zero-seeded; the block lifecycle ledger CONSERVES (cause sums
+    == total frees, `unattributed` == 0, births - frees == live) and
+    the per-cause metric values equal the ledger's; `/debug/profile`
+    carries the cache anatomy + hashed heat digest; `/debug/traces`
+    carries the kv_evictions counter track; `/v1/models` exports the
+    heat digest in 16-hex hashed form."""
+    import jax
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu import obs as obs_lib
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.serving import (
+        EngineConfig,
+        InferenceEngine,
+        LLAMA_FAMILY,
+    )
+    from kubeflow_tpu.serving import server as server_lib
+    from kubeflow_tpu.tenancy import config_from_dict
+
+    failures: list[str] = []
+    cfg = llama.LLAMA_TINY
+    params = llama.init(jax.random.key(0), cfg)
+    engine = InferenceEngine(params, cfg, LLAMA_FAMILY,
+                             EngineConfig(max_len=64))
+    # block size 8 so a short prompt still fills whole KV blocks (the
+    # unit the ledger and the heat digest account in); a tenancy
+    # config so the X-Tenant header reaches the tenant-labelled
+    # hit/miss series
+    app = server_lib.create_serving_app(
+        {"m": engine}, continuous=True, max_batch=2, kv_block_size=8,
+        tenancy=config_from_dict({"tenants": {"acme": {}}}))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        prompt = [3, 5, 7, 11, 13, 17, 19, 23]  # one full block
+        r = await client.post("/v1/models/m:generate",
+                              json={"tokens": [prompt], "max_new": 4})
+        if r.status != 200:
+            return [f"generate -> {r.status}: {await r.text()}"]
+        # warm repeat, tenant-labelled: radix hit + tenant series inc
+        r = await client.post("/v1/models/m:generate",
+                              json={"tokens": [prompt], "max_new": 4},
+                              headers={"X-Tenant": "acme"})
+        if r.status != 200:
+            return [f"generate -> {r.status}: {await r.text()}"]
+
+        # 1. /metrics: strict parse + zero-seeded closed cause sets
+        text = await (await client.get("/metrics")).text()
+        try:
+            families = parse_exposition(text)
+        except ExpositionError as e:
+            return [f"serving /metrics failed strict parse: {e}"]
+
+        def sample(fam: str, sname: str, **labels):
+            f = families.get(fam)
+            if f is None:
+                failures.append(f"/metrics missing family {fam}")
+                return None
+            key = (sname, tuple(sorted(labels.items())))
+            if key not in f["samples"]:
+                failures.append(
+                    f"/metrics missing sample {sname}{labels}")
+                return None
+            return f["samples"][key]
+
+        causes = (*obs_lib.EVICTION_CAUSES, obs_lib.UNATTRIBUTED)
+        evict = {c: sample("serving_kv_evictions_total",
+                           "serving_kv_evictions_total",
+                           model="m", cause=c) for c in causes}
+        for c in obs_lib.DEFER_CAUSES:
+            sample("serving_kv_admission_defers_total",
+                   "serving_kv_admission_defers_total",
+                   model="m", cause=c)
+        for fam in ("serving_kv_reuse_distance_admissions",
+                    "serving_kv_block_age_admissions"):
+            sample(fam, f"{fam}_count", model="m")
+        if (sample("serving_kv_reuse_distance_admissions",
+                   "serving_kv_reuse_distance_admissions_count",
+                   model="m") or 0) < 1:
+            failures.append(
+                "no reuse-distance sample after a radix hit")
+        if evict.get(obs_lib.UNATTRIBUTED):
+            failures.append(
+                f"unattributed evictions: {evict[obs_lib.UNATTRIBUTED]}"
+                " — some pool.free() site forgot its cause")
+        # tenant-labelled hit/miss series: zero-seeded "other" plus
+        # the real tenant, alongside the bitwise-compatible unlabelled
+        # (model-only) series
+        for fam in ("serving_prefix_cache_hits_total",
+                    "serving_prefix_cache_misses_total"):
+            plain = sample(fam, fam, model="m")
+            sample(fam, fam, model="m", tenant="other")
+            tenanted = sample(fam, fam, model="m", tenant="acme")
+            if plain is not None and tenanted is not None \
+                    and plain < tenanted:
+                failures.append(
+                    f"{fam}: model-only series ({plain}) < tenant "
+                    f"series ({tenanted}) — totals must stay supersets")
+        hits = sample("serving_prefix_cache_hits_total",
+                      "serving_prefix_cache_hits_total",
+                      model="m", tenant="acme")
+        if hits is not None and hits < 1:
+            failures.append(
+                "tenant-labelled prefix hit not booked for the warm "
+                f"repeat (got {hits})")
+
+        # 2. /debug/profile: cache anatomy, conservation, heat digest
+        prof = json.loads(
+            await (await client.get("/debug/profile")).text())
+        cache = prof.get("models", {}).get("m", {}).get("cache")
+        if cache is None:
+            failures.append("/debug/profile has no cache anatomy")
+        else:
+            led = cache.get("ledger", {})
+            for key in ("births", "frees", "frees_total",
+                        "live_blocks", "defers", "reuse_distance",
+                        "block_age", "conserved"):
+                if key not in led:
+                    failures.append(
+                        f"/debug/profile cache.ledger missing {key!r}")
+            if not led.get("conserved"):
+                failures.append(
+                    f"cache ledger NOT conserved: {led}")
+            if sum(led.get("frees", {}).values()) \
+                    != led.get("frees_total"):
+                failures.append(
+                    "eviction causes do not sum to total frees: "
+                    f"{led.get('frees')}")
+            # the /metrics counters and the ledger are the same books
+            for c, v in (led.get("frees") or {}).items():
+                if evict.get(c) is not None and evict[c] != v:
+                    failures.append(
+                        f"serving_kv_evictions_total{{cause={c}}} = "
+                        f"{evict[c]} but ledger says {v}")
+            heat = cache.get("heat")
+            if not heat:
+                failures.append("/debug/profile cache.heat is empty "
+                                "after two admissions")
+            else:
+                want = obs_lib.prefix_hash(prompt)
+                if heat[0].get("prefix") != want:
+                    failures.append(
+                        f"hottest prefix {heat[0]} is not the hashed "
+                        f"prompt block {want}")
+
+        # 3. /debug/traces: the kv_evictions counter track
+        payload = json.loads(
+            await (await client.get("/debug/traces")).text())
+        events = payload.get("traceEvents") or []
+        counters = {e.get("name") for e in events
+                    if e.get("ph") == "C"}
+        if "m.kv_evictions" not in counters:
+            failures.append(
+                "serving /debug/traces has no m.kv_evictions counter "
+                f"track (got {sorted(counters)})")
+
+        # 4. /v1/models: bounded hashed heat digest on the model card
+        models = json.loads(
+            await (await client.get("/v1/models")).text())["models"]
+        pc = models[0].get("prefix_cache", {})
+        dg = pc.get("heat")
+        if not isinstance(dg, list) or not dg:
+            failures.append("/v1/models prefix_cache.heat missing")
+        elif not all(
+                isinstance(e.get("prefix"), str)
+                and len(e["prefix"]) == 16
+                and all(ch in "0123456789abcdef" for ch in e["prefix"])
+                and isinstance(e.get("score"), (int, float))
+                for e in dg):
+            failures.append(
+                f"/v1/models heat digest is not 16-hex + score: {dg}")
+    finally:
+        await client.close()
+    return failures
+
+
 async def run_train_check() -> list[str]:
     """Fourth act (ISSUE 11): boot the elastic-training coordinator —
     real aiohttp app, no jax — and hold its /metrics to the strict
@@ -652,11 +834,12 @@ async def run_disagg_check() -> list[str]:
 
 
 def main(argv: list[str] | None = None) -> int:
-    """Default: all five acts. `python -m ci.obs_check profile` runs
-    only the serving step-anatomy act (`make profile-check`) — it is
-    the only act that compiles jax programs, so the fast acts stay
-    usable on their own. `python -m ci.obs_check disagg` is the
-    metrics half of `make disagg-check`."""
+    """Default: all six acts. `python -m ci.obs_check profile` runs
+    only the serving step-anatomy act (`make profile-check`); it and
+    `cache` are the acts that compile jax programs, so the fast acts
+    stay usable on their own. `python -m ci.obs_check disagg` is the
+    metrics half of `make disagg-check`, `cache` of
+    `make cache-check`."""
     import asyncio
 
     argv = sys.argv[1:] if argv is None else argv
@@ -666,6 +849,7 @@ def main(argv: list[str] | None = None) -> int:
         "fleet": run_fleet_check,
         "train": run_train_check,
         "disagg": run_disagg_check,
+        "cache": run_cache_check,
     }
     wanted = argv or list(acts)
     unknown = [a for a in wanted if a not in acts]
@@ -685,8 +869,10 @@ def main(argv: list[str] | None = None) -> int:
           "tracks), /debug/profile serves the step anatomy, "
           "/fleet/metrics federates two replicas under the same "
           "contract, the train_* catalog zero-seeds + tracks "
-          "membership, and the pool-labeled disaggregation plane "
-          "zero-seeds + tracks a prefill->decode handoff")
+          "membership, the pool-labeled disaggregation plane "
+          "zero-seeds + tracks a prefill->decode handoff, and the "
+          "KV-cache ledger conserves (causes sum to frees, zero "
+          "unattributed) with a hashed heat digest on the model card")
     return 0
 
 

@@ -551,6 +551,46 @@ def disagg_check_workflow() -> dict:
     }
 
 
+def cache_check_workflow() -> dict:
+    """KV-cache observatory gate (ISSUE 13): `make cache-check` runs
+    the block-lifecycle ledger suite (conservation under radix reuse /
+    preemption / migration / duplicate import, reuse-distance math on
+    a scripted trace, decayed heat ranking, heartbeat digest
+    round-trip, the router's two-real-replica counterfactual counter)
+    plus the cache metrics contract (`serving_kv_evictions_total`
+    cause set zero-seeded with cause sums == ledger frees and zero
+    `unattributed`, defer causes, tenant-labelled hit/miss series,
+    hashed heat digest on `/v1/models`). The conservation invariant is
+    structural — any new `pool.free()` site that forgets its cause
+    fails here, not in a dashboard six weeks later."""
+    return {
+        "name": "cache check",
+        "on": {
+            "pull_request": {"paths": ["kubeflow_tpu/obs/**",
+                                       "kubeflow_tpu/serving/**",
+                                       "kubeflow_tpu/fleet/**",
+                                       "tests/test_cachestats.py",
+                                       "ci/obs_check.py",
+                                       "Makefile"]},
+            "push": {"branches": ["main"]},
+        },
+        "jobs": {
+            "cache-check": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e .[ci] pytest"},
+                    {"name": "ledger suite + cache metrics contract",
+                     "run": "make cache-check",
+                     "env": {"JAX_PLATFORMS": "cpu"}},
+                ],
+            }
+        },
+    }
+
+
 def tenancy_check_workflow() -> dict:
     """Multi-tenant QoS gate: `make tenancy-check` runs the tenancy
     unit suite (fair-share math, preemption token-identity, prefix
@@ -683,6 +723,7 @@ def all_workflows() -> dict[str, dict]:
     out["chaos_check.yaml"] = chaos_check_workflow()
     out["train_check.yaml"] = train_check_workflow()
     out["disagg_check.yaml"] = disagg_check_workflow()
+    out["cache_check.yaml"] = cache_check_workflow()
     out["tenancy_check.yaml"] = tenancy_check_workflow()
     out["kernels_check.yaml"] = kernels_check_workflow()
     out["profile_check.yaml"] = profile_check_workflow()

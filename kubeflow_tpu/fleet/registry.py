@@ -87,6 +87,10 @@ class Replica:
     # cumulative step-phase seconds from the replica's PhaseProfiler
     # ({"prefill": s, "decode": s}): the pool autoscaler's only signal
     phase_seconds: dict = field(default_factory=dict)
+    # heartbeat-reported prefix-heat digest: top-K
+    # [{"prefix": 16-hex, "score": float}] — feeds /fleet/cache and
+    # the counterfactual remote-hit counter
+    cache_digest: list = field(default_factory=list)
     # router-side accounting
     inflight: int = 0            # proxied requests currently open
     failures: int = 0            # consecutive router-observed failures
@@ -112,6 +116,7 @@ class Replica:
             "max_slots": self.max_slots,
             "kv_blocks_free": self.kv_blocks_free,
             "kv_blocks_total": self.kv_blocks_total,
+            "cache_digest": [dict(d) for d in self.cache_digest],
             "inflight": self.inflight, "failures": self.failures,
             "circuit_open_until": self.circuit_open_until,
             "last_heartbeat_age_s": None,
@@ -217,6 +222,24 @@ class ReplicaRegistry:
                      and not isinstance(v, bool) and v >= 0.0}
             if clean or not ph:
                 rep.phase_seconds = clean
+        # prefix-heat digest: keep only well-formed entries — 16-hex
+        # prefix names (the hashed-LabelGuard format, so a replica
+        # can never smuggle raw tokens or unbounded strings into the
+        # fleet heat map) with finite non-negative scores — and cap
+        # the list length defensively
+        dg = stats.get("cache_digest")
+        if isinstance(dg, list):
+            clean_dg = []
+            for e in dg[:64]:
+                if not isinstance(e, dict):
+                    continue
+                p, s = e.get("prefix"), e.get("score")
+                if (isinstance(p, str) and len(p) == 16
+                        and all(c in "0123456789abcdef" for c in p)
+                        and isinstance(s, (int, float))
+                        and not isinstance(s, bool) and s >= 0.0):
+                    clean_dg.append({"prefix": p, "score": float(s)})
+            rep.cache_digest = clean_dg
 
     def drain(self, replica_id: str) -> bool:
         rep = self._replicas.get(replica_id)

@@ -63,11 +63,9 @@ ROUTE_REASONS = ("affinity", "fallback", "hedge", "retry")
 _BOS, _BYTE_OFFSET = 1, 3
 
 
-def affinity_key(body: dict, block_size: int) -> bytes:
-    """Routing key: the first `block_size`-aligned token block of the
-    prompt. Requests sharing it co-locate on one replica (where the
-    radix cache can serve it); malformed bodies key to b"" (no
-    affinity — the replica will 400 them, but through a live one)."""
+def affinity_tokens(body: dict, block_size: int) -> list[int] | None:
+    """The first `block_size` prompt tokens the routing key and the
+    prefix-heat hash are both built from; None for malformed bodies."""
     toks = None
     if isinstance(body, dict):
         t = body.get("tokens")
@@ -78,9 +76,18 @@ def affinity_key(body: dict, block_size: int) -> bytes:
         elif isinstance(body.get("text"), str):
             toks = [_BOS] + [b + _BYTE_OFFSET
                              for b in body["text"].encode("utf-8")]
+    return toks[:block_size] if toks else None
+
+
+def affinity_key(body: dict, block_size: int) -> bytes:
+    """Routing key: the first `block_size`-aligned token block of the
+    prompt. Requests sharing it co-locate on one replica (where the
+    radix cache can serve it); malformed bodies key to b"" (no
+    affinity — the replica will 400 them, but through a live one)."""
+    toks = affinity_tokens(body, block_size)
     if not toks:
         return b""
-    return " ".join(str(x) for x in toks[:block_size]).encode()
+    return " ".join(str(x) for x in toks).encode()
 
 
 def _byte_decode_fleet(ids) -> str:
@@ -201,6 +208,18 @@ class FleetObs:
             "fleet_hedge_wins_total",
             "Hedged duplicates that answered before the primary",
             self.registry)
+        # Counterfactual fleet prefix hits (ISSUE 13): requests whose
+        # chosen replica's heat digest lacked the routing prefix while
+        # some OTHER replica's digest had it hot — each one is a
+        # prefill a cross-replica cache tier would have saved. The gap
+        # between (hits + remote_hits) / lookups and the measured
+        # affinity hit rate is that tier's business case, as a number.
+        self.remote_hits = Counter(
+            "fleet_prefix_remote_hits_total",
+            "Routed generates whose prefix was cold on the chosen "
+            "replica but hot in a peer's heat digest — misses a "
+            "cross-replica KV cache tier would have served",
+            self.registry)
         self.failover = Counter(
             "fleet_failover_total",
             "In-flight generations re-dispatched to a healthy replica "
@@ -260,6 +279,7 @@ class FleetObs:
         self.hedge_wins.inc(0)
         self.failover.inc(0)
         self.handoff_bytes.inc(0)
+        self.remote_hits.inc(0)
         for _oc in ("ok", "skipped", "failed"):
             self.handoff_seconds.seed(outcome=_oc)
 
@@ -701,6 +721,28 @@ def _pick_target(st: _FleetState, key: bytes, exclude: set,
     return _choose(st, key, exclude, pool)
 
 
+def _note_counterfactual(st: "_FleetState", body, rep) -> None:
+    """Counterfactual fleet prefix hit (ISSUE 13): the request landed
+    on `rep` whose heartbeat heat digest does NOT show its routing
+    prefix (so the replica almost certainly prefilled it cold), while
+    some OTHER replica's digest shows it hot — a cross-replica cache
+    tier would have served this prefix remotely. Hashes join because
+    replica digests and this check both run `prefix_hash` over the
+    same first-KV-block token slice (namespaced tenant entries are
+    salted and simply never match — conservative undercount)."""
+    toks = affinity_tokens(body, st.block_size)
+    if not toks:
+        return
+    h = obs_lib.prefix_hash(toks)
+    if any(e.get("prefix") == h for e in rep.cache_digest):
+        return
+    for other in st.registry.replicas():
+        if other.id != rep.id and any(
+                e.get("prefix") == h for e in other.cache_digest):
+            st.obs.remote_hits.inc()
+            return
+
+
 async def _routed_generate(request: web.Request):
     st: _FleetState = request.app[FLEET_KEY]
     name = request.match_info["name"]
@@ -794,6 +836,7 @@ async def _routed_generate(request: web.Request):
                 st.obs.failover.inc()
             dt = time.perf_counter() - t0
             st.obs.note_route(reason, rep.pool)
+            _note_counterfactual(st, body, rep)
             st.obs.route_latency.observe(dt, model=name, reason=reason)
             st.obs.slo.observe("fleet_route_latency", dt)
             st.obs.slo.record("fleet_availability", status < 500)
@@ -910,6 +953,7 @@ async def _routed_stream(request: web.Request, st: _FleetState,
                     tried.add(replica.id)
                     continue
                 st.obs.note_route(reason, replica.pool)
+                _note_counterfactual(st, body, replica)
                 if resp is None:
                     headers = {
                         "Content-Type": "text/event-stream",
@@ -1012,7 +1056,7 @@ async def _register(request: web.Request):
         **{k: v for k, v in body.items()
            if k in ("queue_depth", "active_slots", "max_slots",
                     "kv_blocks_free", "kv_blocks_total",
-                    "pool", "phase_seconds")})
+                    "pool", "phase_seconds", "cache_digest")})
     st.ingest_checkpoints(rep.id, body.get("checkpoints"))
     log.info("fleet: registered replica %s at %s", rep.id, rep.url)
     return web.json_response({"id": rep.id, "state": rep.state})
@@ -1037,7 +1081,7 @@ async def _heartbeat(request: web.Request):
         k: v for k, v in body.items()
         if k in ("queue_depth", "active_slots", "max_slots",
                  "kv_blocks_free", "kv_blocks_total", "draining",
-                 "pool", "phase_seconds")})
+                 "pool", "phase_seconds", "cache_digest")})
     if not ok:
         # unknown id: the router restarted and lost its table — 404
         # tells the replica to re-register (server.py's beat loop does)
@@ -1214,6 +1258,40 @@ async def _scrape_replicas(st: _FleetState, path: str, *,
     return await asyncio.gather(*(fetch(rep) for rep in reps))
 
 
+async def _fleet_cache(request: web.Request):
+    """GET /fleet/cache — the fleet-wide prefix heat map: every
+    replica's heartbeat heat digest, plus the merged view (scores
+    summed per 16-hex prefix, carriers listed), plus the cumulative
+    counterfactual remote-hit count. No replica round-trips: this
+    reads the registry table the heartbeats already fed, so it is
+    cheap enough for a loadtest to poll."""
+    st: _FleetState = request.app[FLEET_KEY]
+    st.registry.sweep()
+    per_replica = {}
+    merged: dict[str, dict] = {}
+    for rep in sorted(st.registry.replicas(), key=lambda r: r.id):
+        digest = [dict(e) for e in rep.cache_digest]
+        per_replica[rep.id] = {"state": rep.state, "pool": rep.pool,
+                               "digest": digest}
+        for e in digest:
+            m = merged.setdefault(
+                e["prefix"], {"prefix": e["prefix"], "score": 0.0,
+                              "replicas": []})
+            m["score"] = round(m["score"] + e["score"], 4)
+            m["replicas"].append(rep.id)
+    heat = sorted(merged.values(), key=lambda m: m["score"],
+                  reverse=True)
+    return web.json_response({
+        "replicas": per_replica,
+        "heat": heat,
+        # prefixes hot on >1 replica: each is duplicated prefill work
+        # a cross-replica cache tier would de-duplicate
+        "shared_prefixes": sum(1 for m in heat
+                               if len(m["replicas"]) > 1),
+        "remote_hits_total": st.obs.remote_hits.value(),
+    })
+
+
 async def _fleet_metrics(request: web.Request):
     """GET /fleet/metrics — one exposition for the whole fleet: every
     routable replica's /metrics scraped, strictly parsed, and merged
@@ -1362,6 +1440,7 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
     app.router.add_get("/fleet/replicas", _replicas)
     app.router.add_get("/fleet/autoscale", _autoscale)
     app.router.add_get("/fleet/stats", _stats)
+    app.router.add_get("/fleet/cache", _fleet_cache)
     app.router.add_get("/v1/models", _proxied_models)
     app.router.add_post("/v1/models/{name}:generate", _routed_generate)
     return app

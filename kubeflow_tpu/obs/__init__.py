@@ -21,6 +21,15 @@ imports lazily instead.
 
 from __future__ import annotations
 
+from kubeflow_tpu.obs.cachestats import (
+    DEFER_CAUSES,
+    EVICTION_CAUSES,
+    REUSE_BUCKETS,
+    UNATTRIBUTED,
+    CacheLedger,
+    canonical_prefix,
+    prefix_hash,
+)
 from kubeflow_tpu.obs.cardinality import OVERFLOW_LABEL, LabelGuard
 from kubeflow_tpu.obs.exposition import (
     ExpositionError,
@@ -61,13 +70,18 @@ from kubeflow_tpu.obs.tracing import (
 # aiohttp into HTTP-free processes (the Trainer).
 
 __all__ = [
+    "DEFER_CAUSES",
+    "EVICTION_CAUSES",
     "LATENCY_BUCKETS",
+    "REUSE_BUCKETS",
     "SIZE_BUCKETS",
     "TOKEN_BUCKETS",
     "SERVING_PHASES",
     "TRAIN_PHASES",
+    "UNATTRIBUTED",
     "WATCHED_SERVING_FNS",
     "WATCHED_TRAIN_FNS",
+    "CacheLedger",
     "CompileWatch",
     "ExpositionError",
     "Histogram",
@@ -82,6 +96,7 @@ __all__ = [
     "Tracer",
     "DEFAULT_TRACER",
     "abstract_signature",
+    "canonical_prefix",
     "default_registry",
     "federate",
     "format_float",
@@ -90,6 +105,7 @@ __all__ = [
     "merge_counter_tracks",
     "merge_families",
     "parse_exposition",
+    "prefix_hash",
     "render_families",
     "sample_quantile",
     "traces_response_payload",

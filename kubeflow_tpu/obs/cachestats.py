@@ -1,0 +1,266 @@
+"""KV-cache block lifecycle ledger + bounded prefix hashing.
+
+The paged-KV pool (`serving/paged.py`) is the resource that actually
+caps a replica's concurrency, and until now its observability stopped
+at "blocks in use" plus one aggregate hit/miss pair. This module adds
+the accounting the fleet-wide cache-tier work needs:
+
+- `CacheLedger`: a pure-python sidecar the `BlockPool` notifies on
+  every block birth and death. Every death is booked to a CAUSE from a
+  closed set (`EVICTION_CAUSES`); a `pool.free()` call that forgot to
+  say why lands in `unattributed`, which CI asserts is zero — the same
+  structural-conservation discipline as PR 8's phase-sums == wall.
+  The ledger also keeps reuse distances (admissions between touches of
+  the same block), block age at death, and admission-defer causes.
+- `prefix_hash`: the ONE hash both replicas and the router use to name
+  a prefix (first KV block of tokens). 16 hex chars of blake2b, salted
+  by tenant namespace, so per-prefix label cardinality is bounded by
+  construction (fixed format, top-K digests only) and a replica's heat
+  digest can be joined against the router's routing key without ever
+  shipping raw prompt tokens off the replica.
+
+The ledger is metric-free (importable in jax-only processes); the
+serving layer binds its `on_*` hooks to real counters/histograms, the
+same wiring idiom as `PhaseProfiler.on_phase`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import deque
+from typing import Callable, Iterable
+
+from .metrics import sample_quantile
+
+# Closed set of reasons a KV block dies. These become the `cause` label
+# on `serving_kv_evictions_total`, so the set is CLOSED by design:
+#   lru        — radix prefix-cache LRU eviction (cold prefix displaced)
+#   pressure   — slot preemption under pool pressure (victim's blocks)
+#   refdrop    — normal retirement: request finished/cancelled/failed
+#                and its non-cached blocks dropped their last reference
+#   divergence — copy-on-write/import duplicate: a block whose contents
+#                already exist under another id (freed immediately)
+#   migration  — blocks handed to / rolled back from a peer replica
+EVICTION_CAUSES = ("lru", "pressure", "refdrop", "divergence", "migration")
+# Where a `pool.free()` with no stated cause is booked. Conservation CI
+# asserts this series stays at zero — it existing (zero-seeded) is what
+# makes "every free site states its cause" checkable from /metrics.
+UNATTRIBUTED = "unattributed"
+# Why an admission was deferred this step (`serving_kv_admission_defers_
+# _total{cause}`): per-tenant KV quota vs the pool simply being empty
+# even after LRU eviction.
+DEFER_CAUSES = ("kv_quota", "pool_exhausted")
+
+# Reuse-distance / block-age buckets, in ADMISSIONS (logical ticks, one
+# per admitted request) — powers of two out past any realistic pool
+# residency. Distance ~pool-size is the working-set cliff: blocks whose
+# reuse distance exceeds the pool's capacity in blocks will have been
+# evicted before their next use.
+REUSE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                 512.0, 1024.0, 4096.0)
+
+# Raw-sample windows for the /debug/profile quantiles (the histograms
+# keep the unbounded cumulative view; these keep the recent shape).
+_WINDOW = 512
+_MAX_COUNTER_EVENTS = 2048
+
+
+def canonical_prefix(tokens: Iterable[int], ns: str = "") -> str:
+    """Canonical string form of a token prefix: space-joined decimal
+    ints (the router's rendezvous `affinity_key` form), NUL-salted by
+    tenant namespace when namespaced. This is the string a hashed
+    `LabelGuard` digests — replica heat digests and the router's
+    routing key MUST hash the same canonical form or the fleet heat
+    map joins garbage."""
+    joined = " ".join(str(int(t)) for t in tokens)
+    return f"{ns}\x00{joined}" if ns else joined
+
+
+def prefix_hash(tokens: Iterable[int], ns: str = "") -> str:
+    """16-hex name for a token prefix, salted by tenant namespace —
+    blake2b-64 of `canonical_prefix`, byte-identical to what a hashed
+    LabelGuard returns for the same canonical string."""
+    return hashlib.blake2b(
+        canonical_prefix(tokens, ns).encode("utf-8", "replace"),
+        digest_size=8).hexdigest()
+
+
+class CacheLedger:
+    """Block lifecycle accounting for one BlockPool.
+
+    Attach by assigning to `pool.ledger`; the pool then calls
+    `note_alloc` / `note_free` inline (pure dict/deque work, no metric
+    or lock-ordering hazards on the hot path beyond one short lock).
+    The batcher calls `note_admission` once per admitted request (the
+    logical clock), `note_reuse` for radix-hit blocks, and `note_defer`
+    when admission is pushed back.
+
+    Conservation invariant (asserted by tests and `ci/obs_check cache`):
+        births - sum(frees over all causes) == pool.in_use
+    and `frees[UNATTRIBUTED] == 0` — every free site states its cause.
+    """
+
+    def __init__(self, *, window: int = _WINDOW,
+                 wall: Callable[[], float] = time.time):
+        self._lock = threading.Lock()
+        self._wall = wall
+        self._tick = 0                       # admissions so far
+        self.births = 0
+        self.frees = {c: 0 for c in (*EVICTION_CAUSES, UNATTRIBUTED)}
+        self.defers = {c: 0 for c in DEFER_CAUSES}
+        # live block id -> (birth_tick, last_use_tick)
+        self._live: dict[int, list[int]] = {}
+        self._reuse = deque(maxlen=window)   # distances, in admissions
+        self._ages = deque(maxlen=window)    # age at death, admissions
+        # Chrome "C" counter events: one all-zero seed so the track
+        # exists in every trace, then one point per free.
+        self._events: deque = deque(maxlen=_MAX_COUNTER_EVENTS)
+        self._emit_event()
+        # serving-layer metric bindings; exceptions are swallowed so a
+        # bad hook can never kill the batcher worker (PhaseProfiler
+        # idiom)
+        self.on_free: Callable[[str, int], None] | None = None
+        self.on_reuse: Callable[[int], None] | None = None
+        self.on_age: Callable[[int], None] | None = None
+        self.on_defer: Callable[[str], None] | None = None
+
+    # -- pool-side hooks ---------------------------------------------------
+
+    def note_alloc(self, blocks: Iterable[int]) -> None:
+        with self._lock:
+            t = self._tick
+            for b in blocks:
+                self._live[int(b)] = [t, t]
+                self.births += 1
+
+    def note_free(self, blocks: Iterable[int], cause: str | None) -> None:
+        cause = cause if cause in self.frees else UNATTRIBUTED
+        ages = []
+        with self._lock:
+            n = 0
+            for b in blocks:
+                n += 1
+                meta = self._live.pop(int(b), None)
+                if meta is not None:
+                    age = self._tick - meta[0]
+                    self._ages.append(age)
+                    ages.append(age)
+            if n:
+                self.frees[cause] += n
+                self._emit_event()
+        if n and self.on_free is not None:
+            try:
+                self.on_free(cause, n)
+            except Exception:
+                pass
+        if self.on_age is not None:
+            for age in ages:
+                try:
+                    self.on_age(age)
+                except Exception:
+                    pass
+
+    # -- batcher-side hooks ------------------------------------------------
+
+    def note_admission(self) -> None:
+        """Advance the logical clock: one tick per admitted request."""
+        with self._lock:
+            self._tick += 1
+
+    def note_reuse(self, blocks: Iterable[int]) -> None:
+        """Radix-hit blocks for the request being admitted: records the
+        reuse distance (admissions since each block's last touch)."""
+        dists = []
+        with self._lock:
+            t = self._tick
+            for b in blocks:
+                meta = self._live.get(int(b))
+                if meta is None:
+                    continue
+                d = t - meta[1]
+                dists.append(d)
+                self._reuse.append(d)
+                meta[1] = t
+        if self.on_reuse is not None:
+            for d in dists:
+                try:
+                    self.on_reuse(d)
+                except Exception:
+                    pass
+
+    def note_defer(self, cause: str) -> None:
+        if cause not in self.defers:
+            cause = "pool_exhausted"
+        with self._lock:
+            self.defers[cause] += 1
+        if self.on_defer is not None:
+            try:
+                self.on_defer(cause)
+            except Exception:
+                pass
+
+    # -- read side ---------------------------------------------------------
+
+    def frees_total(self) -> int:
+        with self._lock:
+            return sum(self.frees.values())
+
+    def live_blocks(self) -> int:
+        """Blocks currently alive per the ledger — must equal the
+        pool's `in_use` whenever the ledger was attached from the
+        pool's first alloc (the conservation check)."""
+        with self._lock:
+            return len(self._live)
+
+    def snapshot(self) -> dict:
+        """/debug/profile payload: cause totals, recent-window reuse /
+        age quantiles, defers, and the conservation fields."""
+        with self._lock:
+            frees = dict(self.frees)
+            reuse = list(self._reuse)
+            ages = list(self._ages)
+            out = {
+                "admissions": self._tick,
+                "births": self.births,
+                "frees": frees,
+                "frees_total": sum(frees.values()),
+                "live_blocks": len(self._live),
+                "defers": dict(self.defers),
+            }
+        out["reuse_distance"] = {
+            "count": len(reuse),
+            "p50": sample_quantile(reuse, 0.50),
+            "p95": sample_quantile(reuse, 0.95),
+        }
+        out["block_age"] = {
+            "count": len(ages),
+            "p50": sample_quantile(ages, 0.50),
+            "p95": sample_quantile(ages, 0.95),
+        }
+        out["conserved"] = (out["births"] - out["frees_total"]
+                            == out["live_blocks"]
+                            and frees[UNATTRIBUTED] == 0)
+        return out
+
+    # -- chrome counter tracks --------------------------------------------
+
+    def _emit_event(self) -> None:
+        # caller holds the lock
+        self._events.append({
+            "name": "kv_evictions", "ph": "C",
+            "ts": round(self._wall() * 1e6, 1), "pid": 1, "tid": 0,
+            "args": {c: self.frees[c] for c in EVICTION_CAUSES},
+        })
+
+    def counter_events(self, *, prefix: str = "") -> list[dict]:
+        """Chrome "C" events for `/debug/traces` (cumulative eviction
+        counts per cause over time), names prefixed per model the same
+        way as `PhaseProfiler.counter_events`."""
+        with self._lock:
+            evs = [dict(e) for e in self._events]
+        if prefix:
+            for e in evs:
+                e["name"] = f"{prefix}.{e['name']}"
+        return evs

@@ -12,6 +12,7 @@ bucket (`other`)."""
 
 from __future__ import annotations
 
+import hashlib
 import threading
 
 OVERFLOW_LABEL = "other"
@@ -23,7 +24,7 @@ class LabelGuard:
 
     def __init__(self, max_values: int = 32,
                  overflow: str = OVERFLOW_LABEL, seed=(),
-                 closed: bool = False):
+                 closed: bool = False, hashed: bool = False):
         if max_values < 1:
             raise ValueError(f"max_values must be >= 1, got {max_values}")
         self.max_values = int(max_values)
@@ -32,6 +33,15 @@ class LabelGuard:
         # label values that enumerate code (phase names, watched fn
         # names), where a novel value is a bug, not a new tenant
         self.closed = bool(closed)
+        # hashed guards never grow state at all: admit() maps every
+        # value to 16 hex chars of blake2b, so the FORMAT is bounded by
+        # construction and the VALUE never leaks raw client data into a
+        # label. The series count is bounded by the caller (e.g. a
+        # top-K prefix-heat digest), not by this guard — there is no
+        # overflow bucket and nothing to seed.
+        self.hashed = bool(hashed)
+        if self.hashed and self.closed:
+            raise ValueError("hashed and closed modes are exclusive")
         self._lock = threading.Lock()
         self._values: set[str] = set()
         self.overflowed = 0  # values that hit the cap, cumulative
@@ -42,9 +52,14 @@ class LabelGuard:
     def admit(self, value: str) -> str:
         """The label value to actually use for `value`: itself while
         seeded (closed mode) or under the cap (open mode), the overflow
-        bucket after. The overflow bucket itself never counts against
-        the cap."""
+        bucket after; hashed mode returns the 16-hex digest of any
+        value. The overflow bucket itself never counts against the
+        cap."""
         value = value or self.overflow
+        if self.hashed:
+            return hashlib.blake2b(
+                value.encode("utf-8", "replace"),
+                digest_size=8).hexdigest()
         if value == self.overflow:
             return self.overflow
         with self._lock:

@@ -71,6 +71,7 @@ from kubeflow_tpu.serving.engine import (
     SamplingParams,
     transformer_block,
 )
+from kubeflow_tpu.obs.cachestats import CacheLedger
 from kubeflow_tpu.obs.profiling import CompileWatch, PhaseProfiler
 from kubeflow_tpu.obs.timeline import RequestTimeline, TimelineStore
 from kubeflow_tpu.serving import migration
@@ -1334,13 +1335,22 @@ class ContinuousBatcher:
         # generalization of the manual `prefixes` registration (which
         # stays as a pre-warm hint).
         self._radix = RadixPrefixCache(self.cengine.pool)
+        # Block lifecycle ledger (ISSUE 13): attached to the pool
+        # before any alloc, so every block birth/death is booked to a
+        # cause and births − frees reconciles against pool.in_use (the
+        # eviction-forensics conservation invariant). The server binds
+        # its on_* hooks to /metrics families; /debug/profile and
+        # bench read snapshot() via cache_anatomy().
+        self.cache_ledger = CacheLedger()
+        self.cengine.pool.attach_ledger(self.cache_ledger)
         self._dirty: list[int] = []  # freed slots awaiting table reset
         self.prefix_hits = 0      # admissions that reused cached cells
         self.prefix_misses = 0
         self.tokens_prefilled = 0  # suffix tokens actually computed
         self.tokens_reused = 0     # prompt cells served from cache
-        # optional hook(computed: int, reused: int, hit: bool), called
-        # per admission — the server wires metrics through this
+        # optional hook(computed: int, reused: int, hit: bool,
+        # tenant: str), called per admission — the server wires metrics
+        # (including the tenant-labelled hit/miss series) through this
         self.on_prefix = None
         # Per-request token timelines (obs.timeline): every request
         # gets a RequestTimeline stamped with its structural events
@@ -1480,7 +1490,7 @@ class ContinuousBatcher:
         HBM)."""
         return self.cengine.pool.in_use
 
-    def prefix_cache_stats(self) -> dict[str, int]:
+    def prefix_cache_stats(self) -> dict:
         return {
             "hits": self.prefix_hits,
             "misses": self.prefix_misses,
@@ -1488,6 +1498,19 @@ class ContinuousBatcher:
             "tokens_reused": self.tokens_reused,
             "cached_blocks": self._radix.cached_blocks,
             "blocks_in_use": self.cengine.pool.in_use,
+            # top-K decayed prefix heat, 16-hex hashed names — the
+            # per-replica half of the fleet heat map (`/fleet/cache`)
+            "heat": self._radix.heat_digest(16),
+        }
+
+    def cache_anatomy(self) -> dict:
+        """Cache-observatory snapshot for `/debug/profile` and bench:
+        the lifecycle ledger (eviction causes, reuse-distance/age
+        quantiles, defer causes, conservation fields) plus the prefix
+        heat digest."""
+        return {
+            "ledger": self.cache_ledger.snapshot(),
+            "heat": self._radix.heat_digest(16),
         }
 
     def warmup(self, buckets=None) -> int:
@@ -1655,22 +1678,25 @@ class ContinuousBatcher:
             self._sp_dirty = False
         return self._sp_cache
 
-    def _release(self, slot: int) -> None:
+    def _release(self, slot: int, *, cause: str = "refdrop") -> None:
         """Return a slot to the pool with greedy filler knobs (a
         leftover sampled temperature would drag all-greedy steps into
         the sampled branch's full-vocab argsorts). Releases the slot's
-        KV blocks and marks its device-side block table dirty (reset
-        to trash before the next admission, so the freed blocks stop
-        receiving the retired slot's garbage decode writes)."""
+        KV blocks (deaths booked to `cause` — refdrop for ordinary
+        retirement, pressure for preemption, migration for export) and
+        marks its device-side block table dirty (reset to trash before
+        the next admission, so the freed blocks stop receiving the
+        retired slot's garbage decode writes)."""
         rec = self._active.pop(slot, None)
         self._free.append(slot)
         self._temp[slot], self._topk[slot], self._topp[slot] = 0, 0, 1.0
         self._sp_dirty = True
         if rec is not None:
-            self._release_blocks(rec)
+            self._release_blocks(rec, cause=cause)
             self._dirty.append(slot)
 
-    def _release_blocks(self, rec: _Slot) -> None:
+    def _release_blocks(self, rec: _Slot, *,
+                        cause: str = "refdrop") -> None:
         """Drop a request's claim on pool blocks: unref its radix
         nodes (tree-owned blocks stay cached, evictable once idle) and
         free the exclusively-owned ones. Idempotent."""
@@ -1684,7 +1710,7 @@ class ContinuousBatcher:
             self._radix.unref(rec.node_refs)
             rec.node_refs = []
         if rec.owned:
-            self.cengine.pool.free(rec.owned.values())
+            self.cengine.pool.free(rec.owned.values(), cause=cause)
             rec.owned = {}
 
     def _cache_blocks(self, rec: _Slot) -> None:
@@ -1711,6 +1737,17 @@ class ContinuousBatcher:
             ns=rec.meta.ns if rec.meta is not None else "")
         for i in adopted:
             del rec.owned[i]
+        # Blocks we OFFERED but the tree declined already have an edge
+        # for the same token path (a concurrent twin prefill won the
+        # insert): this copy's content is a duplicate — book its death
+        # as `divergence`, distinct from the slot's ordinary refdrop
+        # tail (the final partial block et al, freed by _release).
+        dup = [blocks[i] for i in blocks if i not in adopted]
+        if dup:
+            for i in list(rec.owned):
+                if rec.owned[i] in dup:
+                    del rec.owned[i]
+            self.cengine.pool.free(dup, cause="divergence")
 
     def _index_inflight(self, rec: _Slot) -> None:
         """At admission, index the prompt's full blocks in the radix
@@ -1862,7 +1899,7 @@ class ContinuousBatcher:
         rec = self._active[slot]
         meta = rec.meta
         self._cache_blocks(rec)
-        self._release(slot)
+        self._release(slot, cause="pressure")
         self.preemptions += 1
         if self._ledger is not None:
             self._ledger.note_preempted(meta.tenant)
@@ -1977,16 +2014,26 @@ class ContinuousBatcher:
             held = self._ledger.blocks_held(meta.tenant)
             if lim is not None and held > 0 and held + n_fresh > lim:
                 self._ledger.note_throttled(meta.tenant, "kv_quota")
+                self.cache_ledger.note_defer("kv_quota")
                 return None
         fresh = ceng.pool.alloc(n_fresh)
         if fresh is None:
             self._radix.evict(n_fresh - ceng.pool.num_free)
             fresh = ceng.pool.alloc(n_fresh)
             if fresh is None:
+                self.cache_ledger.note_defer("pool_exhausted")
                 return None
         self._radix.ref(chain)
         if extra is not None:
             self._radix.ref([extra])
+        # cache-ledger clock: one tick per admitted request; reused
+        # chain/CoW blocks record their reuse distance in admissions
+        self.cache_ledger.note_admission()
+        reused = [n.block for n in chain]
+        if extra is not None:
+            reused.append(extra.block)
+        if reused:
+            self.cache_ledger.note_reuse(reused)
         table = np.zeros(mb, np.int32)
         phys = [n.block for n in chain] + fresh
         table[:len(phys)] = phys
@@ -2000,7 +2047,7 @@ class ContinuousBatcher:
         if plan["extra"] is not None:
             self._radix.unref([plan["extra"]])
         if plan["fresh"]:
-            self.cengine.pool.free(plan["fresh"])
+            self.cengine.pool.free(plan["fresh"], cause="refdrop")
 
     async def _admit_group(self, items: list) -> None:
         # `admit` phase wraps the whole admission pass; the grouped
@@ -2219,7 +2266,8 @@ class ContinuousBatcher:
                     self.prefix_misses += 1
                 if self.on_prefix is not None:
                     try:
-                        self.on_prefix(computed, reused, reused > 0)
+                        self.on_prefix(computed, reused, reused > 0,
+                                       meta.tenant)
                     except Exception:  # noqa: BLE001 — metrics hook
                         pass           # must never kill the worker
                 if resumed:
@@ -2437,7 +2485,9 @@ class ContinuousBatcher:
         reused = len(rec.kv_toks) - len(suffix)
         if self.on_prefix is not None:
             try:
-                self.on_prefix(len(suffix), reused, reused > 0)
+                self.on_prefix(
+                    len(suffix), reused, reused > 0,
+                    rec.meta.tenant if rec.meta is not None else "")
             except Exception:  # noqa: BLE001 — metrics hook
                 pass           # must never kill the worker
         if self.cengine.draft is not None:
@@ -2866,7 +2916,7 @@ class ContinuousBatcher:
             if meta is not None and meta.timeline is not None:
                 meta.timeline.event("migrate_out",
                                     emitted=len(rec.out), blocks=n)
-            self._release(slot)
+            self._release(slot, cause="migration")
             self._fail(rec.fut, rec.queue, MigratedAway(rid))
         if self._ledger is not None:
             leftovers = self._pending.drain_all()
@@ -2962,7 +3012,7 @@ class ContinuousBatcher:
             done = True
         finally:
             if not done:
-                pool.free(fresh)
+                pool.free(fresh, cause="migration")
                 if self._st is not None and any(
                         leaf.is_deleted() for leaf in
                         jax.tree.leaves(self._st)
@@ -2972,7 +3022,7 @@ class ContinuousBatcher:
         if dup:
             # this prefix (or part of it) was already cached locally:
             # the tree kept its own blocks, ours are duplicates
-            pool.free(dup)
+            pool.free(dup, cause="divergence")
         return n_full - len(dup)
 
     async def export_prefix(self, tokens: list[int], *, ns: str = "",

@@ -54,6 +54,9 @@ engine guarantees, not a behavior the kernel checks at runtime.
 
 from __future__ import annotations
 
+from kubeflow_tpu.obs.cachestats import canonical_prefix
+from kubeflow_tpu.obs.cardinality import LabelGuard
+
 __all__ = ["BlockPool", "RadixPrefixCache", "TRASH_BLOCK"]
 
 TRASH_BLOCK = 0
@@ -78,6 +81,20 @@ class BlockPool:
         # already free (double-free would hand the same physical block to
         # two owners and silently corrupt both sequences' KV).
         self._free_set = set(self._free)
+        # Optional obs.CacheLedger: when attached, every alloc/free is
+        # booked (frees to a CAUSE), giving the eviction-forensics
+        # metrics their conservation guarantee at the only chokepoint
+        # blocks actually pass through.
+        self.ledger = None
+
+    def attach_ledger(self, ledger) -> None:
+        """Attach a lifecycle ledger. Must happen before the first
+        alloc, or the ledger's birth count can't reconcile against
+        `in_use` (the conservation invariant CI asserts)."""
+        if self.in_use:
+            raise ValueError(
+                f"ledger attached with {self.in_use} blocks already live")
+        self.ledger = ledger
 
     @property
     def capacity(self) -> int:
@@ -100,16 +117,28 @@ class BlockPool:
             return None
         out = [self._free.pop() for _ in range(n)]
         self._free_set.difference_update(out)
+        if self.ledger is not None:
+            self.ledger.note_alloc(out)
         return out
 
-    def free(self, blocks) -> None:
+    def free(self, blocks, *, cause: str | None = None) -> None:
+        """Return `blocks` to the pool. `cause` books the deaths in the
+        attached ledger (see obs.EVICTION_CAUSES); a None cause lands in
+        the ledger's `unattributed` bucket, which CI pins at zero — so
+        every call site must say WHY the blocks died."""
+        blocks = list(blocks)
+        seen: set[int] = set()
         for b in blocks:
             if not (0 < b < self.num_blocks):
                 raise ValueError(f"freeing out-of-range block {b}")
-            if b in self._free_set:
+            if b in self._free_set or b in seen:
                 raise ValueError(f"double-free of block {b}")
+            seen.add(b)
+        for b in blocks:
             self._free.append(b)
             self._free_set.add(b)
+        if self.ledger is not None:
+            self.ledger.note_free(blocks, cause)
 
 
 class _Node:
@@ -134,7 +163,8 @@ class RadixPrefixCache:
     `evict` pops refcount-0 leaves in LRU order back to the pool.
     """
 
-    def __init__(self, pool: BlockPool):
+    def __init__(self, pool: BlockPool, *, heat_half_life: int = 64,
+                 heat_max_entries: int = 512):
         self.pool = pool
         self.block_size = pool.block_size
         self.root = _Node(None, None, None)
@@ -146,6 +176,21 @@ class RadixPrefixCache:
         self._roots: dict[str, _Node] = {"": self.root}
         self._clock = 0
         self.cached_blocks = 0  # blocks currently owned by the tree
+        # Decayed per-prefix heat: (ns, first-block key) -> [score,
+        # last-bump clock]. A prefix is named by its FIRST full block —
+        # the same token slice the router's rendezvous affinity key
+        # hashes, so replica digests join against routing keys. Scores
+        # halve every `heat_half_life` radix-clock ticks (accesses),
+        # and the table is pruned to its hottest half past
+        # `heat_max_entries`, so memory is bounded regardless of
+        # prompt diversity.
+        self.heat_half_life = max(1, int(heat_half_life))
+        self.heat_max_entries = max(2, int(heat_max_entries))
+        self._heat: dict[tuple[str, tuple], list] = {}
+        # hashed-mode guard: digests export prefixes as 16-hex blake2b
+        # names, never raw tokens — bounded label cardinality by
+        # construction
+        self.heat_guard = LabelGuard(hashed=True)
 
     # -- internals ---------------------------------------------------------
 
@@ -165,6 +210,28 @@ class RadixPrefixCache:
         while node is not None and node.key is not None:
             node.last_use = t
             node = node.parent
+
+    def _decayed(self, ent: list, t: int) -> float:
+        return ent[0] * 0.5 ** ((t - ent[1]) / self.heat_half_life)
+
+    def _heat_bump(self, ns: str, key: tuple) -> None:
+        t = self._clock
+        ent = self._heat.get((ns, key))
+        if ent is None:
+            if len(self._heat) >= self.heat_max_entries:
+                self._heat_prune(t)
+            self._heat[(ns, key)] = [1.0, t]
+        else:
+            ent[0] = self._decayed(ent, t) + 1.0
+            ent[1] = t
+
+    def _heat_prune(self, t: int) -> None:
+        """Keep only the hottest half (by decayed score) — amortized
+        O(n log n) once per max_entries/2 novel prefixes."""
+        ranked = sorted(self._heat.items(),
+                        key=lambda kv: self._decayed(kv[1], t),
+                        reverse=True)
+        self._heat = dict(ranked[: self.heat_max_entries // 2])
 
     # -- queries -----------------------------------------------------------
 
@@ -202,6 +269,7 @@ class RadixPrefixCache:
                     partial_node, partial_len = child, n
         if nodes:
             self._touch(nodes[-1])
+            self._heat_bump(ns, nodes[0].key)
         if partial_node is not None:
             self._touch(partial_node)
         return nodes, partial_node, partial_len
@@ -249,6 +317,10 @@ class RadixPrefixCache:
                 node.children[key] = child
                 adopted.add(i)
                 self.cached_blocks += 1
+                if i == 0:
+                    # a prefix's first cached appearance is its first
+                    # heat point (later hits bump via match())
+                    self._heat_bump(ns, key)
                 if hold:
                     child.refs = 1
                     held.append(child)
@@ -277,17 +349,19 @@ class RadixPrefixCache:
             if victim is None:
                 break
             del victim.parent.children[victim.key]
-            self.pool.free([victim.block])
+            self.pool.free([victim.block], cause="lru")
             self.cached_blocks -= 1
             freed += 1
         return freed
 
-    def clear(self) -> None:
+    def clear(self, *, cause: str = "refdrop") -> None:
         """Drop the whole tree, returning every cached block to the pool.
 
         Must be called whenever the device-side pool array is discarded
         (e.g. after a failed dispatch poisons the state): the tree's
-        blocks describe content that no longer exists.
+        blocks describe content that no longer exists. That is a
+        reference drop (the content died with the device state), not an
+        LRU decision — hence the default cause.
         """
         blocks = []
         for root in self._roots.values():
@@ -298,5 +372,25 @@ class RadixPrefixCache:
                 stack.extend(n.children.values())
             root.children.clear()
         if blocks:
-            self.pool.free(blocks)
+            self.pool.free(blocks, cause=cause)
         self.cached_blocks = 0
+
+    # -- heat export -------------------------------------------------------
+
+    def heat_digest(self, k: int = 16) -> list[dict]:
+        """Top-`k` hottest prefixes by decayed score, exported as
+        16-hex hashed names (via the hashed LabelGuard) — safe to put
+        on heartbeats and `/v1/models` without leaking prompt tokens,
+        and joinable against the router's `prefix_hash` of the same
+        first-block token slice."""
+        t = self._clock
+        ranked = sorted(
+            ((self._decayed(ent, t), ns, key)
+             for (ns, key), ent in self._heat.items()),
+            key=lambda x: x[0], reverse=True)
+        return [
+            {"prefix": self.heat_guard.admit(canonical_prefix(key, ns)),
+             "score": round(score, 4)}
+            for score, ns, key in ranked[: max(0, int(k))]
+            if score > 1e-9
+        ]

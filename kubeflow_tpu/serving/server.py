@@ -258,6 +258,36 @@ class ServingObs:
             "shape signature past the fn's first) — nonzero RATE in "
             "steady state means the compile-shape bucketing leaked",
             self.registry)
+        # KV-cache observatory (ISSUE 13): the block lifecycle ledger
+        # (obs.cachestats.CacheLedger, attached to each batcher's
+        # BlockPool) books every block death to a CAUSE; the cause set
+        # is closed and zero-seeded per model, and the conservation
+        # invariant — causes sum to total frees, `unattributed` == 0 —
+        # is what `ci/obs_check cache` asserts from a live scrape.
+        self.kv_evictions = Counter(
+            "serving_kv_evictions_total",
+            "KV pool blocks freed, by cause: lru (radix eviction), "
+            "pressure (preemption), refdrop (normal retirement), "
+            "divergence (duplicate content), migration (exported or "
+            "rolled back). `unattributed` is a free site that forgot "
+            "to book a cause — always zero, or it's a bug",
+            self.registry)
+        self.kv_admission_defers = Counter(
+            "serving_kv_admission_defers_total",
+            "Admissions pushed back for lack of KV blocks, by cause: "
+            "kv_quota (tenant share spent) vs pool_exhausted (pool "
+            "empty even after LRU eviction)", self.registry)
+        self.kv_reuse_distance = obs_lib.get_or_create_histogram(
+            self.registry, "serving_kv_reuse_distance_admissions",
+            "Admissions between consecutive touches of the same cached "
+            "KV block, per model — the working-set curve; mass beyond "
+            "the pool's block count predicts misses an LRU pool of "
+            "that size must take", buckets=obs_lib.REUSE_BUCKETS)
+        self.kv_block_age = obs_lib.get_or_create_histogram(
+            self.registry, "serving_kv_block_age_admissions",
+            "Block age at death in admissions, per model — young "
+            "deaths under pressure/lru mean the pool churns before "
+            "reuse can pay off", buckets=obs_lib.REUSE_BUCKETS)
         # SLO burn rates (obs.slo): the engine IS the gauge metric —
         # registering it zero-seeds every slo x window series. TTFT
         # objectives are per priority class; error-rate likewise;
@@ -738,9 +768,15 @@ def create_serving_app(engines: dict[str, InferenceEngine],
             b.on_batch = (lambda n, _m=model_name:
                           sobs.batch_size.observe(n, model=_m))
         elif isinstance(b, ContinuousBatcher):
-            def on_prefix(computed, reused, hit, _m=model_name):
-                (sobs.prefix_hits if hit
-                 else sobs.prefix_misses).inc(model=_m)
+            def on_prefix(computed, reused, hit, tenant="",
+                          _m=model_name):
+                fam = sobs.prefix_hits if hit else sobs.prefix_misses
+                # the unlabeled (model-only) totals stay exactly what
+                # they always were — the bench gate reads them; the
+                # tenant-labelled series rides in the same family,
+                # guard-capped (ISSUE 13)
+                fam.inc(model=_m)
+                fam.inc(model=_m, tenant=sobs.tenant_guard.admit(tenant))
                 sobs.prefill_tokens.observe(
                     computed, model=_m, source="computed")
                 if reused:
@@ -764,6 +800,9 @@ def create_serving_app(engines: dict[str, InferenceEngine],
             # (and a 0 reading) before the first admission
             sobs.prefix_hits.inc(0, model=model_name)
             sobs.prefix_misses.inc(0, model=model_name)
+            _t0 = sobs.tenant_guard.admit("")  # tenant-blind bucket
+            sobs.prefix_hits.inc(0, model=model_name, tenant=_t0)
+            sobs.prefix_misses.inc(0, model=model_name, tenant=_t0)
             sobs.migration_out.inc(0, model=model_name)
             sobs.migration_in.inc(0, model=model_name)
             for _d in ("in", "out"):
@@ -790,6 +829,34 @@ def create_serving_app(engines: dict[str, InferenceEngine],
             sobs.kv_high_water.set(0, model=model_name)
             for _fn in obs_lib.WATCHED_SERVING_FNS:
                 sobs.recompiles.inc(0, model=model_name, fn=_fn)
+            # cache observatory: zero-seed the CLOSED cause sets (incl.
+            # `unattributed`, whose permanent zero is the conservation
+            # contract) and the reuse/age histograms, then bind the
+            # lifecycle ledger's hooks
+            for _c in (*obs_lib.EVICTION_CAUSES, obs_lib.UNATTRIBUTED):
+                sobs.kv_evictions.inc(0, model=model_name, cause=_c)
+            for _c in obs_lib.DEFER_CAUSES:
+                sobs.kv_admission_defers.inc(
+                    0, model=model_name, cause=_c)
+            sobs.kv_reuse_distance.seed(model=model_name)
+            sobs.kv_block_age.seed(model=model_name)
+
+            def on_free(cause, n, _m=model_name):
+                sobs.kv_evictions.inc(n, model=_m, cause=cause)
+
+            def on_reuse(dist, _m=model_name):
+                sobs.kv_reuse_distance.observe(dist, model=_m)
+
+            def on_age(age, _m=model_name):
+                sobs.kv_block_age.observe(age, model=_m)
+
+            def on_defer(cause, _m=model_name):
+                sobs.kv_admission_defers.inc(model=_m, cause=cause)
+
+            b.cache_ledger.on_free = on_free
+            b.cache_ledger.on_reuse = on_reuse
+            b.cache_ledger.on_age = on_age
+            b.cache_ledger.on_defer = on_defer
 
             def on_phase(phase, seconds, tokens, _m=model_name):
                 # seconds is None for token-only attributions
@@ -839,6 +906,8 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                 sobs.tenant_queue_depth.set(0, model=_m, tenant=_t)
                 sobs.tenant_tokens.inc(0, model=_m, tenant=_t)
                 sobs.tenant_preemptions.inc(0, model=_m, tenant=_t)
+                sobs.prefix_hits.inc(0, model=_m, tenant=_t)
+                sobs.prefix_misses.inc(0, model=_m, tenant=_t)
                 for _r in THROTTLE_REASONS:
                     sobs.tenant_throttled.inc(
                         0, model=_m, tenant=_t, reason=_r)
@@ -917,6 +986,9 @@ def create_serving_app(engines: dict[str, InferenceEngine],
             if isinstance(_b, ContinuousBatcher):
                 obs_lib.merge_counter_tracks(
                     payload, _b.profiler.counter_events(prefix=_m))
+                obs_lib.merge_counter_tracks(
+                    payload,
+                    _b.cache_ledger.counter_events(prefix=_m))
         return web.json_response(payload)
 
     async def debug_profile(request):
@@ -929,6 +1001,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
             if isinstance(_b, ContinuousBatcher):
                 snap = _b.profiler.snapshot()
                 snap["recompiles"] = _b.compile_watch.counts()
+                snap["cache"] = _b.cache_anatomy()
                 models[_m] = snap
         return web.json_response({"models": models})
 
@@ -968,6 +1041,7 @@ def fleet_stats(app: web.Application) -> dict:
     queue_depth = active = max_slots = 0
     kv_free = kv_total = 0
     phase_prefill = phase_decode = 0.0
+    cache_digest: list = []
     for b in app[BATCHERS_KEY].values():
         if isinstance(b, ContinuousBatcher):
             queue_depth += len(b._pending)
@@ -975,6 +1049,7 @@ def fleet_stats(app: web.Application) -> dict:
             max_slots += len(b._free) + len(b._active)
             kv_free += b.cengine.pool.num_free
             kv_total += b.cengine.num_blocks
+            cache_digest.extend(b._radix.heat_digest(16))
             totals = b.profiler.totals()
             phase_prefill += (totals.get("prefill", 0.0)
                               + totals.get("prefill_chunk", 0.0))
@@ -993,6 +1068,9 @@ def fleet_stats(app: web.Application) -> dict:
         "pool": app.get(POOL_KEY, "mixed"),
         "phase_seconds": {"prefill": round(phase_prefill, 6),
                           "decode": round(phase_decode, 6)},
+        # top-K hashed prefix heat (ISSUE 13): the router merges these
+        # into the fleet heat map and scores counterfactual remote hits
+        "cache_digest": cache_digest,
     }
 
 

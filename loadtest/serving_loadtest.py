@@ -489,6 +489,7 @@ def run_fleet(clients: int, requests: int, max_new: int, *,
 
         stats0 = {p: prefix_stats(p) for p in rep_ports}
         route0 = _get_json(f"{router_base}/fleet/stats")
+        cache0 = _get_json(f"{router_base}/fleet/cache")
 
         failures = 0
         latencies: list[float] = []
@@ -544,6 +545,31 @@ def run_fleet(clients: int, requests: int, max_new: int, *,
         reasons = {r: int(route1["route_total"][r]
                           - route0["route_total"][r])
                    for r in route1["route_total"]}
+        # fleet cache observatory (ISSUE 13): the router's
+        # counterfactual counter books every routed request that
+        # missed on its replica while a PEER's heartbeat digest had
+        # the prefix hot — the hits a cross-replica cache tier would
+        # have converted. Counterfactual fleet hit rate = (actual hits
+        # + convertible misses) / lookups; the gap over the affinity
+        # hit rate is the headroom a shared tier buys. Digests are
+        # top-K and heartbeat-lagged, so clamp at 1.0.
+        cache1 = _get_json(f"{router_base}/fleet/cache")
+        remote = int(cache1["remote_hits_total"]
+                     - cache0["remote_hits_total"])
+        affinity_rate = (round(hits / (hits + misses), 3)
+                         if hits + misses else 0.0)
+        counterfactual = (min(1.0, round((hits + remote)
+                                         / (hits + misses), 3))
+                          if hits + misses else 0.0)
+        assert counterfactual >= affinity_rate, (
+            f"counterfactual fleet hit rate {counterfactual} < "
+            f"measured affinity rate {affinity_rate}")
+        print(f"# fleet cache: affinity_hit_rate={affinity_rate} "
+              f"counterfactual_hit_rate={counterfactual} "
+              f"remote_hits={remote} "
+              f"headroom={round(counterfactual - affinity_rate, 3)} "
+              f"shared_prefixes={cache1.get('shared_prefixes', 0)}",
+              file=sys.stderr)
 
         latencies.sort()
         q = statistics.quantiles(latencies, n=20)
@@ -564,8 +590,10 @@ def run_fleet(clients: int, requests: int, max_new: int, *,
             "wall_s": round(wall, 2),
             "prefix_hits": hits,
             "prefix_misses": misses,
-            "affinity_hit_rate": (round(hits / (hits + misses), 3)
-                                  if hits + misses else 0.0),
+            "affinity_hit_rate": affinity_rate,
+            "fleet_remote_hits": remote,
+            "counterfactual_hit_rate": counterfactual,
+            "cache_headroom": round(counterfactual - affinity_rate, 3),
             # prompt cells served from cache / prompt cells total —
             # the bandwidth view of the same A/B (a hit that reuses 2
             # of 24 tokens is not much of a win)

@@ -1,0 +1,507 @@
+"""KV-cache observatory (ISSUE 13): block lifecycle ledger
+conservation, reuse-distance math, decayed prefix heat, heartbeat
+digest round-trip, and the router's counterfactual fleet-hit counter.
+
+The structural invariant under test everywhere: every block death is
+booked to a cause from a closed set and the causes SUM to total frees
+(`unattributed` stays zero), the same discipline as PR 8's
+phase-sums == wall. The fleet half pins that replica heat digests and
+the router's routing key hash the same canonical prefix form, so the
+fleet heat map joins on real keys."""
+
+import asyncio
+import socket
+
+import pytest
+from aiohttp import web  # noqa: F401  (pytest plugin needs aiohttp)
+from aiohttp.test_utils import TestClient, TestServer
+
+pytest_plugins = ("aiohttp.pytest_plugin",)
+
+from kubeflow_tpu.fleet import router as router_mod
+from kubeflow_tpu.fleet.registry import ReplicaRegistry, rendezvous
+from kubeflow_tpu.obs.cachestats import (
+    DEFER_CAUSES,
+    EVICTION_CAUSES,
+    UNATTRIBUTED,
+    CacheLedger,
+    canonical_prefix,
+    prefix_hash,
+)
+from kubeflow_tpu.obs.cardinality import LabelGuard
+from kubeflow_tpu.serving.paged import BlockPool, RadixPrefixCache
+
+BS = 8  # kv block size for the engine-level tests
+
+
+# -- prefix hashing ---------------------------------------------------------
+
+
+def test_prefix_hash_matches_hashed_label_guard():
+    """The ONE join key: a replica's hashed LabelGuard over the
+    canonical prefix string must equal the router's prefix_hash of the
+    same token slice, or /fleet/cache merges garbage."""
+    guard = LabelGuard(hashed=True)
+    toks = [3, 5, 7, 11, 13, 17, 19, 23]
+    h = prefix_hash(toks)
+    assert h == guard.admit(canonical_prefix(toks))
+    assert len(h) == 16 and all(c in "0123456789abcdef" for c in h)
+    # tenant namespace salts the hash: same tokens, different name
+    assert prefix_hash(toks, ns="acme") != h
+    assert prefix_hash(toks, ns="acme") == guard.admit(
+        canonical_prefix(toks, ns="acme"))
+    # canonical form is the router's space-joined-decimal affinity form
+    assert canonical_prefix([1, 2, 3]) == "1 2 3"
+
+
+def test_hashed_guard_modes_are_exclusive():
+    with pytest.raises(ValueError):
+        LabelGuard(hashed=True, closed=True, seed=("a", "b"))
+    # hashed mode never overflows: unbounded values, bounded output
+    guard = LabelGuard(max_values=2, hashed=True)
+    outs = {guard.admit(f"v{i}") for i in range(50)}
+    assert len(outs) == 50 and all(len(o) == 16 for o in outs)
+
+
+# -- ledger: scripted trace -------------------------------------------------
+
+
+def test_ledger_scripted_trace_conservation_and_reuse_math():
+    led = CacheLedger(wall=lambda: 42.0)
+    led.note_alloc([1, 2, 3])           # born at tick 0
+    led.note_admission()                # tick 1
+    led.note_admission()                # tick 2
+    led.note_reuse([1, 2])              # d = 2 - 0 = 2, twice
+    led.note_admission()                # tick 3
+    led.note_reuse([1])                 # d = 3 - 2 = 1
+    led.note_reuse([99])                # untracked block: ignored
+    led.note_free([2], "lru")           # age 3
+    led.note_free([3], "pressure")      # age 3
+    led.note_free([], "lru")            # empty free books nothing
+
+    snap = led.snapshot()
+    assert snap["admissions"] == 3 and snap["births"] == 3
+    assert snap["frees"]["lru"] == 1
+    assert snap["frees"]["pressure"] == 1
+    assert snap["frees"][UNATTRIBUTED] == 0
+    assert snap["frees_total"] == 2 and snap["live_blocks"] == 1
+    assert snap["conserved"] is True
+    assert snap["reuse_distance"]["count"] == 3
+    assert snap["reuse_distance"]["p50"] == 2      # sorted [1, 2, 2]
+    assert snap["reuse_distance"]["p95"] == 2
+    assert snap["block_age"] == {"count": 2, "p50": 3, "p95": 3}
+
+    # defers: unknown causes collapse into pool_exhausted, never a new
+    # label
+    led.note_defer("kv_quota")
+    led.note_defer("???")
+    assert led.snapshot()["defers"] == {"kv_quota": 1,
+                                        "pool_exhausted": 1}
+
+    # a free that forgot its cause breaks conservation VISIBLY
+    led.note_free([1], None)
+    snap = led.snapshot()
+    assert snap["frees"][UNATTRIBUTED] == 1
+    assert snap["conserved"] is False
+
+    # chrome counter track: all-zero seed point first, then one point
+    # per non-empty free, names prefixed per model
+    evs = led.counter_events(prefix="tiny")
+    assert [e["name"] for e in evs] == ["tiny.kv_evictions"] * 4
+    assert evs[0]["args"] == {c: 0 for c in EVICTION_CAUSES}
+    assert evs[1]["args"]["lru"] == 1
+    assert evs[-1]["ts"] == 42.0 * 1e6
+
+
+def test_ledger_hooks_fire_and_swallow_exceptions():
+    led = CacheLedger()
+    seen = {"free": [], "reuse": [], "age": [], "defer": []}
+    led.on_free = lambda c, n: seen["free"].append((c, n))
+    led.on_reuse = seen["reuse"].append
+    led.on_age = seen["age"].append
+    led.on_defer = seen["defer"].append
+    led.note_alloc([1, 2])
+    led.note_admission()
+    led.note_reuse([1])
+    led.note_free([1, 2], "refdrop")
+    led.note_defer("kv_quota")
+    assert seen == {"free": [("refdrop", 2)], "reuse": [1],
+                    "age": [1, 1], "defer": ["kv_quota"]}
+
+    # a hook that raises must never reach the batcher worker
+    led2 = CacheLedger()
+    led2.on_free = led2.on_age = lambda *a: 1 / 0
+    led2.note_alloc([5])
+    led2.note_free([5], "lru")
+    assert led2.snapshot()["frees"]["lru"] == 1
+
+
+# -- pool + radix integration ----------------------------------------------
+
+
+def test_pool_ledger_attach_guard_and_cause_plumbing():
+    pool = BlockPool(num_blocks=6, block_size=4)
+    got = pool.alloc(2)
+    # attaching after blocks are live would desync births vs in_use
+    with pytest.raises(ValueError, match="already live"):
+        pool.attach_ledger(CacheLedger())
+    pool.free(got)
+
+    led = CacheLedger()
+    pool.attach_ledger(led)
+    got = pool.alloc(3)
+    assert led.snapshot()["births"] == 3
+    pool.free(got[:1], cause="migration")
+    pool.free(got[1:])  # cause-less free: booked, but unattributed
+    snap = led.snapshot()
+    assert snap["frees"]["migration"] == 1
+    assert snap["frees"][UNATTRIBUTED] == 2
+    assert snap["live_blocks"] == pool.in_use == 0
+
+
+def test_radix_eviction_books_lru_and_clear_books_refdrop():
+    pool = BlockPool(num_blocks=10, block_size=2)
+    led = CacheLedger()
+    pool.attach_ledger(led)
+    cache = RadixPrefixCache(pool)
+    (a,) = pool.alloc(1)
+    (b,) = pool.alloc(1)
+    cache.insert([1, 2], {0: a})
+    cache.insert([3, 4], {0: b})
+    cache.match([1, 2])          # touch a: b becomes the LRU victim
+    assert cache.evict(1) == 1
+    assert led.snapshot()["frees"]["lru"] == 1
+    cache.clear()
+    snap = led.snapshot()
+    assert snap["frees"]["refdrop"] == 1
+    assert snap["conserved"] and snap["live_blocks"] == 0
+
+
+# -- decayed prefix heat ----------------------------------------------------
+
+
+def test_heat_decay_ranking_and_digest_hashes():
+    pool = BlockPool(num_blocks=10, block_size=2)
+    cache = RadixPrefixCache(pool, heat_half_life=2)
+    (a,) = pool.alloc(1)
+    (b,) = pool.alloc(1)
+    cache.insert([1, 2], {0: a})
+    cache.insert([3, 4], {0: b})
+    for _ in range(3):
+        cache.match([1, 2])
+    dg = cache.heat_digest(16)
+    assert [e["prefix"] for e in dg] == [prefix_hash([1, 2]),
+                                         prefix_hash([3, 4])]
+    assert dg[0]["score"] > dg[1]["score"] > 0
+
+    # heat is RECENCY-weighted: hammer the other prefix and the old
+    # leader's score halves every 2 clock ticks until it's overtaken
+    for _ in range(10):
+        cache.match([3, 4])
+    dg = cache.heat_digest(16)
+    assert dg[0]["prefix"] == prefix_hash([3, 4])
+    # k caps the digest; every score survives JSON round-trip as-is
+    assert len(cache.heat_digest(1)) == 1
+    assert all(isinstance(e["score"], float) for e in dg)
+
+
+def test_heat_table_is_pruned_to_bound():
+    pool = BlockPool(num_blocks=40, block_size=2)
+    cache = RadixPrefixCache(pool, heat_max_entries=4)
+    hot = [1, 2]
+    (h,) = pool.alloc(1)
+    cache.insert(hot, {0: h})
+    for _ in range(8):
+        cache.match(hot)
+    for i in range(10):
+        (blk,) = pool.alloc(1)
+        cache.insert([100 + i, 200 + i], {0: blk})
+        assert len(cache._heat) <= 4
+    # the genuinely hot prefix survived every prune
+    assert any(e["prefix"] == prefix_hash(hot)
+               for e in cache.heat_digest(16))
+
+
+# -- registry: digest round-trip -------------------------------------------
+
+
+def test_registry_heartbeat_digest_roundtrip_and_sanitation():
+    reg = ReplicaRegistry()
+    good = {"prefix": prefix_hash([1, 2, 3]), "score": 2.5}
+    reg.register("http://a:1", replica_id="a", cache_digest=[good])
+    assert reg.get("a").cache_digest == [good]
+    assert reg.get("a").snapshot()["cache_digest"] == [good]
+
+    # heartbeats replace the digest wholesale (it's a point-in-time
+    # top-K, not a delta) and scrub anything that isn't a 16-hex
+    # prefix with a finite non-negative score
+    reg.heartbeat("a", cache_digest=[
+        good,
+        {"prefix": "not-hex!", "score": 1.0},
+        {"prefix": "ab", "score": 1.0},            # wrong length
+        {"prefix": prefix_hash([9]), "score": -1}, # negative
+        {"prefix": prefix_hash([8]), "score": True},  # bool
+        "garbage",
+        {"score": 3.0},
+    ])
+    assert reg.get("a").cache_digest == [good]
+    # a digest longer than the cap is truncated, not rejected
+    reg.heartbeat("a", cache_digest=[
+        {"prefix": prefix_hash([i]), "score": 1.0} for i in range(100)])
+    assert len(reg.get("a").cache_digest) == 64
+    # non-list payloads leave the previous digest untouched
+    reg.heartbeat("a", cache_digest="nope")
+    assert len(reg.get("a").cache_digest) == 64
+
+
+# -- router: /fleet/cache merge --------------------------------------------
+
+
+async def test_fleet_cache_endpoint_merges_digests(aiohttp_client):
+    reg = ReplicaRegistry()
+    shared = prefix_hash([1, 2, 3, 4])
+    only_b = prefix_hash([5, 6, 7, 8])
+    reg.register("http://a:1", replica_id="a", cache_digest=[
+        {"prefix": shared, "score": 2.0}])
+    reg.register("http://b:1", replica_id="b", cache_digest=[
+        {"prefix": shared, "score": 1.5},
+        {"prefix": only_b, "score": 9.0}])
+    client = await aiohttp_client(router_mod.create_router_app(reg))
+    body = await (await client.get("/fleet/cache")).json()
+    assert set(body["replicas"]) == {"a", "b"}
+    assert body["replicas"]["a"]["digest"] == [
+        {"prefix": shared, "score": 2.0}]
+    heat = {e["prefix"]: e for e in body["heat"]}
+    assert heat[shared]["score"] == 3.5
+    assert heat[shared]["replicas"] == ["a", "b"]
+    assert heat[only_b]["replicas"] == ["b"]
+    # sorted hottest-first; one prefix is hot on both replicas
+    assert body["heat"][0]["prefix"] == only_b
+    assert body["shared_prefixes"] == 1
+    assert body["remote_hits_total"] == 0
+    # the counter is zero-seeded in /metrics even before any routing
+    text = await (await client.get("/metrics")).text()
+    assert "fleet_prefix_remote_hits_total 0" in text
+
+
+# -- engine-level conservation ---------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    import jax
+
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.serving import (
+        EngineConfig,
+        InferenceEngine,
+        LLAMA_FAMILY,
+    )
+
+    cfg = llama.LLAMA_TINY
+    params = llama.init(jax.random.key(0), cfg)
+    return InferenceEngine(params, cfg, LLAMA_FAMILY,
+                           EngineConfig(max_len=64))
+
+
+def _batcher(engine, **kw):
+    from kubeflow_tpu.serving.continuous import ContinuousBatcher
+
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("kv_block_size", BS)
+    return ContinuousBatcher(engine, asyncio.Lock(), **kw)
+
+
+def _assert_conserved(b):
+    snap = b.cache_ledger.snapshot()
+    assert snap["conserved"], snap
+    assert snap["frees"][UNATTRIBUTED] == 0
+    assert snap["live_blocks"] == b.cengine.pool.in_use
+    return snap
+
+
+async def test_ledger_conserves_through_reuse_and_close(tiny_engine):
+    """Two identical requests: the second radix-hits, records a reuse
+    distance, and every block the server ever allocated is accounted
+    dead (refdrop) after close — births - frees == 0 live."""
+    b = _batcher(tiny_engine)
+    prompt = [3, 5, 7, 11, 13, 17, 19, 23]  # exactly one full block
+    try:
+        await b.submit(prompt, 4, ())
+        _assert_conserved(b)
+        await b.submit(prompt, 4, ())
+        assert b.prefix_hits >= 1
+        snap = _assert_conserved(b)
+        assert snap["admissions"] == 2
+        assert snap["reuse_distance"]["count"] >= 1
+        assert snap["reuse_distance"]["p50"] >= 1
+        # the reused prefix is the hottest entry, named by the same
+        # hash the router would compute for this prompt
+        anat = b.cache_anatomy()
+        assert anat["heat"][0]["prefix"] == prefix_hash(prompt[:BS])
+    finally:
+        await b.close()
+    # close() keeps the radix warm (cached blocks stay live); clearing
+    # it retires the remainder as refdrop and the books close to zero
+    b._radix.clear()
+    snap = b.cache_ledger.snapshot()
+    assert snap["conserved"] and snap["live_blocks"] == 0
+    assert snap["frees"]["refdrop"] > 0
+    assert snap["births"] == snap["frees_total"]
+
+
+async def test_ledger_books_divergence_on_duplicate_import(tiny_engine):
+    """CoW-style duplicate: importing a migrated prefix the target
+    already cached frees the duplicate blocks under `divergence`, and
+    both replicas' ledgers stay conserved."""
+    from kubeflow_tpu.serving.continuous import MigratedAway
+
+    prompt = [3, 5, 7, 11, 13, 17, 19, 23, 2, 4]
+    src = _batcher(tiny_engine)
+    try:
+        fut, q = src.open_stream(prompt, 12, ())
+        for _ in range(3):
+            assert (await q.get()) is not None
+        records = await src.export_sequences()
+        with pytest.raises(MigratedAway):
+            await fut
+        assert len(records) == 1 and records[0]["kv"] is not None
+        snap = _assert_conserved(src)
+        assert snap["frees"]["migration"] >= records[0]["kv"]["n_full"]
+    finally:
+        await src.close()
+
+    dst = _batcher(tiny_engine)
+    try:
+        n_full = records[0]["kv"]["n_full"]
+        assert await dst.import_sequence(records[0]) == n_full
+        _assert_conserved(dst)
+        # second import of the same record: radix keeps its blocks,
+        # the fresh copies die as divergence
+        assert await dst.import_sequence(records[0]) == 0
+        snap = _assert_conserved(dst)
+        assert snap["frees"]["divergence"] >= n_full
+    finally:
+        await dst.close()
+    assert dst.cache_ledger.snapshot()["conserved"]
+
+
+@pytest.mark.slow
+async def test_ledger_books_pressure_on_preemption(tiny_engine):
+    """Tenancy preemption: the victim's blocks die as `pressure`, and
+    the ledger stays conserved through preempt + replay + close."""
+    from kubeflow_tpu.tenancy import config_from_dict
+
+    qos = {"tenants": {"live": {"priority": "interactive"},
+                       "bulk": {"priority": "batch"}}}
+    b = _batcher(tiny_engine, tenancy=config_from_dict(qos))
+    try:
+        f1 = asyncio.ensure_future(
+            b.submit([3, 5, 7, 11], 24, (("tenant", "bulk"),)))
+        f2 = asyncio.ensure_future(
+            b.submit([4, 6, 8, 10], 24, (("tenant", "bulk"),)))
+        for _ in range(400):
+            if len(b._active) == 2:
+                break
+            await asyncio.sleep(0.02)
+        assert len(b._active) == 2
+        got = await b.submit([9, 2, 4, 8], 8, (("tenant", "live"),))
+        await f1
+        await f2
+        assert len(got) == 8 and b.preemptions >= 1
+        snap = _assert_conserved(b)
+        assert snap["frees"]["pressure"] >= 1
+    finally:
+        await b.close()
+    assert b.cache_ledger.snapshot()["conserved"]
+
+
+# -- router: counterfactual remote hits, two real replicas ------------------
+
+
+async def _start_replica(engine):
+    from kubeflow_tpu.serving import server as server_lib
+
+    app = server_lib.create_serving_app(
+        {"tiny": engine}, continuous=True, max_batch=2,
+        kv_block_size=BS)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    server = TestServer(app, port=port)
+    await server.start_server()
+    return app, server, f"http://127.0.0.1:{port}"
+
+
+@pytest.mark.slow
+async def test_router_counterfactual_remote_hits_two_replicas(
+        tiny_engine):
+    """The headline fleet measurement: a prompt routed (by affinity)
+    to replica X that missed, while peer Y's heartbeat digest shows
+    the same prefix hot, increments fleet_prefix_remote_hits_total —
+    the hit a cross-replica cache tier would have converted."""
+    from kubeflow_tpu.serving import server as server_lib
+
+    app_a, srv_a, url_a = await _start_replica(tiny_engine)
+    app_b, srv_b, url_b = await _start_replica(tiny_engine)
+    reg = ReplicaRegistry()
+    reg.register(url_a, replica_id="ra", models=["tiny"])
+    reg.register(url_b, replica_id="rb", models=["tiny"])
+    router_server = TestServer(router_mod.create_router_app(
+        reg, block_size=BS))
+    await router_server.start_server()
+    rc = TestClient(router_server)
+    try:
+        # a prompt whose affinity key pins replica "ra"
+        ids = ["ra", "rb"]
+        prompt = None
+        for s in range(3, 2000):
+            toks = [s, 1, 2, 3, 5, 7, 11, 13]
+            key = router_mod.affinity_key({"tokens": [toks]}, BS)
+            if rendezvous(key, ids) == "ra":
+                prompt = toks
+                break
+        assert prompt is not None
+
+        # warm the OTHER replica ("rb") with this prompt, out of band
+        peers = {"ra": (app_a, srv_a), "rb": (app_b, srv_b)}
+        pc = TestClient(peers["rb"][1])
+        r = await pc.post("/v1/models/tiny:generate",
+                          json={"tokens": [prompt], "max_new": 2})
+        assert r.status == 200
+        await pc.close()
+        dg = server_lib.fleet_stats(app_b)["cache_digest"]
+        assert any(e["prefix"] == prefix_hash(prompt[:BS])
+                   for e in dg), dg
+        reg.heartbeat("rb", cache_digest=dg)
+        reg.heartbeat("ra", cache_digest=[])
+
+        # routed request lands cold on "ra" while "rb" is hot -> one
+        # counterfactual remote hit, visible on /fleet/cache
+        r = await rc.post("/v1/models/tiny:generate",
+                          json={"tokens": [prompt], "max_new": 2})
+        assert r.status == 200
+        assert r.headers["X-Fleet-Replica"] == "ra"
+        body = await (await rc.get("/fleet/cache")).json()
+        assert body["remote_hits_total"] == 1
+        assert any(e["prefix"] == prefix_hash(prompt[:BS])
+                   and e["replicas"] == ["rb"] for e in body["heat"])
+
+        # once "ra" itself reports the prefix hot, the same request is
+        # a LOCAL hit and the counterfactual counter stays put
+        dg_a = server_lib.fleet_stats(app_a)["cache_digest"]
+        assert any(e["prefix"] == prefix_hash(prompt[:BS])
+                   for e in dg_a), dg_a
+        reg.heartbeat("ra", cache_digest=dg_a)
+        r = await rc.post("/v1/models/tiny:generate",
+                          json={"tokens": [prompt], "max_new": 2})
+        assert r.status == 200
+        body = await (await rc.get("/fleet/cache")).json()
+        assert body["remote_hits_total"] == 1
+        text = await (await rc.get("/metrics")).text()
+        assert "fleet_prefix_remote_hits_total 1" in text
+    finally:
+        await rc.close()
+        await router_server.close()
+        await srv_a.close()
+        await srv_b.close()
