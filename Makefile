@@ -1,7 +1,7 @@
 # Developer entry points (the reference drives everything through
 # per-component Makefiles; here one root Makefile covers the repo).
 
-.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check profile-check serving-check fleet-check kernels-check tenancy-check chaos-check train-check disagg-check cache-check
+.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check profile-check serving-check fleet-check kernels-check tenancy-check chaos-check train-check train-obs-check disagg-check cache-check
 
 verify-all:  ## the full evidence sweep, one command
 	python -m pytest tests -q -m "slow or not slow"
@@ -66,6 +66,11 @@ train-check: ## elastic-training gate: resize/ZeRO/commit-marker suites + metric
 	JAX_PLATFORMS=cpu python -m ci.obs_check train
 	JAX_PLATFORMS=cpu python loadtest/serving_loadtest.py --mode train-chaos \
 	  --train-replicas 2 --train-steps 8 --train-save-every 2
+
+train-obs-check: ## training observatory gate: goodput ledger suite + federated /elastic/metrics conservation contract
+	JAX_PLATFORMS=cpu python -m pytest tests/test_train_obs.py -q \
+	  -m "slow or not slow"
+	JAX_PLATFORMS=cpu python -m ci.obs_check train-obs
 
 disagg-check: ## disaggregated prefill/decode gate: unit suite + pool metrics contract + A/B loadtest
 	JAX_PLATFORMS=cpu python -m pytest tests/test_disagg.py \

@@ -501,6 +501,74 @@ def bench_train_zero(*, verbose: bool = True) -> dict:
     return m
 
 
+def bench_train_goodput(*, steps: int = 6, seq: int = 16,
+                        verbose: bool = True) -> dict:
+    """Goodput observatory on the bench path (ISSUE 14): the tiny
+    trainer runs under a real GoodputLedger — the first step books to
+    `compile`, the rest to `productive` with the model-FLOPs estimate
+    attached — and the section reports the resulting goodput fraction.
+    The run also asserts the ledger's conservation invariant on real
+    (not scripted) clocks. Unit "fraction" keeps the number
+    informational in the bench gate: it is a property of this tiny
+    compile-dominated run, not a regression surface."""
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.parallel import MeshSpec, create_mesh
+    from kubeflow_tpu.train import Trainer, TrainConfig
+    from kubeflow_tpu.train.goodput import GoodputLedger
+
+    cfg = bench_configs()["tiny"]
+    n_devices = len(jax.devices())
+    mesh = create_mesh(MeshSpec(data=1, fsdp=n_devices, tensor=1))
+    batch = n_devices  # one sample per device keeps the section cheap
+    trainer = Trainer(
+        mesh=mesh,
+        apply_fn=lambda p_, t: llama.apply(p_, cfg, t),
+        init_fn=lambda k: llama.init(k, cfg),
+        logical_axes=llama.param_logical_axes(cfg),
+        train_config=TrainConfig(warmup_steps=10, total_steps=1000),
+    )
+    state = trainer.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    ledger = GoodputLedger()
+    flops = trainer.step_flops(batch, seq)
+    for i in range(steps):
+        # step walls on the ledger's own clock (time.monotonic): mixing
+        # clocks lets bookings exceed wall by microseconds and trips
+        # the conservation assert below
+        t0 = time.monotonic()
+        state, loss = trainer.step(state, tokens, targets)
+        float(loss)  # device sync: the wall is the step, not dispatch
+        ledger.note_step(i, time.monotonic() - t0,
+                        tokens=batch * seq, flops=flops,
+                        compiling=(i == 0))
+    snap = ledger.snapshot()
+    if not snap["conserved"]:
+        raise AssertionError(
+            f"goodput ledger failed conservation on the bench run: "
+            f"booked {snap['booked_seconds']:.3f}s != wall "
+            f"{snap['wall_seconds']:.3f}s "
+            f"(unattributed {snap['seconds']['unattributed']:.3f}s)")
+    gen = detect_generation()
+    if verbose:
+        s = snap["seconds"]
+        print(f"# train-goodput steps={steps} "
+              f"fraction={snap['goodput_fraction']:.3f} "
+              f"compile={s['compile']:.2f}s "
+              f"productive={s['productive']:.2f}s "
+              f"tokens/s={snap['tokens_per_second']:.0f}",
+              file=sys.stderr)
+    return {
+        "metric": f"train_goodput_fraction[tiny,{gen}]",
+        "value": round(snap["goodput_fraction"], 4),
+        "unit": "fraction",
+        "vs_baseline": round(snap["goodput_fraction"], 4),
+    }
+
+
 def _decode_model(name: str):
     """(cfg, init_fn, family) for the decode benches: the llama bench
     configs plus the gemma family (BASELINE config #5 "Gemma-2B
@@ -1411,8 +1479,8 @@ def first_compile_metric() -> dict:
 # flash4k stays LAST (known wedge risk — see ordering note below);
 # mnist/vit/decode-gemma complete the BASELINE.md config matrix
 # (configs #1, #2, #5 — VERDICT r04 weak #4).
-ALL_SECTIONS = ("train500m", "train1b", "train-zero", "decode",
-                "decode-int8", "decode-cont", "decode-paged",
+ALL_SECTIONS = ("train500m", "train1b", "train-zero", "train-goodput",
+                "decode", "decode-int8", "decode-cont", "decode-paged",
                 "decode-spec-paged", "decode-paged-kernel",
                 "decode-gemma", "serving-disagg", "mnist", "vit",
                 "flash4k")
@@ -1428,10 +1496,10 @@ _SECTION_TIMEOUT_S = float(
 
 def _sweep_for(backend: str, wanted: list[str], p) -> list[str]:
     sweep = (list(ALL_SECTIONS) if backend == "tpu"
-             else ["train500m", "train-zero", "decode", "decode-int8",
-                   "decode-cont", "decode-paged", "decode-spec-paged",
-                   "decode-paged-kernel", "decode-gemma",
-                   "serving-disagg", "mnist", "vit"])
+             else ["train500m", "train-zero", "train-goodput", "decode",
+                   "decode-int8", "decode-cont", "decode-paged",
+                   "decode-spec-paged", "decode-paged-kernel",
+                   "decode-gemma", "serving-disagg", "mnist", "vit"])
     if wanted:
         unavailable = [s for s in wanted if s not in sweep]
         if unavailable:
@@ -1730,6 +1798,11 @@ def _run_sweep(sweep: list[str], backend: str, *, in_child: bool,
             return m
 
         guarded("train-zero", _train_zero)
+    if "train-goodput" in sweep:
+        # Goodput ledger on the bench path: conservation asserted on
+        # real clocks; the fraction itself stays informational.
+        guarded("train-goodput",
+                lambda: bench_train_goodput(verbose=verbose))
     if "flash4k" in sweep:
         guarded("flash4k", lambda: bench_train(
             TRAIN_PRESETS["tpu-flash-4k"], assert_flash=True,

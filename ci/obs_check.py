@@ -683,6 +683,210 @@ async def run_train_check() -> list[str]:
     return failures
 
 
+async def run_train_obs_check() -> list[str]:
+    """Seventh act (ISSUE 14): the training observatory. Boot the
+    coordinator — real aiohttp app, no jax — plus two fake workers
+    that carry REAL goodput ledgers and registries in their
+    heartbeats, and hold `GET /elastic/metrics` to the contract:
+
+    - the federated exposition strict-parses with the goodput catalog
+      (`train_goodput_seconds_total{cause}`, wall gauge, tokens/s,
+      straggler + fraction gauges, `slo_burn_rate{slo=train_*}`)
+      zero-seeded before any worker ever stepped;
+    - CONSERVATION as an equality between planes: the summed per-cause
+      counters in the federated scrape == the summed wall gauge == the
+      workers' own ledger books (every worker-second attributed,
+      nothing minted in flight);
+    - `GET /elastic/traces` merges the workers' Chrome traces onto
+      per-worker process tracks.
+    """
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu.controlplane.metrics import Registry
+    from kubeflow_tpu.obs.slo import WINDOWS
+    from kubeflow_tpu.train.elastic import (
+        ElasticCoordinator,
+        create_coordinator_app,
+    )
+    from kubeflow_tpu.train.goodput import (
+        GOODPUT_CAUSES,
+        LOST_CAUSES,
+        GoodputLedger,
+        bind_ledger_metrics,
+    )
+
+    failures: list[str] = []
+    clock_t = [0.0]
+    coord = ElasticCoordinator(
+        min_replicas=2, degraded_after_s=5.0, dead_after_s=10.0,
+        clock=lambda: clock_t[0], registry=Registry())
+    client = TestClient(TestServer(create_coordinator_app(coord)))
+
+    class FakeWorker:
+        """A trainer worker reduced to its telemetry: a goodput ledger
+        on a scripted clock, a registry exposing it, and a canned
+        Chrome trace — exactly the payload run_worker's heartbeater
+        enriches beats with."""
+
+        def __init__(self, rid: str):
+            self.rid = rid
+            self.t = [0.0]
+            self.ledger = GoodputLedger(clock=lambda: self.t[0],
+                                        wall=lambda: self.t[0])
+            self.registry = Registry()
+            bind_ledger_metrics(self.registry, self.ledger)
+
+        def payload(self, **extra) -> dict:
+            trace = {"displayTimeUnit": "ms", "traceEvents": [
+                {"name": "train.step", "ph": "X", "ts": 0,
+                 "dur": 1000, "pid": 1, "tid": 1}]}
+            return {"replica_id": self.rid,
+                    "goodput": self.ledger.snapshot(),
+                    "metrics": self.registry.render(),
+                    "trace": trace, **extra}
+
+    try:
+        await client.start_server()
+
+        async def federated() -> dict:
+            resp = await client.get("/elastic/metrics")
+            text = await resp.text()
+            if resp.status != 200:
+                failures.append(f"/elastic/metrics -> {resp.status}")
+                return {}
+            try:
+                return parse_exposition(text)
+            except ExpositionError as e:
+                failures.append(
+                    f"/elastic/metrics failed strict parse: {e}")
+                return {}
+
+        def sample(families: dict, fam: str, sname: str, **labels):
+            f = families.get(fam)
+            if f is None:
+                failures.append(
+                    f"/elastic/metrics missing family {fam}")
+                return None
+            key = (sname, tuple(sorted(labels.items())))
+            if key not in f["samples"]:
+                failures.append(
+                    f"/elastic/metrics missing sample {sname}{labels}")
+                return None
+            return f["samples"][key]
+
+        # 1. zero-seeded goodput catalog before ANY worker exists
+        fams = await federated()
+        for c in (*GOODPUT_CAUSES, "unattributed"):
+            if sample(fams, "train_goodput_seconds_total",
+                      "train_goodput_seconds_total",
+                      cause=c) not in (0, None):
+                failures.append(
+                    f"train_goodput_seconds_total[{c}] not zero-seeded")
+        for c in LOST_CAUSES:
+            if sample(fams, "train_replay_seconds_total",
+                      "train_replay_seconds_total",
+                      cause=c) not in (0, None):
+                failures.append(
+                    f"train_replay_seconds_total[{c}] not zero-seeded")
+        for g in ("train_goodput_wall_seconds", "train_tokens_per_second",
+                  "train_straggler_ratio", "train_goodput_fraction"):
+            if sample(fams, g, g) not in (0, None):
+                failures.append(f"{g} not zero-seeded")
+        if sample(fams, "train_worker_step_seconds",
+                  "train_worker_step_seconds",
+                  worker="other") not in (0, None):
+            failures.append(
+                "train_worker_step_seconds[other] not zero-seeded")
+        for slo in ("train_step_time", "train_checkpoint_save",
+                    "train_goodput", "train_restart_burn"):
+            for w in WINDOWS:
+                if sample(fams, "slo_burn_rate", "slo_burn_rate",
+                          slo=slo, window=w) not in (0, None):
+                    failures.append(
+                        f"slo_burn_rate[{slo},{w}] not zero-seeded")
+
+        # 2. a gang of two ledger-carrying workers steps, one stalls
+        workers = [FakeWorker("tr0"), FakeWorker("tr1")]
+        for w in workers:
+            resp = await client.post("/elastic/register",
+                                     json=w.payload(step=0))
+            if resp.status != 200:
+                failures.append(f"register {w.rid} -> {resp.status}")
+        for i in range(3):
+            for w, dt in zip(workers, (0.1, 0.3)):
+                w.t[0] += dt
+                w.ledger.note_step(i, dt, tokens=64, flops=100.0)
+            workers[1].t[0] += 0.1
+            with workers[1].ledger.book("stall"):
+                workers[1].t[0] += 0.2
+            clock_t[0] += 0.5
+            for w, dt in zip(workers, (0.1, 0.3)):
+                resp = await client.post(
+                    "/elastic/heartbeat",
+                    json=w.payload(step=i + 1, step_seconds=dt))
+                if resp.status != 200:
+                    failures.append(
+                        f"heartbeat {w.rid} -> {resp.status}")
+
+        # 3. conservation equality across the federation boundary
+        fams = await federated()
+        fam = fams.get("train_goodput_seconds_total", {"samples": {}})
+        booked = sum(fam["samples"].values())
+        wall_fam = fams.get("train_goodput_wall_seconds",
+                            {"samples": {}})
+        wall = sum(wall_fam["samples"].values())
+        ledgers = sum(w.ledger.snapshot()["wall_seconds"]
+                      for w in workers)
+        if abs(booked - wall) > 1e-6:
+            failures.append(
+                f"federated goodput not conserved: cause counters sum "
+                f"{booked} != wall gauge {wall}")
+        if abs(wall - ledgers) > 1e-6:
+            failures.append(
+                f"federated wall {wall} != workers' own ledgers "
+                f"{ledgers} (seconds minted or lost in federation)")
+        if not any(w.ledger.snapshot()["conserved"] for w in workers):
+            failures.append("worker ledgers report conserved=False")
+        for rid in ("coordinator", "tr0", "tr1"):
+            if sample(fams, "fleet_federation_up",
+                      "fleet_federation_up", replica=rid) != 1:
+                failures.append(
+                    f"fleet_federation_up[{rid}] != 1 with the gang "
+                    "live")
+        # the stalling worker moved the forensics gauges
+        ratio = sample(fams, "train_straggler_ratio",
+                       "train_straggler_ratio")
+        if ratio is not None and not ratio > 1.0:
+            failures.append(
+                f"train_straggler_ratio {ratio} did not flag the 3x "
+                "straggler")
+        if sample(fams, "train_worker_step_seconds",
+                  "train_worker_step_seconds", worker="tr1") != 0.3:
+            failures.append(
+                "train_worker_step_seconds[tr1] != its reported 0.3")
+        stall = sample(fams, "train_replay_seconds_total",
+                       "train_replay_seconds_total", cause="stall")
+        if stall is not None and not stall > 0:
+            failures.append(
+                "train_replay_seconds_total[stall] stayed 0 through a "
+                "booked stall")
+
+        # 4. merged traces: one process track per live worker
+        resp = await client.get("/elastic/traces")
+        payload = json.loads(await resp.text())
+        tracks = {e["args"]["name"]
+                  for e in payload.get("traceEvents", [])
+                  if e.get("ph") == "M"
+                  and e.get("name") == "process_name"}
+        if tracks != {"tr0", "tr1"}:
+            failures.append(
+                f"/elastic/traces tracks {sorted(tracks)} != one per "
+                "worker ['tr0', 'tr1']")
+    finally:
+        await client.close()
+    return failures
+
+
 async def run_disagg_check() -> list[str]:
     """Fifth act (ISSUE 12): boot the router over pool-labeled STUB
     replicas — no jax — and hold the disaggregation plane to the
@@ -834,7 +1038,7 @@ async def run_disagg_check() -> list[str]:
 
 
 def main(argv: list[str] | None = None) -> int:
-    """Default: all six acts. `python -m ci.obs_check profile` runs
+    """Default: all seven acts. `python -m ci.obs_check profile` runs
     only the serving step-anatomy act (`make profile-check`); it and
     `cache` are the acts that compile jax programs, so the fast acts
     stay usable on their own. `python -m ci.obs_check disagg` is the
@@ -848,6 +1052,7 @@ def main(argv: list[str] | None = None) -> int:
         "profile": run_profile_check,
         "fleet": run_fleet_check,
         "train": run_train_check,
+        "train-obs": run_train_obs_check,
         "disagg": run_disagg_check,
         "cache": run_cache_check,
     }
@@ -870,9 +1075,11 @@ def main(argv: list[str] | None = None) -> int:
           "/fleet/metrics federates two replicas under the same "
           "contract, the train_* catalog zero-seeds + tracks "
           "membership, the pool-labeled disaggregation plane "
-          "zero-seeds + tracks a prefill->decode handoff, and the "
+          "zero-seeds + tracks a prefill->decode handoff, the "
           "KV-cache ledger conserves (causes sum to frees, zero "
-          "unattributed) with a hashed heat digest on the model card")
+          "unattributed) with a hashed heat digest on the model card, "
+          "and /elastic/metrics federates goodput ledgers conserved "
+          "(cause counters == wall) with per-worker trace tracks")
     return 0
 
 

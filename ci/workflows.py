@@ -510,6 +510,45 @@ def train_check_workflow() -> dict:
     }
 
 
+def train_obs_check_workflow() -> dict:
+    """Training-observatory gate (ISSUE 14): `make train-obs-check`
+    runs the goodput-ledger suite (conservation on scripted clocks,
+    replay attribution across a kill/restore, straggler-ratio math,
+    the heartbeat -> /elastic/metrics federation round-trip, train SLO
+    burn windows, trace-merge track naming) plus the federated metrics
+    contract: the goodput catalog zero-seeded in one coordinator
+    scrape and the conservation EQUALITY — summed per-cause counters
+    == summed wall gauges == the workers' own ledgers — held across
+    the federation boundary. Any new wait the trainer grows that
+    forgets to book its cause fails here, not in a capacity review."""
+    return {
+        "name": "train obs check",
+        "on": {
+            "pull_request": {"paths": ["kubeflow_tpu/train/**",
+                                       "kubeflow_tpu/obs/**",
+                                       "tests/test_train_obs.py",
+                                       "ci/obs_check.py",
+                                       "Makefile"]},
+            "push": {"branches": ["main"]},
+        },
+        "jobs": {
+            "train-obs-check": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e .[ci] pytest"},
+                    {"name": "goodput ledger suite + federated "
+                             "conservation contract",
+                     "run": "make train-obs-check",
+                     "env": {"JAX_PLATFORMS": "cpu"}},
+                ],
+            }
+        },
+    }
+
+
 def disagg_check_workflow() -> dict:
     """Disaggregated-serving gate (ISSUE 12): `make disagg-check` runs
     the pool/handoff unit suite (pool-aware pick, handoff token parity
@@ -722,6 +761,7 @@ def all_workflows() -> dict[str, dict]:
     out["fleet_check.yaml"] = fleet_check_workflow()
     out["chaos_check.yaml"] = chaos_check_workflow()
     out["train_check.yaml"] = train_check_workflow()
+    out["train_obs_check.yaml"] = train_obs_check_workflow()
     out["disagg_check.yaml"] = disagg_check_workflow()
     out["cache_check.yaml"] = cache_check_workflow()
     out["tenancy_check.yaml"] = tenancy_check_workflow()

@@ -56,7 +56,7 @@ from kubeflow_tpu.obs.profiling import (
     abstract_signature,
     merge_counter_tracks,
 )
-from kubeflow_tpu.obs.slo import Slo, SloEngine
+from kubeflow_tpu.obs.slo import Slo, SloEngine, get_or_create_slo_engine
 from kubeflow_tpu.obs.timeline import RequestTimeline, TimelineStore
 from kubeflow_tpu.obs.tracing import (
     Span,
@@ -101,6 +101,7 @@ __all__ = [
     "federate",
     "format_float",
     "get_or_create_histogram",
+    "get_or_create_slo_engine",
     "merge_chrome_traces",
     "merge_counter_tracks",
     "merge_families",

@@ -93,6 +93,18 @@ class SloEngine:
             s.name: collections.deque(maxlen=MAX_EVENTS_PER_SLO)
             for s in slos}
 
+    def add(self, slo: Slo) -> None:
+        """Add an objective to a live engine. A no-op when the name
+        already exists (first definition wins — two owners sharing one
+        registry must agree on the objective, and the shared engine is
+        the one place they meet)."""
+        with self._lock:
+            if slo.name in self.slos:
+                return
+            self.slos[slo.name] = slo
+            self._events[slo.name] = collections.deque(
+                maxlen=MAX_EVENTS_PER_SLO)
+
     # -- feed side ---------------------------------------------------------
 
     def record(self, name: str, good: bool) -> None:
@@ -142,3 +154,32 @@ class SloEngine:
             for wname in WINDOWS:
                 yield (self.name, {"slo": name, "window": wname},
                        rates[(name, wname)])
+
+
+def get_or_create_slo_engine(registry, slos, *,
+                             short_window_s: float = 60.0,
+                             long_window_s: float = 600.0,
+                             clock: Callable[[], float] | None = None):
+    """One burn-rate engine per registry.
+
+    The engine IS the `slo_burn_rate` metric, so a registry can hold
+    exactly one; every component that wants objectives on a shared
+    registry (a serving app and a coordinator in one test process, or
+    several apps behind one /metrics) must feed the same instance.
+    Registers a fresh engine when the registry has none, otherwise
+    merges the requested `slos` into the existing engine (first
+    definition of a name wins) and returns it.
+    """
+    engine = registry.get("slo_burn_rate")
+    if engine is None:
+        engine = SloEngine(slos, short_window_s=short_window_s,
+                           long_window_s=long_window_s, clock=clock)
+        try:
+            registry.register(engine)
+        except ValueError:
+            engine = registry.get("slo_burn_rate") or engine
+        else:
+            return engine
+    for slo in slos:
+        engine.add(slo)
+    return engine

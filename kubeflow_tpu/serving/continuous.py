@@ -1364,6 +1364,9 @@ class ContinuousBatcher:
         self.timelines = TimelineStore()
         self.on_itl = None
         self.on_queue_wait = None
+        # on_spec_round(proposed: int, accepted: int) — per speculative
+        # verify round; the server feeds the spec-acceptance SLO
+        self.on_spec_round = None
         # optional obs.Tracer: when set (the server wires it), every
         # decode-chunk dispatch opens a `decode.attention` span in the
         # executor thread, tagged with the RESOLVED attention impl —
@@ -2557,7 +2560,9 @@ class ContinuousBatcher:
                 self._dst = dst
                 self._rng = rng
         self.calls += 1
-        self.spec_proposed += gamma * len(snap)
+        round_proposed = gamma * len(snap)
+        round_accepted = 0
+        self.spec_proposed += round_proposed
         emitted0 = self.tokens_emitted
         with self.profiler.phase("detokenize"):
             for slot, srec in list(self._active.items()):
@@ -2568,6 +2573,7 @@ class ContinuousBatcher:
                     continue
                 acc = int(k[slot])
                 self.spec_accepted += acc
+                round_accepted += acc
                 for j in range(acc + 1):
                     self._emit(slot, srec, int(emit[slot, j]),
                                float(lps[slot, j]))
@@ -2575,6 +2581,11 @@ class ContinuousBatcher:
                         break  # retired mid-window; tail is dropped
         self.profiler.add_tokens("verify",
                                  self.tokens_emitted - emitted0)
+        if self.on_spec_round is not None and round_proposed:
+            try:
+                self.on_spec_round(round_proposed, round_accepted)
+            except Exception:
+                pass  # hooks must never kill the worker
 
     def _plan_steps(self, inflight) -> int:
         """Next chunk size: bounded by the longest remaining budget NOT

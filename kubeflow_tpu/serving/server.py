@@ -101,6 +101,13 @@ SLO_TTFT_THRESHOLDS_S = {
 SLO_ITL_THRESHOLD_S = 0.25
 SLO_LATENCY_OBJECTIVE = 0.95   # 95% of requests under threshold
 SLO_ERROR_OBJECTIVE = 0.99     # 99% of requests without a 5xx
+# Speculative decoding pays for itself only while the draft keeps
+# guessing right: every verified draft token is a good/bad event, and
+# the burn rate pages when the accepted fraction drops below this
+# objective (a stale or mismatched draft silently BURNS throughput —
+# each rejected token is a wasted verify slot). Overridable per
+# deployment via `create_serving_app(slo_spec_acceptance=...)`.
+SLO_SPEC_ACCEPTANCE_OBJECTIVE = 0.5
 
 
 class ServingObs:
@@ -109,7 +116,8 @@ class ServingObs:
     registry, `/debug/traces` exports the tracer's ring; every request
     carries its trace id back in `X-Trace-Id`."""
 
-    def __init__(self, registry=None, tracer=None, *, slo_ttft_s=None):
+    def __init__(self, registry=None, tracer=None, *, slo_ttft_s=None,
+                 slo_spec_acceptance: float | None = None):
         # controlplane.metrics is pure Python (no jax/store state is
         # touched here) — the ONE Registry implementation serves all
         # three layers rather than a drifted serving copy.
@@ -311,13 +319,16 @@ class ServingObs:
                         description=f"99% of {cls} requests answered "
                                     "without a 5xx")
                     for cls in PRIORITIES)
-        self.slo = obs_lib.SloEngine(slos)
-        try:
-            self.registry.register(self.slo)
-        except ValueError:
-            # shared registry already carries a burn-rate gauge (one
-            # process hosting several apps): feed the existing one
-            self.slo = self.registry.get("slo_burn_rate") or self.slo
+        spec_obj = SLO_SPEC_ACCEPTANCE_OBJECTIVE \
+            if slo_spec_acceptance is None else float(slo_spec_acceptance)
+        slos.append(obs_lib.Slo(
+            "serving_spec_acceptance", spec_obj,
+            description=f"{spec_obj:.0%} of verified draft tokens "
+                        "accepted (below this the draft burns more "
+                        "verify slots than it saves)"))
+        # shared-registry rule: one burn-rate engine per registry (a
+        # process hosting several apps feeds the first one)
+        self.slo = obs_lib.get_or_create_slo_engine(self.registry, slos)
         # X-Tenant is a raw client header: anywhere it becomes a label
         # or span attribute it passes this guard, so a scanner minting
         # fresh values cannot mint unbounded timeseries.
@@ -608,6 +619,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                        drain_grace_s: float = 30.0,
                        tenancy: TenancyConfig | None = None,
                        slo_ttft_s: dict[str, float] | None = None,
+                       slo_spec_acceptance: float | None = None,
                        pool: str = "mixed",
                        ) -> web.Application:
     """`tokenizer` (data.bpe.Tokenizer or anything with encode/decode)
@@ -676,7 +688,8 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     app[POOL_KEY] = pool
     app[DRAIN_KEY] = {"draining": False, "grace_s": float(drain_grace_s)}
     sobs = ServingObs(registry=registry, tracer=tracer,
-                      slo_ttft_s=slo_ttft_s)
+                      slo_ttft_s=slo_ttft_s,
+                      slo_spec_acceptance=slo_spec_acceptance)
     app[OBS_KEY] = sobs
     app[ENGINES_KEY] = engines
     unknown = set(drafts or {}) - set(engines)
@@ -794,8 +807,20 @@ def create_serving_app(engines: dict[str, InferenceEngine],
             def on_queue_wait(wait, _m=model_name):
                 sobs.queue_wait.observe(wait, model=_m)
 
+            # every verified draft token is one good/bad event against
+            # the spec-acceptance SLO (rejected = budget burned); the
+            # series zero-seeds with the engine whether or not
+            # spec_decode is on, so the dashboard shape is stable
+            def on_spec_round(proposed, accepted):
+                accepted = min(int(accepted), int(proposed))
+                for _ in range(accepted):
+                    sobs.slo.record("serving_spec_acceptance", True)
+                for _ in range(int(proposed) - accepted):
+                    sobs.slo.record("serving_spec_acceptance", False)
+
             b.on_itl = on_itl
             b.on_queue_wait = on_queue_wait
+            b.on_spec_round = on_spec_round
             # seed zero samples so the exposition carries the series
             # (and a 0 reading) before the first admission
             sobs.prefix_hits.inc(0, model=model_name)

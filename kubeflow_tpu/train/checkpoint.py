@@ -103,16 +103,13 @@ class Checkpointer:
         self._closed = False
         self._handlers_installed = False
         reg = registry if registry is not None else obs.default_registry()
-        self.save_seconds = obs.get_or_create_histogram(
-            reg, "train_checkpoint_save_seconds",
-            "checkpoint save wall time (async: dispatch + previous-save "
-            "drain, not the device->disk copy itself)")
-        self.restore_seconds = obs.get_or_create_histogram(
-            reg, "train_checkpoint_restore_seconds",
-            "checkpoint restore wall time onto the current mesh "
-            "(includes cross-replica-count resharding on resize)")
-        self.save_seconds.seed()
-        self.restore_seconds.seed()
+        # one catalog site (train.goodput.checkpoint_histograms) owns
+        # the name/help/bucket definitions — the coordinator zero-seeds
+        # the same families and the two may not drift
+        from kubeflow_tpu.train.goodput import checkpoint_histograms
+
+        self.save_seconds, self.restore_seconds = \
+            checkpoint_histograms(reg)
         if config.install_crash_handlers:
             self.install_crash_handlers()
 

@@ -40,9 +40,17 @@ import time
 from typing import Any, Callable, Mapping
 
 from kubeflow_tpu import obs
-from kubeflow_tpu.controlplane.metrics import Counter, Gauge
+from kubeflow_tpu.controlplane.metrics import Counter, Gauge, Registry
 from kubeflow_tpu.fleet import registry as fleet_registry
 from kubeflow_tpu.fleet.registry import STATES, ReplicaRegistry
+from kubeflow_tpu.train.goodput import (
+    GOODPUT_CAUSES,
+    LOST_CAUSES,
+    GoodputLedger,
+    bind_ledger_metrics,
+    checkpoint_histograms,
+    goodput_metrics,
+)
 
 log = logging.getLogger(__name__)
 
@@ -55,6 +63,13 @@ PHASE_STEP = "step"
 PHASE_SAVING = "saving"
 PHASE_RESTORING = "restoring"
 PHASE_DONE = "done"
+
+# Everything a worker heartbeat may carry. The observatory keys
+# (step_seconds, saves/save_seconds, goodput, metrics, trace) ride the
+# same POST as the membership keys — one beat is both liveness and
+# telemetry, so a worker that is alive is by construction observable.
+HEARTBEAT_KEYS = ("step", "loss", "phase", "generation", "step_seconds",
+                  "saves", "save_seconds", "goodput", "metrics", "trace")
 
 
 class ElasticCoordinator:
@@ -70,7 +85,12 @@ class ElasticCoordinator:
                  degraded_after_s: float = 6.0,
                  dead_after_s: float = 20.0,
                  clock: Callable[[], float] = time.monotonic,
-                 registry=None):
+                 registry=None,
+                 slo_step_time_s: float = 2.0,
+                 slo_checkpoint_save_s: float = 10.0,
+                 restart_burn_hold_s: float = 5.0,
+                 slo_short_window_s: float = 60.0,
+                 slo_long_window_s: float = 600.0):
         self.min_replicas = int(min_replicas)
         self._registry = ReplicaRegistry(
             degraded_after_s=degraded_after_s,
@@ -78,6 +98,7 @@ class ElasticCoordinator:
             clock=clock,
         )
         self._lock = threading.Lock()
+        self._clock = clock
         self._stats: dict[str, dict[str, Any]] = {}
         self._members: tuple[str, ...] = ()
         self._generation = 0
@@ -108,15 +129,81 @@ class ElasticCoordinator:
         self.restarts_total.inc(0.0)
         # The full train_* metric catalog lives on the coordinator's
         # registry so one /metrics scrape sees every family zero-seeded
-        # (ci.obs_check train) even before any checkpoint I/O happened.
-        obs.get_or_create_histogram(
-            self.registry, "train_checkpoint_save_seconds",
-            "checkpoint save wall time (async: dispatch + previous-save "
-            "drain, not the device->disk copy itself)").seed()
-        obs.get_or_create_histogram(
-            self.registry, "train_checkpoint_restore_seconds",
-            "checkpoint restore wall time onto the current mesh "
-            "(includes cross-replica-count resharding on resize)").seed()
+        # (ci.obs_check train / train-obs) even before any checkpoint
+        # I/O or worker telemetry happened.
+        checkpoint_histograms(self.registry)
+        # -- goodput observatory (ISSUE 14) --------------------------------
+        # Worker labels pass a guard so a flapping fleet cannot mint
+        # unbounded timeseries; past the cap stragglers collapse into
+        # the "other" bucket (which we zero-seed so the family exists).
+        self._worker_guard = obs.LabelGuard(max_values=32)
+        self.worker_step_seconds = self.registry.get(
+            "train_worker_step_seconds")
+        if self.worker_step_seconds is None:
+            self.worker_step_seconds = Gauge(
+                "train_worker_step_seconds",
+                "Latest steady-state step wall time per worker "
+                "(straggler forensics; 0 = no step yet or worker lost)",
+                self.registry)
+        self.worker_step_seconds.set(0.0, worker=obs.OVERFLOW_LABEL)
+        self.straggler_ratio = self.registry.get("train_straggler_ratio")
+        if self.straggler_ratio is None:
+            self.straggler_ratio = Gauge(
+                "train_straggler_ratio",
+                "Slowest / median live-worker step time (1.0 = uniform "
+                "fleet; the gang runs at the slowest member's pace)",
+                self.registry)
+        self.straggler_ratio.set(0.0)
+        self.goodput_fraction = self.registry.get("train_goodput_fraction")
+        if self.goodput_fraction is None:
+            self.goodput_fraction = Gauge(
+                "train_goodput_fraction",
+                "Fleet productive worker-seconds over all booked "
+                "worker-seconds, cumulative across worker incarnations",
+                self.registry)
+        self.goodput_fraction.set(0.0)
+        self.replay_seconds_total = self.registry.get(
+            "train_replay_seconds_total")
+        if self.replay_seconds_total is None:
+            self.replay_seconds_total = Counter(
+                "train_replay_seconds_total",
+                "Fleet worker-seconds NOT spent advancing the run, by "
+                "cause (replay = re-running steps past the last "
+                "committed checkpoint — the direct price of a restart)",
+                self.registry)
+        for _c in LOST_CAUSES:
+            self.replay_seconds_total.inc(0.0, cause=_c)
+        # Zero-seed the worker-side goodput families too: one scrape of
+        # the coordinator (or of /elastic/metrics with zero live
+        # workers) still shows the full catalog shape.
+        goodput_metrics(self.registry)
+        # -- train SLOs (PR 6 engine; the engine IS slo_burn_rate) ---------
+        self.restart_burn_hold_s = float(restart_burn_hold_s)
+        self._burn_until = 0.0
+        self._saves_seen: dict[str, int] = {}
+        self._goodput_last: dict[str, dict[str, float]] = {}
+        self._fleet_seconds: dict[str, float] = {
+            c: 0.0 for c in (*GOODPUT_CAUSES, obs.UNATTRIBUTED)}
+        self.slo = obs.get_or_create_slo_engine(self.registry, [
+            obs.Slo("train_step_time", 0.9,
+                    threshold_s=float(slo_step_time_s),
+                    description="90% of steady-state worker steps "
+                                f"under {slo_step_time_s:g} s"),
+            obs.Slo("train_checkpoint_save", 0.9,
+                    threshold_s=float(slo_checkpoint_save_s),
+                    description="90% of checkpoint saves under "
+                                f"{slo_checkpoint_save_s:g} s"),
+            obs.Slo("train_goodput", 0.9,
+                    description="90% of goodput pulses productive: a "
+                                "heartbeat interval must book at least "
+                                "as many productive seconds as replay+"
+                                "restore+compile+stall combined"),
+            obs.Slo("train_restart_burn", 0.99,
+                    description="99% of heartbeats outside a restart "
+                                "hold window (a lost member burns the "
+                                "budget for restart_burn_hold_s)"),
+        ], short_window_s=slo_short_window_s,
+           long_window_s=slo_long_window_s, clock=clock)
 
     # -- membership ------------------------------------------------------
 
@@ -142,9 +229,74 @@ class ElasticCoordinator:
 
     def _note(self, replica_id: str, stats: Mapping[str, Any]) -> None:
         slot = self._stats.setdefault(replica_id, {})
-        for key in ("step", "loss", "phase", "generation"):
+        prev_step = slot.get("step")
+        for key in HEARTBEAT_KEYS:
             if stats.get(key) is not None:
                 slot[key] = stats[key]
+        # straggler forensics: latest steady step wall per worker, and
+        # one step-time SLO event per step ADVANCE (heartbeats repeat
+        # the latest value between steps; re-recording it would drown
+        # the burn windows in duplicates)
+        ss = stats.get("step_seconds")
+        if ss is not None:
+            self.worker_step_seconds.set(
+                float(ss), worker=self._worker_guard.admit(replica_id))
+            if stats.get("step") is not None \
+                    and stats.get("step") != prev_step:
+                self.slo.observe("train_step_time", float(ss))
+        # checkpoint-save SLO: once per completed save (the `saves`
+        # counter dedups the repeated heartbeat snapshots)
+        saves = stats.get("saves")
+        if saves is not None and stats.get("save_seconds") is not None \
+                and int(saves) > self._saves_seen.get(replica_id, 0):
+            self._saves_seen[replica_id] = int(saves)
+            self.slo.observe("train_checkpoint_save",
+                             float(stats["save_seconds"]))
+        gp = stats.get("goodput")
+        if isinstance(gp, Mapping):
+            self._ingest_goodput(replica_id, gp)
+        # restart-burn pulse: every heartbeat inside the hold window
+        # after a lost member is a bad event — the burn rate stays hot
+        # for restart_burn_hold_s, then recovers
+        self.slo.record("train_restart_burn",
+                        self._clock() >= self._burn_until)
+
+    def _ingest_goodput(self, replica_id: str,
+                        gp: Mapping[str, Any]) -> None:
+        """Fold one worker's cumulative ledger snapshot into the fleet
+        cause totals via clamped deltas. A restarted worker's ledger
+        begins at zero — detected by its wall clock rewinding — so
+        every incarnation's seconds count exactly once."""
+        secs = gp.get("seconds")
+        if not isinstance(secs, Mapping):
+            return
+        wall = float(gp.get("wall_seconds") or 0.0)
+        last = self._goodput_last.get(replica_id)
+        if last is None or wall < last.get("_wall", 0.0) - 1e-6:
+            last = {"_wall": 0.0}
+        deltas: dict[str, float] = {}
+        for c in (*GOODPUT_CAUSES, obs.UNATTRIBUTED):
+            v = float(secs.get(c) or 0.0)
+            deltas[c] = max(v - last.get(c, 0.0), 0.0)
+            last[c] = max(v, last.get(c, 0.0))
+        last["_wall"] = wall
+        self._goodput_last[replica_id] = last
+        for c, d in deltas.items():
+            self._fleet_seconds[c] += d
+            if d > 0 and c in LOST_CAUSES:
+                self.replay_seconds_total.inc(d, cause=c)
+        booked = sum(self._fleet_seconds.values())
+        if booked > 0:
+            self.goodput_fraction.set(
+                self._fleet_seconds["productive"] / booked)
+        # goodput pulse: this interval's productive seconds must cover
+        # its hard overhead (replay/restore/compile/stall; save and
+        # idle are normal operation and have their own signals)
+        hard = (deltas["replay"] + deltas["checkpoint_restore"]
+                + deltas["compile"] + deltas["stall"])
+        if deltas["productive"] > 0 or hard > 0:
+            self.slo.record("train_goodput",
+                            deltas["productive"] >= hard)
 
     def sweep(self) -> None:
         with self._lock:
@@ -160,6 +312,14 @@ class ElasticCoordinator:
             self._generation += 1
             if lost:
                 self.restarts_total.inc()
+                # open the restart-burn window: heartbeats record bad
+                # until it closes, so slo_burn_rate{slo=
+                # train_restart_burn} spikes for the hold duration
+                self._burn_until = self._clock() + self.restart_burn_hold_s
+                self.slo.record("train_restart_burn", False)
+                for rid in lost:
+                    self.worker_step_seconds.set(
+                        0.0, worker=self._worker_guard.admit(rid))
                 log.warning(
                     "trainer world change: lost %s, generation %d -> "
                     "world %s (survivors restart from last committed "
@@ -171,6 +331,20 @@ class ElasticCoordinator:
         for state, n in self._registry.counts().items():
             self.replicas_gauge.set(float(n), state=state)
         self.generation_gauge.set(float(self._generation))
+        # straggler ratio over the LIVE members that have stepped:
+        # slowest / median latest step time (1.0 = uniform; a worker
+        # with no steps yet simply isn't in the sample)
+        vals = []
+        for rid in self._members:
+            ss = self._stats.get(rid, {}).get("step_seconds")
+            if ss is not None and float(ss) > 0:
+                vals.append(float(ss))
+        if vals:
+            med = obs.sample_quantile(vals, 0.5)
+            self.straggler_ratio.set(
+                max(vals) / med if med and med > 0 else 0.0)
+        else:
+            self.straggler_ratio.set(0.0)
 
     # -- world view ------------------------------------------------------
 
@@ -193,10 +367,25 @@ class ElasticCoordinator:
                 rid: self._stats.get(rid, {}).get("phase")
                 for rid in self._members
             },
+            "step_seconds": {
+                rid: self._stats.get(rid, {}).get("step_seconds")
+                for rid in self._members
+            },
+            # fleet cause totals accumulate across worker incarnations
+            # AND deaths — the goodput summary survives the workers
+            # (the chaos harness reads it after an arm's fleet exits)
+            "goodput": {
+                "seconds": dict(self._fleet_seconds),
+                "fraction": (
+                    self._fleet_seconds["productive"]
+                    / sum(self._fleet_seconds.values())
+                    if sum(self._fleet_seconds.values()) > 0 else 0.0),
+            },
         }
         if include_stats:
             world["replicas"] = {
-                rid: dict(self._stats.get(rid, {}))
+                rid: {k: v for k, v in self._stats.get(rid, {}).items()
+                      if k not in ("metrics", "trace")}
                 for rid in self._members
             }
         return world
@@ -205,6 +394,34 @@ class ElasticCoordinator:
         with self._lock:
             self._recompute()
             return self._world_locked(include_stats)
+
+    # -- observatory surfaces ---------------------------------------------
+
+    def federated_metrics(self) -> str:
+        """One exposition for the whole fleet: the coordinator's own
+        registry plus every LIVE member's latest heartbeat exposition,
+        merged by obs.federate (counters/gauges sum; histograms merge
+        on the union bucket grid; a member with no exposition yet shows
+        up as `fleet_federation_up{replica} 0`)."""
+        with self._lock:
+            self._recompute()
+            scrapes: dict[str, str | None] = {
+                "coordinator": self.registry.render()}
+            for rid in self._members:
+                scrapes[rid] = self._stats.get(rid, {}).get("metrics")
+        return obs.federate(scrapes)
+
+    def merged_traces(self) -> dict[str, Any]:
+        """Every live worker's Chrome trace as its own process track
+        (obs.merge_chrome_traces names the tracks by replica id)."""
+        with self._lock:
+            self._recompute()
+            segments = []
+            for rid in self._members:
+                payload = self._stats.get(rid, {}).get("trace")
+                if isinstance(payload, dict):
+                    segments.append((rid, payload))
+        return obs.merge_chrome_traces(segments)
 
 
 def create_coordinator_app(coord: ElasticCoordinator):
@@ -219,16 +436,14 @@ def create_coordinator_app(coord: ElasticCoordinator):
         body = await request.json()
         world = coord.register(
             str(body["replica_id"]),
-            step=body.get("step"), loss=body.get("loss"),
-            phase=body.get("phase"), generation=body.get("generation"))
+            **{k: body.get(k) for k in HEARTBEAT_KEYS})
         return web.json_response(world)
 
     async def heartbeat(request):
         body = await request.json()
         known = coord.heartbeat(
             str(body["replica_id"]),
-            step=body.get("step"), loss=body.get("loss"),
-            phase=body.get("phase"), generation=body.get("generation"))
+            **{k: body.get(k) for k in HEARTBEAT_KEYS})
         world = coord.world()
         world["known"] = known
         return web.json_response(world)
@@ -236,9 +451,18 @@ def create_coordinator_app(coord: ElasticCoordinator):
     async def world(request):
         return web.json_response(coord.world(include_stats=True))
 
+    async def metrics_federated(request):
+        return web.Response(text=coord.federated_metrics(),
+                            content_type="text/plain")
+
+    async def traces_merged(request):
+        return web.json_response(coord.merged_traces())
+
     app.router.add_post("/elastic/register", register)
     app.router.add_post("/elastic/heartbeat", heartbeat)
     app.router.add_get("/elastic/world", world)
+    app.router.add_get("/elastic/metrics", metrics_federated)
+    app.router.add_get("/elastic/traces", traces_merged)
     obs_endpoints.mount_observability(
         app, registry=coord.registry, tracer=obs.DEFAULT_TRACER)
     return app
@@ -326,7 +550,7 @@ def _deterministic_batch(cfg_vocab: int, batch: int, seq: int, seed: int,
     return toks, tgts
 
 
-def _build_trainer(world_size: int, cfg):
+def _build_trainer(world_size: int, cfg, *, registry=None, tracer=None):
     import jax
 
     from kubeflow_tpu.models import llama
@@ -348,6 +572,8 @@ def _build_trainer(world_size: int, cfg):
         init_fn=lambda k: llama.init(k, cfg),
         logical_axes=llama.param_logical_axes(cfg),
         train_config=TrainConfig(warmup_steps=2, total_steps=1000),
+        registry=registry,
+        tracer=tracer,
     )
 
 
@@ -367,6 +593,13 @@ class _Heartbeater(threading.Thread):
         self.status: dict[str, Any] = {"phase": PHASE_RESTORING}
         self.world = world
         self._stop = threading.Event()
+        # optional per-beat payload producer: run_worker wires the
+        # goodput ledger / registry exposition / trace through this so
+        # telemetry stays FRESH while the training thread is blocked
+        # for tens of seconds inside a compile or restore (a stale
+        # snapshot there would hide exactly the burn the observatory
+        # exists to show)
+        self.enrich: Callable[[], dict[str, Any]] | None = None
 
     def update(self, **status) -> None:
         self.status = {**self.status, **status}
@@ -377,6 +610,11 @@ class _Heartbeater(threading.Thread):
     def run(self) -> None:
         while not self._stop.is_set():
             snap = dict(self.status)
+            if self.enrich is not None:
+                try:
+                    snap.update(self.enrich())
+                except Exception as e:  # noqa: BLE001 — same contract
+                    log.debug("heartbeat enrich failed: %s", e)
             try:
                 w = self.client.heartbeat(self.replica_id, **snap)
                 if not w.get("known"):
@@ -411,6 +649,15 @@ def run_worker(wc: WorkerConfig) -> dict[str, Any]:
     client = _CoordinatorClient(wc.coordinator_url)
     loss_f = open(wc.loss_log, "a", buffering=1) if wc.loss_log else None
 
+    # Worker-local observatory (ISSUE 14): a private registry + tracer
+    # (shipped to the coordinator on every heartbeat and federated at
+    # /elastic/metrics) and the goodput ledger that books every second
+    # of this incarnation's life into an exclusive cause.
+    wreg = Registry()
+    tracer = obs.Tracer()
+    ledger = GoodputLedger()
+    bind_ledger_metrics(wreg, ledger)
+
     def log_loss(step: int, loss: float, generation: int) -> None:
         if loss_f is not None:
             loss_f.write(json.dumps({
@@ -419,6 +666,19 @@ def run_worker(wc: WorkerConfig) -> dict[str, Any]:
 
     world = client.register(wc.replica_id, phase=PHASE_RESTORING)
     hb = _Heartbeater(client, wc.replica_id, wc.heartbeat_s, world)
+
+    def _enrich() -> dict[str, Any]:
+        # evaluated by the heartbeat THREAD each beat, so the numbers
+        # keep moving while the training thread is pinned inside a
+        # compile/restore — exactly when the coordinator's burn rates
+        # need to see the overhead accumulating
+        payload = tracer.chrome_trace()
+        payload["traceEvents"] = (list(payload["traceEvents"])
+                                  + ledger.counter_events(prefix="train"))
+        return {"goodput": ledger.snapshot(), "metrics": wreg.render(),
+                "trace": payload}
+
+    hb.enrich = _enrich
     hb.start()
     deadline = time.monotonic() + wc.join_timeout_s
     while not hb.world.get("ready"):
@@ -433,6 +693,7 @@ def run_worker(wc: WorkerConfig) -> dict[str, Any]:
     generation = world["generation"]
     restores = 0
     corrupt_restores = 0
+    saves = 0
     trainer = ckpt = state = None
     last_loss = float("nan")
     last_saved = -1
@@ -442,15 +703,22 @@ def run_worker(wc: WorkerConfig) -> dict[str, Any]:
         last_saved = -1
         if ckpt is not None:
             ckpt.close()
-        trainer = _build_trainer(world_size, cfg)
+        with ledger.book("compile"):
+            trainer = _build_trainer(world_size, cfg,
+                                     registry=wreg, tracer=tracer)
         ckpt = Checkpointer(
             CheckpointConfig(
                 wc.ckpt_dir, save_interval_steps=wc.save_every,
                 enable_async=True, install_crash_handlers=True),
             trainer,
             run_metadata={"replica": wc.replica_id},
+            registry=wreg,
         )
-        state = ckpt.restore_or_init(jax.random.key(wc.seed))
+        with ledger.book("checkpoint_restore"):
+            state = ckpt.restore_or_init(jax.random.key(wc.seed))
+        # any step at or below the pre-crash high-water mark is now a
+        # re-run: the ledger books it to `replay`, not `productive`
+        ledger.note_restore(int(jax.device_get(state.step)))
         restores += 1
 
     try:
@@ -481,7 +749,8 @@ def run_worker(wc: WorkerConfig) -> dict[str, Any]:
         world = hb.world
         if world["generation"] == generation and \
                 others_behind(world, step):
-            time.sleep(wc.heartbeat_s)
+            with ledger.book("stall"):
+                time.sleep(wc.heartbeat_s)
             continue
         # `ready` gated only initial formation: a world that shrank
         # BELOW min_replicas still continues (that is the point of
@@ -503,23 +772,47 @@ def run_worker(wc: WorkerConfig) -> dict[str, Any]:
             continue
         toks, tgts = _deterministic_batch(
             cfg.vocab_size, wc.batch, wc.seq, wc.seed, step)
+        # the first call on a fresh Trainer blocks through
+        # trace+compile — its wall is booked to `compile`, not to the
+        # productive/replay causes (it is overwhelmingly XLA's time)
+        compiling = not trainer._stepped
+        t_step = time.perf_counter()
         state, loss = trainer.step(
             state, jnp.asarray(toks, jnp.int32),
             jnp.asarray(tgts, jnp.int32))
+        # device_get blocks until the step's math is done, so dt is
+        # the real step wall, not just the async dispatch
         last_loss = float(jax.device_get(loss))
-        step = int(jax.device_get(state.step))
+        new_step = int(jax.device_get(state.step))
+        dt_step = time.perf_counter() - t_step
+        ledger.note_step(step, dt_step, tokens=wc.batch * wc.seq,
+                         flops=trainer.step_flops(wc.batch, wc.seq),
+                         compiling=compiling)
+        step = new_step
         log_loss(step, last_loss, generation)
+        if not compiling:
+            # steady-state step wall feeds straggler forensics and the
+            # train_step_time SLO; compile walls would drown them
+            hb.update(step=step, loss=last_loss, step_seconds=dt_step,
+                      generation=generation)
         chief = world.get("chief") == wc.replica_id
         if chief and step % wc.save_every == 0 and step != last_saved:
             hb.update(step=step, loss=last_loss, phase=PHASE_SAVING,
                       generation=generation)
-            ckpt.save(state, force=True)
-            last_saved = step
-            if wc.slow_save_s > 0:
-                # Chaos window: the save is dispatched but its
-                # COMMITTED marker cannot appear until the next
-                # save/wait — a SIGKILL in here is a mid-save crash.
-                time.sleep(wc.slow_save_s)
+            with ledger.book("checkpoint_save"):
+                t_save = time.perf_counter()
+                ckpt.save(state, force=True)
+                dt_save = time.perf_counter() - t_save
+                last_saved = step
+                saves += 1
+                hb.update(saves=saves, save_seconds=dt_save)
+                if wc.slow_save_s > 0:
+                    # Chaos window: the save is dispatched but its
+                    # COMMITTED marker cannot appear until the next
+                    # save/wait — a SIGKILL in here is a mid-save
+                    # crash. The sleep books to checkpoint_save: it
+                    # widens exactly the window a slow real save would.
+                    time.sleep(wc.slow_save_s)
             hb.update(phase=PHASE_STEP)
 
     final_step = int(jax.device_get(state.step))
@@ -527,8 +820,10 @@ def run_worker(wc: WorkerConfig) -> dict[str, Any]:
               generation=generation)
     world = hb.world
     if world.get("chief") == wc.replica_id and final_step != last_saved:
-        ckpt.save(state, force=True)
-    ckpt.close()  # drains async saves + writes COMMITTED markers
+        with ledger.book("checkpoint_save"):
+            ckpt.save(state, force=True)
+    with ledger.book("checkpoint_save"):
+        ckpt.close()  # drains async saves + writes COMMITTED markers
     # Drain barrier: keep heartbeating until every live member reports
     # done — vanishing the moment WE finish would read as a death to a
     # straggler (soft lockstep keeps the skew to a couple of steps, so
@@ -550,6 +845,10 @@ def run_worker(wc: WorkerConfig) -> dict[str, Any]:
         "restores": restores,
         "corrupt_restores": corrupt_restores,
         "world_size": world["world_size"],
+        # per-incarnation goodput book: the chaos harness reads these
+        # RESULT lines for its per-arm summary table (the processes are
+        # gone by the time the table prints)
+        "goodput": ledger.snapshot(),
     }
     if loss_f is not None:
         loss_f.close()

@@ -31,6 +31,16 @@ from kubeflow_tpu.parallel.sharding import ShardingRules
 Params = Any
 
 
+def estimate_step_flops(n_params: int, tokens: int) -> float:
+    """Model FLOPs for one train step: the standard 6·N·T estimate
+    (2·N·T forward + 4·N·T backward) over all processed tokens. This is
+    MODEL flops — the numerator of MFU — not hardware flops: attention
+    quadratic terms and rematerialization are deliberately excluded, so
+    MFU stays comparable across implementations (the scaling-book
+    convention the paper's goodput accounting uses)."""
+    return 6.0 * float(n_params) * float(tokens)
+
+
 def _masked_mean(
     nll: jnp.ndarray,                 # [b, s] per-position losses
     mask: jnp.ndarray | None,         # [b, s] float/bool, 0 = ignore
@@ -417,6 +427,28 @@ class Trainer:
         the executable."""
         with mesh_lib.set_mesh(self.mesh):
             return self._jit_build_state(params)
+
+    @property
+    def param_count(self) -> int:
+        """Total trainable parameter count, from the abstract state
+        tree (no device math) — the N in the 6·N·T step-FLOPs
+        estimate."""
+        total = 0
+        for leaf in jax.tree.leaves(self.state_shapes.params):
+            shape = getattr(leaf, "shape", None)
+            if shape is None:
+                continue
+            n = 1
+            for d in shape:
+                n *= d
+            total += n
+        return total
+
+    def step_flops(self, batch: int, seq: int) -> float:
+        """Model FLOPs one `step()` call spends on a [batch, seq]
+        token block (6·N·T) — what the elastic worker feeds the
+        GoodputLedger for MFU/tokens-per-second accounting."""
+        return estimate_step_flops(self.param_count, batch * seq)
 
     def opt_state_bytes(self, *, per_replica: bool = True) -> int:
         """Optimizer-state footprint in bytes: global, or what a single

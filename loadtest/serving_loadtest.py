@@ -264,7 +264,9 @@ from kubeflow_tpu.train.elastic import (
 )
 coord = ElasticCoordinator(min_replicas={min_replicas},
                            degraded_after_s={degraded_s},
-                           dead_after_s={dead_s})
+                           dead_after_s={dead_s},
+                           slo_short_window_s={slo_short_s},
+                           restart_burn_hold_s={burn_hold_s})
 web.run_app(create_coordinator_app(coord), host="127.0.0.1",
             port={port}, print=None)
 '''
@@ -352,6 +354,15 @@ def _burn_rate(families: dict, slo: str, window: str) -> float:
     samples = families["slo_burn_rate"]["samples"]
     return samples[("slo_burn_rate",
                     (("slo", slo), ("window", window)))]
+
+
+def _scrape_federated(base: str) -> dict:
+    """GET /elastic/metrics (the coordinator's federated fleet view)
+    and strict-parse it — same contract-check stance as /metrics."""
+    from kubeflow_tpu.obs.exposition import parse_exposition
+    with urllib.request.urlopen(f"{base}/elastic/metrics",
+                                timeout=10) as r:
+        return parse_exposition(r.read().decode())
 
 
 def _hist_quantile_bracket(families: dict, family: str, q: float,
@@ -1165,7 +1176,7 @@ def run_chaos(clients: int, requests: int, max_new: int, *,
 
 def _train_arm(workdir: str, *, replicas: int, steps: int,
                save_every: int, kill: str | None,
-               slow_save_s: float) -> dict:
+               slow_save_s: float, slo_short_s: float = 6.0) -> dict:
     """One elastic-training gang: a coordinator + `replicas` workers on
     a shared checkpoint dir. `kill` selects the fault:
 
@@ -1204,7 +1215,8 @@ def _train_arm(workdir: str, *, replicas: int, steps: int,
             [sys.executable, "-c",
              TRAIN_COORDINATOR_CODE.format(
                  repo=REPO, port=port, min_replicas=replicas,
-                 degraded_s=1.0, dead_s=2.5)],
+                 degraded_s=1.0, dead_s=2.5,
+                 slo_short_s=slo_short_s, burn_hold_s=3.0)],
             stdout=coord_log, stderr=subprocess.STDOUT)
         deadline = time.monotonic() + 60
         while time.monotonic() < deadline:
@@ -1257,6 +1269,34 @@ def _train_arm(workdir: str, *, replicas: int, steps: int,
             raise AssertionError(
                 f"gang never formed at {replicas} replicas: {world()}")
 
+        # Federation check: with the whole gang live, /elastic/metrics
+        # must strict-parse and show fleet_federation_up == 1 for the
+        # coordinator AND every worker (a worker's first enriched
+        # heartbeat can lag registration by an interval, so retry
+        # briefly before calling it a regression). The worker goodput
+        # ledgers must also arrive conserved: the summed per-cause
+        # counters equal the summed wall-clock gauge.
+        deadline = time.monotonic() + 30
+        while True:
+            efams = _scrape_federated(base)
+            up = {lbls[0][1]: v for (_, lbls), v in
+                  efams["fleet_federation_up"]["samples"].items()}
+            down = [r for r in ("coordinator", *rids) if up.get(r) != 1.0]
+            if not down:
+                break
+            if time.monotonic() >= deadline:
+                raise AssertionError(
+                    f"/elastic/metrics never federated {down}: {up}")
+            time.sleep(0.2)
+        booked = sum(
+            efams["train_goodput_seconds_total"]["samples"].values())
+        walls = sum(
+            efams["train_goodput_wall_seconds"]["samples"].values())
+        if abs(booked - walls) > 1e-3 + 1e-4 * max(walls, 1.0):
+            raise AssertionError(
+                f"federated goodput ledger not conserved: booked "
+                f"{booked} != wall {walls}")
+
         killed_at = None
         if kill is not None:
             # Arm the fault one save interval in: the first save is
@@ -1304,19 +1344,48 @@ def _train_arm(workdir: str, *, replicas: int, steps: int,
             killed_at = dict(world().get("steps", {}))
 
         survivors = [r for r in rids if r != victim_rid or kill is None]
+        # While the survivors run down the rebuild -> restore -> replay
+        # path, poll the coordinator's burn gauges: a SIGKILL arm must
+        # drive slo_burn_rate{slo=train_goodput,window=short} over the
+        # 1.0 alert line while the gang is re-spending worker-seconds,
+        # and the lost member must open the restart-burn hold.
+        burn_peak = {"train_goodput": 0.0, "train_restart_burn": 0.0}
         deadline = time.monotonic() + 300
-        for rid in survivors:
-            remaining = max(1.0, deadline - time.monotonic())
+        while time.monotonic() < deadline:
             try:
-                procs[rid].wait(timeout=remaining)
-            except subprocess.TimeoutExpired:
-                raise AssertionError(
-                    f"survivor {rid} hung after the {kill} kill "
-                    f"(world {world()}):\n" + tail(rid))
+                fams = _scrape_metrics(base)
+                for slo in burn_peak:
+                    burn_peak[slo] = max(
+                        burn_peak[slo], _burn_rate(fams, slo, "short"))
+            except Exception:
+                if coord.poll() is not None:
+                    raise RuntimeError(
+                        f"train coordinator died rc={coord.poll()} "
+                        "mid-arm")
+                # transient scrape hiccup: the next poll retries
+            if all(procs[r].poll() is not None for r in survivors):
+                break
+            time.sleep(0.2)
+        else:
+            hung = [r for r in survivors if procs[r].poll() is None]
+            raise AssertionError(
+                f"survivor(s) {hung} hung after the {kill} kill "
+                f"(world {world()}):\n" + tail(hung[0]))
+        for rid in survivors:
             if procs[rid].returncode != 0:
                 raise AssertionError(
                     f"survivor {rid} exited rc={procs[rid].returncode} "
                     f"after the {kill} kill:\n" + tail(rid))
+
+        # Recovery: once the fleet is done no new bad events arrive, so
+        # after one short window the burn gauge must drop back under
+        # the alert line (this is exactly when the page would clear).
+        burn_final = {}
+        if kill is not None:
+            time.sleep(slo_short_s + 1.0)
+            fams = _scrape_metrics(base)
+            burn_final = {
+                slo: _burn_rate(fams, slo, "short") for slo in burn_peak}
 
         results = {}
         for rid in survivors:
@@ -1345,6 +1414,7 @@ def _train_arm(workdir: str, *, replicas: int, steps: int,
         fams = _scrape_metrics(base)
         restarts = sum(
             fams["train_restarts_total"]["samples"].values())
+        fleet_goodput = world().get("goodput") or {}
         committed = sorted(
             int(d) for d in os.listdir(ckpt_dir)
             if d.isdigit() and os.path.exists(
@@ -1361,6 +1431,9 @@ def _train_arm(workdir: str, *, replicas: int, steps: int,
             "victim": victim_rid if kill else None,
             "committed_steps": committed,
             "uncommitted_steps": uncommitted,
+            "fleet_goodput": fleet_goodput,
+            "burn_peak": burn_peak,
+            "burn_final": burn_final,
         }
     finally:
         for p in procs.values():
@@ -1385,7 +1458,8 @@ def _train_arm(workdir: str, *, replicas: int, steps: int,
 
 def run_train_chaos(*, replicas: int = 2, steps: int = 8,
                     save_every: int = 2,
-                    slow_save_s: float = 1.5) -> dict:
+                    slow_save_s: float = 1.5,
+                    slo_short_s: float = 6.0) -> dict:
     """The elastic-training fault-injection run. Three gangs on fresh
     checkpoint dirs: a fault-free single-replica oracle for the loss
     curve, then a mid-step SIGKILL of a non-chief worker, then a
@@ -1405,13 +1479,16 @@ def run_train_chaos(*, replicas: int = 2, steps: int = 8,
     try:
         oracle = _train_arm(
             os.path.join(root, "oracle"), replicas=1, steps=steps,
-            save_every=save_every, kill=None, slow_save_s=0.0)
+            save_every=save_every, kill=None, slow_save_s=0.0,
+            slo_short_s=slo_short_s)
+        arms = {"oracle": oracle}
         scenarios = {}
         for kill in ("mid-step", "mid-save"):
             arm = _train_arm(
                 os.path.join(root, kill), replicas=replicas,
                 steps=steps, save_every=save_every, kill=kill,
-                slow_save_s=slow_save_s)
+                slow_save_s=slow_save_s, slo_short_s=slo_short_s)
+            arms[kill] = arm
             for rid, res in arm["results"].items():
                 if res["final_step"] != steps:
                     raise AssertionError(
@@ -1443,6 +1520,41 @@ def run_train_chaos(*, replicas: int = 2, steps: int = 8,
                 raise AssertionError(
                     f"{kill}: loss curve diverged from the fault-free "
                     f"oracle by {div} (> 5e-4)")
+            # Goodput forensics. Only the mid-save arm is GUARANTEED
+            # replay seconds: its survivor is the non-chief, rewound to
+            # the last COMMITTED step well below its own high-water
+            # mark. The mid-step arm's survivor IS the chief, which
+            # restores at its own latest save — at most one step back,
+            # and that step re-compiles on the rebuilt trainer, so its
+            # wall books to `compile`, not `replay`.
+            gp = arm["fleet_goodput"].get("seconds", {})
+            if kill == "mid-save" and not gp.get("replay", 0.0) > 0.0:
+                raise AssertionError(
+                    f"{kill}: restart re-ran steps but the fleet ledger "
+                    f"booked no replay seconds: {gp}")
+            # BOUNDED replay in every arm: less than the productive
+            # time, or the checkpoint cadence is broken and restarts
+            # cost more than the run itself.
+            if gp.get("replay", 0.0) >= gp.get("productive", 0.0):
+                raise AssertionError(
+                    f"{kill}: replay burn unbounded — "
+                    f"{gp['replay']:.2f}s replay >= "
+                    f"{gp.get('productive', 0.0):.2f}s productive")
+            # Burn-rate plane: the short-window train_goodput gauge
+            # must cross the 1.0 alert line while the gang replays, the
+            # restart hold must page, and both must clear one short
+            # window after the fleet resumes and finishes.
+            for slo in ("train_goodput", "train_restart_burn"):
+                if arm["burn_peak"][slo] <= 1.0:
+                    raise AssertionError(
+                        f"{kill}: slo_burn_rate{{slo={slo}}} never "
+                        f"crossed the alert line "
+                        f"(peak {arm['burn_peak'][slo]:.2f})")
+                if arm["burn_final"][slo] >= 1.0:
+                    raise AssertionError(
+                        f"{kill}: slo_burn_rate{{slo={slo}}} did not "
+                        f"recover after resume "
+                        f"(still {arm['burn_final'][slo]:.2f})")
             scenarios[kill.replace("-", "_")] = {
                 "victim": arm["victim"],
                 "killed_at_steps": arm["killed_at"],
@@ -1453,7 +1565,30 @@ def run_train_chaos(*, replicas: int = 2, steps: int = 8,
                 "committed_steps": arm["committed_steps"],
                 "uncommitted_steps": arm["uncommitted_steps"],
                 "max_loss_divergence": div,
+                "goodput": arm["fleet_goodput"],
+                "burn_peak_short": arm["burn_peak"],
+                "burn_final_short": arm["burn_final"],
             }
+        # Goodput summary: where did every fleet worker-second go, per
+        # arm? (fleet ledger, cumulative across worker incarnations)
+        print("goodput summary (fleet worker-seconds per arm):",
+              file=sys.stderr)
+        hdr = (f"  {'arm':<10} {'prod':>8} {'replay':>8} {'ckpt':>8} "
+               f"{'compile':>8} {'stall':>8} {'idle':>8} {'frac':>6}")
+        print(hdr, file=sys.stderr)
+        for name, arm in arms.items():
+            gp = arm["fleet_goodput"]
+            s = gp.get("seconds", {})
+            print(f"  {name:<10}"
+                  f" {s.get('productive', 0.0):>8.2f}"
+                  f" {s.get('replay', 0.0):>8.2f}"
+                  f" {s.get('checkpoint_save', 0.0) + s.get('checkpoint_restore', 0.0):>8.2f}"
+                  f" {s.get('compile', 0.0):>8.2f}"
+                  f" {s.get('stall', 0.0):>8.2f}"
+                  f" {s.get('idle', 0.0):>8.2f}"
+                  f" {gp.get('fraction', 0.0):>6.3f}",
+                  file=sys.stderr)
+        oracle_gp = oracle["fleet_goodput"]
         wall = time.perf_counter() - t0
         return {
             "metric": "train_chaos",
@@ -1463,6 +1598,8 @@ def run_train_chaos(*, replicas: int = 2, steps: int = 8,
             "save_every": save_every,
             "slow_save_s": slow_save_s,
             "oracle_final_loss": oracle["losses"][steps],
+            "oracle_goodput_fraction": round(
+                oracle_gp.get("fraction", 0.0), 4),
             "scenarios": scenarios,
             "corrupt_restores": 0,
             "wall_s": round(wall, 2),
@@ -1909,6 +2046,10 @@ def main() -> int:
                         "chief's save path — widens the window where a "
                         "SIGKILL lands between the checkpoint write "
                         "and its COMMITTED marker")
+    p.add_argument("--train-slo-short-s", type=float, default=6.0,
+                   help="train-chaos mode: coordinator short SLO "
+                        "window; the run waits one window after each "
+                        "kill arm to assert the burn gauges clear")
     p.add_argument("--chaos-seed", type=int, default=1,
                    help="chaos mode: fault-plan seed (same seed, same "
                         "fault sequence)")
@@ -2041,7 +2182,8 @@ def main() -> int:
             replicas=args.train_replicas,
             steps=args.train_steps,
             save_every=args.train_save_every,
-            slow_save_s=args.train_slow_save_s)
+            slow_save_s=args.train_slow_save_s,
+            slo_short_s=args.train_slo_short_s)
     elif args.mode == "tenants":
         if args.tenant_bulk_clients < 1:
             p.error("--tenant-bulk-clients must be >= 1")

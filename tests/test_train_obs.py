@@ -1,0 +1,524 @@
+"""Training observatory (ISSUE 14): the goodput ledger and its
+federation.
+
+The load-bearing property is CONSERVATION: every worker-second books
+into exactly one cause (productive / replay / checkpoint / compile /
+stall / idle), booked always equals wall — at every read, including
+mid-frame — and anything double-booked surfaces as `unattributed`
+instead of silently inflating a cause. On top of the ledger: replay
+attribution across a kill/restore, MFU/tokens-per-second from the
+model-FLOPs estimate, the coordinator's straggler forensics and train
+SLO burn windows, the /elastic/metrics federation round-trip, and the
+per-worker trace-merge tracks.
+
+Everything here runs on scripted clocks — no jax compilation, no
+processes, no sleeps.
+"""
+
+import json
+
+import pytest
+
+from kubeflow_tpu import obs
+from kubeflow_tpu.controlplane.metrics import Registry
+from kubeflow_tpu.train.elastic import (
+    ElasticCoordinator,
+    create_coordinator_app,
+)
+from kubeflow_tpu.train.goodput import (
+    GOODPUT_CAUSES,
+    LOST_CAUSES,
+    GoodputLedger,
+    bind_ledger_metrics,
+    checkpoint_histograms,
+    goodput_metrics,
+)
+from kubeflow_tpu.train.trainer import estimate_step_flops
+
+pytest_plugins = ("aiohttp.pytest_plugin",)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_ledger(**kw):
+    clk = FakeClock()
+    return GoodputLedger(clock=clk, wall=clk, **kw), clk
+
+
+# -- ledger conservation ----------------------------------------------------
+
+
+def test_ledger_conserves_on_scripted_trace():
+    led, clk = make_ledger()
+    # compile 3s -> 4 productive steps of 1s -> save 1.5s -> 0.5s idle
+    with led.book("compile"):
+        clk.advance(3.0)
+    for i in range(4):
+        clk.advance(1.0)
+        led.note_step(i, 1.0, tokens=128, flops=1e6)
+    with led.book("checkpoint_save"):
+        clk.advance(1.5)
+    clk.advance(0.5)
+    snap = led.snapshot()
+    assert snap["conserved"]
+    assert snap["wall_seconds"] == pytest.approx(9.0)
+    assert snap["booked_seconds"] == pytest.approx(9.0)
+    s = snap["seconds"]
+    assert s["compile"] == pytest.approx(3.0)
+    assert s["productive"] == pytest.approx(4.0)
+    assert s["checkpoint_save"] == pytest.approx(1.5)
+    assert s["idle"] == pytest.approx(0.5)
+    assert s["replay"] == 0.0
+    assert s[obs.UNATTRIBUTED] == 0.0
+    assert snap["productive_steps"] == 4
+    assert snap["tokens"] == 512
+
+
+def test_ledger_conserves_mid_frame():
+    """Open frames are attributed at read time: a scrape taken WHILE
+    the trainer sits inside a restore still balances — this is exactly
+    when the burn gauges must not show a telemetry hole."""
+    led, clk = make_ledger()
+    clk.advance(1.0)
+    led.note_step(0, 1.0)
+    cm = led.book("checkpoint_restore")
+    cm.__enter__()
+    clk.advance(2.0)
+    snap = led.snapshot()  # frame still open
+    assert snap["conserved"]
+    assert snap["seconds"]["checkpoint_restore"] == pytest.approx(2.0)
+    assert snap["wall_seconds"] == pytest.approx(3.0)
+    cm.__exit__(None, None, None)
+    assert led.snapshot()["seconds"]["checkpoint_restore"] == \
+        pytest.approx(2.0)
+
+
+def test_ledger_nested_frames_are_exclusive():
+    """A child frame's seconds are NOT double-counted in its parent
+    (the chief books checkpoint_save around a save that internally
+    stalls)."""
+    led, clk = make_ledger()
+    with led.book("checkpoint_save"):
+        clk.advance(1.0)
+        with led.book("stall"):
+            clk.advance(2.0)
+        clk.advance(0.5)
+    snap = led.snapshot()
+    assert snap["conserved"]
+    assert snap["seconds"]["checkpoint_save"] == pytest.approx(1.5)
+    assert snap["seconds"]["stall"] == pytest.approx(2.0)
+
+
+def test_ledger_double_booking_surfaces_as_unattributed():
+    """If bookings ever exceed wall (clock skew, a buggy caller), the
+    excess lands in `unattributed` and conserved flips False — never a
+    silently inflated cause."""
+    led, clk = make_ledger()
+    clk.advance(1.0)
+    led.note_step(0, 1.0)
+    led.note_step(1, 1.0)  # second booked without wall advancing
+    snap = led.snapshot()
+    assert not snap["conserved"]
+    assert snap["seconds"][obs.UNATTRIBUTED] == pytest.approx(1.0)
+    # the breach shows as booked > wall, never as a shaved cause
+    assert snap["booked_seconds"] > snap["wall_seconds"]
+    assert snap["seconds"]["productive"] == pytest.approx(2.0)
+
+
+def test_ledger_books_unknown_cause_as_unattributed():
+    """A misspelled cause can't silently mint a new bucket: it books
+    to `unattributed`, which fails conservation visibly."""
+    led, clk = make_ledger()
+    with led.book("coffee"):
+        clk.advance(1.0)
+    snap = led.snapshot()
+    assert snap["seconds"][obs.UNATTRIBUTED] == pytest.approx(1.0)
+    assert not snap["conserved"]
+
+
+# -- replay attribution across kill/restore ---------------------------------
+
+
+def test_replay_attribution_across_restore():
+    """Steps re-run between the last COMMITTED checkpoint and the
+    crash point book as replay, not productive; past the pre-crash
+    high-water mark the run is advancing again."""
+    led, clk = make_ledger()
+    for i in range(6):  # reached step 6, committed at 2
+        clk.advance(1.0)
+        led.note_step(i, 1.0, tokens=10)
+    led.note_restore(2)
+    for i in range(2, 8):
+        clk.advance(1.0)
+        led.note_step(i, 1.0, tokens=10)
+    snap = led.snapshot()
+    assert snap["conserved"]
+    # steps 2..5 after the restore re-ran known work
+    assert snap["seconds"]["replay"] == pytest.approx(4.0)
+    assert snap["replay_steps"] == 4
+    assert snap["seconds"]["productive"] == pytest.approx(8.0)
+    # replayed tokens don't count toward throughput
+    assert snap["tokens"] == 80
+    assert snap["restores"] == 1
+
+
+def test_restore_at_high_water_replays_nothing():
+    led, clk = make_ledger()
+    clk.advance(1.0)
+    led.note_step(0, 1.0)
+    led.note_restore(1)  # restored exactly where we were
+    clk.advance(1.0)
+    led.note_step(1, 1.0)
+    snap = led.snapshot()
+    assert snap["seconds"]["replay"] == 0.0
+    assert snap["replay_steps"] == 0
+
+
+def test_compile_step_books_compile_not_productive():
+    led, clk = make_ledger()
+    clk.advance(30.0)
+    led.note_step(0, 30.0, tokens=10, compiling=True)
+    clk.advance(1.0)
+    led.note_step(1, 1.0, tokens=10)
+    snap = led.snapshot()
+    assert snap["seconds"]["compile"] == pytest.approx(30.0)
+    assert snap["seconds"]["productive"] == pytest.approx(1.0)
+    assert snap["productive_steps"] == 1
+    assert snap["tokens"] == 10
+
+
+# -- MFU / throughput -------------------------------------------------------
+
+
+def test_estimate_step_flops_is_6nt():
+    assert estimate_step_flops(1000, 64) == pytest.approx(6.0 * 1000 * 64)
+
+
+def test_mfu_and_tokens_per_second():
+    led, clk = make_ledger(peak_flops_per_s=1e6)
+    for i in range(4):
+        clk.advance(2.0)
+        led.note_step(i, 2.0, tokens=100, flops=4e5)
+    with led.book("stall"):
+        clk.advance(2.0)  # stall must not dilute MFU
+    snap = led.snapshot()
+    # 1.6e6 flops over 8 productive seconds against a 1e6 flop/s peak
+    assert snap["mfu"] == pytest.approx(0.2)
+    assert snap["tokens_per_second"] == pytest.approx(50.0)
+    assert snap["goodput_fraction"] == pytest.approx(0.8)
+
+
+def test_mfu_zero_without_peak():
+    led, clk = make_ledger()
+    clk.advance(1.0)
+    led.note_step(0, 1.0, flops=1e9)
+    assert led.snapshot()["mfu"] == 0.0
+
+
+# -- exposition binding -----------------------------------------------------
+
+
+def test_bound_metrics_equal_ledger_at_scrape():
+    led, clk = make_ledger()
+    reg = Registry()
+    bind_ledger_metrics(reg, led)
+    fams = obs.parse_exposition(reg.render())
+    booked = sum(fams["train_goodput_seconds_total"]["samples"].values())
+    assert booked == 0.0
+    with led.book("compile"):
+        clk.advance(3.0)
+    clk.advance(1.0)
+    led.note_step(0, 1.0, tokens=50)
+    fams = obs.parse_exposition(reg.render())
+    samples = fams["train_goodput_seconds_total"]["samples"]
+    booked = sum(samples.values())
+    wall = fams["train_goodput_wall_seconds"]["samples"][
+        ("train_goodput_wall_seconds", ())]
+    assert booked == pytest.approx(wall) == pytest.approx(4.0)
+    # full cause catalog present even where zero
+    causes = {dict(k[1])["cause"] for k in samples}
+    assert causes == set(GOODPUT_CAUSES) | {obs.UNATTRIBUTED}
+
+
+def test_checkpoint_histograms_single_registration():
+    """elastic.py and checkpoint.py both want the save/restore
+    histograms on one registry; the catalog helper must hand back the
+    SAME objects instead of raising on the second definition."""
+    reg = Registry()
+    save1, restore1 = checkpoint_histograms(reg)
+    save2, restore2 = checkpoint_histograms(reg)
+    assert save1 is save2 and restore1 is restore2
+    fams = obs.parse_exposition(reg.render())
+    assert fams["train_checkpoint_save_seconds"]["samples"][
+        ("train_checkpoint_save_seconds_count", ())] == 0
+
+
+def test_goodput_metrics_get_or_create():
+    reg = Registry()
+    a = goodput_metrics(reg)
+    b = goodput_metrics(reg)
+    assert all(x is y for x, y in zip(a, b))
+
+
+# -- coordinator forensics (fake clock, no processes) -----------------------
+
+
+def _mk_coord(**kw):
+    clk = FakeClock()
+    kw.setdefault("min_replicas", 2)
+    kw.setdefault("degraded_after_s", 5.0)
+    kw.setdefault("dead_after_s", 10.0)
+    coord = ElasticCoordinator(clock=clk, registry=Registry(), **kw)
+    return coord, clk
+
+
+def test_straggler_ratio_is_slowest_over_median():
+    coord, clk = _mk_coord(min_replicas=3)
+    for rid in ("tr0", "tr1", "tr2"):
+        coord.register(rid, step=0)
+    for step in (1, 2):
+        clk.advance(0.5)
+        coord.heartbeat("tr0", step=step, step_seconds=0.1)
+        coord.heartbeat("tr1", step=step, step_seconds=0.2)
+        coord.heartbeat("tr2", step=step, step_seconds=0.6)
+    fams = obs.parse_exposition(coord.registry.render())
+    ratio = fams["train_straggler_ratio"]["samples"][
+        ("train_straggler_ratio", ())]
+    assert ratio == pytest.approx(3.0)  # 0.6 / median 0.2
+    per = fams["train_worker_step_seconds"]["samples"]
+    assert per[("train_worker_step_seconds",
+                (("worker", "tr2"),))] == pytest.approx(0.6)
+
+
+def test_lost_worker_zeroes_its_step_gauge():
+    coord, clk = _mk_coord()
+    coord.register("tr0", step=0)
+    coord.register("tr1", step=0)
+    coord.heartbeat("tr0", step=1, step_seconds=0.1)
+    coord.heartbeat("tr1", step=1, step_seconds=0.1)
+    clk.advance(11.0)
+    coord.heartbeat("tr0", step=2, step_seconds=0.1)
+    coord.world()
+    fams = obs.parse_exposition(coord.registry.render())
+    per = fams["train_worker_step_seconds"]["samples"]
+    assert per[("train_worker_step_seconds",
+                (("worker", "tr1"),))] == 0.0
+
+
+def test_goodput_ingestion_survives_worker_restart():
+    """Fleet cause totals are cumulative across worker incarnations: a
+    restarted worker's ledger resets to zero, which must NOT rewind or
+    double-count the fleet counters."""
+    coord, clk = _mk_coord(min_replicas=1)
+    led, wclk = make_ledger()
+    coord.register("tr0", step=0)
+    wclk.advance(2.0)
+    led.note_step(0, 2.0)
+    clk.advance(0.5)
+    coord.heartbeat("tr0", step=1, goodput=led.snapshot())
+    # incarnation 2: fresh ledger (wall rewinds to 0)
+    led2, wclk2 = make_ledger()
+    led2.note_restore(0)
+    wclk2.advance(1.0)
+    clk.advance(0.5)
+    coord.heartbeat("tr0", step=1, goodput=led2.snapshot())
+    w = coord.world()
+    fleet = w["goodput"]["seconds"]
+    assert fleet["productive"] == pytest.approx(2.0)
+    # the second incarnation's idle second arrived once, not rewound
+    assert fleet["idle"] == pytest.approx(1.0)
+    fams = obs.parse_exposition(coord.registry.render())
+    replay = fams["train_replay_seconds_total"]["samples"]
+    assert sum(replay.values()) == pytest.approx(1.0)
+    causes = {dict(k[1])["cause"] for k in replay}
+    assert causes == set(LOST_CAUSES)
+
+
+# -- train SLO burn windows -------------------------------------------------
+
+
+def test_goodput_burn_spikes_on_replay_and_ages_out():
+    """Heartbeats whose ledger deltas are replay/compile-dominated burn
+    the train_goodput budget; once the fleet is productive again the
+    short window ages the bad pulses out and the gauge clears."""
+    coord, clk = _mk_coord(min_replicas=1, slo_short_window_s=10.0,
+                           slo_long_window_s=600.0)
+    led, wclk = make_ledger()
+    coord.register("tr0", step=0)
+
+    def beat(step):
+        coord.heartbeat("tr0", step=step, goodput=led.snapshot())
+
+    def burn(window="short"):
+        rates = coord.slo.burn_rates()
+        return rates[("train_goodput", window)]
+
+    # productive regime
+    for i in range(3):
+        wclk.advance(1.0)
+        led.note_step(i, 1.0)
+        clk.advance(1.0)
+        beat(i + 1)
+    assert burn() == 0.0
+    # outage: restore + replay dominate each interval
+    led.note_restore(0)
+    for i in range(3):
+        wclk.advance(1.0)
+        led.note_step(i, 1.0)  # all replay (high water was 3)
+        clk.advance(1.0)
+        beat(3)
+    assert burn() > 1.0
+    # recovery: productive pulses return, then the window slides past
+    for i in range(3, 6):
+        wclk.advance(1.0)
+        led.note_step(i, 1.0)
+        clk.advance(1.0)
+        beat(i + 1)
+    clk.advance(8.0)
+    for i in range(6, 8):
+        wclk.advance(1.0)
+        led.note_step(i, 1.0)
+        clk.advance(1.0)
+        beat(i + 1)
+    assert burn() < 1.0
+
+
+def test_restart_burn_holds_after_lost_member():
+    coord, clk = _mk_coord(restart_burn_hold_s=5.0,
+                           slo_short_window_s=10.0,
+                           slo_long_window_s=600.0)
+    coord.register("tr0", step=0)
+    coord.register("tr1", step=0)
+    clk.advance(11.0)  # tr1 dead
+    coord.heartbeat("tr0", step=1)
+    coord.world()  # recompute: loss detected, hold window opens
+    assert coord.slo.burn_rates()[("train_restart_burn", "short")] > 1.0
+    # inside the hold window every beat still burns
+    clk.advance(1.0)
+    coord.heartbeat("tr0", step=2)
+    assert coord.slo.burn_rates()[("train_restart_burn", "short")] > 1.0
+    # past the hold AND the short window, beats record good again and
+    # the outage pulses age out
+    clk.advance(11.0)
+    for step in range(3, 10):
+        coord.heartbeat("tr0", step=step)
+    rates = coord.slo.burn_rates()
+    assert rates[("train_restart_burn", "short")] == 0.0
+    # the long window still remembers the outage
+    assert rates[("train_restart_burn", "long")] > 0.0
+
+
+def test_step_time_slo_only_sees_advancing_steps():
+    """Heartbeats repeat the latest step_seconds between steps; only a
+    step ADVANCE feeds the SLO, so a slow-but-alive worker can't drown
+    the burn window in duplicate events."""
+    coord, clk = _mk_coord(min_replicas=1, slo_step_time_s=1.0)
+    coord.register("tr0", step=0)
+    coord.heartbeat("tr0", step=1, step_seconds=2.0)  # bad: over 1s
+    for _ in range(20):  # same step re-reported
+        coord.heartbeat("tr0", step=1, step_seconds=2.0)
+    dq = coord.slo._events["train_step_time"]
+    assert len(dq) == 1
+
+
+# -- federation round-trip --------------------------------------------------
+
+
+async def test_elastic_metrics_federates_and_conserves(aiohttp_client):
+    coord, clk = _mk_coord()
+    client = await aiohttp_client(create_coordinator_app(coord))
+
+    workers = {}
+    for rid in ("tr0", "tr1"):
+        led, wclk = make_ledger()
+        wreg = Registry()
+        bind_ledger_metrics(wreg, led)
+        workers[rid] = (led, wclk, wreg)
+        resp = await client.post("/elastic/register", json={
+            "replica_id": rid, "step": 0})
+        assert resp.status == 200
+
+    for i in range(3):
+        for rid, (led, wclk, wreg) in workers.items():
+            wclk.advance(0.5)
+            led.note_step(i, 0.5, tokens=32)
+            clk.advance(0.25)
+            resp = await client.post("/elastic/heartbeat", json={
+                "replica_id": rid, "step": i + 1, "step_seconds": 0.5,
+                "goodput": led.snapshot(), "metrics": wreg.render(),
+                "trace": {"displayTimeUnit": "ms", "traceEvents": [
+                    {"name": "train.step", "ph": "X", "ts": 0,
+                     "dur": 500, "pid": 1, "tid": 1}]}})
+            assert resp.status == 200
+
+    resp = await client.get("/elastic/metrics")
+    assert resp.status == 200
+    fams = obs.parse_exposition(await resp.text())  # strict parse
+    booked = sum(fams["train_goodput_seconds_total"]["samples"].values())
+    wall = sum(fams["train_goodput_wall_seconds"]["samples"].values())
+    assert booked == pytest.approx(wall) == pytest.approx(3.0)
+    up = {dict(k[1])["replica"]: v
+          for k, v in fams["fleet_federation_up"]["samples"].items()}
+    assert up == {"coordinator": 1.0, "tr0": 1.0, "tr1": 1.0}
+    # summable worker gauges federate by summing
+    tps = sum(fams["train_tokens_per_second"]["samples"].values())
+    assert tps == pytest.approx(128.0)  # 2 workers x 32 tokens / 0.5 s
+
+
+async def test_merged_traces_name_per_worker_tracks(aiohttp_client):
+    coord, clk = _mk_coord()
+    client = await aiohttp_client(create_coordinator_app(coord))
+    for rid in ("tr0", "tr1"):
+        await client.post("/elastic/register", json={
+            "replica_id": rid, "step": 0,
+            "trace": {"displayTimeUnit": "ms", "traceEvents": [
+                {"name": f"step-{rid}", "ph": "X", "ts": 0, "dur": 10,
+                 "pid": 1, "tid": 1}]}})
+    resp = await client.get("/elastic/traces")
+    assert resp.status == 200
+    payload = json.loads(await resp.text())
+    tracks = {e["args"]["name"] for e in payload["traceEvents"]
+              if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert tracks == {"tr0", "tr1"}
+    # each worker's events moved onto its own pid
+    pids = {e["pid"] for e in payload["traceEvents"]
+            if e.get("ph") == "X"}
+    assert len(pids) == 2
+
+
+async def test_federation_marks_traceless_worker_up(aiohttp_client):
+    """A worker that never attached metrics federates as up=0 — absence
+    is visible, not silently merged as zeros."""
+    coord, clk = _mk_coord(min_replicas=1)
+    client = await aiohttp_client(create_coordinator_app(coord))
+    await client.post("/elastic/register",
+                      json={"replica_id": "tr0", "step": 0})
+    resp = await client.get("/elastic/metrics")
+    fams = obs.parse_exposition(await resp.text())
+    up = {dict(k[1])["replica"]: v
+          for k, v in fams["fleet_federation_up"]["samples"].items()}
+    assert up["tr0"] == 0.0
+
+
+# -- ledger counter events ride the trace -----------------------------------
+
+
+def test_counter_events_track_cause_seconds():
+    led, clk = make_ledger()
+    clk.advance(1.0)
+    led.note_step(0, 1.0)
+    events = led.counter_events(prefix="train")
+    assert events, "no counter events emitted"
+    ev = events[-1]
+    assert ev["ph"] == "C"
+    assert ev["name"] == "train.goodput_seconds"
+    assert ev["args"]["productive"] == pytest.approx(1.0)
