@@ -1,7 +1,7 @@
 # Developer entry points (the reference drives everything through
 # per-component Makefiles; here one root Makefile covers the repo).
 
-.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check profile-check serving-check fleet-check kernels-check tenancy-check chaos-check train-check train-obs-check disagg-check cache-check
+.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check profile-check serving-check fleet-check kernels-check tenancy-check chaos-check train-check train-obs-check disagg-check cache-check control-check
 
 verify-all:  ## the full evidence sweep, one command
 	python -m pytest tests -q -m "slow or not slow"
@@ -83,6 +83,11 @@ cache-check: ## KV-cache observatory gate: ledger/heat/counterfactual suite + ca
 	JAX_PLATFORMS=cpu python -m pytest tests/test_cachestats.py -q \
 	  -m "slow or not slow"
 	JAX_PLATFORMS=cpu python -m ci.obs_check cache
+
+control-check: ## closed-loop control gate: hysteresis/ledger/actuator suite + decision-plane metrics contract
+	JAX_PLATFORMS=cpu python -m pytest tests/test_control.py -q \
+	  -m "slow or not slow"
+	JAX_PLATFORMS=cpu python -m ci.obs_check control
 
 tenancy-check: ## multi-tenant QoS gate: unit suite + noisy-neighbor A/B loadtest
 	JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q \

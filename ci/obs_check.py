@@ -1037,6 +1037,169 @@ async def run_disagg_check() -> list[str]:
     return failures
 
 
+async def run_control_check() -> list[str]:
+    """Eighth act (ISSUE 16): the decision-plane contract. Boot the
+    fleet router with two declarative policies and the controller
+    built but NOT ticking (interval 0 — the act drives evaluations by
+    hand, no jax, no sleeps), then hold the closed loop to its
+    observability promises: the policy x outcome and policy x action
+    grids zero-seeded on the first scrape, the ledger at
+    /fleet/decisions conserved across a healthy tick + a breach tick,
+    the fired action auditable (evidence -> action -> pending
+    verdict), its floor visible at /fleet/autoscale, and the
+    control.action span in /debug/traces."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu.fleet import control
+    from kubeflow_tpu.fleet import router as router_mod
+    from kubeflow_tpu.obs.decisions import OUTCOMES
+
+    failures: list[str] = []
+    policies = [
+        control.Policy(
+            name="availability_burn_scale_out",
+            signal=control.Signal(
+                "slo_burn_rate",
+                {"slo": "fleet_availability", "window": "short"},
+                source="local"),
+            threshold=1.0, clear=0.5, cooldown_s=60.0,
+            verify_window_s=60.0, action="scale_out"),
+        control.Policy(
+            name="spec_acceptance_burn_draft_off",
+            signal=control.Signal(
+                "slo_burn_rate",
+                {"slo": "serving_spec_acceptance", "window": "short"},
+                source="federated"),
+            threshold=1.0, clear=0.5, cooldown_s=60.0,
+            verify_window_s=60.0, action="disable_draft"),
+    ]
+    app = router_mod.create_router_app(policies=policies,
+                                       control_interval_s=0)
+    client = TestClient(TestServer(app))
+    try:
+        await client.start_server()
+        st = app[router_mod.FLEET_KEY]
+
+        # -- zero-seeded decision plane on the FIRST scrape
+        resp = await client.get("/metrics")
+        try:
+            families = parse_exposition(await resp.text())
+        except ExpositionError as e:
+            return [f"/metrics failed strict parse: {e}"]
+
+        def sample(fams: dict, fam: str, sname: str, **labels):
+            f = fams.get(fam)
+            if f is None:
+                failures.append(f"missing family {fam}")
+                return None
+            key = (sname, tuple(sorted(labels.items())))
+            if key not in f["samples"]:
+                failures.append(f"missing sample {sname}{labels}")
+                return None
+            return f["samples"][key]
+
+        for pol in policies:
+            for oc in OUTCOMES:
+                if sample(families, "fleet_control_decisions_total",
+                          "fleet_control_decisions_total",
+                          policy=pol.name, outcome=oc) not in (0, None):
+                    failures.append(
+                        f"decisions[{pol.name},{oc}] not zero-seeded")
+            for act in control.ACTIONS:
+                if sample(families, "fleet_control_actions_total",
+                          "fleet_control_actions_total",
+                          policy=pol.name, action=act) not in (0, None):
+                    failures.append(
+                        f"actions[{pol.name},{act}] not zero-seeded")
+        if sample(families, "slo_error_budget_remaining",
+                  "slo_error_budget_remaining",
+                  slo="fleet_availability") != 1.0:
+            failures.append(
+                "slo_error_budget_remaining[fleet_availability] "
+                "should start at full budget 1.0")
+
+        # -- a healthy tick, then a breach tick over the live router
+        st.registry.register("http://127.0.0.1:1", replica_id="stub-0")
+        st.obs.slo.record("fleet_availability", True)
+        await st.controller.evaluate_once()
+        for _ in range(4):
+            st.obs.slo.record("fleet_availability", False)
+        await st.controller.evaluate_once()
+
+        resp = await client.get("/fleet/decisions")
+        if resp.status != 200:
+            return failures + [f"/fleet/decisions -> {resp.status}"]
+        dec = await resp.json()
+        if dec.get("conserved") is not True:
+            failures.append(f"ledger not conserved: {dec}")
+        if dec.get("evaluations") != 4:
+            failures.append(
+                f"want 4 evaluations (2 ticks x 2 policies), got "
+                f"{dec.get('evaluations')}")
+        fired = [r for r in dec.get("records", [])
+                 if r.get("outcome") == "fired"]
+        if len(fired) != 1:
+            failures.append(
+                f"want exactly one fired decision, got {len(fired)}")
+        else:
+            rec = fired[0]
+            if rec.get("policy") != "availability_burn_scale_out":
+                failures.append(f"wrong policy fired: {rec}")
+            if rec.get("action") != "scale_out":
+                failures.append(f"fired action not audited: {rec}")
+            if rec.get("verdict") != "pending":
+                failures.append(
+                    f"fired decision should await its verdict: {rec}")
+            ev = rec.get("evidence") or {}
+            if not isinstance(ev.get("signal"), (int, float)) \
+                    or ev["signal"] <= 1.0:
+                failures.append(
+                    f"fired decision lacks breach evidence: {ev}")
+
+        # the ledger's counters moved with it (suppressed-vs-fired
+        # split visible per policy)
+        families = parse_exposition(
+            await (await client.get("/metrics")).text())
+        if sample(families, "fleet_control_decisions_total",
+                  "fleet_control_decisions_total",
+                  policy="availability_burn_scale_out",
+                  outcome="fired") != 1:
+            failures.append("fired not counted in decisions_total")
+        if sample(families, "fleet_control_decisions_total",
+                  "fleet_control_decisions_total",
+                  policy="spec_acceptance_burn_draft_off",
+                  outcome="below_threshold") != 2:
+            failures.append(
+                "unreadable/healthy policy should book below_threshold")
+        if sample(families, "fleet_control_actions_total",
+                  "fleet_control_actions_total",
+                  policy="availability_burn_scale_out",
+                  action="scale_out") != 1:
+            failures.append("fired action not counted in actions_total")
+
+        # -- the actuation is live: the desired floor reached
+        # /fleet/autoscale
+        auto = await (await client.get("/fleet/autoscale")).json()
+        if auto.get("controller_floor") != 2:
+            failures.append(
+                f"scale_out floor not visible at /fleet/autoscale: "
+                f"{auto}")
+
+        # -- the fired action left a control.action span
+        traces = await (await client.get(
+            "/debug/traces?name=control.action&format=summary")).json()
+        spans = [s for t in traces.get("traces", [])
+                 for s in t.get("spans", [])]
+        if not any(s.get("attrs", {}).get("outcome") == "fired"
+                   for s in spans):
+            failures.append(
+                "no control.action span with outcome=fired in "
+                "/debug/traces")
+    finally:
+        await client.close()
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
     """Default: all seven acts. `python -m ci.obs_check profile` runs
     only the serving step-anatomy act (`make profile-check`); it and
@@ -1055,6 +1218,7 @@ def main(argv: list[str] | None = None) -> int:
         "train-obs": run_train_obs_check,
         "disagg": run_disagg_check,
         "cache": run_cache_check,
+        "control": run_control_check,
     }
     wanted = argv or list(acts)
     unknown = [a for a in wanted if a not in acts]
@@ -1078,8 +1242,11 @@ def main(argv: list[str] | None = None) -> int:
           "zero-seeds + tracks a prefill->decode handoff, the "
           "KV-cache ledger conserves (causes sum to frees, zero "
           "unattributed) with a hashed heat digest on the model card, "
-          "and /elastic/metrics federates goodput ledgers conserved "
-          "(cause counters == wall) with per-worker trace tracks")
+          "/elastic/metrics federates goodput ledgers conserved "
+          "(cause counters == wall) with per-worker trace tracks, "
+          "and the decision plane zero-seeds its policy x "
+          "outcome/action grids with the /fleet/decisions ledger "
+          "conserved and the fired action auditable end to end")
     return 0
 
 

@@ -630,6 +630,48 @@ def cache_check_workflow() -> dict:
     }
 
 
+def control_check_workflow() -> dict:
+    """Closed-loop control gate (ISSUE 16): `make control-check` runs
+    the controller suite (hysteresis/cooldown math on a fake clock,
+    decision-ledger conservation, every actuator through a stub
+    router, verdict booking after the recovery window, the
+    /fleet/decisions round-trip) plus the decision-plane metrics
+    contract (policy x outcome and policy x action grids zero-seeded,
+    ledger conserved over a live router, the fired action auditable
+    with its control.action span). The conservation invariant is
+    structural — a controller path that forgets to book its outcome
+    fails here, not during the next incident."""
+    return {
+        "name": "control check",
+        "on": {
+            "pull_request": {"paths": ["kubeflow_tpu/fleet/**",
+                                       "kubeflow_tpu/obs/**",
+                                       "kubeflow_tpu/serving/**",
+                                       "kubeflow_tpu/train/elastic.py",
+                                       "loadtest/serving_loadtest.py",
+                                       "tests/test_control.py",
+                                       "ci/obs_check.py",
+                                       "Makefile"]},
+            "push": {"branches": ["main"]},
+        },
+        "jobs": {
+            "control-check": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e .[ci] pytest"},
+                    {"name": "controller suite + decision-plane "
+                             "metrics contract",
+                     "run": "make control-check",
+                     "env": {"JAX_PLATFORMS": "cpu"}},
+                ],
+            }
+        },
+    }
+
+
 def tenancy_check_workflow() -> dict:
     """Multi-tenant QoS gate: `make tenancy-check` runs the tenancy
     unit suite (fair-share math, preemption token-identity, prefix
@@ -764,6 +806,7 @@ def all_workflows() -> dict[str, dict]:
     out["train_obs_check.yaml"] = train_obs_check_workflow()
     out["disagg_check.yaml"] = disagg_check_workflow()
     out["cache_check.yaml"] = cache_check_workflow()
+    out["control_check.yaml"] = control_check_workflow()
     out["tenancy_check.yaml"] = tenancy_check_workflow()
     out["kernels_check.yaml"] = kernels_check_workflow()
     out["profile_check.yaml"] = profile_check_workflow()

@@ -12,10 +12,17 @@ with least-queue-depth fallback, retry/backoff and hedged requests
 a desired-replica recommendation the ModelServer controller consumes
 (`autoscale.py`).
 
-Import discipline: `registry` and `autoscale` are pure Python (the
-control plane imports `autoscale` and must stay jax-free); `router`
-adds aiohttp + obs, still no jax — the router process boots in
-milliseconds while replicas compile.
+The closed loop (`control.py`, ISSUE 16) rides on top of the router:
+declarative `Policy` rules over the federated metrics view fire the
+existing actuators (autoscale floor bumps, drain/migrate, elastic
+eviction, draft disable), with every evaluation booked into the
+conservation-checked decision ledger served at `/fleet/decisions`.
+
+Import discipline: `registry`, `autoscale` and `control`'s math half
+are pure Python (the control plane imports `autoscale` and must stay
+jax-free; `control` only imports aiohttp lazily inside the router
+actuators); `router` adds aiohttp + obs, still no jax — the router
+process boots in milliseconds while replicas compile.
 """
 
 from kubeflow_tpu.fleet.registry import (
@@ -28,15 +35,27 @@ from kubeflow_tpu.fleet.registry import (
     rendezvous,
 )
 from kubeflow_tpu.fleet.autoscale import Recommendation, recommend_replicas
+from kubeflow_tpu.fleet.control import (
+    ACTIONS,
+    Controller,
+    Policy,
+    Signal,
+    default_policies,
+)
 
 __all__ = [
+    "ACTIONS",
+    "Controller",
     "DEAD",
     "DEGRADED",
     "DRAINING",
+    "Policy",
     "READY",
     "Recommendation",
     "Replica",
     "ReplicaRegistry",
+    "Signal",
+    "default_policies",
     "recommend_replicas",
     "rendezvous",
 ]

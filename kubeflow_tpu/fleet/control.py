@@ -1,0 +1,540 @@
+"""Closed-loop fleet control: SLO-burn-driven actuation.
+
+PRs 6-14 made every layer observable — burn-rate gauges, cache and
+goodput ledgers, straggler forensics — but nothing *acted* on those
+signals. This module closes the loop: a `Controller` evaluates
+declarative `Policy` objects (a signal query over the router's
+federated metrics view, a threshold, a hysteresis band, a cooldown)
+and fires the EXISTING actuators — desired-replica bumps surfaced
+through `/fleet/autoscale`, replica drain/migrate, elastic worker
+eviction via the coordinator's generation bump, draft-model disable on
+speculative-acceptance burn.
+
+Autopilot-lineage systems are only trustworthy when every decision is
+itself a first-class observable, so the controller's one hard rule is:
+every evaluation is booked into exactly one outcome in the
+`obs.decisions.DecisionLedger` (conservation: evaluations == sum of
+outcomes), every fired action carries its evidence snapshot, and after
+the policy's verify window the controller re-reads the signal and
+books a recovered / not_recovered verdict. The book is served at
+`GET /fleet/decisions`, counted in zero-seeded
+`fleet_control_decisions_total{policy,outcome}` /
+`fleet_control_actions_total{policy,action}`, and each fired action is
+a `control.action` span in `/debug/traces`.
+
+The controller is sans-jax and pure-asyncio; the clock, signal reader
+and actuator table are all injectable, so the hysteresis/cooldown math
+is testable on a fake clock with stub actuators.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable
+
+from kubeflow_tpu.fleet.registry import DEGRADED, READY
+from kubeflow_tpu.obs.decisions import DecisionLedger
+from kubeflow_tpu.obs.exposition import ExpositionError, parse_exposition
+from kubeflow_tpu.obs.federation import merge_families
+
+log = logging.getLogger(__name__)
+
+# Closed set of things the controller can do. These become the `action`
+# label on `fleet_control_actions_total`, so the set is CLOSED by
+# design (cardinality bounded by code, not configuration):
+#   scale_out     — raise the desired-replica floor surfaced at
+#                   /fleet/autoscale (the infra layer watching that
+#                   endpoint boots the replica; the controller decides)
+#   drain_replica — drain + migrate the most-loaded replica (sheds a
+#                   hot spot; its sequences move to healthy peers)
+#   evict_worker  — ask the elastic coordinator to evict its straggler
+#                   (generation bump; survivors resume at the new size)
+#   disable_draft — turn speculative decoding off fleet-wide when the
+#                   draft model stops earning its keep
+ACTIONS = ("scale_out", "drain_replica", "evict_worker", "disable_draft")
+
+_SIGNAL_MODES = ("value", "rate")
+_SIGNAL_REDUCES = ("max", "sum", "avg")
+_SIGNAL_SOURCES = ("federated", "local")
+_DIRECTIONS = ("above", "below")
+
+
+@dataclass(frozen=True)
+class Signal:
+    """One metric query: which family, which label subset, and how to
+    collapse the matching series into one number.
+
+    `source` is "local" (the router's own registry: fleet_* families,
+    router-side burn rates) or "federated" (every routable replica's
+    /metrics, merged — serving_* and train_* families live there).
+    `mode` is "value" (gauges) or "rate" (counters: per-second delta
+    against the previous read; first read and counter resets report
+    0.0). `reduce` collapses multiple matching series (max for burn
+    rates — the hottest replica is the breach; sum for event rates)."""
+
+    family: str
+    labels: dict = field(default_factory=dict)
+    mode: str = "value"
+    reduce: str = "max"
+    source: str = "federated"
+
+    def __post_init__(self):
+        if not self.family:
+            raise ValueError("signal needs a metric family name")
+        if self.mode not in _SIGNAL_MODES:
+            raise ValueError(f"unknown signal mode {self.mode!r}")
+        if self.reduce not in _SIGNAL_REDUCES:
+            raise ValueError(f"unknown signal reduce {self.reduce!r}")
+        if self.source not in _SIGNAL_SOURCES:
+            raise ValueError(f"unknown signal source {self.source!r}")
+
+    def describe(self) -> dict:
+        return {"family": self.family, "labels": dict(self.labels),
+                "mode": self.mode, "reduce": self.reduce,
+                "source": self.source}
+
+
+@dataclass
+class Policy:
+    """One declarative control rule.
+
+    Fires `action` when the signal breaches `threshold` (strictly
+    above for direction="above"). `clear` is the hysteresis level the
+    signal must drop back to/below before the policy can fire again
+    (defaults to the threshold — no band); `cooldown_s` is the minimum
+    time between fires regardless of the signal; `verify_window_s` is
+    how long after a fire the controller waits before re-reading the
+    signal and booking the recovered / not_recovered verdict."""
+
+    name: str
+    signal: Signal
+    threshold: float
+    action: str
+    clear: float | None = None
+    cooldown_s: float = 30.0
+    verify_window_s: float = 30.0
+    direction: str = "above"
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("policy needs a name")
+        if self.action not in ACTIONS:
+            raise ValueError(f"policy {self.name!r}: unknown action "
+                             f"{self.action!r} (not in {ACTIONS})")
+        if self.direction not in _DIRECTIONS:
+            raise ValueError(f"policy {self.name!r}: direction must be "
+                             f"one of {_DIRECTIONS}")
+        if self.clear is None:
+            self.clear = self.threshold
+        ok = (self.clear <= self.threshold if self.direction == "above"
+              else self.clear >= self.threshold)
+        if not ok:
+            raise ValueError(
+                f"policy {self.name!r}: clear level must sit on the "
+                "healthy side of the threshold")
+        if self.cooldown_s < 0 or self.verify_window_s <= 0:
+            raise ValueError(
+                f"policy {self.name!r}: cooldown must be >= 0 and "
+                "verify window > 0")
+
+    def breached(self, value: float) -> bool:
+        return (value > self.threshold if self.direction == "above"
+                else value < self.threshold)
+
+    def still_hot(self, value: float) -> bool:
+        """Inside the hysteresis band: back under the threshold but not
+        yet past the clear level — a latched policy stays latched."""
+        return (value > self.clear if self.direction == "above"
+                else value < self.clear)
+
+    def describe(self) -> dict:
+        return {"name": self.name, "signal": self.signal.describe(),
+                "threshold": self.threshold, "clear": self.clear,
+                "direction": self.direction,
+                "cooldown_s": self.cooldown_s,
+                "verify_window_s": self.verify_window_s,
+                "action": self.action}
+
+
+def signal_value(families: dict, sig: Signal) -> float | None:
+    """Extract one number from a `parse_exposition`-shaped dict: every
+    sample of `sig.family` whose labels are a superset of `sig.labels`
+    (extra labels — replica, window — are ignored), collapsed by
+    `sig.reduce`. None when no series matches (an absent family is
+    "can't tell", never 0 — zero-seeding is what makes healthy zeros
+    distinguishable from holes)."""
+    fam = families.get(sig.family)
+    if fam is None:
+        return None
+    want = sig.labels.items()
+    vals = [v for (sname, labels), v in fam["samples"].items()
+            if sname == sig.family
+            and all(dict(labels).get(k) == lv for k, lv in want)]
+    if not vals:
+        return None
+    if sig.reduce == "max":
+        return max(vals)
+    if sig.reduce == "sum":
+        return sum(vals)
+    return sum(vals) / len(vals)
+
+
+class FederatedSignalReader:
+    """Default signal source: the router's own registry ("local") or
+    every routable replica's /metrics merged ("federated") — the same
+    strict parse + merge `/fleet/metrics` serves. Keeps per-policy
+    baselines for rate-mode signals. Any scrape/parse trouble reads as
+    None (signal unavailable), never an exception — the control loop
+    must not die because one replica served garbage."""
+
+    def __init__(self, st, *, clock: Callable[[], float] | None = None):
+        self._st = st
+        self._clock = clock or time.monotonic
+        # policy name -> (t, cumulative value) for rate signals
+        self._last: dict[str, tuple[float, float]] = {}
+
+    async def __call__(self, policy: Policy) -> float | None:
+        sig = policy.signal
+        try:
+            if sig.source == "local":
+                texts = [self._st.obs.registry.render()]
+            else:
+                from kubeflow_tpu.fleet import router as router_mod
+
+                scrapes = await router_mod._scrape_replicas(
+                    self._st, "/metrics", as_json=False)
+                texts = [t for _, t in scrapes if t]
+            parsed = []
+            for t in texts:
+                try:
+                    parsed.append(parse_exposition(t))
+                except ExpositionError:
+                    continue
+            merged = merge_families(parsed)
+        except Exception:  # noqa: BLE001 — unavailable, not fatal
+            return None
+        value = signal_value(merged, sig)
+        if value is None or sig.mode == "value":
+            return value
+        now = self._clock()
+        prev = self._last.get(policy.name)
+        self._last[policy.name] = (now, value)
+        if prev is None:
+            return 0.0
+        dt = now - prev[0]
+        delta = value - prev[1]
+        if dt <= 0 or delta < 0:
+            # counter reset or a replica left the merge: re-baseline
+            return 0.0
+        return delta / dt
+
+
+class _PolicyState:
+    __slots__ = ("latched", "cooldown_until")
+
+    def __init__(self):
+        self.latched = False
+        self.cooldown_until = float("-inf")
+
+
+class Controller:
+    """Evaluates every policy once per tick and books each evaluation
+    into exactly one `DecisionLedger` outcome.
+
+    Per-policy per-tick state machine (the math `tests/test_control.py`
+    pins on a fake clock):
+
+        breached, in cooldown          -> suppressed_cooldown
+        breached, latched              -> suppressed_hysteresis
+        breached, unlatched, cooled    -> fire (latch + start cooldown)
+        actuator raised                -> actuator_failed (NOT latched:
+                                          retried next tick)
+        healthy but still above clear  -> suppressed_hysteresis
+        healthy, below clear           -> below_threshold (unlatch)
+
+    An unreadable signal (no replicas yet, scrape failed) evaluates as
+    healthy-below-clear: the controller never actuates on evidence it
+    does not have. Fired decisions are re-read after
+    `policy.verify_window_s` and their verdict booked.
+    """
+
+    def __init__(self, policies, *,
+                 ledger: DecisionLedger | None = None,
+                 reader: Callable[[Policy],
+                                  Awaitable[float | None]] | None = None,
+                 actuators: dict[str, Callable] | None = None,
+                 interval_s: float = 2.0,
+                 clock: Callable[[], float] | None = None,
+                 tracer=None):
+        policies = list(policies)
+        if len({p.name for p in policies}) != len(policies):
+            raise ValueError("duplicate policy names")
+        self.policies = policies
+        self.ledger = ledger if ledger is not None else DecisionLedger()
+        self.reader = reader
+        self.actuators = dict(actuators or {})
+        self.interval_s = interval_s
+        self.clock = clock or time.monotonic
+        self.tracer = tracer
+        self._state = {p.name: _PolicyState() for p in policies}
+        # fired decisions awaiting their verdict: {id, policy, due}
+        self._pending: list[dict] = []
+
+    # -- one tick ----------------------------------------------------------
+
+    async def evaluate_once(self) -> list[dict]:
+        """One control tick: resolve due verdicts, then evaluate every
+        policy. Returns the tick's ledger records (tests inspect
+        them); the ledger and metrics are the durable book."""
+        now = self.clock()
+        await self.resolve_due(now)
+        records = []
+        for p in self.policies:
+            records.append(await self._evaluate_policy(p, now))
+        return records
+
+    async def _evaluate_policy(self, p: Policy, now: float) -> dict:
+        ps = self._state[p.name]
+        value = await self._read(p)
+        evidence = {"signal": value, "family": p.signal.family,
+                    "threshold": p.threshold, "clear": p.clear}
+        breached = value is not None and p.breached(value)
+        if not breached:
+            if ps.latched and value is not None and p.still_hot(value):
+                return self.ledger.note(p.name, "suppressed_hysteresis",
+                                        evidence=evidence)
+            ps.latched = False
+            return self.ledger.note(p.name, "below_threshold",
+                                    evidence=evidence)
+        if now < ps.cooldown_until:
+            evidence["cooldown_remaining_s"] = round(
+                ps.cooldown_until - now, 3)
+            return self.ledger.note(p.name, "suppressed_cooldown",
+                                    evidence=evidence)
+        if ps.latched:
+            return self.ledger.note(p.name, "suppressed_hysteresis",
+                                    evidence=evidence)
+        return await self._fire(p, ps, now, evidence)
+
+    async def _fire(self, p: Policy, ps: _PolicyState, now: float,
+                    evidence: dict) -> dict:
+        span_cm = (self.tracer.span("control.action", policy=p.name,
+                                    action=p.action)
+                   if self.tracer is not None
+                   else contextlib.nullcontext())
+        with span_cm as span:
+            try:
+                actuator = self.actuators.get(p.action)
+                if actuator is None:
+                    raise RuntimeError(
+                        f"no actuator bound for {p.action!r}")
+                detail = await actuator(p, dict(evidence))
+            except Exception as e:  # noqa: BLE001 — booked, not raised
+                evidence["error"] = str(e) or type(e).__name__
+                if span is not None:
+                    span.attrs["outcome"] = "actuator_failed"
+                log.warning("control: policy %s actuator %s failed: %s",
+                            p.name, p.action, e)
+                return self.ledger.note(p.name, "actuator_failed",
+                                        action=p.action,
+                                        evidence=evidence)
+            if span is not None:
+                span.attrs["outcome"] = "fired"
+        ps.latched = True
+        ps.cooldown_until = now + p.cooldown_s
+        if isinstance(detail, dict):
+            evidence["result"] = detail
+        log.info("control: policy %s fired %s (signal=%s threshold=%s)",
+                 p.name, p.action, evidence.get("signal"), p.threshold)
+        rec = self.ledger.note(p.name, "fired", action=p.action,
+                               evidence=evidence)
+        self._pending.append({"id": rec["id"], "policy": p,
+                              "due": now + p.verify_window_s})
+        return rec
+
+    async def resolve_due(self, now: float | None = None) -> None:
+        """Book verdicts for fired decisions whose verify window has
+        elapsed: re-read the signal; recovered iff no longer breached."""
+        now = self.clock() if now is None else now
+        due = [e for e in self._pending if e["due"] <= now]
+        if not due:
+            return
+        self._pending = [e for e in self._pending if e["due"] > now]
+        for ent in due:
+            p = ent["policy"]
+            value = await self._read(p)
+            recovered = value is not None and not p.breached(value)
+            self.ledger.resolve(
+                ent["id"],
+                "recovered" if recovered else "not_recovered",
+                evidence={"signal": value, "threshold": p.threshold})
+
+    async def _read(self, p: Policy) -> float | None:
+        if self.reader is None:
+            return None
+        try:
+            return await self.reader(p)
+        except Exception:  # noqa: BLE001 — unreadable, not fatal
+            return None
+
+    # -- background loop ---------------------------------------------------
+
+    async def run(self) -> None:
+        """Tick forever (the router runs this as a background task)."""
+        while True:
+            try:
+                await self.evaluate_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — the loop must survive
+                log.exception("control: evaluation tick failed")
+            await asyncio.sleep(self.interval_s)
+
+    def describe(self) -> dict:
+        """Controller state for `GET /fleet/decisions`."""
+        now = self.clock()
+        return {
+            "interval_s": self.interval_s,
+            "policies": [
+                {**p.describe(),
+                 "latched": self._state[p.name].latched,
+                 "cooldown_remaining_s": max(
+                     0.0, round(self._state[p.name].cooldown_until - now,
+                                3))}
+                for p in self.policies],
+            "pending_verdicts": len(self._pending),
+        }
+
+
+# -- the router's actuator table ------------------------------------------
+
+
+def router_actuators(st, *, elastic_url: str | None = None,
+                     clock: Callable[[], float] | None = None,
+                     floor_ttl_s: float = 120.0) -> dict:
+    """Bind the closed ACTIONS set to one router's `_FleetState`.
+
+    Every actuator returns a jsonable evidence dict (folded into the
+    ledger record) or raises — the controller books the raise as
+    `actuator_failed`. `elastic_url` points at the elastic training
+    coordinator for `evict_worker`; without one that actuator fails
+    loudly rather than silently no-oping."""
+    clk = clock or time.monotonic
+
+    async def scale_out(policy: Policy, evidence: dict) -> dict:
+        st.registry.sweep()
+        counts = st.registry.counts()
+        routable = counts[READY] + counts[DEGRADED]
+        floor = max(routable + 1, getattr(st, "control_floor", 0))
+        st.control_floor = floor
+        st.control_floor_until = clk() + floor_ttl_s
+        return {"desired_floor": floor, "routable": routable,
+                "floor_ttl_s": floor_ttl_s}
+
+    async def drain_replica(policy: Policy, evidence: dict) -> dict:
+        from kubeflow_tpu.fleet import router as router_mod
+
+        st.registry.sweep()
+        cands = st.registry.routable(set())
+        if len(cands) < 2:
+            raise RuntimeError(
+                "need >= 2 routable replicas to drain one")
+        victim = max(cands, key=lambda r: (r.load(), r.id))
+        out = await router_mod.drain_and_migrate(st, victim.id)
+        return {"replica": victim.id, "drain": out}
+
+    async def evict_worker(policy: Policy, evidence: dict) -> dict:
+        if elastic_url is None:
+            raise RuntimeError("no elastic coordinator configured")
+        async with st.session.post(
+                f"{elastic_url.rstrip('/')}/elastic/evict", json={},
+                timeout=aiohttp_timeout(10.0)) as r:
+            body = await r.json(content_type=None)
+            if r.status != 200:
+                raise RuntimeError(
+                    f"coordinator refused eviction: {body}")
+            return body if isinstance(body, dict) else {"world": body}
+
+    async def disable_draft(policy: Policy, evidence: dict) -> dict:
+        st.registry.sweep()
+        reps = st.registry.routable(set())
+        if not reps:
+            raise RuntimeError("no routable replicas")
+        results: dict[str, int] = {}
+        for rep in reps:
+            try:
+                async with st.session.post(
+                        f"{rep.url}/v1/spec", json={"enabled": False},
+                        timeout=aiohttp_timeout(10.0)) as r:
+                    results[rep.id] = r.status
+            except Exception:  # noqa: BLE001 — per-replica best effort
+                results[rep.id] = 0
+        if not any(s == 200 for s in results.values()):
+            raise RuntimeError(
+                f"no replica accepted the draft disable: {results}")
+        return {"replicas": results, "enabled": False}
+
+    return {"scale_out": scale_out, "drain_replica": drain_replica,
+            "evict_worker": evict_worker, "disable_draft": disable_draft}
+
+
+def aiohttp_timeout(total: float):
+    """Lazy aiohttp import so this module stays importable without it
+    (the math half — Policy/Controller/ledger — has no HTTP needs)."""
+    import aiohttp
+
+    return aiohttp.ClientTimeout(total=total)
+
+
+def default_policies(*, burn_threshold: float = 1.0,
+                     burn_clear: float = 0.5,
+                     cooldown_s: float = 20.0,
+                     verify_window_s: float = 30.0,
+                     kv_pressure_rate: float = 5.0,
+                     straggler_ratio: float = 0.25) -> list[Policy]:
+    """The canonical policy set the closed-loop chaos arm and the docs
+    describe — one policy per actuator, driven by the four signals the
+    observability PRs built:
+
+    - router availability burn (short window) -> scale out
+    - fleet-wide pressure-eviction rate       -> drain the hot replica
+    - train straggler ratio                   -> evict the straggler
+    - speculative-acceptance burn             -> disable the draft
+    """
+    return [
+        Policy(name="availability_burn_scale_out",
+               signal=Signal("slo_burn_rate",
+                             {"slo": "fleet_availability",
+                              "window": "short"},
+                             source="local", reduce="max"),
+               threshold=burn_threshold, clear=burn_clear,
+               cooldown_s=cooldown_s, verify_window_s=verify_window_s,
+               action="scale_out"),
+        Policy(name="kv_pressure_drain",
+               signal=Signal("serving_kv_evictions_total",
+                             {"cause": "pressure"},
+                             mode="rate", reduce="sum"),
+               threshold=kv_pressure_rate,
+               clear=kv_pressure_rate / 2,
+               cooldown_s=cooldown_s, verify_window_s=verify_window_s,
+               action="drain_replica"),
+        Policy(name="straggler_evict",
+               signal=Signal("train_straggler_ratio", {},
+                             reduce="max"),
+               threshold=straggler_ratio,
+               clear=straggler_ratio / 2,
+               cooldown_s=cooldown_s, verify_window_s=verify_window_s,
+               action="evict_worker"),
+        Policy(name="spec_acceptance_burn_draft_off",
+               signal=Signal("slo_burn_rate",
+                             {"slo": "serving_spec_acceptance",
+                              "window": "short"},
+                             reduce="max"),
+               threshold=burn_threshold, clear=burn_clear,
+               cooldown_s=cooldown_s, verify_window_s=verify_window_s,
+               action="disable_draft"),
+    ]

@@ -39,6 +39,7 @@ from aiohttp import web
 
 from kubeflow_tpu import obs as obs_lib
 from kubeflow_tpu.fleet import autoscale
+from kubeflow_tpu.fleet import control as control_mod
 from kubeflow_tpu.fleet.registry import (
     DECODE,
     DEGRADED,
@@ -266,6 +267,31 @@ class FleetObs:
             self.registry.register(self.slo)
         except ValueError:
             pass  # shared registry already carries a burn-rate gauge
+        else:
+            obs_lib.register_budget_gauge(self.registry, self.slo)
+        # Decision-plane counters (ISSUE 16): the controller's ledger
+        # hooks feed these; series are zero-seeded per configured
+        # policy by `bind_control` once the policy set is known.
+        self.control_decisions = Counter(
+            "fleet_control_decisions_total",
+            "Controller policy evaluations by outcome — every "
+            "evaluation lands in exactly one of fired / "
+            "suppressed_hysteresis / suppressed_cooldown / "
+            "below_threshold / actuator_failed (ledger conservation)",
+            self.registry)
+        self.control_actions = Counter(
+            "fleet_control_actions_total",
+            "Actuations the controller actually fired, by policy and "
+            "action (scale_out / drain_replica / evict_worker / "
+            "disable_draft)", self.registry)
+        # policy/outcome/action labels enumerate code + configuration,
+        # never traffic: closed guards (a misconfigured policy name
+        # collapses to the overflow bucket instead of minting series)
+        self.control_policy_guard = obs_lib.LabelGuard(closed=True)
+        self.control_outcome_guard = obs_lib.LabelGuard(
+            seed=obs_lib.DECISION_OUTCOMES, closed=True)
+        self.control_action_guard = obs_lib.LabelGuard(
+            seed=control_mod.ACTIONS, closed=True)
         circuit_g = Gauge(
             "fleet_circuit_open",
             "1 while the replica's circuit breaker is open (skipped by "
@@ -302,6 +328,28 @@ class FleetObs:
         validates roles at the heartbeat door)."""
         self.route_total.inc(reason=reason,
                              pool=self.pool_guard.admit(pool))
+
+    def bind_control(self, policy_names, ledger) -> None:
+        """Wire one DecisionLedger into the decision-plane counters:
+        zero-seed the full policy x outcome and policy x action grids
+        (every series exists on the first scrape) and bind the
+        ledger's hooks. The policy guard is rebuilt CLOSED over the
+        configured names — a policy minted at runtime cannot grow the
+        label set past the overflow bucket."""
+        names = list(policy_names)
+        self.control_policy_guard = obs_lib.LabelGuard(
+            seed=names, closed=True)
+        for p in names:
+            for oc in obs_lib.DECISION_OUTCOMES:
+                self.control_decisions.inc(0, policy=p, outcome=oc)
+            for act in control_mod.ACTIONS:
+                self.control_actions.inc(0, policy=p, action=act)
+        ledger.on_decision = lambda p, oc: self.control_decisions.inc(
+            policy=self.control_policy_guard.admit(p),
+            outcome=self.control_outcome_guard.admit(oc))
+        ledger.on_action = lambda p, act: self.control_actions.inc(
+            policy=self.control_policy_guard.admit(p),
+            action=self.control_action_guard.admit(act))
 
 
 class _FleetState:
@@ -350,6 +398,14 @@ class _FleetState:
         self.tenancy = tenancy
         self.ledger = TenantLedger(tenancy) if tenancy is not None \
             else None
+        # Closed-loop control (ISSUE 16): the controller and its
+        # background task, plus the scale_out actuator's desired-
+        # replica floor (absolute count, TTL'd) that /fleet/autoscale
+        # folds into its recommendation.
+        self.controller = None
+        self.control_task: asyncio.Task | None = None
+        self.control_floor = 0
+        self.control_floor_until = float("-inf")
 
     def ingest_checkpoints(self, replica_id: str, cks) -> None:
         """Fold one heartbeat's sequence checkpoints into the store
@@ -1118,14 +1174,28 @@ async def _drain(request: web.Request):
     except Exception:
         return web.json_response({"error": "invalid JSON"}, status=400)
     rid = str(body.get("id", ""))
-    rep = st.registry.get(rid)
-    if rep is None:
+    if st.registry.get(rid) is None:
         return web.json_response(
             {"error": f"unknown replica {rid!r}"}, status=404)
+    out = await drain_and_migrate(st, rid,
+                                  migrate=body.get("migrate", True))
+    return web.json_response(out)
+
+
+async def drain_and_migrate(st: _FleetState, rid: str, *,
+                            migrate: bool = True) -> dict:
+    """Drain one replica: mark it draining in the table and forward
+    the drain (with migrate peers when any exist). Shared by the
+    `/fleet/drain` handler and the controller's `drain_replica`
+    actuator — the closed loop fires the exact code path an operator
+    would."""
+    rep = st.registry.get(rid)
+    if rep is None:
+        raise KeyError(f"unknown replica {rid!r}")
     st.registry.drain(rid)
     peers = sorted(st.registry.routable({rid}),
                    key=lambda r: (r.load(), r.id))
-    migrate = bool(peers) and body.get("migrate", True)
+    migrate = bool(peers) and migrate
     payload = ({"migrate": True, "peers": [r.url for r in peers]}
                if migrate else None)
     forwarded: dict = {}
@@ -1138,8 +1208,7 @@ async def _drain(request: web.Request):
                 forwarded = await r.json()
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
         pass  # marking it draining here already stops routing
-    return web.json_response({"id": rid, "state": "draining",
-                              "replica": forwarded})
+    return {"id": rid, "state": "draining", "replica": forwarded}
 
 
 async def _placements(request: web.Request):
@@ -1178,11 +1247,17 @@ async def _autoscale(request: web.Request):
     """GET /fleet/autoscale[?pools=1] — replica-count recommendation.
     With `pools=1` the response adds the prefill/decode split driven
     by the fleet's phase-seconds shares (autoscale.recommend_pools);
-    the min defaults to 2 there so both pools can hold a replica."""
+    the min defaults to 2 there so both pools can hold a replica.
+    When the controller's scale_out actuator has raised a desired
+    floor (and its TTL has not lapsed), `desired` is lifted to it —
+    the infra layer polling this endpoint is the dumb half of the
+    closed loop."""
     st: _FleetState = request.app[FLEET_KEY]
     st.registry.sweep()
     q = request.rel_url.query
     pools = q.get("pools", "") not in ("", "0", "false")
+    floor = (st.control_floor
+             if st.registry.clock() < st.control_floor_until else 0)
     try:
         lo = int(q.get("min", 2 if pools else 1))
         hi = int(q.get("max", 8))
@@ -1191,18 +1266,21 @@ async def _autoscale(request: web.Request):
                 st.registry.replicas(), min_replicas=lo,
                 max_replicas=hi)
             return web.json_response({
-                "desired": prec.desired,
+                "desired": max(prec.desired, min(hi, floor)),
                 "pools": {"prefill": prec.prefill,
                           "decode": prec.decode},
                 "reason": prec.reason,
-                "signals": prec.signals})
+                "signals": prec.signals,
+                "controller_floor": floor})
         rec = autoscale.recommend_replicas(
             st.registry.replicas(), min_replicas=lo, max_replicas=hi)
     except ValueError as e:
         return web.json_response({"error": str(e)}, status=400)
-    return web.json_response({"desired": rec.desired,
+    return web.json_response({"desired": max(rec.desired,
+                                             min(hi, floor)),
                               "reason": rec.reason,
-                              "signals": rec.signals})
+                              "signals": rec.signals,
+                              "controller_floor": floor})
 
 
 async def _stats(request: web.Request):
@@ -1330,6 +1408,29 @@ async def _merged_traces(request: web.Request):
     return web.json_response(obs_lib.merge_chrome_traces(segments))
 
 
+async def _decisions(request: web.Request):
+    """GET /fleet/decisions[?limit=N] — the control plane's audit
+    book: the conservation-checked ledger snapshot (every evaluation
+    booked to exactly one outcome), the bounded audit trail of
+    decision records (evidence in, action taken, verdict out), and
+    the live policy state (latched flags, cooldown remainders)."""
+    st: _FleetState = request.app[FLEET_KEY]
+    ctl = st.controller
+    if ctl is None:
+        return web.json_response(
+            {"error": "router has no controller"}, status=404)
+    q = request.rel_url.query
+    try:
+        limit = int(q.get("limit", 0)) or None
+    except ValueError:
+        return web.json_response({"error": "bad limit"}, status=400)
+    return web.json_response({
+        **ctl.ledger.snapshot(),
+        "records": ctl.ledger.records(limit),
+        "controller": ctl.describe(),
+    })
+
+
 async def _healthz(request: web.Request):
     st: _FleetState = request.app[FLEET_KEY]
     st.registry.sweep()
@@ -1379,7 +1480,10 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
                       metrics_registry=None, tracer=None,
                       tenancy: TenancyConfig | None = None,
                       max_attempts: int | None = None,
-                      chaos=None) -> web.Application:
+                      chaos=None,
+                      policies=None,
+                      control_interval_s: float = 2.0,
+                      elastic_url: str | None = None) -> web.Application:
     """Build the router app. `block_size` must match the replicas'
     `kv_block_size` (the affinity key is the first block — a mismatch
     only costs cache hits, never correctness). `policy` is "affinity"
@@ -1394,7 +1498,13 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
     `max_attempts` caps TOTAL upstream dispatches per request —
     primaries, retries and hedges together (default `retries + 2`).
     `chaos` is a `fleet.chaos.ChaosInjector` for the fault-injection
-    loadtest; leave None in production."""
+    loadtest; leave None in production. `policies` is a list of
+    `fleet.control.Policy` rules: when given, a closed-loop
+    `Controller` evaluates them every `control_interval_s` seconds
+    against the federated metrics view and fires the built-in
+    actuators (see `control.router_actuators`; `elastic_url` points
+    `evict_worker` at an elastic coordinator). With or without
+    policies, `/fleet/decisions` serves the decision ledger."""
     if policy not in ("affinity", "roundrobin"):
         raise ValueError(f"unknown policy {policy!r}")
     if block_size < 1:
@@ -1412,13 +1522,35 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
                      backoff_s=backoff_s, timeout_s=request_timeout_s,
                      tenancy=tenancy, max_attempts=max_attempts,
                      chaos=chaos)
+    # Closed-loop controller: constructed with or without policies so
+    # /fleet/decisions always answers; the background loop only runs
+    # when there are policies to evaluate.
+    pols = list(policies) if policies else []
+    decision_ledger = obs_lib.DecisionLedger()
+    obs.bind_control([p.name for p in pols], decision_ledger)
+    st.controller = control_mod.Controller(
+        pols, ledger=decision_ledger,
+        reader=control_mod.FederatedSignalReader(st, clock=reg.clock),
+        actuators=control_mod.router_actuators(
+            st, elastic_url=elastic_url, clock=reg.clock),
+        interval_s=control_interval_s, clock=reg.clock,
+        tracer=obs.tracer)
     app = web.Application(middlewares=[_router_obs_middleware])
     app[FLEET_KEY] = st
 
     async def _start(app_):
         st.session = aiohttp.ClientSession()
+        if pols and control_interval_s > 0:
+            st.control_task = asyncio.create_task(st.controller.run())
 
     async def _stop(app_):
+        if st.control_task is not None:
+            st.control_task.cancel()
+            try:
+                await st.control_task
+            except asyncio.CancelledError:
+                pass
+            st.control_task = None
         if st.session is not None:
             await st.session.close()
 
@@ -1439,6 +1571,7 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
     app.router.add_get("/fleet/placements", _placements)
     app.router.add_get("/fleet/replicas", _replicas)
     app.router.add_get("/fleet/autoscale", _autoscale)
+    app.router.add_get("/fleet/decisions", _decisions)
     app.router.add_get("/fleet/stats", _stats)
     app.router.add_get("/fleet/cache", _fleet_cache)
     app.router.add_get("/v1/models", _proxied_models)

@@ -31,6 +31,11 @@ from kubeflow_tpu.obs.cachestats import (
     prefix_hash,
 )
 from kubeflow_tpu.obs.cardinality import OVERFLOW_LABEL, LabelGuard
+from kubeflow_tpu.obs.decisions import (
+    OUTCOMES as DECISION_OUTCOMES,
+    VERDICTS as DECISION_VERDICTS,
+    DecisionLedger,
+)
 from kubeflow_tpu.obs.exposition import (
     ExpositionError,
     parse_exposition,
@@ -56,7 +61,13 @@ from kubeflow_tpu.obs.profiling import (
     abstract_signature,
     merge_counter_tracks,
 )
-from kubeflow_tpu.obs.slo import Slo, SloEngine, get_or_create_slo_engine
+from kubeflow_tpu.obs.slo import (
+    Slo,
+    SloBudgetGauge,
+    SloEngine,
+    get_or_create_slo_engine,
+    register_budget_gauge,
+)
 from kubeflow_tpu.obs.timeline import RequestTimeline, TimelineStore
 from kubeflow_tpu.obs.tracing import (
     Span,
@@ -83,6 +94,9 @@ __all__ = [
     "WATCHED_TRAIN_FNS",
     "CacheLedger",
     "CompileWatch",
+    "DECISION_OUTCOMES",
+    "DECISION_VERDICTS",
+    "DecisionLedger",
     "ExpositionError",
     "Histogram",
     "LabelGuard",
@@ -90,6 +104,7 @@ __all__ = [
     "PhaseProfiler",
     "RequestTimeline",
     "Slo",
+    "SloBudgetGauge",
     "SloEngine",
     "Span",
     "TimelineStore",
@@ -107,6 +122,7 @@ __all__ = [
     "merge_families",
     "parse_exposition",
     "prefix_hash",
+    "register_budget_gauge",
     "render_families",
     "sample_quantile",
     "traces_response_payload",

@@ -1,0 +1,190 @@
+"""Decision ledger: conservation-checked accounting for the control loop.
+
+The fleet controller (`fleet/control.py`) is only trustworthy if every
+decision it takes — and every decision it *declines* to take — is a
+first-class observable. This module is the book: a pure-python sidecar
+(metric-free, importable anywhere) where every policy evaluation is
+booked into exactly ONE outcome from a closed set, and every fired
+action carries its evidence snapshot in and its post-window verdict
+out.
+
+Conservation invariant (asserted by tests and `ci/obs_check control`):
+
+    evaluations == sum(outcomes over all causes)
+
+i.e. no evaluation vanishes un-booked and none is double-counted — the
+same structural discipline as `CacheLedger` (births - frees == in_use)
+and the goodput ledger (phase sums == wall). An actuator that throws is
+booked `actuator_failed`, never `fired`, so the fired count is a count
+of actions that actually went out.
+
+The ledger is metric-free; the router binds `on_decision`/`on_action`
+to real counters (`fleet_control_decisions_total{policy,outcome}`,
+`fleet_control_actions_total{policy,action}`), the same wiring idiom
+as `PhaseProfiler.on_phase` and `CacheLedger.on_free`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+# Closed set of evaluation outcomes. These become the `outcome` label on
+# `fleet_control_decisions_total`, so the set is CLOSED by design:
+#   fired                 — breach confirmed, actuator ran successfully
+#   suppressed_hysteresis — signal breached (or is still above the clear
+#                           level) but the policy is latched from a prior
+#                           fire; re-firing waits for the signal to drop
+#                           below the clear band
+#   suppressed_cooldown   — breach confirmed but the policy fired too
+#                           recently; cooling down
+#   below_threshold       — nothing to do: signal is healthy
+#   actuator_failed       — breach confirmed, fire attempted, actuator
+#                           raised; booked here so `fired` only ever
+#                           counts actions that actually went out
+OUTCOMES = ("fired", "suppressed_hysteresis", "suppressed_cooldown",
+            "below_threshold", "actuator_failed")
+
+# Post-window verdict on a fired action: did the signal that justified
+# the fire actually recover inside the policy's verify window?
+VERDICTS = ("pending", "recovered", "not_recovered")
+
+# Audit records kept for `GET /fleet/decisions`. Bounds memory; the
+# counters underneath are cumulative and never truncate.
+_MAX_RECORDS = 256
+
+
+class DecisionLedger:
+    """Accounting for one controller's policy evaluations.
+
+    The controller calls `note(policy, outcome, ...)` exactly once per
+    evaluation; for `fired`/`actuator_failed` outcomes it passes the
+    evidence snapshot (signal value, threshold, replica counts — the
+    facts the decision was made on) and, when fired, the action name.
+    Later it calls `resolve(decision_id, verdict, ...)` once the verify
+    window has elapsed and the signal has been re-read.
+
+    Hook exceptions are swallowed: the ledger must never crash the
+    control loop it is auditing.
+    """
+
+    def __init__(self, *, max_records: int = _MAX_RECORDS,
+                 wall: Callable[[], float] = time.time):
+        self._lock = threading.Lock()
+        self._wall = wall
+        self.evaluations = 0
+        self.outcomes = {o: 0 for o in OUTCOMES}
+        # per policy: {outcome: count}; grown on first sight so the
+        # snapshot shows exactly the policies that were evaluated.
+        self._by_policy: dict[str, dict[str, int]] = {}
+        self.verdicts = {v: 0 for v in VERDICTS}
+        self._records: deque = deque(maxlen=max_records)
+        self._by_id: dict[int, dict] = {}
+        self._next_id = 0
+        # Bound by the consuming layer to real counters.
+        self.on_decision: Callable[[str, str], None] | None = None
+        self.on_action: Callable[[str, str], None] | None = None
+
+    # -- write side --------------------------------------------------------
+
+    def note(self, policy: str, outcome: str, *,
+             action: str | None = None,
+             evidence: dict | None = None) -> dict:
+        """Book one evaluation into exactly one outcome. Returns the
+        audit record; for fired outcomes the caller keeps its `id` to
+        `resolve()` the verdict after the verify window."""
+        if outcome not in OUTCOMES:
+            raise ValueError(f"unknown outcome {outcome!r}")
+        if outcome == "fired" and not action:
+            raise ValueError("fired decisions must name their action")
+        rec = {
+            "id": None,
+            "wall": self._wall(),
+            "policy": policy,
+            "outcome": outcome,
+            "action": action,
+            "evidence": dict(evidence or {}),
+            "verdict": "pending" if outcome == "fired" else None,
+            "verdict_evidence": None,
+        }
+        with self._lock:
+            self.evaluations += 1
+            self.outcomes[outcome] += 1
+            per = self._by_policy.setdefault(
+                policy, {o: 0 for o in OUTCOMES})
+            per[outcome] += 1
+            if outcome == "fired":
+                rec["id"] = self._next_id
+                self._next_id += 1
+                self.verdicts["pending"] += 1
+                self._by_id[rec["id"]] = rec
+                # evict the oldest pending index entry once the deque
+                # rolls it out, so _by_id stays bounded too
+                if (len(self._records) == self._records.maxlen
+                        and self._records[0].get("id") is not None):
+                    self._by_id.pop(self._records[0]["id"], None)
+            self._records.append(rec)
+        self._hook(self.on_decision, policy, outcome)
+        if outcome == "fired":
+            self._hook(self.on_action, policy, action)
+        return rec
+
+    def resolve(self, decision_id: int, verdict: str, *,
+                evidence: dict | None = None) -> bool:
+        """Book the post-window verdict on a fired decision. Returns
+        False when the id is unknown or already resolved."""
+        if verdict not in VERDICTS or verdict == "pending":
+            raise ValueError(f"unknown verdict {verdict!r}")
+        with self._lock:
+            rec = self._by_id.get(decision_id)
+            if rec is None or rec["verdict"] != "pending":
+                return False
+            rec["verdict"] = verdict
+            rec["verdict_evidence"] = dict(evidence or {})
+            self.verdicts["pending"] -= 1
+            self.verdicts[verdict] += 1
+        return True
+
+    # -- read side ---------------------------------------------------------
+
+    @property
+    def conserved(self) -> bool:
+        with self._lock:
+            return self.evaluations == sum(self.outcomes.values())
+
+    def records(self, limit: int | None = None) -> list[dict]:
+        """Audit trail, oldest first (evidence dicts are shallow-copied
+        so callers can jsonify without racing the controller)."""
+        with self._lock:
+            recs = [dict(r) for r in self._records]
+        return recs[-limit:] if limit else recs
+
+    def pending(self) -> list[dict]:
+        """Fired decisions still awaiting their verdict."""
+        with self._lock:
+            return [dict(r) for r in self._by_id.values()
+                    if r["verdict"] == "pending"]
+
+    def snapshot(self) -> dict:
+        """Jsonable summary for `GET /fleet/decisions`."""
+        with self._lock:
+            return {
+                "evaluations": self.evaluations,
+                "outcomes": dict(self.outcomes),
+                "by_policy": {p: dict(c)
+                              for p, c in sorted(self._by_policy.items())},
+                "verdicts": dict(self.verdicts),
+                "conserved": (self.evaluations
+                              == sum(self.outcomes.values())),
+            }
+
+    @staticmethod
+    def _hook(fn, *args) -> None:
+        if fn is None:
+            return
+        try:
+            fn(*args)
+        except Exception:
+            pass

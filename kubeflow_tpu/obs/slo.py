@@ -88,6 +88,7 @@ class SloEngine:
                         "long": float(long_window_s)}
         self._clock = clock or time.monotonic
         self._lock = threading.Lock()
+        self._budget: SloBudgetGauge | None = None
         # per slo: deque of (t, bad) — bad is 0/1
         self._events: dict[str, collections.deque] = {
             s.name: collections.deque(maxlen=MAX_EVENTS_PER_SLO)
@@ -155,6 +156,52 @@ class SloEngine:
                 yield (self.name, {"slo": name, "window": wname},
                        rates[(name, wname)])
 
+    def budget_gauge(self) -> "SloBudgetGauge":
+        """The engine's companion `slo_error_budget_remaining` metric
+        (one instance per engine — the Registry dedupes by name, so a
+        second family cannot come from the engine object itself)."""
+        if self._budget is None:
+            self._budget = SloBudgetGauge(self)
+        return self._budget
+
+
+class SloBudgetGauge:
+    """Remaining error budget per SLO, as a fraction of the long
+    window's budget: 1 - long-window burn. 1.0 = untouched, 0.0 =
+    spending exactly at the objective's rate, negative = overspent.
+    Operators and the fleet controller both want "how much runway is
+    left", not just "how fast is it burning" — this is that number,
+    computed live at scrape time from the same event windows as
+    `slo_burn_rate`. Every SLO is always emitted (zero-seeded: an
+    event-free window burns 0, so the budget reads a full 1.0)."""
+
+    name = "slo_error_budget_remaining"
+    help = ("fraction of the error budget left in the long burn "
+            "window (1 - long-window burn rate; 1 = untouched, "
+            "0 = spending at the objective's rate, negative = "
+            "overspent)")
+    TYPE = "gauge"
+
+    def __init__(self, engine: SloEngine):
+        self._engine = engine
+
+    def expositions(self) -> Iterator[tuple[str, dict[str, str], float]]:
+        rates = self._engine.burn_rates()
+        for name in sorted(self._engine.slos):
+            yield (self.name, {"slo": name},
+                   1.0 - rates[(name, "long")])
+
+
+def register_budget_gauge(registry, engine: SloEngine) -> None:
+    """Idempotently register `engine`'s budget gauge on `registry`.
+    Callers that register an engine directly (rather than through
+    `get_or_create_slo_engine`) use this to get the companion family."""
+    if registry.get(SloBudgetGauge.name) is None:
+        try:
+            registry.register(engine.budget_gauge())
+        except ValueError:
+            pass  # raced: the registry already carries one
+
 
 def get_or_create_slo_engine(registry, slos, *,
                              short_window_s: float = 60.0,
@@ -179,7 +226,9 @@ def get_or_create_slo_engine(registry, slos, *,
         except ValueError:
             engine = registry.get("slo_burn_rate") or engine
         else:
+            register_budget_gauge(registry, engine)
             return engine
     for slo in slos:
         engine.add(slo)
+    register_budget_gauge(registry, engine)
     return engine

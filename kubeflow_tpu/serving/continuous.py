@@ -1367,6 +1367,15 @@ class ContinuousBatcher:
         # on_spec_round(proposed: int, accepted: int) — per speculative
         # verify round; the server feeds the spec-acceptance SLO
         self.on_spec_round = None
+        # Runtime kill switch for speculative decoding (the fleet
+        # controller's disable_draft actuator flips it via POST
+        # /v1/spec). Off: spec rounds and draft-cache seeding stop,
+        # plain decode continues; the draft engine and its caches stay
+        # allocated. Re-enabling mid-flight is safe only at low load —
+        # slots admitted while disabled have no draft KV row, so spec
+        # rounds would verify against a stale draft cache; prefer to
+        # re-enable when the batcher drains.
+        self.spec_enabled = True
         # optional obs.Tracer: when set (the server wires it), every
         # decode-chunk dispatch opens a `decode.attention` span in the
         # executor thread, tagged with the RESOLVED attention impl —
@@ -2295,7 +2304,7 @@ class ContinuousBatcher:
                 self._topk[slot] = sampling.get("top_k", ec.top_k)
                 self._topp[slot] = sampling.get("top_p", ec.top_p)
                 self._sp_dirty = True
-                if self.cengine.draft is not None:
+                if self.cengine.draft is not None and self.spec_enabled:
                     # seed the draft cache row BEFORE the first token
                     # is appended: the draft row must hold exactly the
                     # prompt's KV, aligned with the target cursor
@@ -2493,7 +2502,7 @@ class ContinuousBatcher:
                     rec.meta.tenant if rec.meta is not None else "")
             except Exception:  # noqa: BLE001 — metrics hook
                 pass           # must never kill the worker
-        if self.cengine.draft is not None:
+        if self.cengine.draft is not None and self.spec_enabled:
             with self.profiler.phase("draft"):
                 await self._draft_seed(loop, slot, rec)
         self._emit(slot, rec, first, flp, decode=False)
@@ -2773,7 +2782,7 @@ class ContinuousBatcher:
                 # not kill the worker and hang every future.
                 while inflight and inflight[0]["toks"].is_ready():
                     self._process_chunk(inflight.popleft())
-                if self.cengine.draft is not None:
+                if self.cengine.draft is not None and self.spec_enabled:
                     # speculative rounds replace plain decode chunks;
                     # synchronous (acceptance gates retirement), so the
                     # inflight pipeline stays empty in spec mode

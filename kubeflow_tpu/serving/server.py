@@ -1030,8 +1030,35 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                 models[_m] = snap
         return web.json_response({"models": models})
 
+    async def spec_toggle(request: web.Request):
+        """POST /v1/spec {"enabled": bool} — runtime kill switch for
+        speculative decoding on every model this replica serves (the
+        fleet controller's disable_draft actuator fires this when the
+        spec-acceptance SLO burns: a draft model that stops earning
+        its keep costs a verify round per window for nothing). GET
+        returns the current per-model state."""
+        if request.method == "GET":
+            return web.json_response({"models": _spec_state(request.app)})
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid JSON"},
+                                     status=400)
+        enabled = body.get("enabled") if isinstance(body, dict) else None
+        if not isinstance(enabled, bool):
+            return web.json_response(
+                {"error": "body needs a boolean 'enabled'"}, status=400)
+        for b in request.app[BATCHERS_KEY].values():
+            if isinstance(b, ContinuousBatcher) \
+                    and b.cengine.draft is not None:
+                b.spec_enabled = enabled
+        return web.json_response({"enabled": enabled,
+                                  "models": _spec_state(request.app)})
+
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/readyz", _ok)
+    app.router.add_get("/v1/spec", spec_toggle)
+    app.router.add_post("/v1/spec", spec_toggle)
     app.router.add_get("/metrics",
                        obs_endpoints.metrics_handler(sobs.registry))
     app.router.add_get("/debug/traces", debug_traces)
@@ -1048,6 +1075,17 @@ def create_serving_app(engines: dict[str, InferenceEngine],
 
 async def _ok(request: web.Request):
     return web.json_response({"status": "ok"})
+
+
+def _spec_state(app: web.Application) -> dict:
+    """Per-model speculative-decoding state for /v1/spec."""
+    out = {}
+    for name, b in app[BATCHERS_KEY].items():
+        has_draft = (isinstance(b, ContinuousBatcher)
+                     and b.cengine.draft is not None)
+        out[name] = {"draft": has_draft,
+                     "spec_enabled": bool(has_draft and b.spec_enabled)}
+    return out
 
 
 def _in_flight(app: web.Application) -> int:

@@ -302,6 +302,47 @@ class ElasticCoordinator:
         with self._lock:
             self._recompute()
 
+    def evict(self, replica_id: str | None = None) -> dict[str, Any]:
+        """Evict one worker from the gang — the straggler actuator the
+        fleet controller fires on `train_straggler_ratio` burn. With no
+        `replica_id` the coordinator picks its own straggler: the live
+        member with the slowest latest step. Eviction is just a
+        deregister + recompute, so it rides the existing generation
+        bump: survivors see the new generation on their next heartbeat
+        and resize; the evicted worker's next heartbeat gets
+        `known=False` and it rejoins as a fresh member (a slow HOST
+        stays slow and gets evicted again; a transient straggler gets a
+        second chance). Raises KeyError for an unknown id and
+        RuntimeError when eviction would drop the gang below
+        `min_replicas` — the controller books that as actuator_failed
+        rather than stalling the whole job."""
+        with self._lock:
+            self._recompute()
+            if len(self._members) <= self.min_replicas:
+                raise RuntimeError(
+                    f"eviction would drop the gang below min_replicas="
+                    f"{self.min_replicas} (members: {len(self._members)})")
+            if replica_id is None:
+                slowest, slowest_ss = None, 0.0
+                for rid in self._members:
+                    ss = self._stats.get(rid, {}).get("step_seconds")
+                    if ss is not None and float(ss) > slowest_ss:
+                        slowest, slowest_ss = rid, float(ss)
+                if slowest is None:
+                    raise RuntimeError(
+                        "no member has reported a step time yet — "
+                        "nothing to call a straggler")
+                replica_id = slowest
+            elif replica_id not in self._members:
+                raise KeyError(f"unknown member {replica_id!r}")
+            self._registry.deregister(replica_id)
+            self._recompute()
+            log.warning("trainer eviction: %s removed (generation %d)",
+                        replica_id, self._generation)
+            world = self._world_locked()
+            world["evicted"] = replica_id
+            return world
+
     def _recompute(self) -> None:
         self._registry.sweep()
         live = tuple(sorted(
@@ -451,6 +492,20 @@ def create_coordinator_app(coord: ElasticCoordinator):
     async def world(request):
         return web.json_response(coord.world(include_stats=True))
 
+    async def evict(request):
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        rid = body.get("replica_id") if isinstance(body, dict) else None
+        try:
+            world = coord.evict(str(rid) if rid is not None else None)
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        except RuntimeError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return web.json_response(world)
+
     async def metrics_federated(request):
         return web.Response(text=coord.federated_metrics(),
                             content_type="text/plain")
@@ -460,6 +515,7 @@ def create_coordinator_app(coord: ElasticCoordinator):
 
     app.router.add_post("/elastic/register", register)
     app.router.add_post("/elastic/heartbeat", heartbeat)
+    app.router.add_post("/elastic/evict", evict)
     app.router.add_get("/elastic/world", world)
     app.router.add_get("/elastic/metrics", metrics_federated)
     app.router.add_get("/elastic/traces", traces_merged)

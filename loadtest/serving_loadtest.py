@@ -36,6 +36,17 @@ rolls back without leaking a pool block, and p95 stays bounded. The
 JSON line records the injected-fault ledger and the drain-to-exit
 time.
 
+`--mode chaos --closed-loop` swaps the fault-injection arm for the
+closed-loop recovery arm (ISSUE 16): the router runs its SLO-burn
+controller live, the harness SIGKILLs the WHOLE fleet mid-flood and
+then acts as dumb infra — booting a replacement replica only when the
+controller's scale_out floor at /fleet/autoscale exceeds live
+capacity. The controller is the only recovery path; the run fails
+unless availability burn clears within one short window, every
+request eventually completes token-exact, and the fired decision is
+booked `recovered` in the conservation-checked /fleet/decisions
+ledger (printed as the run's audit table).
+
 `--mode disagg` is the disaggregated-pools A/B (ISSUE 12): a fleet
 split into prefill/decode pools (prefill replicas fill paged KV
 blocks and ship them to the decode pool over /v1/migrate/in, the
@@ -187,6 +198,37 @@ app = srv.create_serving_app({{"tiny": eng}}, continuous=True, warmup=True,
 srv.enable_fleet_registration(app, {router!r},
                               "http://127.0.0.1:{port}",
                               replica_id="replica-{idx}", period_s=0.5)
+web.run_app(app, host="127.0.0.1", port={port}, print=None)
+'''
+
+
+# Closed-loop router (--mode chaos --closed-loop): the fleet router
+# with ONE declarative policy — availability short-window burn over
+# threshold fires scale_out — and the controller loop running live.
+# The short SLO window is shrunk from the prod 60 s so "burn clears
+# within one short window" is a seconds-scale assertion, and retries
+# are capped low so a dead fleet turns into 503s (availability budget
+# spend, the controller's evidence) in about a second instead of
+# hiding the outage inside a long retry ladder.
+CLOSED_LOOP_ROUTER_CODE = r'''
+import sys
+sys.path.insert(0, {repo!r})
+from aiohttp import web
+from kubeflow_tpu.fleet import control
+from kubeflow_tpu.fleet.router import FLEET_KEY, create_router_app
+pol = control.Policy(
+    name="availability_burn_scale_out",
+    signal=control.Signal(
+        "slo_burn_rate",
+        {{"slo": "fleet_availability", "window": "short"}},
+        source="local"),
+    threshold=1.0, clear=0.5, cooldown_s={cooldown_s},
+    verify_window_s={verify_s}, action="scale_out")
+app = create_router_app(block_size={block_size}, policy="affinity",
+                        hedge_after_s=0.0, retries={retries},
+                        backoff_s=0.05, policies=[pol],
+                        control_interval_s={interval_s})
+app[FLEET_KEY].obs.slo.windows["short"] = {short_window_s}
 web.run_app(app, host="127.0.0.1", port={port}, print=None)
 '''
 
@@ -1108,6 +1150,15 @@ def run_chaos(clients: int, requests: int, max_new: int, *,
             raise AssertionError(f"clean re-import failed: {imported}")
 
         route1 = _get_json(f"{router_base}/fleet/stats")
+        try:
+            # no policies configured on this arm, so the table shows
+            # an empty-but-conserved ledger — the closed-loop arm is
+            # where decisions appear; printing both keeps the two
+            # chaos arms' audit output symmetric
+            _print_decision_table(
+                _get_json(f"{router_base}/fleet/decisions"))
+        except Exception:
+            pass
         ledger = route1.get("chaos") or {}
         if sum(ledger.values()) <= 0:
             raise AssertionError(
@@ -1157,6 +1208,316 @@ def run_chaos(clients: int, requests: int, max_new: int, *,
             "drain_failed": int(fwd.get("failed", 0)),
             "migrate_s": fwd.get("migrate_s"),
             "wedge_rollback_ok": True,
+            "client_failures": 0,
+            "token_mismatches": 0,
+        }
+    finally:
+        log.close()
+        os.unlink(log.name)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+
+def _print_decision_table(dec: dict, *, limit: int = 20) -> None:
+    """Render a /fleet/decisions payload as the run's audit table (on
+    stderr — stdout stays the one machine-readable JSON line)."""
+    print("decision ledger "
+          f"(evaluations={dec.get('evaluations')} "
+          f"conserved={dec.get('conserved')}):", file=sys.stderr)
+    for pol, ocs in sorted((dec.get("by_policy") or {}).items()):
+        booked = {k: v for k, v in sorted(ocs.items()) if v}
+        print(f"  {pol}: {booked}", file=sys.stderr)
+    rows = (dec.get("records") or [])[-limit:]
+    if rows:
+        print(f"  last {len(rows)} records "
+              "(outcome/action/verdict/signal):", file=sys.stderr)
+    for r in rows:
+        ev = r.get("evidence") or {}
+        sig = ev.get("signal")
+        print(f"    {r.get('policy'):<28} {r.get('outcome'):<22} "
+              f"{str(r.get('action') or '-'):<14} "
+              f"{str(r.get('verdict') or '-'):<14} "
+              f"{sig if sig is None else round(float(sig), 3)}",
+              file=sys.stderr)
+
+
+def run_chaos_closed_loop(clients: int, requests: int, max_new: int, *,
+                          replicas: int = 1, block_size: int = 8,
+                          retries: int = 2, interval_s: float = 1.0,
+                          short_window_s: float = 10.0,
+                          cooldown_s: float = 60.0,
+                          verify_window_s: float = 75.0) -> dict:
+    """The closed-loop recovery arm (--mode chaos --closed-loop): the
+    CONTROLLER is the only recovery path. A flood runs against the
+    fleet while the harness SIGKILLs every replica process; routed
+    requests start 503ing, the router's own availability burn gauge
+    breaches, and the controller's scale_out policy raises the desired
+    floor at /fleet/autoscale. The harness plays the dumb infra half
+    of the loop: it polls that endpoint and boots a replacement
+    replica ONLY when `controller_floor` exceeds live capacity — never
+    on the demand-based recommendation (which asks for min_replicas
+    whenever the fleet is empty, controller or not). Clients retry on
+    503/connection errors, so the pass bar is zero requests that never
+    completed, token-exact outputs vs the pre-fault oracle, burn back
+    under 1.0 within one short window of the replacement turning
+    routable, and the fired decision booked `recovered` in
+    /fleet/decisions."""
+    import tempfile
+    import threading
+
+    router_port = free_port()
+    rep_ports = [free_port() for _ in range(replicas)]
+    router_base = f"http://127.0.0.1:{router_port}"
+    log = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".log", prefix="kftpu-closedloop-",
+        delete=False)
+    procs: list[subprocess.Popen] = []
+
+    def boot_replica(idx: int, port: int) -> None:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c",
+             CHAOS_REPLICA_CODE.format(
+                 repo=REPO, port=port, idx=idx,
+                 router=router_base, block_size=block_size)],
+            stdout=log, stderr=subprocess.STDOUT))
+
+    try:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c",
+             CLOSED_LOOP_ROUTER_CODE.format(
+                 repo=REPO, port=router_port, block_size=block_size,
+                 retries=retries, interval_s=interval_s,
+                 short_window_s=short_window_s, cooldown_s=cooldown_s,
+                 verify_s=verify_window_s)],
+            stdout=log, stderr=subprocess.STDOUT))
+        for idx, port in enumerate(rep_ports):
+            boot_replica(idx, port)
+
+        def live_count() -> int:
+            counts = _get_json(f"{router_base}/fleet/replicas")["counts"]
+            return counts.get("ready", 0) + counts.get("degraded", 0)
+
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if any(p.poll() is not None for p in procs):
+                break
+            try:
+                if live_count() >= replicas:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        try:
+            ready = live_count() >= replicas
+        except Exception:
+            ready = False
+        if not ready:
+            log.flush()
+            with open(log.name) as f:
+                tail = "\n".join(f.read().splitlines()[-30:])
+            raise RuntimeError(
+                f"closed-loop fleet never became ready "
+                f"(rcs={[p.poll() for p in procs]}):\n{tail}")
+
+        def post(base: str, body: dict, timeout: float = 120.0) -> dict:
+            return _post_json(f"{base}/v1/models/tiny:generate", body,
+                              timeout=timeout)
+
+        prompt_len = 3 * block_size
+        warm_prompt = [255, 99] + [5 + t % 200
+                                   for t in range(prompt_len - 2)]
+        for port in rep_ports:
+            post(f"http://127.0.0.1:{port}",
+                 {"tokens": [warm_prompt], "max_new": max_new})
+
+        # fault-free oracle straight off replica-0 (sharpened lm_head:
+        # byte-reproducible on the replacement replica too, which
+        # boots from the identical seed)
+        k = max(1, requests // 6)
+        prompts = [[3 + j % 250, 100] + [7 + (j + t) % 200
+                                         for t in range(prompt_len - 2)]
+                   for j in range(k)]
+        rep0 = f"http://127.0.0.1:{rep_ports[0]}"
+        oracle = [post(rep0, {"tokens": [pr], "max_new": max_new})
+                  ["tokens"][0] for pr in prompts]
+
+        prompt_order = [i % k for i in range(requests)]
+        random.Random(1).shuffle(prompt_order)
+
+        failures: list[str] = []
+        mismatches: list[str] = []
+        lock = threading.Lock()
+
+        def one(i: int, deadline_s: float) -> None:
+            """One request, retried through the outage: a 503 (or a
+            dead-router blip) is the router honestly reporting zero
+            capacity — the client backs off and retries until the
+            controller has restored the fleet or the deadline says
+            the loop never closed."""
+            j = prompt_order[i]
+            body = {"tokens": [prompts[j]], "max_new": max_new}
+            stop = time.monotonic() + deadline_s
+            while True:
+                try:
+                    got = post(router_base, body)["tokens"][0]
+                    break
+                except Exception as e:  # noqa: BLE001 — retried
+                    if time.monotonic() >= stop:
+                        with lock:
+                            failures.append(
+                                f"req {i}: {type(e).__name__}: {e}")
+                        return
+                    time.sleep(0.5)
+            if [int(t) for t in got] != [int(t) for t in oracle[j]]:
+                with lock:
+                    mismatches.append(
+                        f"req {i} prompt {j}: {got} != {oracle[j]}")
+
+        # infra poller: the dumb half of the loop. Boots a replacement
+        # replica only while the CONTROLLER floor exceeds live+booted
+        # capacity.
+        stop_infra = threading.Event()
+        booted: list[int] = []
+        infra_floor_seen = [0]
+
+        def infra() -> None:
+            while not stop_infra.is_set():
+                try:
+                    rec = _get_json(f"{router_base}/fleet/autoscale")
+                    floor = int(rec.get("controller_floor", 0))
+                    infra_floor_seen[0] = max(infra_floor_seen[0],
+                                              floor)
+                    if floor > live_count() + len(booted):
+                        port = free_port()
+                        boot_replica(replicas + len(booted), port)
+                        booted.append(port)
+                except Exception:
+                    pass
+                stop_infra.wait(0.5)
+
+        infra_thread = threading.Thread(target=infra, daemon=True)
+        infra_thread.start()
+
+        half = requests // 2
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+            list(ex.map(lambda i: one(i, 60.0), range(half)))
+        # second half: SIGKILL every replica mid-burst — total
+        # capacity loss, nothing recovers unless the controller fires
+        with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+            futs = [ex.submit(one, i, 240.0)
+                    for i in range(half, requests)]
+            time.sleep(0.05)
+            t_kill = time.perf_counter()
+            for pproc in procs[1:1 + replicas]:
+                pproc.kill()
+            for f in futs:
+                f.result()
+        wall = time.perf_counter() - t0
+
+        # replacement routable?
+        t_routable = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                if live_count() >= 1:
+                    t_routable = time.perf_counter()
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        if t_routable is None:
+            raise AssertionError(
+                "no replacement replica ever turned routable — the "
+                f"closed loop never actuated (floor seen: "
+                f"{infra_floor_seen[0]}, booted: {len(booted)})")
+
+        # burn back under 1.0 within one short window of routable
+        burn_final = None
+        deadline = time.monotonic() + short_window_s + 30.0
+        while time.monotonic() < deadline:
+            fams = _scrape_metrics(router_base)
+            burn_final = _burn_rate(fams, "fleet_availability", "short")
+            if burn_final < 1.0:
+                break
+            time.sleep(1.0)
+        recovered_s = time.perf_counter() - t_kill
+        if burn_final is None or burn_final >= 1.0:
+            raise AssertionError(
+                f"availability burn never cleared after recovery "
+                f"(last {burn_final})")
+
+        # the fired decision must book `recovered` once the verify
+        # window lapses (the controller resolves on its own ticks)
+        verdict = None
+        fired_rec = None
+        deadline = time.monotonic() + verify_window_s + 45.0
+        while time.monotonic() < deadline:
+            dec = _get_json(f"{router_base}/fleet/decisions")
+            fired = [r for r in dec.get("records", [])
+                     if r.get("outcome") == "fired"]
+            if fired and all(r.get("verdict") != "pending"
+                             for r in fired):
+                fired_rec = fired[-1]
+                verdict = fired_rec.get("verdict")
+                break
+            time.sleep(1.0)
+        dec = _get_json(f"{router_base}/fleet/decisions")
+        _print_decision_table(dec)
+        if not dec.get("conserved"):
+            raise AssertionError(
+                f"decision ledger lost an evaluation: {dec}")
+        if dec["outcomes"].get("fired", 0) < 1:
+            raise AssertionError(
+                f"controller never fired: {dec['outcomes']}")
+        if verdict != "recovered":
+            raise AssertionError(
+                f"fired decision verdict {verdict!r}, want "
+                f"'recovered' (record {fired_rec})")
+        stop_infra.set()
+        infra_thread.join(timeout=5)
+
+        if failures:
+            raise AssertionError(
+                f"{len(failures)} requests never completed through "
+                f"the outage: {failures[:5]}")
+        if mismatches:
+            raise AssertionError(
+                f"{len(mismatches)} token mismatches vs the "
+                f"fault-free oracle: {mismatches[:3]}")
+
+        fams = _scrape_metrics(router_base)
+        budget_left = fams["slo_error_budget_remaining"]["samples"][
+            ("slo_error_budget_remaining",
+             (("slo", "fleet_availability"),))]
+        return {
+            "metric": "serving_chaos_closed_loop",
+            "mode": "chaos",
+            "closed_loop": True,
+            "fleet_replicas": replicas,
+            "clients": clients,
+            "requests": requests,
+            "max_new": max_new,
+            "kv_block_size": block_size,
+            "short_window_s": short_window_s,
+            "wall_s": round(wall, 2),
+            "replacements_booted": len(booted),
+            "controller_floor_peak": infra_floor_seen[0],
+            "outage_to_routable_s": round(t_routable - t_kill, 2),
+            "outage_to_burn_clear_s": round(recovered_s, 2),
+            "burn_final": round(burn_final, 3),
+            "error_budget_remaining": round(budget_left, 4),
+            "decisions": dec["outcomes"],
+            "actions_fired": dec["outcomes"].get("fired", 0),
+            "verdict": verdict,
+            "ledger_conserved": True,
             "client_failures": 0,
             "token_mismatches": 0,
         }
@@ -2063,6 +2424,16 @@ def main() -> int:
                    help="chaos mode: heartbeats to swallow from "
                         "replica-1 (>=13 walks the degraded path at "
                         "the default 6s staleness / 0.5s period)")
+    p.add_argument("--closed-loop", action="store_true",
+                   help="chaos mode: run the closed-loop recovery arm "
+                        "instead of the fault-injection arm — SIGKILL "
+                        "the whole fleet under flood and let the "
+                        "router's burn-driven controller (scale_out "
+                        "desired floor, polled by the harness as dumb "
+                        "infra) be the ONLY recovery path; asserts "
+                        "burn clears within one short window, zero "
+                        "requests lost, and the fired decision books "
+                        "`recovered` in /fleet/decisions")
     p.add_argument("--tenant-bulk-clients", type=int, default=8,
                    help="tenants mode: concurrent batch-class flooder "
                         "threads (the noisy neighbor); must exceed the "
@@ -2122,8 +2493,15 @@ def main() -> int:
         p.error("--pipeline-depth requires --mode continuous")
     if args.pipeline_depth < 0:
         p.error("--pipeline-depth must be >= 0")
+    if args.closed_loop and args.mode != "chaos":
+        p.error("--closed-loop requires --mode chaos")
     if args.fleet_replicas is None:
-        args.fleet_replicas = 3 if args.mode == "chaos" else 2
+        if args.mode == "chaos":
+            # fault-injection needs kill+drain+survivor; the closed
+            # loop needs total capacity loss, so a 1-replica fleet
+            args.fleet_replicas = 1 if args.closed_loop else 3
+        else:
+            args.fleet_replicas = 2
     if args.mode == "fleet":
         if args.fleet_replicas < 1:
             p.error("--fleet-replicas must be >= 1")
@@ -2154,6 +2532,15 @@ def main() -> int:
             block_size=args.fleet_block_size,
             long_every=args.disagg_long_every,
             hedge_after_s=args.fleet_hedge_after_s)
+    elif args.mode == "chaos" and args.closed_loop:
+        if args.fleet_replicas < 1:
+            p.error("--closed-loop needs --fleet-replicas >= 1")
+        if args.requests < 8:
+            p.error("--closed-loop needs --requests >= 8")
+        result = run_chaos_closed_loop(
+            args.clients, args.requests, args.max_new,
+            replicas=args.fleet_replicas,
+            block_size=args.fleet_block_size)
     elif args.mode == "chaos":
         if args.fleet_replicas < 3:
             # one SIGKILLed + one drained + at least one survivor to

@@ -1,0 +1,575 @@
+"""Closed-loop control: policy hysteresis/cooldown math on a fake
+clock, decision-ledger conservation, every actuator through a stub
+router, suppressed-vs-fired metric deltas, /fleet/decisions
+round-trip, verdict booking after the recovery window."""
+
+import asyncio
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+pytest_plugins = ("aiohttp.pytest_plugin",)
+
+from kubeflow_tpu import obs as obs_lib
+from kubeflow_tpu.fleet import control
+from kubeflow_tpu.fleet import router as router_mod
+from kubeflow_tpu.fleet.registry import DRAINING, ReplicaRegistry
+from kubeflow_tpu.obs.decisions import OUTCOMES, DecisionLedger
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def mk_policy(**kw):
+    base = dict(name="p", signal=control.Signal("sig"), threshold=1.0,
+                clear=0.5, cooldown_s=10.0, verify_window_s=5.0,
+                action="scale_out")
+    base.update(kw)
+    return control.Policy(**base)
+
+
+def mk_controller(policy, clock, signal, actuator=None):
+    """Controller over one policy with a dict-driven stub reader and a
+    recording stub actuator."""
+    calls = []
+
+    async def read(p):
+        return signal["v"]
+
+    async def act(p, evidence):
+        calls.append(p.action)
+        return {"ok": True}
+
+    ctl = control.Controller(
+        [policy], clock=clock,
+        reader=read,
+        actuators={policy.action: actuator or act})
+    return ctl, calls
+
+
+# -- pure math: ledger -------------------------------------------------------
+
+
+def test_ledger_books_every_outcome_exactly_once():
+    led = DecisionLedger(wall=lambda: 123.0)
+    for oc in OUTCOMES:
+        led.note("pol", oc, action="scale_out" if oc != "below_threshold"
+                 else None, evidence={"signal": 2.0})
+    snap = led.snapshot()
+    assert snap["conserved"]
+    assert snap["evaluations"] == len(OUTCOMES)
+    assert sum(snap["outcomes"].values()) == len(OUTCOMES)
+    assert snap["by_policy"]["pol"]["fired"] == 1
+    # exactly the fired decision carries a pending verdict
+    assert snap["verdicts"] == {"pending": 1, "recovered": 0,
+                                "not_recovered": 0}
+    rec = [r for r in led.records() if r["outcome"] == "fired"][0]
+    assert rec["verdict"] == "pending" and rec["wall"] == 123.0
+    assert led.resolve(rec["id"], "recovered", evidence={"signal": 0.1})
+    assert not led.resolve(rec["id"], "recovered")   # already booked
+    assert not led.resolve(999, "not_recovered")     # unknown id
+    snap = led.snapshot()
+    assert snap["verdicts"]["recovered"] == 1
+    assert snap["verdicts"]["pending"] == 0
+
+
+def test_ledger_rejects_garbage_and_stays_bounded():
+    led = DecisionLedger(max_records=8)
+    with pytest.raises(ValueError):
+        led.note("p", "exploded")
+    with pytest.raises(ValueError):
+        led.note("p", "fired")              # fired needs an action
+    with pytest.raises(ValueError):
+        led.resolve(0, "pending")
+    for i in range(50):
+        led.note("p", "fired", action="scale_out")
+    assert len(led.records()) == 8
+    assert led.snapshot()["conserved"]
+    assert led.snapshot()["evaluations"] == 50
+    # hooks never raise out of the ledger
+    led.on_decision = lambda p, oc: 1 / 0
+    led.note("p", "below_threshold")
+    assert led.snapshot()["conserved"]
+
+
+# -- pure math: hysteresis / cooldown on a fake clock ------------------------
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        mk_policy(action="reboot_the_universe")
+    with pytest.raises(ValueError):
+        mk_policy(clear=2.0)                # clear above threshold
+    with pytest.raises(ValueError):
+        mk_policy(verify_window_s=0.0)
+    with pytest.raises(ValueError):
+        control.Signal("sig", mode="derivative")
+    # "below" direction flips the band check
+    p = mk_policy(direction="below", threshold=0.5, clear=0.9)
+    assert p.breached(0.2) and not p.breached(0.7)
+
+
+def test_hysteresis_and_cooldown_state_machine():
+    clk = FakeClock()
+    sig = {"v": 0.4}
+    pol = mk_policy(cooldown_s=10.0, verify_window_s=100.0)
+    ctl, calls = mk_controller(pol, clk, sig)
+
+    async def tick(t, v):
+        clk.t, sig["v"] = t, v
+        return (await ctl.evaluate_once())[0]["outcome"]
+
+    async def scenario():
+        assert await tick(0, 0.4) == "below_threshold"
+        assert await tick(1, 1.5) == "fired"
+        assert await tick(2, 1.5) == "suppressed_cooldown"
+        # cooldown (10 s from t=1) expired, but still latched hot
+        assert await tick(12, 1.5) == "suppressed_hysteresis"
+        # inside the band: under the threshold but above clear=0.5
+        assert await tick(13, 0.8) == "suppressed_hysteresis"
+        # past the clear level: unlatch
+        assert await tick(14, 0.4) == "below_threshold"
+        # breach again, unlatched + cooled: a second fire
+        assert await tick(15, 1.5) == "fired"
+
+    asyncio.run(scenario())
+    assert calls == ["scale_out", "scale_out"]
+    snap = ctl.ledger.snapshot()
+    assert snap["conserved"] and snap["evaluations"] == 7
+    assert snap["by_policy"]["p"] == {
+        "fired": 2, "suppressed_hysteresis": 2,
+        "suppressed_cooldown": 1, "below_threshold": 2,
+        "actuator_failed": 0}
+
+
+def test_actuator_failure_is_booked_not_latched():
+    clk = FakeClock()
+    sig = {"v": 2.0}
+    pol = mk_policy()
+    boom = {"on": True}
+
+    async def flaky(p, evidence):
+        if boom["on"]:
+            raise RuntimeError("actuator down")
+        return {"ok": True}
+
+    ctl, _ = mk_controller(pol, clk, sig, actuator=flaky)
+
+    async def scenario():
+        rec = (await ctl.evaluate_once())[0]
+        assert rec["outcome"] == "actuator_failed"
+        assert rec["evidence"]["error"] == "actuator down"
+        # a failed fire neither latches nor starts the cooldown: the
+        # very next tick retries and succeeds
+        boom["on"] = False
+        clk.t = 1.0
+        assert (await ctl.evaluate_once())[0]["outcome"] == "fired"
+
+    asyncio.run(scenario())
+    assert ctl.ledger.snapshot()["conserved"]
+
+
+def test_unreadable_signal_never_actuates():
+    clk = FakeClock()
+    pol = mk_policy()
+
+    async def read(p):
+        return None
+
+    fired = []
+
+    async def act(p, evidence):
+        fired.append(p.name)
+
+    ctl = control.Controller([pol], clock=clk, reader=read,
+                             actuators={pol.action: act})
+
+    async def scenario():
+        rec = (await ctl.evaluate_once())[0]
+        assert rec["outcome"] == "below_threshold"
+        assert rec["evidence"]["signal"] is None
+
+    asyncio.run(scenario())
+    assert not fired
+
+
+def test_verdict_booked_after_recovery_window():
+    clk = FakeClock()
+    sig = {"v": 2.0}
+    pol = mk_policy(cooldown_s=100.0, verify_window_s=5.0)
+    ctl, _ = mk_controller(pol, clk, sig)
+
+    async def scenario():
+        rec = (await ctl.evaluate_once())[0]
+        assert rec["outcome"] == "fired"
+        # before the window elapses the verdict stays pending
+        clk.t = 3.0
+        await ctl.evaluate_once()
+        assert ctl.ledger.pending()[0]["id"] == rec["id"]
+        # window elapsed and the burn recovered
+        clk.t, sig["v"] = 6.0, 0.2
+        await ctl.evaluate_once()
+        booked = [r for r in ctl.ledger.records()
+                  if r["id"] == rec["id"]][0]
+        assert booked["verdict"] == "recovered"
+        assert booked["verdict_evidence"]["signal"] == 0.2
+        assert ctl.ledger.snapshot()["verdicts"]["recovered"] == 1
+
+        # and the not-recovered path: fire again (unlatch first), stay
+        # hot through the window
+        clk.t, sig["v"] = 200.0, 3.0
+        rec2 = (await ctl.evaluate_once())[0]
+        assert rec2["outcome"] == "fired"
+        clk.t = 206.0
+        await ctl.evaluate_once()
+        booked2 = [r for r in ctl.ledger.records()
+                   if r["id"] == rec2["id"]][0]
+        assert booked2["verdict"] == "not_recovered"
+
+    asyncio.run(scenario())
+    assert ctl.ledger.snapshot()["conserved"]
+
+
+# -- signal extraction -------------------------------------------------------
+
+
+EXPO = """# HELP slo_burn_rate burn
+# TYPE slo_burn_rate gauge
+slo_burn_rate{slo="fleet_availability",window="short"} 3.5
+slo_burn_rate{slo="fleet_availability",window="long"} 0.5
+slo_burn_rate{slo="other",window="short"} 9.0
+# HELP serving_kv_evictions_total ev
+# TYPE serving_kv_evictions_total counter
+serving_kv_evictions_total{cause="pressure",replica="a"} 10
+serving_kv_evictions_total{cause="pressure",replica="b"} 4
+serving_kv_evictions_total{cause="lru",replica="a"} 100
+"""
+
+
+def test_signal_value_extraction_and_reduce():
+    fams = obs_lib.parse_exposition(EXPO)
+    sig = control.Signal("slo_burn_rate",
+                         {"slo": "fleet_availability", "window": "short"})
+    assert control.signal_value(fams, sig) == 3.5
+    s_sum = control.Signal("serving_kv_evictions_total",
+                           {"cause": "pressure"}, reduce="sum")
+    assert control.signal_value(fams, s_sum) == 14.0
+    s_avg = control.Signal("serving_kv_evictions_total",
+                           {"cause": "pressure"}, reduce="avg")
+    assert control.signal_value(fams, s_avg) == 7.0
+    # absent family / no matching series is None, never 0
+    assert control.signal_value(
+        fams, control.Signal("nope")) is None
+    assert control.signal_value(
+        fams, control.Signal("slo_burn_rate", {"slo": "ghost"})) is None
+
+
+def test_rate_mode_baselines_and_reset():
+    clk = FakeClock()
+    texts = {"t": EXPO}
+
+    class _Obs:
+        pass
+
+    st = _Obs()
+    st.obs = _Obs()
+    st.obs.registry = _Obs()
+    st.obs.registry.render = lambda: texts["t"]
+    reader = control.FederatedSignalReader(st, clock=clk)
+    pol = mk_policy(signal=control.Signal(
+        "serving_kv_evictions_total", {"cause": "pressure"},
+        mode="rate", reduce="sum", source="local"))
+
+    async def scenario():
+        assert await reader(pol) == 0.0          # first read: baseline
+        clk.t = 10.0
+        texts["t"] = EXPO.replace('replica="a"} 10', 'replica="a"} 30')
+        assert await reader(pol) == 2.0          # (34-14)/10
+        clk.t = 20.0
+        texts["t"] = EXPO.replace('replica="a"} 10', 'replica="a"} 0')
+        assert await reader(pol) == 0.0          # reset: re-baseline
+
+    asyncio.run(scenario())
+
+
+# -- actuators through a stub router ----------------------------------------
+
+
+def _stub_replica_app(calls):
+    """A replica-shaped aiohttp app: /drain and /v1/spec record their
+    payloads; /metrics serves a fixed serving-side exposition."""
+    app = web.Application()
+
+    async def drain(request):
+        calls.append(("drain", await request.json()))
+        return web.json_response({"draining": True, "migrated": 0})
+
+    async def spec(request):
+        calls.append(("spec", await request.json()))
+        return web.json_response({"enabled": False})
+
+    async def metrics(request):
+        return web.Response(text=EXPO, content_type="text/plain")
+
+    app.router.add_post("/drain", drain)
+    app.router.add_post("/v1/spec", spec)
+    app.router.add_get("/metrics", metrics)
+    return app
+
+
+async def _router_with(aiohttp_client, policies, reg=None, **kw):
+    reg = reg if reg is not None else ReplicaRegistry()
+    app = router_mod.create_router_app(
+        reg, block_size=8, policies=policies, control_interval_s=0,
+        **kw)
+    client = await aiohttp_client(app)
+    return client, app[router_mod.FLEET_KEY], reg
+
+
+async def test_scale_out_fires_and_raises_autoscale_floor(aiohttp_client):
+    pol = control.Policy(
+        name="avail", threshold=1.0, clear=0.5, cooldown_s=60.0,
+        verify_window_s=60.0, action="scale_out",
+        signal=control.Signal(
+            "slo_burn_rate",
+            {"slo": "fleet_availability", "window": "short"},
+            source="local"))
+    client, st, reg = await _router_with(aiohttp_client, [pol])
+    reg.register("http://127.0.0.1:1", replica_id="a")
+    for _ in range(4):
+        st.obs.slo.record("fleet_availability", False)
+
+    recs = await st.controller.evaluate_once()
+    assert recs[0]["outcome"] == "fired"
+    assert recs[0]["evidence"]["result"]["desired_floor"] == 2
+
+    body = await (await client.get("/fleet/autoscale")).json()
+    assert body["controller_floor"] == 2
+    assert body["desired"] >= 2
+
+    # suppressed-vs-fired metric deltas: the second tick cools down,
+    # decisions moves, actions does NOT
+    recs = await st.controller.evaluate_once()
+    assert recs[0]["outcome"] == "suppressed_cooldown"
+    dec, act = st.obs.control_decisions, st.obs.control_actions
+    assert dec.value(policy="avail", outcome="fired") == 1
+    assert dec.value(policy="avail", outcome="suppressed_cooldown") == 1
+    assert act.value(policy="avail", action="scale_out") == 1
+    # zero-seeded series exist for the untouched grid cells
+    assert dec.value(policy="avail", outcome="actuator_failed") == 0
+    assert act.value(policy="avail", action="drain_replica") == 0
+
+    # a control.action span landed in the router's tracer
+    traces = st.obs.tracer.traces(name="control.action")
+    assert traces
+    assert traces[0]["spans"][0]["attrs"]["outcome"] == "fired"
+
+
+async def test_drain_actuator_picks_most_loaded_replica(aiohttp_client):
+    calls = []
+    stub = TestServer(_stub_replica_app(calls))
+    await stub.start_server()
+    try:
+        pol = mk_policy(name="kvp", action="drain_replica",
+                        signal=control.Signal(
+                            "serving_kv_evictions_total",
+                            {"cause": "pressure"}, mode="rate",
+                            reduce="sum"))
+        client, st, reg = await _router_with(aiohttp_client, [pol])
+        url = f"http://127.0.0.1:{stub.port}"
+        reg.register(url, replica_id="cold", max_slots=8)
+        reg.register(url, replica_id="hot", max_slots=8)
+        reg.heartbeat("hot", queue_depth=20, active_slots=8)
+
+        async def hot_signal(p):
+            return 99.0
+
+        st.controller.reader = hot_signal
+        recs = await st.controller.evaluate_once()
+        assert recs[0]["outcome"] == "fired"
+        assert recs[0]["evidence"]["result"]["replica"] == "hot"
+        assert reg.get("hot").state == DRAINING
+        # the forwarded drain carried the migrate peers
+        assert calls and calls[0][0] == "drain"
+        assert calls[0][1]["migrate"] is True
+    finally:
+        await stub.close()
+
+
+async def test_disable_draft_actuator_hits_every_replica(aiohttp_client):
+    calls = []
+    stub = TestServer(_stub_replica_app(calls))
+    await stub.start_server()
+    try:
+        pol = mk_policy(name="spec", action="disable_draft")
+        client, st, reg = await _router_with(aiohttp_client, [pol])
+        reg.register(f"http://127.0.0.1:{stub.port}", replica_id="r0")
+
+        async def hot_signal(p):
+            return 99.0
+
+        st.controller.reader = hot_signal
+        recs = await st.controller.evaluate_once()
+        assert recs[0]["outcome"] == "fired"
+        assert recs[0]["evidence"]["result"] == {
+            "replicas": {"r0": 200}, "enabled": False}
+        assert calls == [("spec", {"enabled": False})]
+    finally:
+        await stub.close()
+
+
+async def test_evict_worker_actuator_evicts_the_straggler(aiohttp_client):
+    from kubeflow_tpu.train.elastic import (
+        ElasticCoordinator,
+        create_coordinator_app,
+    )
+
+    coord = ElasticCoordinator(min_replicas=1)
+    coord.register("w0", step_seconds=1.0, step=5)
+    coord.register("w1", step_seconds=9.0, step=5)   # the straggler
+    gen0 = coord.world()["generation"]
+    csrv = TestServer(create_coordinator_app(coord))
+    await csrv.start_server()
+    try:
+        pol = mk_policy(name="strag", action="evict_worker",
+                        signal=control.Signal("train_straggler_ratio"))
+        client, st, reg = await _router_with(
+            aiohttp_client, [pol],
+            elastic_url=f"http://127.0.0.1:{csrv.port}")
+
+        async def hot_signal(p):
+            return 99.0
+
+        st.controller.reader = hot_signal
+        recs = await st.controller.evaluate_once()
+        assert recs[0]["outcome"] == "fired"
+        assert recs[0]["evidence"]["result"]["evicted"] == "w1"
+        world = coord.world()
+        assert world["members"] == ["w0"]
+        assert world["generation"] > gen0
+        # min_replicas floor: a second eviction is refused -> the
+        # actuator raises -> booked actuator_failed, loop survives
+        st.controller._state["strag"].latched = False
+        st.controller._state["strag"].cooldown_until = float("-inf")
+        recs = await st.controller.evaluate_once()
+        assert recs[0]["outcome"] == "actuator_failed"
+        assert st.controller.ledger.snapshot()["conserved"]
+    finally:
+        await csrv.close()
+
+
+def test_coordinator_evict_validates():
+    from kubeflow_tpu.train.elastic import ElasticCoordinator
+
+    coord = ElasticCoordinator(min_replicas=1)
+    coord.register("w0", step_seconds=1.0)
+    coord.register("w1", step_seconds=2.0)
+    with pytest.raises(KeyError):
+        coord.evict("ghost")
+    world = coord.evict("w1")
+    assert world["evicted"] == "w1" and world["members"] == ["w0"]
+    with pytest.raises(RuntimeError):
+        coord.evict("w0")   # would drop below min_replicas
+
+
+# -- /fleet/decisions round-trip --------------------------------------------
+
+
+async def test_fleet_decisions_roundtrip(aiohttp_client):
+    pol = control.Policy(
+        name="avail", threshold=1.0, clear=0.5, cooldown_s=60.0,
+        verify_window_s=60.0, action="scale_out",
+        signal=control.Signal(
+            "slo_burn_rate",
+            {"slo": "fleet_availability", "window": "short"},
+            source="local"))
+    client, st, reg = await _router_with(aiohttp_client, [pol])
+    reg.register("http://127.0.0.1:1", replica_id="a")
+    # healthy tick, then a breach tick
+    st.obs.slo.record("fleet_availability", True)
+    await st.controller.evaluate_once()
+    for _ in range(4):
+        st.obs.slo.record("fleet_availability", False)
+    await st.controller.evaluate_once()
+
+    body = await (await client.get("/fleet/decisions")).json()
+    assert body["conserved"] is True
+    assert body["evaluations"] == 2
+    assert body["outcomes"]["below_threshold"] == 1
+    assert body["outcomes"]["fired"] == 1
+    fired = [r for r in body["records"] if r["outcome"] == "fired"][0]
+    assert fired["action"] == "scale_out"
+    assert fired["verdict"] == "pending"
+    assert fired["evidence"]["signal"] > 1.0
+    desc = body["controller"]["policies"][0]
+    assert desc["name"] == "avail" and desc["latched"] is True
+    assert desc["cooldown_remaining_s"] > 0
+    # limit trims the audit trail, not the book
+    body = await (await client.get("/fleet/decisions?limit=1")).json()
+    assert len(body["records"]) == 1 and body["evaluations"] == 2
+
+
+async def test_decisions_served_without_policies(aiohttp_client):
+    client, st, reg = await _router_with(aiohttp_client, [])
+    body = await (await client.get("/fleet/decisions")).json()
+    assert body["conserved"] is True and body["evaluations"] == 0
+    assert body["controller"]["policies"] == []
+
+
+# -- metric surface ----------------------------------------------------------
+
+
+async def test_decision_metrics_zero_seeded_and_guarded(aiohttp_client):
+    pol = mk_policy(name="only")
+    client, st, reg = await _router_with(aiohttp_client, [pol])
+    text = await (await client.get("/metrics")).text()
+    fams = obs_lib.parse_exposition(text)
+    dec = fams["fleet_control_decisions_total"]["samples"]
+    for oc in OUTCOMES:
+        key = ("fleet_control_decisions_total",
+               (("outcome", oc), ("policy", "only")))
+        assert dec[key] == 0.0
+    act = fams["fleet_control_actions_total"]["samples"]
+    for a in control.ACTIONS:
+        key = ("fleet_control_actions_total",
+               (("action", a), ("policy", "only")))
+        assert act[key] == 0.0
+    # the budget-gauge satellite: remaining budget per router SLO
+    bud = fams["slo_error_budget_remaining"]["samples"]
+    assert bud[("slo_error_budget_remaining",
+                (("slo", "fleet_availability"),))] == 1.0
+    # closed guards: a rogue policy name collapses to the overflow
+    # bucket instead of minting a series
+    st.controller.ledger.note("rogue", "below_threshold")
+    assert st.obs.control_decisions.value(
+        policy=obs_lib.OVERFLOW_LABEL, outcome="below_threshold") == 1
+
+
+def test_budget_gauge_tracks_long_window_burn():
+    from kubeflow_tpu.controlplane.metrics import Registry
+
+    clk = FakeClock()
+    reg = Registry()
+    eng = obs_lib.get_or_create_slo_engine(
+        reg, [obs_lib.Slo("x", 0.9)], clock=clk)
+    text = reg.render()
+    fams = obs_lib.parse_exposition(text)
+    assert fams["slo_error_budget_remaining"]["samples"][
+        ("slo_error_budget_remaining", (("slo", "x"),))] == 1.0
+    # 2 bad / 10 events = 0.2 bad fraction / 0.1 budget = burn 2.0
+    for i in range(10):
+        eng.record("x", good=i >= 2)
+    fams = obs_lib.parse_exposition(reg.render())
+    assert fams["slo_error_budget_remaining"]["samples"][
+        ("slo_error_budget_remaining", (("slo", "x"),))] == pytest.approx(-1.0)
+    # idempotent re-registration through the helper
+    eng2 = obs_lib.get_or_create_slo_engine(
+        reg, [obs_lib.Slo("y", 0.5)], clock=clk)
+    assert eng2 is eng
+    obs_lib.parse_exposition(reg.render())  # still one family
