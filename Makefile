@@ -1,7 +1,7 @@
 # Developer entry points (the reference drives everything through
 # per-component Makefiles; here one root Makefile covers the repo).
 
-.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check profile-check serving-check fleet-check kernels-check tenancy-check chaos-check train-check train-obs-check disagg-check cache-check control-check
+.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check profile-check serving-check fleet-check kernels-check tenancy-check chaos-check train-check train-obs-check disagg-check cache-check control-check rollout-check
 
 verify-all:  ## the full evidence sweep, one command
 	python -m pytest tests -q -m "slow or not slow"
@@ -88,6 +88,13 @@ control-check: ## closed-loop control gate: hysteresis/ledger/actuator suite + d
 	JAX_PLATFORMS=cpu python -m pytest tests/test_control.py -q \
 	  -m "slow or not slow"
 	JAX_PLATFORMS=cpu python -m ci.obs_check control
+
+rollout-check: ## live-deployment gate: rollout suite + rollout-plane metrics contract + mid-flood roll/rollback loadtest
+	JAX_PLATFORMS=cpu python -m pytest tests/test_rollout.py -q \
+	  -m "slow or not slow"
+	JAX_PLATFORMS=cpu python -m ci.obs_check rollout
+	JAX_PLATFORMS=cpu python loadtest/serving_loadtest.py --mode rollout \
+	  --clients 8 --requests 24 --max-new 8
 
 tenancy-check: ## multi-tenant QoS gate: unit suite + noisy-neighbor A/B loadtest
 	JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q \

@@ -1200,6 +1200,217 @@ async def run_control_check() -> list[str]:
     return failures
 
 
+async def run_rollout_check() -> list[str]:
+    """Ninth act (ISSUE 18): the rollout plane's contract. Boot the
+    fleet router with the RolloutManager built but NOT ticking
+    (interval 0 — the act drives the state machine by hand with stub
+    replicas and stub drain/reload/probe fns, no jax, no sleeps), then
+    hold the deployment plane to its observability promises: the
+    fleet_rollout_* families zero-seeded over their closed phase and
+    outcome grids on the first scrape, a full publish -> canary ->
+    bake -> promote cycle booked and conserved in /fleet/rollouts, a
+    planted-bad second version auto-rolled-back on SLO burn with the
+    restore reload counted, the version label live on fleet_replicas
+    without disturbing the unlabeled totals, and every transition
+    leaving a rollout.phase span in /debug/traces."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu.fleet import rollout as rollout_mod
+    from kubeflow_tpu.fleet import router as router_mod
+
+    failures: list[str] = []
+    # bake window 0 + min_probes 1: one healthy probe promotes, one
+    # bad probe burns — the cycle runs on monotonic time, no sleeps
+    app = router_mod.create_router_app(
+        control_interval_s=0, rollout_interval_s=0,
+        rollout_bake_s=0.0, rollout_min_probes=1)
+    client = TestClient(TestServer(app))
+    try:
+        await client.start_server()
+        st = app[router_mod.FLEET_KEY]
+
+        resp = await client.get("/metrics")
+        try:
+            families = parse_exposition(await resp.text())
+        except ExpositionError as e:
+            return [f"/metrics failed strict parse: {e}"]
+
+        def sample(fams: dict, fam: str, sname: str, **labels):
+            f = fams.get(fam)
+            if f is None:
+                failures.append(f"missing family {fam}")
+                return None
+            key = (sname, tuple(sorted(labels.items())))
+            if key not in f["samples"]:
+                failures.append(f"missing sample {sname}{labels}")
+                return None
+            return f["samples"][key]
+
+        # -- the full phase/outcome grids exist at zero on the FIRST
+        # scrape — dashboards must never meet a hole
+        if sample(families, "fleet_rollout_published_total",
+                  "fleet_rollout_published_total") not in (0, None):
+            failures.append("fleet_rollout_published_total not "
+                            "zero-seeded")
+        for ph in rollout_mod.PHASES:
+            if sample(families, "fleet_rollout_transitions_total",
+                      "fleet_rollout_transitions_total",
+                      phase=ph) not in (0, None):
+                failures.append(f"transitions[{ph}] not zero-seeded")
+        for oc in rollout_mod.RELOAD_OUTCOMES:
+            if sample(families, "fleet_rollout_reloads_total",
+                      "fleet_rollout_reloads_total",
+                      outcome=oc) not in (0, None):
+                failures.append(f"reloads[{oc}] not zero-seeded")
+        if sample(families, "fleet_rollout_active",
+                  "fleet_rollout_active") not in (0, None):
+            failures.append("fleet_rollout_active should start 0")
+
+        book = await (await client.get("/fleet/rollouts")).json()
+        if book.get("conserved") is not True or book.get("started"):
+            failures.append(f"empty ledger not conserved: {book}")
+
+        # -- stub fleet + stub effectors: the state machine runs for
+        # real, the I/O boundary is faked
+        st.registry.register("http://127.0.0.1:1", replica_id="s0",
+                             models=["m"])
+        st.registry.register("http://127.0.0.1:2", replica_id="s1",
+                             models=["m"])
+        probe_result = {"res": (0.01, True)}
+        reloads: list[tuple[str, str]] = []
+
+        async def _drain(rid):
+            return None
+
+        async def _reload(rep, entry):
+            reloads.append((rep.id, entry["version"]))
+            st.registry.heartbeat(rep.id, version=entry["version"])
+            return True
+
+        async def _probe(rep):
+            return probe_result["res"]
+
+        st.rollout.drain_fn = _drain
+        st.rollout.reload_fn = _reload
+        st.rollout.probe_fn = _probe
+
+        # -- good cycle: publish step-1, drive to completed
+        resp = await client.post(
+            "/fleet/versions",
+            json={"version": "step-1", "model": "m", "step": 1,
+                  "source": {"checkpoint": "/ckpt", "step": 1}})
+        if resp.status != 200 or not (await resp.json()).get(
+                "published"):
+            return failures + [f"publish refused: {resp.status}"]
+        for _ in range(20):
+            await st.rollout.step()
+            if st.rollout_ledger.phase_of("step-1") == "completed":
+                break
+        else:
+            return failures + [
+                f"step-1 never completed "
+                f"(phase={st.rollout_ledger.phase_of('step-1')})"]
+
+        # -- bad cycle: probes burn the canary SLO, must roll back and
+        # restore the touched replica to step-1
+        probe_result["res"] = (5.0, False)
+        resp = await client.post(
+            "/fleet/versions",
+            json={"version": "step-2-bad", "model": "m", "step": 2,
+                  "source": {"checkpoint": "/ckpt", "step": 2}})
+        if resp.status != 200:
+            return failures + [f"bad publish -> {resp.status}"]
+        for _ in range(20):
+            await st.rollout.step()
+            if st.rollout_ledger.phase_of("step-2-bad") \
+                    == "rolled_back":
+                break
+        else:
+            return failures + [
+                f"step-2-bad never rolled back "
+                f"(phase={st.rollout_ledger.phase_of('step-2-bad')})"]
+
+        book = await (await client.get("/fleet/rollouts")).json()
+        if book.get("conserved") is not True:
+            failures.append(f"ledger not conserved: {book}")
+        hist = (book.get("rollouts", {}).get("step-1") or {}) \
+            .get("history")
+        if hist != ["published", "canarying", "baking", "promoting",
+                    "completed"]:
+            failures.append(f"step-1 history wrong: {hist}")
+        hist = (book.get("rollouts", {}).get("step-2-bad") or {}) \
+            .get("history")
+        if hist != ["published", "canarying", "baking", "rolled_back"]:
+            failures.append(f"step-2-bad history wrong: {hist}")
+        burn_rec = next(
+            (r for r in book.get("records", [])
+             if r.get("version") == "step-2-bad"
+             and r.get("phase") == "rolled_back"), None)
+        if burn_rec is None \
+                or burn_rec["evidence"].get("reason") != "slo_burn":
+            failures.append(
+                f"rollback not booked with slo_burn evidence: "
+                f"{burn_rec}")
+        if book.get("manager", {}).get("current") != "step-1":
+            failures.append(
+                f"current should stay step-1 after the rollback: "
+                f"{book.get('manager')}")
+        if book.get("active") != 0:
+            failures.append(f"no rollout should stay active: {book}")
+        # the bad canary was restored: its LAST reload is back to
+        # step-1 (canary -> bad, restore -> step-1)
+        if not reloads or reloads[-1][1] != "step-1":
+            failures.append(f"touched replica not restored: {reloads}")
+
+        # -- the counters and the version label moved with the cycle
+        families = parse_exposition(
+            await (await client.get("/metrics")).text())
+        if sample(families, "fleet_rollout_published_total",
+                  "fleet_rollout_published_total") != 2:
+            failures.append("published_total should count 2 versions")
+        for ph, want in (("completed", 1), ("rolled_back", 1),
+                         ("published", 2), ("canarying", 2)):
+            if sample(families, "fleet_rollout_transitions_total",
+                      "fleet_rollout_transitions_total",
+                      phase=ph) != want:
+                failures.append(f"transitions[{ph}] != {want}")
+        if sample(families, "fleet_rollout_reloads_total",
+                  "fleet_rollout_reloads_total",
+                  outcome="ok") != len(reloads):
+            failures.append(
+                f"reloads[ok] should count all {len(reloads)} "
+                "stub reloads")
+        if sample(families, "fleet_rollout_active",
+                  "fleet_rollout_active") != 0:
+            failures.append("fleet_rollout_active should end 0")
+        # both stub replicas ended back on step-1: the versioned
+        # fleet_replicas series shows it, the unlabeled total is
+        # undisturbed (PR 13 parallel-series pattern)
+        if sample(families, "fleet_replicas", "fleet_replicas",
+                  state="ready", pool="mixed") != 2:
+            failures.append(
+                "version-blind fleet_replicas[ready,mixed] != 2")
+        if sample(families, "fleet_replicas", "fleet_replicas",
+                  state="ready", version="step-1") != 2:
+            failures.append(
+                "fleet_replicas[ready,version=step-1] != 2")
+
+        # -- every transition left a rollout.phase span
+        traces = await (await client.get(
+            "/debug/traces?name=rollout.phase&format=summary")).json()
+        spans = [s for t in traces.get("traces", [])
+                 for s in t.get("spans", [])]
+        booked = sum(1 for s in spans
+                     if s.get("name") == "rollout.phase")
+        if booked != book["transitions"]:
+            failures.append(
+                f"want one rollout.phase span per transition "
+                f"({book['transitions']}), got {booked}")
+    finally:
+        await client.close()
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
     """Default: all seven acts. `python -m ci.obs_check profile` runs
     only the serving step-anatomy act (`make profile-check`); it and
@@ -1219,6 +1430,7 @@ def main(argv: list[str] | None = None) -> int:
         "disagg": run_disagg_check,
         "cache": run_cache_check,
         "control": run_control_check,
+        "rollout": run_rollout_check,
     }
     wanted = argv or list(acts)
     unknown = [a for a in wanted if a not in acts]
@@ -1246,7 +1458,10 @@ def main(argv: list[str] | None = None) -> int:
           "(cause counters == wall) with per-worker trace tracks, "
           "and the decision plane zero-seeds its policy x "
           "outcome/action grids with the /fleet/decisions ledger "
-          "conserved and the fired action auditable end to end")
+          "conserved and the fired action auditable end to end, "
+          "and the rollout plane zero-seeds its phase/outcome grids "
+          "with /fleet/rollouts conserved across a promote and an "
+          "SLO-burn rollback")
     return 0
 
 

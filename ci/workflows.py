@@ -672,6 +672,50 @@ def control_check_workflow() -> dict:
     }
 
 
+def rollout_check_workflow() -> dict:
+    """Live-deployment gate (ISSUE 18): `make rollout-check` runs the
+    rollout suite (version-registry round-trip, ledger conservation,
+    canary promote/rollback state machines on a fake clock, the
+    /v1/reload drain-then-swap token parity on a live replica, the
+    chief's publish hook), the rollout-plane metrics contract
+    (fleet_rollout_* grids zero-seeded, /fleet/rollouts conserved
+    across a promote and an SLO-burn rollback), and the mid-flood
+    loadtest: a 4-replica fleet rolls a weight update under
+    continuous traffic with zero client failures and byte-exact
+    tokens, then a deliberately-bad version auto-rolls-back on
+    canary SLO burn."""
+    return {
+        "name": "rollout check",
+        "on": {
+            "pull_request": {"paths": ["kubeflow_tpu/fleet/**",
+                                       "kubeflow_tpu/obs/**",
+                                       "kubeflow_tpu/serving/**",
+                                       "kubeflow_tpu/train/elastic.py",
+                                       "kubeflow_tpu/train/checkpoint.py",
+                                       "loadtest/serving_loadtest.py",
+                                       "tests/test_rollout.py",
+                                       "ci/obs_check.py",
+                                       "Makefile"]},
+            "push": {"branches": ["main"]},
+        },
+        "jobs": {
+            "rollout-check": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e .[ci] pytest"},
+                    {"name": "rollout suite + metrics contract + "
+                             "mid-flood roll/rollback loadtest",
+                     "run": "make rollout-check",
+                     "env": {"JAX_PLATFORMS": "cpu"}},
+                ],
+            }
+        },
+    }
+
+
 def tenancy_check_workflow() -> dict:
     """Multi-tenant QoS gate: `make tenancy-check` runs the tenancy
     unit suite (fair-share math, preemption token-identity, prefix
@@ -807,6 +851,7 @@ def all_workflows() -> dict[str, dict]:
     out["disagg_check.yaml"] = disagg_check_workflow()
     out["cache_check.yaml"] = cache_check_workflow()
     out["control_check.yaml"] = control_check_workflow()
+    out["rollout_check.yaml"] = rollout_check_workflow()
     out["tenancy_check.yaml"] = tenancy_check_workflow()
     out["kernels_check.yaml"] = kernels_check_workflow()
     out["profile_check.yaml"] = profile_check_workflow()

@@ -216,6 +216,14 @@ class ModelServerSpec:
     # tools/prepare_data.py output), "none" = byte fallback forced,
     # else an explicit tokenizer file path/URL for text mode
     tokenizer: str = "auto"
+    # Rollout plane (ISSUE 18): the model version label the pods BOOT
+    # with ("" = unversioned). Live rollouts do not go through the
+    # CRD — the RolloutManager reloads running replicas in place — but
+    # the kubeflow-tpu.dev/model-version annotation (which overrides
+    # this field) lets whatever consumes /fleet/versions pin the
+    # version new/restarted pods come up on, so a pod restart during a
+    # completed rollout does not resurrect the old weights' label.
+    model_version: str = ""
     tpu: TpuSpec = field(default_factory=TpuSpec)
 
 

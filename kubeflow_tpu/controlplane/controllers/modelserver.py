@@ -89,6 +89,12 @@ DESIRED_DECODE_ANNOTATION = "kubeflow-tpu.dev/desired-decode-replicas"
 # Module constant so tests shrink the window instead of sleeping.
 DRAIN_ANNOTATION = "kubeflow-tpu.dev/draining-since"
 DRAIN_GRACE_S = 2.0
+# Rollout handshake (ISSUE 18): whatever consumes the fleet router's
+# /fleet/versions registry (the promoted `current` version) writes it
+# here; the rendered pods boot with `--model-version <value>` so a
+# restarted replica re-registers under the promoted label instead of
+# the stale spec default. Annotation wins over spec.model_version.
+MODEL_VERSION_ANNOTATION = "kubeflow-tpu.dev/model-version"
 
 
 class ModelServerController(Controller):
@@ -406,6 +412,14 @@ class ModelServerController(Controller):
             args += ["--tokenizer", spec.tokenizer]
         if pool:
             args += ["--pool", pool]
+        # model-version label (ISSUE 18): the annotation (written by
+        # the rollout consumer after a promote) overrides the spec
+        # default, so restarted pods re-register under the PROMOTED
+        # version instead of resurrecting a stale label
+        version = ms.metadata.annotations.get(
+            MODEL_VERSION_ANNOTATION, "") or spec.model_version
+        if version:
+            args += ["--model-version", version]
 
         container = Container(
             name=child_name or name,

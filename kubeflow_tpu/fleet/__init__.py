@@ -18,6 +18,15 @@ existing actuators (autoscale floor bumps, drain/migrate, elastic
 eviction, draft disable), with every evaluation booked into the
 conservation-checked decision ledger served at `/fleet/decisions`.
 
+The rollout plane (`rollout.py`, ISSUE 18) closes the train→serve
+loop: the elastic chief publishes each COMMITTED checkpoint to the
+`VersionRegistry` (`POST /fleet/versions`), and the `RolloutManager`
+canaries it on one drained replica, bakes it against version-labelled
+TTFT/error SLOs, then rolls the fleet replica-by-replica — migrating
+in-flight KV first, rolling back automatically on burn — with every
+phase transition booked in the conservation-checked `RolloutLedger`
+served at `/fleet/rollouts`.
+
 Import discipline: `registry`, `autoscale` and `control`'s math half
 are pure Python (the control plane imports `autoscale` and must stay
 jax-free; `control` only imports aiohttp lazily inside the router
@@ -42,6 +51,13 @@ from kubeflow_tpu.fleet.control import (
     Signal,
     default_policies,
 )
+from kubeflow_tpu.fleet.rollout import (
+    PHASES,
+    RolloutLedger,
+    RolloutManager,
+    VersionRegistry,
+    valid_version,
+)
 
 __all__ = [
     "ACTIONS",
@@ -49,13 +65,18 @@ __all__ = [
     "DEAD",
     "DEGRADED",
     "DRAINING",
+    "PHASES",
     "Policy",
     "READY",
     "Recommendation",
     "Replica",
     "ReplicaRegistry",
+    "RolloutLedger",
+    "RolloutManager",
     "Signal",
+    "VersionRegistry",
     "default_policies",
     "recommend_replicas",
     "rendezvous",
+    "valid_version",
 ]

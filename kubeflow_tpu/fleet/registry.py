@@ -78,6 +78,10 @@ class Replica:
     # disaggregation role ("prefill" / "decode" / "mixed"): which pool
     # the router files this replica under when picking targets
     pool: str = MIXED
+    # model version the replica advertises in heartbeats ("" until it
+    # adopts one): the rollout plane's confirmation signal, and a
+    # LabelGuard-capped label on fleet_replicas / federated metrics
+    version: str = ""
     # heartbeat-reported routing/autoscale signals
     queue_depth: int = 0
     active_slots: int = 0
@@ -110,6 +114,7 @@ class Replica:
         return {
             "id": self.id, "url": self.url, "models": list(self.models),
             "state": self.state, "pool": self.pool,
+            "version": self.version,
             "phase_seconds": dict(self.phase_seconds),
             "queue_depth": self.queue_depth,
             "active_slots": self.active_slots,
@@ -211,6 +216,15 @@ class ReplicaRegistry:
         p = stats.get("pool")
         if isinstance(p, str) and p in POOLS:
             rep.pool = p
+        # version label: same charset/length contract as
+        # fleet.rollout.valid_version (not imported — rollout imports
+        # this module). Malformed values stay at the current version;
+        # "" is legal (a replica that never adopted one).
+        ver = stats.get("version")
+        if isinstance(ver, str) and len(ver) <= 64 and all(
+                ("a" <= c <= "z") or ("A" <= c <= "Z")
+                or ("0" <= c <= "9") or c in "._-" for c in ver):
+            rep.version = ver
         # cumulative phase seconds: keep only finite non-negative
         # numbers under string keys (fed straight to the pool
         # autoscaler, so garbage must die at the door)

@@ -1,0 +1,681 @@
+"""Live model rollout: trainer→fleet continuous deployment (ISSUE 18).
+
+The repo owns both halves of the train/serve loop, but until now they
+only met through a static `--checkpoint` path at boot. This module is
+the missing plane between them: the elastic chief publishes each
+COMMITTED checkpoint to a `VersionRegistry` (`POST /fleet/versions`),
+and a `RolloutManager` running in the router process rolls it across
+the fleet with the primitives the repo already has — drain/migrate
+(no in-flight sequence ever sees a reload), `/v1/reload` (drain-then-
+swap on the replica), version-labelled heartbeats, and the PR 6 SLO
+engine as the canary judge.
+
+State machine (one rollout per published version):
+
+    published ──> canarying ──> baking ──> promoting ──> completed
+                      │            │           │
+                      └────────────┴───────────┴──────> rolled_back
+
+  canarying  — one replica drained (in-flight KV migrated to peers),
+               reloaded to the candidate, waiting for it to re-register
+               with the new `version` label in its heartbeat
+  baking     — the canary serves real + probe traffic while the
+               manager's SloEngine watches version-labelled TTFT and
+               error events over a configurable bake window
+  promoting  — the bake held: remaining replicas reload one at a time,
+               each drained (KV migrated) first, so the flood never
+               sees a failure
+  rolled_back— the bake (or any reload) burned: every touched replica
+               is reloaded back to the prior version, best-effort
+  completed  — every live replica heartbeats the new version
+
+Every phase transition is booked as a first-class event in the
+conservation-checked `RolloutLedger` (the `DecisionLedger` discipline:
+no transition vanishes un-booked, none is double-counted; every
+rollout that starts ends active or terminal), served at
+`GET /fleet/rollouts` and fed into zero-seeded `fleet_rollout_*`
+metrics plus `rollout.phase` spans.
+
+Import discipline: pure Python — no aiohttp, no jax. The router
+injects the I/O (`drain_fn`/`reload_fn`/`probe_fn` async callables),
+which is also what makes the state machine drivable on a fake clock
+in tier-1 tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+from kubeflow_tpu import obs as obs_lib
+from kubeflow_tpu.fleet.registry import DEGRADED, READY, ReplicaRegistry
+
+log = logging.getLogger(__name__)
+
+# Closed set of rollout phases. These become the `phase` label on
+# `fleet_rollout_transitions_total`, so the set is CLOSED by design.
+PHASES = ("published", "canarying", "baking", "promoting",
+          "rolled_back", "completed")
+# A rollout whose newest phase is terminal is finished; anything else
+# is the (single) active rollout.
+TERMINAL_PHASES = ("rolled_back", "completed")
+
+# Closed outcome set for `fleet_rollout_reloads_total`.
+RELOAD_OUTCOMES = ("ok", "failed")
+
+# Version-entry lifecycle in the VersionRegistry (NOT a metric label —
+# the phase label above is the observable vocabulary).
+V_PENDING = "pending"        # published, not yet rolled
+V_ROLLING = "rolling"        # the active rollout's candidate
+V_LIVE = "live"              # promoted fleet-wide (current)
+V_ROLLED_BACK = "rolled_back"
+V_SUPERSEDED = "superseded"  # displaced by a newer publish/promote
+
+_MAX_RECORDS = 256
+_MAX_VERSIONS = 64
+
+_VERSION_CHARS = frozenset(
+    "abcdefghijklmnopqrstuvwxyz"
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-")
+
+
+def valid_version(v: Any) -> bool:
+    """Version names become metric labels and heartbeat fields, so
+    they are validated at every door with ONE predicate: 1..64 chars
+    from [A-Za-z0-9._-]. (`serving.server` and `fleet.registry` both
+    import this — the vocabulary may not drift.)"""
+    return (isinstance(v, str) and 0 < len(v) <= 64
+            and all(c in _VERSION_CHARS for c in v))
+
+
+class VersionRegistry:
+    """Ordered store of published model versions (the rollout queue).
+
+    The elastic chief POSTs each COMMITTED checkpoint here; entries
+    carry the opaque `source` spec a replica's `/v1/reload` consumes
+    (`{"checkpoint": dir, "step": n}` or `{"seed": n}`, plus the chaos
+    harness's optional `defect`). `current` is the fleet-wide live
+    version ("" until a rollout completes). Event-loop owned, like
+    `ReplicaRegistry` — no lock.
+    """
+
+    def __init__(self, *, max_versions: int = _MAX_VERSIONS,
+                 wall: Callable[[], float] = time.time):
+        self._wall = wall
+        self.max_versions = max_versions
+        self._entries: dict[str, dict] = {}  # insertion-ordered
+        self.current = ""
+        # Bound by the consuming layer (FleetObs.bind_rollout) to the
+        # fleet_rollout_published_total counter.
+        self.on_publish: Callable[[dict], None] | None = None
+
+    def publish(self, version: str, *, model: str = "",
+                source: dict | None = None,
+                step: int | None = None) -> tuple[dict, bool]:
+        """Register one version. Idempotent by name: re-publishing an
+        existing version returns `(entry, False)` untouched — the
+        chief re-announcing a checkpoint after a coordinator blip must
+        not restart a finished rollout. Returns `(entry, created)`."""
+        if not valid_version(version):
+            raise ValueError(
+                f"invalid version {version!r} (1..64 chars from "
+                "[A-Za-z0-9._-])")
+        existing = self._entries.get(version)
+        if existing is not None:
+            return existing, False
+        entry = {
+            "version": version,
+            "model": str(model or ""),
+            "source": dict(source or {}),
+            "step": int(step) if isinstance(step, int) else None,
+            "published_at": self._wall(),
+            "status": V_PENDING,
+        }
+        self._entries[version] = entry
+        # bounded: drop the OLDEST non-current entry past the cap
+        while len(self._entries) > self.max_versions:
+            for old in self._entries:
+                if old != self.current:
+                    del self._entries[old]
+                    break
+            else:  # pragma: no cover — cap >= 1 keeps current
+                break
+        if self.on_publish is not None:
+            try:
+                self.on_publish(entry)
+            except Exception:  # noqa: BLE001 — hooks never crash the door
+                pass
+        return entry, True
+
+    def get(self, version: str) -> dict | None:
+        return self._entries.get(version)
+
+    def entries(self) -> list[dict]:
+        return [dict(e) for e in self._entries.values()]
+
+    def latest_pending(self) -> dict | None:
+        """Newest pending entry — the rollout candidate. Older pending
+        entries are superseded by it (the trainer publishes every
+        committed save; only the newest is worth a bake window)."""
+        pending = [e for e in self._entries.values()
+                   if e["status"] == V_PENDING]
+        if not pending:
+            return None
+        for stale in pending[:-1]:
+            stale["status"] = V_SUPERSEDED
+        return pending[-1]
+
+    def set_current(self, version: str) -> None:
+        """Promote `version` to fleet-wide live; the previous current
+        entry (if tracked) becomes superseded."""
+        prev = self._entries.get(self.current)
+        if prev is not None and prev["status"] == V_LIVE:
+            prev["status"] = V_SUPERSEDED
+        self.current = version
+        entry = self._entries.get(version)
+        if entry is not None:
+            entry["status"] = V_LIVE
+
+    def snapshot(self) -> dict:
+        return {"current": self.current, "versions": self.entries()}
+
+
+class RolloutLedger:
+    """Conservation-checked phase accounting for rollouts.
+
+    The `DecisionLedger` discipline applied to deployment: every phase
+    transition is booked exactly once into a closed phase set, so
+
+        transitions == sum(phases over all phases)
+
+    and every rollout that ever published is either still active or
+    ended in exactly one terminal phase:
+
+        started == finished + active
+
+    Both equalities are asserted by tests and `ci/obs_check rollout`.
+    Hook exceptions are swallowed — the ledger must never crash the
+    rollout loop it audits.
+    """
+
+    def __init__(self, *, max_records: int = _MAX_RECORDS,
+                 wall: Callable[[], float] = time.time):
+        self._lock = threading.Lock()
+        self._wall = wall
+        self.transitions = 0
+        self.phases = {p: 0 for p in PHASES}
+        self.started = 0
+        self.finished = 0
+        # version -> ordered phase history (the audit spine)
+        self._rollouts: dict[str, list[str]] = {}
+        self._records: deque = deque(maxlen=max_records)
+        # Bound by the consuming layer to fleet_rollout_transitions_total.
+        self.on_phase: Callable[[str, str], None] | None = None
+
+    def note(self, version: str, phase: str, *,
+             evidence: dict | None = None) -> dict:
+        """Book one phase transition for `version`. Idempotence is the
+        CALLER's job (the manager's state machine transitions once);
+        the ledger's job is that whatever was booked is conserved."""
+        if phase not in PHASES:
+            raise ValueError(f"unknown rollout phase {phase!r}")
+        rec = {
+            "wall": self._wall(),
+            "version": version,
+            "phase": phase,
+            "evidence": dict(evidence or {}),
+        }
+        with self._lock:
+            history = self._rollouts.setdefault(version, [])
+            if phase == "published" and not history:
+                self.started += 1
+            if (phase in TERMINAL_PHASES
+                    and (not history
+                         or history[-1] not in TERMINAL_PHASES)):
+                self.finished += 1
+            history.append(phase)
+            self.transitions += 1
+            self.phases[phase] += 1
+            self._records.append(rec)
+        self._hook(self.on_phase, version, phase)
+        return rec
+
+    def phase_of(self, version: str) -> str | None:
+        with self._lock:
+            history = self._rollouts.get(version)
+            return history[-1] if history else None
+
+    def verdict(self, version: str) -> str:
+        """Terminal phase of `version`'s rollout, or "active"/"unknown"
+        — what the loadtest asserts against `/fleet/rollouts`."""
+        phase = self.phase_of(version)
+        if phase is None:
+            return "unknown"
+        return phase if phase in TERMINAL_PHASES else "active"
+
+    @property
+    def active(self) -> int:
+        with self._lock:
+            return sum(
+                1 for h in self._rollouts.values()
+                if h and h[-1] not in TERMINAL_PHASES
+                and "published" in h)
+
+    @property
+    def conserved(self) -> bool:
+        with self._lock:
+            by_history = sum(
+                1 for h in self._rollouts.values()
+                if h and h[-1] not in TERMINAL_PHASES
+                and "published" in h)
+            return (self.transitions == sum(self.phases.values())
+                    and self.started == self.finished + by_history)
+
+    def records(self, limit: int | None = None) -> list[dict]:
+        with self._lock:
+            recs = [dict(r) for r in self._records]
+        return recs[-limit:] if limit else recs
+
+    def snapshot(self) -> dict:
+        """Jsonable summary for `GET /fleet/rollouts`."""
+        with self._lock:
+            active = sum(
+                1 for h in self._rollouts.values()
+                if h and h[-1] not in TERMINAL_PHASES
+                and "published" in h)
+            return {
+                "transitions": self.transitions,
+                "phases": dict(self.phases),
+                "started": self.started,
+                "finished": self.finished,
+                "active": active,
+                "rollouts": {v: {"history": list(h),
+                                 "phase": h[-1] if h else None}
+                             for v, h in self._rollouts.items()},
+                "conserved": (
+                    self.transitions == sum(self.phases.values())
+                    and self.started == self.finished + active),
+            }
+
+    @staticmethod
+    def _hook(fn, *args) -> None:
+        if fn is None:
+            return
+        try:
+            fn(*args)
+        except Exception:  # noqa: BLE001 — swallowed by contract
+            pass
+
+
+def rollout_slos(*, ttft_threshold_s: float = 1.5,
+                 ttft_objective: float = 0.95,
+                 error_objective: float = 0.99) -> list:
+    """The canary judge's objectives: version-labelled TTFT (threshold
+    SLO over probe + routed latencies attributed to the candidate) and
+    error rate. One definition site — the manager and the router's
+    shared-registry wiring must agree."""
+    return [
+        obs_lib.Slo("rollout_canary_ttft", ttft_objective,
+                    threshold_s=ttft_threshold_s,
+                    description="canary answers under the TTFT "
+                                "threshold during the bake window"),
+        obs_lib.Slo("rollout_canary_errors", error_objective,
+                    description="canary answers without a 5xx during "
+                                "the bake window"),
+    ]
+
+
+class RolloutManager:
+    """Canary → bake → promote state machine over the replica fleet.
+
+    Runs in the router process beside the `Controller`; the router
+    injects the three I/O callables so this module stays pure:
+
+      drain_fn(replica_id) -> awaitable     (drain_and_migrate: mark
+            draining + push in-flight KV to peers — the flood never
+            sees a reload)
+      reload_fn(replica, entry) -> awaitable bool   (POST /v1/reload
+            with the entry's source spec; True = swap confirmed)
+      probe_fn(replica) -> awaitable (seconds, ok) | None   (one
+            direct canary generate — the active half of the judge;
+            passive version-labelled routed traffic feeds in through
+            `observe_request`)
+
+    `step()` advances the machine by at most one phase action and is
+    the unit tests and `ci/obs_check rollout` drive on a fake clock;
+    `run()` is the router's background loop around it.
+    """
+
+    def __init__(self, registry: ReplicaRegistry,
+                 versions: VersionRegistry, ledger: RolloutLedger, *,
+                 drain_fn=None, reload_fn=None, probe_fn=None,
+                 slo_engine=None,
+                 bake_window_s: float = 30.0,
+                 bake_min_probes: int = 4,
+                 burn_threshold: float = 2.0,
+                 ttft_threshold_s: float = 1.5,
+                 confirm_timeout_s: float = 60.0,
+                 interval_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 tracer=None,
+                 on_reload: Callable[[str], None] | None = None):
+        self.registry = registry
+        self.versions = versions
+        self.ledger = ledger
+        self.drain_fn = drain_fn
+        self.reload_fn = reload_fn
+        self.probe_fn = probe_fn
+        self.bake_window_s = float(bake_window_s)
+        self.bake_min_probes = int(bake_min_probes)
+        self.burn_threshold = float(burn_threshold)
+        self.confirm_timeout_s = float(confirm_timeout_s)
+        self.interval_s = float(interval_s)
+        self.clock = clock
+        self.tracer = tracer if tracer is not None else obs_lib.Tracer()
+        self.on_reload = on_reload
+        self.slo = slo_engine if slo_engine is not None else \
+            obs_lib.SloEngine(
+                rollout_slos(ttft_threshold_s=ttft_threshold_s),
+                short_window_s=max(bake_window_s, 1.0),
+                long_window_s=max(bake_window_s, 1.0) * 10,
+                clock=clock)
+        for slo in rollout_slos(ttft_threshold_s=ttft_threshold_s):
+            self.slo.add(slo)  # shared engines merge; first def wins
+        # manual knob: while pinned, no NEW rollout starts (an active
+        # one finishes its course) — the operator's change freeze
+        self.pinned = False
+        self._rollback_requested = ""
+        # the single active rollout, or None
+        self.active: dict | None = None
+
+    # -- feed side (router's _routed_generate) ---------------------------
+
+    def observe_request(self, version: str, seconds: float,
+                        ok: bool) -> None:
+        """Passive judge feed: one routed generate answered by a
+        replica heartbeating `version`. Only the active candidate's
+        events count (the judge compares the candidate against its SLO
+        objectives, not against other versions). Never throws."""
+        try:
+            act = self.active
+            if act is None or version != act["version"]:
+                return
+            if act["phase"] not in ("canarying", "baking", "promoting"):
+                return
+            self.slo.observe("rollout_canary_ttft", seconds)
+            self.slo.record("rollout_canary_errors", ok)
+            act["observed"] += 1
+        except Exception:  # noqa: BLE001 — feeders never crash routing
+            pass
+
+    # -- manual knobs (POST /fleet/rollouts) -----------------------------
+
+    def request_rollback(self, reason: str = "manual") -> bool:
+        """Abort the active rollout on the next step. Returns whether
+        there was one to abort."""
+        if self.active is None:
+            return False
+        self._rollback_requested = reason or "manual"
+        return True
+
+    def pin(self, pinned: bool = True) -> None:
+        self.pinned = bool(pinned)
+
+    # -- state machine ----------------------------------------------------
+
+    async def step(self) -> None:
+        """Advance by at most one phase action. Safe to call with no
+        replicas, no pending versions, or mid-rollout."""
+        if self.active is None:
+            if self.pinned:
+                return
+            entry = self.versions.latest_pending()
+            if entry is not None:
+                await self._start(entry)
+            return
+        if self._rollback_requested:
+            reason, self._rollback_requested = \
+                self._rollback_requested, ""
+            await self._rollback(reason)
+            return
+        phase = self.active["phase"]
+        if phase == "canarying":
+            await self._step_canarying()
+        elif phase == "baking":
+            await self._step_baking()
+        elif phase == "promoting":
+            await self._step_promoting()
+
+    async def run(self) -> None:
+        """Background loop for the router process (cancelled on app
+        cleanup). Exceptions are logged, never fatal — a rollout plane
+        that can crash the router would be worse than no rollouts."""
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                await self.step()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                log.exception("rollout step failed")
+
+    def _transition(self, phase: str, **evidence) -> None:
+        act = self.active
+        version = act["version"] if act else evidence.get("version", "")
+        with self.tracer.span("rollout.phase", version=version,
+                              phase=phase):
+            self.ledger.note(version, phase, evidence=evidence)
+        if act is not None:
+            act["phase"] = phase
+            act["t_phase"] = self.clock()
+        log.info("rollout %s -> %s %s", version, phase, evidence or "")
+
+    def _live_replicas(self) -> list:
+        return [r for r in self.registry.replicas()
+                if r.state in (READY, DEGRADED)]
+
+    async def _reload_replica(self, rep, entry) -> bool:
+        """Drain-then-reload one replica: migrate its in-flight KV to
+        peers, then POST the version's source spec to /v1/reload. The
+        outcome feeds fleet_rollout_reloads_total either way."""
+        ok = False
+        try:
+            if self.drain_fn is not None:
+                await self.drain_fn(rep.id)
+            if self.reload_fn is not None:
+                ok = bool(await self.reload_fn(rep, entry))
+        except Exception as e:  # noqa: BLE001 — a dead replica is a failed reload
+            log.warning("rollout: reload of %s to %s failed: %s",
+                        rep.id, entry["version"], e)
+            ok = False
+        self._hook_reload("ok" if ok else "failed")
+        if ok:
+            self.active["touched"].append(rep.id)
+        return ok
+
+    def _hook_reload(self, outcome: str) -> None:
+        if self.on_reload is None:
+            return
+        try:
+            self.on_reload(outcome)
+        except Exception:  # noqa: BLE001 — swallowed by contract
+            pass
+
+    async def _start(self, entry: dict) -> None:
+        candidates = [r for r in self._live_replicas()
+                      if r.version != entry["version"]]
+        if not candidates:
+            return  # nothing to roll onto yet; stay pending
+        # least-loaded canary: draining it strands the fewest sequences
+        canary = min(candidates, key=lambda r: (r.load(), r.id))
+        prior = self.versions.current
+        entry["status"] = V_ROLLING
+        self.active = {
+            "version": entry["version"],
+            "prior": prior,
+            "phase": "published",
+            "canary": canary.id,
+            "touched": [],
+            "observed": 0,
+            "probes": 0,
+            "t_phase": self.clock(),
+            "t_start": self.clock(),
+        }
+        # the "published" booking opens this rollout in the ledger
+        # (started++) — conservation needs the open BEFORE any
+        # terminal phase can close it
+        self._transition("published", model=entry.get("model", ""),
+                         step=entry.get("step"))
+        self._transition("canarying", canary=canary.id, prior=prior)
+        if not await self._reload_replica(canary, entry):
+            await self._rollback("canary_reload_failed")
+
+    def _confirmed(self, rid: str) -> bool:
+        rep = self.registry.get(rid)
+        return (rep is not None
+                and rep.version == self.active["version"]
+                and rep.state in (READY, DEGRADED))
+
+    async def _step_canarying(self) -> None:
+        act = self.active
+        if self._confirmed(act["canary"]):
+            self._transition("baking", canary=act["canary"])
+            return
+        if self.clock() - act["t_phase"] > self.confirm_timeout_s:
+            await self._rollback("canary_confirm_timeout")
+
+    def _burn(self) -> float:
+        rates = self.slo.burn_rates()
+        return max(rates.get(("rollout_canary_ttft", "short"), 0.0),
+                   rates.get(("rollout_canary_errors", "short"), 0.0))
+
+    async def _probe_canary(self) -> None:
+        act = self.active
+        rep = self.registry.get(act["canary"])
+        if self.probe_fn is None or rep is None:
+            return
+        try:
+            res = await self.probe_fn(rep)
+        except Exception:  # noqa: BLE001 — a probe that died is a bad event
+            res = (self.confirm_timeout_s, False)
+        if res is None:
+            return
+        seconds, ok = res
+        self.slo.observe("rollout_canary_ttft", float(seconds))
+        self.slo.record("rollout_canary_errors", bool(ok))
+        act["probes"] += 1
+
+    async def _step_baking(self) -> None:
+        act = self.active
+        await self._probe_canary()
+        samples = act["probes"] + act["observed"]
+        burn = self._burn()
+        if samples >= self.bake_min_probes \
+                and burn >= self.burn_threshold:
+            await self._rollback("slo_burn", burn=round(burn, 3),
+                                 samples=samples)
+            return
+        if (self.clock() - act["t_phase"] >= self.bake_window_s
+                and samples >= self.bake_min_probes):
+            self._transition("promoting", burn=round(burn, 3),
+                             samples=samples)
+
+    async def _step_promoting(self) -> None:
+        act = self.active
+        entry = self.versions.get(act["version"])
+        if entry is None:  # pragma: no cover — entries outlive rollouts
+            await self._rollback("version_vanished")
+            return
+        burn = self._burn()
+        if burn >= self.burn_threshold:
+            await self._rollback("slo_burn_during_promote",
+                                 burn=round(burn, 3))
+            return
+        remaining = [r for r in self._live_replicas()
+                     if r.version != act["version"]]
+        todo = [r for r in remaining if r.id not in act["touched"]]
+        if todo:
+            # one replica per step: the fleet loses at most one
+            # replica's capacity at a time, exactly like the canary
+            target = min(todo, key=lambda r: (r.load(), r.id))
+            if not await self._reload_replica(target, entry):
+                await self._rollback("reload_failed",
+                                     replica=target.id)
+            return
+        if not remaining:
+            self.versions.set_current(act["version"])
+            self._transition("completed",
+                             replicas=len(self._live_replicas()))
+            self.active = None
+            return
+        # every remaining replica was reloaded but has not re-
+        # registered with the new version yet: wait, bounded
+        if self.clock() - act["t_phase"] > \
+                self.confirm_timeout_s + self.bake_window_s:
+            await self._rollback("promote_confirm_timeout")
+
+    async def _rollback(self, reason: str, **evidence) -> None:
+        act = self.active
+        entry = self.versions.get(act["version"])
+        if entry is not None:
+            entry["status"] = V_ROLLED_BACK
+        self._transition("rolled_back", reason=reason,
+                         prior=act["prior"], touched=len(act["touched"]),
+                         **evidence)
+        prior_entry = self.versions.get(act["prior"])
+        if prior_entry is not None and prior_entry.get("source"):
+            # restore every touched replica to the prior version,
+            # best-effort (a replica that will not come back is the
+            # registry's problem, not the rollout's)
+            for rid in list(act["touched"]):
+                rep = self.registry.get(rid)
+                if rep is None:
+                    continue
+                try:
+                    if self.drain_fn is not None:
+                        await self.drain_fn(rid)
+                    if self.reload_fn is not None:
+                        restored = bool(
+                            await self.reload_fn(rep, prior_entry))
+                        self._hook_reload(
+                            "ok" if restored else "failed")
+                except Exception:  # noqa: BLE001 — best-effort by contract
+                    self._hook_reload("failed")
+        else:
+            log.warning(
+                "rollout %s rolled back but prior version %r has no "
+                "reloadable source — touched replicas keep the bad "
+                "weights until the next publish", act["version"],
+                act["prior"])
+        self.active = None
+
+    # -- read side ---------------------------------------------------------
+
+    def describe(self) -> dict:
+        """Jsonable live state for `GET /fleet/rollouts`."""
+        act = None
+        if self.active is not None:
+            act = {k: self.active[k]
+                   for k in ("version", "prior", "phase", "canary",
+                             "touched", "probes", "observed")}
+            act["phase_age_s"] = round(
+                self.clock() - self.active["t_phase"], 3)
+        burn = self.slo.burn_rates()
+        return {
+            "active": act,
+            "pinned": self.pinned,
+            "current": self.versions.current,
+            "config": {
+                "bake_window_s": self.bake_window_s,
+                "bake_min_probes": self.bake_min_probes,
+                "burn_threshold": self.burn_threshold,
+                "confirm_timeout_s": self.confirm_timeout_s,
+            },
+            "burn": {f"{name}/{window}": round(v, 4)
+                     for (name, window), v in sorted(burn.items())
+                     if name.startswith("rollout_")},
+        }

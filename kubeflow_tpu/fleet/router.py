@@ -40,12 +40,14 @@ from aiohttp import web
 from kubeflow_tpu import obs as obs_lib
 from kubeflow_tpu.fleet import autoscale
 from kubeflow_tpu.fleet import control as control_mod
+from kubeflow_tpu.fleet import rollout as rollout_mod
 from kubeflow_tpu.fleet.registry import (
     DECODE,
     DEGRADED,
     POOLS,
     PREFILL,
     READY,
+    STATES,
     ReplicaRegistry,
 )
 from kubeflow_tpu.obs import endpoints as obs_endpoints
@@ -292,6 +294,40 @@ class FleetObs:
             seed=obs_lib.DECISION_OUTCOMES, closed=True)
         self.control_action_guard = obs_lib.LabelGuard(
             seed=control_mod.ACTIONS, closed=True)
+        # Rollout plane (ISSUE 18): the RolloutLedger's hooks feed
+        # these; the full closed phase/outcome grids are zero-seeded
+        # below so every series exists on the first scrape.
+        self.rollout_published = Counter(
+            "fleet_rollout_published_total",
+            "Model versions published to the registry by the trainer "
+            "(POST /fleet/versions; idempotent re-publishes excluded)",
+            self.registry)
+        self.rollout_transitions = Counter(
+            "fleet_rollout_transitions_total",
+            "Rollout phase transitions — every one lands in exactly "
+            "one of published / canarying / baking / promoting / "
+            "rolled_back / completed (ledger conservation)",
+            self.registry)
+        self.rollout_reloads = Counter(
+            "fleet_rollout_reloads_total",
+            "Replica weight reloads dispatched by the RolloutManager "
+            "(canary, promote wave, and rollback restores), by outcome",
+            self.registry)
+        self.rollout_active_g = Gauge(
+            "fleet_rollout_active",
+            "Rollouts currently in a non-terminal phase (0 or 1: the "
+            "manager runs one rollout at a time)", self.registry)
+        self.rollout_phase_guard = obs_lib.LabelGuard(
+            seed=rollout_mod.PHASES, closed=True)
+        self.rollout_outcome_guard = obs_lib.LabelGuard(
+            seed=rollout_mod.RELOAD_OUTCOMES, closed=True)
+        # Version label values come from TRAFFIC (the trainer mints
+        # one per committed checkpoint), so the guard stays open but
+        # capped — the parallel version-labelled fleet_replicas series
+        # cannot outgrow it.
+        self.version_guard = obs_lib.LabelGuard()
+        # bound by bind_rollout; collect() reads it for the gauge
+        self.rollout_ledger = None
         circuit_g = Gauge(
             "fleet_circuit_open",
             "1 while the replica's circuit breaker is open (skipped by "
@@ -306,6 +342,12 @@ class FleetObs:
         self.failover.inc(0)
         self.handoff_bytes.inc(0)
         self.remote_hits.inc(0)
+        self.rollout_published.inc(0)
+        for _ph in rollout_mod.PHASES:
+            self.rollout_transitions.inc(0, phase=_ph)
+        for _oc in rollout_mod.RELOAD_OUTCOMES:
+            self.rollout_reloads.inc(0, outcome=_oc)
+        self.rollout_active_g.set(0)
         for _oc in ("ok", "skipped", "failed"):
             self.handoff_seconds.seed(outcome=_oc)
 
@@ -315,9 +357,27 @@ class FleetObs:
                 for state, nn in states.items():
                     replicas_g.set(nn, state=state,
                                    pool=self.pool_guard.admit(_pool))
+            # Parallel version-labelled series in the SAME family
+            # (ISSUE 18, the PR 13 tenant pattern): the unlabeled
+            # {state, pool} totals above are untouched; {state,
+            # version} series ride beside them, guard-capped. Every
+            # known (state, version) cell is written each scrape so a
+            # version that left the fleet drops to 0 instead of
+            # freezing at its last count.
+            by_ver: dict[tuple, int] = {}
+            for rep in reg.replicas():
+                ver = self.version_guard.admit(rep.version or "none")
+                by_ver[(rep.state, ver)] = \
+                    by_ver.get((rep.state, ver), 0) + 1
+            for ver in self.version_guard.known():
+                for state in STATES:
+                    replicas_g.set(by_ver.get((state, ver), 0),
+                                   state=state, version=ver)
             for rep in reg.replicas():
                 circuit_g.set(int(reg.circuit_open(rep.id)),
                               replica=self.replica_guard.admit(rep.id))
+            if self.rollout_ledger is not None:
+                self.rollout_active_g.set(self.rollout_ledger.active)
 
         self.registry.register_collector(collect)
 
@@ -350,6 +410,26 @@ class FleetObs:
         ledger.on_action = lambda p, act: self.control_actions.inc(
             policy=self.control_policy_guard.admit(p),
             action=self.control_action_guard.admit(act))
+
+    def bind_rollout(self, versions, ledger) -> None:
+        """Wire the rollout plane into the `fleet_rollout_*` counters:
+        the VersionRegistry's publish hook and the RolloutLedger's
+        phase hook feed the (already zero-seeded) series, and collect()
+        starts reading the ledger for the active-rollout gauge. Version
+        names pass the open-but-capped version guard before becoming
+        label values."""
+        versions.on_publish = lambda entry: (
+            self.rollout_published.inc(),
+            self.version_guard.admit(entry.get("version", "") or "none"),
+        )
+        ledger.on_phase = lambda v, ph: self.rollout_transitions.inc(
+            phase=self.rollout_phase_guard.admit(ph))
+        self.rollout_ledger = ledger
+
+    def note_reload(self, outcome: str) -> None:
+        """One RolloutManager-dispatched replica reload by outcome."""
+        self.rollout_reloads.inc(
+            outcome=self.rollout_outcome_guard.admit(outcome))
 
 
 class _FleetState:
@@ -406,6 +486,15 @@ class _FleetState:
         self.control_task: asyncio.Task | None = None
         self.control_floor = 0
         self.control_floor_until = float("-inf")
+        # Rollout plane (ISSUE 18): version registry, conservation-
+        # checked phase ledger, manager + its background task. Always
+        # constructed by create_router_app (like the controller) so
+        # /fleet/versions and /fleet/rollouts answer even when the
+        # background loop is off.
+        self.versions: rollout_mod.VersionRegistry | None = None
+        self.rollout_ledger: rollout_mod.RolloutLedger | None = None
+        self.rollout: rollout_mod.RolloutManager | None = None
+        self.rollout_task: asyncio.Task | None = None
 
     def ingest_checkpoints(self, replica_id: str, cks) -> None:
         """Fold one heartbeat's sequence checkpoints into the store
@@ -896,6 +985,11 @@ async def _routed_generate(request: web.Request):
             st.obs.route_latency.observe(dt, model=name, reason=reason)
             st.obs.slo.observe("fleet_route_latency", dt)
             st.obs.slo.record("fleet_availability", status < 500)
+            if st.rollout is not None:
+                # passive canary feed: latency/status attributed to the
+                # answering replica's version (never throws)
+                st.rollout.observe_request(rep.version, dt,
+                                           status < 500)
             span.attrs.update(replica=rep.id, reason=reason,
                               hedge_won=hedge_won, status=status)
             if trace:
@@ -1112,7 +1206,8 @@ async def _register(request: web.Request):
         **{k: v for k, v in body.items()
            if k in ("queue_depth", "active_slots", "max_slots",
                     "kv_blocks_free", "kv_blocks_total",
-                    "pool", "phase_seconds", "cache_digest")})
+                    "pool", "phase_seconds", "cache_digest",
+                    "version")})
     st.ingest_checkpoints(rep.id, body.get("checkpoints"))
     log.info("fleet: registered replica %s at %s", rep.id, rep.url)
     return web.json_response({"id": rep.id, "state": rep.state})
@@ -1137,7 +1232,8 @@ async def _heartbeat(request: web.Request):
         k: v for k, v in body.items()
         if k in ("queue_depth", "active_slots", "max_slots",
                  "kv_blocks_free", "kv_blocks_total", "draining",
-                 "pool", "phase_seconds", "cache_digest")})
+                 "pool", "phase_seconds", "cache_digest",
+                 "version")})
     if not ok:
         # unknown id: the router restarted and lost its table — 404
         # tells the replica to re-register (server.py's beat loop does)
@@ -1193,7 +1289,13 @@ async def drain_and_migrate(st: _FleetState, rid: str, *,
     if rep is None:
         raise KeyError(f"unknown replica {rid!r}")
     st.registry.drain(rid)
-    peers = sorted(st.registry.routable({rid}),
+    # migrated KV describes the SOURCE replica's weights — mid-rollout,
+    # landing it on a peer serving a different version would finish the
+    # generation with the wrong model. Only same-version peers qualify;
+    # with none (the last replica of a version to roll), the reload's
+    # admission-stopped grace wait finishes in-flight work in place.
+    peers = sorted((r for r in st.registry.routable({rid})
+                    if r.version == rep.version),
                    key=lambda r: (r.load(), r.id))
     migrate = bool(peers) and migrate
     payload = ({"migrate": True, "peers": [r.url for r in peers]}
@@ -1379,7 +1481,11 @@ async def _fleet_metrics(request: web.Request):
     double-count once an external Prometheus scrapes both."""
     st: _FleetState = request.app[FLEET_KEY]
     scrapes = await _scrape_replicas(st, "/metrics", as_json=False)
-    text = obs_lib.federate(dict(scrapes), guard=st.obs.replica_guard)
+    versions = {rep.id: rep.version
+                for rep in st.registry.replicas() if rep.version}
+    text = obs_lib.federate(dict(scrapes), guard=st.obs.replica_guard,
+                            versions=versions,
+                            version_guard=st.obs.version_guard)
     return web.Response(text=text, content_type="text/plain")
 
 
@@ -1429,6 +1535,100 @@ async def _decisions(request: web.Request):
         "records": ctl.ledger.records(limit),
         "controller": ctl.describe(),
     })
+
+
+async def _publish_version(request: web.Request):
+    """POST /fleet/versions — the trainer's publish door (ISSUE 18):
+    the elastic chief announces each COMMITTED checkpoint here
+    (`{"version": "step-12", "model": ..., "step": 12, "source":
+    {"checkpoint": dir, "step": 12}}`). Idempotent by version name —
+    a chief re-announcing after a coordinator blip must not restart a
+    finished rollout. The RolloutManager picks the newest pending
+    entry up on its next tick."""
+    st: _FleetState = request.app[FLEET_KEY]
+    try:
+        body = await request.json()
+    except Exception:
+        return web.json_response({"error": "invalid JSON"}, status=400)
+    if not isinstance(body, dict):
+        return web.json_response({"error": "body must be an object"},
+                                 status=400)
+    version = body.get("version", "")
+    if not rollout_mod.valid_version(version):
+        return web.json_response(
+            {"error": "version must be 1..64 chars of [A-Za-z0-9._-]"},
+            status=400)
+    source = body.get("source")
+    if source is not None and not isinstance(source, dict):
+        return web.json_response({"error": "source must be an object"},
+                                 status=400)
+    step = body.get("step")
+    entry, created = st.versions.publish(
+        version, model=str(body.get("model", "") or ""),
+        source=source,
+        step=step if isinstance(step, int)
+        and not isinstance(step, bool) else None)
+    if created:
+        log.info("fleet: version %s published (model=%s step=%s)",
+                 version, entry["model"], entry["step"])
+    return web.json_response({"published": created, "entry": entry,
+                              "current": st.versions.current})
+
+
+async def _versions(request: web.Request):
+    """GET /fleet/versions — the version registry: every published
+    entry with its lifecycle status, plus the fleet-wide current."""
+    st: _FleetState = request.app[FLEET_KEY]
+    return web.json_response(st.versions.snapshot())
+
+
+async def _rollouts(request: web.Request):
+    """GET /fleet/rollouts[?limit=N] — the rollout plane's audit book:
+    the conservation-checked phase ledger (every transition booked to
+    exactly one phase; every started rollout active or terminal), the
+    bounded transition records, and the manager's live state (active
+    rollout, burn rates, knobs)."""
+    st: _FleetState = request.app[FLEET_KEY]
+    q = request.rel_url.query
+    try:
+        limit = int(q.get("limit", 0)) or None
+    except ValueError:
+        return web.json_response({"error": "bad limit"}, status=400)
+    return web.json_response({
+        **st.rollout_ledger.snapshot(),
+        "records": st.rollout_ledger.records(limit),
+        "manager": st.rollout.describe(),
+    })
+
+
+async def _rollout_control(request: web.Request):
+    """POST /fleet/rollouts — the operator's manual knobs:
+    `{"pin": true}` freezes new rollouts (an active one finishes its
+    course), `{"pin": false}` unfreezes, `{"rollback": true, "reason":
+    "..."}` aborts the active rollout on the manager's next tick."""
+    st: _FleetState = request.app[FLEET_KEY]
+    try:
+        body = await request.json()
+    except Exception:
+        return web.json_response({"error": "invalid JSON"}, status=400)
+    if not isinstance(body, dict):
+        return web.json_response({"error": "body must be an object"},
+                                 status=400)
+    out = {}
+    if "pin" in body:
+        if not isinstance(body["pin"], bool):
+            return web.json_response({"error": "pin must be boolean"},
+                                     status=400)
+        st.rollout.pin(body["pin"])
+        out["pinned"] = st.rollout.pinned
+    if body.get("rollback"):
+        out["rollback_requested"] = st.rollout.request_rollback(
+            str(body.get("reason", "manual")))
+    if not out:
+        return web.json_response(
+            {"error": "body needs 'pin' and/or 'rollback'"},
+            status=400)
+    return web.json_response(out)
 
 
 async def _healthz(request: web.Request):
@@ -1483,7 +1683,14 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
                       chaos=None,
                       policies=None,
                       control_interval_s: float = 2.0,
-                      elastic_url: str | None = None) -> web.Application:
+                      elastic_url: str | None = None,
+                      rollout_interval_s: float = 1.0,
+                      rollout_bake_s: float = 30.0,
+                      rollout_min_probes: int = 4,
+                      rollout_burn_threshold: float = 2.0,
+                      rollout_ttft_slo_s: float = 1.5,
+                      rollout_confirm_timeout_s: float = 60.0,
+                      ) -> web.Application:
     """Build the router app. `block_size` must match the replicas'
     `kv_block_size` (the affinity key is the first block — a mismatch
     only costs cache hits, never correctness). `policy` is "affinity"
@@ -1504,7 +1711,18 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
     against the federated metrics view and fires the built-in
     actuators (see `control.router_actuators`; `elastic_url` points
     `evict_worker` at an elastic coordinator). With or without
-    policies, `/fleet/decisions` serves the decision ledger."""
+    policies, `/fleet/decisions` serves the decision ledger.
+    The rollout plane (ISSUE 18) is always mounted: the trainer
+    publishes versions at `POST /fleet/versions` and a `RolloutManager`
+    canaries each one on a single replica, bakes it for
+    `rollout_bake_s` seconds (at least `rollout_min_probes` judged
+    events), and rolls or rolls back on its SLO burn vs
+    `rollout_burn_threshold`; `rollout_ttft_slo_s` is the canary TTFT
+    threshold and `rollout_confirm_timeout_s` bounds how long a
+    reloaded replica may take to re-register with the new version
+    label. `rollout_interval_s <= 0` disables the background loop
+    (tests and `ci/obs_check rollout` drive `step()` by hand);
+    `/fleet/rollouts` serves the phase ledger either way."""
     if policy not in ("affinity", "roundrobin"):
         raise ValueError(f"unknown policy {policy!r}")
     if block_size < 1:
@@ -1535,6 +1753,69 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
             st, elastic_url=elastic_url, clock=reg.clock),
         interval_s=control_interval_s, clock=reg.clock,
         tracer=obs.tracer)
+    # Rollout plane (ISSUE 18): registry + ledger + manager, always
+    # constructed (like the controller) so the /fleet/versions and
+    # /fleet/rollouts doors answer even with the loop disabled. The
+    # three injected callables are the ONLY I/O the manager does.
+    st.versions = rollout_mod.VersionRegistry()
+    st.rollout_ledger = rollout_mod.RolloutLedger()
+    obs.bind_rollout(st.versions, st.rollout_ledger)
+
+    async def _rollout_drain(rid: str) -> None:
+        # same path the operator's POST /fleet/drain and the
+        # controller's drain_replica actuator fire: mark draining +
+        # migrate in-flight KV to peers, so the reload never aborts a
+        # client's generation
+        await drain_and_migrate(st, rid)
+
+    async def _rollout_reload(rep, entry) -> bool:
+        payload: dict = {"version": entry["version"],
+                         "source": dict(entry["source"])}
+        if entry.get("model"):
+            payload["model"] = entry["model"]
+        # the chaos harness publishes deliberately-bad versions by
+        # tucking a defect into the source; it rides to the replica as
+        # the /v1/reload defect field (reload resets any previous one)
+        if isinstance(entry["source"].get("defect"), dict):
+            payload["defect"] = entry["source"]["defect"]
+        try:
+            async with st.session.post(
+                    f"{rep.url}/v1/reload", json=payload,
+                    timeout=aiohttp.ClientTimeout(total=120)) as r:
+                return r.status == 200
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            return False
+
+    async def _rollout_probe(rep):
+        # active canary judge: one tiny direct generate against the
+        # canary. Direct on purpose — the router's retry/hedge shell
+        # would mask a failing canary by answering from a healthy
+        # replica, which is exactly the blind spot a canary exists to
+        # not have.
+        models = rep.models or ["llama-tiny"]
+        t0 = time.perf_counter()
+        try:
+            async with st.session.post(
+                    f"{rep.url}/v1/models/{models[0]}:generate",
+                    json={"tokens": [[1]], "max_new": 1},
+                    timeout=aiohttp.ClientTimeout(
+                        total=max(5.0, 4 * rollout_ttft_slo_s))) as r:
+                await r.read()
+                return time.perf_counter() - t0, r.status < 500
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            return time.perf_counter() - t0, False
+
+    st.rollout = rollout_mod.RolloutManager(
+        reg, st.versions, st.rollout_ledger,
+        drain_fn=_rollout_drain, reload_fn=_rollout_reload,
+        probe_fn=_rollout_probe,
+        bake_window_s=rollout_bake_s,
+        bake_min_probes=rollout_min_probes,
+        burn_threshold=rollout_burn_threshold,
+        ttft_threshold_s=rollout_ttft_slo_s,
+        confirm_timeout_s=rollout_confirm_timeout_s,
+        interval_s=rollout_interval_s, clock=reg.clock,
+        tracer=obs.tracer, on_reload=obs.note_reload)
     app = web.Application(middlewares=[_router_obs_middleware])
     app[FLEET_KEY] = st
 
@@ -1542,15 +1823,19 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
         st.session = aiohttp.ClientSession()
         if pols and control_interval_s > 0:
             st.control_task = asyncio.create_task(st.controller.run())
+        if rollout_interval_s > 0:
+            st.rollout_task = asyncio.create_task(st.rollout.run())
 
     async def _stop(app_):
-        if st.control_task is not None:
-            st.control_task.cancel()
-            try:
-                await st.control_task
-            except asyncio.CancelledError:
-                pass
-            st.control_task = None
+        for task_attr in ("control_task", "rollout_task"):
+            task = getattr(st, task_attr)
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+                setattr(st, task_attr, None)
         if st.session is not None:
             await st.session.close()
 
@@ -1572,6 +1857,10 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
     app.router.add_get("/fleet/replicas", _replicas)
     app.router.add_get("/fleet/autoscale", _autoscale)
     app.router.add_get("/fleet/decisions", _decisions)
+    app.router.add_get("/fleet/versions", _versions)
+    app.router.add_post("/fleet/versions", _publish_version)
+    app.router.add_get("/fleet/rollouts", _rollouts)
+    app.router.add_post("/fleet/rollouts", _rollout_control)
     app.router.add_get("/fleet/stats", _stats)
     app.router.add_get("/fleet/cache", _fleet_cache)
     app.router.add_get("/v1/models", _proxied_models)

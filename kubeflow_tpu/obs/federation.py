@@ -111,11 +111,21 @@ def merge_families(expositions: list[dict[str, dict]]) -> dict[str, dict]:
 
 
 def federate(scrapes: dict[str, str | None],
-             guard: LabelGuard | None = None) -> str:
+             guard: LabelGuard | None = None,
+             versions: dict[str, str] | None = None,
+             version_guard: LabelGuard | None = None) -> str:
     """Scrape texts keyed by replica id (None = unreachable) -> one
     merged exposition text. Replicas whose text fails the strict parse
-    are treated as down rather than poisoning the merge."""
+    are treated as down rather than poisoning the merge. `versions`
+    (replica id -> model-version label, ISSUE 18) adds PARALLEL
+    `fleet_federation_up{replica,version}` series beside the plain
+    `{replica}` ones — same family, unlabeled-by-version totals
+    untouched (the PR 13 pattern) — so one federated scrape says which
+    weights each covered replica was serving; values pass
+    `version_guard` (capped) before becoming labels."""
     guard = guard or LabelGuard()
+    versions = versions or {}
+    version_guard = version_guard or LabelGuard()
     parsed: list[dict[str, dict]] = []
     up: dict[str, float] = {}
     for rid, text in scrapes.items():
@@ -130,13 +140,27 @@ def federate(scrapes: dict[str, str | None],
             continue
         up[label] = max(up.get(label, 1.0), 1.0)
     merged = merge_families(parsed)
+    samples = {
+        ("fleet_federation_up", (("replica", label),)): v
+        for label, v in up.items()
+    }
+    # version-labelled parallel series (never replaces the plain ones)
+    ver_by_label = {guard.admit(rid): v
+                    for rid, v in versions.items() if v}
+    for label, v in up.items():
+        ver = ver_by_label.get(label)
+        if ver:
+            key = ("fleet_federation_up",
+                   tuple(sorted((("replica", label),
+                                 ("version",
+                                  version_guard.admit(ver))))))
+            samples[key] = max(samples.get(key, 0.0), v)
     merged["fleet_federation_up"] = {
         "type": "gauge",
         "help": "1 if the replica's /metrics was scraped and strictly "
-                "parsed into this federation, 0 otherwise",
-        "samples": {
-            ("fleet_federation_up", (("replica", label),)): v
-            for label, v in up.items()
-        },
+                "parsed into this federation, 0 otherwise; "
+                "version-labelled series say which model version the "
+                "covered replica was serving",
+        "samples": samples,
     }
     return render_families(merged)

@@ -74,6 +74,60 @@ def _load_params(args, init1):
     return restored[STATE_ITEM]["params"]
 
 
+def _make_reloader(init_fn, cfg, quant: str):
+    """Build the /v1/reload weight materializer for this process: a
+    checkpoint source goes through the same Orbax partial-restore path
+    as boot (plus the boot-time quantization, so a reload can't
+    silently de-quantize a server started with --quant); a seed source
+    (`{"seed": N}`) re-initializes — the loadtest/chaos path that
+    needs distinguishable weights without writing checkpoints."""
+    def _reload(name, engine, source):
+        import jax
+
+        if "seed" in source:
+            params = init_fn(jax.random.key(int(source["seed"])), cfg)
+        else:
+            ckpt_dir = source.get("checkpoint", "")
+            if not ckpt_dir:
+                raise ValueError(
+                    "reload source needs 'checkpoint' or 'seed'")
+            import orbax.checkpoint as ocp
+
+            from kubeflow_tpu.train.checkpoint import STATE_ITEM
+
+            # boot's _load_params shape: abstract from init_fn (NOT
+            # engine.params, which may be int8-quantized already),
+            # params subtree only, pinned to source["step"] when given
+            mgr = ocp.CheckpointManager(ckpt_dir,
+                                        item_names=(STATE_ITEM,))
+            try:
+                step = source.get("step")
+                if not isinstance(step, int):
+                    step = mgr.latest_step()
+                if step is None:
+                    raise ValueError(
+                        f"no checkpoint under {ckpt_dir!r}")
+                abstract = jax.eval_shape(
+                    lambda k: init_fn(k, cfg),
+                    jax.ShapeDtypeStruct((2,), "uint32"))
+                restored = mgr.restore(
+                    step, args=ocp.args.Composite(**{
+                        STATE_ITEM: ocp.args.PyTreeRestore(
+                            {"params": abstract},
+                            partial_restore=True),
+                    }))
+            finally:
+                mgr.close()
+            params = restored[STATE_ITEM]["params"]
+        if quant == "int8":
+            from kubeflow_tpu.serving.quant import quantize_blocks
+
+            params = quantize_blocks(params)
+        return params
+
+    return _reload
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m kubeflow_tpu.serving")
     p.add_argument("--model", default="llama-tiny", choices=MODEL_NAMES)
@@ -157,6 +211,11 @@ def main(argv=None) -> int:
     p.add_argument("--advertise", default="",
                    help="URL the fleet router should reach this "
                         "replica at (default http://HOST:PORT)")
+    p.add_argument("--model-version", default="",
+                   help="model version label this replica boots with "
+                        "(rides in fleet heartbeats; POST /v1/reload "
+                        "updates it live — the rollout plane's "
+                        "confirmation signal, ISSUE 18)")
     args = p.parse_args(argv)
     if not args.checkpoint and not args.random:
         p.error("pass --checkpoint DIR or --random")
@@ -271,6 +330,8 @@ def main(argv=None) -> int:
         drain_grace_s=args.drain_grace_s,
         tenancy=tenancy,
         pool=args.pool,
+        model_version=args.model_version,
+        reloader=_make_reloader(init_fn, cfg, args.quant),
     )
     if args.fleet_router:
         enable_fleet_registration(

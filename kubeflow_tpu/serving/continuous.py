@@ -3094,8 +3094,22 @@ class ContinuousBatcher:
 
     def begin_drain(self) -> None:
         """Stop admission (new `_enqueue` calls raise) while in-flight
-        requests keep decoding to completion. Sticky until close()."""
+        requests keep decoding to completion. Sticky until close() or
+        end_drain()."""
         self._draining = True
+
+    def end_drain(self) -> None:
+        """Re-open admission after a completed drain. The reload path
+        (`POST /v1/reload`) drains to zero, swaps weights, then calls
+        this — a drain is only terminal when close() follows it."""
+        self._draining = False
+
+    def flush_cache(self) -> None:
+        """Invalidate the radix prefix cache: after a weight swap every
+        cached KV block describes activations of a model that no longer
+        exists. Only safe at in_flight() == 0 — active sequences hold
+        refs the clear would strand."""
+        self._radix.clear(cause="refdrop")
 
     async def drain(self, timeout: float | None = None) -> bool:
         """Stop admission and wait for in-flight work to finish.

@@ -82,6 +82,13 @@ DRAIN_KEY: web.AppKey = web.AppKey("drain_state", dict)
 FLEET_REG_KEY: web.AppKey = web.AppKey("fleet_registration", dict)
 TENANCY_KEY: web.AppKey = web.AppKey("tenancy", object)  # TenancyConfig|None
 POOL_KEY: web.AppKey = web.AppKey("pool_role", str)  # disagg role
+# Live-rollout plane (ISSUE 18): the version this replica advertises in
+# fleet heartbeats, the injected weight-reloader callable (None → Orbax
+# checkpoint restore), and the chaos-defect dict the loadtest's bad-
+# version arm plants via /v1/reload to force an SLO burn.
+MODEL_VERSION_KEY: web.AppKey = web.AppKey("model_version", str)
+RELOADER_KEY: web.AppKey = web.AppKey("weight_reloader", object)
+DEFECT_KEY: web.AppKey = web.AppKey("reload_defect", dict)
 
 # Disaggregation roles (mirrors fleet.registry.POOLS — the serving
 # side must stay importable without the fleet package and vice versa)
@@ -454,8 +461,14 @@ class Batcher:
         return self._queue.qsize() + len(self._inflight)
 
     def begin_drain(self) -> None:
-        """Stop admission; queued work still runs. Sticky until close()."""
+        """Stop admission; queued work still runs. Sticky until close()
+        or end_drain()."""
         self._draining = True
+
+    def end_drain(self) -> None:
+        """Re-open admission after a completed drain (the /v1/reload
+        drain-swap-resume cycle; a drain is only terminal with close)."""
+        self._draining = False
 
     async def drain(self, timeout: float | None = None) -> bool:
         """Stop admission and wait for admitted work to resolve. Same
@@ -621,6 +634,8 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                        slo_ttft_s: dict[str, float] | None = None,
                        slo_spec_acceptance: float | None = None,
                        pool: str = "mixed",
+                       model_version: str = "",
+                       reloader=None,
                        ) -> web.Application:
     """`tokenizer` (data.bpe.Tokenizer or anything with encode/decode)
     serves the "text" request mode; without one, the zero-training
@@ -676,7 +691,14 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     pool and hands the filled KV blocks to decode replicas over
     `/v1/migrate/in`. The role changes ROUTING, not capability —
     either specialized replica can still serve a full generation, so
-    pool imbalance degrades to symmetric behavior instead of 503s."""
+    pool imbalance degrades to symmetric behavior instead of 503s.
+    `model_version` names the weights this replica boots with; it rides
+    in fleet heartbeats (the rollout plane's confirmation signal) and
+    is updated by `POST /v1/reload`. `reloader` is an optional
+    `fn(name, engine, source) -> params` callable /v1/reload uses to
+    materialize new weights (tests and the loadtest inject seed-based
+    reloaders); without one, reload restores `source["checkpoint"]`
+    via Orbax."""
     if pool not in POOL_ROLES:
         raise ValueError(
             f"pool must be one of {POOL_ROLES}, got {pool!r}")
@@ -687,6 +709,9 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     app = web.Application(middlewares=[_obs_middleware])
     app[POOL_KEY] = pool
     app[DRAIN_KEY] = {"draining": False, "grace_s": float(drain_grace_s)}
+    app[MODEL_VERSION_KEY] = str(model_version or "")
+    app[RELOADER_KEY] = reloader
+    app[DEFECT_KEY] = {}
     sobs = ServingObs(registry=registry, tracer=tracer,
                       slo_ttft_s=slo_ttft_s,
                       slo_spec_acceptance=slo_spec_acceptance)
@@ -1065,6 +1090,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     app.router.add_get("/debug/profile", debug_profile)
     app.router.add_post("/drain", drain_endpoint)
     app.router.add_post("/v1/migrate/in", migrate_in)
+    app.router.add_post("/v1/reload", reload_weights)
     app.router.add_get("/v1/models", list_models)
     app.router.add_get("/v1/requests/{id}/timeline", request_timeline)
     app.router.add_post("/v1/models/{name}:generate", generate)
@@ -1129,6 +1155,9 @@ def fleet_stats(app: web.Application) -> dict:
         "kv_blocks_total": kv_total,
         "draining": app[DRAIN_KEY]["draining"],
         "pool": app.get(POOL_KEY, "mixed"),
+        # the rollout plane's confirmation signal: the RolloutManager
+        # watches this label flip after a /v1/reload before promoting
+        "version": app.get(MODEL_VERSION_KEY, ""),
         "phase_seconds": {"prefill": round(phase_prefill, 6),
                           "decode": round(phase_decode, 6)},
         # top-K hashed prefix heat (ISSUE 13): the router merges these
@@ -1282,6 +1311,208 @@ async def migrate_in(request: web.Request):
            if isinstance(record, dict) else "")
     return web.json_response(
         {"imported": True, "blocks": blocks, "request_id": rid})
+
+
+# Mirrors fleet.rollout.valid_version — the serving side must stay
+# importable without the fleet package (same pact as POOL_ROLES).
+_VERSION_CHARS = frozenset(
+    "abcdefghijklmnopqrstuvwxyz"
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-")
+
+
+def _valid_version(v: Any) -> bool:
+    return (isinstance(v, str) and 0 < len(v) <= 64
+            and all(c in _VERSION_CHARS for c in v))
+
+
+def _params_mismatch(old, new) -> str:
+    """Structural compatibility check before a weight swap: same
+    treedef, same leaf shapes and dtypes. The compiled decode/prefill
+    functions are shape-specialized on the param tree — swapping in a
+    differently-shaped tree would either retrace everything or crash
+    mid-decode, so a mismatch rejects the reload with the old weights
+    still live. Returns "" when compatible, else the reason."""
+    import jax
+
+    old_leaves, old_def = jax.tree.flatten(old)
+    new_leaves, new_def = jax.tree.flatten(new)
+    if old_def != new_def:
+        return ("parameter tree structure differs from the live "
+                "model's")
+    for i, (o, n) in enumerate(zip(old_leaves, new_leaves)):
+        o_shape = getattr(o, "shape", None)
+        n_shape = getattr(n, "shape", None)
+        o_dtype = getattr(o, "dtype", None)
+        n_dtype = getattr(n, "dtype", None)
+        if o_shape != n_shape or o_dtype != n_dtype:
+            return (f"leaf {i}: incoming {n_shape}/{n_dtype} vs live "
+                    f"{o_shape}/{o_dtype}")
+    return ""
+
+
+def _default_reloader(name: str, engine: InferenceEngine,
+                      source: dict):
+    """Materialize replacement params from a version's source spec —
+    the same Orbax partial-restore path `python -m kubeflow_tpu.serving
+    --checkpoint` boots from (params subtree only; pulling the Adam
+    moments through disk to throw away would double the IO). Runs in
+    an executor thread: restore is blocking IO. Deployments with other
+    weight sources (seed-init tests, the loadtest) inject their own
+    `reloader=` instead."""
+    ckpt_dir = source.get("checkpoint", "")
+    if not ckpt_dir:
+        raise ValueError(
+            "reload source needs a 'checkpoint' directory (no "
+            "custom reloader is installed on this replica)")
+    import jax
+    import orbax.checkpoint as ocp
+
+    from kubeflow_tpu.train.checkpoint import STATE_ITEM
+
+    mgr = ocp.CheckpointManager(ckpt_dir, item_names=(STATE_ITEM,))
+    try:
+        step = source.get("step")
+        if not isinstance(step, int):
+            step = mgr.latest_step()
+        if step is None:
+            raise ValueError(f"no committed checkpoint under "
+                             f"{ckpt_dir!r}")
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            engine.params)
+        restored = mgr.restore(step, args=ocp.args.Composite(**{
+            STATE_ITEM: ocp.args.PyTreeRestore(
+                {"params": abstract}, partial_restore=True),
+        }))
+    finally:
+        mgr.close()
+    return restored[STATE_ITEM]["params"]
+
+
+def _resume_admission(app: web.Application, draining: bool) -> None:
+    """Undo a reload's drain: re-open every batcher and restore the
+    door flag (a replica that was ALREADY draining when the reload
+    arrived stays draining)."""
+    for b in app[BATCHERS_KEY].values():
+        b.end_drain()
+    app[DRAIN_KEY]["draining"] = draining
+
+
+async def reload_weights(request: web.Request):
+    """POST /v1/reload — drain-then-swap live weight reload (the
+    rollout plane's replica-side primitive, ISSUE 18). Body:
+
+        {"version": "step-12",           # required, [A-Za-z0-9._-]{1,64}
+         "model": "llama-tiny",          # optional when one model served
+         "source": {"checkpoint": dir,   # what to load — consumed by the
+                    "step": 12},         #   installed reloader
+         "defect": {"ttft_delay_s": 2}}  # optional chaos (bad-version arm)
+
+    Choreography: stop admission (drain door + every batcher), wait out
+    in-flight generations (grace-bounded — the ROUTER migrates KV off
+    the replica via /drain BEFORE calling this, so the wait is normally
+    zero), materialize the new params in an executor under the gpu
+    lock, verify tree/shape/dtype compatibility, swap `engine.params`,
+    invalidate the radix prefix cache (cached KV describes the old
+    weights), re-open admission, adopt the version label, and force a
+    fleet re-registration so the router sees the flip without waiting a
+    heartbeat period. Every failure path resumes admission with the OLD
+    weights — a failed reload must leave a serving replica, not a
+    drained one. A reload also RESETS any planted defect: rolling back
+    to the prior version heals the chaos arm by construction."""
+    app = request.app
+    try:
+        body: dict[str, Any] = await request.json()
+    except Exception:
+        return web.json_response({"error": "invalid JSON"}, status=400)
+    if not isinstance(body, dict):
+        return web.json_response({"error": "body must be an object"},
+                                 status=400)
+    version = body.get("version", "")
+    if not _valid_version(version):
+        return web.json_response(
+            {"error": "version must be 1..64 chars of [A-Za-z0-9._-]"},
+            status=400)
+    engines = app[ENGINES_KEY]
+    name = body.get("model", "")
+    if not name and len(engines) == 1:
+        name = next(iter(engines))
+    if name not in engines:
+        return web.json_response(
+            {"error": f"no model {name!r} (serving "
+                      f"{sorted(engines)})"}, status=404)
+    source = body.get("source")
+    if source is not None and not isinstance(source, dict):
+        return web.json_response({"error": "source must be an object"},
+                                 status=400)
+    defect = body.get("defect")
+    if defect is not None:
+        delay = defect.get("ttft_delay_s", 0.0) \
+            if isinstance(defect, dict) else None
+        if not isinstance(delay, (int, float)) \
+                or isinstance(delay, bool) or not 0 <= delay <= 30:
+            return web.json_response(
+                {"error": "defect.ttft_delay_s must be a number in "
+                          "[0, 30]"}, status=400)
+    engine = engines[name]
+    sobs: ServingObs = app[OBS_KEY]
+    was_draining = app[DRAIN_KEY]["draining"]
+    app[DRAIN_KEY]["draining"] = True
+    grace = app[DRAIN_KEY]["grace_s"]
+    batchers = app[BATCHERS_KEY]
+    for b in batchers.values():
+        b.begin_drain()
+    for b in batchers.values():
+        if not await b.drain(timeout=grace):
+            _resume_admission(app, was_draining)
+            return web.json_response(
+                {"error": f"drain timed out with {b.in_flight()} "
+                          "request(s) in flight; weights unchanged"},
+                status=409)
+    reloader = app[RELOADER_KEY] or _default_reloader
+    t0 = time.monotonic()
+    try:
+        with sobs.tracer.span("weights.reload", model=name,
+                              version=version):
+            async with app[GPU_LOCK_KEY]:
+                params = await asyncio.get_event_loop() \
+                    .run_in_executor(
+                        None, reloader, name, engine,
+                        dict(source or {}))
+            why = _params_mismatch(engine.params, params)
+            if why:
+                raise ValueError(f"incompatible weights: {why}")
+            engine.params = params
+            b = batchers.get(name)
+            if isinstance(b, ContinuousBatcher):
+                # in_flight()==0 here (drained above): safe to drop
+                # every cached block — they hold the OLD model's KV
+                b.flush_cache()
+    except ValueError as e:
+        _resume_admission(app, was_draining)
+        return web.json_response({"error": str(e)}, status=400)
+    except Exception as e:  # noqa: BLE001 — old weights stay live
+        _resume_admission(app, was_draining)
+        return web.json_response(
+            {"error": f"{type(e).__name__}: {e}"}, status=500)
+    _resume_admission(app, False)
+    app[MODEL_VERSION_KEY] = version
+    app[DEFECT_KEY].clear()
+    if isinstance(defect, dict):
+        app[DEFECT_KEY].update(defect)
+    # push the new version label to the fleet registry NOW — the
+    # RolloutManager's confirm step watches for it, and a heartbeat
+    # period of staleness would just slow every rollout phase down
+    reg_state = app.get(FLEET_REG_KEY)
+    register_fn = (reg_state or {}).get("register_fn")
+    if register_fn is not None:
+        try:
+            await register_fn()
+        except Exception:  # noqa: BLE001 — the beat loop will retry
+            pass
+    return web.json_response({
+        "reloaded": True, "model": name, "version": version,
+        "reload_s": round(time.monotonic() - t0, 3)})
 
 
 async def prefill_handoff(request: web.Request):
@@ -1749,6 +1980,11 @@ async def generate(request: web.Request):
     if engine is None:
         return web.json_response(
             {"error": f"no model {name!r}"}, status=404)
+    # Chaos defect planted by /v1/reload (the rollout loadtest's bad-
+    # version arm): a deliberate TTFT stall the canary judge must catch.
+    _delay = request.app[DEFECT_KEY].get("ttft_delay_s", 0.0)
+    if _delay:
+        await asyncio.sleep(float(_delay))
     # tenant identity is a HEADER, not a body field: proxies (the fleet
     # router) forward it without parsing the payload, and a gateway can
     # inject it from auth without rewriting bodies. Absent/unknown
@@ -2182,6 +2418,15 @@ def enable_fleet_registration(app: web.Application, router_url: str,
                 return r.status == 200
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
             return False
+
+    async def _register_now() -> bool:
+        # /v1/reload forces an immediate re-registration so the router
+        # sees the new version label without waiting a heartbeat period
+        if state["session"] is None:
+            return False
+        return await _register(app)
+
+    state["register_fn"] = _register_now
 
     async def _beat_loop(app_):
         while True:

@@ -133,7 +133,7 @@ class Checkpointer:
         # serializes saves anyway, so this barrier is ~free) — only THEN
         # may its COMMITTED marker appear.
         self._flush_commits()
-        step_dir = epath.Path(self.config.directory) / str(step)
+        step_dir = self.step_path(step)
         if step_dir.exists():
             if self._is_committed(step):
                 # Replaying up to an already-durable step (post-restore
@@ -172,8 +172,7 @@ class Checkpointer:
         return saved
 
     def _commit(self, step: int) -> None:
-        marker = (epath.Path(self.config.directory) / str(step)
-                  / COMMIT_MARKER)
+        marker = self.step_path(step) / COMMIT_MARKER
         if marker.parent.exists():
             marker.write_text(f"{step}\n")
 
@@ -206,9 +205,23 @@ class Checkpointer:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def step_path(self, step: int) -> epath.Path:
+        """The directory one step's checkpoint lives in — the ONE
+        derivation site for <dir>/<step> (save, commit markers, restore
+        side channels, and the rollout publish hook all go through
+        here)."""
+        return epath.Path(self.config.directory) / str(step)
+
+    def latest_committed_path(self) -> epath.Path | None:
+        """Directory of the newest COMMITTED step, or None before the
+        first durable save. What the elastic chief publishes to
+        `POST /fleet/versions` (ISSUE 18) and what resize-on-restore
+        inspects — never an uncommitted crash leftover."""
+        step = self.latest_committed_step()
+        return None if step is None else self.step_path(step)
+
     def _is_committed(self, step: int) -> bool:
-        return (epath.Path(self.config.directory) / str(step)
-                / COMMIT_MARKER).exists()
+        return (self.step_path(step) / COMMIT_MARKER).exists()
 
     def committed_steps(self) -> list[int]:
         """Steps with a durable COMMITTED marker, ascending. Dirs left
@@ -297,10 +310,11 @@ class Checkpointer:
         saved = meta.get("virtual_replicas")
         if saved and int(saved) != self.virtual_replicas:
             log.info(
-                "resize-on-restore: step %d was saved at %d virtual "
-                "replicas, restored at %d (optimizer state re-partitioned "
-                "over the new data axis)",
-                step, int(saved), self.virtual_replicas)
+                "resize-on-restore: step %d (%s) was saved at %d "
+                "virtual replicas, restored at %d (optimizer state "
+                "re-partitioned over the new data axis)",
+                step, self.step_path(step), int(saved),
+                self.virtual_replicas)
 
     def _restore_json_item(self, item: str, step: int | None,
                            *, missing_ok: bool) -> dict[str, Any]:
@@ -321,7 +335,7 @@ class Checkpointer:
             # probe would silently report every item absent — restarting
             # a resumed data stream at ticket 0, the exact failure this
             # item exists to prevent.
-            item_dir = epath.Path(self.config.directory) / str(step) / item
+            item_dir = self.step_path(step) / item
             if not item_dir.exists():
                 return {}
         restored = self._mgr.restore(

@@ -566,6 +566,13 @@ class WorkerConfig:
     slow_save_s: float = 0.0
     loss_log: str = ""
     join_timeout_s: float = 60.0
+    # Continuous-deployment hook (ISSUE 18): when set, the CHIEF
+    # publishes every COMMITTED checkpoint to this fleet router's
+    # `POST /fleet/versions` (version "step-<N>", source pointing at
+    # ckpt_dir) so the RolloutManager can canary it onto the serving
+    # fleet. Best-effort by design: a down router never blocks a save.
+    publish_url: str = ""
+    publish_model: str = "llama-tiny"
 
 
 class _CoordinatorClient:
@@ -590,6 +597,46 @@ class _CoordinatorClient:
     def heartbeat(self, replica_id: str, **stats) -> dict:
         return self._post("/elastic/heartbeat",
                           {"replica_id": replica_id, **stats})
+
+
+def _publish_version(wc: WorkerConfig, ckpt, published: set) -> bool:
+    """Publish the newest COMMITTED checkpoint to the fleet router's
+    version registry (the trainer half of the ISSUE 18 rollout loop).
+    Async saves commit on the NEXT save/close, so "newest committed"
+    at publish time can trail the save just dispatched — the close()
+    call site catches the final one. Idempotent via `published` (steps
+    already announced) and the router's own by-name idempotence;
+    best-effort: any network failure is logged and swallowed, the
+    training loop must never stall on a down router."""
+    step = ckpt.latest_committed_step()
+    if step is None or step in published:
+        return False
+    path = ckpt.latest_committed_path()
+    body = {
+        "version": f"step-{step}",
+        "model": wc.publish_model,
+        "step": step,
+        "source": {"checkpoint": wc.ckpt_dir, "step": step,
+                   "path": str(path)},
+    }
+    import urllib.request
+
+    req = urllib.request.Request(
+        wc.publish_url.rstrip("/") + "/fleet/versions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            ok = resp.status == 200
+    except OSError as e:
+        log.warning("publish of step %d to %s failed: %s", step,
+                    wc.publish_url, e)
+        return False
+    if ok:
+        published.add(step)
+        log.info("published committed step %d (%s) to %s", step,
+                 body["version"], wc.publish_url)
+    return ok
 
 
 def _deterministic_batch(cfg_vocab: int, batch: int, seq: int, seed: int,
@@ -750,6 +797,7 @@ def run_worker(wc: WorkerConfig) -> dict[str, Any]:
     restores = 0
     corrupt_restores = 0
     saves = 0
+    published_steps: set = set()  # committed steps announced to the fleet
     trainer = ckpt = state = None
     last_loss = float("nan")
     last_saved = -1
@@ -862,6 +910,11 @@ def run_worker(wc: WorkerConfig) -> dict[str, Any]:
                 last_saved = step
                 saves += 1
                 hb.update(saves=saves, save_seconds=dt_save)
+                if wc.publish_url:
+                    # publish hook: announce whatever is COMMITTED by
+                    # now (async saves trail by one flush — close()
+                    # below publishes the final step)
+                    _publish_version(wc, ckpt, published_steps)
                 if wc.slow_save_s > 0:
                     # Chaos window: the save is dispatched but its
                     # COMMITTED marker cannot appear until the next
@@ -880,6 +933,9 @@ def run_worker(wc: WorkerConfig) -> dict[str, Any]:
             ckpt.save(state, force=True)
     with ledger.book("checkpoint_save"):
         ckpt.close()  # drains async saves + writes COMMITTED markers
+    if world.get("chief") == wc.replica_id and wc.publish_url:
+        # the final save is durable now: publish it
+        _publish_version(wc, ckpt, published_steps)
     # Drain barrier: keep heartbeating until every live member reports
     # done — vanishing the moment WE finish would read as a death to a
     # straggler (soft lockstep keeps the skew to a couple of steps, so
@@ -901,6 +957,7 @@ def run_worker(wc: WorkerConfig) -> dict[str, Any]:
         "restores": restores,
         "corrupt_restores": corrupt_restores,
         "world_size": world["world_size"],
+        "published": len(published_steps),
         # per-incarnation goodput book: the chaos harness reads these
         # RESULT lines for its per-arm summary table (the processes are
         # gone by the time the table prints)
@@ -936,6 +993,13 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--slow-save-s", type=float, default=0.0)
     parser.add_argument("--loss-log", default="")
+    parser.add_argument("--publish-url", default="",
+                        help="fleet router base URL: the chief "
+                             "publishes each COMMITTED checkpoint to "
+                             "POST /fleet/versions (ISSUE 18)")
+    parser.add_argument("--publish-model", default="llama-tiny",
+                        help="served model name the published "
+                             "versions target")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -963,6 +1027,8 @@ def main(argv: list[str] | None = None) -> int:
         seed=args.seed,
         slow_save_s=args.slow_save_s,
         loss_log=args.loss_log,
+        publish_url=args.publish_url,
+        publish_model=args.publish_model,
     ))
     print(json.dumps(result))
     return 0

@@ -260,6 +260,65 @@ web.run_app(app, host="127.0.0.1", port={port}, print=None)
 '''
 
 
+# Rollout-arm router (--mode rollout): the live-deployment plane
+# (ISSUE 18) running for real — the RolloutManager loop ticks fast,
+# the bake window is seconds-scale, and the TTFT SLO threshold sits
+# between a healthy CPU generate and the bad arm's planted defect
+# delay so the canary judge discriminates the two versions.
+ROLLOUT_ROUTER_CODE = r'''
+import sys
+sys.path.insert(0, {repo!r})
+from aiohttp import web
+from kubeflow_tpu.fleet.router import create_router_app
+app = create_router_app(block_size={block_size}, policy="affinity",
+                        hedge_after_s=0.0, retries={retries},
+                        backoff_s=0.05,
+                        rollout_interval_s={interval_s},
+                        rollout_bake_s={bake_s},
+                        rollout_min_probes={min_probes},
+                        rollout_burn_threshold=2.0,
+                        rollout_ttft_slo_s={ttft_slo_s},
+                        rollout_confirm_timeout_s=60.0)
+web.run_app(app, host="127.0.0.1", port={port}, print=None)
+'''
+
+# Rollout-arm replica: CHAOS_REPLICA_CODE (sharpened lm_head — the
+# mid-roll parity oracle needs byte-exact greedy generations) plus a
+# seed-keyed reloader, so `POST /v1/reload {"source": {"seed": N}}`
+# swaps to DISTINGUISHABLE weights without anyone writing checkpoints.
+ROLLOUT_REPLICA_CODE = r'''
+import os, sys
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+sys.path.insert(0, {repo!r})
+import jax; jax.config.update("jax_platforms", "cpu")
+from aiohttp import web
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.serving.engine import InferenceEngine, LLAMA_FAMILY, EngineConfig
+from kubeflow_tpu.serving import server as srv
+cfg = llama.LLAMA_TINY
+
+def mk_params(seed):
+    params = dict(llama.init(jax.random.key(seed), cfg))
+    params["lm_head"] = params["lm_head"] * 50.0
+    return params
+
+def reloader(name, engine, source):
+    if "seed" not in source:
+        raise ValueError("rollout loadtest reloads are seed-sourced")
+    return mk_params(int(source["seed"]))
+
+eng = InferenceEngine(mk_params(0), cfg, LLAMA_FAMILY,
+                      EngineConfig(max_len=128))
+app = srv.create_serving_app({{"tiny": eng}}, continuous=True, warmup=True,
+                             kv_block_size={block_size},
+                             model_version="seed-0", reloader=reloader)
+srv.enable_fleet_registration(app, {router!r},
+                              "http://127.0.0.1:{port}",
+                              replica_id="replica-{idx}", period_s=0.5)
+web.run_app(app, host="127.0.0.1", port={port}, print=None)
+'''
+
+
 TENANT_SERVER_CODE = r'''
 import os, sys
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
@@ -1817,6 +1876,347 @@ def _train_arm(workdir: str, *, replicas: int, steps: int,
             f.close()
 
 
+def run_rollout(clients: int, requests: int, max_new: int, *,
+                replicas: int = 4, block_size: int = 8,
+                bake_s: float = 4.0, defect_delay_s: float = 3.0,
+                retries: int = 6) -> dict:
+    """The live-deployment run (ISSUE 18). N replicas on seed-0
+    weights behind a rollout-armed router; client threads flood the
+    router CONTINUOUSLY while the harness publishes version seed-1 and
+    the RolloutManager canaries, bakes, and rolls it across the whole
+    fleet — so every phase (canary drain+reload, bake, each promote
+    drain+reload) lands under live traffic. Token safety is judged
+    retroactively: every flood response must byte-match the seed-0
+    oracle or the seed-1 oracle (both taken directly from replica-0,
+    before publish and after promote) — version-aware migration means
+    there is no third, mixed-weights outcome. Then the bad arm:
+    seed-2-bad ships a planted TTFT defect wider than the canary SLO,
+    and must be auto-rolled-back by the burn judge with the fleet
+    healed to seed-1, every phase conserved in the ledger. The run
+    raises unless client failures and token mismatches are both zero
+    and both arms reach their terminal verdicts."""
+    import tempfile
+    import threading
+
+    router_port = free_port()
+    rep_ports = [free_port() for _ in range(replicas)]
+    router_base = f"http://127.0.0.1:{router_port}"
+    log = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".log", prefix="kftpu-rolloutload-",
+        delete=False)
+    procs: list[subprocess.Popen] = []
+    try:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c",
+             ROLLOUT_ROUTER_CODE.format(
+                 repo=REPO, port=router_port, block_size=block_size,
+                 retries=retries, interval_s=0.25, bake_s=bake_s,
+                 min_probes=3, ttft_slo_s=2.0)],
+            stdout=log, stderr=subprocess.STDOUT))
+        for idx, port in enumerate(rep_ports):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 ROLLOUT_REPLICA_CODE.format(
+                     repo=REPO, port=port, idx=idx,
+                     router=router_base, block_size=block_size)],
+                stdout=log, stderr=subprocess.STDOUT))
+
+        def tail_fail(msg: str) -> RuntimeError:
+            log.flush()
+            with open(log.name) as f:
+                tail = "\n".join(f.read().splitlines()[-30:])
+            rcs = [p.poll() for p in procs]
+            return RuntimeError(f"{msg} (rcs={rcs}):\n{tail}")
+
+        deadline = time.monotonic() + 240
+        ready = False
+        while time.monotonic() < deadline:
+            if any(p.poll() is not None for p in procs):
+                break
+            try:
+                counts = _get_json(
+                    f"{router_base}/fleet/replicas")["counts"]
+                if counts["ready"] >= replicas:
+                    ready = True
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        if not ready:
+            raise tail_fail("rollout fleet never became ready")
+
+        def post(base: str, body: dict, timeout: float = 120.0) -> dict:
+            return _post_json(f"{base}/v1/models/tiny:generate", body,
+                              timeout=timeout)
+
+        # warm every replica's batch shapes before anything is timed;
+        # token 255 keeps the warm prompt's radix line disjoint from
+        # the measured prompts (3..10) and the canary probe ([1])
+        prompt_len = 3 * block_size
+        warm_prompt = [255, 99] + [5 + t % 200
+                                   for t in range(prompt_len - 2)]
+
+        def warm(i: int) -> None:
+            base = f"http://127.0.0.1:{rep_ports[i % replicas]}"
+            post(base, {"tokens": [warm_prompt], "max_new": max_new})
+
+        with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+            for _ in range(3):
+                list(ex.map(warm, range(max(clients, replicas))))
+
+        # both oracles come DIRECTLY from replica-0 — seed-0 now,
+        # seed-1 after the promote finishes (same process, new weights)
+        k = max(1, requests // 6)
+        prompts = [[3 + j % 250, 100] + [7 + (j + t) % 200
+                                         for t in range(prompt_len - 2)]
+                   for j in range(k)]
+        rep0 = f"http://127.0.0.1:{rep_ports[0]}"
+        oracle0 = [post(rep0, {"tokens": [pr], "max_new": max_new})
+                   ["tokens"][0] for pr in prompts]
+
+        # continuous flood: client threads hammer the router until the
+        # roll completes, so canary/bake/promote ALL land under load
+        stop_flood = threading.Event()
+        lock = threading.Lock()
+        responses: list[tuple[int, list]] = []
+        failures: list[str] = []
+        latencies: list[float] = []
+
+        def flooder(worker: int) -> None:
+            i = 0
+            while not stop_flood.is_set():
+                j = (worker * 7919 + i * 31) % k
+                body = {"tokens": [prompts[j]], "max_new": max_new}
+                t0 = time.perf_counter()
+                try:
+                    if i % 3 == 0:
+                        got = _sse_generate(router_base, body)
+                    else:
+                        got = post(router_base, body)["tokens"][0]
+                except Exception as e:  # noqa: BLE001 — tallied below
+                    with lock:
+                        failures.append(
+                            f"worker {worker} req {i}: "
+                            f"{type(e).__name__}: {e}")
+                    i += 1
+                    continue
+                with lock:
+                    responses.append((j, [int(t) for t in got]))
+                    latencies.append(time.perf_counter() - t0)
+                i += 1
+
+        threads = [threading.Thread(target=flooder, args=(w,))
+                   for w in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(0.5)  # flood established before the publish lands
+
+        pub = _post_json(f"{router_base}/fleet/versions",
+                         {"version": "seed-1", "model": "tiny",
+                          "source": {"seed": 1}})
+        if not pub.get("published"):
+            raise AssertionError(f"seed-1 publish refused: {pub}")
+
+        def phase_of(version: str) -> str | None:
+            book = _get_json(f"{router_base}/fleet/rollouts")
+            return (book["rollouts"].get(version) or {}).get("phase")
+
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            ph = phase_of("seed-1")
+            if ph == "completed":
+                break
+            if ph in ("rolled_back",):
+                raise tail_fail("healthy seed-1 rollout rolled back")
+            time.sleep(0.5)
+        else:
+            raise tail_fail(
+                f"seed-1 never completed (phase={phase_of('seed-1')})")
+        promote_wall = time.perf_counter() - t0
+
+        # one more beat of post-promote traffic, then stop the flood
+        time.sleep(1.0)
+        stop_flood.set()
+        for t in threads:
+            t.join(timeout=120)
+        wall = time.perf_counter() - t0
+
+        reps = _get_json(f"{router_base}/fleet/replicas")["replicas"]
+        wrong = {r["id"]: r["version"] for r in reps
+                 if r["version"] != "seed-1"}
+        if wrong:
+            raise AssertionError(
+                f"promote completed but replicas still off-version: "
+                f"{wrong}")
+
+        oracle1 = [post(rep0, {"tokens": [pr], "max_new": max_new})
+                   ["tokens"][0] for pr in prompts]
+        for j in range(k):
+            if oracle0[j] == oracle1[j]:
+                raise AssertionError(
+                    f"prompt {j}: seed-0 and seed-1 oracles agree — "
+                    "the weight swap is not observable")
+
+        served_old = served_new = 0
+        mismatches: list[str] = []
+        for j, got in responses:
+            if got == [int(t) for t in oracle0[j]]:
+                served_old += 1
+            elif got == [int(t) for t in oracle1[j]]:
+                served_new += 1
+            else:
+                mismatches.append(f"prompt {j}: {got}")
+        if failures:
+            raise AssertionError(
+                f"{len(failures)} client-visible failures during the "
+                f"roll: {failures[:5]}")
+        if mismatches:
+            raise AssertionError(
+                f"{len(mismatches)} responses match NEITHER oracle "
+                f"(mixed-weight generation?): {mismatches[:3]}")
+        if len(responses) < requests:
+            raise AssertionError(
+                f"flood too thin: {len(responses)} < {requests} "
+                "responses across the roll")
+        if not served_old or not served_new:
+            raise AssertionError(
+                f"roll was not observed mid-flood (served_old="
+                f"{served_old} served_new={served_new})")
+
+        book = _get_json(f"{router_base}/fleet/rollouts")
+        hist = book["rollouts"]["seed-1"]["history"]
+        want = ["published", "canarying", "baking", "promoting",
+                "completed"]
+        if hist != want:
+            raise AssertionError(f"seed-1 history {hist} != {want}")
+        if not book["conserved"]:
+            raise AssertionError(f"rollout ledger not conserved: {book}")
+        if book["manager"]["current"] != "seed-1":
+            raise AssertionError(
+                f"fleet current is {book['manager']['current']!r}, "
+                "not seed-1")
+        canary_good = next(
+            (r["evidence"].get("canary") for r in book["records"]
+             if r["version"] == "seed-1" and r["phase"] == "canarying"),
+            None)
+
+        # ---- bad arm: planted TTFT defect must burn the canary SLO
+        # and auto-rollback, healing the fleet to seed-1 ----
+        pub = _post_json(
+            f"{router_base}/fleet/versions",
+            {"version": "seed-2-bad", "model": "tiny",
+             "source": {"seed": 2,
+                        "defect": {"ttft_delay_s": defect_delay_s}}})
+        if not pub.get("published"):
+            raise AssertionError(f"seed-2-bad publish refused: {pub}")
+        t_bad = time.perf_counter()
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            ph = phase_of("seed-2-bad")
+            if ph == "rolled_back":
+                break
+            if ph == "completed":
+                raise tail_fail("defective seed-2-bad was PROMOTED")
+            time.sleep(0.5)
+        else:
+            raise tail_fail(
+                "seed-2-bad never rolled back "
+                f"(phase={phase_of('seed-2-bad')})")
+        rollback_wall = time.perf_counter() - t_bad
+
+        book = _get_json(f"{router_base}/fleet/rollouts")
+        hist = book["rollouts"]["seed-2-bad"]["history"]
+        want = ["published", "canarying", "baking", "rolled_back"]
+        if hist != want:
+            raise AssertionError(f"seed-2-bad history {hist} != {want}")
+        if not book["conserved"]:
+            raise AssertionError(f"rollout ledger not conserved: {book}")
+        if book["manager"]["current"] != "seed-1":
+            raise AssertionError(
+                "rollback left current at "
+                f"{book['manager']['current']!r}")
+        if book["manager"]["active"] is not None:
+            raise AssertionError(
+                f"rollback left a live rollout: {book['manager']}")
+        if book["active"] != 0:
+            raise AssertionError(
+                f"ledger still counts {book['active']} active rollouts")
+        canary_bad = next(
+            (r["evidence"].get("canary") for r in book["records"]
+             if r["version"] == "seed-2-bad"
+             and r["phase"] == "canarying"), None)
+
+        reps = _get_json(f"{router_base}/fleet/replicas")["replicas"]
+        wrong = {r["id"]: r["version"] for r in reps
+                 if r["version"] != "seed-1"}
+        if wrong:
+            raise AssertionError(
+                f"rollback left replicas off seed-1: {wrong}")
+
+        # the healed ex-canary must serve seed-1 tokens with the
+        # defect CLEARED — fast first token, oracle-exact output
+        heal_base = router_base
+        if canary_bad is not None:
+            for idx, port in enumerate(rep_ports):
+                if canary_bad == f"replica-{idx}":
+                    heal_base = f"http://127.0.0.1:{port}"
+        t_h = time.perf_counter()
+        healed = post(heal_base, {"tokens": [prompts[0]],
+                                  "max_new": max_new})["tokens"][0]
+        heal_lat = time.perf_counter() - t_h
+        if [int(t) for t in healed] != [int(t) for t in oracle1[0]]:
+            raise AssertionError(
+                f"healed canary serves wrong tokens: {healed} != "
+                f"{oracle1[0]}")
+        if heal_lat >= defect_delay_s:
+            raise AssertionError(
+                f"healed canary still defect-slow ({heal_lat:.2f}s >= "
+                f"{defect_delay_s}s)")
+
+        latencies.sort()
+        q = statistics.quantiles(latencies, n=20)
+        return {
+            "metric": "serving_rollout",
+            "mode": "rollout",
+            "fleet_replicas": replicas,
+            "clients": clients,
+            "requests": len(responses),
+            "max_new": max_new,
+            "kv_block_size": block_size,
+            "bake_s": bake_s,
+            "requests_per_sec": round(len(responses) / wall, 2),
+            "tokens_per_sec": round(len(responses) * max_new / wall, 1),
+            "p50_s": round(q[9], 3),
+            "p95_s": round(q[18], 3),
+            "wall_s": round(wall, 2),
+            "promote_wall_s": round(promote_wall, 2),
+            "rollback_wall_s": round(rollback_wall, 2),
+            "served_old_version": served_old,
+            "served_new_version": served_new,
+            "canary_good": canary_good,
+            "canary_bad": canary_bad,
+            "good_verdict": "completed",
+            "bad_verdict": "rolled_back",
+            "ledger_conserved": True,
+            "transitions": book["transitions"],
+            "client_failures": 0,
+            "token_mismatches": 0,
+        }
+    finally:
+        log.close()
+        os.unlink(log.name)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+
 def run_train_chaos(*, replicas: int = 2, steps: int = 8,
                     save_every: int = 2,
                     slow_save_s: float = 1.5,
@@ -2379,7 +2779,8 @@ def main() -> int:
     p.add_argument("--batch-window-ms", type=int, default=5)
     p.add_argument("--mode",
                    choices=("window", "continuous", "fleet", "tenants",
-                            "chaos", "train-chaos", "disagg"),
+                            "chaos", "train-chaos", "disagg",
+                            "rollout"),
                    default="window")
     p.add_argument("--disagg-prefill", type=int, default=1,
                    help="disagg mode: prefill-pool replicas (arm A); "
@@ -2500,6 +2901,10 @@ def main() -> int:
             # fault-injection needs kill+drain+survivor; the closed
             # loop needs total capacity loss, so a 1-replica fleet
             args.fleet_replicas = 1 if args.closed_loop else 3
+        elif args.mode == "rollout":
+            # the roll must walk canary + several promote steps so the
+            # old and new version genuinely coexist under flood
+            args.fleet_replicas = 4
         else:
             args.fleet_replicas = 2
     if args.mode == "fleet":
@@ -2557,6 +2962,16 @@ def main() -> int:
             delay_rate=args.chaos_delay_rate,
             duplicate_rate=args.chaos_duplicate_rate,
             blackhole_beats=args.chaos_blackhole_beats)
+    elif args.mode == "rollout":
+        if args.fleet_replicas < 2:
+            p.error("--mode rollout needs --fleet-replicas >= 2 "
+                    "(a canary plus at least one replica to promote)")
+        if args.requests < 8:
+            p.error("--mode rollout needs --requests >= 8")
+        result = run_rollout(
+            args.clients, args.requests, args.max_new,
+            replicas=args.fleet_replicas,
+            block_size=args.fleet_block_size)
     elif args.mode == "train-chaos":
         if args.train_replicas < 2:
             p.error("--train-replicas must be >= 2 (one to kill, one "

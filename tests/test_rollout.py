@@ -1,0 +1,821 @@
+"""Live model rollout (ISSUE 18): version-registry round-trip,
+RolloutLedger conservation, the canary→bake→promote and rollback state
+machines on a fake clock, `/v1/reload` drain-then-swap token parity on
+a live replica, the elastic chief's publish hook, router endpoint
+round-trips, the version-labelled federation series, and the CRD
+annotation rendering."""
+
+import asyncio
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+pytest_plugins = ("aiohttp.pytest_plugin",)
+
+from kubeflow_tpu import obs as obs_lib
+from kubeflow_tpu.fleet import rollout
+from kubeflow_tpu.fleet.registry import DEAD, READY, ReplicaRegistry
+from kubeflow_tpu.fleet.rollout import (
+    PHASES,
+    TERMINAL_PHASES,
+    RolloutLedger,
+    RolloutManager,
+    VersionRegistry,
+    valid_version,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# -- version vocabulary ------------------------------------------------------
+
+
+def test_valid_version_is_the_single_gate():
+    assert valid_version("step-12")
+    assert valid_version("v1.2.3_rc1")
+    assert valid_version("A" * 64)
+    assert not valid_version("")
+    assert not valid_version("A" * 65)
+    assert not valid_version("no spaces")
+    assert not valid_version("café")     # unicode alnum stays out
+    assert not valid_version(12)
+    assert not valid_version(None)
+    # serving.server mirrors the charset without importing fleet —
+    # the two predicates may not drift
+    from kubeflow_tpu.serving import server as server_lib
+    for v in ("step-12", "", "no spaces", "café", "A" * 65):
+        assert server_lib._valid_version(v) == valid_version(v)
+
+
+def test_version_registry_roundtrip_and_idempotence():
+    clk = FakeClock()
+    vr = VersionRegistry(wall=clk)
+    with pytest.raises(ValueError):
+        vr.publish("bad version!")
+    clk.t = 5.0
+    e1, created = vr.publish("step-1", model="llama-tiny",
+                             source={"seed": 1}, step=1)
+    assert created and e1["published_at"] == 5.0
+    assert e1["status"] == rollout.V_PENDING
+    # idempotent by name: the chief re-announcing after a blip must
+    # not reset the entry
+    e1["status"] = rollout.V_LIVE
+    e2, created = vr.publish("step-1", step=999)
+    assert not created and e2 is e1 and e2["step"] == 1
+    assert vr.get("step-1") is e1
+    assert vr.get("ghost") is None
+    snap = vr.snapshot()
+    assert snap["current"] == ""
+    assert [e["version"] for e in snap["versions"]] == ["step-1"]
+
+
+def test_latest_pending_supersedes_older_and_current_promotes():
+    vr = VersionRegistry(wall=lambda: 0.0)
+    vr.publish("a", source={"seed": 1})
+    vr.publish("b", source={"seed": 2})
+    vr.publish("c", source={"seed": 3})
+    cand = vr.latest_pending()
+    assert cand["version"] == "c"
+    # the trainer publishes every save; only the newest earns a bake
+    assert vr.get("a")["status"] == rollout.V_SUPERSEDED
+    assert vr.get("b")["status"] == rollout.V_SUPERSEDED
+    vr.set_current("c")
+    assert vr.current == "c"
+    assert vr.get("c")["status"] == rollout.V_LIVE
+    assert vr.latest_pending() is None
+    # promoting a successor displaces the previous live entry
+    vr.publish("d")
+    vr.set_current("d")
+    assert vr.get("c")["status"] == rollout.V_SUPERSEDED
+
+
+def test_version_registry_bounded_never_evicts_current():
+    vr = VersionRegistry(max_versions=3, wall=lambda: 0.0)
+    vr.publish("keep")
+    vr.set_current("keep")
+    for i in range(10):
+        vr.publish(f"v{i}")
+    entries = [e["version"] for e in vr.entries()]
+    assert len(entries) == 3
+    assert "keep" in entries
+
+
+def test_publish_hook_fires_and_never_raises():
+    vr = VersionRegistry(wall=lambda: 0.0)
+    seen = []
+    vr.on_publish = lambda e: seen.append(e["version"])
+    vr.publish("v1")
+    vr.publish("v1")                       # replay: no second hook
+    assert seen == ["v1"]
+    vr.on_publish = lambda e: 1 / 0
+    entry, created = vr.publish("v2")      # hook explodes, door holds
+    assert created and vr.get("v2") is entry
+
+
+# -- ledger conservation -----------------------------------------------------
+
+
+def test_ledger_conservation_over_full_lifecycle():
+    led = RolloutLedger(wall=lambda: 7.0)
+    for ph in ("published", "canarying", "baking", "promoting"):
+        led.note("v1", ph, evidence={"k": ph})
+        assert led.phase_of("v1") == ph
+        assert led.verdict("v1") == "active"
+        assert led.active == 1
+        assert led.conserved
+    led.note("v1", "completed")
+    assert led.verdict("v1") == "completed"
+    assert led.active == 0
+    snap = led.snapshot()
+    assert snap["conserved"]
+    assert snap["started"] == snap["finished"] == 1
+    assert snap["transitions"] == 5 == sum(snap["phases"].values())
+    assert snap["rollouts"]["v1"]["history"] == [
+        "published", "canarying", "baking", "promoting", "completed"]
+    assert led.records()[0]["wall"] == 7.0
+    assert led.verdict("ghost") == "unknown"
+
+
+def test_ledger_rejects_unknown_phase_and_stays_bounded():
+    led = RolloutLedger(max_records=4)
+    with pytest.raises(ValueError):
+        led.note("v", "exploded")
+    for i in range(20):
+        led.note(f"v{i}", "published")
+        led.note(f"v{i}", "rolled_back")
+    assert len(led.records()) == 4
+    assert led.records(limit=2) == led.records()[-2:]
+    snap = led.snapshot()
+    assert snap["conserved"]
+    assert snap["started"] == snap["finished"] == 20
+    assert snap["phases"]["rolled_back"] == 20
+    # hooks are swallowed by contract
+    led.on_phase = lambda v, ph: 1 / 0
+    led.note("hook", "published")
+    assert led.conserved
+
+
+def test_ledger_terminal_booked_once_per_rollout():
+    led = RolloutLedger()
+    led.note("v", "published")
+    led.note("v", "rolled_back")
+    # a second terminal note (caller bug) must not double-finish
+    led.note("v", "rolled_back")
+    assert led.finished == 1
+    assert led.snapshot()["conserved"]
+
+
+# -- manager state machine on a fake clock -----------------------------------
+
+
+class Harness:
+    """RolloutManager over a real ReplicaRegistry with recording stub
+    drain/reload/probe callables: reload flips the replica's heartbeat
+    version (what a real replica's forced re-registration does) unless
+    told to fail or go silent."""
+
+    def __init__(self, n=3, **kw):
+        self.clk = FakeClock()
+        self.reg = ReplicaRegistry(clock=self.clk)
+        for i in range(n):
+            self.reg.register(f"http://r{i}", replica_id=f"r{i}",
+                              max_slots=8)
+        self.versions = VersionRegistry(wall=self.clk)
+        self.ledger = RolloutLedger(wall=self.clk)
+        self.drained = []
+        self.reloads = []          # (replica_id, version)
+        self.outcomes = []
+        self.fail_reload = set()   # replica ids whose reload errors
+        self.silent_reload = False  # reload "succeeds" but no confirm
+        self.probe = (0.01, True)
+
+        async def drain(rid):
+            self.drained.append(rid)
+
+        async def reload(rep, entry):
+            self.reloads.append((rep.id, entry["version"]))
+            if rep.id in self.fail_reload:
+                return False
+            if not self.silent_reload:
+                self.reg.heartbeat(rep.id, version=entry["version"])
+            return True
+
+        async def probe(rep):
+            return self.probe
+
+        kw.setdefault("bake_window_s", 10.0)
+        kw.setdefault("bake_min_probes", 2)
+        kw.setdefault("confirm_timeout_s", 30.0)
+        self.mgr = RolloutManager(
+            self.reg, self.versions, self.ledger,
+            drain_fn=drain, reload_fn=reload, probe_fn=probe,
+            clock=self.clk, on_reload=self.outcomes.append, **kw)
+
+    def step(self, dt=0.0):
+        self.clk.t += dt
+        asyncio.run(self.mgr.step())
+
+
+def test_promote_cycle_end_to_end():
+    h = Harness(n=3)
+    h.versions.publish("v2", model="llama-tiny", source={"seed": 2})
+    h.step()                                   # adopt -> canary reload
+    act = h.mgr.active
+    assert act["phase"] == "canarying"
+    canary = act["canary"]
+    assert h.drained == [canary]               # KV migrated BEFORE swap
+    assert h.reloads == [(canary, "v2")]
+    assert h.versions.get("v2")["status"] == rollout.V_ROLLING
+    h.step()                                   # heartbeat confirmed
+    assert h.mgr.active["phase"] == "baking"
+    h.step(1.0)                                # probe 1
+    h.step(1.0)                                # probe 2 (min reached)
+    assert h.mgr.active["probes"] == 2
+    assert h.mgr.active["phase"] == "baking"   # window not elapsed
+    h.step(10.0)                               # window elapsed: promote
+    assert h.mgr.active["phase"] == "promoting"
+    h.step(1.0)                                # roll replica 2
+    h.step(1.0)                                # roll replica 3
+    h.step(1.0)                                # all confirmed: complete
+    assert h.mgr.active is None
+    assert h.versions.current == "v2"
+    assert h.versions.get("v2")["status"] == rollout.V_LIVE
+    assert all(r.version == "v2" for r in h.reg.replicas())
+    assert sorted(h.drained) == ["r0", "r1", "r2"]
+    assert h.outcomes == ["ok", "ok", "ok"]
+    snap = h.ledger.snapshot()
+    assert snap["conserved"]
+    assert snap["rollouts"]["v2"]["history"] == [
+        "published", "canarying", "baking", "promoting", "completed"]
+    assert h.mgr.describe()["current"] == "v2"
+
+
+def test_bake_burn_rolls_back_and_restores_prior():
+    h = Harness(n=2)
+    # establish a live prior with a reloadable source first
+    h.versions.publish("v1", source={"seed": 1})
+    for _ in range(10):
+        h.step(3.0)
+    assert h.versions.current == "v1" and h.mgr.active is None
+    h.reloads.clear()
+    h.drained.clear()
+
+    h.versions.publish("v2", source={"seed": 2})
+    h.probe = (9.0, False)                     # slow AND failing canary
+    h.step()                                   # adopt
+    canary = h.mgr.active["canary"]
+    h.step()                                   # confirmed -> baking
+    h.step(1.0)                                # probe 1 (below min: no verdict)
+    assert h.mgr.active["phase"] == "baking"
+    h.step(1.0)                                # probe 2 -> burn -> rollback
+    assert h.mgr.active is None
+    assert h.ledger.verdict("v2") == "rolled_back"
+    assert h.versions.get("v2")["status"] == rollout.V_ROLLED_BACK
+    assert h.versions.current == "v1"          # never promoted
+    # the touched canary was drained again and reloaded BACK to v1
+    assert h.reloads == [(canary, "v2"), (canary, "v1")]
+    assert h.drained.count(canary) == 2
+    assert h.reg.get(canary).version == "v1"
+    rec = [r for r in h.ledger.records()
+           if r["phase"] == "rolled_back"][-1]
+    assert rec["evidence"]["reason"] == "slo_burn"
+    assert rec["evidence"]["burn"] >= h.mgr.burn_threshold
+    assert h.ledger.snapshot()["conserved"]
+
+
+def test_canary_reload_failure_rolls_back_immediately():
+    h = Harness(n=2)
+    h.fail_reload = {"r0", "r1"}
+    h.versions.publish("v2", source={"seed": 2})
+    h.step()
+    assert h.mgr.active is None
+    assert h.ledger.verdict("v2") == "rolled_back"
+    assert h.outcomes == ["failed"]
+    assert h.ledger.snapshot()["conserved"]
+    rec = h.ledger.records()[-1]
+    assert rec["evidence"]["reason"] == "canary_reload_failed"
+
+
+def test_canary_confirm_timeout_rolls_back():
+    h = Harness(n=2, confirm_timeout_s=5.0)
+    h.silent_reload = True     # reload "ok" but version never flips
+    h.versions.publish("v2", source={"seed": 2})
+    h.step()
+    assert h.mgr.active["phase"] == "canarying"
+    h.step(1.0)                # still waiting
+    assert h.mgr.active["phase"] == "canarying"
+    h.step(10.0)               # past the confirm window
+    assert h.mgr.active is None
+    assert h.ledger.verdict("v2") == "rolled_back"
+    rec = [r for r in h.ledger.records()
+           if r["phase"] == "rolled_back"][-1]
+    assert rec["evidence"]["reason"] == "canary_confirm_timeout"
+    assert h.ledger.snapshot()["conserved"]
+
+
+def test_no_replicas_stays_pending_without_booking():
+    h = Harness(n=0)
+    h.versions.publish("v2", source={"seed": 2})
+    h.step()
+    h.step(1.0)
+    assert h.mgr.active is None
+    assert h.ledger.snapshot()["transitions"] == 0
+    assert h.versions.get("v2")["status"] == rollout.V_PENDING
+    assert h.ledger.conserved
+
+
+def test_pin_freezes_new_rollouts_and_manual_rollback_aborts():
+    h = Harness(n=2)
+    h.mgr.pin(True)
+    h.versions.publish("v2", source={"seed": 2})
+    h.step()
+    assert h.mgr.active is None and h.reloads == []
+    h.mgr.pin(False)
+    h.step()
+    assert h.mgr.active["phase"] == "canarying"
+    assert h.mgr.request_rollback("operator said no")
+    h.step()
+    assert h.mgr.active is None
+    rec = [r for r in h.ledger.records()
+           if r["phase"] == "rolled_back"][-1]
+    assert rec["evidence"]["reason"] == "operator said no"
+    assert not h.mgr.request_rollback()       # nothing active now
+    assert h.ledger.snapshot()["conserved"]
+
+
+def test_observe_request_feeds_only_the_active_candidate():
+    h = Harness(n=2)
+    h.versions.publish("v2", source={"seed": 2})
+    h.step()
+    h.step()                                  # baking
+    h.mgr.observe_request("v2", 0.02, True)
+    h.mgr.observe_request("v1", 9.0, False)   # other version: ignored
+    h.mgr.observe_request("", 9.0, False)
+    assert h.mgr.active["observed"] == 1
+    # routed observations count toward the bake sample floor
+    h.mgr.observe_request("v2", 0.02, True)
+    h.step(11.0)
+    assert h.mgr.active["phase"] == "promoting"
+
+
+def test_burn_during_promote_rolls_back():
+    h = Harness(n=3)
+    h.versions.publish("v1", source={"seed": 1})
+    for _ in range(12):
+        h.step(3.0)
+    assert h.versions.current == "v1"
+    h.versions.publish("v2", source={"seed": 2})
+    h.step()                                   # canary
+    h.step()                                   # baking
+    h.step(1.0)
+    h.step(1.0)
+    h.step(10.0)                               # promoting
+    assert h.mgr.active["phase"] == "promoting"
+    # late regression: errors start burning mid-promote
+    for _ in range(6):
+        h.mgr.observe_request("v2", 0.02, False)
+    h.step(1.0)
+    assert h.mgr.active is None
+    assert h.ledger.verdict("v2") == "rolled_back"
+    # every touched replica was restored to v1
+    assert all(r.version == "v1" for r in h.reg.replicas())
+    assert h.ledger.snapshot()["conserved"]
+
+
+def test_dead_replicas_are_not_rollout_targets():
+    h = Harness(n=2)
+    h.reg.get("r1").state = DEAD
+    h.versions.publish("v2", source={"seed": 2})
+    for _ in range(10):
+        h.step(3.0)
+    assert h.versions.current == "v2"
+    assert h.reg.get("r0").version == "v2"
+    assert h.reg.get("r1").version == ""      # dead: untouched
+    assert [rid for rid, _ in h.reloads] == ["r0"]
+
+
+def test_describe_is_jsonable_and_complete():
+    h = Harness(n=2)
+    h.versions.publish("v2", source={"seed": 2})
+    h.step()
+    d = h.mgr.describe()
+    json.dumps(d)
+    assert d["active"]["version"] == "v2"
+    assert d["active"]["phase"] == "canarying"
+    assert d["active"]["phase_age_s"] == 0.0
+    assert d["pinned"] is False
+    assert d["config"]["bake_window_s"] == 10.0
+    assert set(d["burn"]) <= {"rollout_canary_ttft/short",
+                              "rollout_canary_ttft/long",
+                              "rollout_canary_errors/short",
+                              "rollout_canary_errors/long"}
+
+
+# -- /v1/reload on a live replica --------------------------------------------
+
+
+def _llama_params(seed):
+    import jax
+
+    from kubeflow_tpu.models import llama
+    params = dict(llama.init(jax.random.key(seed), llama.LLAMA_TINY))
+    params["lm_head"] = params["lm_head"] * 50.0   # argmax can't flip
+    return params
+
+
+@pytest.fixture(scope="module")
+def reload_engine():
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.serving import (
+        EngineConfig,
+        InferenceEngine,
+        LLAMA_FAMILY,
+    )
+    return InferenceEngine(_llama_params(0), llama.LLAMA_TINY,
+                           LLAMA_FAMILY, EngineConfig(max_len=64))
+
+
+def _seed_reloader(name, engine, source):
+    if "seed" not in source:
+        raise ValueError("reload source needs 'seed'")
+    return _llama_params(int(source["seed"]))
+
+
+async def _reload_app(aiohttp_client, engine, **kw):
+    from kubeflow_tpu.serving import server as server_lib
+    kw.setdefault("reloader", _seed_reloader)
+    kw.setdefault("continuous", True)
+    app = server_lib.create_serving_app({"m": engine}, **kw)
+    client = await aiohttp_client(app)
+    return client, app
+
+
+async def test_reload_swaps_weights_token_exact(aiohttp_client,
+                                                reload_engine):
+    """The parity contract: after a reload to seed 1 the replica emits
+    EXACTLY the tokens a fresh seed-1 engine would — and a generation
+    in flight during the reload completes on the OLD weights."""
+    import jax.numpy as jnp
+
+    import numpy as np
+
+    from kubeflow_tpu.serving import server as server_lib
+    prompt = [3, 5, 7, 11, 13, 17]
+    oracle_old = np.asarray(reload_engine.generate(
+        jnp.asarray([prompt], jnp.int32), max_new=12))[0].tolist()
+    client, app = await _reload_app(aiohttp_client, reload_engine,
+                                    model_version="v0")
+
+    async def gen():
+        r = await client.post("/v1/models/m:generate",
+                              json={"tokens": [prompt], "max_new": 12})
+        assert r.status == 200, await r.text()
+        return (await r.json())["tokens"][0]
+
+    # in-flight generation rides out the drain on the old weights
+    inflight = asyncio.ensure_future(gen())
+    await asyncio.sleep(0.05)
+    r = await client.post("/v1/reload", json={
+        "version": "v1", "source": {"seed": 1}})
+    body = await r.json()
+    assert r.status == 200, body
+    assert body["reloaded"] and body["model"] == "m"
+    assert body["version"] == "v1" and body["reload_s"] >= 0
+    assert await inflight == oracle_old
+    assert app[server_lib.MODEL_VERSION_KEY] == "v1"
+    # admission re-opened, new weights live: token parity vs a fresh
+    # seed-1 engine
+    reload_engine.params = _llama_params(1)  # oracle via same engine
+    oracle_new = np.asarray(reload_engine.generate(
+        jnp.asarray([prompt], jnp.int32), max_new=12))[0].tolist()
+    assert await gen() == oracle_new
+    assert app[server_lib.DRAIN_KEY]["draining"] is False
+    # the swap landed a weights.reload span (nested under the request)
+    sobs = app[server_lib.OBS_KEY]
+    spans = [s for t in sobs.tracer.traces() for s in t["spans"]
+             if s["name"] == "weights.reload"]
+    assert spans and spans[0]["attrs"]["version"] == "v1"
+    # restore the module-scoped engine for later tests
+    reload_engine.params = _llama_params(0)
+
+
+async def test_reload_validates_and_failure_keeps_old_weights(
+        aiohttp_client, reload_engine):
+    from kubeflow_tpu.serving import server as server_lib
+    client, app = await _reload_app(aiohttp_client, reload_engine)
+    # vocabulary violations
+    r = await client.post("/v1/reload", json={"version": "bad ver"})
+    assert r.status == 400
+    r = await client.post("/v1/reload",
+                          json={"version": "v1", "model": "ghost"})
+    assert r.status == 404
+    r = await client.post("/v1/reload", json={
+        "version": "v1", "source": {"seed": 1},
+        "defect": {"ttft_delay_s": 99}})
+    assert r.status == 400
+    # reloader raising ValueError -> 400, replica still serves
+    r = await client.post("/v1/reload",
+                          json={"version": "v1", "source": {}})
+    assert r.status == 400
+    assert app[server_lib.MODEL_VERSION_KEY] == ""
+    assert app[server_lib.DRAIN_KEY]["draining"] is False
+    r = await client.post("/v1/models/m:generate",
+                          json={"tokens": [[1, 2, 3]], "max_new": 2})
+    assert r.status == 200
+    # incompatible tree -> 400 and the old weights stay live
+    app[server_lib.RELOADER_KEY] = \
+        lambda name, engine, source: {"nonsense": 1}
+    r = await client.post("/v1/reload",
+                          json={"version": "v2", "source": {}})
+    assert r.status == 400
+    assert "incompatible" in (await r.json())["error"]
+    r = await client.post("/v1/models/m:generate",
+                          json={"tokens": [[1, 2, 3]], "max_new": 2})
+    assert r.status == 200
+
+
+async def test_reload_plants_and_heals_defect(aiohttp_client,
+                                              reload_engine):
+    from kubeflow_tpu.serving import server as server_lib
+    client, app = await _reload_app(aiohttp_client, reload_engine)
+    r = await client.post("/v1/reload", json={
+        "version": "bad", "source": {"seed": 0},
+        "defect": {"ttft_delay_s": 0.2}})
+    assert r.status == 200
+    assert app[server_lib.DEFECT_KEY] == {"ttft_delay_s": 0.2}
+    t0 = asyncio.get_event_loop().time()
+    r = await client.post("/v1/models/m:generate",
+                          json={"tokens": [[1, 2, 3]], "max_new": 1})
+    assert r.status == 200
+    assert asyncio.get_event_loop().time() - t0 >= 0.2
+    # rolling BACK (any reload) heals the chaos by construction
+    r = await client.post("/v1/reload",
+                          json={"version": "good", "source": {"seed": 0}})
+    assert r.status == 200
+    assert app[server_lib.DEFECT_KEY] == {}
+
+
+# -- chief publish hook ------------------------------------------------------
+
+
+class _PublishStub:
+    """Records POST /fleet/versions bodies; sync urllib-compatible."""
+
+    def __init__(self, status=200):
+        self.bodies = []
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                stub.bodies.append(
+                    (self.path, json.loads(self.rfile.read(n))))
+                payload = json.dumps({"published": True}).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *a):
+                pass
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.url = f"http://127.0.0.1:{self.srv.server_port}"
+        self.thread = threading.Thread(target=self.srv.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+class _CkptStub:
+    def __init__(self, step, path="/ckpt/00000012"):
+        self.step, self.path = step, path
+
+    def latest_committed_step(self):
+        return self.step
+
+    def latest_committed_path(self):
+        return self.path
+
+
+def test_chief_publish_hook_posts_committed_step():
+    from types import SimpleNamespace
+
+    from kubeflow_tpu.train.elastic import _publish_version
+    stub = _PublishStub()
+    try:
+        wc = SimpleNamespace(publish_url=stub.url,
+                             publish_model="llama-tiny",
+                             ckpt_dir="/ckpt")
+        published = set()
+        assert _publish_version(wc, _CkptStub(12), published)
+        assert published == {12}
+        path, body = stub.bodies[0]
+        assert path == "/fleet/versions"
+        assert body["version"] == "step-12" and body["step"] == 12
+        assert body["model"] == "llama-tiny"
+        assert body["source"]["checkpoint"] == "/ckpt"
+        assert body["source"]["step"] == 12
+        # idempotent per step; a NEW commit publishes again
+        assert not _publish_version(wc, _CkptStub(12), published)
+        assert _publish_version(wc, _CkptStub(13), published)
+        assert len(stub.bodies) == 2
+        # nothing committed yet: nothing to announce
+        assert not _publish_version(wc, _CkptStub(None), published)
+        assert len(stub.bodies) == 2
+    finally:
+        stub.close()
+    # a down router is logged and swallowed, never raised
+    wc = SimpleNamespace(publish_url="http://127.0.0.1:1",
+                         publish_model="m", ckpt_dir="/ckpt")
+    assert not _publish_version(wc, _CkptStub(14), set())
+
+
+def test_latest_committed_path_derivation(tmp_path):
+    """`latest_committed_path` is `step_path(latest_committed_step)` —
+    the one derivation site the publish hook, commit markers, and
+    restore share — and it resolves through COMMITTED markers only
+    (a crash leftover without a marker is never published)."""
+    from kubeflow_tpu.train.checkpoint import (
+        COMMIT_MARKER,
+        CheckpointConfig,
+        Checkpointer,
+    )
+    ck = Checkpointer.__new__(Checkpointer)   # derivation needs no mesh
+    ck.config = CheckpointConfig(str(tmp_path))
+
+    class _Mgr:
+        def all_steps(self):
+            return [7, 12]
+
+    ck._mgr = _Mgr()
+    assert str(ck.step_path(12)) == str(tmp_path / "12")
+    assert ck.latest_committed_path() is None        # nothing durable
+    for step, committed in ((7, True), (12, False)):
+        d = tmp_path / str(step)
+        d.mkdir()
+        if committed:
+            (d / COMMIT_MARKER).write_text(f"{step}\n")
+    # step 12's dir exists but carries no marker: 7 is the newest
+    # COMMITTED step, and the path is step_path-derived
+    assert ck.latest_committed_step() == 7
+    assert str(ck.latest_committed_path()) == str(ck.step_path(7))
+
+
+# -- router endpoints + version-labelled series ------------------------------
+
+
+async def _router(aiohttp_client, **kw):
+    from kubeflow_tpu.fleet import router as router_mod
+    reg = kw.pop("registry", None) or ReplicaRegistry()
+    kw.setdefault("control_interval_s", 0)
+    kw.setdefault("rollout_interval_s", 0)
+    app = router_mod.create_router_app(reg, block_size=8, **kw)
+    client = await aiohttp_client(app)
+    return client, app[router_mod.FLEET_KEY], reg
+
+
+async def test_fleet_versions_and_rollouts_roundtrip(aiohttp_client):
+    client, st, reg = await _router(aiohttp_client)
+    # zero state first: conserved, no rollouts, manager idle
+    body = await (await client.get("/fleet/rollouts")).json()
+    assert body["conserved"] is True
+    assert body["started"] == body["finished"] == body["active"] == 0
+    assert body["manager"]["active"] is None
+    r = await client.post("/fleet/versions", json={
+        "version": "step-3", "model": "llama-tiny", "step": 3,
+        "source": {"checkpoint": "/ckpt", "step": 3}})
+    assert r.status == 200
+    assert (await r.json())["published"] is True
+    # idempotent replay
+    r = await client.post("/fleet/versions", json={"version": "step-3"})
+    assert (await r.json())["published"] is False
+    # vocabulary enforced at the door
+    for bad in ({"version": "no way!"}, {"version": ""},
+                {"version": "v", "source": ["x"]}, ["not a dict"]):
+        r = await client.post("/fleet/versions", json=bad)
+        assert r.status == 400
+    body = await (await client.get("/fleet/versions")).json()
+    assert body["current"] == ""
+    assert [e["version"] for e in body["versions"]] == ["step-3"]
+    # publish flowed into the zero-seeded counter
+    assert st.obs.rollout_published.value() == 1
+    # manual knobs round-trip
+    r = await client.post("/fleet/rollouts", json={"pin": True})
+    assert (await r.json())["pinned"] is True
+    assert st.rollout.pinned
+    r = await client.post("/fleet/rollouts",
+                          json={"rollback": True, "reason": "ops"})
+    assert (await r.json())["rollback_requested"] is False
+    r = await client.post("/fleet/rollouts", json={})
+    assert r.status == 400
+
+
+async def test_heartbeat_version_label_and_metrics(aiohttp_client):
+    client, st, reg = await _router(aiohttp_client)
+    r = await client.post("/fleet/register", json={
+        "id": "a", "url": "http://127.0.0.1:1", "version": "step-3"})
+    assert r.status == 200
+    assert reg.get("a").version == "step-3"
+    await client.post("/fleet/heartbeat", json={
+        "id": "a", "version": "step-4"})
+    assert reg.get("a").version == "step-4"
+    # invalid version strings are DROPPED, not adopted
+    await client.post("/fleet/heartbeat", json={
+        "id": "a", "version": "café"})
+    assert reg.get("a").version == "step-4"
+    body = await (await client.get("/fleet/replicas")).json()
+    rep = [x for x in body["replicas"] if x["id"] == "a"][0]
+    assert rep["version"] == "step-4"
+    # version-labelled parallel gauge series beside the {state,pool}
+    # ones; unlabeled-by-version cells keep their meaning
+    fams = obs_lib.parse_exposition(
+        await (await client.get("/metrics")).text())
+    reps = fams["fleet_replicas"]["samples"]
+    assert reps[("fleet_replicas",
+                 (("state", "ready"), ("version", "step-4")))] == 1.0
+    assert reps[("fleet_replicas",
+                 (("state", "dead"), ("version", "step-4")))] == 0.0
+    # rollout families zero-seeded on first scrape
+    trans = fams["fleet_rollout_transitions_total"]["samples"]
+    for ph in PHASES:
+        assert trans[("fleet_rollout_transitions_total",
+                      (("phase", ph),))] == 0.0
+    assert fams["fleet_rollout_active"]["samples"][
+        ("fleet_rollout_active", ())] == 0.0
+
+
+def test_federate_version_parallel_series():
+    from kubeflow_tpu.obs.federation import federate
+    text = ("# HELP c t\n# TYPE c counter\nc 1\n")
+    merged = federate(
+        {"a": text, "b": text, "down": None},
+        versions={"a": "v1", "down": "v9"},
+        version_guard=obs_lib.LabelGuard(max_values=8))
+    fams = obs_lib.parse_exposition(merged)
+    up = fams["fleet_federation_up"]["samples"]
+    # plain per-replica series unchanged by the version plumbing
+    assert up[("fleet_federation_up", (("replica", "a"),))] == 1.0
+    assert up[("fleet_federation_up", (("replica", "b"),))] == 1.0
+    assert up[("fleet_federation_up", (("replica", "down"),))] == 0.0
+    # parallel version-labelled series only for versioned replicas
+    assert up[("fleet_federation_up",
+               (("replica", "a"), ("version", "v1")))] == 1.0
+    assert up[("fleet_federation_up",
+               (("replica", "down"), ("version", "v9")))] == 0.0
+    assert ("fleet_federation_up",
+            (("replica", "b"), ("version", ""))) not in up
+    assert fams["c"]["samples"][("c", ())] == 2.0
+
+
+# -- CRD annotation rendering ------------------------------------------------
+
+
+def test_model_version_annotation_renders_flag():
+    from kubeflow_tpu.api.crds import ModelServer
+    from kubeflow_tpu.controlplane.cluster import Cluster, ClusterConfig
+    from kubeflow_tpu.controlplane.controllers.modelserver import (
+        MODEL_VERSION_ANNOTATION,
+    )
+
+    def mk(name, **spec):
+        ms = ModelServer()
+        ms.metadata.name = name
+        ms.metadata.namespace = "user1"
+        for k, v in spec.items():
+            setattr(ms.spec, k, v)
+        return ms
+
+    with Cluster(ClusterConfig()) as cluster:
+        # no version anywhere: no flag rendered
+        cluster.store.create(mk("plain", model="llama-tiny"))
+        # spec default
+        cluster.store.create(mk("specd", model="llama-tiny",
+                                model_version="step-1"))
+        # annotation (the rollout consumer's write) wins over spec
+        ms = mk("pinned", model="llama-tiny", model_version="step-1")
+        ms.metadata.annotations[MODEL_VERSION_ANNOTATION] = "step-9"
+        cluster.store.create(ms)
+        assert cluster.wait_idle()
+
+        def args_of(name):
+            dep = cluster.store.get("Deployment", "user1", name)
+            return dep.spec.template.spec.containers[0].args
+
+        assert "--model-version" not in args_of("plain")
+        a = args_of("specd")
+        assert a[a.index("--model-version") + 1] == "step-1"
+        a = args_of("pinned")
+        assert a[a.index("--model-version") + 1] == "step-9"
