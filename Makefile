@@ -1,7 +1,7 @@
 # Developer entry points (the reference drives everything through
 # per-component Makefiles; here one root Makefile covers the repo).
 
-.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check profile-check serving-check fleet-check kernels-check tenancy-check chaos-check train-check train-obs-check disagg-check cache-check control-check rollout-check
+.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check profile-check serving-check fleet-check kernels-check tenancy-check chaos-check train-check train-obs-check disagg-check cache-check cache-tier-check control-check rollout-check
 
 verify-all:  ## the full evidence sweep, one command
 	python -m pytest tests -q -m "slow or not slow"
@@ -83,6 +83,11 @@ cache-check: ## KV-cache observatory gate: ledger/heat/counterfactual suite + ca
 	JAX_PLATFORMS=cpu python -m pytest tests/test_cachestats.py -q \
 	  -m "slow or not slow"
 	JAX_PLATFORMS=cpu python -m ci.obs_check cache
+
+cache-tier-check: ## fleet cache-tier gate: spill/restore + peer-fetch suite + tier metrics contract
+	JAX_PLATFORMS=cpu python -m pytest tests/test_cache_tier.py -q \
+	  -m "slow or not slow"
+	JAX_PLATFORMS=cpu python -m ci.obs_check cache-tier
 
 control-check: ## closed-loop control gate: hysteresis/ledger/actuator suite + decision-plane metrics contract
 	JAX_PLATFORMS=cpu python -m pytest tests/test_control.py -q \

@@ -586,6 +586,173 @@ async def run_cache_check() -> list[str]:
     return failures
 
 
+async def run_cache_tier_check() -> list[str]:
+    """Cache-tier act (ISSUE 19): the host-RAM spill tier contract.
+    Boot the serving app with the smallest legal paged pool plus a
+    spill tier, drive enough distinct prompts that allocation pressure
+    demotes cold radix chains to the host, then re-request the first
+    prompt so a demoted block is RESTORED — and hold the plane to its
+    contract: `serving_prefill_tokens{source}` zero-seeded over the
+    CLOSED four-source set and `fleet_peer_fetch_total{outcome}` over
+    the CLOSED outcome set; the spill demotion/restore counters and
+    byte gauge agree with the ledger; the restored re-request books
+    `source="restored"` tokens AND replays token-identically; and the
+    EXTENDED conservation (births − frees == live + spilled, with
+    restores netted out) holds under pressure."""
+    import jax
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from kubeflow_tpu import obs as obs_lib
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.serving import (
+        EngineConfig,
+        InferenceEngine,
+        LLAMA_FAMILY,
+    )
+    from kubeflow_tpu.serving import server as server_lib
+
+    failures: list[str] = []
+    cfg = llama.LLAMA_TINY
+    params = llama.init(jax.random.key(0), cfg)
+    engine = InferenceEngine(params, cfg, LLAMA_FAMILY,
+                             EngineConfig(max_len=64))
+    # 9 blocks = trash + one slot's worth at max_len 64 / block 8: the
+    # smallest legal pool. Each retired 12-token prompt parks one full
+    # KV block in the radix, so ten distinct prompts overflow the 8
+    # usable blocks and the allocator demotes the LRU chains to the
+    # host tier instead of discarding them.
+    app = server_lib.create_serving_app(
+        {"m": engine}, continuous=True, max_batch=2, kv_block_size=8,
+        kv_pool_blocks=9, kv_spill_bytes=64 << 20)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        def prompt(i: int) -> list[int]:
+            # distinct FIRST blocks (the spill key is the full token
+            # path, so the lead tokens must differ per prompt)
+            return [40 + i] * 4 + [3, 5, 7, 11, 13, 17, 19, 23]
+
+        first = None
+        for i in range(10):
+            r = await client.post(
+                "/v1/models/m:generate",
+                json={"tokens": [prompt(i)], "max_new": 4})
+            if r.status != 200:
+                return [f"generate[{i}] -> {r.status}: "
+                        f"{await r.text()}"]
+            if i == 0:
+                first = (await r.json())["tokens"]
+        # the re-request: its first block was demoted under pressure,
+        # so the radix miss must be answered from the host tier
+        r = await client.post(
+            "/v1/models/m:generate",
+            json={"tokens": [prompt(0)], "max_new": 4})
+        if r.status != 200:
+            return [f"restored generate -> {r.status}: "
+                    f"{await r.text()}"]
+        again = (await r.json())["tokens"]
+        if again != first:
+            failures.append(
+                f"restored replay diverged: {again} != {first} — the "
+                "spill tier returned different KV content than the "
+                "original prefill")
+
+        text = await (await client.get("/metrics")).text()
+        try:
+            families = parse_exposition(text)
+        except ExpositionError as e:
+            return [f"serving /metrics failed strict parse: {e}"]
+
+        def sample(fam: str, sname: str, **labels):
+            f = families.get(fam)
+            if f is None:
+                failures.append(f"/metrics missing family {fam}")
+                return None
+            key = (sname, tuple(sorted(labels.items())))
+            if key not in f["samples"]:
+                failures.append(
+                    f"/metrics missing sample {sname}{labels}")
+                return None
+            return f["samples"][key]
+
+        # 1. zero-seeded CLOSED grids: all four prefill sources, all
+        # three peer-fetch outcomes, from the first scrape
+        counts = {s: sample("serving_prefill_tokens",
+                            "serving_prefill_tokens_count",
+                            model="m", source=s)
+                  for s in obs_lib.PREFILL_SOURCES}
+        fetches = {o: sample("fleet_peer_fetch_total",
+                             "fleet_peer_fetch_total",
+                             model="m", outcome=o)
+                   for o in obs_lib.PEER_FETCH_OUTCOMES}
+        if any(v for v in fetches.values() if v):
+            failures.append(
+                f"peer fetches booked with no peer configured: "
+                f"{fetches}")
+        if not counts.get("restored"):
+            failures.append(
+                "serving_prefill_tokens{source=restored} never "
+                f"observed (counts: {counts}) — the spilled block was "
+                "not promoted back on the warm re-request")
+        if counts.get("peer_fetched"):
+            failures.append(
+                "peer_fetched tokens booked on a single replica: "
+                f"{counts}")
+
+        # 2. spill counters + byte gauge vs the ledger's books
+        demos = sample("serving_kv_spill_demotions_total",
+                       "serving_kv_spill_demotions_total", model="m")
+        rests = sample("serving_kv_spill_restores_total",
+                       "serving_kv_spill_restores_total", model="m")
+        gauge = sample("serving_kv_spill_bytes",
+                       "serving_kv_spill_bytes", model="m")
+        spill_evict = sample("serving_kv_evictions_total",
+                             "serving_kv_evictions_total",
+                             model="m", cause="spill")
+        if not demos:
+            failures.append(
+                "no spill demotions under a pool 4x smaller than the "
+                "working set — pressure evictions bypassed the tier")
+        if not rests:
+            failures.append("no spill restores after the warm "
+                            "re-request of a demoted prefix")
+        if demos is not None and spill_evict != demos:
+            failures.append(
+                f"evictions{{cause=spill}} = {spill_evict} != "
+                f"demotions counter {demos}: one booking chokepoint "
+                "drifted from the other")
+
+        prof = json.loads(
+            await (await client.get("/debug/profile")).text())
+        led = (prof.get("models", {}).get("m", {})
+               .get("cache", {}).get("ledger", {}))
+        sp = led.get("spill")
+        if not isinstance(sp, dict):
+            failures.append("/debug/profile cache.ledger has no spill "
+                            "section")
+        else:
+            if demos is not None and sp.get("demotions") != demos:
+                failures.append(
+                    f"ledger demotions {sp.get('demotions')} != metric "
+                    f"{demos}")
+            if rests is not None and sp.get("restores") != rests:
+                failures.append(
+                    f"ledger restores {sp.get('restores')} != metric "
+                    f"{rests}")
+            if gauge is not None and \
+                    (gauge > 0) != (sp.get("spilled", 0) > 0):
+                failures.append(
+                    f"serving_kv_spill_bytes = {gauge} disagrees with "
+                    f"ledger spilled = {sp.get('spilled')}")
+        if not led.get("conserved"):
+            failures.append(
+                "cache ledger NOT conserved under spill pressure: "
+                f"{led}")
+    finally:
+        await client.close()
+    return failures
+
+
 async def run_train_check() -> list[str]:
     """Fourth act (ISSUE 11): boot the elastic-training coordinator —
     real aiohttp app, no jax — and hold its /metrics to the strict
@@ -1429,6 +1596,7 @@ def main(argv: list[str] | None = None) -> int:
         "train-obs": run_train_obs_check,
         "disagg": run_disagg_check,
         "cache": run_cache_check,
+        "cache-tier": run_cache_tier_check,
         "control": run_control_check,
         "rollout": run_rollout_check,
     }

@@ -630,6 +630,46 @@ def cache_check_workflow() -> dict:
     }
 
 
+def cache_tier_check_workflow() -> dict:
+    """Fleet cache-tier gate (ISSUE 19): `make cache-tier-check` runs
+    the spill-tier suite (spill/restore token parity on two model
+    families, the EXTENDED conservation invariant births − frees ==
+    live + spilled, budget-ordered host evictions, the peer-fetch
+    degradation matrix — dead peer / geometry mismatch / stale hint
+    all fall back to plain prefill token-identically — and the
+    router's X-KV-Peer hint through two real replicas) plus the tier
+    metrics contract (`serving_prefill_tokens{source}` and
+    `fleet_peer_fetch_total{outcome}` zero-seeded over their CLOSED
+    sets, spill counters == ledger books, a live demote->restore
+    round-trip replaying token-identically under pressure)."""
+    return {
+        "name": "cache tier check",
+        "on": {
+            "pull_request": {"paths": ["kubeflow_tpu/obs/**",
+                                       "kubeflow_tpu/serving/**",
+                                       "kubeflow_tpu/fleet/**",
+                                       "tests/test_cache_tier.py",
+                                       "ci/obs_check.py",
+                                       "Makefile"]},
+            "push": {"branches": ["main"]},
+        },
+        "jobs": {
+            "cache-tier-check": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e .[ci] pytest"},
+                    {"name": "spill/peer suite + tier metrics contract",
+                     "run": "make cache-tier-check",
+                     "env": {"JAX_PLATFORMS": "cpu"}},
+                ],
+            }
+        },
+    }
+
+
 def control_check_workflow() -> dict:
     """Closed-loop control gate (ISSUE 16): `make control-check` runs
     the controller suite (hysteresis/cooldown math on a fake clock,
@@ -850,6 +890,7 @@ def all_workflows() -> dict[str, dict]:
     out["train_obs_check.yaml"] = train_obs_check_workflow()
     out["disagg_check.yaml"] = disagg_check_workflow()
     out["cache_check.yaml"] = cache_check_workflow()
+    out["cache_tier_check.yaml"] = cache_tier_check_workflow()
     out["control_check.yaml"] = control_check_workflow()
     out["rollout_check.yaml"] = rollout_check_workflow()
     out["tenancy_check.yaml"] = tenancy_check_workflow()

@@ -55,7 +55,13 @@ log = logging.getLogger(__name__)
 #                   (generation bump; survivors resume at the new size)
 #   disable_draft — turn speculative decoding off fleet-wide when the
 #                   draft model stops earning its keep
-ACTIONS = ("scale_out", "drain_replica", "evict_worker", "disable_draft")
+#   shift_pool_split — lean the disaggregated prefill/decode split one
+#                   replica toward decode (TTL'd, like the scale_out
+#                   floor): pressure evictions mean decode KV demand
+#                   outgrew its pool share, and /fleet/autoscale folds
+#                   the shift into its recommendation
+ACTIONS = ("scale_out", "drain_replica", "evict_worker", "disable_draft",
+           "shift_pool_split")
 
 _SIGNAL_MODES = ("value", "rate")
 _SIGNAL_REDUCES = ("max", "sum", "avg")
@@ -478,8 +484,19 @@ def router_actuators(st, *, elastic_url: str | None = None,
                 f"no replica accepted the draft disable: {results}")
         return {"replicas": results, "enabled": False}
 
+    async def shift_pool_split(policy: Policy, evidence: dict) -> dict:
+        # one replica of lean per fire, TTL'd like the scale_out
+        # floor: when the burn stops, the shift quietly expires and
+        # the phase-seconds recommendation takes back over
+        shift = min(int(getattr(st, "pool_shift", 0)) + 1, 8)
+        st.pool_shift = shift
+        st.pool_shift_until = clk() + floor_ttl_s
+        return {"pool_shift": shift, "shift_ttl_s": floor_ttl_s,
+                "disaggregated": st.registry.disaggregated()}
+
     return {"scale_out": scale_out, "drain_replica": drain_replica,
-            "evict_worker": evict_worker, "disable_draft": disable_draft}
+            "evict_worker": evict_worker, "disable_draft": disable_draft,
+            "shift_pool_split": shift_pool_split}
 
 
 def aiohttp_timeout(total: float):
@@ -495,16 +512,21 @@ def default_policies(*, burn_threshold: float = 1.0,
                      cooldown_s: float = 20.0,
                      verify_window_s: float = 30.0,
                      kv_pressure_rate: float = 5.0,
+                     kv_shift_rate: float | None = None,
                      straggler_ratio: float = 0.25) -> list[Policy]:
     """The canonical policy set the closed-loop chaos arm and the docs
-    describe — one policy per actuator, driven by the four signals the
+    describe — one policy per actuator, driven by the signals the
     observability PRs built:
 
     - router availability burn (short window) -> scale out
     - fleet-wide pressure-eviction rate       -> drain the hot replica
     - train straggler ratio                   -> evict the straggler
     - speculative-acceptance burn             -> disable the draft
+    - pressure-eviction rate (half the drain
+      threshold: the gentler lever fires first) -> shift pool split
     """
+    if kv_shift_rate is None:
+        kv_shift_rate = kv_pressure_rate / 2
     return [
         Policy(name="availability_burn_scale_out",
                signal=Signal("slo_burn_rate",
@@ -529,6 +551,14 @@ def default_policies(*, burn_threshold: float = 1.0,
                clear=straggler_ratio / 2,
                cooldown_s=cooldown_s, verify_window_s=verify_window_s,
                action="evict_worker"),
+        Policy(name="kv_pressure_shift_split",
+               signal=Signal("serving_kv_evictions_total",
+                             {"cause": "pressure"},
+                             mode="rate", reduce="sum"),
+               threshold=kv_shift_rate,
+               clear=kv_shift_rate / 2,
+               cooldown_s=cooldown_s, verify_window_s=verify_window_s,
+               action="shift_pool_split"),
         Policy(name="spec_acceptance_burn_draft_off",
                signal=Signal("slo_burn_rate",
                              {"slo": "serving_spec_acceptance",

@@ -332,6 +332,26 @@ class ReplicaRegistry:
             out[rep.pool][rep.state] += 1
         return out
 
+    def digest_carriers(self, prefix: str,
+                        exclude: str = "") -> list[Replica]:
+        """Live (ready/degraded) replicas whose heartbeat heat digest
+        advertises `prefix` (a 16-hex `prefix_hash`), hottest first.
+        These are the candidates a cold replica can pull the prefix's
+        KV blocks from — the router's `X-KV-Peer` hint and the
+        counterfactual remote-hit check both read this. Draining and
+        dead replicas are skipped: a block pull must not pin work on
+        a replica that is leaving."""
+        scored: list[tuple[float, Replica]] = []
+        for rep in self._replicas.values():
+            if rep.id == exclude or rep.state not in (READY, DEGRADED):
+                continue
+            for e in rep.cache_digest:
+                if e.get("prefix") == prefix:
+                    scored.append((float(e.get("score", 0.0)), rep))
+                    break
+        scored.sort(key=lambda t: (-t[0], t[1].id))
+        return [rep for _, rep in scored]
+
     def disaggregated(self) -> bool:
         """True when the fleet actually runs split pools: at least one
         live (ready/degraded) prefill replica AND one live decode

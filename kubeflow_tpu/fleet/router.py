@@ -442,11 +442,15 @@ class _FleetState:
                  block_size: int, policy: str, hedge_after_s: float,
                  retries: int, backoff_s: float, timeout_s: float,
                  tenancy: TenancyConfig | None = None,
-                 max_attempts: int | None = None, chaos=None):
+                 max_attempts: int | None = None, chaos=None,
+                 peer_hints: bool = True):
         self.registry = registry
         self.obs = obs
         self.block_size = block_size
         self.policy = policy
+        # X-KV-Peer heat hints (ISSUE 19): off = the control arm of
+        # the cache-tier A/B (replicas never peer-fetch)
+        self.peer_hints = peer_hints
         self.hedge_after_s = hedge_after_s
         self.retries = retries
         self.backoff_s = backoff_s
@@ -486,6 +490,10 @@ class _FleetState:
         self.control_task: asyncio.Task | None = None
         self.control_floor = 0
         self.control_floor_until = float("-inf")
+        # shift_pool_split actuator (ISSUE 19): TTL'd lean of the
+        # prefill/decode recommendation toward decode, in replicas
+        self.pool_shift = 0
+        self.pool_shift_until = float("-inf")
         # Rollout plane (ISSUE 18): version registry, conservation-
         # checked phase ledger, manager + its background task. Always
         # constructed by create_router_app (like the controller) so
@@ -632,11 +640,16 @@ async def _chaos_gate(st: _FleetState, rep, name: str, raw: bytes,
 
 
 async def _call_replica(st: _FleetState, rep, name: str, raw: bytes,
-                        tried: set, headers: dict):
+                        tried: set, headers: dict, body=None):
     """One proxied generate against one replica. Success returns
     (status, payload, replica, upstream_trace_id); replica-side
     failures mark the replica, add it to `tried`, and raise
-    `_UpstreamError` so the caller moves on."""
+    `_UpstreamError` so the caller moves on. `body` (the parsed
+    request, when the caller has it) enables the per-TARGET
+    `X-KV-Peer` heat hint — it must be computed here, against the
+    replica actually dialed, because a hedge dispatch goes to a
+    different replica whose digest changes the answer."""
+    headers = _with_peer_hint(st, body, rep, headers)
     st.registry.note_dispatch(rep.id)
     try:
         await _chaos_gate(st, rep, name, raw, headers)
@@ -662,7 +675,7 @@ async def _call_replica(st: _FleetState, rep, name: str, raw: bytes,
 async def _race_hedged(st: _FleetState, primary, name: str, raw: bytes,
                        key: bytes, tried: set, model: str,
                        headers: dict, budget: list,
-                       pool: str | None = None):
+                       pool: str | None = None, body=None):
     """Dispatch to `primary`; past the hedge deadline, duplicate to a
     second replica (from the same disaggregation `pool`, if any) and
     take whichever answers first. Every dispatch (primary and hedge
@@ -672,7 +685,8 @@ async def _race_hedged(st: _FleetState, primary, name: str, raw: bytes,
     every dispatched replica failed (all are in `tried` by then)."""
     budget[0] -= 1
     tasks = {asyncio.create_task(_call_replica(st, primary, name, raw,
-                                               tried, headers))}
+                                               tried, headers,
+                                               body=body))}
     hedged_id = None
     if st.hedge_after_s > 0:
         done, _pending = await asyncio.wait(tasks,
@@ -684,7 +698,8 @@ async def _race_hedged(st: _FleetState, primary, name: str, raw: bytes,
                 hedged_id = hedge_rep.id
                 st.obs.note_route("hedge", hedge_rep.pool)
                 tasks.add(asyncio.create_task(_call_replica(
-                    st, hedge_rep, name, raw, tried, headers)))
+                    st, hedge_rep, name, raw, tried, headers,
+                    body=body)))
     winner = None
     pending = tasks
     while pending:
@@ -888,6 +903,38 @@ def _note_counterfactual(st: "_FleetState", body, rep) -> None:
             return
 
 
+def _with_peer_hint(st: "_FleetState", body, rep,
+                    headers: dict) -> dict:
+    """Attach the `X-KV-Peer` heat hint for one dispatch target: when
+    `rep`'s heartbeat digest does NOT show this request's routing
+    prefix but a live peer's digest does — exactly the condition
+    `fleet_prefix_remote_hits_total` counts as a missed remote hit —
+    the hint names the hottest carrier so the replica can pull the
+    prefix's KV blocks instead of prefilling cold. Returns `headers`
+    untouched (same object) when no hint applies; the hint rides a
+    COPY, because the caller reuses its dict across retries/hedges
+    to different targets."""
+    if not getattr(st, "peer_hints", True):
+        return headers                      # A/B control arm
+    if not isinstance(body, dict) or body.get("prefix"):
+        # registered-prefix expansion happens replica-side; the
+        # router cannot name the expanded first block
+        return headers
+    toks = affinity_tokens(body, st.block_size)
+    if not toks or len(toks) < st.block_size:
+        # shorter than one full block: nothing a peer could export
+        return headers
+    h = obs_lib.prefix_hash(toks)
+    if any(e.get("prefix") == h for e in rep.cache_digest):
+        return headers                      # target already hot
+    carriers = st.registry.digest_carriers(h, exclude=rep.id)
+    if not carriers:
+        return headers
+    out = dict(headers)
+    out["X-KV-Peer"] = carriers[0].url
+    return out
+
+
 async def _routed_generate(request: web.Request):
     st: _FleetState = request.app[FLEET_KEY]
     name = request.match_info["name"]
@@ -970,7 +1017,7 @@ async def _routed_generate(request: web.Request):
             result = await _race_hedged(st, replica, name,
                                         dispatch_raw, key, tried,
                                         name, fwd_headers, budget,
-                                        pool=pool)
+                                        pool=pool, body=body)
             if result is None:
                 continue  # dispatched replicas failed; retry others
             status, payload, rep, hedge_won, trace = result
@@ -1073,15 +1120,18 @@ async def _routed_stream(request: web.Request, st: _FleetState,
             if not failed_over:
                 failed_over = True
                 st.obs.failover.inc()
+        # per-target heat hint: recomputed every attempt because the
+        # failover replica's digest (and the live peer set) differ
+        hdrs = _with_peer_hint(st, body, replica, fwd_headers)
         st.registry.note_dispatch(replica.id)
         budget -= 1
         try:
             await _chaos_gate(st, replica, name, dispatch_raw,
-                              fwd_headers)
+                              hdrs)
             async with st.session.post(
                     f"{replica.url}/v1/models/{name}:generate",
                     data=dispatch_raw,
-                    headers=_inject_trace_context(st, fwd_headers),
+                    headers=_inject_trace_context(st, hdrs),
                     timeout=aiohttp.ClientTimeout(
                         total=st.timeout_s)) as up:
                 if up.status >= 500:
@@ -1367,13 +1417,25 @@ async def _autoscale(request: web.Request):
             prec = autoscale.recommend_pools(
                 st.registry.replicas(), min_replicas=lo,
                 max_replicas=hi)
+            # controller lean (shift_pool_split, TTL'd): move whole
+            # replicas of the recommendation from prefill to decode,
+            # never below one prefill replica
+            shift = (st.pool_shift
+                     if st.registry.clock() < st.pool_shift_until
+                     else 0)
+            prefill, decode = prec.prefill, prec.decode
+            if shift:
+                total = prefill + decode
+                decode = min(total - 1, decode + shift)
+                prefill = total - decode
             return web.json_response({
                 "desired": max(prec.desired, min(hi, floor)),
-                "pools": {"prefill": prec.prefill,
-                          "decode": prec.decode},
+                "pools": {"prefill": prefill,
+                          "decode": decode},
                 "reason": prec.reason,
                 "signals": prec.signals,
-                "controller_floor": floor})
+                "controller_floor": floor,
+                "pool_shift": shift})
         rec = autoscale.recommend_replicas(
             st.registry.replicas(), min_replicas=lo, max_replicas=hi)
     except ValueError as e:
@@ -1690,6 +1752,7 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
                       rollout_burn_threshold: float = 2.0,
                       rollout_ttft_slo_s: float = 1.5,
                       rollout_confirm_timeout_s: float = 60.0,
+                      peer_hints: bool = True,
                       ) -> web.Application:
     """Build the router app. `block_size` must match the replicas'
     `kv_block_size` (the affinity key is the first block — a mismatch
@@ -1722,7 +1785,9 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
     reloaded replica may take to re-register with the new version
     label. `rollout_interval_s <= 0` disables the background loop
     (tests and `ci/obs_check rollout` drive `step()` by hand);
-    `/fleet/rollouts` serves the phase ledger either way."""
+    `/fleet/rollouts` serves the phase ledger either way.
+    `peer_hints=False` disables the `X-KV-Peer` heat hints (the
+    cache-tier A/B's control arm — replicas never peer-fetch)."""
     if policy not in ("affinity", "roundrobin"):
         raise ValueError(f"unknown policy {policy!r}")
     if block_size < 1:
@@ -1739,7 +1804,7 @@ def create_router_app(registry: ReplicaRegistry | None = None, *,
                      hedge_after_s=hedge_after_s, retries=retries,
                      backoff_s=backoff_s, timeout_s=request_timeout_s,
                      tenancy=tenancy, max_attempts=max_attempts,
-                     chaos=chaos)
+                     chaos=chaos, peer_hints=peer_hints)
     # Closed-loop controller: constructed with or without policies so
     # /fleet/decisions always answers; the background loop only runs
     # when there are policies to evaluate.

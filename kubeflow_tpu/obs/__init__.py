@@ -24,6 +24,8 @@ from __future__ import annotations
 from kubeflow_tpu.obs.cachestats import (
     DEFER_CAUSES,
     EVICTION_CAUSES,
+    PEER_FETCH_OUTCOMES,
+    PREFILL_SOURCES,
     REUSE_BUCKETS,
     UNATTRIBUTED,
     CacheLedger,
@@ -84,6 +86,8 @@ __all__ = [
     "DEFER_CAUSES",
     "EVICTION_CAUSES",
     "LATENCY_BUCKETS",
+    "PEER_FETCH_OUTCOMES",
+    "PREFILL_SOURCES",
     "REUSE_BUCKETS",
     "SIZE_BUCKETS",
     "TOKEN_BUCKETS",

@@ -43,7 +43,11 @@ from .metrics import sample_quantile
 #   divergence — copy-on-write/import duplicate: a block whose contents
 #                already exist under another id (freed immediately)
 #   migration  — blocks handed to / rolled back from a peer replica
-EVICTION_CAUSES = ("lru", "pressure", "refdrop", "divergence", "migration")
+#   spill      — LRU victim DEMOTED to the host-RAM spill tier instead
+#                of discarded: the device block is freed but the
+#                content survives on the host (restorable)
+EVICTION_CAUSES = ("lru", "pressure", "refdrop", "divergence",
+                   "migration", "spill")
 # Where a `pool.free()` with no stated cause is booked. Conservation CI
 # asserts this series stays at zero — it existing (zero-seeded) is what
 # makes "every free site states its cause" checkable from /metrics.
@@ -52,6 +56,19 @@ UNATTRIBUTED = "unattributed"
 # _total{cause}`): per-tenant KV quota vs the pool simply being empty
 # even after LRU eviction.
 DEFER_CAUSES = ("kv_quota", "pool_exhausted")
+# Where an admitted prompt's tokens came from
+# (`serving_prefill_tokens{source}` — CLOSED set, zero-seeded):
+#   computed     — suffix actually prefilled on the device
+#   reused       — served from device-resident cached KV (radix hit)
+#   restored     — promoted from the host-RAM spill tier (host->device
+#                  copy; a radix hit whose content had been demoted)
+#   peer_fetched — imported from a peer replica's cache via the
+#                  router's X-KV-Peer heat hint
+PREFILL_SOURCES = ("computed", "reused", "restored", "peer_fetched")
+# Outcome of one replica-side peer block fetch
+# (`fleet_peer_fetch_total{outcome}` — CLOSED set, zero-seeded). Only
+# `ok` imported blocks; miss/failed degraded to plain prefill.
+PEER_FETCH_OUTCOMES = ("ok", "miss", "failed")
 
 # Reuse-distance / block-age buckets, in ADMISSIONS (logical ticks, one
 # per admitted request) — powers of two out past any realistic pool
@@ -100,6 +117,19 @@ class CacheLedger:
     Conservation invariant (asserted by tests and `ci/obs_check cache`):
         births - sum(frees over all causes) == pool.in_use
     and `frees[UNATTRIBUTED] == 0` — every free site states its cause.
+
+    With a host-RAM spill tier attached (PR 19) the ledger also books
+    the CONTENT lifecycle: a `spill` free demotes a block's content to
+    the host tier (`spilled` += 1), `note_restore` moves it back into
+    a freshly-allocated device block (the alloc's birth is a re-birth,
+    not new content), and `note_spill_drop` books host-tier budget
+    evictions (content truly dead). The extended conservation — the
+    ISSUE-19 shorthand `births − frees == live + spilled` — is then
+        (births - restores) - (frees_total - frees["spill"] + drops)
+            == live_blocks + spilled
+    i.e. content born minus content dead equals content reachable on
+    device plus content parked on the host. Both equalities must hold
+    for `snapshot()["conserved"]`.
     """
 
     def __init__(self, *, window: int = _WINDOW,
@@ -110,6 +140,13 @@ class CacheLedger:
         self.births = 0
         self.frees = {c: 0 for c in (*EVICTION_CAUSES, UNATTRIBUTED)}
         self.defers = {c: 0 for c in DEFER_CAUSES}
+        # Host-RAM spill tier accounting (PR 19). `spilled` counts
+        # block contents currently parked on the host; demotions /
+        # restores / drops are the cumulative transitions in and out.
+        self.spilled = 0
+        self.spill_demotions = 0
+        self.spill_restores = 0
+        self.spill_drops = 0
         # live block id -> (birth_tick, last_use_tick)
         self._live: dict[int, list[int]] = {}
         self._reuse = deque(maxlen=window)   # distances, in admissions
@@ -125,6 +162,9 @@ class CacheLedger:
         self.on_reuse: Callable[[int], None] | None = None
         self.on_age: Callable[[int], None] | None = None
         self.on_defer: Callable[[str], None] | None = None
+        # on_spill(kind, n) with kind in {"demote", "restore", "drop"}
+        # — the server binds the spill counters through this
+        self.on_spill: Callable[[str, int], None] | None = None
 
     # -- pool-side hooks ---------------------------------------------------
 
@@ -149,10 +189,20 @@ class CacheLedger:
                     ages.append(age)
             if n:
                 self.frees[cause] += n
+                if cause == "spill":
+                    # the device block died but its content moved to
+                    # the host tier — the content-conservation books
+                    self.spill_demotions += n
+                    self.spilled += n
                 self._emit_event()
         if n and self.on_free is not None:
             try:
                 self.on_free(cause, n)
+            except Exception:
+                pass
+        if n and cause == "spill" and self.on_spill is not None:
+            try:
+                self.on_spill("demote", n)
             except Exception:
                 pass
         if self.on_age is not None:
@@ -189,6 +239,38 @@ class CacheLedger:
                     self.on_reuse(d)
                 except Exception:
                     pass
+
+    def note_restore(self, n: int) -> None:
+        """`n` spilled block contents copied back into freshly
+        allocated device blocks. The allocs already booked their
+        births via `note_alloc`; this books the host-tier exits so
+        the content-conservation equality nets the re-births out."""
+        n = int(n)
+        if n <= 0:
+            return
+        with self._lock:
+            self.spill_restores += n
+            self.spilled -= n
+        if self.on_spill is not None:
+            try:
+                self.on_spill("restore", n)
+            except Exception:
+                pass
+
+    def note_spill_drop(self, n: int) -> None:
+        """`n` host-tier entries evicted by the tier's byte budget (or
+        lost to a failed restore): the content is truly dead now."""
+        n = int(n)
+        if n <= 0:
+            return
+        with self._lock:
+            self.spill_drops += n
+            self.spilled -= n
+        if self.on_spill is not None:
+            try:
+                self.on_spill("drop", n)
+            except Exception:
+                pass
 
     def note_defer(self, cause: str) -> None:
         if cause not in self.defers:
@@ -228,6 +310,12 @@ class CacheLedger:
                 "frees_total": sum(frees.values()),
                 "live_blocks": len(self._live),
                 "defers": dict(self.defers),
+                "spill": {
+                    "spilled": self.spilled,
+                    "demotions": self.spill_demotions,
+                    "restores": self.spill_restores,
+                    "drops": self.spill_drops,
+                },
             }
         out["reuse_distance"] = {
             "count": len(reuse),
@@ -239,8 +327,19 @@ class CacheLedger:
             "p50": sample_quantile(ages, 0.50),
             "p95": sample_quantile(ages, 0.95),
         }
+        sp = out["spill"]
+        # Device-block conservation (the original invariant) AND the
+        # PR-19 content conservation: births − frees == live + spilled
+        # once restores are netted out of births and spill demotions
+        # out of the deaths (a demote keeps the content alive on the
+        # host; a budget drop or failed restore kills it for real).
+        content_alive = (
+            (out["births"] - sp["restores"])
+            - (out["frees_total"] - frees["spill"] + sp["drops"]))
         out["conserved"] = (out["births"] - out["frees_total"]
                             == out["live_blocks"]
+                            and content_alive
+                            == out["live_blocks"] + sp["spilled"]
                             and frees[UNATTRIBUTED] == 0)
         return out
 

@@ -155,6 +155,15 @@ def main(argv=None) -> int:
                         "interleaved with decode chunks — bounds the "
                         "decode stall a long prompt imposes. 0 = "
                         "monolithic admission prefill")
+    p.add_argument("--kv-spill-bytes", type=int, default=0,
+                   help="host-RAM KV spill tier byte budget "
+                        "(continuous only): radix eviction demotes "
+                        "block contents to host memory instead of "
+                        "discarding, and a returning prefix restores "
+                        "them with a host->device copy instead of "
+                        "recomputing prefill. Size from the "
+                        "reuse-distance histogram's mass beyond the "
+                        "pool (docs/operator-guide.md). 0 = off")
     p.add_argument("--spec-decode", action="store_true",
                    help="speculative decoding on the paged KV cache "
                         "(continuous only): every request drafts "
@@ -228,6 +237,11 @@ def main(argv=None) -> int:
         p.error("--paged-attention-impl requires --continuous")
     if args.prefill_chunk_tokens and not args.continuous:
         p.error("--prefill-chunk-tokens requires --continuous")
+    if args.kv_spill_bytes and not args.continuous:
+        # the spill tier hangs off the continuous batcher's block
+        # pool; silently ignoring the budget would serve with the
+        # recompute-on-evict behavior the operator paid RAM to avoid
+        p.error("--kv-spill-bytes requires --continuous")
     if args.spec_decode and not args.continuous:
         p.error("--spec-decode requires --continuous")
     if args.spec_decode and not args.draft_model:
@@ -322,6 +336,7 @@ def main(argv=None) -> int:
         warmup=args.warmup,
         prefill_chunk=args.prefill_chunk or None,
         prefill_chunk_tokens=args.prefill_chunk_tokens or None,
+        kv_spill_bytes=args.kv_spill_bytes or None,
         pipeline_depth=args.pipeline_depth or None,
         paged_attention_impl=args.paged_attention_impl,
         drafts=drafts,

@@ -75,7 +75,8 @@ from kubeflow_tpu.obs.cachestats import CacheLedger
 from kubeflow_tpu.obs.profiling import CompileWatch, PhaseProfiler
 from kubeflow_tpu.obs.timeline import RequestTimeline, TimelineStore
 from kubeflow_tpu.serving import migration
-from kubeflow_tpu.serving.paged import BlockPool, RadixPrefixCache
+from kubeflow_tpu.serving.paged import (BlockPool, HostSpillTier,
+                                        RadixPrefixCache)
 from kubeflow_tpu.serving.speculative import _dist, _draw
 from kubeflow_tpu.tenancy.ledger import TenantLedger
 from kubeflow_tpu.tenancy.scheduler import FairShareQueue, ReqMeta
@@ -1266,6 +1267,7 @@ class ContinuousBatcher:
                  paged_attention_impl: str = "auto",
                  draft: InferenceEngine | None = None,
                  spec_gamma: int = 4,
+                 kv_spill_bytes: int | None = None,
                  tenancy=None, clock=None):
         # window_ms accepted (and ignored) for constructor parity with
         # Batcher: admission is per-token here, there is no window.
@@ -1343,6 +1345,22 @@ class ContinuousBatcher:
         # bench read snapshot() via cache_anatomy().
         self.cache_ledger = CacheLedger()
         self.cengine.pool.attach_ledger(self.cache_ledger)
+        # Host-RAM spill tier (ISSUE 19): with a byte budget, radix
+        # eviction demotes block contents to host numpy instead of
+        # discarding (deaths booked `spill`), and admission planning
+        # promotes them back with a host->device copy when the same
+        # prefix returns (`note_restore`). Conservation extends to
+        # content: (births - restores) - (non-spill deaths + drops)
+        # == live + spilled. None disables the tier entirely.
+        if kv_spill_bytes is not None and kv_spill_bytes < 0:
+            raise ValueError(
+                f"kv_spill_bytes must be >= 0, got {kv_spill_bytes}")
+        self._spill_tier: HostSpillTier | None = None
+        if kv_spill_bytes is not None:
+            self._spill_tier = HostSpillTier(
+                kv_spill_bytes, self.cengine.kv_block_bytes())
+            self._radix.attach_spill(self._spill_tier,
+                                     self._spill_reader)
         self._dirty: list[int] = []  # freed slots awaiting table reset
         self.prefix_hits = 0      # admissions that reused cached cells
         self.prefix_misses = 0
@@ -1510,6 +1528,11 @@ class ContinuousBatcher:
             "tokens_reused": self.tokens_reused,
             "cached_blocks": self._radix.cached_blocks,
             "blocks_in_use": self.cengine.pool.in_use,
+            # host spill tier occupancy (0s when the tier is off)
+            "spilled_blocks": (self._spill_tier.spilled_blocks
+                               if self._spill_tier is not None else 0),
+            "spilled_bytes": (self._spill_tier.spilled_bytes
+                              if self._spill_tier is not None else 0),
             # top-K decayed prefix heat, 16-hex hashed names — the
             # per-replica half of the fleet heat map (`/fleet/cache`)
             "heat": self._radix.heat_digest(16),
@@ -1967,6 +1990,118 @@ class ContinuousBatcher:
                 self._prefix_states.pop(name)
             raise
 
+    def _spill_reader(self, block: int):
+        """Device->host snapshot of one pool block's K/V payload —
+        the reader `RadixPrefixCache.evict` demotes through. Returns
+        `(k, v)` numpy `[L, 1, bs, n_kv, hd]`, or None when there is
+        no device state yet. Runs synchronously on the caller's
+        thread; a concurrently-donated state raises (deleted buffer),
+        which the cache treats as "demote failed, discard instead"."""
+        if self._st is None:
+            return None
+        return self.cengine.export_blocks(self._st, [block])
+
+    async def _restore_spilled(self, item) -> None:
+        """Promote this request's spilled full-block prefix back into
+        the pool BEFORE block planning, so `_plan_blocks` radix-hits
+        it exactly as if the blocks had never been evicted. Restores
+        are token-identical by the canonical-form invariant: the tier
+        key is the full token prefix, and the payload re-enters the
+        pool through the same `import_blocks` scatter migration uses.
+        Best-effort throughout — any failure (pool full, donated
+        state, partial insert) degrades to plain prefill of the
+        missing cells and never raises into admission. Books
+        `note_restore` for adopted blocks and stamps `meta.restored`
+        so the admission's `on_prefix` can split the metric source."""
+        tier = self._spill_tier
+        if tier is None or tier.spilled_blocks == 0 or item[6]:
+            return
+        tokens, meta = item[0], item[7]
+        full = [int(t) for t in tokens]
+        ns = meta.ns
+        bs = self.cengine.block_size
+        nodes, _pnode, _plen = self._radix.match(full, ns=ns)
+        # walk the tier forward from the cached frontier; the planner
+        # always leaves >= 1 token to prefill, so a block whose last
+        # cell is the final prompt token is useless — stop before it
+        i = len(nodes) * bs
+        end = i
+        while (end + bs <= len(full) - 1
+               and tier.contains(ns, full[:end + bs])):
+            end += bs
+        n = (end - i) // bs
+        if n <= 0:
+            return
+        pool = self.cengine.pool
+        fresh = pool.alloc(n)
+        if fresh is None:
+            # evicting to restore can itself demote colder blocks —
+            # the tier's LRU decides which contents deserve host RAM
+            self._radix.evict(n - pool.num_free)
+            fresh = pool.alloc(n)
+            if fresh is None:
+                return
+        payloads = []
+        for j in range(n):
+            p = tier.pop(ns, full[:i + (j + 1) * bs])
+            if p is None:
+                # budget dropped it between probe and pop (a demote
+                # during our own evict above) — restore what we have
+                break
+            payloads.append(p)
+        if not payloads:
+            pool.free(fresh, cause="refdrop")
+            return
+        if len(payloads) < n:
+            pool.free(fresh[len(payloads):], cause="refdrop")
+            fresh = fresh[:len(payloads)]
+            n = len(payloads)
+        k = np.concatenate([p[0] for p in payloads], axis=1)
+        v = np.concatenate([p[1] for p in payloads], axis=1)
+        loop = asyncio.get_event_loop()
+        done = False
+        booked = False
+        try:
+            if self._st is None:
+                self._st = self.cengine.init_slots()
+
+            def run_restore():
+                # read self._st INSIDE the lock: import_blocks donates
+                # the buffers (same discipline as import_sequence)
+                return self.cengine.import_blocks(self._st, fresh, k, v)
+
+            async with self.gpu_lock:
+                self._st = await loop.run_in_executor(None, run_restore)
+            # every popped payload left the tier and reached the
+            # device: that IS the restore, whether or not the tree
+            # adopts each block below (duplicates die as divergence)
+            self.cache_ledger.note_restore(n)
+            booked = True
+            blocks = {len(nodes) + j: b for j, b in enumerate(fresh)}
+            adopted, _ = self._radix.insert(full[:end], blocks, ns=ns)
+            dup = [b for j, b in blocks.items() if j not in adopted]
+            done = True
+        finally:
+            if not done:
+                # import failed: the blocks never became cached
+                # content, and the popped payloads are gone — content
+                # deaths unless the restore was already booked
+                pool.free(fresh, cause="refdrop")
+                if not booked:
+                    self.cache_ledger.note_spill_drop(n)
+                if self._st is not None and any(
+                        leaf.is_deleted() for leaf in
+                        jax.tree.leaves(self._st)
+                        if hasattr(leaf, "is_deleted")):
+                    self._fail_all(RuntimeError(
+                        "slot state lost to donated spill restore"))
+        if dup:
+            # someone re-cached (part of) this prefix while we copied:
+            # the tree kept its blocks, ours are duplicates
+            pool.free(dup, cause="divergence")
+        if n > len(dup):
+            meta.restored += (n - len(dup)) * bs
+
     def _plan_blocks(self, item):
         """Match one request against the radix cache and reserve its
         physical blocks. Returns a plan dict, or None when the pool
@@ -2095,6 +2230,10 @@ class ContinuousBatcher:
         plans = []
         deferred = []
         for item in items:
+            try:
+                await self._restore_spilled(item)
+            except Exception:  # noqa: BLE001 — restore is best-effort
+                pass           # plain prefill covers whatever's missing
             plan = self._plan_blocks(item)
             if plan is None:
                 deferred.append(item)
@@ -2279,7 +2418,8 @@ class ContinuousBatcher:
                 if self.on_prefix is not None:
                     try:
                         self.on_prefix(computed, reused, reused > 0,
-                                       meta.tenant)
+                                       meta.tenant,
+                                       restored=meta.restored)
                     except Exception:  # noqa: BLE001 — metrics hook
                         pass           # must never kill the worker
                 if resumed:
@@ -2327,6 +2467,10 @@ class ContinuousBatcher:
         for item in mine:
             if item[3].done():
                 continue
+            try:
+                await self._restore_spilled(item)
+            except Exception:  # noqa: BLE001 — restore is best-effort
+                pass           # plain prefill covers whatever's missing
             plan = self._plan_blocks(item)
             if plan is None:
                 deferred.append(item)
@@ -2499,7 +2643,9 @@ class ContinuousBatcher:
             try:
                 self.on_prefix(
                     len(suffix), reused, reused > 0,
-                    rec.meta.tenant if rec.meta is not None else "")
+                    rec.meta.tenant if rec.meta is not None else "",
+                    restored=(rec.meta.restored
+                              if rec.meta is not None else 0))
             except Exception:  # noqa: BLE001 — metrics hook
                 pass           # must never kill the worker
         if self.cengine.draft is not None and self.spec_enabled:

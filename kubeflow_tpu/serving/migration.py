@@ -33,6 +33,8 @@ import base64
 
 import numpy as np
 
+from kubeflow_tpu.obs.cachestats import prefix_hash
+
 __all__ = [
     "MIGRATION_WIRE_VERSION",
     "pool_geometry",
@@ -41,6 +43,8 @@ __all__ = [
     "decode_kv",
     "pack_record",
     "unpack_record",
+    "prefix_fetch_request",
+    "validate_fetch_request",
 ]
 
 MIGRATION_WIRE_VERSION = 1
@@ -135,6 +139,59 @@ def pack_record(*, request_id: str, tenant: str, ns: str,
         "geometry": dict(geometry),
         "kv": encode_kv(*kv) if kv is not None else None,
     }
+
+
+def prefix_fetch_request(model: str, tokens, *, ns: str = "",
+                         block_size: int) -> dict:
+    """Body for a peer-side `POST /v1/blocks/export` (the fleet cache
+    tier's pull path, ISSUE 19): the requesting replica asks a peer —
+    named by the router's `X-KV-Peer` heat hint — for the cached KV
+    blocks covering `tokens`. `prefix` is the 16-hex hash of the
+    FIRST full block (the same `prefix_hash` the heat digests and the
+    router's affinity key use), so the peer can cheaply verify the
+    request names the prefix its digest advertised."""
+    toks = [int(t) for t in tokens]
+    if len(toks) < block_size:
+        raise ValueError(
+            f"prefix fetch needs >= one full block ({block_size} "
+            f"tokens), got {len(toks)}")
+    return {
+        "model": str(model),
+        "tokens": toks,
+        "ns": str(ns),
+        "prefix": prefix_hash(toks[:block_size], ns),
+    }
+
+
+def validate_fetch_request(body: dict, *,
+                           block_size: int) -> tuple[str, list[int], str]:
+    """Peer-side validation of a `/v1/blocks/export` body: shape-check
+    the fields and recompute the first-block prefix hash — a mismatch
+    means the requester and this pool disagree on block size or the
+    body was mangled, and exporting would ship blocks the requester
+    can't place. Returns `(model, tokens, ns)`; raises ValueError."""
+    if not isinstance(body, dict):
+        raise ValueError(
+            f"fetch request must be a dict, got {type(body).__name__}")
+    tokens = body.get("tokens")
+    if (not isinstance(tokens, list) or not tokens
+            or not all(isinstance(t, int) and not isinstance(t, bool)
+                       for t in tokens)):
+        raise ValueError("fetch request needs a non-empty integer "
+                         "token list")
+    ns = body.get("ns", "")
+    if not isinstance(ns, str):
+        raise ValueError("fetch request ns must be a string")
+    if len(tokens) < block_size:
+        raise ValueError(
+            f"fetch request covers no full block: {len(tokens)} "
+            f"tokens < block_size {block_size}")
+    want = prefix_hash(tokens[:block_size], ns)
+    if body.get("prefix") != want:
+        raise ValueError(
+            "fetch request prefix hash does not match its own tokens "
+            "— block-size disagreement or mangled body")
+    return str(body.get("model", "")), [int(t) for t in tokens], ns
 
 
 def unpack_record(record: dict) -> dict:

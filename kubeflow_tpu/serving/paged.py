@@ -54,10 +54,14 @@ engine guarantees, not a behavior the kernel checks at runtime.
 
 from __future__ import annotations
 
+from collections import OrderedDict
+from typing import Callable
+
 from kubeflow_tpu.obs.cachestats import canonical_prefix
 from kubeflow_tpu.obs.cardinality import LabelGuard
 
-__all__ = ["BlockPool", "RadixPrefixCache", "TRASH_BLOCK"]
+__all__ = ["BlockPool", "HostSpillTier", "RadixPrefixCache",
+           "TRASH_BLOCK"]
 
 TRASH_BLOCK = 0
 
@@ -141,6 +145,78 @@ class BlockPool:
             self.ledger.note_free(blocks, cause)
 
 
+class HostSpillTier:
+    """Bytes-budgeted host-RAM LRU store for demoted KV block contents
+    (the fleet cache tier's middle rung, PR 19).
+
+    Entries are keyed by `(ns, token_path)` where `token_path` is the
+    FULL token prefix ending at the block — content is a pure function
+    of the token prefix by the insert-time canonical-form invariant,
+    so the key alone names the payload and a restore is token-identical
+    by construction. Payloads are opaque to this module (the batcher
+    stores host-numpy `(k, v)` copies); this class only does the
+    budget/LRU bookkeeping, so it stays jax-free like the rest of the
+    file. `put` returns the keys the budget pushed out (oldest first)
+    so the caller can book them as content deaths
+    (`CacheLedger.note_spill_drop`)."""
+
+    def __init__(self, budget_bytes: int, block_bytes: int):
+        if budget_bytes < 0:
+            raise ValueError(f"budget_bytes must be >= 0, got "
+                             f"{budget_bytes}")
+        if block_bytes < 1:
+            raise ValueError(f"block_bytes must be >= 1, got "
+                             f"{block_bytes}")
+        self.budget_bytes = int(budget_bytes)
+        self.block_bytes = int(block_bytes)
+        # (ns, token_path tuple) -> payload; insertion order == LRU
+        # order (move_to_end on every touch)
+        self._entries: OrderedDict[tuple, object] = OrderedDict()
+
+    @property
+    def capacity_blocks(self) -> int:
+        return self.budget_bytes // self.block_bytes
+
+    @property
+    def spilled_blocks(self) -> int:
+        return len(self._entries)
+
+    @property
+    def spilled_bytes(self) -> int:
+        return len(self._entries) * self.block_bytes
+
+    def _key(self, ns: str, path) -> tuple:
+        return (ns, tuple(int(t) for t in path))
+
+    def contains(self, ns: str, path) -> bool:
+        """Presence probe WITHOUT an LRU touch — planning peeks, only
+        an actual demote/restore moves the clock."""
+        return self._key(ns, path) in self._entries
+
+    def put(self, ns: str, path, payload) -> list[tuple]:
+        """Park one block's content; returns the `(ns, token_path)`
+        keys the byte budget evicted to make room (possibly including
+        this very entry when the budget can't hold even one block)."""
+        key = self._key(ns, path)
+        self._entries[key] = payload
+        self._entries.move_to_end(key)
+        dropped: list[tuple] = []
+        while len(self._entries) * self.block_bytes > self.budget_bytes:
+            victim, _ = self._entries.popitem(last=False)
+            dropped.append(victim)
+        return dropped
+
+    def pop(self, ns: str, path):
+        """Take one block's content out (a restore owns it now), or
+        None if the budget already dropped it."""
+        return self._entries.pop(self._key(ns, path), None)
+
+    def clear(self) -> int:
+        n = len(self._entries)
+        self._entries.clear()
+        return n
+
+
 class _Node:
     __slots__ = ("key", "block", "children", "refs", "last_use", "parent")
 
@@ -191,6 +267,24 @@ class RadixPrefixCache:
         # names, never raw tokens — bounded label cardinality by
         # construction
         self.heat_guard = LabelGuard(hashed=True)
+        # Optional host-RAM spill tier (PR 19): when attached (with a
+        # device-block reader), evict() demotes victim contents to the
+        # tier instead of discarding them. The reader is best-effort —
+        # any failure degrades that eviction to a plain discard.
+        self.spill: HostSpillTier | None = None
+        self.spill_reader: Callable[[int], object] | None = None
+
+    def attach_spill(self, tier: HostSpillTier,
+                     reader: Callable[[int], object]) -> None:
+        """Attach a `HostSpillTier` plus a `reader(block_id) ->
+        payload | None` that snapshots one device block's contents to
+        host memory (the batcher closes it over the engine's
+        `export_blocks`). From then on eviction demotes instead of
+        discarding, booked as cause `spill`; a None/raising reader
+        falls back to the old `lru` discard, so spill can never make
+        eviction less correct — only cheaper to undo."""
+        self.spill = tier
+        self.spill_reader = reader
 
     # -- internals ---------------------------------------------------------
 
@@ -331,25 +425,67 @@ class RadixPrefixCache:
 
     # -- shrink ------------------------------------------------------------
 
+    def _path_tokens(self, node: _Node) -> tuple:
+        """Full token prefix ending at `node`'s block, reconstructed
+        by walking parent edges to the namespace root — the spill
+        tier's key (content is a pure function of this path by the
+        canonical-form invariant)."""
+        keys = []
+        while node is not None and node.key is not None:
+            keys.append(node.key)
+            node = node.parent
+        out: list[int] = []
+        for key in reversed(keys):
+            out.extend(key)
+        return tuple(out)
+
+    def _demote(self, ns: str, victim: _Node) -> bool:
+        """Try to park `victim`'s block content in the spill tier.
+        Returns True when the content survives on the host (the free
+        books as `spill`), False for a plain discard (`lru`). Reader
+        failures — including a concurrently-donated device state —
+        degrade to discard: spill is an optimization, never a new
+        failure mode."""
+        if self.spill is None or self.spill_reader is None:
+            return False
+        try:
+            payload = self.spill_reader(victim.block)
+        except Exception:  # noqa: BLE001 — best-effort device read
+            payload = None
+        if payload is None:
+            return False
+        dropped = self.spill.put(ns, self._path_tokens(victim), payload)
+        if dropped and self.pool.ledger is not None:
+            self.pool.ledger.note_spill_drop(len(dropped))
+        return True
+
     def evict(self, need: int) -> int:
         """Free refcount-0 LRU leaves back to the pool until `need`
         blocks have been released (or no candidates remain). Returns
-        how many were actually freed."""
+        how many were actually freed. With a spill tier attached each
+        victim's content is demoted to host RAM first (death cause
+        `spill` instead of `lru`), so a later request for the same
+        prefix restores it with a host-to-device copy instead of
+        recomputing the prefill."""
         freed = 0
         while freed < need:
             victim = None
-            stack = list(self._roots.values())  # evict across namespaces
+            victim_ns = ""
+            # evict across namespaces
+            stack = [(ns, root) for ns, root in self._roots.items()]
             while stack:
-                n = stack.pop()
-                stack.extend(n.children.values())
+                ns, n = stack.pop()
+                stack.extend((ns, c) for c in n.children.values())
                 if n.key is None or n.children or n.refs > 0:
                     continue
                 if victim is None or n.last_use < victim.last_use:
-                    victim = n
+                    victim, victim_ns = n, ns
             if victim is None:
                 break
+            spilled = self._demote(victim_ns, victim)
             del victim.parent.children[victim.key]
-            self.pool.free([victim.block], cause="lru")
+            self.pool.free([victim.block],
+                           cause="spill" if spilled else "lru")
             self.cached_blocks -= 1
             freed += 1
         return freed

@@ -38,6 +38,7 @@ from kubeflow_tpu.serving.continuous import (
     bucket_pow2,
 )
 from kubeflow_tpu.serving.engine import InferenceEngine
+from kubeflow_tpu.serving import migration
 from kubeflow_tpu.serving.speculative import SpeculativeEngine
 from kubeflow_tpu.tenancy import (
     PRIORITIES,
@@ -166,7 +167,10 @@ class ServingObs:
         self.prefill_tokens = obs_lib.get_or_create_histogram(
             self.registry, "serving_prefill_tokens",
             "Per-admission prompt tokens by source: computed (suffix "
-            "actually prefilled) vs reused (served from cached KV)",
+            "actually prefilled), reused (served from device-resident "
+            "cached KV), restored (host spill tier, host->device "
+            "copy), peer_fetched (imported from a peer replica via "
+            "the X-KV-Peer heat hint)",
             buckets=obs_lib.TOKEN_BUCKETS)
         self.dropped_tokens = Counter(
             "serving_tokenizer_dropped_tokens_total",
@@ -292,6 +296,35 @@ class ServingObs:
             "Admissions pushed back for lack of KV blocks, by cause: "
             "kv_quota (tenant share spent) vs pool_exhausted (pool "
             "empty even after LRU eviction)", self.registry)
+        # Fleet cache tier (ISSUE 19): host-RAM spill demotions and
+        # restores are content movement, not deaths — they get their
+        # own counters so the tier's traffic is visible next to the
+        # eviction causes, plus a render-time occupancy gauge. Peer
+        # block fetches (the router's X-KV-Peer hint) count by
+        # OUTCOME (closed set: ok/miss/failed); any non-ok falls back
+        # to plain prefill, so `failed` burning is a perf smell, not
+        # a correctness one.
+        self.kv_spill_demotions = Counter(
+            "serving_kv_spill_demotions_total",
+            "KV blocks demoted from the device pool into the host-RAM "
+            "spill tier on eviction, per model (deaths booked to "
+            "cause=spill in serving_kv_evictions_total)", self.registry)
+        self.kv_spill_restores = Counter(
+            "serving_kv_spill_restores_total",
+            "Spilled KV blocks promoted back into the device pool on "
+            "a prefix re-hit (host->device copy instead of prefill "
+            "recompute), per model", self.registry)
+        self.kv_spill_bytes = Gauge(
+            "serving_kv_spill_bytes",
+            "Host RAM currently holding spilled KV block contents, "
+            "per model (bounded by --kv-spill-bytes)", self.registry)
+        self.peer_fetch = Counter(
+            "fleet_peer_fetch_total",
+            "Replica-side KV block fetches from a peer named by the "
+            "router's X-KV-Peer heat hint, by outcome: ok (blocks "
+            "imported, prefill seeded), miss (peer no longer caches "
+            "the prefix), failed (transport/geometry error — request "
+            "fell back to plain prefill)", self.registry)
         self.kv_reuse_distance = obs_lib.get_or_create_histogram(
             self.registry, "serving_kv_reuse_distance_admissions",
             "Admissions between consecutive touches of the same cached "
@@ -624,6 +657,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                        pipeline_depth: int | None = None,
                        kv_block_size: int = 64,
                        kv_pool_blocks: int | None = None,
+                       kv_spill_bytes: int | None = None,
                        paged_attention_impl: str = "auto",
                        drafts: dict[str, InferenceEngine] | None = None,
                        spec_decode: bool = False,
@@ -665,7 +699,13 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     tokens per block and total pool blocks per model (default: the
     dense equivalent, every slot can reach max_len — shrink the pool
     to cap KV HBM, admission then accounts by blocks free and defers
-    requests the pool can't cover). `paged_attention_impl`
+    requests the pool can't cover). `kv_spill_bytes` (continuous only)
+    adds a bounded host-RAM spill tier under each model's pool: radix
+    eviction demotes block contents to host numpy instead of
+    discarding, and a returning prefix restores them with a
+    host->device copy instead of recomputing prefill — size it from
+    the reuse-distance histogram's mass beyond the pool (see
+    docs/operator-guide.md). `paged_attention_impl`
     (continuous only) selects decode's attention path: "xla" (gather
     through the block table), "pallas" (fused kernel walking the table
     in-kernel; interpret mode off-TPU), or "auto" (pallas on TPU, xla
@@ -745,6 +785,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                            or pipeline_depth is not None
                            or kv_block_size != 64
                            or kv_pool_blocks is not None
+                           or kv_spill_bytes is not None
                            or paged_attention_impl != "auto"
                            or tenancy is not None):
         # these knobs only exist on the continuous batcher; silently
@@ -755,7 +796,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
         raise ValueError(
             "warmup/prefill_chunk/prefill_chunk_tokens/prefixes/"
             "max_pending/pipeline_depth/kv_block_size/kv_pool_blocks/"
-            "paged_attention_impl/spec_decode/tenancy "
+            "kv_spill_bytes/paged_attention_impl/spec_decode/tenancy "
             "require continuous=True")
     if spec_decode:
         missing = set(engines) - set(drafts or {})
@@ -781,6 +822,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                 pipeline_depth=pipeline_depth,
                 kv_block_size=kv_block_size,
                 kv_pool_blocks=kv_pool_blocks,
+                kv_spill_bytes=kv_spill_bytes,
                 paged_attention_impl=paged_attention_impl,
                 draft=(drafts or {}).get(name) if spec_decode else None,
                 spec_gamma=spec_gamma,
@@ -807,7 +849,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                           sobs.batch_size.observe(n, model=_m))
         elif isinstance(b, ContinuousBatcher):
             def on_prefix(computed, reused, hit, tenant="",
-                          _m=model_name):
+                          restored=0, _m=model_name):
                 fam = sobs.prefix_hits if hit else sobs.prefix_misses
                 # the unlabeled (model-only) totals stay exactly what
                 # they always were — the bench gate reads them; the
@@ -817,9 +859,16 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                 fam.inc(model=_m, tenant=sobs.tenant_guard.admit(tenant))
                 sobs.prefill_tokens.observe(
                     computed, model=_m, source="computed")
-                if reused:
+                # restored cells are radix hits whose content came off
+                # the host spill tier — split them out of `reused` so
+                # the two sources partition the cached cells exactly
+                restored = max(0, min(int(restored), int(reused)))
+                if reused - restored:
                     sobs.prefill_tokens.observe(
-                        reused, model=_m, source="reused")
+                        reused - restored, model=_m, source="reused")
+                if restored:
+                    sobs.prefill_tokens.observe(
+                        restored, model=_m, source="restored")
 
             b.on_prefix = on_prefix
 
@@ -890,6 +939,17 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                     0, model=model_name, cause=_c)
             sobs.kv_reuse_distance.seed(model=model_name)
             sobs.kv_block_age.seed(model=model_name)
+            # fleet cache tier (ISSUE 19): zero-seed the closed
+            # prefill-source and peer-fetch-outcome sets plus the
+            # spill traffic counters, so the tier's absence reads as
+            # explicit zeros rather than missing series
+            for _s in obs_lib.PREFILL_SOURCES:
+                sobs.prefill_tokens.seed(model=model_name, source=_s)
+            for _o in obs_lib.PEER_FETCH_OUTCOMES:
+                sobs.peer_fetch.inc(0, model=model_name, outcome=_o)
+            sobs.kv_spill_demotions.inc(0, model=model_name)
+            sobs.kv_spill_restores.inc(0, model=model_name)
+            sobs.kv_spill_bytes.set(0, model=model_name)
 
             def on_free(cause, n, _m=model_name):
                 sobs.kv_evictions.inc(n, model=_m, cause=cause)
@@ -903,10 +963,21 @@ def create_serving_app(engines: dict[str, InferenceEngine],
             def on_defer(cause, _m=model_name):
                 sobs.kv_admission_defers.inc(model=_m, cause=cause)
 
+            def on_spill(event, n, _m=model_name):
+                # demote/restore are content movement between tiers;
+                # "drop" (budget pushed an entry out of host RAM) has
+                # no counter of its own — it shows up as the spilled
+                # gauge falling without a restore
+                if event == "demote":
+                    sobs.kv_spill_demotions.inc(n, model=_m)
+                elif event == "restore":
+                    sobs.kv_spill_restores.inc(n, model=_m)
+
             b.cache_ledger.on_free = on_free
             b.cache_ledger.on_reuse = on_reuse
             b.cache_ledger.on_age = on_age
             b.cache_ledger.on_defer = on_defer
+            b.cache_ledger.on_spill = on_spill
 
             def on_phase(phase, seconds, tokens, _m=model_name):
                 # seconds is None for token-only attributions
@@ -931,6 +1002,10 @@ def create_serving_app(engines: dict[str, InferenceEngine],
             for _m, _b in app[BATCHERS_KEY].items():
                 if isinstance(_b, ContinuousBatcher):
                     sobs.kv_blocks.set(_b.kv_blocks_in_use(), model=_m)
+                    tier = _b._spill_tier
+                    sobs.kv_spill_bytes.set(
+                        tier.spilled_bytes if tier is not None else 0,
+                        model=_m)
 
         sobs.registry.register_collector(collect_kv_blocks)
 
@@ -1090,6 +1165,7 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     app.router.add_get("/debug/profile", debug_profile)
     app.router.add_post("/drain", drain_endpoint)
     app.router.add_post("/v1/migrate/in", migrate_in)
+    app.router.add_post("/v1/blocks/export", blocks_export)
     app.router.add_post("/v1/reload", reload_weights)
     app.router.add_get("/v1/models", list_models)
     app.router.add_get("/v1/requests/{id}/timeline", request_timeline)
@@ -1311,6 +1387,115 @@ async def migrate_in(request: web.Request):
            if isinstance(record, dict) else "")
     return web.json_response(
         {"imported": True, "blocks": blocks, "request_id": rid})
+
+
+async def blocks_export(request: web.Request):
+    """POST /v1/blocks/export — peer side of the fleet cache tier's
+    pull path (ISSUE 19). Body: `migration.prefix_fetch_request`
+    (`model`/`tokens`/`ns` plus the 16-hex first-block prefix hash the
+    router's heat hint advertised). Exports this replica's cached
+    full-block KV prefix of `tokens` as a migration wire record —
+    exactly the `/v1/migrate/in` format with `out=[]`, so the
+    requester imports it through `import_sequence` with geometry
+    validation unchanged. 404 when the prefix is no longer cached
+    (heat digests lag evictions); the requester books that as
+    `outcome=miss` and prefills normally — this endpoint can make a
+    remote hit cheap, never a local miss wrong."""
+    app = request.app
+    try:
+        body: dict[str, Any] = await request.json()
+    except Exception:
+        return web.json_response({"error": "invalid JSON"}, status=400)
+    name = body.get("model", "") if isinstance(body, dict) else ""
+    if name not in app[ENGINES_KEY]:
+        return web.json_response(
+            {"error": f"no model {name!r}"}, status=404)
+    batcher = app[BATCHERS_KEY].get(name)
+    if not isinstance(batcher, ContinuousBatcher):
+        return web.json_response(
+            {"error": "block export requires continuous batching"},
+            status=400)
+    try:
+        _model, tokens, ns = migration.validate_fetch_request(
+            body, block_size=batcher.cengine.block_size)
+    except ValueError as e:
+        return web.json_response({"error": str(e)}, status=400)
+    sobs: ServingObs = app[OBS_KEY]
+    rid = request.headers.get("X-Request-Id") or secrets.token_hex(8)
+    with sobs.tracer.span("blocks.export", model=name):
+        record = await batcher.export_prefix(tokens, ns=ns,
+                                             request_id=rid)
+    if record is None:
+        return web.json_response(
+            {"error": "prefix not cached"}, status=404)
+    blocks = int(record["kv"]["n_full"]) if record.get("kv") else 0
+    if blocks:
+        sobs.migration_blocks.inc(blocks, model=name, direction="out")
+    return web.json_response({"record": record, "blocks": blocks})
+
+
+async def _peer_fetch_blocks(app, name: str, batcher, tokens,
+                             peer: str) -> None:
+    """Requester side of the fleet cache tier's pull path: the router
+    said `peer`'s heat digest carries this prompt's first-block prefix
+    (`X-KV-Peer`), so pull the cached blocks over
+    `/v1/blocks/export` + `import_sequence` BEFORE admission — the
+    prefill then radix-hits the imported prefix. Best-effort with the
+    PR 12 degradation discipline: any failure (dead peer, geometry
+    mismatch, stale digest, import race) books its outcome and falls
+    through to plain prefill, token-identically. Only the shared
+    namespace participates — heat hints join on un-namespaced prefix
+    hashes, and tenant-isolated trees never leave their replica."""
+    sobs: ServingObs = app[OBS_KEY]
+    bs = batcher.cengine.block_size
+    if len(tokens) < bs + 1:
+        # no full block that planning could reuse (the planner always
+        # leaves >= 1 token to prefill)
+        return
+    nodes, _partial, _plen = batcher._radix.match(tokens)
+    if nodes:
+        return  # locally cached already — the hint is stale
+    try:
+        req = migration.prefix_fetch_request(
+            name, tokens, block_size=bs)
+    except ValueError:
+        return
+    import aiohttp
+
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                    f"{peer.rstrip('/')}/v1/blocks/export", json=req,
+                    timeout=aiohttp.ClientTimeout(total=30)) as r:
+                if r.status == 404:
+                    sobs.peer_fetch.inc(model=name, outcome="miss")
+                    return
+                if r.status != 200:
+                    sobs.peer_fetch.inc(model=name, outcome="failed")
+                    return
+                payload = await r.json()
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+        sobs.peer_fetch.inc(model=name, outcome="failed")
+        return
+    record = (payload.get("record")
+              if isinstance(payload, dict) else None)
+    if record is None:
+        sobs.peer_fetch.inc(model=name, outcome="miss")
+        return
+    try:
+        with sobs.tracer.span("peer.fetch", model=name):
+            blocks = await batcher.import_sequence(record)
+    except Exception:  # noqa: BLE001 — import rolled back inside
+        sobs.peer_fetch.inc(model=name, outcome="failed")
+        return
+    sobs.peer_fetch.inc(model=name, outcome="ok")
+    if blocks:
+        # booked at import time: these cells reach the prefill as a
+        # radix hit, so they ALSO appear under source=reused at
+        # admission — peer_fetched measures transfer traffic, the
+        # admission sources measure what seeded each prefill
+        sobs.prefill_tokens.observe(blocks * bs, model=name,
+                                    source="peer_fetched")
 
 
 # Mirrors fleet.rollout.valid_version — the serving side must stay
@@ -2114,6 +2299,20 @@ async def generate(request: web.Request):
     if arr.min() < 0 or arr.max() >= vocab:
         return web.json_response(
             {"error": f"token ids must be in [0, {vocab})"}, status=400)
+
+    # Fleet cache tier (ISSUE 19): the router attaches X-KV-Peer when
+    # a peer's heat digest carries this prompt's first-block prefix
+    # and the chosen replica's doesn't — pull the hot blocks before
+    # admission so the prefill radix-hits instead of recomputing.
+    # Strictly best-effort: every failure path degrades to the plain
+    # prefill this request would have run anyway.
+    peer_hint = request.headers.get("X-KV-Peer", "")
+    if (peer_hint and not prefix and arr.shape[0] == 1
+            and not request.app[DRAIN_KEY]["draining"]):
+        peer_batcher = request.app[BATCHERS_KEY].get(name)
+        if isinstance(peer_batcher, ContinuousBatcher):
+            await _peer_fetch_blocks(request.app, name, peer_batcher,
+                                     arr[0].tolist(), peer_hint)
 
     speculative = body.get("speculative", False)
     if not isinstance(speculative, bool):

@@ -42,7 +42,7 @@ class ReqMeta:
 
     __slots__ = ("tenant", "priority", "weight", "cost", "t_enqueue",
                  "seq", "ns", "resume", "charged", "request_id",
-                 "timeline")
+                 "timeline", "restored")
 
     def __init__(self, tenant: str = "", priority: str = "standard",
                  weight: float = 1.0, cost: float = 1.0,
@@ -61,6 +61,11 @@ class ReqMeta:
         # obs.timeline.RequestTimeline — rides the meta so the record
         # survives preemption's re-enqueue round trip
         self.timeline = timeline
+        # prompt cells whose radix hit came from spill-tier restores
+        # (host->device copy, not a device-resident cache hit); the
+        # batcher stamps it at admission so on_prefix can split the
+        # reused count into `reused` vs `restored` metric sources
+        self.restored = 0
 
 
 class FairShareQueue:

@@ -24,6 +24,16 @@ prefix-cache deltas) and routing-reason counts, so affinity vs
 `--fleet-kill-one` proves retry/fallback completes every request when
 a replica dies mid-run.
 
+`--mode fleet --fleet-kv-pressure` is the cache-tier A/B (ISSUE 19):
+the same seeded repeated-prompt workload through a control fleet
+(router peer hints off, no spill tier) and a tier fleet (X-KV-Peer
+hints + host-RAM spill), both under a block pool sized to force
+eviction. Seed responses are the recompute oracle every routed
+response must match token-for-token; the run fails unless the tier
+fleet's measured fleet-wide hit rate closes at least half of the
+affinity-vs-counterfactual gap the control arm's `/fleet/cache`
+reports.
+
 `--mode chaos` is the fleet fault-injection harness: replicas behind a
 router whose dispatch path runs a SEEDED `fleet.chaos.ChaosInjector`
 (drop / delay / duplicate / heartbeat blackhole), plus the two
@@ -123,7 +133,8 @@ sys.path.insert(0, {repo!r})
 from aiohttp import web
 from kubeflow_tpu.fleet.router import create_router_app
 app = create_router_app(block_size={block_size}, policy={policy!r},
-                        hedge_after_s={hedge_after_s})
+                        hedge_after_s={hedge_after_s},
+                        peer_hints={peer_hints})
 web.run_app(app, host="127.0.0.1", port={port}, print=None)
 '''
 
@@ -146,6 +157,35 @@ params = llama.init(jax.random.key(0), cfg)
 eng = InferenceEngine(params, cfg, LLAMA_FAMILY, EngineConfig(max_len=128))
 app = srv.create_serving_app({{"tiny": eng}}, continuous=True, warmup=True,
                              kv_block_size={block_size})
+srv.enable_fleet_registration(app, {router!r},
+                              "http://127.0.0.1:{port}",
+                              replica_id="replica-{idx}", period_s=0.5)
+web.run_app(app, host="127.0.0.1", port={port}, print=None)
+'''
+
+
+# KV-pressure-arm replica (ISSUE 19): FLEET_REPLICA_CODE with the
+# chaos arm's sharpened lm_head (token parity against a recompute
+# oracle must be exact across batch shapes) plus the cache-tier knobs
+# — a pool small enough that parked prefixes get evicted under load,
+# and a spill budget (None = tier off, the control arm).
+KV_REPLICA_CODE = r'''
+import os, sys
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+sys.path.insert(0, {repo!r})
+import jax; jax.config.update("jax_platforms", "cpu")
+from aiohttp import web
+from kubeflow_tpu.models import llama
+from kubeflow_tpu.serving.engine import InferenceEngine, LLAMA_FAMILY, EngineConfig
+from kubeflow_tpu.serving import server as srv
+cfg = llama.LLAMA_TINY
+params = dict(llama.init(jax.random.key(0), cfg))
+params["lm_head"] = params["lm_head"] * 50.0
+eng = InferenceEngine(params, cfg, LLAMA_FAMILY, EngineConfig(max_len=128))
+app = srv.create_serving_app({{"tiny": eng}}, continuous=True, warmup=True,
+                             kv_block_size={block_size},
+                             kv_pool_blocks={pool_blocks},
+                             kv_spill_bytes={spill_bytes})
 srv.enable_fleet_registration(app, {router!r},
                               "http://127.0.0.1:{port}",
                               replica_id="replica-{idx}", period_s=0.5)
@@ -516,7 +556,8 @@ def run_fleet(clients: int, requests: int, max_new: int, *,
             [sys.executable, "-c",
              ROUTER_CODE.format(repo=REPO, port=router_port,
                                 block_size=block_size, policy=policy,
-                                hedge_after_s=hedge_after_s)],
+                                hedge_after_s=hedge_after_s,
+                                peer_hints=True)],
             stdout=log, stderr=subprocess.STDOUT))
         for idx, port in enumerate(rep_ports):
             procs.append(subprocess.Popen(
@@ -731,6 +772,317 @@ def run_fleet(clients: int, requests: int, max_new: int, *,
                 p.wait()
 
 
+def run_fleet_kv_pressure(clients: int, requests: int, max_new: int, *,
+                          replicas: int = 2, block_size: int = 8,
+                          hedge_after_s: float = 10.0,
+                          pool_blocks: int = 0,
+                          spill_bytes: int = 32 << 20) -> dict:
+    """KV-pressure cache-tier A/B (ISSUE 19): the same repeated-prompt
+    workload run through two sequential fleets — a CONTROL fleet
+    (router peer hints off, no spill tier) and a TIER fleet (X-KV-Peer
+    hints + host-RAM spill) — with every replica's block pool sized
+    small enough that parked prefixes get evicted under load.
+
+    Each distinct prompt is seeded cache-clean on replica j%N before
+    the timed window; those seed responses ARE the recompute oracle
+    every routed response (peer-fetched, restored, or recomputed) must
+    match token-for-token (sharpened lm_head, so parity is exact).
+    Seeds that land off the prompt's rendezvous target are exactly the
+    misses `/fleet/cache` books as counterfactual remote hits in the
+    control arm. The run prints measured fleet-wide hit rate vs the
+    control arm's affinity rate vs that counterfactual ceiling, and
+    FAILS unless the tier closes at least half the gap."""
+    import tempfile
+    import threading
+
+    prompt_len = 3 * block_size
+    warm_prompt = [255, 99] + [5 + t % 200 for t in range(prompt_len - 2)]
+    k = max(2, requests // 4)
+    if pool_blocks <= 0:
+        # auto-size for pressure: room for the 8 active slots plus
+        # roughly HALF the parked-prefix demand the seeded workload
+        # generates per replica (~3.5 full blocks per distinct prompt
+        # between affinity parks and peer imports) — parked prefixes
+        # MUST evict for the spill tier to have anything to do
+        seq_blocks = -(-(prompt_len + max_new) // block_size)
+        pool_blocks = 8 * seq_blocks + max(8, (7 * k) // (4 * replicas))
+    prompts = [[3 + j % 250, 100] + [7 + (j + t) % 200
+                                     for t in range(prompt_len - 2)]
+               for j in range(k)]
+    prompt_order = [i % k for i in range(requests)]
+    random.Random(0).shuffle(prompt_order)
+
+    def arm(peer_hints: bool, arm_spill: int | None) -> dict:
+        router_port = free_port()
+        rep_ports = [free_port() for _ in range(replicas)]
+        router_base = f"http://127.0.0.1:{router_port}"
+        log = tempfile.NamedTemporaryFile(
+            mode="w+", suffix=".log", prefix="kftpu-kvfleet-",
+            delete=False)
+        procs: list[subprocess.Popen] = []
+        try:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 ROUTER_CODE.format(repo=REPO, port=router_port,
+                                    block_size=block_size,
+                                    policy="affinity",
+                                    hedge_after_s=hedge_after_s,
+                                    peer_hints=peer_hints)],
+                stdout=log, stderr=subprocess.STDOUT))
+            for idx, port in enumerate(rep_ports):
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-c",
+                     KV_REPLICA_CODE.format(
+                         repo=REPO, port=port, idx=idx,
+                         router=router_base, block_size=block_size,
+                         pool_blocks=pool_blocks,
+                         spill_bytes=arm_spill)],
+                    stdout=log, stderr=subprocess.STDOUT))
+
+            deadline = time.monotonic() + 180
+            ready = False
+            while time.monotonic() < deadline:
+                if any(p.poll() is not None for p in procs):
+                    break
+                try:
+                    counts = _get_json(
+                        f"{router_base}/fleet/replicas")["counts"]
+                    if counts["ready"] >= replicas:
+                        ready = True
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.5)
+            if not ready:
+                log.flush()
+                with open(log.name) as f:
+                    tail = "\n".join(f.read().splitlines()[-30:])
+                rcs = [p.poll() for p in procs]
+                raise RuntimeError(
+                    f"kv fleet never became ready (rcs={rcs}):\n{tail}")
+
+            def post(base: str, body: dict,
+                     timeout: float = 120.0) -> dict:
+                req = urllib.request.Request(
+                    f"{base}/v1/models/tiny:generate",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return json.loads(r.read())
+
+            def warm(i: int) -> None:
+                base = f"http://127.0.0.1:{rep_ports[i % replicas]}"
+                post(base, {"tokens": [warm_prompt],
+                            "max_new": max_new})
+
+            with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+                for _ in range(3):
+                    list(ex.map(warm, range(max(clients, replicas))))
+
+            # Seed pass = the recompute oracle: each distinct prompt
+            # computed once, cache-clean, DIRECTLY on replica j%N
+            # (sequential — one active sequence, so nothing evicts
+            # during seeding). Prompts whose rendezvous target is a
+            # DIFFERENT replica are the peer-heat the tier converts.
+            oracle = []
+            for j, prompt in enumerate(prompts):
+                base = f"http://127.0.0.1:{rep_ports[j % replicas]}"
+                out = post(base, {"tokens": [prompt],
+                                  "max_new": max_new})
+                oracle.append(out["tokens"][0])
+            # a few 0.5s heartbeats so the seeded prefix digests reach
+            # the router before the timed window routes against them
+            time.sleep(1.5)
+
+            def prefix_stats(port: int) -> tuple[int, int, int, int]:
+                m = _get_json(
+                    f"http://127.0.0.1:{port}/v1/models")["models"][0]
+                pc = m.get("prefix_cache", {})
+                return (pc.get("hits", 0), pc.get("misses", 0),
+                        pc.get("tokens_reused", 0),
+                        pc.get("tokens_prefilled", 0))
+
+            stats0 = {p: prefix_stats(p) for p in rep_ports}
+            cache0 = _get_json(f"{router_base}/fleet/cache")
+
+            failures = 0
+            mismatches: list[int] = []
+            lock = threading.Lock()
+
+            def one(i: int) -> float:
+                j = prompt_order[i]
+                t0 = time.perf_counter()
+                try:
+                    out = post(router_base,
+                               {"tokens": [prompts[j]],
+                                "max_new": max_new})
+                except Exception:
+                    nonlocal failures
+                    with lock:
+                        failures += 1
+                    raise
+                if out["tokens"][0] != oracle[j]:
+                    with lock:
+                        mismatches.append(j)
+                return time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+                latencies = list(ex.map(one, range(requests)))
+            wall = time.perf_counter() - t0
+
+            hits = misses = reused = prefilled = 0
+            for port in rep_ports:
+                s1 = prefix_stats(port)
+                hits += s1[0] - stats0[port][0]
+                misses += s1[1] - stats0[port][1]
+                reused += s1[2] - stats0[port][2]
+                prefilled += s1[3] - stats0[port][3]
+            cache1 = _get_json(f"{router_base}/fleet/cache")
+            remote = int(cache1["remote_hits_total"]
+                         - cache0["remote_hits_total"])
+
+            fetch = {"ok": 0, "miss": 0, "failed": 0}
+            restored_toks = peer_toks = 0
+            demotions = restores = 0
+            for port in rep_ports:
+                fams = _scrape_metrics(f"http://127.0.0.1:{port}")
+
+                def total(fam: str, sname: str | None = None,
+                          **labels) -> int:
+                    # sum over label subsets: these families carry a
+                    # `model` label the A/B does not care about
+                    want = set(labels.items())
+                    return int(sum(
+                        v for (sn, lbls), v in
+                        fams.get(fam, {}).get("samples", {}).items()
+                        if sn == (sname or fam) and want <= set(lbls)))
+
+                for oc in fetch:
+                    fetch[oc] += total("fleet_peer_fetch_total",
+                                       outcome=oc)
+                restored_toks += total("serving_prefill_tokens",
+                                       "serving_prefill_tokens_sum",
+                                       source="restored")
+                peer_toks += total("serving_prefill_tokens",
+                                   "serving_prefill_tokens_sum",
+                                   source="peer_fetched")
+                demotions += total("serving_kv_spill_demotions_total")
+                restores += total("serving_kv_spill_restores_total")
+
+            assert not mismatches, (
+                f"{len(mismatches)} routed responses diverged from "
+                f"the recompute oracle "
+                f"(prompts {sorted(set(mismatches))[:5]})")
+            latencies.sort()
+            q = statistics.quantiles(latencies, n=20)
+            lookups = hits + misses
+            return {
+                "oracle": oracle,
+                "hits": hits, "misses": misses,
+                "reused": reused, "prefilled": prefilled,
+                "remote": remote,
+                "rate": (round(hits / lookups, 3) if lookups else 0.0),
+                "counterfactual": (min(1.0, round(
+                    (hits + remote) / lookups, 3))
+                    if lookups else 0.0),
+                "fetch": fetch,
+                "restored_tokens": restored_toks,
+                "peer_fetched_tokens": peer_toks,
+                "spill_demotions": demotions,
+                "spill_restores": restores,
+                "failures": failures,
+                "wall": wall,
+                "p50_s": round(q[9], 3),
+                "p95_s": round(q[18], 3),
+            }
+        finally:
+            log.close()
+            os.unlink(log.name)
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+
+    control = arm(False, None)
+    tier = arm(True, spill_bytes)
+
+    assert control["oracle"] == tier["oracle"], \
+        "the two arms' recompute oracles diverged"
+    assert control["failures"] == 0 and tier["failures"] == 0, (
+        f"client failures: control={control['failures']} "
+        f"tier={tier['failures']}")
+    # hints off must mean ZERO peer traffic — otherwise the control
+    # arm is not a control
+    assert control["fetch"] == {"ok": 0, "miss": 0, "failed": 0}, (
+        f"control arm peer-fetched with hints off: {control['fetch']}")
+    assert control["spill_demotions"] == 0, \
+        "control arm spilled with the tier disabled"
+    assert tier["fetch"]["ok"] >= 1, (
+        f"tier arm never completed a peer fetch: {tier['fetch']}")
+    assert tier["spill_demotions"] >= 1, (
+        "no spill demotions — the pool is not under pressure; "
+        "lower --fleet-kv-pool-blocks")
+
+    affinity = control["rate"]
+    counterfactual = control["counterfactual"]
+    measured = tier["rate"]
+    gap = round(counterfactual - affinity, 3)
+    assert gap > 0, (
+        f"workload produced no affinity-vs-counterfactual gap "
+        f"(affinity={affinity} counterfactual={counterfactual}) — "
+        f"nothing for the tier to convert")
+    closed = round((measured - affinity) / gap, 3)
+    assert measured - affinity >= 0.5 * gap, (
+        f"cache tier closed only {closed} of the gap: "
+        f"affinity={affinity} measured={measured} "
+        f"counterfactual={counterfactual} "
+        f"(peer_fetch={tier['fetch']} restores={tier['spill_restores']})")
+    print(f"# kv tier: affinity_hit_rate={affinity} "
+          f"fleet_hit_rate={measured} "
+          f"counterfactual_hit_rate={counterfactual} "
+          f"gap_closed={closed} peer_fetch={tier['fetch']} "
+          f"spill_demotions={tier['spill_demotions']} "
+          f"spill_restores={tier['spill_restores']} "
+          f"restored_tokens={tier['restored_tokens']} "
+          f"peer_fetched_tokens={tier['peer_fetched_tokens']}",
+          file=sys.stderr)
+
+    return {
+        "metric": "serving_fleet_kv_tier",
+        "mode": "fleet-kv",
+        "fleet_replicas": replicas,
+        "clients": clients,
+        "requests": requests,
+        "max_new": max_new,
+        "kv_block_size": block_size,
+        "kv_pool_blocks": pool_blocks,
+        "kv_spill_bytes": spill_bytes,
+        "distinct_prompts": k,
+        "affinity_hit_rate": affinity,
+        "counterfactual_hit_rate": counterfactual,
+        "fleet_hit_rate": measured,
+        "gap": gap,
+        "gap_closed": closed,
+        "peer_fetch": tier["fetch"],
+        "restored_tokens": tier["restored_tokens"],
+        "peer_fetched_tokens": tier["peer_fetched_tokens"],
+        "spill_demotions": tier["spill_demotions"],
+        "spill_restores": tier["spill_restores"],
+        "control_p95_s": control["p95_s"],
+        "tier_p95_s": tier["p95_s"],
+        "requests_per_sec": round(requests / tier["wall"], 2),
+        "tokens_per_sec": round(requests * max_new / tier["wall"], 1),
+        "wall_s": round(control["wall"] + tier["wall"], 2),
+        "client_failures": 0,
+    }
+
+
 def run_disagg(clients: int, requests: int, max_new: int, *,
                prefill_replicas: int = 1, decode_replicas: int = 3,
                block_size: int = 8, long_every: int = 2,
@@ -794,7 +1146,8 @@ def run_disagg(clients: int, requests: int, max_new: int, *,
                  ROUTER_CODE.format(repo=REPO, port=router_port,
                                     block_size=block_size,
                                     policy="affinity",
-                                    hedge_after_s=hedge_after_s)],
+                                    hedge_after_s=hedge_after_s,
+                                    peer_hints=True)],
                 stdout=log, stderr=subprocess.STDOUT))
             for idx, (port, pool) in enumerate(zip(rep_ports, pools)):
                 procs.append(subprocess.Popen(
@@ -2880,6 +3233,26 @@ def main() -> int:
                    help="fleet mode: router hedge deadline (high "
                         "default: CPU compile stalls should retry, "
                         "not duplicate)")
+    p.add_argument("--fleet-kv-pressure", action="store_true",
+                   help="fleet mode: run the ISSUE-19 cache-tier A/B "
+                        "instead of the policy A/B — a control fleet "
+                        "(peer hints off, no spill) vs a tier fleet "
+                        "(X-KV-Peer hints + host-RAM spill), both "
+                        "with a block pool sized to force eviction; "
+                        "asserts every response matches the recompute "
+                        "oracle and the measured fleet-wide hit rate "
+                        "closes >= half the affinity-vs-counterfactual "
+                        "gap from /fleet/cache")
+    p.add_argument("--fleet-kv-pool-blocks", type=int, default=0,
+                   help="kv-pressure arm: per-replica KV pool blocks "
+                        "(small enough that parked prefixes evict "
+                        "under the seeded workload; 0 = auto-size "
+                        "from the workload)")
+    p.add_argument("--fleet-kv-spill-bytes", type=int,
+                   default=32 << 20,
+                   help="kv-pressure arm: host-RAM spill budget on "
+                        "the TIER fleet's replicas (control always "
+                        "runs with the tier off)")
     p.add_argument("--spread", action="store_true",
                    help="per-request max_new cycles 1/4x..1x of "
                         "--max-new (heterogeneous workload)")
@@ -2907,6 +3280,8 @@ def main() -> int:
             args.fleet_replicas = 4
         else:
             args.fleet_replicas = 2
+    if args.fleet_kv_pressure and args.mode != "fleet":
+        p.error("--fleet-kv-pressure requires --mode fleet")
     if args.mode == "fleet":
         if args.fleet_replicas < 1:
             p.error("--fleet-replicas must be >= 1")
@@ -2914,12 +3289,35 @@ def main() -> int:
             p.error("--fleet-kill-one needs --fleet-replicas >= 2")
         if args.fleet_block_size < 1:
             p.error("--fleet-block-size must be >= 1")
-        result = run_fleet(
-            args.clients, args.requests, args.max_new,
-            replicas=args.fleet_replicas, policy=args.fleet_policy,
-            block_size=args.fleet_block_size,
-            kill_one=args.fleet_kill_one,
-            hedge_after_s=args.fleet_hedge_after_s)
+        if args.fleet_kv_pressure:
+            if args.fleet_kill_one:
+                p.error("--fleet-kv-pressure and --fleet-kill-one are "
+                        "separate arms — run them separately")
+            if args.fleet_replicas < 2:
+                p.error("--fleet-kv-pressure needs --fleet-replicas "
+                        ">= 2 (peer fetch needs a peer)")
+            if args.requests < 8:
+                p.error("--fleet-kv-pressure needs --requests >= 8")
+            if 0 < args.fleet_kv_pool_blocks < 16:
+                p.error("--fleet-kv-pool-blocks must be >= 16 (the "
+                        "pool must at least hold the active slots) "
+                        "or 0 for auto-sizing")
+            if args.fleet_kv_spill_bytes < 0:
+                p.error("--fleet-kv-spill-bytes must be >= 0")
+            result = run_fleet_kv_pressure(
+                args.clients, args.requests, args.max_new,
+                replicas=args.fleet_replicas,
+                block_size=args.fleet_block_size,
+                hedge_after_s=args.fleet_hedge_after_s,
+                pool_blocks=args.fleet_kv_pool_blocks,
+                spill_bytes=args.fleet_kv_spill_bytes)
+        else:
+            result = run_fleet(
+                args.clients, args.requests, args.max_new,
+                replicas=args.fleet_replicas, policy=args.fleet_policy,
+                block_size=args.fleet_block_size,
+                kill_one=args.fleet_kill_one,
+                hedge_after_s=args.fleet_hedge_after_s)
     elif args.mode == "disagg":
         if args.disagg_prefill < 1 or args.disagg_decode < 1:
             p.error("--mode disagg needs --disagg-prefill >= 1 and "

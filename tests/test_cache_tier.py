@@ -1,0 +1,583 @@
+"""Fleet KV cache tier (ISSUE 19): host-RAM spill + peer block sharing.
+
+Two invariants anchor every test here:
+
+- Canonical form: a paged block's content is a pure function of the
+  token prefix it covers, so a block restored from the host tier or
+  imported from a peer replica MUST replay token-identically against a
+  plain-prefill oracle — any divergence is corruption, not drift.
+- Extended conservation: with the spill tier attached the cache ledger
+  books the CONTENT lifecycle too — births − frees == live + spilled
+  (restores netted out of births, demotions out of the deaths) — and
+  the equality must hold under allocation pressure, budget drops, and
+  failed imports alike.
+
+The peer half is held to the PR 12 degradation discipline: a dead
+peer, a stale heat hint, a geometry mismatch — every failure books its
+outcome and falls through to plain prefill with the same tokens.
+"""
+
+import asyncio
+import socket
+import types
+
+import pytest
+from aiohttp import web  # noqa: F401  (pytest plugin needs aiohttp)
+from aiohttp.test_utils import TestClient, TestServer
+
+pytest_plugins = ("aiohttp.pytest_plugin",)
+
+from kubeflow_tpu.fleet import control as control_mod
+from kubeflow_tpu.fleet import router as router_mod
+from kubeflow_tpu.fleet.registry import ReplicaRegistry, rendezvous
+from kubeflow_tpu.obs.cachestats import prefix_hash
+from kubeflow_tpu.obs.exposition import parse_exposition
+from kubeflow_tpu.serving.paged import HostSpillTier
+
+BS = 8  # kv block size everywhere below
+
+
+# -- the host tier itself (pure, no jax) ------------------------------------
+
+
+def test_spill_tier_validates_and_reports_capacity():
+    with pytest.raises(ValueError):
+        HostSpillTier(-1, 100)
+    with pytest.raises(ValueError):
+        HostSpillTier(100, 0)
+    t = HostSpillTier(350, 100)
+    assert t.capacity_blocks == 3
+    assert t.spilled_blocks == 0 and t.spilled_bytes == 0
+
+
+def test_spill_tier_budget_evicts_in_lru_order():
+    t = HostSpillTier(300, 100)
+    pa, pb, pc = ("", (1, 2)), ("", (3, 4)), ("", (5, 6))
+    assert t.put(*pa, "A") == []
+    assert t.put(*pb, "B") == []
+    assert t.put(*pc, "C") == []
+    assert t.spilled_blocks == 3 and t.spilled_bytes == 300
+    # contains() is a PEEK, not a touch: probing the oldest entry must
+    # not save it from the budget
+    assert t.contains(*pa)
+    dropped = t.put("", (7, 8), "D")
+    assert dropped == [("", (1, 2))]
+    assert not t.contains(*pa) and t.contains(*pb)
+    # re-putting an entry refreshes its LRU position
+    t.put(*pb, "B2")
+    dropped = t.put("", (9, 10), "E")
+    assert dropped == [("", (5, 6))]   # C went, B survived its refresh
+    assert t.pop(*pb) == "B2"
+    assert t.pop(*pb) is None          # pop is destructive
+    # namespaces never collide: same path, different ns, two entries
+    t.put("tenant", (7, 8), "D-ns")
+    assert t.pop("", (7, 8)) == "D" and t.pop("tenant", (7, 8)) == "D-ns"
+
+
+# -- engine fixtures --------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def llama_engine():
+    import jax
+
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.serving import (
+        EngineConfig,
+        InferenceEngine,
+        LLAMA_FAMILY,
+    )
+
+    cfg = llama.LLAMA_TINY
+    params = dict(llama.init(jax.random.key(0), cfg))
+    params["lm_head"] = params["lm_head"] * 50.0  # argmax can't flip
+    return InferenceEngine(params, cfg, LLAMA_FAMILY,
+                           EngineConfig(max_len=64))
+
+
+def _gemma_engine():
+    import jax
+
+    from kubeflow_tpu.models import gemma
+    from kubeflow_tpu.serving import (
+        EngineConfig,
+        GEMMA_FAMILY,
+        InferenceEngine,
+    )
+
+    cfg = gemma.GEMMA_TINY
+    params = dict(gemma.init(jax.random.key(1), cfg))
+    if "lm_head" in params:  # gemma ties its embeddings
+        params["lm_head"] = params["lm_head"] * 50.0
+    return InferenceEngine(params, cfg, GEMMA_FAMILY,
+                           EngineConfig(max_len=64))
+
+
+def _batcher(engine, **kw):
+    from kubeflow_tpu.serving.continuous import ContinuousBatcher
+
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("kv_block_size", BS)
+    return ContinuousBatcher(engine, asyncio.Lock(), **kw)
+
+
+def _prompt(i: int) -> list[int]:
+    # 12 tokens, distinct FIRST block per i (the spill key and the
+    # affinity hash are both built from the lead tokens)
+    return [40 + i] * 4 + [3, 5, 7, 11, 13, 17, 19, 23]
+
+
+async def _fill_past_pool(b, n=10, max_new=4):
+    """Sequential distinct prompts: each retirement parks one full KV
+    block in the radix, so `n` prompts overflow a trash+8 pool and the
+    allocator demotes the LRU chains into the spill tier."""
+    outs = []
+    for i in range(n):
+        outs.append(list(await b.submit(_prompt(i), max_new, ())))
+    return outs
+
+
+# -- spill/restore: parity + conservation -----------------------------------
+
+
+async def test_spill_restore_token_parity_llama(llama_engine):
+    """The tentpole guarantee: a prefix demoted to host RAM under
+    pressure and restored on the next request replays the EXACT tokens
+    the cold prefill produced — and the extended ledger conserves
+    through the whole demote/restore cycle."""
+    b = _batcher(llama_engine, kv_pool_blocks=9,
+                 kv_spill_bytes=64 << 20)
+    try:
+        outs = await _fill_past_pool(b)
+        snap = b.cache_ledger.snapshot()
+        assert snap["spill"]["demotions"] > 0, snap
+        assert snap["frees"]["spill"] == snap["spill"]["demotions"]
+        assert b._spill_tier.spilled_blocks == snap["spill"]["spilled"]
+        assert snap["spill"]["spilled"] > 0
+        assert snap["conserved"], snap
+
+        again = list(await b.submit(_prompt(0), 4, ()))
+        assert again == outs[0], "restored replay diverged from the " \
+            "cold prefill — the host tier returned corrupt KV content"
+        snap = b.cache_ledger.snapshot()
+        assert snap["spill"]["restores"] >= 1, snap
+        assert snap["conserved"], snap
+        stats = b.prefix_cache_stats()
+        assert stats["spilled_blocks"] == b._spill_tier.spilled_blocks
+        assert stats["spilled_bytes"] == b._spill_tier.spilled_bytes
+        assert stats["spilled_bytes"] == (
+            b._spill_tier.spilled_blocks * b.cengine.kv_block_bytes())
+    finally:
+        await b.close()
+    assert b.cache_ledger.snapshot()["conserved"]
+
+
+@pytest.mark.slow
+async def test_spill_restore_token_parity_gemma():
+    """The other family (GQA 4:1, different norm/rope plumbing): the
+    canonical-form invariant the tier leans on must hold there too."""
+    b = _batcher(_gemma_engine(), kv_pool_blocks=9,
+                 kv_spill_bytes=64 << 20)
+    try:
+        outs = await _fill_past_pool(b)
+        snap = b.cache_ledger.snapshot()
+        assert snap["spill"]["demotions"] > 0, snap
+        again = list(await b.submit(_prompt(0), 4, ()))
+        assert again == outs[0]
+        snap = b.cache_ledger.snapshot()
+        assert snap["spill"]["restores"] >= 1 and snap["conserved"]
+    finally:
+        await b.close()
+
+
+async def test_spill_budget_drops_conserve_and_fall_back(llama_engine):
+    """A tier sized to TWO blocks under a ten-prompt working set: the
+    budget drops the oldest demotions (booked as `drops`, so the
+    content books still balance), and a re-request whose entry was
+    dropped falls back to plain prefill token-identically."""
+    probe = _batcher(llama_engine, kv_pool_blocks=9,
+                     kv_spill_bytes=1 << 20)
+    bb = probe.cengine.kv_block_bytes()
+    await probe.close()
+
+    b = _batcher(llama_engine, kv_pool_blocks=9, kv_spill_bytes=2 * bb)
+    try:
+        assert b._spill_tier.capacity_blocks == 2
+        outs = await _fill_past_pool(b)
+        snap = b.cache_ledger.snapshot()
+        sp = snap["spill"]
+        assert sp["demotions"] > 2, sp
+        assert sp["drops"] >= sp["demotions"] - 2, sp
+        assert sp["spilled"] == b._spill_tier.spilled_blocks <= 2
+        assert snap["conserved"], snap
+        # net bookkeeping: everything that entered the tier either
+        # left it (restore/drop) or is still parked there
+        assert sp["demotions"] == (sp["restores"] + sp["drops"]
+                                   + sp["spilled"])
+        # prompt 0 was demoted FIRST, so its entry was dropped first —
+        # the re-request recomputes and still matches
+        assert not b._spill_tier.contains("", tuple(_prompt(0)[:BS]))
+        restores_before = sp["restores"]
+        again = list(await b.submit(_prompt(0), 4, ()))
+        assert again == outs[0]
+        snap = b.cache_ledger.snapshot()
+        assert snap["spill"]["restores"] == restores_before
+        assert snap["conserved"], snap
+    finally:
+        await b.close()
+
+
+# -- replica-side peer fetch ------------------------------------------------
+
+
+async def _start_replica(engine, **kw):
+    from kubeflow_tpu.serving import server as server_lib
+
+    kw.setdefault("kv_block_size", BS)
+    app = server_lib.create_serving_app(
+        {"tiny": engine}, continuous=True, max_batch=2, **kw)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    server = TestServer(app, port=port)
+    await server.start_server()
+    return app, server, f"http://127.0.0.1:{port}"
+
+
+async def _metric(client, fam: str, sname: str | None = None,
+                  **labels) -> float | None:
+    text = await (await client.get("/metrics")).text()
+    fams = parse_exposition(text)
+    f = fams.get(fam)
+    if f is None:
+        return None
+    key = (sname or fam, tuple(sorted(labels.items())))
+    return f["samples"].get(key)
+
+
+@pytest.mark.slow
+async def test_peer_fetch_ok_books_sources_and_parity(llama_engine):
+    """Happy path, replica-side only: a warm peer and an X-KV-Peer
+    hint turn replica A's cold prefill into an imported radix hit —
+    `fleet_peer_fetch_total{outcome=ok}` and
+    `serving_prefill_tokens{source=peer_fetched}` book it, and the
+    response matches the peer's cold-prefill tokens exactly."""
+    from kubeflow_tpu.serving import server as server_lib
+
+    app_a, srv_a, url_a = await _start_replica(llama_engine)
+    app_b, srv_b, url_b = await _start_replica(llama_engine)
+    ca, cb = TestClient(srv_a), TestClient(srv_b)
+    try:
+        p = _prompt(0)
+        r = await cb.post("/v1/models/tiny:generate",
+                          json={"tokens": [p], "max_new": 4})
+        assert r.status == 200
+        want = (await r.json())["tokens"]
+
+        r = await ca.post("/v1/models/tiny:generate",
+                          json={"tokens": [p], "max_new": 4},
+                          headers={"X-KV-Peer": url_b})
+        assert r.status == 200
+        assert (await r.json())["tokens"] == want
+        assert await _metric(ca, "fleet_peer_fetch_total",
+                             model="tiny", outcome="ok") == 1
+        fetched = await _metric(
+            ca, "serving_prefill_tokens",
+            sname="serving_prefill_tokens_count",
+            model="tiny", source="peer_fetched")
+        assert fetched and fetched >= 1
+        # the imported cells seed the prefill as a radix hit
+        reused = await _metric(
+            ca, "serving_prefill_tokens",
+            sname="serving_prefill_tokens_count",
+            model="tiny", source="reused")
+        assert reused and reused >= 1
+        # peer booked the outbound transfer
+        assert (await _metric(cb, "serving_migration_blocks_total",
+                              model="tiny", direction="out") or 0) >= 1
+        # a second identical request is locally cached: the stale-hint
+        # guard skips the fetch, no new peer traffic
+        r = await ca.post("/v1/models/tiny:generate",
+                          json={"tokens": [p], "max_new": 4},
+                          headers={"X-KV-Peer": url_b})
+        assert r.status == 200
+        assert (await r.json())["tokens"] == want
+        assert await _metric(ca, "fleet_peer_fetch_total",
+                             model="tiny", outcome="ok") == 1
+        # both ledgers conserved through export + import
+        for app in (app_a, app_b):
+            led = app[server_lib.BATCHERS_KEY]["tiny"] \
+                .cache_ledger.snapshot()
+            assert led["conserved"], led
+    finally:
+        await ca.close()
+        await cb.close()
+        await srv_a.close()
+        await srv_b.close()
+
+
+@pytest.mark.slow
+async def test_peer_fetch_degradation_matrix(llama_engine):
+    """Every peer-fetch failure mode falls back to plain prefill with
+    oracle-identical tokens, booking its outcome:
+
+    - dead peer (connection refused)            -> failed
+    - peer evicted the prefix before the fetch
+      (mid-flight eviction / stale heat digest) -> miss
+    - peer pool geometry differs (gemma peer)   -> failed, after the
+      wire-level geometry validation rejects the import
+    - peer simply never had the prefix          -> miss
+    """
+    from kubeflow_tpu.serving import server as server_lib
+
+    app_a, srv_a, _ = await _start_replica(llama_engine)
+    app_o, srv_o, _ = await _start_replica(llama_engine)   # oracle
+    app_b, srv_b, url_b = await _start_replica(llama_engine)
+    app_g, srv_g, url_g = await _start_replica(_gemma_engine())
+    ca, co, cb = TestClient(srv_a), TestClient(srv_o), TestClient(srv_b)
+    cg = TestClient(srv_g)
+    try:
+        async def oracle(p):
+            r = await co.post("/v1/models/tiny:generate",
+                              json={"tokens": [p], "max_new": 4})
+            assert r.status == 200
+            return (await r.json())["tokens"]
+
+        async def hinted(p, peer):
+            r = await ca.post("/v1/models/tiny:generate",
+                              json={"tokens": [p], "max_new": 4},
+                              headers={"X-KV-Peer": peer})
+            assert r.status == 200
+            return (await r.json())["tokens"]
+
+        # 1. dead peer: nothing listens on port 9
+        p = _prompt(20)
+        assert await hinted(p, "http://127.0.0.1:9") == await oracle(p)
+
+        # 2. warm peer that evicted the prefix before our fetch (the
+        # digest advertised it, the export 404s)
+        p = _prompt(21)
+        r = await cb.post("/v1/models/tiny:generate",
+                          json={"tokens": [p], "max_new": 4})
+        assert r.status == 200
+        app_b[server_lib.BATCHERS_KEY]["tiny"]._radix.clear()
+        assert await hinted(p, url_b) == await oracle(p)
+
+        # 3. geometry mismatch: the gemma peer exports happily (same
+        # block size), the import's geometry validation rejects it
+        # BEFORE any block is allocated
+        p = _prompt(22)
+        r = await cg.post("/v1/models/tiny:generate",
+                          json={"tokens": [p], "max_new": 4})
+        assert r.status == 200
+        assert await hinted(p, url_g) == await oracle(p)
+
+        # 4. live peer that never saw the prompt
+        p = _prompt(23)
+        assert await hinted(p, url_b) == await oracle(p)
+
+        assert await _metric(ca, "fleet_peer_fetch_total",
+                             model="tiny", outcome="failed") == 2
+        assert await _metric(ca, "fleet_peer_fetch_total",
+                             model="tiny", outcome="miss") == 2
+        assert await _metric(ca, "fleet_peer_fetch_total",
+                             model="tiny", outcome="ok") == 0
+        assert await _metric(
+            ca, "serving_prefill_tokens",
+            sname="serving_prefill_tokens_count",
+            model="tiny", source="peer_fetched") == 0
+        led = app_a[server_lib.BATCHERS_KEY]["tiny"] \
+            .cache_ledger.snapshot()
+        assert led["conserved"], led
+    finally:
+        for c in (ca, co, cb, cg):
+            await c.close()
+        for s in (srv_a, srv_o, srv_b, srv_g):
+            await s.close()
+
+
+# -- router: the X-KV-Peer hint through two real replicas -------------------
+
+
+@pytest.mark.slow
+async def test_router_peer_hint_two_replicas(llama_engine):
+    """End to end: replica rb is hot (heartbeat digest carries the
+    prefix), affinity routes the request to cold ra — the router
+    attaches X-KV-Peer naming rb, ra pulls the blocks and answers
+    token-identically. Once ra's own digest shows the prefix hot, the
+    hint stops."""
+    from kubeflow_tpu.serving import server as server_lib
+
+    app_a, srv_a, url_a = await _start_replica(llama_engine)
+    app_b, srv_b, url_b = await _start_replica(llama_engine)
+    reg = ReplicaRegistry()
+    reg.register(url_a, replica_id="ra", models=["tiny"])
+    reg.register(url_b, replica_id="rb", models=["tiny"])
+    router_server = TestServer(router_mod.create_router_app(
+        reg, block_size=BS))
+    await router_server.start_server()
+    rc = TestClient(router_server)
+    ca = TestClient(srv_a)
+    cb = None
+    try:
+        # a 12-token prompt whose affinity key pins replica "ra"
+        prompt = None
+        for s in range(3, 2000):
+            toks = [s, 1, 2, 3, 5, 7, 11, 13, 17, 19, 23, 29]
+            key = router_mod.affinity_key({"tokens": [toks]}, BS)
+            if rendezvous(key, ["ra", "rb"]) == "ra":
+                prompt = toks
+                break
+        assert prompt is not None
+
+        # warm rb out of band; only rb's heartbeat advertises the heat
+        # (NB: closing this client would close srv_b with it — teardown
+        # only)
+        cb = TestClient(srv_b)
+        r = await cb.post("/v1/models/tiny:generate",
+                          json={"tokens": [prompt], "max_new": 4})
+        assert r.status == 200
+        want = (await r.json())["tokens"]
+        dg = server_lib.fleet_stats(app_b)["cache_digest"]
+        assert any(e["prefix"] == prefix_hash(prompt[:BS])
+                   for e in dg), dg
+        reg.heartbeat("rb", cache_digest=dg)
+        reg.heartbeat("ra", cache_digest=[])
+
+        # the digest-carrier helper the hint rides on
+        h = prefix_hash(prompt[:BS])
+        assert [r_.id for r_ in reg.digest_carriers(h)] == ["rb"]
+        assert reg.digest_carriers(h, exclude="rb") == []
+
+        r = await rc.post("/v1/models/tiny:generate",
+                          json={"tokens": [prompt], "max_new": 4})
+        assert r.status == 200
+        assert r.headers["X-Fleet-Replica"] == "ra"
+        assert (await r.json())["tokens"] == want
+        assert await _metric(ca, "fleet_peer_fetch_total",
+                             model="tiny", outcome="ok") == 1
+
+        # ra now advertises the prefix itself: the hint condition
+        # clears and the same request stays local (no new fetch)
+        dg_a = server_lib.fleet_stats(app_a)["cache_digest"]
+        reg.heartbeat("ra", cache_digest=dg_a)
+        st = router_server.app[router_mod.FLEET_KEY]
+        rep_a = reg.get("ra")
+        hdrs = {"Content-Type": "application/json"}
+        out = router_mod._with_peer_hint(
+            st, {"tokens": [prompt]}, rep_a, hdrs)
+        assert out is hdrs and "X-KV-Peer" not in out
+        r = await rc.post("/v1/models/tiny:generate",
+                          json={"tokens": [prompt], "max_new": 4})
+        assert r.status == 200
+        assert (await r.json())["tokens"] == want
+        assert await _metric(ca, "fleet_peer_fetch_total",
+                             model="tiny", outcome="ok") == 1
+    finally:
+        await rc.close()
+        await ca.close()
+        if cb is not None:
+            await cb.close()
+        await router_server.close()
+        await srv_a.close()
+        await srv_b.close()
+
+
+def test_peer_hint_skips_short_and_prefix_bodies():
+    """The hint needs a full first block and a router-hashable body;
+    registered-prefix bodies expand replica-side, so the router cannot
+    name their first block."""
+    reg = ReplicaRegistry()
+    reg.register("http://x", replica_id="ra", models=["m"])
+    reg.register("http://y", replica_id="rb", models=["m"])
+    toks = list(range(3, 3 + BS))
+    reg.heartbeat("rb", cache_digest=[
+        {"prefix": prefix_hash(toks), "score": 1.0}])
+    st = types.SimpleNamespace(registry=reg, block_size=BS)
+    rep = reg.get("ra")
+    hdrs: dict = {}
+    out = router_mod._with_peer_hint(
+        st, {"tokens": [toks]}, rep, hdrs)
+    assert out["X-KV-Peer"] == "http://y" and "X-KV-Peer" not in hdrs
+    assert router_mod._with_peer_hint(
+        st, {"tokens": [toks[:4]]}, rep, hdrs) is hdrs
+    assert router_mod._with_peer_hint(
+        st, {"tokens": [toks], "prefix": "sys"}, rep, hdrs) is hdrs
+    assert router_mod._with_peer_hint(st, "junk", rep, hdrs) is hdrs
+    # draining carriers never serve hints
+    reg.drain("rb")
+    assert router_mod._with_peer_hint(
+        st, {"tokens": [toks]}, rep, hdrs) is hdrs
+
+
+# -- the shift_pool_split satellite (PR 16 remainder) -----------------------
+
+
+async def test_shift_pool_split_actuator_books_through_ledger():
+    """The controller fires shift_pool_split on a pressure-eviction
+    burn and books it through the decision ledger; repeated fires
+    accumulate (capped), and the lean is TTL'd."""
+    clk = [0.0]
+    reg = ReplicaRegistry(clock=lambda: clk[0])
+    st = types.SimpleNamespace(registry=reg)
+    acts = control_mod.router_actuators(
+        st, clock=lambda: clk[0], floor_ttl_s=60.0)
+    assert set(acts) == set(control_mod.ACTIONS)
+    pol = control_mod.Policy(
+        name="kv_pressure_shift_split",
+        signal=control_mod.Signal("serving_kv_evictions_total",
+                                  {"cause": "pressure"},
+                                  mode="rate", reduce="sum"),
+        threshold=2.0, clear=1.0, cooldown_s=0.0, action="shift_pool_split")
+
+    async def reader(policy):
+        return 5.0  # burning
+
+    ctl = control_mod.Controller(
+        [pol], reader=reader, actuators=acts, clock=lambda: clk[0])
+    recs = await ctl.evaluate_once()
+    assert recs[0]["outcome"] == "fired"
+    assert recs[0]["action"] == "shift_pool_split"
+    assert st.pool_shift == 1 and st.pool_shift_until == 60.0
+    assert recs[0]["evidence"]["result"]["pool_shift"] == 1
+    assert ctl.ledger.conserved and ctl.ledger.outcomes["fired"] == 1
+    # the default policy set carries the satellite
+    names = {p.name: p.action for p in control_mod.default_policies()}
+    assert names["kv_pressure_shift_split"] == "shift_pool_split"
+
+
+async def test_autoscale_folds_pool_shift(aiohttp_client):
+    """/fleet/autoscale?pools=1 leans its prefill/decode split by the
+    TTL'd controller shift — never below one prefill replica — and
+    reports the active shift."""
+    reg = ReplicaRegistry()
+    for i in range(4):
+        reg.register(f"http://r{i}", replica_id=f"r{i}", models=["m"])
+        reg.heartbeat(f"r{i}", phase_seconds={"prefill": 1.0,
+                                              "decode": 1.0})
+    client = await aiohttp_client(router_mod.create_router_app(reg))
+    st = client.app[router_mod.FLEET_KEY]
+    base = await (await client.get("/fleet/autoscale?pools=1")).json()
+    assert base["pool_shift"] == 0
+    total = base["pools"]["prefill"] + base["pools"]["decode"]
+
+    st.pool_shift = 1
+    st.pool_shift_until = st.registry.clock() + 100.0
+    body = await (await client.get("/fleet/autoscale?pools=1")).json()
+    assert body["pool_shift"] == 1
+    assert body["pools"]["decode"] == min(total - 1,
+                                          base["pools"]["decode"] + 1)
+    assert body["pools"]["prefill"] + body["pools"]["decode"] == total
+    assert body["pools"]["prefill"] >= 1
+
+    # a huge shift clamps: one prefill replica always survives
+    st.pool_shift = 8
+    body = await (await client.get("/fleet/autoscale?pools=1")).json()
+    assert body["pools"]["prefill"] == 1
+    assert body["pools"]["decode"] == total - 1
+
+    # lapsed TTL: the lean expires quietly
+    st.pool_shift_until = float("-inf")
+    body = await (await client.get("/fleet/autoscale?pools=1")).json()
+    assert body["pool_shift"] == 0
+    assert body["pools"] == base["pools"]
