@@ -1,7 +1,7 @@
 # Developer entry points (the reference drives everything through
 # per-component Makefiles; here one root Makefile covers the repo).
 
-.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check profile-check serving-check fleet-check kernels-check tenancy-check chaos-check train-check train-obs-check disagg-check cache-check cache-tier-check control-check rollout-check
+.PHONY: test test-slow test-all e2e smoke conformance bench bench-gate dryrun native verify-all obs-check profile-check serving-check fleet-check kernels-check tenancy-check chaos-check train-check train-obs-check disagg-check cache-check cache-tier-check control-check rollout-check scenario-check
 
 verify-all:  ## the full evidence sweep, one command
 	python -m pytest tests -q -m "slow or not slow"
@@ -100,6 +100,18 @@ rollout-check: ## live-deployment gate: rollout suite + rollout-plane metrics co
 	JAX_PLATFORMS=cpu python -m ci.obs_check rollout
 	JAX_PLATFORMS=cpu python loadtest/serving_loadtest.py --mode rollout \
 	  --clients 8 --requests 24 --max-new 8
+
+scenario-check: ## scenario engine gate: trace/replay suite + record-replay contract + pathological scenarios vs the live fleet + recorded-replay fidelity
+	JAX_PLATFORMS=cpu python -m pytest tests/test_scenarios.py -q \
+	  -m "slow or not slow"
+	JAX_PLATFORMS=cpu python -m ci.obs_check scenario
+	JAX_PLATFORMS=cpu python loadtest/serving_loadtest.py --mode scenario \
+	  --scenario loadtest/scenarios/flash_crowd.jsonl --scenario-target fleet
+	JAX_PLATFORMS=cpu python loadtest/serving_loadtest.py --mode scenario \
+	  --scenario loadtest/scenarios/abandon_retry.jsonl --scenario-target fleet
+	JAX_PLATFORMS=cpu python loadtest/serving_loadtest.py --mode scenario \
+	  --scenario loadtest/scenarios/tenant_flood.jsonl \
+	  --scenario-max-batch 1 --scenario-fidelity-pct 10
 
 tenancy-check: ## multi-tenant QoS gate: unit suite + noisy-neighbor A/B loadtest
 	JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py -q \

@@ -1455,6 +1455,74 @@ def bench_serving_disagg(*, clients: int = 12, requests: int = 48,
     }
 
 
+def bench_scenario_replay(*, scenario: str = "tenant_flood",
+                          fidelity_pct: float = 10.0,
+                          verbose: bool = True) -> dict:
+    """Record/replay fidelity of the scenario engine (ISSUE 20): replay
+    the committed tenant-flood trace against a live continuous server,
+    capture the run off the server's timeline store, then replay the
+    RECORDING interleaved with the original against the same warm
+    engine (the loadtest's paired fidelity path). Headline = fidelity
+    headroom, 1 - delta/budget, where delta is the paired
+    |recorded - original| p95-TTFT fraction and budget is the run's
+    own assertion bound — unit "ratio" so the gate holds it
+    higher-is-better: headroom collapsing toward 0 means the recorder
+    is drifting from what it observed. The absolute TTFT p95s ride
+    along in ms, informational: on a shared CPU runner absolute
+    service rate swings run to run, while the paired delta stays
+    stable — which is exactly why the delta-derived number is the
+    gated one."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serving_loadtest",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "loadtest", "serving_loadtest.py"))
+    lt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lt)
+    r = lt.run_scenario(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "loadtest", "scenarios", f"{scenario}.jsonl"),
+        target="single", max_batch=1, fidelity_pct=fidelity_pct)
+    # run_scenario already raised on expect violations, client
+    # failures, lost recordings, or a delta past the budget; reaching
+    # here means the hard bars held — the gate's only job is to catch
+    # headroom EROSION across commits.
+    fid = r["fidelity"]
+    delta = fid["delta_frac"]
+    budget = fid["max_frac"]
+    headroom = round(1.0 - delta / budget, 4)
+    gen = detect_generation()
+    label = scenario.replace("_", "-")
+    if verbose:
+        print(f"# scenario-replay {r['scenario']} "
+              f"offered={r['offered']} completed={r['completed']} "
+              f"p95 orig={fid['orig_ttft_p95_s']}s "
+              f"recorded={fid['recorded_ttft_p95_s']}s "
+              f"delta={delta:.2%} (budget {budget:.0%})",
+              file=sys.stderr)
+    return {
+        "metric": f"scenario_replay_fidelity_headroom[{label},{gen}]",
+        "value": headroom,
+        "unit": "ratio",
+        "vs_baseline": headroom,
+        "extra_metrics": [
+            {"metric":
+                f"scenario_replay_fidelity_delta[{label},{gen}]",
+             "value": delta, "unit": "fraction",
+             "vs_baseline": headroom},
+            {"metric":
+                f"scenario_replay_ttft_p95_ms[{label}-orig,{gen}]",
+             "value": round(fid["orig_ttft_p95_s"] * 1000.0, 3),
+             "unit": "ms", "vs_baseline": 1.0},
+            {"metric":
+                f"scenario_replay_ttft_p95_ms[{label}-recorded,{gen}]",
+             "value": round(fid["recorded_ttft_p95_s"] * 1000.0, 3),
+             "unit": "ms", "vs_baseline": 1.0},
+        ],
+    }
+
+
 def bench_mnist(*, steps: int = 200, batch: int = 256,
                 verbose: bool = True) -> dict:
     """BASELINE config #1: MNIST-MLP smoke train (images/s + accuracy).
@@ -1602,7 +1670,7 @@ ALL_SECTIONS = ("train500m", "train1b", "train-zero", "train-goodput",
                 "decode", "decode-int8", "decode-cont", "decode-paged",
                 "decode-spill", "decode-spec-paged",
                 "decode-paged-kernel", "decode-gemma", "serving-disagg",
-                "mnist", "vit", "flash4k")
+                "scenario-replay", "mnist", "vit", "flash4k")
 # Per-section wall-clock bound for the orchestrated TPU sweep. Sized
 # from measured section times (train sections ~2-4 min incl. compile,
 # decode ~2 min) with slack for tunnel weather; a section that wedges
@@ -1619,7 +1687,8 @@ def _sweep_for(backend: str, wanted: list[str], p) -> list[str]:
                    "decode-int8", "decode-cont", "decode-paged",
                    "decode-spill", "decode-spec-paged",
                    "decode-paged-kernel", "decode-gemma",
-                   "serving-disagg", "mnist", "vit"])
+                   "serving-disagg", "scenario-replay", "mnist",
+                   "vit"])
     if wanted:
         unavailable = [s for s in wanted if s not in sweep]
         if unavailable:
@@ -1784,8 +1853,8 @@ def main() -> int:
                    help="comma-separated subset: train500m,train1b,"
                         "flash4k,decode,decode-int8,decode-cont,"
                         "decode-paged,decode-spill,decode-spec-paged,"
-                        "decode-paged-kernel (default: full sweep for "
-                        "the backend)")
+                        "decode-paged-kernel,scenario-replay (default: "
+                        "full sweep for the backend)")
     p.add_argument("--json-only", action="store_true")
     p.add_argument("--json-out", default="",
                    help="also write the sweep's single JSON artifact "
@@ -2093,6 +2162,18 @@ def _run_sweep(sweep: list[str], backend: str, *, in_child: bool,
             return m
 
         guarded("serving-disagg", _disagg)
+    if "scenario-replay" in sweep:
+        # Scenario-engine record/replay fidelity via the loadtest's
+        # paired interleaved A/B (replicas pin themselves to CPU
+        # regardless of backend). The headroom ratio feeds the bench
+        # gate; the expect block, zero-client-failure, and
+        # delta-within-budget bars are enforced inside the run.
+        def _scenario() -> dict:
+            m = bench_scenario_replay(verbose=verbose)
+            extras.extend(m.pop("extra_metrics", []))
+            return m
+
+        guarded("scenario-replay", _scenario)
     if "mnist" in sweep:
         # BASELINE config #1 (MNIST-MLP smoke) — same section on every
         # backend; the metric label carries where it ran.

@@ -1578,6 +1578,150 @@ async def run_rollout_check() -> list[str]:
     return failures
 
 
+async def run_scenario_check() -> list[str]:
+    """Scenario act (ISSUE 20): the record/generate/replay contract,
+    no jax. Boot a STUB replica — the real SSE generate surface and
+    the real `TimelineStore` behind the real timeline endpoints, with
+    a paced fake decode — then hold the engine to its promises: a
+    generated flash crowd replays open-loop through `HttpTarget` with
+    its expect block green and bounded arrival skew; an abandon-retry
+    storm books every scheduled hang-up as abandoned (zero client
+    failures — the cancellation path, not an error path); the run
+    records back off `/v1/requests/timelines` into a trace whose
+    arrivals, shapes, and hang-ups match what was offered; and the
+    RECORDING replays with the same outcome (the record -> replay
+    loop closed without an engine in sight)."""
+    import asyncio
+
+    from aiohttp import web
+    from aiohttp.test_utils import TestServer
+
+    from kubeflow_tpu import scenarios
+    from kubeflow_tpu.obs.timeline import RequestTimeline, TimelineStore
+
+    failures: list[str] = []
+    store = TimelineStore(capacity=256)
+
+    async def gen(request):
+        body = await request.json()
+        rid = request.headers.get("X-Request-Id", "")
+        tl = RequestTimeline(
+            rid, tenant=request.headers.get("X-Tenant", ""),
+            prompt_tokens=len(body["tokens"][0]),
+            max_new=int(body.get("max_new", 4)))
+        tl.event("enqueue")
+        store.add(tl)
+        resp = web.StreamResponse()
+        resp.content_type = "text/event-stream"
+        await resp.prepare(request)
+        tl.event("admit")
+        # 4 ms per token: slow enough that an abandoning client's
+        # hang-up always lands mid-stream, fast enough to stay a gate
+        for _ in range(tl.max_new):
+            await asyncio.sleep(0.004)
+            tl.token()
+            await resp.write(b'data: {"tokens": [[7]]}\n\n')
+        tl.event("finish")
+        await resp.write(b'data: {"done": true}\n\n')
+        return resp
+
+    async def timelines_index(request):
+        return web.json_response({"requests": store.ids()})
+
+    async def timeline_one(request):
+        tl = store.get(request.match_info["rid"])
+        if tl is None:
+            raise web.HTTPNotFound
+        return web.json_response(tl.to_dict())
+
+    app = web.Application()
+    app.router.add_post("/v1/models/{name}:generate", gen)
+    app.router.add_get("/v1/requests/timelines", timelines_index)
+    app.router.add_get("/v1/requests/{rid}/timeline", timeline_one)
+    server = TestServer(app)
+    await server.start_server()
+    base = f"http://127.0.0.1:{server.port}"
+    loop = asyncio.get_running_loop()
+
+    def run(tr, name):
+        target = scenarios.HttpTarget(base, seed=tr.seed)
+        recs = scenarios.replay(tr, target,
+                                max_workers=len(tr.requests) + 8)
+        result = scenarios.summarize(tr, recs)
+        for f in scenarios.check_expect(tr.expect, result):
+            failures.append(f"{name}: {f}")
+        return result
+
+    try:
+        # 1. a flash crowd replays clean, open-loop
+        crowd = scenarios.generate(
+            "flash_crowd", 5, duration_s=2.0, base_rps=2.0,
+            burst_len_s=0.5, burst_rps=20.0, prompt_tokens=8,
+            prefix_tokens=4, max_new=4)
+        res = await loop.run_in_executor(
+            None, lambda: run(crowd, "flash_crowd"))
+        skew = res.get("arrival_skew_p95_s")
+        if skew is None or skew > 0.25:
+            failures.append(
+                f"flash_crowd: open-loop arrival skew p95 {skew}s — "
+                "the replayer is not keeping the trace's schedule")
+
+        # 2. an abandon-retry storm: every scheduled hang-up fires,
+        # none books as a failure (the expect block pins the count)
+        storm = scenarios.generate("abandon_retry", 4, n=6, rps=8.0)
+        res = await loop.run_in_executor(
+            None, lambda: run(storm, "abandon_retry"))
+        n_abandoned = res.get("abandoned", 0)
+
+        # 3. record the storm back off the timeline endpoints
+        rec = await loop.run_in_executor(
+            None, lambda: scenarios.record_from_server(
+                base, ids=[r.id for r in storm.requests],
+                name="storm-recorded"))
+        if {r.id for r in rec.requests} \
+                != {r.id for r in storm.requests}:
+            failures.append(
+                "recording lost requests: "
+                f"{len(rec.requests)}/{len(storm.requests)}")
+        want = {r.id: r for r in storm.requests}
+        # recordings re-base to their first enqueue; compare against
+        # the offered trace re-based the same way
+        t0 = min(r.at for r in storm.requests)
+        for r in rec.requests:
+            w = want.get(r.id)
+            if w is None:
+                continue
+            if (r.prompt_tokens, r.max_new) != (w.prompt_tokens,
+                                                w.max_new):
+                failures.append(
+                    f"recorded shape drifted for {r.id}: "
+                    f"({r.prompt_tokens}, {r.max_new}) != "
+                    f"({w.prompt_tokens}, {w.max_new})")
+            if abs(r.at - (w.at - t0)) > 0.25:
+                failures.append(
+                    f"recorded arrival drifted for {r.id}: "
+                    f"{r.at} vs offered {w.at - t0}")
+            if (r.abandon_at is not None) \
+                    != (w.abandon_at is not None):
+                failures.append(
+                    f"recorded hang-up state wrong for {r.id}: "
+                    f"abandon_at={r.abandon_at} (offered "
+                    f"{w.abandon_at})")
+        if scenarios.Trace.loads(rec.dumps()).dumps() != rec.dumps():
+            failures.append("recorded trace does not round-trip "
+                            "byte-identically")
+
+        # 4. close the loop: the RECORDING replays with the same
+        # outcome (same hang-ups, still zero failures)
+        rec.expect["abandoned"] = {"min": n_abandoned,
+                                   "max": n_abandoned}
+        await loop.run_in_executor(
+            None, lambda: run(rec, "recorded-replay"))
+    finally:
+        await server.close()
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
     """Default: all seven acts. `python -m ci.obs_check profile` runs
     only the serving step-anatomy act (`make profile-check`); it and
@@ -1599,6 +1743,7 @@ def main(argv: list[str] | None = None) -> int:
         "cache-tier": run_cache_tier_check,
         "control": run_control_check,
         "rollout": run_rollout_check,
+        "scenario": run_scenario_check,
     }
     wanted = argv or list(acts)
     unknown = [a for a in wanted if a not in acts]
@@ -1629,7 +1774,10 @@ def main(argv: list[str] | None = None) -> int:
           "conserved and the fired action auditable end to end, "
           "and the rollout plane zero-seeds its phase/outcome grids "
           "with /fleet/rollouts conserved across a promote and an "
-          "SLO-burn rollback")
+          "SLO-burn rollback, and the scenario engine closes its "
+          "record -> replay loop against a stub replica (expect "
+          "blocks green, hang-ups booked abandoned, recordings "
+          "byte-stable and faithful)")
     return 0
 
 

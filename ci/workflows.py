@@ -756,6 +756,51 @@ def rollout_check_workflow() -> dict:
     }
 
 
+def scenario_check_workflow() -> dict:
+    """Scenario-engine gate (ISSUE 20): `make scenario-check` runs the
+    trace/generator/replay suite (canonical byte-identity, seeded
+    determinism, shape properties, fake-clock arrival fidelity, live
+    abandon cancellation), the record->replay contract against a stub
+    replica (ci.obs_check scenario), two pathological generated
+    scenarios — a flash crowd and an abandon-retry storm — replayed
+    against the full router+fleet stack with their expect SLO blocks
+    asserted, and the fidelity gate: a tenant-flood run recorded off
+    the live timeline store and replayed paired-interleaved with the
+    original, p95 TTFT required within 10%. Traffic shapes are
+    artifacts here; this keeps every committed one replayable and
+    every recorded one faithful."""
+    return {
+        "name": "scenario check",
+        "on": {
+            "pull_request": {"paths": ["kubeflow_tpu/scenarios/**",
+                                       "kubeflow_tpu/obs/**",
+                                       "kubeflow_tpu/serving/**",
+                                       "kubeflow_tpu/fleet/**",
+                                       "loadtest/serving_loadtest.py",
+                                       "loadtest/scenarios/**",
+                                       "tests/test_scenarios.py",
+                                       "ci/obs_check.py",
+                                       "Makefile"]},
+            "push": {"branches": ["main"]},
+        },
+        "jobs": {
+            "scenario-check": {
+                "runs-on": "ubuntu-latest",
+                "steps": [
+                    {"uses": "actions/checkout@v4"},
+                    {"uses": "actions/setup-python@v5",
+                     "with": {"python-version": "3.11"}},
+                    {"run": "pip install -e .[ci] pytest"},
+                    {"name": "trace suite + record/replay contract + "
+                             "fleet scenarios + fidelity gate",
+                     "run": "make scenario-check",
+                     "env": {"JAX_PLATFORMS": "cpu"}},
+                ],
+            }
+        },
+    }
+
+
 def tenancy_check_workflow() -> dict:
     """Multi-tenant QoS gate: `make tenancy-check` runs the tenancy
     unit suite (fair-share math, preemption token-identity, prefix
@@ -893,6 +938,7 @@ def all_workflows() -> dict[str, dict]:
     out["cache_tier_check.yaml"] = cache_tier_check_workflow()
     out["control_check.yaml"] = control_check_workflow()
     out["rollout_check.yaml"] = rollout_check_workflow()
+    out["scenario_check.yaml"] = scenario_check_workflow()
     out["tenancy_check.yaml"] = tenancy_check_workflow()
     out["kernels_check.yaml"] = kernels_check_workflow()
     out["profile_check.yaml"] = profile_check_workflow()

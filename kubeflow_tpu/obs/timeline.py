@@ -39,15 +39,22 @@ MAX_TOKENS = 65536
 class RequestTimeline:
     """Event + token-timestamp record for one request."""
 
-    __slots__ = ("request_id", "model", "tenant", "events", "tokens",
-                 "_clock", "_itl_break", "done")
+    __slots__ = ("request_id", "model", "tenant", "prompt_tokens",
+                 "max_new", "events", "tokens", "_clock", "_itl_break",
+                 "done")
 
     def __init__(self, request_id: str, *, model: str = "",
-                 tenant: str = "",
+                 tenant: str = "", prompt_tokens: int = 0,
+                 max_new: int = 0,
                  clock: Callable[[], float] | None = None):
         self.request_id = request_id
         self.model = model
         self.tenant = tenant
+        # workload shape, stamped by the batcher at enqueue; together
+        # with the enqueue instant this makes any stored timeline
+        # replayable (the scenario recorder reads exactly these)
+        self.prompt_tokens = prompt_tokens
+        self.max_new = max_new
         self._clock = clock or time.monotonic
         self.events: list[tuple[float, str, dict]] = []
         self.tokens: list[float] = []
@@ -128,6 +135,13 @@ class RequestTimeline:
             "request_id": self.request_id,
             "model": self.model,
             "tenant": self.tenant,
+            "prompt_tokens": self.prompt_tokens,
+            "max_new": self.max_new,
+            "output_tokens": len(self.tokens),
+            # absolute arrival on the timeline's own clock: relative
+            # times suffice for debugging ONE request, but recording a
+            # replayable trace needs cross-request ordering
+            "enqueue_monotonic_s": round(t0, 6),
             "done": self.done,
             "events": [
                 {"t": round(t - t0, 6), "kind": k, **detail}
@@ -170,6 +184,17 @@ class TimelineStore:
     def get(self, request_id: str) -> RequestTimeline | None:
         with self._lock:
             return self._items.get(request_id)
+
+    def ids(self) -> list[str]:
+        """Request ids currently stored, oldest first."""
+        with self._lock:
+            return list(self._items)
+
+    def snapshot(self) -> list[RequestTimeline]:
+        """Stored timelines, oldest first (the scenario recorder's
+        enumeration surface)."""
+        with self._lock:
+            return list(self._items.values())
 
     def __len__(self) -> int:
         with self._lock:

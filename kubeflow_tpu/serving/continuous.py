@@ -1679,6 +1679,7 @@ class ContinuousBatcher:
         tl = RequestTimeline(
             request_id,
             tenant=spec.name if spec is not None else tenant,
+            prompt_tokens=len(tokens), max_new=max_new,
             clock=self._clock)
         meta = ReqMeta(
             tenant=spec.name if spec is not None else "",

@@ -1098,6 +1098,15 @@ def create_serving_app(engines: dict[str, InferenceEngine],
                       "and the store is bounded)"},
             status=404)
 
+    async def request_timelines_index(request):
+        # enumeration surface for the scenario recorder: every id the
+        # bounded stores still hold, oldest first per batcher
+        ids: list[str] = []
+        for b in request.app[BATCHERS_KEY].values():
+            if isinstance(b, ContinuousBatcher):
+                ids.extend(b.timelines.ids())
+        return web.json_response({"requests": ids})
+
     async def debug_traces(request):
         # the shared traces handler plus this app's counter tracks
         # (ISSUE 8): phase budgets and pool fill ride the SAME Chrome
@@ -1168,6 +1177,8 @@ def create_serving_app(engines: dict[str, InferenceEngine],
     app.router.add_post("/v1/blocks/export", blocks_export)
     app.router.add_post("/v1/reload", reload_weights)
     app.router.add_get("/v1/models", list_models)
+    app.router.add_get("/v1/requests/timelines",
+                       request_timelines_index)
     app.router.add_get("/v1/requests/{id}/timeline", request_timeline)
     app.router.add_post("/v1/models/{name}:generate", generate)
     app.router.add_post("/v1/models/{name}:prefill", prefill_handoff)

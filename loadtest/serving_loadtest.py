@@ -78,6 +78,17 @@ and OFF (tenant-blind FIFO) — against identical workloads and
 reports interactive TTFT percentiles side by side, plus the
 preemption/throughput evidence that batch work kept flowing.
 
+`--mode scenario` replays a trace file (or a seeded generated shape,
+`--scenario gen:flash-crowd --seed 7`) open-loop against a single
+continuous server or the full router+fleet stack
+(`--scenario-target fleet`), asserting the trace's declarative
+`expect` block on the outcome. `--scenario-fidelity-pct N` runs the
+record/replay round-trip: replay the scenario, RECORD it back off the
+server's timeline store, replay the recording on a fresh identical
+server, and fail unless recorded-replay p95 TTFT lands within N% of
+the original. The scenario engine itself lives in
+`kubeflow_tpu.scenarios`; this mode is the harness wiring.
+
 Hermetic by default (tiny model, CPU): the number is a CONTROL-PLANE
 number (batching, HTTP, queueing) — model throughput on hardware is
 bench.py's job.
@@ -121,6 +132,7 @@ cfg = llama.LLAMA_TINY
 params = llama.init(jax.random.key(0), cfg)
 eng = InferenceEngine(params, cfg, LLAMA_FAMILY, EngineConfig(max_len=128))
 app = srv.create_serving_app({{"tiny": eng}}, batch_window_ms={window_ms},
+                             max_batch={max_batch},
                              continuous={continuous}, warmup={continuous},
                              pipeline_depth={pipeline_depth})
 web.run_app(app, host="127.0.0.1", port={port}, print=None)
@@ -2975,6 +2987,262 @@ def run_tenants(*, bulk_clients: int = 8, live_requests: int = 8,
     }
 
 
+def _load_scenario(spec: str, seed: int):
+    """`gen:<shape>` generates with the explicit seed; anything else
+    is a trace file path."""
+    from kubeflow_tpu import scenarios
+    if spec.startswith("gen:"):
+        return scenarios.generate(spec[len("gen:"):], seed)
+    return scenarios.read_trace(spec)
+
+
+def run_scenario(scenario: str, *, seed: int = 0, speed: float = 1.0,
+                 target: str = "single", replicas: int = 2,
+                 block_size: int = 8, max_batch: int = 8,
+                 fidelity_pct: float = 0.0) -> dict:
+    """Replay a scenario open-loop against a live stack and judge the
+    trace's `expect` block. `target="single"` is one continuous
+    server; `target="fleet"` is N replicas behind the fleet router —
+    the replay code is identical, which is the point: one trace, any
+    topology.
+
+    With `fidelity_pct > 0` (single target only — timelines live on
+    replicas, not the router), the run also closes the record/replay
+    loop: capture the just-replayed run off the server's timeline
+    store by the replayer's own request ids, replay the RECORDING on
+    a fresh identical server, and fail unless recorded-replay p95
+    TTFT is within fidelity_pct percent of the original's."""
+    import tempfile
+
+    from kubeflow_tpu import scenarios
+
+    trace = _load_scenario(scenario, seed)
+    worst = max(r.prompt_tokens + r.max_new for r in trace.requests)
+    if worst > 120:
+        # the harness engine runs max_len=128; fail before boot, by
+        # name, not after 180s of mysterious 4xx
+        raise ValueError(
+            f"scenario {trace.name!r} needs prompt+max_new <= 120 "
+            f"for the loadtest's tiny engine (worst request asks "
+            f"{worst}); regenerate with smaller params")
+
+    def wait_ready(base: str, procs: list, log) -> None:
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            if any(p.poll() is not None for p in procs):
+                break
+            try:
+                if target == "fleet":
+                    counts = _get_json(f"{base}/fleet/replicas")["counts"]
+                    if counts["ready"] >= replicas:
+                        return
+                else:
+                    urllib.request.urlopen(f"{base}/v1/models",
+                                           timeout=2)
+                    return
+            except Exception:
+                pass
+            time.sleep(0.5)
+        log.flush()
+        with open(log.name) as f:
+            tail = "\n".join(f.read().splitlines()[-30:])
+        rcs = [p.poll() for p in procs]
+        raise RuntimeError(
+            f"scenario target never became ready (rcs={rcs}):\n{tail}")
+
+    def warm(base: str, tr) -> None:
+        # compile every prompt shape the trace will touch BEFORE the
+        # clock matters — the fidelity arm compares p95 TTFT across
+        # two servers, so a first-touch XLA compile landing inside one
+        # arm's timed window and not the other's would swamp the
+        # comparison with compiler noise
+        lengths = sorted({r.prompt_tokens for r in tr.requests})
+
+        def one(n: int) -> None:
+            req = urllib.request.Request(
+                f"{base}/v1/models/tiny:generate",
+                data=json.dumps({"tokens": [[5 + i % 480
+                                             for i in range(n)]],
+                                 "max_new": 2}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                r.read()
+
+        with concurrent.futures.ThreadPoolExecutor(8) as ex:
+            list(ex.map(one, lengths))
+            # concurrent bursts compile the coalesced admission-group
+            # shapes (same idiom as run()'s warmup)
+            for _ in range(3):
+                list(ex.map(one, [4] * 8))
+
+    def replay_against(base: str, tr, run_speed: float) -> dict:
+        tgt = scenarios.HttpTarget(base, model="tiny", seed=tr.seed,
+                                   speed=run_speed)
+        # one worker per request: under a saturating flood the
+        # backlog's open connections must never exhaust the pool, or
+        # dispatch blocks and the replay silently goes closed-loop
+        records = scenarios.replay(tr, tgt, speed=run_speed,
+                                   max_workers=len(tr.requests) + 8)
+        return scenarios.summarize(tr, records, speed=run_speed)
+
+    def boot():
+        log = tempfile.NamedTemporaryFile(
+            mode="w+", suffix=".log", prefix="kftpu-scenario-",
+            delete=False)
+        procs: list[subprocess.Popen] = []
+        if target == "fleet":
+            router_port = free_port()
+            base = f"http://127.0.0.1:{router_port}"
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 ROUTER_CODE.format(repo=REPO, port=router_port,
+                                    block_size=block_size,
+                                    policy="affinity",
+                                    hedge_after_s=10.0,
+                                    peer_hints=True)],
+                stdout=log, stderr=subprocess.STDOUT))
+            for idx in range(replicas):
+                port = free_port()
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-c",
+                     FLEET_REPLICA_CODE.format(
+                         repo=REPO, port=port, idx=idx, router=base,
+                         block_size=block_size)],
+                    stdout=log, stderr=subprocess.STDOUT))
+        else:
+            port = free_port()
+            base = f"http://127.0.0.1:{port}"
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 SERVER_CODE.format(repo=REPO, port=port, window_ms=5,
+                                    max_batch=max_batch,
+                                    continuous=True,
+                                    pipeline_depth=None)],
+                stdout=log, stderr=subprocess.STDOUT))
+        return procs, log, base
+
+    def teardown(procs: list, log) -> None:
+        log.close()
+        os.unlink(log.name)
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    procs, log, base = boot()
+    try:
+        wait_ready(base, procs, log)
+        warm(base, trace)
+        result = replay_against(base, trace, speed)
+        expect_failures = scenarios.check_expect(trace.expect, result)
+
+        out = {
+            "metric": "scenario_replay",
+            "mode": "scenario",
+            "scenario": trace.name,
+            "generator": trace.generator or "file",
+            "target": target,
+            **({"replicas": replicas} if target == "fleet" else {}),
+            **result,
+            "expect_failures": expect_failures,
+        }
+
+        if fidelity_pct > 0:
+            import dataclasses
+
+            # capture by the replayer's OWN request ids: warmup posts
+            # share the store but must not pollute the recording
+            recorded = scenarios.record_from_server(
+                base, ids=[r.id for r in trace.requests],
+                name=f"{trace.name}-recorded")
+            out["recorded_requests"] = len(recorded.requests)
+            if len(recorded.requests) != len(trace.requests):
+                raise AssertionError(
+                    f"recording lost requests: {len(trace.requests)} "
+                    f"replayed, {len(recorded.requests)} captured")
+            # PAIRED comparison: replay the original trace and the
+            # recording SIMULTANEOUSLY, interleaved, against the same
+            # warm engine. Sequential A-then-B comparisons on a shared
+            # CPU box fold +-15% run-to-run service drift into the
+            # metric; interleaving makes both arms ride the exact same
+            # queue and the same service-rate fluctuations, so the
+            # only thing that can separate their TTFT distributions is
+            # the recording itself being unfaithful (lost requests,
+            # shifted arrivals, wrong lengths). Ids are disambiguated
+            # by arm prefix; the derived prompt contents therefore
+            # differ per arm (same lengths), so no radix reuse crosses
+            # the arms. Original offsets are divided by --speed (the
+            # pace the original actually replayed at); recorded
+            # offsets are already wall-time.
+            def scale(r):
+                return dataclasses.replace(
+                    r, id="o!" + r.id, at=round(r.at / speed, 6),
+                    abandon_at=(None if r.abandon_at is None
+                                else round(r.abandon_at / speed, 6)))
+
+            paired_reqs = ([scale(r) for r in trace.requests]
+                           + [dataclasses.replace(r, id="r!" + r.id)
+                              for r in recorded.requests])
+            paired = scenarios.Trace(
+                name=f"{trace.name}-paired", requests=paired_reqs,
+                seed=trace.seed, generator="paired")
+            tgt = scenarios.HttpTarget(base, model="tiny",
+                                       seed=trace.seed)
+            precs = scenarios.replay(
+                paired, tgt, max_workers=len(paired_reqs) + 8)
+
+            def arm_stats(prefix: str) -> dict:
+                rs = [r for r in precs if r["id"].startswith(prefix)]
+                ttfts = sorted(r["ttft_s"] for r in rs
+                               if r["ttft_s"] is not None)
+                return {
+                    "ttft_p95_s": round(
+                        ttfts[min(len(ttfts) - 1,
+                                  int(0.95 * len(ttfts)))], 6)
+                    if ttfts else None,
+                    "client_failures": sum(1 for r in rs
+                                           if not r["ok"]),
+                    "abandoned": sum(1 for r in rs if r["abandoned"]),
+                }
+
+            orig_arm, rec_arm = arm_stats("o!"), arm_stats("r!")
+            p95a, p95b = orig_arm["ttft_p95_s"], rec_arm["ttft_p95_s"]
+            delta = (abs(p95b - p95a) / p95a
+                     if p95a else float("inf"))
+            out["fidelity"] = {
+                "orig_ttft_p95_s": p95a,
+                "recorded_ttft_p95_s": p95b,
+                "delta_frac": round(delta, 4),
+                "max_frac": fidelity_pct / 100.0,
+                "solo_ttft_p95_s": result["ttft_p95_s"],
+                "orig_arm": orig_arm,
+                "recorded_arm": rec_arm,
+            }
+            fails = orig_arm["client_failures"] \
+                + rec_arm["client_failures"]
+            if fails:
+                raise AssertionError(
+                    f"paired fidelity replay saw {fails} client "
+                    f"failure(s)")
+            if delta > fidelity_pct / 100.0:
+                raise AssertionError(
+                    f"record/replay fidelity: p95 TTFT moved "
+                    f"{delta:.1%} (original arm {p95a}s -> recorded "
+                    f"arm {p95b}s), budget {fidelity_pct}%")
+
+        if expect_failures:
+            raise AssertionError(
+                f"scenario {trace.name!r} violated its expect block: "
+                f"{expect_failures}")
+        return out
+    finally:
+        teardown(procs, log)
+
+
 def run(clients: int, requests: int, max_new: int,
         window_ms: int, mode: str = "window",
         spread: bool = False, pipeline_depth: int = 0) -> dict:
@@ -2986,6 +3254,7 @@ def run(clients: int, requests: int, max_new: int,
     proc = subprocess.Popen(
         [sys.executable, "-c",
          SERVER_CODE.format(repo=REPO, port=port, window_ms=window_ms,
+                            max_batch=8,
                             continuous=(mode == "continuous"),
                             # unconditional: an invalid combination
                             # must hit create_serving_app's loud
@@ -3133,8 +3402,37 @@ def main() -> int:
     p.add_argument("--mode",
                    choices=("window", "continuous", "fleet", "tenants",
                             "chaos", "train-chaos", "disagg",
-                            "rollout"),
+                            "rollout", "scenario"),
                    default="window")
+    p.add_argument("--scenario", default="",
+                   help="scenario mode: a trace file path, or "
+                        "gen:<shape> to generate one with --seed "
+                        "(shapes: diurnal, flash-crowd, heavy-tail, "
+                        "agent-swarm, abandon-retry, tenant-flood)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="scenario mode: generator seed for "
+                        "gen:<shape> — same seed, byte-identical "
+                        "workload")
+    p.add_argument("--scenario-speed", type=float, default=1.0,
+                   help="scenario mode: time-scale for arrivals "
+                        "(2.0 replays twice as fast)")
+    p.add_argument("--scenario-target", choices=("single", "fleet"),
+                   default="single",
+                   help="scenario mode: one continuous server, or "
+                        "--fleet-replicas behind the fleet router")
+    p.add_argument("--scenario-max-batch", type=int, default=8,
+                   help="scenario mode, single target: the server's "
+                        "continuous-batching slot count — the "
+                        "fidelity arm constrains it so the flood "
+                        "queues structurally and p95 TTFT is set by "
+                        "arrival order, not scheduler noise")
+    p.add_argument("--scenario-fidelity-pct", type=float, default=0.0,
+                   help="scenario mode: also record the replayed run "
+                        "off the server's timeline store, replay the "
+                        "recording on a fresh server, and fail if "
+                        "recorded-replay p95 TTFT differs from the "
+                        "original by more than this percent (0 = "
+                        "skip the fidelity arm)")
     p.add_argument("--disagg-prefill", type=int, default=1,
                    help="disagg mode: prefill-pool replicas (arm A); "
                         "the symmetric arm gets prefill+decode mixed "
@@ -3384,6 +3682,28 @@ def main() -> int:
             save_every=args.train_save_every,
             slow_save_s=args.train_slow_save_s,
             slo_short_s=args.train_slo_short_s)
+    elif args.mode == "scenario":
+        if not args.scenario:
+            p.error("--mode scenario requires --scenario "
+                    "(a trace file or gen:<shape>)")
+        if args.scenario_speed <= 0:
+            p.error("--scenario-speed must be > 0")
+        if args.scenario_fidelity_pct < 0:
+            p.error("--scenario-fidelity-pct must be >= 0")
+        if (args.scenario_fidelity_pct > 0
+                and args.scenario_target != "single"):
+            p.error("--scenario-fidelity-pct needs --scenario-target "
+                    "single (timelines live on replicas, not the "
+                    "router)")
+        if args.scenario_max_batch < 1:
+            p.error("--scenario-max-batch must be >= 1")
+        result = run_scenario(
+            args.scenario, seed=args.seed, speed=args.scenario_speed,
+            target=args.scenario_target,
+            replicas=args.fleet_replicas,
+            block_size=args.fleet_block_size,
+            max_batch=args.scenario_max_batch,
+            fidelity_pct=args.scenario_fidelity_pct)
     elif args.mode == "tenants":
         if args.tenant_bulk_clients < 1:
             p.error("--tenant-bulk-clients must be >= 1")

@@ -1,0 +1,452 @@
+"""Scenario engine: trace format round-trips, generator shape
+properties, open-loop replay fidelity on a fake clock, the expect
+gate in both directions, and record -> replay against a live serving
+app (abandon cancellation included).
+
+Format/generator/replay-math tests are pure stdlib (no jax); the live
+tests boot the sharpened-head LLAMA_TINY engine behind a real-socket
+`TestServer` and drive it with the same `HttpTarget` the loadtest
+uses, with `replay()` running in an executor thread (urllib is
+blocking; the server needs the loop)."""
+
+import asyncio
+import socket
+import threading
+import time
+
+import pytest
+
+pytest_plugins = ("aiohttp.pytest_plugin",)
+
+from kubeflow_tpu.obs.timeline import RequestTimeline, TimelineStore
+from kubeflow_tpu.scenarios import (
+    GENERATORS,
+    HttpTarget,
+    Trace,
+    TraceRequest,
+    assert_expect,
+    check_expect,
+    generate,
+    prompt_ids_for,
+    read_trace,
+    record_from_server,
+    replay,
+    summarize,
+    trace_from_store,
+    trace_from_timeline_payloads,
+    write_trace,
+)
+
+# -- trace format ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("shape", sorted(GENERATORS))
+def test_round_trip_is_byte_identical(shape, tmp_path):
+    tr = generate(shape, 7)
+    text = tr.dumps()
+    assert Trace.loads(text).dumps() == text
+    p = tmp_path / "t.jsonl"
+    write_trace(tr, str(p))
+    again = tmp_path / "t2.jsonl"
+    write_trace(read_trace(str(p)), str(again))
+    assert p.read_bytes() == again.read_bytes()
+
+
+@pytest.mark.parametrize("shape", sorted(GENERATORS))
+def test_same_seed_same_bytes_different_seed_differs(shape):
+    assert generate(shape, 3).dumps() == generate(shape, 3).dumps()
+    assert generate(shape, 3).dumps() != generate(shape, 4).dumps()
+
+
+def test_requests_sort_canonically_regardless_of_build_order():
+    a = TraceRequest(id="a", at=1.0, prompt_tokens=4, max_new=2)
+    b = TraceRequest(id="b", at=0.5, prompt_tokens=4, max_new=2)
+    fwd = Trace(name="t", requests=[a, b])
+    rev = Trace(name="t", requests=[b, a])
+    assert fwd.dumps() == rev.dumps()
+    assert [r.id for r in fwd.requests] == ["b", "a"]
+    assert fwd.duration_s == 1.0
+
+
+def test_trace_validation_fails_loudly():
+    ok = dict(prompt_tokens=4, max_new=2)
+    with pytest.raises(ValueError, match="duplicate"):
+        Trace(name="t", requests=[
+            TraceRequest(id="x", at=0, **ok),
+            TraceRequest(id="x", at=1, **ok)])
+    with pytest.raises(ValueError, match="version"):
+        Trace(name="t", requests=[], version=99)
+    with pytest.raises(ValueError, match="unknown bound"):
+        Trace(name="t", requests=[], expect={"ttft_p95_s": {"lt": 1}})
+    with pytest.raises(ValueError, match="prefix_tokens"):
+        TraceRequest(id="x", at=0, prompt_tokens=4, max_new=2,
+                     prefix_tokens=2)  # no group
+    with pytest.raises(ValueError, match="before arrival"):
+        TraceRequest(id="x", at=2.0, abandon_at=1.0, **ok)
+    with pytest.raises(ValueError, match="header"):
+        Trace.loads('{"id":"x","at":0}\n')
+    with pytest.raises(ValueError, match="unsupported"):
+        Trace.loads('{"trace":{"version":2,"name":"t"}}\n')
+
+
+def test_unknown_shape_and_params_fail():
+    with pytest.raises(ValueError, match="unknown scenario shape"):
+        generate("warp-speed", 0)
+    with pytest.raises(TypeError):
+        generate("diurnal", 0, not_a_param=1)
+
+
+# -- generator shape properties --------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_flash_crowd_burst_dominates_baseline(seed):
+    tr = generate("flash_crowd", seed)
+    t0 = tr.meta["burst_t0_s"]
+    t1 = t0 + tr.meta["burst_len_s"]
+    dur = tr.meta["duration_s"]
+    inside = [r for r in tr.requests if t0 <= r.at < t1]
+    outside = [r for r in tr.requests if not (t0 <= r.at < t1)]
+    rate_in = len(inside) / (t1 - t0)
+    rate_out = len(outside) / (dur - (t1 - t0))
+    assert rate_in > 5 * rate_out, (rate_in, rate_out)
+    # the crowd wants the SAME content: one shared prefix group
+    crowd = [r for r in tr.requests if r.id.startswith("c-")]
+    assert crowd and all(r.prefix_group == "crowd" and
+                         r.prefix_tokens > 0 for r in crowd)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_heavy_tail_mass_concentrates(seed):
+    tr = generate("heavy_tail", seed)
+    lens = sorted((r.prompt_tokens for r in tr.requests), reverse=True)
+    assert all(2 <= ln <= tr.meta["max_prompt"] for ln in lens)
+    top = max(1, len(lens) // 10)
+    # Pareto alpha=1.2: the top decile carries far more than its
+    # 10% "fair share" of total prompt tokens
+    assert sum(lens[:top]) / sum(lens) > 0.2
+    with pytest.raises(ValueError, match="unknown dist"):
+        generate("heavy_tail", 0, dist="uniform")
+    # lognormal variant is a distinct deterministic stream
+    assert generate("heavy_tail", 0, dist="lognormal").dumps() \
+        != generate("heavy_tail", 0).dumps()
+
+
+def test_agent_swarm_prefix_reuse_structure():
+    tr = generate("agent_swarm", 5)
+    assert all(r.prefix_group for r in tr.requests)
+    groups = {r.prefix_group for r in tr.requests}
+    assert len(groups) == tr.meta["agents"]
+    # shared-prefix fraction is the shape's whole point
+    reuse = sum(r.prefix_tokens for r in tr.requests) \
+        / sum(r.prompt_tokens for r in tr.requests)
+    assert reuse > 0.4
+    # each agent's conversation grows by step_tokens per turn
+    for g in groups:
+        steps = sorted((r for r in tr.requests if r.prefix_group == g),
+                       key=lambda r: r.at)
+        grows = [b.prompt_tokens - a.prompt_tokens
+                 for a, b in zip(steps, steps[1:])]
+        assert all(d == tr.meta["step_tokens"] for d in grows)
+
+
+def test_abandon_retry_pins_exact_abandon_count():
+    tr = generate("abandon_retry", 3)
+    abandoning = [r for r in tr.requests if r.abandon_at is not None]
+    finals = [r for r in tr.requests if r.abandon_at is None]
+    assert abandoning and finals
+    # expect block pins the exact count — replay outcome is structural,
+    # not a race (see generator docstring)
+    assert tr.expect["abandoned"] == {"min": len(abandoning),
+                                      "max": len(abandoning)}
+    for r in abandoning:
+        # an abandoning attempt asks for more decode than any server
+        # can deliver inside its patience window
+        assert r.max_new == 96 and r.abandon_at > r.at
+    # every retry re-asks the same thing: same prefix group, later at
+    by_ask = {}
+    for r in tr.requests:
+        by_ask.setdefault(r.prefix_group, []).append(r)
+    for attempts in by_ask.values():
+        attempts.sort(key=lambda r: r.at)
+        assert all(r.abandon_at is not None for r in attempts[:-1])
+        assert attempts[-1].abandon_at is None
+
+
+def test_tenant_flood_probe_cadence_and_classes():
+    tr = generate("tenant_flood", 11, duration_s=6, bulk_rps=16)
+    live = [r for r in tr.requests if r.tenant == "live"]
+    bulk = [r for r in tr.requests if r.tenant == "bulk"]
+    assert live and bulk and len(live) + len(bulk) == len(tr.requests)
+    period = tr.meta["live_period_s"]
+    for i, r in enumerate(sorted(live, key=lambda r: r.at)):
+        assert r.at == pytest.approx((i + 1) * period)
+        assert r.priority == "interactive"
+    assert all(r.priority == "batch" for r in bulk)
+    # Poisson flood at 16 rps over 6 s: loose two-sided sanity band
+    assert 0.5 * 16 * 6 < len(bulk) < 2.0 * 16 * 6
+
+
+# -- deterministic prompt derivation ---------------------------------------
+
+
+def test_prompt_ids_share_prefix_within_group_only():
+    a = TraceRequest(id="a", at=0, prompt_tokens=12, max_new=2,
+                     prefix_group="g", prefix_tokens=8)
+    b = TraceRequest(id="b", at=0, prompt_tokens=12, max_new=2,
+                     prefix_group="g", prefix_tokens=8)
+    c = TraceRequest(id="c", at=0, prompt_tokens=12, max_new=2,
+                     prefix_group="h", prefix_tokens=8)
+    ia, ib, ic = (prompt_ids_for(r, 7) for r in (a, b, c))
+    assert ia == prompt_ids_for(a, 7)          # stable
+    assert ia[:8] == ib[:8] != ic[:8]          # group-shared prefix
+    assert ia[8:] != ib[8:]                    # unique remainders
+    assert prompt_ids_for(a, 8) != ia          # seed matters
+    assert len(ia) == 12 and all(5 <= t < 485 for t in ia)
+
+
+# -- open-loop replay on a fake clock --------------------------------------
+
+
+class _FakeTime:
+    """Deterministic clock for replay(): `sleep` only advances time
+    once every worker due so far has reached submit, so arrival
+    stamps are EXACT (no thread race between the dispatcher advancing
+    the clock and a worker reading it)."""
+
+    def __init__(self, arrivals, speed):
+        self.t = 100.0  # nonzero start: catches t0==0 assumptions
+        self.t0 = self.t
+        self.arrivals = sorted(a / speed for a in arrivals)
+        self.landed = 0
+        self.lock = threading.Lock()
+
+    def clock(self):
+        with self.lock:
+            return self.t
+
+    def sleep(self, dt):
+        due = sum(1 for a in self.arrivals
+                  if a <= self.t - self.t0 + 1e-12)
+        while True:
+            with self.lock:
+                if self.landed >= due:
+                    self.t += dt
+                    return
+            time.sleep(0.0005)
+
+
+@pytest.mark.parametrize("speed", [1.0, 4.0])
+def test_replay_arrival_fidelity_fake_clock(speed):
+    tr = Trace(name="t", requests=[
+        TraceRequest(id=f"r{i}", at=at, prompt_tokens=4, max_new=2)
+        for i, at in enumerate([0.0, 0.5, 0.5, 2.0, 2.25])])
+    ft = _FakeTime([r.at for r in tr.requests], speed)
+
+    def submit(req, t0):
+        with ft.lock:
+            ft.landed += 1
+        return {"ok": True, "abandoned": False, "tokens": req.max_new,
+                "ttft_s": 0.01}
+
+    records = replay(tr, submit, speed=speed,
+                     clock=ft.clock, sleep=ft.sleep)
+    assert [r["id"] for r in records] == [f"r{i}" for i in range(5)]
+    for r in records:  # dispatched in trace time, exactly on schedule
+        assert r["dispatched_at"] == r["scheduled_at"]
+    # open-loop wall time is trace duration scaled by speed, exactly
+    assert ft.t - ft.t0 == pytest.approx(tr.duration_s / speed)
+    s = summarize(tr, records, speed=speed)
+    assert s["arrival_skew_p95_s"] == 0.0
+    assert s["completed"] == 5 and s["client_failures"] == 0
+    assert s["duration_s"] == pytest.approx(tr.duration_s / speed)
+
+
+def test_replay_books_submit_exception_as_client_failure():
+    tr = Trace(name="t", requests=[
+        TraceRequest(id="good", at=0, prompt_tokens=4, max_new=2),
+        TraceRequest(id="boom", at=0, prompt_tokens=4, max_new=2)])
+
+    def submit(req, t0):
+        if req.id == "boom":
+            raise RuntimeError("kaput")
+        return {"ok": True, "abandoned": False, "tokens": 2,
+                "ttft_s": 0.01}
+
+    s = summarize(tr, replay(tr, submit))
+    assert s["client_failures"] == 1 and s["completed"] == 1
+    assert "kaput" in s["first_error"]
+    with pytest.raises(ValueError, match="speed"):
+        replay(tr, submit, speed=0)
+
+
+# -- expect gate, both directions ------------------------------------------
+
+
+def test_check_expect_passes_and_fails():
+    result = {"completed": 10, "abandoned": 2, "ttft_p95_s": 0.5,
+              "never_measured": None, "flag": True}
+    assert check_expect({"completed": {"min": 10},
+                         "ttft_p95_s": {"max": 0.5}}, result) == []
+    fails = check_expect({
+        "completed": {"min": 11},          # below min
+        "abandoned": {"max": 1},           # above max
+        "never_measured": {"max": 1},      # None is a violation
+        "missing_key": {"min": 0},         # absent is a violation
+        "flag": {"min": 0},                # bool is not a number
+    }, result)
+    assert len(fails) == 5
+    tr = Trace(name="t", requests=[], expect={"completed": {"min": 1}})
+    with pytest.raises(AssertionError, match="violated its expect"):
+        assert_expect(tr, {"completed": 0})
+    assert_expect(tr, {"completed": 1})  # passes silently
+
+
+# -- recorder: timeline payloads -> trace ----------------------------------
+
+
+def _payload(rid, enq, *, done=True, prompt=8, max_new=4, tenant="",
+             token_times=(), events=()):
+    return {"request_id": rid, "enqueue_monotonic_s": enq,
+            "prompt_tokens": prompt, "max_new": max_new,
+            "tenant": tenant, "done": done,
+            "token_times": list(token_times),
+            "events": [{"t": t, "kind": "k"} for t in events]}
+
+
+def test_recorder_rebases_and_marks_unfinished_abandoned():
+    tr = trace_from_timeline_payloads([
+        _payload("a", 1000.5, tenant="live"),
+        _payload("b", 1002.0, done=False, token_times=[0.1, 0.7]),
+        _payload("warmup", 1000.0, prompt=0),  # skipped, not guessed
+    ])
+    assert [r.id for r in tr.requests] == ["a", "b"]
+    assert tr.requests[0].at == 0.0          # re-based to first enqueue
+    assert tr.requests[0].tenant == "live"
+    assert tr.requests[0].abandon_at is None
+    b = tr.requests[1]
+    assert b.at == pytest.approx(1.5)
+    # unfinished -> hang-up at last observed activity
+    assert b.abandon_at == pytest.approx(1.5 + 0.7)
+    assert tr.generator == "recorded"
+    assert tr.meta["prefix_groups_recovered"] is False
+
+
+def test_recorder_rejects_pre_extension_payloads():
+    with pytest.raises(ValueError, match="recorder fields"):
+        trace_from_timeline_payloads([
+            {"request_id": "a", "ttft_s": 0.1}])
+    with pytest.raises(ValueError, match="no replayable"):
+        trace_from_timeline_payloads([_payload("w", 1.0, prompt=0)])
+
+
+def test_trace_from_store_uses_stamped_shape():
+    clk = lambda: 50.0  # noqa: E731
+    store = TimelineStore(capacity=4)
+    tl = RequestTimeline("r1", tenant="bulk", prompt_tokens=6,
+                         max_new=9, clock=clk)
+    tl.event("enqueue")
+    tl.event("finish")
+    store.add(tl)
+    assert store.ids() == ["r1"]
+    d = store.snapshot()[0].to_dict()
+    # the recorder's contract with the timeline extension
+    assert d["prompt_tokens"] == 6 and d["max_new"] == 9
+    assert d["enqueue_monotonic_s"] == 50.0
+    assert d["output_tokens"] == 0
+    tr = trace_from_store(store, name="cap")
+    assert tr.requests[0].prompt_tokens == 6
+    assert tr.requests[0].max_new == 9
+    assert tr.requests[0].tenant == "bulk"
+
+
+# -- live server: replay, abandon cancellation, record round-trip ----------
+
+
+def _engine(max_len=64):
+    import jax
+
+    from kubeflow_tpu.models import llama
+    from kubeflow_tpu.serving import (
+        EngineConfig,
+        InferenceEngine,
+        LLAMA_FAMILY,
+    )
+
+    cfg = llama.LLAMA_TINY
+    params = dict(llama.init(jax.random.key(0), cfg))
+    params["lm_head"] = params["lm_head"] * 50.0  # argmax can't flip
+    return InferenceEngine(params, cfg, LLAMA_FAMILY,
+                           EngineConfig(max_len=max_len))
+
+
+async def _start_server():
+    from aiohttp.test_utils import TestServer
+
+    from kubeflow_tpu.serving import server as server_lib
+
+    app = server_lib.create_serving_app(
+        {"tiny": _engine()}, continuous=True, max_batch=2)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    server = TestServer(app, port=port)
+    await server.start_server()
+    return server, f"http://127.0.0.1:{port}"
+
+
+@pytest.mark.slow  # boots a real engine: one jax compile (~2 min CPU)
+async def test_live_replay_abandon_cancellation_and_record():
+    """One boot, three acts: (1) replay a mini trace whose impatient
+    request hangs up mid-generate — booked abandoned, not failed, and
+    the slot is released; (2) the expect gate passes on the live
+    result; (3) record the run back off the timeline store and check
+    the capture is a faithful, replayable trace."""
+    server, base = await _start_server()
+    loop = asyncio.get_running_loop()
+    try:
+        tr = Trace(
+            name="mini", seed=9,
+            requests=[
+                # impatient: asks for 48 tokens, hangs up at 0.25 s —
+                # on this engine (compile included) completion cannot
+                # win, so the abandon count is structural
+                TraceRequest(id="a", at=0.0, prompt_tokens=6,
+                             max_new=48, abandon_at=0.25),
+                TraceRequest(id="b", at=0.0, prompt_tokens=6,
+                             max_new=4, tenant="live"),
+            ],
+            expect={"client_failures": {"max": 0},
+                    "abandoned": {"min": 1, "max": 1},
+                    "completed": {"min": 1}})
+        target = HttpTarget(base, seed=tr.seed)
+        records = await loop.run_in_executor(
+            None, lambda: replay(tr, target))
+        result = summarize(tr, records)
+        assert_expect(tr, result)
+        by_id = {r["id"]: r for r in records}
+        assert by_id["a"]["abandoned"] and by_id["a"]["ok"]
+        assert by_id["b"]["tokens"] == 4
+
+        # the abandoned slot is free: a fresh request completes
+        follow = Trace(name="follow", requests=[
+            TraceRequest(id="f", at=0.0, prompt_tokens=6, max_new=4)])
+        frec = await loop.run_in_executor(
+            None, lambda: replay(follow, HttpTarget(base)))
+        assert frec[0]["ok"] and frec[0]["tokens"] == 4
+
+        # record the capture by id (excludes nothing here; ids keep
+        # the capture exact even on a shared store)
+        rec = await loop.run_in_executor(
+            None, lambda: record_from_server(
+                base, ids=["a", "b", "f"], name="cap"))
+        assert {r.id for r in rec.requests} == {"a", "b", "f"}
+        got = {r.id: r for r in rec.requests}
+        assert got["a"].prompt_tokens == 6 and got["a"].max_new == 48
+        assert got["b"].tenant == "live"
+        # recorded offsets re-base to the first enqueue
+        assert min(r.at for r in rec.requests) == 0.0
+        # the capture round-trips like any generated trace
+        assert Trace.loads(rec.dumps()).dumps() == rec.dumps()
+    finally:
+        await server.close()
